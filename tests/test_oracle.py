@@ -1,0 +1,766 @@
+"""Oracle state-machine tests: table-driven scenarios modeled on the
+reference's state_machine_tests.zig (the compatibility suite the TPU kernel
+must also pass, via differential testing against this oracle)."""
+
+import pytest
+
+from tigerbeetle_tpu.constants import NS_PER_S, U63_MAX, U128_MAX, TIMESTAMP_MAX
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags,
+    CreateAccountStatus as AS,
+    CreateTransferStatus as TS,
+    Transfer,
+    TransferFlags as TF,
+    TransferPendingStatus,
+)
+
+TS_BASE = 10_000_000_000  # arbitrary prepare timestamp base
+
+
+def make_accounts(oracle, specs, timestamp=TS_BASE):
+    events = [Account(**spec) for spec in specs]
+    return oracle.create_accounts(events, timestamp)
+
+
+def setup_two_accounts(oracle, **kwargs):
+    results = make_accounts(
+        oracle,
+        [
+            dict(id=1, ledger=1, code=1, **kwargs),
+            dict(id=2, ledger=1, code=1, **kwargs),
+        ],
+    )
+    assert [r.status for r in results] == [AS.created, AS.created]
+    return oracle
+
+
+class TestCreateAccounts:
+    def test_created_and_timestamps(self):
+        oracle = StateMachineOracle()
+        results = make_accounts(oracle, [dict(id=1, ledger=1, code=1), dict(id=2, ledger=1, code=1)])
+        assert [r.status for r in results] == [AS.created, AS.created]
+        # timestamp_event = timestamp - len + index + 1 (reference :3031).
+        assert [r.timestamp for r in results] == [TS_BASE - 1, TS_BASE]
+        assert oracle.accounts[1].timestamp == TS_BASE - 1
+
+    def test_validation_codes(self):
+        oracle = StateMachineOracle()
+        results = make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, reserved=1),
+                dict(id=1, ledger=1, code=1, flags=1 << 10),
+                dict(id=0, ledger=1, code=1),
+                dict(id=U128_MAX, ledger=1, code=1),
+                dict(
+                    id=1,
+                    ledger=1,
+                    code=1,
+                    flags=int(
+                        AccountFlags.debits_must_not_exceed_credits
+                        | AccountFlags.credits_must_not_exceed_debits
+                    ),
+                ),
+                dict(id=1, ledger=1, code=1, debits_pending=1),
+                dict(id=1, ledger=1, code=1, debits_posted=1),
+                dict(id=1, ledger=1, code=1, credits_pending=1),
+                dict(id=1, ledger=1, code=1, credits_posted=1),
+                dict(id=1, ledger=0, code=1),
+                dict(id=1, ledger=1, code=0),
+            ],
+        )
+        assert [r.status for r in results] == [
+            AS.reserved_field,
+            AS.reserved_flag,
+            AS.id_must_not_be_zero,
+            AS.id_must_not_be_int_max,
+            AS.flags_are_mutually_exclusive,
+            AS.debits_pending_must_be_zero,
+            AS.debits_posted_must_be_zero,
+            AS.credits_pending_must_be_zero,
+            AS.credits_posted_must_be_zero,
+            AS.ledger_must_not_be_zero,
+            AS.code_must_not_be_zero,
+        ]
+
+    def test_exists_variants(self):
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [dict(id=1, ledger=1, code=1, user_data_64=7)])
+        results = make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, user_data_64=7, flags=int(AccountFlags.history)),
+                dict(id=1, ledger=1, code=1, user_data_128=9, user_data_64=7),
+                dict(id=1, ledger=1, code=1, user_data_64=8),
+                dict(id=1, ledger=1, code=1, user_data_64=7, user_data_32=3),
+                dict(id=1, ledger=2, code=1, user_data_64=7),
+                dict(id=1, ledger=1, code=2, user_data_64=7),
+                dict(id=1, ledger=1, code=1, user_data_64=7),
+            ],
+            timestamp=TS_BASE + 100,
+        )
+        assert [r.status for r in results] == [
+            AS.exists_with_different_flags,
+            AS.exists_with_different_user_data_128,
+            AS.exists_with_different_user_data_64,
+            AS.exists_with_different_user_data_32,
+            AS.exists_with_different_ledger,
+            AS.exists_with_different_code,
+            AS.exists,
+        ]
+        # exists returns the original timestamp (reference :3101).
+        assert results[-1].timestamp == oracle.accounts[1].timestamp
+
+    def test_timestamp_must_be_zero(self):
+        oracle = StateMachineOracle()
+        results = make_accounts(oracle, [dict(id=1, ledger=1, code=1, timestamp=5)])
+        assert results[0].status == AS.timestamp_must_be_zero
+
+    def test_imported_batch_homogeneity(self):
+        oracle = StateMachineOracle()
+        imported = int(AccountFlags.imported)
+        results = make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=imported, timestamp=100),
+                dict(id=2, ledger=1, code=1),  # not imported in imported batch
+            ],
+        )
+        assert results[0].status == AS.created
+        assert results[0].timestamp == 100
+        assert results[1].status == AS.imported_event_expected
+
+        results = make_accounts(
+            oracle,
+            [
+                dict(id=3, ledger=1, code=1),
+                dict(id=4, ledger=1, code=1, flags=imported, timestamp=200),
+            ],
+            timestamp=TS_BASE + 10,
+        )
+        assert results[0].status == AS.created
+        assert results[1].status == AS.imported_event_not_expected
+
+    def test_imported_timestamp_rules(self):
+        oracle = StateMachineOracle()
+        imported = int(AccountFlags.imported)
+        results = make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=imported, timestamp=0),
+                dict(id=2, ledger=1, code=1, flags=imported, timestamp=TS_BASE + 50),
+                dict(id=3, ledger=1, code=1, flags=imported, timestamp=1000),
+                dict(id=4, ledger=1, code=1, flags=imported, timestamp=999),  # regress
+                dict(id=5, ledger=1, code=1, flags=imported, timestamp=1000),  # equal = regress
+            ],
+        )
+        assert [r.status for r in results] == [
+            AS.imported_event_timestamp_out_of_range,
+            AS.imported_event_timestamp_must_not_advance,
+            AS.created,
+            AS.imported_event_timestamp_must_not_regress,
+            AS.imported_event_timestamp_must_not_regress,
+        ]
+
+
+class TestCreateTransfers:
+    def test_simple_transfer(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        results = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert results[0].status == TS.created
+        assert results[0].timestamp == TS_BASE + 100
+        assert oracle.accounts[1].debits_posted == 100
+        assert oracle.accounts[2].credits_posted == 100
+
+    def test_validation_codes(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        t0 = TS_BASE + 100
+        cases = [
+            (Transfer(id=1, flags=1 << 12), TS.reserved_flag),
+            (Transfer(id=0), TS.id_must_not_be_zero),
+            (Transfer(id=U128_MAX), TS.id_must_not_be_int_max),
+            (Transfer(id=1, debit_account_id=0), TS.debit_account_id_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=U128_MAX), TS.debit_account_id_must_not_be_int_max),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=0), TS.credit_account_id_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=U128_MAX), TS.credit_account_id_must_not_be_int_max),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=1), TS.accounts_must_be_different),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, pending_id=3), TS.pending_id_must_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, timeout=1), TS.timeout_reserved_for_pending_transfer),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, flags=int(TF.closing_debit)), TS.closing_transfer_must_be_pending),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2), TS.ledger_must_not_be_zero),
+            (Transfer(id=1, debit_account_id=1, credit_account_id=2, ledger=1), TS.code_must_not_be_zero),
+            # Transient failures poison the id, so use fresh ids below.
+            (Transfer(id=31, debit_account_id=3, credit_account_id=2, ledger=1, code=1), TS.debit_account_not_found),
+            (Transfer(id=32, debit_account_id=1, credit_account_id=3, ledger=1, code=1), TS.credit_account_not_found),
+            (Transfer(id=33, debit_account_id=1, credit_account_id=2, ledger=9, code=1), TS.transfer_must_have_the_same_ledger_as_accounts),
+        ]
+        for i, (t, expected) in enumerate(cases):
+            results = oracle.create_transfers([t], t0 + i)
+            assert results[0].status == expected, f"case {i}: got {results[0].status!r}"
+
+    def test_accounts_must_have_the_same_ledger(self):
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [dict(id=1, ledger=1, code=1), dict(id=2, ledger=2, code=1)])
+        results = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert results[0].status == TS.accounts_must_have_the_same_ledger
+
+    def test_transient_error_poisons_id(self):
+        """reference: state_machine.zig:3215-3252."""
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        r1 = oracle.create_transfers(
+            [Transfer(id=7, debit_account_id=1, credit_account_id=99, amount=1, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert r1[0].status == TS.credit_account_not_found  # transient
+        r2 = oracle.create_transfers(
+            [Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 101,
+        )
+        assert r2[0].status == TS.id_already_failed
+
+    def test_exists_variants(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        t = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                     user_data_64=5, ledger=1, code=1)
+        assert oracle.create_transfers([t], TS_BASE + 100)[0].status == TS.created
+
+        import dataclasses as dc
+        variants = [
+            (dc.replace(t, flags=int(TF.pending)), TS.exists_with_different_flags),
+            (dc.replace(t, debit_account_id=2, credit_account_id=1), TS.exists_with_different_debit_account_id),
+            (dc.replace(t, amount=50), TS.exists_with_different_amount),
+            (dc.replace(t, user_data_128=1), TS.exists_with_different_user_data_128),
+            (dc.replace(t, user_data_64=6), TS.exists_with_different_user_data_64),
+            (dc.replace(t, user_data_32=1), TS.exists_with_different_user_data_32),
+            (dc.replace(t, code=9), TS.exists_with_different_code),
+            (t, TS.exists),
+        ]
+        for i, (variant, expected) in enumerate(variants):
+            results = oracle.create_transfers([variant], TS_BASE + 200 + i)
+            assert results[0].status == expected, f"variant {i}"
+        # exists returns original transfer's timestamp.
+        assert oracle.create_transfers([t], TS_BASE + 300)[0].timestamp == TS_BASE + 100
+
+    def test_balance_limits(self):
+        oracle = StateMachineOracle()
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=int(AccountFlags.debits_must_not_exceed_credits)),
+                dict(id=2, ledger=1, code=1),
+            ],
+        )
+        # Account 1 has zero credits: any debit > 0 exceeds.
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert r[0].status == TS.exceeds_credits
+        # Fund account 1 with 100 credits, then a 100 debit is allowed, 101 is not.
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=2, credit_account_id=1, amount=100, ledger=1, code=1)],
+            TS_BASE + 101,
+        )
+        assert r[0].status == TS.created
+        r = oracle.create_transfers(
+            [
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=101, ledger=1, code=1),
+                Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+            ],
+            TS_BASE + 103,
+        )
+        assert [x.status for x in r] == [TS.exceeds_credits, TS.created]
+
+    def test_exceeds_debits(self):
+        oracle = StateMachineOracle()
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1),
+                dict(id=2, ledger=1, code=1, flags=int(AccountFlags.credits_must_not_exceed_debits)),
+            ],
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert r[0].status == TS.exceeds_debits
+
+    def test_overflow_codes(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        big = U128_MAX - 10
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=big, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        assert r[0].status == TS.created
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=11, ledger=1, code=1)],
+            TS_BASE + 101,
+        )
+        assert r[0].status == TS.overflows_debits_posted
+
+    def test_overflows_timeout(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        timeout = (U63_MAX - TS_BASE) // NS_PER_S + 1
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
+                      ledger=1, code=1, timeout=timeout, flags=int(TF.pending))],
+            TS_BASE + 100,
+        )
+        assert r[0].status == TS.overflows_timeout
+
+
+class TestLinkedChains:
+    def test_chain_rollback(self):
+        """All-or-nothing: a failing member rolls back the whole chain
+        (reference: execute_create :3116-3150)."""
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=10, ledger=0, code=1),  # fails
+            ],
+            TS_BASE + 100,
+        )
+        assert [x.status for x in r] == [
+            TS.linked_event_failed,
+            TS.linked_event_failed,
+            TS.ledger_must_not_be_zero,
+        ]
+        # Rolled back: no transfers persisted, balances untouched.
+        assert 1 not in oracle.transfers
+        assert oracle.accounts[1].debits_posted == 0
+
+    def test_chain_success(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        assert [x.status for x in r] == [TS.created, TS.created]
+        assert oracle.accounts[1].debits_posted == 15
+
+    def test_chain_open(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+            ],
+            TS_BASE + 100,
+        )
+        assert [x.status for x in r] == [TS.linked_event_failed, TS.linked_event_chain_open]
+        assert 1 not in oracle.transfers
+
+    def test_chains_are_independent(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10, ledger=0, code=1),  # breaks chain 1
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=7, ledger=1, code=1),  # independent
+            ],
+            TS_BASE + 100,
+        )
+        assert [x.status for x in r] == [
+            TS.linked_event_failed,
+            TS.ledger_must_not_be_zero,
+            TS.created,
+        ]
+        assert oracle.accounts[1].debits_posted == 7
+
+    def test_chain_sees_intermediate_state(self):
+        """Events in a chain see prior members' effects (duplicate id inside
+        chain -> exists -> breaks chain since status != created)."""
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        # Duplicate id within chain: the second event sees the first one's
+        # insert (flags differ by `linked`) -> exists_with_different_flags;
+        # that failure breaks the chain.
+        assert [x.status for x in r] == [
+            TS.linked_event_failed,
+            TS.exists_with_different_flags,
+        ]
+
+    def test_rollback_restores_orphans_and_limits(self):
+        """After a rolled-back chain, subsequent events see pre-chain state."""
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        linked = int(TF.linked)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10, ledger=0, code=1),
+                # id=1 again: chain rolled back, so id 1 was never created.
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        assert [x.status for x in r] == [
+            TS.linked_event_failed,
+            TS.ledger_must_not_be_zero,
+            TS.created,
+        ]
+        assert oracle.transfers[1].amount == 3
+
+
+class TestTwoPhase:
+    def _pending(self, oracle, tid=1, amount=100, timeout=0, flags=0):
+        return oracle.create_transfers(
+            [Transfer(id=tid, debit_account_id=1, credit_account_id=2, amount=amount,
+                      ledger=1, code=1, timeout=timeout, flags=int(TF.pending) | flags)],
+            TS_BASE + 100,
+        )
+
+    def test_pending_then_post(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        assert self._pending(oracle)[0].status == TS.created
+        assert oracle.accounts[1].debits_pending == 100
+        assert oracle.accounts[1].debits_posted == 0
+
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].debits_pending == 0
+        assert oracle.accounts[1].debits_posted == 100
+        assert oracle.pending_status[oracle.transfers[1].timestamp] == TransferPendingStatus.posted
+        # Stored transfer inherits from pending (reference :4195-4209).
+        stored = oracle.transfers[2]
+        assert stored.debit_account_id == 1 and stored.credit_account_id == 2
+        assert stored.ledger == 1 and stored.code == 1 and stored.amount == 100
+
+    def test_partial_post(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        self._pending(oracle)
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=40, flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].debits_posted == 40
+        assert oracle.accounts[1].debits_pending == 0  # full pending amount released
+
+    def test_void(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        self._pending(oracle)
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, flags=int(TF.void_pending_transfer))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].debits_pending == 0
+        assert oracle.accounts[1].debits_posted == 0
+
+    def test_post_validation_codes(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        self._pending(oracle)
+        post = int(TF.post_pending_transfer)
+        void = int(TF.void_pending_transfer)
+        cases = [
+            (Transfer(id=2, pending_id=1, flags=post | void), TS.flags_are_mutually_exclusive),
+            (Transfer(id=2, pending_id=1, flags=post | int(TF.pending)), TS.flags_are_mutually_exclusive),
+            (Transfer(id=2, pending_id=0, flags=post), TS.pending_id_must_not_be_zero),
+            (Transfer(id=2, pending_id=U128_MAX, flags=post), TS.pending_id_must_not_be_int_max),
+            (Transfer(id=2, pending_id=2, flags=post), TS.pending_id_must_be_different),
+            (Transfer(id=2, pending_id=1, timeout=1, flags=post), TS.timeout_reserved_for_pending_transfer),
+            # pending_transfer_not_found is transient: poisons its id; use a fresh one.
+            (Transfer(id=99, pending_id=98, flags=post), TS.pending_transfer_not_found),
+            (Transfer(id=2, pending_id=1, debit_account_id=9, flags=post), TS.pending_transfer_has_different_debit_account_id),
+            (Transfer(id=2, pending_id=1, credit_account_id=9, flags=post), TS.pending_transfer_has_different_credit_account_id),
+            (Transfer(id=2, pending_id=1, ledger=9, flags=post), TS.pending_transfer_has_different_ledger),
+            (Transfer(id=2, pending_id=1, code=9, flags=post), TS.pending_transfer_has_different_code),
+            (Transfer(id=2, pending_id=1, amount=101, flags=post), TS.exceeds_pending_transfer_amount),
+            (Transfer(id=2, pending_id=1, amount=99, flags=void), TS.pending_transfer_has_different_amount),
+        ]
+        for i, (t, expected) in enumerate(cases):
+            r = oracle.create_transfers([t], TS_BASE + 200 + i)
+            assert r[0].status == expected, f"case {i}: got {r[0].status!r}"
+
+    def test_pending_transfer_not_pending(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.pending_transfer_not_pending
+
+    def test_already_posted_and_voided(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        self._pending(oracle, tid=1)
+        self._pending_2 = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                      ledger=1, code=1, flags=int(TF.pending))],
+            TS_BASE + 150,
+        )
+        post = int(TF.post_pending_transfer)
+        void = int(TF.void_pending_transfer)
+        assert oracle.create_transfers([Transfer(id=3, pending_id=1, amount=U128_MAX, flags=post)], TS_BASE + 200)[0].status == TS.created
+        assert oracle.create_transfers([Transfer(id=4, pending_id=1, amount=U128_MAX, flags=post)], TS_BASE + 201)[0].status == TS.pending_transfer_already_posted
+        assert oracle.create_transfers([Transfer(id=5, pending_id=2, flags=void)], TS_BASE + 202)[0].status == TS.created
+        assert oracle.create_transfers([Transfer(id=6, pending_id=2, flags=void)], TS_BASE + 203)[0].status == TS.pending_transfer_already_voided
+
+    def test_expiry(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        self._pending(oracle, tid=1, timeout=10)
+        pending_ts = oracle.transfers[1].timestamp
+        expires_at = pending_ts + 10 * NS_PER_S
+        # pulse_next_timestamp starts at timestamp_min ("must scan to know",
+        # reference :4915-4920); an empty scan then schedules the real expiry.
+        assert oracle.pulse_needed(TS_BASE + 101)
+        assert oracle.expire_pending_transfers(TS_BASE + 101) == 0
+        assert oracle.pulse_next_timestamp == expires_at
+
+        # Posting after expiry fails even before the pulse runs (reference :4145-4153).
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer))],
+            expires_at + 100,
+        )
+        assert r[0].status == TS.pending_transfer_expired
+
+        # Pulse expires it.
+        assert oracle.pulse_needed(expires_at + 100)
+        count = oracle.expire_pending_transfers(expires_at + 100)
+        assert count == 1
+        assert oracle.accounts[1].debits_pending == 0
+        assert oracle.pending_status[pending_ts] == TransferPendingStatus.expired
+        assert oracle.pulse_next_timestamp == TIMESTAMP_MAX
+        r = oracle.create_transfers(
+            [Transfer(id=3, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer))],
+            expires_at + 200,
+        )
+        assert r[0].status == TS.pending_transfer_expired
+
+
+class TestClosingAccounts:
+    def test_closing_and_reopen(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=0,
+                      ledger=1, code=1, flags=int(TF.pending | TF.closing_debit))],
+            TS_BASE + 100,
+        )
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].flags & AccountFlags.closed
+
+        # Debiting a closed account fails (transient).
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.debit_account_already_closed
+
+        # Voiding the closing transfer reopens.
+        r = oracle.create_transfers(
+            [Transfer(id=3, pending_id=1, flags=int(TF.void_pending_transfer))],
+            TS_BASE + 300,
+        )
+        assert r[0].status == TS.created
+        assert not (oracle.accounts[1].flags & AccountFlags.closed)
+
+    def test_credit_account_already_closed(self):
+        oracle = StateMachineOracle()
+        setup_two_accounts(oracle)
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=0,
+                      ledger=1, code=1, flags=int(TF.pending | TF.closing_credit))],
+            TS_BASE + 100,
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.credit_account_already_closed
+
+
+class TestBalancing:
+    def test_balancing_debit(self):
+        """reference: :3841-3853 — amount clamped to what keeps debits <= credits."""
+        oracle = StateMachineOracle()
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=int(AccountFlags.debits_must_not_exceed_credits)),
+                dict(id=2, ledger=1, code=1),
+            ],
+        )
+        # Fund account 1 with 70 credits.
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1, amount=70, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        # Balancing debit of up to 100: clamps to 70.
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=1, flags=int(TF.balancing_debit))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.created
+        assert oracle.transfers[2].amount == 70
+        assert oracle.accounts[1].debits_posted == 70
+
+        # Resubmit with same upper bound: exists (reference :4016-4031).
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=1, flags=int(TF.balancing_debit))],
+            TS_BASE + 300,
+        )
+        assert r[0].status == TS.exists
+        # Lower bound than committed amount: exists_with_different_amount.
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=69,
+                      ledger=1, code=1, flags=int(TF.balancing_debit))],
+            TS_BASE + 400,
+        )
+        assert r[0].status == TS.exists_with_different_amount
+
+    def test_balancing_credit(self):
+        oracle = StateMachineOracle()
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1),
+                dict(id=2, ledger=1, code=1, flags=int(AccountFlags.credits_must_not_exceed_debits)),
+            ],
+        )
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1, amount=30, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=1, flags=int(TF.balancing_credit))],
+            TS_BASE + 200,
+        )
+        assert r[0].status == TS.created
+        assert oracle.transfers[2].amount == 30
+
+
+class TestImportedTransfers:
+    def test_imported_flow(self):
+        oracle = StateMachineOracle()
+        imported_a = int(AccountFlags.imported)
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=imported_a, timestamp=100),
+                dict(id=2, ledger=1, code=1, flags=imported_a, timestamp=200),
+            ],
+        )
+        imported_t = int(TF.imported)
+        r = oracle.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=150),  # predates cr account
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=200),  # collides with account ts
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=300),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=250),  # regress
+            ],
+            TS_BASE,
+        )
+        assert [x.status for x in r] == [
+            TS.imported_event_timestamp_must_postdate_credit_account,
+            TS.imported_event_timestamp_must_not_regress,
+            TS.created,
+            TS.imported_event_timestamp_must_not_regress,
+        ]
+        assert r[2].timestamp == 300
+
+    def test_imported_timeout_must_be_zero(self):
+        oracle = StateMachineOracle()
+        imported_a = int(AccountFlags.imported)
+        make_accounts(
+            oracle,
+            [
+                dict(id=1, ledger=1, code=1, flags=imported_a, timestamp=100),
+                dict(id=2, ledger=1, code=1, flags=imported_a, timestamp=200),
+            ],
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1,
+                      code=1, flags=int(TF.imported | TF.pending), timeout=5, timestamp=300)],
+            TS_BASE,
+        )
+        assert r[0].status == TS.imported_event_timeout_must_be_zero
+
+
+class TestScopeRollbackIndexes:
+    def test_rolled_back_imported_account_frees_timestamp(self):
+        """A rolled-back chain must also roll back the timestamp index, or a
+        later imported transfer at that timestamp spuriously regresses
+        (reference: groove scope_close rolls back all indexes,
+        src/lsm/groove.zig:1972-1984)."""
+        oracle = StateMachineOracle()
+        imported = int(AccountFlags.imported)
+        r = oracle.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=imported | int(AccountFlags.linked), timestamp=500),
+                Account(id=2, ledger=1, code=0, flags=imported, timestamp=600),  # fails
+            ],
+            TS_BASE,
+        )
+        assert [x.status for x in r] == [AS.linked_event_failed, AS.code_must_not_be_zero]
+        oracle.create_accounts(
+            [
+                Account(id=3, ledger=1, code=1, flags=imported, timestamp=100),
+                Account(id=4, ledger=1, code=1, flags=imported, timestamp=200),
+            ],
+            TS_BASE + 10,
+        )
+        r = oracle.create_transfers(
+            [Transfer(id=9, debit_account_id=3, credit_account_id=4, amount=1,
+                      ledger=1, code=1, flags=int(TF.imported), timestamp=500)],
+            TS_BASE + 20,
+        )
+        assert r[0].status == TS.created

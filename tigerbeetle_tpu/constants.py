@@ -1,0 +1,56 @@
+"""Cluster-critical constants.
+
+TPU-native rebuild of the reference's two-level comptime config
+(reference: src/config.zig:153-163, src/constants.zig). These are the
+consensus-critical values that must match across a cluster; they are plain
+Python ints here, frozen at import time, and baked into jitted kernels as
+static shapes (the TPU analog of comptime).
+"""
+
+# --- Wire / message plane (reference: src/config.zig:159, src/vsr/message_header.zig:72) ---
+MESSAGE_SIZE_MAX = 1024 * 1024  # 1 MiB
+HEADER_SIZE = 256
+MESSAGE_BODY_SIZE_MAX = MESSAGE_SIZE_MAX - HEADER_SIZE
+
+# --- Data model (reference: src/tigerbeetle.zig:10-43,85-116) ---
+ACCOUNT_SIZE = 128
+TRANSFER_SIZE = 128
+RESULT_SIZE = 16  # CreateAccountResult / CreateTransferResult (tigerbeetle.zig:471-493)
+
+# Maximum events in one create_accounts/create_transfers batch:
+# (1 MiB - 256 B header) / 128 B = 8190 (reference: src/state_machine.zig:336-380,
+# docs/concepts/performance.md:27).  This is the static batch shape of the TPU kernel.
+BATCH_MAX = MESSAGE_BODY_SIZE_MAX // TRANSFER_SIZE
+assert BATCH_MAX == 8190
+
+# --- Integer domains ---
+U128_MAX = (1 << 128) - 1
+U64_MAX = (1 << 64) - 1
+U63_MAX = (1 << 63) - 1
+U32_MAX = (1 << 32) - 1
+U16_MAX = (1 << 16) - 1
+
+# Timestamps are u63; the MSB of the u64 is reserved as the LSM tombstone flag
+# (reference: src/lsm/timestamp_range.zig:5-10).
+TIMESTAMP_MIN = 1
+TIMESTAMP_MAX = U63_MAX
+
+NS_PER_S = 1_000_000_000
+
+# --- VSR (reference: src/config.zig:153-163) ---
+JOURNAL_SLOT_COUNT = 1024
+PIPELINE_PREPARE_QUEUE_MAX = 8
+CLIENTS_MAX = 64
+SUPERBLOCK_COPIES = 4
+VSR_OPERATIONS_RESERVED = 128
+
+# --- LSM (reference: src/config.zig:162-163) ---
+LSM_LEVELS = 7
+LSM_GROWTH_FACTOR = 8
+LSM_COMPACTION_OPS = 32  # ops per compaction "bar"
+BLOCK_SIZE = 512 * 1024  # grid block size
+
+
+def timestamp_valid(timestamp: int) -> bool:
+    """reference: src/lsm/timestamp_range.zig:36-39"""
+    return TIMESTAMP_MIN <= timestamp <= TIMESTAMP_MAX

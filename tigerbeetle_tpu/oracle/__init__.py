@@ -1,0 +1,10 @@
+"""Sequential, spec-exact oracle of the accounting state machine.
+
+This package is the ground-truth semantics the TPU kernels are differentially
+tested against (the stand-in for running the reference Zig state machine, which
+this environment cannot build). reference: src/state_machine.zig.
+"""
+
+from .state_machine import StateMachineOracle, AccountEventRecord
+
+__all__ = ["StateMachineOracle", "AccountEventRecord"]
