@@ -1,0 +1,445 @@
+"""Differential tests: JAX kernel vs oracle, bit-identical results and state.
+
+This is the TPU analog of the reference's state-machine oracle tests
+(src/state_machine_tests.zig) plus a state_machine_fuzz-style randomized
+generator with bit-edge-biased integers (src/state_machine_fuzz.zig:17-35).
+"""
+
+import random
+
+import pytest
+
+from tigerbeetle_tpu.constants import NS_PER_S, U128_MAX
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops import run_create_accounts, run_create_transfers
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags,
+    Transfer,
+    TransferFlags as TF,
+)
+
+TS_BASE = 10_000_000_000_000
+
+
+def assert_state_equal(oracle: StateMachineOracle, kstate: StateMachineOracle):
+    assert oracle.accounts == kstate.accounts
+    assert oracle.transfers == kstate.transfers
+    assert oracle.orphaned == kstate.orphaned
+    assert oracle.pending_status == kstate.pending_status
+    assert oracle.expiry == kstate.expiry
+    assert oracle.accounts_key_max == kstate.accounts_key_max
+    assert oracle.transfers_key_max == kstate.transfers_key_max
+    assert oracle.pulse_next_timestamp == kstate.pulse_next_timestamp
+    assert oracle.account_by_timestamp == kstate.account_by_timestamp
+    assert oracle.transfer_by_timestamp == kstate.transfer_by_timestamp
+
+
+class Differ:
+    """Drives the same operations through the oracle and the kernel path."""
+
+    def __init__(self):
+        self.oracle = StateMachineOracle()
+        self.kstate = StateMachineOracle()  # plain state store for the kernel
+
+    def create_accounts(self, events, timestamp):
+        expect = self.oracle.create_accounts(events, timestamp)
+        got = run_create_accounts(self.kstate, events, timestamp)
+        self._compare(expect, got, events)
+        return expect
+
+    def create_transfers(self, events, timestamp):
+        expect = self.oracle.create_transfers(events, timestamp)
+        got = run_create_transfers(self.kstate, events, timestamp)
+        self._compare(expect, got, events)
+        return expect
+
+    def _compare(self, expect, got, events):
+        for i, (e, g) in enumerate(zip(expect, got)):
+            assert (e.timestamp, e.status) == (g.timestamp, g.status), (
+                f"event {i}: oracle ({e.timestamp}, {e.status!r}) != "
+                f"kernel ({g.timestamp}, {g.status!r})\n  event: {events[i]}"
+            )
+        assert_state_equal(self.oracle, self.kstate)
+
+
+def two_accounts(d: Differ, **kwargs):
+    d.create_accounts(
+        [Account(id=1, ledger=1, code=1, **kwargs), Account(id=2, ledger=1, code=1, **kwargs)],
+        TS_BASE,
+    )
+
+
+class TestKernelScenarios:
+    def test_simple_and_errors(self):
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=0, ledger=1, code=1),
+                Transfer(id=0),
+                Transfer(id=U128_MAX),
+                Transfer(id=3, debit_account_id=1, credit_account_id=1, ledger=1, code=1),
+                Transfer(id=3, debit_account_id=1, credit_account_id=9, ledger=1, code=1),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, ledger=1, code=1),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=99, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_account_scenarios(self):
+        d = Differ()
+        d.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1),
+                Account(id=1, ledger=1, code=1),  # exists
+                Account(id=1, ledger=2, code=1),  # exists_with_different_ledger
+                Account(id=0, ledger=1, code=1),
+                Account(id=2, ledger=1, code=1, reserved=9),
+                Account(id=3, ledger=1, code=1, debits_posted=5),
+                Account(id=4, ledger=0, code=1),
+                Account(id=5, ledger=1, code=1, flags=int(AccountFlags.history)),
+            ],
+            TS_BASE,
+        )
+
+    def test_account_chains(self):
+        d = Differ()
+        linked = int(AccountFlags.linked)
+        d.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=linked),
+                Account(id=2, ledger=0, code=1),  # break -> rollback
+                Account(id=3, ledger=1, code=1, flags=linked),
+                Account(id=4, ledger=1, code=1),  # chain ok
+                Account(id=1, ledger=1, code=1),  # created (first was rolled back)
+                Account(id=5, ledger=1, code=1, flags=linked),  # chain open at end
+            ],
+            TS_BASE,
+        )
+
+    def test_transfer_chains_with_rollback(self):
+        d = Differ()
+        two_accounts(d)
+        linked = int(TF.linked)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10, ledger=1, code=1, flags=linked),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=10, ledger=0, code=1),
+                Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=7, ledger=1, code=1),
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=3, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_two_phase(self):
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                      ledger=1, code=1, flags=int(TF.pending))],
+            TS_BASE + 100,
+        )
+        d.create_transfers(
+            [
+                Transfer(id=2, pending_id=1, amount=40, flags=int(TF.post_pending_transfer)),
+                Transfer(id=3, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
+                Transfer(id=4, pending_id=99, flags=int(TF.void_pending_transfer)),
+            ],
+            TS_BASE + 200,
+        )
+
+    def test_two_phase_same_batch(self):
+        """Pending created and posted within one batch (batch-store p lookup)."""
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100,
+                         ledger=1, code=1, flags=int(TF.pending), timeout=60),
+                Transfer(id=2, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
+                Transfer(id=3, pending_id=1, flags=int(TF.void_pending_transfer)),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_void_and_closing(self):
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=0,
+                         ledger=1, code=1, flags=int(TF.pending | TF.closing_debit)),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+                Transfer(id=3, pending_id=1, flags=int(TF.void_pending_transfer)),
+                Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_balancing(self):
+        d = Differ()
+        d.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=int(AccountFlags.debits_must_not_exceed_credits)),
+                Account(id=2, ledger=1, code=1, flags=int(AccountFlags.credits_must_not_exceed_debits)),
+                Account(id=3, ledger=1, code=1),
+            ],
+            TS_BASE,
+        )
+        d.create_transfers(
+            [Transfer(id=1, debit_account_id=3, credit_account_id=1, amount=70, ledger=1, code=1)],
+            TS_BASE + 100,
+        )
+        d.create_transfers(
+            [
+                Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=100,
+                         ledger=1, code=1, flags=int(TF.balancing_debit)),
+                Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=100,
+                         ledger=1, code=1, flags=int(TF.balancing_debit)),  # exists
+                Transfer(id=2, debit_account_id=1, credit_account_id=3, amount=69,
+                         ledger=1, code=1, flags=int(TF.balancing_debit)),  # different_amount
+            ],
+            TS_BASE + 200,
+        )
+
+    def test_balance_limits(self):
+        d = Differ()
+        d.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=int(AccountFlags.debits_must_not_exceed_credits)),
+                Account(id=2, ledger=1, code=1),
+            ],
+            TS_BASE,
+        )
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=2, credit_account_id=1, amount=100, ledger=1, code=1),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=101, ledger=1, code=1),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=101, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_overflows(self):
+        d = Differ()
+        two_accounts(d)
+        big = U128_MAX - 10
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=big, ledger=1, code=1),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=11, ledger=1, code=1),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=5,
+                         ledger=1, code=1, flags=int(TF.pending)),
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_expiry_pulse_scheduling(self):
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, timeout=60, flags=int(TF.pending)),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, timeout=30, flags=int(TF.pending)),
+            ],
+            TS_BASE + 100,
+        )
+        d.create_transfers(
+            [Transfer(id=3, pending_id=2, amount=U128_MAX, flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200,
+        )
+        # Posting after expiry fails identically.
+        d.create_transfers(
+            [Transfer(id=4, pending_id=1, amount=U128_MAX, flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200 + 61 * NS_PER_S,
+        )
+
+    def test_imported(self):
+        d = Differ()
+        imported_a = int(AccountFlags.imported)
+        d.create_accounts(
+            [
+                Account(id=1, ledger=1, code=1, flags=imported_a, timestamp=100),
+                Account(id=2, ledger=1, code=1, flags=imported_a, timestamp=200),
+                Account(id=3, ledger=1, code=1, flags=imported_a, timestamp=150),  # regress
+                Account(id=4, ledger=1, code=1),  # expected
+            ],
+            TS_BASE,
+        )
+        imported_t = int(TF.imported)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=150),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=250),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=240),  # regress
+                Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=10,
+                         ledger=1, code=1, flags=imported_t, timestamp=200),  # acct collision
+            ],
+            TS_BASE + 100,
+        )
+
+    def test_transient_poisoning_in_batch(self):
+        d = Differ()
+        two_accounts(d)
+        d.create_transfers(
+            [
+                Transfer(id=7, debit_account_id=1, credit_account_id=99, amount=1, ledger=1, code=1),
+                Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        d.create_transfers(
+            [Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 200,
+        )
+
+
+# ------------------------------------------------------------------- fuzzing
+
+EDGE_AMOUNTS = [0, 1, 2, 99, 100, (1 << 64) - 1, 1 << 64, (1 << 127), U128_MAX - 1, U128_MAX]
+
+
+def random_transfer(rng: random.Random, id_space: int, acct_space: int) -> Transfer:
+    flags = 0
+    r = rng.random()
+    if r < 0.15:
+        flags |= int(TF.pending)
+    elif r < 0.25:
+        flags |= int(TF.post_pending_transfer)
+    elif r < 0.35:
+        flags |= int(TF.void_pending_transfer)
+    if rng.random() < 0.15:
+        flags |= int(TF.linked)
+    if rng.random() < 0.08:
+        flags |= int(TF.balancing_debit)
+    if rng.random() < 0.08:
+        flags |= int(TF.balancing_credit)
+    if rng.random() < 0.05:
+        flags |= int(TF.closing_debit)
+    if rng.random() < 0.05:
+        flags |= int(TF.closing_credit)
+    if rng.random() < 0.02:
+        flags |= 1 << rng.randrange(9, 16)  # reserved padding bits
+    return Transfer(
+        id=rng.randrange(0, id_space) if rng.random() < 0.9 else rng.choice([0, U128_MAX]),
+        debit_account_id=rng.randrange(0, acct_space),
+        credit_account_id=rng.randrange(0, acct_space),
+        amount=rng.choice(EDGE_AMOUNTS) if rng.random() < 0.5 else rng.randrange(0, 1000),
+        pending_id=rng.randrange(0, id_space) if rng.random() < 0.5 else 0,
+        user_data_128=rng.choice([0, 1, U128_MAX]),
+        user_data_64=rng.choice([0, 7]),
+        user_data_32=rng.choice([0, 3]),
+        timeout=rng.choice([0, 0, 0, 1, 60, 0xFFFFFFFF]),
+        ledger=rng.choice([0, 1, 1, 1, 2]),
+        code=rng.choice([0, 1, 1, 1, 9]),
+        flags=flags,
+        timestamp=rng.choice([0, 0, 0, 5]),
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_fuzz_transfers(seed):
+    rng = random.Random(seed)
+    d = Differ()
+    accounts = []
+    for aid in range(1, 8):
+        aflags = 0
+        if rng.random() < 0.3:
+            aflags |= int(AccountFlags.debits_must_not_exceed_credits)
+        elif rng.random() < 0.3:
+            aflags |= int(AccountFlags.credits_must_not_exceed_debits)
+        if rng.random() < 0.2:
+            aflags |= int(AccountFlags.history)
+        accounts.append(Account(id=aid, ledger=1, code=1, flags=aflags))
+    d.create_accounts(accounts, TS_BASE)
+
+    ts = TS_BASE + 1000
+    for batch_idx in range(6):
+        batch = [random_transfer(rng, id_space=30, acct_space=10) for _ in range(rng.randrange(1, 40))]
+        # Never leave a chain open by accident unless the rng wants it.
+        d.create_transfers(batch, ts)
+        ts += 10_000_000_000
+
+
+@pytest.mark.parametrize("seed", [11, 12])
+def test_fuzz_accounts(seed):
+    rng = random.Random(seed)
+    d = Differ()
+    ts = TS_BASE
+    for _ in range(4):
+        batch = []
+        for _ in range(rng.randrange(1, 25)):
+            flags = 0
+            if rng.random() < 0.2:
+                flags |= int(AccountFlags.linked)
+            if rng.random() < 0.15:
+                flags |= int(AccountFlags.debits_must_not_exceed_credits)
+            if rng.random() < 0.15:
+                flags |= int(AccountFlags.credits_must_not_exceed_debits)
+            if rng.random() < 0.03:
+                flags |= 1 << rng.randrange(6, 16)
+            batch.append(
+                Account(
+                    id=rng.randrange(0, 15) if rng.random() < 0.9 else rng.choice([0, U128_MAX]),
+                    debits_pending=rng.choice([0, 0, 0, 1]),
+                    user_data_64=rng.choice([0, 7]),
+                    ledger=rng.choice([0, 1, 1, 2]),
+                    code=rng.choice([0, 1, 1]),
+                    flags=flags,
+                    timestamp=rng.choice([0, 0, 0, 5]),
+                )
+            )
+        d.create_accounts(batch, ts)
+        ts += 10_000_000_000
+
+
+class TestRollbackOrdering:
+    def test_close_then_void_in_rolled_back_chain(self):
+        """LIFO rollback: chain [close, void-reopen, fail] must restore the
+        pre-chain closed bit (absolute-snapshot restores unwind newest-first)."""
+        d = Differ()
+        two_accounts(d)
+        linked = int(TF.linked)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5,
+                         ledger=1, code=1, flags=linked | int(TF.pending | TF.closing_debit)),
+                Transfer(id=2, pending_id=1, flags=linked | int(TF.void_pending_transfer)),
+                Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=1, ledger=0, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        # Account 1 must be open again in BOTH paths.
+        d.create_transfers(
+            [Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1)],
+            TS_BASE + 200,
+        )
+
+    def test_pulse_not_restored_on_rollback(self):
+        """A rolled-back pending+timeout still lowers pulse_next_timestamp
+        (state-machine state is not groove state; reference keeps it)."""
+        d = Differ()
+        two_accounts(d)
+        # Settle pulse_next to timestamp_max first.
+        d.oracle.expire_pending_transfers(TS_BASE + 10)
+        d.kstate.expire_pending_transfers(TS_BASE + 10)
+        linked = int(TF.linked)
+        d.create_transfers(
+            [
+                Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=5,
+                         ledger=1, code=1, timeout=60, flags=linked | int(TF.pending)),
+                Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=1, ledger=0, code=1),
+            ],
+            TS_BASE + 100,
+        )
+        assert d.oracle.pulse_next_timestamp == TS_BASE + 99 + 60 * NS_PER_S

@@ -1,0 +1,32 @@
+"""TPU kernels: batched state-machine validation as JAX programs.
+
+The reference's prefetch/execute split (docs/ARCHITECTURE.md:424-434) makes
+commit a pure function (state_cache, batch) -> (state_delta, results); these
+modules are that function, compiled by XLA:
+
+- u128: exact unsigned 128-bit arithmetic as 2xuint64 limbs.
+- batch: host-side prefetch — gathers the accounts/transfers a batch could
+  touch into SoA caches plus precomputed indices (the TPU analog of
+  src/lsm/groove.zig:996-1450 prefetch machinery).
+- create_kernels: the create_accounts / create_transfers batch validators
+  (reference hot loop: src/state_machine.zig:3002-4299).
+"""
+
+from . import u128
+from .batch import prefetch_create_transfers, prefetch_create_accounts
+from .create_kernels import (
+    create_transfers_kernel,
+    create_accounts_kernel,
+    run_create_transfers,
+    run_create_accounts,
+)
+
+__all__ = [
+    "u128",
+    "prefetch_create_transfers",
+    "prefetch_create_accounts",
+    "create_transfers_kernel",
+    "create_accounts_kernel",
+    "run_create_transfers",
+    "run_create_accounts",
+]

@@ -1,0 +1,978 @@
+"""Batch create_accounts / create_transfers validation kernels.
+
+The reference hot loop (src/state_machine.zig:3002-3213 execute_create,
+:3719-3986 create_transfer, :4053-4299 post_or_void_pending_transfer) as a
+JAX program: a lax.fori_loop over the batch carrying device-resident SoA
+state. Every data-dependent access is an array gather by an index the host
+prefetch precomputed (ops/batch.py); linked-chain rollback replays an undo
+log (the device analog of groove scope_open/scope_close,
+src/lsm/groove.zig:1963-1984).
+
+Status selection: each validation check contributes a (condition, wire-code)
+pair in the reference's *check order*; folding them in reverse with
+jnp.where makes the first failing check win — exactly the sequential
+early-return semantics, branch-free.
+
+This sequential kernel is the correctness baseline (bit-identical results vs
+the oracle); the vectorized fast-path kernel lives in ops/parallel_kernel.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import NS_PER_S, TIMESTAMP_MAX, U63_MAX
+from ..types import (
+    Account,
+    AccountFlags,
+    CreateAccountResult,
+    CreateAccountStatus,
+    CreateTransferResult,
+    CreateTransferStatus,
+    Transfer,
+    TransferFlags,
+    TransferPendingStatus,
+)
+from . import u128
+from .batch import (
+    accounts_to_arrays,
+    prefetch_create_accounts,
+    prefetch_create_transfers,
+    transfers_to_arrays,
+)
+
+# ---------------------------------------------------------------- constants
+
+_CREATED = np.uint32(0xFFFFFFFF)
+_TS = {s.name: np.uint32(int(s)) for s in CreateTransferStatus}
+_AS = {s.name: np.uint32(int(s)) for s in CreateAccountStatus}
+
+# Transfer flag bits (types.TransferFlags).
+_F_LINKED = np.uint32(1 << 0)
+_F_PENDING = np.uint32(1 << 1)
+_F_POST = np.uint32(1 << 2)
+_F_VOID = np.uint32(1 << 3)
+_F_BAL_DR = np.uint32(1 << 4)
+_F_BAL_CR = np.uint32(1 << 5)
+_F_CLOSE_DR = np.uint32(1 << 6)
+_F_CLOSE_CR = np.uint32(1 << 7)
+_F_IMPORTED = np.uint32(1 << 8)
+_TF_PADDING = np.uint32(0xFFFF & ~0x1FF)
+
+# Account flag bits (types.AccountFlags).
+_A_LINKED = np.uint32(1 << 0)
+_A_DR_LIMIT = np.uint32(1 << 1)  # debits_must_not_exceed_credits
+_A_CR_LIMIT = np.uint32(1 << 2)  # credits_must_not_exceed_debits
+_A_IMPORTED = np.uint32(1 << 4)
+_A_CLOSED = np.uint32(1 << 5)
+_AF_PADDING = np.uint32(0xFFFF & ~0x3F)
+
+_PS_PENDING = np.int32(int(TransferPendingStatus.pending))
+_PS_POSTED = np.int32(int(TransferPendingStatus.posted))
+_PS_VOIDED = np.int32(int(TransferPendingStatus.voided))
+_PS_EXPIRED = np.int32(int(TransferPendingStatus.expired))
+
+_TRANSIENT_CODES = tuple(
+    np.uint32(int(s)) for s in CreateTransferStatus if s.transient()
+)
+
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+_NSPS = np.uint64(NS_PER_S)
+_U63_MAX = np.uint64(U63_MAX)
+
+
+def _first_failure(checks, created=_CREATED):
+    """Fold (cond, code) pairs so the earliest listed failing check wins."""
+    status = jnp.uint32(created)
+    for cond, code in reversed(checks):
+        status = jnp.where(cond, jnp.uint32(code), status)
+    return status
+
+
+def _flag(flags, bit):
+    return (flags & bit) != 0
+
+
+# ======================================================== create_transfers
+
+def _ct_init_state(inputs):
+    N = inputs["event"]["id_lo"].shape[0]
+    A = inputs["acct"]["dp_hi"].shape[0]
+    z64 = functools.partial(jnp.zeros, dtype=jnp.uint64)
+    z32u = functools.partial(jnp.zeros, dtype=jnp.uint32)
+    z32i = functools.partial(jnp.zeros, dtype=jnp.int32)
+    zb = functools.partial(jnp.zeros, dtype=jnp.bool_)
+    return dict(
+        # Mutable account cache (balances + flags).
+        a_dp_hi=jnp.asarray(inputs["acct"]["dp_hi"]),
+        a_dp_lo=jnp.asarray(inputs["acct"]["dp_lo"]),
+        a_dpos_hi=jnp.asarray(inputs["acct"]["dpos_hi"]),
+        a_dpos_lo=jnp.asarray(inputs["acct"]["dpos_lo"]),
+        a_cp_hi=jnp.asarray(inputs["acct"]["cp_hi"]),
+        a_cp_lo=jnp.asarray(inputs["acct"]["cp_lo"]),
+        a_cpos_hi=jnp.asarray(inputs["acct"]["cpos_hi"]),
+        a_cpos_lo=jnp.asarray(inputs["acct"]["cpos_lo"]),
+        a_flags=jnp.asarray(inputs["acct"]["flags"]),
+        # Batch store: transfers created by earlier events in this batch,
+        # indexed by slot (= first index of the id in the batch).
+        s_created=zb(N), s_orphaned=zb(N),
+        s_amt_hi=z64(N), s_amt_lo=z64(N),
+        s_dr_idx=z32i(N), s_cr_idx=z32i(N),
+        s_dr_hi=z64(N), s_dr_lo=z64(N),
+        s_cr_hi=z64(N), s_cr_lo=z64(N),
+        s_pid_hi=z64(N), s_pid_lo=z64(N),
+        s_ud128_hi=z64(N), s_ud128_lo=z64(N),
+        s_ud64=z64(N), s_ud32=z32u(N),
+        s_timeout=z32u(N), s_ledger=z32u(N), s_code=z32u(N),
+        s_flags=z32u(N), s_ts=z64(N),
+        s_pstat=z32i(N), s_expires=z64(N),
+        # Committed pending statuses (mutable: post/void flips them).
+        tc_pstat=jnp.asarray(inputs["tc"]["pending_status"]),
+        # Undo log for chain rollback.
+        rb_kind=z32i(N),  # 0 none, 1 regular, 2 pending, 3 post, 4 void
+        rb_dr_idx=z32i(N), rb_cr_idx=z32i(N),
+        rb_amt_hi=z64(N), rb_amt_lo=z64(N),
+        rb_pamt_hi=z64(N), rb_pamt_lo=z64(N),
+        rb_p_batch=zb(N), rb_p_idx=z32i(N),
+        rb_dr_closed=zb(N), rb_cr_closed=zb(N),
+        # Scalars.
+        key_max=jnp.asarray(inputs["transfers_key_max"], dtype=jnp.uint64),
+        # pulse_next_timestamp is NOT restored on chain rollback (it is
+        # state-machine state, not groove state — see oracle _Scope note).
+        pulse_next=jnp.asarray(inputs["pulse_next"], dtype=jnp.uint64),
+        chain_start=jnp.int32(-1),
+        chain_broken=jnp.bool_(False),
+        chain_key_max=jnp.uint64(0),
+        # Results.
+        r_ts=z64(N), r_status=z32u(N),
+    )
+
+
+def _gather_event(ev, i):
+    return {k: ev[k][i] for k in ev}
+
+
+def _acct_row(st, inputs, idx):
+    """Gather one account-cache row (dynamic balances/flags, static rest)."""
+    return dict(
+        exists=inputs["acct"]["exists"][idx],
+        dp_hi=st["a_dp_hi"][idx], dp_lo=st["a_dp_lo"][idx],
+        dpos_hi=st["a_dpos_hi"][idx], dpos_lo=st["a_dpos_lo"][idx],
+        cp_hi=st["a_cp_hi"][idx], cp_lo=st["a_cp_lo"][idx],
+        cpos_hi=st["a_cpos_hi"][idx], cpos_lo=st["a_cpos_lo"][idx],
+        flags=st["a_flags"][idx],
+        ledger=inputs["acct"]["ledger"][idx],
+        code=inputs["acct"]["code"][idx],
+        ts=inputs["acct"]["ts"][idx],
+    )
+
+
+_P_FIELDS = (
+    "amt_hi", "amt_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
+    "ud128_hi", "ud128_lo", "ud64", "ud32", "timeout", "ledger", "code",
+    "flags", "ts", "dr_idx", "cr_idx",
+)
+
+
+def _transfer_row(st, inputs, from_cache, cache_idx, slot):
+    """Gather a stored transfer from either the committed cache or the batch
+    store (reference: grooves.transfers.get, src/state_machine.zig:3734)."""
+    ci = jnp.maximum(cache_idx, 0)
+    sl = jnp.maximum(slot, 0)
+    tc = inputs["tc"]
+    row = {}
+    for f in _P_FIELDS:
+        row[f] = jnp.where(from_cache, tc[f][ci], st[f"s_{f}"][sl])
+    row["pid_hi"] = jnp.where(from_cache, tc["pid_hi"][ci], st["s_pid_hi"][sl])
+    row["pid_lo"] = jnp.where(from_cache, tc["pid_lo"][ci], st["s_pid_lo"][sl])
+    row["pstat"] = jnp.where(from_cache, st["tc_pstat"][ci], st["s_pstat"][sl])
+    row["expires"] = jnp.where(from_cache, tc["expires_at"][ci], st["s_expires"][sl])
+    return row
+
+
+def _ct_eval_exists(e, t_row, p_row):
+    """create_transfer_exists + post_or_void_pending_transfer_exists
+    (reference: src/state_machine.zig:3988-4051, 4301-4382)."""
+    is_post = _flag(e["flags"], _F_POST)
+    is_void = _flag(e["flags"], _F_VOID)
+    pv = is_post | is_void
+    balancing = _flag(e["flags"], _F_BAL_DR) | _flag(e["flags"], _F_BAL_CR)
+
+    t_amt_zero = u128.is_zero(e["amt_hi"], e["amt_lo"])
+    t_amt_max = u128.is_max(e["amt_hi"], e["amt_lo"])
+    amt_ne_e = ~u128.eq(e["amt_hi"], e["amt_lo"], t_row["amt_hi"], t_row["amt_lo"])
+    eamt_ne_pamt = ~u128.eq(t_row["amt_hi"], t_row["amt_lo"], p_row["amt_hi"], p_row["amt_lo"])
+
+    # Amount mismatch, per branch:
+    amt_diff_regular = jnp.where(
+        balancing,
+        u128.lt(e["amt_hi"], e["amt_lo"], t_row["amt_hi"], t_row["amt_lo"]),
+        amt_ne_e,
+    )
+    amt_diff_pv = jnp.where(
+        is_void,
+        jnp.where(t_amt_zero, eamt_ne_pamt, amt_ne_e),
+        jnp.where(t_amt_max, eamt_ne_pamt, amt_ne_e),
+    )
+
+    def ud_diff(tf, ef, pf):
+        zero = tf == 0
+        return jnp.where(pv, jnp.where(zero, ef != pf, tf != ef), tf != ef)
+
+    ud128_zero = u128.is_zero(e["ud128_hi"], e["ud128_lo"])
+    ud128_ne_e = ~u128.eq(e["ud128_hi"], e["ud128_lo"], t_row["ud128_hi"], t_row["ud128_lo"])
+    ud128_e_ne_p = ~u128.eq(t_row["ud128_hi"], t_row["ud128_lo"], p_row["ud128_hi"], p_row["ud128_lo"])
+    ud128_diff = jnp.where(pv, jnp.where(ud128_zero, ud128_e_ne_p, ud128_ne_e), ud128_ne_e)
+
+    dr_ne = ~u128.eq(e["dr_hi"], e["dr_lo"], t_row["dr_hi"], t_row["dr_lo"])
+    cr_ne = ~u128.eq(e["cr_hi"], e["cr_lo"], t_row["cr_hi"], t_row["cr_lo"])
+    dr_nonzero = ~u128.is_zero(e["dr_hi"], e["dr_lo"])
+    cr_nonzero = ~u128.is_zero(e["cr_hi"], e["cr_lo"])
+    dr_diff = jnp.where(pv, dr_nonzero & dr_ne, dr_ne)
+    cr_diff = jnp.where(pv, cr_nonzero & cr_ne, cr_ne)
+
+    ledger_diff = jnp.where(
+        pv,
+        (e["ledger"] != 0) & (e["ledger"] != t_row["ledger"]),
+        e["ledger"] != t_row["ledger"],
+    )
+    code_diff = jnp.where(
+        pv,
+        (e["code"] != 0) & (e["code"] != t_row["code"]),
+        e["code"] != t_row["code"],
+    )
+
+    checks = [
+        ((e["flags"] & 0xFFFF) != (t_row["flags"] & 0xFFFF), _TS["exists_with_different_flags"]),
+        (~u128.eq(e["pid_hi"], e["pid_lo"], t_row["pid_hi"], t_row["pid_lo"]),
+         _TS["exists_with_different_pending_id"]),
+        (e["timeout"] != t_row["timeout"], _TS["exists_with_different_timeout"]),
+        (dr_diff, _TS["exists_with_different_debit_account_id"]),
+        (cr_diff, _TS["exists_with_different_credit_account_id"]),
+        (jnp.where(pv, amt_diff_pv, amt_diff_regular), _TS["exists_with_different_amount"]),
+        (ud128_diff, _TS["exists_with_different_user_data_128"]),
+        (ud_diff(e["ud64"], t_row["ud64"], p_row["ud64"]), _TS["exists_with_different_user_data_64"]),
+        (ud_diff(e["ud32"], t_row["ud32"], p_row["ud32"]), _TS["exists_with_different_user_data_32"]),
+        (ledger_diff, _TS["exists_with_different_ledger"]),
+        (code_diff, _TS["exists_with_different_code"]),
+    ]
+    status = _first_failure(checks, created=_TS["exists"])
+    return status, t_row["ts"]
+
+
+def _ct_body(inputs, i, st):
+    ev = _gather_event(inputs["event"], i)
+    n = inputs["n_events"]
+    timestamp = inputs["timestamp"]
+    timestamp_event = (
+        timestamp - n.astype(jnp.uint64) + jnp.asarray(i).astype(jnp.uint64) + jnp.uint64(1)
+    )
+    valid = ev["valid"]
+
+    linked = _flag(ev["flags"], _F_LINKED)
+    imported = _flag(ev["flags"], _F_IMPORTED)
+    is_post = _flag(ev["flags"], _F_POST)
+    is_void = _flag(ev["flags"], _F_VOID)
+    pv = is_post | is_void
+    pending = _flag(ev["flags"], _F_PENDING)
+    batch_imported = _flag(inputs["event"]["flags"][0], _F_IMPORTED) & (n > 0)
+
+    # --- chain open (reference :3033-3043) ---
+    chain_active = st["chain_start"] >= 0
+    opening = linked & ~chain_active & valid
+    st["chain_start"] = jnp.where(opening, jnp.int32(i), st["chain_start"])
+    st["chain_key_max"] = jnp.where(opening, st["key_max"], st["chain_key_max"])
+    chain_active = chain_active | opening
+
+    # --- transfer lookup: committed cache / orphan / batch store ---
+    slot = ev["slot"]
+    e_from_cache = ev["exists_idx"] >= 0
+    e_from_batch = ~e_from_cache & st["s_created"][slot]
+    e_found = e_from_cache | e_from_batch
+    orphan = ev["orphaned"] | st["s_orphaned"][slot]
+
+    e_row = _transfer_row(st, inputs, e_from_cache, ev["exists_idx"], slot)
+
+    # --- pending transfer lookup (shared by post/void path and the exists
+    # comparison, where t.pending_id == e.pending_id is guaranteed) ---
+    p_from_cache = ev["pending_cache_idx"] >= 0
+    p_from_batch = ~p_from_cache & (ev["pending_slot"] >= 0) & st["s_created"][jnp.maximum(ev["pending_slot"], 0)]
+    p_found = p_from_cache | p_from_batch
+    p_row = _transfer_row(st, inputs, p_from_cache, ev["pending_cache_idx"], ev["pending_slot"])
+    p_dr = _acct_row(st, inputs, p_row["dr_idx"])
+    p_cr = _acct_row(st, inputs, p_row["cr_idx"])
+
+    dr = _acct_row(st, inputs, ev["dr_idx"])
+    cr = _acct_row(st, inputs, ev["cr_idx"])
+
+    exists_status, exists_ts = _ct_eval_exists(ev, e_row, p_row)
+
+    id_zero = u128.is_zero(ev["id_hi"], ev["id_lo"])
+    id_max = u128.is_max(ev["id_hi"], ev["id_lo"])
+    pid_zero = u128.is_zero(ev["pid_hi"], ev["pid_lo"])
+    pid_max = u128.is_max(ev["pid_hi"], ev["pid_lo"])
+
+    # ---------------- post/void path (reference :4053-4299) ----------------
+    pv_amt_hi, pv_amt_lo = u128.select(
+        jnp.where(is_void, u128.is_zero(ev["amt_hi"], ev["amt_lo"]),
+                  u128.is_max(ev["amt_hi"], ev["amt_lo"])),
+        p_row["amt_hi"], p_row["amt_lo"],
+        ev["amt_hi"], ev["amt_lo"],
+    )
+    p_expires_due = (p_row["timeout"] != 0) & (p_row["expires"] <= timestamp_event)
+    pv_regress = imported & (
+        (ev["ts"] <= st["key_max"]) | ev["acct_ts_collision"]
+    )
+    pv_ts_actual = jnp.where(imported, ev["ts"], timestamp_event)
+    pv_checks = [
+        (is_post & is_void, _TS["flags_are_mutually_exclusive"]),
+        (pending | _flag(ev["flags"], _F_BAL_DR) | _flag(ev["flags"], _F_BAL_CR)
+         | _flag(ev["flags"], _F_CLOSE_DR) | _flag(ev["flags"], _F_CLOSE_CR),
+         _TS["flags_are_mutually_exclusive"]),
+        (pid_zero, _TS["pending_id_must_not_be_zero"]),
+        (pid_max, _TS["pending_id_must_not_be_int_max"]),
+        (u128.eq(ev["pid_hi"], ev["pid_lo"], ev["id_hi"], ev["id_lo"]),
+         _TS["pending_id_must_be_different"]),
+        (ev["timeout"] != 0, _TS["timeout_reserved_for_pending_transfer"]),
+        (~p_found, _TS["pending_transfer_not_found"]),
+        (~_flag(p_row["flags"], _F_PENDING), _TS["pending_transfer_not_pending"]),
+        ((~u128.is_zero(ev["dr_hi"], ev["dr_lo"])) &
+         ~u128.eq(ev["dr_hi"], ev["dr_lo"], p_row["dr_hi"], p_row["dr_lo"]),
+         _TS["pending_transfer_has_different_debit_account_id"]),
+        ((~u128.is_zero(ev["cr_hi"], ev["cr_lo"])) &
+         ~u128.eq(ev["cr_hi"], ev["cr_lo"], p_row["cr_hi"], p_row["cr_lo"]),
+         _TS["pending_transfer_has_different_credit_account_id"]),
+        ((ev["ledger"] != 0) & (ev["ledger"] != p_row["ledger"]),
+         _TS["pending_transfer_has_different_ledger"]),
+        ((ev["code"] != 0) & (ev["code"] != p_row["code"]),
+         _TS["pending_transfer_has_different_code"]),
+        (u128.lt(p_row["amt_hi"], p_row["amt_lo"], pv_amt_hi, pv_amt_lo),
+         _TS["exceeds_pending_transfer_amount"]),
+        (is_void & u128.lt(pv_amt_hi, pv_amt_lo, p_row["amt_hi"], p_row["amt_lo"]),
+         _TS["pending_transfer_has_different_amount"]),
+        (p_row["pstat"] == _PS_POSTED, _TS["pending_transfer_already_posted"]),
+        (p_row["pstat"] == _PS_VOIDED, _TS["pending_transfer_already_voided"]),
+        (p_row["pstat"] == _PS_EXPIRED, _TS["pending_transfer_expired"]),
+        (p_expires_due, _TS["pending_transfer_expired"]),
+        (pv_regress, _TS["imported_event_timestamp_must_not_regress"]),
+        (_flag(p_dr["flags"], _A_CLOSED) & ~is_void, _TS["debit_account_already_closed"]),
+        (_flag(p_cr["flags"], _A_CLOSED) & ~is_void, _TS["credit_account_already_closed"]),
+    ]
+    pv_status = _first_failure(pv_checks)
+
+    # ---------------- regular path (reference :3748-3904) ----------------
+    dr_zero = u128.is_zero(ev["dr_hi"], ev["dr_lo"])
+    dr_max = u128.is_max(ev["dr_hi"], ev["dr_lo"])
+    cr_zero = u128.is_zero(ev["cr_hi"], ev["cr_lo"])
+    cr_max = u128.is_max(ev["cr_hi"], ev["cr_lo"])
+    same_acct = u128.eq(ev["dr_hi"], ev["dr_lo"], ev["cr_hi"], ev["cr_lo"])
+
+    reg_regress = imported & ((ev["ts"] <= st["key_max"]) | ev["acct_ts_collision"])
+    reg_ts_actual = jnp.where(imported, ev["ts"], timestamp_event)
+
+    # Balancing clamp (reference :3840-3853).
+    amt_hi, amt_lo = ev["amt_hi"], ev["amt_lo"]
+    dr_bal_hi, dr_bal_lo, _ = u128.add(dr["dpos_hi"], dr["dpos_lo"], dr["dp_hi"], dr["dp_lo"])
+    dr_avail_hi, dr_avail_lo = u128.sat_sub(dr["cpos_hi"], dr["cpos_lo"], dr_bal_hi, dr_bal_lo)
+    bal_dr_hi, bal_dr_lo = u128.min_(amt_hi, amt_lo, dr_avail_hi, dr_avail_lo)
+    amt_hi, amt_lo = u128.select(_flag(ev["flags"], _F_BAL_DR), bal_dr_hi, bal_dr_lo, amt_hi, amt_lo)
+    cr_bal_hi, cr_bal_lo, _ = u128.add(cr["cpos_hi"], cr["cpos_lo"], cr["cp_hi"], cr["cp_lo"])
+    cr_avail_hi, cr_avail_lo = u128.sat_sub(cr["dpos_hi"], cr["dpos_lo"], cr_bal_hi, cr_bal_lo)
+    bal_cr_hi, bal_cr_lo = u128.min_(amt_hi, amt_lo, cr_avail_hi, cr_avail_lo)
+    amt_hi, amt_lo = u128.select(_flag(ev["flags"], _F_BAL_CR), bal_cr_hi, bal_cr_lo, amt_hi, amt_lo)
+
+    # Overflow checks (reference :3856-3901).
+    _, _, ovf_dp = u128.add(amt_hi, amt_lo, dr["dp_hi"], dr["dp_lo"])
+    _, _, ovf_cp = u128.add(amt_hi, amt_lo, cr["cp_hi"], cr["cp_lo"])
+    _, _, ovf_dpos = u128.add(amt_hi, amt_lo, dr["dpos_hi"], dr["dpos_lo"])
+    _, _, ovf_cpos = u128.add(amt_hi, amt_lo, cr["cpos_hi"], cr["cpos_lo"])
+    _, _, ovf_d = u128.add3(amt_hi, amt_lo, dr["dp_hi"], dr["dp_lo"], dr["dpos_hi"], dr["dpos_lo"])
+    _, _, ovf_c = u128.add3(amt_hi, amt_lo, cr["cp_hi"], cr["cp_lo"], cr["cpos_hi"], cr["cpos_lo"])
+    timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
+    ovf_timeout = reg_ts_actual + timeout_ns > _U63_MAX
+
+    # Balance limits (reference tigerbeetle.zig:34-42).
+    dr_tot_hi, dr_tot_lo, _ = u128.add3(
+        dr["dp_hi"], dr["dp_lo"], dr["dpos_hi"], dr["dpos_lo"], amt_hi, amt_lo)
+    exceeds_credits = _flag(dr["flags"], _A_DR_LIMIT) & u128.lt(
+        dr["cpos_hi"], dr["cpos_lo"], dr_tot_hi, dr_tot_lo)
+    cr_tot_hi, cr_tot_lo, _ = u128.add3(
+        cr["cp_hi"], cr["cp_lo"], cr["cpos_hi"], cr["cpos_lo"], amt_hi, amt_lo)
+    exceeds_debits = _flag(cr["flags"], _A_CR_LIMIT) & u128.lt(
+        cr["dpos_hi"], cr["dpos_lo"], cr_tot_hi, cr_tot_lo)
+
+    reg_checks = [
+        (dr_zero, _TS["debit_account_id_must_not_be_zero"]),
+        (dr_max, _TS["debit_account_id_must_not_be_int_max"]),
+        (cr_zero, _TS["credit_account_id_must_not_be_zero"]),
+        (cr_max, _TS["credit_account_id_must_not_be_int_max"]),
+        (same_acct, _TS["accounts_must_be_different"]),
+        (~pid_zero, _TS["pending_id_must_be_zero"]),
+        (~pending & (ev["timeout"] != 0), _TS["timeout_reserved_for_pending_transfer"]),
+        (~pending & (_flag(ev["flags"], _F_CLOSE_DR) | _flag(ev["flags"], _F_CLOSE_CR)),
+         _TS["closing_transfer_must_be_pending"]),
+        (ev["ledger"] == 0, _TS["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _TS["code_must_not_be_zero"]),
+        (~dr["exists"], _TS["debit_account_not_found"]),
+        (~cr["exists"], _TS["credit_account_not_found"]),
+        (dr["ledger"] != cr["ledger"], _TS["accounts_must_have_the_same_ledger"]),
+        (ev["ledger"] != dr["ledger"], _TS["transfer_must_have_the_same_ledger_as_accounts"]),
+        (reg_regress, _TS["imported_event_timestamp_must_not_regress"]),
+        (imported & (ev["ts"] <= dr["ts"]), _TS["imported_event_timestamp_must_postdate_debit_account"]),
+        (imported & (ev["ts"] <= cr["ts"]), _TS["imported_event_timestamp_must_postdate_credit_account"]),
+        (imported & (ev["timeout"] != 0), _TS["imported_event_timeout_must_be_zero"]),
+        (_flag(dr["flags"], _A_CLOSED), _TS["debit_account_already_closed"]),
+        (_flag(cr["flags"], _A_CLOSED), _TS["credit_account_already_closed"]),
+        (pending & ovf_dp, _TS["overflows_debits_pending"]),
+        (pending & ovf_cp, _TS["overflows_credits_pending"]),
+        (ovf_dpos, _TS["overflows_debits_posted"]),
+        (ovf_cpos, _TS["overflows_credits_posted"]),
+        (ovf_d, _TS["overflows_debits"]),
+        (ovf_c, _TS["overflows_credits"]),
+        (ovf_timeout, _TS["overflows_timeout"]),
+        (exceeds_credits, _TS["exceeds_credits"]),
+        (exceeds_debits, _TS["exceeds_debits"]),
+    ]
+    reg_status = _first_failure(reg_checks)
+
+    # ------- combine the three evaluation paths (reference :3729-3746) -------
+    inner_status = jnp.where(
+        e_found, exists_status,
+        jnp.where(orphan, _TS["id_already_failed"],
+                  jnp.where(pv, pv_status, reg_status)))
+    pre_status = _first_failure([
+        ((ev["flags"] & _TF_PADDING) != 0, _TS["reserved_flag"]),
+        (id_zero, _TS["id_must_not_be_zero"]),
+        (id_max, _TS["id_must_not_be_int_max"]),
+    ])
+    inner_status = jnp.where(pre_status != _CREATED, pre_status, inner_status)
+
+    ts_actual_inner = jnp.where(
+        e_found & (inner_status == _TS["exists"]), exists_ts,
+        jnp.where(inner_status == _CREATED,
+                  jnp.where(pv, pv_ts_actual, reg_ts_actual),
+                  timestamp_event))
+
+    # ------- wrapper checks (reference execute_create :3033-3104) -------
+    ts_valid = (ev["ts"] >= 1) & (ev["ts"] <= _U63_MAX)
+    status = inner_status
+    status = jnp.where(~imported & (ev["ts"] != 0), _TS["timestamp_must_be_zero"], status)
+    status = jnp.where(imported & ts_valid & (ev["ts"] >= timestamp),
+                       _TS["imported_event_timestamp_must_not_advance"], status)
+    status = jnp.where(imported & ~ts_valid, _TS["imported_event_timestamp_out_of_range"], status)
+    status = jnp.where(imported != batch_imported,
+                       jnp.where(imported, _TS["imported_event_not_expected"],
+                                 _TS["imported_event_expected"]), status)
+    status = jnp.where(st["chain_broken"], _TS["linked_event_failed"], status)
+    status = jnp.where(linked & (i == n - 1), _TS["linked_event_chain_open"], status)
+
+    ts_actual = jnp.where(status == inner_status, ts_actual_inner, timestamp_event)
+
+    # ---------------- application (masked) ----------------
+    created = (status == _CREATED) & valid
+    ap_pv = created & pv
+    ap_reg = created & ~pv
+    ap_pending = ap_reg & pending
+
+    f_amt_hi = jnp.where(pv, pv_amt_hi, amt_hi)
+    f_amt_lo = jnp.where(pv, pv_amt_lo, amt_lo)
+    f_ts = jnp.where(pv, pv_ts_actual, reg_ts_actual)
+
+    def add_at(hi_key, lo_key, idx, d_hi, d_lo, mask):
+        h, l, _ = u128.add(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
+        st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
+        st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
+
+    def sub_at(hi_key, lo_key, idx, d_hi, d_lo, mask):
+        h, l = u128.sub(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
+        st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
+        st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
+
+    # Regular/pending application (reference :3909-3985).
+    add_at("a_dp_hi", "a_dp_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_pending)
+    add_at("a_cp_hi", "a_cp_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_pending)
+    add_at("a_dpos_hi", "a_dpos_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
+    add_at("a_cpos_hi", "a_cpos_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
+
+    rb_dr_closed = _flag(st["a_flags"][jnp.where(pv, p_row["dr_idx"], ev["dr_idx"])], _A_CLOSED)
+    rb_cr_closed = _flag(st["a_flags"][jnp.where(pv, p_row["cr_idx"], ev["cr_idx"])], _A_CLOSED)
+
+    close_dr = ap_reg & _flag(ev["flags"], _F_CLOSE_DR)
+    close_cr = ap_reg & _flag(ev["flags"], _F_CLOSE_CR)
+    st["a_flags"] = st["a_flags"].at[ev["dr_idx"]].set(
+        jnp.where(close_dr, st["a_flags"][ev["dr_idx"]] | _A_CLOSED, st["a_flags"][ev["dr_idx"]]))
+    st["a_flags"] = st["a_flags"].at[ev["cr_idx"]].set(
+        jnp.where(close_cr, st["a_flags"][ev["cr_idx"]] | _A_CLOSED, st["a_flags"][ev["cr_idx"]]))
+
+    # Post/void application (reference :4195-4283).
+    sub_at("a_dp_hi", "a_dp_lo", p_row["dr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
+    sub_at("a_cp_hi", "a_cp_lo", p_row["cr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
+    add_at("a_dpos_hi", "a_dpos_lo", p_row["dr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
+    add_at("a_cpos_hi", "a_cpos_lo", p_row["cr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
+    reopen_dr = ap_pv & is_void & _flag(p_row["flags"], _F_CLOSE_DR)
+    reopen_cr = ap_pv & is_void & _flag(p_row["flags"], _F_CLOSE_CR)
+    st["a_flags"] = st["a_flags"].at[p_row["dr_idx"]].set(
+        jnp.where(reopen_dr, st["a_flags"][p_row["dr_idx"]] & ~_A_CLOSED, st["a_flags"][p_row["dr_idx"]]))
+    st["a_flags"] = st["a_flags"].at[p_row["cr_idx"]].set(
+        jnp.where(reopen_cr, st["a_flags"][p_row["cr_idx"]] & ~_A_CLOSED, st["a_flags"][p_row["cr_idx"]]))
+
+    # Flip p's pending status (reference :4233-4238).
+    new_pstat = jnp.where(is_post, _PS_POSTED, _PS_VOIDED)
+    pci = jnp.maximum(ev["pending_cache_idx"], 0)
+    psl = jnp.maximum(ev["pending_slot"], 0)
+    st["tc_pstat"] = st["tc_pstat"].at[pci].set(
+        jnp.where(ap_pv & p_from_cache, new_pstat, st["tc_pstat"][pci]))
+    st["s_pstat"] = st["s_pstat"].at[psl].set(
+        jnp.where(ap_pv & ~p_from_cache, new_pstat, st["s_pstat"][psl]))
+
+    # Insert the stored transfer into the batch store at `slot`.
+    stores = dict(
+        amt_hi=f_amt_hi, amt_lo=f_amt_lo,
+        dr_idx=jnp.where(pv, p_row["dr_idx"], ev["dr_idx"]),
+        cr_idx=jnp.where(pv, p_row["cr_idx"], ev["cr_idx"]),
+        dr_hi=jnp.where(pv, p_row["dr_hi"], ev["dr_hi"]),
+        dr_lo=jnp.where(pv, p_row["dr_lo"], ev["dr_lo"]),
+        cr_hi=jnp.where(pv, p_row["cr_hi"], ev["cr_hi"]),
+        cr_lo=jnp.where(pv, p_row["cr_lo"], ev["cr_lo"]),
+        pid_hi=ev["pid_hi"], pid_lo=ev["pid_lo"],
+        ud128_hi=jnp.where(pv & u128.is_zero(ev["ud128_hi"], ev["ud128_lo"]),
+                           p_row["ud128_hi"], ev["ud128_hi"]),
+        ud128_lo=jnp.where(pv & u128.is_zero(ev["ud128_hi"], ev["ud128_lo"]),
+                           p_row["ud128_lo"], ev["ud128_lo"]),
+        ud64=jnp.where(pv & (ev["ud64"] == 0), p_row["ud64"], ev["ud64"]),
+        ud32=jnp.where(pv & (ev["ud32"] == 0), p_row["ud32"], ev["ud32"]),
+        timeout=jnp.where(pv, jnp.uint32(0), ev["timeout"]),
+        ledger=jnp.where(pv, p_row["ledger"], ev["ledger"]),
+        code=jnp.where(pv, p_row["code"], ev["code"]),
+        flags=ev["flags"],
+        ts=f_ts,
+        pstat=jnp.where(ap_pending, _PS_PENDING, jnp.int32(0)),
+        expires=jnp.where(ap_pending & (ev["timeout"] != 0), f_ts + timeout_ns, jnp.uint64(0)),
+    )
+    for k, v in stores.items():
+        st[f"s_{k}"] = st[f"s_{k}"].at[slot].set(jnp.where(created, v, st[f"s_{k}"][slot]))
+    st["s_created"] = st["s_created"].at[slot].set(st["s_created"][slot] | created)
+
+    st["key_max"] = jnp.where(created, jnp.maximum(st["key_max"], f_ts), st["key_max"])
+
+    # Pulse scheduling (reference :3975-3981 add, :4227-4230 remove-reset).
+    expires_new = f_ts + timeout_ns
+    st["pulse_next"] = jnp.where(
+        ap_pending & (ev["timeout"] != 0) & (expires_new < st["pulse_next"]),
+        expires_new, st["pulse_next"])
+    st["pulse_next"] = jnp.where(
+        ap_pv & (p_row["timeout"] != 0) & (st["pulse_next"] == p_row["expires"]),
+        jnp.uint64(1), st["pulse_next"])
+
+    # Undo log record.
+    rb_kind = jnp.where(~created, jnp.int32(0),
+                        jnp.where(is_post, jnp.int32(3),
+                                  jnp.where(is_void, jnp.int32(4),
+                                            jnp.where(pending, jnp.int32(2), jnp.int32(1)))))
+    st["rb_kind"] = st["rb_kind"].at[i].set(rb_kind)
+    st["rb_dr_idx"] = st["rb_dr_idx"].at[i].set(jnp.where(pv, p_row["dr_idx"], ev["dr_idx"]))
+    st["rb_cr_idx"] = st["rb_cr_idx"].at[i].set(jnp.where(pv, p_row["cr_idx"], ev["cr_idx"]))
+    st["rb_amt_hi"] = st["rb_amt_hi"].at[i].set(f_amt_hi)
+    st["rb_amt_lo"] = st["rb_amt_lo"].at[i].set(f_amt_lo)
+    st["rb_pamt_hi"] = st["rb_pamt_hi"].at[i].set(p_row["amt_hi"])
+    st["rb_pamt_lo"] = st["rb_pamt_lo"].at[i].set(p_row["amt_lo"])
+    st["rb_p_batch"] = st["rb_p_batch"].at[i].set(~p_from_cache)
+    st["rb_p_idx"] = st["rb_p_idx"].at[i].set(jnp.where(p_from_cache, pci, psl))
+    st["rb_dr_closed"] = st["rb_dr_closed"].at[i].set(rb_dr_closed)
+    st["rb_cr_closed"] = st["rb_cr_closed"].at[i].set(rb_cr_closed)
+
+    # Orphan transient failures (reference transient_error :3215-3252).
+    transient = jnp.zeros((), dtype=jnp.bool_)
+    for code in _TRANSIENT_CODES:
+        transient = transient | (status == code)
+    st["s_orphaned"] = st["s_orphaned"].at[slot].set(
+        st["s_orphaned"][slot] | (transient & valid))
+
+    # Results.
+    st["r_ts"] = st["r_ts"].at[i].set(jnp.where(valid, ts_actual, st["r_ts"][i]))
+    st["r_status"] = st["r_status"].at[i].set(jnp.where(valid, status, st["r_status"][i]))
+
+    # ------- chain break: roll back the applied prefix (reference :3116-3150) -------
+    breaking = (status != _CREATED) & chain_active & ~st["chain_broken"] & valid
+
+    # LIFO rollback: balance undos are delta-based (order-independent), but
+    # closed-flag and pending-status restores are absolute pre-event
+    # snapshots, so members must unwind newest-first (two chain members
+    # touching the same account's closed bit — close then void-reopen —
+    # would otherwise resurrect the wrong snapshot).
+    def rollback_k(k, stj):
+        j = i - 1 - k
+        kind = stj["rb_kind"][j]
+        applied = kind > 0
+        a_hi, a_lo = stj["rb_amt_hi"][j], stj["rb_amt_lo"][j]
+        pa_hi, pa_lo = stj["rb_pamt_hi"][j], stj["rb_pamt_lo"][j]
+        dri, cri = stj["rb_dr_idx"][j], stj["rb_cr_idx"][j]
+
+        def u_sub(hi_key, lo_key, idx, dh, dl, mask):
+            h, l = u128.sub(stj[hi_key][idx], stj[lo_key][idx], dh, dl)
+            stj[hi_key] = stj[hi_key].at[idx].set(jnp.where(mask, h, stj[hi_key][idx]))
+            stj[lo_key] = stj[lo_key].at[idx].set(jnp.where(mask, l, stj[lo_key][idx]))
+
+        def u_add(hi_key, lo_key, idx, dh, dl, mask):
+            h, l, _ = u128.add(stj[hi_key][idx], stj[lo_key][idx], dh, dl)
+            stj[hi_key] = stj[hi_key].at[idx].set(jnp.where(mask, h, stj[hi_key][idx]))
+            stj[lo_key] = stj[lo_key].at[idx].set(jnp.where(mask, l, stj[lo_key][idx]))
+
+        u_sub("a_dpos_hi", "a_dpos_lo", dri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
+        u_sub("a_cpos_hi", "a_cpos_lo", cri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
+        u_sub("a_dp_hi", "a_dp_lo", dri, a_hi, a_lo, applied & (kind == 2))
+        u_sub("a_cp_hi", "a_cp_lo", cri, a_hi, a_lo, applied & (kind == 2))
+        u_add("a_dp_hi", "a_dp_lo", dri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
+        u_add("a_cp_hi", "a_cp_lo", cri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
+
+        # Restore closed bits to their pre-event values.
+        for idx, prev_key in ((dri, "rb_dr_closed"), (cri, "rb_cr_closed")):
+            prev = stj[prev_key][j]
+            cur = stj["a_flags"][idx]
+            restored = jnp.where(prev, cur | _A_CLOSED, cur & ~_A_CLOSED)
+            stj["a_flags"] = stj["a_flags"].at[idx].set(jnp.where(applied, restored, cur))
+
+        # Restore p's pending status to pending for post/void.
+        p_idx = stj["rb_p_idx"][j]
+        was_pv = applied & ((kind == 3) | (kind == 4))
+        p_batch = stj["rb_p_batch"][j]
+        stj["tc_pstat"] = stj["tc_pstat"].at[p_idx].set(
+            jnp.where(was_pv & ~p_batch, _PS_PENDING, stj["tc_pstat"][p_idx]))
+        stj["s_pstat"] = stj["s_pstat"].at[p_idx].set(
+            jnp.where(was_pv & p_batch, _PS_PENDING, stj["s_pstat"][p_idx]))
+
+        # Un-create and rewrite the result status (FIFO, reference :3123-3145).
+        slot_j = inputs["event"]["slot"][j]
+        stj["s_created"] = stj["s_created"].at[slot_j].set(
+            jnp.where(applied, False, stj["s_created"][slot_j]))
+        stj["rb_kind"] = stj["rb_kind"].at[j].set(jnp.int32(0))
+        stj["r_status"] = stj["r_status"].at[j].set(_TS["linked_event_failed"])
+        return stj
+
+    count = jnp.where(breaking, jnp.int32(i) - jnp.maximum(st["chain_start"], 0), jnp.int32(0))
+    st = jax.lax.fori_loop(0, count, rollback_k, st)
+    st["key_max"] = jnp.where(breaking, st["chain_key_max"], st["key_max"])
+    st["chain_broken"] = st["chain_broken"] | breaking
+
+    # Chain close (reference :3196-3207).
+    closing = chain_active & (~linked | (status == _TS["linked_event_chain_open"]))
+    st["chain_start"] = jnp.where(closing, jnp.int32(-1), st["chain_start"])
+    st["chain_broken"] = jnp.where(closing, jnp.bool_(False), st["chain_broken"])
+    return st
+
+
+@functools.partial(jax.jit, static_argnames=())
+def create_transfers_kernel(inputs):
+    """Run a create_transfers batch; returns results + final state arrays."""
+    N = inputs["event"]["id_lo"].shape[0]
+    st = _ct_init_state(inputs)
+    st = jax.lax.fori_loop(
+        0, N, lambda i, s: _ct_body(inputs, i, s), st
+    )
+    return st
+
+
+# ======================================================== create_accounts
+
+def _ca_body(inputs, i, st):
+    ev = _gather_event(inputs["event"], i)
+    n = inputs["n_events"]
+    timestamp = inputs["timestamp"]
+    timestamp_event = (
+        timestamp - n.astype(jnp.uint64) + jnp.asarray(i).astype(jnp.uint64) + jnp.uint64(1)
+    )
+    valid = ev["valid"]
+
+    linked = _flag(ev["flags"], _A_LINKED)
+    imported = _flag(ev["flags"], _A_IMPORTED)
+    batch_imported = _flag(inputs["event"]["flags"][0], _A_IMPORTED) & (n > 0)
+
+    chain_active = st["chain_start"] >= 0
+    opening = linked & ~chain_active & valid
+    st["chain_start"] = jnp.where(opening, jnp.int32(i), st["chain_start"])
+    st["chain_key_max"] = jnp.where(opening, st["key_max"], st["chain_key_max"])
+    chain_active = chain_active | opening
+
+    slot = ev["slot"]
+    e_from_cache = ev["exists_idx"] >= 0
+    e_from_batch = ~e_from_cache & st["s_created"][slot]
+    e_found = e_from_cache | e_from_batch
+    ci = jnp.maximum(ev["exists_idx"], 0)
+    ac = inputs["ac"]
+
+    def e_field(name):
+        return jnp.where(e_from_cache, ac[name][ci], st[f"s_{name}"][slot])
+
+    # create_account_exists (reference :3691-3703).
+    exists_checks = [
+        ((ev["flags"] & 0xFFFF) != (e_field("flags") & 0xFFFF), _AS["exists_with_different_flags"]),
+        (~u128.eq(ev["ud128_hi"], ev["ud128_lo"], e_field("ud128_hi"), e_field("ud128_lo")),
+         _AS["exists_with_different_user_data_128"]),
+        (ev["ud64"] != e_field("ud64"), _AS["exists_with_different_user_data_64"]),
+        (ev["ud32"] != e_field("ud32"), _AS["exists_with_different_user_data_32"]),
+        (ev["ledger"] != e_field("ledger"), _AS["exists_with_different_ledger"]),
+        (ev["code"] != e_field("code"), _AS["exists_with_different_code"]),
+    ]
+    exists_status = _first_failure(exists_checks, created=_AS["exists"])
+    exists_ts = e_field("ts")
+
+    regress = imported & (
+        ((st["key_max"] != 0) & (ev["ts"] <= st["key_max"])) | ev["transfer_ts_collision"]
+    )
+    ts_actual_created = jnp.where(imported, ev["ts"], timestamp_event)
+
+    # create_account (reference :3613-3689).
+    checks = [
+        (ev["reserved"] != 0, _AS["reserved_field"]),
+        ((ev["flags"] & _AF_PADDING) != 0, _AS["reserved_flag"]),
+        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _AS["id_must_not_be_zero"]),
+        (u128.is_max(ev["id_hi"], ev["id_lo"]), _AS["id_must_not_be_int_max"]),
+        (e_found, jnp.uint32(0)),  # placeholder: replaced by exists_status below
+        (_flag(ev["flags"], _A_DR_LIMIT) & _flag(ev["flags"], _A_CR_LIMIT),
+         _AS["flags_are_mutually_exclusive"]),
+        (~u128.is_zero(ev["dp_hi"], ev["dp_lo"]), _AS["debits_pending_must_be_zero"]),
+        (~u128.is_zero(ev["dpos_hi"], ev["dpos_lo"]), _AS["debits_posted_must_be_zero"]),
+        (~u128.is_zero(ev["cp_hi"], ev["cp_lo"]), _AS["credits_pending_must_be_zero"]),
+        (~u128.is_zero(ev["cpos_hi"], ev["cpos_lo"]), _AS["credits_posted_must_be_zero"]),
+        (ev["ledger"] == 0, _AS["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _AS["code_must_not_be_zero"]),
+        (regress, _AS["imported_event_timestamp_must_not_regress"]),
+    ]
+    inner_status = _first_failure(checks)
+    inner_status = jnp.where(inner_status == 0, exists_status, inner_status)
+
+    ts_actual_inner = jnp.where(
+        inner_status == _AS["exists"], exists_ts,
+        jnp.where(inner_status == _CREATED, ts_actual_created, timestamp_event))
+
+    ts_valid = (ev["ts"] >= 1) & (ev["ts"] <= _U63_MAX)
+    status = inner_status
+    status = jnp.where(~imported & (ev["ts"] != 0), _AS["timestamp_must_be_zero"], status)
+    status = jnp.where(imported & ts_valid & (ev["ts"] >= timestamp),
+                       _AS["imported_event_timestamp_must_not_advance"], status)
+    status = jnp.where(imported & ~ts_valid, _AS["imported_event_timestamp_out_of_range"], status)
+    status = jnp.where(imported != batch_imported,
+                       jnp.where(imported, _AS["imported_event_not_expected"],
+                                 _AS["imported_event_expected"]), status)
+    status = jnp.where(st["chain_broken"], _AS["linked_event_failed"], status)
+    status = jnp.where(linked & (i == n - 1), _AS["linked_event_chain_open"], status)
+    ts_actual = jnp.where(status == inner_status, ts_actual_inner, timestamp_event)
+
+    created = (status == _CREATED) & valid
+    for name in ("ud128_hi", "ud128_lo", "ud64", "ud32", "ledger", "code", "flags"):
+        st[f"s_{name}"] = st[f"s_{name}"].at[slot].set(
+            jnp.where(created, ev[name], st[f"s_{name}"][slot]))
+    st["s_ts"] = st["s_ts"].at[slot].set(jnp.where(created, ts_actual_created, st["s_ts"][slot]))
+    st["s_created"] = st["s_created"].at[slot].set(st["s_created"][slot] | created)
+    st["key_max"] = jnp.where(created, jnp.maximum(st["key_max"], ts_actual_created), st["key_max"])
+
+    st["r_ts"] = st["r_ts"].at[i].set(jnp.where(valid, ts_actual, st["r_ts"][i]))
+    st["r_status"] = st["r_status"].at[i].set(jnp.where(valid, status, st["r_status"][i]))
+
+    breaking = (status != _CREATED) & chain_active & ~st["chain_broken"] & valid
+
+    def rollback_j(j, stj):
+        slot_j = inputs["event"]["slot"][j]
+        stj["s_created"] = stj["s_created"].at[slot_j].set(False)
+        stj["r_status"] = stj["r_status"].at[j].set(_AS["linked_event_failed"])
+        return stj
+
+    lo = jnp.where(breaking, jnp.maximum(st["chain_start"], 0), jnp.int32(0))
+    hi = jnp.where(breaking, jnp.int32(i), jnp.int32(0))
+    st = jax.lax.fori_loop(lo, hi, rollback_j, st)
+    st["key_max"] = jnp.where(breaking, st["chain_key_max"], st["key_max"])
+    st["chain_broken"] = st["chain_broken"] | breaking
+
+    closing = chain_active & (~linked | (status == _AS["linked_event_chain_open"]))
+    st["chain_start"] = jnp.where(closing, jnp.int32(-1), st["chain_start"])
+    st["chain_broken"] = jnp.where(closing, jnp.bool_(False), st["chain_broken"])
+    return st
+
+
+@jax.jit
+def create_accounts_kernel(inputs):
+    N = inputs["event"]["id_lo"].shape[0]
+    z64 = functools.partial(jnp.zeros, dtype=jnp.uint64)
+    st = dict(
+        s_created=jnp.zeros(N, dtype=jnp.bool_),
+        s_ud128_hi=z64(N), s_ud128_lo=z64(N),
+        s_ud64=z64(N), s_ud32=jnp.zeros(N, dtype=jnp.uint32),
+        s_ledger=jnp.zeros(N, dtype=jnp.uint32),
+        s_code=jnp.zeros(N, dtype=jnp.uint32),
+        s_flags=jnp.zeros(N, dtype=jnp.uint32),
+        s_ts=z64(N),
+        key_max=jnp.asarray(inputs["accounts_key_max"], dtype=jnp.uint64),
+        chain_start=jnp.int32(-1),
+        chain_broken=jnp.bool_(False),
+        chain_key_max=jnp.uint64(0),
+        r_ts=z64(N), r_status=jnp.zeros(N, dtype=jnp.uint32),
+    )
+    st = jax.lax.fori_loop(0, N, lambda i, s: _ca_body(inputs, i, s), st)
+    return st
+
+
+# ======================================================== host application
+
+def _u128_of(hi, lo, idx) -> int:
+    return (int(hi[idx]) << 64) | int(lo[idx])
+
+
+def apply_create_transfers(state, inputs, aux, out) -> list[CreateTransferResult]:
+    """Apply kernel outputs back to the host state store (the TPU path's
+    equivalent of the groove inserts/updates inside the reference hot loop)."""
+    n = aux["n"]
+    r_status = np.asarray(out["r_status"][:n])
+    r_ts = np.asarray(out["r_ts"][:n])
+    event_ids = aux["event_ids"]
+    ev = inputs["event"]
+
+    # Orphan transient failures.
+    transient_codes = {int(c) for c in _TRANSIENT_CODES}
+    for i in np.nonzero(np.isin(r_status, list(transient_codes)))[0]:
+        state.orphaned.add(event_ids[int(i)])
+
+    # Write back only accounts the kernel actually changed (vectorized dirty
+    # detection against the prefetched cache).
+    acct_in = inputs["acct"]
+    dirty = (
+        (np.asarray(out["a_dp_hi"]) != acct_in["dp_hi"])
+        | (np.asarray(out["a_dp_lo"]) != acct_in["dp_lo"])
+        | (np.asarray(out["a_dpos_hi"]) != acct_in["dpos_hi"])
+        | (np.asarray(out["a_dpos_lo"]) != acct_in["dpos_lo"])
+        | (np.asarray(out["a_cp_hi"]) != acct_in["cp_hi"])
+        | (np.asarray(out["a_cp_lo"]) != acct_in["cp_lo"])
+        | (np.asarray(out["a_cpos_hi"]) != acct_in["cpos_hi"])
+        | (np.asarray(out["a_cpos_lo"]) != acct_in["cpos_lo"])
+        | (np.asarray(out["a_flags"]) != acct_in["flags"])
+    )
+    for aid, idx in aux["acct_id_to_idx"].items():
+        if not (dirty[idx] and acct_in["exists"][idx]):
+            continue
+        a = state.accounts[aid]
+        state.accounts[aid] = dataclasses.replace(
+            a,
+            debits_pending=_u128_of(out["a_dp_hi"], out["a_dp_lo"], idx),
+            debits_posted=_u128_of(out["a_dpos_hi"], out["a_dpos_lo"], idx),
+            credits_pending=_u128_of(out["a_cp_hi"], out["a_cp_lo"], idx),
+            credits_posted=_u128_of(out["a_cpos_hi"], out["a_cpos_lo"], idx),
+            flags=int(out["a_flags"][idx]),
+        )
+
+    # Committed pending-status flips (post/void). Expiry-index removal happens
+    # in the in-order walk below for exact pulse_next_timestamp parity.
+    tc_pstat = np.asarray(out["tc_pstat"])
+    for idx, t in enumerate(aux["tc_rows"]):
+        old = int(inputs["tc"]["pending_status"][idx])
+        new = int(tc_pstat[idx])
+        if new != old:
+            state.pending_status[t.timestamp] = TransferPendingStatus(new)
+
+    # Materialize batch-created transfers.
+    created = np.asarray(out["s_created"])
+    for slot in np.nonzero(created[:n])[0]:
+        slot = int(slot)
+        t = Transfer(
+            id=event_ids[slot],
+            debit_account_id=_u128_of(out["s_dr_hi"], out["s_dr_lo"], slot),
+            credit_account_id=_u128_of(out["s_cr_hi"], out["s_cr_lo"], slot),
+            amount=_u128_of(out["s_amt_hi"], out["s_amt_lo"], slot),
+            pending_id=_u128_of(out["s_pid_hi"], out["s_pid_lo"], slot),
+            user_data_128=_u128_of(out["s_ud128_hi"], out["s_ud128_lo"], slot),
+            user_data_64=int(out["s_ud64"][slot]),
+            user_data_32=int(out["s_ud32"][slot]),
+            timeout=int(out["s_timeout"][slot]),
+            ledger=int(out["s_ledger"][slot]),
+            code=int(out["s_code"][slot]),
+            flags=int(out["s_flags"][slot]),
+            timestamp=int(out["s_ts"][slot]),
+        )
+        state.transfers[t.id] = t
+        state.transfer_by_timestamp[t.timestamp] = t.id
+        pstat = int(out["s_pstat"][slot])
+        if pstat != 0:
+            state.pending_status[t.timestamp] = TransferPendingStatus(pstat)
+
+    # Expiry-index maintenance in event order. pulse_next_timestamp comes
+    # from the kernel scalar, which tracks the reference's sequential updates
+    # exactly (add at :3975-3981, remove-and-reset at :4227-4230) including
+    # rolled-back chains not restoring it.
+    flags = np.asarray(ev["flags"][:n])
+    created_mask = r_status == 0xFFFFFFFF
+    pending_add = created_mask & ((flags & 0x2) != 0) & (np.asarray(ev["timeout"][:n]) != 0)
+    pv_mask = created_mask & ((flags & 0xC) != 0)
+    for i in np.nonzero(pending_add | pv_mask)[0]:
+        i = int(i)
+        if pending_add[i]:
+            ts = int(r_ts[i])
+            state.expiry[ts] = ts + int(ev["timeout"][i]) * NS_PER_S
+        else:
+            p = state.transfers[aux["event_pids"][i]]
+            state.expiry.pop(p.timestamp, None)
+    state.pulse_next_timestamp = int(out["pulse_next"])
+
+    key_max = int(out["key_max"])
+    state.transfers_key_max = key_max or None
+    if created_mask.any():
+        state.commit_timestamp = int(r_ts[np.nonzero(created_mask)[0][-1]])
+
+    return [
+        CreateTransferResult(timestamp=int(r_ts[i]), status=CreateTransferStatus(int(r_status[i])))
+        for i in range(n)
+    ]
+
+
+def apply_create_accounts(state, inputs, aux, out) -> list[CreateAccountResult]:
+    n = aux["n"]
+    r_status = np.asarray(out["r_status"][:n])
+    r_ts = np.asarray(out["r_ts"][:n])
+    event_ids = aux["event_ids"]
+
+    created = np.asarray(out["s_created"])
+    for slot in np.nonzero(created[:n])[0]:
+        slot = int(slot)
+        a = Account(
+            id=event_ids[slot],
+            user_data_128=_u128_of(out["s_ud128_hi"], out["s_ud128_lo"], slot),
+            user_data_64=int(out["s_ud64"][slot]),
+            user_data_32=int(out["s_ud32"][slot]),
+            ledger=int(out["s_ledger"][slot]),
+            code=int(out["s_code"][slot]),
+            flags=int(out["s_flags"][slot]),
+            timestamp=int(out["s_ts"][slot]),
+        )
+        state.accounts[a.id] = a
+        state.account_by_timestamp[a.timestamp] = a.id
+    key_max = int(out["key_max"])
+    state.accounts_key_max = key_max or None
+    created_mask = r_status == 0xFFFFFFFF
+    if created_mask.any():
+        state.commit_timestamp = int(r_ts[np.nonzero(created_mask)[0][-1]])
+
+    return [
+        CreateAccountResult(timestamp=int(r_ts[i]), status=CreateAccountStatus(int(r_status[i])))
+        for i in range(n)
+    ]
+
+
+# ======================================================== one-call wrappers
+
+def run_create_transfers(state, transfers: list[Transfer], timestamp: int,
+                         n_pad=None) -> list[CreateTransferResult]:
+    """prefetch -> kernel -> apply: drop-in replacement for
+    StateMachineOracle.create_transfers, running validation on device."""
+    ev = transfers_to_arrays(transfers)
+    inputs, aux = prefetch_create_transfers(state, ev, timestamp, n_pad=n_pad)
+    out = create_transfers_kernel(inputs)
+    return apply_create_transfers(state, inputs, aux, out)
+
+
+def run_create_accounts(state, accounts, timestamp: int, n_pad=None) -> list[CreateAccountResult]:
+    ev = accounts_to_arrays(accounts)
+    inputs, aux = prefetch_create_accounts(state, ev, timestamp, n_pad=n_pad)
+    out = create_accounts_kernel(inputs)
+    return apply_create_accounts(state, inputs, aux, out)

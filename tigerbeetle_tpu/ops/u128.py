@@ -1,0 +1,100 @@
+"""Exact unsigned 128-bit arithmetic as (hi, lo) uint64 limb pairs.
+
+TPUs have no native u128 (the reference leans on Zig's native u128 for
+balances — src/tigerbeetle.zig:11-15). All balance math in the kernels runs on
+limb pairs with explicit carries; the six distinct overflow statuses
+(src/state_machine.zig:3856-3884) need exact overflow detection, so every op
+here is checked against Python ints in tests/test_u128.py.
+
+All functions are elementwise and shape-polymorphic (work on scalars and
+arrays alike); u64 wrap-around follows unsigned modular semantics.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+U64 = jnp.uint64
+_MAX64 = np.uint64(0xFFFFFFFFFFFFFFFF)
+
+
+def from_int(x: int):
+    """Python int -> (hi, lo) numpy scalars."""
+    return np.uint64(x >> 64), np.uint64(x & 0xFFFFFFFFFFFFFFFF)
+
+
+def from_ints(xs):
+    """Iterable of Python ints -> (hi, lo) numpy arrays."""
+    hi = np.array([x >> 64 for x in xs], dtype=np.uint64)
+    lo = np.array([x & 0xFFFFFFFFFFFFFFFF for x in xs], dtype=np.uint64)
+    return hi, lo
+
+
+def to_int(hi, lo) -> int:
+    return (int(hi) << 64) | int(lo)
+
+
+def add(a_hi, a_lo, b_hi, b_lo):
+    """(a + b) mod 2^128 plus an overflow flag."""
+    lo = a_lo + b_lo
+    carry = (lo < a_lo).astype(U64)
+    hi_sum = a_hi + b_hi
+    ovf1 = hi_sum < a_hi
+    hi = hi_sum + carry
+    ovf2 = hi < hi_sum
+    return hi, lo, ovf1 | ovf2
+
+
+def add3(a_hi, a_lo, b_hi, b_lo, c_hi, c_lo):
+    """a + b + c with combined overflow flag (for pending+posted+amount)."""
+    hi1, lo1, o1 = add(a_hi, a_lo, b_hi, b_lo)
+    hi2, lo2, o2 = add(hi1, lo1, c_hi, c_lo)
+    return hi2, lo2, o1 | o2
+
+
+def sub(a_hi, a_lo, b_hi, b_lo):
+    """(a - b) mod 2^128 (callers guarantee a >= b where it matters)."""
+    lo = a_lo - b_lo
+    borrow = (a_lo < b_lo).astype(U64)
+    hi = a_hi - b_hi - borrow
+    return hi, lo
+
+
+def lt(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo < b_lo))
+
+
+def le(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi < b_hi) | ((a_hi == b_hi) & (a_lo <= b_lo))
+
+
+def eq(a_hi, a_lo, b_hi, b_lo):
+    return (a_hi == b_hi) & (a_lo == b_lo)
+
+
+def is_zero(hi, lo):
+    return (hi == 0) & (lo == 0)
+
+
+def is_max(hi, lo):
+    return (hi == _MAX64) & (lo == _MAX64)
+
+
+def min_(a_hi, a_lo, b_hi, b_lo):
+    take_a = lt(a_hi, a_lo, b_hi, b_lo)
+    return jnp.where(take_a, a_hi, b_hi), jnp.where(take_a, a_lo, b_lo)
+
+
+def sat_sub(a_hi, a_lo, b_hi, b_lo):
+    """max(a - b, 0): Zig's  -|  saturating subtraction
+    (reference balancing clamp, src/state_machine.zig:3845,3850)."""
+    underflow = lt(a_hi, a_lo, b_hi, b_lo)
+    hi, lo = sub(a_hi, a_lo, b_hi, b_lo)
+    zero = jnp.zeros_like(hi)
+    return jnp.where(underflow, zero, hi), jnp.where(underflow, zero, lo)
+
+
+def select(cond, a_hi, a_lo, b_hi, b_lo):
+    """where(cond, a, b) on limb pairs."""
+    return jnp.where(cond, a_hi, b_hi), jnp.where(cond, a_lo, b_lo)

@@ -70,7 +70,12 @@ class _Scope:
         self.commit_timestamp = oracle.commit_timestamp
         self.transfers_key_max = oracle.transfers_key_max
         self.accounts_key_max = oracle.accounts_key_max
-        self.pulse_next_timestamp = oracle.pulse_next_timestamp
+        # NOTE: pulse_next_timestamp is deliberately NOT snapshotted — it is
+        # state-machine state, not groove state, and the reference never
+        # reverts it on scope discard (a rolled-back pending transfer may
+        # leave an early pulse_next behind; the pulse scan then finds nothing,
+        # which is safe by the "timestamp_min means scan to check" contract,
+        # src/state_machine.zig:4915-4920).
 
 
 class StateMachineOracle:
@@ -139,7 +144,6 @@ class StateMachineOracle:
         self.commit_timestamp = scope.commit_timestamp
         self.transfers_key_max = scope.transfers_key_max
         self.accounts_key_max = scope.accounts_key_max
-        self.pulse_next_timestamp = scope.pulse_next_timestamp
 
     # ------------------------------------------------------- journaled mutators
 
