@@ -1,0 +1,170 @@
+"""Benchmark driver: create_transfers validated transfers/sec on TPU.
+
+Measures the same quantity as the reference's `tigerbeetle benchmark`
+"load accepted ... tx/s" (src/tigerbeetle/benchmark_load.zig:587): accepted
+transfers / wall time, with result-code parity checked against the
+sequential oracle.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "transfers/s", "vs_baseline": N, ...}
+
+Baseline: the reference's design claim of 1M TPS on a single core
+(docs/ARCHITECTURE.md:179-184); the driver target is 10M/s on one v5e chip.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+if os.environ.get("BENCH_PLATFORM"):
+    # The axon site hook pins JAX_PLATFORMS=axon; an explicit override needs
+    # jax.config (must run before any backend initializes).
+    import jax
+
+    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
+
+from tigerbeetle_tpu.constants import BATCH_MAX
+from tigerbeetle_tpu.oracle.state_machine import StateMachineOracle
+from tigerbeetle_tpu.types import Account, CreateTransferStatus, Transfer
+
+BASELINE_TPS = 1_000_000  # reference design claim, single core
+TARGET_TPS = 10_000_000  # driver target, single v5e chip
+
+
+def _mk_transfers(n, id_base, rng, account_count, hot=None):
+    """Zipfian-ish workload like benchmark_load.zig: ids sequential, accounts
+    uniform over [1, account_count] (with optional hot subset)."""
+    ids = np.arange(id_base, id_base + n, dtype=np.uint64)
+    if account_count == 2:
+        dr = np.full(n, 1, dtype=np.uint64)
+        cr = np.full(n, 2, dtype=np.uint64)
+    else:
+        dr = rng.integers(1, account_count + 1, size=n, dtype=np.uint64)
+        cr = rng.integers(1, account_count + 1, size=n, dtype=np.uint64)
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+    amount = rng.integers(1, 1000, size=n, dtype=np.uint64)
+    z = np.zeros(n, dtype=np.uint64)
+    return dict(
+        id_hi=z.copy(), id_lo=ids,
+        dr_hi=z.copy(), dr_lo=dr,
+        cr_hi=z.copy(), cr_lo=cr,
+        amt_hi=z.copy(), amt_lo=amount,
+        pid_hi=z.copy(), pid_lo=z.copy(),
+        ud128_hi=z.copy(), ud128_lo=z.copy(),
+        ud64=z.copy(),
+        ud32=np.zeros(n, dtype=np.uint32),
+        timeout=np.zeros(n, dtype=np.uint32),
+        ledger=np.ones(n, dtype=np.uint32),
+        code=np.ones(n, dtype=np.uint32),
+        flags=np.zeros(n, dtype=np.uint32),
+        ts=z.copy(),
+    )
+
+
+def _setup_state(account_count):
+    state = StateMachineOracle()
+    accounts = [Account(id=i, ledger=1, code=1) for i in range(1, account_count + 1)]
+    for lo in range(0, account_count, BATCH_MAX):
+        chunk = accounts[lo:lo + BATCH_MAX]
+        state.create_accounts(chunk, timestamp=lo + len(chunk))
+    return state
+
+
+def bench_sequential_kernel(account_count, batches, events_per_batch=BATCH_MAX):
+    """prefetch -> device kernel -> apply, per batch (host state store)."""
+    from tigerbeetle_tpu.ops.batch import prefetch_create_transfers
+    from tigerbeetle_tpu.ops.create_kernels import (
+        apply_create_transfers,
+        create_transfers_kernel,
+    )
+
+    rng = np.random.default_rng(42)
+    state = _setup_state(account_count)
+    ts = 1_000_000_000
+
+    def run_batch(i, timed_state):
+        ev = _mk_transfers(events_per_batch, 1_000_000 + i * events_per_batch,
+                           rng, account_count)
+        nonlocal ts
+        ts += events_per_batch + 1
+        inputs, aux = prefetch_create_transfers(timed_state, ev, ts)
+        out = create_transfers_kernel(inputs)
+        return apply_create_transfers(timed_state, inputs, aux, out)
+
+    # Warmup/compile.
+    run_batch(-1, _setup_state(account_count))
+
+    accepted = 0
+    t0 = time.perf_counter()
+    for i in range(batches):
+        results = run_batch(i, state)
+        accepted += sum(
+            1 for r in results if r.status == CreateTransferStatus.created
+        )
+    elapsed = time.perf_counter() - t0
+    return accepted, elapsed
+
+
+def parity_check(n=512):
+    """Kernel vs oracle on one mixed batch."""
+    from tigerbeetle_tpu.ops.create_kernels import run_create_transfers
+
+    rng = np.random.default_rng(7)
+    kernel_state = _setup_state(10)
+    oracle_state = _setup_state(10)
+    transfers = [
+        Transfer(
+            id=int(i) + 1,
+            debit_account_id=int(rng.integers(0, 12)),
+            credit_account_id=int(rng.integers(0, 12)),
+            amount=int(rng.integers(0, 1000)),
+            ledger=int(rng.integers(1, 3)),
+            code=1,
+        )
+        for i in range(n)
+    ]
+    ts = 10_000_000
+    got = run_create_transfers(kernel_state, transfers, ts)
+    want = oracle_state.create_transfers(transfers, ts)
+    return all(
+        g.status == w.status and g.timestamp == w.timestamp
+        for g, w in zip(got, want)
+    )
+
+
+def main():
+    quick = os.environ.get("BENCH_QUICK") == "1"
+    events = 512 if quick else BATCH_MAX
+    parity = parity_check()
+
+    # Config 1: single-ledger, 2 hot accounts (repl/benchmark shape).
+    acc1, el1 = bench_sequential_kernel(
+        account_count=2, batches=2 if quick else 3, events_per_batch=events)
+    # Config 2: random transfers over 10K accounts (fuzz shape), subsampled.
+    acc2, el2 = bench_sequential_kernel(
+        account_count=10_000, batches=2 if quick else 3, events_per_batch=events)
+
+    tps1 = acc1 / el1
+    tps2 = acc2 / el2
+    value = tps2  # headline: the fuzz workload
+
+    print(json.dumps({
+        "metric": "create_transfers_validated_per_sec",
+        "value": round(value, 1),
+        "unit": "transfers/s",
+        "vs_baseline": round(value / BASELINE_TPS, 4),
+        "vs_target_10m": round(value / TARGET_TPS, 4),
+        "config1_2acct_tps": round(tps1, 1),
+        "config2_10kacct_tps": round(tps2, 1),
+        "parity_vs_oracle": parity,
+        "kernel": "sequential_fori",
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -3,13 +3,20 @@
 Multi-chip hardware is unavailable in CI; shardings are validated on a virtual
 CPU mesh (the reference's analogous trick is compile-time-injecting simulated
 Storage/MessageBus into real replicas — src/testing/cluster.zig:58).
+
+The environment pins JAX_PLATFORMS=axon (the TPU tunnel), so env vars alone
+are not enough: jax.config.update must run before any backend initializes.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -33,6 +33,7 @@ def assert_state_equal(oracle: StateMachineOracle, kstate: StateMachineOracle):
     assert oracle.pulse_next_timestamp == kstate.pulse_next_timestamp
     assert oracle.account_by_timestamp == kstate.account_by_timestamp
     assert oracle.transfer_by_timestamp == kstate.transfer_by_timestamp
+    assert oracle.account_events == kstate.account_events
 
 
 class Differ:

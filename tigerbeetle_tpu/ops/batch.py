@@ -30,8 +30,6 @@ from ..constants import U128_MAX
 from ..types import Transfer, TransferPendingStatus
 from .u128 import from_int as _split, from_ints as _limbs
 
-_M64 = 0xFFFFFFFFFFFFFFFF
-
 
 def _pad(arr: np.ndarray, n: int, fill=0):
     if len(arr) == n:

@@ -94,6 +94,20 @@ def _first_failure(checks, created=_CREATED):
     return status
 
 
+def _limb_add_at(st, hi_key, lo_key, idx, d_hi, d_lo, mask):
+    """Masked u128 read-modify-write add at st[hi_key/lo_key][idx]."""
+    h, l, _ = u128.add(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
+    st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
+    st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
+
+
+def _limb_sub_at(st, hi_key, lo_key, idx, d_hi, d_lo, mask):
+    """Masked u128 read-modify-write subtract at st[hi_key/lo_key][idx]."""
+    h, l = u128.sub(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
+    st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
+    st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
+
+
 def _flag(flags, bit):
     return (flags & bit) != 0
 
@@ -483,21 +497,11 @@ def _ct_body(inputs, i, st):
     f_amt_lo = jnp.where(pv, pv_amt_lo, amt_lo)
     f_ts = jnp.where(pv, pv_ts_actual, reg_ts_actual)
 
-    def add_at(hi_key, lo_key, idx, d_hi, d_lo, mask):
-        h, l, _ = u128.add(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
-        st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
-        st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
-
-    def sub_at(hi_key, lo_key, idx, d_hi, d_lo, mask):
-        h, l = u128.sub(st[hi_key][idx], st[lo_key][idx], d_hi, d_lo)
-        st[hi_key] = st[hi_key].at[idx].set(jnp.where(mask, h, st[hi_key][idx]))
-        st[lo_key] = st[lo_key].at[idx].set(jnp.where(mask, l, st[lo_key][idx]))
-
     # Regular/pending application (reference :3909-3985).
-    add_at("a_dp_hi", "a_dp_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_pending)
-    add_at("a_cp_hi", "a_cp_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_pending)
-    add_at("a_dpos_hi", "a_dpos_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
-    add_at("a_cpos_hi", "a_cpos_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
+    _limb_add_at(st, "a_dp_hi", "a_dp_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_pending)
+    _limb_add_at(st, "a_cp_hi", "a_cp_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_pending)
+    _limb_add_at(st, "a_dpos_hi", "a_dpos_lo", ev["dr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
+    _limb_add_at(st, "a_cpos_hi", "a_cpos_lo", ev["cr_idx"], f_amt_hi, f_amt_lo, ap_reg & ~pending)
 
     rb_dr_closed = _flag(st["a_flags"][jnp.where(pv, p_row["dr_idx"], ev["dr_idx"])], _A_CLOSED)
     rb_cr_closed = _flag(st["a_flags"][jnp.where(pv, p_row["cr_idx"], ev["cr_idx"])], _A_CLOSED)
@@ -510,10 +514,10 @@ def _ct_body(inputs, i, st):
         jnp.where(close_cr, st["a_flags"][ev["cr_idx"]] | _A_CLOSED, st["a_flags"][ev["cr_idx"]]))
 
     # Post/void application (reference :4195-4283).
-    sub_at("a_dp_hi", "a_dp_lo", p_row["dr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
-    sub_at("a_cp_hi", "a_cp_lo", p_row["cr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
-    add_at("a_dpos_hi", "a_dpos_lo", p_row["dr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
-    add_at("a_cpos_hi", "a_cpos_lo", p_row["cr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
+    _limb_sub_at(st, "a_dp_hi", "a_dp_lo", p_row["dr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
+    _limb_sub_at(st, "a_cp_hi", "a_cp_lo", p_row["cr_idx"], p_row["amt_hi"], p_row["amt_lo"], ap_pv)
+    _limb_add_at(st, "a_dpos_hi", "a_dpos_lo", p_row["dr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
+    _limb_add_at(st, "a_cpos_hi", "a_cpos_lo", p_row["cr_idx"], f_amt_hi, f_amt_lo, ap_pv & is_post)
     reopen_dr = ap_pv & is_void & _flag(p_row["flags"], _F_CLOSE_DR)
     reopen_cr = ap_pv & is_void & _flag(p_row["flags"], _F_CLOSE_CR)
     st["a_flags"] = st["a_flags"].at[p_row["dr_idx"]].set(
@@ -613,22 +617,12 @@ def _ct_body(inputs, i, st):
         pa_hi, pa_lo = stj["rb_pamt_hi"][j], stj["rb_pamt_lo"][j]
         dri, cri = stj["rb_dr_idx"][j], stj["rb_cr_idx"][j]
 
-        def u_sub(hi_key, lo_key, idx, dh, dl, mask):
-            h, l = u128.sub(stj[hi_key][idx], stj[lo_key][idx], dh, dl)
-            stj[hi_key] = stj[hi_key].at[idx].set(jnp.where(mask, h, stj[hi_key][idx]))
-            stj[lo_key] = stj[lo_key].at[idx].set(jnp.where(mask, l, stj[lo_key][idx]))
-
-        def u_add(hi_key, lo_key, idx, dh, dl, mask):
-            h, l, _ = u128.add(stj[hi_key][idx], stj[lo_key][idx], dh, dl)
-            stj[hi_key] = stj[hi_key].at[idx].set(jnp.where(mask, h, stj[hi_key][idx]))
-            stj[lo_key] = stj[lo_key].at[idx].set(jnp.where(mask, l, stj[lo_key][idx]))
-
-        u_sub("a_dpos_hi", "a_dpos_lo", dri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
-        u_sub("a_cpos_hi", "a_cpos_lo", cri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
-        u_sub("a_dp_hi", "a_dp_lo", dri, a_hi, a_lo, applied & (kind == 2))
-        u_sub("a_cp_hi", "a_cp_lo", cri, a_hi, a_lo, applied & (kind == 2))
-        u_add("a_dp_hi", "a_dp_lo", dri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
-        u_add("a_cp_hi", "a_cp_lo", cri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
+        _limb_sub_at(stj, "a_dpos_hi", "a_dpos_lo", dri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
+        _limb_sub_at(stj, "a_cpos_hi", "a_cpos_lo", cri, a_hi, a_lo, applied & ((kind == 1) | (kind == 3)))
+        _limb_sub_at(stj, "a_dp_hi", "a_dp_lo", dri, a_hi, a_lo, applied & (kind == 2))
+        _limb_sub_at(stj, "a_cp_hi", "a_cp_lo", cri, a_hi, a_lo, applied & (kind == 2))
+        _limb_add_at(stj, "a_dp_hi", "a_dp_lo", dri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
+        _limb_add_at(stj, "a_cp_hi", "a_cp_lo", cri, pa_hi, pa_lo, applied & ((kind == 3) | (kind == 4)))
 
         # Restore closed bits to their pre-event values.
         for idx, prev_key in ((dri, "rb_dr_closed"), (cri, "rb_cr_closed")):
@@ -902,9 +896,13 @@ def apply_create_transfers(state, inputs, aux, out) -> list[CreateTransferResult
     # exactly (add at :3975-3981, remove-and-reset at :4227-4230) including
     # rolled-back chains not restoring it.
     flags = np.asarray(ev["flags"][:n])
-    created_mask = r_status == 0xFFFFFFFF
-    pending_add = created_mask & ((flags & 0x2) != 0) & (np.asarray(ev["timeout"][:n]) != 0)
-    pv_mask = created_mask & ((flags & 0xC) != 0)
+    created_mask = r_status == int(_CREATED)
+    pending_add = (
+        created_mask
+        & ((flags & int(_F_PENDING)) != 0)
+        & (np.asarray(ev["timeout"][:n]) != 0)
+    )
+    pv_mask = created_mask & ((flags & int(_F_POST | _F_VOID)) != 0)
     for i in np.nonzero(pending_add | pv_mask)[0]:
         i = int(i)
         if pending_add[i]:
@@ -914,6 +912,93 @@ def apply_create_transfers(state, inputs, aux, out) -> list[CreateTransferResult
             p = state.transfers[aux["event_pids"][i]]
             state.expiry.pop(p.timestamp, None)
     state.pulse_next_timestamp = int(out["pulse_next"])
+
+    # Account-event rows (CDC + balance history groove; reference
+    # account_event() src/state_machine.zig:4384-4470): replay created
+    # events' balance deltas from the prefetched snapshot so each row
+    # captures both accounts *after* its event, like the sequential path.
+    from ..oracle.state_machine import AccountEventRecord
+
+    _F_CLOSE_DR_I = int(_F_CLOSE_DR)
+    _F_CLOSE_CR_I = int(_F_CLOSE_CR)
+    _A_CLOSED_I = int(_A_CLOSED)
+    idx_to_id = {v: k for k, v in aux["acct_id_to_idx"].items()}
+    rb_kind = np.asarray(out["rb_kind"][:n])
+    slot_arr = np.asarray(ev["slot"][:n])
+    acct_in0 = inputs["acct"]
+    running: dict[int, list] = {}  # acct idx -> [dp, dpos, cp, cpos, flags]
+
+    def _running(idx: int) -> list:
+        if idx not in running:
+            running[idx] = [
+                _u128_of(acct_in0["dp_hi"], acct_in0["dp_lo"], idx),
+                _u128_of(acct_in0["dpos_hi"], acct_in0["dpos_lo"], idx),
+                _u128_of(acct_in0["cp_hi"], acct_in0["cp_lo"], idx),
+                _u128_of(acct_in0["cpos_hi"], acct_in0["cpos_lo"], idx),
+                int(acct_in0["flags"][idx]),
+            ]
+        return running[idx]
+
+    for i in np.nonzero(created_mask)[0]:
+        i = int(i)
+        kind = int(rb_kind[i])  # 1 regular, 2 pending, 3 post, 4 void
+        assert kind in (1, 2, 3, 4)
+        # Stored-transfer fields live at the event's first-occurrence slot
+        # (the batch store is slot-indexed); rb_*/r_* are event-indexed.
+        sl = int(slot_arr[i])
+        amt = _u128_of(out["s_amt_hi"], out["s_amt_lo"], sl)
+        dr = _running(int(out["s_dr_idx"][sl]))
+        cr = _running(int(out["s_cr_idx"][sl]))
+        flags_t = int(flags[i])
+        p = None
+        if kind == 1:
+            dr[1] += amt
+            cr[3] += amt
+        elif kind == 2:
+            dr[0] += amt
+            cr[2] += amt
+            if flags_t & _F_CLOSE_DR_I:
+                dr[4] |= _A_CLOSED_I
+            if flags_t & _F_CLOSE_CR_I:
+                cr[4] |= _A_CLOSED_I
+        else:
+            p = state.transfers[aux["event_pids"][i]]
+            dr[0] -= p.amount
+            cr[2] -= p.amount
+            if kind == 3:
+                dr[1] += amt
+                cr[3] += amt
+            else:
+                if p.flags & _F_CLOSE_DR_I:
+                    dr[4] &= ~_A_CLOSED_I
+                if p.flags & _F_CLOSE_CR_I:
+                    cr[4] &= ~_A_CLOSED_I
+        pstatus = {
+            1: TransferPendingStatus.none,
+            2: TransferPendingStatus.pending,
+            3: TransferPendingStatus.posted,
+            4: TransferPendingStatus.voided,
+        }[kind]
+        dr_snap = dataclasses.replace(
+            state.accounts[idx_to_id[int(out["s_dr_idx"][sl])]],
+            debits_pending=dr[0], debits_posted=dr[1],
+            credits_pending=dr[2], credits_posted=dr[3], flags=dr[4])
+        cr_snap = dataclasses.replace(
+            state.accounts[idx_to_id[int(out["s_cr_idx"][sl])]],
+            debits_pending=cr[0], debits_posted=cr[1],
+            credits_pending=cr[2], credits_posted=cr[3], flags=cr[4])
+        state.account_events.append(
+            AccountEventRecord(
+                timestamp=int(r_ts[i]),
+                dr_account=dr_snap,
+                cr_account=cr_snap,
+                transfer_flags=flags_t,
+                transfer_pending_status=pstatus,
+                transfer_pending=p,
+                amount_requested=_u128_of(ev["amt_hi"], ev["amt_lo"], i),
+                amount=amt,
+            )
+        )
 
     key_max = int(out["key_max"])
     state.transfers_key_max = key_max or None
@@ -949,7 +1034,7 @@ def apply_create_accounts(state, inputs, aux, out) -> list[CreateAccountResult]:
         state.account_by_timestamp[a.timestamp] = a.id
     key_max = int(out["key_max"])
     state.accounts_key_max = key_max or None
-    created_mask = r_status == 0xFFFFFFFF
+    created_mask = r_status == int(_CREATED)
     if created_mask.any():
         state.commit_timestamp = int(r_ts[np.nonzero(created_mask)[0][-1]])
 

@@ -1,0 +1,13 @@
+"""Device-mesh parallelism for batch validation.
+
+The reference's only multi-node axis is state-machine replication (VSR,
+SURVEY §2.5); its intra-batch axis is the 8190-event hot loop
+(reference: docs/ARCHITECTURE.md:358-362). On TPU the intra-batch axis maps
+to SPMD over a `jax.sharding.Mesh`: events are sharded across devices,
+account-balance deltas are combined with `psum` over ICI, and the account
+cache stays replicated (it is the small, hot working set).
+"""
+
+from .sharded import make_sharded_validate, sharded_demo_inputs
+
+__all__ = ["make_sharded_validate", "sharded_demo_inputs"]

@@ -1,0 +1,210 @@
+"""SPMD-sharded batch validation over a device mesh.
+
+Events are sharded along the batch axis; the account cache (the hot working
+set, equivalent of the reference's groove object cache —
+src/lsm/groove.zig:885) is replicated. Each device validates its slice of
+events and produces a dense per-account balance-delta tensor; deltas are
+summed with `psum` over ICI and applied identically on every device, so the
+replicated account state stays bit-identical across the mesh — the SPMD
+restatement of the reference's determinism doctrine
+(docs/ARCHITECTURE.md:281-307).
+
+This module intentionally implements the *order-independent* subset of the
+create_transfers checks (the full sequential semantics live in
+ops/create_kernels.py; the single-chip vectorized fast path in
+ops/fast_kernels.py). It is the multi-chip scaling skeleton: the same
+shard_map layout carries the fast-path kernel across chips.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.shard_map import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops import u128
+
+_CREATED = jnp.uint32(0xFFFFFFFF)
+
+# Wire codes (types.CreateTransferStatus values), kept in check order.
+_CODES = dict(
+    reserved_flag=4,
+    id_must_not_be_zero=5,
+    id_must_not_be_int_max=6,
+    debit_account_id_must_not_be_zero=8,
+    debit_account_id_must_not_be_int_max=9,
+    credit_account_id_must_not_be_zero=10,
+    credit_account_id_must_not_be_int_max=11,
+    accounts_must_be_different=12,
+    pending_id_must_be_zero=13,
+    timeout_reserved_for_pending_transfer=17,
+    ledger_must_not_be_zero=19,
+    code_must_not_be_zero=20,
+    debit_account_not_found=21,
+    credit_account_not_found=22,
+    accounts_must_have_the_same_ledger=23,
+    transfer_must_have_the_same_ledger_as_accounts=24,
+    debit_account_already_closed=65,
+    credit_account_already_closed=66,
+)
+
+_F_PENDING = jnp.uint32(1 << 1)
+_TF_PADDING = jnp.uint32(0xFFFF & ~0x1FF)
+_A_CLOSED = jnp.uint32(1 << 5)
+
+
+def _first_failure(checks):
+    status = _CREATED
+    for cond, code in reversed(checks):
+        status = jnp.where(cond, jnp.uint32(code), status)
+    return status
+
+
+def _validate_shard(ev, acct, n_events, timestamp):
+    """Validate one shard of events against the replicated account cache."""
+    dr = {k: acct[k][ev["dr_idx"]] for k in acct}
+    cr = {k: acct[k][ev["cr_idx"]] for k in acct}
+    pending = (ev["flags"] & _F_PENDING) != 0
+
+    checks = [
+        ((ev["flags"] & _TF_PADDING) != 0, _CODES["reserved_flag"]),
+        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _CODES["id_must_not_be_zero"]),
+        (u128.is_max(ev["id_hi"], ev["id_lo"]), _CODES["id_must_not_be_int_max"]),
+        (u128.is_zero(ev["dr_hi"], ev["dr_lo"]), _CODES["debit_account_id_must_not_be_zero"]),
+        (u128.is_max(ev["dr_hi"], ev["dr_lo"]), _CODES["debit_account_id_must_not_be_int_max"]),
+        (u128.is_zero(ev["cr_hi"], ev["cr_lo"]), _CODES["credit_account_id_must_not_be_zero"]),
+        (u128.is_max(ev["cr_hi"], ev["cr_lo"]), _CODES["credit_account_id_must_not_be_int_max"]),
+        (u128.eq(ev["dr_hi"], ev["dr_lo"], ev["cr_hi"], ev["cr_lo"]),
+         _CODES["accounts_must_be_different"]),
+        (~u128.is_zero(ev["pid_hi"], ev["pid_lo"]), _CODES["pending_id_must_be_zero"]),
+        (~pending & (ev["timeout"] != 0), _CODES["timeout_reserved_for_pending_transfer"]),
+        (ev["ledger"] == 0, _CODES["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _CODES["code_must_not_be_zero"]),
+        (~dr["exists"], _CODES["debit_account_not_found"]),
+        (~cr["exists"], _CODES["credit_account_not_found"]),
+        (dr["ledger"] != cr["ledger"], _CODES["accounts_must_have_the_same_ledger"]),
+        (ev["ledger"] != dr["ledger"], _CODES["transfer_must_have_the_same_ledger_as_accounts"]),
+        ((dr["flags"] & _A_CLOSED) != 0, _CODES["debit_account_already_closed"]),
+        ((cr["flags"] & _A_CLOSED) != 0, _CODES["credit_account_already_closed"]),
+    ]
+    status = jnp.where(ev["valid"], _first_failure(checks), jnp.uint32(0))
+    created = status == _CREATED
+
+    # Dense per-account delta tensors, carry-exact: u64 limbs are split into
+    # 32-bit halves so segment sums cannot wrap, then recombined.
+    A = acct["exists"].shape[0]
+
+    def seg_sum_u128(idx, hi, lo, mask):
+        hi = jnp.where(mask, hi, jnp.uint64(0))
+        lo = jnp.where(mask, lo, jnp.uint64(0))
+        parts = []
+        for limb in (lo, hi):
+            lo32 = limb & jnp.uint64(0xFFFFFFFF)
+            hi32 = limb >> jnp.uint64(32)
+            parts.append(jax.ops.segment_sum(lo32, idx, num_segments=A))
+            parts.append(jax.ops.segment_sum(hi32, idx, num_segments=A))
+        add_hi32 = parts[1] << jnp.uint64(32)
+        s_lo = parts[0] + add_hi32
+        carry = (parts[1] >> jnp.uint64(32)) + jnp.where(
+            s_lo < add_hi32, jnp.uint64(1), jnp.uint64(0))
+        s_hi = parts[2] + (parts[3] << jnp.uint64(32)) + carry
+        return s_hi, s_lo
+
+    d_dpos_hi, d_dpos_lo = seg_sum_u128(
+        ev["dr_idx"], ev["amt_hi"], ev["amt_lo"], created & ~pending)
+    d_cpos_hi, d_cpos_lo = seg_sum_u128(
+        ev["cr_idx"], ev["amt_hi"], ev["amt_lo"], created & ~pending)
+    d_dp_hi, d_dp_lo = seg_sum_u128(
+        ev["dr_idx"], ev["amt_hi"], ev["amt_lo"], created & pending)
+    d_cp_hi, d_cp_lo = seg_sum_u128(
+        ev["cr_idx"], ev["amt_hi"], ev["amt_lo"], created & pending)
+
+    deltas = dict(
+        dpos_hi=d_dpos_hi, dpos_lo=d_dpos_lo,
+        cpos_hi=d_cpos_hi, cpos_lo=d_cpos_lo,
+        dp_hi=d_dp_hi, dp_lo=d_dp_lo,
+        cp_hi=d_cp_hi, cp_lo=d_cp_lo,
+    )
+    return status, deltas
+
+
+def make_sharded_validate(mesh: Mesh, axis: str = "batch"):
+    """Build the jitted SPMD validation step over `mesh`.
+
+    Returns step(events, acct, n_events, timestamp) ->
+    (statuses, new_acct) with events sharded on `axis`, account state
+    replicated, and balance deltas combined via psum over the mesh.
+    """
+
+    def step(ev, acct, n_events, timestamp):
+        def shard_fn(ev, acct, n_events, timestamp):
+            status, deltas = _validate_shard(ev, acct, n_events, timestamp)
+            # One psum per leaf: some backends lower only plain sum
+            # all-reduces, not tuple-combined ones.
+            deltas = {k: jax.lax.psum(v, axis) for k, v in deltas.items()}
+            new_acct = dict(acct)
+            for field in ("dp", "dpos", "cp", "cpos"):
+                hi, lo, _ = u128.add(
+                    acct[f"{field}_hi"], acct[f"{field}_lo"],
+                    deltas[f"{field}_hi"], deltas[f"{field}_lo"])
+                new_acct[f"{field}_hi"] = hi
+                new_acct[f"{field}_lo"] = lo
+            return status, new_acct
+
+        ev_spec = {k: P(axis) for k in ev}
+        acct_spec = {k: P() for k in acct}
+        return shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(ev_spec, acct_spec, P(), P()),
+            out_specs=({k: P(axis) for k in ev}["id_lo"], acct_spec),
+            check_rep=False,
+        )(ev, acct, n_events, timestamp)
+
+    return jax.jit(step)
+
+
+def sharded_demo_inputs(n_devices: int, events_per_device: int = 16, n_accounts: int = 8):
+    """Tiny deterministic inputs for the multi-chip dryrun."""
+    import numpy as np
+
+    N = n_devices * events_per_device
+    A = n_accounts
+    ids = np.arange(1, N + 1, dtype=np.uint64)
+    dr_idx = (np.arange(N) % (A - 1) + 1).astype(np.int32)
+    cr_idx = ((np.arange(N) + 1) % (A - 1) + 1).astype(np.int32)
+    # Make dr != cr everywhere (wraparound can collide).
+    cr_idx = np.where(cr_idx == dr_idx, ((cr_idx % (A - 1)) + 1).astype(np.int32), cr_idx)
+    z64 = np.zeros(N, dtype=np.uint64)
+    ev = dict(
+        valid=np.ones(N, dtype=bool),
+        id_hi=z64, id_lo=ids,
+        dr_hi=z64, dr_lo=dr_idx.astype(np.uint64),
+        cr_hi=z64, cr_lo=cr_idx.astype(np.uint64),
+        amt_hi=z64, amt_lo=np.full(N, 10, dtype=np.uint64),
+        pid_hi=z64, pid_lo=z64,
+        ud128_hi=z64, ud128_lo=z64,
+        ud64=z64, ud32=np.zeros(N, dtype=np.uint32),
+        timeout=np.zeros(N, dtype=np.uint32),
+        ledger=np.ones(N, dtype=np.uint32),
+        code=np.ones(N, dtype=np.uint32),
+        flags=np.zeros(N, dtype=np.uint32),
+        ts=z64,
+        dr_idx=dr_idx, cr_idx=cr_idx,
+    )
+    za = np.zeros(A, dtype=np.uint64)
+    acct = dict(
+        exists=np.ones(A, dtype=bool),
+        dp_hi=za.copy(), dp_lo=za.copy(),
+        dpos_hi=za.copy(), dpos_lo=za.copy(),
+        cp_hi=za.copy(), cp_lo=za.copy(),
+        cpos_hi=za.copy(), cpos_lo=za.copy(),
+        ledger=np.ones(A, dtype=np.uint32),
+        code=np.ones(A, dtype=np.uint32),
+        flags=np.zeros(A, dtype=np.uint32),
+        ts=np.arange(A, dtype=np.uint64),
+    )
+    acct["exists"][0] = False
+    return ev, acct
