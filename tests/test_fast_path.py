@@ -1,0 +1,263 @@
+"""Differential tests: DeviceLedger (vectorized fast path) vs oracle.
+
+Mirrors the reference's state-machine oracle + fuzz strategy
+(src/state_machine_tests.zig, src/state_machine_fuzz.zig): every batch runs
+through both engines; results must match (timestamp, status) exactly and the
+reconstructed host state must equal the oracle state. Hard batches exercise
+the fallback path; eligible batches exercise the vectorized kernel.
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.constants import U128_MAX
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags as AF,
+    Transfer,
+    TransferFlags as TF,
+)
+
+TS = 10_000_000_000_000
+
+
+class Differ:
+    def __init__(self, a_cap=1 << 12, t_cap=1 << 14):
+        self.led = DeviceLedger(a_cap=a_cap, t_cap=t_cap)
+        self.sm = StateMachineOracle()
+        self.ts = TS
+
+    def _step(self, fn, events):
+        self.ts += len(events) + 7
+        got = getattr(self.led, fn)(events, self.ts)
+        want = getattr(self.sm, fn)(events, self.ts)
+        assert [(r.timestamp, r.status.name) for r in got] == [
+            (r.timestamp, r.status.name) for r in want
+        ], fn
+        return want
+
+    def accounts(self, events):
+        return self._step("create_accounts", events)
+
+    def transfers(self, events):
+        return self._step("create_transfers", events)
+
+    def check_state(self):
+        host = self.led.to_host()
+        for f in ("accounts", "transfers", "pending_status", "orphaned",
+                  "expiry", "pulse_next_timestamp", "commit_timestamp",
+                  "accounts_key_max", "transfers_key_max"):
+            assert getattr(host, f) == getattr(self.sm, f), f
+
+
+def test_accounts_scenarios():
+    d = Differ()
+    d.accounts([
+        Account(id=1, ledger=1, code=1),
+        Account(id=2, ledger=1, code=1, flags=int(AF.history)),
+        Account(id=0, ledger=1, code=1),
+        Account(id=U128_MAX, ledger=1, code=1),
+        Account(id=3, ledger=0, code=1),
+        Account(id=4, ledger=1, code=0),
+        Account(id=5, ledger=1, code=1, debits_posted=5),
+        Account(id=6, ledger=1, code=1,
+                flags=int(AF.debits_must_not_exceed_credits
+                          | AF.credits_must_not_exceed_debits)),
+        Account(id=7, ledger=1, code=1, timestamp=55),
+    ])
+    # exists comparisons
+    d.accounts([
+        Account(id=1, ledger=1, code=1),
+        Account(id=1, ledger=2, code=1),
+        Account(id=1, ledger=1, code=9),
+        Account(id=2, ledger=1, code=1),
+    ])
+    # linked chains (ok / broken / open)
+    d.accounts([
+        Account(id=10, ledger=1, code=1, flags=int(AF.linked)),
+        Account(id=11, ledger=1, code=1),
+        Account(id=12, ledger=1, code=1, flags=int(AF.linked)),
+        Account(id=0, ledger=1, code=1),
+        Account(id=13, ledger=1, code=1, flags=int(AF.linked)),
+    ])
+    d.check_state()
+
+
+def test_transfer_scenarios():
+    d = Differ()
+    d.accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 9)]
+        + [Account(id=9, ledger=2, code=1),
+           Account(id=10, ledger=1, code=1, flags=int(AF.closed))]
+    )
+    d.transfers([
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+        Transfer(id=2, debit_account_id=1, credit_account_id=1, amount=1, ledger=1, code=1),
+        Transfer(id=3, debit_account_id=1, credit_account_id=99, amount=1, ledger=1, code=1),
+        Transfer(id=4, debit_account_id=1, credit_account_id=9, amount=1, ledger=1, code=1),
+        Transfer(id=5, debit_account_id=1, credit_account_id=2, amount=1, ledger=2, code=1),
+        Transfer(id=6, debit_account_id=1, credit_account_id=10, amount=1, ledger=1, code=1),
+        Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=0),
+        Transfer(id=8, debit_account_id=1, credit_account_id=2, amount=1, ledger=0, code=1),
+        Transfer(id=9, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
+                 timeout=5),
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
+                 pending_id=77),
+    ])
+    # retry orphaned id (id=3 failed with credit_account_not_found: transient)
+    d.transfers([
+        Transfer(id=3, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1),
+    ])
+    # exists / exists_with_different_*
+    d.transfers([
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=1),
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=7, ledger=1, code=1),
+        Transfer(id=1, debit_account_id=3, credit_account_id=2, amount=100, ledger=1, code=1),
+        Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=100, ledger=1, code=2),
+    ])
+    d.check_state()
+
+
+def test_two_phase_and_chains():
+    d = Differ()
+    d.accounts([Account(id=i, ledger=1, code=1) for i in range(1, 7)])
+    d.transfers([
+        Transfer(id=100, debit_account_id=1, credit_account_id=2, amount=50, ledger=1, code=1,
+                 flags=int(TF.pending)),
+        Transfer(id=101, debit_account_id=3, credit_account_id=4, amount=60, ledger=1, code=1,
+                 flags=int(TF.pending), timeout=100),
+        Transfer(id=102, debit_account_id=5, credit_account_id=6, amount=70, ledger=1, code=1,
+                 flags=int(TF.pending)),
+    ])
+    # post (partial), void, post-after-expiry-window still valid, errors
+    d.transfers([
+        Transfer(id=110, pending_id=100, amount=20, flags=int(TF.post_pending_transfer)),
+        Transfer(id=111, pending_id=102, flags=int(TF.void_pending_transfer)),
+        Transfer(id=112, pending_id=999, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
+        Transfer(id=113, pending_id=113, flags=int(TF.void_pending_transfer)),
+    ])
+    # already posted / voided
+    d.transfers([
+        Transfer(id=120, pending_id=100, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
+        Transfer(id=121, pending_id=102, flags=int(TF.void_pending_transfer)),
+    ])
+    # chains over two-phase
+    d.transfers([
+        Transfer(id=130, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
+                 flags=int(TF.linked)),
+        Transfer(id=131, pending_id=101, flags=int(TF.void_pending_transfer)),
+    ])
+    d.check_state()
+
+
+def test_hard_batches_fall_back():
+    d = Differ()
+    d.accounts([
+        Account(id=1, ledger=1, code=1),
+        Account(id=2, ledger=1, code=1),
+        Account(id=3, ledger=1, code=1, flags=int(AF.debits_must_not_exceed_credits)),
+    ])
+    # balance limits touched -> fallback, still exact
+    d.transfers([
+        Transfer(id=1, debit_account_id=1, credit_account_id=3, amount=10, ledger=1, code=1),
+        Transfer(id=2, debit_account_id=3, credit_account_id=2, amount=5, ledger=1, code=1),
+        Transfer(id=3, debit_account_id=3, credit_account_id=2, amount=6, ledger=1, code=1),
+    ])
+    assert d.led.fallbacks == 1
+    # balancing flag -> fallback
+    d.transfers([
+        Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=U128_MAX, ledger=1, code=1,
+                 flags=int(TF.balancing_debit)),
+    ])
+    # in-batch pending+post -> fallback
+    d.transfers([
+        Transfer(id=5, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1,
+                 flags=int(TF.pending)),
+        Transfer(id=6, pending_id=5, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
+    ])
+    # closing transfer -> fallback
+    d.transfers([
+        Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
+                 flags=int(TF.pending | TF.closing_debit)),
+    ])
+    # void of closing pending (reopen) -> fallback
+    d.transfers([
+        Transfer(id=8, pending_id=7, flags=int(TF.void_pending_transfer)),
+    ])
+    assert d.led.fallbacks >= 4
+    d.check_state()
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_fuzz_differential(seed):
+    """Random workload biased to eligible batches with occasional hard ones."""
+    rng = random.Random(seed)
+    d = Differ()
+    account_ids = list(range(1, 40))
+    d.accounts([Account(id=i, ledger=1 + (i % 2), code=1,
+                        flags=int(AF.history) if i % 7 == 0 else 0)
+                for i in account_ids])
+    next_id = 1000
+    pending_ids = []
+    for _ in range(12):
+        batch = []
+        n = rng.randrange(1, 40)
+        for _ in range(n):
+            roll = rng.random()
+            tid = next_id
+            next_id += 1
+            if roll < 0.60:
+                batch.append(Transfer(
+                    id=tid,
+                    debit_account_id=rng.choice(account_ids + [0, 99]),
+                    credit_account_id=rng.choice(account_ids + [99]),
+                    amount=rng.choice([0, 1, rng.randrange(1, 10**6)]),
+                    ledger=rng.choice([1, 2]),
+                    code=rng.choice([0, 1]),
+                ))
+            elif roll < 0.75:
+                t = Transfer(
+                    id=tid,
+                    debit_account_id=rng.choice(account_ids),
+                    credit_account_id=rng.choice(account_ids),
+                    amount=rng.randrange(1, 100),
+                    ledger=rng.choice([1, 2]), code=1,
+                    flags=int(TF.pending),
+                    timeout=rng.choice([0, 0, 5]),
+                )
+                pending_ids.append(tid)
+                batch.append(t)
+            elif roll < 0.88 and pending_ids:
+                pid = rng.choice(pending_ids)
+                post = rng.random() < 0.5
+                batch.append(Transfer(
+                    id=tid, pending_id=pid,
+                    amount=U128_MAX if post else 0,
+                    flags=int(TF.post_pending_transfer if post
+                              else TF.void_pending_transfer),
+                ))
+            elif roll < 0.94:
+                # duplicate of an existing id (exists path)
+                batch.append(Transfer(
+                    id=rng.randrange(1000, max(1001, next_id)),
+                    debit_account_id=rng.choice(account_ids),
+                    credit_account_id=rng.choice(account_ids),
+                    amount=rng.randrange(0, 100),
+                    ledger=1, code=1,
+                ))
+            else:
+                # chain head
+                batch.append(Transfer(
+                    id=tid,
+                    debit_account_id=rng.choice(account_ids),
+                    credit_account_id=rng.choice(account_ids),
+                    amount=rng.randrange(1, 100),
+                    ledger=1, code=1,
+                    flags=int(TF.linked),
+                ))
+        d.transfers(batch)
+    d.check_state()

@@ -1,0 +1,712 @@
+"""Vectorized create_transfers / create_accounts kernels over a device ledger.
+
+The sequential kernel (ops/create_kernels.py) is the bit-exact baseline: a
+lax.fori_loop whose iteration i sees iteration i-1's effects — the direct
+image of the reference hot loop (src/state_machine.zig:3002-3213). This
+module is the TPU-native fast path: every per-event check evaluated on the
+whole batch at once, chains resolved with a segment first-failure broadcast,
+and balance application done with carry-safe scatter-adds.
+
+Exactness strategy: a batch is *eligible* for the fast path iff its statuses
+are provably order-independent. The kernel verifies eligibility on device
+(returns a `fallback` flag and leaves state untouched when set):
+
+  E1  no imported / balancing_debit|credit / closing_debit|credit flags
+      (imported regress checks and balance clamps are order-dependent);
+  E2  no duplicate ids within the batch, no pending_id referencing an id in
+      the batch, no duplicate pending_ids (intra-batch object dependencies);
+  E3  no balance-limit-flagged account is touched by a regular transfer
+      (exceeds_credits/debits would depend on running balances);
+  E4  no u128 balance overflow is possible: max touched balance plus the
+      exact 160-bit sum of all batch amounts stays below 2^128, so the six
+      overflow statuses (src/state_machine.zig:3856-3884) cannot fire;
+  E5  a voided pending transfer has no closing flags (void would reopen a
+      closed account mid-batch);
+  E6  pulse scheduling stays closed-form: not both pending-with-timeout and
+      post/void events in one batch;
+  E7  hash/row capacity suffices.
+
+Under E1-E7, statuses depend only on pre-batch state and per-event fields
+(plus chain topology), so evaluating them in parallel is exactly the
+sequential semantics. Everything else — exists/idempotency, orphaned ids,
+two-phase post/void of *committed* pendings, expired pendings, closed
+accounts, chains with rollback — is handled natively in parallel.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..constants import NS_PER_S, U63_MAX
+from . import u128
+from .create_kernels import (
+    _A_CLOSED,
+    _A_CR_LIMIT,
+    _A_DR_LIMIT,
+    _A_IMPORTED,
+    _A_LINKED,
+    _AF_PADDING,
+    _AS,
+    _CREATED,
+    _F_BAL_CR,
+    _F_BAL_DR,
+    _F_CLOSE_CR,
+    _F_CLOSE_DR,
+    _F_IMPORTED,
+    _F_LINKED,
+    _F_PENDING,
+    _F_POST,
+    _F_VOID,
+    _PS_EXPIRED,
+    _PS_PENDING,
+    _PS_POSTED,
+    _PS_VOIDED,
+    _TF_PADDING,
+    _TRANSIENT_CODES,
+    _TS,
+    _ct_eval_exists,
+    _first_failure,
+)
+
+_NSPS = np.uint64(NS_PER_S)
+_U63 = np.uint64(U63_MAX)
+_M32 = np.uint64(0xFFFFFFFF)
+_INF = np.int32(0x7FFFFFFF)
+
+
+def _flag(flags, bit):
+    return (flags & bit) != 0
+
+
+# ------------------------------------------------------------ limb helpers
+
+def _to_limbs(hi, lo):
+    """(hi, lo) u64 pair -> 4 x u32-normalized limbs in u64 lanes."""
+    return (lo & _M32, lo >> jnp.uint64(32), hi & _M32, hi >> jnp.uint64(32))
+
+
+def _from_limbs(l0, l1, l2, l3):
+    """Normalized limbs -> (hi, lo)."""
+    return (l2 | (l3 << jnp.uint64(32)), l0 | (l1 << jnp.uint64(32)))
+
+
+def _neg_limbs(hi, lo):
+    """Limbs of (2^128 - x) mod 2^128: two's complement for scatter-subtract."""
+    n_lo = (~lo) + jnp.uint64(1)
+    n_hi = (~hi) + jnp.where(lo == 0, jnp.uint64(1), jnp.uint64(0))
+    return _to_limbs(n_hi, n_lo)
+
+
+def _normalize_rows(bal, rows):
+    """Carry-propagate the 4-limb balances at `rows` (dup rows write the same
+    value, so the scatter is deterministic). Result limbs are u32-normalized
+    mod 2^128."""
+    out = dict(bal)
+    for field in ("dp", "dpos", "cp", "cpos"):
+        l0 = bal[f"{field}0"][rows]
+        l1 = bal[f"{field}1"][rows]
+        l2 = bal[f"{field}2"][rows]
+        l3 = bal[f"{field}3"][rows]
+        c = l0 >> jnp.uint64(32)
+        l0 = l0 & _M32
+        l1 = l1 + c
+        c = l1 >> jnp.uint64(32)
+        l1 = l1 & _M32
+        l2 = l2 + c
+        c = l2 >> jnp.uint64(32)
+        l2 = l2 & _M32
+        l3 = (l3 + c) & _M32
+        out[f"{field}0"] = out[f"{field}0"].at[rows].set(l0)
+        out[f"{field}1"] = out[f"{field}1"].at[rows].set(l1)
+        out[f"{field}2"] = out[f"{field}2"].at[rows].set(l2)
+        out[f"{field}3"] = out[f"{field}3"].at[rows].set(l3)
+    return out
+
+
+def _gather_balance(bal, field, rows):
+    return _from_limbs(
+        bal[f"{field}0"][rows], bal[f"{field}1"][rows],
+        bal[f"{field}2"][rows], bal[f"{field}3"][rows])
+
+
+def _u128_max_reduce(his, los):
+    """Exact max over a list of (hi, lo) arrays of equal shape."""
+    hi = his[0]
+    lo = los[0]
+    for h, l in zip(his[1:], los[1:]):
+        take = (h > hi) | ((h == hi) & (l > lo))
+        hi = jnp.where(take, h, hi)
+        lo = jnp.where(take, l, lo)
+    mhi = jnp.max(hi)
+    mlo = jnp.max(jnp.where(hi == mhi, lo, jnp.uint64(0)))
+    return mhi, mlo
+
+
+def _dup_keys(k_hi, k_lo, tags):
+    """True if any two tagged keys are equal. Sort by (key, tagged-first) so
+    tagged duplicates are adjacent even when untagged copies of the same key
+    sit between them."""
+    untag = (~tags).astype(jnp.int32)
+    order = jnp.lexsort((untag, k_lo, k_hi))
+    s_hi = k_hi[order]
+    s_lo = k_lo[order]
+    s_tag = tags[order]
+    eq = (s_hi[1:] == s_hi[:-1]) & (s_lo[1:] == s_lo[:-1])
+    both = s_tag[1:] & s_tag[:-1]
+    return jnp.any(eq & both)
+
+
+# ================================================== create_transfers (fast)
+
+def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
+    """One batch against the device ledger. Returns (new_state, out) where
+    out = {r_status, r_ts, fallback, created_count}. When out['fallback'] is
+    set, new_state is the input state unchanged (every write is masked to the
+    dump slot, so donated buffers are reusable in place).
+
+    force_fallback: optional bool scalar that aborts the batch uncondition-
+    ally (used by the scan driver to poison batches after a fallback)."""
+    from .hash_table import ht_lookup, ht_plan, ht_write
+
+    acc = state["accounts"]
+    xfr = state["transfers"]
+    N = ev["id_lo"].shape[0]
+    A_dump = acc["id_hi"].shape[0] - 1
+    T_dump = xfr["id_hi"].shape[0] - 1
+    idxs = jnp.arange(N, dtype=jnp.int32)
+    valid = ev["valid"]
+    nn = n.astype(jnp.uint64)
+    ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
+
+    flags = ev["flags"]
+    linked = _flag(flags, _F_LINKED) & valid
+    pending = _flag(flags, _F_PENDING)
+    is_post = _flag(flags, _F_POST)
+    is_void = _flag(flags, _F_VOID)
+    pv = is_post | is_void
+
+    # ---------------- lookups ----------------
+    dr_found, dr_row = ht_lookup(state["acct_ht"], ev["dr_hi"], ev["dr_lo"])
+    cr_found, cr_row = ht_lookup(state["acct_ht"], ev["cr_hi"], ev["cr_lo"])
+    e_found, e_row = ht_lookup(state["xfer_ht"], ev["id_hi"], ev["id_lo"])
+    o_found, _ = ht_lookup(state["orphan_ht"], ev["id_hi"], ev["id_lo"])
+    p_found, p_row = ht_lookup(state["xfer_ht"], ev["pid_hi"], ev["pid_lo"])
+
+    dr_rowc = jnp.where(dr_found, dr_row, A_dump)
+    cr_rowc = jnp.where(cr_found, cr_row, A_dump)
+    e_rowc = jnp.where(e_found, e_row, T_dump)
+    p_rowc = jnp.where(p_found, p_row, T_dump)
+
+    def acct_gather(rows, found):
+        return dict(
+            exists=found,
+            dp=_gather_balance(acc, "dp", rows),
+            dpos=_gather_balance(acc, "dpos", rows),
+            cp=_gather_balance(acc, "cp", rows),
+            cpos=_gather_balance(acc, "cpos", rows),
+            ledger=acc["ledger"][rows],
+            code=acc["code"][rows],
+            flags=acc["flags"][rows],
+            ts=acc["ts"][rows],
+        )
+
+    def xfer_gather(rows):
+        g = {k: xfr[k][rows] for k in (
+            "dr_hi", "dr_lo", "cr_hi", "cr_lo", "amt_hi", "amt_lo",
+            "pid_hi", "pid_lo", "ud128_hi", "ud128_lo", "ud64", "ud32",
+            "timeout", "ledger", "code", "flags", "ts", "expires",
+            "pstat", "dr_row", "cr_row")}
+        return g
+
+    dr = acct_gather(dr_rowc, dr_found)
+    cr = acct_gather(cr_rowc, cr_found)
+    e = xfer_gather(e_rowc)
+    p = xfer_gather(p_rowc)
+    p_dr = acct_gather(p["dr_row"], p_found)
+    p_cr = acct_gather(p["cr_row"], p_found)
+
+    # Resolved post/void amount (sentinel resolution, reference :4101-4112).
+    pv_amt_hi, pv_amt_lo = u128.select(
+        jnp.where(is_void,
+                  u128.is_zero(ev["amt_hi"], ev["amt_lo"]),
+                  u128.is_max(ev["amt_hi"], ev["amt_lo"])),
+        p["amt_hi"], p["amt_lo"], ev["amt_hi"], ev["amt_lo"])
+
+    # ---------------- eligibility ----------------
+    hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
+    e1 = jnp.any(valid & _flag(flags, jnp.uint32(hard_flags)))
+
+    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
+    e2 = _dup_keys(
+        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
+        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
+        jnp.concatenate([tag, ptag]))
+
+    reg = valid & ~pv
+    e3 = jnp.any(reg & (_flag(dr["flags"], _A_DR_LIMIT)
+                        | _flag(cr["flags"], _A_CR_LIMIT)))
+
+    amt_res_hi = jnp.where(pv, pv_amt_hi, ev["amt_hi"])
+    amt_res_lo = jnp.where(pv, pv_amt_lo, ev["amt_lo"])
+    a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
+    a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
+    l0, l1, l2, l3 = _to_limbs(a_hi, a_lo)
+    s0 = jnp.sum(l0)
+    s1 = jnp.sum(l1)
+    s2 = jnp.sum(l2)
+    s3 = jnp.sum(l3)  # each < 2^45: no u64 overflow
+    # S as 5 limbs (normalized).
+    c = s0 >> jnp.uint64(32); s0 &= _M32
+    s1 += c; c = s1 >> jnp.uint64(32); s1 &= _M32
+    s2 += c; c = s2 >> jnp.uint64(32); s2 &= _M32
+    s3 += c; s4 = s3 >> jnp.uint64(32); s3 &= _M32
+    s_hi = s2 | (s3 << jnp.uint64(32))
+    s_lo = s0 | (s1 << jnp.uint64(32))
+    zeros = jnp.zeros_like(ev["amt_hi"])
+    m_hi, m_lo = _u128_max_reduce(
+        [jnp.where(valid, x, zeros) for x in (
+            dr["dp"][0], dr["dpos"][0], dr["cp"][0], dr["cpos"][0],
+            cr["dp"][0], cr["dpos"][0], cr["cp"][0], cr["cpos"][0],
+            p_dr["dp"][0], p_dr["dpos"][0], p_dr["cp"][0], p_dr["cpos"][0],
+            p_cr["dp"][0], p_cr["dpos"][0], p_cr["cp"][0], p_cr["cpos"][0])],
+        [jnp.where(valid, x, zeros) for x in (
+            dr["dp"][1], dr["dpos"][1], dr["cp"][1], dr["cpos"][1],
+            cr["dp"][1], cr["dpos"][1], cr["cp"][1], cr["cpos"][1],
+            p_dr["dp"][1], p_dr["dpos"][1], p_dr["cp"][1], p_dr["cpos"][1],
+            p_cr["dp"][1], p_cr["dpos"][1], p_cr["cp"][1], p_cr["cpos"][1])])
+    _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
+    e4 = ovf | (s4 > 0)
+
+    e5 = jnp.any(valid & is_void & p_found
+                 & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
+
+    any_pending_timeout = jnp.any(valid & pending & (ev["timeout"] != 0))
+    any_pv = jnp.any(valid & pv)
+    e6 = any_pending_timeout & any_pv
+
+    fallback_pre = e1 | e2 | e3 | e4 | e5 | e6
+
+    # ---------------- status evaluation ----------------
+    exists_status, exists_ts = _ct_eval_exists(
+        {k: ev[k] for k in ev}, e, p)
+
+    p_expires_due = (p["timeout"] != 0) & (p["expires"] <= ts_event)
+    pid_zero = u128.is_zero(ev["pid_hi"], ev["pid_lo"])
+    pid_max = u128.is_max(ev["pid_hi"], ev["pid_lo"])
+    pv_checks = [
+        (is_post & is_void, _TS["flags_are_mutually_exclusive"]),
+        (pending | _flag(flags, _F_BAL_DR) | _flag(flags, _F_BAL_CR)
+         | _flag(flags, _F_CLOSE_DR) | _flag(flags, _F_CLOSE_CR),
+         _TS["flags_are_mutually_exclusive"]),
+        (pid_zero, _TS["pending_id_must_not_be_zero"]),
+        (pid_max, _TS["pending_id_must_not_be_int_max"]),
+        (u128.eq(ev["pid_hi"], ev["pid_lo"], ev["id_hi"], ev["id_lo"]),
+         _TS["pending_id_must_be_different"]),
+        (ev["timeout"] != 0, _TS["timeout_reserved_for_pending_transfer"]),
+        (~p_found, _TS["pending_transfer_not_found"]),
+        (~_flag(p["flags"], _F_PENDING), _TS["pending_transfer_not_pending"]),
+        ((~u128.is_zero(ev["dr_hi"], ev["dr_lo"])) &
+         ~u128.eq(ev["dr_hi"], ev["dr_lo"], p["dr_hi"], p["dr_lo"]),
+         _TS["pending_transfer_has_different_debit_account_id"]),
+        ((~u128.is_zero(ev["cr_hi"], ev["cr_lo"])) &
+         ~u128.eq(ev["cr_hi"], ev["cr_lo"], p["cr_hi"], p["cr_lo"]),
+         _TS["pending_transfer_has_different_credit_account_id"]),
+        ((ev["ledger"] != 0) & (ev["ledger"] != p["ledger"]),
+         _TS["pending_transfer_has_different_ledger"]),
+        ((ev["code"] != 0) & (ev["code"] != p["code"]),
+         _TS["pending_transfer_has_different_code"]),
+        (u128.lt(p["amt_hi"], p["amt_lo"], pv_amt_hi, pv_amt_lo),
+         _TS["exceeds_pending_transfer_amount"]),
+        (is_void & u128.lt(pv_amt_hi, pv_amt_lo, p["amt_hi"], p["amt_lo"]),
+         _TS["pending_transfer_has_different_amount"]),
+        (p["pstat"] == _PS_POSTED, _TS["pending_transfer_already_posted"]),
+        (p["pstat"] == _PS_VOIDED, _TS["pending_transfer_already_voided"]),
+        (p["pstat"] == _PS_EXPIRED, _TS["pending_transfer_expired"]),
+        (p_expires_due, _TS["pending_transfer_expired"]),
+        (_flag(p_dr["flags"], _A_CLOSED) & ~is_void, _TS["debit_account_already_closed"]),
+        (_flag(p_cr["flags"], _A_CLOSED) & ~is_void, _TS["credit_account_already_closed"]),
+    ]
+    pv_status = _first_failure(pv_checks)
+
+    dr_zero = u128.is_zero(ev["dr_hi"], ev["dr_lo"])
+    dr_max = u128.is_max(ev["dr_hi"], ev["dr_lo"])
+    cr_zero = u128.is_zero(ev["cr_hi"], ev["cr_lo"])
+    cr_max = u128.is_max(ev["cr_hi"], ev["cr_lo"])
+    timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
+    ovf_timeout = ts_event + timeout_ns > _U63
+    reg_checks = [
+        (dr_zero, _TS["debit_account_id_must_not_be_zero"]),
+        (dr_max, _TS["debit_account_id_must_not_be_int_max"]),
+        (cr_zero, _TS["credit_account_id_must_not_be_zero"]),
+        (cr_max, _TS["credit_account_id_must_not_be_int_max"]),
+        (u128.eq(ev["dr_hi"], ev["dr_lo"], ev["cr_hi"], ev["cr_lo"]),
+         _TS["accounts_must_be_different"]),
+        (~pid_zero, _TS["pending_id_must_be_zero"]),
+        (~pending & (ev["timeout"] != 0), _TS["timeout_reserved_for_pending_transfer"]),
+        (ev["ledger"] == 0, _TS["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _TS["code_must_not_be_zero"]),
+        (~dr["exists"], _TS["debit_account_not_found"]),
+        (~cr["exists"], _TS["credit_account_not_found"]),
+        (dr["ledger"] != cr["ledger"], _TS["accounts_must_have_the_same_ledger"]),
+        (ev["ledger"] != dr["ledger"], _TS["transfer_must_have_the_same_ledger_as_accounts"]),
+        (_flag(dr["flags"], _A_CLOSED), _TS["debit_account_already_closed"]),
+        (_flag(cr["flags"], _A_CLOSED), _TS["credit_account_already_closed"]),
+        (ovf_timeout, _TS["overflows_timeout"]),
+    ]
+    reg_status = _first_failure(reg_checks)
+
+    inner = jnp.where(
+        e_found, exists_status,
+        jnp.where(o_found, _TS["id_already_failed"],
+                  jnp.where(pv, pv_status, reg_status)))
+    pre = _first_failure([
+        ((flags & _TF_PADDING) != 0, _TS["reserved_flag"]),
+        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _TS["id_must_not_be_zero"]),
+        (u128.is_max(ev["id_hi"], ev["id_lo"]), _TS["id_must_not_be_int_max"]),
+    ])
+    inner = jnp.where(pre != _CREATED, pre, inner)
+    ts_inner = jnp.where(e_found & (inner == _TS["exists"]), exists_ts, ts_event)
+
+    imported = _flag(flags, _F_IMPORTED)
+    status = inner
+    status = jnp.where(~imported & (ev["ts"] != 0), _TS["timestamp_must_be_zero"], status)
+    # batch_imported batches fall back (E1), so an imported flag here is
+    # always a mismatch (reference execute_create :3052-3063).
+    status = jnp.where(imported, _TS["imported_event_not_expected"], status)
+    ts_actual = jnp.where(status == inner, ts_inner, ts_event)
+
+    # ---------------- chains: segment first-failure broadcast ----------------
+    l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
+    in_chain = linked | l_prev
+    start = linked & ~l_prev
+    chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
+    is_last = idxs == (n - 1)
+    status = jnp.where(linked & is_last, _TS["linked_event_chain_open"], status)
+    fail = in_chain & valid & (status != _CREATED)
+    fail_pos = jnp.where(fail, idxs, _INF)
+    seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
+    my_first = seg_first[chain_id]
+    broken = in_chain & (my_first != _INF)
+    not_the_failure = broken & (idxs != my_first)
+    status = jnp.where(not_the_failure, _TS["linked_event_failed"], status)
+    ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
+
+    status = jnp.where(valid, status, jnp.uint32(0))
+    created = valid & (status == _CREATED)
+
+    # ------- commit/abort decision (fully read-only planning) -------
+    # All remaining fallback causes are resolved BEFORE any state write, so
+    # the abort path is "mask every scatter to the dump slot" — the donated
+    # state buffers are updated in place and never copied.
+    row_off = (jnp.cumsum(created.astype(jnp.int32), dtype=jnp.int32)
+               - created.astype(jnp.int32))
+    n_created = jnp.sum(created, dtype=jnp.int32)
+    new_rows = xfr["count"] + row_off
+
+    e7 = ((xfr["count"] + n_created) > jnp.int32(T_dump))
+
+    transient = jnp.zeros_like(valid)
+    for code in _TRANSIENT_CODES:
+        transient = transient | (status == code)
+    orphan_new = valid & transient
+
+    xfer_pos, ins_ok = ht_plan(
+        state["xfer_ht"], ev["id_hi"], ev["id_lo"], created)
+    orph_pos, orph_ok = ht_plan(
+        state["orphan_ht"], ev["id_hi"], ev["id_lo"], orphan_new)
+
+    fallback = fallback_pre | e7 | ~ins_ok | ~orph_ok
+    if force_fallback is not None:
+        fallback = fallback | force_fallback
+    ok = ~fallback
+
+    # ---------------- application (all masked by ok) ----------------
+    ap = created & ok
+    ap_reg = ap & ~pv & ~pending
+    ap_pend = ap & ~pv & pending
+    ap_pv = ap & pv
+    ap_post = ap_pv & is_post
+
+    al0, al1, al2, al3 = _to_limbs(amt_res_hi, amt_res_lo)
+    nl0, nl1, nl2, nl3 = _neg_limbs(p["amt_hi"], p["amt_lo"])
+
+    bal = {k: acc[k] for k in acc}
+
+    def scat_add(field, rows, limbs, mask):
+        tpos = jnp.where(mask, rows, A_dump)
+        for j, lim in enumerate(limbs):
+            bal[f"{field}{j}"] = bal[f"{field}{j}"].at[tpos].add(
+                jnp.where(mask, lim, jnp.uint64(0)))
+
+    scat_add("dpos", dr_rowc, (al0, al1, al2, al3), ap_reg)
+    scat_add("cpos", cr_rowc, (al0, al1, al2, al3), ap_reg)
+    scat_add("dp", dr_rowc, (al0, al1, al2, al3), ap_pend)
+    scat_add("cp", cr_rowc, (al0, al1, al2, al3), ap_pend)
+    # post/void: release pending amounts from p's accounts...
+    scat_add("dp", p["dr_row"], (nl0, nl1, nl2, nl3), ap_pv)
+    scat_add("cp", p["cr_row"], (nl0, nl1, nl2, nl3), ap_pv)
+    # ...and post the resolved amount.
+    scat_add("dpos", p["dr_row"], (al0, al1, al2, al3), ap_post)
+    scat_add("cpos", p["cr_row"], (al0, al1, al2, al3), ap_post)
+
+    touched = jnp.concatenate([
+        jnp.where(ap & ~pv, dr_rowc, A_dump),
+        jnp.where(ap & ~pv, cr_rowc, A_dump),
+        jnp.where(ap_pv, p["dr_row"], A_dump),
+        jnp.where(ap_pv, p["cr_row"], A_dump),
+    ])
+    bal = _normalize_rows(bal, touched)
+    new_acc = bal
+
+    # Pending-status flips on committed pendings (E2 guarantees unique rows).
+    flip_pos = jnp.where(ap_pv, p_rowc, T_dump)
+    new_pstat = xfr["pstat"].at[flip_pos].set(
+        jnp.where(is_post, _PS_POSTED, _PS_VOIDED))
+
+    # Insert created transfer rows (compacted).
+    trow = jnp.where(ap, new_rows, T_dump)
+    ud128z = u128.is_zero(ev["ud128_hi"], ev["ud128_lo"])
+    stores = dict(
+        id_hi=ev["id_hi"], id_lo=ev["id_lo"],
+        dr_hi=jnp.where(pv, p["dr_hi"], ev["dr_hi"]),
+        dr_lo=jnp.where(pv, p["dr_lo"], ev["dr_lo"]),
+        cr_hi=jnp.where(pv, p["cr_hi"], ev["cr_hi"]),
+        cr_lo=jnp.where(pv, p["cr_lo"], ev["cr_lo"]),
+        amt_hi=amt_res_hi, amt_lo=amt_res_lo,
+        pid_hi=ev["pid_hi"], pid_lo=ev["pid_lo"],
+        ud128_hi=jnp.where(pv & ud128z, p["ud128_hi"], ev["ud128_hi"]),
+        ud128_lo=jnp.where(pv & ud128z, p["ud128_lo"], ev["ud128_lo"]),
+        ud64=jnp.where(pv & (ev["ud64"] == 0), p["ud64"], ev["ud64"]),
+        ud32=jnp.where(pv & (ev["ud32"] == 0), p["ud32"], ev["ud32"]),
+        timeout=jnp.where(pv, jnp.uint32(0), ev["timeout"]),
+        ledger=jnp.where(pv, p["ledger"], ev["ledger"]),
+        code=jnp.where(pv, p["code"], ev["code"]),
+        flags=flags,
+        ts=ts_event,
+        pstat=jnp.where(pending & ~pv, _PS_PENDING, jnp.int32(0)),
+        expires=jnp.where(pending & ~pv & (ev["timeout"] != 0),
+                          ts_event + timeout_ns, jnp.uint64(0)),
+        dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
+        cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
+    )
+    new_xfr = {"pstat": new_pstat, "count": xfr["count"]}
+    for k, v in stores.items():
+        if k == "pstat":
+            new_xfr["pstat"] = new_xfr["pstat"].at[trow].set(
+                jnp.where(ap, v, new_xfr["pstat"][T_dump]))
+        else:
+            new_xfr[k] = xfr[k].at[trow].set(v)
+    new_xfr["count"] = xfr["count"] + jnp.where(ok, n_created, 0)
+
+    new_xfer_ht = ht_write(
+        state["xfer_ht"], xfer_pos, ev["id_hi"], ev["id_lo"], new_rows, ap)
+    new_orphan_ht = ht_write(
+        state["orphan_ht"], orph_pos, ev["id_hi"], ev["id_lo"],
+        jnp.zeros(N, dtype=jnp.int32), orphan_new & ok)
+
+    # Scalars.
+    last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
+    key_max = jnp.where(created.any() & ok,
+                        jnp.maximum(state["xfer_key_max"], last_ts),
+                        state["xfer_key_max"])
+    commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
+
+    # Pulse scheduling, closed-form under E6.
+    expires_new = jnp.where(
+        created & pending & (ev["timeout"] != 0),
+        ts_event + timeout_ns, jnp.uint64(0xFFFFFFFFFFFFFFFF))
+    min_exp = jnp.min(expires_new)
+    pulse = state["pulse_next"]
+    pulse = jnp.where(any_pending_timeout & (min_exp < pulse), min_exp, pulse)
+    pv_reset = jnp.any(ap_pv & (p["timeout"] != 0)
+                       & (p["expires"] == state["pulse_next"]))
+    pulse = jnp.where(pv_reset, jnp.uint64(1), pulse)
+    pulse = jnp.where(ok, pulse, state["pulse_next"])
+
+    new_state = dict(
+        accounts=new_acc,
+        transfers=new_xfr,
+        acct_ht=state["acct_ht"],
+        xfer_ht=new_xfer_ht,
+        orphan_ht=new_orphan_ht,
+        acct_key_max=state["acct_key_max"],
+        xfer_key_max=key_max,
+        pulse_next=pulse,
+        commit_ts=commit_ts,
+    )
+    out = dict(
+        r_status=jnp.where(ok, status, jnp.zeros_like(status)),
+        r_ts=jnp.where(ok, jnp.where(valid, ts_actual, jnp.uint64(0)),
+                       jnp.zeros_like(ts_actual)),
+        fallback=fallback,
+        created_count=jnp.where(ok, n_created, 0),
+    )
+    return new_state, out
+
+
+create_transfers_fast_jit = jax.jit(create_transfers_fast, donate_argnums=0)
+
+
+def create_transfers_scan(state, evs, timestamps, ns):
+    """Run B batches back-to-back on device (lax.scan over the leading axis).
+    If any batch sets `fallback`, that batch and all later ones leave state
+    untouched and report zeroed results — the caller replays from that batch
+    on the exact path. Returns (state, outs) with stacked outs."""
+
+    def step(carry, batch):
+        state, poisoned = carry
+        ev, ts, n = batch
+        new_state, out = create_transfers_fast(
+            state, ev, ts, n, force_fallback=poisoned)
+        bad = out["fallback"]
+        return (new_state, bad), dict(out, fallback=bad)
+
+    (state, _), outs = jax.lax.scan(
+        step, (state, jnp.bool_(False)), (evs, timestamps, ns))
+    return state, outs
+
+
+create_transfers_scan_jit = jax.jit(create_transfers_scan, donate_argnums=0)
+
+
+# ================================================== create_accounts (fast)
+
+def create_accounts_fast(state, ev, timestamp, n):
+    """Vectorized create_accounts (reference :3613-3689). Eligibility: no
+    imported flags, no duplicate ids in batch, capacity suffices."""
+    from .hash_table import ht_lookup, ht_plan, ht_write
+
+    acc = state["accounts"]
+    A_dump = acc["id_hi"].shape[0] - 1
+    N = ev["id_lo"].shape[0]
+    idxs = jnp.arange(N, dtype=jnp.int32)
+    valid = ev["valid"]
+    nn = n.astype(jnp.uint64)
+    ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
+
+    flags = ev["flags"]
+    linked = _flag(flags, _A_LINKED) & valid
+    imported = _flag(flags, _A_IMPORTED)
+
+    e_found, e_row = ht_lookup(state["acct_ht"], ev["id_hi"], ev["id_lo"])
+    e_rowc = jnp.where(e_found, e_row, A_dump)
+
+    e1 = jnp.any(valid & imported)
+    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+    e2 = _dup_keys(ev["id_hi"], ev["id_lo"], tag)
+    fallback_pre = e1 | e2
+
+    exists_checks = [
+        ((flags & 0xFFFF) != (acc["flags"][e_rowc] & 0xFFFF),
+         _AS["exists_with_different_flags"]),
+        (~u128.eq(ev["ud128_hi"], ev["ud128_lo"],
+                  acc["ud128_hi"][e_rowc], acc["ud128_lo"][e_rowc]),
+         _AS["exists_with_different_user_data_128"]),
+        (ev["ud64"] != acc["ud64"][e_rowc], _AS["exists_with_different_user_data_64"]),
+        (ev["ud32"] != acc["ud32"][e_rowc], _AS["exists_with_different_user_data_32"]),
+        (ev["ledger"] != acc["ledger"][e_rowc], _AS["exists_with_different_ledger"]),
+        (ev["code"] != acc["code"][e_rowc], _AS["exists_with_different_code"]),
+    ]
+    exists_status = _first_failure(exists_checks, created=_AS["exists"])
+    exists_ts = acc["ts"][e_rowc]
+
+    checks = [
+        (ev["reserved"] != 0, _AS["reserved_field"]),
+        ((flags & _AF_PADDING) != 0, _AS["reserved_flag"]),
+        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _AS["id_must_not_be_zero"]),
+        (u128.is_max(ev["id_hi"], ev["id_lo"]), _AS["id_must_not_be_int_max"]),
+        (e_found, jnp.uint32(0)),  # replaced by exists_status below
+        (_flag(flags, _A_DR_LIMIT) & _flag(flags, _A_CR_LIMIT),
+         _AS["flags_are_mutually_exclusive"]),
+        (~u128.is_zero(ev["dp_hi"], ev["dp_lo"]), _AS["debits_pending_must_be_zero"]),
+        (~u128.is_zero(ev["dpos_hi"], ev["dpos_lo"]), _AS["debits_posted_must_be_zero"]),
+        (~u128.is_zero(ev["cp_hi"], ev["cp_lo"]), _AS["credits_pending_must_be_zero"]),
+        (~u128.is_zero(ev["cpos_hi"], ev["cpos_lo"]), _AS["credits_posted_must_be_zero"]),
+        (ev["ledger"] == 0, _AS["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _AS["code_must_not_be_zero"]),
+    ]
+    inner = _first_failure(checks)
+    inner = jnp.where(inner == 0, exists_status, inner)
+    ts_inner = jnp.where(inner == _AS["exists"], exists_ts, ts_event)
+
+    status = inner
+    status = jnp.where(~imported & (ev["ts"] != 0), _AS["timestamp_must_be_zero"], status)
+    status = jnp.where(imported, _AS["imported_event_not_expected"], status)
+    ts_actual = jnp.where(status == inner, ts_inner, ts_event)
+
+    l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
+    in_chain = linked | l_prev
+    start = linked & ~l_prev
+    chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
+    status = jnp.where(linked & (idxs == (n - 1)),
+                       _AS["linked_event_chain_open"], status)
+    fail = in_chain & valid & (status != _CREATED)
+    fail_pos = jnp.where(fail, idxs, _INF)
+    seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
+    my_first = seg_first[chain_id]
+    not_the_failure = in_chain & (my_first != _INF) & (idxs != my_first)
+    status = jnp.where(not_the_failure, _AS["linked_event_failed"], status)
+    ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
+
+    status = jnp.where(valid, status, jnp.uint32(0))
+    created = valid & (status == _CREATED)
+
+    row_off = (jnp.cumsum(created.astype(jnp.int32), dtype=jnp.int32)
+               - created.astype(jnp.int32))
+    n_created = jnp.sum(created, dtype=jnp.int32)
+    e7 = (acc["count"] + n_created) > jnp.int32(A_dump)
+    new_rows = acc["count"] + row_off
+    ht_pos, ins_ok = ht_plan(
+        state["acct_ht"], ev["id_hi"], ev["id_lo"], created)
+    fallback = fallback_pre | e7 | ~ins_ok
+    ok = ~fallback
+    ap = created & ok
+    arow = jnp.where(ap, new_rows, A_dump)
+
+    z64 = jnp.uint64(0)
+    new_acc = dict(acc)
+    for k, v in dict(
+        id_hi=ev["id_hi"], id_lo=ev["id_lo"],
+        ud128_hi=ev["ud128_hi"], ud128_lo=ev["ud128_lo"],
+        ud64=ev["ud64"], ud32=ev["ud32"],
+        ledger=ev["ledger"], code=ev["code"], flags=flags,
+        ts=ts_event,
+    ).items():
+        new_acc[k] = acc[k].at[arow].set(v)
+    for f in ("dp", "dpos", "cp", "cpos"):
+        for j in range(4):
+            new_acc[f"{f}{j}"] = acc[f"{f}{j}"].at[arow].set(z64)
+    new_acc["count"] = acc["count"] + jnp.where(ok, n_created, 0)
+
+    new_ht = ht_write(
+        state["acct_ht"], ht_pos, ev["id_hi"], ev["id_lo"], new_rows, ap)
+
+    last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
+    key_max = jnp.where(created.any() & ok,
+                        jnp.maximum(state["acct_key_max"], last_ts),
+                        state["acct_key_max"])
+    commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
+
+    new_state = dict(
+        state,
+        accounts=new_acc,
+        acct_ht=new_ht,
+        acct_key_max=key_max,
+        commit_ts=commit_ts,
+    )
+    out = dict(
+        r_status=jnp.where(ok, status, jnp.zeros_like(status)),
+        r_ts=jnp.where(ok, jnp.where(valid, ts_actual, z64),
+                       jnp.zeros_like(ts_actual)),
+        fallback=fallback,
+        created_count=jnp.where(ok, n_created, 0),
+    )
+    return new_state, out
+
+
+create_accounts_fast_jit = jax.jit(create_accounts_fast, donate_argnums=0)

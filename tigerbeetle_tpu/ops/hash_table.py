@@ -1,0 +1,158 @@
+"""Device-resident open-addressing hash table for u128 keys.
+
+The TPU-native analog of the reference's groove object cache / cache_map
+(src/lsm/cache_map.zig, src/lsm/set_associative_cache.zig): id -> row-index
+lookups for accounts and transfers, entirely on device, so prefetch needs no
+host round-trip.
+
+Layout: three arrays of length cap+1 (cap a power of two); index `cap` is a
+write-dump scratch slot so masked-out scatter lanes never alias a live slot.
+Key 0 is the empty sentinel — valid object ids are never 0
+(id_must_not_be_zero precedes every insert). Linear probing; batch insert
+resolves intra-batch slot contention with a deterministic scatter-min claim
+round, so table contents are bit-identical for identical inputs regardless
+of scheduling.
+
+All entry points are shape-stable and jit-friendly; MAX_PROBES bounds every
+probe chain, and inserts report failure (host resizes and rebuilds) instead
+of looping unboundedly.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+MAX_PROBES = 32
+
+_C1 = np.uint64(0x9E3779B97F4A7C15)
+_C2 = np.uint64(0xBF58476D1CE4E5B9)
+
+
+def ht_init(cap: int) -> dict:
+    """cap must be a power of two, sized >= 2x expected live keys."""
+    assert cap & (cap - 1) == 0
+    return dict(
+        key_hi=jnp.zeros(cap + 1, dtype=jnp.uint64),
+        key_lo=jnp.zeros(cap + 1, dtype=jnp.uint64),
+        val=jnp.zeros(cap + 1, dtype=jnp.int32),
+    )
+
+
+def ht_cap(table: dict) -> int:
+    return table["key_hi"].shape[0] - 1
+
+
+def _hash(k_hi, k_lo, cap: int):
+    h = (k_lo ^ (k_hi * _C1)) * _C2
+    h = h ^ (h >> jnp.uint64(31))
+    return (h & jnp.uint64(cap - 1)).astype(jnp.int32)
+
+
+def ht_lookup(table: dict, k_hi, k_lo):
+    """Vectorized lookup. Returns (found: bool[N], val: int32[N]).
+
+    Empty slot terminates the probe chain; keys equal to the sentinel (0)
+    are reported as absent without probing.
+    """
+    cap = ht_cap(table)
+    pos0 = _hash(k_hi, k_lo, cap)
+    querying = ~((k_hi == 0) & (k_lo == 0))
+
+    def cond(carry):
+        i, found, val, alive = carry
+        return (i < MAX_PROBES) & jnp.any(alive)
+
+    def body(carry):
+        i, found, val, alive = carry
+        pos = (pos0 + i) & (cap - 1)
+        s_hi = table["key_hi"][pos]
+        s_lo = table["key_lo"][pos]
+        empty = (s_hi == 0) & (s_lo == 0)
+        match = alive & (s_hi == k_hi) & (s_lo == k_lo)
+        found = found | match
+        val = jnp.where(match, table["val"][pos], val)
+        alive = alive & ~empty & ~match
+        return i + 1, found, val, alive
+
+    found = jnp.zeros_like(querying)
+    val = jnp.full_like(pos0, -1)
+    _, found, val, _ = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), found, val, querying)
+    )
+    return found, val
+
+
+def ht_plan(table: dict, k_hi, k_lo, mask):
+    """Plan a batch insert WITHOUT touching the table: returns
+    (pos: int32[N], ok: bool scalar) where pos[i] is the slot key i will
+    occupy. Caller guarantees masked keys are unique and absent.
+
+    Deterministic parallel claim: each probe round, every unplaced key
+    scatter-mins its batch index into a claim grid at its probe slot; the
+    winner (lowest batch index) takes an empty unclaimed slot, losers
+    advance their probe. The claim grid persists across rounds so a slot
+    claimed in round r is occupied for round r+1. ok=False if any key is
+    unplaced after MAX_PROBES (caller treats as capacity fallback).
+
+    Separating plan from write lets callers compute a global commit/abort
+    decision first and then apply all writes masked — no state copies for
+    the abort path.
+    """
+    cap = ht_cap(table)
+    N = k_hi.shape[0]
+    pos0 = _hash(k_hi, k_lo, cap)
+    idx = jnp.arange(N, dtype=jnp.int32)
+    big = jnp.int32(N)
+    dump = jnp.int32(cap)
+
+    def cond(carry):
+        i, claim, placed, probe, out = carry
+        return (i < MAX_PROBES) & ~jnp.all(placed | ~mask)
+
+    def body(carry):
+        i, claim, placed, probe, out = carry
+        pos = (pos0 + probe) & (cap - 1)
+        slot_free = ((table["key_hi"][pos] == 0)
+                     & (table["key_lo"][pos] == 0)
+                     & (claim[pos] == big))
+        want = ~placed & mask & slot_free
+        tpos = jnp.where(want, pos, dump)
+        claim = claim.at[tpos].min(idx)
+        won = want & (claim[pos] == idx)
+        out = jnp.where(won, pos, out)
+        placed = placed | won
+        probe = jnp.where(~placed & mask, probe + 1, probe)
+        return i + 1, claim, placed, probe, out
+
+    claim0 = jnp.full(cap + 1, big, dtype=jnp.int32)
+    placed0 = jnp.zeros(N, dtype=jnp.bool_)
+    probe0 = jnp.zeros(N, dtype=jnp.int32)
+    out0 = jnp.full(N, dump, dtype=jnp.int32)
+    _, _, placed, _, out = jax.lax.while_loop(
+        cond, body, (jnp.int32(0), claim0, placed0, probe0, out0)
+    )
+    ok = jnp.all(placed | ~mask)
+    return out, ok
+
+
+def ht_write(table: dict, pos, k_hi, k_lo, vals, mask):
+    """Apply a planned insert: one masked scatter per array (index cap is the
+    dump slot for masked-out lanes)."""
+    cap = ht_cap(table)
+    wpos = jnp.where(mask, pos, jnp.int32(cap))
+    return dict(
+        key_hi=table["key_hi"].at[wpos].set(k_hi),
+        key_lo=table["key_lo"].at[wpos].set(k_lo),
+        val=table["val"].at[wpos].set(vals),
+    )
+
+
+def ht_insert(table: dict, k_hi, k_lo, vals, mask):
+    """plan + write in one call. Returns (table, ok). On ok=False the table
+    still received the keys that did place; callers that need atomicity use
+    ht_plan/ht_write with their own commit mask."""
+    pos, ok = ht_plan(table, k_hi, k_lo, mask)
+    table = ht_write(table, pos, k_hi, k_lo, vals, mask & ok)
+    return table, ok

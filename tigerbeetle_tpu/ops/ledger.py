@@ -1,0 +1,444 @@
+"""DeviceLedger: the device-resident account/transfer state store.
+
+The TPU-native re-design of the reference's groove object caches
+(src/lsm/groove.zig:885 get, :1770 insert): accounts and transfers live in
+HBM as struct-of-arrays rows; id -> row lookups run through the device hash
+table (ops/hash_table.py); batch validation runs the vectorized fast kernels
+(ops/fast_kernels.py) with zero per-event host work.
+
+Exactness contract: eligible batches (see fast_kernels eligibility E1-E7)
+are processed entirely on device with results bit-identical to the oracle;
+ineligible batches fall back to the host sequential kernel
+(ops/create_kernels.py) via a full state sync — slow but exact. The ledger
+therefore always matches the oracle, batch for batch.
+
+Known scope limit (round 1): account_events (CDC/balance history) rows are
+recorded only on the fallback path; the device path counts them but does not
+materialize history rows. The StateMachine shell keeps full history.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..constants import BATCH_MAX, NS_PER_S
+from ..types import (
+    Account,
+    CreateAccountResult,
+    CreateAccountStatus,
+    CreateTransferResult,
+    CreateTransferStatus,
+    Transfer,
+    TransferPendingStatus,
+)
+from . import u128
+from .hash_table import ht_init
+
+N_PAD = 8192
+assert N_PAD >= BATCH_MAX
+
+
+def _split(x: int):
+    return np.uint64(x >> 64), np.uint64(x & 0xFFFFFFFFFFFFFFFF)
+
+
+def _limbs4(value: int):
+    return [np.uint64((value >> (32 * j)) & 0xFFFFFFFF) for j in range(4)]
+
+
+def _balance_int(acc, field, row) -> int:
+    return sum(int(acc[f"{field}{j}"][row]) << (32 * j) for j in range(4))
+
+
+def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21) -> dict:
+    """Fresh device ledger state pytree (host numpy; moved to device lazily
+    by the first jitted call)."""
+    import jax.numpy as jnp
+
+    def rows_accounts():
+        d = dict(
+            id_hi=jnp.zeros(a_cap + 1, jnp.uint64),
+            id_lo=jnp.zeros(a_cap + 1, jnp.uint64),
+            ud128_hi=jnp.zeros(a_cap + 1, jnp.uint64),
+            ud128_lo=jnp.zeros(a_cap + 1, jnp.uint64),
+            ud64=jnp.zeros(a_cap + 1, jnp.uint64),
+            ud32=jnp.zeros(a_cap + 1, jnp.uint32),
+            ledger=jnp.zeros(a_cap + 1, jnp.uint32),
+            code=jnp.zeros(a_cap + 1, jnp.uint32),
+            flags=jnp.zeros(a_cap + 1, jnp.uint32),
+            ts=jnp.zeros(a_cap + 1, jnp.uint64),
+            count=jnp.int32(0),
+        )
+        for f in ("dp", "dpos", "cp", "cpos"):
+            for j in range(4):
+                d[f"{f}{j}"] = jnp.zeros(a_cap + 1, jnp.uint64)
+        return d
+
+    def rows_transfers():
+        u64s = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
+                "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi",
+                "ud128_lo", "ud64", "ts", "expires")
+        u32s = ("ud32", "timeout", "ledger", "code", "flags")
+        d = {k: jnp.zeros(t_cap + 1, jnp.uint64) for k in u64s}
+        d.update({k: jnp.zeros(t_cap + 1, jnp.uint32) for k in u32s})
+        d["pstat"] = jnp.zeros(t_cap + 1, jnp.int32)
+        d["dr_row"] = jnp.zeros(t_cap + 1, jnp.int32)
+        d["cr_row"] = jnp.zeros(t_cap + 1, jnp.int32)
+        d["count"] = jnp.int32(0)
+        return d
+
+    return dict(
+        accounts=rows_accounts(),
+        transfers=rows_transfers(),
+        acct_ht=ht_init(2 * a_cap),
+        xfer_ht=ht_init(2 * t_cap),
+        orphan_ht=ht_init(1 << 16),
+        acct_key_max=np.uint64(0),
+        xfer_key_max=np.uint64(0),
+        pulse_next=np.uint64(1),
+        commit_ts=np.uint64(0),
+    )
+
+
+def pad_transfer_events(ev: dict, n_pad: int = N_PAD) -> dict:
+    """Pad a transfers_to_arrays SoA dict to the kernel's static shape."""
+    n = len(ev["id_lo"])
+    assert n <= n_pad
+    out = {}
+    for k, v in ev.items():
+        arr = np.zeros(n_pad, dtype=v.dtype)
+        arr[:n] = v
+        out[k] = arr
+    valid = np.zeros(n_pad, dtype=bool)
+    valid[:n] = True
+    out["valid"] = valid
+    return out
+
+
+def pad_account_events(ev: dict, n_pad: int = N_PAD) -> dict:
+    return pad_transfer_events(ev, n_pad)
+
+
+class DeviceLedger:
+    """Stateful wrapper: owns the device pytree + fallback orchestration."""
+
+    def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21):
+        self.a_cap = a_cap
+        self.t_cap = t_cap
+        self.state = init_state(a_cap, t_cap)
+        self.account_events: list = []  # fallback-path CDC rows only
+        self.fallbacks = 0
+        self.fast_batches = 0
+
+    # ------------------------------------------------------------- fast path
+
+    def create_accounts(self, accounts: list[Account], timestamp: int):
+        from .batch import accounts_to_arrays
+        from .fast_kernels import create_accounts_fast_jit
+
+        ev = pad_account_events(accounts_to_arrays(accounts))
+        n = len(accounts)
+        new_state, out = create_accounts_fast_jit(
+            self.state, ev, np.uint64(timestamp), np.int32(n))
+        if bool(out["fallback"]):
+            # new_state is the old state (all selects masked); it was donated,
+            # so adopt it before syncing down.
+            self.state = new_state
+            return self._fallback_accounts(accounts, timestamp)
+        self.state = new_state
+        self.fast_batches += 1
+        st = np.asarray(out["r_status"][:n])
+        ts = np.asarray(out["r_ts"][:n])
+        return [
+            CreateAccountResult(timestamp=int(ts[i]),
+                                status=CreateAccountStatus(int(st[i])))
+            for i in range(n)
+        ]
+
+    def create_transfers(self, transfers: list[Transfer], timestamp: int):
+        from .batch import transfers_to_arrays
+
+        ev = transfers_to_arrays(transfers)
+        return self.create_transfers_arrays(ev, timestamp, transfers=transfers)
+
+    def create_transfers_arrays(self, ev: dict, timestamp: int, transfers=None):
+        """ev: unpadded SoA dict (the zero-host-cost entry point)."""
+        from .fast_kernels import create_transfers_fast_jit
+
+        n = len(ev["id_lo"])
+        evp = pad_transfer_events(ev)
+        new_state, out = create_transfers_fast_jit(
+            self.state, evp, np.uint64(timestamp), np.int32(n))
+        if bool(out["fallback"]):
+            self.state = new_state
+            if transfers is None:
+                transfers = _transfers_from_arrays(ev)
+            return self._fallback_transfers(transfers, timestamp)
+        self.state = new_state
+        self.fast_batches += 1
+        st = np.asarray(out["r_status"][:n])
+        ts = np.asarray(out["r_ts"][:n])
+        return [
+            CreateTransferResult(timestamp=int(ts[i]),
+                                 status=CreateTransferStatus(int(st[i])))
+            for i in range(n)
+        ]
+
+    # ------------------------------------------------------------- lookups
+
+    def lookup_accounts(self, ids: list[int]) -> list[Account]:
+        import jax.numpy as jnp
+
+        from .hash_table import ht_lookup
+
+        hi = np.array([i >> 64 for i in ids], dtype=np.uint64)
+        lo = np.array([i & (1 << 64) - 1 for i in ids], dtype=np.uint64)
+        found, rows = ht_lookup(self.state["acct_ht"], jnp.asarray(hi),
+                                jnp.asarray(lo))
+        found = np.asarray(found)
+        rows = np.asarray(rows)
+        acc = {k: np.asarray(v) for k, v in self.state["accounts"].items()
+               if k != "count"}
+        out = []
+        for i, aid in enumerate(ids):
+            if not found[i]:
+                continue
+            r = int(rows[i])
+            out.append(Account(
+                id=aid,
+                debits_pending=_balance_int(acc, "dp", r),
+                debits_posted=_balance_int(acc, "dpos", r),
+                credits_pending=_balance_int(acc, "cp", r),
+                credits_posted=_balance_int(acc, "cpos", r),
+                user_data_128=u128.to_int(acc["ud128_hi"][r], acc["ud128_lo"][r]),
+                user_data_64=int(acc["ud64"][r]),
+                user_data_32=int(acc["ud32"][r]),
+                ledger=int(acc["ledger"][r]),
+                code=int(acc["code"][r]),
+                flags=int(acc["flags"][r]),
+                timestamp=int(acc["ts"][r]),
+            ))
+        return out
+
+    def lookup_transfers(self, ids: list[int]) -> list[Transfer]:
+        import jax.numpy as jnp
+
+        from .hash_table import ht_lookup
+
+        hi = np.array([i >> 64 for i in ids], dtype=np.uint64)
+        lo = np.array([i & (1 << 64) - 1 for i in ids], dtype=np.uint64)
+        found, rows = ht_lookup(self.state["xfer_ht"], jnp.asarray(hi),
+                                jnp.asarray(lo))
+        found = np.asarray(found)
+        rows = np.asarray(rows)
+        xfr = {k: np.asarray(v) for k, v in self.state["transfers"].items()
+               if k != "count"}
+        return [
+            _transfer_from_row(xfr, int(rows[i]), ids[i])
+            for i in range(len(ids)) if found[i]
+        ]
+
+    # --------------------------------------------------------- host fallback
+
+    def to_host(self):
+        """Reconstruct an oracle-compatible host state from device arrays."""
+        from ..oracle.state_machine import StateMachineOracle
+
+        sm = StateMachineOracle()
+        acc = {k: np.asarray(v) for k, v in self.state["accounts"].items()}
+        n_a = int(acc["count"])
+        for r in range(n_a):
+            a = Account(
+                id=u128.to_int(acc["id_hi"][r], acc["id_lo"][r]),
+                debits_pending=_balance_int(acc, "dp", r),
+                debits_posted=_balance_int(acc, "dpos", r),
+                credits_pending=_balance_int(acc, "cp", r),
+                credits_posted=_balance_int(acc, "cpos", r),
+                user_data_128=u128.to_int(acc["ud128_hi"][r], acc["ud128_lo"][r]),
+                user_data_64=int(acc["ud64"][r]),
+                user_data_32=int(acc["ud32"][r]),
+                ledger=int(acc["ledger"][r]),
+                code=int(acc["code"][r]),
+                flags=int(acc["flags"][r]),
+                timestamp=int(acc["ts"][r]),
+            )
+            sm.accounts[a.id] = a
+            sm.account_by_timestamp[a.timestamp] = a.id
+
+        xfr = {k: np.asarray(v) for k, v in self.state["transfers"].items()}
+        n_t = int(xfr["count"])
+        for r in range(n_t):
+            t = _transfer_from_row(xfr, r, None)
+            sm.transfers[t.id] = t
+            sm.transfer_by_timestamp[t.timestamp] = t.id
+            pstat = int(xfr["pstat"][r])
+            if pstat != 0:
+                sm.pending_status[t.timestamp] = TransferPendingStatus(pstat)
+                if (pstat == int(TransferPendingStatus.pending)
+                        and t.timeout != 0):
+                    sm.expiry[t.timestamp] = t.timestamp + t.timeout * NS_PER_S
+
+        orph = {k: np.asarray(v) for k, v in self.state["orphan_ht"].items()}
+        live = (orph["key_hi"][:-1] != 0) | (orph["key_lo"][:-1] != 0)
+        for pos in np.nonzero(live)[0]:
+            sm.orphaned.add(
+                u128.to_int(orph["key_hi"][pos], orph["key_lo"][pos]))
+
+        sm.accounts_key_max = int(self.state["acct_key_max"]) or None
+        sm.transfers_key_max = int(self.state["xfer_key_max"]) or None
+        sm.pulse_next_timestamp = int(self.state["pulse_next"])
+        sm.commit_timestamp = int(self.state["commit_ts"])
+        sm.account_events = self.account_events
+        return sm
+
+    def from_host(self, sm) -> None:
+        """Rebuild the device state from a host oracle state."""
+        import jax.numpy as jnp
+
+        from .hash_table import ht_insert
+
+        self.state = init_state(self.a_cap, self.t_cap)
+        st = self.state
+
+        def batch_insert(table, keys_vals):
+            for lo_i in range(0, len(keys_vals), N_PAD):
+                chunk = keys_vals[lo_i:lo_i + N_PAD]
+                hi = np.array([k >> 64 for k, _ in chunk], dtype=np.uint64)
+                lo = np.array([k & (1 << 64) - 1 for k, _ in chunk], dtype=np.uint64)
+                vals = np.array([v for _, v in chunk], dtype=np.int32)
+                table, ok = ht_insert(
+                    table, jnp.asarray(hi), jnp.asarray(lo),
+                    jnp.asarray(vals), jnp.ones(len(chunk), dtype=bool))
+                assert bool(ok), "hash rebuild overflow: raise capacities"
+            return table
+
+        accounts = list(sm.accounts.values())
+        assert len(accounts) <= self.a_cap and len(sm.transfers) <= self.t_cap
+        acc = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
+               for k, v in st["accounts"].items()}
+        for r, a in enumerate(accounts):
+            acc["id_hi"][r], acc["id_lo"][r] = _split(a.id)
+            for f, val in (("dp", a.debits_pending), ("dpos", a.debits_posted),
+                           ("cp", a.credits_pending), ("cpos", a.credits_posted)):
+                for j, lim in enumerate(_limbs4(val)):
+                    acc[f"{f}{j}"][r] = lim
+            acc["ud128_hi"][r], acc["ud128_lo"][r] = _split(a.user_data_128)
+            acc["ud64"][r] = a.user_data_64
+            acc["ud32"][r] = a.user_data_32
+            acc["ledger"][r] = a.ledger
+            acc["code"][r] = a.code
+            acc["flags"][r] = a.flags
+            acc["ts"][r] = a.timestamp
+        acc["count"] = np.int32(len(accounts))
+        st["accounts"] = {k: jnp.asarray(v) for k, v in acc.items()}
+
+        acct_row = {a.id: r for r, a in enumerate(accounts)}
+        st["acct_ht"] = batch_insert(
+            st["acct_ht"], [(a.id, r) for r, a in enumerate(accounts)])
+
+        transfers = list(sm.transfers.values())
+        xfr = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
+               for k, v in st["transfers"].items()}
+        for r, t in enumerate(transfers):
+            xfr["id_hi"][r], xfr["id_lo"][r] = _split(t.id)
+            xfr["dr_hi"][r], xfr["dr_lo"][r] = _split(t.debit_account_id)
+            xfr["cr_hi"][r], xfr["cr_lo"][r] = _split(t.credit_account_id)
+            xfr["amt_hi"][r], xfr["amt_lo"][r] = _split(t.amount)
+            xfr["pid_hi"][r], xfr["pid_lo"][r] = _split(t.pending_id)
+            xfr["ud128_hi"][r], xfr["ud128_lo"][r] = _split(t.user_data_128)
+            xfr["ud64"][r] = t.user_data_64
+            xfr["ud32"][r] = t.user_data_32
+            xfr["timeout"][r] = t.timeout
+            xfr["ledger"][r] = t.ledger
+            xfr["code"][r] = t.code
+            xfr["flags"][r] = t.flags
+            xfr["ts"][r] = t.timestamp
+            xfr["pstat"][r] = int(
+                sm.pending_status.get(t.timestamp, TransferPendingStatus.none))
+            xfr["expires"][r] = (
+                t.timestamp + t.timeout * NS_PER_S if t.timeout else 0)
+            xfr["dr_row"][r] = acct_row.get(t.debit_account_id, self.a_cap)
+            xfr["cr_row"][r] = acct_row.get(t.credit_account_id, self.a_cap)
+        xfr["count"] = np.int32(len(transfers))
+        st["transfers"] = {k: jnp.asarray(v) for k, v in xfr.items()}
+        st["xfer_ht"] = batch_insert(
+            st["xfer_ht"], [(t.id, r) for r, t in enumerate(transfers)])
+        st["orphan_ht"] = batch_insert(
+            st["orphan_ht"], [(oid, 0) for oid in sorted(sm.orphaned)])
+
+        st["acct_key_max"] = np.uint64(sm.accounts_key_max or 0)
+        st["xfer_key_max"] = np.uint64(sm.transfers_key_max or 0)
+        st["pulse_next"] = np.uint64(sm.pulse_next_timestamp)
+        st["commit_ts"] = np.uint64(sm.commit_timestamp)
+        self.account_events = sm.account_events
+
+    def _fallback_transfers(self, transfers, timestamp):
+        from .create_kernels import run_create_transfers
+
+        self.fallbacks += 1
+        sm = self.to_host()
+        results = run_create_transfers(sm, transfers, timestamp)
+        self.from_host(sm)
+        return results
+
+    def _fallback_accounts(self, accounts, timestamp):
+        from .create_kernels import run_create_accounts
+
+        self.fallbacks += 1
+        sm = self.to_host()
+        results = run_create_accounts(sm, accounts, timestamp)
+        self.from_host(sm)
+        return results
+
+    # ------------------------------------------------------------- pulse
+
+    def pulse_needed(self, timestamp: int) -> bool:
+        return int(self.state["pulse_next"]) <= timestamp
+
+    def expire_pending_transfers(self, timestamp: int) -> int:
+        """Expiry runs on the exact host path (rare, pulse-driven)."""
+        sm = self.to_host()
+        n = sm.expire_pending_transfers(timestamp)
+        self.from_host(sm)
+        return n
+
+
+def _transfer_from_row(xfr, r: int, tid) -> Transfer:
+    return Transfer(
+        id=(u128.to_int(xfr["id_hi"][r], xfr["id_lo"][r])
+            if tid is None else tid),
+        debit_account_id=u128.to_int(xfr["dr_hi"][r], xfr["dr_lo"][r]),
+        credit_account_id=u128.to_int(xfr["cr_hi"][r], xfr["cr_lo"][r]),
+        amount=u128.to_int(xfr["amt_hi"][r], xfr["amt_lo"][r]),
+        pending_id=u128.to_int(xfr["pid_hi"][r], xfr["pid_lo"][r]),
+        user_data_128=u128.to_int(xfr["ud128_hi"][r], xfr["ud128_lo"][r]),
+        user_data_64=int(xfr["ud64"][r]),
+        user_data_32=int(xfr["ud32"][r]),
+        timeout=int(xfr["timeout"][r]),
+        ledger=int(xfr["ledger"][r]),
+        code=int(xfr["code"][r]),
+        flags=int(xfr["flags"][r]),
+        timestamp=int(xfr["ts"][r]),
+    )
+
+
+def _transfers_from_arrays(ev: dict) -> list[Transfer]:
+    n = len(ev["id_lo"])
+    return [
+        Transfer(
+            id=u128.to_int(ev["id_hi"][i], ev["id_lo"][i]),
+            debit_account_id=u128.to_int(ev["dr_hi"][i], ev["dr_lo"][i]),
+            credit_account_id=u128.to_int(ev["cr_hi"][i], ev["cr_lo"][i]),
+            amount=u128.to_int(ev["amt_hi"][i], ev["amt_lo"][i]),
+            pending_id=u128.to_int(ev["pid_hi"][i], ev["pid_lo"][i]),
+            user_data_128=u128.to_int(ev["ud128_hi"][i], ev["ud128_lo"][i]),
+            user_data_64=int(ev["ud64"][i]),
+            user_data_32=int(ev["ud32"][i]),
+            timeout=int(ev["timeout"][i]),
+            ledger=int(ev["ledger"][i]),
+            code=int(ev["code"][i]),
+            flags=int(ev["flags"][i]),
+            timestamp=int(ev["ts"][i]),
+        )
+        for i in range(n)
+    ]

@@ -9,7 +9,12 @@ replicated account state stays bit-identical across the mesh — the SPMD
 restatement of the reference's determinism doctrine
 (docs/ARCHITECTURE.md:281-307).
 
-This module intentionally implements the *order-independent* subset of the
+Carry-exactness across the mesh: u64 limbs are split into 32-bit halves and
+the halves are psum'd BEFORE recombining, so neither intra-device segment
+sums nor the cross-device reduction can drop a carry (each 32-bit half sum
+stays far below 2^64 for any batch/mesh size).
+
+This module implements the *order-independent* subset of the
 create_transfers checks (the full sequential semantics live in
 ops/create_kernels.py; the single-chip vectorized fast path in
 ops/fast_kernels.py). It is the multi-chip scaling skeleton: the same
@@ -18,86 +23,61 @@ shard_map layout carries the fast-path kernel across chips.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops import u128
-
-_CREATED = jnp.uint32(0xFFFFFFFF)
-
-# Wire codes (types.CreateTransferStatus values), kept in check order.
-_CODES = dict(
-    reserved_flag=4,
-    id_must_not_be_zero=5,
-    id_must_not_be_int_max=6,
-    debit_account_id_must_not_be_zero=8,
-    debit_account_id_must_not_be_int_max=9,
-    credit_account_id_must_not_be_zero=10,
-    credit_account_id_must_not_be_int_max=11,
-    accounts_must_be_different=12,
-    pending_id_must_be_zero=13,
-    timeout_reserved_for_pending_transfer=17,
-    ledger_must_not_be_zero=19,
-    code_must_not_be_zero=20,
-    debit_account_not_found=21,
-    credit_account_not_found=22,
-    accounts_must_have_the_same_ledger=23,
-    transfer_must_have_the_same_ledger_as_accounts=24,
-    debit_account_already_closed=65,
-    credit_account_already_closed=66,
+from ..ops.create_kernels import (
+    _CREATED,
+    _TF_PADDING,
+    _TS,
+    _first_failure,
 )
 
 _F_PENDING = jnp.uint32(1 << 1)
-_TF_PADDING = jnp.uint32(0xFFFF & ~0x1FF)
 _A_CLOSED = jnp.uint32(1 << 5)
 
 
-def _first_failure(checks):
-    status = _CREATED
-    for cond, code in reversed(checks):
-        status = jnp.where(cond, jnp.uint32(code), status)
-    return status
+def _validate_shard(ev, acct):
+    """Validate one shard of events against the replicated account cache.
 
-
-def _validate_shard(ev, acct, n_events, timestamp):
-    """Validate one shard of events against the replicated account cache."""
+    Returns (status, delta_parts) where delta_parts holds four u64 arrays of
+    32-bit half sums per balance limb field — recombined only after psum.
+    """
     dr = {k: acct[k][ev["dr_idx"]] for k in acct}
     cr = {k: acct[k][ev["cr_idx"]] for k in acct}
     pending = (ev["flags"] & _F_PENDING) != 0
 
     checks = [
-        ((ev["flags"] & _TF_PADDING) != 0, _CODES["reserved_flag"]),
-        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _CODES["id_must_not_be_zero"]),
-        (u128.is_max(ev["id_hi"], ev["id_lo"]), _CODES["id_must_not_be_int_max"]),
-        (u128.is_zero(ev["dr_hi"], ev["dr_lo"]), _CODES["debit_account_id_must_not_be_zero"]),
-        (u128.is_max(ev["dr_hi"], ev["dr_lo"]), _CODES["debit_account_id_must_not_be_int_max"]),
-        (u128.is_zero(ev["cr_hi"], ev["cr_lo"]), _CODES["credit_account_id_must_not_be_zero"]),
-        (u128.is_max(ev["cr_hi"], ev["cr_lo"]), _CODES["credit_account_id_must_not_be_int_max"]),
+        ((ev["flags"] & _TF_PADDING) != 0, _TS["reserved_flag"]),
+        (u128.is_zero(ev["id_hi"], ev["id_lo"]), _TS["id_must_not_be_zero"]),
+        (u128.is_max(ev["id_hi"], ev["id_lo"]), _TS["id_must_not_be_int_max"]),
+        (u128.is_zero(ev["dr_hi"], ev["dr_lo"]), _TS["debit_account_id_must_not_be_zero"]),
+        (u128.is_max(ev["dr_hi"], ev["dr_lo"]), _TS["debit_account_id_must_not_be_int_max"]),
+        (u128.is_zero(ev["cr_hi"], ev["cr_lo"]), _TS["credit_account_id_must_not_be_zero"]),
+        (u128.is_max(ev["cr_hi"], ev["cr_lo"]), _TS["credit_account_id_must_not_be_int_max"]),
         (u128.eq(ev["dr_hi"], ev["dr_lo"], ev["cr_hi"], ev["cr_lo"]),
-         _CODES["accounts_must_be_different"]),
-        (~u128.is_zero(ev["pid_hi"], ev["pid_lo"]), _CODES["pending_id_must_be_zero"]),
-        (~pending & (ev["timeout"] != 0), _CODES["timeout_reserved_for_pending_transfer"]),
-        (ev["ledger"] == 0, _CODES["ledger_must_not_be_zero"]),
-        (ev["code"] == 0, _CODES["code_must_not_be_zero"]),
-        (~dr["exists"], _CODES["debit_account_not_found"]),
-        (~cr["exists"], _CODES["credit_account_not_found"]),
-        (dr["ledger"] != cr["ledger"], _CODES["accounts_must_have_the_same_ledger"]),
-        (ev["ledger"] != dr["ledger"], _CODES["transfer_must_have_the_same_ledger_as_accounts"]),
-        ((dr["flags"] & _A_CLOSED) != 0, _CODES["debit_account_already_closed"]),
-        ((cr["flags"] & _A_CLOSED) != 0, _CODES["credit_account_already_closed"]),
+         _TS["accounts_must_be_different"]),
+        (~u128.is_zero(ev["pid_hi"], ev["pid_lo"]), _TS["pending_id_must_be_zero"]),
+        (~pending & (ev["timeout"] != 0), _TS["timeout_reserved_for_pending_transfer"]),
+        (ev["ledger"] == 0, _TS["ledger_must_not_be_zero"]),
+        (ev["code"] == 0, _TS["code_must_not_be_zero"]),
+        (~dr["exists"], _TS["debit_account_not_found"]),
+        (~cr["exists"], _TS["credit_account_not_found"]),
+        (dr["ledger"] != cr["ledger"], _TS["accounts_must_have_the_same_ledger"]),
+        (ev["ledger"] != dr["ledger"], _TS["transfer_must_have_the_same_ledger_as_accounts"]),
+        ((dr["flags"] & _A_CLOSED) != 0, _TS["debit_account_already_closed"]),
+        ((cr["flags"] & _A_CLOSED) != 0, _TS["credit_account_already_closed"]),
     ]
     status = jnp.where(ev["valid"], _first_failure(checks), jnp.uint32(0))
     created = status == _CREATED
 
-    # Dense per-account delta tensors, carry-exact: u64 limbs are split into
-    # 32-bit halves so segment sums cannot wrap, then recombined.
     A = acct["exists"].shape[0]
 
-    def seg_sum_u128(idx, hi, lo, mask):
+    def seg_sum_parts(idx, hi, lo, mask):
+        """Per-account sums as four 32-bit half-sum arrays (u64 lanes)."""
         hi = jnp.where(mask, hi, jnp.uint64(0))
         lo = jnp.where(mask, lo, jnp.uint64(0))
         parts = []
@@ -106,50 +86,53 @@ def _validate_shard(ev, acct, n_events, timestamp):
             hi32 = limb >> jnp.uint64(32)
             parts.append(jax.ops.segment_sum(lo32, idx, num_segments=A))
             parts.append(jax.ops.segment_sum(hi32, idx, num_segments=A))
-        add_hi32 = parts[1] << jnp.uint64(32)
-        s_lo = parts[0] + add_hi32
-        carry = (parts[1] >> jnp.uint64(32)) + jnp.where(
-            s_lo < add_hi32, jnp.uint64(1), jnp.uint64(0))
-        s_hi = parts[2] + (parts[3] << jnp.uint64(32)) + carry
-        return s_hi, s_lo
+        return parts
 
-    d_dpos_hi, d_dpos_lo = seg_sum_u128(
-        ev["dr_idx"], ev["amt_hi"], ev["amt_lo"], created & ~pending)
-    d_cpos_hi, d_cpos_lo = seg_sum_u128(
-        ev["cr_idx"], ev["amt_hi"], ev["amt_lo"], created & ~pending)
-    d_dp_hi, d_dp_lo = seg_sum_u128(
-        ev["dr_idx"], ev["amt_hi"], ev["amt_lo"], created & pending)
-    d_cp_hi, d_cp_lo = seg_sum_u128(
-        ev["cr_idx"], ev["amt_hi"], ev["amt_lo"], created & pending)
-
-    deltas = dict(
-        dpos_hi=d_dpos_hi, dpos_lo=d_dpos_lo,
-        cpos_hi=d_cpos_hi, cpos_lo=d_cpos_lo,
-        dp_hi=d_dp_hi, dp_lo=d_dp_lo,
-        cp_hi=d_cp_hi, cp_lo=d_cp_lo,
+    delta_parts = dict(
+        dpos=seg_sum_parts(ev["dr_idx"], ev["amt_hi"], ev["amt_lo"],
+                           created & ~pending),
+        cpos=seg_sum_parts(ev["cr_idx"], ev["amt_hi"], ev["amt_lo"],
+                           created & ~pending),
+        dp=seg_sum_parts(ev["dr_idx"], ev["amt_hi"], ev["amt_lo"],
+                         created & pending),
+        cp=seg_sum_parts(ev["cr_idx"], ev["amt_hi"], ev["amt_lo"],
+                         created & pending),
     )
-    return status, deltas
+    return status, delta_parts
+
+
+def _recombine(parts):
+    """Four psum'd 32-bit half sums -> exact (hi, lo) u128 delta."""
+    p0, p1, p2, p3 = parts
+    add_hi32 = p1 << jnp.uint64(32)
+    lo = p0 + add_hi32
+    carry = (p1 >> jnp.uint64(32)) + jnp.where(
+        lo < add_hi32, jnp.uint64(1), jnp.uint64(0))
+    hi = p2 + (p3 << jnp.uint64(32)) + carry
+    return hi, lo
 
 
 def make_sharded_validate(mesh: Mesh, axis: str = "batch"):
     """Build the jitted SPMD validation step over `mesh`.
 
-    Returns step(events, acct, n_events, timestamp) ->
-    (statuses, new_acct) with events sharded on `axis`, account state
-    replicated, and balance deltas combined via psum over the mesh.
+    Returns step(events, acct) -> (statuses, new_acct) with events sharded on
+    `axis`, account state replicated, and balance deltas combined via psum.
     """
 
-    def step(ev, acct, n_events, timestamp):
-        def shard_fn(ev, acct, n_events, timestamp):
-            status, deltas = _validate_shard(ev, acct, n_events, timestamp)
-            # One psum per leaf: some backends lower only plain sum
-            # all-reduces, not tuple-combined ones.
-            deltas = {k: jax.lax.psum(v, axis) for k, v in deltas.items()}
+    def step(ev, acct):
+        def shard_fn(ev, acct):
+            status, delta_parts = _validate_shard(ev, acct)
+            # One psum per 32-bit half-sum leaf: carry-safe, and plain sum
+            # all-reduces lower on every backend.
+            delta_parts = {
+                k: [jax.lax.psum(p, axis) for p in parts]
+                for k, parts in delta_parts.items()
+            }
             new_acct = dict(acct)
-            for field in ("dp", "dpos", "cp", "cpos"):
+            for field, parts in delta_parts.items():
+                d_hi, d_lo = _recombine(parts)
                 hi, lo, _ = u128.add(
-                    acct[f"{field}_hi"], acct[f"{field}_lo"],
-                    deltas[f"{field}_hi"], deltas[f"{field}_lo"])
+                    acct[f"{field}_hi"], acct[f"{field}_lo"], d_hi, d_lo)
                 new_acct[f"{field}_hi"] = hi
                 new_acct[f"{field}_lo"] = lo
             return status, new_acct
@@ -158,10 +141,10 @@ def make_sharded_validate(mesh: Mesh, axis: str = "batch"):
         acct_spec = {k: P() for k in acct}
         return shard_map(
             shard_fn, mesh=mesh,
-            in_specs=(ev_spec, acct_spec, P(), P()),
-            out_specs=({k: P(axis) for k in ev}["id_lo"], acct_spec),
+            in_specs=(ev_spec, acct_spec),
+            out_specs=(P(axis), acct_spec),
             check_rep=False,
-        )(ev, acct, n_events, timestamp)
+        )(ev, acct)
 
     return jax.jit(step)
 
