@@ -192,6 +192,68 @@ def test_hard_batches_fall_back():
     d.check_state()
 
 
+def test_overflow_pair_sum_falls_back():
+    """overflows_debits sums dp+dpos+amount; the eligibility bound must use
+    the pair sums (regression: single-field max admitted a diverging batch)."""
+    d = Differ()
+    d.accounts([Account(id=1, ledger=1, code=1), Account(id=2, ledger=1, code=1),
+                Account(id=3, ledger=1, code=1)])
+    big = 1 << 127
+    d.transfers([Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                          amount=big, ledger=1, code=1,
+                          flags=int(TF.pending))])
+    d.transfers([Transfer(id=2, pending_id=1, amount=(1 << 128) - 1,
+                          flags=int(TF.post_pending_transfer))])
+    d.transfers([Transfer(id=3, debit_account_id=1, credit_account_id=2,
+                          amount=big - 10, ledger=1, code=1,
+                          flags=int(TF.pending))])
+    # dp + dpos + 100 overflows u128: must report overflows_debits exactly.
+    res = d.transfers([Transfer(id=4, debit_account_id=1, credit_account_id=3,
+                                amount=100, ledger=1, code=1)])
+    assert res[0].status.name == "overflows_debits"
+    d.check_state()
+
+
+def test_chain_open_after_earlier_failure():
+    """The open-chain terminator keeps linked_event_chain_open even when an
+    earlier chain member failed (regression: broadcast rewrote it)."""
+    d = Differ()
+    d.accounts([Account(id=1, ledger=1, code=1), Account(id=2, ledger=1, code=1)])
+    res = d.transfers([
+        Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=0, code=1, flags=int(TF.linked)),
+        Transfer(id=11, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=1, code=1, flags=int(TF.linked)),
+    ])
+    assert [r.status.name for r in res] == [
+        "ledger_must_not_be_zero", "linked_event_chain_open"]
+    # Same shape for accounts.
+    res = d.accounts([
+        Account(id=20, ledger=0, code=1, flags=int(AF.linked)),
+        Account(id=21, ledger=1, code=1, flags=int(AF.linked)),
+    ])
+    assert [r.status.name for r in res] == [
+        "ledger_must_not_be_zero", "linked_event_chain_open"]
+    d.check_state()
+
+
+def test_pulse_next_survives_chain_rollback():
+    """pulse_next updates from a pending that was applied then rolled back by
+    a chain break are kept (reference scope semantics)."""
+    d = Differ()
+    d.accounts([Account(id=1, ledger=1, code=1), Account(id=2, ledger=1, code=1)])
+    res = d.transfers([
+        Transfer(id=30, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=1, code=1, flags=int(TF.linked | TF.pending), timeout=5),
+        Transfer(id=31, debit_account_id=1, credit_account_id=2, amount=1,
+                 ledger=0, code=1),
+    ])
+    assert [r.status.name for r in res] == [
+        "linked_event_failed", "ledger_must_not_be_zero"]
+    assert d.led.fallbacks == 0  # must be exact on the fast path
+    d.check_state()
+
+
 @pytest.mark.parametrize("seed", [1, 2, 3])
 def test_fuzz_differential(seed):
     """Random workload biased to eligible batches with occasional hard ones."""

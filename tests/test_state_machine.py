@@ -1,0 +1,242 @@
+"""StateMachine shell tests: queries, history, change events, wire codec.
+
+Host analog of the reference's state_machine_tests.zig query scenarios plus
+multi_batch.zig round-trip tests.
+"""
+
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.state_machine import OPERATION_SPECS, StateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountBalance,
+    AccountFilter,
+    AccountFilterFlags as AFF,
+    AccountFlags as AF,
+    ChangeEvent,
+    ChangeEventType,
+    ChangeEventsFilter,
+    CreateTransferResult,
+    Operation,
+    QueryFilter,
+    QueryFilterFlags as QFF,
+    Transfer,
+    TransferFlags as TF,
+)
+
+TS = 10**13
+
+
+def _setup(engine="kernel"):
+    sm = StateMachine(engine=engine)
+    res = sm.create_accounts([
+        Account(id=1, ledger=1, code=10, user_data_64=7),
+        Account(id=2, ledger=1, code=10, flags=int(AF.history)),
+        Account(id=3, ledger=1, code=20, user_data_64=7),
+        Account(id=4, ledger=2, code=10),
+    ], TS)
+    assert all(r.status.name == "created" for r in res)
+    res = sm.create_transfers([
+        Transfer(id=101, debit_account_id=1, credit_account_id=2, amount=10,
+                 ledger=1, code=5, user_data_64=77),
+        Transfer(id=102, debit_account_id=2, credit_account_id=3, amount=20,
+                 ledger=1, code=5),
+        Transfer(id=103, debit_account_id=3, credit_account_id=1, amount=30,
+                 ledger=1, code=6, user_data_64=77),
+        Transfer(id=104, debit_account_id=1, credit_account_id=2, amount=40,
+                 ledger=1, code=6, flags=int(TF.pending)),
+    ], TS + 100)
+    assert all(r.status.name == "created" for r in res)
+    return sm
+
+
+@pytest.mark.parametrize("engine", ["kernel", "oracle"])
+def test_get_account_transfers(engine):
+    sm = _setup(engine)
+    f = AccountFilter(account_id=2, limit=100,
+                      flags=int(AFF.debits | AFF.credits))
+    got = [t.id for t in sm.get_account_transfers(f)]
+    assert got == [101, 102, 104]
+
+    f = AccountFilter(account_id=2, limit=100, flags=int(AFF.credits))
+    assert [t.id for t in sm.get_account_transfers(f)] == [101, 104]
+
+    f = AccountFilter(account_id=2, limit=100,
+                      flags=int(AFF.debits | AFF.credits | AFF.reversed))
+    assert [t.id for t in sm.get_account_transfers(f)] == [104, 102, 101]
+
+    f = AccountFilter(account_id=1, limit=100, user_data_64=77,
+                      flags=int(AFF.debits | AFF.credits))
+    assert [t.id for t in sm.get_account_transfers(f)] == [101, 103]
+
+    f = AccountFilter(account_id=1, limit=2,
+                      flags=int(AFF.debits | AFF.credits))
+    assert [t.id for t in sm.get_account_transfers(f)] == [101, 103]
+
+    # invalid filters -> empty
+    assert sm.get_account_transfers(
+        AccountFilter(account_id=0, limit=10, flags=int(AFF.debits))) == []
+    assert sm.get_account_transfers(
+        AccountFilter(account_id=1, limit=0, flags=int(AFF.debits))) == []
+    assert sm.get_account_transfers(
+        AccountFilter(account_id=1, limit=10)) == []  # neither side
+    assert sm.get_account_transfers(
+        AccountFilter(account_id=1, limit=10, timestamp_min=5, timestamp_max=4,
+                      flags=int(AFF.debits))) == []
+
+
+@pytest.mark.parametrize("engine", ["kernel", "oracle"])
+def test_get_account_balances(engine):
+    sm = _setup(engine)
+    f = AccountFilter(account_id=2, limit=100,
+                      flags=int(AFF.debits | AFF.credits))
+    balances = sm.get_account_balances(f)
+    # Account 2 is touched by transfers 101 (cr +10 posted), 102 (dr 20),
+    # 104 (cr pending 40).
+    assert [(b.credits_posted, b.debits_posted, b.credits_pending)
+            for b in balances] == [(10, 0, 0), (10, 20, 0), (10, 20, 40)]
+    # Non-history account -> empty.
+    f = AccountFilter(account_id=1, limit=100,
+                      flags=int(AFF.debits | AFF.credits))
+    assert sm.get_account_balances(f) == []
+
+
+@pytest.mark.parametrize("engine", ["kernel", "oracle"])
+def test_query_accounts_and_transfers(engine):
+    sm = _setup(engine)
+    got = [a.id for a in sm.query_accounts(QueryFilter(limit=10, code=10))]
+    assert got == [1, 2, 4]
+    got = [a.id for a in sm.query_accounts(
+        QueryFilter(limit=10, code=10, ledger=1))]
+    assert got == [1, 2]
+    got = [a.id for a in sm.query_accounts(
+        QueryFilter(limit=10, user_data_64=7,
+                    flags=int(QFF.reversed)))]
+    assert got == [3, 1]
+    got = [a.id for a in sm.query_accounts(QueryFilter(limit=2))]
+    assert got == [1, 2]
+
+    got = [t.id for t in sm.query_transfers(QueryFilter(limit=10, code=6))]
+    assert got == [103, 104]
+    got = [t.id for t in sm.query_transfers(QueryFilter(limit=10))]
+    assert got == [101, 102, 103, 104]
+    assert sm.query_transfers(QueryFilter(limit=0)) == []
+
+
+@pytest.mark.parametrize("engine", ["kernel", "oracle"])
+def test_change_events(engine):
+    sm = _setup(engine)
+    # post the pending transfer, then expire nothing
+    res = sm.create_transfers(
+        [Transfer(id=105, pending_id=104, amount=(1 << 128) - 1,
+                  flags=int(TF.post_pending_transfer))], TS + 200)
+    assert res[0].status.name == "created"
+    events = sm.get_change_events(ChangeEventsFilter(limit=100))
+    assert [e.type for e in events] == [
+        ChangeEventType.single_phase,
+        ChangeEventType.single_phase,
+        ChangeEventType.single_phase,
+        ChangeEventType.two_phase_pending,
+        ChangeEventType.two_phase_posted,
+    ]
+    assert events[0].transfer_id == 101
+    assert events[0].debit_account_id == 1
+    assert events[0].credit_account_id == 2
+    assert events[0].debit_account_debits_posted == 10
+    assert events[4].transfer_pending_id == 104
+    assert events[4].transfer_amount == 40
+    # round-trip the wire format
+    raw = events[0].pack()
+    assert len(raw) == 384
+    assert ChangeEvent.unpack(raw) == events[0]
+    # limit + range
+    sub = sm.get_change_events(ChangeEventsFilter(limit=2))
+    assert len(sub) == 2
+    assert sm.get_change_events(ChangeEventsFilter(limit=0)) == []
+
+
+def test_change_events_expiry():
+    sm = StateMachine()
+    sm.create_accounts([Account(id=1, ledger=1, code=1),
+                        Account(id=2, ledger=1, code=1)], TS)
+    sm.create_transfers(
+        [Transfer(id=10, debit_account_id=1, credit_account_id=2, amount=5,
+                  ledger=1, code=1, flags=int(TF.pending), timeout=1)],
+        TS + 100)
+    assert sm.pulse_needed(TS + 100 + 2 * 10**9)
+    sm.commit(Operation.pulse, b"", TS + 100 + 2 * 10**9)
+    events = sm.get_change_events(ChangeEventsFilter(limit=10))
+    assert [e.type for e in events] == [
+        ChangeEventType.two_phase_pending,
+        ChangeEventType.two_phase_expired,
+    ]
+    assert events[1].transfer_id == 10  # the pending transfer itself
+    assert events[1].transfer_pending_id == 0
+
+
+def test_multi_batch_roundtrip():
+    for element_size in (8, 16, 64, 128):
+        batches = [b"\x01" * element_size * 3, b"", b"\x02" * element_size]
+        body = multi_batch.encode(batches, element_size)
+        assert len(body) % element_size == 0 or element_size == 8
+        out = multi_batch.decode(body, element_size)
+        assert out == batches
+    # single batch
+    body = multi_batch.encode([b"\x07" * 128], 128)
+    assert multi_batch.decode(body, 128) == [b"\x07" * 128]
+    # malformed
+    with pytest.raises(ValueError):
+        multi_batch.decode(b"", 128)
+    with pytest.raises(ValueError):
+        multi_batch.decode(b"\x00\x00", 128)
+
+
+def test_wire_commit_path():
+    sm = StateMachine()
+    accounts = b"".join(
+        Account(id=i, ledger=1, code=1).pack() for i in (1, 2))
+    body = multi_batch.encode([accounts], 128)
+    out = sm.commit(Operation.create_accounts, body, TS)
+    results = multi_batch.decode(out, 16)
+    assert len(results[0]) == 32  # two dense CreateAccountResults
+
+    transfers = b"".join(
+        Transfer(id=100 + i, debit_account_id=1, credit_account_id=2,
+                 amount=10, ledger=1, code=1).pack() for i in range(3))
+    body = multi_batch.encode([transfers], 128)
+    out = sm.commit(Operation.create_transfers, body, TS + 100)
+    (payload,) = multi_batch.decode(out, 16)
+    assert len(payload) == 48
+    r = CreateTransferResult.unpack(payload[:16])
+    assert r.status.name == "created"
+
+    # lookups via wire
+    ids = (100).to_bytes(16, "little") + (999).to_bytes(16, "little")
+    body = multi_batch.encode([ids], 16)
+    out = sm.commit(Operation.lookup_transfers, body, TS + 200)
+    (payload,) = multi_batch.decode(out, 128)
+    assert len(payload) == 128  # only id 100 found
+    assert Transfer.unpack(payload).id == 100
+
+    # deprecated sparse create: one bad event -> single {index, result} pair
+    bad = Transfer(id=0, debit_account_id=1, credit_account_id=2,
+                   amount=1, ledger=1, code=1).pack()
+    good = Transfer(id=200, debit_account_id=1, credit_account_id=2,
+                    amount=1, ledger=1, code=1).pack()
+    body = multi_batch.encode([bad + good], 128)
+    out = sm.commit(Operation.deprecated_create_transfers_sparse, body, TS + 300)
+    (payload,) = multi_batch.decode(out, 8)
+    assert len(payload) == 8
+    import struct as _s
+
+    index, code = _s.unpack("<II", payload)
+    assert index == 0 and code == 5  # id_must_not_be_zero
+
+    # get_account_transfers via wire
+    f = AccountFilter(account_id=1, limit=10,
+                      flags=int(AFF.debits | AFF.credits))
+    body = multi_batch.encode([f.pack()], 128)
+    out = sm.commit(Operation.get_account_transfers, body, TS + 400)
+    (payload,) = multi_batch.decode(out, 128)
+    assert len(payload) // 128 == 4

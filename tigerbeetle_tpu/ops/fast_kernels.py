@@ -267,20 +267,23 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     s3 += c; s4 = s3 >> jnp.uint64(32); s3 &= _M32
     s_hi = s2 | (s3 << jnp.uint64(32))
     s_lo = s0 | (s1 << jnp.uint64(32))
+    # The tightest overflow statuses are overflows_debits/credits, which sum
+    # TWO balance fields plus the amount (reference :3874-3884). Bound them
+    # with max over touched accounts of (dp+dpos) and (cp+cpos): any
+    # already-overflowing pair sum, or pair-max + S >= 2^128, falls back.
+    # Every single-field check is dominated by its pair sum.
     zeros = jnp.zeros_like(ev["amt_hi"])
-    m_hi, m_lo = _u128_max_reduce(
-        [jnp.where(valid, x, zeros) for x in (
-            dr["dp"][0], dr["dpos"][0], dr["cp"][0], dr["cpos"][0],
-            cr["dp"][0], cr["dpos"][0], cr["cp"][0], cr["cpos"][0],
-            p_dr["dp"][0], p_dr["dpos"][0], p_dr["cp"][0], p_dr["cpos"][0],
-            p_cr["dp"][0], p_cr["dpos"][0], p_cr["cp"][0], p_cr["cpos"][0])],
-        [jnp.where(valid, x, zeros) for x in (
-            dr["dp"][1], dr["dpos"][1], dr["cp"][1], dr["cpos"][1],
-            cr["dp"][1], cr["dpos"][1], cr["cp"][1], cr["cpos"][1],
-            p_dr["dp"][1], p_dr["dpos"][1], p_dr["cp"][1], p_dr["cpos"][1],
-            p_cr["dp"][1], p_cr["dpos"][1], p_cr["cp"][1], p_cr["cpos"][1])])
+    pair_his, pair_los, pair_ovf = [], [], jnp.bool_(False)
+    for acct_g in (dr, cr, p_dr, p_cr):
+        for f1, f2 in (("dp", "dpos"), ("cp", "cpos")):
+            h, l, o = u128.add(acct_g[f1][0], acct_g[f1][1],
+                               acct_g[f2][0], acct_g[f2][1])
+            pair_his.append(jnp.where(valid, h, zeros))
+            pair_los.append(jnp.where(valid, l, zeros))
+            pair_ovf = pair_ovf | jnp.any(valid & o)
+    m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
     _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
-    e4 = ovf | (s4 > 0)
+    e4 = ovf | (s4 > 0) | pair_ovf
 
     e5 = jnp.any(valid & is_void & p_found
                  & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
@@ -386,18 +389,29 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     start = linked & ~l_prev
     chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
     is_last = idxs == (n - 1)
-    status = jnp.where(linked & is_last, _TS["linked_event_chain_open"], status)
+    chain_open_evt = linked & is_last
+    status = jnp.where(chain_open_evt, _TS["linked_event_chain_open"], status)
     fail = in_chain & valid & (status != _CREATED)
     fail_pos = jnp.where(fail, idxs, _INF)
     seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
     my_first = seg_first[chain_id]
     broken = in_chain & (my_first != _INF)
-    not_the_failure = broken & (idxs != my_first)
+    # chain_open is applied AFTER chain_broken in the sequential order
+    # (reference execute_create :3096-3104), so the open-chain terminator
+    # keeps linked_event_chain_open even when an earlier member failed.
+    not_the_failure = broken & (idxs != my_first) & ~chain_open_evt
     status = jnp.where(not_the_failure, _TS["linked_event_failed"], status)
     ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
 
     status = jnp.where(valid, status, jnp.uint32(0))
     created = valid & (status == _CREATED)
+    # Events applied then rolled back by a chain break: everything before the
+    # chain's first failure that had passed validation. pulse_next updates
+    # from these survive rollback (reference scope semantics — see oracle
+    # _Scope note).
+    applied_ever = created | (
+        in_chain & valid & (status == _TS["linked_event_failed"])
+        & (idxs < my_first))
 
     # ------- commit/abort decision (fully read-only planning) -------
     # All remaining fallback causes are resolved BEFORE any state write, so
@@ -516,9 +530,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
                         state["xfer_key_max"])
     commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
 
-    # Pulse scheduling, closed-form under E6.
+    # Pulse scheduling, closed-form under E6. Uses applied_ever, not created:
+    # chain rollback does not restore pulse_next (state-machine state, not
+    # groove state — reference keeps the early wake-up, which is safe).
     expires_new = jnp.where(
-        created & pending & (ev["timeout"] != 0),
+        applied_ever & pending & (ev["timeout"] != 0),
         ts_event + timeout_ns, jnp.uint64(0xFFFFFFFFFFFFFFFF))
     min_exp = jnp.min(expires_new)
     pulse = state["pulse_next"]
@@ -643,13 +659,16 @@ def create_accounts_fast(state, ev, timestamp, n):
     in_chain = linked | l_prev
     start = linked & ~l_prev
     chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
-    status = jnp.where(linked & (idxs == (n - 1)),
-                       _AS["linked_event_chain_open"], status)
+    chain_open_evt = linked & (idxs == (n - 1))
+    status = jnp.where(chain_open_evt, _AS["linked_event_chain_open"], status)
     fail = in_chain & valid & (status != _CREATED)
     fail_pos = jnp.where(fail, idxs, _INF)
     seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
     my_first = seg_first[chain_id]
-    not_the_failure = in_chain & (my_first != _INF) & (idxs != my_first)
+    # The open-chain terminator keeps chain_open even when an earlier member
+    # failed (chain_open is applied after chain_broken sequentially).
+    not_the_failure = (in_chain & (my_first != _INF) & (idxs != my_first)
+                       & ~chain_open_evt)
     status = jnp.where(not_the_failure, _AS["linked_event_failed"], status)
     ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
 

@@ -50,7 +50,8 @@ def _balance_int(acc, field, row) -> int:
     return sum(int(acc[f"{field}{j}"][row]) << (32 * j) for j in range(4))
 
 
-def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21) -> dict:
+def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
+               orphan_cap: int | None = None) -> dict:
     """Fresh device ledger state pytree (host numpy; moved to device lazily
     by the first jitted call)."""
     import jax.numpy as jnp
@@ -87,12 +88,17 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21) -> dict:
         d["count"] = jnp.int32(0)
         return d
 
+    if orphan_cap is None:
+        # Orphaned (transient-failure) ids are never evicted; keep the table
+        # load low enough that 32-probe chains stay improbable even for
+        # failure-heavy workloads.
+        orphan_cap = max(1 << 16, t_cap)
     return dict(
         accounts=rows_accounts(),
         transfers=rows_transfers(),
         acct_ht=ht_init(2 * a_cap),
         xfer_ht=ht_init(2 * t_cap),
-        orphan_ht=ht_init(1 << 16),
+        orphan_ht=ht_init(orphan_cap),
         acct_key_max=np.uint64(0),
         xfer_key_max=np.uint64(0),
         pulse_next=np.uint64(1),
@@ -186,55 +192,49 @@ class DeviceLedger:
 
     # ------------------------------------------------------------- lookups
 
-    def lookup_accounts(self, ids: list[int]) -> list[Account]:
+    def _gather_rows(self, table_key: str, store_key: str, ids: list[int]):
+        """Device-side id->row lookup + row gather: only the queried rows
+        cross to the host, never the full table."""
         import jax.numpy as jnp
 
         from .hash_table import ht_lookup
 
         hi = np.array([i >> 64 for i in ids], dtype=np.uint64)
         lo = np.array([i & (1 << 64) - 1 for i in ids], dtype=np.uint64)
-        found, rows = ht_lookup(self.state["acct_ht"], jnp.asarray(hi),
+        found, rows = ht_lookup(self.state[table_key], jnp.asarray(hi),
                                 jnp.asarray(lo))
-        found = np.asarray(found)
-        rows = np.asarray(rows)
-        acc = {k: np.asarray(v) for k, v in self.state["accounts"].items()
-               if k != "count"}
+        rows = jnp.maximum(rows, 0)
+        store = self.state[store_key]
+        gathered = {k: np.asarray(store[k][rows]) for k in store
+                    if k != "count"}
+        return np.asarray(found), gathered
+
+    def lookup_accounts(self, ids: list[int]) -> list[Account]:
+        found, acc = self._gather_rows("acct_ht", "accounts", ids)
         out = []
         for i, aid in enumerate(ids):
             if not found[i]:
                 continue
-            r = int(rows[i])
             out.append(Account(
                 id=aid,
-                debits_pending=_balance_int(acc, "dp", r),
-                debits_posted=_balance_int(acc, "dpos", r),
-                credits_pending=_balance_int(acc, "cp", r),
-                credits_posted=_balance_int(acc, "cpos", r),
-                user_data_128=u128.to_int(acc["ud128_hi"][r], acc["ud128_lo"][r]),
-                user_data_64=int(acc["ud64"][r]),
-                user_data_32=int(acc["ud32"][r]),
-                ledger=int(acc["ledger"][r]),
-                code=int(acc["code"][r]),
-                flags=int(acc["flags"][r]),
-                timestamp=int(acc["ts"][r]),
+                debits_pending=_balance_int(acc, "dp", i),
+                debits_posted=_balance_int(acc, "dpos", i),
+                credits_pending=_balance_int(acc, "cp", i),
+                credits_posted=_balance_int(acc, "cpos", i),
+                user_data_128=u128.to_int(acc["ud128_hi"][i], acc["ud128_lo"][i]),
+                user_data_64=int(acc["ud64"][i]),
+                user_data_32=int(acc["ud32"][i]),
+                ledger=int(acc["ledger"][i]),
+                code=int(acc["code"][i]),
+                flags=int(acc["flags"][i]),
+                timestamp=int(acc["ts"][i]),
             ))
         return out
 
     def lookup_transfers(self, ids: list[int]) -> list[Transfer]:
-        import jax.numpy as jnp
-
-        from .hash_table import ht_lookup
-
-        hi = np.array([i >> 64 for i in ids], dtype=np.uint64)
-        lo = np.array([i & (1 << 64) - 1 for i in ids], dtype=np.uint64)
-        found, rows = ht_lookup(self.state["xfer_ht"], jnp.asarray(hi),
-                                jnp.asarray(lo))
-        found = np.asarray(found)
-        rows = np.asarray(rows)
-        xfr = {k: np.asarray(v) for k, v in self.state["transfers"].items()
-               if k != "count"}
+        found, xfr = self._gather_rows("xfer_ht", "transfers", ids)
         return [
-            _transfer_from_row(xfr, int(rows[i]), ids[i])
+            _transfer_from_row(xfr, i, ids[i])
             for i in range(len(ids)) if found[i]
         ]
 

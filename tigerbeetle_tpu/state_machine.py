@@ -1,0 +1,507 @@
+"""StateMachine shell: operation dispatch, queries, and the wire boundary.
+
+The host-side counterpart of the reference StateMachine
+(src/state_machine.zig:222 StateMachineType): owns the authoritative state
+store, routes create batches through the TPU validation kernels
+(ops/create_kernels.py — bit-exact vs the oracle), serves lookups and
+queries, schedules the expiry pulse, and encodes/decodes operation bodies
+(including the multi-batch trailer, src/vsr/multi_batch.zig).
+
+Queries are served from incrementally-maintained secondary indexes — the
+host analog of the reference's 33 LSM index trees (tree ids at
+src/state_machine.zig:45-90). Index lists are keyed by field value and hold
+timestamps in ascending commit order (imported-timestamp regression checks
+guarantee inserts are timestamp-monotonic per groove).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+from . import multi_batch
+from .constants import (
+    MESSAGE_BODY_SIZE_MAX,
+    TIMESTAMP_MAX,
+    U128_MAX,
+)
+from .oracle.state_machine import AccountEventRecord, StateMachineOracle
+from .types import (
+    Account,
+    AccountBalance,
+    AccountFilter,
+    AccountFilterFlags,
+    AccountFlags,
+    ChangeEvent,
+    ChangeEventType,
+    ChangeEventsFilter,
+    CreateAccountResult,
+    CreateTransferResult,
+    Operation,
+    QueryFilter,
+    QueryFilterFlags,
+    Transfer,
+    TransferFlags,
+    TransferPendingStatus,
+)
+
+__all__ = ["StateMachine", "OperationSpec", "OPERATION_SPECS"]
+
+
+@dataclasses.dataclass(frozen=True)
+class OperationSpec:
+    """Wire shape of one operation (reference: src/tigerbeetle.zig:717-785
+    EventType/ResultType per operation)."""
+
+    event_size: int
+    result_size: int
+    sparse_results: bool = False  # deprecated {index, result} encoding
+
+    def event_max(self, body_max: int = MESSAGE_BODY_SIZE_MAX) -> int:
+        return body_max // self.event_size if self.event_size else 0
+
+    def result_max(self, body_max: int = MESSAGE_BODY_SIZE_MAX) -> int:
+        return body_max // self.result_size if self.result_size else 0
+
+
+OPERATION_SPECS: dict[Operation, OperationSpec] = {
+    Operation.pulse: OperationSpec(0, 0),
+    Operation.create_accounts: OperationSpec(128, 16),
+    Operation.create_transfers: OperationSpec(128, 16),
+    Operation.lookup_accounts: OperationSpec(16, 128),
+    Operation.lookup_transfers: OperationSpec(16, 128),
+    Operation.get_account_transfers: OperationSpec(128, 128),
+    Operation.get_account_balances: OperationSpec(128, 128),
+    Operation.query_accounts: OperationSpec(64, 128),
+    Operation.query_transfers: OperationSpec(64, 128),
+    Operation.get_change_events: OperationSpec(64, 384),
+    Operation.deprecated_create_accounts_unbatched: OperationSpec(128, 8, True),
+    Operation.deprecated_create_transfers_unbatched: OperationSpec(128, 8, True),
+    Operation.deprecated_create_accounts_sparse: OperationSpec(128, 8, True),
+    Operation.deprecated_create_transfers_sparse: OperationSpec(128, 8, True),
+    Operation.deprecated_lookup_accounts_unbatched: OperationSpec(16, 128),
+    Operation.deprecated_lookup_transfers_unbatched: OperationSpec(16, 128),
+    Operation.deprecated_get_account_transfers_unbatched: OperationSpec(128, 128),
+    Operation.deprecated_get_account_balances_unbatched: OperationSpec(128, 128),
+    Operation.deprecated_query_accounts_unbatched: OperationSpec(64, 128),
+    Operation.deprecated_query_transfers_unbatched: OperationSpec(64, 128),
+}
+
+
+class _Index:
+    """Per-field secondary index: value -> ascending timestamp list."""
+
+    def __init__(self):
+        self.by_value: dict[int, list[int]] = {}
+
+    def add(self, value: int, timestamp: int) -> None:
+        self.by_value.setdefault(value, []).append(timestamp)
+
+    def get(self, value: int) -> list[int]:
+        return self.by_value.get(value, [])
+
+
+class StateMachine:
+    """Engine selection mirrors the reference's `-Dvopr-state-machine=`
+    differential-testing switch: 'kernel' runs batches on the TPU sequential
+    kernel, 'oracle' runs the pure-Python reference implementation."""
+
+    def __init__(self, engine: str = "kernel"):
+        assert engine in ("kernel", "oracle")
+        self.engine = engine
+        self.state = StateMachineOracle()
+        # Secondary indexes (host analog of the LSM index trees).
+        self._xfer_ts: list[int] = []  # all transfer timestamps ascending
+        self._xfer_by: dict[str, _Index] = {
+            f: _Index() for f in (
+                "debit_account_id", "credit_account_id",
+                "user_data_128", "user_data_64", "user_data_32",
+                "ledger", "code")}
+        self._xfer_indexed = 0
+        self._acct_ts: list[int] = []
+        self._acct_by: dict[str, _Index] = {
+            f: _Index() for f in (
+                "user_data_128", "user_data_64", "user_data_32",
+                "ledger", "code")}
+        self._acct_indexed = 0
+        self._events_by_ts: dict[int, AccountEventRecord] = {}
+        self._events_indexed = 0
+
+    # ------------------------------------------------------------- creates
+
+    def create_accounts(self, events: list[Account], timestamp: int):
+        if self.engine == "kernel":
+            from .ops.create_kernels import run_create_accounts
+
+            return run_create_accounts(self.state, events, timestamp)
+        return self.state.create_accounts(events, timestamp)
+
+    def create_transfers(self, events: list[Transfer], timestamp: int):
+        if self.engine == "kernel":
+            from .ops.create_kernels import run_create_transfers
+
+            return run_create_transfers(self.state, events, timestamp)
+        return self.state.create_transfers(events, timestamp)
+
+    # ------------------------------------------------------------- lookups
+
+    def lookup_accounts(self, ids: list[int]) -> list[Account]:
+        return [self.state.accounts[i] for i in ids if i in self.state.accounts]
+
+    def lookup_transfers(self, ids: list[int]) -> list[Transfer]:
+        return [self.state.transfers[i] for i in ids if i in self.state.transfers]
+
+    # ------------------------------------------------------------- indexes
+
+    def _refresh_indexes(self) -> None:
+        transfers = self.state.transfers
+        if len(transfers) > self._xfer_indexed:
+            items = list(transfers.values())[self._xfer_indexed:]
+            for t in items:
+                ts = t.timestamp
+                self._xfer_ts.append(ts)
+                for field, idx in self._xfer_by.items():
+                    idx.add(getattr(t, field), ts)
+            self._xfer_indexed = len(transfers)
+        accounts = self.state.accounts
+        if len(accounts) > self._acct_indexed:
+            for a in list(accounts.values())[self._acct_indexed:]:
+                self._acct_ts.append(a.timestamp)
+                for field, idx in self._acct_by.items():
+                    idx.add(getattr(a, field), a.timestamp)
+            self._acct_indexed = len(accounts)
+        events = self.state.account_events
+        if len(events) > self._events_indexed:
+            for rec in events[self._events_indexed:]:
+                self._events_by_ts[rec.timestamp] = rec
+            self._events_indexed = len(events)
+
+    # ------------------------------------------------------------- queries
+
+    @staticmethod
+    def _account_filter_valid(f: AccountFilter) -> bool:
+        """reference: src/state_machine.zig:1737-1752"""
+        ts_ok = (
+            (f.timestamp_min == 0 or 1 <= f.timestamp_min <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or 1 <= f.timestamp_max <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or f.timestamp_min <= f.timestamp_max)
+        )
+        flags_ok = (
+            (f.flags & (AccountFilterFlags.credits | AccountFilterFlags.debits))
+            and not (f.flags & ~0x7)
+        )
+        return bool(
+            f.account_id not in (0, U128_MAX) and ts_ok and f.limit != 0
+            and flags_ok
+        )
+
+    def _filtered_account_transfer_ts(self, f: AccountFilter) -> list[int]:
+        """Candidate timestamps matching an AccountFilter, in scan order."""
+        self._refresh_indexes()
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TIMESTAMP_MAX
+        cands: list[int] = []
+        if f.flags & AccountFilterFlags.debits:
+            cands += self._xfer_by["debit_account_id"].get(f.account_id)
+        if f.flags & AccountFilterFlags.credits:
+            cands += self._xfer_by["credit_account_id"].get(f.account_id)
+        cands = sorted(set(cands))
+        out = []
+        for ts in cands:
+            if not (ts_min <= ts <= ts_max):
+                continue
+            t = self.state.transfers[self.state.transfer_by_timestamp[ts]]
+            if f.user_data_128 and t.user_data_128 != f.user_data_128:
+                continue
+            if f.user_data_64 and t.user_data_64 != f.user_data_64:
+                continue
+            if f.user_data_32 and t.user_data_32 != f.user_data_32:
+                continue
+            if f.code and t.code != f.code:
+                continue
+            out.append(ts)
+        if f.flags & AccountFilterFlags.reversed:
+            out.reverse()
+        return out
+
+    def get_account_transfers(self, f: AccountFilter) -> list[Transfer]:
+        """reference: src/state_machine.zig:3294-3310 + scan construction
+        :1737-1831 (debits OR credits, AND user_data/code, range, limit)."""
+        if not self._account_filter_valid(f):
+            return []
+        limit = min(f.limit,
+                    OPERATION_SPECS[Operation.get_account_transfers].result_max())
+        ts_list = self._filtered_account_transfer_ts(f)[:limit]
+        return [self.state.transfers[self.state.transfer_by_timestamp[ts]]
+                for ts in ts_list]
+
+    def get_account_balances(self, f: AccountFilter) -> list[AccountBalance]:
+        """reference: src/state_machine.zig:1568-1666, 3312-3357 — the same
+        transfer scan, mapped through account_events history rows; only for
+        accounts with flags.history."""
+        if not self._account_filter_valid(f):
+            return []
+        account = self.state.accounts.get(f.account_id)
+        if account is None or not (account.flags & AccountFlags.history):
+            return []
+        limit = min(f.limit,
+                    OPERATION_SPECS[Operation.get_account_balances].result_max())
+        out: list[AccountBalance] = []
+        for ts in self._filtered_account_transfer_ts(f):
+            rec = self._events_by_ts.get(ts)
+            if rec is None:
+                continue
+            if rec.dr_account.id == f.account_id:
+                side = rec.dr_account
+            elif rec.cr_account.id == f.account_id:
+                side = rec.cr_account
+            else:
+                continue
+            out.append(AccountBalance(
+                debits_pending=side.debits_pending,
+                debits_posted=side.debits_posted,
+                credits_pending=side.credits_pending,
+                credits_posted=side.credits_posted,
+                timestamp=ts,
+            ))
+            if len(out) >= limit:
+                break
+        return out
+
+    @staticmethod
+    def _query_filter_valid(f: QueryFilter) -> bool:
+        """reference: src/state_machine.zig:2054-2070"""
+        ts_ok = (
+            (f.timestamp_min == 0 or 1 <= f.timestamp_min <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or 1 <= f.timestamp_max <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or f.timestamp_min <= f.timestamp_max)
+        )
+        return bool(ts_ok and f.limit != 0 and not (f.flags & ~0x1))
+
+    def _query(self, f: QueryFilter, kind: str, limit_cap: int) -> list[int]:
+        """Shared query_accounts/query_transfers index walk."""
+        self._refresh_indexes()
+        indexes = self._acct_by if kind == "accounts" else self._xfer_by
+        all_ts = self._acct_ts if kind == "accounts" else self._xfer_ts
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TIMESTAMP_MAX
+        conds = [(field, getattr(f, field))
+                 for field in ("user_data_128", "user_data_64", "user_data_32",
+                               "ledger", "code")
+                 if getattr(f, field) != 0]
+        if conds:
+            # Walk the most selective index; verify the rest on the object.
+            field0, value0 = min(
+                conds, key=lambda fv: len(indexes[fv[0]].get(fv[1])))
+            cands = indexes[field0].get(value0)
+        else:
+            cands = all_ts
+        by_ts = (self.state.account_by_timestamp if kind == "accounts"
+                 else self.state.transfer_by_timestamp)
+        store = (self.state.accounts if kind == "accounts"
+                 else self.state.transfers)
+        out = []
+        it = reversed(cands) if f.flags & QueryFilterFlags.reversed else iter(cands)
+        limit = min(f.limit, limit_cap)
+        for ts in it:
+            if not (ts_min <= ts <= ts_max):
+                continue
+            obj = store[by_ts[ts]]
+            if any(getattr(obj, field) != value for field, value in conds):
+                continue
+            out.append(ts)
+            if len(out) >= limit:
+                break
+        return out
+
+    def query_accounts(self, f: QueryFilter) -> list[Account]:
+        """reference: src/state_machine.zig:3359-3375 + :2054-2124."""
+        if not self._query_filter_valid(f):
+            return []
+        cap = OPERATION_SPECS[Operation.query_accounts].result_max()
+        return [self.state.accounts[self.state.account_by_timestamp[ts]]
+                for ts in self._query(f, "accounts", cap)]
+
+    def query_transfers(self, f: QueryFilter) -> list[Transfer]:
+        if not self._query_filter_valid(f):
+            return []
+        cap = OPERATION_SPECS[Operation.query_transfers].result_max()
+        return [self.state.transfers[self.state.transfer_by_timestamp[ts]]
+                for ts in self._query(f, "transfers", cap)]
+
+    def get_change_events(self, f: ChangeEventsFilter) -> list[ChangeEvent]:
+        """reference: src/state_machine.zig:3395-3528 — scan account_events
+        by timestamp, join the transfer (by event timestamp; by pending id
+        for expiries) and both accounts."""
+        valid = (
+            f.limit != 0
+            and (f.timestamp_min == 0 or 1 <= f.timestamp_min <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or 1 <= f.timestamp_max <= TIMESTAMP_MAX)
+            and (f.timestamp_max == 0 or f.timestamp_min <= f.timestamp_max)
+        )
+        if not valid:
+            return []
+        self._refresh_indexes()
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TIMESTAMP_MAX
+        limit = min(f.limit,
+                    OPERATION_SPECS[Operation.get_change_events].result_max())
+        out: list[ChangeEvent] = []
+        for rec in self.state.account_events:
+            if not (ts_min <= rec.timestamp <= ts_max):
+                continue
+            out.append(self._change_event(rec))
+            if len(out) >= limit:
+                break
+        return out
+
+    def _change_event(self, rec: AccountEventRecord) -> ChangeEvent:
+        status = rec.transfer_pending_status
+        if status == TransferPendingStatus.expired:
+            transfer = rec.transfer_pending
+            assert transfer is not None
+            etype = ChangeEventType.two_phase_expired
+        else:
+            transfer = self.state.transfers[
+                self.state.transfer_by_timestamp[rec.timestamp]]
+            etype = {
+                TransferPendingStatus.none: ChangeEventType.single_phase,
+                TransferPendingStatus.pending: ChangeEventType.two_phase_pending,
+                TransferPendingStatus.posted: ChangeEventType.two_phase_posted,
+                TransferPendingStatus.voided: ChangeEventType.two_phase_voided,
+            }[status]
+        dr = self.state.accounts[rec.dr_account.id]
+        cr = self.state.accounts[rec.cr_account.id]
+        return ChangeEvent(
+            transfer_id=transfer.id,
+            transfer_amount=rec.amount,
+            transfer_pending_id=transfer.pending_id,
+            transfer_user_data_128=transfer.user_data_128,
+            transfer_user_data_64=transfer.user_data_64,
+            transfer_user_data_32=transfer.user_data_32,
+            transfer_timeout=transfer.timeout,
+            transfer_code=transfer.code,
+            transfer_flags=transfer.flags,
+            ledger=transfer.ledger,
+            type=etype,
+            debit_account_id=dr.id,
+            debit_account_debits_pending=rec.dr_account.debits_pending,
+            debit_account_debits_posted=rec.dr_account.debits_posted,
+            debit_account_credits_pending=rec.dr_account.credits_pending,
+            debit_account_credits_posted=rec.dr_account.credits_posted,
+            debit_account_user_data_128=dr.user_data_128,
+            debit_account_user_data_64=dr.user_data_64,
+            debit_account_user_data_32=dr.user_data_32,
+            debit_account_code=dr.code,
+            debit_account_flags=rec.dr_account.flags,
+            credit_account_id=cr.id,
+            credit_account_debits_pending=rec.cr_account.debits_pending,
+            credit_account_debits_posted=rec.cr_account.debits_posted,
+            credit_account_credits_pending=rec.cr_account.credits_pending,
+            credit_account_credits_posted=rec.cr_account.credits_posted,
+            credit_account_user_data_128=cr.user_data_128,
+            credit_account_user_data_64=cr.user_data_64,
+            credit_account_user_data_32=cr.user_data_32,
+            credit_account_code=cr.code,
+            credit_account_flags=rec.cr_account.flags,
+            timestamp=rec.timestamp,
+            transfer_timestamp=transfer.timestamp,
+            debit_account_timestamp=dr.timestamp,
+            credit_account_timestamp=cr.timestamp,
+        )
+
+    # ------------------------------------------------------------- pulse
+
+    def pulse_needed(self, timestamp: int) -> bool:
+        """reference: src/state_machine.zig:1138-1144"""
+        return self.state.pulse_needed(timestamp)
+
+    # ------------------------------------------------------------- wire
+
+    def commit(self, op: Operation, body: bytes, timestamp: int) -> bytes:
+        """Execute one operation body (reference StateMachine.commit,
+        src/state_machine.zig:2564-2669): decode (multi-batch aware),
+        dispatch, encode results."""
+        spec = OPERATION_SPECS[op]
+        if op == Operation.pulse:
+            self.state.expire_pending_transfers(timestamp)
+            return b""
+        if op.is_multi_batch():
+            batches = multi_batch.decode(body, spec.event_size)
+            results = [self._commit_one(op, spec, b, timestamp) for b in batches]
+            return multi_batch.encode(results, spec.result_size)
+        return self._commit_one(op, spec, body, timestamp)
+
+    def _commit_one(self, op: Operation, spec: OperationSpec, body: bytes,
+                    timestamp: int) -> bytes:
+        events = [body[i:i + spec.event_size]
+                  for i in range(0, len(body), spec.event_size)]
+        O = Operation
+        base = _base_operation(op)
+        if base == O.create_accounts:
+            accounts = [Account.unpack(e) for e in events]
+            results = self.create_accounts(accounts, timestamp)
+            return _encode_create_results(results, spec)
+        if base == O.create_transfers:
+            transfers = [Transfer.unpack(e) for e in events]
+            results = self.create_transfers(transfers, timestamp)
+            return _encode_create_results(results, spec)
+        if base == O.lookup_accounts:
+            ids = [int.from_bytes(e, "little") for e in events]
+            return b"".join(a.pack() for a in self.lookup_accounts(ids))
+        if base == O.lookup_transfers:
+            ids = [int.from_bytes(e, "little") for e in events]
+            return b"".join(t.pack() for t in self.lookup_transfers(ids))
+        if base == O.get_account_transfers:
+            assert len(events) == 1
+            return b"".join(t.pack() for t in
+                            self.get_account_transfers(AccountFilter.unpack(events[0])))
+        if base == O.get_account_balances:
+            assert len(events) == 1
+            return b"".join(b.pack() for b in
+                            self.get_account_balances(AccountFilter.unpack(events[0])))
+        if base == O.query_accounts:
+            assert len(events) == 1
+            return b"".join(a.pack() for a in
+                            self.query_accounts(QueryFilter.unpack(events[0])))
+        if base == O.query_transfers:
+            assert len(events) == 1
+            return b"".join(t.pack() for t in
+                            self.query_transfers(QueryFilter.unpack(events[0])))
+        if base == O.get_change_events:
+            assert len(events) == 1
+            return b"".join(e.pack() for e in
+                            self.get_change_events(ChangeEventsFilter.unpack(events[0])))
+        raise ValueError(f"unhandled operation {op!r}")
+
+
+def _base_operation(op: Operation) -> Operation:
+    """Map deprecated wire-compat variants onto their modern semantics
+    (reference: src/tigerbeetle.zig:685-715)."""
+    O = Operation
+    return {
+        O.deprecated_create_accounts_unbatched: O.create_accounts,
+        O.deprecated_create_transfers_unbatched: O.create_transfers,
+        O.deprecated_create_accounts_sparse: O.create_accounts,
+        O.deprecated_create_transfers_sparse: O.create_transfers,
+        O.deprecated_lookup_accounts_unbatched: O.lookup_accounts,
+        O.deprecated_lookup_transfers_unbatched: O.lookup_transfers,
+        O.deprecated_get_account_transfers_unbatched: O.get_account_transfers,
+        O.deprecated_get_account_balances_unbatched: O.get_account_balances,
+        O.deprecated_query_accounts_unbatched: O.query_accounts,
+        O.deprecated_query_transfers_unbatched: O.query_transfers,
+    }.get(op, op)
+
+
+def _encode_create_results(results, spec: OperationSpec) -> bytes:
+    if not spec.sparse_results:
+        return b"".join(r.pack() for r in results)
+    # Deprecated sparse encoding: {index: u32, result: u32} for non-ok only,
+    # where `created` maps to omitted and wire code `ok`=0 is never sent.
+    out = b""
+    for i, r in enumerate(results):
+        if r.status.name == "created":
+            continue
+        out += struct.pack("<II", i, int(r.status))
+    return out

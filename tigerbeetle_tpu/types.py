@@ -39,6 +39,7 @@ __all__ = [
     "QueryFilter",
     "QueryFilterFlags",
     "ChangeEventType",
+    "ChangeEvent",
     "ChangeEventsFilter",
     "Operation",
 ]
@@ -630,6 +631,138 @@ class ChangeEventsFilter:
     def unpack(cls, data: bytes) -> "ChangeEventsFilter":
         f = _CHANGE_EVENTS_FILTER_FMT.unpack(data)
         return cls(timestamp_min=f[0], timestamp_max=f[1], limit=f[2])
+
+
+_CHANGE_EVENT_FMT = struct.Struct(
+    "<16s16s16s16sQIIHHIB39s"  # transfer block + ledger/type/reserved (128)
+    "16s16s16s16s16s16sQIHH"   # debit account block (112)
+    "16s16s16s16s16s16sQIHH"   # credit account block (112)
+    "QQQQ"                     # timestamps (32)
+)
+assert _CHANGE_EVENT_FMT.size == 384
+
+
+@dataclasses.dataclass
+class ChangeEvent:
+    """reference: src/tigerbeetle.zig:622-670 — 384 bytes
+    (= one Transfer + two Accounts)."""
+
+    transfer_id: int = 0
+    transfer_amount: int = 0
+    transfer_pending_id: int = 0
+    transfer_user_data_128: int = 0
+    transfer_user_data_64: int = 0
+    transfer_user_data_32: int = 0
+    transfer_timeout: int = 0
+    transfer_code: int = 0
+    transfer_flags: int = 0
+    ledger: int = 0
+    type: ChangeEventType = ChangeEventType.single_phase
+    debit_account_id: int = 0
+    debit_account_debits_pending: int = 0
+    debit_account_debits_posted: int = 0
+    debit_account_credits_pending: int = 0
+    debit_account_credits_posted: int = 0
+    debit_account_user_data_128: int = 0
+    debit_account_user_data_64: int = 0
+    debit_account_user_data_32: int = 0
+    debit_account_code: int = 0
+    debit_account_flags: int = 0
+    credit_account_id: int = 0
+    credit_account_debits_pending: int = 0
+    credit_account_debits_posted: int = 0
+    credit_account_credits_pending: int = 0
+    credit_account_credits_posted: int = 0
+    credit_account_user_data_128: int = 0
+    credit_account_user_data_64: int = 0
+    credit_account_user_data_32: int = 0
+    credit_account_code: int = 0
+    credit_account_flags: int = 0
+    timestamp: int = 0
+    transfer_timestamp: int = 0
+    debit_account_timestamp: int = 0
+    credit_account_timestamp: int = 0
+
+    def pack(self) -> bytes:
+        return _CHANGE_EVENT_FMT.pack(
+            _u128_to_bytes(self.transfer_id),
+            _u128_to_bytes(self.transfer_amount),
+            _u128_to_bytes(self.transfer_pending_id),
+            _u128_to_bytes(self.transfer_user_data_128),
+            self.transfer_user_data_64,
+            self.transfer_user_data_32,
+            self.transfer_timeout,
+            self.transfer_code,
+            self.transfer_flags,
+            self.ledger,
+            int(self.type),
+            b"\x00" * 39,
+            _u128_to_bytes(self.debit_account_id),
+            _u128_to_bytes(self.debit_account_debits_pending),
+            _u128_to_bytes(self.debit_account_debits_posted),
+            _u128_to_bytes(self.debit_account_credits_pending),
+            _u128_to_bytes(self.debit_account_credits_posted),
+            _u128_to_bytes(self.debit_account_user_data_128),
+            self.debit_account_user_data_64,
+            self.debit_account_user_data_32,
+            self.debit_account_code,
+            self.debit_account_flags,
+            _u128_to_bytes(self.credit_account_id),
+            _u128_to_bytes(self.credit_account_debits_pending),
+            _u128_to_bytes(self.credit_account_debits_posted),
+            _u128_to_bytes(self.credit_account_credits_pending),
+            _u128_to_bytes(self.credit_account_credits_posted),
+            _u128_to_bytes(self.credit_account_user_data_128),
+            self.credit_account_user_data_64,
+            self.credit_account_user_data_32,
+            self.credit_account_code,
+            self.credit_account_flags,
+            self.timestamp,
+            self.transfer_timestamp,
+            self.debit_account_timestamp,
+            self.credit_account_timestamp,
+        )
+
+    @classmethod
+    def unpack(cls, data: bytes) -> "ChangeEvent":
+        f = _CHANGE_EVENT_FMT.unpack(data)
+        return cls(
+            transfer_id=_u128_from_bytes(f[0]),
+            transfer_amount=_u128_from_bytes(f[1]),
+            transfer_pending_id=_u128_from_bytes(f[2]),
+            transfer_user_data_128=_u128_from_bytes(f[3]),
+            transfer_user_data_64=f[4],
+            transfer_user_data_32=f[5],
+            transfer_timeout=f[6],
+            transfer_code=f[7],
+            transfer_flags=f[8],
+            ledger=f[9],
+            type=ChangeEventType(f[10]),
+            debit_account_id=_u128_from_bytes(f[12]),
+            debit_account_debits_pending=_u128_from_bytes(f[13]),
+            debit_account_debits_posted=_u128_from_bytes(f[14]),
+            debit_account_credits_pending=_u128_from_bytes(f[15]),
+            debit_account_credits_posted=_u128_from_bytes(f[16]),
+            debit_account_user_data_128=_u128_from_bytes(f[17]),
+            debit_account_user_data_64=f[18],
+            debit_account_user_data_32=f[19],
+            debit_account_code=f[20],
+            debit_account_flags=f[21],
+            credit_account_id=_u128_from_bytes(f[22]),
+            credit_account_debits_pending=_u128_from_bytes(f[23]),
+            credit_account_debits_posted=_u128_from_bytes(f[24]),
+            credit_account_credits_pending=_u128_from_bytes(f[25]),
+            credit_account_credits_posted=_u128_from_bytes(f[26]),
+            credit_account_user_data_128=_u128_from_bytes(f[27]),
+            credit_account_user_data_64=f[28],
+            credit_account_user_data_32=f[29],
+            credit_account_code=f[30],
+            credit_account_flags=f[31],
+            timestamp=f[32],
+            transfer_timestamp=f[33],
+            debit_account_timestamp=f[34],
+            credit_account_timestamp=f[35],
+        )
 
 
 class Operation(enum.IntEnum):
