@@ -175,6 +175,45 @@ def test_change_events_expiry():
     assert events[1].transfer_pending_id == 0
 
 
+def test_multi_batch_timestamps_advance_per_batch():
+    """Each inner batch consumes one timestamp per event (reference:
+    execute_multi_batch advances the execute timestamp per batch)."""
+    sm = StateMachine()
+    accounts = b"".join(Account(id=i, ledger=1, code=1).pack() for i in (1, 2))
+    sm.commit(Operation.create_accounts, multi_batch.encode([accounts], 128), TS)
+    t1 = Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
+                  ledger=1, code=1).pack()
+    t2 = Transfer(id=2, debit_account_id=1, credit_account_id=2, amount=2,
+                  ledger=1, code=1).pack()
+    out = sm.commit(Operation.create_transfers,
+                    multi_batch.encode([t1, t2], 128), TS + 100)
+    r1, r2 = [CreateTransferResult.unpack(p)
+              for p in multi_batch.decode(out, 16)]
+    assert r1.status.name == r2.status.name == "created"
+    assert r1.timestamp == TS + 99 and r2.timestamp == TS + 100
+    assert len(sm.state.transfer_by_timestamp) == 2
+
+
+def test_malformed_bodies_rejected():
+    import pytest as _pytest
+
+    from tigerbeetle_tpu.state_machine import ProtocolError
+
+    sm = StateMachine()
+    assert not sm.input_valid(Operation.create_accounts, b"\x01" * 100)
+    with _pytest.raises(ProtocolError):
+        sm.commit(Operation.deprecated_create_transfers_unbatched,
+                  b"\x00" * 100, TS)
+    # two filters in a single-filter op
+    f = AccountFilter(account_id=1, limit=10, flags=int(AFF.debits)).pack()
+    body = multi_batch.encode([f + f], 128)
+    assert not sm.input_valid(Operation.get_account_transfers, body)
+    # junk between payload and trailer
+    good = multi_batch.encode([f], 128)
+    bad = good[:-128] + b"\x99" * 16 + good[-128:-16] + good[-16:]
+    assert not sm.input_valid(Operation.get_account_transfers, bad)
+
+
 def test_multi_batch_roundtrip():
     for element_size in (8, 16, 64, 128):
         batches = [b"\x01" * element_size * 3, b"", b"\x02" * element_size]

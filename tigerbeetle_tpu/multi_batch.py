@@ -69,9 +69,11 @@ def decode(body: bytes, element_size: int) -> list[bytes]:
     counts = [items[-2 - i] for i in range(batch_count)]
     if any(c == PADDING for c in counts):
         raise ValueError("padding marker inside counts")
+    if any(p != PADDING for p in items[:n_items - 1 - batch_count]):
+        raise ValueError("trailer padding not 0xFFFF")
     payload_len = sum(counts) * element_size
-    if payload_len + tsize > len(body):
-        raise ValueError("batch payloads exceed body")
+    if payload_len + tsize != len(body):
+        raise ValueError("body size does not match trailer counts")
     out = []
     offset = 0
     for c in counts:

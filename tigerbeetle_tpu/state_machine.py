@@ -46,7 +46,12 @@ from .types import (
     TransferPendingStatus,
 )
 
-__all__ = ["StateMachine", "OperationSpec", "OPERATION_SPECS"]
+__all__ = ["StateMachine", "OperationSpec", "OPERATION_SPECS", "ProtocolError"]
+
+
+class ProtocolError(ValueError):
+    """Malformed operation body (the replica rejects the request;
+    reference: input_valid / batch en/decode errors)."""
 
 
 @dataclasses.dataclass(frozen=True)
@@ -155,10 +160,12 @@ class StateMachine:
     # ------------------------------------------------------------- indexes
 
     def _refresh_indexes(self) -> None:
+        import itertools
+
         transfers = self.state.transfers
         if len(transfers) > self._xfer_indexed:
-            items = list(transfers.values())[self._xfer_indexed:]
-            for t in items:
+            for t in itertools.islice(transfers.values(),
+                                      self._xfer_indexed, None):
                 ts = t.timestamp
                 self._xfer_ts.append(ts)
                 for field, idx in self._xfer_by.items():
@@ -166,7 +173,8 @@ class StateMachine:
             self._xfer_indexed = len(transfers)
         accounts = self.state.accounts
         if len(accounts) > self._acct_indexed:
-            for a in list(accounts.values())[self._acct_indexed:]:
+            for a in itertools.islice(accounts.values(),
+                                      self._acct_indexed, None):
                 self._acct_ts.append(a.timestamp)
                 for field, idx in self._acct_by.items():
                     idx.add(getattr(a, field), a.timestamp)
@@ -419,17 +427,59 @@ class StateMachine:
 
     # ------------------------------------------------------------- wire
 
+    def input_valid(self, op: Operation, body: bytes) -> bool:
+        """Cheap wire-shape validation before a request is accepted
+        (reference: input_valid, src/state_machine.zig:~1000)."""
+        spec = OPERATION_SPECS.get(op)
+        if spec is None:
+            return False
+        if op == Operation.pulse:
+            return body == b""
+        try:
+            batches = (multi_batch.decode(body, spec.event_size)
+                       if op.is_multi_batch() else [body])
+        except ValueError:
+            return False
+        base = _base_operation(op)
+        single = base in (
+            Operation.get_account_transfers, Operation.get_account_balances,
+            Operation.query_accounts, Operation.query_transfers,
+            Operation.get_change_events)
+        for b in batches:
+            if spec.event_size and len(b) % spec.event_size != 0:
+                return False
+            if single and len(b) != spec.event_size:
+                return False
+        return True
+
     def commit(self, op: Operation, body: bytes, timestamp: int) -> bytes:
         """Execute one operation body (reference StateMachine.commit,
         src/state_machine.zig:2564-2669): decode (multi-batch aware),
-        dispatch, encode results."""
+        dispatch, encode results. Raises ProtocolError on malformed input
+        (callers validate first via input_valid)."""
+        if not self.input_valid(op, body):
+            raise ProtocolError(f"malformed body for {op!r}")
         spec = OPERATION_SPECS[op]
         if op == Operation.pulse:
             self.state.expire_pending_transfers(timestamp)
             return b""
         if op.is_multi_batch():
             batches = multi_batch.decode(body, spec.event_size)
-            results = [self._commit_one(op, spec, b, timestamp) for b in batches]
+            results = []
+            if _base_operation(op) in (Operation.create_accounts,
+                                       Operation.create_transfers):
+                # Each inner batch consumes one timestamp per event; the
+                # prepare timestamp is the LAST event's
+                # (reference: execute_multi_batch advances the execute
+                # timestamp per batch, src/state_machine.zig:2720-2756).
+                counts = [len(b) // spec.event_size for b in batches]
+                running = timestamp - sum(counts)
+                for b, n in zip(batches, counts):
+                    running += n
+                    results.append(self._commit_one(op, spec, b, running))
+            else:
+                results = [self._commit_one(op, spec, b, timestamp)
+                           for b in batches]
             return multi_batch.encode(results, spec.result_size)
         return self._commit_one(op, spec, body, timestamp)
 

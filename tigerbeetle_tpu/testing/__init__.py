@@ -1,0 +1,11 @@
+"""Deterministic simulation testing (reference: src/testing/, src/vopr.zig).
+
+Whole clusters — replicas, storage, network, clocks, clients — run in one
+process from one seed. Every failure is a replayable seed; all replicas must
+converge to byte-identical state (the reference's StateChecker /
+StorageChecker discipline, src/testing/cluster/state_checker.zig).
+"""
+
+from .cluster import Cluster, SimClient
+
+__all__ = ["Cluster", "SimClient"]

@@ -1,0 +1,268 @@
+"""In-process deterministic cluster: simulated time, network, and storage.
+
+reference: src/testing/cluster.zig (ClusterType), packet_simulator.zig
+(delay/loss/duplication/partitions), time.zig (TimeSim). Replicas are REAL
+Replica instances — only their environment is simulated, via constructor
+injection. Every message crosses the "network" as serialized bytes, so wire
+codecs are exercised and no Python object state leaks between replicas.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+import random
+from typing import Callable, Optional
+
+from ..state_machine import StateMachine
+from ..types import Operation
+from ..vsr import snapshot as snapshot_codec
+from ..vsr.header import Command, Header, Message
+from ..vsr.replica import Replica, ReplicaOptions
+from ..vsr.storage import MemoryStorage, StorageLayout, TEST_LAYOUT
+
+MS = 1_000_000
+
+
+class TimeSim:
+    """Deterministic clock shared by the cluster (per-replica drift can be
+    layered on later; reference: src/testing/time.zig)."""
+
+    def __init__(self, start_ns: int = 1_700_000_000 * 10**9):
+        self.now = start_ns
+
+    def monotonic(self) -> int:
+        return self.now
+
+    def realtime(self) -> int:
+        return self.now
+
+    def advance(self, dt_ns: int) -> None:
+        self.now += dt_ns
+
+
+@dataclasses.dataclass
+class NetworkOptions:
+    """reference: src/testing/packet_simulator.zig:13-74"""
+
+    delay_min_ns: int = 1 * MS
+    delay_max_ns: int = 5 * MS
+    loss_probability: float = 0.0
+    duplicate_probability: float = 0.0
+
+
+class _ReplicaBus:
+    """MessageBus facade handed to one replica."""
+
+    def __init__(self, cluster: "Cluster", replica_id: int):
+        self.cluster = cluster
+        self.replica_id = replica_id
+
+    def send_to_replica(self, dst: int, msg: Message) -> None:
+        self.cluster._post(("replica", self.replica_id), ("replica", dst),
+                           msg.pack())
+
+    def send_to_client(self, client_id: int, msg: Message) -> None:
+        self.cluster._post(("replica", self.replica_id), ("client", client_id),
+                           msg.pack())
+
+
+class SimClient:
+    """Driver-side client: request/reply with redundancy against every
+    replica (only the primary acts; session request numbers dedupe).
+    reference: src/vsr/client.zig (simplified: no hedging, no eviction)."""
+
+    def __init__(self, cluster: "Cluster", client_id: int):
+        self.cluster = cluster
+        self.client_id = client_id
+        self.request_number = 0
+        self.inflight: Optional[dict] = None
+        self.replies: list[Message] = []
+
+    def request(self, operation: Operation, body: bytes,
+                callback: Optional[Callable[[Message], None]] = None) -> None:
+        assert self.inflight is None, "one request at a time"
+        self.request_number += 1
+        header = Header(
+            command=Command.request, cluster=self.cluster.cluster_id,
+            client=self.client_id, request=self.request_number,
+            operation=int(operation))
+        msg = Message(header.finalize(body), body=body)
+        self.inflight = {"message": msg, "sent_at": 0, "callback": callback}
+        self._send()
+
+    def _send(self) -> None:
+        msg = self.inflight["message"]
+        self.inflight["sent_at"] = self.cluster.time.now
+        for r in range(self.cluster.replica_count):
+            self.cluster._post(("client", self.client_id), ("replica", r),
+                               msg.pack())
+
+    def on_message(self, msg: Message) -> None:
+        if msg.header.command != Command.reply:
+            return
+        if self.inflight is None:
+            return
+        if msg.header.request != self.request_number:
+            return
+        cb = self.inflight["callback"]
+        self.inflight = None
+        self.replies.append(msg)
+        if cb is not None:
+            cb(msg)
+
+    def tick(self) -> None:
+        if (self.inflight is not None
+                and self.cluster.time.now - self.inflight["sent_at"] > 300 * MS):
+            self._send()  # resend (view change / loss)
+
+    @property
+    def idle(self) -> bool:
+        return self.inflight is None
+
+
+class Cluster:
+    def __init__(self, *, seed: int = 0, replica_count: int = 3,
+                 layout: StorageLayout = TEST_LAYOUT,
+                 network: NetworkOptions = NetworkOptions(),
+                 options: ReplicaOptions = ReplicaOptions(),
+                 state_machine_factory=StateMachine):
+        self.cluster_id = 0xC1A57E12
+        self.rng = random.Random(seed)
+        self.time = TimeSim()
+        self.network = network
+        self.replica_count = replica_count
+        self.layout = layout
+        self.options = options
+        self.state_machine_factory = state_machine_factory
+        self.queue: list = []  # heap of (deliver_at, seq, src, dst, raw)
+        self._seq = 0
+        self.partitioned: set = set()  # endpoints whose links are cut
+        self.crashed: set[int] = set()
+
+        self.storages = [MemoryStorage(layout) for _ in range(replica_count)]
+        self.replicas: list[Replica] = []
+        for i in range(replica_count):
+            Replica.format(self.storages[i], cluster=self.cluster_id,
+                           replica_id=i, replica_count=replica_count)
+            self.replicas.append(self._make_replica(i))
+            self.replicas[i].open()
+        self.clients: dict[int, SimClient] = {}
+
+    def _make_replica(self, i: int) -> Replica:
+        return Replica(
+            cluster=self.cluster_id, replica_id=i,
+            replica_count=self.replica_count, storage=self.storages[i],
+            bus=_ReplicaBus(self, i), time=self.time,
+            state_machine_factory=self.state_machine_factory,
+            options=self.options)
+
+    def client(self, client_id: int) -> SimClient:
+        if client_id not in self.clients:
+            self.clients[client_id] = SimClient(self, client_id)
+        return self.clients[client_id]
+
+    # ------------------------------------------------------------- network
+
+    def _post(self, src, dst, raw: bytes) -> None:
+        if src in self.partitioned or dst in self.partitioned:
+            return
+        if dst[0] == "replica" and dst[1] in self.crashed:
+            return
+        if self.rng.random() < self.network.loss_probability:
+            return
+        copies = 1
+        if self.rng.random() < self.network.duplicate_probability:
+            copies = 2
+        for _ in range(copies):
+            delay = self.rng.randrange(
+                self.network.delay_min_ns, self.network.delay_max_ns + 1)
+            self._seq += 1
+            heapq.heappush(
+                self.queue, (self.time.now + delay, self._seq, dst, raw))
+
+    # ------------------------------------------------------------- control
+
+    def crash(self, replica_id: int) -> None:
+        """Stop a replica (its storage survives)."""
+        self.crashed.add(replica_id)
+
+    def restart(self, replica_id: int) -> None:
+        assert replica_id in self.crashed
+        self.crashed.discard(replica_id)
+        self.replicas[replica_id] = self._make_replica(replica_id)
+        self.replicas[replica_id].open()
+
+    def partition(self, endpoint) -> None:
+        self.partitioned.add(endpoint)
+
+    def heal(self, endpoint=None) -> None:
+        if endpoint is None:
+            self.partitioned.clear()
+        else:
+            self.partitioned.discard(endpoint)
+
+    # -------------------------------------------------------------- ticking
+
+    def tick(self, dt_ns: int = 10 * MS) -> None:
+        self.time.advance(dt_ns)
+        while self.queue and self.queue[0][0] <= self.time.now:
+            _, _, dst, raw = heapq.heappop(self.queue)
+            try:
+                msg = Message.unpack(raw)
+            except Exception:
+                continue
+            if dst[0] == "replica":
+                if dst[1] in self.crashed or dst in self.partitioned:
+                    continue
+                self.replicas[dst[1]].on_message(msg)
+            else:
+                client = self.clients.get(dst[1])
+                if client is not None and dst not in self.partitioned:
+                    client.on_message(msg)
+        for i, replica in enumerate(self.replicas):
+            if i not in self.crashed:
+                replica.tick()
+        for client in self.clients.values():
+            client.tick()
+
+    def run(self, ticks: int, dt_ns: int = 10 * MS,
+            until: Optional[Callable[[], bool]] = None) -> bool:
+        for _ in range(ticks):
+            self.tick(dt_ns)
+            if until is not None and until():
+                return True
+        return until is None
+
+    # ------------------------------------------------------------- checkers
+
+    def settle(self, ticks: int = 2000) -> None:
+        """Heal everything and run until all live replicas converge."""
+        self.heal()
+        self.network.loss_probability = 0.0
+        self.network.duplicate_probability = 0.0
+        ok = self.run(ticks, until=self._converged)
+        assert ok, self.debug_status()
+        self.check_convergence()
+
+    def _converged(self) -> bool:
+        live = [r for i, r in enumerate(self.replicas) if i not in self.crashed]
+        commits = {r.commit_min for r in live}
+        ops = [r.op for r in live]
+        return (len(commits) == 1 and commits.pop() == max(ops)
+                and all(c.idle for c in self.clients.values()))
+
+    def check_convergence(self) -> None:
+        """All live replicas hold byte-identical state (the reference's
+        StateChecker/StorageChecker invariant)."""
+        live = [r for i, r in enumerate(self.replicas) if i not in self.crashed]
+        snaps = [snapshot_codec.encode(r.state_machine.state) for r in live]
+        assert all(s == snaps[0] for s in snaps[1:]), "state divergence"
+        commit = {r.commit_min for r in live}
+        assert len(commit) == 1
+
+    def debug_status(self) -> str:
+        return " | ".join(
+            f"r{r.replica_id}:{r.status} v={r.view} op={r.op} "
+            f"cmin={r.commit_min} cmax={r.commit_max}"
+            for r in self.replicas)

@@ -1,0 +1,15 @@
+"""VSR consensus and durability (reference: src/vsr/, SURVEY §2.1).
+
+The control plane of the framework: replicated state machines over a custom
+message bus, a write-ahead journal, quorum-replicated superblocks, and
+deterministic checkpoints. All components are sans-IO: Storage, MessageBus,
+and Time are constructor-injected so the deterministic simulator
+(tigerbeetle_tpu.testing) can drive whole clusters in one process — the
+Python restatement of the reference's comptime dependency injection
+(src/testing/cluster.zig:70).
+"""
+
+from .checksum import checksum
+from .header import Command, Header
+
+__all__ = ["checksum", "Command", "Header"]

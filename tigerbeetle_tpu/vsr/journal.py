@@ -1,0 +1,144 @@
+"""Write-ahead journal: two on-disk rings (redundant headers + prepares).
+
+reference: src/vsr/journal.zig:16-27 — the WAL is two rings indexed by
+op % slot_count: a ring of full prepare messages and a ring of just their
+256-byte headers. The redundant header ring disambiguates torn prepare
+writes during recovery: a valid header whose prepare is corrupt marks the
+slot faulty-but-known, repairable from peers; both-invalid marks it
+unknown.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+from .header import HEADER_SIZE, Command, Header, Message
+from .storage import Storage
+
+
+class SlotState(enum.Enum):
+    clean = "clean"  # header and prepare agree and validate
+    faulty = "faulty"  # header valid, prepare torn/corrupt -> repair
+    unknown = "unknown"  # nothing valid in the slot
+
+
+@dataclasses.dataclass
+class Slot:
+    state: SlotState
+    header: Optional[Header] = None  # valid for clean/faulty
+
+
+class Journal:
+    def __init__(self, storage: Storage):
+        self.storage = storage
+        self.slot_count = storage.layout.slot_count
+        self.prepare_size_max = storage.layout.message_size_max
+        # In-memory copy of the header ring (reference keeps headers
+        # resident: src/vsr/journal.zig headers array).
+        self.headers: list[Optional[Header]] = [None] * self.slot_count
+        self.dirty: set[int] = set()
+        self.faulty: set[int] = set()
+
+    def slot_for_op(self, op: int) -> int:
+        return op % self.slot_count
+
+    # ---------------------------------------------------------------- write
+
+    def append(self, message: Message) -> None:
+        """Write prepare body then its redundant header (ordering matters:
+        a crash between the two leaves the old header pointing at the old,
+        still-valid prepare, or the new prepare not yet referenced)."""
+        header = message.header
+        assert header.command == Command.prepare
+        assert header.size <= self.prepare_size_max + HEADER_SIZE
+        slot = self.slot_for_op(header.op)
+        raw = message.pack()
+        self.storage.write("wal_prepares", slot * self.prepare_size_max, raw)
+        self.storage.write("wal_headers", slot * HEADER_SIZE, header.pack())
+        self.headers[slot] = header
+        self.dirty.discard(slot)
+        self.faulty.discard(slot)
+
+    # ---------------------------------------------------------------- read
+
+    def read_prepare(self, op: int) -> Optional[Message]:
+        slot = self.slot_for_op(op)
+        header = self.headers[slot]
+        if header is None or header.op != op:
+            return None
+        raw = self.storage.read(
+            "wal_prepares", slot * self.prepare_size_max,
+            min(self.prepare_size_max, max(header.size, HEADER_SIZE)))
+        try:
+            msg = Message.unpack(raw)
+        except Exception:
+            return None
+        if not msg.valid() or msg.header.op != op:
+            return None
+        return msg
+
+    # ------------------------------------------------------------- recovery
+
+    def recover(self) -> list[Slot]:
+        """Scan both rings, classify each slot, and load the in-memory header
+        ring (reference: journal recovery in src/vsr/journal.zig; decision
+        table in docs/internals/vsr.md:188-217)."""
+        slots: list[Slot] = []
+        for slot in range(self.slot_count):
+            hdr_raw = self.storage.read(
+                "wal_headers", slot * HEADER_SIZE, HEADER_SIZE)
+            header = _try_header(hdr_raw)
+            prep_raw = self.storage.read(
+                "wal_prepares", slot * self.prepare_size_max, HEADER_SIZE)
+            prep_header = _try_header(prep_raw)
+
+            prepare_valid = False
+            if prep_header is not None and prep_header.command == Command.prepare:
+                msg = None
+                if prep_header.size <= self.prepare_size_max + HEADER_SIZE:
+                    body_raw = self.storage.read(
+                        "wal_prepares", slot * self.prepare_size_max,
+                        prep_header.size)
+                    try:
+                        msg = Message.unpack(body_raw)
+                    except Exception:
+                        msg = None
+                prepare_valid = msg is not None and msg.valid()
+
+            if header is not None and header.command == Command.prepare:
+                if (prepare_valid and prep_header.checksum == header.checksum):
+                    slots.append(Slot(SlotState.clean, header))
+                    self.headers[slot] = header
+                elif prepare_valid and prep_header.op > header.op:
+                    # Torn header write after a newer prepare landed: trust
+                    # the newer prepare.
+                    slots.append(Slot(SlotState.clean, prep_header))
+                    self.headers[slot] = prep_header
+                else:
+                    slots.append(Slot(SlotState.faulty, header))
+                    self.headers[slot] = header
+                    self.faulty.add(slot)
+            elif prepare_valid:
+                # Header torn, prepare intact.
+                slots.append(Slot(SlotState.clean, prep_header))
+                self.headers[slot] = prep_header
+            else:
+                slots.append(Slot(SlotState.unknown))
+                self.faulty.add(slot)
+        return slots
+
+    def op_max(self) -> int:
+        """Highest op in the journal (after recover())."""
+        return max((h.op for h in self.headers if h is not None), default=0)
+
+
+def _try_header(raw: bytes) -> Optional[Header]:
+    try:
+        header = Header.unpack(raw)
+    except Exception:
+        return None
+    if not header.valid_checksum():
+        return None
+    return header

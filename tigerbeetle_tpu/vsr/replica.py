@@ -1,0 +1,698 @@
+"""The VSR replica: consensus participant + commit pipeline.
+
+reference: src/vsr/replica.zig (normal protocol :1944-2330, commit pipeline
+:4374-5440, view change per docs/internals/vsr.md:106-186). This is a fresh
+sans-IO implementation: all effects go through injected Storage / MessageBus
+/ Time, so the deterministic simulator can run whole clusters in-process
+(the reference achieves the same via comptime injection,
+src/testing/cluster.zig:70).
+
+Protocol summary (faithful to VSR; simplified where noted):
+- normal: primary assigns (op, timestamp) to client requests, appends to its
+  journal, replicates `prepare` to backups; backups append + `prepare_ok`;
+  primary commits on replication quorum, executes the state machine, replies
+  to the client; backups learn commits from piggybacked `commit` numbers and
+  heartbeat `commit` messages.
+- view change: on primary timeout, replicas send `start_view_change` for
+  view v+1; on quorum each sends `do_view_change` (carrying log_view, op,
+  and the header suffix above the checkpoint) to v+1's primary; the new
+  primary adopts the best log (max log_view, then max op), sends
+  `start_view`; backups install the suffix and repair missing prepares.
+- repair: gaps are filled via `request_prepare`/`prepare` from any peer.
+- checkpoint: every `checkpoint_interval` commits the state machine snapshot
+  is written to the alternating snapshot slot and the superblock flips
+  (snapshot-based for round 1; the LSM grid replaces this later).
+
+Omitted in round 1 (tracked for later rounds): standbys, state sync for
+replicas that fell behind WAL wrap (they currently halt and must be
+reformatted), protocol-aware NACK recovery, request hedging.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, Optional
+
+from ..constants import PIPELINE_PREPARE_QUEUE_MAX
+from ..state_machine import StateMachine
+from ..types import Operation
+from . import snapshot as snapshot_codec
+from .checksum import checksum
+from .header import HEADER_SIZE, Command, Header, Message
+from .journal import Journal
+from .storage import Storage
+from .superblock import SuperBlock
+
+MS = 1_000_000  # ns
+
+
+@dataclasses.dataclass
+class ReplicaOptions:
+    heartbeat_interval_ns: int = 100 * MS
+    view_change_timeout_ns: int = 500 * MS
+    repair_interval_ns: int = 50 * MS
+    checkpoint_interval: int = 16  # ops between checkpoints
+
+
+class Replica:
+    def __init__(self, *, cluster: int, replica_id: int, replica_count: int,
+                 storage: Storage, bus, time,
+                 state_machine_factory: Callable[[], StateMachine] = StateMachine,
+                 options: ReplicaOptions = ReplicaOptions()):
+        assert 1 <= replica_count <= 6
+        assert 0 <= replica_id < replica_count
+        self.cluster = cluster
+        self.replica_id = replica_id
+        self.replica_count = replica_count
+        self.storage = storage
+        self.bus = bus
+        self.time = time
+        self.options = options
+        self.state_machine_factory = state_machine_factory
+
+        self.journal = Journal(storage)
+        self.state_machine: StateMachine = state_machine_factory()
+        self.superblock: Optional[SuperBlock] = None
+
+        self.status = "recovering"
+        self.view = 0
+        self.log_view = 0
+        self.op = 0  # highest op appended to our journal
+        self.commit_min = 0  # highest op executed
+        self.commit_max = 0  # highest op known committed cluster-wide
+        self.prepare_timestamp = 0
+
+        # Primary pipeline: op -> {"message": Message, "oks": set[replica]}
+        self.pipeline: dict[int, dict] = {}
+        # Client sessions: client_id -> {"request": int, "reply": Message}
+        self.sessions: dict[int, dict] = {}
+        # View change collection state.
+        self.svc_votes: dict[int, set[int]] = {}
+        self.dvc_messages: dict[int, dict[int, Message]] = {}
+        # Canonical header checksums installed from start_view/do_view_change:
+        # prepares matching these are authoritative regardless of their view
+        # (the view-change quorum chose this log).
+        self.canonical: dict[int, int] = {}
+        # Repair bookkeeping.
+        self.repair_requested: dict[int, int] = {}  # op -> last request ns
+
+        self.last_heartbeat_rx = 0
+        self.last_heartbeat_tx = 0
+        self.last_repair_tick = 0
+
+    # ------------------------------------------------------------ lifecycle
+
+    @staticmethod
+    def format(storage: Storage, *, cluster: int, replica_id: int,
+               replica_count: int) -> None:
+        """Create a fresh data file (reference: src/vsr/replica_format.zig)."""
+        state = StateMachine().state
+        raw = snapshot_codec.encode(state)
+        storage.write("snapshot", 0, raw)
+        sb = SuperBlock(
+            cluster=cluster, replica_id=replica_id,
+            replica_count=replica_count,
+            snapshot_slot=0, snapshot_size=len(raw),
+            snapshot_checksum=checksum(raw, domain=b"snap"))
+        sb.store(storage)
+
+    def open(self) -> None:
+        """Recover durable state: superblock quorum -> snapshot -> WAL replay
+        (reference: src/vsr/replica.zig:654 open + commit_journal)."""
+        sb = SuperBlock.load(self.storage)
+        assert sb is not None, "data file not formatted"
+        assert sb.cluster == self.cluster
+        assert sb.replica_id == self.replica_id
+        self.superblock = sb
+        self.view = sb.view
+        self.log_view = sb.log_view
+
+        raw = self.storage.read(
+            "snapshot", sb.snapshot_slot * self.storage.layout.snapshot_size_max,
+            sb.snapshot_size)
+        assert checksum(raw, domain=b"snap") == sb.snapshot_checksum, \
+            "snapshot corrupt"
+        self.state_machine = self.state_machine_factory()
+        self.state_machine.state = snapshot_codec.decode(raw)
+
+        self.journal.recover()
+        self.op = max(sb.op_checkpoint, self._journal_contiguous_max(sb.op_checkpoint))
+        self.commit_min = sb.op_checkpoint
+        self.commit_max = max(sb.commit_max, sb.op_checkpoint)
+        self.prepare_timestamp = self.state_machine.state.commit_timestamp
+        # Replay the WAL suffix above the checkpoint.
+        self._commit_journal(min(self.op, max(self.commit_max, self.op)))
+        self.status = "normal"
+        self.last_heartbeat_rx = self.time.monotonic()
+
+    def _journal_contiguous_max(self, from_op: int) -> int:
+        """Highest op such that every (from_op, op] slot holds a valid,
+        hash-chained prepare."""
+        op = from_op
+        while True:
+            nxt = self.journal.read_prepare(op + 1)
+            if nxt is None:
+                return op
+            if op > from_op:
+                cur = self.journal.read_prepare(op)
+                if cur is None or nxt.header.parent != cur.header.checksum:
+                    return op
+            op += 1
+
+    # ------------------------------------------------------------ identity
+
+    def primary_index(self, view: Optional[int] = None) -> int:
+        return (self.view if view is None else view) % self.replica_count
+
+    @property
+    def is_primary(self) -> bool:
+        return self.status == "normal" and self.primary_index() == self.replica_id
+
+    @property
+    def quorum_replication(self) -> int:
+        """Flexible quorums (reference: docs/internals/vsr.md:283-289)."""
+        return {1: 1, 2: 2, 3: 2, 4: 2, 5: 3, 6: 3}[self.replica_count]
+
+    @property
+    def quorum_view_change(self) -> int:
+        return {1: 1, 2: 2, 3: 2, 4: 3, 5: 3, 6: 4}[self.replica_count]
+
+    # ------------------------------------------------------------- messages
+
+    def on_message(self, msg: Message) -> None:
+        if not msg.valid():
+            return
+        h = msg.header
+        if h.cluster != self.cluster:
+            return
+        handler = {
+            Command.request: self.on_request,
+            Command.prepare: self.on_prepare,
+            Command.prepare_ok: self.on_prepare_ok,
+            Command.commit: self.on_commit,
+            Command.start_view_change: self.on_start_view_change,
+            Command.do_view_change: self.on_do_view_change,
+            Command.start_view: self.on_start_view,
+            Command.request_start_view: self.on_request_start_view,
+            Command.request_prepare: self.on_request_prepare,
+            Command.ping: self.on_ping,
+            Command.pong: self.on_pong,
+        }.get(h.command)
+        if handler is not None:
+            handler(msg)
+
+    # --------------------------------------------------------- normal path
+
+    def on_request(self, msg: Message) -> None:
+        if not self.is_primary:
+            return  # client retries against the right primary
+        h = msg.header
+        session = self.sessions.get(h.client)
+        if session is not None:
+            if h.request < session["request"]:
+                return  # stale duplicate
+            if h.request == session["request"] and session["reply"] is not None:
+                self.bus.send_to_client(h.client, session["reply"])
+                return
+        for entry in self.pipeline.values():
+            eh = entry["message"].header
+            if eh.client == h.client and eh.request == h.request:
+                return  # already preparing this request
+        if len(self.pipeline) >= PIPELINE_PREPARE_QUEUE_MAX:
+            return  # backpressure: client will retry
+        if not self.state_machine.input_valid(Operation(h.operation), msg.body):
+            return  # malformed body: never prepare it (client bug)
+        self._primary_prepare(Operation(h.operation), msg.body, client=h.client,
+                              request=h.request)
+
+    def _primary_prepare(self, operation: Operation, body: bytes, *,
+                         client: int = 0, request: int = 0) -> None:
+        assert self.is_primary
+        op = self.op + 1
+        self.prepare_timestamp = max(
+            self.prepare_timestamp + _event_count(operation, body),
+            self.time.realtime())
+        parent = self._prepare_checksum(self.op)
+        header = Header(
+            command=Command.prepare, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=op,
+            commit=self.commit_max, timestamp=self.prepare_timestamp,
+            operation=int(operation), client=client, request=request,
+            parent=parent,
+        )
+        prepare = Message(header=header.finalize(body), body=body)
+        self.journal.append(prepare)
+        self.op = op
+        self.pipeline[op] = {"message": prepare, "oks": {self.replica_id}}
+        for r in range(self.replica_count):
+            if r != self.replica_id:
+                self.bus.send_to_replica(r, prepare)
+        self._check_quorum(op)
+
+    def _prepare_checksum(self, op: int) -> int:
+        if op == 0:
+            return checksum(
+                self.cluster.to_bytes(16, "little"), domain=b"genesis")
+        msg = self.journal.read_prepare(op)
+        return msg.header.checksum if msg else 0
+
+    def on_prepare(self, msg: Message) -> None:
+        h = msg.header
+        # A prepare matching a canonical header (installed by the view-change
+        # quorum) is authoritative regardless of its original view.
+        if self.canonical.get(h.op) == h.checksum and self.status == "normal":
+            held = self.journal.read_prepare(h.op)
+            if held is None or held.header.checksum != h.checksum:
+                self.journal.append(msg)  # overwrite a stale same-op prepare
+            self.op = max(self.op, h.op)
+            if not self.is_primary:
+                self._send_prepare_ok(h)
+            else:
+                self._primary_adopt_canonical(msg)
+            self._commit_journal(self.commit_max)
+            return
+        if self.status != "normal" or h.view != self.view:
+            if h.view > self.view:
+                self._request_start_view(h.view)
+            return
+        if self.is_primary:
+            return
+        self.last_heartbeat_rx = self.time.monotonic()
+        if h.op <= self.op:
+            held = self.journal.read_prepare(h.op)
+            if held is None and self._chains_into_log(h):
+                # Repair fill: the prepare for a gap slot, validated by its
+                # hash-chain linkage to neighbors we already hold.
+                self.journal.append(msg)
+                held = msg
+                self._commit_journal(self.commit_max)
+            if held is not None and held.header.checksum == h.checksum:
+                self._send_prepare_ok(h)  # ack only what we actually hold
+        elif h.op == self.op + 1 and h.parent == self._prepare_checksum(self.op):
+            self.journal.append(msg)
+            self.op = h.op
+            self._send_prepare_ok(h)
+        else:
+            # Gap or chain break: repair.
+            for missing in range(self.op + 1, h.op):
+                self.repair_requested.setdefault(missing, 0)
+            self.journal.append(msg)  # keep the prepare; chain checked later
+            self.op = max(self.op, h.op)
+        self.commit_max = max(self.commit_max, h.commit)
+        self._commit_journal(self.commit_max)
+
+    def _chains_into_log(self, h: Header) -> bool:
+        """Validate a repair prepare by hash-chain linkage. Forward linkage
+        (op+1's parent pins this checksum) is authoritative at any view;
+        backward linkage is only safe within the current view — an op
+        replaced during a view change chains backward identically to its
+        canonical replacement, so a stale prepare from a deposed primary
+        must not be admitted that way."""
+        nxt = self.journal.read_prepare(h.op + 1)
+        if nxt is not None:
+            return nxt.header.parent == h.checksum
+        if h.op == 0 or h.view != self.view:
+            return False
+        prev_checksum = self._prepare_checksum(h.op - 1)
+        return prev_checksum != 0 and h.parent == prev_checksum
+
+    def _primary_adopt_canonical(self, msg: Message) -> None:
+        """New primary obtained a canonical suffix prepare body: re-replicate
+        it in the new view so it can gather a fresh quorum."""
+        op = msg.header.op
+        if op <= self.commit_min or op in self.pipeline:
+            return
+        self.pipeline[op] = {"message": msg, "oks": {self.replica_id}}
+        for r in range(self.replica_count):
+            if r != self.replica_id:
+                self.bus.send_to_replica(r, msg)
+        self._check_quorum(op)
+
+    def _send_prepare_ok(self, prepare_header: Header) -> None:
+        ok = Header(
+            command=Command.prepare_ok, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=prepare_header.op,
+            context=prepare_header.checksum,
+            commit=self.commit_min,
+        )
+        self.bus.send_to_replica(self.primary_index(), Message(ok.finalize()))
+
+    def on_prepare_ok(self, msg: Message) -> None:
+        if not self.is_primary or msg.header.view != self.view:
+            return
+        entry = self.pipeline.get(msg.header.op)
+        if entry is None:
+            return
+        if msg.header.context != entry["message"].header.checksum:
+            return
+        entry["oks"].add(msg.header.replica)
+        self._check_quorum(msg.header.op)
+
+    def _check_quorum(self, op: int) -> None:
+        """Commit in order as quorums complete (reference commit_dispatch)."""
+        while True:
+            entry = self.pipeline.get(self.commit_min + 1)
+            if entry is None or len(entry["oks"]) < self.quorum_replication:
+                return
+            self.commit_max = max(self.commit_max, self.commit_min + 1)
+            self._commit_op(entry["message"])
+            del self.pipeline[self.commit_min]
+
+    def on_commit(self, msg: Message) -> None:
+        if self.status != "normal" or msg.header.view != self.view:
+            if msg.header.view > self.view:
+                self._request_start_view(msg.header.view)
+            return
+        if self.is_primary:
+            return
+        self.last_heartbeat_rx = self.time.monotonic()
+        self.commit_max = max(self.commit_max, msg.header.commit)
+        self._commit_journal(self.commit_max)
+
+    def _commit_journal(self, commit_target: int) -> None:
+        """Execute committed prepares from the journal, in order, as far as
+        we have them (reference: commit_journal :4310). A journaled prepare
+        that contradicts a canonical header (stale op from a deposed
+        primary) must be repaired, never executed."""
+        while self.commit_min < commit_target:
+            op = self.commit_min + 1
+            msg = self.journal.read_prepare(op)
+            want = self.canonical.get(op)
+            if msg is None or (want is not None
+                               and msg.header.checksum != want):
+                self.repair_requested.setdefault(op, 0)
+                return
+            self._commit_op(msg)
+
+    def _commit_op(self, prepare: Message) -> None:
+        h = prepare.header
+        assert h.op == self.commit_min + 1
+        operation = Operation(h.operation)
+        result = self.state_machine.commit(operation, prepare.body, h.timestamp)
+        self.commit_min = h.op
+        if h.client:
+            reply_header = Header(
+                command=Command.reply, cluster=self.cluster,
+                replica=self.replica_id, view=self.view, op=h.op,
+                client=h.client, request=h.request, commit=h.op,
+                context=h.checksum, operation=h.operation,
+                timestamp=h.timestamp,
+            )
+            reply = Message(reply_header.finalize(result), body=result)
+            self.sessions[h.client] = {"request": h.request, "reply": reply}
+            if self.is_primary:
+                self.bus.send_to_client(h.client, reply)
+        if self.commit_min % self.options.checkpoint_interval == 0:
+            self._checkpoint()
+
+    def _checkpoint(self) -> None:
+        """Snapshot + superblock flip (reference commit_checkpoint_data /
+        commit_checkpoint_superblock :4989,5110)."""
+        sb = self.superblock
+        raw = snapshot_codec.encode(self.state_machine.state)
+        assert len(raw) <= self.storage.layout.snapshot_size_max, \
+            "snapshot exceeds slot (raise snapshot_size_max)"
+        slot = 1 - sb.snapshot_slot
+        self.storage.write(
+            "snapshot", slot * self.storage.layout.snapshot_size_max, raw)
+        sb.snapshot_slot = slot
+        sb.snapshot_size = len(raw)
+        sb.snapshot_checksum = checksum(raw, domain=b"snap")
+        sb.op_checkpoint = self.commit_min
+        sb.commit_min = self.commit_min
+        sb.commit_max = self.commit_max
+        sb.view = self.view
+        sb.log_view = self.log_view
+        sb.checkpoint_id = checksum(
+            sb.checkpoint_id.to_bytes(16, "little") + raw[:64], domain=b"ckpt")
+        sb.store(self.storage)
+
+    # ---------------------------------------------------------- view change
+
+    def _start_view_change(self, new_view: int) -> None:
+        assert new_view > self.view
+        self.status = "view_change"
+        self.view = new_view
+        self.pipeline.clear()
+        self._persist_view()
+        votes = self.svc_votes.setdefault(new_view, set())
+        votes.add(self.replica_id)
+        header = Header(
+            command=Command.start_view_change, cluster=self.cluster,
+            replica=self.replica_id, view=new_view)
+        msg = Message(header.finalize())
+        for r in range(self.replica_count):
+            if r != self.replica_id:
+                self.bus.send_to_replica(r, msg)
+        self._check_svc_quorum(new_view)
+
+    def on_start_view_change(self, msg: Message) -> None:
+        v = msg.header.view
+        if v < self.view:
+            return
+        if v > self.view:
+            self._start_view_change(v)
+        self.svc_votes.setdefault(v, set()).add(msg.header.replica)
+        self._check_svc_quorum(v)
+
+    def _check_svc_quorum(self, v: int) -> None:
+        if self.status != "view_change" or v != self.view:
+            return
+        if len(self.svc_votes.get(v, ())) < self.quorum_view_change:
+            return
+        self._send_do_view_change(v)
+
+    def _send_do_view_change(self, v: int) -> None:
+        """Send our log suffix to the new primary (headers above checkpoint)."""
+        body = b"".join(
+            m.header.pack() for m in self._suffix_prepares())
+        header = Header(
+            command=Command.do_view_change, cluster=self.cluster,
+            replica=self.replica_id, view=v, op=self.op,
+            commit=self.commit_min, context=self.log_view)
+        msg = Message(header.finalize(body), body=body)
+        if self.primary_index(v) == self.replica_id:
+            self.on_do_view_change(msg)
+        else:
+            self.bus.send_to_replica(self.primary_index(v), msg)
+
+    def _suffix_prepares(self) -> list[Message]:
+        base = self.superblock.op_checkpoint if self.superblock else 0
+        out = []
+        for op in range(base + 1, self.op + 1):
+            m = self.journal.read_prepare(op)
+            if m is not None:
+                out.append(m)
+        return out
+
+    def on_do_view_change(self, msg: Message) -> None:
+        v = msg.header.view
+        if v < self.view or self.primary_index(v) != self.replica_id:
+            return
+        if v > self.view:
+            self._start_view_change(v)
+        if self.status != "view_change" or v != self.view:
+            return
+        self.dvc_messages.setdefault(v, {})[msg.header.replica] = msg
+        dvcs = self.dvc_messages[v]
+        if self.replica_id not in dvcs:
+            body = b"".join(m.header.pack() for m in self._suffix_prepares())
+            own = Header(
+                command=Command.do_view_change, cluster=self.cluster,
+                replica=self.replica_id, view=v, op=self.op,
+                commit=self.commit_min, context=self.log_view)
+            dvcs[self.replica_id] = Message(own.finalize(body), body=body)
+        if len(dvcs) < self.quorum_view_change:
+            return
+        # Adopt the best log: max (log_view, op) (VSR view-change rule).
+        best = max(dvcs.values(),
+                   key=lambda m: (m.header.context, m.header.op))
+        self._install_log(best)
+        self.log_view = v
+        self.status = "normal"
+        self._persist_view()
+        commit_max = max(m.header.commit for m in dvcs.values())
+        self.commit_max = max(self.commit_max, commit_max)
+        self._broadcast_start_view()
+        self._commit_journal(self.commit_max)
+        # Re-replicate the uncommitted canonical suffix in the new view so
+        # possibly-committed ops regain a quorum (VSR safety: the view-change
+        # quorum intersects every replication quorum).
+        for op in range(self.commit_min + 1, self.op + 1):
+            m = self.journal.read_prepare(op)
+            if m is None:
+                self.repair_requested.setdefault(op, 0)
+            elif self.canonical.get(op, m.header.checksum) == m.header.checksum:
+                self._primary_adopt_canonical(m)
+
+    def _install_log(self, dvc: Message) -> None:
+        """Install the header suffix from the chosen DVC as canonical; fetch
+        bodies we lack via repair."""
+        headers = _unpack_headers(dvc.body)
+        for h in headers:
+            self.canonical[h.op] = h.checksum
+            ours = self.journal.read_prepare(h.op)
+            if ours is None or ours.header.checksum != h.checksum:
+                self.repair_requested.setdefault(h.op, 0)
+        if headers:
+            self.op = max(self.op, max(h.op for h in headers))
+
+    def _broadcast_start_view(self) -> None:
+        body = b"".join(m.header.pack() for m in self._suffix_prepares())
+        header = Header(
+            command=Command.start_view, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=self.op,
+            commit=self.commit_max)
+        msg = Message(header.finalize(body), body=body)
+        for r in range(self.replica_count):
+            if r != self.replica_id:
+                self.bus.send_to_replica(r, msg)
+
+    def on_start_view(self, msg: Message) -> None:
+        h = msg.header
+        if h.view < self.view or h.replica != self.primary_index(h.view):
+            return
+        self.view = h.view
+        self.log_view = h.view
+        self.status = "normal"
+        self.pipeline.clear()
+        self._persist_view()
+        self._install_log(msg)
+        self.commit_max = max(self.commit_max, h.commit)
+        self.last_heartbeat_rx = self.time.monotonic()
+        self._commit_journal(self.commit_max)
+
+    def on_request_start_view(self, msg: Message) -> None:
+        if self.is_primary and msg.header.view <= self.view:
+            self._broadcast_start_view()
+
+    def _request_start_view(self, view: int) -> None:
+        header = Header(
+            command=Command.request_start_view, cluster=self.cluster,
+            replica=self.replica_id, view=view)
+        self.bus.send_to_replica(self.primary_index(view),
+                                 Message(header.finalize()))
+
+    def _persist_view(self) -> None:
+        if self.superblock is None:
+            return
+        self.superblock.view = self.view
+        self.superblock.log_view = self.log_view
+        self.superblock.store(self.storage)
+
+    # -------------------------------------------------------------- repair
+
+    def on_request_prepare(self, msg: Message) -> None:
+        m = self.journal.read_prepare(msg.header.op)
+        if m is not None:
+            self.bus.send_to_replica(msg.header.replica, m)
+
+    def _repair(self, now: int) -> None:
+        if now - self.last_repair_tick < self.options.repair_interval_ns:
+            return
+        self.last_repair_tick = now
+        # Re-derive gaps below commit_max.
+        for op in range(self.commit_min + 1, min(self.commit_max, self.op) + 1):
+            if self.journal.read_prepare(op) is None:
+                self.repair_requested.setdefault(op, 0)
+        for op in [o for o in self.canonical if o <= self.commit_min]:
+            del self.canonical[op]
+        # Primary: resend the oldest unacked prepare (reference
+        # prepare_timeout, replica.zig:3567+ timeout battery).
+        if self.is_primary:
+            entry = self.pipeline.get(self.commit_min + 1)
+            if entry is not None and now - entry.get("sent_at", 0) >= \
+                    self.options.repair_interval_ns:
+                entry["sent_at"] = now
+                for r in range(self.replica_count):
+                    if r != self.replica_id and r not in entry["oks"]:
+                        self.bus.send_to_replica(r, entry["message"])
+        for op, last in list(self.repair_requested.items()):
+            held = self.journal.read_prepare(op)
+            want = self.canonical.get(op)
+            satisfied = held is not None and (
+                want is None or held.header.checksum == want)
+            if op <= self.commit_min or satisfied:
+                del self.repair_requested[op]
+                continue
+            if now - last < self.options.repair_interval_ns:
+                continue
+            self.repair_requested[op] = now
+            header = Header(
+                command=Command.request_prepare, cluster=self.cluster,
+                replica=self.replica_id, view=self.view, op=op)
+            msg = Message(header.finalize())
+            for r in range(self.replica_count):
+                if r != self.replica_id:
+                    self.bus.send_to_replica(r, msg)
+        self._commit_journal(self.commit_max)
+
+    # ---------------------------------------------------------------- time
+
+    def on_ping(self, msg: Message) -> None:
+        pong = Header(
+            command=Command.pong, cluster=self.cluster,
+            replica=self.replica_id, view=self.view,
+            timestamp=self.time.realtime(), context=msg.header.timestamp)
+        self.bus.send_to_replica(msg.header.replica, Message(pong.finalize()))
+
+    def on_pong(self, msg: Message) -> None:
+        pass  # clock sampling (vsr/clock.py) is wired in a later round
+
+    def tick(self) -> None:
+        now = self.time.monotonic()
+        if self.status == "normal" and self.is_primary:
+            if now - self.last_heartbeat_tx >= self.options.heartbeat_interval_ns:
+                self.last_heartbeat_tx = now
+                header = Header(
+                    command=Command.commit, cluster=self.cluster,
+                    replica=self.replica_id, view=self.view,
+                    commit=self.commit_max)
+                msg = Message(header.finalize())
+                for r in range(self.replica_count):
+                    if r != self.replica_id:
+                        self.bus.send_to_replica(r, msg)
+            # Self-issued expiry pulse (reference: replica.zig:4906-4910).
+            if (not self.pipeline
+                    and self.state_machine.pulse_needed(self.prepare_timestamp)):
+                self._primary_prepare(Operation.pulse, b"")
+        elif self.status == "normal":
+            if now - self.last_heartbeat_rx >= self.options.view_change_timeout_ns:
+                self._start_view_change(self.view + 1)
+        elif self.status == "view_change":
+            if now - self.last_heartbeat_rx >= 2 * self.options.view_change_timeout_ns:
+                self.last_heartbeat_rx = now
+                self._start_view_change(self.view + 1)
+        self._repair(now)
+
+
+def _event_count(operation: Operation, body: bytes) -> int:
+    """Number of logical events in a request body (drives timestamp
+    assignment: each event gets a distinct timestamp below the prepare's)."""
+    from .. import multi_batch
+    from ..constants import BATCH_MAX
+    from ..state_machine import OPERATION_SPECS
+
+    if operation == Operation.pulse:
+        # An expiry pulse may emit up to a full batch of expiry events, each
+        # needing a distinct timestamp below the prepare's.
+        return BATCH_MAX
+    spec = OPERATION_SPECS.get(operation)
+    if spec is None or spec.event_size == 0:
+        return 1
+    if operation.is_multi_batch():
+        try:
+            batches = multi_batch.decode(body, spec.event_size)
+        except ValueError:
+            return 1
+        return max(1, sum(len(b) // spec.event_size for b in batches))
+    return max(1, len(body) // spec.event_size)
+
+
+def _unpack_headers(body: bytes) -> list[Header]:
+    out = []
+    for off in range(0, len(body), HEADER_SIZE):
+        h = Header.unpack(body[off:off + HEADER_SIZE])
+        if h.valid_checksum():
+            out.append(h)
+    return out

@@ -1,0 +1,138 @@
+"""Storage: zoned access to a replica's single data file.
+
+reference: src/storage.zig (zone-aware sector IO) + data-file layout
+docs/internals/data_file.md:11-97. Zones here:
+
+  superblock   SUPERBLOCK_COPIES x SUPERBLOCK_COPY_SIZE
+  wal_headers  slot_count x 256
+  wal_prepares slot_count x message_size_max
+  client_replies clients_max x message_size_max
+  snapshot     2 x snapshot_size_max  (A/B checkpoint slots)
+
+Round-1 simplification (vs the reference's io_uring async path): the IO
+interface is synchronous; the deterministic simulator injects faults by
+wrapping MemoryStorage (corrupting reads/writes per its fault plan) and by
+cutting writes short at crash points. The async completion model returns
+with the native C++ storage engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+from .header import HEADER_SIZE
+
+SUPERBLOCK_COPIES = 4
+SUPERBLOCK_COPY_SIZE = 4096
+
+
+@dataclasses.dataclass(frozen=True)
+class StorageLayout:
+    """Sizes that shape the data file (consensus-critical; reference:
+    src/config.zig:153-163)."""
+
+    slot_count: int = 1024
+    message_size_max: int = 1024 * 1024
+    clients_max: int = 64
+    snapshot_size_max: int = 256 * 1024 * 1024
+
+    @property
+    def zone_offsets(self) -> dict:
+        off = {}
+        pos = 0
+        off["superblock"] = pos
+        pos += SUPERBLOCK_COPIES * SUPERBLOCK_COPY_SIZE
+        off["wal_headers"] = pos
+        pos += self.slot_count * HEADER_SIZE
+        off["wal_prepares"] = pos
+        pos += self.slot_count * self.message_size_max
+        off["client_replies"] = pos
+        pos += self.clients_max * self.message_size_max
+        off["snapshot"] = pos
+        pos += 2 * self.snapshot_size_max
+        off["_end"] = pos
+        return off
+
+    @property
+    def size(self) -> int:
+        return self.zone_offsets["_end"]
+
+
+TEST_LAYOUT = StorageLayout(
+    slot_count=32, message_size_max=64 * 1024, clients_max=8,
+    snapshot_size_max=4 * 1024 * 1024)
+
+
+class Storage:
+    """Abstract zoned storage."""
+
+    layout: StorageLayout
+
+    def read(self, zone: str, offset: int, size: int) -> bytes:
+        raise NotImplementedError
+
+    def write(self, zone: str, offset: int, data: bytes) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        pass
+
+    def _check(self, zone: str, offset: int, size: int) -> int:
+        zones = self.layout.zone_offsets
+        base = zones[zone]
+        keys = list(zones)
+        limit = zones[keys[keys.index(zone) + 1]]
+        assert base + offset + size <= limit, (zone, offset, size)
+        return base + offset
+
+
+class MemoryStorage(Storage):
+    """In-memory data file (simulator base; reference testing/storage.zig)."""
+
+    def __init__(self, layout: StorageLayout = TEST_LAYOUT):
+        self.layout = layout
+        self.data = bytearray(layout.size)
+        self.reads = 0
+        self.writes = 0
+
+    def read(self, zone: str, offset: int, size: int) -> bytes:
+        pos = self._check(zone, offset, size)
+        self.reads += 1
+        return bytes(self.data[pos:pos + size])
+
+    def write(self, zone: str, offset: int, data: bytes) -> None:
+        pos = self._check(zone, offset, len(data))
+        self.writes += 1
+        self.data[pos:pos + len(data)] = data
+
+
+class FileStorage(Storage):
+    """Direct file-backed storage (the production path until the C++ engine
+    lands; reference: src/storage.zig read_sectors/write_sectors)."""
+
+    def __init__(self, path: str, layout: StorageLayout = StorageLayout(),
+                 create: bool = False):
+        self.layout = layout
+        self.path = path
+        flags = os.O_RDWR | (os.O_CREAT if create else 0)
+        self.fd = os.open(path, flags, 0o644)
+        if create:
+            os.ftruncate(self.fd, layout.size)
+
+    def read(self, zone: str, offset: int, size: int) -> bytes:
+        pos = self._check(zone, offset, size)
+        data = os.pread(self.fd, size, pos)
+        if len(data) < size:
+            data += b"\x00" * (size - len(data))
+        return data
+
+    def write(self, zone: str, offset: int, data: bytes) -> None:
+        pos = self._check(zone, offset, len(data))
+        os.pwrite(self.fd, data, pos)
+
+    def sync(self) -> None:
+        os.fsync(self.fd)
+
+    def close(self) -> None:
+        os.close(self.fd)
