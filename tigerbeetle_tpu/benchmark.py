@@ -1,0 +1,251 @@
+"""Benchmark harness: workload generation + measurement.
+
+The package-level core of the repo's bench.py driver (reference:
+src/tigerbeetle/benchmark_load.zig — "load accepted ... tx/s"): builds
+Zipfian/uniform workloads as SoA arrays, runs them through the device
+ledger's scan path, and measures accepted transfers / wall time. The five
+configs mirror BASELINE.md.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from .constants import BATCH_MAX, U128_MAX
+from .types import (
+    Account,
+    AccountFlags,
+    Transfer,
+    TransferFlags,
+)
+
+BASELINE_TPS = 1_000_000  # reference design claim, single core
+TARGET_TPS = 10_000_000  # driver target, single v5e chip
+N = BATCH_MAX
+
+
+def _soa(ids, dr, cr, amount, flags=None, pid=None, timeout=None):
+    n = len(ids)
+    z = np.zeros(n, dtype=np.uint64)
+    z32 = np.zeros(n, dtype=np.uint32)
+    return dict(
+        id_hi=z.copy(), id_lo=np.asarray(ids, dtype=np.uint64),
+        dr_hi=z.copy(), dr_lo=np.asarray(dr, dtype=np.uint64),
+        cr_hi=z.copy(), cr_lo=np.asarray(cr, dtype=np.uint64),
+        amt_hi=z.copy(), amt_lo=np.asarray(amount, dtype=np.uint64),
+        pid_hi=z.copy(),
+        pid_lo=z.copy() if pid is None else np.asarray(pid, dtype=np.uint64),
+        ud128_hi=z.copy(), ud128_lo=z.copy(), ud64=z.copy(),
+        ud32=z32.copy(),
+        timeout=z32.copy() if timeout is None else np.asarray(timeout, dtype=np.uint32),
+        ledger=np.ones(n, dtype=np.uint32),
+        code=np.ones(n, dtype=np.uint32),
+        flags=z32.copy() if flags is None else np.asarray(flags, dtype=np.uint32),
+        ts=z.copy(),
+    )
+
+
+def _make_ledger(account_count, a_cap=1 << 15, t_cap=1 << 21):
+    from .ops.ledger import DeviceLedger
+
+    led = DeviceLedger(a_cap=a_cap, t_cap=t_cap)
+    accounts = [Account(id=i, ledger=1, code=1)
+                for i in range(1, account_count + 1)]
+    for lo in range(0, account_count, BATCH_MAX):
+        chunk = accounts[lo:lo + BATCH_MAX]
+        led.create_accounts(chunk, timestamp=lo + len(chunk))
+    assert led.fallbacks == 0
+    return led
+
+
+def _stack(evs):
+    from .ops.ledger import pad_transfer_events
+
+    padded = [pad_transfer_events(e) for e in evs]
+    return {k: np.stack([p[k] for p in padded]) for k in padded[0]}
+
+
+def _run_scan(led, evs, ts0):
+    """Dispatch B batches as one on-device scan; returns (accepted, elapsed)."""
+    from .ops.fast_kernels import create_transfers_scan_jit
+
+    B = len(evs)
+    stacked = _stack(evs)
+    ns = np.full(B, N, dtype=np.int32)
+    tss = (ts0 + np.arange(B, dtype=np.uint64) * np.uint64(N + 10)).astype(np.uint64)
+    t0 = time.perf_counter()
+    state, outs = create_transfers_scan_jit(led.state, stacked, tss, ns)
+    accepted = int(np.asarray(outs["created_count"]).sum())
+    elapsed = time.perf_counter() - t0
+    assert not bool(np.asarray(outs["fallback"]).any()), "unexpected fallback"
+    led.state = state
+    return accepted, elapsed
+
+
+def bench_config1(batches):
+    """2 hot accounts, one ledger."""
+    led = _make_ledger(2)
+    rng = np.random.default_rng(1)
+
+    def mk(b):
+        base = 10**7 + b * N
+        ids = np.arange(base, base + N)
+        dr = np.full(N, 1)
+        cr = np.full(N, 2)
+        return _soa(ids, dr, cr, rng.integers(1, 1000, N))
+
+    _run_scan(led, [mk(b) for b in range(-batches, 0)],
+              np.uint64(10**11))  # warmup at the same B (compile cache)
+    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+
+
+def bench_config2(batches, account_count=10_000):
+    """Uniform random transfers over 10K accounts (fuzz shape)."""
+    led = _make_ledger(account_count)
+    rng = np.random.default_rng(2)
+
+    def mk(b):
+        base = 10**7 + b * N
+        ids = np.arange(base, base + N)
+        dr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+        cr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+        return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
+
+    _run_scan(led, [mk(b) for b in range(-batches, 0)],
+              np.uint64(10**11))  # warmup at the same B (compile cache)
+    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+
+
+def bench_config3(batches, account_count=1000):
+    """Linked chains: all-or-nothing pairs, ~25% of chains failing."""
+    led = _make_ledger(account_count)
+    rng = np.random.default_rng(3)
+    linked = int(TransferFlags.linked)
+
+    def mk(b):
+        base = 10**7 + b * N
+        ids = np.arange(base, base + N)
+        dr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+        cr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+        flags = np.zeros(N, dtype=np.uint32)
+        flags[0::2] = linked  # pairs: even=head, odd=terminator
+        # poison ~25% of chains: terminator debits a missing account
+        bad = rng.random(N // 2) < 0.25
+        dr[1::2][bad] = account_count + 10**6
+        return _soa(ids, dr, cr, rng.integers(1, 1000, N), flags=flags)
+
+    _run_scan(led, [mk(b) for b in range(-batches, 0)],
+              np.uint64(10**11))  # warmup at the same B (compile cache)
+    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+
+
+def bench_config4(batches=2, n=1024, account_count=64):
+    """Two-phase under balance limits: exact fallback path (host sequential
+    kernel). Deliberately small — this is the hard-semantics config."""
+    from .ops.ledger import DeviceLedger
+
+    led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+    limit = int(AccountFlags.debits_must_not_exceed_credits)
+    accounts = [Account(id=i, ledger=1, code=1,
+                        flags=limit if i % 2 == 0 else 0)
+                for i in range(1, account_count + 1)]
+    led.create_accounts(accounts, timestamp=account_count)
+    rng = np.random.default_rng(4)
+    pend = int(TransferFlags.pending)
+    post = int(TransferFlags.post_pending_transfer)
+    void = int(TransferFlags.void_pending_transfer)
+
+    accepted = 0
+    t0 = time.perf_counter()
+    ts = 10**12
+    next_id = 10**7
+    for b in range(batches):
+        pend_ids = list(range(next_id, next_id + n))
+        next_id += n
+        events = [
+            Transfer(id=tid,
+                     debit_account_id=int(rng.integers(1, account_count + 1)),
+                     credit_account_id=int(rng.integers(1, account_count + 1)),
+                     amount=int(rng.integers(1, 100)),
+                     ledger=1, code=1, flags=pend)
+            for tid in pend_ids
+        ]
+        for e in events:
+            if e.debit_account_id == e.credit_account_id:
+                e.credit_account_id = e.debit_account_id % account_count + 1
+        ts += n + 10
+        res = led.create_transfers(events, ts)
+        accepted += sum(1 for r in res if r.status.name == "created")
+        resolves = [
+            Transfer(id=next_id + i, pending_id=pend_ids[i],
+                     amount=U128_MAX if i % 2 == 0 else 0,
+                     flags=post if i % 2 == 0 else void)
+            for i in range(n)
+        ]
+        next_id += n
+        ts += n + 10
+        res = led.create_transfers(resolves, ts)
+        accepted += sum(1 for r in res if r.status.name == "created")
+    return accepted, time.perf_counter() - t0
+
+
+def parity_config5(n_batches=6, batch=256):
+    """Differential check: DeviceLedger vs sequential oracle, mixed workload."""
+    from .oracle import StateMachineOracle
+    from .ops.ledger import DeviceLedger
+
+    led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+    sm = StateMachineOracle()
+    rng = np.random.default_rng(5)
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 101)]
+    for eng in (led, sm):
+        eng.create_accounts(accts, 100)
+    ts = 10**12
+    next_id = 10**6
+    pend = int(TransferFlags.pending)
+    post = int(TransferFlags.post_pending_transfer)
+    for b in range(n_batches):
+        events = []
+        for i in range(batch):
+            roll = rng.random()
+            tid = next_id
+            next_id += 1
+            if roll < 0.7:
+                events.append(Transfer(
+                    id=tid, debit_account_id=int(rng.integers(0, 110)),
+                    credit_account_id=int(rng.integers(1, 110)),
+                    amount=int(rng.integers(0, 1000)), ledger=1,
+                    code=int(rng.integers(0, 2))))
+            elif roll < 0.85:
+                events.append(Transfer(
+                    id=tid, debit_account_id=int(rng.integers(1, 101)),
+                    credit_account_id=1 + int(rng.integers(1, 100)),
+                    amount=int(rng.integers(1, 100)), ledger=1, code=1,
+                    flags=pend))
+            else:
+                events.append(Transfer(
+                    id=tid, pending_id=int(rng.integers(10**6, next_id)),
+                    amount=U128_MAX, flags=post))
+        for e in events:
+            # Post/void events legitimately carry zero account ids (sentinel
+            # = inherit from the pending transfer); only fix regular events.
+            if (e.flags & post) == 0 and e.debit_account_id == e.credit_account_id:
+                e.credit_account_id = e.debit_account_id % 100 + 1
+        ts += batch + 10
+        got = led.create_transfers(events, ts)
+        want = sm.create_transfers(events, ts)
+        if [(r.timestamp, r.status) for r in got] != [
+                (r.timestamp, r.status) for r in want]:
+            return False
+    host = led.to_host()
+    return (host.accounts == sm.accounts and host.transfers == sm.transfers
+            and host.pending_status == sm.pending_status
+            and host.orphaned == sm.orphaned)
+
+

@@ -1,0 +1,209 @@
+"""Process entry point: `python -m tigerbeetle_tpu <command>`.
+
+reference: src/tigerbeetle/main.zig (commands :146-186) + cli.zig. Commands:
+
+  format     --cluster=N --replica=I --replica-count=N <path>
+  start      --addresses=a:p,b:p,... --replica=I [--engine=kernel|oracle] <path>
+  repl       --addresses=... [--cluster=N]
+  benchmark  [--transfer-count=N] [--account-count=N]
+  inspect    <path>
+  version
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def _parse_addresses(text: str) -> list[tuple[str, int]]:
+    out = []
+    for part in text.split(","):
+        host, _, port = part.rpartition(":")
+        out.append((host or "127.0.0.1", int(port)))
+    return out
+
+
+def cmd_format(args) -> int:
+    from .vsr.replica import Replica
+    from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
+
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout, create=True)
+    Replica.format(storage, cluster=args.cluster, replica_id=args.replica,
+                   replica_count=args.replica_count)
+    storage.sync()
+    storage.close()
+    print(f"formatted {args.path}: cluster={args.cluster} "
+          f"replica={args.replica}/{args.replica_count}")
+    return 0
+
+
+class _WallTime:
+    def monotonic(self) -> int:
+        import time
+
+        return time.monotonic_ns()
+
+    def realtime(self) -> int:
+        import time
+
+        return time.time_ns()
+
+
+def cmd_start(args) -> int:
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from .state_machine import StateMachine
+    from .vsr.message_bus import MessageBus
+    from .vsr.replica import Replica
+    from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
+
+    addresses = _parse_addresses(args.addresses)
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout)
+
+    replica_holder: list = []
+
+    def on_message(msg):
+        replica_holder[0].on_message(msg)
+
+    bus = MessageBus(cluster=args.cluster, on_message=on_message,
+                     replica_addresses=addresses, replica_id=args.replica,
+                     listen=True)
+    replica = Replica(
+        cluster=args.cluster, replica_id=args.replica,
+        replica_count=len(addresses), storage=storage, bus=bus,
+        time=_WallTime(),
+        state_machine_factory=lambda: StateMachine(engine=args.engine))
+    replica_holder.append(replica)
+    replica.open()
+    print(f"replica {args.replica} listening on "
+          f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
+          f"(cluster={args.cluster}, engine={args.engine})", flush=True)
+    # The reference main loop: tick + io.run_for_ns
+    # (src/tigerbeetle/main.zig:522-525).
+    try:
+        while True:
+            bus.poll(0.01)
+            replica.tick()
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_repl(args) -> int:
+    from .repl import run_repl
+    from .vsr.client import Client
+
+    client = Client(cluster=args.cluster, client_id=args.client_id,
+                    replica_addresses=_parse_addresses(args.addresses))
+    try:
+        run_repl(client)
+    finally:
+        client.close()
+    return 0
+
+
+def cmd_benchmark(args) -> int:
+    import json
+
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+
+    from .benchmark import bench_config2
+
+    accepted, elapsed = bench_config2(
+        max(1, args.transfer_count // 8190), account_count=args.account_count)
+    print(json.dumps({
+        "load_accepted_tx_per_s": round(accepted / elapsed, 1),
+        "transfers": accepted,
+        "seconds": round(elapsed, 3),
+    }))
+    return 0
+
+
+def cmd_inspect(args) -> int:
+    from .vsr.journal import Journal
+    from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
+    from .vsr.superblock import SuperBlock
+
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout)
+    sb = SuperBlock.load(storage)
+    if sb is None:
+        print("superblock: no quorum (unformatted or corrupt)")
+        return 1
+    print(f"superblock: cluster={sb.cluster} replica={sb.replica_id}/"
+          f"{sb.replica_count} seq={sb.sequence} view={sb.view} "
+          f"checkpoint_op={sb.op_checkpoint} commit_max={sb.commit_max}")
+    print(f"snapshot: slot={sb.snapshot_slot} size={sb.snapshot_size}")
+    journal = Journal(storage)
+    slots = journal.recover()
+    clean = sum(1 for s in slots if s.state.value == "clean")
+    faulty = sum(1 for s in slots if s.state.value == "faulty")
+    print(f"journal: {clean} clean, {faulty} faulty, "
+          f"{len(slots) - clean - faulty} unknown; op_max={journal.op_max()}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    from . import __version__
+
+    print(f"tigerbeetle-tpu {__version__}")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(prog="tigerbeetle_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("format")
+    p.add_argument("--cluster", type=int, default=0)
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--replica-count", type=int, required=True)
+    p.add_argument("--small", action="store_true",
+                   help="small test layout (32-slot WAL)")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_format)
+
+    p = sub.add_parser("start")
+    p.add_argument("--addresses", required=True)
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--cluster", type=int, default=0)
+    p.add_argument("--engine", choices=("kernel", "oracle"), default="kernel")
+    p.add_argument("--platform", default=None,
+                   help="force a JAX platform (e.g. cpu)")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("repl")
+    p.add_argument("--addresses", required=True)
+    p.add_argument("--cluster", type=int, default=0)
+    p.add_argument("--client-id", type=int, default=1)
+    p.set_defaults(fn=cmd_repl)
+
+    p = sub.add_parser("benchmark")
+    p.add_argument("--transfer-count", type=int, default=100_000)
+    p.add_argument("--account-count", type=int, default=10_000)
+    p.add_argument("--platform", default=None)
+    p.set_defaults(fn=cmd_benchmark)
+
+    p = sub.add_parser("inspect")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("version")
+    p.set_defaults(fn=cmd_version)
+
+    args = parser.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

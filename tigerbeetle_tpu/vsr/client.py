@@ -1,0 +1,111 @@
+"""Cluster client: sessions, request/reply, retries.
+
+reference: src/vsr/client.zig (ClientType: register :273, request :326).
+Simplified for round 1: no request hedging, sessions are implicit (created
+on first request), one in-flight request at a time (the reference enforces
+the same per-client serialization).
+"""
+
+from __future__ import annotations
+
+import time as _time
+from typing import Optional
+
+from .. import multi_batch
+from ..state_machine import OPERATION_SPECS
+from ..types import (
+    Account,
+    CreateAccountResult,
+    CreateTransferResult,
+    Operation,
+    Transfer,
+)
+from .header import Command, Header, Message
+from .message_bus import MessageBus
+
+
+class Client:
+    def __init__(self, *, cluster: int, client_id: int,
+                 replica_addresses: list[tuple[str, int]]):
+        self.cluster = cluster
+        self.client_id = client_id
+        self.request_number = 0
+        self._reply: Optional[Message] = None
+        self.bus = MessageBus(
+            cluster=cluster, on_message=self._on_message,
+            replica_addresses=replica_addresses)
+
+    def _on_message(self, msg: Message) -> None:
+        if (msg.header.command == Command.reply
+                and msg.header.request == self.request_number):
+            self._reply = msg
+
+    def request(self, operation: Operation, body: bytes,
+                timeout_s: float = 10.0) -> bytes:
+        """Send one request and block until its reply (resending on
+        timeout; all replicas are addressed, only the primary acts)."""
+        self.request_number += 1
+        header = Header(
+            command=Command.request, cluster=self.cluster,
+            client=self.client_id, request=self.request_number,
+            operation=int(operation))
+        msg = Message(header.finalize(body), body=body)
+        self._reply = None
+        deadline = _time.monotonic() + timeout_s
+        resend_at = 0.0
+        while self._reply is None:
+            now = _time.monotonic()
+            if now >= deadline:
+                raise TimeoutError(f"request {self.request_number} timed out")
+            if now >= resend_at:
+                resend_at = now + 0.5
+                for r in range(len(self.bus.replica_addresses)):
+                    self.bus.send_to_replica(r, msg)
+            self.bus.poll(0.02)
+        return self._reply.body
+
+    # --------------------------------------------------------- conveniences
+
+    def create_accounts(self, accounts: list[Account]) -> list[CreateAccountResult]:
+        body = multi_batch.encode([b"".join(a.pack() for a in accounts)], 128)
+        out = self.request(Operation.create_accounts, body)
+        (payload,) = multi_batch.decode(out, 16)
+        return [CreateAccountResult.unpack(payload[i:i + 16])
+                for i in range(0, len(payload), 16)]
+
+    def create_transfers(self, transfers: list[Transfer]) -> list[CreateTransferResult]:
+        body = multi_batch.encode([b"".join(t.pack() for t in transfers)], 128)
+        out = self.request(Operation.create_transfers, body)
+        (payload,) = multi_batch.decode(out, 16)
+        return [CreateTransferResult.unpack(payload[i:i + 16])
+                for i in range(0, len(payload), 16)]
+
+    def lookup_accounts(self, ids: list[int]) -> list[Account]:
+        body = multi_batch.encode(
+            [b"".join(i.to_bytes(16, "little") for i in ids)], 16)
+        out = self.request(Operation.lookup_accounts, body)
+        (payload,) = multi_batch.decode(out, 128)
+        return [Account.unpack(payload[i:i + 128])
+                for i in range(0, len(payload), 128)]
+
+    def lookup_transfers(self, ids: list[int]) -> list[Transfer]:
+        body = multi_batch.encode(
+            [b"".join(i.to_bytes(16, "little") for i in ids)], 16)
+        out = self.request(Operation.lookup_transfers, body)
+        (payload,) = multi_batch.decode(out, 128)
+        return [Transfer.unpack(payload[i:i + 128])
+                for i in range(0, len(payload), 128)]
+
+    def query(self, operation: Operation, filter_obj) -> bytes:
+        """Single-filter query ops; returns the raw result payload."""
+        spec = OPERATION_SPECS[operation]
+        body = filter_obj.pack()
+        if operation.is_multi_batch():
+            body = multi_batch.encode([body], spec.event_size)
+        out = self.request(operation, body)
+        if operation.is_multi_batch():
+            (out,) = multi_batch.decode(out, spec.result_size)
+        return out
+
+    def close(self) -> None:
+        self.bus.close()

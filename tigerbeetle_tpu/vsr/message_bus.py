@@ -1,0 +1,233 @@
+"""TCP message bus: the production network transport.
+
+reference: src/message_bus.zig (MessageBusType over io_uring sockets) +
+src/message_buffer.zig (checksum-validated framing). This implementation is
+a single-threaded selectors-based event loop — the same run-to-completion
+model as the reference's io_uring loop, portable Python instead of Zig.
+
+Delivery contract is deliberately weak, exactly like the reference
+(docs/ARCHITECTURE.md:610-615): messages may be dropped (send buffers full,
+connection resets), duplicated (reconnects), or reordered across
+connections; VSR tolerates all of it. Frames are validated by header +
+body checksums before delivery; garbage closes the connection.
+
+Peers: each replica listens on its address and dials every other replica;
+inbound connections are identified by the `replica` field of their first
+valid message. Clients connect inbound only and are identified by the
+`client` field of their requests.
+"""
+
+from __future__ import annotations
+
+import errno
+import selectors
+import socket
+from typing import Callable, Optional
+
+from .header import HEADER_SIZE, Command, Header, Message
+
+RECV_CHUNK = 256 * 1024
+SEND_BUFFER_MAX = 64 * 1024 * 1024
+
+
+class _Connection:
+    def __init__(self, sock: socket.socket):
+        self.sock = sock
+        self.rx = bytearray()
+        self.tx = bytearray()
+        self.peer: Optional[tuple] = None  # ("replica", i) | ("client", id)
+
+    def want_write(self) -> bool:
+        return bool(self.tx)
+
+
+class MessageBus:
+    """One event loop endpoint (a replica process or a client process)."""
+
+    def __init__(self, *, cluster: int,
+                 on_message: Callable[[Message], None],
+                 replica_addresses: list[tuple[str, int]],
+                 replica_id: Optional[int] = None,
+                 listen: bool = False):
+        self.cluster = cluster
+        self.on_message = on_message
+        self.replica_addresses = replica_addresses
+        self.replica_id = replica_id
+        self.selector = selectors.DefaultSelector()
+        self.connections: dict[socket.socket, _Connection] = {}
+        self.by_peer: dict[tuple, _Connection] = {}
+        self.listener: Optional[socket.socket] = None
+        if listen:
+            assert replica_id is not None
+            host, port = replica_addresses[replica_id]
+            self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            self.listener.bind((host, port))
+            self.listener.listen(64)
+            self.listener.setblocking(False)
+            self.selector.register(self.listener, selectors.EVENT_READ, None)
+
+    @property
+    def listen_address(self) -> tuple[str, int]:
+        return self.listener.getsockname()
+
+    # ------------------------------------------------------------- sending
+
+    def send_to_replica(self, dst: int, msg: Message) -> None:
+        if dst == self.replica_id:
+            self.on_message(msg)
+            return
+        conn = self.by_peer.get(("replica", dst))
+        if conn is None:
+            conn = self._dial(dst)
+            if conn is None:
+                return  # dropped: weak delivery contract
+        self._enqueue(conn, msg)
+
+    def send_to_client(self, client_id: int, msg: Message) -> None:
+        conn = self.by_peer.get(("client", client_id))
+        if conn is not None:
+            self._enqueue(conn, msg)
+
+    def _enqueue(self, conn: _Connection, msg: Message) -> None:
+        if len(conn.tx) > SEND_BUFFER_MAX:
+            return  # backpressure: drop (peer will retry)
+        conn.tx += msg.pack()
+        self._update_events(conn)
+
+    def _dial(self, dst: int) -> Optional[_Connection]:
+        host, port = self.replica_addresses[dst]
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.setblocking(False)
+        try:
+            sock.connect((host, port))
+        except BlockingIOError:
+            pass
+        except OSError:
+            sock.close()
+            return None
+        conn = _Connection(sock)
+        conn.peer = ("replica", dst)
+        self.connections[sock] = conn
+        self.by_peer[conn.peer] = conn
+        self.selector.register(sock, selectors.EVENT_READ | selectors.EVENT_WRITE,
+                               conn)
+        if self.replica_id is not None:
+            # Identify ourselves so the peer can route prepare_oks back
+            # (reference: peer handshake via header fields, src/vsr.zig:88-94).
+            hello = Header(command=Command.ping, cluster=self.cluster,
+                           replica=self.replica_id)
+            conn.tx += Message(hello.finalize()).pack()
+        return conn
+
+    # ------------------------------------------------------------ the loop
+
+    def poll(self, timeout: float = 0.0) -> None:
+        for key, events in self.selector.select(timeout):
+            if key.fileobj is self.listener:
+                self._accept()
+                continue
+            conn: _Connection = key.data
+            if events & selectors.EVENT_WRITE:
+                self._flush(conn)
+            if events & selectors.EVENT_READ and conn.sock in self.connections:
+                self._drain(conn)
+
+    def _accept(self) -> None:
+        try:
+            sock, _addr = self.listener.accept()
+        except OSError:
+            return
+        sock.setblocking(False)
+        conn = _Connection(sock)
+        self.connections[sock] = conn
+        self.selector.register(sock, selectors.EVENT_READ, conn)
+
+    def _flush(self, conn: _Connection) -> None:
+        try:
+            while conn.tx:
+                sent = conn.sock.send(conn.tx[:RECV_CHUNK])
+                if sent == 0:
+                    break
+                del conn.tx[:sent]
+        except OSError as e:
+            if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK):
+                self._close(conn)
+                return
+        self._update_events(conn)
+
+    def _drain(self, conn: _Connection) -> None:
+        try:
+            chunk = conn.sock.recv(RECV_CHUNK)
+        except OSError as e:
+            if e.errno in (errno.EAGAIN, errno.EWOULDBLOCK):
+                return
+            self._close(conn)
+            return
+        if not chunk:
+            self._close(conn)
+            return
+        conn.rx += chunk
+        while len(conn.rx) >= HEADER_SIZE:
+            try:
+                header = Header.unpack(bytes(conn.rx[:HEADER_SIZE]))
+            except Exception:
+                self._close(conn)
+                return
+            if (not header.valid_checksum()
+                    or header.size < HEADER_SIZE
+                    or header.size > 64 * 1024 * 1024):
+                self._close(conn)  # corrupt stream: force reconnect
+                return
+            if len(conn.rx) < header.size:
+                break
+            raw = bytes(conn.rx[:header.size])
+            del conn.rx[:header.size]
+            msg = Message.unpack(raw)
+            if not msg.valid() or msg.header.cluster != self.cluster:
+                continue
+            self._identify(conn, msg.header)
+            self.on_message(msg)
+
+    def _identify(self, conn: _Connection, header: Header) -> None:
+        if conn.peer is not None:
+            return
+        if header.command == Command.request or header.command in (
+                Command.ping_client, Command.pong_client):
+            peer = ("client", header.client)
+        else:
+            peer = ("replica", header.replica)
+        conn.peer = peer
+        old = self.by_peer.get(peer)
+        self.by_peer[peer] = conn
+        if old is not None and old is not conn:
+            self._close(old, forget_peer=False)
+
+    def _update_events(self, conn: _Connection) -> None:
+        if conn.sock not in self.connections:
+            return
+        events = selectors.EVENT_READ
+        if conn.want_write():
+            events |= selectors.EVENT_WRITE
+        try:
+            self.selector.modify(conn.sock, events, conn)
+        except KeyError:
+            pass
+
+    def _close(self, conn: _Connection, forget_peer: bool = True) -> None:
+        self.connections.pop(conn.sock, None)
+        if forget_peer and conn.peer is not None:
+            if self.by_peer.get(conn.peer) is conn:
+                del self.by_peer[conn.peer]
+        try:
+            self.selector.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        conn.sock.close()
+
+    def close(self) -> None:
+        for conn in list(self.connections.values()):
+            self._close(conn)
+        if self.listener is not None:
+            self.selector.unregister(self.listener)
+            self.listener.close()

@@ -207,6 +207,10 @@ class Replica:
         if not self.is_primary:
             return  # client retries against the right primary
         h = msg.header
+        try:
+            operation = Operation(h.operation)
+        except ValueError:
+            return  # unknown operation: drop, never crash the replica
         session = self.sessions.get(h.client)
         if session is not None:
             if h.request < session["request"]:
@@ -220,9 +224,9 @@ class Replica:
                 return  # already preparing this request
         if len(self.pipeline) >= PIPELINE_PREPARE_QUEUE_MAX:
             return  # backpressure: client will retry
-        if not self.state_machine.input_valid(Operation(h.operation), msg.body):
+        if not self.state_machine.input_valid(operation, msg.body):
             return  # malformed body: never prepare it (client bug)
-        self._primary_prepare(Operation(h.operation), msg.body, client=h.client,
+        self._primary_prepare(operation, msg.body, client=h.client,
                               request=h.request)
 
     def _primary_prepare(self, operation: Operation, body: bytes, *,
