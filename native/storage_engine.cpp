@@ -1,0 +1,280 @@
+// Native storage engine: zoned data-file IO, checksums, WAL recovery scan.
+//
+// The native runtime component of tigerbeetle_tpu (the reference's
+// equivalent layer is src/storage.zig + src/vsr/journal.zig recovery over
+// io_uring). Exposed as a C ABI consumed via ctypes
+// (tigerbeetle_tpu/native.py). Single-threaded, synchronous pread/pwrite —
+// the replica event loop is single-threaded by design.
+//
+// BLAKE2b implemented from RFC 7693 (keyed mode), producing digests
+// identical to Python's hashlib.blake2b(data, digest_size=16, key=...):
+// the wire/disk checksum contract is shared across both runtimes.
+
+#include <cstdint>
+#include <cstring>
+#include <fcntl.h>
+#include <unistd.h>
+
+extern "C" {
+
+// ------------------------------------------------------------- BLAKE2b
+
+static const uint64_t B2B_IV[8] = {
+    0x6a09e667f3bcc908ULL, 0xbb67ae8584caa73bULL,
+    0x3c6ef372fe94f82bULL, 0xa54ff53a5f1d36f1ULL,
+    0x510e527fade682d1ULL, 0x9b05688c2b3e6c1fULL,
+    0x1f83d9abfb41bd6bULL, 0x5be0cd19137e2179ULL};
+
+static const uint8_t B2B_SIGMA[12][16] = {
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3},
+    {11, 8, 12, 0, 5, 2, 15, 13, 10, 14, 3, 6, 7, 1, 9, 4},
+    {7, 9, 3, 1, 13, 12, 11, 14, 2, 6, 5, 10, 4, 0, 15, 8},
+    {9, 0, 5, 7, 2, 4, 10, 15, 14, 1, 11, 12, 6, 8, 3, 13},
+    {2, 12, 6, 10, 0, 11, 8, 3, 4, 13, 7, 5, 15, 14, 1, 9},
+    {12, 5, 1, 15, 14, 13, 4, 10, 0, 7, 6, 3, 9, 2, 8, 11},
+    {13, 11, 7, 14, 12, 1, 3, 9, 5, 0, 15, 4, 8, 6, 2, 10},
+    {6, 15, 14, 9, 11, 3, 0, 8, 12, 2, 13, 7, 1, 4, 10, 5},
+    {10, 2, 8, 4, 7, 6, 1, 5, 15, 11, 9, 14, 3, 12, 13, 0},
+    {0, 1, 2, 3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14, 15},
+    {14, 10, 4, 8, 9, 15, 13, 6, 1, 12, 0, 2, 11, 7, 5, 3}};
+
+static inline uint64_t rotr64(uint64_t x, int n) {
+  return (x >> n) | (x << (64 - n));
+}
+
+struct B2BState {
+  uint64_t h[8];
+  uint64_t t[2];
+  uint8_t buf[128];
+  size_t buflen;
+  size_t outlen;
+};
+
+static void b2b_compress(B2BState *S, const uint8_t *block, int last) {
+  uint64_t v[16], m[16];
+  for (int i = 0; i < 8; i++) v[i] = S->h[i];
+  for (int i = 0; i < 8; i++) v[i + 8] = B2B_IV[i];
+  v[12] ^= S->t[0];
+  v[13] ^= S->t[1];
+  if (last) v[14] = ~v[14];
+  for (int i = 0; i < 16; i++) memcpy(&m[i], block + 8 * i, 8);
+
+#define B2B_G(a, b, c, d, x, y)                                               \
+  v[a] = v[a] + v[b] + (x);                                                   \
+  v[d] = rotr64(v[d] ^ v[a], 32);                                             \
+  v[c] = v[c] + v[d];                                                         \
+  v[b] = rotr64(v[b] ^ v[c], 24);                                             \
+  v[a] = v[a] + v[b] + (y);                                                   \
+  v[d] = rotr64(v[d] ^ v[a], 16);                                             \
+  v[c] = v[c] + v[d];                                                         \
+  v[b] = rotr64(v[b] ^ v[c], 63);
+
+  for (int r = 0; r < 12; r++) {
+    const uint8_t *s = B2B_SIGMA[r];
+    B2B_G(0, 4, 8, 12, m[s[0]], m[s[1]]);
+    B2B_G(1, 5, 9, 13, m[s[2]], m[s[3]]);
+    B2B_G(2, 6, 10, 14, m[s[4]], m[s[5]]);
+    B2B_G(3, 7, 11, 15, m[s[6]], m[s[7]]);
+    B2B_G(0, 5, 10, 15, m[s[8]], m[s[9]]);
+    B2B_G(1, 6, 11, 12, m[s[10]], m[s[11]]);
+    B2B_G(2, 7, 8, 13, m[s[12]], m[s[13]]);
+    B2B_G(3, 4, 9, 14, m[s[14]], m[s[15]]);
+  }
+#undef B2B_G
+  for (int i = 0; i < 8; i++) S->h[i] ^= v[i] ^ v[i + 8];
+}
+
+static void b2b_init(B2BState *S, size_t outlen, const uint8_t *key,
+                     size_t keylen) {
+  memset(S, 0, sizeof(*S));
+  S->outlen = outlen;
+  for (int i = 0; i < 8; i++) S->h[i] = B2B_IV[i];
+  // Parameter block word 0: digest_length | key_length<<8 | fanout<<16
+  // | depth<<24 (sequential mode: fanout=1, depth=1).
+  S->h[0] ^= (uint64_t)outlen | ((uint64_t)keylen << 8) | (1ULL << 16) |
+             (1ULL << 24);
+  if (keylen > 0) {
+    // Keyed mode: the zero-padded key is the first 128-byte block.
+    memcpy(S->buf, key, keylen);
+    S->buflen = 128;
+  }
+}
+
+static void b2b_update(B2BState *S, const uint8_t *in, size_t inlen) {
+  while (inlen > 0) {
+    if (S->buflen == 128) {
+      // Buffer full and more input follows: not the final block.
+      S->t[0] += 128;
+      if (S->t[0] < 128) S->t[1]++;
+      b2b_compress(S, S->buf, 0);
+      S->buflen = 0;
+    }
+    size_t take = 128 - S->buflen;
+    if (take > inlen) take = inlen;
+    memcpy(S->buf + S->buflen, in, take);
+    S->buflen += take;
+    in += take;
+    inlen -= take;
+  }
+}
+
+static void b2b_final(B2BState *S, uint8_t *out) {
+  S->t[0] += S->buflen;
+  if (S->t[0] < S->buflen) S->t[1]++;
+  memset(S->buf + S->buflen, 0, 128 - S->buflen);
+  b2b_compress(S, S->buf, 1);
+  for (size_t i = 0; i < S->outlen; i++)
+    out[i] = (uint8_t)(S->h[i >> 3] >> (8 * (i & 7)));
+}
+
+void tbs_checksum(const uint8_t *data, uint64_t len, const uint8_t *key,
+                  uint64_t key_len, uint8_t *out16) {
+  B2BState S;
+  b2b_init(&S, 16, key, (size_t)key_len);
+  b2b_update(&S, data, (size_t)len);
+  b2b_final(&S, out16);
+}
+
+// --------------------------------------------------------------- file io
+
+int tbs_open(const char *path, uint64_t size, int create) {
+  int flags = O_RDWR | (create ? O_CREAT : 0);
+  int fd = open(path, flags, 0644);
+  if (fd < 0) return -1;
+  if (create && ftruncate(fd, (off_t)size) != 0) {
+    close(fd);
+    return -1;
+  }
+  return fd;
+}
+
+int tbs_close(int fd) { return close(fd); }
+
+int64_t tbs_read(int fd, uint64_t off, uint8_t *buf, uint64_t len) {
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = pread(fd, buf + done, len - done, (off_t)(off + done));
+    if (n < 0) return -1;
+    if (n == 0) {
+      memset(buf + done, 0, len - done);
+      return (int64_t)len;
+    }
+    done += (uint64_t)n;
+  }
+  return (int64_t)done;
+}
+
+int64_t tbs_write(int fd, uint64_t off, const uint8_t *buf, uint64_t len) {
+  uint64_t done = 0;
+  while (done < len) {
+    ssize_t n = pwrite(fd, buf + done, len - done, (off_t)(off + done));
+    if (n < 0) return -1;
+    done += (uint64_t)n;
+  }
+  return (int64_t)done;
+}
+
+int tbs_sync(int fd) { return fsync(fd); }
+
+// ------------------------------------------------------------- WAL scan
+
+// Header layout offsets (tigerbeetle_tpu/vsr/header.py).
+static const uint64_t HDR_SIZE = 256;
+static const uint64_t OFF_CSUM_BODY = 16;
+static const uint64_t OFF_SIZE = 88;
+static const uint64_t OFF_OP = 104;
+static const uint64_t OFF_COMMAND = 138;
+static const uint8_t CMD_PREPARE = 6;
+
+static uint64_t rd_u64(const uint8_t *p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return v;
+}
+static uint32_t rd_u32(const uint8_t *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return v;
+}
+
+static int header_valid(const uint8_t *hdr, const uint8_t *hdr_key,
+                        uint64_t hdr_key_len) {
+  uint8_t digest[16];
+  tbs_checksum(hdr + 16, HDR_SIZE - 16, hdr_key, hdr_key_len, digest);
+  return memcmp(digest, hdr, 16) == 0 && hdr[OFF_COMMAND] == CMD_PREPARE;
+}
+
+// Scan the WAL rings and classify every slot.
+// states_out[i]: 0 = clean, 1 = faulty (header known), 2 = unknown.
+// headers_out: slot_count * 256 bytes (the adopted header for clean/faulty).
+// scratch must hold prepare_size_max bytes.
+int tbs_wal_scan(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
+                 uint32_t slot_count, uint64_t prepare_size_max,
+                 const uint8_t *hdr_key, uint64_t hdr_key_len,
+                 const uint8_t *body_key, uint64_t body_key_len,
+                 uint8_t *headers_out, uint8_t *states_out,
+                 uint8_t *scratch) {
+  for (uint32_t slot = 0; slot < slot_count; slot++) {
+    uint8_t ring_hdr[256];
+    if (tbs_read(fd, hdr_zone_off + (uint64_t)slot * HDR_SIZE, ring_hdr,
+                 HDR_SIZE) < 0)
+      return -1;
+    int ring_ok = header_valid(ring_hdr, hdr_key, hdr_key_len);
+
+    uint64_t prep_off = prep_zone_off + (uint64_t)slot * prepare_size_max;
+    if (tbs_read(fd, prep_off, scratch, HDR_SIZE) < 0) return -1;
+    int prep_hdr_ok = header_valid(scratch, hdr_key, hdr_key_len);
+    int prep_ok = 0;
+    if (prep_hdr_ok) {
+      uint32_t size = rd_u32(scratch + OFF_SIZE);
+      if (size >= HDR_SIZE && size <= prepare_size_max + HDR_SIZE &&
+          size <= prepare_size_max) {
+        if (tbs_read(fd, prep_off + HDR_SIZE, scratch + HDR_SIZE,
+                     size - HDR_SIZE) < 0)
+          return -1;
+        uint8_t digest[16];
+        tbs_checksum(scratch + HDR_SIZE, size - HDR_SIZE, body_key,
+                     body_key_len, digest);
+        prep_ok = memcmp(digest, scratch + OFF_CSUM_BODY, 16) == 0;
+      }
+    }
+
+    uint8_t *out_hdr = headers_out + (uint64_t)slot * HDR_SIZE;
+    if (ring_ok && prep_ok && memcmp(scratch, ring_hdr, 16) == 0) {
+      states_out[slot] = 0;
+      memcpy(out_hdr, ring_hdr, HDR_SIZE);
+    } else if (prep_ok && ring_ok &&
+               rd_u64(scratch + OFF_OP) > rd_u64(ring_hdr + OFF_OP)) {
+      states_out[slot] = 0;
+      memcpy(out_hdr, scratch, HDR_SIZE);
+    } else if (prep_ok && !ring_ok) {
+      states_out[slot] = 0;
+      memcpy(out_hdr, scratch, HDR_SIZE);
+    } else if (ring_ok) {
+      states_out[slot] = 1;
+      memcpy(out_hdr, ring_hdr, HDR_SIZE);
+    } else {
+      states_out[slot] = 2;
+      memset(out_hdr, 0, HDR_SIZE);
+    }
+  }
+  return 0;
+}
+
+// Append one prepare: body first, then the redundant header (write
+// ordering is the torn-write defense; see vsr/journal.py).
+int tbs_wal_append(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
+                   uint32_t slot, uint64_t prepare_size_max,
+                   const uint8_t *msg, uint64_t msg_len) {
+  if (msg_len < HDR_SIZE || msg_len > prepare_size_max) return -1;
+  if (tbs_write(fd, prep_zone_off + (uint64_t)slot * prepare_size_max, msg,
+                msg_len) < 0)
+    return -1;
+  if (tbs_write(fd, hdr_zone_off + (uint64_t)slot * HDR_SIZE, msg,
+                HDR_SIZE) < 0)
+    return -1;
+  return 0;
+}
+
+}  // extern "C"

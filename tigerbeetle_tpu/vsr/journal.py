@@ -84,7 +84,11 @@ class Journal:
     def recover(self) -> list[Slot]:
         """Scan both rings, classify each slot, and load the in-memory header
         ring (reference: journal recovery in src/vsr/journal.zig; decision
-        table in docs/internals/vsr.md:188-217)."""
+        table in docs/internals/vsr.md:188-217). Runs on the native engine
+        when the storage is native-backed."""
+        native_file = getattr(self.storage, "native", None)
+        if native_file is not None:
+            return self._recover_native(native_file)
         slots: list[Slot] = []
         for slot in range(self.slot_count):
             hdr_raw = self.storage.read(
@@ -124,6 +128,35 @@ class Journal:
                 # Header torn, prepare intact.
                 slots.append(Slot(SlotState.clean, prep_header))
                 self.headers[slot] = prep_header
+            else:
+                slots.append(Slot(SlotState.unknown))
+                self.faulty.add(slot)
+        return slots
+
+    def _recover_native(self, native_file) -> list[Slot]:
+        """Native scan: classification logic is mirrored in C++
+        (native/storage_engine.cpp tbs_wal_scan); differential-tested
+        against the Python path in tests/test_native.py."""
+        from ..vsr.checksum import _SEED
+
+        zones = self.storage.layout.zone_offsets
+        states, headers_raw = native_file.wal_scan(
+            zones["wal_headers"], zones["wal_prepares"],
+            self.slot_count, self.prepare_size_max,
+            _SEED + b"hdr", _SEED + b"body")
+        slots: list[Slot] = []
+        for slot in range(self.slot_count):
+            state = states[slot]
+            raw = headers_raw[slot * HEADER_SIZE:(slot + 1) * HEADER_SIZE]
+            if state == 0:
+                header = Header.unpack(raw)
+                slots.append(Slot(SlotState.clean, header))
+                self.headers[slot] = header
+            elif state == 1:
+                header = Header.unpack(raw)
+                slots.append(Slot(SlotState.faulty, header))
+                self.headers[slot] = header
+                self.faulty.add(slot)
             else:
                 slots.append(Slot(SlotState.unknown))
                 self.faulty.add(slot)

@@ -108,13 +108,21 @@ class MemoryStorage(Storage):
 
 
 class FileStorage(Storage):
-    """Direct file-backed storage (the production path until the C++ engine
-    lands; reference: src/storage.zig read_sectors/write_sectors)."""
+    """File-backed storage, served by the native C++ engine when available
+    (native/storage_engine.cpp via ctypes; reference: src/storage.zig
+    read_sectors/write_sectors). Falls back to os.pread/pwrite."""
 
     def __init__(self, path: str, layout: StorageLayout = StorageLayout(),
                  create: bool = False):
+        from .. import native as native_mod
+
         self.layout = layout
         self.path = path
+        self.native = None
+        if native_mod.available():
+            self.native = native_mod.NativeFile(path, layout.size, create)
+            self.fd = -1
+            return
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         self.fd = os.open(path, flags, 0o644)
         if create:
@@ -122,6 +130,8 @@ class FileStorage(Storage):
 
     def read(self, zone: str, offset: int, size: int) -> bytes:
         pos = self._check(zone, offset, size)
+        if self.native is not None:
+            return self.native.read(pos, size)
         data = os.pread(self.fd, size, pos)
         if len(data) < size:
             data += b"\x00" * (size - len(data))
@@ -129,10 +139,19 @@ class FileStorage(Storage):
 
     def write(self, zone: str, offset: int, data: bytes) -> None:
         pos = self._check(zone, offset, len(data))
+        if self.native is not None:
+            self.native.write(pos, data)
+            return
         os.pwrite(self.fd, data, pos)
 
     def sync(self) -> None:
+        if self.native is not None:
+            self.native.sync()
+            return
         os.fsync(self.fd)
 
     def close(self) -> None:
+        if self.native is not None:
+            self.native.close()
+            return
         os.close(self.fd)
