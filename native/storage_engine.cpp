@@ -228,8 +228,9 @@ int tbs_wal_scan(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
     int prep_ok = 0;
     if (prep_hdr_ok) {
       uint32_t size = rd_u32(scratch + OFF_SIZE);
-      if (size >= HDR_SIZE && size <= prepare_size_max + HDR_SIZE &&
-          size <= prepare_size_max) {
+      // Protocol bound: header + body <= message_size_max == slot stride
+      // (mirrors vsr/journal.py append/recover).
+      if (size >= HDR_SIZE && size <= prepare_size_max) {
         if (tbs_read(fd, prep_off + HDR_SIZE, scratch + HDR_SIZE,
                      size - HDR_SIZE) < 0)
           return -1;

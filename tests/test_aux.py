@@ -1,0 +1,211 @@
+"""Aux subsystem tests: EWAH, Marzullo clock, tracer/statsd, AOF, CDC,
+multiversion, clock sampling in the cluster."""
+
+import json
+import random
+
+import pytest
+
+from tigerbeetle_tpu import ewah
+from tigerbeetle_tpu.aof import AOF, recover as aof_recover
+from tigerbeetle_tpu.cdc import CDCRunner, CallbackSink, JsonlSink
+from tigerbeetle_tpu.multiversion import RELEASE, ReleaseTracker
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.trace import NullTracer, StatsD, Tracer
+from tigerbeetle_tpu.types import Account, ChangeEventsFilter, Operation, Transfer
+from tigerbeetle_tpu.vsr.clock import Clock, Interval, marzullo
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+
+class TestEwah:
+    def test_roundtrip_random(self):
+        rng = random.Random(7)
+        for _ in range(20):
+            words = []
+            for _ in range(rng.randrange(0, 50)):
+                roll = rng.random()
+                if roll < 0.4:
+                    words.extend([0] * rng.randrange(1, 20))
+                elif roll < 0.6:
+                    words.extend([(1 << 64) - 1] * rng.randrange(1, 20))
+                else:
+                    words.append(rng.getrandbits(64) | 1)
+            assert ewah.decode(ewah.encode(words)) == words
+
+    def test_compression_and_bitset(self):
+        words = [0] * 1000 + [0xDEADBEEF] + [(1 << 64) - 1] * 1000
+        blob = ewah.encode(words)
+        assert len(blob) < len(words) * 8 // 100  # >100x on runs
+        bits = [i % 7 == 0 for i in range(1000)]
+        assert ewah.decode_bitset(ewah.encode_bitset(bits)) == bits
+
+
+class TestMarzullo:
+    def test_overlap(self):
+        best = marzullo([Interval(0, 10), Interval(5, 15), Interval(8, 12),
+                         Interval(100, 110)])
+        assert best.lo == 8 and best.hi == 10
+
+    def test_disjoint_majority(self):
+        best = marzullo([Interval(0, 1), Interval(0, 2), Interval(10, 11)])
+        assert best.lo == 0 and best.hi == 1
+
+    def test_clock_learn(self):
+        class T:
+            def realtime(self):
+                return 1000
+
+            def monotonic(self):
+                return 1000
+
+        clock = Clock(0, 3, T())
+        assert clock.offset() is None  # no quorum yet
+        clock.learn(1, 900, 1040, 1000)  # rtt 100 -> offset 90 +- 50
+        iv = clock.offset()
+        assert iv is not None
+        assert iv.lo <= 90 <= iv.hi or iv.hi <= 90  # overlapping with own 0?
+        assert clock.realtime_synchronized() is not None
+
+
+class TestTracer:
+    def test_spans_and_chrome_dump(self, tmp_path):
+        tracer = Tracer()
+        with tracer.span("commit", op=1):
+            pass
+        tracer.count("commits")
+        tracer.count("commits", 2)
+        tracer.gauge("pipeline_depth", 3)
+        assert tracer.counters["commits"] == 3
+        assert tracer.gauges["pipeline_depth"] == 3
+        path = tmp_path / "trace.json"
+        tracer.dump_chrome_trace(str(path))
+        doc = json.loads(path.read_text())
+        assert doc["traceEvents"][0]["name"] == "commit"
+        assert doc["traceEvents"][0]["ph"] == "X"
+
+    def test_statsd_datagram_format(self):
+        captured = []
+
+        class FakeSock:
+            def sendto(self, data, addr):
+                captured.append(data.decode())
+
+            def setblocking(self, flag):
+                pass
+
+            def close(self):
+                pass
+
+        statsd = StatsD()
+        statsd.sock = FakeSock()
+        statsd.count("commits", 2, replica=1)
+        statsd.timing("commit", 1.5)
+        assert captured[0] == "tb_tpu.commits:2|c|#replica:1"
+        assert captured[1] == "tb_tpu.commit:1.5|ms"
+
+    def test_null_tracer_is_silent(self):
+        tracer = NullTracer()
+        with tracer.span("anything"):
+            pass
+        tracer.count("x")
+
+
+def _prepare(op, operation, body, ts):
+    header = Header(command=Command.prepare, cluster=1, op=op,
+                    operation=int(operation), timestamp=ts)
+    return Message(header.finalize(body), body=body)
+
+
+class TestAOF:
+    def test_append_iterate_recover(self, tmp_path):
+        from tigerbeetle_tpu import multi_batch
+
+        path = str(tmp_path / "a.aof")
+        aof = AOF(path)
+        sm = StateMachine()
+        ts = 10**13
+        body1 = multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack() for i in (1, 2))],
+            128)
+        sm.commit(Operation.create_accounts, body1, ts)
+        aof.append(_prepare(1, Operation.create_accounts, body1, ts))
+        body2 = multi_batch.encode(
+            [Transfer(id=9, debit_account_id=1, credit_account_id=2,
+                      amount=5, ledger=1, code=1).pack()], 128)
+        sm.commit(Operation.create_transfers, body2, ts + 100)
+        aof.append(_prepare(2, Operation.create_transfers, body2, ts + 100))
+        aof.close()
+
+        msgs = list(AOF.iterate(path))
+        assert [m.header.op for m in msgs] == [1, 2]
+
+        recovered = StateMachine()
+        applied = aof_recover(path, recovered)
+        assert applied == 2
+        assert recovered.state.accounts == sm.state.accounts
+        assert recovered.state.transfers == sm.state.transfers
+
+    def test_torn_tail_stops_iteration(self, tmp_path):
+        path = str(tmp_path / "torn.aof")
+        aof = AOF(path)
+        body = b""
+        aof.append(_prepare(1, Operation.pulse, b"", 10**13))
+        aof.close()
+        with open(path, "ab") as f:
+            f.write(b"TBTPUAOF\xff\xff")  # torn frame
+        assert len(list(AOF.iterate(path))) == 1
+
+
+class TestCDC:
+    def test_runner_watermark(self, tmp_path):
+        from tigerbeetle_tpu import multi_batch
+
+        sm = StateMachine()
+        ts = 10**13
+        sm.create_accounts([Account(id=1, ledger=1, code=1),
+                            Account(id=2, ledger=1, code=1)], ts)
+        sm.create_transfers(
+            [Transfer(id=i, debit_account_id=1, credit_account_id=2,
+                      amount=i, ledger=1, code=1) for i in (1, 2, 3)],
+            ts + 100)
+        seen = []
+        runner = CDCRunner(sm, CallbackSink(seen.append), batch_limit=2)
+        assert runner.run_until_idle() == 3
+        assert [e.transfer_id for e in seen] == [1, 2, 3]
+        # New events after the watermark only.
+        sm.create_transfers(
+            [Transfer(id=4, debit_account_id=2, credit_account_id=1,
+                      amount=9, ledger=1, code=1)], ts + 200)
+        assert runner.poll() == 1
+        assert seen[-1].transfer_id == 4
+
+        jsonl = tmp_path / "events.jsonl"
+        sink = JsonlSink(str(jsonl))
+        runner2 = CDCRunner(sm, sink)
+        assert runner2.run_until_idle() == 4
+        sink.close()
+        lines = [json.loads(l) for l in jsonl.read_text().splitlines()]
+        assert len(lines) == 4 and lines[0]["transfer_id"] == 1
+        assert lines[0]["type"] == "single_phase"
+
+
+class TestMultiversion:
+    def test_release_gating(self):
+        tracker = ReleaseTracker()
+        tracker.observe(1, RELEASE)
+        tracker.observe(2, RELEASE + 1)
+        assert tracker.cluster_min == RELEASE
+        assert tracker.compatible(RELEASE)
+        assert not tracker.compatible(RELEASE + 1)
+
+
+def test_cluster_clock_and_release_sampling():
+    """Pings flow in the simulator: clocks learn offsets, releases spread."""
+    from tigerbeetle_tpu.testing.cluster import Cluster
+
+    cluster = Cluster(seed=5, replica_count=3)
+    cluster.run(200)
+    for r in cluster.replicas:
+        assert r.releases.peers, "release observations missing"
+        assert r.clock.samples, "clock samples missing"
+        assert r.clock.realtime_synchronized() is not None

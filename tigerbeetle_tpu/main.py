@@ -73,10 +73,27 @@ def cmd_start(args) -> int:
     bus = MessageBus(cluster=args.cluster, on_message=on_message,
                      replica_addresses=addresses, replica_id=args.replica,
                      listen=True)
+    tracer = None
+    if args.trace or args.statsd:
+        from .trace import StatsD, Tracer
+
+        statsd = None
+        if args.statsd:
+            host, sep, port = args.statsd.rpartition(":")
+            if not sep or not port.isdigit():
+                print(f"error: --statsd expects host:port, got {args.statsd!r}")
+                return 2
+            statsd = StatsD(host or "127.0.0.1", int(port))
+        tracer = Tracer(statsd=statsd)
+    aof = None
+    if args.aof:
+        from .aof import AOF
+
+        aof = AOF(args.aof)
     replica = Replica(
         cluster=args.cluster, replica_id=args.replica,
         replica_count=len(addresses), storage=storage, bus=bus,
-        time=_WallTime(),
+        time=_WallTime(), tracer=tracer, aof=aof,
         state_machine_factory=lambda: StateMachine(engine=args.engine))
     replica_holder.append(replica)
     replica.open()
@@ -90,6 +107,8 @@ def cmd_start(args) -> int:
             bus.poll(0.01)
             replica.tick()
     except KeyboardInterrupt:
+        if tracer is not None and args.trace:
+            tracer.dump_chrome_trace(args.trace)
         return 0
 
 
@@ -123,6 +142,35 @@ def cmd_benchmark(args) -> int:
         "transfers": accepted,
         "seconds": round(elapsed, 3),
     }))
+    return 0
+
+
+def cmd_recover(args) -> int:
+    """Rebuild a fresh data file from an append-only file (reference:
+    `tigerbeetle recover` replaying src/aof.zig frames)."""
+    from .aof import recover
+    from .state_machine import StateMachine
+    from .vsr import snapshot as snapshot_codec
+    from .vsr.checksum import checksum
+    from .vsr.replica import Replica
+    from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
+    from .vsr.superblock import SuperBlock
+
+    sm = StateMachine(engine="oracle")
+    applied = recover(args.aof, sm)
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout, create=True)
+    Replica.format(storage, cluster=args.cluster, replica_id=args.replica,
+                   replica_count=args.replica_count)
+    raw = snapshot_codec.encode(sm.state)
+    storage.write("snapshot", 0, raw)
+    sb = SuperBlock.load(storage)
+    sb.snapshot_size = len(raw)
+    sb.snapshot_checksum = checksum(raw, domain=b"snap")
+    sb.store(storage)
+    storage.sync()
+    storage.close()
+    print(f"recovered {applied} ops from {args.aof} into {args.path}")
     return 0
 
 
@@ -178,8 +226,23 @@ def main(argv=None) -> int:
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu)")
     p.add_argument("--small", action="store_true")
+    p.add_argument("--trace", default=None,
+                   help="dump a Chrome trace JSON here on shutdown")
+    p.add_argument("--statsd", default=None,
+                   help="emit DogStatsD metrics to host:port")
+    p.add_argument("--aof", default=None,
+                   help="append committed prepares to this AOF path")
     p.add_argument("path")
     p.set_defaults(fn=cmd_start)
+
+    p = sub.add_parser("recover")
+    p.add_argument("--cluster", type=int, default=0)
+    p.add_argument("--replica", type=int, required=True)
+    p.add_argument("--replica-count", type=int, required=True)
+    p.add_argument("--small", action="store_true")
+    p.add_argument("aof")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_recover)
 
     p = sub.add_parser("repl")
     p.add_argument("--addresses", required=True)

@@ -1,0 +1,44 @@
+"""Release/upgrade management.
+
+reference: src/multiversion.zig — the reference packs multiple release
+binaries into one executable and re-execs into the version matching the
+cluster's checkpoint. A Python deployment upgrades differently (the
+interpreter reloads code), so this module keeps the protocol-visible parts:
+
+- a monotonically increasing release number stamped into every message
+  header (`release` field) and checkpoint;
+- compatibility gating: a replica refuses to run a data file checkpointed
+  by a NEWER release (it must be upgraded first), and records the release
+  floor peers advertise so operators can see when a rolling upgrade is
+  complete.
+
+The in-binary multi-release packing itself is deliberately out of scope —
+its job (atomic coordinated upgrades) is served by release gating plus
+process restarts in this runtime.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+RELEASE = 1  # bump on every protocol-visible change
+
+
+@dataclasses.dataclass
+class ReleaseTracker:
+    """Per-replica view of the cluster's release spread."""
+
+    own: int = RELEASE
+    peers: dict = dataclasses.field(default_factory=dict)
+
+    def observe(self, replica: int, release: int) -> None:
+        self.peers[replica] = release
+
+    @property
+    def cluster_min(self) -> int:
+        return min([self.own, *self.peers.values()])
+
+    def compatible(self, checkpoint_release: int) -> bool:
+        """A data file written by a newer release cannot be opened by an
+        older binary (reference: multiversion re-exec decision)."""
+        return checkpoint_release <= self.own

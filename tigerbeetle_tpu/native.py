@@ -104,7 +104,8 @@ class NativeFile:
             raise OSError("tbs_write failed")
 
     def sync(self) -> None:
-        self.lib.tbs_sync(self.fd)
+        if self.lib.tbs_sync(self.fd) != 0:
+            raise OSError("tbs_sync (fsync) failed")
 
     def close(self) -> None:
         if self.fd >= 0:

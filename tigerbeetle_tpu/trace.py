@@ -1,0 +1,133 @@
+"""Tracing and metrics: spans, Chrome-trace export, StatsD emission.
+
+reference: src/trace.zig (span start/stop compiled into the hot path,
+Chrome/Perfetto JSON via --trace), src/trace/statsd.zig (StatsD/DogStatsD
+metric emission), src/trace/event.zig (event catalog). The tracer is
+injected into the replica at construction; the default NullTracer keeps
+the hot path free of overhead.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import time as _time
+from typing import Optional
+
+
+class NullTracer:
+    """No-op tracer (production default unless --trace is set)."""
+
+    def span(self, name: str, **tags):
+        return _NULL_SPAN
+
+    def count(self, metric: str, value: int = 1, **tags) -> None:
+        pass
+
+    def gauge(self, metric: str, value: float, **tags) -> None:
+        pass
+
+    def dump_chrome_trace(self, path: str) -> None:
+        pass
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer(NullTracer):
+    """Recording tracer: bounded ring of completed spans + counters."""
+
+    def __init__(self, capacity: int = 65536, statsd: "Optional[StatsD]" = None):
+        self.capacity = capacity
+        self.events: list[dict] = []
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self.statsd = statsd
+
+    def span(self, name: str, **tags):
+        return _Span(self, name, tags)
+
+    def count(self, metric: str, value: int = 1, **tags) -> None:
+        self.counters[metric] = self.counters.get(metric, 0) + value
+        if self.statsd is not None:
+            self.statsd.count(metric, value, **tags)
+
+    def gauge(self, metric: str, value: float, **tags) -> None:
+        self.gauges[metric] = value
+        if self.statsd is not None:
+            self.statsd.gauge(metric, value, **tags)
+
+    def _record(self, name: str, start_us: float, dur_us: float,
+                tags: dict) -> None:
+        if len(self.events) >= self.capacity:
+            del self.events[: self.capacity // 2]
+        self.events.append({
+            "name": name, "ph": "X", "ts": start_us, "dur": dur_us,
+            "pid": 0, "tid": 0, "args": tags,
+        })
+        if self.statsd is not None:
+            self.statsd.timing(name, dur_us / 1000.0, **tags)
+
+    def dump_chrome_trace(self, path: str) -> None:
+        """Chrome/Perfetto-loadable trace (reference: --trace=file)."""
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.events}, f)
+
+
+class _Span:
+    __slots__ = ("tracer", "name", "tags", "start")
+
+    def __init__(self, tracer: Tracer, name: str, tags: dict):
+        self.tracer = tracer
+        self.name = name
+        self.tags = tags
+
+    def __enter__(self):
+        self.start = _time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = _time.perf_counter_ns() - self.start
+        self.tracer._record(self.name, self.start / 1000.0, dur / 1000.0,
+                            self.tags)
+        return False
+
+
+class StatsD:
+    """DogStatsD-format UDP emitter (reference: src/trace/statsd.zig)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "tb_tpu"):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setblocking(False)
+
+    def _emit(self, metric: str, value, kind: str, tags: dict) -> None:
+        line = f"{self.prefix}.{metric}:{value}|{kind}"
+        if tags:
+            line += "|#" + ",".join(f"{k}:{v}" for k, v in tags.items())
+        try:
+            self.sock.sendto(line.encode(), self.addr)
+        except OSError:
+            pass  # metrics are best-effort
+
+    def count(self, metric: str, value: int = 1, **tags) -> None:
+        self._emit(metric, value, "c", tags)
+
+    def gauge(self, metric: str, value: float, **tags) -> None:
+        self._emit(metric, value, "g", tags)
+
+    def timing(self, metric: str, ms: float, **tags) -> None:
+        self._emit(metric, ms, "ms", tags)
+
+    def close(self) -> None:
+        self.sock.close()

@@ -49,14 +49,22 @@ class Journal:
     def append(self, message: Message) -> None:
         """Write prepare body then its redundant header (ordering matters:
         a crash between the two leaves the old header pointing at the old,
-        still-valid prepare, or the new prepare not yet referenced)."""
+        still-valid prepare, or the new prepare not yet referenced). Uses
+        the native engine's ordered append when available."""
         header = message.header
         assert header.command == Command.prepare
-        assert header.size <= self.prepare_size_max + HEADER_SIZE
+        assert header.size <= self.prepare_size_max
         slot = self.slot_for_op(header.op)
         raw = message.pack()
-        self.storage.write("wal_prepares", slot * self.prepare_size_max, raw)
-        self.storage.write("wal_headers", slot * HEADER_SIZE, header.pack())
+        native_file = getattr(self.storage, "native", None)
+        if native_file is not None:
+            zones = self.storage.layout.zone_offsets
+            native_file.wal_append(
+                zones["wal_headers"], zones["wal_prepares"], slot,
+                self.prepare_size_max, raw)
+        else:
+            self.storage.write("wal_prepares", slot * self.prepare_size_max, raw)
+            self.storage.write("wal_headers", slot * HEADER_SIZE, header.pack())
         self.headers[slot] = header
         self.dirty.discard(slot)
         self.faulty.discard(slot)
@@ -101,7 +109,7 @@ class Journal:
             prepare_valid = False
             if prep_header is not None and prep_header.command == Command.prepare:
                 msg = None
-                if prep_header.size <= self.prepare_size_max + HEADER_SIZE:
+                if prep_header.size <= self.prepare_size_max:
                     body_raw = self.storage.read(
                         "wal_prepares", slot * self.prepare_size_max,
                         prep_header.size)

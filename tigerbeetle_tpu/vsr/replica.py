@@ -58,9 +58,20 @@ class Replica:
     def __init__(self, *, cluster: int, replica_id: int, replica_count: int,
                  storage: Storage, bus, time,
                  state_machine_factory: Callable[[], StateMachine] = StateMachine,
-                 options: ReplicaOptions = ReplicaOptions()):
+                 options: ReplicaOptions = ReplicaOptions(),
+                 tracer=None, aof=None):
+        from ..multiversion import RELEASE, ReleaseTracker
+        from ..trace import NullTracer
+        from .clock import Clock
+
         assert 1 <= replica_count <= 6
         assert 0 <= replica_id < replica_count
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self.aof = aof
+        self.release = RELEASE
+        self.releases = ReleaseTracker()
+        self.clock = Clock(replica_id, replica_count, time)
+        self.last_ping_tx = 0
         self.cluster = cluster
         self.replica_id = replica_id
         self.replica_count = replica_count
@@ -140,8 +151,13 @@ class Replica:
         self.commit_min = sb.op_checkpoint
         self.commit_max = max(sb.commit_max, sb.op_checkpoint)
         self.prepare_timestamp = self.state_machine.state.commit_timestamp
-        # Replay the WAL suffix above the checkpoint.
-        self._commit_journal(min(self.op, max(self.commit_max, self.op)))
+        # Replay the WAL suffix above the checkpoint. Replayed ops were
+        # already appended to the AOF before the crash — don't duplicate.
+        self._replaying = True
+        try:
+            self._commit_journal(min(self.op, max(self.commit_max, self.op)))
+        finally:
+            self._replaying = False
         self.status = "normal"
         self.last_heartbeat_rx = self.time.monotonic()
 
@@ -242,7 +258,7 @@ class Replica:
             replica=self.replica_id, view=self.view, op=op,
             commit=self.commit_max, timestamp=self.prepare_timestamp,
             operation=int(operation), client=client, request=request,
-            parent=parent,
+            parent=parent, release=self.release,
         )
         prepare = Message(header=header.finalize(body), body=body)
         self.journal.append(prepare)
@@ -392,7 +408,12 @@ class Replica:
         h = prepare.header
         assert h.op == self.commit_min + 1
         operation = Operation(h.operation)
-        result = self.state_machine.commit(operation, prepare.body, h.timestamp)
+        with self.tracer.span("commit", op=h.op, operation=int(operation)):
+            result = self.state_machine.commit(operation, prepare.body,
+                                               h.timestamp)
+        self.tracer.count("commits")
+        if self.aof is not None and not getattr(self, "_replaying", False):
+            self.aof.append(prepare)
         self.commit_min = h.op
         if h.client:
             reply_header = Header(
@@ -634,17 +655,33 @@ class Replica:
     # ---------------------------------------------------------------- time
 
     def on_ping(self, msg: Message) -> None:
+        self.releases.observe(msg.header.replica, msg.header.release)
         pong = Header(
             command=Command.pong, cluster=self.cluster,
-            replica=self.replica_id, view=self.view,
+            replica=self.replica_id, view=self.view, release=self.release,
             timestamp=self.time.realtime(), context=msg.header.timestamp)
         self.bus.send_to_replica(msg.header.replica, Message(pong.finalize()))
 
     def on_pong(self, msg: Message) -> None:
-        pass  # clock sampling (vsr/clock.py) is wired in a later round
+        """Clock sample: context echoes our ping's monotonic tx time
+        (reference: clock sampling via ping/pong, src/vsr/clock.zig)."""
+        self.releases.observe(msg.header.replica, msg.header.release)
+        self.clock.learn(
+            msg.header.replica, msg.header.context,
+            msg.header.timestamp, self.time.monotonic())
 
     def tick(self) -> None:
         now = self.time.monotonic()
+        if now - self.last_ping_tx >= self.options.heartbeat_interval_ns * 5:
+            self.last_ping_tx = now
+            ping = Header(
+                command=Command.ping, cluster=self.cluster,
+                replica=self.replica_id, view=self.view,
+                release=self.release, timestamp=now)
+            msg = Message(ping.finalize())
+            for r in range(self.replica_count):
+                if r != self.replica_id:
+                    self.bus.send_to_replica(r, msg)
         if self.status == "normal" and self.is_primary:
             if now - self.last_heartbeat_tx >= self.options.heartbeat_interval_ns:
                 self.last_heartbeat_tx = now
