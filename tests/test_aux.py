@@ -189,6 +189,100 @@ class TestCDC:
         assert lines[0]["type"] == "single_phase"
 
 
+class TestAOFContiguity:
+    def test_reopen_dedupes_and_blocks_gaps(self, tmp_path):
+        path = str(tmp_path / "c.aof")
+        aof = AOF(path)
+        aof.append(_prepare(1, Operation.pulse, b"", 10**13))
+        aof.append(_prepare(2, Operation.pulse, b"", 10**13 + 1))
+        aof.close()
+        # Reopen: last_op recovered; duplicate appends are no-ops.
+        aof2 = AOF(path)
+        assert aof2.last_op == 2
+        aof2.append(_prepare(2, Operation.pulse, b"", 10**13 + 1))
+        aof2.append(_prepare(3, Operation.pulse, b"", 10**13 + 2))
+        with pytest.raises(RuntimeError):
+            aof2.append(_prepare(7, Operation.pulse, b"", 10**13 + 9))
+        aof2.close()
+        assert [m.header.op for m in AOF.iterate(path)] == [1, 2, 3]
+
+    def test_recover_rejects_gapped_aof(self, tmp_path):
+        path = str(tmp_path / "gap.aof")
+        aof = AOF(path)
+        aof.append(_prepare(1, Operation.pulse, b"", 10**13))
+        aof.last_op = 4  # simulate a gap on disk
+        aof.append(_prepare(5, Operation.pulse, b"", 10**13 + 9))
+        aof.close()
+        with pytest.raises(ValueError):
+            aof_recover(path, StateMachine())
+
+
+class TestCDCFlushFailure:
+    def test_watermark_holds_until_flush_succeeds(self):
+        sm = StateMachine()
+        ts = 10**13
+        sm.create_accounts([Account(id=1, ledger=1, code=1),
+                            Account(id=2, ledger=1, code=1)], ts)
+        sm.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1)], ts + 100)
+
+        class FlakySink:
+            def __init__(self):
+                self.fail = True
+                self.events = []
+
+            def publish(self, event):
+                self.events.append(event)
+
+            def flush(self):
+                if self.fail:
+                    self.fail = False
+                    raise OSError("disk full")
+
+        sink = FlakySink()
+        runner = CDCRunner(sm, sink)
+        with pytest.raises(OSError):
+            runner.poll()
+        assert runner.timestamp_processed == 0  # watermark held
+        assert runner.poll() == 1  # re-read and delivered
+        assert runner.timestamp_processed > 0
+
+
+def test_release_gating_enforced_at_open():
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+    cluster = Cluster(seed=6, replica_count=1)
+    cluster.run(50)
+    storage = cluster.storages[0]
+    sb = SuperBlock.load(storage)
+    sb.release = RELEASE + 1  # written by a future release
+    sb.store(storage)
+    cluster.crash(0)
+    with pytest.raises(RuntimeError, match="release"):
+        cluster.restart(0)
+
+
+def test_clock_samples_expire():
+    class T:
+        def __init__(self):
+            self.now = 10**12
+
+        def realtime(self):
+            return self.now
+
+        def monotonic(self):
+            return self.now
+
+    t = T()
+    clock = Clock(0, 3, t)
+    clock.learn(1, t.now - 100, t.now + 50, t.now)
+    assert clock.offset() is not None
+    t.now += clock.window_ns + 1
+    assert clock.offset() is None  # stale sample no longer counts
+
+
 class TestMultiversion:
     def test_release_gating(self):
         tracker = ReleaseTracker()

@@ -1,0 +1,147 @@
+"""LSM engine tests: tables, tree semantics across compactions, forest
+checkpoint/restore, and byte-determinism of the grid."""
+
+import random
+import struct
+
+import pytest
+
+from tigerbeetle_tpu.lsm.grid import Grid, MemoryDevice
+from tigerbeetle_tpu.lsm.table import Table, release_table, write_table
+from tigerbeetle_tpu.lsm.tree import BAR_LENGTH, Tree
+from tigerbeetle_tpu.lsm.forest import Forest
+
+KEY = 8
+VAL = 16
+
+
+def _grid(blocks=4096, block_size=4096):
+    return Grid(MemoryDevice(blocks * block_size), block_size=block_size,
+                block_count=blocks)
+
+
+def k(i):
+    return struct.pack(">Q", i)  # big-endian: numeric order == bytes order
+
+
+def v(i):
+    return struct.pack(">QQ", i, i * 7)
+
+
+class TestTable:
+    def test_write_read_multiblock(self):
+        grid = _grid(block_size=4096)
+        entries = [(k(i), v(i)) for i in range(2000)]  # ~12 value blocks
+        info = write_table(grid, entries, KEY, VAL)
+        table = Table(grid, info, KEY, VAL)
+        assert len(table.block_addresses) > 1
+        assert table.get(k(0)) == v(0)
+        assert table.get(k(1999)) == v(1999)
+        assert table.get(k(777)) == v(777)
+        assert table.get(k(5000)) is None
+        assert list(table.iter_entries()) == entries
+
+    def test_corruption_detected(self):
+        grid = _grid()
+        info = write_table(grid, [(k(1), v(1))], KEY, VAL)
+        grid.device.data[info.index_address.index * grid.block_size] ^= 0xFF
+        with pytest.raises(IOError):
+            Table(grid, info, KEY, VAL)
+
+
+class TestTree:
+    def test_put_get_overwrite_remove_across_flushes(self):
+        tree = Tree(_grid(), key_size=KEY, value_size=VAL)
+        model = {}
+        rng = random.Random(3)
+        for i in range(2000):
+            key = rng.randrange(300)
+            if rng.random() < 0.15:
+                tree.remove(k(key))
+                model.pop(k(key), None)
+            else:
+                tree.put(k(key), v(i))
+                model[k(key)] = v(i)
+            tree.compact_beat()
+        for key in range(300):
+            assert tree.get(k(key)) == model.get(k(key)), key
+        got = tree.scan(k(0), k(299))
+        assert got == sorted(model.items())
+        # Deep levels actually formed.
+        assert sum(len(lv) for lv in tree.levels[1:]) > 0
+
+    def test_scan_range(self):
+        tree = Tree(_grid(), key_size=KEY, value_size=VAL)
+        for i in range(100):
+            tree.put(k(i), v(i))
+            tree.compact_beat()
+        tree.flush_memtable()
+        assert [kk for kk, _ in tree.scan(k(10), k(19))] == [
+            k(i) for i in range(10, 20)]
+
+
+class TestForest:
+    SCHEMA = {"accounts": (KEY, VAL), "transfers": (KEY, VAL)}
+
+    def test_checkpoint_reopen(self):
+        grid = _grid()
+        forest = Forest(grid, self.SCHEMA)
+        for i in range(200):
+            forest.trees["accounts"].put(k(i), v(i))
+            forest.trees["transfers"].put(k(1000 + i), v(i))
+            forest.compact_beat()
+        root = forest.checkpoint()
+
+        # Re-open over the same device bytes.
+        grid2 = Grid(grid.device, block_size=grid.block_size,
+                     block_count=grid.block_count)
+        forest2 = Forest(grid2, self.SCHEMA)
+        forest2.open(root)
+        for i in range(200):
+            assert forest2.trees["accounts"].get(k(i)) == v(i)
+            assert forest2.trees["transfers"].get(k(1000 + i)) == v(i)
+        # Free set restored: allocations continue without clobbering data.
+        for i in range(200, 260):
+            forest2.trees["accounts"].put(k(i), v(i))
+            forest2.compact_beat()
+        forest2.trees["accounts"].flush_memtable()
+        assert forest2.trees["accounts"].get(k(0)) == v(0)
+        assert forest2.trees["accounts"].get(k(259)) == v(259)
+
+    def test_checkpoint_discards_pending_frees_until_flip(self):
+        grid = _grid(blocks=256)
+        forest = Forest(grid, {"t": (KEY, VAL)})
+        tree = forest.trees["t"]
+        for i in range(600):
+            tree.put(k(i % 50), v(i))
+            tree.compact_beat()
+        free_before = sum(grid.free)
+        assert grid.freed_pending  # compactions released blocks
+        forest.checkpoint()
+        assert not grid.freed_pending
+        assert sum(grid.free) >= free_before  # frees landed at the flip
+
+
+def test_grid_byte_determinism():
+    """Same op sequence => byte-identical device contents (the property
+    replica repair relies on; reference: docs/ARCHITECTURE.md:281-307)."""
+
+    def run():
+        grid = _grid(blocks=512)
+        forest = Forest(grid, {"a": (KEY, VAL), "b": (KEY, VAL)})
+        rng = random.Random(42)
+        for i in range(1500):
+            tree = forest.trees["a" if rng.random() < 0.7 else "b"]
+            key = rng.randrange(200)
+            if rng.random() < 0.1:
+                tree.remove(k(key))
+            else:
+                tree.put(k(key), v(i))
+            forest.compact_beat()
+        root = forest.checkpoint()
+        return bytes(grid.device.data), root
+
+    bytes1, root1 = run()
+    bytes2, root2 = run()
+    assert root1 == root2
+    assert bytes1 == bytes2

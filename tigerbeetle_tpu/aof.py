@@ -24,14 +24,28 @@ _FRAME = struct.Struct("<8sI")
 class AOF:
     def __init__(self, path: str):
         self.path = path
+        # Resume-safe: find the last op already framed so restarts neither
+        # duplicate nor gap the sequence.
+        self.last_op = 0
+        if os.path.exists(path):
+            for msg in AOF.iterate(path):
+                self.last_op = msg.header.op
         self.file = open(path, "ab")
 
     def append(self, message: Message) -> None:
         assert message.header.command == Command.prepare
+        op = message.header.op
+        if op <= self.last_op:
+            return  # already framed (startup WAL replay re-commits these)
+        if self.last_op and op != self.last_op + 1:
+            raise RuntimeError(
+                f"AOF gap: last framed op {self.last_op}, appending {op} "
+                "(was --aof enabled mid-life? start a fresh AOF)")
         raw = message.pack()
         self.file.write(_FRAME.pack(_MAGIC, len(raw)) + raw)
         self.file.flush()
         os.fsync(self.file.fileno())
+        self.last_op = op
 
     def close(self) -> None:
         self.file.close()
@@ -61,17 +75,24 @@ class AOF:
 
 
 def recover(path: str, state_machine) -> int:
-    """Replay an AOF into a state machine, in op order, deduplicating
-    (reference: `tigerbeetle recover`). Returns ops applied."""
+    """Replay an AOF into a state machine (reference: `tigerbeetle
+    recover`). The op sequence must start at 1 and be contiguous — a gap
+    means the AOF cannot reproduce the full state and recovery must fail
+    loudly rather than write a divergent snapshot. Returns ops applied."""
     from .types import Operation
 
     applied = 0
     last_op = 0
     for msg in AOF.iterate(path):
-        if msg.header.op <= last_op:
+        op = msg.header.op
+        if op <= last_op:
             continue
+        if op != last_op + 1:
+            raise ValueError(
+                f"AOF not contiguous: op {op} follows {last_op} "
+                "(truncated or mid-life AOF; cannot rebuild full state)")
         state_machine.commit(Operation(msg.header.operation), msg.body,
                              msg.header.timestamp)
-        last_op = msg.header.op
+        last_op = op
         applied += 1
     return applied

@@ -65,17 +65,20 @@ class CDCRunner:
         self.published = 0
 
     def poll(self) -> int:
-        """One pump iteration; returns events published."""
+        """One pump iteration; returns events published. The watermark
+        commits only after the sink flushed — a failed flush leaves it in
+        place so the batch is re-read (at-least-once)."""
         events = self.source.get_change_events(ChangeEventsFilter(
             timestamp_min=self.timestamp_processed + 1,
             timestamp_max=0,
             limit=self.batch_limit))
+        if not events:
+            return 0
         for event in events:
             self.sink.publish(event)
-            self.timestamp_processed = event.timestamp
-            self.published += 1
-        if events:
-            self.sink.flush()
+        self.sink.flush()
+        self.timestamp_processed = events[-1].timestamp
+        self.published += len(events)
         return len(events)
 
     def run_until_idle(self, max_batches: int = 1 << 20) -> int:

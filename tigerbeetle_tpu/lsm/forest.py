@@ -1,0 +1,69 @@
+"""Forest: named trees sharing one grid, with atomic checkpoints.
+
+reference: src/lsm/forest.zig (open/compact/checkpoint across all trees,
+shared manifest log). A checkpoint serializes every tree's manifest plus
+the grid free set into grid blocks and returns one root address blob —
+the superblock-equivalent pointer a caller persists atomically."""
+
+from __future__ import annotations
+
+import struct
+
+from .grid import ADDRESS_SIZE, BlockAddress, Grid
+from .tree import Tree
+
+
+class Forest:
+    def __init__(self, grid: Grid, schema: dict[str, tuple[int, int]]):
+        """schema: name -> (key_size, value_size), fixed at format time
+        (the reference's comptime groove schema)."""
+        self.grid = grid
+        self.schema = dict(sorted(schema.items()))
+        self.trees: dict[str, Tree] = {
+            name: Tree(grid, key_size=k, value_size=v, name=name)
+            for name, (k, v) in self.schema.items()}
+
+    def compact_beat(self) -> None:
+        for tree in self.trees.values():
+            tree.compact_beat()
+
+    def checkpoint(self) -> bytes:
+        """Flush + serialize everything; returns the root blob
+        (manifest block address + free set). Pending grid frees are applied
+        here — the atomic flip point."""
+        manifests = {name: tree.manifest_pack()
+                     for name, tree in self.trees.items()}
+        parts = [struct.pack("<I", len(manifests))]
+        for name, raw in manifests.items():
+            nb = name.encode()
+            parts.append(struct.pack("<HI", len(nb), len(raw)))
+            parts.append(nb)
+            parts.append(raw)
+        manifest_blob = b"".join(parts)
+        assert len(manifest_blob) <= self.grid.block_size, \
+            "manifest exceeds one block (chain blocks in a later round)"
+        address = self.grid.write_block(manifest_blob)
+        free_blob = self.grid.checkpoint_free_set()
+        # The manifest block itself was just acquired; reflect that in the
+        # free set by re-serializing after the write (acquire happened
+        # before checkpoint_free_set, so it is already excluded).
+        return (address.pack() + struct.pack("<I", len(manifest_blob))
+                + struct.pack("<I", len(free_blob)) + free_blob)
+
+    def open(self, root: bytes) -> None:
+        """Restore from a checkpoint root blob."""
+        address = BlockAddress.unpack(root[:ADDRESS_SIZE])
+        (manifest_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE)
+        (free_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE + 4)
+        free_blob = root[ADDRESS_SIZE + 8:ADDRESS_SIZE + 8 + free_size]
+        self.grid.restore_free_set(free_blob)
+        raw = self.grid.read_block(address, manifest_size)
+        (count,) = struct.unpack_from("<I", raw)
+        pos = 4
+        for _ in range(count):
+            name_len, size = struct.unpack_from("<HI", raw, pos)
+            pos += 6
+            name = raw[pos:pos + name_len].decode()
+            pos += name_len
+            self.trees[name].manifest_restore(raw[pos:pos + size])
+            pos += size

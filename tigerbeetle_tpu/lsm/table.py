@@ -1,0 +1,133 @@
+"""Immutable sorted tables serialized into grid blocks.
+
+reference: src/lsm/table.zig (index block + value blocks) +
+src/lsm/table_memory.zig. A table is one sorted run of fixed-size
+(key, value) entries: value blocks hold the entries, the index block holds
+each value block's first key + address. Lookups binary-search the index
+then the block (reference: src/lsm/binary_search.zig — here Python's
+bisect over in-memory key arrays)."""
+
+from __future__ import annotations
+
+import bisect
+import dataclasses
+import struct
+
+from .grid import ADDRESS_SIZE, BlockAddress, Grid
+
+TOMBSTONE = b"\xff"  # value prefix marking a deletion
+
+
+@dataclasses.dataclass
+class TableInfo:
+    """Manifest entry (reference: manifest TableInfo)."""
+
+    index_address: BlockAddress
+    index_size: int
+    key_min: bytes
+    key_max: bytes
+    entry_count: int
+
+    def pack(self) -> bytes:
+        return (self.index_address.pack()
+                + struct.pack("<IHHI", self.index_size, len(self.key_min),
+                              len(self.key_max), self.entry_count)
+                + self.key_min + self.key_max)
+
+    @classmethod
+    def unpack(cls, raw: bytes, offset: int = 0) -> tuple["TableInfo", int]:
+        addr = BlockAddress.unpack(raw[offset:offset + ADDRESS_SIZE])
+        offset += ADDRESS_SIZE
+        size, kmin_len, kmax_len, count = struct.unpack_from("<IHHI", raw, offset)
+        offset += 12
+        kmin = raw[offset:offset + kmin_len]
+        offset += kmin_len
+        kmax = raw[offset:offset + kmax_len]
+        offset += kmax_len
+        return cls(addr, size, kmin, kmax, count), offset
+
+
+class Table:
+    """Reader over one on-grid table: index loaded, blocks read on demand."""
+
+    def __init__(self, grid: Grid, info: TableInfo, key_size: int,
+                 value_size: int):
+        self.grid = grid
+        self.info = info
+        self.key_size = key_size
+        self.value_size = value_size
+        raw = grid.read_block(info.index_address, info.index_size)
+        (count,) = struct.unpack_from("<I", raw)
+        self.block_first_keys: list[bytes] = []
+        self.block_addresses: list[BlockAddress] = []
+        self.block_sizes: list[int] = []
+        pos = 4
+        for _ in range(count):
+            addr = BlockAddress.unpack(raw[pos:pos + ADDRESS_SIZE])
+            pos += ADDRESS_SIZE
+            (size,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            first = raw[pos:pos + key_size]
+            pos += key_size
+            self.block_addresses.append(addr)
+            self.block_sizes.append(size)
+            self.block_first_keys.append(first)
+
+    def _block_entries(self, i: int) -> tuple[list[bytes], list[bytes]]:
+        raw = self.grid.read_block(self.block_addresses[i], self.block_sizes[i])
+        (n,) = struct.unpack_from("<I", raw)
+        pos = 4
+        entry = self.key_size + self.value_size
+        keys = [raw[pos + j * entry: pos + j * entry + self.key_size]
+                for j in range(n)]
+        vals = [raw[pos + j * entry + self.key_size: pos + (j + 1) * entry]
+                for j in range(n)]
+        return keys, vals
+
+    def get(self, key: bytes):
+        if not (self.info.key_min <= key <= self.info.key_max):
+            return None
+        i = bisect.bisect_right(self.block_first_keys, key) - 1
+        if i < 0:
+            return None
+        keys, vals = self._block_entries(i)
+        j = bisect.bisect_left(keys, key)
+        if j < len(keys) and keys[j] == key:
+            return vals[j]
+        return None
+
+    def iter_entries(self):
+        for i in range(len(self.block_addresses)):
+            keys, vals = self._block_entries(i)
+            yield from zip(keys, vals)
+
+
+def write_table(grid: Grid, entries: list[tuple[bytes, bytes]],
+                key_size: int, value_size: int) -> TableInfo:
+    """Serialize one sorted run (caller guarantees sort order + unique keys)."""
+    assert entries
+    entry_size = key_size + value_size
+    per_block = max(1, (grid.block_size - 4) // entry_size)
+    index_parts = [b""]  # placeholder for count
+    block_count = 0
+    for base in range(0, len(entries), per_block):
+        chunk = entries[base:base + per_block]
+        raw = struct.pack("<I", len(chunk)) + b"".join(k + v for k, v in chunk)
+        addr = grid.write_block(raw)
+        index_parts.append(addr.pack() + struct.pack("<I", len(raw))
+                           + chunk[0][0])
+        block_count += 1
+    index_raw = struct.pack("<I", block_count) + b"".join(index_parts[1:])
+    assert len(index_raw) <= grid.block_size, "table too large for one index"
+    index_addr = grid.write_block(index_raw)
+    return TableInfo(
+        index_address=index_addr, index_size=len(index_raw),
+        key_min=entries[0][0], key_max=entries[-1][0],
+        entry_count=len(entries))
+
+
+def release_table(grid: Grid, table: Table) -> None:
+    """Free all of a table's blocks (effective at next checkpoint)."""
+    for addr in table.block_addresses:
+        grid.release(addr.index)
+    grid.release(table.info.index_address.index)

@@ -1,0 +1,199 @@
+"""One LSM tree: memtable + leveled immutable tables with deterministic
+compaction.
+
+reference: src/lsm/tree.zig (mutable/immutable memtables, 7 levels, growth
+factor 8 — src/config.zig:162-163), src/lsm/compaction.zig (incremental
+merge paced in bars/beats; deterministic pacing is load-bearing for
+replica-identical data files), src/lsm/manifest.zig (least-overlap table
+selection, docs/internals/lsm.md:93-108).
+
+Pacing model here: `compact_beat()` is called once per committed op (the
+reference's beat); every `bar_length` beats the mutable memtable flushes to
+level 0 and one compaction step runs per level that exceeds its budget.
+All decisions are pure functions of the op sequence — byte-deterministic
+across replicas (tested)."""
+
+from __future__ import annotations
+
+import dataclasses
+import struct
+from typing import Optional
+
+from .grid import Grid
+from .table import (
+    Table,
+    TableInfo,
+    TOMBSTONE,
+    release_table,
+    write_table,
+)
+
+LSM_LEVELS = 7
+GROWTH_FACTOR = 8
+BAR_LENGTH = 32  # ops per bar (reference: lsm_compaction_ops)
+L0_TABLES_MAX = 4
+
+
+class Tree:
+    def __init__(self, grid: Grid, *, key_size: int, value_size: int,
+                 name: str = "tree"):
+        self.grid = grid
+        self.key_size = key_size
+        self.value_size = value_size
+        self.name = name
+        self.memtable: dict[bytes, bytes] = {}
+        self.levels: list[list[Table]] = [[] for _ in range(LSM_LEVELS)]
+        self.beat = 0
+
+    # ------------------------------------------------------------- updates
+
+    def put(self, key: bytes, value: bytes) -> None:
+        assert len(key) == self.key_size and len(value) == self.value_size
+        self.memtable[key] = value
+
+    def remove(self, key: bytes) -> None:
+        assert len(key) == self.key_size
+        self.memtable[key] = TOMBSTONE * self.value_size
+
+    def get(self, key: bytes) -> Optional[bytes]:
+        value = self.memtable.get(key)
+        if value is None:
+            for level in self.levels:
+                # Newest-first within a level (L0 tables may overlap).
+                for table in reversed(level):
+                    value = table.get(key)
+                    if value is not None:
+                        break
+                if value is not None:
+                    break
+        if value is None or value == TOMBSTONE * self.value_size:
+            return None
+        return value
+
+    def scan(self, key_min: bytes, key_max: bytes) -> list[tuple[bytes, bytes]]:
+        """Merged range scan (newest version wins)."""
+        merged: dict[bytes, bytes] = {}
+        for level in reversed(self.levels):
+            for table in level:  # oldest-first; newer overwrite
+                if table.info.key_max < key_min or table.info.key_min > key_max:
+                    continue
+                for k, v in table.iter_entries():
+                    if key_min <= k <= key_max:
+                        merged[k] = v
+        for k, v in self.memtable.items():
+            if key_min <= k <= key_max:
+                merged[k] = v
+        dead = TOMBSTONE * self.value_size
+        return sorted((k, v) for k, v in merged.items() if v != dead)
+
+    # ---------------------------------------------------------- compaction
+
+    def compact_beat(self) -> None:
+        """One beat; at each bar boundary, flush + rebalance one step.
+        Deterministic in the op sequence (no clocks, no randomness)."""
+        self.beat += 1
+        if self.beat % BAR_LENGTH == 0:
+            self.flush_memtable()
+            self._compact_levels()
+
+    def flush_memtable(self) -> None:
+        if not self.memtable:
+            return
+        entries = sorted(self.memtable.items())
+        info = write_table(self.grid, entries, self.key_size, self.value_size)
+        self.levels[0].append(
+            Table(self.grid, info, self.key_size, self.value_size))
+        self.memtable.clear()
+
+    def _level_budget(self, level: int) -> int:
+        if level == 0:
+            return L0_TABLES_MAX
+        return GROWTH_FACTOR ** level
+
+    def _compact_levels(self) -> None:
+        for level in range(LSM_LEVELS - 1):
+            if len(self.levels[level]) > self._level_budget(level):
+                self._compact_one(level)
+
+    def _pick_table(self, level: int) -> Table:
+        """Selection policy: L0 tables overlap each other, so only the
+        OLDEST may move down (a newer table would otherwise be shadowed by
+        stale data left behind). Deeper levels are disjoint; pick by least
+        overlap with the next level, ties on smallest key_min for
+        determinism (reference: docs/internals/lsm.md:93-108)."""
+        if level == 0:
+            return self.levels[0][0]
+
+        def overlap(table: Table) -> int:
+            return sum(
+                1 for t in self.levels[level + 1]
+                if not (t.info.key_max < table.info.key_min
+                        or t.info.key_min > table.info.key_max))
+
+        return min(self.levels[level],
+                   key=lambda t: (overlap(t), t.info.key_min))
+
+    def _compact_one(self, level: int) -> None:
+        table = self._pick_table(level)
+        self.levels[level].remove(table)
+        next_level = self.levels[level + 1]
+        overlapping = [
+            t for t in next_level
+            if not (t.info.key_max < table.info.key_min
+                    or t.info.key_min > table.info.key_max)]
+        for t in overlapping:
+            next_level.remove(t)
+
+        merged: dict[bytes, bytes] = {}
+        for t in overlapping:  # older
+            for k, v in t.iter_entries():
+                merged[k] = v
+        for k, v in table.iter_entries():  # newer wins
+            merged[k] = v
+        last_level = level + 1 == LSM_LEVELS - 1
+        dead = TOMBSTONE * self.value_size
+        entries = sorted(
+            (k, v) for k, v in merged.items()
+            if not (last_level and v == dead))  # tombstones die at the bottom
+        if entries:
+            info = write_table(self.grid, entries, self.key_size,
+                               self.value_size)
+            bisect_insert(next_level, Table(
+                self.grid, info, self.key_size, self.value_size))
+        release_table(self.grid, table)
+        for t in overlapping:
+            release_table(self.grid, t)
+
+    # ------------------------------------------------------------ manifest
+
+    def manifest_pack(self) -> bytes:
+        """Serialize level structure (reference: manifest log replay)."""
+        self.flush_memtable()
+        parts = [struct.pack("<B", LSM_LEVELS)]
+        for level in self.levels:
+            parts.append(struct.pack("<I", len(level)))
+            for table in level:
+                parts.append(table.info.pack())
+        return b"".join(parts)
+
+    def manifest_restore(self, raw: bytes) -> None:
+        (n_levels,) = struct.unpack_from("<B", raw)
+        assert n_levels == LSM_LEVELS
+        pos = 1
+        self.levels = [[] for _ in range(LSM_LEVELS)]
+        for level in range(n_levels):
+            (count,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            for _ in range(count):
+                info, pos = TableInfo.unpack(raw, pos)
+                self.levels[level].append(Table(
+                    self.grid, info, self.key_size, self.value_size))
+        self.memtable.clear()
+
+
+def bisect_insert(level: list[Table], table: Table) -> None:
+    """Keep levels ordered by key_min (disjoint above L0)."""
+    i = 0
+    while i < len(level) and level[i].info.key_min < table.info.key_min:
+        i += 1
+    level.insert(i, table)

@@ -435,6 +435,8 @@ class StateMachine:
             return False
         if op == Operation.pulse:
             return body == b""
+        if len(body) > MESSAGE_BODY_SIZE_MAX:
+            return False  # would not fit a prepare (journal slot bound)
         try:
             batches = (multi_batch.decode(body, spec.event_size)
                        if op.is_multi_batch() else [body])

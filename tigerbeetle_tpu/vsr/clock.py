@@ -53,13 +53,19 @@ class Clock:
 
     Samples are (monotonic_tx, peer_realtime, monotonic_rx) triples from
     ping/pong exchanges; each gives offset = peer_realtime - local_mid with
-    uncertainty rtt/2."""
+    uncertainty rtt/2. Samples expire after `window_ns` — a partitioned
+    peer's hours-old offset must not keep "synchronizing" the clock
+    (reference: epoch expiry in src/vsr/clock.zig)."""
 
-    def __init__(self, replica_id: int, replica_count: int, time):
+    WINDOW_NS_DEFAULT = 10_000_000_000  # 10s
+
+    def __init__(self, replica_id: int, replica_count: int, time,
+                 window_ns: int = WINDOW_NS_DEFAULT):
         self.replica_id = replica_id
         self.replica_count = replica_count
         self.time = time
-        self.samples: dict[int, Interval] = {}
+        self.window_ns = window_ns
+        self.samples: dict[int, tuple[int, Interval]] = {}  # peer -> (at, iv)
 
     def learn(self, peer: int, monotonic_tx: int, peer_realtime: int,
               monotonic_rx: int) -> None:
@@ -69,12 +75,17 @@ class Clock:
             return
         local_mid = self.time.realtime() - (monotonic_rx - monotonic_tx) // 2
         offset = peer_realtime - local_mid
-        self.samples[peer] = Interval(offset - rtt // 2, offset + rtt // 2)
+        self.samples[peer] = (
+            monotonic_rx, Interval(offset - rtt // 2, offset + rtt // 2))
+
+    def _fresh(self) -> list[Interval]:
+        horizon = self.time.monotonic() - self.window_ns
+        return [iv for at, iv in self.samples.values() if at >= horizon]
 
     def offset(self) -> Optional[Interval]:
-        """Agreed offset interval (None without a quorum of samples)."""
+        """Agreed offset interval (None without a quorum of fresh samples)."""
         own = [Interval(0, 0)]  # our own clock, zero offset
-        intervals = own + list(self.samples.values())
+        intervals = own + self._fresh()
         quorum = self.replica_count // 2 + 1
         if len(intervals) < quorum:
             return None

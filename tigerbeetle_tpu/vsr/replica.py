@@ -117,12 +117,14 @@ class Replica:
     def format(storage: Storage, *, cluster: int, replica_id: int,
                replica_count: int) -> None:
         """Create a fresh data file (reference: src/vsr/replica_format.zig)."""
+        from ..multiversion import RELEASE
+
         state = StateMachine().state
         raw = snapshot_codec.encode(state)
         storage.write("snapshot", 0, raw)
         sb = SuperBlock(
             cluster=cluster, replica_id=replica_id,
-            replica_count=replica_count,
+            replica_count=replica_count, release=RELEASE,
             snapshot_slot=0, snapshot_size=len(raw),
             snapshot_checksum=checksum(raw, domain=b"snap"))
         sb.store(storage)
@@ -134,6 +136,11 @@ class Replica:
         assert sb is not None, "data file not formatted"
         assert sb.cluster == self.cluster
         assert sb.replica_id == self.replica_id
+        if not self.releases.compatible(sb.release):
+            raise RuntimeError(
+                f"data file checkpointed by release {sb.release}; this "
+                f"binary is release {self.release} — upgrade before starting "
+                "(reference: multiversion re-exec decision)")
         self.superblock = sb
         self.view = sb.view
         self.log_view = sb.log_view
@@ -151,13 +158,9 @@ class Replica:
         self.commit_min = sb.op_checkpoint
         self.commit_max = max(sb.commit_max, sb.op_checkpoint)
         self.prepare_timestamp = self.state_machine.state.commit_timestamp
-        # Replay the WAL suffix above the checkpoint. Replayed ops were
-        # already appended to the AOF before the crash — don't duplicate.
-        self._replaying = True
-        try:
-            self._commit_journal(min(self.op, max(self.commit_max, self.op)))
-        finally:
-            self._replaying = False
+        # Replay the WAL suffix above the checkpoint. AOF appends dedupe by
+        # op internally, so replayed ops neither duplicate nor gap the AOF.
+        self._commit_journal(min(self.op, max(self.commit_max, self.op)))
         self.status = "normal"
         self.last_heartbeat_rx = self.time.monotonic()
 
@@ -412,7 +415,7 @@ class Replica:
             result = self.state_machine.commit(operation, prepare.body,
                                                h.timestamp)
         self.tracer.count("commits")
-        if self.aof is not None and not getattr(self, "_replaying", False):
+        if self.aof is not None:
             self.aof.append(prepare)
         self.commit_min = h.op
         if h.client:
@@ -448,6 +451,7 @@ class Replica:
         sb.commit_max = self.commit_max
         sb.view = self.view
         sb.log_view = self.log_view
+        sb.release = self.release
         sb.checkpoint_id = checksum(
             sb.checkpoint_id.to_bytes(16, "little") + raw[:64], domain=b"ckpt")
         sb.store(self.storage)

@@ -39,6 +39,7 @@ class SuperBlock:
     op_checkpoint: int = 0
     checkpoint_id: int = 0  # hash-chained across checkpoints
     snapshot_slot: int = 0  # 0 or 1 (A/B)
+    release: int = 0  # release that wrote this checkpoint (multiversion)
     snapshot_size: int = 0
     snapshot_checksum: int = 0
 
@@ -49,7 +50,7 @@ class SuperBlock:
             self.sequence, self.view, self.log_view,
             self.commit_min, self.commit_max, self.op_checkpoint,
             self.checkpoint_id & ((1 << 64) - 1),
-            self.snapshot_slot, 0,
+            self.snapshot_slot, self.release,
             self.snapshot_size,
             self.snapshot_checksum.to_bytes(16, "little"),
         )
@@ -71,7 +72,7 @@ class SuperBlock:
             sequence=f[4], view=f[5], log_view=f[6],
             commit_min=f[7], commit_max=f[8], op_checkpoint=f[9],
             checkpoint_id=f[10],
-            snapshot_slot=f[11], snapshot_size=f[13],
+            snapshot_slot=f[11], release=f[12], snapshot_size=f[13],
             snapshot_checksum=int.from_bytes(f[14], "little"),
         )
 
