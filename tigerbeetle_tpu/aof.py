@@ -25,11 +25,17 @@ class AOF:
     def __init__(self, path: str):
         self.path = path
         # Resume-safe: find the last op already framed so restarts neither
-        # duplicate nor gap the sequence.
+        # duplicate nor gap the sequence, and truncate a torn tail (a crashed
+        # mid-append) so new frames don't land unreachable after garbage.
         self.last_op = 0
+        valid_end = 0
         if os.path.exists(path):
-            for msg in AOF.iterate(path):
+            for msg, end in AOF._iterate_offsets(path):
                 self.last_op = msg.header.op
+                valid_end = end
+            if os.path.getsize(path) > valid_end:
+                with open(path, "r+b") as f:
+                    f.truncate(valid_end)
         self.file = open(path, "ab")
 
     def append(self, message: Message) -> None:
@@ -37,6 +43,14 @@ class AOF:
         op = message.header.op
         if op <= self.last_op:
             return  # already framed (startup WAL replay re-commits these)
+        if self.last_op == 0 and op != 1:
+            # A fresh AOF starting mid-history can never satisfy recover()'s
+            # contiguity-from-1 requirement — fail at write time, not at
+            # disaster-recovery time.
+            raise RuntimeError(
+                f"AOF is empty but the first committed op is {op} "
+                "(was --aof enabled mid-life? reformat, or restore the "
+                "original AOF file)")
         if self.last_op and op != self.last_op + 1:
             raise RuntimeError(
                 f"AOF gap: last framed op {self.last_op}, appending {op} "
@@ -54,7 +68,15 @@ class AOF:
     def iterate(path: str) -> Iterator[Message]:
         """Replay frames; stops at the first torn/corrupt frame (a crashed
         append), like the reference's recovery scan."""
+        for msg, _ in AOF._iterate_offsets(path):
+            yield msg
+
+    @staticmethod
+    def _iterate_offsets(path: str) -> Iterator[tuple[Message, int]]:
+        """(message, end-offset-of-its-frame) pairs up to the first torn
+        frame — the end offset is where a resuming writer must truncate."""
         with open(path, "rb") as f:
+            pos = 0
             while True:
                 frame = f.read(_FRAME.size)
                 if len(frame) < _FRAME.size:
@@ -71,7 +93,8 @@ class AOF:
                     return
                 if not msg.valid():
                     return
-                yield msg
+                pos += _FRAME.size + size
+                yield msg, pos
 
 
 def recover(path: str, state_machine) -> int:

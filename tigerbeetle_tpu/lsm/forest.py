@@ -22,10 +22,11 @@ class Forest:
         self.trees: dict[str, Tree] = {
             name: Tree(grid, key_size=k, value_size=v, name=name)
             for name, (k, v) in self.schema.items()}
+        self._manifest_block: int = -1  # previous checkpoint's manifest
 
-    def compact_beat(self) -> None:
+    def compact_beat(self, op=None) -> None:
         for tree in self.trees.values():
-            tree.compact_beat()
+            tree.compact_beat(op)
 
     def checkpoint(self) -> bytes:
         """Flush + serialize everything; returns the root blob
@@ -42,7 +43,13 @@ class Forest:
         manifest_blob = b"".join(parts)
         assert len(manifest_blob) <= self.grid.block_size, \
             "manifest exceeds one block (chain blocks in a later round)"
+        # Free the previous checkpoint's manifest block (two-phase: it stays
+        # intact on disk until this checkpoint's free set takes effect, so a
+        # crash before the superblock flip still recovers the old root).
+        if self._manifest_block >= 0:
+            self.grid.release(self._manifest_block)
         address = self.grid.write_block(manifest_blob)
+        self._manifest_block = address.index
         free_blob = self.grid.checkpoint_free_set()
         # The manifest block itself was just acquired; reflect that in the
         # free set by re-serializing after the write (acquire happened
@@ -57,6 +64,7 @@ class Forest:
         (free_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE + 4)
         free_blob = root[ADDRESS_SIZE + 8:ADDRESS_SIZE + 8 + free_size]
         self.grid.restore_free_set(free_blob)
+        self._manifest_block = address.index
         raw = self.grid.read_block(address, manifest_size)
         (count,) = struct.unpack_from("<I", raw)
         pos = 4

@@ -102,6 +102,24 @@ class Table:
             yield from zip(keys, vals)
 
 
+def table_entry_max(grid: Grid, key_size: int, value_size: int) -> int:
+    """Largest entry count whose index still fits one block (reference:
+    tables have a fixed value_count_max per comptime layout)."""
+    per_block = max(1, (grid.block_size - 4) // (key_size + value_size))
+    index_entries_max = (grid.block_size - 4) // (ADDRESS_SIZE + 4 + key_size)
+    return per_block * index_entries_max
+
+
+def write_tables(grid: Grid, entries: list[tuple[bytes, bytes]],
+                 key_size: int, value_size: int) -> list["TableInfo"]:
+    """Serialize a sorted run as one or more bounded tables (a single merge
+    output may exceed one table's index capacity — split, like the
+    reference's compaction emitting multiple output tables)."""
+    cap = table_entry_max(grid, key_size, value_size)
+    return [write_table(grid, entries[i:i + cap], key_size, value_size)
+            for i in range(0, len(entries), cap)]
+
+
 def write_table(grid: Grid, entries: list[tuple[bytes, bytes]],
                 key_size: int, value_size: int) -> TableInfo:
     """Serialize one sorted run (caller guarantees sort order + unique keys)."""

@@ -25,7 +25,7 @@ from .table import (
     TableInfo,
     TOMBSTONE,
     release_table,
-    write_table,
+    write_tables,
 )
 
 LSM_LEVELS = 7
@@ -88,10 +88,15 @@ class Tree:
 
     # ---------------------------------------------------------- compaction
 
-    def compact_beat(self) -> None:
+    def compact_beat(self, op: Optional[int] = None) -> None:
         """One beat; at each bar boundary, flush + rebalance one step.
-        Deterministic in the op sequence (no clocks, no randomness)."""
-        self.beat += 1
+        Deterministic in the op sequence (no clocks, no randomness). When
+        `op` is given, the bar phase is derived from the op number itself so
+        a restarted replica replaying the WAL suffix hits the exact same
+        flush points as one that never crashed (the reference derives
+        compaction pacing from op % lsm_compaction_ops the same way,
+        docs/internals/lsm.md:37-91)."""
+        self.beat = self.beat + 1 if op is None else op
         if self.beat % BAR_LENGTH == 0:
             self.flush_memtable()
             self._compact_levels()
@@ -100,9 +105,10 @@ class Tree:
         if not self.memtable:
             return
         entries = sorted(self.memtable.items())
-        info = write_table(self.grid, entries, self.key_size, self.value_size)
-        self.levels[0].append(
-            Table(self.grid, info, self.key_size, self.value_size))
+        for info in write_tables(self.grid, entries, self.key_size,
+                                 self.value_size):
+            self.levels[0].append(
+                Table(self.grid, info, self.key_size, self.value_size))
         self.memtable.clear()
 
     def _level_budget(self, level: int) -> int:
@@ -156,10 +162,12 @@ class Tree:
             (k, v) for k, v in merged.items()
             if not (last_level and v == dead))  # tombstones die at the bottom
         if entries:
-            info = write_table(self.grid, entries, self.key_size,
-                               self.value_size)
-            bisect_insert(next_level, Table(
-                self.grid, info, self.key_size, self.value_size))
+            # A merge output exceeding one table's capacity splits into
+            # several disjoint tables (all still inside next_level's range).
+            for info in write_tables(self.grid, entries, self.key_size,
+                                     self.value_size):
+                bisect_insert(next_level, Table(
+                    self.grid, info, self.key_size, self.value_size))
         release_table(self.grid, table)
         for t in overlapping:
             release_table(self.grid, t)

@@ -150,8 +150,8 @@ def cmd_recover(args) -> int:
     `tigerbeetle recover` replaying src/aof.zig frames)."""
     from .aof import recover
     from .state_machine import StateMachine
-    from .vsr import snapshot as snapshot_codec
     from .vsr.checksum import checksum
+    from .vsr.durable import DurableState
     from .vsr.replica import Replica
     from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
     from .vsr.superblock import SuperBlock
@@ -162,11 +162,15 @@ def cmd_recover(args) -> int:
     storage = FileStorage(args.path, layout=layout, create=True)
     Replica.format(storage, cluster=args.cluster, replica_id=args.replica,
                    replica_count=args.replica_count)
-    raw = snapshot_codec.encode(sm.state)
-    storage.write("snapshot", 0, raw)
+    # Persist the replayed state as a fresh forest checkpoint (the recovered
+    # oracle's dirty sets cover every object, so this writes everything).
+    durable = DurableState(storage)
+    root = durable.checkpoint(sm.state)
+    storage.write("snapshot", 0, root)
     sb = SuperBlock.load(storage)
-    sb.snapshot_size = len(raw)
-    sb.snapshot_checksum = checksum(raw, domain=b"snap")
+    sb.snapshot_slot = 0
+    sb.snapshot_size = len(root)
+    sb.snapshot_checksum = checksum(root, domain=b"ckptroot")
     sb.store(storage)
     storage.sync()
     storage.close()

@@ -57,6 +57,46 @@ class AccountEventRecord:
     amount: int
 
 
+class DirtyDict(dict):
+    """Dict that records mutated keys (the durable layer's write-behind set:
+    every key touched since the last flush, whether by the sequential oracle
+    or by the kernel wrapper's direct write-backs). `dirty` is cleared by the
+    flusher, never by the dict itself."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.dirty: set = set()
+
+    def __setitem__(self, key, value):
+        super().__setitem__(key, value)
+        self.dirty.add(key)
+
+    def __delitem__(self, key):
+        if key in self:
+            self.dirty.add(key)
+        super().__delitem__(key)
+
+    def pop(self, key, *default):
+        # Only a pop that actually removes something dirties the key: a
+        # no-op pop (absent key, default given) must not produce a spurious
+        # tombstone write downstream.
+        if key in self:
+            self.dirty.add(key)
+        return super().pop(key, *default)
+
+
+class DirtySet(set):
+    """Set that records added members since the last flush."""
+
+    def __init__(self, *args):
+        super().__init__(*args)
+        self.dirty: set = set()
+
+    def add(self, member):
+        super().add(member)
+        self.dirty.add(member)
+
+
 class _Scope:
     """Rollback scope for linked chains (reference: src/lsm/groove.zig:1963-1984
     scope_open/scope_close generalized across all oracle containers)."""
@@ -82,16 +122,16 @@ class StateMachineOracle:
     """In-memory state machine with reference-exact create/lookup semantics."""
 
     def __init__(self) -> None:
-        self.accounts: dict[int, Account] = {}
-        self.transfers: dict[int, Transfer] = {}
+        self.accounts: DirtyDict = DirtyDict()
+        self.transfers: DirtyDict = DirtyDict()
         # Transfer ids that failed with a transient status: retried ids fail
         # with id_already_failed (reference: groove.insert_orphaned_primary_key).
-        self.orphaned: set[int] = set()
+        self.orphaned: DirtySet = DirtySet()
         # pending transfer timestamp -> TransferPendingStatus
         # (reference: transfers_pending groove, state_machine.zig:92-102).
-        self.pending_status: dict[int, TransferPendingStatus] = {}
+        self.pending_status: DirtyDict = DirtyDict()
         # pending transfer timestamp -> expires_at (live expires_at index).
-        self.expiry: dict[int, int] = {}
+        self.expiry: DirtyDict = DirtyDict()
         # Object-tree key ranges for imported-timestamp regression checks
         # (reference: groove objects.key_range; key = timestamp).
         self.accounts_key_max: Optional[int] = None

@@ -260,6 +260,38 @@ class Cluster:
         assert all(s == snaps[0] for s in snaps[1:]), "state divergence"
         commit = {r.commit_min for r in live}
         assert len(commit) == 1
+        self.check_storage()
+
+    def check_storage(self) -> None:
+        """Physical determinism (reference: storage_checker.zig:55 —
+        byte-identical checkpoints): replicas at the same checkpoint hold
+        byte-identical grid zones and checkpoint-root blobs."""
+        live = [i for i in range(self.replica_count) if i not in self.crashed]
+        by_ckpt: dict[tuple, list[int]] = {}
+        for i in live:
+            r = self.replicas[i]
+            if r.superblock is not None:
+                # Grid bytes depend on all flushes <= commit_min, so only
+                # replicas at the same (checkpoint, commit) must match.
+                key = (r.superblock.op_checkpoint, r.commit_min)
+                by_ckpt.setdefault(key, []).append(i)
+        zones = self.layout.zone_offsets
+        glo, ghi = zones["grid"], zones["_end"]
+        for (ckpt, _), members in by_ckpt.items():
+            if ckpt == 0 or len(members) < 2:
+                continue
+            grids = [bytes(self.storages[i].data[glo:ghi]) for i in members]
+            assert all(g == grids[0] for g in grids[1:]), \
+                f"grid divergence at checkpoint {ckpt}: replicas {members}"
+            roots = []
+            for i in members:
+                sb = self.replicas[i].superblock
+                roots.append(self.storages[i].read(
+                    "snapshot",
+                    sb.snapshot_slot * self.layout.snapshot_size_max,
+                    sb.snapshot_size))
+            assert all(r == roots[0] for r in roots[1:]), \
+                f"checkpoint root divergence at {ckpt}"
 
     def debug_status(self) -> str:
         return " | ".join(

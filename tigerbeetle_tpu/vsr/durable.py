@@ -1,0 +1,212 @@
+"""DurableState: the LSM forest under the replica.
+
+The incremental-checkpoint engine (replacing the round-1 whole-state
+snapshots): state-machine objects are written through to LSM trees sharing
+one copy-on-write grid in the data file's grid zone, compaction is paced
+deterministically by op number, and a checkpoint serializes only manifests
+plus the free set into one small root blob the superblock references.
+
+reference mapping:
+  grooves / object trees        src/lsm/groove.zig, forest.zig  -> Forest
+  grid zone (CoW blocks)        src/vsr/grid.zig                -> lsm/grid.py
+  checkpoint trailer (free set) src/vsr/checkpoint_trailer.zig  -> root blob
+  write-through after commit    groove insert/update at commit
+
+Determinism contract (load-bearing, like the reference's physical
+determinism, docs/ARCHITECTURE.md:281-307): given an identical committed op
+sequence, every replica produces byte-identical grid zones. Achieved by
+(a) sorted dirty-set flush order, (b) op-derived compaction pacing, and
+(c) deterministic grid allocation (cursor scan, reset at checkpoint).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..lsm.forest import Forest
+from ..lsm.grid import Grid
+from ..oracle.state_machine import AccountEventRecord, StateMachineOracle
+from ..types import Account, Transfer, TransferPendingStatus
+from .storage import Storage
+
+# Fixed-size AccountEventRecord row (reference: 256-byte AccountEvent,
+# src/state_machine.zig:104-220; ours carries both full account snapshots).
+_EVENT_SIZE = 8 + 2 + 1 + 1 + 128 + 128 + 16 + 16 + 128
+
+SCHEMA = {
+    "accounts": (16, 128),
+    "transfers": (16, 128),
+    "pending": (8, 1),
+    "expiry": (8, 8),
+    "orphaned": (16, 1),
+    "events": (8, _EVENT_SIZE),
+}
+
+_META_SIZE = 40  # scalars appended to the checkpoint root blob
+
+_NO_PENDING = b"\x00" * 128
+_FLAGS_NONE = 0xFFFF  # transfer_flags=None sentinel (expiry events)
+
+
+def _k8(x: int) -> bytes:
+    return x.to_bytes(8, "big")  # big-endian: lexicographic == numeric
+
+
+def _k16(x: int) -> bytes:
+    return x.to_bytes(16, "big")
+
+
+def _pack_event(rec: AccountEventRecord) -> bytes:
+    flags = _FLAGS_NONE if rec.transfer_flags is None else rec.transfer_flags
+    return (struct.pack(
+        "<QHBB", rec.timestamp, flags, int(rec.transfer_pending_status),
+        1 if rec.transfer_pending is not None else 0)
+        + rec.dr_account.pack() + rec.cr_account.pack()
+        + rec.amount_requested.to_bytes(16, "little")
+        + rec.amount.to_bytes(16, "little")
+        + (rec.transfer_pending.pack() if rec.transfer_pending is not None
+           else _NO_PENDING))
+
+
+def _unpack_event(raw: bytes) -> AccountEventRecord:
+    ts, flags, pstat, has_p = struct.unpack_from("<QHBB", raw)
+    pos = 12
+    dr = Account.unpack(raw[pos:pos + 128]); pos += 128
+    cr = Account.unpack(raw[pos:pos + 128]); pos += 128
+    amount_requested = int.from_bytes(raw[pos:pos + 16], "little"); pos += 16
+    amount = int.from_bytes(raw[pos:pos + 16], "little"); pos += 16
+    pending = Transfer.unpack(raw[pos:pos + 128]) if has_p else None
+    return AccountEventRecord(
+        timestamp=ts, dr_account=dr, cr_account=cr,
+        transfer_flags=None if flags == _FLAGS_NONE else flags,
+        transfer_pending_status=TransferPendingStatus(pstat),
+        transfer_pending=pending,
+        amount_requested=amount_requested, amount=amount)
+
+
+class _ZoneDevice:
+    """Adapter: a storage zone as the grid's flat byte device."""
+
+    def __init__(self, storage: Storage, zone: str):
+        self.storage = storage
+        self.zone = zone
+
+    def read(self, off: int, size: int) -> bytes:
+        return self.storage.read(self.zone, off, size)
+
+    def write(self, off: int, data: bytes) -> None:
+        self.storage.write(self.zone, off, data)
+
+
+class DurableState:
+    """Write-behind LSM persistence for one replica's state machine."""
+
+    def __init__(self, storage: Storage):
+        layout = storage.layout
+        self.grid = Grid(
+            _ZoneDevice(storage, "grid"),
+            block_size=layout.grid_block_size,
+            block_count=layout.grid_block_count)
+        self.forest = Forest(self.grid, SCHEMA)
+        self.events_persisted = 0
+
+    # ------------------------------------------------------------- writes
+
+    def flush(self, state: StateMachineOracle) -> None:
+        """Write every object mutated since the last flush into the trees
+        (sorted key order: byte-deterministic across replicas)."""
+        trees = self.forest.trees
+        # A dirty key absent from its dict was created then rolled back by a
+        # linked-chain scope within one commit — it was never flushed, so
+        # skip it (accounts/transfers/pending are never legitimately
+        # removed; only expiry needs real tombstones).
+        acc = state.accounts
+        for aid in sorted(acc.dirty):
+            if aid in acc:
+                trees["accounts"].put(_k16(aid), acc[aid].pack())
+        acc.dirty.clear()
+        xfr = state.transfers
+        for tid in sorted(xfr.dirty):
+            if tid in xfr:
+                trees["transfers"].put(_k16(tid), xfr[tid].pack())
+        xfr.dirty.clear()
+        pend = state.pending_status
+        for ts in sorted(pend.dirty):
+            if ts in pend:
+                trees["pending"].put(_k8(ts), bytes([int(pend[ts])]))
+        pend.dirty.clear()
+        exp = state.expiry
+        for ts in sorted(exp.dirty):
+            if ts in exp:
+                trees["expiry"].put(_k8(ts), struct.pack("<Q", exp[ts]))
+            else:
+                trees["expiry"].remove(_k8(ts))
+        exp.dirty.clear()
+        orph = state.orphaned
+        for oid in sorted(orph.dirty):
+            trees["orphaned"].put(_k16(oid), b"\x01")
+        orph.dirty.clear()
+        for rec in state.account_events[self.events_persisted:]:
+            trees["events"].put(_k8(rec.timestamp), _pack_event(rec))
+        self.events_persisted = len(state.account_events)
+
+    def compact_beat(self, op: int) -> None:
+        self.forest.compact_beat(op)
+
+    def checkpoint(self, state: StateMachineOracle) -> bytes:
+        """Flush + forest checkpoint; returns the root blob to persist.
+        The 40 scalar bytes (key maxes, pulse, commit timestamp, event
+        count) ride in the root blob itself — they are only ever read at
+        restore, so they don't belong in a tree (reference analog: the
+        superblock's VSRState vs the checkpoint trailer)."""
+        self.flush(state)
+        meta = struct.pack(
+            "<QQQQQ",
+            state.accounts_key_max or 0, state.transfers_key_max or 0,
+            state.pulse_next_timestamp, state.commit_timestamp,
+            self.events_persisted)
+        return self.forest.checkpoint() + meta
+
+    # ------------------------------------------------------------- recover
+
+    def open(self, root: Optional[bytes]) -> StateMachineOracle:
+        """Restore the forest from a checkpoint root and rebuild the
+        in-memory state (object dicts + derived timestamp indexes)."""
+        state = StateMachineOracle()
+        if root is not None:
+            meta = root[-_META_SIZE:]
+            self.forest.open(root[:-_META_SIZE])
+            trees = self.forest.trees
+            lo16, hi16 = b"\x00" * 16, b"\xff" * 16
+            lo8, hi8 = b"\x00" * 8, b"\xff" * 8
+            for _, v in trees["accounts"].scan(lo16, hi16):
+                a = Account.unpack(v)
+                state.accounts[a.id] = a
+                state.account_by_timestamp[a.timestamp] = a.id
+            for _, v in trees["transfers"].scan(lo16, hi16):
+                t = Transfer.unpack(v)
+                state.transfers[t.id] = t
+                state.transfer_by_timestamp[t.timestamp] = t.id
+            for k, v in trees["pending"].scan(lo8, hi8):
+                state.pending_status[int.from_bytes(k, "big")] = \
+                    TransferPendingStatus(v[0])
+            for k, v in trees["expiry"].scan(lo8, hi8):
+                state.expiry[int.from_bytes(k, "big")] = \
+                    struct.unpack("<Q", v)[0]
+            for k, _ in trees["orphaned"].scan(lo16, hi16):
+                state.orphaned.add(int.from_bytes(k, "big"))
+            for _, v in trees["events"].scan(lo8, hi8):
+                state.account_events.append(_unpack_event(v))
+            akm, tkm, pulse, commit_ts, events_len = struct.unpack("<QQQQQ", meta)
+            state.accounts_key_max = akm or None
+            state.transfers_key_max = tkm or None
+            state.pulse_next_timestamp = pulse
+            state.commit_timestamp = commit_ts
+            assert events_len == len(state.account_events)
+        # Everything just loaded is already durable.
+        for container in (state.accounts, state.transfers,
+                          state.pending_status, state.expiry, state.orphaned):
+            container.dirty.clear()
+        self.events_persisted = len(state.account_events)
+        return state

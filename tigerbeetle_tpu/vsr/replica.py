@@ -19,9 +19,12 @@ Protocol summary (faithful to VSR; simplified where noted):
   primary adopts the best log (max log_view, then max op), sends
   `start_view`; backups install the suffix and repair missing prepares.
 - repair: gaps are filled via `request_prepare`/`prepare` from any peer.
-- checkpoint: every `checkpoint_interval` commits the state machine snapshot
-  is written to the alternating snapshot slot and the superblock flips
-  (snapshot-based for round 1; the LSM grid replaces this later).
+- checkpoint: state-machine objects are written through to the LSM forest
+  after every commit (vsr/durable.py), compaction is paced by op number, and
+  every `checkpoint_interval` commits the forest checkpoints: manifests +
+  free set serialize into a small root blob written to the alternating
+  snapshot slot, then the superblock flips — an incremental checkpoint, like
+  the reference's grid + checkpoint trailer (docs/internals/data_file.md).
 
 Omitted in round 1 (tracked for later rounds): standbys, state sync for
 replicas that fell behind WAL wrap (they currently halt and must be
@@ -36,8 +39,8 @@ from typing import Callable, Optional
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
 from ..state_machine import StateMachine
 from ..types import Operation
-from . import snapshot as snapshot_codec
 from .checksum import checksum
+from .durable import DurableState
 from .header import HEADER_SIZE, Command, Header, Message
 from .journal import Journal
 from .storage import Storage
@@ -83,6 +86,7 @@ class Replica:
 
         self.journal = Journal(storage)
         self.state_machine: StateMachine = state_machine_factory()
+        self.durable = DurableState(storage)
         self.superblock: Optional[SuperBlock] = None
 
         self.status = "recovering"
@@ -116,17 +120,18 @@ class Replica:
     @staticmethod
     def format(storage: Storage, *, cluster: int, replica_id: int,
                replica_count: int) -> None:
-        """Create a fresh data file (reference: src/vsr/replica_format.zig)."""
+        """Create a fresh data file (reference: src/vsr/replica_format.zig):
+        an empty forest checkpoint root + the genesis superblock."""
         from ..multiversion import RELEASE
 
-        state = StateMachine().state
-        raw = snapshot_codec.encode(state)
-        storage.write("snapshot", 0, raw)
+        durable = DurableState(storage)
+        root = durable.checkpoint(StateMachine().state)
+        storage.write("snapshot", 0, root)
         sb = SuperBlock(
             cluster=cluster, replica_id=replica_id,
             replica_count=replica_count, release=RELEASE,
-            snapshot_slot=0, snapshot_size=len(raw),
-            snapshot_checksum=checksum(raw, domain=b"snap"))
+            snapshot_slot=0, snapshot_size=len(root),
+            snapshot_checksum=checksum(root, domain=b"ckptroot"))
         sb.store(storage)
 
     def open(self) -> None:
@@ -145,13 +150,13 @@ class Replica:
         self.view = sb.view
         self.log_view = sb.log_view
 
-        raw = self.storage.read(
+        root = self.storage.read(
             "snapshot", sb.snapshot_slot * self.storage.layout.snapshot_size_max,
             sb.snapshot_size)
-        assert checksum(raw, domain=b"snap") == sb.snapshot_checksum, \
-            "snapshot corrupt"
+        assert checksum(root, domain=b"ckptroot") == sb.snapshot_checksum, \
+            "checkpoint root corrupt"
         self.state_machine = self.state_machine_factory()
-        self.state_machine.state = snapshot_codec.decode(raw)
+        self.state_machine.state = self.durable.open(root)
 
         self.journal.recover()
         self.op = max(sb.op_checkpoint, self._journal_contiguous_max(sb.op_checkpoint))
@@ -243,6 +248,8 @@ class Replica:
                 return  # already preparing this request
         if len(self.pipeline) >= PIPELINE_PREPARE_QUEUE_MAX:
             return  # backpressure: client will retry
+        if HEADER_SIZE + len(msg.body) > self.storage.layout.message_size_max:
+            return  # would not fit THIS replica's journal slot (small layout)
         if not self.state_machine.input_valid(operation, msg.body):
             return  # malformed body: never prepare it (client bug)
         self._primary_prepare(operation, msg.body, client=h.client,
@@ -418,6 +425,10 @@ class Replica:
         if self.aof is not None:
             self.aof.append(prepare)
         self.commit_min = h.op
+        # Write-through to the LSM forest + one deterministic compaction
+        # beat (reference: commit_compact, one beat per op — §3.4).
+        self.durable.flush(self.state_machine.state)
+        self.durable.compact_beat(h.op)
         if h.client:
             reply_header = Header(
                 command=Command.reply, cluster=self.cluster,
@@ -434,18 +445,20 @@ class Replica:
             self._checkpoint()
 
     def _checkpoint(self) -> None:
-        """Snapshot + superblock flip (reference commit_checkpoint_data /
-        commit_checkpoint_superblock :4989,5110)."""
+        """Forest checkpoint + superblock flip (reference
+        commit_checkpoint_data / commit_checkpoint_superblock :4989,5110).
+        Only manifests + the free set are serialized — table data is already
+        durable in the copy-on-write grid, so the flip is incremental."""
         sb = self.superblock
-        raw = snapshot_codec.encode(self.state_machine.state)
-        assert len(raw) <= self.storage.layout.snapshot_size_max, \
-            "snapshot exceeds slot (raise snapshot_size_max)"
+        root = self.durable.checkpoint(self.state_machine.state)
+        assert len(root) <= self.storage.layout.snapshot_size_max, \
+            "checkpoint root exceeds slot (raise snapshot_size_max)"
         slot = 1 - sb.snapshot_slot
         self.storage.write(
-            "snapshot", slot * self.storage.layout.snapshot_size_max, raw)
+            "snapshot", slot * self.storage.layout.snapshot_size_max, root)
         sb.snapshot_slot = slot
-        sb.snapshot_size = len(raw)
-        sb.snapshot_checksum = checksum(raw, domain=b"snap")
+        sb.snapshot_size = len(root)
+        sb.snapshot_checksum = checksum(root, domain=b"ckptroot")
         sb.op_checkpoint = self.commit_min
         sb.commit_min = self.commit_min
         sb.commit_max = self.commit_max
@@ -453,7 +466,7 @@ class Replica:
         sb.log_view = self.log_view
         sb.release = self.release
         sb.checkpoint_id = checksum(
-            sb.checkpoint_id.to_bytes(16, "little") + raw[:64], domain=b"ckpt")
+            sb.checkpoint_id.to_bytes(16, "little") + root[:64], domain=b"ckpt")
         sb.store(self.storage)
 
     # ---------------------------------------------------------- view change

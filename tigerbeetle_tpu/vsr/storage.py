@@ -7,7 +7,8 @@ docs/internals/data_file.md:11-97. Zones here:
   wal_headers  slot_count x 256
   wal_prepares slot_count x message_size_max
   client_replies clients_max x message_size_max
-  snapshot     2 x snapshot_size_max  (A/B checkpoint slots)
+  snapshot     2 x snapshot_size_max  (A/B checkpoint-root slots)
+  grid         grid_block_count x grid_block_size (LSM copy-on-write blocks)
 
 Round-1 simplification (vs the reference's io_uring async path): the IO
 interface is synchronous; the deterministic simulator injects faults by
@@ -35,7 +36,11 @@ class StorageLayout:
     slot_count: int = 1024
     message_size_max: int = 1024 * 1024
     clients_max: int = 64
-    snapshot_size_max: int = 256 * 1024 * 1024
+    # The snapshot zone holds the two A/B checkpoint-root blobs (forest
+    # manifests address + free set) — small; bulk state lives in the grid.
+    snapshot_size_max: int = 4 * 1024 * 1024
+    grid_block_size: int = 64 * 1024
+    grid_block_count: int = 8192  # 512 MiB grid zone
 
     @property
     def zone_offsets(self) -> dict:
@@ -51,6 +56,8 @@ class StorageLayout:
         pos += self.clients_max * self.message_size_max
         off["snapshot"] = pos
         pos += 2 * self.snapshot_size_max
+        off["grid"] = pos
+        pos += self.grid_block_count * self.grid_block_size
         off["_end"] = pos
         return off
 
@@ -61,7 +68,8 @@ class StorageLayout:
 
 TEST_LAYOUT = StorageLayout(
     slot_count=32, message_size_max=64 * 1024, clients_max=8,
-    snapshot_size_max=4 * 1024 * 1024)
+    snapshot_size_max=256 * 1024, grid_block_size=8 * 1024,
+    grid_block_count=2048)
 
 
 class Storage:
