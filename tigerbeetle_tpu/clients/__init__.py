@@ -1,0 +1,11 @@
+"""Language clients over the shared native tb_client runtime.
+
+reference: src/clients/ — every language binding is a thin wrapper over the
+C-ABI tb_client (src/clients/c/tb_client.zig). Here: the C ABI lives in
+native/tb_client.cpp and `clients.c_client.CClient` is the Python binding
+over it; `vsr.client.Client` is the pure-Python alternative.
+"""
+
+from .c_client import CClient, c_client_available
+
+__all__ = ["CClient", "c_client_available"]

@@ -17,20 +17,31 @@ from typing import Optional
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _SRC = os.path.join(_REPO, "native", "storage_engine.cpp")
 _LIB = os.path.join(_REPO, "native", "libtb_storage.so")
+_HDR = os.path.join(_REPO, "native", "blake2b.h")
+_CLIENT_SRC = os.path.join(_REPO, "native", "tb_client.cpp")
+_CLIENT_LIB = os.path.join(_REPO, "native", "libtb_client.so")
 
 _lock = threading.Lock()
 _lib: Optional[ctypes.CDLL] = None
 _tried = False
 
 
-def _build() -> bool:
+def _build(src: str, lib: str, *extra: str) -> bool:
     try:
         subprocess.run(
-            ["g++", "-O2", "-fPIC", "-shared", "-o", _LIB, _SRC],
+            ["g++", "-O2", "-fPIC", "-shared", *extra, "-o", lib, src],
             check=True, capture_output=True, timeout=120)
         return True
     except Exception:
         return False
+
+
+def _stale(lib: str, *sources: str) -> bool:
+    if not os.path.exists(lib):
+        return True
+    mtime = os.path.getmtime(lib)
+    return any(os.path.getmtime(s) > mtime
+               for s in sources if os.path.exists(s))
 
 
 def load() -> Optional[ctypes.CDLL]:
@@ -42,9 +53,8 @@ def load() -> Optional[ctypes.CDLL]:
         _tried = True
         if not os.path.exists(_SRC):
             return None
-        if (not os.path.exists(_LIB)
-                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
-            if not _build():
+        if _stale(_LIB, _SRC, _HDR):
+            if not _build(_SRC, _LIB):
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
@@ -140,3 +150,31 @@ class NativeFile:
 
 def available() -> bool:
     return load() is not None
+
+
+# ------------------------------------------------------- tb_client library
+
+_client_lock = threading.Lock()
+_client_lib: Optional[ctypes.CDLL] = None
+_client_tried = False
+
+
+def load_client() -> Optional[ctypes.CDLL]:
+    """The native tb_client library (native/tb_client.cpp), built on
+    demand; None when unavailable."""
+    global _client_lib, _client_tried
+    with _client_lock:
+        if _client_lib is not None or _client_tried:
+            return _client_lib
+        _client_tried = True
+        if not os.path.exists(_CLIENT_SRC):
+            return None
+        if _stale(_CLIENT_LIB, _CLIENT_SRC, _HDR):
+            if not _build(_CLIENT_SRC, _CLIENT_LIB, "-pthread"):
+                return None
+        try:
+            lib = ctypes.CDLL(_CLIENT_LIB)
+        except OSError:
+            return None
+        _client_lib = lib
+        return _client_lib
