@@ -1,0 +1,291 @@
+"""VSR auxiliary components: durable client sessions/replies, fault
+detector, repair budget, grid scrubber.
+
+reference analogs: src/vsr/client_sessions.zig + client_replies.zig,
+src/vsr/fault_detector.zig, src/vsr/repair_budget.zig,
+src/vsr/grid_scrubber.zig.
+"""
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.lsm.forest import Forest
+from tigerbeetle_tpu.lsm.grid import Grid, MemoryDevice
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Account, Operation, Transfer
+from tigerbeetle_tpu.vsr.client_sessions import ClientSessions
+from tigerbeetle_tpu.vsr.fault_detector import FaultDetector
+from tigerbeetle_tpu.vsr.grid_scrubber import GridScrubber
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+from tigerbeetle_tpu.vsr.repair_budget import RepairBudget
+from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+MS = 1_000_000
+
+
+def _reply(client: int, request: int, body: bytes = b"x" * 16) -> Message:
+    h = Header(command=Command.reply, cluster=1, client=client,
+               request=request)
+    return Message(h.finalize(body), body=body)
+
+
+class TestClientSessions:
+    def test_put_get_roundtrip_and_zone_persistence(self):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sessions = ClientSessions(storage)
+        for c in range(1, 4):
+            assert sessions.put_reply(c, 1, _reply(c, 1)) is None
+        blob = sessions.pack()
+
+        restored = ClientSessions(storage)
+        restored.restore(blob)
+        for c in range(1, 4):
+            e = restored.get(c)
+            assert e["request"] == 1
+            assert e["reply"].body == b"x" * 16
+            assert e["reply"].valid()
+
+    def test_eviction_oldest_request_first(self):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sessions = ClientSessions(storage)
+        cap = storage.layout.clients_max
+        for c in range(1, cap + 1):
+            assert sessions.put_reply(c, c, _reply(c, c)) is None
+        # Table full: the session with the lowest request number goes.
+        evicted = sessions.put_reply(999, 100, _reply(999, 100))
+        assert evicted == 1
+        assert sessions.get(1) is None
+        assert sessions.get(999)["request"] == 100
+
+    def test_corrupt_reply_slot_detected(self):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sessions = ClientSessions(storage)
+        sessions.put_reply(5, 7, _reply(5, 7))
+        blob = sessions.pack()
+        slot = sessions.get(5)["slot"]
+        storage.write("client_replies",
+                      slot * storage.layout.message_size_max + 100, b"\xff")
+        restored = ClientSessions(storage)
+        restored.restore(blob)
+        e = restored.get(5)
+        assert e["request"] == 7 and e["reply"] is None  # fault, not garbage
+
+
+class TestSessionsSurviveRestart:
+    def test_duplicate_request_after_restart_answered_from_disk(self):
+        cluster = Cluster(seed=77, replica_count=3)
+        client = cluster.client(11)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(20):  # run past a checkpoint (interval 16)
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=100 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1,
+                          code=1).pack()], 128))
+        cluster.settle()
+        last_reply = client.replies[-1].body
+
+        victim = cluster.replicas[0].primary_index()
+        cluster.crash(victim)
+        cluster.restart(victim)
+        cluster.settle()
+        e = cluster.replicas[victim].sessions.get(11)
+        assert e is not None
+        assert e["request"] == client.request_number
+        assert e["reply"].body == last_reply
+
+
+class TestStateSync:
+    def test_lagging_replica_jumps_to_peer_checkpoint(self):
+        """Crash a replica, drive the cluster past the WAL wrap
+        (slot_count=32 in TEST_LAYOUT), restart it: normal repair cannot
+        bridge the gap, so it must state-sync to a peer's checkpoint
+        (reference: docs/internals/sync.md:49-79)."""
+        cluster = Cluster(seed=55, replica_count=3)
+        client = cluster.client(3)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.crash(victim)
+        for k in range(40):  # > slot_count: the WAL wraps past the victim
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=100 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1,
+                          code=1).pack()], 128))
+        cluster.restart(victim)
+        cluster.settle(ticks=6000)
+        r = cluster.replicas[victim]
+        # It cannot have replayed the whole log — it jumped via sync.
+        assert r.superblock.op_checkpoint >= 32
+        a1 = r.state_machine.state.accounts[1]
+        assert a1.debits_posted == 40
+        e = r.sessions.get(3)
+        assert e is not None and e["request"] == client.request_number
+
+
+class TestScrubRepairEndToEnd:
+    def test_corrupt_block_repaired_from_peer(self):
+        """Corrupt one replica's grid block; the scrubber finds it and the
+        repair path installs a validated copy from a peer (grids are
+        byte-identical, reference: docs/ARCHITECTURE.md:281-307)."""
+        cluster = Cluster(seed=91, replica_count=3)
+        client = cluster.client(2)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(18):  # past a checkpoint: tables exist on the grid
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=100 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1,
+                          code=1).pack()], 128))
+        cluster.settle()
+
+        r0 = cluster.replicas[0]
+        tables = [t for tree in r0.durable.forest.trees.values()
+                  for level in tree.levels for t in level]
+        assert tables, "expected flushed tables after a checkpoint"
+        victim = tables[0].info.index_address
+        zones = cluster.layout.zone_offsets
+        off = zones["grid"] + victim.index * cluster.layout.grid_block_size + 8
+        cluster.storages[0].data[off] ^= 0xFF
+
+        # Let the scrubber tour (every 64 ticks) and the repair path run:
+        # wait for two FULL tours after the corruption (the first detects,
+        # a later one confirms the repaired block scans clean).
+        r0.scrubber.reads_per_tick = 32
+        cycles0 = r0.scrubber.cycles
+        cluster.run(20000, until=lambda: (
+            r0.scrubber.cycles >= cycles0 + 2
+            and victim.index not in r0.block_repair
+            and victim.index not in r0.scrubber.faults))
+        raw = cluster.storages[0].read(
+            "grid", victim.index * cluster.layout.grid_block_size,
+            tables[0].info.index_size)
+        r0.durable.grid.read_block(victim, tables[0].info.index_size)
+        assert raw is not None  # read_block above validated the checksum
+
+    def test_missing_reply_repaired_from_peer(self):
+        """Blow away a replica's reply slot + restart: the periodic reply
+        repair refills it from peers (reference: client_replies repair)."""
+        cluster = Cluster(seed=92, replica_count=3)
+        client = cluster.client(6)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(17):  # past a checkpoint so sessions are durable
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=200 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1,
+                          code=1).pack()], 128))
+        cluster.settle()
+        victim = 2 if cluster.replicas[0].primary_index() != 2 else 1
+        r = cluster.replicas[victim]
+        entry = r.sessions.get(6)
+        assert entry is not None and entry["reply"] is not None
+        # Corrupt the reply slot on disk, then restart the replica.
+        zones = cluster.layout.zone_offsets
+        off = (zones["client_replies"]
+               + entry["slot"] * cluster.layout.message_size_max + 300)
+        cluster.storages[victim].data[off] ^= 0xFF
+        cluster.crash(victim)
+        cluster.restart(victim)
+        r = cluster.replicas[victim]
+        cluster.run(500, until=lambda: r.status == "normal")
+        # If the WAL replay rebuilt the reply it's already fine; otherwise
+        # the repair path must refill it from a peer.
+        cluster.run(4000, until=lambda: not r.sessions.missing_replies())
+        assert not r.sessions.missing_replies()
+        e = r.sessions.get(6)
+        assert e["reply"] is not None and e["reply"].valid()
+
+
+class TestFaultDetector:
+    def test_adapts_to_observed_rate(self):
+        fd = FaultDetector(suspect_multiplier=4.0)
+        t = 0
+        for _ in range(100):
+            t += 100 * MS
+            fd.observe_progress(t)
+        # EWMA converged to ~100ms; deadline ~400ms.
+        assert 350 * MS < fd.deadline_ns() < 450 * MS
+        assert not fd.suspect(t + 300 * MS)
+        assert fd.suspect(t + 500 * MS)
+
+    def test_reset_restores_generous_deadline(self):
+        fd = FaultDetector(suspect_multiplier=4.0)
+        t = 0
+        for _ in range(50):
+            t += 60 * MS
+            fd.observe_progress(t)
+        fd.reset(t)
+        assert fd.deadline_ns() == 4 * fd.ceil_ns
+        assert not fd.suspect(t + 1000 * MS)
+
+
+class TestRepairBudget:
+    def test_spend_and_refill(self):
+        rb = RepairBudget(capacity=4, refill_interval_ns=50 * MS)
+        t = 10**9
+        for _ in range(4):
+            assert rb.spend(t)
+        assert not rb.spend(t)
+        assert rb.spend(t + 50 * MS)  # one token earned
+        assert not rb.spend(t + 50 * MS)
+        t2 = t + 50 * MS + 4 * 50 * MS
+        rb.refill(t2)
+        assert rb.tokens == 4  # capped at capacity
+
+
+class TestGridScrubber:
+    def _forest(self):
+        grid = Grid(MemoryDevice(8192 * 256), block_size=8192,
+                    block_count=256)
+        forest = Forest(grid, {"t": (8, 8)})
+        tree = forest.trees["t"]
+        for i in range(100):
+            tree.put(i.to_bytes(8, "big"), i.to_bytes(8, "little"))
+        tree.flush_memtable()
+        return grid, forest
+
+    def test_clean_tour_finds_nothing(self):
+        _, forest = self._forest()
+        scrubber = GridScrubber(forest, reads_per_tick=16)
+        while scrubber.cycles == 0:
+            assert scrubber.tick() == []
+        assert scrubber.checked > 0 and not scrubber.faults
+
+    def test_corrupt_block_surfaced(self):
+        grid, forest = self._forest()
+        table = forest.trees["t"].levels[0][0]
+        victim = table.block_addresses[0]
+        grid.device.data[victim.index * grid.block_size + 4] ^= 0xFF
+        scrubber = GridScrubber(forest, reads_per_tick=16)
+        found = []
+        while scrubber.cycles == 0:
+            found += scrubber.tick()
+        assert any(addr == victim for _, addr, _ in found)
+        assert victim.index in scrubber.faults

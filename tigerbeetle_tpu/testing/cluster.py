@@ -275,12 +275,22 @@ class Cluster:
                 # replicas at the same (checkpoint, commit) must match.
                 key = (r.superblock.op_checkpoint, r.commit_min)
                 by_ckpt.setdefault(key, []).append(i)
-        zones = self.layout.zone_offsets
-        glo, ghi = zones["grid"], zones["_end"]
+        bs = self.layout.grid_block_size
         for (ckpt, _), members in by_ckpt.items():
             if ckpt == 0 or len(members) < 2:
                 continue
-            grids = [bytes(self.storages[i].data[glo:ghi]) for i in members]
+            # Compare allocated blocks only: a state-synced replica never
+            # receives FREE blocks, whose stale bytes are unreachable and
+            # legitimately differ (the reference checker likewise compares
+            # checkpointed content, not raw free space).
+            frees = [self.replicas[i].durable.grid.free for i in members]
+            assert all(f == frees[0] for f in frees[1:]), \
+                f"free-set divergence at checkpoint {ckpt}: {members}"
+            allocated = [b for b, free in enumerate(frees[0]) if not free]
+            grids = [
+                tuple(self.storages[i].read("grid", b * bs, bs)
+                      for b in allocated)
+                for i in members]
             assert all(g == grids[0] for g in grids[1:]), \
                 f"grid divergence at checkpoint {ckpt}: replicas {members}"
             roots = []

@@ -85,6 +85,52 @@ def _unpack_event(raw: bytes) -> AccountEventRecord:
         amount_requested=amount_requested, amount=amount)
 
 
+def allocated_blocks(root_with_meta: bytes) -> list[int]:
+    """Grid block indices a checkpoint root reaches (the complement of its
+    free set) — the exact transfer set for state sync."""
+    from .. import ewah
+    from ..lsm.grid import ADDRESS_SIZE
+
+    root = root_with_meta[:-_META_SIZE]
+    (free_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE + 4)
+    free_blob = root[ADDRESS_SIZE + 8:ADDRESS_SIZE + 8 + free_size]
+    bits = ewah.decode_bitset(free_blob)
+    return [i for i, free in enumerate(bits) if not free]
+
+
+class _DictDevice:
+    """Read-only staging device over a {block index: raw bytes} dict — used
+    to validate state-synced blocks BEFORE they touch the live grid zone."""
+
+    def __init__(self, blocks: dict, block_size: int):
+        self.blocks = blocks
+        self.block_size = block_size
+
+    def read(self, off: int, size: int) -> bytes:
+        idx, within = divmod(off, self.block_size)
+        raw = self.blocks.get(idx, b"").ljust(self.block_size, b"\x00")
+        return raw[within:within + size]
+
+    def write(self, off: int, data: bytes) -> None:
+        raise RuntimeError("staging device is read-only")
+
+
+def validate_staged_checkpoint(blocks: dict, layout,
+                               root_forest: bytes) -> StateMachineOracle:
+    """Open a checkpoint root entirely from staged blocks; every read
+    validates its parent-held checksum, so success proves the transfer is
+    complete and uncorrupted. Raises on any fault — the caller must not
+    have written anything to the live grid yet."""
+    staged = DurableState.__new__(DurableState)
+    staged.grid = Grid(
+        _DictDevice(blocks, layout.grid_block_size),
+        block_size=layout.grid_block_size,
+        block_count=layout.grid_block_count)
+    staged.forest = Forest(staged.grid, SCHEMA)
+    staged.events_persisted = 0
+    return staged.open(root_forest)
+
+
 class _ZoneDevice:
     """Adapter: a storage zone as the grid's flat byte device."""
 

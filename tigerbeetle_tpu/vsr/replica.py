@@ -26,9 +26,14 @@ Protocol summary (faithful to VSR; simplified where noted):
   snapshot slot, then the superblock flips — an incremental checkpoint, like
   the reference's grid + checkpoint trailer (docs/internals/data_file.md).
 
-Omitted in round 1 (tracked for later rounds): standbys, state sync for
-replicas that fell behind WAL wrap (they currently halt and must be
-reformatted), protocol-aware NACK recovery, request hedging.
+State sync (docs/internals/sync.md): a replica that fell behind the WAL
+wrap jumps to a peer's checkpoint — the peer offers its checkpoint root in
+response to an unserviceable request_prepare, the lagging replica fetches
+the reachable grid blocks (request_blocks/block) and installs checkpoint +
+sessions + superblock atomically.
+
+Omitted in round 1 (tracked for later rounds): standbys, protocol-aware
+NACK recovery, request hedging.
 """
 
 from __future__ import annotations
@@ -39,10 +44,16 @@ from typing import Callable, Optional
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
 from ..state_machine import StateMachine
 from ..types import Operation
+import struct
+
 from .checksum import checksum
+from .client_sessions import ClientSessions
 from .durable import DurableState
+from .fault_detector import FaultDetector
+from .grid_scrubber import GridScrubber
 from .header import HEADER_SIZE, Command, Header, Message
 from .journal import Journal
+from .repair_budget import RepairBudget
 from .storage import Storage
 from .superblock import SuperBlock
 
@@ -88,6 +99,10 @@ class Replica:
         self.state_machine: StateMachine = state_machine_factory()
         self.durable = DurableState(storage)
         self.superblock: Optional[SuperBlock] = None
+        self.fault_detector = FaultDetector(suspect_multiplier=4.0)
+        self.repair_budget = RepairBudget()
+        self.scrubber = GridScrubber(self.durable.forest)
+        self._scrub_phase = 0
 
         self.status = "recovering"
         self.view = 0
@@ -99,8 +114,8 @@ class Replica:
 
         # Primary pipeline: op -> {"message": Message, "oks": set[replica]}
         self.pipeline: dict[int, dict] = {}
-        # Client sessions: client_id -> {"request": int, "reply": Message}
-        self.sessions: dict[int, dict] = {}
+        # Durable session table + latest replies (client_replies zone).
+        self.sessions = ClientSessions(storage)
         # View change collection state.
         self.svc_votes: dict[int, set[int]] = {}
         self.dvc_messages: dict[int, dict[int, Message]] = {}
@@ -110,6 +125,12 @@ class Replica:
         self.canonical: dict[int, int] = {}
         # Repair bookkeeping.
         self.repair_requested: dict[int, int] = {}  # op -> last request ns
+        # State-sync progress (None when not syncing).
+        self.syncing: Optional[dict] = None
+        # Scrub-detected corrupt blocks awaiting peer repair:
+        # block index -> (tree, address, size).
+        self.block_repair: dict[int, tuple] = {}
+        self._reply_repair_last = 0
 
         self.last_heartbeat_rx = 0
         self.last_heartbeat_tx = 0
@@ -125,7 +146,9 @@ class Replica:
         from ..multiversion import RELEASE
 
         durable = DurableState(storage)
-        root = durable.checkpoint(StateMachine().state)
+        sessions_blob = ClientSessions(storage).pack()
+        root = (durable.checkpoint(StateMachine().state)
+                + sessions_blob + struct.pack("<I", len(sessions_blob)))
         storage.write("snapshot", 0, root)
         sb = SuperBlock(
             cluster=cluster, replica_id=replica_id,
@@ -155,8 +178,12 @@ class Replica:
             sb.snapshot_size)
         assert checksum(root, domain=b"ckptroot") == sb.snapshot_checksum, \
             "checkpoint root corrupt"
+        # Root layout: forest-root || sessions-blob || u32 sessions length
+        # (reference: checkpoint trailer carries the client sessions too).
+        forest_root, sessions_blob = _split_root(root)
+        self.sessions.restore(sessions_blob)
         self.state_machine = self.state_machine_factory()
-        self.state_machine.state = self.durable.open(root)
+        self.state_machine.state = self.durable.open(forest_root)
 
         self.journal.recover()
         self.op = max(sb.op_checkpoint, self._journal_contiguous_max(sb.op_checkpoint))
@@ -219,6 +246,11 @@ class Replica:
             Command.start_view: self.on_start_view,
             Command.request_start_view: self.on_request_start_view,
             Command.request_prepare: self.on_request_prepare,
+            Command.request_reply: self.on_request_reply,
+            Command.reply: self.on_reply,
+            Command.headers: self.on_sync_offer,
+            Command.request_blocks: self.on_request_blocks,
+            Command.block: self.on_block,
             Command.ping: self.on_ping,
             Command.pong: self.on_pong,
         }.get(h.command)
@@ -239,8 +271,13 @@ class Replica:
         if session is not None:
             if h.request < session["request"]:
                 return  # stale duplicate
-            if h.request == session["request"] and session["reply"] is not None:
-                self.bus.send_to_client(h.client, session["reply"])
+            if h.request == session["request"]:
+                if session["reply"] is not None:
+                    self.bus.send_to_client(h.client, session["reply"])
+                else:
+                    # Reply bytes missing locally (torn slot / state sync):
+                    # repair from peers; the client's retry answers then.
+                    self._request_reply_repair(h.client)
                 return
         for entry in self.pipeline.values():
             eh = entry["message"].header
@@ -250,6 +287,9 @@ class Replica:
             return  # backpressure: client will retry
         if HEADER_SIZE + len(msg.body) > self.storage.layout.message_size_max:
             return  # would not fit THIS replica's journal slot (small layout)
+        if not _reply_fits(operation, len(msg.body),
+                           self.storage.layout.message_size_max):
+            return  # worst-case reply would not fit a message/reply slot
         if not self.state_machine.input_valid(operation, msg.body):
             return  # malformed body: never prepare it (client bug)
         self._primary_prepare(operation, msg.body, client=h.client,
@@ -308,6 +348,7 @@ class Replica:
         if self.is_primary:
             return
         self.last_heartbeat_rx = self.time.monotonic()
+        self.fault_detector.observe_progress(self.last_heartbeat_rx)
         if h.op <= self.op:
             held = self.journal.read_prepare(h.op)
             if held is None and self._chains_into_log(h):
@@ -396,6 +437,7 @@ class Replica:
         if self.is_primary:
             return
         self.last_heartbeat_rx = self.time.monotonic()
+        self.fault_detector.observe_progress(self.last_heartbeat_rx)
         self.commit_max = max(self.commit_max, msg.header.commit)
         self._commit_journal(self.commit_max)
 
@@ -430,15 +472,25 @@ class Replica:
         self.durable.flush(self.state_machine.state)
         self.durable.compact_beat(h.op)
         if h.client:
+            # Reply fields derive from the PREPARE (its view and original
+            # primary), never from this replica's identity/current view —
+            # replies must be byte-identical across replicas so checkpoints
+            # (which carry the session table) are byte-identical and reply
+            # slots are peer-repairable (reference: client_replies repair).
             reply_header = Header(
                 command=Command.reply, cluster=self.cluster,
-                replica=self.replica_id, view=self.view, op=h.op,
+                replica=h.replica, view=h.view, op=h.op,
                 client=h.client, request=h.request, commit=h.op,
                 context=h.checksum, operation=h.operation,
                 timestamp=h.timestamp,
             )
             reply = Message(reply_header.finalize(result), body=result)
-            self.sessions[h.client] = {"request": h.request, "reply": reply}
+            evicted = self.sessions.put_reply(h.client, h.request, reply)
+            if evicted is not None and self.is_primary:
+                ev = Header(
+                    command=Command.eviction, cluster=self.cluster,
+                    replica=self.replica_id, view=self.view, client=evicted)
+                self.bus.send_to_client(evicted, Message(ev.finalize()))
             if self.is_primary:
                 self.bus.send_to_client(h.client, reply)
         if self.commit_min % self.options.checkpoint_interval == 0:
@@ -450,7 +502,9 @@ class Replica:
         Only manifests + the free set are serialized — table data is already
         durable in the copy-on-write grid, so the flip is incremental."""
         sb = self.superblock
-        root = self.durable.checkpoint(self.state_machine.state)
+        sessions_blob = self.sessions.pack()
+        root = (self.durable.checkpoint(self.state_machine.state)
+                + sessions_blob + struct.pack("<I", len(sessions_blob)))
         assert len(root) <= self.storage.layout.snapshot_size_max, \
             "checkpoint root exceeds slot (raise snapshot_size_max)"
         slot = 1 - sb.snapshot_slot
@@ -602,6 +656,7 @@ class Replica:
         self._install_log(msg)
         self.commit_max = max(self.commit_max, h.commit)
         self.last_heartbeat_rx = self.time.monotonic()
+        self.fault_detector.reset(self.last_heartbeat_rx)
         self._commit_journal(self.commit_max)
 
     def on_request_start_view(self, msg: Message) -> None:
@@ -628,6 +683,190 @@ class Replica:
         m = self.journal.read_prepare(msg.header.op)
         if m is not None:
             self.bus.send_to_replica(msg.header.replica, m)
+        elif (self.superblock is not None
+              and msg.header.op <= self.superblock.op_checkpoint):
+            # We committed past this op and the WAL wrapped: the peer can
+            # never repair forward — offer our checkpoint instead
+            # (reference: state sync, docs/internals/sync.md:49-79).
+            self._send_sync_offer(msg.header.replica)
+
+    # ---------------------------------------------------------- state sync
+    #
+    # A replica that fell behind the cluster's WAL coverage jumps to a
+    # peer's checkpoint: it receives the checkpoint root blob (`headers`
+    # message), fetches every grid block the root reaches
+    # (`request_blocks`/`block` — reachability = the root's free-set
+    # complement), installs the blocks + root + superblock, and reopens its
+    # forest from them. Block integrity is validated transitively on open
+    # (every read checks the parent-held checksum), so a corrupted transfer
+    # aborts the install and the sync retries.
+
+    def _send_sync_offer(self, dst: int) -> None:
+        sb = self.superblock
+        root = self.storage.read(
+            "snapshot", sb.snapshot_slot * self.storage.layout.snapshot_size_max,
+            sb.snapshot_size)
+        header = Header(
+            command=Command.headers, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=sb.op_checkpoint,
+            commit=self.commit_max, context=sb.checkpoint_id)
+        self.bus.send_to_replica(dst, Message(header.finalize(root), body=root))
+
+    def on_sync_offer(self, msg: Message) -> None:
+        from . import durable as durable_mod
+
+        h = msg.header
+        if h.op <= self.commit_min:
+            return  # not ahead of us
+        if self.syncing is not None and self.syncing["target_op"] >= h.op:
+            return  # already syncing to an equal-or-newer target
+        try:
+            root_forest, _ = _split_root(msg.body)
+            needed = set(durable_mod.allocated_blocks(root_forest))
+        except Exception:
+            return  # malformed offer
+        self.syncing = {
+            "target_op": h.op, "root": msg.body, "source": h.replica,
+            "commit_max": h.commit, "needed": needed, "have": {},
+            "last_request": 0,
+        }
+        self._sync_request_blocks(self.time.monotonic())
+
+    def _sync_request_blocks(self, now: int) -> None:
+        sync = self.syncing
+        if sync is None:
+            return
+        if not sync["needed"]:
+            self._sync_install()
+            return
+        if now - sync["last_request"] < self.options.repair_interval_ns:
+            return
+        sync["last_request"] = now
+        missing = sorted(sync["needed"])[:64]
+        body = b"".join(struct.pack("<Q", i) for i in missing)
+        header = Header(
+            command=Command.request_blocks, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=sync["target_op"])
+        self.bus.send_to_replica(sync["source"],
+                                 Message(header.finalize(body), body=body))
+
+    def on_request_blocks(self, msg: Message) -> None:
+        block_size = self.storage.layout.grid_block_size
+        for off in range(0, len(msg.body), 8):
+            (index,) = struct.unpack_from("<Q", msg.body, off)
+            if index >= self.storage.layout.grid_block_count:
+                continue
+            raw = self.storage.read("grid", index * block_size, block_size)
+            header = Header(
+                command=Command.block, cluster=self.cluster,
+                replica=self.replica_id, view=self.view, op=index)
+            self.bus.send_to_replica(msg.header.replica,
+                                     Message(header.finalize(raw), body=raw))
+
+    def on_block(self, msg: Message) -> None:
+        index = msg.header.op
+        sync = self.syncing
+        if sync is not None and index in sync["needed"]:
+            sync["needed"].discard(index)
+            sync["have"][index] = msg.body
+            if not sync["needed"]:
+                self._sync_install()
+            return
+        # Scrub repair: a peer-provided copy of a corrupt block; install it
+        # only if it satisfies the referring structure's checksum.
+        fault = self.block_repair.get(index)
+        if fault is not None:
+            _, address, size = fault
+            block_size = self.storage.layout.grid_block_size
+            original = self.storage.read("grid", index * block_size, block_size)
+            self.storage.write("grid", index * block_size, msg.body)
+            try:
+                self.durable.grid.read_block(address, size)
+            except IOError:
+                self.storage.write("grid", index * block_size, original)
+                return
+            del self.block_repair[index]
+            self.scrubber.faults.pop(index, None)
+
+    def _sync_install(self) -> None:
+        from .durable import validate_staged_checkpoint
+
+        sync = self.syncing
+        block_size = self.storage.layout.grid_block_size
+        try:
+            # Validate the ENTIRE staged checkpoint before touching the live
+            # grid: a bad transfer must not clobber our current (still
+            # recoverable) checkpoint.
+            root = sync["root"]
+            forest_root, sessions_blob = _split_root(root)
+            validate_staged_checkpoint(
+                sync["have"], self.storage.layout, forest_root)
+        except Exception:
+            # Corrupted transfer or bad offer: drop and re-request later.
+            self.syncing = None
+            return
+        for index, raw in sorted(sync["have"].items()):
+            self.storage.write("grid", index * block_size, raw)
+        sb = self.superblock
+        slot = 1 - sb.snapshot_slot
+        self.storage.write(
+            "snapshot", slot * self.storage.layout.snapshot_size_max, root)
+        durable = DurableState(self.storage)
+        state = durable.open(forest_root)
+        self.sessions.restore(sessions_blob)
+        self.durable = durable
+        self.scrubber = GridScrubber(self.durable.forest)
+        self.block_repair.clear()
+        self.state_machine = self.state_machine_factory()
+        self.state_machine.state = state
+        sb.snapshot_slot = slot
+        sb.snapshot_size = len(root)
+        sb.snapshot_checksum = checksum(root, domain=b"ckptroot")
+        sb.op_checkpoint = sync["target_op"]
+        sb.commit_min = sync["target_op"]
+        sb.commit_max = max(sb.commit_max, sync["commit_max"])
+        sb.view = self.view
+        sb.log_view = self.log_view
+        sb.store(self.storage)
+        self.commit_min = sync["target_op"]
+        self.commit_max = max(self.commit_max, sync["commit_max"])
+        self.op = max(self.op, sync["target_op"])
+        self.prepare_timestamp = max(
+            self.prepare_timestamp,
+            self.state_machine.state.commit_timestamp)
+        for op in [o for o in self.repair_requested if o <= self.commit_min]:
+            del self.repair_requested[op]
+        self.syncing = None
+
+    # --------------------------------------------------------- reply repair
+
+    def _request_reply_repair(self, client: int) -> None:
+        """Ask peers for the durable reply bytes we lack (reference:
+        client_replies repair via request_reply / reply)."""
+        entry = self.sessions.get(client)
+        if entry is None or entry["reply"] is not None:
+            return
+        header = Header(
+            command=Command.request_reply, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, client=client,
+            context=entry["reply_checksum"])
+        msg = Message(header.finalize())
+        for r in range(self.replica_count):
+            if r != self.replica_id:
+                self.bus.send_to_replica(r, msg)
+
+    def on_request_reply(self, msg: Message) -> None:
+        entry = self.sessions.get(msg.header.client)
+        if entry is None or entry["reply"] is None:
+            return
+        if entry["reply_checksum"] != msg.header.context:
+            return  # we hold a different (older/newer) reply
+        self.bus.send_to_replica(msg.header.replica, entry["reply"])
+
+    def on_reply(self, msg: Message) -> None:
+        """A peer answered our request_reply (replicas otherwise never
+        receive reply messages)."""
+        self.sessions.repair_reply(msg.header.client, msg)
 
     def _repair(self, now: int) -> None:
         if now - self.last_repair_tick < self.options.repair_interval_ns:
@@ -659,6 +898,8 @@ class Replica:
                 continue
             if now - last < self.options.repair_interval_ns:
                 continue
+            if not self.repair_budget.spend(now):
+                break  # rate limit: repair must not starve the normal path
             self.repair_requested[op] = now
             header = Header(
                 command=Command.request_prepare, cluster=self.cluster,
@@ -667,6 +908,26 @@ class Replica:
             for r in range(self.replica_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
+        self._sync_request_blocks(now)  # re-request lost sync blocks
+        # Scrub repair: ask peers for fresh copies of corrupt blocks.
+        if self.block_repair and self.syncing is None \
+                and self.repair_budget.spend(now):
+            body = b"".join(struct.pack("<Q", i)
+                            for i in sorted(self.block_repair)[:16])
+            header = Header(
+                command=Command.request_blocks, cluster=self.cluster,
+                replica=self.replica_id, view=self.view)
+            msg = Message(header.finalize(body), body=body)
+            for r in range(self.replica_count):
+                if r != self.replica_id:
+                    self.bus.send_to_replica(r, msg)
+        # Reply repair: refill missing client replies from peers.
+        missing = self.sessions.missing_replies()
+        if missing and now - self._reply_repair_last >= \
+                4 * self.options.repair_interval_ns:
+            self._reply_repair_last = now
+            for client in missing[:8]:
+                self._request_reply_repair(client)
         self._commit_journal(self.commit_max)
 
     # ---------------------------------------------------------------- time
@@ -715,13 +976,49 @@ class Replica:
                     and self.state_machine.pulse_needed(self.prepare_timestamp)):
                 self._primary_prepare(Operation.pulse, b"")
         elif self.status == "normal":
-            if now - self.last_heartbeat_rx >= self.options.view_change_timeout_ns:
+            # Adaptive liveness: the EWMA fault detector may suspect the
+            # primary before the hard timeout (reference fault_detector +
+            # timeout battery); the hard timeout stays as the ceiling.
+            deadline = min(self.options.view_change_timeout_ns,
+                           max(self.fault_detector.deadline_ns(),
+                               2 * self.options.heartbeat_interval_ns))
+            if now - self.last_heartbeat_rx >= deadline:
                 self._start_view_change(self.view + 1)
         elif self.status == "view_change":
             if now - self.last_heartbeat_rx >= 2 * self.options.view_change_timeout_ns:
                 self.last_heartbeat_rx = now
                 self._start_view_change(self.view + 1)
         self._repair(now)
+        # Background scrub: a few grid block validations per phase window
+        # (reference: grid_scrubber.zig incremental tour); faults queue for
+        # peer repair (grids are byte-identical across replicas).
+        self._scrub_phase += 1
+        if self._scrub_phase % 64 == 0:
+            for name, address, size in self.scrubber.tick():
+                self.block_repair[address.index] = (name, address, size)
+
+
+def _split_root(root: bytes) -> tuple[bytes, bytes]:
+    """Checkpoint root blob -> (forest root, sessions blob). Layout:
+    forest-root || sessions-blob || u32 sessions length."""
+    (slen,) = struct.unpack_from("<I", root, len(root) - 4)
+    return root[:len(root) - 4 - slen], root[len(root) - 4 - slen:len(root) - 4]
+
+
+def _reply_fits(operation: Operation, body_len: int,
+                message_size_max: int) -> bool:
+    """Admission bound: the worst-case reply for `body_len` request bytes
+    must fit one message (and so the durable reply slot) — lookups amplify
+    16-byte ids into 128-byte records (reference: batch_max accounts for
+    both directions, src/state_machine.zig:336-380)."""
+    from ..state_machine import OPERATION_SPECS
+
+    spec = OPERATION_SPECS.get(operation)
+    if spec is None or spec.event_size == 0 or \
+            spec.result_size <= spec.event_size:
+        return True
+    worst = (body_len // spec.event_size) * spec.result_size
+    return HEADER_SIZE + worst + body_len <= message_size_max
 
 
 def _event_count(operation: Operation, body: bytes) -> int:
