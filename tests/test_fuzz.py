@@ -1,0 +1,38 @@
+"""Fuzz registry: every fuzzer runs clean on a couple of seeds, and the
+CLI surface works (reference: src/fuzz_tests.zig + `zig build fuzz`)."""
+
+import pytest
+
+from tigerbeetle_tpu.main import main
+from tigerbeetle_tpu.testing import fuzz
+
+FAST = ["ewah", "multi_batch", "superblock_quorums", "journal",
+        "client_sessions"]
+
+
+@pytest.mark.parametrize("name", FAST)
+@pytest.mark.parametrize("seed", [1, 2])
+def test_fast_fuzzers(name, seed):
+    fuzz.run(name, seed)
+
+
+@pytest.mark.parametrize("seed", [5])
+def test_lsm_tree_fuzzer(seed):
+    fuzz.run("lsm_tree", seed, iterations=4)
+
+
+@pytest.mark.parametrize("seed", [9])
+def test_state_machine_fuzzer(seed):
+    fuzz.run("state_machine", seed, iterations=30)
+
+
+def test_cli_list_and_unknown(capsys):
+    assert main(["fuzz", "list"]) == 0
+    out = capsys.readouterr().out.split()
+    assert set(FAST) <= set(out)
+    assert main(["fuzz", "not_a_fuzzer"]) == 1
+
+
+def test_cli_run(capsys):
+    assert main(["fuzz", "ewah", "3", "--iterations", "20"]) == 0
+    assert "OK" in capsys.readouterr().out

@@ -164,8 +164,16 @@ def cmd_recover(args) -> int:
                    replica_count=args.replica_count)
     # Persist the replayed state as a fresh forest checkpoint (the recovered
     # oracle's dirty sets cover every object, so this writes everything).
+    # The root carries the sessions trailer like every checkpoint root
+    # (empty: AOF replay has no client sessions to preserve).
+    import struct as _struct
+
+    from .vsr.client_sessions import ClientSessions
+
     durable = DurableState(storage)
-    root = durable.checkpoint(sm.state)
+    sessions_blob = ClientSessions(storage).pack()
+    root = (durable.checkpoint(sm.state)
+            + sessions_blob + _struct.pack("<I", len(sessions_blob)))
     storage.write("snapshot", 0, root)
     sb = SuperBlock.load(storage)
     sb.snapshot_slot = 0
@@ -199,6 +207,23 @@ def cmd_inspect(args) -> int:
     faulty = sum(1 for s in slots if s.state.value == "faulty")
     print(f"journal: {clean} clean, {faulty} faulty, "
           f"{len(slots) - clean - faulty} unknown; op_max={journal.op_max()}")
+    return 0
+
+
+def cmd_fuzz(args) -> int:
+    """Run a named fuzzer with a seed (reference: `zig build fuzz --
+    <name> <seed>`, src/fuzz_tests.zig registry)."""
+    from .testing import fuzz
+
+    if args.name == "list":
+        for name in fuzz.FUZZERS:
+            print(name)
+        return 0
+    if args.name != "smoke" and args.name not in fuzz.FUZZERS:
+        print(f"unknown fuzzer {args.name!r}; `fuzz list` shows them")
+        return 1
+    fuzz.run(args.name, args.seed, args.iterations)
+    print(f"fuzz {args.name} seed={args.seed}: OK")
     return 0
 
 
@@ -264,6 +289,13 @@ def main(argv=None) -> int:
     p.add_argument("--small", action="store_true")
     p.add_argument("path")
     p.set_defaults(fn=cmd_inspect)
+
+    p = sub.add_parser("fuzz")
+    p.add_argument("name", help="fuzzer name, 'smoke' (all briefly), "
+                   "or 'list'")
+    p.add_argument("seed", type=int, nargs="?", default=0)
+    p.add_argument("--iterations", type=int, default=None)
+    p.set_defaults(fn=cmd_fuzz)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

@@ -1,0 +1,319 @@
+"""Fuzzer registry: seed-replayable randomized checks per subsystem.
+
+reference: src/fuzz_tests.zig:35-57 (the named-fuzzer registry run as
+`zig build fuzz -- <name> <seed>`) — here `python -m tigerbeetle_tpu fuzz
+<name> <seed>`. Every fuzzer is a pure function of its seed: any failure
+reproduces from the command line.
+
+The int generator is bit-edge-biased like the reference's
+(src/state_machine_fuzz.zig:17-35): powers of two, off-by-ones, and type
+maxes are massively overrepresented because that is where validation code
+breaks.
+"""
+
+from __future__ import annotations
+
+import random
+from typing import Callable
+
+
+
+
+def int_edgy(prng: random.Random, bits: int = 128) -> int:
+    """Bit-edge-biased random int in [0, 2^bits)."""
+    roll = prng.random()
+    if roll < 0.2:
+        return prng.randrange(0, 4)
+    if roll < 0.4:
+        edge = 1 << prng.randrange(0, bits)
+        return (edge + prng.choice((-1, 0, 1))) % (1 << bits)
+    if roll < 0.5:
+        return (1 << bits) - 1 - prng.randrange(0, 4)
+    if roll < 0.75:
+        return prng.randrange(0, 1 << prng.randrange(1, bits))
+    return prng.randrange(0, 1 << bits)
+
+
+# ------------------------------------------------------------ fuzz targets
+
+def fuzz_ewah(prng: random.Random, iterations: int) -> None:
+    """Roundtrip random bitsets incl. long runs (reference: ewah fuzz)."""
+    from .. import ewah
+
+    for _ in range(iterations):
+        n = prng.randrange(1, 4096)
+        style = prng.random()
+        if style < 0.4:
+            bits = [prng.random() < 0.5 for _ in range(n)]
+        elif style < 0.7:
+            bits = [False] * n
+            for _ in range(prng.randrange(0, 8)):
+                bits[prng.randrange(n)] = True
+        else:
+            run = prng.randrange(1, n + 1)
+            bits = ([True] * run + [False] * (n - run))
+            prng.shuffle(bits)
+        blob = ewah.encode_bitset(bits)
+        assert ewah.decode_bitset(blob) == bits
+
+
+def fuzz_multi_batch(prng: random.Random, iterations: int) -> None:
+    """Roundtrip + malformed-trailer rejection (reference: vsr_multi_batch)."""
+    from .. import multi_batch
+
+    for _ in range(iterations):
+        element_size = prng.choice((1, 2, 8, 16, 64, 128))
+        batches = [
+            bytes(prng.randrange(256)
+                  for _ in range(element_size * prng.randrange(0, 8)))
+            for _ in range(prng.randrange(1, 6))]
+        body = multi_batch.encode(batches, element_size)
+        assert multi_batch.decode(body, element_size) == batches
+        # Mutate one byte: must either still decode or raise ValueError —
+        # never crash with anything else.
+        mutated = bytearray(body)
+        mutated[prng.randrange(len(mutated))] ^= 1 << prng.randrange(8)
+        try:
+            multi_batch.decode(bytes(mutated), element_size)
+        except ValueError:
+            pass
+
+
+def fuzz_superblock_quorums(prng: random.Random, iterations: int) -> None:
+    """Random torn/corrupt copy patterns must never elect a wrong quorum
+    (reference: vsr_superblock_quorums fuzz)."""
+    from ..vsr.storage import SUPERBLOCK_COPY_SIZE, TEST_LAYOUT, MemoryStorage
+    from ..vsr.superblock import SuperBlock
+
+    for _ in range(iterations):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sb = SuperBlock(cluster=7, replica_id=0, replica_count=1)
+        seqs = []
+        for _ in range(prng.randrange(1, 4)):
+            sb.commit_max += prng.randrange(0, 5)
+            sb.store(storage)
+            seqs.append((sb.sequence, sb.commit_max))
+        # Corrupt a random subset of copies.
+        for copy in range(4):
+            if prng.random() < 0.4:
+                off = copy * SUPERBLOCK_COPY_SIZE + prng.randrange(64)
+                storage.data[off] ^= 0xFF
+        got = SuperBlock.load(storage)
+        if got is not None:
+            assert (got.sequence, got.commit_max) in seqs, \
+                "elected a superblock state that was never stored"
+
+
+def fuzz_journal(prng: random.Random, iterations: int) -> None:
+    """Torn writes + bit rot across both WAL rings: recovery must classify
+    every slot and never adopt a corrupt prepare (reference: storage fuzz +
+    journal recovery decision table)."""
+    from ..vsr.header import HEADER_SIZE, Command, Header, Message
+    from ..vsr.journal import Journal
+    from ..vsr.storage import TEST_LAYOUT, MemoryStorage
+
+    for _ in range(iterations):
+        storage = MemoryStorage(TEST_LAYOUT)
+        journal = Journal(storage)
+        written = {}
+        for op in range(1, prng.randrange(2, 12)):
+            body = bytes(prng.randrange(256)
+                         for _ in range(prng.randrange(0, 64)))
+            h = Header(command=Command.prepare, cluster=1, op=op,
+                       timestamp=op)
+            msg = Message(h.finalize(body), body=body)
+            journal.append(msg)
+            written[op] = msg.header.checksum
+        # Random corruption in either ring.
+        zones = TEST_LAYOUT.zone_offsets
+        for _ in range(prng.randrange(0, 6)):
+            zone = prng.choice(("wal_headers", "wal_prepares"))
+            span = (TEST_LAYOUT.slot_count * HEADER_SIZE
+                    if zone == "wal_headers"
+                    else TEST_LAYOUT.slot_count * TEST_LAYOUT.message_size_max)
+            storage.data[zones[zone] + prng.randrange(span)] ^= 0xFF
+        fresh = Journal(storage)
+        fresh.recover()
+        for op, checksum_want in written.items():
+            msg = fresh.read_prepare(op)
+            if msg is not None:
+                assert msg.header.checksum == checksum_want
+                assert msg.valid()
+
+
+def fuzz_lsm_tree(prng: random.Random, iterations: int) -> None:
+    """Random put/remove/compaction vs a dict model; scans must agree
+    (reference: lsm_tree / lsm_forest fuzzers)."""
+    from ..lsm.forest import Forest
+    from ..lsm.grid import Grid, MemoryDevice
+
+    for _ in range(iterations):
+        grid = Grid(MemoryDevice(8192 * 512), block_size=8192,
+                    block_count=512)
+        forest = Forest(grid, {"t": (8, 16)})
+        tree = forest.trees["t"]
+        model: dict[bytes, bytes] = {}
+        op_n = 0
+        for _ in range(prng.randrange(10, 400)):
+            op_n += 1
+            key = int_edgy(prng, 20).to_bytes(8, "big")
+            if prng.random() < 0.85:
+                value = bytes(prng.randrange(256) for _ in range(16))
+                tree.put(key, value)
+                model[key] = value
+            else:
+                tree.remove(key)
+                model.pop(key, None)
+            if prng.random() < 0.2:
+                tree.compact_beat(op_n * 32)  # force bar boundaries
+            if prng.random() < 0.05:
+                root = forest.checkpoint()
+                fresh = Forest(grid, {"t": (8, 16)})
+                fresh.open(root)
+                tree = fresh.trees["t"]
+                forest = fresh
+        for key, value in model.items():
+            assert tree.get(key) == value
+        lo, hi = b"\x00" * 8, b"\xff" * 8
+        assert dict(tree.scan(lo, hi)) == model
+
+
+def fuzz_state_machine(prng: random.Random, iterations: int) -> None:
+    """Random op batches with bit-edge ints, kernel vs oracle differential
+    (reference: state_machine_fuzz — the poison-pill hunt)."""
+    from ..oracle.state_machine import StateMachineOracle
+    from ..state_machine import StateMachine
+    from ..types import Account, Transfer, TransferFlags
+
+    F = TransferFlags
+    flag_pool = [0, int(F.linked), int(F.pending),
+                 int(F.post_pending_transfer), int(F.void_pending_transfer),
+                 int(F.balancing_debit), int(F.balancing_credit),
+                 int(F.closing_debit) | int(F.pending),
+                 int(F.pending) | int(F.linked)]
+    kernel = StateMachine(engine="kernel")
+    oracle = StateMachineOracle()
+    ts = 10**9
+    next_id = 1
+    for _ in range(iterations):
+        ts += 10_000
+        if prng.random() < 0.25:
+            accounts = []
+            for _ in range(prng.randrange(1, 8)):
+                accounts.append(Account(
+                    id=int_edgy(prng, 8) or next_id, ledger=prng.choice((0, 1, 2)),
+                    code=prng.choice((0, 1)),
+                    flags=prng.choice((0, 1 << 1, 1 << 2, 1 << 3))))
+                next_id += 1
+            want = oracle.create_accounts(accounts, ts)
+            got = kernel.create_accounts(accounts, ts)
+        else:
+            transfers = []
+            for _ in range(prng.randrange(1, 12)):
+                transfers.append(Transfer(
+                    id=prng.choice((next_id, int_edgy(prng, 10))),
+                    debit_account_id=int_edgy(prng, 4),
+                    credit_account_id=int_edgy(prng, 4),
+                    amount=int_edgy(prng, 128),
+                    pending_id=int_edgy(prng, 10) if prng.random() < 0.4 else 0,
+                    timeout=prng.choice((0, 0, 1, 10, 0xFFFFFFFF)),
+                    ledger=prng.choice((0, 1, 2)), code=prng.choice((0, 1)),
+                    flags=prng.choice(flag_pool)))
+                next_id += 1
+            want = oracle.create_transfers(transfers, ts)
+            got = kernel.create_transfers(transfers, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+            [(r.timestamp, r.status) for r in want], "kernel/oracle diverged"
+
+
+def fuzz_client_sessions(prng: random.Random, iterations: int) -> None:
+    """Random put/evict/restore with torn reply slots (reference:
+    client_replies faults)."""
+    from ..vsr.client_sessions import ClientSessions
+    from ..vsr.header import Command, Header, Message
+    from ..vsr.storage import TEST_LAYOUT, MemoryStorage
+
+    for _ in range(iterations):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sessions = ClientSessions(storage)
+        model: dict[int, int] = {}
+        for _ in range(prng.randrange(1, 40)):
+            client = prng.randrange(1, 16)
+            request = model.get(client, 0) + 1
+            body = bytes(prng.randrange(256)
+                         for _ in range(prng.randrange(0, 128)))
+            h = Header(command=Command.reply, cluster=1, client=client,
+                       request=request)
+            evicted = sessions.put_reply(client, request,
+                                         Message(h.finalize(body), body=body))
+            model[client] = request
+            if evicted is not None:
+                del model[evicted]
+        blob = sessions.pack()
+        restored = ClientSessions(storage)
+        restored.restore(blob)
+        assert {c: e["request"] for c, e in restored.entries.items()} == model
+        for e in restored.entries.values():
+            assert e["reply"] is not None and e["reply"].valid()
+
+
+def fuzz_vopr_smoke(prng: random.Random, iterations: int) -> None:
+    """One short randomized cluster run per iteration (the full VOPR swarm
+    lives in tests/test_vopr.py; this is the registry's smoke entry)."""
+    from ..testing.cluster import Cluster, NetworkOptions
+    from ..types import Operation
+    from .. import multi_batch
+    from ..types import Account
+
+    MSN = 1_000_000
+    for _ in range(iterations):
+        cluster = Cluster(
+            seed=prng.randrange(1 << 30), replica_count=prng.choice((2, 3)),
+            network=NetworkOptions(
+                loss_probability=prng.choice((0.0, 0.05)),
+                duplicate_probability=prng.choice((0.0, 0.05)),
+                delay_min_ns=1 * MSN, delay_max_ns=20 * MSN))
+        client = cluster.client(1)
+        client.request(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        assert cluster.run(6000, until=lambda: client.idle), \
+            cluster.debug_status()
+        cluster.settle()
+
+
+FUZZERS: dict[str, Callable[[random.Random, int], None]] = {
+    "ewah": fuzz_ewah,
+    "multi_batch": fuzz_multi_batch,
+    "superblock_quorums": fuzz_superblock_quorums,
+    "journal": fuzz_journal,
+    "lsm_tree": fuzz_lsm_tree,
+    "state_machine": fuzz_state_machine,
+    "client_sessions": fuzz_client_sessions,
+    "vopr_smoke": fuzz_vopr_smoke,
+}
+
+DEFAULT_ITERATIONS = {
+    "ewah": 200,
+    "multi_batch": 300,
+    "superblock_quorums": 150,
+    "journal": 60,
+    "lsm_tree": 10,
+    "state_machine": 60,
+    "client_sessions": 80,
+    "vopr_smoke": 2,
+}
+
+
+def run(name: str, seed: int, iterations: int | None = None) -> None:
+    """Run one fuzzer (or 'smoke' = every fuzzer briefly)."""
+    if name == "smoke":
+        for sub in FUZZERS:
+            run(sub, seed,
+                iterations if iterations is not None
+                else max(1, DEFAULT_ITERATIONS[sub] // 10))
+        return
+    fuzzer = FUZZERS[name]
+    fuzzer(random.Random(seed),
+           iterations if iterations is not None
+           else DEFAULT_ITERATIONS[name])

@@ -85,6 +85,60 @@ def _unpack_event(raw: bytes) -> AccountEventRecord:
         amount_requested=amount_requested, amount=amount)
 
 
+def checkpoint_manifest(root_with_meta: bytes):
+    """(manifest BlockAddress, manifest size) of a checkpoint root."""
+    from ..lsm.grid import ADDRESS_SIZE, BlockAddress
+
+    address = BlockAddress.unpack(root_with_meta[:ADDRESS_SIZE])
+    (size,) = struct.unpack_from("<I", root_with_meta, ADDRESS_SIZE)
+    return address, size
+
+
+def manifest_children(manifest_raw: bytes) -> list:
+    """(tree name, key_size, TableInfo) per table referenced by a forest
+    manifest blob — the first expansion step of a checkpoint's block
+    reachability graph (used by delta state sync)."""
+    from ..lsm.table import TableInfo
+
+    out = []
+    (count,) = struct.unpack_from("<I", manifest_raw)
+    pos = 4
+    for _ in range(count):
+        name_len, size = struct.unpack_from("<HI", manifest_raw, pos)
+        pos += 6
+        name = manifest_raw[pos:pos + name_len].decode()
+        pos += name_len
+        raw = manifest_raw[pos:pos + size]
+        pos += size
+        key_size = SCHEMA[name][0]
+        (n_levels,) = struct.unpack_from("<B", raw)
+        tpos = 1
+        for _ in range(n_levels):
+            (n_tables,) = struct.unpack_from("<I", raw, tpos)
+            tpos += 4
+            for _ in range(n_tables):
+                info, tpos = TableInfo.unpack(raw, tpos)
+                out.append((name, key_size, info))
+    return out
+
+
+def index_children(index_raw: bytes, key_size: int) -> list:
+    """(BlockAddress, size) of every value block an index block references
+    (mirrors lsm.table.Table.__init__'s parse)."""
+    from ..lsm.grid import ADDRESS_SIZE, BlockAddress
+
+    (count,) = struct.unpack_from("<I", index_raw)
+    out = []
+    pos = 4
+    for _ in range(count):
+        addr = BlockAddress.unpack(index_raw[pos:pos + ADDRESS_SIZE])
+        pos += ADDRESS_SIZE
+        (size,) = struct.unpack_from("<I", index_raw, pos)
+        pos += 4 + key_size
+        out.append((addr, size))
+    return out
+
+
 def allocated_blocks(root_with_meta: bytes) -> list[int]:
     """Grid block indices a checkpoint root reaches (the complement of its
     free set) — the exact transfer set for state sync."""

@@ -40,6 +40,14 @@ class GridScrubber:
                     for i, addr in enumerate(table.block_addresses):
                         yield name, addr, table.block_sizes[i]
 
+    def still_referenced(self, address: BlockAddress) -> bool:
+        """True iff the CURRENT manifests still reach this exact address.
+        The tour iterator is lazy over live levels, so a block freed and
+        reused mid-tour can surface as a stale read failure — such an
+        address must never be queued for repair (peers hold the NEW content
+        too, so the repair could never converge)."""
+        return any(a == address for _, a, _ in self._blocks())
+
     def tick(self) -> list[tuple[str, BlockAddress, int]]:
         """Validate up to reads_per_tick blocks; returns faults found now
         (the replica queues them for peer repair via request_blocks)."""
@@ -57,6 +65,13 @@ class GridScrubber:
             try:
                 self.forest.grid.read_block(address, size)
             except IOError:
-                found.append((name, address, size))
-                self.faults[address.index] = (name, address, size)
+                if self.still_referenced(address):
+                    found.append((name, address, size))
+                    self.faults[address.index] = (name, address, size)
+        # Faults whose tables were since compacted away resolve themselves.
+        if self.faults:
+            live = {a for _, a, _ in self._blocks()}
+            for index in [i for i, (_, a, _) in self.faults.items()
+                          if a not in live]:
+                del self.faults[index]
         return found

@@ -722,15 +722,55 @@ class Replica:
             return  # already syncing to an equal-or-newer target
         try:
             root_forest, _ = _split_root(msg.body)
-            needed = set(durable_mod.allocated_blocks(root_forest))
+            manifest_addr, manifest_size = \
+                durable_mod.checkpoint_manifest(root_forest)
         except Exception:
             return  # malformed offer
         self.syncing = {
             "target_op": h.op, "root": msg.body, "source": h.replica,
-            "commit_max": h.commit, "needed": needed, "have": {},
+            "commit_max": h.commit,
+            # block index -> full zone-stride bytes (validated)
+            "have": {},
+            # block index -> (kind, address, size, key_size) to fetch
+            "needed": {},
             "last_request": 0,
         }
+        # Delta sync: expand the checkpoint's reachability graph from the
+        # manifest down, reusing every LOCAL block whose bytes already
+        # match its address checksum (copy-on-write checkpoints share most
+        # blocks, so a slightly-lagging replica transfers only the delta).
+        self._sync_resolve("manifest", manifest_addr, manifest_size, 0)
         self._sync_request_blocks(self.time.monotonic())
+
+    def _sync_resolve(self, kind: str, address, size: int,
+                      key_size: int) -> None:
+        from .checksum import checksum as _checksum
+
+        sync = self.syncing
+        index = address.index
+        if index in sync["have"] or index in sync["needed"]:
+            return
+        block_size = self.storage.layout.grid_block_size
+        if size <= block_size and index < self.storage.layout.grid_block_count:
+            local = self.storage.read("grid", index * block_size, block_size)
+            if _checksum(local[:size], domain=b"blk") == address.checksum:
+                sync["have"][index] = local
+                self._sync_expand(kind, local[:size], key_size)
+                return
+        sync["needed"][index] = (kind, address, size, key_size)
+
+    def _sync_expand(self, kind: str, raw: bytes, key_size: int) -> None:
+        from . import durable as durable_mod
+
+        if kind == "manifest":
+            for _name, child_key_size, info in \
+                    durable_mod.manifest_children(raw):
+                self._sync_resolve("index", info.index_address,
+                                   info.index_size, child_key_size)
+        elif kind == "index":
+            for addr, size in durable_mod.index_children(raw, key_size):
+                self._sync_resolve("value", addr, size, key_size)
+        # "value": leaf — nothing beneath.
 
     def _sync_request_blocks(self, now: int) -> None:
         sync = self.syncing
@@ -764,11 +804,19 @@ class Replica:
                                      Message(header.finalize(raw), body=raw))
 
     def on_block(self, msg: Message) -> None:
+        from .checksum import checksum as _checksum
+
         index = msg.header.op
         sync = self.syncing
         if sync is not None and index in sync["needed"]:
-            sync["needed"].discard(index)
+            kind, address, size, key_size = sync["needed"][index]
+            # Per-block validation against the parent-held checksum — a
+            # corrupt transfer is re-requested, never staged.
+            if _checksum(msg.body[:size], domain=b"blk") != address.checksum:
+                return
+            del sync["needed"][index]
             sync["have"][index] = msg.body
+            self._sync_expand(kind, msg.body[:size], key_size)
             if not sync["needed"]:
                 self._sync_install()
             return
@@ -909,7 +957,12 @@ class Replica:
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
         self._sync_request_blocks(now)  # re-request lost sync blocks
-        # Scrub repair: ask peers for fresh copies of corrupt blocks.
+        # Scrub repair: ask peers for fresh copies of corrupt blocks. A
+        # queued address whose table was compacted away meanwhile is moot —
+        # drop it rather than re-request forever.
+        for index in [i for i, (_, a, _) in self.block_repair.items()
+                      if not self.scrubber.still_referenced(a)]:
+            del self.block_repair[index]
         if self.block_repair and self.syncing is None \
                 and self.repair_budget.spend(now):
             body = b"".join(struct.pack("<Q", i)
