@@ -1,0 +1,319 @@
+"""AMQP 0.9.1 protocol + CDC AMQP sink against an in-process mini broker.
+
+reference: src/amqp.zig + src/amqp/{protocol,spec}.zig (own protocol
+implementation, no client library) and src/cdc/runner.zig (publish with
+confirms). The broker here implements the server side of the same subset,
+so both directions of the codec are exercised honestly over a real socket.
+"""
+
+import json
+import socket
+import struct
+import threading
+
+from tigerbeetle_tpu import amqp
+from tigerbeetle_tpu.amqp import (
+    BASIC_ACK,
+    BASIC_PUBLISH,
+    CHANNEL_OPEN,
+    CHANNEL_OPEN_OK,
+    CONFIRM_SELECT,
+    CONFIRM_SELECT_OK,
+    CONNECTION_CLOSE,
+    CONNECTION_CLOSE_OK,
+    CONNECTION_OPEN,
+    CONNECTION_OPEN_OK,
+    CONNECTION_START,
+    CONNECTION_START_OK,
+    CONNECTION_TUNE,
+    CONNECTION_TUNE_OK,
+    EXCHANGE_DECLARE,
+    EXCHANGE_DECLARE_OK,
+    FRAME_BODY,
+    FRAME_HEADER,
+    PROTOCOL_HEADER,
+    QUEUE_BIND,
+    QUEUE_BIND_OK,
+    QUEUE_DECLARE,
+    QUEUE_DECLARE_OK,
+    Frame,
+    field_table,
+    longstr,
+    method_frame,
+    shortstr,
+)
+
+
+class MiniBroker:
+    """Single-connection AMQP 0.9.1 server: handshake, declarations,
+    publishes (stored), confirms."""
+
+    def __init__(self):
+        self.listener = socket.socket()
+        self.listener.bind(("127.0.0.1", 0))
+        self.listener.listen(1)
+        self.port = self.listener.getsockname()[1]
+        self.messages = []  # (exchange, routing_key, body)
+        self.declared_exchanges = []
+        self.declared_queues = []
+        self.bindings = []
+        self.auth = None
+        self.thread = threading.Thread(target=self._serve, daemon=True)
+        self.thread.start()
+
+    def _serve(self):
+        sock, _ = self.listener.accept()
+        rx = bytearray()
+
+        def recv_frame():
+            while True:
+                got = Frame.parse(rx)
+                if got is not None:
+                    return got
+                chunk = sock.recv(64 * 1024)
+                if not chunk:
+                    return None
+                rx.extend(chunk)
+
+        header = b""
+        while len(header) < 8:
+            header += sock.recv(8 - len(header))
+        assert header == PROTOCOL_HEADER, header
+        sock.sendall(method_frame(
+            0, CONNECTION_START,
+            struct.pack(">BB", 0, 9) + field_table({"product": "mini"})
+            + longstr(b"PLAIN") + longstr(b"en_US")))
+
+        delivery_tag = 0
+        pending = None
+        body_size = 0
+        body = b""
+        while True:
+            got = recv_frame()
+            if got is None:
+                break
+            method = got.method
+            if method == CONNECTION_START_OK:
+                args = got.args()
+                args.table()
+                mechanism = args.shortstr()
+                response = args.longstr()
+                self.auth = (mechanism, response)
+                sock.sendall(method_frame(0, CONNECTION_TUNE, struct.pack(
+                    ">HIH", 0, 128 * 1024, 0)))
+            elif method == CONNECTION_TUNE_OK:
+                pass
+            elif method == CONNECTION_OPEN:
+                sock.sendall(method_frame(0, CONNECTION_OPEN_OK, b"\x00"))
+            elif method == CHANNEL_OPEN:
+                sock.sendall(method_frame(
+                    got.channel, CHANNEL_OPEN_OK, longstr(b"")))
+            elif method == EXCHANGE_DECLARE:
+                args = got.args()
+                args.u16()
+                self.declared_exchanges.append(
+                    (args.shortstr(), args.shortstr()))
+                sock.sendall(method_frame(got.channel, EXCHANGE_DECLARE_OK))
+            elif method == QUEUE_DECLARE:
+                args = got.args()
+                args.u16()
+                name = args.shortstr()
+                self.declared_queues.append(name)
+                sock.sendall(method_frame(
+                    got.channel, QUEUE_DECLARE_OK,
+                    shortstr(name) + struct.pack(">II", 0, 0)))
+            elif method == QUEUE_BIND:
+                args = got.args()
+                args.u16()
+                self.bindings.append(
+                    (args.shortstr(), args.shortstr(), args.shortstr()))
+                sock.sendall(method_frame(got.channel, QUEUE_BIND_OK))
+            elif method == CONFIRM_SELECT:
+                sock.sendall(method_frame(got.channel, CONFIRM_SELECT_OK))
+            elif method == BASIC_PUBLISH:
+                args = got.args()
+                args.u16()
+                pending = (args.shortstr(), args.shortstr())
+            elif method == CONNECTION_CLOSE:
+                sock.sendall(method_frame(0, CONNECTION_CLOSE_OK))
+                break
+            elif got.type == FRAME_HEADER and pending is not None:
+                _, _, body_size, _ = struct.unpack_from(">HHQH", got.payload)
+                body = b""
+                if body_size == 0:
+                    self._deliver(sock, got.channel, pending, b"")
+                    delivery_tag += 1
+                    pending = None
+            elif got.type == FRAME_BODY and pending is not None:
+                body += got.payload
+                if len(body) >= body_size:
+                    delivery_tag += 1
+                    self.messages.append((*pending, body))
+                    sock.sendall(method_frame(
+                        got.channel, BASIC_ACK,
+                        struct.pack(">QB", delivery_tag, 0)))
+                    pending = None
+        sock.close()
+
+    def _deliver(self, sock, channel, pending, body):
+        self.messages.append((*pending, body))
+        sock.sendall(method_frame(channel, BASIC_ACK,
+                                  struct.pack(">QB", 1, 0)))
+
+    def close(self):
+        self.listener.close()
+
+
+class TestAmqpClient:
+    def test_handshake_declare_publish_confirm(self):
+        broker = MiniBroker()
+        client = amqp.AmqpClient("127.0.0.1", broker.port,
+                                 user="svc", password="secret")
+        try:
+            client.exchange_declare("tb.cdc", "topic")
+            client.queue_declare("audit")
+            client.queue_bind("audit", "tb.cdc", "cdc.#")
+            client.confirm_select()
+            client.publish("tb.cdc", "cdc.single_phase", b"hello")
+            client.publish("tb.cdc", "cdc.two_phase_pending", b"x" * 300_000)
+            client.wait_confirms()
+        finally:
+            client.close()
+            broker.close()
+        assert broker.auth == ("PLAIN", b"\x00svc\x00secret")
+        assert ("tb.cdc", "topic") in broker.declared_exchanges
+        assert "audit" in broker.declared_queues
+        assert ("audit", "tb.cdc", "cdc.#") in broker.bindings
+        assert broker.messages[0] == ("tb.cdc", "cdc.single_phase", b"hello")
+        ex, rk, body = broker.messages[1]
+        assert rk == "cdc.two_phase_pending" and body == b"x" * 300_000
+
+    def test_wait_confirms_out_of_order_and_multiple(self):
+        """Acks may arrive out of order and with `multiple` set; a nack is
+        a delivery failure (AMQP 0.9.1 publisher-confirms semantics)."""
+        client = amqp.AmqpClient.__new__(amqp.AmqpClient)
+        client.confirm_mode = True
+        client.outstanding = {1, 2, 3}
+        acks = [
+            amqp.Frame(amqp.FRAME_METHOD, 1,
+                       struct.pack(">HHQB", 60, 80, 3, 0)),  # ack tag 3
+            amqp.Frame(amqp.FRAME_METHOD, 1,
+                       struct.pack(">HHQB", 60, 80, 2, 1)),  # ack <=2
+        ]
+        client._recv_frame = lambda: acks.pop(0)
+        client.wait_confirms()
+        assert client.outstanding == set()
+
+        client.outstanding = {1}
+        nack = amqp.Frame(amqp.FRAME_METHOD, 1,
+                          struct.pack(">HHQB", 60, 120, 1, 0))
+        client._recv_frame = lambda: nack
+        try:
+            client.wait_confirms()
+            assert False, "nack must raise"
+        except amqp.ProtocolError as e:
+            assert "nacked" in str(e)
+
+    def test_frame_roundtrip_and_parse_publishes(self):
+        raw = (method_frame(1, BASIC_PUBLISH,
+                            struct.pack(">H", 0) + shortstr("e")
+                            + shortstr("k") + b"\x00")
+               + amqp.content_frames(1, b"payload"))
+        got = list(amqp.parse_publishes(raw))
+        assert got == [("e", "k", b"payload")]
+
+
+class TestAmqpCommand:
+    def test_cdc_pump_from_live_replica(self, tmp_path):
+        """format -> start -> commit transfers -> `amqp --once` pumps the
+        change events into the broker (reference: `tigerbeetle amqp`)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        from tigerbeetle_tpu.main import _parse_addresses, main
+        from tigerbeetle_tpu.types import Account, Transfer
+        from tigerbeetle_tpu.vsr.client import Client
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        address = f"127.0.0.1:{port}"
+        path = tmp_path / "r0.tigerbeetle"
+        subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "format", "--cluster=4",
+             "--replica=0", "--replica-count=1", "--small", str(path)],
+            check=True, cwd="/root/repo", timeout=60,
+            stdout=subprocess.DEVNULL)
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tigerbeetle_tpu", "start",
+             f"--addresses={address}", "--replica=0", "--cluster=4",
+             "--engine=oracle", "--small", str(path)],
+            cwd="/root/repo", env=dict(os.environ),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        broker = MiniBroker()
+        try:
+            client = Client(cluster=4, client_id=5,
+                            replica_addresses=_parse_addresses(address))
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                try:
+                    client.create_accounts([Account(id=1, ledger=1, code=1),
+                                            Account(id=2, ledger=1, code=1)])
+                    break
+                except TimeoutError:
+                    continue
+            client.create_transfers([
+                Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                         amount=9, ledger=1, code=1)])
+            client.close()
+            rc = main(["amqp", f"--addresses={address}", "--cluster=4",
+                       f"--amqp=127.0.0.1:{broker.port}", "--once"])
+            assert rc == 0
+        finally:
+            broker.close()
+            proc.send_signal(signal.SIGINT)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        assert len(broker.messages) == 1
+        record = json.loads(broker.messages[0][2])
+        assert record["transfer_id"] == 10 and record["transfer_amount"] == 9
+
+
+class TestCdcAmqpSink:
+    def test_runner_publishes_change_events_with_confirms(self):
+        from tigerbeetle_tpu.cdc import AmqpSink, CDCRunner
+        from tigerbeetle_tpu.state_machine import StateMachine
+        from tigerbeetle_tpu.types import Account, Transfer, TransferFlags
+
+        sm = StateMachine(engine="oracle")
+        ts = 10**9
+        sm.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in (1, 2)], ts)
+        ts += 1000
+        sm.create_transfers(
+            [Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                      amount=5, ledger=1, code=1),
+             Transfer(id=11, debit_account_id=1, credit_account_id=2,
+                      amount=3, ledger=1, code=1,
+                      flags=int(TransferFlags.pending))], ts)
+
+        broker = MiniBroker()
+        sink = AmqpSink("127.0.0.1", broker.port)
+        try:
+            runner = CDCRunner(sm, sink)
+            published = runner.run_until_idle()
+        finally:
+            sink.close()
+            broker.close()
+        assert published == 2
+        keys = [rk for _, rk, _ in broker.messages]
+        assert keys == ["cdc.single_phase", "cdc.two_phase_pending"]
+        record = json.loads(broker.messages[0][2])
+        assert record["transfer_amount"] == 5
+        assert record["type"] == "single_phase"

@@ -191,3 +191,10 @@ def test_inspect_after_shutdown(cluster_processes):
     assert out.returncode == 0
     assert "superblock: cluster=7" in out.stdout
     assert "journal:" in out.stdout
+    # Full-file verification (reference: inspect_integrity.zig).
+    out = subprocess.run(
+        [sys.executable, "-m", "tigerbeetle_tpu", "inspect", "--small",
+         "--integrity", str(tmp_path / "r0.tigerbeetle")],
+        capture_output=True, text=True, cwd="/root/repo", timeout=60)
+    assert out.returncode == 0, out.stdout
+    assert "0 fault(s)" in out.stdout

@@ -2,9 +2,8 @@
 
 reference: src/cdc/runner.zig — polls the cluster for change events past a
 progress watermark and publishes them to RabbitMQ with at-least-once
-delivery. The transport here is a pluggable Sink (the environment has no
-AMQP broker; a JSONL file sink and a callback sink are provided — the AMQP
-0.9.1 client maps onto the same interface in a later round).
+delivery. Sinks: AMQP 0.9.1 with publisher confirms (amqp.py, the
+reference's transport), a JSONL file sink, and a callback sink.
 """
 
 from __future__ import annotations
@@ -48,6 +47,35 @@ class JsonlSink:
 
     def close(self) -> None:
         self.file.close()
+
+
+class AmqpSink:
+    """Publish change events to an AMQP 0.9.1 exchange with confirms
+    (reference: src/cdc/runner.zig + src/amqp.zig). The watermark only
+    advances after `flush()` saw every broker ack — at-least-once."""
+
+    def __init__(self, host: str, port: int, *, exchange: str = "tb.cdc",
+                 routing_prefix: str = "cdc", **connect_kwargs):
+        from .amqp import AmqpClient
+
+        self.client = AmqpClient(host, port, **connect_kwargs)
+        self.exchange = exchange
+        self.routing_prefix = routing_prefix
+        self.client.exchange_declare(exchange, "topic", durable=True)
+        self.client.confirm_select()
+
+    def publish(self, event: ChangeEvent) -> None:
+        record = dataclasses.asdict(event)
+        record["type"] = event.type.name
+        routing_key = f"{self.routing_prefix}.{event.type.name}"
+        self.client.publish(self.exchange, routing_key,
+                            json.dumps(record).encode())
+
+    def flush(self) -> None:
+        self.client.wait_confirms()
+
+    def close(self) -> None:
+        self.client.close()
 
 
 class CDCRunner:
