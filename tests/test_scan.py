@@ -1,0 +1,221 @@
+"""LSM scan machinery: k-way merge, zig-zag intersection, seekable tree
+scans, and the forest query engine (differential vs the host indexes).
+
+reference analogs: src/lsm/k_way_merge.zig, zig_zag_merge.zig,
+scan_tree.zig, scan_builder.zig, composite_key.zig.
+"""
+
+import random
+
+from tigerbeetle_tpu.lsm.forest import Forest
+from tigerbeetle_tpu.lsm.grid import Grid, MemoryDevice
+from tigerbeetle_tpu.lsm.k_way_merge import k_way_merge
+from tigerbeetle_tpu.lsm.query import ForestQuery
+from tigerbeetle_tpu.lsm.scan import (
+    TreeScan,
+    composite_key,
+    intersect_scans,
+    union_scans,
+)
+from tigerbeetle_tpu.lsm.zig_zag_merge import zig_zag_intersect
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFilter,
+    AccountFilterFlags as AFF,
+    Transfer,
+    TransferFlags,
+)
+from tigerbeetle_tpu.vsr.durable import DurableState
+from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+
+class TestKWayMerge:
+    def test_merge_dedupes_newest_first(self):
+        newest = [(b"a", 1), (b"c", 1)]
+        older = [(b"a", 2), (b"b", 2), (b"c", 2), (b"d", 2)]
+        got = list(k_way_merge([newest, older]))
+        assert got == [(b"a", 1), (b"b", 2), (b"c", 1), (b"d", 2)]
+
+    def test_merge_random_against_sorted(self):
+        rng = random.Random(5)
+        sources = []
+        expected = {}
+        for i in range(6):
+            items = sorted(
+                (rng.randrange(500).to_bytes(2, "big"), (i, k))
+                for k in range(rng.randrange(0, 80)))
+            # dedupe within a source (sorted uniq)
+            uniq = dict(items)
+            sources.append(sorted(uniq.items()))
+            for key, value in uniq.items():
+                if key not in expected:
+                    expected[key] = value
+        # lowest source index wins: build expected accordingly
+        expected = {}
+        for i in reversed(range(len(sources))):
+            for key, value in sources[i]:
+                expected[key] = value
+        got = dict(k_way_merge(sources))
+        assert got == expected
+        assert list(got) == sorted(got)
+
+
+class TestZigZag:
+    class _Stream:
+        def __init__(self, keys):
+            self.keys = sorted(keys)
+            self.pos = 0
+
+        def peek(self):
+            return self.keys[self.pos] if self.pos < len(self.keys) else None
+
+        def next(self):
+            self.pos += 1
+
+        def seek(self, key):
+            while self.pos < len(self.keys) and self.keys[self.pos] < key:
+                self.pos += 1
+
+    def test_intersection(self):
+        a = self._Stream([1, 3, 5, 7, 9, 11])
+        b = self._Stream([2, 3, 4, 7, 11, 12])
+        c = self._Stream([3, 7, 8, 11])
+        assert list(zig_zag_intersect([a, b, c])) == [3, 7, 11]
+
+    def test_random_against_set_intersection(self):
+        rng = random.Random(9)
+        for _ in range(30):
+            sets = [set(rng.sample(range(200), rng.randrange(1, 60)))
+                    for _ in range(rng.randrange(2, 5))]
+            want = sorted(set.intersection(*sets))
+            got = list(zig_zag_intersect(
+                [self._Stream(sorted(s)) for s in sets]))
+            assert got == want
+
+
+def _tree_with(entries, removes=()):
+    grid = Grid(MemoryDevice(8192 * 256), block_size=8192, block_count=256)
+    forest = Forest(grid, {"t": (8, 8)})
+    tree = forest.trees["t"]
+    op = 0
+    for k, v in entries:
+        tree.put(k, v)
+        op += 1
+        if op % 7 == 0:
+            tree.compact_beat(op * 32)  # scatter tables across levels
+    for k in removes:
+        tree.remove(k)
+    return tree
+
+
+class TestTreeScan:
+    def test_streaming_matches_model_and_seek(self):
+        rng = random.Random(3)
+        model = {}
+        entries = []
+        for _ in range(300):
+            k = rng.randrange(1000).to_bytes(8, "big")
+            v = rng.randrange(2**32).to_bytes(8, "big")
+            entries.append((k, v))
+            model[k] = v
+        removes = rng.sample(sorted(model), 20)
+        tree = _tree_with(entries, removes)
+        for k in removes:
+            del model[k]
+        lo, hi = (100).to_bytes(8, "big"), (800).to_bytes(8, "big")
+        want = sorted((k, v) for k, v in model.items() if lo <= k <= hi)
+        assert list(TreeScan(tree, lo, hi)) == want
+        # seek jumps forward without replaying skipped keys
+        scan = TreeScan(tree, lo, hi)
+        mid = (400).to_bytes(8, "big")
+        scan.seek(mid)
+        rest = list(scan)
+        assert rest == [(k, v) for k, v in want if k >= mid]
+
+    def test_union_and_intersection_of_scans(self):
+        t1 = _tree_with([(i.to_bytes(8, "big"), b"1" * 8)
+                         for i in range(0, 100, 2)])
+        t2 = _tree_with([(i.to_bytes(8, "big"), b"2" * 8)
+                         for i in range(0, 100, 3)])
+        lo, hi = (0).to_bytes(8, "big"), (99).to_bytes(8, "big")
+        union = [int.from_bytes(k, "big")
+                 for k, _ in union_scans([TreeScan(t1, lo, hi),
+                                          TreeScan(t2, lo, hi)])]
+        assert union == sorted(set(range(0, 100, 2)) | set(range(0, 100, 3)))
+        inter = [int.from_bytes(k, "big")
+                 for k in intersect_scans([TreeScan(t1, lo, hi),
+                                           TreeScan(t2, lo, hi)])]
+        assert inter == sorted(set(range(0, 100, 2)) & set(range(0, 100, 3)))
+
+
+class TestForestQuery:
+    def _build(self, seed=17, n=300):
+        """StateMachine + DurableState flushed through checkpoints."""
+        rng = random.Random(seed)
+        sm = StateMachine(engine="oracle")
+        storage = MemoryStorage(TEST_LAYOUT)
+        durable = DurableState(storage)
+        ts = 10**9
+        sm.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 9)], ts)
+        durable.flush(sm.state)
+        tid = 1000
+        for batch in range(6):
+            ts += 10_000
+            events = []
+            for _ in range(n // 6):
+                dr = rng.randrange(1, 9)
+                cr = rng.randrange(1, 9)
+                if cr == dr:
+                    cr = dr % 8 + 1
+                events.append(Transfer(
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=rng.randrange(1, 50), ledger=1,
+                    code=rng.choice((1, 2)),
+                    user_data_64=rng.choice((0, 7)),
+                    flags=int(TransferFlags.pending) if rng.random() < 0.2
+                    else 0))
+                tid += 1
+            sm.create_transfers(events, ts)
+            durable.flush(sm.state)
+            durable.compact_beat(batch * 32)
+        durable.checkpoint(sm.state)
+        return sm, durable
+
+    def test_differential_vs_host_indexes(self):
+        sm, durable = self._build()
+        query = ForestQuery(durable.forest)
+        filters = [
+            AccountFilter(account_id=1, limit=8190,
+                          flags=int(AFF.debits | AFF.credits)),
+            AccountFilter(account_id=3, limit=8190, flags=int(AFF.debits)),
+            AccountFilter(account_id=5, limit=8190, flags=int(AFF.credits)),
+            AccountFilter(account_id=2, limit=10,
+                          flags=int(AFF.debits | AFF.credits)),
+            AccountFilter(account_id=4, limit=8190, code=2,
+                          flags=int(AFF.debits | AFF.credits)),
+            AccountFilter(account_id=6, limit=8190, user_data_64=7,
+                          flags=int(AFF.debits | AFF.credits)),
+            AccountFilter(account_id=7, limit=5,
+                          flags=int(AFF.debits | AFF.credits | AFF.reversed)),
+            AccountFilter(account_id=8, limit=8190,
+                          timestamp_min=10**9 + 20_000,
+                          timestamp_max=10**9 + 40_000,
+                          flags=int(AFF.debits | AFF.credits)),
+        ]
+        for f in filters:
+            want = sm.get_account_transfers(f)
+            got = query.get_account_transfers(f)
+            assert got == want, f"filter {f} diverged"
+
+    def test_queries_survive_reopen(self):
+        sm, durable = self._build(seed=23)
+        root = durable.checkpoint(sm.state)
+        storage = durable.grid.device.storage
+        fresh = DurableState(storage)
+        fresh.open(root)
+        query = ForestQuery(fresh.forest)
+        f = AccountFilter(account_id=1, limit=8190,
+                          flags=int(AFF.debits | AFF.credits))
+        assert query.get_account_transfers(f) == sm.get_account_transfers(f)

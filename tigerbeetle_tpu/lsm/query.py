@@ -1,0 +1,90 @@
+"""Forest query engine: secondary-index scans resolved to objects.
+
+reference: src/lsm/scan_builder.zig (composing index conditions into
+union/intersection scans) + scan_lookup.zig (resolving matched timestamps
+to objects) as used by get_account_transfers / get_account_balances
+(src/state_machine.zig:1737-1831). This is the on-disk query path over the
+durable forest — it must return exactly what the state machine's in-memory
+indexes return (differential-tested).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+from ..constants import TIMESTAMP_MAX
+from ..types import AccountFilter, AccountFilterFlags, Transfer
+from .forest import Forest
+from .k_way_merge import k_way_merge
+from .scan import TreeScan, composite_key
+
+_TS_MIN_KEY = (0).to_bytes(8, "big")
+_TS_MAX_KEY = (TIMESTAMP_MAX + 1).to_bytes(8, "big")
+
+
+class ForestQuery:
+    def __init__(self, forest: Forest):
+        self.forest = forest
+
+    # ---------------------------------------------------------- primitives
+
+    def _index_scan(self, tree_name: str, prefix: int,
+                    ts_min: int, ts_max: int) -> TreeScan:
+        tree = self.forest.trees[tree_name]
+        return TreeScan(
+            tree,
+            composite_key(prefix, ts_min, 16),
+            composite_key(prefix, ts_max, 16))
+
+    def transfer_by_timestamp(self, timestamp: int) -> Optional[Transfer]:
+        tid = self.forest.trees["xfer_by_ts"].get(
+            timestamp.to_bytes(8, "big"))
+        if tid is None:
+            return None
+        raw = self.forest.trees["transfers"].get(tid)
+        return None if raw is None else Transfer.unpack(raw)
+
+    # ------------------------------------------------------------- queries
+
+    def account_transfer_timestamps(self, f: AccountFilter) -> Iterator[int]:
+        """Ascending matching timestamps for an AccountFilter's
+        debits/credits index conditions (the OR side; user_data/code
+        predicates apply at lookup)."""
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TIMESTAMP_MAX
+        scans = []
+        if f.flags & AccountFilterFlags.debits:
+            scans.append(self._index_scan(
+                "xfer_by_dr", f.account_id, ts_min, ts_max))
+        if f.flags & AccountFilterFlags.credits:
+            scans.append(self._index_scan(
+                "xfer_by_cr", f.account_id, ts_min, ts_max))
+        # Union on the timestamp suffix (dr and cr scans share the same
+        # account prefix, so suffix order == key order).
+        suffix_streams = [
+            ((key[-8:], None) for key, _ in scan) for scan in scans]
+        for suffix, _ in k_way_merge(suffix_streams):
+            yield int.from_bytes(suffix, "big")
+
+    def get_account_transfers(self, f: AccountFilter,
+                              limit_cap: int = 8190) -> list[Transfer]:
+        """The reference query (src/state_machine.zig:3294-3310) served
+        from the forest: index scan -> object lookup -> residual filters ->
+        direction/limit."""
+        matches: list[Transfer] = []
+        for timestamp in self.account_transfer_timestamps(f):
+            t = self.transfer_by_timestamp(timestamp)
+            if t is None:
+                continue
+            if f.user_data_128 and t.user_data_128 != f.user_data_128:
+                continue
+            if f.user_data_64 and t.user_data_64 != f.user_data_64:
+                continue
+            if f.user_data_32 and t.user_data_32 != f.user_data_32:
+                continue
+            if f.code and t.code != f.code:
+                continue
+            matches.append(t)
+        if f.flags & AccountFilterFlags.reversed:
+            matches.reverse()
+        return matches[:min(f.limit, limit_cap)]

@@ -71,20 +71,12 @@ class Tree:
         return value
 
     def scan(self, key_min: bytes, key_max: bytes) -> list[tuple[bytes, bytes]]:
-        """Merged range scan (newest version wins)."""
-        merged: dict[bytes, bytes] = {}
-        for level in reversed(self.levels):
-            for table in level:  # oldest-first; newer overwrite
-                if table.info.key_max < key_min or table.info.key_min > key_max:
-                    continue
-                for k, v in table.iter_entries():
-                    if key_min <= k <= key_max:
-                        merged[k] = v
-        for k, v in self.memtable.items():
-            if key_min <= k <= key_max:
-                merged[k] = v
-        dead = TOMBSTONE * self.value_size
-        return sorted((k, v) for k, v in merged.items() if v != dead)
+        """Merged range scan, newest version wins (streaming k-way merge
+        over memtable + levels — reference: scan_tree.zig; the lazy
+        iterator API is lsm/scan.py's TreeScan)."""
+        from .scan import TreeScan
+
+        return list(TreeScan(self, key_min, key_max))
 
     # ---------------------------------------------------------- compaction
 

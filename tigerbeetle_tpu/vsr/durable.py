@@ -26,6 +26,7 @@ from typing import Optional
 
 from ..lsm.forest import Forest
 from ..lsm.grid import Grid
+from ..lsm.scan import composite_key
 from ..oracle.state_machine import AccountEventRecord, StateMachineOracle
 from ..types import Account, Transfer, TransferPendingStatus
 from .storage import Storage
@@ -41,6 +42,13 @@ SCHEMA = {
     "expiry": (8, 8),
     "orphaned": (16, 1),
     "events": (8, _EVENT_SIZE),
+    # Secondary indexes (reference: the transfers groove's index trees,
+    # src/state_machine.zig:45-90): composite key = field prefix ||
+    # timestamp (composite_key.zig), value = transfer id for the object
+    # lookup hop (scan_lookup.zig).
+    "xfer_by_ts": (8, 16),
+    "xfer_by_dr": (24, 1),
+    "xfer_by_cr": (24, 1),
 }
 
 _META_SIZE = 40  # scalars appended to the checkpoint root blob
@@ -229,7 +237,13 @@ class DurableState:
         xfr = state.transfers
         for tid in sorted(xfr.dirty):
             if tid in xfr:
-                trees["transfers"].put(_k16(tid), xfr[tid].pack())
+                t = xfr[tid]
+                trees["transfers"].put(_k16(tid), t.pack())
+                trees["xfer_by_ts"].put(_k8(t.timestamp), _k16(tid))
+                trees["xfer_by_dr"].put(
+                    composite_key(t.debit_account_id, t.timestamp, 16), b"\x01")
+                trees["xfer_by_cr"].put(
+                    composite_key(t.credit_account_id, t.timestamp, 16), b"\x01")
         xfr.dirty.clear()
         pend = state.pending_status
         for ts in sorted(pend.dirty):
