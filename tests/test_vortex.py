@@ -1,0 +1,81 @@
+"""Vortex: chaos over real processes + real TCP + fault proxy.
+
+reference: src/vortex.zig — the non-deterministic counterpart of VOPR.
+Bounded for CI: one short storm, then heal, audit, shutdown, verify data
+files. (The reference runs vortex for hours in CI; the harness supports
+that by raising the step count.)
+"""
+
+import time
+
+import pytest
+
+from tigerbeetle_tpu.main import _parse_addresses
+from tigerbeetle_tpu.testing.vortex import VortexSupervisor
+from tigerbeetle_tpu.types import Account, Transfer
+from tigerbeetle_tpu.vsr.client import Client
+
+
+@pytest.mark.integration
+def test_vortex_storm(tmp_path):
+    supervisor = VortexSupervisor(str(tmp_path), replica_count=3, seed=7)
+    committed = []
+    try:
+        client = Client(cluster=supervisor.cluster, client_id=9,
+                        replica_addresses=_parse_addresses(
+                            supervisor.addresses))
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                client.create_accounts([Account(id=1, ledger=1, code=1),
+                                        Account(id=2, ledger=1, code=1)])
+                break
+            except TimeoutError:
+                continue
+        else:
+            raise AssertionError("cluster never became available")
+
+        tid = 100
+        for step in range(12):
+            fault = supervisor.random_fault(max_down=1)
+            amount = step + 1
+            try:
+                results = client.create_transfers([Transfer(
+                    id=tid, debit_account_id=1, credit_account_id=2,
+                    amount=amount, ledger=1, code=1)])
+                if results[0].status.name in ("created", "exists"):
+                    committed.append((tid, amount))
+            except TimeoutError:
+                # Unknown outcome: the transfer may or may not have
+                # committed. Resolve it after healing.
+                committed.append((tid, None))
+            tid += 1
+            if step == 5:
+                supervisor.heal_all()  # mid-run heal keeps liveness honest
+        supervisor.heal_all()
+
+        # Audit: every known-committed transfer present; unknowns resolved.
+        deadline = time.monotonic() + 90
+        transfers = None
+        while time.monotonic() < deadline:
+            try:
+                transfers = {t.id: t for t in client.lookup_transfers(
+                    [t for t, _ in committed])}
+                break
+            except TimeoutError:
+                continue
+        assert transfers is not None, "cluster did not recover"
+        total = 0
+        for tid_, amount in committed:
+            if amount is not None:
+                assert tid_ in transfers, f"committed transfer {tid_} lost"
+                total += transfers[tid_].amount
+            elif tid_ in transfers:
+                total += transfers[tid_].amount
+        accounts = {a.id: a for a in client.lookup_accounts([1, 2])}
+        assert accounts[1].debits_posted == total
+        assert accounts[2].credits_posted == total
+        client.close()
+    finally:
+        supervisor.shutdown()
+    supervisor.verify_data_files()

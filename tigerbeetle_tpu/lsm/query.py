@@ -13,13 +13,10 @@ from __future__ import annotations
 from typing import Iterator, Optional
 
 from ..constants import TIMESTAMP_MAX
-from ..types import AccountFilter, AccountFilterFlags, Transfer
+from ..types import AccountFilter, AccountFilterFlags, Operation, Transfer
 from .forest import Forest
 from .k_way_merge import k_way_merge
 from .scan import TreeScan, composite_key
-
-_TS_MIN_KEY = (0).to_bytes(8, "big")
-_TS_MAX_KEY = (TIMESTAMP_MAX + 1).to_bytes(8, "big")
 
 
 class ForestQuery:
@@ -67,10 +64,20 @@ class ForestQuery:
             yield int.from_bytes(suffix, "big")
 
     def get_account_transfers(self, f: AccountFilter,
-                              limit_cap: int = 8190) -> list[Transfer]:
+                              limit_cap: int = 0) -> list[Transfer]:
         """The reference query (src/state_machine.zig:3294-3310) served
-        from the forest: index scan -> object lookup -> residual filters ->
-        direction/limit."""
+        from the forest: filter validation -> index scan -> object lookup
+        -> residual filters -> direction/limit. Must return exactly what
+        the host-index path returns (differential-tested)."""
+        from ..state_machine import OPERATION_SPECS, StateMachine
+
+        if not StateMachine._account_filter_valid(f):
+            return []
+        if not limit_cap:
+            limit_cap = OPERATION_SPECS[
+                Operation.get_account_transfers].result_max()
+        limit = min(f.limit, limit_cap)
+        reverse = bool(f.flags & AccountFilterFlags.reversed)
         matches: list[Transfer] = []
         for timestamp in self.account_transfer_timestamps(f):
             t = self.transfer_by_timestamp(timestamp)
@@ -85,6 +92,8 @@ class ForestQuery:
             if f.code and t.code != f.code:
                 continue
             matches.append(t)
-        if f.flags & AccountFilterFlags.reversed:
+            if not reverse and len(matches) >= limit:
+                break  # ascending: the limit cuts the front of the stream
+        if reverse:
             matches.reverse()
-        return matches[:min(f.limit, limit_cap)]
+        return matches[:limit]

@@ -45,6 +45,7 @@ class TreeScan:
         self.key_min = key_min
         self.key_max = key_max
         self._head: Optional[tuple] = None
+        self._exhausted = False
         self._iter = self._merged(key_min)
         self._advance()
 
@@ -71,6 +72,8 @@ class TreeScan:
 
     def _advance(self) -> None:
         self._head = next(self._iter, None)
+        if self._head is None:
+            self._exhausted = True
 
     # ------------------------------------------------- SeekableStream API
 
@@ -86,8 +89,10 @@ class TreeScan:
     def seek(self, key: bytes) -> None:
         """Advance to the first key >= `key` (zig-zag leapfrog). Rebuilds
         the merge from the target — each source binary-searches, so a seek
-        is O(sources * log n), not a linear drain."""
-        if self._head is not None and self._head[0] >= key:
+        is O(sources * log n), not a linear drain. Seek only moves forward:
+        an exhausted scan stays exhausted (SeekableStream contract)."""
+        if self._exhausted or (self._head is not None
+                               and self._head[0] >= key):
             return
         self._iter = self._merged(key)
         self._advance()

@@ -74,7 +74,7 @@ def cmd_start(args) -> int:
 
     bus = MessageBus(cluster=args.cluster, on_message=on_message,
                      replica_addresses=addresses, replica_id=args.replica,
-                     listen=True)
+                     listen=True, listen_port=args.listen_port)
     tracer = None
     if args.trace or args.statsd:
         from .trace import StatsD, Tracer
@@ -418,6 +418,9 @@ def main(argv=None) -> int:
                    help="emit DogStatsD metrics to host:port")
     p.add_argument("--aof", default=None,
                    help="append committed prepares to this AOF path")
+    p.add_argument("--listen-port", type=int, default=None,
+                   help="bind this port instead of the advertised one "
+                        "(lets a fault proxy sit in front — vortex)")
     p.add_argument("path")
     p.set_defaults(fn=cmd_start)
 

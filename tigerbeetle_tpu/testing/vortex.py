@@ -1,0 +1,260 @@
+"""Vortex: non-deterministic whole-system chaos testing.
+
+reference: src/vortex.zig + src/testing/vortex/{supervisor,faulty_network}
+.zig — unlike the deterministic VOPR (in-process, simulated everything),
+vortex runs REAL replica processes over REAL TCP, injects packet-level
+network faults through a byte proxy, pauses/kills/restarts processes, and
+audits client-visible results. It exists to catch what simulation cannot:
+kernel-level socket behavior, process lifecycle, actual fsync timing.
+
+Topology: every replica address handed to the processes is a FaultyProxy
+port; each proxy forwards to its replica's real port, so replica<->replica
+and client->replica traffic all crosses the fault layer.
+"""
+
+from __future__ import annotations
+
+import os
+import random
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+
+def free_ports(n: int) -> list[int]:
+    socks = [socket.socket() for _ in range(n)]
+    for s in socks:
+        s.bind(("127.0.0.1", 0))
+    ports = [s.getsockname()[1] for s in socks]
+    for s in socks:
+        s.close()
+    return ports
+
+
+class FaultyProxy:
+    """Byte-level TCP proxy with injectable faults (reference:
+    faulty_network.zig): per-direction forwarding threads that can delay,
+    and a kill switch that resets every in-flight connection."""
+
+    def __init__(self, listen_port: int, target_port: int,
+                 seed: int = 0):
+        self.listen_port = listen_port
+        self.target_port = target_port
+        self.prng = random.Random(seed)
+        self.delay_max_s = 0.0
+        self.broken = False  # refuse/kill all connections
+        self._conns: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self.listener = socket.socket()
+        self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self.listener.bind(("127.0.0.1", listen_port))
+        self.listener.listen(64)
+        self.closing = False
+        self.thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self.thread.start()
+
+    def _accept_loop(self) -> None:
+        while not self.closing:
+            try:
+                downstream, _ = self.listener.accept()
+            except OSError:
+                return
+            if self.broken:
+                downstream.close()
+                continue
+            try:
+                upstream = socket.create_connection(
+                    ("127.0.0.1", self.target_port), timeout=5)
+            except OSError:
+                downstream.close()
+                continue
+            with self._lock:
+                self._conns += [downstream, upstream]
+            for a, b in ((downstream, upstream), (upstream, downstream)):
+                threading.Thread(target=self._pump, args=(a, b),
+                                 daemon=True).start()
+
+    def _pump(self, src: socket.socket, dst: socket.socket) -> None:
+        try:
+            while True:
+                chunk = src.recv(64 * 1024)
+                if not chunk or self.broken:
+                    break
+                if self.delay_max_s:
+                    time.sleep(self.prng.random() * self.delay_max_s)
+                dst.sendall(chunk)
+        except OSError:
+            pass
+        for s in (src, dst):
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def smash(self) -> None:
+        """Reset every in-flight connection and refuse new ones."""
+        self.broken = True
+        with self._lock:
+            conns, self._conns = self._conns, []
+        for s in conns:
+            try:
+                s.close()
+            except OSError:
+                pass
+
+    def heal(self) -> None:
+        self.broken = False
+
+    def close(self) -> None:
+        self.closing = True
+        self.smash()
+        self.listener.close()
+
+
+class VortexSupervisor:
+    """Spawns real replica processes behind faulty proxies and drives
+    faults (reference: testing/vortex/supervisor.zig)."""
+
+    def __init__(self, tmp_dir: str, *, replica_count: int = 3,
+                 cluster: int = 0xF0, seed: int = 0):
+        self.tmp_dir = tmp_dir
+        self.replica_count = replica_count
+        self.cluster = cluster
+        self.prng = random.Random(seed)
+        ports = free_ports(2 * replica_count)
+        self.real_ports = ports[:replica_count]
+        self.proxy_ports = ports[replica_count:]
+        self.addresses = ",".join(
+            f"127.0.0.1:{p}" for p in self.proxy_ports)
+        self.proxies = [
+            FaultyProxy(self.proxy_ports[i], self.real_ports[i],
+                        seed=seed + i)
+            for i in range(replica_count)]
+        self.procs: list[Optional[subprocess.Popen]] = [None] * replica_count
+        self.paused: set[int] = set()
+        for i in range(replica_count):
+            self._format(i)
+            self.start_replica(i)
+
+    def _data_path(self, i: int) -> str:
+        return os.path.join(self.tmp_dir, f"r{i}.tigerbeetle")
+
+    def _format(self, i: int) -> None:
+        subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "format",
+             f"--cluster={self.cluster}", f"--replica={i}",
+             f"--replica-count={self.replica_count}", "--small",
+             self._data_path(i)],
+            check=True, cwd="/root/repo", timeout=60,
+            stdout=subprocess.DEVNULL)
+
+    def start_replica(self, i: int) -> None:
+        assert self.procs[i] is None
+        # The replica listens on its REAL port but dials peers through
+        # their proxies: addresses are proxy ports, with our own entry
+        # overridden via --listen-port.
+        self.procs[i] = subprocess.Popen(
+            [sys.executable, "-m", "tigerbeetle_tpu", "start",
+             f"--addresses={self.addresses}", f"--replica={i}",
+             f"--cluster={self.cluster}", "--engine=oracle", "--small",
+             f"--listen-port={self.real_ports[i]}", self._data_path(i)],
+            cwd="/root/repo", env=dict(os.environ),
+            # Never a PIPE nobody drains: a chatty replica would block on a
+            # full pipe buffer and masquerade as a liveness failure.
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    # -------------------------------------------------------------- faults
+
+    def kill_replica(self, i: int) -> None:
+        proc = self.procs[i]
+        if proc is None:
+            return
+        proc.kill()
+        proc.wait(timeout=10)
+        self.procs[i] = None
+        self.paused.discard(i)
+
+    def restart_replica(self, i: int) -> None:
+        if self.procs[i] is None:
+            self.start_replica(i)
+
+    def pause_replica(self, i: int) -> None:
+        proc = self.procs[i]
+        if proc is not None and i not in self.paused:
+            proc.send_signal(signal.SIGSTOP)
+            self.paused.add(i)
+
+    def resume_replica(self, i: int) -> None:
+        proc = self.procs[i]
+        if proc is not None and i in self.paused:
+            proc.send_signal(signal.SIGCONT)
+            self.paused.discard(i)
+
+    def down_count(self) -> int:
+        return sum(1 for i in range(self.replica_count)
+                   if self.procs[i] is None or i in self.paused
+                   or self.proxies[i].broken)
+
+    def random_fault(self, max_down: int) -> str:
+        """Inject one random fault / heal step; returns a description."""
+        i = self.prng.randrange(self.replica_count)
+        roll = self.prng.random()
+        if roll < 0.25 and self.procs[i] is not None \
+                and self.down_count() < max_down:
+            self.kill_replica(i)
+            return f"kill r{i}"
+        if roll < 0.45 and self.procs[i] is None:
+            self.restart_replica(i)
+            return f"restart r{i}"
+        if roll < 0.6 and self.down_count() < max_down \
+                and i not in self.paused:
+            self.pause_replica(i)
+            return f"pause r{i}"
+        if roll < 0.75 and self.paused:
+            victim = self.prng.choice(sorted(self.paused))
+            self.resume_replica(victim)
+            return f"resume r{victim}"
+        if roll < 0.85 and self.down_count() < max_down:
+            self.proxies[i].smash()
+            return f"smash proxy r{i}"
+        for proxy in self.proxies:
+            proxy.heal()
+        return "heal proxies"
+
+    def heal_all(self) -> None:
+        for proxy in self.proxies:
+            proxy.heal()
+        for i in sorted(self.paused):
+            self.resume_replica(i)
+        for i in range(self.replica_count):
+            self.restart_replica(i)
+
+    def shutdown(self) -> None:
+        self.heal_all()
+        for i, proc in enumerate(self.procs):
+            if proc is not None:
+                proc.send_signal(signal.SIGINT)
+        for i, proc in enumerate(self.procs):
+            if proc is not None:
+                try:
+                    proc.wait(timeout=15)
+                except subprocess.TimeoutExpired:
+                    proc.kill()
+        for proxy in self.proxies:
+            proxy.close()
+
+    def verify_data_files(self) -> None:
+        """After shutdown: every data file must pass full integrity
+        verification (reference: vortex's post-run liveness+consistency
+        checks)."""
+        for i in range(self.replica_count):
+            out = subprocess.run(
+                [sys.executable, "-m", "tigerbeetle_tpu", "inspect",
+                 "--small", "--integrity", self._data_path(i)],
+                capture_output=True, text=True, cwd="/root/repo",
+                timeout=120)
+            assert out.returncode == 0, f"r{i}: {out.stdout}"

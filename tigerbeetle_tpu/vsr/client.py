@@ -1,9 +1,12 @@
-"""Cluster client: sessions, request/reply, retries.
+"""Cluster client: sessions, request/reply, hedged retries.
 
-reference: src/vsr/client.zig (ClientType: register :273, request :326).
-Simplified for round 1: no request hedging, sessions are implicit (created
-on first request), one in-flight request at a time (the reference enforces
-the same per-client serialization).
+reference: src/vsr/client.zig (ClientType: register :273, request :326,
+send_request_with_hedging :734). Sessions are implicit (created on first
+request); one in-flight request at a time (the reference enforces the same
+per-client serialization). Hedging: the request goes to the believed
+primary first; only if no reply arrives within the hedge delay does it fan
+out to every replica — steady-state traffic is 1 message per request, not
+N, while view changes still resolve via the fan-out.
 """
 
 from __future__ import annotations
@@ -17,26 +20,44 @@ from .header import Command, Header, Message
 from .message_bus import MessageBus
 
 
+class SessionEvicted(Exception):
+    """The cluster evicted this client's session (table full); create a
+    new Client (new session) to continue (reference: eviction message)."""
+
+
 class Client(ClientHelpers):
     def __init__(self, *, cluster: int, client_id: int,
-                 replica_addresses: list[tuple[str, int]]):
+                 replica_addresses: list[tuple[str, int]],
+                 hedge_delay_s: float = 0.1):
         self.cluster = cluster
         self.client_id = client_id
         self.request_number = 0
+        self.hedge_delay_s = hedge_delay_s
         self._reply: Optional[Message] = None
+        self._evicted = False
+        self._primary_guess = 0
         self.bus = MessageBus(
             cluster=cluster, on_message=self._on_message,
             replica_addresses=replica_addresses)
 
     def _on_message(self, msg: Message) -> None:
-        if (msg.header.command == Command.reply
-                and msg.header.request == self.request_number):
+        h = msg.header
+        if h.command == Command.eviction and h.client == self.client_id:
+            self._evicted = True
+            return
+        if h.command == Command.reply and h.request == self.request_number:
             self._reply = msg
+            # The reply carries the committing view: remember its primary
+            # so the next request goes straight there (hedging).
+            self._primary_guess = h.view % len(self.bus.replica_addresses)
 
     def request(self, operation: Operation, body: bytes,
                 timeout_s: float = 10.0) -> bytes:
-        """Send one request and block until its reply (resending on
-        timeout; all replicas are addressed, only the primary acts)."""
+        """Send one request and block until its reply. Hedged: believed
+        primary first, full fan-out only after hedge_delay_s, then resends
+        every 500ms until the deadline."""
+        if self._evicted:
+            raise SessionEvicted(f"client {self.client_id} was evicted")
         self.request_number += 1
         header = Header(
             command=Command.request, cluster=self.cluster,
@@ -44,13 +65,19 @@ class Client(ClientHelpers):
             operation=int(operation))
         msg = Message(header.finalize(body), body=body)
         self._reply = None
-        deadline = _time.monotonic() + timeout_s
+        start = _time.monotonic()
+        deadline = start + timeout_s
+        hedge_at = start + self.hedge_delay_s
         resend_at = 0.0
+        self.bus.send_to_replica(self._primary_guess, msg)
         while self._reply is None:
+            if self._evicted:
+                raise SessionEvicted(
+                    f"client {self.client_id} was evicted")
             now = _time.monotonic()
             if now >= deadline:
                 raise TimeoutError(f"request {self.request_number} timed out")
-            if now >= resend_at:
+            if now >= hedge_at and now >= resend_at:
                 resend_at = now + 0.5
                 for r in range(len(self.bus.replica_addresses)):
                     self.bus.send_to_replica(r, msg)

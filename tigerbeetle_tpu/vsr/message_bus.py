@@ -48,7 +48,8 @@ class MessageBus:
                  on_message: Callable[[Message], None],
                  replica_addresses: list[tuple[str, int]],
                  replica_id: Optional[int] = None,
-                 listen: bool = False):
+                 listen: bool = False,
+                 listen_port: Optional[int] = None):
         self.cluster = cluster
         self.on_message = on_message
         self.replica_addresses = replica_addresses
@@ -60,6 +61,10 @@ class MessageBus:
         if listen:
             assert replica_id is not None
             host, port = replica_addresses[replica_id]
+            if listen_port is not None:
+                # Bind here while peers dial us at the advertised address —
+                # lets a fault-injecting proxy sit in between (vortex).
+                port = listen_port
             self.listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
             self.listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
             self.listener.bind((host, port))
