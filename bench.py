@@ -51,7 +51,7 @@ def main():
     if "3" in run:
         acc3, el3 = bench_config3(b3)
     if "4" in run:
-        acc4, el4 = bench_config4(batches=1 if quick else 2)
+        acc4, el4 = bench_config4(batches=2 if quick else 6)
     if "5" in run:
         parity = parity_config5(n_batches=3 if quick else 6)
 

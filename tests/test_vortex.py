@@ -55,16 +55,27 @@ def test_vortex_storm(tmp_path):
         supervisor.heal_all()
 
         # Audit: every known-committed transfer present; unknowns resolved.
-        deadline = time.monotonic() + 90
-        transfers = None
+        # Unknown-outcome prepares may still commit DURING the audit (a
+        # healing view change adopts them), so transfers and accounts are
+        # re-read until two consecutive observations agree — a consistent
+        # snapshot of the settled cluster.
+        deadline = time.monotonic() + 120
+        snapshot = prev = None
         while time.monotonic() < deadline:
             try:
                 transfers = {t.id: t for t in client.lookup_transfers(
                     [t for t, _ in committed])}
-                break
+                accounts = {a.id: a for a in client.lookup_accounts([1, 2])}
             except TimeoutError:
                 continue
-        assert transfers is not None, "cluster did not recover"
+            obs = (sorted(transfers), accounts[1].debits_posted,
+                   accounts[2].credits_posted)
+            if obs == prev:
+                snapshot = (transfers, accounts)
+                break
+            prev = obs
+        assert snapshot is not None, "cluster did not settle"
+        transfers, accounts = snapshot
         total = 0
         for tid_, amount in committed:
             if amount is not None:
@@ -72,7 +83,6 @@ def test_vortex_storm(tmp_path):
                 total += transfers[tid_].amount
             elif tid_ in transfers:
                 total += transfers[tid_].amount
-        accounts = {a.id: a for a in client.lookup_accounts([1, 2])}
         assert accounts[1].debits_posted == total
         assert accounts[2].credits_posted == total
         client.close()

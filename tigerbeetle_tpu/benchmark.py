@@ -181,10 +181,13 @@ def bench_config4(batches=2, n=1024, account_count=64):
     void = int(TransferFlags.void_pending_transfer)
 
     accepted = 0
-    t0 = time.perf_counter()
     ts = 10**12
     next_id = 10**7
-    for b in range(batches):
+    t0 = None  # set after the warmup iteration (compile caches)
+    for b in range(-1, batches):
+        if b == 0:
+            accepted = 0  # warmup events don't count
+            t0 = time.perf_counter()
         pend_ids = list(range(next_id, next_id + n))
         next_id += n
         events = [

@@ -156,3 +156,9 @@ def ht_insert(table: dict, k_hi, k_lo, vals, mask):
     pos, ok = ht_plan(table, k_hi, k_lo, mask)
     table = ht_write(table, pos, k_hi, k_lo, vals, mask & ok)
     return table, ok
+
+
+# Jitted entry point for host-driven batch inserts (the mirror regime's
+# delta pushes call this repeatedly; without jit the while_loop inside
+# would re-trace and re-compile on every call).
+ht_insert_jit = jax.jit(ht_insert, donate_argnums=0)

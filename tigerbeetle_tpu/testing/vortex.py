@@ -94,6 +94,8 @@ class FaultyProxy:
                 s.close()
             except OSError:
                 pass
+        with self._lock:
+            self._conns = [c for c in self._conns if c not in (src, dst)]
 
     def smash(self) -> None:
         """Reset every in-flight connection and refuse new ones."""
