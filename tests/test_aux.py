@@ -303,3 +303,46 @@ def test_cluster_clock_and_release_sampling():
         assert r.releases.peers, "release observations missing"
         assert r.clock.samples, "clock samples missing"
         assert r.clock.realtime_synchronized() is not None
+
+
+class TestDevhub:
+    def test_record_and_render(self, tmp_path):
+        from tigerbeetle_tpu import devhub
+
+        history = str(tmp_path / "h.jsonl")
+        out = str(tmp_path / "devhub.html")
+        for v in (100.0, 200.0, 150.0):
+            devhub.record(history, {"value": v, "config2_10k_tps": v * 2})
+        assert devhub.render(history, out) == 3
+        html = open(out).read()
+        assert "polyline" in html and "300" in html
+
+    def test_torn_history_line_skipped(self, tmp_path):
+        from tigerbeetle_tpu import devhub
+
+        history = tmp_path / "h.jsonl"
+        history.write_text('{"value": 1.0}\n{"val')  # torn tail
+        assert devhub.load(str(history)) == [{"value": 1.0}]
+
+
+class TestJaxhound:
+    def test_report_accounts_kernel(self):
+        import re
+
+        from tigerbeetle_tpu.jaxhound import report
+
+        lines = report("create_accounts_fast")
+        header = next(line for line in lines if "HLO instructions" in line)
+        count = int(re.search(r"(\d+) HLO instructions", header).group(1))
+        assert count > 50  # the kernel is large; 0 means the parser broke
+        assert any("stablehlo." in line for line in lines)  # histogram rows
+
+
+class TestMultiversionCli:
+    def test_compatible_data_file(self, tmp_path):
+        from tigerbeetle_tpu.main import main
+
+        path = str(tmp_path / "r0.tb")
+        assert main(["format", "--cluster=1", "--replica=0",
+                     "--replica-count=1", "--small", path]) == 0
+        assert main(["multiversion", "--small", path]) == 0

@@ -100,6 +100,64 @@ class TestSessionsSurviveRestart:
         assert e["reply"].body == last_reply
 
 
+class TestStandbys:
+    def test_standby_follows_without_voting(self):
+        """3 active + 1 standby: the standby converges byte-identically but
+        never acks or votes (reference: standbys,
+        docs/ARCHITECTURE.md — warm spares outside the quorums)."""
+        cluster = Cluster(seed=61, replica_count=3, standby_count=1)
+        client = cluster.client(4)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(20):
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=100 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1,
+                          code=1).pack()], 128))
+        cluster.settle()
+        standby = cluster.replicas[3]
+        assert standby.is_standby
+        assert standby.commit_min == cluster.replicas[0].commit_min
+        a1 = standby.state_machine.state.accounts[1]
+        assert a1.debits_posted == 20
+        # It holds checkpoints too (usable as a state-sync source).
+        assert standby.superblock.op_checkpoint > 0
+
+    def test_quorum_survives_active_crash_with_standby_up(self):
+        """Losing one ACTIVE replica of 3 still commits (quorum 2); the
+        standby's presence neither helps nor hurts the quorum math."""
+        cluster = Cluster(seed=62, replica_count=3, standby_count=1)
+        client = cluster.client(5)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(6000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.crash(victim)
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=200, debit_account_id=1, credit_account_id=2,
+                      amount=5, ledger=1, code=1).pack()], 128))
+        cluster.restart(victim)
+        cluster.settle()
+        # The standby never voted: no prepare_ok from id 3 possible (it
+        # would have tripped the quorum assert if counted).
+        standby = cluster.replicas[3]
+        assert standby.is_standby and not standby.is_primary
+        assert standby.state_machine.state.accounts[1].debits_posted == 5
+
+
 class TestStateSync:
     def test_lagging_replica_jumps_to_peer_checkpoint(self):
         """Crash a replica, drive the cluster past the WAL wrap

@@ -1,0 +1,89 @@
+"""devhub: benchmark history + dashboard.
+
+reference: src/devhub/ + src/scripts/devhub.zig — nightly metrics
+(benchmark tx/s, latency, sizes) recorded to a database and rendered on a
+dashboard. Here: bench JSON lines append to a JSONL history, and `render`
+emits a self-contained HTML dashboard with inline SVG sparklines (no
+external assets, mirroring the reference's static devhub page).
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import time
+from typing import Optional
+
+NUMERIC_KEYS = (
+    "value", "config1_2hot_tps", "config2_10k_tps", "config3_chains_tps",
+    "config4_twophase_limits_tps",
+)
+
+
+def record(history_path: str, bench_json: dict,
+           timestamp: Optional[int] = None) -> None:
+    entry = dict(bench_json)
+    entry["recorded_at"] = timestamp if timestamp is not None else int(time.time())
+    with open(history_path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+
+
+def load(history_path: str) -> list[dict]:
+    out = []
+    try:
+        with open(history_path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        continue  # torn write: skip
+    except FileNotFoundError:
+        pass
+    return out
+
+
+def _sparkline(values: list[float], width: int = 320, height: int = 48) -> str:
+    values = [v for v in values if v is not None]
+    if not values:
+        return "<svg/>"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    step = width / max(1, len(values) - 1) if len(values) > 1 else width
+    points = " ".join(
+        f"{round(i * step, 1)},{round(height - 4 - (v - lo) / span * (height - 8), 1)}"
+        for i, v in enumerate(values))
+    return (f'<svg width="{width}" height="{height}">'
+            f'<polyline fill="none" stroke="#2a6" stroke-width="2" '
+            f'points="{points}"/></svg>')
+
+
+def render(history_path: str, out_path: str) -> int:
+    """Render the dashboard; returns the number of history entries."""
+    entries = load(history_path)
+    rows = []
+    for key in NUMERIC_KEYS:
+        series = [e.get(key) for e in entries]
+        latest = next((v for v in reversed(series) if v is not None), None)
+        rows.append(
+            "<tr><td>{}</td><td>{}</td><td>{}</td></tr>".format(
+                html.escape(key),
+                "-" if latest is None else f"{latest:,.0f}",
+                _sparkline(series)))
+    doc = f"""<!doctype html>
+<html><head><meta charset="utf-8"><title>tigerbeetle-tpu devhub</title>
+<style>
+body {{ font: 14px/1.5 system-ui, sans-serif; margin: 2rem; }}
+table {{ border-collapse: collapse; }}
+td {{ padding: .4rem 1rem; border-bottom: 1px solid #ddd; }}
+</style></head><body>
+<h1>tigerbeetle-tpu devhub</h1>
+<p>{len(entries)} recorded runs; latest metric values with history
+sparklines (reference: devhub.tigerbeetle.com).</p>
+<table><tr><th>metric</th><th>latest</th><th>history</th></tr>
+{''.join(rows)}
+</table></body></html>"""
+    with open(out_path, "w") as f:
+        f.write(doc)
+    return len(entries)

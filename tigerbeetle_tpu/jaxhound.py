@@ -1,0 +1,109 @@
+"""jaxhound: compile-artifact analysis for the TPU kernels.
+
+reference: src/copyhound.zig:1-9 — the reference hunts large memcpys and
+monomorphization bloat in LLVM IR; the TPU-native analog inspects XLA
+artifacts: per-kernel HLO instruction counts, fusion counts, and the
+largest temp buffers. Compile bloat here is the same disease copyhound
+hunts there — generated code growing without anyone noticing.
+
+Usage: `python -m tigerbeetle_tpu jaxhound [--kernel NAME]`.
+"""
+
+from __future__ import annotations
+
+import collections
+import re
+from typing import Callable
+
+
+def analyze_lowered(lowered) -> dict:
+    """Instruction histogram + size stats from a lowered jax computation."""
+    text = lowered.as_text()
+    ops = collections.Counter()
+    # StableHLO prints ops in two forms: pretty ('%3 = stablehlo.add %0,
+    # %2 : ...') and generic ('%9 = "stablehlo.scatter"(%0, ...) ...');
+    # match the op name in either (also '%cst = stablehlo.constant ...').
+    op_re = re.compile(r"%[\w#]+(?::\d+)? = \"?([\w]+\.[\w.]+)\"?[ (<]")
+    for line in text.splitlines():
+        match = op_re.match(line.strip())
+        if match:
+            ops[match.group(1)] += 1
+    compiled = lowered.compile()
+    stats = {}
+    try:
+        analysis = compiled.cost_analysis()
+        if isinstance(analysis, list):
+            analysis = analysis[0]
+        if analysis:
+            stats = {k: analysis[k] for k in
+                     ("flops", "bytes accessed", "optimal_seconds")
+                     if k in analysis}
+    except Exception:
+        pass
+    try:
+        mem = compiled.memory_analysis()
+        stats["temp_bytes"] = getattr(mem, "temp_size_in_bytes", None)
+        stats["argument_bytes"] = getattr(mem, "argument_size_in_bytes", None)
+        stats["output_bytes"] = getattr(mem, "output_size_in_bytes", None)
+    except Exception:
+        pass
+    return {
+        "instructions": sum(ops.values()),
+        "top_ops": ops.most_common(12),
+        "stats": stats,
+    }
+
+
+def kernels() -> dict[str, Callable[[], "object"]]:
+    """Lowerable entry points (thunks so nothing compiles until asked)."""
+
+    def transfers_fast():
+        import jax
+        import numpy as np
+
+        from .ops.batch import transfers_to_arrays
+        from .ops.fast_kernels import create_transfers_fast
+        from .ops.ledger import init_state, pad_transfer_events
+        from .types import Transfer
+
+        state = init_state(1 << 10, 1 << 12)
+        ev = pad_transfer_events(transfers_to_arrays(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1)]))
+        return jax.jit(create_transfers_fast).lower(
+            state, ev, np.uint64(1000), np.int32(1))
+
+    def accounts_fast():
+        import jax
+        import numpy as np
+
+        from .ops.fast_kernels import create_accounts_fast
+        from .ops.ledger import init_state, pad_account_events
+        from .ops.batch import accounts_to_arrays
+        from .types import Account
+
+        state = init_state(1 << 10, 1 << 12)
+        ev = pad_account_events(accounts_to_arrays(
+            [Account(id=1, ledger=1, code=1)]))
+        return jax.jit(create_accounts_fast).lower(
+            state, ev, np.uint64(1000), np.int32(1))
+
+    return {
+        "create_transfers_fast": transfers_fast,
+        "create_accounts_fast": accounts_fast,
+    }
+
+
+def report(kernel: str | None = None) -> list[str]:
+    lines = []
+    for name, thunk in kernels().items():
+        if kernel and name != kernel:
+            continue
+        info = analyze_lowered(thunk())
+        lines.append(f"{name}: {info['instructions']} HLO instructions")
+        for op, count in info["top_ops"]:
+            lines.append(f"  {op:<24} {count}")
+        for key, value in info["stats"].items():
+            if value is not None:
+                lines.append(f"  {key}: {value}")
+    return lines

@@ -188,8 +188,9 @@ def cmd_recover(args) -> int:
     return 0
 
 
-def cmd_inspect(args) -> int:
-    from .vsr.journal import Journal
+def _open_superblock(args):
+    """(storage, superblock) for a path/--small pair, or (storage, None)
+    with the shared no-quorum error printed."""
     from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
     from .vsr.superblock import SuperBlock
 
@@ -198,6 +199,14 @@ def cmd_inspect(args) -> int:
     sb = SuperBlock.load(storage)
     if sb is None:
         print("superblock: no quorum (unformatted or corrupt)")
+    return storage, sb
+
+
+def cmd_inspect(args) -> int:
+    from .vsr.journal import Journal
+
+    storage, sb = _open_superblock(args)
+    if sb is None:
         return 1
     print(f"superblock: cluster={sb.cluster} replica={sb.replica_id}/"
           f"{sb.replica_count} seq={sb.sequence} view={sb.view} "
@@ -384,6 +393,50 @@ def cmd_fuzz(args) -> int:
     return 0
 
 
+def cmd_multiversion(args) -> int:
+    """Inspect a data file's checkpoint release vs this binary
+    (reference: `tigerbeetle multiversion` + the re-exec decision,
+    src/multiversion.zig)."""
+    from .multiversion import RELEASE, ReleaseTracker, release_str
+
+    _storage, sb = _open_superblock(args)
+    if sb is None:
+        return 1
+    compatible = ReleaseTracker().compatible(sb.release)
+    print(f"binary release:     {release_str(RELEASE)}")
+    print(f"data file release:  {release_str(sb.release)} "
+          f"(checkpoint op {sb.op_checkpoint})")
+    print(f"compatible:         {'yes' if compatible else 'NO — upgrade path required'}")
+    return 0 if compatible else 1
+
+
+def cmd_jaxhound(args) -> int:
+    """Kernel compile-bloat report (reference analog: src/copyhound.zig —
+    IR-level bloat hunting)."""
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    from .jaxhound import report
+
+    for line in report(args.kernel):
+        print(line)
+    return 0
+
+
+def cmd_devhub(args) -> int:
+    """Record bench results + render the metrics dashboard (reference:
+    src/scripts/devhub.zig + devhub.tigerbeetle.com)."""
+    from . import devhub
+
+    if args.record:
+        with open(args.record) as f:
+            devhub.record(args.history, json.load(f))
+    n = devhub.render(args.history, args.out)
+    print(f"devhub: {n} runs -> {args.out}")
+    return 0
+
+
 def cmd_version(args) -> int:
     from . import __version__
 
@@ -477,6 +530,23 @@ def main(argv=None) -> int:
     p.add_argument("seed", type=int, nargs="?", default=0)
     p.add_argument("--iterations", type=int, default=None)
     p.set_defaults(fn=cmd_fuzz)
+
+    p = sub.add_parser("multiversion")
+    p.add_argument("--small", action="store_true")
+    p.add_argument("path")
+    p.set_defaults(fn=cmd_multiversion)
+
+    p = sub.add_parser("jaxhound")
+    p.add_argument("--kernel", default=None)
+    p.add_argument("--platform", default=None)
+    p.set_defaults(fn=cmd_jaxhound)
+
+    p = sub.add_parser("devhub")
+    p.add_argument("--record", default=None,
+                   help="bench JSON file to append to the history")
+    p.add_argument("--history", default="devhub_history.jsonl")
+    p.add_argument("--out", default="devhub.html")
+    p.set_defaults(fn=cmd_devhub)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

@@ -24,6 +24,12 @@ import dataclasses
 RELEASE = 1  # bump on every protocol-visible change
 
 
+def release_str(release: int) -> str:
+    """Human form: the reference renders releases as triples
+    (major.minor.patch packed into a u32); ours is a plain counter."""
+    return f"r{release}"
+
+
 @dataclasses.dataclass
 class ReleaseTracker:
     """Per-replica view of the cluster's release spread."""

@@ -123,6 +123,7 @@ class SimClient:
 
 class Cluster:
     def __init__(self, *, seed: int = 0, replica_count: int = 3,
+                 standby_count: int = 0,
                  layout: StorageLayout = TEST_LAYOUT,
                  network: NetworkOptions = NetworkOptions(),
                  options: ReplicaOptions = ReplicaOptions(),
@@ -132,6 +133,8 @@ class Cluster:
         self.time = TimeSim()
         self.network = network
         self.replica_count = replica_count
+        self.standby_count = standby_count
+        self.node_count = replica_count + standby_count
         self.layout = layout
         self.options = options
         self.state_machine_factory = state_machine_factory
@@ -140,9 +143,10 @@ class Cluster:
         self.partitioned: set = set()  # endpoints whose links are cut
         self.crashed: set[int] = set()
 
-        self.storages = [MemoryStorage(layout) for _ in range(replica_count)]
+        self.storages = [MemoryStorage(layout)
+                         for _ in range(self.node_count)]
         self.replicas: list[Replica] = []
-        for i in range(replica_count):
+        for i in range(self.node_count):
             Replica.format(self.storages[i], cluster=self.cluster_id,
                            replica_id=i, replica_count=replica_count)
             self.replicas.append(self._make_replica(i))
@@ -152,7 +156,8 @@ class Cluster:
     def _make_replica(self, i: int) -> Replica:
         return Replica(
             cluster=self.cluster_id, replica_id=i,
-            replica_count=self.replica_count, storage=self.storages[i],
+            replica_count=self.replica_count,
+            standby_count=self.standby_count, storage=self.storages[i],
             bus=_ReplicaBus(self, i), time=self.time,
             state_machine_factory=self.state_machine_factory,
             options=self.options)
@@ -266,7 +271,7 @@ class Cluster:
         """Physical determinism (reference: storage_checker.zig:55 —
         byte-identical checkpoints): replicas at the same checkpoint hold
         byte-identical grid zones and checkpoint-root blobs."""
-        live = [i for i in range(self.replica_count) if i not in self.crashed]
+        live = [i for i in range(self.node_count) if i not in self.crashed]
         by_ckpt: dict[tuple, list[int]] = {}
         for i in live:
             r = self.replicas[i]

@@ -32,8 +32,11 @@ response to an unserviceable request_prepare, the lagging replica fetches
 the reachable grid blocks (request_blocks/block) and installs checkpoint +
 sessions + superblock atomically.
 
-Omitted in round 1 (tracked for later rounds): standbys, protocol-aware
-NACK recovery, request hedging.
+Standbys (ids >= replica_count) follow the replication stream and hold
+checkpoints without voting — warm spares outside the quorums.
+
+Omitted in round 1 (tracked for later rounds): protocol-aware NACK
+recovery.
 """
 
 from __future__ import annotations
@@ -73,13 +76,21 @@ class Replica:
                  storage: Storage, bus, time,
                  state_machine_factory: Callable[[], StateMachine] = StateMachine,
                  options: ReplicaOptions = ReplicaOptions(),
-                 tracer=None, aof=None):
+                 tracer=None, aof=None, standby_count: int = 0):
         from ..multiversion import RELEASE, ReleaseTracker
         from ..trace import NullTracer
         from .clock import Clock
 
         assert 1 <= replica_count <= 6
-        assert 0 <= replica_id < replica_count
+        assert 0 <= standby_count <= 6
+        # Standbys (ids >= replica_count) receive the replication stream
+        # and commit like backups, but hold no vote: they never ack
+        # prepares, never join view changes, never become primary
+        # (reference: docs/ARCHITECTURE.md standbys — extra durability and
+        # warm spares without quorum cost).
+        assert 0 <= replica_id < replica_count + standby_count
+        self.standby_count = standby_count
+        self.is_standby = replica_id >= replica_count
         self.tracer = tracer if tracer is not None else NullTracer()
         self.aof = aof
         self.release = RELEASE
@@ -220,6 +231,11 @@ class Replica:
         return self.status == "normal" and self.primary_index() == self.replica_id
 
     @property
+    def peer_count(self) -> int:
+        """All message-reachable replicas: active + standbys."""
+        return self.replica_count + self.standby_count
+
+    @property
     def quorum_replication(self) -> int:
         """Flexible quorums (reference: docs/internals/vsr.md:283-289)."""
         return {1: 1, 2: 2, 3: 2, 4: 2, 5: 3, 6: 3}[self.replica_count]
@@ -314,7 +330,7 @@ class Replica:
         self.journal.append(prepare)
         self.op = op
         self.pipeline[op] = {"message": prepare, "oks": {self.replica_id}}
-        for r in range(self.replica_count):
+        for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, prepare)
         self._check_quorum(op)
@@ -335,7 +351,9 @@ class Replica:
             if held is None or held.header.checksum != h.checksum:
                 self.journal.append(msg)  # overwrite a stale same-op prepare
             self.op = max(self.op, h.op)
-            if not self.is_primary:
+            if self.is_standby:
+                pass  # standbys hold no vote (no prepare_ok)
+            elif not self.is_primary:
                 self._send_prepare_ok(h)
             else:
                 self._primary_adopt_canonical(msg)
@@ -357,12 +375,14 @@ class Replica:
                 self.journal.append(msg)
                 held = msg
                 self._commit_journal(self.commit_max)
-            if held is not None and held.header.checksum == h.checksum:
+            if held is not None and held.header.checksum == h.checksum \
+                    and not self.is_standby:
                 self._send_prepare_ok(h)  # ack only what we actually hold
         elif h.op == self.op + 1 and h.parent == self._prepare_checksum(self.op):
             self.journal.append(msg)
             self.op = h.op
-            self._send_prepare_ok(h)
+            if not self.is_standby:
+                self._send_prepare_ok(h)
         else:
             # Gap or chain break: repair.
             for missing in range(self.op + 1, h.op):
@@ -394,7 +414,7 @@ class Replica:
         if op <= self.commit_min or op in self.pipeline:
             return
         self.pipeline[op] = {"message": msg, "oks": {self.replica_id}}
-        for r in range(self.replica_count):
+        for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
         self._check_quorum(op)
@@ -526,6 +546,7 @@ class Replica:
     # ---------------------------------------------------------- view change
 
     def _start_view_change(self, new_view: int) -> None:
+        assert not self.is_standby  # standbys follow, never elect
         assert new_view > self.view
         self.status = "view_change"
         self.view = new_view
@@ -544,7 +565,7 @@ class Replica:
 
     def on_start_view_change(self, msg: Message) -> None:
         v = msg.header.view
-        if v < self.view:
+        if self.is_standby or v < self.view:
             return
         if v > self.view:
             self._start_view_change(v)
@@ -582,6 +603,8 @@ class Replica:
         return out
 
     def on_do_view_change(self, msg: Message) -> None:
+        if self.is_standby:
+            return
         v = msg.header.view
         if v < self.view or self.primary_index(v) != self.replica_id:
             return
@@ -640,7 +663,7 @@ class Replica:
             replica=self.replica_id, view=self.view, op=self.op,
             commit=self.commit_max)
         msg = Message(header.finalize(body), body=body)
-        for r in range(self.replica_count):
+        for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
 
@@ -899,7 +922,7 @@ class Replica:
             replica=self.replica_id, view=self.view, client=client,
             context=entry["reply_checksum"])
         msg = Message(header.finalize())
-        for r in range(self.replica_count):
+        for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
 
@@ -953,7 +976,7 @@ class Replica:
                 command=Command.request_prepare, cluster=self.cluster,
                 replica=self.replica_id, view=self.view, op=op)
             msg = Message(header.finalize())
-            for r in range(self.replica_count):
+            for r in range(self.peer_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
         self._sync_request_blocks(now)  # re-request lost sync blocks
@@ -971,7 +994,7 @@ class Replica:
                 command=Command.request_blocks, cluster=self.cluster,
                 replica=self.replica_id, view=self.view)
             msg = Message(header.finalize(body), body=body)
-            for r in range(self.replica_count):
+            for r in range(self.peer_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
         # Reply repair: refill missing client replies from peers.
@@ -1010,7 +1033,7 @@ class Replica:
                 replica=self.replica_id, view=self.view,
                 release=self.release, timestamp=now)
             msg = Message(ping.finalize())
-            for r in range(self.replica_count):
+            for r in range(self.peer_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
         if self.status == "normal" and self.is_primary:
@@ -1021,7 +1044,7 @@ class Replica:
                     replica=self.replica_id, view=self.view,
                     commit=self.commit_max)
                 msg = Message(header.finalize())
-                for r in range(self.replica_count):
+                for r in range(self.peer_count):
                     if r != self.replica_id:
                         self.bus.send_to_replica(r, msg)
             # Self-issued expiry pulse (reference: replica.zig:4906-4910).
@@ -1036,7 +1059,20 @@ class Replica:
                            max(self.fault_detector.deadline_ns(),
                                2 * self.options.heartbeat_interval_ns))
             if now - self.last_heartbeat_rx >= deadline:
-                self._start_view_change(self.view + 1)
+                if self.is_standby:
+                    # Follow the electorate: probe every active replica for
+                    # the current view instead of electing (whichever is
+                    # primary answers with start_view).
+                    self.last_heartbeat_rx = now
+                    header = Header(
+                        command=Command.request_start_view,
+                        cluster=self.cluster, replica=self.replica_id,
+                        view=self.view)
+                    probe = Message(header.finalize())
+                    for r in range(self.replica_count):
+                        self.bus.send_to_replica(r, probe)
+                else:
+                    self._start_view_change(self.view + 1)
         elif self.status == "view_change":
             if now - self.last_heartbeat_rx >= 2 * self.options.view_change_timeout_ns:
                 self.last_heartbeat_rx = now
