@@ -152,13 +152,18 @@ class TestTreeScan:
 class TestForestQuery:
     def _build(self, seed=17, n=300):
         """StateMachine + DurableState flushed through checkpoints."""
+        from tigerbeetle_tpu.types import AccountFlags
+
         rng = random.Random(seed)
         sm = StateMachine(engine="oracle")
         storage = MemoryStorage(TEST_LAYOUT)
         durable = DurableState(storage)
         ts = 10**9
         sm.create_accounts(
-            [Account(id=i, ledger=1, code=1) for i in range(1, 9)], ts)
+            [Account(id=i, ledger=1, code=rng.choice((1, 2)),
+                     user_data_64=rng.choice((0, 5)),
+                     flags=int(AccountFlags.history) if i % 2 else 0)
+             for i in range(1, 9)], ts)
         durable.flush(sm.state)
         tid = 1000
         for batch in range(6):
@@ -208,6 +213,36 @@ class TestForestQuery:
             want = sm.get_account_transfers(f)
             got = query.get_account_transfers(f)
             assert got == want, f"filter {f} diverged"
+
+    def test_balances_and_query_ops_differential(self):
+        from tigerbeetle_tpu.types import QueryFilter
+        from tigerbeetle_tpu.types import QueryFilterFlags as QFF
+
+        sm, durable = self._build(seed=31)
+        query = ForestQuery(durable.forest)
+        for f in [
+            AccountFilter(account_id=1, limit=8190,
+                          flags=int(AFF.debits | AFF.credits)),
+            AccountFilter(account_id=3, limit=7, flags=int(AFF.debits)),
+            AccountFilter(account_id=5, limit=8190, code=2,
+                          flags=int(AFF.debits | AFF.credits | AFF.reversed)),
+            AccountFilter(account_id=2, limit=8190,  # no history flag
+                          flags=int(AFF.debits | AFF.credits)),
+        ]:
+            assert (query.get_account_balances(f)
+                    == sm.get_account_balances(f)), f
+        for f in [
+            QueryFilter(limit=8190),
+            QueryFilter(limit=8190, ledger=1),
+            QueryFilter(limit=8190, code=2),
+            QueryFilter(limit=10, user_data_64=7),
+            QueryFilter(limit=5, code=1, flags=int(QFF.reversed)),
+            QueryFilter(limit=8190, timestamp_min=10**9 + 20_000,
+                        timestamp_max=10**9 + 40_000),
+            QueryFilter(limit=8190, ledger=1, code=2),
+        ]:
+            assert query.query_transfers(f) == sm.query_transfers(f), f
+            assert query.query_accounts(f) == sm.query_accounts(f), f
 
     def test_queries_survive_reopen(self):
         sm, durable = self._build(seed=23)

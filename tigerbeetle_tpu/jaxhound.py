@@ -95,8 +95,12 @@ def kernels() -> dict[str, Callable[[], "object"]]:
 
 
 def report(kernel: str | None = None) -> list[str]:
+    registry = kernels()
+    if kernel is not None and kernel not in registry:
+        raise KeyError(
+            f"unknown kernel {kernel!r}; available: {sorted(registry)}")
     lines = []
-    for name, thunk in kernels().items():
+    for name, thunk in registry.items():
         if kernel and name != kernel:
             continue
         info = analyze_lowered(thunk())

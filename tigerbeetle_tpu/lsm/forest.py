@@ -1,16 +1,37 @@
 """Forest: named trees sharing one grid, with atomic checkpoints.
 
 reference: src/lsm/forest.zig (open/compact/checkpoint across all trees,
-shared manifest log). A checkpoint serializes every tree's manifest plus
-the grid free set into grid blocks and returns one root address blob —
-the superblock-equivalent pointer a caller persists atomically."""
+shared manifest log — a linked list of manifest blocks replayed at
+startup, docs/internals/data_file.md:151-194). A checkpoint serializes
+every tree's manifest into a CHAIN of grid blocks (head -> ... -> tail,
+each block carrying the next block's address) plus the grid free set, and
+returns one small root blob — the superblock-equivalent pointer a caller
+persists atomically.
+"""
 
 from __future__ import annotations
 
 import struct
+from typing import Optional
 
 from .grid import ADDRESS_SIZE, BlockAddress, Grid
 from .tree import Tree
+
+# Per-chain-block header: next address (24) + next block size (4).
+# next size == 0 marks the tail.
+CHAIN_HEADER = ADDRESS_SIZE + 4
+
+
+def chain_next(block_raw: bytes) -> Optional[tuple[BlockAddress, int]]:
+    """(next address, next size) of a manifest chain block, or None."""
+    (next_size,) = struct.unpack_from("<I", block_raw, ADDRESS_SIZE)
+    if next_size == 0:
+        return None
+    return BlockAddress.unpack(block_raw[:ADDRESS_SIZE]), next_size
+
+
+def chain_payload(block_raw: bytes) -> bytes:
+    return block_raw[CHAIN_HEADER:]
 
 
 class Forest:
@@ -22,15 +43,15 @@ class Forest:
         self.trees: dict[str, Tree] = {
             name: Tree(grid, key_size=k, value_size=v, name=name)
             for name, (k, v) in self.schema.items()}
-        self._manifest_block: int = -1  # previous checkpoint's manifest
+        self._manifest_chain: list[int] = []  # previous checkpoint's blocks
 
     def compact_beat(self, op=None) -> None:
         for tree in self.trees.values():
             tree.compact_beat(op)
 
     def checkpoint(self) -> bytes:
-        """Flush + serialize everything; returns the root blob
-        (manifest block address + free set). Pending grid frees are applied
+        """Flush + serialize everything; returns the root blob (manifest
+        chain head address + free set). Pending grid frees are applied
         here — the atomic flip point."""
         manifests = {name: tree.manifest_pack()
                      for name, tree in self.trees.items()}
@@ -41,31 +62,51 @@ class Forest:
             parts.append(nb)
             parts.append(raw)
         manifest_blob = b"".join(parts)
-        assert len(manifest_blob) <= self.grid.block_size, \
-            "manifest exceeds one block (chain blocks in a later round)"
-        # Free the previous checkpoint's manifest block (two-phase: it stays
-        # intact on disk until this checkpoint's free set takes effect, so a
-        # crash before the superblock flip still recovers the old root).
-        if self._manifest_block >= 0:
-            self.grid.release(self._manifest_block)
-        address = self.grid.write_block(manifest_blob)
-        self._manifest_block = address.index
+        # Free the previous checkpoint's manifest chain (two-phase: the
+        # blocks stay intact on disk until this checkpoint's free set takes
+        # effect, so a crash before the superblock flip still recovers the
+        # old root).
+        for index in self._manifest_chain:
+            self.grid.release(index)
+        # Write the chain tail-first so each block can embed its
+        # successor's address.
+        chunk_max = self.grid.block_size - CHAIN_HEADER
+        chunks = [manifest_blob[off:off + chunk_max]
+                  for off in range(0, len(manifest_blob), chunk_max)] or [b""]
+        next_address: Optional[BlockAddress] = None
+        next_size = 0
+        chain: list[int] = []
+        for chunk in reversed(chunks):
+            raw = ((next_address.pack() if next_address is not None
+                    else b"\x00" * ADDRESS_SIZE)
+                   + struct.pack("<I", next_size) + chunk)
+            next_address = self.grid.write_block(raw)
+            next_size = len(raw)
+            chain.append(next_address.index)
+        self._manifest_chain = chain
+        head_address, head_size = next_address, next_size
         free_blob = self.grid.checkpoint_free_set()
-        # The manifest block itself was just acquired; reflect that in the
-        # free set by re-serializing after the write (acquire happened
-        # before checkpoint_free_set, so it is already excluded).
-        return (address.pack() + struct.pack("<I", len(manifest_blob))
+        return (head_address.pack() + struct.pack("<I", head_size)
                 + struct.pack("<I", len(free_blob)) + free_blob)
 
     def open(self, root: bytes) -> None:
-        """Restore from a checkpoint root blob."""
+        """Restore from a checkpoint root blob (walking the chain)."""
         address = BlockAddress.unpack(root[:ADDRESS_SIZE])
-        (manifest_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE)
+        (size,) = struct.unpack_from("<I", root, ADDRESS_SIZE)
         (free_size,) = struct.unpack_from("<I", root, ADDRESS_SIZE + 4)
         free_blob = root[ADDRESS_SIZE + 8:ADDRESS_SIZE + 8 + free_size]
         self.grid.restore_free_set(free_blob)
-        self._manifest_block = address.index
-        raw = self.grid.read_block(address, manifest_size)
+        payload_parts = []
+        chain: list[int] = []
+        link: Optional[tuple[BlockAddress, int]] = (address, size)
+        while link is not None:
+            block_address, block_size = link
+            raw = self.grid.read_block(block_address, block_size)
+            chain.append(block_address.index)
+            payload_parts.append(chain_payload(raw))
+            link = chain_next(raw)
+        self._manifest_chain = list(reversed(chain))  # tail-first like write
+        raw = b"".join(payload_parts)
         (count,) = struct.unpack_from("<I", raw)
         pos = 4
         for _ in range(count):

@@ -13,10 +13,43 @@ from __future__ import annotations
 from typing import Iterator, Optional
 
 from ..constants import TIMESTAMP_MAX
-from ..types import AccountFilter, AccountFilterFlags, Operation, Transfer
+from ..types import (
+    Account,
+    AccountBalance,
+    AccountFilter,
+    AccountFilterFlags,
+    AccountFlags,
+    Operation,
+    QueryFilter,
+    QueryFilterFlags,
+    Transfer,
+)
 from .forest import Forest
 from .k_way_merge import k_way_merge
 from .scan import TreeScan, composite_key
+
+# QueryFilter condition fields -> (index tree suffix, prefix byte width).
+_QUERY_FIELDS = (
+    ("user_data_128", "ud128", 16),
+    ("user_data_64", "ud64", 8),
+    ("user_data_32", "ud32", 4),
+    ("ledger", "ledger", 4),
+    ("code", "code", 2),
+)
+
+
+def _transfer_matches(f: AccountFilter, t: Transfer) -> bool:
+    """AccountFilter residual predicate (the conditions not served by the
+    debits/credits index scan) — shared by transfers and balances."""
+    if f.user_data_128 and t.user_data_128 != f.user_data_128:
+        return False
+    if f.user_data_64 and t.user_data_64 != f.user_data_64:
+        return False
+    if f.user_data_32 and t.user_data_32 != f.user_data_32:
+        return False
+    if f.code and t.code != f.code:
+        return False
+    return True
 
 
 class ForestQuery:
@@ -40,6 +73,14 @@ class ForestQuery:
             return None
         raw = self.forest.trees["transfers"].get(tid)
         return None if raw is None else Transfer.unpack(raw)
+
+    def account_by_timestamp(self, timestamp: int) -> Optional[Account]:
+        aid = self.forest.trees["acct_by_ts"].get(
+            timestamp.to_bytes(8, "big"))
+        if aid is None:
+            return None
+        raw = self.forest.trees["accounts"].get(aid)
+        return None if raw is None else Account.unpack(raw)
 
     # ------------------------------------------------------------- queries
 
@@ -81,15 +122,7 @@ class ForestQuery:
         matches: list[Transfer] = []
         for timestamp in self.account_transfer_timestamps(f):
             t = self.transfer_by_timestamp(timestamp)
-            if t is None:
-                continue
-            if f.user_data_128 and t.user_data_128 != f.user_data_128:
-                continue
-            if f.user_data_64 and t.user_data_64 != f.user_data_64:
-                continue
-            if f.user_data_32 and t.user_data_32 != f.user_data_32:
-                continue
-            if f.code and t.code != f.code:
+            if t is None or not _transfer_matches(f, t):
                 continue
             matches.append(t)
             if not reverse and len(matches) >= limit:
@@ -97,3 +130,136 @@ class ForestQuery:
         if reverse:
             matches.reverse()
         return matches[:limit]
+
+    def get_account_balances(self, f: AccountFilter,
+                             limit_cap: int = 0) -> list[AccountBalance]:
+        """Balance history from the events tree (reference:
+        src/state_machine.zig:1568-1666 — the same transfer scan mapped
+        through account_events rows; history-flagged accounts only)."""
+        from ..state_machine import OPERATION_SPECS, StateMachine
+        from ..vsr.durable import _unpack_event
+
+        if not StateMachine._account_filter_valid(f):
+            return []
+        raw = self.forest.trees["accounts"].get(
+            f.account_id.to_bytes(16, "big"))
+        if raw is None:
+            return []
+        account = Account.unpack(raw)
+        if not (account.flags & AccountFlags.history):
+            return []
+        if not limit_cap:
+            limit_cap = OPERATION_SPECS[
+                Operation.get_account_balances].result_max()
+        limit = min(f.limit, limit_cap)
+        events = self.forest.trees["events"]
+        reverse = bool(f.flags & AccountFilterFlags.reversed)
+
+        def balances():
+            for timestamp in self.account_transfer_timestamps(f):
+                raw_event = events.get(timestamp.to_bytes(8, "big"))
+                if raw_event is None:
+                    continue
+                t = self.transfer_by_timestamp(timestamp)
+                if t is None or not _transfer_matches(f, t):
+                    continue
+                rec = _unpack_event(raw_event)
+                if rec.dr_account.id == f.account_id:
+                    side = rec.dr_account
+                elif rec.cr_account.id == f.account_id:
+                    side = rec.cr_account
+                else:
+                    continue
+                yield AccountBalance(
+                    debits_pending=side.debits_pending,
+                    debits_posted=side.debits_posted,
+                    credits_pending=side.credits_pending,
+                    credits_posted=side.credits_posted,
+                    timestamp=timestamp,
+                )
+
+        if reverse:
+            # The host path reverses the full match stream, then cuts.
+            out = list(balances())
+            out.reverse()
+            return out[:limit]
+        out = []
+        for balance in balances():
+            out.append(balance)
+            if len(out) >= limit:
+                break
+        return out
+
+    def _query_objects(self, f: QueryFilter, groove: str):
+        """Matching objects for a QueryFilter over one groove, ascending
+        (reference: src/state_machine.zig:2054-2124 — walk one condition
+        index, or the timestamp tree when unconditioned; verify residual
+        conditions on the object)."""
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TIMESTAMP_MAX
+        conds = [(attr, suffix, width)
+                 for attr, suffix, width in _QUERY_FIELDS
+                 if getattr(f, attr) != 0]
+        prefix = "acct" if groove == "accounts" else "xfer"
+        lookup = (self.account_by_timestamp if groove == "accounts"
+                  else self.transfer_by_timestamp)
+        if conds:
+            attr, suffix, width = conds[0]
+            tree = self.forest.trees[f"{prefix}_by_{suffix}"]
+            scan = TreeScan(
+                tree,
+                composite_key(getattr(f, attr), ts_min, width),
+                composite_key(getattr(f, attr), ts_max, width))
+            candidates = (int.from_bytes(key[-8:], "big")
+                          for key, _ in scan)
+        else:
+            tree = self.forest.trees[f"{prefix}_by_ts"]
+            scan = TreeScan(tree, ts_min.to_bytes(8, "big"),
+                            ts_max.to_bytes(8, "big"))
+            candidates = (int.from_bytes(key, "big") for key, _ in scan)
+        for timestamp in candidates:
+            obj = lookup(timestamp)
+            if obj is None:
+                continue
+            if any(getattr(obj, attr) != getattr(f, attr)
+                   for attr, _, _ in conds):
+                continue
+            yield obj
+
+    def _query(self, f: QueryFilter, groove: str, operation: Operation):
+        from ..state_machine import OPERATION_SPECS, StateMachine
+
+        if not StateMachine._query_filter_valid(f):
+            return []
+        limit = min(f.limit, OPERATION_SPECS[operation].result_max())
+        if f.flags & QueryFilterFlags.reversed:
+            matches = list(self._query_objects(f, groove))
+            matches.reverse()
+            return matches[:limit]
+        matches = []
+        for obj in self._query_objects(f, groove):
+            matches.append(obj)
+            if len(matches) >= limit:
+                break  # ascending: stop at limit (host path does too)
+        return matches
+
+    def query_accounts(self, f: QueryFilter) -> list[Account]:
+        return self._query(f, "accounts", Operation.query_accounts)
+
+    def query_transfers(self, f: QueryFilter) -> list[Transfer]:
+        return self._query(f, "transfers", Operation.query_transfers)
+
+    def transfers_by_pending_id(self, pending_id: int) -> list[Transfer]:
+        """Resolutions (posts/voids) of a pending transfer, ascending —
+        served by the pending_id index tree (reference: the transfers
+        groove's pending_id index)."""
+        scan = TreeScan(
+            self.forest.trees["xfer_by_pid"],
+            composite_key(pending_id, 1, 16),
+            composite_key(pending_id, TIMESTAMP_MAX, 16))
+        out = []
+        for key, _ in scan:
+            t = self.transfer_by_timestamp(int.from_bytes(key[-8:], "big"))
+            if t is not None:
+                out.append(t)
+        return out

@@ -253,16 +253,25 @@ def _inspect_integrity(storage, sb) -> int:
             return None
         return raw
 
+    from .lsm.forest import chain_next, chain_payload
+
     blocks = checked = 0
-    manifest_addr, manifest_size = durable_mod.checkpoint_manifest(forest_root)
-    blocks += 1
-    manifest_raw = read_block(manifest_addr, manifest_size)
-    if manifest_raw is None:
-        faults += 1
-        print(f"integrity: manifest block {manifest_addr.index} CORRUPT")
-    else:
+    link = durable_mod.checkpoint_manifest(forest_root)
+    manifest_payload = b""
+    while link is not None:
+        manifest_addr, manifest_size = link
+        blocks += 1
+        raw_chain = read_block(manifest_addr, manifest_size)
+        if raw_chain is None:
+            faults += 1
+            print(f"integrity: manifest block {manifest_addr.index} CORRUPT")
+            manifest_payload = None
+            break
         checked += 1
-        for name, key_size, info in durable_mod.manifest_children(manifest_raw):
+        manifest_payload += chain_payload(raw_chain)
+        link = chain_next(raw_chain)
+    if manifest_payload is not None:
+        for name, key_size, info in durable_mod.manifest_children(manifest_payload):
             blocks += 1
             index_raw = read_block(info.index_address, info.index_size)
             if index_raw is None:
@@ -419,7 +428,12 @@ def cmd_jaxhound(args) -> int:
         jax.config.update("jax_platforms", args.platform)
     from .jaxhound import report
 
-    for line in report(args.kernel):
+    try:
+        lines = report(args.kernel)
+    except KeyError as e:
+        print(e.args[0])
+        return 1
+    for line in lines:
         print(line)
     return 0
 

@@ -21,7 +21,10 @@ from __future__ import annotations
 
 import dataclasses
 
-RELEASE = 1  # bump on every protocol-visible change
+# Bump on every protocol-visible change.
+# r2: manifest chain headers + full secondary-index tree schema (r1 data
+#     files must be rebuilt via `recover`).
+RELEASE = 2
 
 
 def release_str(release: int) -> str:

@@ -42,13 +42,26 @@ SCHEMA = {
     "expiry": (8, 8),
     "orphaned": (16, 1),
     "events": (8, _EVENT_SIZE),
-    # Secondary indexes (reference: the transfers groove's index trees,
-    # src/state_machine.zig:45-90): composite key = field prefix ||
-    # timestamp (composite_key.zig), value = transfer id for the object
-    # lookup hop (scan_lookup.zig).
+    # Secondary indexes (reference: the groove index trees,
+    # src/state_machine.zig:45-90 — accounts: 9 trees, transfers: 14):
+    # composite key = field prefix || timestamp (composite_key.zig);
+    # timestamp trees map ts -> id for the object lookup hop
+    # (scan_lookup.zig).
+    "acct_by_ts": (8, 16),
+    "acct_by_ud128": (24, 1),
+    "acct_by_ud64": (16, 1),
+    "acct_by_ud32": (12, 1),
+    "acct_by_ledger": (12, 1),
+    "acct_by_code": (10, 1),
     "xfer_by_ts": (8, 16),
     "xfer_by_dr": (24, 1),
     "xfer_by_cr": (24, 1),
+    "xfer_by_pid": (24, 1),
+    "xfer_by_ud128": (24, 1),
+    "xfer_by_ud64": (16, 1),
+    "xfer_by_ud32": (12, 1),
+    "xfer_by_ledger": (12, 1),
+    "xfer_by_code": (10, 1),
 }
 
 _META_SIZE = 40  # scalars appended to the checkpoint root blob
@@ -190,6 +203,7 @@ def validate_staged_checkpoint(blocks: dict, layout,
         block_count=layout.grid_block_count)
     staged.forest = Forest(staged.grid, SCHEMA)
     staged.events_persisted = 0
+    staged._indexed_accounts = set()
     return staged.open(root_forest)
 
 
@@ -218,6 +232,10 @@ class DurableState:
             block_count=layout.grid_block_count)
         self.forest = Forest(self.grid, SCHEMA)
         self.events_persisted = 0
+        # Accounts whose (immutable) index entries are already in the
+        # trees: balance updates re-dirty accounts every batch, but only
+        # the object row changes — index keys are written once.
+        self._indexed_accounts: set[int] = set()
 
     # ------------------------------------------------------------- writes
 
@@ -232,18 +250,52 @@ class DurableState:
         acc = state.accounts
         for aid in sorted(acc.dirty):
             if aid in acc:
-                trees["accounts"].put(_k16(aid), acc[aid].pack())
+                a = acc[aid]
+                trees["accounts"].put(_k16(aid), a.pack())
+                if aid in self._indexed_accounts:
+                    continue  # balances changed; indexed fields immutable
+                self._indexed_accounts.add(aid)
+                ts = a.timestamp
+                trees["acct_by_ts"].put(_k8(ts), _k16(aid))
+                trees["acct_by_ud128"].put(
+                    composite_key(a.user_data_128, ts, 16), b"\x01")
+                trees["acct_by_ud64"].put(
+                    composite_key(a.user_data_64, ts, 8), b"\x01")
+                trees["acct_by_ud32"].put(
+                    composite_key(a.user_data_32, ts, 4), b"\x01")
+                trees["acct_by_ledger"].put(
+                    composite_key(a.ledger, ts, 4), b"\x01")
+                trees["acct_by_code"].put(
+                    composite_key(a.code, ts, 2), b"\x01")
         acc.dirty.clear()
         xfr = state.transfers
         for tid in sorted(xfr.dirty):
             if tid in xfr:
                 t = xfr[tid]
+                ts = t.timestamp
                 trees["transfers"].put(_k16(tid), t.pack())
-                trees["xfer_by_ts"].put(_k8(t.timestamp), _k16(tid))
+                trees["xfer_by_ts"].put(_k8(ts), _k16(tid))
                 trees["xfer_by_dr"].put(
-                    composite_key(t.debit_account_id, t.timestamp, 16), b"\x01")
+                    composite_key(t.debit_account_id, ts, 16), b"\x01")
                 trees["xfer_by_cr"].put(
-                    composite_key(t.credit_account_id, t.timestamp, 16), b"\x01")
+                    composite_key(t.credit_account_id, ts, 16), b"\x01")
+                if t.pending_id:
+                    # Zero means 'not a post/void' — never indexed
+                    # (reference: the pending_id tree likewise only holds
+                    # resolutions; ForestQuery.transfers_by_pending_id
+                    # reads it).
+                    trees["xfer_by_pid"].put(
+                        composite_key(t.pending_id, ts, 16), b"\x01")
+                trees["xfer_by_ud128"].put(
+                    composite_key(t.user_data_128, ts, 16), b"\x01")
+                trees["xfer_by_ud64"].put(
+                    composite_key(t.user_data_64, ts, 8), b"\x01")
+                trees["xfer_by_ud32"].put(
+                    composite_key(t.user_data_32, ts, 4), b"\x01")
+                trees["xfer_by_ledger"].put(
+                    composite_key(t.ledger, ts, 4), b"\x01")
+                trees["xfer_by_code"].put(
+                    composite_key(t.code, ts, 2), b"\x01")
         xfr.dirty.clear()
         pend = state.pending_status
         for ts in sorted(pend.dirty):
@@ -298,6 +350,7 @@ class DurableState:
                 a = Account.unpack(v)
                 state.accounts[a.id] = a
                 state.account_by_timestamp[a.timestamp] = a.id
+                self._indexed_accounts.add(a.id)
             for _, v in trees["transfers"].scan(lo16, hi16):
                 t = Transfer.unpack(v)
                 state.transfers[t.id] = t

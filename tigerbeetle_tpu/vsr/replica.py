@@ -756,6 +756,9 @@ class Replica:
             "have": {},
             # block index -> (kind, address, size, key_size) to fetch
             "needed": {},
+            # manifest chain payloads, head-first (chain fetch is
+            # sequential: each block names its successor)
+            "manifest_parts": [],
             "last_request": 0,
         }
         # Delta sync: expand the checkpoint's reachability graph from the
@@ -783,13 +786,21 @@ class Replica:
         sync["needed"][index] = (kind, address, size, key_size)
 
     def _sync_expand(self, kind: str, raw: bytes, key_size: int) -> None:
+        from ..lsm.forest import chain_next, chain_payload
         from . import durable as durable_mod
 
         if kind == "manifest":
-            for _name, child_key_size, info in \
-                    durable_mod.manifest_children(raw):
-                self._sync_resolve("index", info.index_address,
-                                   info.index_size, child_key_size)
+            sync = self.syncing
+            sync["manifest_parts"].append(chain_payload(raw))
+            nxt = chain_next(raw)
+            if nxt is not None:
+                self._sync_resolve("manifest", nxt[0], nxt[1], 0)
+            else:
+                full = b"".join(sync["manifest_parts"])
+                for _name, child_key_size, info in \
+                        durable_mod.manifest_children(full):
+                    self._sync_resolve("index", info.index_address,
+                                       info.index_size, child_key_size)
         elif kind == "index":
             for addr, size in durable_mod.index_children(raw, key_size):
                 self._sync_resolve("value", addr, size, key_size)
