@@ -11,6 +11,26 @@ from __future__ import annotations
 
 import json
 import os
+import threading
+
+# Watchdog: if the TPU tunnel wedges (backend init or a compile hangs),
+# still emit ONE JSON line before the driver's budget burns out.
+_done = threading.Event()
+
+
+def _watchdog():
+    timeout = float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
+    if not _done.wait(timeout):
+        print(json.dumps({
+            "metric": "create_transfers_validated_per_sec",
+            "value": None, "unit": "transfers/s", "vs_baseline": None,
+            "error": f"watchdog: no result within {timeout:.0f}s "
+                     "(backend init or compile hang)",
+        }), flush=True)
+        os._exit(2)
+
+
+threading.Thread(target=_watchdog, daemon=True).start()
 
 if os.environ.get("BENCH_PLATFORM"):
     # The axon site hook pins JAX_PLATFORMS; an explicit override must go
@@ -63,7 +83,7 @@ def main():
 
     value = tps(acc2, el2)
 
-    print(json.dumps({
+    out = {
         "metric": "create_transfers_validated_per_sec",
         "value": r(value),
         "unit": "transfers/s",
@@ -74,8 +94,16 @@ def main():
         "config3_chains_tps": r(tps(acc3, el3)),
         "config4_twophase_limits_tps": r(tps(acc4, el4)),
         "config5_oracle_parity": parity,
+        # Mean 8190-event batch latency at config2 rate. (The reference
+        # reports p100 — benchmark_load.zig:587; a true max needs per-batch
+        # syncs, which would serialize the on-device scan, so the mean is
+        # reported under an honest name instead.)
+        "batch_latency_mean_ms": (
+            None if not acc2 else round(8190 / (acc2 / el2) * 1000, 3)),
         "engine": "device_ledger_scan",
-    }))
+    }
+    _done.set()
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -36,3 +36,10 @@ def test_cli_list_and_unknown(capsys):
 def test_cli_run(capsys):
     assert main(["fuzz", "ewah", "3", "--iterations", "20"]) == 0
     assert "OK" in capsys.readouterr().out
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_durability_fuzzer(seed):
+    """Crash-point recovery: reopening after a crash at ANY write boundary
+    must succeed with balanced books."""
+    fuzz.run("durability", seed, iterations=6)
