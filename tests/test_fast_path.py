@@ -50,7 +50,8 @@ class Differ:
         host = self.led.to_host()
         for f in ("accounts", "transfers", "pending_status", "orphaned",
                   "expiry", "pulse_next_timestamp", "commit_timestamp",
-                  "accounts_key_max", "transfers_key_max"):
+                  "accounts_key_max", "transfers_key_max",
+                  "account_events"):
             assert getattr(host, f) == getattr(self.sm, f), f
 
 
@@ -323,3 +324,50 @@ def test_fuzz_differential(seed):
                 ))
         d.transfers(batch)
     d.check_state()
+
+
+class TestDeviceHistoryRing:
+    def test_snapshots_exact_on_hot_accounts(self):
+        """Per-event balance snapshots are prefix sums: a hot account
+        touched by many events in one batch (both as debit and credit)
+        must match the oracle record-for-record (reference: account_event
+        snapshots, src/state_machine.zig:4384-4470)."""
+        from tigerbeetle_tpu.ops.ledger import DeviceLedger
+        from tigerbeetle_tpu.oracle.state_machine import StateMachineOracle
+        from tigerbeetle_tpu.types import Account, Transfer, TransferFlags
+
+        led = DeviceLedger(a_cap=1 << 8, t_cap=1 << 10)
+        sm = StateMachineOracle()
+        accounts = [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
+        for engine in (led, sm):
+            engine.create_accounts(accounts, 100)
+
+        ts = 10_000
+        batch1 = [
+            Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                     amount=5, ledger=1, code=1),
+            Transfer(id=11, debit_account_id=2, credit_account_id=1,
+                     amount=3, ledger=1, code=1),
+            Transfer(id=12, debit_account_id=1, credit_account_id=3,
+                     amount=7, ledger=1, code=1,
+                     flags=int(TransferFlags.pending)),
+            Transfer(id=13, debit_account_id=3, credit_account_id=1,
+                     amount=2, ledger=1, code=1),
+            Transfer(id=14, debit_account_id=1, credit_account_id=2,
+                     amount=11, ledger=1, code=1),
+        ]
+        for engine in (led, sm):
+            engine.create_transfers(batch1, ts)
+        ts += 1000
+        batch2 = [  # resolve the pending + more traffic on account 1
+            Transfer(id=20, pending_id=12, amount=7, ledger=1, code=1,
+                     flags=int(TransferFlags.post_pending_transfer)),
+            Transfer(id=21, debit_account_id=2, credit_account_id=1,
+                     amount=1, ledger=1, code=1),
+        ]
+        for engine in (led, sm):
+            engine.create_transfers(batch2, ts)
+
+        assert led.fallbacks == 0, "must exercise the DEVICE history path"
+        host = led.to_host()
+        assert host.account_events == sm.account_events

@@ -268,6 +268,7 @@ def parity_config5(n_batches=6, batch=256):
     host = led.to_host()
     return (host.accounts == sm.accounts and host.transfers == sm.transfers
             and host.pending_status == sm.pending_status
-            and host.orphaned == sm.orphaned)
+            and host.orphaned == sm.orphaned
+            and host.account_events == sm.account_events)
 
 

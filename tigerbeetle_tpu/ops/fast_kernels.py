@@ -423,6 +423,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     new_rows = xfr["count"] + row_off
 
     e7 = ((xfr["count"] + n_created) > jnp.int32(T_dump))
+    # Event-ring capacity (expiry rows pushed from the host can make the
+    # events count exceed the transfers count, so it needs its own guard).
+    E_dump_cap = jnp.int32(state["events"]["ts"].shape[0] - 1)
+    e8 = ((state["events"]["count"] + n_created) > E_dump_cap)
 
     transient = jnp.zeros_like(valid)
     for code in _TRANSIENT_CODES:
@@ -434,7 +438,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     orph_pos, orph_ok = ht_plan(
         state["orphan_ht"], ev["id_hi"], ev["id_lo"], orphan_new)
 
-    fallback = fallback_pre | e7 | ~ins_ok | ~orph_ok
+    fallback = fallback_pre | e7 | e8 | ~ins_ok | ~orph_ok
     if force_fallback is not None:
         fallback = fallback | force_fallback
     ok = ~fallback
@@ -523,6 +527,123 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
         state["orphan_ht"], orph_pos, ev["id_hi"], ev["id_lo"],
         jnp.zeros(N, dtype=jnp.int32), orphan_new & ok)
 
+    # ------- account_events history ring (reference: account_event(),
+    # src/state_machine.zig:4384-4470 — POST-application balance snapshots
+    # of both touched accounts per created transfer). Statuses are
+    # order-independent under eligibility, but snapshots are prefix sums:
+    # event i's snapshot includes every earlier created event's delta on
+    # that account. Computed exactly with a sort + segmented limb cumsum.
+    evr = state["events"]
+    E_dump = evr["ts"].shape[0] - 1
+    z64 = jnp.uint64(0)
+    side_rows = [
+        jnp.where(ap, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
+        jnp.where(ap, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
+    ]
+    # Per-entry limb deltas for the 4 balance fields (16 lanes/side).
+    zl = (z64, z64, z64, z64)
+
+    def lanes(cond_pos, pos_limbs, cond_neg=None, neg_limbs=zl):
+        out = []
+        for j in range(4):
+            lane = jnp.where(cond_pos & ap, pos_limbs[j], z64)
+            if cond_neg is not None:
+                lane = lane + jnp.where(cond_neg & ap, neg_limbs[j], z64)
+            out.append(lane)
+        return out
+
+    al = (al0, al1, al2, al3)
+    nl = (nl0, nl1, nl2, nl3)
+    deltas = [  # [side][field] -> 4 limb lanes
+        {  # debit side
+            "dp": lanes(ap_pend, al, ap_pv, nl),
+            "dpos": lanes(ap_reg | ap_post, al),
+            "cp": lanes(jnp.zeros_like(ap), al),
+            "cpos": lanes(jnp.zeros_like(ap), al),
+        },
+        {  # credit side
+            "dp": lanes(jnp.zeros_like(ap), al),
+            "dpos": lanes(jnp.zeros_like(ap), al),
+            "cp": lanes(ap_pend, al, ap_pv, nl),
+            "cpos": lanes(ap_reg | ap_post, al),
+        },
+    ]
+    rows2 = jnp.concatenate(side_rows)  # 2N entries: dr sides then cr sides
+    order2 = jnp.concatenate([idxs, idxs])
+    # Single-key sort: (row, event order) packed into one int64 — one sort
+    # pass instead of lexsort's two stable passes.
+    entry_pos = jnp.arange(2 * N, dtype=jnp.int64)
+    combined = ((rows2.astype(jnp.int64) << jnp.int64(34))
+                | (order2.astype(jnp.int64) << jnp.int64(17))
+                | entry_pos & jnp.int64((1 << 17) - 1))
+    perm = jnp.argsort(combined).astype(jnp.int32)
+    rows_sorted = rows2[perm]
+    is_start = jnp.concatenate([
+        jnp.ones(1, dtype=jnp.bool_), rows_sorted[1:] != rows_sorted[:-1]])
+    start_positions = jnp.where(
+        is_start, jnp.arange(2 * N, dtype=jnp.int32), jnp.int32(0))
+    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg_start = jax.ops.segment_max(
+        start_positions, seg_id, num_segments=2 * N)[seg_id]
+
+    # Stacked (4 fields, 4 limbs, 2N): ONE sort-gather, ONE cumsum, ONE
+    # segment-offset gather, ONE base add — not 16 scalar-lane pipelines.
+    fields = ("dp", "dpos", "cp", "cpos")
+    lanes2 = jnp.stack([
+        jnp.stack([jnp.concatenate([deltas[0][field][j], deltas[1][field][j]])
+                   for j in range(4)])
+        for field in fields])                        # (4, 4, 2N)
+    lanes_sorted = lanes2[:, :, perm]
+    cs = jnp.cumsum(lanes_sorted, axis=2)
+    offsets = jnp.where(
+        seg_start > 0,
+        jnp.take(cs, jnp.maximum(seg_start - 1, 0), axis=2), z64)
+    base = jnp.stack([
+        jnp.stack([acc[f"{field}{j}"][rows_sorted] for j in range(4)])
+        for field in fields])
+    limbs = base + cs - offsets                      # (4, 4, 2N)
+    # Carry-normalize mod 2^128 along the limb axis (3 carry steps).
+    l0 = limbs[:, 0]; l1 = limbs[:, 1]; l2 = limbs[:, 2]; l3 = limbs[:, 3]
+    c = l0 >> jnp.uint64(32); l0 = l0 & _M32
+    l1 = l1 + c; c = l1 >> jnp.uint64(32); l1 = l1 & _M32
+    l2 = l2 + c; c = l2 >> jnp.uint64(32); l2 = l2 & _M32
+    l3 = (l3 + c) & _M32
+    hi_sorted = l2 | (l3 << jnp.uint64(32))          # (4, 2N)
+    lo_sorted = l0 | (l1 << jnp.uint64(32))
+    inv = jnp.zeros(2 * N, dtype=jnp.int32).at[perm].set(
+        jnp.arange(2 * N, dtype=jnp.int32))
+    hi_all = jnp.take(hi_sorted, inv, axis=1)        # original entry order
+    lo_all = jnp.take(lo_sorted, inv, axis=1)
+    snap = {}
+    for fi, field in enumerate(fields):
+        snap[f"dr_{field}"] = (hi_all[fi, :N], lo_all[fi, :N])
+        snap[f"cr_{field}"] = (hi_all[fi, N:], lo_all[fi, N:])
+
+    erow = jnp.where(ap, evr["count"] + row_off, E_dump)
+    new_evr = {"count": evr["count"] + jnp.where(ok, n_created, 0)}
+    stores_ev = dict(
+        ts=ts_event,
+        amt_hi=amt_res_hi, amt_lo=amt_res_lo,
+        areq_hi=ev["amt_hi"], areq_lo=ev["amt_lo"],
+        tflags=flags,
+        pstat=jnp.where(pending & ~pv, _PS_PENDING,
+                        jnp.where(is_post, _PS_POSTED,
+                                  jnp.where(is_void, _PS_VOIDED,
+                                            jnp.int32(0)))),
+        p_row=jnp.where(ap_pv, p_rowc, jnp.int32(-1)),
+        dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
+        cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
+        dr_flags=acc["flags"][jnp.where(pv, p["dr_row"], dr_rowc)],
+        cr_flags=acc["flags"][jnp.where(pv, p["cr_row"], cr_rowc)],
+    )
+    for sside in ("dr", "cr"):
+        for field in ("dp", "dpos", "cp", "cpos"):
+            hi_arr, lo_arr = snap[f"{sside}_{field}"]
+            stores_ev[f"{sside}_{field}_hi"] = hi_arr
+            stores_ev[f"{sside}_{field}_lo"] = lo_arr
+    for k, v in stores_ev.items():
+        new_evr[k] = evr[k].at[erow].set(v)
+
     # Scalars.
     last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
     key_max = jnp.where(created.any() & ok,
@@ -547,6 +668,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     new_state = dict(
         accounts=new_acc,
         transfers=new_xfr,
+        events=new_evr,
         acct_ht=state["acct_ht"],
         xfer_ht=new_xfer_ht,
         orphan_ht=new_orphan_ht,

@@ -12,9 +12,11 @@ ineligible batches fall back to the host sequential kernel
 (ops/create_kernels.py) via a full state sync — slow but exact. The ledger
 therefore always matches the oracle, batch for batch.
 
-Known scope limit (round 1): account_events (CDC/balance history) rows are
-recorded only on the fallback path; the device path counts them but does not
-materialize history rows. The StateMachine shell keeps full history.
+History: account_events (CDC/balance history) rows are materialized ON
+DEVICE by the fast path — exact post-application balance snapshots via a
+sort + segmented limb prefix sum in the kernel — and kept in a device ring
+(state["events"]); the mirror regime pushes host-generated rows (hard
+batches, expiries) into the same ring.
 """
 
 from __future__ import annotations
@@ -72,10 +74,14 @@ def _balance_int(acc, field, row) -> int:
 
 
 def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
-               orphan_cap: int | None = None) -> dict:
+               orphan_cap: int | None = None,
+               e_cap: int | None = None) -> dict:
     """Fresh device ledger state pytree (host numpy; moved to device lazily
     by the first jitted call)."""
     import jax.numpy as jnp
+
+    if e_cap is None:
+        e_cap = t_cap  # one history row per created transfer (+ expiries)
 
     def rows_accounts():
         d = dict(
@@ -109,6 +115,25 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         d["count"] = jnp.int32(0)
         return d
 
+    def rows_events():
+        # The account_events history ring (reference: the account_events
+        # groove, src/state_machine.zig:104-220): per created transfer,
+        # POST-application u128 balance snapshots of both touched accounts,
+        # computed exactly in-kernel via segmented prefix sums.
+        d = {k: jnp.zeros(e_cap + 1, jnp.uint64) for k in (
+            "ts", "amt_hi", "amt_lo", "areq_hi", "areq_lo")}
+        for side in ("dr", "cr"):
+            for f in ("dp", "dpos", "cp", "cpos"):
+                d[f"{side}_{f}_hi"] = jnp.zeros(e_cap + 1, jnp.uint64)
+                d[f"{side}_{f}_lo"] = jnp.zeros(e_cap + 1, jnp.uint64)
+            d[f"{side}_row"] = jnp.zeros(e_cap + 1, jnp.int32)
+            d[f"{side}_flags"] = jnp.zeros(e_cap + 1, jnp.uint32)
+        d["tflags"] = jnp.full(e_cap + 1, 0xFFFFFFFF, dtype=jnp.uint32)
+        d["pstat"] = jnp.zeros(e_cap + 1, jnp.int32)
+        d["p_row"] = jnp.full(e_cap + 1, -1, dtype=jnp.int32)
+        d["count"] = jnp.int32(0)
+        return d
+
     if orphan_cap is None:
         # Orphaned (transient-failure) ids are never evicted; keep the table
         # load low enough that 32-probe chains stay improbable even for
@@ -117,6 +142,7 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
     return dict(
         accounts=rows_accounts(),
         transfers=rows_transfers(),
+        events=rows_events(),
         acct_ht=ht_init(2 * a_cap),
         xfer_ht=ht_init(2 * t_cap),
         orphan_ht=ht_init(orphan_cap),
@@ -157,7 +183,7 @@ class DeviceLedger:
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.state = init_state(a_cap, t_cap)
-        self.account_events: list = []  # fallback-path CDC rows only
+        self._events_pushed = 0  # mirror-regime ring watermark
         self.fallbacks = 0
         self.fast_batches = 0
         # Host-mirror fallback regime (see _fallback_transfers): a live
@@ -340,8 +366,62 @@ class DeviceLedger:
         sm.transfers_key_max = int(self.state["xfer_key_max"]) or None
         sm.pulse_next_timestamp = int(self.state["pulse_next"])
         sm.commit_timestamp = int(self.state["commit_ts"])
-        sm.account_events = self.account_events
+        sm.account_events = self._events_to_host(acc, xfr)
+        self._events_pushed = len(sm.account_events)
         return sm
+
+    def _events_to_host(self, acc, xfr) -> list:
+        """Reconstruct AccountEventRecords from the device history ring
+        (reference: the account_events groove rows)."""
+        from ..oracle.state_machine import AccountEventRecord
+
+        n_e = int(self.state["events"]["count"])
+        # Slice on device FIRST: only the live rows cross to the host, not
+        # the full-capacity columns.
+        evr = {k: np.asarray(v[:n_e]) for k, v in self.state["events"].items()
+               if k != "count"}
+        out = []
+
+        def side_account(side: str, r: int) -> Account:
+            row = int(evr[f"{side}_row"][r])
+            return Account(
+                id=u128.to_int(acc["id_hi"][row], acc["id_lo"][row]),
+                debits_pending=u128.to_int(
+                    evr[f"{side}_dp_hi"][r], evr[f"{side}_dp_lo"][r]),
+                debits_posted=u128.to_int(
+                    evr[f"{side}_dpos_hi"][r], evr[f"{side}_dpos_lo"][r]),
+                credits_pending=u128.to_int(
+                    evr[f"{side}_cp_hi"][r], evr[f"{side}_cp_lo"][r]),
+                credits_posted=u128.to_int(
+                    evr[f"{side}_cpos_hi"][r], evr[f"{side}_cpos_lo"][r]),
+                user_data_128=u128.to_int(
+                    acc["ud128_hi"][row], acc["ud128_lo"][row]),
+                user_data_64=int(acc["ud64"][row]),
+                user_data_32=int(acc["ud32"][row]),
+                ledger=int(acc["ledger"][row]),
+                code=int(acc["code"][row]),
+                flags=int(evr[f"{side}_flags"][r]),
+                timestamp=int(acc["ts"][row]),
+            )
+
+        for r in range(n_e):
+            tflags = int(evr["tflags"][r])
+            p_row = int(evr["p_row"][r])
+            out.append(AccountEventRecord(
+                timestamp=int(evr["ts"][r]),
+                dr_account=side_account("dr", r),
+                cr_account=side_account("cr", r),
+                transfer_flags=None if tflags == 0xFFFFFFFF else tflags,
+                transfer_pending_status=TransferPendingStatus(
+                    int(evr["pstat"][r])),
+                transfer_pending=(
+                    _transfer_from_row(xfr, p_row, None) if p_row >= 0
+                    else None),
+                amount_requested=u128.to_int(
+                    evr["areq_hi"][r], evr["areq_lo"][r]),
+                amount=u128.to_int(evr["amt_hi"][r], evr["amt_lo"][r]),
+            ))
+        return out
 
     def from_host(self, sm) -> None:
         """Rebuild the device state from a host oracle state."""
@@ -424,7 +504,19 @@ class DeviceLedger:
         st["xfer_key_max"] = np.uint64(sm.transfers_key_max or 0)
         st["pulse_next"] = np.uint64(sm.pulse_next_timestamp)
         st["commit_ts"] = np.uint64(sm.commit_timestamp)
-        self.account_events = sm.account_events
+        # Rebuild the history ring from the host records.
+        evr = {k: (np.asarray(v).copy() if hasattr(v, "shape") else v)
+               for k, v in st["events"].items()}
+        cols = self._event_cols(sm.account_events)
+        n_e = len(sm.account_events)
+        e_cap = len(evr["ts"]) - 1
+        assert n_e <= e_cap, "e_cap exceeded: raise capacities"
+        for k, v in cols.items():
+            evr[k][:n_e] = v
+        evr["count"] = np.int32(n_e)
+        st["events"] = {k: (jnp.asarray(v) if hasattr(v, "shape")
+                            else jnp.int32(v)) for k, v in evr.items()}
+        self._events_pushed = n_e
 
     # The fallback regime (reference analog: the "hard path" of
     # execute_create — order-dependent batches: balance limits, imported
@@ -467,6 +559,47 @@ class DeviceLedger:
                           self.mirror.orphaned):
             container.dirty.clear()
         return self.mirror
+
+    def _event_cols(self, records: list) -> dict:
+        """Host AccountEventRecords -> ring column arrays (push/from_host)."""
+        n = len(records)
+        cols = {
+            "ts": np.zeros(n, dtype=np.uint64),
+            "amt_hi": np.zeros(n, dtype=np.uint64),
+            "amt_lo": np.zeros(n, dtype=np.uint64),
+            "areq_hi": np.zeros(n, dtype=np.uint64),
+            "areq_lo": np.zeros(n, dtype=np.uint64),
+            "tflags": np.zeros(n, dtype=np.uint32),
+            "pstat": np.zeros(n, dtype=np.int32),
+            "p_row": np.zeros(n, dtype=np.int32),
+        }
+        for side in ("dr", "cr"):
+            cols[f"{side}_row"] = np.zeros(n, dtype=np.int32)
+            cols[f"{side}_flags"] = np.zeros(n, dtype=np.uint32)
+            for f in ("dp", "dpos", "cp", "cpos"):
+                cols[f"{side}_{f}_hi"] = np.zeros(n, dtype=np.uint64)
+                cols[f"{side}_{f}_lo"] = np.zeros(n, dtype=np.uint64)
+        for i, rec in enumerate(records):
+            cols["ts"][i] = rec.timestamp
+            cols["amt_hi"][i], cols["amt_lo"][i] = _split(rec.amount)
+            cols["areq_hi"][i], cols["areq_lo"][i] = _split(
+                rec.amount_requested)
+            cols["tflags"][i] = (0xFFFFFFFF if rec.transfer_flags is None
+                                 else rec.transfer_flags)
+            cols["pstat"][i] = int(rec.transfer_pending_status)
+            cols["p_row"][i] = (
+                self._xfer_row[rec.transfer_pending.id]
+                if rec.transfer_pending is not None else -1)
+            for side, a in (("dr", rec.dr_account), ("cr", rec.cr_account)):
+                cols[f"{side}_row"][i] = self._acct_row[a.id]
+                cols[f"{side}_flags"][i] = a.flags
+                for f, val in (("dp", a.debits_pending),
+                               ("dpos", a.debits_posted),
+                               ("cp", a.credits_pending),
+                               ("cpos", a.credits_posted)):
+                    (cols[f"{side}_{f}_hi"][i],
+                     cols[f"{side}_{f}_lo"][i]) = _split(val)
+        return cols
 
     def _fallback_transfers(self, transfers, timestamp):
         self.fallbacks += 1
@@ -692,6 +825,24 @@ class DeviceLedger:
                 jnp.zeros(bucket(len(dirty_orphans)), dtype=np.int32),
                 pad_mask(len(dirty_orphans)))
             assert bool(ok), "orphan hash overflow: raise capacities"
+
+        # ---- account_events: append the mirror's new history rows
+        new_events = sm.account_events[self._events_pushed:]
+        if new_events:
+            evr = st["events"]
+            e_cap = evr["ts"].shape[0] - 1
+            next_row = int(evr["count"])
+            assert next_row + len(new_events) <= e_cap, "e_cap exceeded"
+            rows = pad(np.arange(next_row, next_row + len(new_events),
+                                 dtype=np.int32), e_cap)
+            cols = self._event_cols(new_events)
+            count = jnp.int32(next_row + len(new_events))
+            st["events"] = scatter_cols(
+                {k: v for k, v in evr.items() if k != "count"},
+                jnp.asarray(rows),
+                {k: jnp.asarray(pad(v, 0)) for k, v in cols.items()})
+            st["events"]["count"] = count
+            self._events_pushed += len(new_events)
 
         # ---- scalars
         st["acct_key_max"] = np.uint64(sm.accounts_key_max or 0)
