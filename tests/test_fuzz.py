@@ -43,3 +43,10 @@ def test_durability_fuzzer(seed):
     """Crash-point recovery: reopening after a crash at ANY write boundary
     must succeed with balanced books."""
     fuzz.run("durability", seed, iterations=6)
+
+
+@pytest.mark.parametrize("seed", [4, 5])
+def test_device_ledger_fuzzer(seed):
+    """Mixed-eligibility DeviceLedger vs oracle: fast path <-> mirror
+    regime transitions with full state + history parity."""
+    fuzz.run("device_ledger", seed, iterations=15)

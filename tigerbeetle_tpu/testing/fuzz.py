@@ -257,6 +257,95 @@ def fuzz_client_sessions(prng: random.Random, iterations: int) -> None:
             assert e["reply"] is not None and e["reply"].valid()
 
 
+def fuzz_device_ledger(prng: random.Random, iterations: int) -> None:
+    """DeviceLedger vs oracle with mixed-eligibility batches: hard flags
+    (balancing, closing), two-phase, chains, and hot accounts force
+    transitions between the vectorized fast path and the host-mirror
+    regime; results AND full state (including history rows) must match
+    event for event."""
+    from ..ops.ledger import DeviceLedger
+    from ..oracle.state_machine import StateMachineOracle
+    from ..types import Account, AccountFlags, Transfer, TransferFlags
+
+    F = TransferFlags
+    led = DeviceLedger(a_cap=1 << 8, t_cap=1 << 12)
+    sm = StateMachineOracle()
+    n_accounts = 12
+    accounts = [
+        Account(id=i, ledger=1, code=1,
+                flags=int(AccountFlags.debits_must_not_exceed_credits)
+                if i % 4 == 0 else 0)
+        for i in range(1, n_accounts + 1)]
+    for engine in (led, sm):
+        engine.create_accounts(accounts, 1000)
+    from ..constants import NS_PER_S
+
+    ts = 10**9
+    next_id = 100
+    open_pendings: list[int] = []
+    for _ in range(iterations):
+        # Mostly small steps; occasionally jump whole seconds so 1-2s
+        # pending timeouts actually elapse (exercising expiry + the
+        # closed-account reopen paths).
+        ts += prng.choice((10_000, 10_000, 10_000, 2 * NS_PER_S))
+        events = []
+        for _ in range(prng.randrange(1, 10)):
+            tid = next_id
+            next_id += 1
+            roll = prng.random()
+            dr = prng.randrange(1, n_accounts + 1)
+            cr = prng.randrange(1, n_accounts + 1)
+            if cr == dr:
+                cr = dr % n_accounts + 1
+            if roll < 0.5:
+                flags = 0
+                timeout = 0
+                if prng.random() < 0.3:
+                    flags = int(F.pending)
+                    timeout = prng.choice((0, 1, 2))
+                    open_pendings.append(tid)
+                if prng.random() < 0.15:
+                    flags |= int(F.linked)
+                events.append(Transfer(
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=int_edgy(prng, 16), ledger=1, code=1,
+                    flags=flags, timeout=timeout))
+            elif roll < 0.65 and open_pendings:
+                pid = open_pendings.pop(prng.randrange(len(open_pendings)))
+                post = prng.random() < 0.6
+                events.append(Transfer(
+                    id=tid, pending_id=pid,
+                    amount=(1 << 128) - 1 if post else 0,
+                    flags=int(F.post_pending_transfer if post
+                              else F.void_pending_transfer)))
+            elif roll < 0.8:
+                events.append(Transfer(  # hard: balancing clamp
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=int_edgy(prng, 12), ledger=1, code=1,
+                    flags=int(F.balancing_debit)))
+            else:
+                events.append(Transfer(  # hard: closing pending
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=prng.randrange(0, 10), ledger=1, code=1,
+                    timeout=prng.choice((0, 1)),
+                    flags=int(F.pending | F.closing_debit)))
+                # Voiding (or expiry) reopens the account — track it so
+                # accounts don't stay closed for the whole run.
+                open_pendings.append(tid)
+        got = led.create_transfers(events, ts)
+        want = sm.create_transfers(events, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+            [(r.timestamp, r.status) for r in want], "ledger/oracle diverged"
+        if prng.random() < 0.2:
+            ts += prng.choice((10_000, 3 * NS_PER_S))
+            assert led.expire_pending_transfers(ts) == \
+                sm.expire_pending_transfers(ts)
+    host = led.to_host()
+    for field in ("accounts", "transfers", "pending_status", "orphaned",
+                  "expiry", "account_events"):
+        assert getattr(host, field) == getattr(sm, field), field
+
+
 class _CrashPoint(Exception):
     pass
 
@@ -410,6 +499,7 @@ FUZZERS: dict[str, Callable[[random.Random, int], None]] = {
     "lsm_tree": fuzz_lsm_tree,
     "state_machine": fuzz_state_machine,
     "client_sessions": fuzz_client_sessions,
+    "device_ledger": fuzz_device_ledger,
     "durability": fuzz_durability,
     "vopr_smoke": fuzz_vopr_smoke,
 }
@@ -422,6 +512,7 @@ DEFAULT_ITERATIONS = {
     "lsm_tree": 10,
     "state_machine": 60,
     "client_sessions": 80,
+    "device_ledger": 30,
     "durability": 12,
     "vopr_smoke": 2,
 }
