@@ -167,3 +167,56 @@ def test_vopr_workload_auditor(seed):
         cluster.restart(r)
     cluster.settle(ticks=60_000)
     assert auditor.checked > 0
+
+
+class TestZipfian:
+    def test_distribution_is_hot_headed(self):
+        from tigerbeetle_tpu.utils import ZipfianGenerator
+
+        zipf = ZipfianGenerator(1000, theta=0.99, seed=3)
+        draws = zipf.draw(50_000)
+        assert draws.min() >= 0 and draws.max() < 1000
+        # Zipf(0.99) over 1000 items: the hottest ~10 items take >30%.
+        hot_share = (draws < 10).mean()
+        assert hot_share > 0.3, hot_share
+        # ...but the tail is still reachable.
+        assert (draws > 500).any()
+
+    def test_grow_preserves_stream(self):
+        from tigerbeetle_tpu.utils import ZipfianGenerator
+
+        zipf = ZipfianGenerator(100, seed=5).grow(200)
+        draws = zipf.draw(10_000)
+        assert draws.max() >= 100  # new items reachable
+
+
+@pytest.mark.parametrize("seed", [71, 72])
+def test_vopr_clock_drift_and_partition_modes(seed):
+    """Swarm with per-replica clock drift and the reference's partition
+    modes (packet_simulator.zig {uniform_size, uniform_partition,
+    isolate_single}): the cluster must still converge byte-identically."""
+    rng = random.Random(seed)
+    cluster = Cluster(
+        seed=seed, replica_count=3,
+        clock_drift_ppm_max=200, clock_offset_ns_max=50 * MS,
+        network=NetworkOptions(loss_probability=0.02,
+                               delay_min_ns=1 * MS, delay_max_ns=20 * MS))
+    client = cluster.client(1)
+    client.request(Operation.create_accounts, _accounts_body([1, 2]))
+    assert cluster.run(20_000, until=lambda: client.idle)
+    next_id = 500
+    for step in range(8):
+        if step % 3 == 1:
+            cluster.partition_mode(rng.choice(
+                ("isolate_single", "uniform_size", "uniform_partition")))
+        elif step % 3 == 2:
+            cluster.heal()
+        client.request(Operation.create_transfers, _transfers_body(
+            [(next_id, 1, 2, step + 1)]))
+        next_id += 1
+        ok = cluster.run(60_000, until=lambda: client.idle)
+        assert ok, f"step {step}: {cluster.debug_status()}"
+    cluster.settle(ticks=60_000)
+    state = cluster.replicas[0].state_machine.state
+    assert state.accounts[1].debits_posted == sum(
+        t.amount for t in state.transfers.values())

@@ -139,6 +139,29 @@ def bench_config2(batches, account_count=10_000):
     return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
 
 
+def bench_config_zipfian(batches, account_count=10_000, theta=0.99):
+    """Zipfian hot accounts — the reference benchmark's default workload
+    shape (src/tigerbeetle/benchmark_load.zig:66-77 account_count_hot)."""
+    from .utils import ZipfianGenerator
+
+    led = _make_ledger(account_count)
+    zipf = ZipfianGenerator(account_count, theta=theta, seed=7)
+    rng = np.random.default_rng(7)
+
+    def mk(b):
+        base = 10**7 + b * N
+        ids = np.arange(base, base + N)
+        dr = zipf.draw(N).astype(np.uint64) + 1
+        cr = zipf.draw(N).astype(np.uint64) + 1
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+        return _soa(ids, dr, cr, rng.integers(1, 1000, N))
+
+    _run_scan(led, [mk(b) for b in range(-B_CHUNK, 0)],
+              np.uint64(10**11))  # warmup: one chunk (shared compile cache)
+    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+
+
 def bench_config3(batches, account_count=1000):
     """Linked chains: all-or-nothing pairs, ~25% of chains failing."""
     led = _make_ledger(account_count)

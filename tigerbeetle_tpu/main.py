@@ -135,10 +135,15 @@ def cmd_benchmark(args) -> int:
 
         jax.config.update("jax_platforms", args.platform)
 
-    from .benchmark import bench_config2
+    from .benchmark import bench_config2, bench_config_zipfian
 
-    accepted, elapsed = bench_config2(
-        max(1, args.transfer_count // 8190), account_count=args.account_count)
+    batches = max(1, args.transfer_count // 8190)
+    if args.zipfian:
+        accepted, elapsed = bench_config_zipfian(
+            batches, account_count=args.account_count, theta=args.theta)
+    else:
+        accepted, elapsed = bench_config2(
+            batches, account_count=args.account_count)
     print(json.dumps({
         "load_accepted_tx_per_s": round(accepted / elapsed, 1),
         "transfers": accepted,
@@ -509,6 +514,9 @@ def main(argv=None) -> int:
     p = sub.add_parser("benchmark")
     p.add_argument("--transfer-count", type=int, default=100_000)
     p.add_argument("--account-count", type=int, default=10_000)
+    p.add_argument("--zipfian", action="store_true",
+                   help="Zipfian hot-account workload (reference default)")
+    p.add_argument("--theta", type=float, default=0.99)
     p.add_argument("--platform", default=None)
     p.set_defaults(fn=cmd_benchmark)
 

@@ -25,8 +25,8 @@ MS = 1_000_000
 
 
 class TimeSim:
-    """Deterministic clock shared by the cluster (per-replica drift can be
-    layered on later; reference: src/testing/time.zig)."""
+    """Deterministic clock shared by the cluster
+    (reference: src/testing/time.zig)."""
 
     def __init__(self, start_ns: int = 1_700_000_000 * 10**9):
         self.now = start_ns
@@ -39,6 +39,29 @@ class TimeSim:
 
     def advance(self, dt_ns: int) -> None:
         self.now += dt_ns
+
+
+class DriftedTime:
+    """Per-replica view of the shared simulated clock with rate drift and
+    a wall-clock offset (reference: TimeSim per-replica drift — the Clock's
+    Marzullo agreement exists to survive exactly this)."""
+
+    def __init__(self, base: TimeSim, drift_ppm: int = 0,
+                 offset_ns: int = 0):
+        self.base = base
+        self.drift_ppm = drift_ppm
+        self.offset_ns = offset_ns
+        self._origin = base.now
+
+    def _scaled(self) -> int:
+        elapsed = self.base.now - self._origin
+        return self._origin + elapsed + elapsed * self.drift_ppm // 1_000_000
+
+    def monotonic(self) -> int:
+        return self._scaled()
+
+    def realtime(self) -> int:
+        return self._scaled() + self.offset_ns
 
 
 @dataclasses.dataclass
@@ -127,7 +150,9 @@ class Cluster:
                  layout: StorageLayout = TEST_LAYOUT,
                  network: NetworkOptions = NetworkOptions(),
                  options: ReplicaOptions = ReplicaOptions(),
-                 state_machine_factory=StateMachine):
+                 state_machine_factory=StateMachine,
+                 clock_drift_ppm_max: int = 0,
+                 clock_offset_ns_max: int = 0):
         self.cluster_id = 0xC1A57E12
         self.rng = random.Random(seed)
         self.time = TimeSim()
@@ -141,7 +166,10 @@ class Cluster:
         self.queue: list = []  # heap of (deliver_at, seq, src, dst, raw)
         self._seq = 0
         self.partitioned: set = set()  # endpoints whose links are cut
+        self.cut_links: set[frozenset] = set()  # replica-pair partitions
         self.crashed: set[int] = set()
+        self.clock_drift_ppm_max = clock_drift_ppm_max
+        self.clock_offset_ns_max = clock_offset_ns_max
 
         self.storages = [MemoryStorage(layout)
                          for _ in range(self.node_count)]
@@ -154,11 +182,20 @@ class Cluster:
         self.clients: dict[int, SimClient] = {}
 
     def _make_replica(self, i: int) -> Replica:
+        time = self.time
+        if self.clock_drift_ppm_max or self.clock_offset_ns_max:
+            drift_rng = random.Random((self.rng.getrandbits(32) << 8) | i)
+            time = DriftedTime(
+                self.time,
+                drift_ppm=drift_rng.randint(-self.clock_drift_ppm_max,
+                                            self.clock_drift_ppm_max),
+                offset_ns=drift_rng.randint(-self.clock_offset_ns_max,
+                                            self.clock_offset_ns_max))
         return Replica(
             cluster=self.cluster_id, replica_id=i,
             replica_count=self.replica_count,
             standby_count=self.standby_count, storage=self.storages[i],
-            bus=_ReplicaBus(self, i), time=self.time,
+            bus=_ReplicaBus(self, i), time=time,
             state_machine_factory=self.state_machine_factory,
             options=self.options)
 
@@ -171,6 +208,9 @@ class Cluster:
 
     def _post(self, src, dst, raw: bytes) -> None:
         if src in self.partitioned or dst in self.partitioned:
+            return
+        if src[0] == "replica" and dst[0] == "replica" \
+                and frozenset((src[1], dst[1])) in self.cut_links:
             return
         if dst[0] == "replica" and dst[1] in self.crashed:
             return
@@ -201,11 +241,38 @@ class Cluster:
     def partition(self, endpoint) -> None:
         self.partitioned.add(endpoint)
 
+    def partition_mode(self, mode: str) -> None:
+        """Link-level partition in one of the reference's modes
+        (src/testing/packet_simulator.zig partition_mode): cut replica<->
+        replica links; client traffic still flows."""
+        self.cut_links.clear()  # REPLACE the previous partition (reference
+        # packet_simulator applies one partition at a time)
+        nodes = list(range(self.node_count))
+        if mode == "isolate_single":
+            victim = self.rng.choice(nodes)
+            group_a = {victim}
+        elif mode == "uniform_size":
+            size = self.rng.randrange(1, self.node_count)
+            group_a = set(self.rng.sample(nodes, size))
+        elif mode == "uniform_partition":
+            group_a = {n for n in nodes if self.rng.random() < 0.5}
+        else:
+            raise ValueError(f"unknown partition mode {mode!r}")
+        group_b = set(nodes) - group_a
+        for a in group_a:
+            for b in group_b:
+                self.cut_links.add(frozenset((a, b)))
+
     def heal(self, endpoint=None) -> None:
         if endpoint is None:
             self.partitioned.clear()
+            self.cut_links.clear()
         else:
             self.partitioned.discard(endpoint)
+            if endpoint[0] == "replica":
+                self.cut_links = {
+                    link for link in self.cut_links
+                    if endpoint[1] not in link}
 
     # -------------------------------------------------------------- ticking
 

@@ -1,0 +1,6 @@
+"""Small in-house utilities (reference: src/stdx/ — the pieces whose jobs
+Python's stdlib doesn't already do)."""
+
+from .zipfian import ZipfianGenerator
+
+__all__ = ["ZipfianGenerator"]

@@ -138,6 +138,13 @@ class Replica:
         self.repair_requested: dict[int, int] = {}  # op -> last request ns
         # State-sync progress (None when not syncing).
         self.syncing: Optional[dict] = None
+        # Ops below this are unverifiable from our journal (a start_view's
+        # suffix began beyond them): execute only canonical entries there.
+        self.sync_floor = 0
+        # Ops whose journaled prepare failed the forward-chain check (a
+        # stale leftover under a committed op number): repair must fetch a
+        # replacement even though a prepare is held.
+        self.chain_suspect: set[int] = set()
         # Scrub-detected corrupt blocks awaiting peer repair:
         # block index -> (tree, address, size).
         self.block_repair: dict[int, tuple] = {}
@@ -369,10 +376,15 @@ class Replica:
         self.fault_detector.observe_progress(self.last_heartbeat_rx)
         if h.op <= self.op:
             held = self.journal.read_prepare(h.op)
-            if held is None and self._chains_into_log(h):
-                # Repair fill: the prepare for a gap slot, validated by its
-                # hash-chain linkage to neighbors we already hold.
+            replace_suspect = (
+                held is not None and h.op in self.chain_suspect
+                and held.header.checksum != h.checksum)
+            if (held is None or replace_suspect) and self._chains_into_log(h):
+                # Repair fill: the prepare for a gap slot — or the
+                # replacement for a stale chain-suspect leftover — validated
+                # by its hash-chain linkage to neighbors we already hold.
                 self.journal.append(msg)
+                self.chain_suspect.discard(h.op)
                 held = msg
                 self._commit_journal(self.commit_max)
             if held is not None and held.header.checksum == h.checksum \
@@ -465,7 +477,18 @@ class Replica:
         """Execute committed prepares from the journal, in order, as far as
         we have them (reference: commit_journal :4310). A journaled prepare
         that contradicts a canonical header (stale op from a deposed
-        primary) must be repaired, never executed."""
+        primary) must be repaired, never executed. Two further guards
+        against stale leftovers (a prepare the old view wrote but the
+        cluster later committed DIFFERENTLY under the same op number):
+        - sync floor: a start_view whose suffix begins beyond our position
+          means our journal entries below it are unverifiable (the
+          electorate checkpointed past them) — never execute them; repair
+          leads to a state-sync offer instead;
+        - forward chain: if the successor prepare is already journaled (and
+          not itself contradicted by a canonical header), this op's
+          checksum must be its parent — a mismatch means one of the two is
+          stale, so repair rather than execute."""
+        prev_checksum = None
         while self.commit_min < commit_target:
             op = self.commit_min + 1
             msg = self.journal.read_prepare(op)
@@ -474,7 +497,23 @@ class Replica:
                                and msg.header.checksum != want):
                 self.repair_requested.setdefault(op, 0)
                 return
+            if want is None and op < self.sync_floor:
+                # Unverifiable leftover below the electorate's checkpoint.
+                self.repair_requested.setdefault(op, 0)
+                return
+            if prev_checksum is None:
+                # 0 = base unknown (e.g. the op behind a synced checkpoint
+                # is not in our journal): the tripwire can't fire there.
+                prev_checksum = self._prepare_checksum(self.commit_min)
+            if prev_checksum and msg.header.parent != prev_checksum:
+                # Backward-chain tripwire: a prepare that doesn't chain from
+                # the op we just committed is a stale leftover.
+                self.chain_suspect.add(op)
+                self.repair_requested.setdefault(op, 0)
+                return
+            self.chain_suspect.discard(op)
             self._commit_op(msg)
+            prev_checksum = msg.header.checksum
 
     def _commit_op(self, prepare: Message) -> None:
         h = prepare.header
@@ -626,7 +665,11 @@ class Replica:
         # Adopt the best log: max (log_view, op) (VSR view-change rule).
         best = max(dvcs.values(),
                    key=lambda m: (m.header.context, m.header.op))
-        self._install_log(best)
+        # Our own log may extend beyond the chosen one (e.g. a higher
+        # log_view with a lower op wins): the excess is uncommitted.
+        if self.op > best.header.op:
+            self.op = best.header.op
+        self._install_log(_unpack_headers(best.body))
         self.log_view = v
         self.status = "normal"
         self._persist_view()
@@ -644,10 +687,9 @@ class Replica:
             elif self.canonical.get(op, m.header.checksum) == m.header.checksum:
                 self._primary_adopt_canonical(m)
 
-    def _install_log(self, dvc: Message) -> None:
-        """Install the header suffix from the chosen DVC as canonical; fetch
-        bodies we lack via repair."""
-        headers = _unpack_headers(dvc.body)
+    def _install_log(self, headers: list) -> None:
+        """Install a canonical header suffix; fetch bodies we lack via
+        repair."""
         for h in headers:
             self.canonical[h.op] = h.checksum
             ours = self.journal.read_prepare(h.op)
@@ -676,7 +718,22 @@ class Replica:
         self.status = "normal"
         self.pipeline.clear()
         self._persist_view()
-        self._install_log(msg)
+        headers = _unpack_headers(msg.body)
+        if headers:
+            suffix_min = min(hh.op for hh in headers)
+            if suffix_min > self.commit_min + 1:
+                # The electorate checkpointed past our position: our journal
+                # entries in (commit_min, suffix_min) are UNVERIFIABLE (a
+                # deposed primary may have written different prepares under
+                # the same op numbers). Never execute them — repair solicits
+                # a state-sync offer instead.
+                self.sync_floor = max(self.sync_floor, suffix_min)
+        # The electorate's log ends at h.op: anything we hold beyond it is
+        # uncommitted by definition — truncate rather than risk executing a
+        # deposed primary's prepares under reused op numbers.
+        if self.op > h.op:
+            self.op = h.op
+        self._install_log(headers)
         self.commit_max = max(self.commit_max, h.commit)
         self.last_heartbeat_rx = self.time.monotonic()
         self.fault_detector.reset(self.last_heartbeat_rx)
@@ -703,6 +760,12 @@ class Replica:
     # -------------------------------------------------------------- repair
 
     def on_request_prepare(self, msg: Message) -> None:
+        if (msg.header.context == 1 and self.superblock is not None
+                and msg.header.op <= self.superblock.op_checkpoint):
+            # The requester cannot trust any served prepare for this op
+            # (it is below its sync floor): offer our checkpoint instead.
+            self._send_sync_offer(msg.header.replica)
+            return
         m = self.journal.read_prepare(msg.header.op)
         if m is not None:
             self.bus.send_to_replica(msg.header.replica, m)
@@ -973,19 +1036,25 @@ class Replica:
         for op, last in list(self.repair_requested.items()):
             held = self.journal.read_prepare(op)
             want = self.canonical.get(op)
+            below_floor = want is None and op < self.sync_floor
             satisfied = held is not None and (
-                want is None or held.header.checksum == want)
+                want is None or held.header.checksum == want) and \
+                op not in self.chain_suspect and not below_floor
             if op <= self.commit_min or satisfied:
                 del self.repair_requested[op]
+                self.chain_suspect.discard(op)
                 continue
             if now - last < self.options.repair_interval_ns:
                 continue
             if not self.repair_budget.spend(now):
                 break  # rate limit: repair must not starve the normal path
             self.repair_requested[op] = now
+            # Below the sync floor a served prepare is untrustworthy —
+            # solicit a state-sync offer instead (context=1).
             header = Header(
                 command=Command.request_prepare, cluster=self.cluster,
-                replica=self.replica_id, view=self.view, op=op)
+                replica=self.replica_id, view=self.view, op=op,
+                context=1 if below_floor else 0)
             msg = Message(header.finalize())
             for r in range(self.peer_count):
                 if r != self.replica_id:
