@@ -220,3 +220,59 @@ def test_vopr_clock_drift_and_partition_modes(seed):
     state = cluster.replicas[0].state_machine.state
     assert state.accounts[1].debits_posted == sum(
         t.amount for t in state.transfers.values())
+
+
+@pytest.mark.parametrize("seed", [1000, 1013, 1018, 1038])
+def test_vopr_storm_regression_seeds(seed):
+    """Seeds that historically exposed consensus bugs (stale-prepare
+    execution after view changes with empty/holey suffixes, restart replay
+    beyond commit_max, canonical staleness across views, repair never
+    pulling committed tail ops). Locked as regressions; the VOPR liveness
+    contract applies: progress is required only once faults heal."""
+    rng = random.Random(seed)
+    n = rng.choice((3, 3, 5))
+    cluster = Cluster(
+        seed=seed, replica_count=n,
+        standby_count=rng.choice((0, 0, 1)),
+        clock_drift_ppm_max=rng.choice((0, 200, 500)),
+        clock_offset_ns_max=rng.choice((0, 80 * MS)),
+        network=NetworkOptions(
+            loss_probability=rng.choice((0.0, 0.03, 0.08)),
+            duplicate_probability=rng.choice((0.0, 0.05)),
+            delay_min_ns=1 * MS,
+            delay_max_ns=rng.choice((10 * MS, 40 * MS))))
+    client = cluster.client(1)
+    client.request(Operation.create_accounts, _accounts_body(range(1, 6)))
+    assert cluster.run(30_000, until=lambda: client.idle)
+    next_id = 500
+    for step in range(14):
+        roll = rng.random()
+        if roll < 0.25:
+            cluster.partition_mode(rng.choice(
+                ("isolate_single", "uniform_size", "uniform_partition")))
+        elif roll < 0.45:
+            cluster.heal()
+        elif roll < 0.55 and len(cluster.crashed) < (n - 1) // 2:
+            victim = rng.randrange(n)
+            if victim not in cluster.crashed:
+                cluster.crash(victim)
+        elif cluster.crashed and roll < 0.75:
+            cluster.restart(rng.choice(sorted(cluster.crashed)))
+        specs = [(next_id + k, rng.randrange(1, 6), rng.randrange(1, 6),
+                  rng.randrange(1, 50)) for k in range(rng.randrange(1, 5))]
+        next_id += len(specs)
+        body = multi_batch.encode([b"".join(
+            Transfer(id=i, debit_account_id=dr,
+                     credit_account_id=cr if cr != dr else dr % 5 + 1,
+                     amount=a, ledger=1, code=1).pack()
+            for i, dr, cr, a in specs)], 128)
+        client.request(Operation.create_transfers, body)
+        if not cluster.run(40_000, until=lambda: client.idle):
+            cluster.heal()
+            for r in sorted(cluster.crashed):
+                cluster.restart(r)
+            assert cluster.run(100_000, until=lambda: client.idle), \
+                f"step {step}: {cluster.debug_status()}"
+    for r in sorted(cluster.crashed):
+        cluster.restart(r)
+    cluster.settle(ticks=100_000)

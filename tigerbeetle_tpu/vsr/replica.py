@@ -130,14 +130,19 @@ class Replica:
         # View change collection state.
         self.svc_votes: dict[int, set[int]] = {}
         self.dvc_messages: dict[int, dict[int, Message]] = {}
-        # Canonical header checksums installed from start_view/do_view_change:
-        # prepares matching these are authoritative regardless of their view
-        # (the view-change quorum chose this log).
-        self.canonical: dict[int, int] = {}
+        # Canonical HEADERS installed from start_view/do_view_change:
+        # prepares matching their checksums are authoritative regardless of
+        # their view (the view-change quorum chose this log). Full headers
+        # are kept so a new primary can broadcast the canonical suffix even
+        # before it repairs the bodies.
+        self.canonical: dict[int, Header] = {}
         # Repair bookkeeping.
         self.repair_requested: dict[int, int] = {}  # op -> last request ns
         # State-sync progress (None when not syncing).
         self.syncing: Optional[dict] = None
+        # A view this (new primary) replica is completing repair for,
+        # before broadcasting start_view.
+        self._pending_view: Optional[int] = None
         # Ops below this are unverifiable from our journal (a start_view's
         # suffix began beyond them): execute only canonical entries there.
         self.sync_floor = 0
@@ -208,11 +213,37 @@ class Replica:
         self.commit_min = sb.op_checkpoint
         self.commit_max = max(sb.commit_max, sb.op_checkpoint)
         self.prepare_timestamp = self.state_machine.state.commit_timestamp
-        # Replay the WAL suffix above the checkpoint. AOF appends dedupe by
-        # op internally, so replayed ops neither duplicate nor gap the AOF.
-        self._commit_journal(min(self.op, max(self.commit_max, self.op)))
-        self.status = "normal"
+        # Replay the WAL suffix above the checkpoint — but only up to the
+        # durably-KNOWN commit point, and only entries written under our
+        # last NORMAL view (sb.log_view): anything else may be a stale
+        # leftover a view change replaced while we were down (the canonical
+        # / sync-floor guards are volatile, so restart cannot trust them).
+        # Deferred entries re-commit through the live protocol once we
+        # rejoin (start_view re-installs canonical headers).
+        replay_to = min(self.op, self.commit_max)
+        for op in range(sb.op_checkpoint + 1, replay_to + 1):
+            m = self.journal.read_prepare(op)
+            if m is None or m.header.view != sb.log_view:
+                replay_to = op - 1
+                break
+        self._commit_journal(replay_to)
+        if sb.log_view < sb.view:
+            # We persisted a view we never completed (crashed mid
+            # view-change): we hold no proof of that view's log — rejoining
+            # as view_change defers everything to the live protocol, and
+            # crucially prevents acting as that view's primary without a
+            # do_view_change quorum.
+            self.status = "view_change"
+        else:
+            self.status = "normal"
         self.last_heartbeat_rx = self.time.monotonic()
+        if self.is_primary:
+            # Re-replicate our uncommitted suffix so it regains a quorum
+            # (single-replica clusters commit it immediately: quorum 1).
+            for op in range(self.commit_min + 1, self.op + 1):
+                m = self.journal.read_prepare(op)
+                if m is not None:
+                    self._primary_adopt_canonical(m)
 
     def _journal_contiguous_max(self, from_op: int) -> int:
         """Highest op such that every (from_op, op] slot holds a valid,
@@ -353,7 +384,9 @@ class Replica:
         h = msg.header
         # A prepare matching a canonical header (installed by the view-change
         # quorum) is authoritative regardless of its original view.
-        if self.canonical.get(h.op) == h.checksum and self.status == "normal":
+        want_hdr = self.canonical.get(h.op)
+        if (want_hdr is not None and want_hdr.checksum == h.checksum
+                and self.status in ("normal", "view_change")):
             held = self.journal.read_prepare(h.op)
             if held is None or held.header.checksum != h.checksum:
                 self.journal.append(msg)  # overwrite a stale same-op prepare
@@ -492,7 +525,8 @@ class Replica:
         while self.commit_min < commit_target:
             op = self.commit_min + 1
             msg = self.journal.read_prepare(op)
-            want = self.canonical.get(op)
+            want_hdr = self.canonical.get(op)
+            want = None if want_hdr is None else want_hdr.checksum
             if msg is None or (want is not None
                                and msg.header.checksum != want):
                 self.repair_requested.setdefault(op, 0)
@@ -506,9 +540,21 @@ class Replica:
                 # is not in our journal): the tripwire can't fire there.
                 prev_checksum = self._prepare_checksum(self.commit_min)
             if prev_checksum and msg.header.parent != prev_checksum:
-                # Backward-chain tripwire: a prepare that doesn't chain from
-                # the op we just committed is a stale leftover.
-                self.chain_suspect.add(op)
+                if want is not None:
+                    # The CANONICAL prepare doesn't chain from what we
+                    # executed: our own prefix diverged. SAFE failure mode:
+                    # refuse to execute further (mark the journal
+                    # unverifiable; repair solicits a state-sync offer once
+                    # a peer checkpoint covers us). A checkpoint-rollback
+                    # re-execution recovery is the round-2 item here —
+                    # divergence is always preferred stalled over executed.
+                    self.sync_floor = max(self.sync_floor,
+                                          max(self.commit_max, op) + 1)
+                    self.canonical.pop(op, None)
+                else:
+                    # Backward-chain tripwire: a prepare that doesn't chain
+                    # from the op we just committed is a stale leftover.
+                    self.chain_suspect.add(op)
                 self.repair_requested.setdefault(op, 0)
                 return
             self.chain_suspect.discard(op)
@@ -587,6 +633,7 @@ class Replica:
     def _start_view_change(self, new_view: int) -> None:
         assert not self.is_standby  # standbys follow, never elect
         assert new_view > self.view
+        self._pending_view = None
         self.status = "view_change"
         self.view = new_view
         self.pipeline.clear()
@@ -641,6 +688,21 @@ class Replica:
                 out.append(m)
         return out
 
+    def _suffix_headers(self) -> list[Header]:
+        """The log suffix as HEADERS: journal-held where possible, else
+        the canonical header (a new primary knows the chosen log's headers
+        before it has repaired the bodies — backups must still learn them,
+        or they silently drop the re-replicated old-view prepares)."""
+        base = self.superblock.op_checkpoint if self.superblock else 0
+        out = []
+        for op in range(base + 1, self.op + 1):
+            m = self.journal.read_prepare(op)
+            if m is not None:
+                out.append(m.header)
+            elif op in self.canonical:
+                out.append(self.canonical[op])
+        return out
+
     def on_do_view_change(self, msg: Message) -> None:
         if self.is_standby:
             return
@@ -669,12 +731,45 @@ class Replica:
         # log_view with a lower op wins): the excess is uncommitted.
         if self.op > best.header.op:
             self.op = best.header.op
-        self._install_log(_unpack_headers(best.body))
+        best_headers = _unpack_headers(best.body)
+        suffix_base = (min(hh.op for hh in best_headers) if best_headers
+                       else best.header.op + 1)
+        if suffix_base > self.commit_min + 1:
+            # Same unverifiable-base rule as on_start_view, for the new
+            # primary itself (the chosen log's sender checkpointed past
+            # our position).
+            self.sync_floor = max(self.sync_floor, suffix_base)
+        self._install_log(best_headers)
+        commit_max = max(m.header.commit for m in dvcs.values())
+        self.commit_max = max(self.commit_max, commit_max)
+        # The view does NOT start yet: the primary must hold the COMPLETE
+        # canonical log first (reference: the new primary repairs before
+        # start_view; a suffix with holes would strand backups on
+        # unverifiable ops). _try_start_view finalizes once repair (already
+        # requested by _install_log for mismatches/gaps) completes; if the
+        # bodies are unobtainable the view-change timer escalates.
+        self._pending_view = v
+        self._try_start_view()
+
+    def _try_start_view(self) -> None:
+        """Finalize a pending view once the primary's log is complete."""
+        if self._pending_view != self.view or self.status != "view_change":
+            return
+        for op in range(max(self.commit_min, self.sync_floor - 1) + 1,
+                        self.op + 1):
+            m = self.journal.read_prepare(op)
+            if m is None:
+                self.repair_requested.setdefault(op, 0)
+                return
+            want = self.canonical.get(op)
+            if want is not None and m.header.checksum != want.checksum:
+                self.repair_requested.setdefault(op, 0)
+                return
+        v = self._pending_view
+        self._pending_view = None
         self.log_view = v
         self.status = "normal"
         self._persist_view()
-        commit_max = max(m.header.commit for m in dvcs.values())
-        self.commit_max = max(self.commit_max, commit_max)
         self._broadcast_start_view()
         self._commit_journal(self.commit_max)
         # Re-replicate the uncommitted canonical suffix in the new view so
@@ -682,29 +777,35 @@ class Replica:
         # quorum intersects every replication quorum).
         for op in range(self.commit_min + 1, self.op + 1):
             m = self.journal.read_prepare(op)
-            if m is None:
-                self.repair_requested.setdefault(op, 0)
-            elif self.canonical.get(op, m.header.checksum) == m.header.checksum:
+            if m is not None and (
+                    op not in self.canonical
+                    or self.canonical[op].checksum == m.header.checksum):
                 self._primary_adopt_canonical(m)
 
     def _install_log(self, headers: list) -> None:
         """Install a canonical header suffix; fetch bodies we lack via
-        repair."""
+        repair. REPLACES the previous canonical set: entries from older
+        views are obsolete (the new electorate's log is the only truth),
+        and a stale leftover would reject the true prepare forever."""
+        self.canonical = {}
         for h in headers:
-            self.canonical[h.op] = h.checksum
+            self.canonical[h.op] = h
             ours = self.journal.read_prepare(h.op)
             if ours is None or ours.header.checksum != h.checksum:
                 self.repair_requested.setdefault(h.op, 0)
         if headers:
             self.op = max(self.op, max(h.op for h in headers))
 
-    def _broadcast_start_view(self) -> None:
-        body = b"".join(m.header.pack() for m in self._suffix_prepares())
+    def _start_view_message(self) -> Message:
+        body = b"".join(h.pack() for h in self._suffix_headers())
         header = Header(
             command=Command.start_view, cluster=self.cluster,
             replica=self.replica_id, view=self.view, op=self.op,
             commit=self.commit_max)
-        msg = Message(header.finalize(body), body=body)
+        return Message(header.finalize(body), body=body)
+
+    def _broadcast_start_view(self) -> None:
+        msg = self._start_view_message()
         for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
@@ -719,15 +820,16 @@ class Replica:
         self.pipeline.clear()
         self._persist_view()
         headers = _unpack_headers(msg.body)
-        if headers:
-            suffix_min = min(hh.op for hh in headers)
-            if suffix_min > self.commit_min + 1:
-                # The electorate checkpointed past our position: our journal
-                # entries in (commit_min, suffix_min) are UNVERIFIABLE (a
-                # deposed primary may have written different prepares under
-                # the same op numbers). Never execute them — repair solicits
-                # a state-sync offer instead.
-                self.sync_floor = max(self.sync_floor, suffix_min)
+        # The suffix covers (primary's checkpoint, primary's op]; an EMPTY
+        # suffix means the primary checkpointed at its log end, so the
+        # verifiable base is op+1. Anything of ours below the base is
+        # UNVERIFIABLE (a deposed primary may have written different
+        # prepares under the same op numbers) — never execute it; repair
+        # solicits a state-sync offer instead.
+        suffix_base = (min(hh.op for hh in headers) if headers
+                       else h.op + 1)
+        if suffix_base > self.commit_min + 1:
+            self.sync_floor = max(self.sync_floor, suffix_base)
         # The electorate's log ends at h.op: anything we hold beyond it is
         # uncommitted by definition — truncate rather than risk executing a
         # deposed primary's prepares under reused op numbers.
@@ -760,12 +862,19 @@ class Replica:
     # -------------------------------------------------------------- repair
 
     def on_request_prepare(self, msg: Message) -> None:
-        if (msg.header.context == 1 and self.superblock is not None
-                and msg.header.op <= self.superblock.op_checkpoint):
-            # The requester cannot trust any served prepare for this op
-            # (it is below its sync floor): offer our checkpoint instead.
-            self._send_sync_offer(msg.header.replica)
-            return
+        if msg.header.context == 1:
+            # The requester cannot trust any served prepare for this op (it
+            # is below its sync floor): offer our checkpoint — or, when no
+            # checkpoint covers it yet, the primary answers with a FULL
+            # start_view whose canonical suffix re-verifies the op.
+            if (self.superblock is not None
+                    and msg.header.op <= self.superblock.op_checkpoint):
+                self._send_sync_offer(msg.header.replica)
+                return
+            if self.is_primary:
+                self.bus.send_to_replica(msg.header.replica,
+                                         self._start_view_message())
+                return
         m = self.journal.read_prepare(msg.header.op)
         if m is not None:
             self.bus.send_to_replica(msg.header.replica, m)
@@ -1017,8 +1126,17 @@ class Replica:
         if now - self.last_repair_tick < self.options.repair_interval_ns:
             return
         self.last_repair_tick = now
-        # Re-derive gaps below commit_max.
-        for op in range(self.commit_min + 1, min(self.commit_max, self.op) + 1):
+        # Re-derive gaps below commit_max — INCLUDING ops beyond our own
+        # log end: they are known-committed, and nothing else pulls them if
+        # the original prepares were all lost (no retransmit path exists
+        # once the primary's pipeline entry commits). Bounded by the WAL
+        # window; older ops resolve via state sync.
+        # slot_count - 1: op commit_min+slot_count would share a WAL slot
+        # with op commit_min, clobbering the chain anchor the commit-time
+        # tripwire validates against.
+        repair_hi = min(self.commit_max,
+                        self.commit_min + self.storage.layout.slot_count - 1)
+        for op in range(self.commit_min + 1, repair_hi + 1):
             if self.journal.read_prepare(op) is None:
                 self.repair_requested.setdefault(op, 0)
         for op in [o for o in self.canonical if o <= self.commit_min]:
@@ -1035,7 +1153,8 @@ class Replica:
                         self.bus.send_to_replica(r, entry["message"])
         for op, last in list(self.repair_requested.items()):
             held = self.journal.read_prepare(op)
-            want = self.canonical.get(op)
+            want_hdr = self.canonical.get(op)
+            want = None if want_hdr is None else want_hdr.checksum
             below_floor = want is None and op < self.sync_floor
             satisfied = held is not None and (
                 want is None or held.header.checksum == want) and \
@@ -1059,6 +1178,7 @@ class Replica:
             for r in range(self.peer_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
+        self._try_start_view()  # a pending primary finalizes when complete
         self._sync_request_blocks(now)  # re-request lost sync blocks
         # Scrub repair: ask peers for fresh copies of corrupt blocks. A
         # queued address whose table was compacted away meanwhile is moot —
