@@ -50,3 +50,10 @@ def test_device_ledger_fuzzer(seed):
     """Mixed-eligibility DeviceLedger vs oracle: fast path <-> mirror
     regime transitions with full state + history parity."""
     fuzz.run("device_ledger", seed, iterations=15)
+
+
+def test_cfo_budgeted(capsys):
+    """cfo: random (fuzzer, seed) pairs under a run budget (reference:
+    scripts/cfo.zig)."""
+    assert main(["cfo", "--max-runs", "3", "--seed", "7"]) == 0
+    assert "clean" in capsys.readouterr().out

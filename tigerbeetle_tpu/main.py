@@ -456,6 +456,44 @@ def cmd_devhub(args) -> int:
     return 0
 
 
+def cmd_cfo(args) -> int:
+    """Continuous fuzzing orchestrator: run random (fuzzer, seed) pairs
+    until stopped or a budget runs out, recording failing seeds
+    (reference: src/scripts/cfo.zig — fleet machines fuzz 24/7 and push
+    failing seeds to devhub)."""
+    import random as _random
+    import time as _time
+
+    from .testing import fuzz
+
+    rng = (_random.Random(args.seed) if args.seed is not None
+           else _random.SystemRandom())
+    deadline = (_time.monotonic() + args.budget_s) if args.budget_s else None
+    runs = failures = 0
+    names = list(fuzz.FUZZERS)
+    try:
+        while deadline is None or _time.monotonic() < deadline:
+            name = rng.choice(names)
+            seed = rng.randrange(1 << 30)
+            try:
+                fuzz.run(name, seed)
+                runs += 1
+            except Exception as e:  # record and keep hunting
+                failures += 1
+                line = f"{name} {seed} {e!r}"
+                print(f"FAIL {line}", flush=True)
+                if args.failures_file:
+                    with open(args.failures_file, "a") as f:
+                        f.write(line + "\n")
+            if args.max_runs and runs + failures >= args.max_runs:
+                break
+    except KeyboardInterrupt:
+        pass
+    print(f"cfo: {runs} clean, {failures} failing "
+          f"(reproduce: python -m tigerbeetle_tpu fuzz <name> <seed>)")
+    return 1 if failures else 0
+
+
 def cmd_version(args) -> int:
     from . import __version__
 
@@ -569,6 +607,16 @@ def main(argv=None) -> int:
     p.add_argument("--history", default="devhub_history.jsonl")
     p.add_argument("--out", default="devhub.html")
     p.set_defaults(fn=cmd_devhub)
+
+    p = sub.add_parser("cfo")
+    p.add_argument("--budget-s", type=float, default=0,
+                   help="stop after this many seconds (0 = run forever)")
+    p.add_argument("--max-runs", type=int, default=0)
+    p.add_argument("--failures-file", default=None,
+                   help="append failing (fuzzer, seed) pairs here")
+    p.add_argument("--seed", type=int, default=None,
+                   help="deterministic pair selection (CI); default: random")
+    p.set_defaults(fn=cmd_cfo)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)

@@ -214,18 +214,25 @@ class Replica:
         self.commit_max = max(sb.commit_max, sb.op_checkpoint)
         self.prepare_timestamp = self.state_machine.state.commit_timestamp
         # Replay the WAL suffix above the checkpoint — but only up to the
-        # durably-KNOWN commit point, and only entries written under our
-        # last NORMAL view (sb.log_view): anything else may be a stale
-        # leftover a view change replaced while we were down (the canonical
-        # / sync-floor guards are volatile, so restart cannot trust them).
-        # Deferred entries re-commit through the live protocol once we
-        # rejoin (start_view re-installs canonical headers).
+        # durably-KNOWN commit point. A primary that COMPLETED its view's
+        # change (log_view == view) provably holds the canonical log up to
+        # that commit point (it verified its journal against the chosen
+        # log before start_view, and every later entry is its own), so it
+        # replays fully — prepares legitimately keep their original older
+        # views, which is why a view filter alone would wedge it. Everyone
+        # else stops at the first entry not written under sb.log_view: it
+        # may be a stale leftover a view change replaced while we were
+        # down (the canonical/sync-floor guards are volatile); deferred
+        # entries re-commit through the live protocol once we rejoin.
+        own_primary = (self.primary_index(sb.view) == self.replica_id
+                       and sb.log_view == sb.view and not self.is_standby)
         replay_to = min(self.op, self.commit_max)
-        for op in range(sb.op_checkpoint + 1, replay_to + 1):
-            m = self.journal.read_prepare(op)
-            if m is None or m.header.view != sb.log_view:
-                replay_to = op - 1
-                break
+        if not own_primary:
+            for op in range(sb.op_checkpoint + 1, replay_to + 1):
+                m = self.journal.read_prepare(op)
+                if m is None or m.header.view != sb.log_view:
+                    replay_to = op - 1
+                    break
         self._commit_journal(replay_to)
         if sb.log_view < sb.view:
             # We persisted a view we never completed (crashed mid
@@ -238,8 +245,14 @@ class Replica:
             self.status = "normal"
         self.last_heartbeat_rx = self.time.monotonic()
         if self.is_primary:
-            # Re-replicate our uncommitted suffix so it regains a quorum
-            # (single-replica clusters commit it immediately: quorum 1).
+            # Re-install canonical headers on the backups (their canonical
+            # sets died with their processes; without this they drop our
+            # old-view prepares), then re-replicate our uncommitted suffix
+            # so it regains a quorum (single-replica clusters commit it
+            # immediately: quorum 1). If the cluster moved to a newer view
+            # while we were down, backups ignore both (view guards) and we
+            # learn the new view from their traffic instead.
+            self._broadcast_start_view()
             for op in range(self.commit_min + 1, self.op + 1):
                 m = self.journal.read_prepare(op)
                 if m is not None:
@@ -391,8 +404,8 @@ class Replica:
             if held is None or held.header.checksum != h.checksum:
                 self.journal.append(msg)  # overwrite a stale same-op prepare
             self.op = max(self.op, h.op)
-            if self.is_standby:
-                pass  # standbys hold no vote (no prepare_ok)
+            if self.is_standby or self._pending_view is not None:
+                pass  # no vote; a pending primary finalizes below instead
             elif not self.is_primary:
                 self._send_prepare_ok(h)
             else:
