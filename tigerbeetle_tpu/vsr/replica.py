@@ -430,6 +430,7 @@ class Replica:
                 # replacement for a stale chain-suspect leftover — validated
                 # by its hash-chain linkage to neighbors we already hold.
                 self.journal.append(msg)
+                self.op = max(self.op, h.op)
                 self.chain_suspect.discard(h.op)
                 held = msg
                 self._commit_journal(self.commit_max)
@@ -741,9 +742,10 @@ class Replica:
         best = max(dvcs.values(),
                    key=lambda m: (m.header.context, m.header.op))
         # Our own log may extend beyond the chosen one (e.g. a higher
-        # log_view with a lower op wins): the excess is uncommitted.
+        # log_view with a lower op wins): the excess is uncommitted. Never
+        # truncate below commit_min — committed ops are final.
         if self.op > best.header.op:
-            self.op = best.header.op
+            self.op = max(best.header.op, self.commit_min)
         best_headers = _unpack_headers(best.body)
         suffix_base = (min(hh.op for hh in best_headers) if best_headers
                        else best.header.op + 1)
@@ -845,9 +847,11 @@ class Replica:
             self.sync_floor = max(self.sync_floor, suffix_base)
         # The electorate's log ends at h.op: anything we hold beyond it is
         # uncommitted by definition — truncate rather than risk executing a
-        # deposed primary's prepares under reused op numbers.
+        # deposed primary's prepares under reused op numbers. Never below
+        # commit_min: committed ops are final (a raced/stale same-view
+        # re-broadcast must not push op under what we executed).
         if self.op > h.op:
-            self.op = h.op
+            self.op = max(h.op, self.commit_min)
         self._install_log(headers)
         self.commit_max = max(self.commit_max, h.commit)
         self.last_heartbeat_rx = self.time.monotonic()
