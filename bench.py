@@ -1,110 +1,357 @@
 """Benchmark driver: create_transfers validated transfers/sec on TPU.
 
-Thin driver over tigerbeetle_tpu.benchmark (the package-level harness,
-reference: src/tigerbeetle/benchmark_driver.zig). Prints ONE JSON line
-{"metric", "value", "unit", "vs_baseline", ...}.
+Harness-proof, phase-isolated orchestrator (reference:
+src/tigerbeetle/benchmark_driver.zig). Every phase that touches JAX runs
+in a freshly-exec'd subprocess with the platform pinned in the
+environment BEFORE any jax import, so a wedged TPU tunnel can never
+take down the driver. Prints ONE JSON line at the end:
+{"metric", "value", "unit", "vs_baseline", ...} plus diagnostics.
 
-Env: BENCH_PLATFORM=cpu to force CPU; BENCH_QUICK=1 for a small CI run.
+Phases:
+  0. loopback port scan (no jax) — evidence of whether the axon relay
+     is listening at all.
+  1. axon backend probe (subprocess, bounded): import jax,
+     jax.devices(), one tiny op. On timeout the child dumps a
+     faulthandler traceback of all threads (captured into the JSON).
+  2. bench run (subprocess) on axon if the probe passed, else on CPU
+     as a clearly-labeled proxy. Per-config progress is streamed so a
+     mid-run wedge still yields partial numbers.
+
+Env knobs:
+  BENCH_PLATFORM=cpu|axon  force the platform (skips the probe)
+  BENCH_QUICK=1            small CI run
+  BENCH_CONFIGS="1,2,3"    config subset
+  BENCH_WATCHDOG_S=1500    total budget
+  BENCH_TPU_INIT_TIMEOUT_S=420  axon probe budget
 """
 
 from __future__ import annotations
 
 import json
 import os
-import threading
+import subprocess
+import sys
+import time
 
-# Watchdog: if the TPU tunnel wedges (backend init or a compile hangs),
-# still emit ONE JSON line before the driver's budget burns out.
-_done = threading.Event()
-
-
-def _watchdog():
-    timeout = float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
-    if not _done.wait(timeout):
-        print(json.dumps({
-            "metric": "create_transfers_validated_per_sec",
-            "value": None, "unit": "transfers/s", "vs_baseline": None,
-            "error": f"watchdog: no result within {timeout:.0f}s "
-                     "(backend init or compile hang)",
-        }), flush=True)
-        os._exit(2)
+REPO = os.path.dirname(os.path.abspath(__file__))
+T0 = time.time()
 
 
-threading.Thread(target=_watchdog, daemon=True).start()
-
-if os.environ.get("BENCH_PLATFORM"):
-    # The axon site hook pins JAX_PLATFORMS; an explicit override must go
-    # through jax.config before any backend initializes.
-    import jax
-
-    jax.config.update("jax_platforms", os.environ["BENCH_PLATFORM"])
-
-from tigerbeetle_tpu.benchmark import (
-    BASELINE_TPS,
-    TARGET_TPS,
-    bench_config1,
-    bench_config2,
-    bench_config3,
-    bench_config4,
-    parity_config5,
-)
+def _budget() -> float:
+    return float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
 
 
-def main():
+def _remaining(margin: float = 20.0) -> float:
+    return max(5.0, _budget() - (time.time() - T0) - margin)
+
+
+# ---------------------------------------------------------------- phase 0
+def listening_loopback_ports() -> list[int]:
+    """Listening TCP ports from /proc — is the axon relay up at all?
+
+    The axon PJRT plugin claims its TPU grant via an orchestrator
+    dialed at 127.0.0.1 (AXON_POOL_SVC_OVERRIDE); if nothing listens
+    there, PJRT_Client_Create retries forever and jax.devices() never
+    returns. This scan is the no-jax evidence for that diagnosis."""
+    loopback_hex = {
+        "0100007F",  # 127.0.0.1
+        "00000000000000000000000001000000",  # ::1
+        "0000000000000000FFFF00000100007F",  # ::ffff:127.0.0.1
+    }
+    any_hex = {"00000000", "00000000000000000000000000000000"}
+    ports: set[int] = set()
+    for path in ("/proc/net/tcp", "/proc/net/tcp6"):
+        try:
+            lines = open(path).read().splitlines()[1:]
+        except OSError:
+            continue
+        for ln in lines:
+            f = ln.split()
+            if len(f) > 3 and f[3] == "0A":  # TCP_LISTEN
+                addr, port = f[1].rsplit(":", 1)
+                # 0.0.0.0/:: wildcards accept loopback connections too.
+                if addr in loopback_hex or addr in any_hex:
+                    ports.add(int(port, 16))
+    return sorted(ports)
+
+
+def _pinned_env(platform: str) -> dict:
+    """Subprocess env with the JAX platform pinned BEFORE interpreter start.
+
+    The axon sitecustomize (PYTHONPATH hook) registers the axon PJRT
+    plugin and sets jax_platforms="axon,cpu" via jax.config.update in
+    every process where PALLAS_AXON_POOL_IPS is set — overriding the
+    JAX_PLATFORMS env var. For non-axon children we therefore strip
+    PALLAS_AXON_POOL_IPS so the plugin is never registered and the env
+    var rules; for axon children we leave the hook in place (it IS the
+    registration path)."""
+    env = dict(os.environ, JAX_PLATFORMS=platform)
+    env.pop("BENCH_PLATFORM", None)
+    if platform != "axon":
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+    return env
+
+
+# ---------------------------------------------------------------- phase 1
+_PROBE_SRC = r'''
+import faulthandler, json, os, sys, threading, time
+faulthandler.enable()
+deadline = float(sys.argv[1])
+def _dump():
+    time.sleep(max(1.0, deadline))
+    sys.stderr.write("PROBE_TIMEOUT_TRACEBACK\n")
+    faulthandler.dump_traceback(all_threads=True, file=sys.stderr)
+    sys.stderr.flush()
+    os._exit(3)
+threading.Thread(target=_dump, daemon=True).start()
+t0 = time.time()
+import jax
+t_import = time.time() - t0
+t1 = time.time()
+devs = jax.devices()
+t_devices = time.time() - t1
+import jax.numpy as jnp
+t2 = time.time()
+y = (jnp.arange(8) * 2).sum().block_until_ready()
+t_op = time.time() - t2
+print(json.dumps({
+    "ok": True, "import_s": round(t_import, 2),
+    "devices_s": round(t_devices, 2), "first_op_s": round(t_op, 2),
+    "n_devices": len(devs), "device0": str(devs[0]),
+    "platform": devs[0].platform, "result": int(y),
+}))
+'''
+
+
+def probe_platform(platform: str, timeout_s: float) -> dict:
+    """Bounded backend-init probe in a fresh subprocess.
+
+    Runs zero repo code: import jax → jax.devices() → one op. A failure
+    here is a platform failure, not a framework failure."""
+    env = _pinned_env(platform)
+    t0 = time.time()
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC, str(timeout_s)],
+            capture_output=True, text=True, timeout=timeout_s + 30,
+            cwd=REPO, env=env,
+        )
+        out, err, rc = p.stdout, p.stderr, p.returncode
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) else (e.stderr or "")
+        rc = -9
+    elapsed = round(time.time() - t0, 1)
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+                d["elapsed_s"] = elapsed
+                return d
+            except json.JSONDecodeError:
+                pass
+    return {
+        "ok": False, "rc": rc, "elapsed_s": elapsed,
+        "timeout_s": timeout_s,
+        "error": ("backend init did not complete: jax.devices() wedged "
+                  "inside PJRT_Client_Create (no repo code involved)"),
+        "stderr_tail": err[-2200:],
+    }
+
+
+# ---------------------------------------------------------------- phase 2
+def run_bench(platform: str, timeout_s: float) -> dict:
+    """Run the five configs in a subprocess pinned to `platform`.
+
+    The child streams one '##bench {json}' line per config, so a wedge
+    mid-run still yields partial per-config numbers."""
+    import tempfile
+    import threading
+
+    env = _pinned_env(platform)
+    # stderr goes to a temp file (not a pipe): a verbose child must never
+    # deadlock on a full pipe buffer while the parent reads stdout.
+    with tempfile.TemporaryFile(mode="w+") as errf:
+        proc = subprocess.Popen(
+            [sys.executable, os.path.join(REPO, "bench.py"), "--inner"],
+            stdout=subprocess.PIPE, stderr=errf, text=True,
+            cwd=REPO, env=env,
+        )
+        partial: dict = {}
+        final: dict | None = None
+        deadline = time.time() + timeout_s
+
+        def _kill_at_deadline():
+            while proc.poll() is None:
+                if time.time() > deadline:
+                    proc.kill()
+                    return
+                time.sleep(1.0)
+
+        threading.Thread(target=_kill_at_deadline, daemon=True).start()
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            line = line.strip()
+            if line.startswith("##bench "):
+                try:
+                    partial.update(json.loads(line[len("##bench "):]))
+                except json.JSONDecodeError:
+                    pass
+            elif line.startswith("{"):
+                try:
+                    final = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        proc.wait()
+        errf.seek(0, os.SEEK_END)
+        errf.seek(max(0, errf.tell() - 1500))
+        err_tail = errf.read()
+    if final is not None:
+        final["ok"] = True
+        return final
+    partial.update({
+        "ok": False, "rc": proc.returncode,
+        "error": f"bench subprocess died/timed out after {timeout_s:.0f}s",
+        "stderr_tail": err_tail,
+    })
+    return partial
+
+
+def inner_main() -> None:
+    """Runs inside the platform-pinned subprocess: execute configs."""
+    platform = os.environ.get("JAX_PLATFORMS", "")
+    if platform and platform != "axon":
+        # Defense in depth: if the axon sitecustomize still ran (e.g.
+        # invoked directly with BENCH_PLATFORM=cpu), out-pin its
+        # jax.config.update before any backend initializes.
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+    from tigerbeetle_tpu.benchmark import (
+        BASELINE_TPS,
+        TARGET_TPS,
+        bench_config1,
+        bench_config2,
+        bench_config3,
+        bench_config4,
+        parity_config5,
+    )
+
     quick = os.environ.get("BENCH_QUICK") == "1"
-    # BENCH_CONFIGS="1,2,3" runs a subset (skipped configs report null).
     subset = os.environ.get("BENCH_CONFIGS")
     run = {t.strip() for t in (subset or "1,2,3,4,5").split(",")}
     unknown = run - {"1", "2", "3", "4", "5"}
     assert not unknown, f"BENCH_CONFIGS has unknown tokens: {sorted(unknown)}"
-    # Batch counts are multiples of the scan chunk (B_CHUNK=8) so no timed
-    # work is spent on empty pad batches.
     b1 = 8 if quick else 24
     b2 = 8 if quick else 120  # 120 * 8190 ~ 1M transfers
     b3 = 8 if quick else 24
 
+    def emit(key, val):
+        print(f"##bench {json.dumps({key: val})}", flush=True)
+
+    def tps(a, e):
+        return None if a is None else round(a / e if e > 0 else 0.0, 1)
+
     acc1 = el1 = acc2 = el2 = acc3 = el3 = acc4 = el4 = parity = None
     if "1" in run:
         acc1, el1 = bench_config1(b1)
+        emit("config1_2hot_tps", tps(acc1, el1))
     if "2" in run:
         acc2, el2 = bench_config2(b2)
+        emit("config2_10k_tps", tps(acc2, el2))
     if "3" in run:
         acc3, el3 = bench_config3(b3)
+        emit("config3_chains_tps", tps(acc3, el3))
     if "4" in run:
         acc4, el4 = bench_config4(batches=2 if quick else 6)
+        emit("config4_twophase_limits_tps", tps(acc4, el4))
     if "5" in run:
         parity = parity_config5(n_batches=3 if quick else 6)
+        emit("config5_oracle_parity", parity)
 
-    def tps(a, e):
-        return None if a is None else (a / e if e > 0 else 0.0)
-
-    def r(x):
-        return None if x is None else round(x, 1)
-
-    value = tps(acc2, el2)
-
+    value = None if acc2 is None else (acc2 / el2 if el2 > 0 else 0.0)
     out = {
         "metric": "create_transfers_validated_per_sec",
-        "value": r(value),
+        "value": None if value is None else round(value, 1),
         "unit": "transfers/s",
         "vs_baseline": None if value is None else round(value / BASELINE_TPS, 4),
         "vs_target_10m": None if value is None else round(value / TARGET_TPS, 4),
-        "config1_2hot_tps": r(tps(acc1, el1)),
-        "config2_10k_tps": r(tps(acc2, el2)),
-        "config3_chains_tps": r(tps(acc3, el3)),
-        "config4_twophase_limits_tps": r(tps(acc4, el4)),
+        "config1_2hot_tps": tps(acc1, el1),
+        "config2_10k_tps": tps(acc2, el2),
+        "config3_chains_tps": tps(acc3, el3),
+        "config4_twophase_limits_tps": tps(acc4, el4),
         "config5_oracle_parity": parity,
         # Mean 8190-event batch latency at config2 rate. (The reference
-        # reports p100 — benchmark_load.zig:587; a true max needs per-batch
-        # syncs, which would serialize the on-device scan, so the mean is
-        # reported under an honest name instead.)
+        # reports p100 — benchmark_load.zig:587; a true max needs
+        # per-batch syncs, which would serialize the on-device scan, so
+        # the mean is reported under an honest name instead.)
         "batch_latency_mean_ms": (
             None if not acc2 else round(8190 / (acc2 / el2) * 1000, 3)),
         "engine": "device_ledger_scan",
     }
-    _done.set()
-    print(json.dumps(out))
+    print(json.dumps(out), flush=True)
+
+
+# ---------------------------------------------------------------- driver
+def main() -> None:
+    ports = listening_loopback_ports()
+    forced = os.environ.get("BENCH_PLATFORM")
+    probe: dict | None = None
+    if forced:
+        platform = forced
+    else:
+        probe_budget = min(
+            float(os.environ.get("BENCH_TPU_INIT_TIMEOUT_S", "420")),
+            _remaining() * 0.5,
+        )
+        probe = probe_platform("axon", probe_budget)
+        platform = "axon" if probe.get("ok") else "cpu"
+
+    bench = run_bench(platform, _remaining())
+
+    # Numbers measured on whatever platform actually ran; a partial run
+    # (subprocess died mid-way) still salvages config2 if it landed.
+    measured = bench.get("value")
+    if measured is None:
+        measured = bench.get("config2_10k_tps")
+    on_tpu = platform == "axon" and measured is not None
+    out = {
+        "metric": "create_transfers_validated_per_sec",
+        # Honest headline: a TPU-measured number when the chip ran (even
+        # partially), else null — the CPU proxy is reported under its
+        # own key and never impersonates the TPU.
+        "value": measured if on_tpu else None,
+        "unit": "transfers/s",
+        "vs_baseline": (round(measured / 1_000_000, 4) if on_tpu else None),
+        "vs_target_10m": (round(measured / 10_000_000, 4) if on_tpu else None),
+        "platform": platform,
+        "bench": {k: v for k, v in bench.items()
+                  if k not in ("metric", "value", "unit", "vs_baseline",
+                               "vs_target_10m")},
+        "loopback_listen_ports": ports,
+        "elapsed_s": round(time.time() - T0, 1),
+    }
+    if probe is not None:
+        out["tpu_probe"] = probe
+    if on_tpu and not bench.get("ok", False):
+        out["partial"] = True
+    if platform != "axon" and measured is not None:
+        out["cpu_proxy_tps"] = measured
+    if probe is not None and not probe.get("ok"):
+        out["error"] = (
+            "TPU backend unavailable: jax.devices() wedges inside "
+            "PJRT_Client_Create before any repo code runs (axon claim "
+            "loop retries forever; orchestrator/relay not reachable on "
+            f"loopback — listening ports: {ports}). See "
+            "tpu_probe.stderr_tail for the faulthandler stack.")
+    elif not bench.get("ok", False) and measured is None:
+        out["error"] = bench.get("error", "bench did not complete")
+    print(json.dumps(out), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--inner" in sys.argv[1:]:
+        inner_main()
+    else:
+        main()

@@ -12,9 +12,22 @@ u128 balances require exact 64-bit limb arithmetic, so the package enables
 jax_enable_x64 at import.
 """
 
+import os
+
 import jax
 
 jax.config.update("jax_enable_x64", True)
+
+# Honor the JAX_PLATFORMS env var even when a site hook has already
+# overridden it via jax.config.update (the axon sitecustomize sets
+# jax_platforms="axon,cpu" in every process, which silently outranks the
+# env var and can wedge a CPU-only user on an unavailable TPU tunnel).
+# Only re-pin while no backend has initialized and only when the user's
+# env choice excludes axon — an axon user keeps the hook's config.
+_env_platforms = os.environ.get("JAX_PLATFORMS", "")
+if _env_platforms and "axon" not in _env_platforms.split(","):
+    jax.config.update("jax_platforms", _env_platforms)
+del _env_platforms
 
 from . import constants, types  # noqa: E402
 
