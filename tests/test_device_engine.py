@@ -1,0 +1,334 @@
+"""The device serving engine: StateMachine(engine="device").
+
+VERDICT r1 #2: the consensus serving path and the benched path must be the
+same code — creates execute on the DeviceLedger via the vectorized fast
+kernels (ops/fast_kernels.py), with a write-through host mirror for
+queries and durability. These tests pin (a) bit-exact parity of the
+serving path against the oracle across fast batches, hard-regime
+fallbacks, and probe recovery; (b) the mirror staying value-identical to
+the device ground truth; (c) restart recovery re-attaching the device
+state; (d) a full consensus cluster running on the device engine.
+
+reference: src/lsm/groove.zig:885 (object cache get),
+src/state_machine.zig:2564 (commit), -Dvopr-state-machine differential
+switch (src/vopr.zig:25-29).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFilter,
+    AccountFilterFlags,
+    Operation,
+    QueryFilter,
+    Transfer,
+    TransferFlags,
+)
+
+PEND = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+VOID = int(TransferFlags.void_pending_transfer)
+LINKED = int(TransferFlags.linked)
+
+
+def _mk_pair(a_cap=1 << 10, t_cap=1 << 12):
+    dev = StateMachine(engine="device", a_cap=a_cap, t_cap=t_cap)
+    orc = StateMachine(engine="oracle")
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 101)]
+    for sm in (dev, orc):
+        res = sm.create_accounts(accts, 120)
+        assert all(r.status.name == "created" for r in res)
+    return dev, orc
+
+
+def _assert_state_equal(s1, s2):
+    assert s1.accounts == s2.accounts
+    assert s1.transfers == s2.transfers
+    assert s1.pending_status == s2.pending_status
+    assert s1.expiry == s2.expiry
+    assert s1.orphaned == s2.orphaned
+    assert s1.account_events == s2.account_events
+    assert s1.commit_timestamp == s2.commit_timestamp
+    assert s1.pulse_next_timestamp == s2.pulse_next_timestamp
+    assert s1.accounts_key_max == s2.accounts_key_max
+    assert s1.transfers_key_max == s2.transfers_key_max
+
+
+def _batch(rng, nid, n, hard_mix=False):
+    evs = []
+    nid_start = nid  # post/void target only pre-batch pendings (E2)
+    pids_used = set()  # E2 also bans duplicate pending_ids per batch
+    for i in range(n):
+        roll = rng.random()
+        tid = nid
+        nid += 1
+        if roll < 0.6:
+            evs.append(Transfer(
+                id=tid, debit_account_id=int(rng.integers(0, 105)),
+                credit_account_id=int(rng.integers(1, 105)),
+                amount=int(rng.integers(0, 500)), ledger=1,
+                code=int(rng.integers(0, 2)),
+                flags=LINKED if i % 11 == 0 else 0))
+        elif roll < 0.8 and hard_mix:
+            evs.append(Transfer(
+                id=tid, debit_account_id=int(rng.integers(1, 101)),
+                credit_account_id=1 + int(rng.integers(1, 100)),
+                amount=int(rng.integers(1, 50)), ledger=1, code=1,
+                flags=PEND, timeout=int(rng.integers(0, 3))))
+        elif roll < 0.9:
+            evs.append(Transfer(
+                id=tid, debit_account_id=int(rng.integers(1, 101)),
+                credit_account_id=1 + int(rng.integers(1, 100)),
+                amount=int(rng.integers(1, 50)), ledger=1, code=1,
+                flags=PEND))
+        else:
+            f = POST if rng.random() < 0.5 else VOID
+            pid = (int(rng.integers(10**6, nid_start))
+                   if nid_start > 10**6  # pre-batch pendings only (E2)
+                   else int(rng.integers(10**5, 10**6)))  # not-found probe
+            if pid in pids_used:  # E2 bans duplicate pending_ids
+                evs.append(Transfer(
+                    id=tid, debit_account_id=int(rng.integers(1, 101)),
+                    credit_account_id=1 + int(rng.integers(1, 100)),
+                    amount=1, ledger=1, code=1))
+                continue
+            pids_used.add(pid)
+            evs.append(Transfer(
+                id=tid, pending_id=pid,
+                amount=(2**128 - 1) if f == POST else 0, flags=f))
+    for e in evs:
+        if (e.flags & (POST | VOID)) == 0 \
+                and e.debit_account_id == e.credit_account_id:
+            e.credit_account_id = e.debit_account_id % 100 + 1
+    if evs[-1].flags & LINKED:
+        evs[-1].flags &= ~LINKED
+    return evs, nid
+
+
+class TestDeviceEngineParity:
+    def test_fast_path_dominates_plain_workload(self):
+        dev, orc = _mk_pair()
+        rng = np.random.default_rng(31)
+        ts, nid = 10**9, 10**6
+        for b in range(4):
+            evs, nid = _batch(rng, nid, 300)
+            ts += 400
+            got = dev.create_transfers(evs, ts)
+            want = orc.create_transfers(evs, ts)
+            assert [(r.timestamp, r.status) for r in got] == \
+                   [(r.timestamp, r.status) for r in want], b
+        assert dev.led.fast_batches >= 4  # accounts batch + transfer batches
+        _assert_state_equal(dev.state, orc.state)
+
+    def test_hard_regime_and_probe_recovery(self):
+        """Hard batches (E6: pending-with-timeout + post/void mixed) push
+        the ledger into the mirror regime; after MIRROR_PROBE_INTERVAL
+        easy batches the probe returns it to the fast path — with the
+        write-through mirror exact throughout."""
+        dev, orc = _mk_pair()
+        rng = np.random.default_rng(32)
+        ts, nid = 10**9, 10**6
+        # 2 hard batches, then 12 easy ones (probe interval is 8).
+        for b in range(14):
+            evs, nid = _batch(rng, nid, 200, hard_mix=(b < 2))
+            ts += 300
+            got = dev.create_transfers(evs, ts)
+            want = orc.create_transfers(evs, ts)
+            assert [(r.timestamp, r.status) for r in got] == \
+                   [(r.timestamp, r.status) for r in want], b
+        assert dev.led.fallbacks > 0
+        assert not dev.led._hard_regime  # probe recovered
+        _assert_state_equal(dev.state, orc.state)
+        # Device ground truth == mirror.
+        host = dev.led.to_host()
+        assert host.accounts == dev.state.accounts
+        assert host.transfers == dev.state.transfers
+        assert host.account_events == dev.state.account_events
+
+    def test_expiry_pulse(self):
+        dev, orc = _mk_pair()
+        ts = 10**9
+        evs = [Transfer(id=10**6 + i, debit_account_id=1 + i,
+                        credit_account_id=2 + i, amount=10, ledger=1, code=1,
+                        flags=PEND, timeout=1) for i in range(5)]
+        ts += 10
+        for sm in (dev, orc):
+            res = sm.create_transfers(evs, ts)
+            assert all(r.status.name == "created" for r in res)
+        later = ts + 5 * 10**9
+        assert dev.pulse_needed(later) and orc.pulse_needed(later)
+        body_ts = later
+        dev.commit(Operation.pulse, b"", body_ts)
+        orc.commit(Operation.pulse, b"", body_ts)
+        _assert_state_equal(dev.state, orc.state)
+        assert all(s.name == "expired"
+                   for s in dev.state.pending_status.values())
+
+    def test_queries_served_after_fast_batches(self):
+        dev, orc = _mk_pair()
+        ts = 10**9
+        evs = [Transfer(id=10**6 + i, debit_account_id=7,
+                        credit_account_id=8 + (i % 3), amount=5 + i,
+                        ledger=1, code=1, user_data_64=i % 2)
+               for i in range(50)]
+        ts += 60
+        for sm in (dev, orc):
+            sm.create_transfers(evs, ts)
+        f = AccountFilter(
+            account_id=7,
+            flags=int(AccountFilterFlags.debits | AccountFilterFlags.credits),
+            limit=100)
+        assert [t.id for t in dev.get_account_transfers(f)] == \
+               [t.id for t in orc.get_account_transfers(f)]
+        q = QueryFilter(user_data_64=1, limit=50)
+        assert [t.id for t in dev.query_transfers(q)] == \
+               [t.id for t in orc.query_transfers(q)]
+
+    def test_commit_wire_path_uses_device(self):
+        """The replica-facing commit() boundary routes through the ledger."""
+        dev = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 12)
+        body = multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128)
+        dev.commit(Operation.create_accounts, body, 100)
+        body = multi_batch.encode(
+            [Transfer(id=9, debit_account_id=1, credit_account_id=2,
+                      amount=50, ledger=1, code=1).pack()], 128)
+        dev.commit(Operation.create_transfers, body, 200)
+        assert dev.led.fast_batches == 2 and dev.led.fallbacks == 0
+        assert dev.state.accounts[2].credits_posted == 50
+
+
+class TestDirtyChannels:
+    def test_fast_orphans_not_repushed_by_hard_batch(self):
+        """Fast-batch transient failures insert orphan ids on device; the
+        next hard batch's push must not re-insert them (ht_insert claims
+        empty slots, so a re-insert would be a permanent duplicate). The
+        durable channel (.dirty) must still carry them for the flusher."""
+        dev, orc = _mk_pair()
+        ts = 10**9
+        # Fast batch with transient failures (missing debit accounts).
+        evs = [Transfer(id=10**6 + i, debit_account_id=500 + i,
+                        credit_account_id=1, amount=1, ledger=1, code=1)
+               for i in range(10)]
+        ts += 20
+        got = dev.create_transfers(evs, ts)
+        orc.create_transfers(evs, ts)
+        assert all(r.status.name == "debit_account_not_found" for r in got)
+        assert len(dev.state.orphaned) == 10
+        # Device-push channel drained; durable channel retained.
+        assert not dev.state.orphaned.dirty_dev
+        assert dev.state.orphaned.dirty == set(dev.state.orphaned)
+        # Hard batch (E6 mix) -> mirror apply + push; must not re-insert.
+        hard = [
+            Transfer(id=10**6 + 100, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1,
+                     flags=PEND, timeout=1),
+            Transfer(id=10**6 + 101, pending_id=10**6 + 100, amount=0,
+                     flags=VOID),
+        ]
+        ts += 20
+        got = dev.create_transfers(hard, ts)
+        want = orc.create_transfers(hard, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        assert dev.led.fallbacks == 1
+        # Retrying a poisoned id still reports id_already_failed via the
+        # device path (orphan_ht consistent, no duplicate entries).
+        ts += 20
+        retry = [Transfer(id=10**6, debit_account_id=1, credit_account_id=2,
+                          amount=1, ledger=1, code=1)]
+        got = dev.create_transfers(retry, ts)
+        want = orc.create_transfers(retry, ts)
+        assert got[0].status.name == "id_already_failed"
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        # Ground truth: device rebuild matches the mirror exactly.
+        host = dev.led.to_host()
+        assert host.orphaned == dev.state.orphaned
+
+
+class TestDeviceEngineRestart:
+    def test_state_reattach_rebuilds_device(self):
+        """Assigning .state (restart recovery / state sync) rebuilds the
+        device tables from the restored host state."""
+        dev, orc = _mk_pair()
+        rng = np.random.default_rng(33)
+        ts, nid = 10**9, 10**6
+        evs, nid = _batch(rng, nid, 100)
+        ts += 150
+        dev.create_transfers(evs, ts)
+        orc.create_transfers(evs, ts)
+        # "Restart": move a copy of the oracle state into a fresh device
+        # engine (replica recovery materializes a fresh oracle from the
+        # forest, so no aliasing there).
+        import copy
+
+        dev2 = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 12)
+        dev2.state = copy.deepcopy(orc.state)
+        evs2, nid = _batch(rng, nid, 100)
+        ts += 150
+        got = dev2.create_transfers(evs2, ts)
+        want = orc.create_transfers(evs2, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        _assert_state_equal(dev2.state, orc.state)
+
+
+class TestDeviceEngineCluster:
+    def test_cluster_consensus_on_device_engine(self):
+        """A 3-replica cluster serving through the device engine: normal
+        path + crash/restart recovery (the round-1 gap: the database
+        never ran the benched engine)."""
+        from tigerbeetle_tpu.testing.cluster import Cluster
+
+        cluster = Cluster(
+            seed=7, replica_count=3,
+            state_machine_factory=lambda: StateMachine(
+                engine="device", a_cap=1 << 10, t_cap=1 << 12))
+        client = cluster.client(55)
+        ops = [
+            (Operation.create_accounts, multi_batch.encode(
+                [b"".join(Account(id=i, ledger=1, code=1).pack()
+                          for i in (1, 2, 3))], 128)),
+            (Operation.create_transfers, multi_batch.encode(
+                [b"".join(Transfer(id=100 + k, debit_account_id=1,
+                                   credit_account_id=2, amount=k + 1,
+                                   ledger=1, code=1).pack()
+                          for k in range(10))], 128)),
+        ]
+        for op, body in ops:
+            client.request(op, body)
+            ok = cluster.run(3000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+        cluster.settle()
+        for r in cluster.replicas:
+            assert r.state_machine.engine == "device"
+            assert r.state_machine.led.fast_batches >= 2
+            a2 = r.state_machine.state.accounts[2]
+            assert a2.credits_posted == sum(range(1, 11))
+        # Crash + restart one backup: recovery must reattach the device.
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.crash(victim)
+        client.request(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=500, debit_account_id=2, credit_account_id=3,
+                      amount=5, ledger=1, code=1).pack()], 128))
+        ok = cluster.run(5000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        cluster.restart(victim)
+        cluster.settle()
+        r = cluster.replicas[victim]
+        assert r.state_machine.state.accounts[3].credits_posted == 5
+        # And the restarted replica keeps serving on the fast path.
+        client.request(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=501, debit_account_id=3, credit_account_id=1,
+                      amount=2, ledger=1, code=1).pack()], 128))
+        ok = cluster.run(5000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        for r in cluster.replicas:
+            assert r.state_machine.state.accounts[1].credits_posted == 2

@@ -3,7 +3,7 @@
 reference: src/tigerbeetle/main.zig (commands :146-186) + cli.zig. Commands:
 
   format     --cluster=N --replica=I --replica-count=N <path>
-  start      --addresses=a:p,b:p,... --replica=I [--engine=kernel|oracle] <path>
+  start      --addresses=a:p,b:p,... --replica=I [--engine=device|kernel|oracle] <path>
   repl       --addresses=... [--cluster=N]
   benchmark  [--transfer-count=N] [--account-count=N]
   inspect    <path>
@@ -96,7 +96,13 @@ def cmd_start(args) -> int:
         cluster=args.cluster, replica_id=args.replica,
         replica_count=len(addresses), storage=storage, bus=bus,
         time=_WallTime(), tracer=tracer, aof=aof,
-        state_machine_factory=lambda: StateMachine(engine=args.engine))
+        state_machine_factory=lambda: StateMachine(
+            engine=args.engine,
+            # Production capacities match the DeviceLedger defaults (the
+            # static-allocation bound, reference: config.zig limits);
+            # --small keeps test clusters light.
+            a_cap=(1 << 12) if args.small else (1 << 17),
+            t_cap=(1 << 14) if args.small else (1 << 21)))
     replica_holder.append(replica)
     replica.open()
     print(f"replica {args.replica} listening on "
@@ -518,7 +524,8 @@ def main(argv=None) -> int:
     p.add_argument("--addresses", required=True)
     p.add_argument("--replica", type=int, required=True)
     p.add_argument("--cluster", type=int, default=0)
-    p.add_argument("--engine", choices=("kernel", "oracle"), default="kernel")
+    p.add_argument("--engine", choices=("device", "kernel", "oracle"),
+               default="device")
     p.add_argument("--platform", default=None,
                    help="force a JAX platform (e.g. cpu)")
     p.add_argument("--small", action="store_true")

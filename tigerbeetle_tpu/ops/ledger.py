@@ -23,9 +23,10 @@ from __future__ import annotations
 
 import numpy as np
 
-from ..constants import BATCH_MAX, NS_PER_S
+from ..constants import BATCH_MAX, NS_PER_S, TIMESTAMP_MIN
 from ..types import (
     Account,
+    AccountFlags,
     CreateAccountResult,
     CreateAccountStatus,
     CreateTransferResult,
@@ -33,6 +34,12 @@ from ..types import (
     Transfer,
     TransferPendingStatus,
 )
+
+# Transient statuses poison the transfer id (reference:
+# src/tigerbeetle.zig:320-399); the write-through delta uses them to
+# mirror the device's orphan inserts on the host.
+_TRANSIENT_CODES = frozenset(
+    int(s) for s in CreateTransferStatus if s.transient())
 from . import u128
 from .hash_table import ht_init
 
@@ -153,6 +160,61 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
     )
 
 
+def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
+    """Fixed-size slices of the appended transfer/event rows + derived
+    gathers — the device side of the write-through delta."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    xfr = state["transfers"]
+    acc = state["accounts"]
+    evr = state["events"]
+    t = {k: lax.dynamic_slice_in_dim(v, t_start, size_t)
+         for k, v in xfr.items() if k != "count"}
+    e = {k: lax.dynamic_slice_in_dim(v, e_start, size_e)
+         for k, v in evr.items() if k != "count"}
+    p_rows = jnp.maximum(e["p_row"], 0)
+    return dict(
+        t=t, e=e,
+        dr_id_hi=acc["id_hi"][e["dr_row"]], dr_id_lo=acc["id_lo"][e["dr_row"]],
+        cr_id_hi=acc["id_hi"][e["cr_row"]], cr_id_lo=acc["id_lo"][e["cr_row"]],
+        p_ts=xfr["ts"][p_rows],
+    )
+
+
+def _acct_delta_gather(state, a_start, size):
+    from jax import lax
+
+    acc = state["accounts"]
+    return {k: lax.dynamic_slice_in_dim(v, a_start, size)
+            for k, v in acc.items() if k != "count"}
+
+
+_xfer_delta_gather_jit_cache = None
+_acct_delta_gather_jit_cache = None
+
+
+def _xfer_delta_gather_jit(state, t_start, e_start, size_t, size_e):
+    global _xfer_delta_gather_jit_cache
+    if _xfer_delta_gather_jit_cache is None:
+        import jax
+
+        _xfer_delta_gather_jit_cache = jax.jit(
+            _xfer_delta_gather, static_argnums=(3, 4))
+    return _xfer_delta_gather_jit_cache(state, t_start, e_start,
+                                        size_t, size_e)
+
+
+def _acct_delta_gather_jit(state, a_start, size):
+    global _acct_delta_gather_jit_cache
+    if _acct_delta_gather_jit_cache is None:
+        import jax
+
+        _acct_delta_gather_jit_cache = jax.jit(
+            _acct_delta_gather, static_argnums=2)
+    return _acct_delta_gather_jit_cache(state, a_start, size)
+
+
 def pad_transfer_events(ev: dict, n_pad: int = N_PAD) -> dict:
     """Pad a transfers_to_arrays SoA dict to the kernel's static shape."""
     n = len(ev["id_lo"])
@@ -179,7 +241,8 @@ class DeviceLedger:
     # the mirror and probe the device fast path again (hysteresis).
     MIRROR_PROBE_INTERVAL = 8
 
-    def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21):
+    def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21,
+                 write_through=None):
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.state = init_state(a_cap, t_cap)
@@ -190,9 +253,27 @@ class DeviceLedger:
         # oracle mirror of the device state, reused across consecutive
         # hard batches so each one costs an oracle apply + a dirty-delta
         # push instead of a full state sync in both directions.
-        self.mirror = None
+        self.mirror = write_through
         self._mirror_batches = 0
         self._probe_pending = False
+        # Write-through mode (the database serving path, reference analog:
+        # groove object cache + write-through at commit,
+        # src/lsm/groove.zig:885,1770): `write_through` is a host oracle
+        # kept in PERMANENT lockstep — fast batches apply a bounded
+        # device->host delta to it (_apply_fast_delta_*), hard batches run
+        # on it directly and push dirty objects back down. The mirror is
+        # never dropped; queries and durability read it while the device
+        # remains the execution engine.
+        self._wt = write_through is not None
+        if self._wt:
+            self._hard_regime = False
+            self._acct_row: dict[int, int] = {}
+            self._xfer_row: dict[int, int] = {}
+            if (write_through.accounts or write_through.transfers
+                    or write_through.account_events):
+                # Attaching a restored state (restart / state sync):
+                # rebuild the device tables from it.
+                self.from_host(write_through)
 
     # ------------------------------------------------------------- fast path
 
@@ -219,6 +300,8 @@ class DeviceLedger:
         self._probe_succeeded()
         st = np.asarray(out["r_status"][:n])
         ts = np.asarray(out["r_ts"][:n])
+        if self._wt:
+            self._apply_fast_delta_accounts(st)
         return [
             CreateAccountResult(timestamp=int(ts[i]),
                                 status=CreateAccountStatus(int(st[i])))
@@ -256,6 +339,8 @@ class DeviceLedger:
         self._probe_succeeded()
         st = np.asarray(out["r_status"][:n])
         ts = np.asarray(out["r_ts"][:n])
+        if self._wt:
+            self._apply_fast_delta_transfers(ev, st)
         return [
             CreateTransferResult(timestamp=int(ts[i]),
                                  status=CreateTransferStatus(int(st[i])))
@@ -517,6 +602,11 @@ class DeviceLedger:
         st["events"] = {k: (jnp.asarray(v) if hasattr(v, "shape")
                             else jnp.int32(v)) for k, v in evr.items()}
         self._events_pushed = n_e
+        # Everything is now device-resident: drop any push-pending marks
+        # the host state carried in (e.g. from a durable-restore rebuild).
+        for c in (sm.accounts, sm.transfers, sm.pending_status,
+                  sm.expiry, sm.orphaned):
+            c.dirty_dev.clear()
 
     # The fallback regime (reference analog: the "hard path" of
     # execute_create — order-dependent batches: balance limits, imported
@@ -529,7 +619,12 @@ class DeviceLedger:
 
     def _mirror_route(self) -> bool:
         """True if this batch should run on the host mirror."""
-        if self.mirror is None:
+        if self._wt:
+            # Write-through: the mirror always exists; the hard-regime
+            # flag (not mirror presence) carries the hysteresis.
+            if not self._hard_regime:
+                return False
+        elif self.mirror is None:
             return False
         self._mirror_batches += 1
         if self._mirror_batches > self.MIRROR_PROBE_INTERVAL:
@@ -544,8 +639,12 @@ class DeviceLedger:
 
     def _probe_succeeded(self) -> None:
         """The fast path took a batch: any held mirror is now stale (the
-        kernel mutated device state) — drop it."""
-        if self.mirror is not None:
+        kernel mutated device state) — drop it. In write-through mode the
+        mirror is permanent (the fast path delta-applies to it); only the
+        hard-regime flag resets."""
+        if self._wt:
+            self._hard_regime = False
+        elif self.mirror is not None:
             self.mirror = None
         self._probe_pending = False
         self._mirror_batches = 0
@@ -558,6 +657,7 @@ class DeviceLedger:
                           self.mirror.pending_status, self.mirror.expiry,
                           self.mirror.orphaned):
             container.dirty.clear()
+            container.dirty_dev.clear()
         return self.mirror
 
     def _event_cols(self, records: list) -> dict:
@@ -601,11 +701,215 @@ class DeviceLedger:
                      cols[f"{side}_{f}_lo"][i]) = _split(val)
         return cols
 
+
+    def _clear_dirty_dev(self) -> None:
+        """Everything the fast delta just applied to the mirror came FROM
+        the device, so it must not be re-pushed by the next _push_dirty
+        (re-inserting orphan ids would duplicate hash-table entries).
+        The durable channel (.dirty) is left untouched for the flusher."""
+        sm = self.mirror
+        for c in (sm.accounts, sm.transfers, sm.pending_status,
+                  sm.expiry, sm.orphaned):
+            c.dirty_dev.clear()
+
+    # ------------------------------------------------- write-through deltas
+
+    def _xfer_delta_fetch(self, n_new: int):
+        """Bounded device->host fetch of one fast batch's effects: the
+        n_new appended transfer rows + event-ring rows, plus derived
+        gathers (touched account ids, pending-transfer timestamps). Fixed
+        slice sizes (256 / N_PAD) keep the compile count at two."""
+        import jax
+
+        t0 = len(self._xfer_row)
+        e0 = self._events_pushed
+        t_len = int(self.state["transfers"]["id_hi"].shape[0])
+        e_len = int(self.state["events"]["ts"].shape[0])
+        size = 256 if n_new <= 256 else N_PAD
+        size_t = min(size, t_len)
+        size_e = min(size, e_len)
+        assert n_new <= size_t and n_new <= size_e
+        t_start = max(0, min(t0, t_len - size_t))
+        e_start = max(0, min(e0, e_len - size_e))
+        out = _xfer_delta_gather_jit(
+            self.state, np.int32(t_start), np.int32(e_start), size_t, size_e)
+        out = jax.device_get(out)
+        t_off, e_off = t0 - t_start, e0 - e_start
+        t = {k: v[t_off:t_off + n_new] for k, v in out["t"].items()}
+        e = {k: v[e_off:e_off + n_new] for k, v in out["e"].items()}
+        der = {k: out[k][e_off:e_off + n_new]
+               for k in ("dr_id_hi", "dr_id_lo", "cr_id_hi", "cr_id_lo",
+                         "p_ts")}
+        return t, e, der, t0
+
+    def _apply_fast_delta_transfers(self, ev: dict, st_np) -> None:
+        """Write-through: apply one fast transfer batch's effects to the
+        host mirror from bounded device slices. Mirrors the oracle's
+        success-path application exactly (oracle/state_machine.py
+        _create_transfer :417 and _post_or_void_pending_transfer :639,
+        including the _put_account conditions), so mirror state stays
+        value-identical to an oracle run, batch for batch."""
+        import dataclasses
+
+        from ..oracle.state_machine import AccountEventRecord
+
+        sm = self.mirror
+        created_code = int(CreateTransferStatus.created)
+        for i in range(len(st_np)):
+            code = int(st_np[i])
+            if code != created_code and code in _TRANSIENT_CODES:
+                sm.orphaned.add(
+                    (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i]))
+        n_new = int((st_np == np.uint32(created_code)).sum())
+        if n_new == 0:
+            self._clear_dirty_dev()
+            return
+        t, e, der, t0 = self._xfer_delta_fetch(n_new)
+        closed = int(AccountFlags.closed)
+        P = TransferPendingStatus
+
+        def u(hi, lo, k):
+            return (int(hi[k]) << 64) | int(lo[k])
+
+        for k in range(n_new):
+            ts = int(e["ts"][k])
+            tid = u(t["id_hi"], t["id_lo"], k)
+            tr = Transfer(
+                id=tid,
+                debit_account_id=u(t["dr_hi"], t["dr_lo"], k),
+                credit_account_id=u(t["cr_hi"], t["cr_lo"], k),
+                amount=u(t["amt_hi"], t["amt_lo"], k),
+                pending_id=u(t["pid_hi"], t["pid_lo"], k),
+                user_data_128=u(t["ud128_hi"], t["ud128_lo"], k),
+                user_data_64=int(t["ud64"][k]),
+                user_data_32=int(t["ud32"][k]),
+                timeout=int(t["timeout"][k]),
+                ledger=int(t["ledger"][k]),
+                code=int(t["code"][k]),
+                flags=int(t["flags"][k]),
+                timestamp=int(t["ts"][k]),
+            )
+            assert tr.timestamp == ts, (tr.timestamp, ts)
+            sm.transfers[tid] = tr
+            sm.transfer_by_timestamp[ts] = tid
+            self._xfer_row[tid] = t0 + k
+            if sm.transfers_key_max is None or ts > sm.transfers_key_max:
+                sm.transfers_key_max = ts
+            pstat = P(int(e["pstat"][k]))
+            amount = u(e["amt_hi"], e["amt_lo"], k)
+            areq = u(e["areq_hi"], e["areq_lo"], k)
+            tflags_raw = int(e["tflags"][k])
+            sides = {}
+            for side, hik, lok in (("dr", "dr_id_hi", "dr_id_lo"),
+                                   ("cr", "cr_id_hi", "cr_id_lo")):
+                aid = u(der[hik], der[lok], k)
+                prev = sm.accounts[aid]
+                new = dataclasses.replace(
+                    prev,
+                    debits_pending=u(e[f"{side}_dp_hi"], e[f"{side}_dp_lo"], k),
+                    debits_posted=u(e[f"{side}_dpos_hi"],
+                                    e[f"{side}_dpos_lo"], k),
+                    credits_pending=u(e[f"{side}_cp_hi"],
+                                      e[f"{side}_cp_lo"], k),
+                    credits_posted=u(e[f"{side}_cpos_hi"],
+                                     e[f"{side}_cpos_lo"], k),
+                    flags=int(e[f"{side}_flags"][k]),
+                )
+                sides[side] = (aid, prev, new)
+            p_obj = None
+            if pstat in (P.posted, P.voided):
+                pts = int(der["p_ts"][k])
+                pid = sm.transfer_by_timestamp[pts]
+                p_obj = sm.transfers[pid]
+                sm.pending_status[pts] = pstat
+                if p_obj.timeout:
+                    expires_at = pts + p_obj.timeout * NS_PER_S
+                    if pts in sm.expiry:
+                        del sm.expiry[pts]
+                    if sm.pulse_next_timestamp == expires_at:
+                        sm.pulse_next_timestamp = TIMESTAMP_MIN
+                for side in ("dr", "cr"):
+                    aid, prev, new = sides[side]
+                    if (amount > 0 or p_obj.amount > 0
+                            or (new.flags ^ prev.flags) & closed):
+                        sm.accounts[aid] = new
+            else:
+                if pstat == P.pending:
+                    sm.pending_status[ts] = P.pending
+                    if tr.timeout:
+                        expires_at = ts + tr.timeout * NS_PER_S
+                        sm.expiry[ts] = expires_at
+                        if expires_at < sm.pulse_next_timestamp:
+                            sm.pulse_next_timestamp = expires_at
+                for side in ("dr", "cr"):
+                    aid, prev, new = sides[side]
+                    if amount > 0 or (new.flags & closed):
+                        sm.accounts[aid] = new
+            sm.account_events.append(AccountEventRecord(
+                timestamp=ts,
+                dr_account=sides["dr"][2], cr_account=sides["cr"][2],
+                transfer_flags=(None if tflags_raw == 0xFFFFFFFF
+                                else tflags_raw),
+                transfer_pending_status=pstat,
+                transfer_pending=p_obj,
+                amount_requested=areq, amount=amount))
+            sm.commit_timestamp = ts
+        self._events_pushed += n_new
+        self._clear_dirty_dev()
+
+    def _apply_fast_delta_accounts(self, st_np) -> None:
+        """Write-through: apply one fast account batch to the host mirror
+        (oracle _create_account :326 success path)."""
+        sm = self.mirror
+        created_code = int(CreateAccountStatus.created)
+        n_new = int((st_np == np.uint32(created_code)).sum())
+        if n_new == 0:
+            return
+        import jax
+
+        a0 = len(self._acct_row)
+        a_len = int(self.state["accounts"]["id_hi"].shape[0])
+        size = min(256 if n_new <= 256 else N_PAD, a_len)
+        assert n_new <= size
+        a_start = max(0, min(a0, a_len - size))
+        a = jax.device_get(
+            _acct_delta_gather_jit(self.state, np.int32(a_start), size))
+        off = a0 - a_start
+        a = {k: v[off:off + n_new] for k, v in a.items()}
+        for k in range(n_new):
+            aid = (int(a["id_hi"][k]) << 64) | int(a["id_lo"][k])
+            acct = Account(
+                id=aid,
+                debits_pending=_balance_int(a, "dp", k),
+                debits_posted=_balance_int(a, "dpos", k),
+                credits_pending=_balance_int(a, "cp", k),
+                credits_posted=_balance_int(a, "cpos", k),
+                user_data_128=(int(a["ud128_hi"][k]) << 64)
+                | int(a["ud128_lo"][k]),
+                user_data_64=int(a["ud64"][k]),
+                user_data_32=int(a["ud32"][k]),
+                ledger=int(a["ledger"][k]),
+                code=int(a["code"][k]),
+                flags=int(a["flags"][k]),
+                timestamp=int(a["ts"][k]),
+            )
+            sm.accounts[aid] = acct
+            sm.account_by_timestamp[acct.timestamp] = aid
+            self._acct_row[aid] = a0 + k
+            if (sm.accounts_key_max is None
+                    or acct.timestamp > sm.accounts_key_max):
+                sm.accounts_key_max = acct.timestamp
+            sm.commit_timestamp = acct.timestamp
+        self._clear_dirty_dev()
+
     def _fallback_transfers(self, transfers, timestamp):
         self.fallbacks += 1
         if self._probe_pending:
             self._probe_pending = False
             self._mirror_batches = 1  # probe failed: regime continues
+        if self._wt and not self._hard_regime:
+            self._hard_regime = True
+            self._mirror_batches = 1
         sm = self.mirror if self.mirror is not None else self._enter_mirror()
         # The pure-Python oracle IS the exact sequential semantics — in the
         # mirror regime it beats the device sequential kernel because the
@@ -619,6 +923,9 @@ class DeviceLedger:
         if self._probe_pending:
             self._probe_pending = False
             self._mirror_batches = 1  # probe failed: regime continues
+        if self._wt and not self._hard_regime:
+            self._hard_regime = True
+            self._mirror_batches = 1
         sm = self.mirror if self.mirror is not None else self._enter_mirror()
         results = sm.create_accounts(accounts, timestamp)
         self._push_dirty()
@@ -660,9 +967,9 @@ class DeviceLedger:
             return jnp.asarray(mask)
 
         # ---- accounts: updates + inserts
-        dirty_accounts = sorted(a for a in sm.accounts.dirty
+        dirty_accounts = sorted(a for a in sm.accounts.dirty_dev
                                 if a in sm.accounts)
-        sm.accounts.dirty.clear()
+        sm.accounts.dirty_dev.clear()
         if dirty_accounts:
             new_ids = [a for a in dirty_accounts if a not in self._acct_row]
             next_row = int(acc["count"])
@@ -715,9 +1022,9 @@ class DeviceLedger:
                 assert bool(ok), "acct hash overflow: raise capacities"
 
         # ---- transfers: inserts (immutable rows)
-        dirty_transfers = sorted(t for t in sm.transfers.dirty
+        dirty_transfers = sorted(t for t in sm.transfers.dirty_dev
                                  if t in sm.transfers)
-        sm.transfers.dirty.clear()
+        sm.transfers.dirty_dev.clear()
         new_tids = [t for t in dirty_transfers if t not in self._xfer_row]
         if new_tids:
             next_row = int(xfr["count"])
@@ -788,8 +1095,8 @@ class DeviceLedger:
             assert bool(ok), "xfer hash overflow: raise capacities"
 
         # ---- pending status flips + expiry changes on EXISTING rows
-        dirty_pending = sorted(sm.pending_status.dirty)
-        sm.pending_status.dirty.clear()
+        dirty_pending = sorted(sm.pending_status.dirty_dev)
+        sm.pending_status.dirty_dev.clear()
         flip = [(self._xfer_row[sm.transfer_by_timestamp[ts]],
                  int(sm.pending_status[ts]))
                 for ts in dirty_pending
@@ -799,8 +1106,8 @@ class DeviceLedger:
                        self.t_cap)
             vals = pad(np.array([v for _, v in flip], dtype=np.int32), 0)
             xfr["pstat"] = xfr["pstat"].at[rows].set(jnp.asarray(vals))
-        dirty_expiry = sorted(sm.expiry.dirty)
-        sm.expiry.dirty.clear()
+        dirty_expiry = sorted(sm.expiry.dirty_dev)
+        sm.expiry.dirty_dev.clear()
         exp = [(self._xfer_row[sm.transfer_by_timestamp[ts]],
                 sm.expiry.get(ts, 0))
                for ts in dirty_expiry
@@ -812,8 +1119,8 @@ class DeviceLedger:
             xfr["expires"] = xfr["expires"].at[rows].set(jnp.asarray(vals))
 
         # ---- orphaned ids
-        dirty_orphans = sorted(sm.orphaned.dirty)
-        sm.orphaned.dirty.clear()
+        dirty_orphans = sorted(sm.orphaned.dirty_dev)
+        sm.orphaned.dirty_dev.clear()
         if dirty_orphans:
             st["orphan_ht"], ok = ht_insert(
                 st["orphan_ht"],

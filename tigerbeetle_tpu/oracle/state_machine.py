@@ -58,22 +58,28 @@ class AccountEventRecord:
 
 
 class DirtyDict(dict):
-    """Dict that records mutated keys (the durable layer's write-behind set:
-    every key touched since the last flush, whether by the sequential oracle
-    or by the kernel wrapper's direct write-backs). `dirty` is cleared by the
-    flusher, never by the dict itself."""
+    """Dict that records mutated keys on two independent channels:
+    `dirty` is the durable layer's write-behind set (cleared by
+    DurableState.flush), `dirty_dev` is the device ledger's push-pending
+    set (cleared by DeviceLedger._push_dirty / the write-through delta).
+    Two consumers with different flush cadences must not share one bit —
+    e.g. a replica flushes every commit while the device push only runs
+    on hard batches."""
 
     def __init__(self, *args):
         super().__init__(*args)
         self.dirty: set = set()
+        self.dirty_dev: set = set()
 
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
         self.dirty.add(key)
+        self.dirty_dev.add(key)
 
     def __delitem__(self, key):
         if key in self:
             self.dirty.add(key)
+            self.dirty_dev.add(key)
         super().__delitem__(key)
 
     def pop(self, key, *default):
@@ -82,19 +88,23 @@ class DirtyDict(dict):
         # tombstone write downstream.
         if key in self:
             self.dirty.add(key)
+            self.dirty_dev.add(key)
         return super().pop(key, *default)
 
 
 class DirtySet(set):
-    """Set that records added members since the last flush."""
+    """Set that records added members since the last flush (same two
+    channels as DirtyDict)."""
 
     def __init__(self, *args):
         super().__init__(*args)
         self.dirty: set = set()
+        self.dirty_dev: set = set()
 
     def add(self, member):
         super().add(member)
         self.dirty.add(member)
+        self.dirty_dev.add(member)
 
 
 class _Scope:

@@ -109,13 +109,26 @@ class _Index:
 
 class StateMachine:
     """Engine selection mirrors the reference's `-Dvopr-state-machine=`
-    differential-testing switch: 'kernel' runs batches on the TPU sequential
-    kernel, 'oracle' runs the pure-Python reference implementation."""
+    differential-testing switch: 'device' serves batches from the
+    device-resident DeviceLedger via the vectorized fast kernels
+    (ops/fast_kernels.py) with a write-through host mirror for queries and
+    durability — the database serving path; 'kernel' runs batches on the
+    sequential device kernel; 'oracle' runs the pure-Python reference
+    implementation."""
 
-    def __init__(self, engine: str = "kernel"):
-        assert engine in ("kernel", "oracle")
+    def __init__(self, engine: str = "kernel",
+                 a_cap: int = 1 << 14, t_cap: int = 1 << 16):
+        assert engine in ("kernel", "oracle", "device")
         self.engine = engine
-        self.state = StateMachineOracle()
+        self._a_cap = a_cap
+        self._t_cap = t_cap
+        self._state = StateMachineOracle()
+        self.led = None
+        if engine == "device":
+            from .ops.ledger import DeviceLedger
+
+            self.led = DeviceLedger(a_cap=a_cap, t_cap=t_cap,
+                                    write_through=self._state)
         # Secondary indexes (host analog of the LSM index trees).
         self._xfer_ts: list[int] = []  # all transfer timestamps ascending
         self._xfer_by: dict[str, _Index] = {
@@ -133,9 +146,40 @@ class StateMachine:
         self._events_by_ts: dict[int, AccountEventRecord] = {}
         self._events_indexed = 0
 
+    # ------------------------------------------------------------- state
+
+    @property
+    def state(self) -> StateMachineOracle:
+        return self._state
+
+    @state.setter
+    def state(self, new_state: StateMachineOracle) -> None:
+        """Replace the authoritative state (restart recovery / state sync,
+        vsr/replica.py). For the device engine this rebuilds the device
+        tables from the restored host state."""
+        self._state = new_state
+        if self.engine == "device":
+            from .ops.ledger import DeviceLedger
+
+            self.led = DeviceLedger(a_cap=self._a_cap, t_cap=self._t_cap,
+                                    write_through=new_state)
+        # Derived query indexes must be rebuilt from scratch.
+        self._xfer_ts = []
+        for idx in self._xfer_by.values():
+            idx.by_value = {}
+        self._xfer_indexed = 0
+        self._acct_ts = []
+        for idx in self._acct_by.values():
+            idx.by_value = {}
+        self._acct_indexed = 0
+        self._events_by_ts = {}
+        self._events_indexed = 0
+
     # ------------------------------------------------------------- creates
 
     def create_accounts(self, events: list[Account], timestamp: int):
+        if self.engine == "device":
+            return self.led.create_accounts(events, timestamp)
         if self.engine == "kernel":
             from .ops.create_kernels import run_create_accounts
 
@@ -143,6 +187,8 @@ class StateMachine:
         return self.state.create_accounts(events, timestamp)
 
     def create_transfers(self, events: list[Transfer], timestamp: int):
+        if self.engine == "device":
+            return self.led.create_transfers(events, timestamp)
         if self.engine == "kernel":
             from .ops.create_kernels import run_create_transfers
 
@@ -463,7 +509,10 @@ class StateMachine:
             raise ProtocolError(f"malformed body for {op!r}")
         spec = OPERATION_SPECS[op]
         if op == Operation.pulse:
-            self.state.expire_pending_transfers(timestamp)
+            if self.engine == "device":
+                self.led.expire_pending_transfers(timestamp)
+            else:
+                self.state.expire_pending_transfers(timestamp)
             return b""
         if op.is_multi_batch():
             batches = multi_batch.decode(body, spec.event_size)

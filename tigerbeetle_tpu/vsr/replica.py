@@ -170,7 +170,7 @@ class Replica:
 
         durable = DurableState(storage)
         sessions_blob = ClientSessions(storage).pack()
-        root = (durable.checkpoint(StateMachine().state)
+        root = (durable.checkpoint(StateMachine(engine="oracle").state)
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
         storage.write("snapshot", 0, root)
         sb = SuperBlock(
