@@ -162,28 +162,50 @@ def _dup_keys(k_hi, k_lo, tags):
 
 # ================================================== create_transfers (fast)
 
-def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
-    """One batch against the device ledger. Returns (new_state, out) where
-    out = {r_status, r_ts, fallback, created_count}. When out['fallback'] is
-    set, new_state is the input state unchanged (every write is masked to the
-    dump slot, so donated buffers are reusable in place).
+def _acct_gather(acc, rows, found):
+    """Gather the account fields the kernel needs at `rows` (clamped)."""
+    return dict(
+        exists=found,
+        dp=_gather_balance(acc, "dp", rows),
+        dpos=_gather_balance(acc, "dpos", rows),
+        cp=_gather_balance(acc, "cp", rows),
+        cpos=_gather_balance(acc, "cpos", rows),
+        ledger=acc["ledger"][rows],
+        code=acc["code"][rows],
+        flags=acc["flags"][rows],
+        ts=acc["ts"][rows],
+    )
 
-    force_fallback: optional bool scalar that aborts the batch uncondition-
-    ally (used by the scan driver to poison batches after a fallback)."""
-    from .hash_table import ht_lookup, ht_plan, ht_write
+
+def _xfer_gather(xfr, rows):
+    return {k: xfr[k][rows] for k in (
+        "dr_hi", "dr_lo", "cr_hi", "cr_lo", "amt_hi", "amt_lo",
+        "pid_hi", "pid_lo", "ud128_hi", "ud128_lo", "ud64", "ud32",
+        "timeout", "ledger", "code", "flags", "ts", "expires",
+        "pstat", "dr_row", "cr_row")}
+
+
+def per_event_status(state, ev, ts_event):
+    """The per-event phase of create_transfers: hash lookups, row gathers,
+    and the order-independent status evaluation (exists/idempotency,
+    post/void checks, regular checks, imported/timestamp rules — reference
+    create_transfer :3719-3904 minus running-balance effects).
+
+    Pure per event given replicated state: this is the SHARDABLE stage of
+    the SPMD kernel. parallel/sharded.py runs it on each device's slice of
+    the batch and all-gathers this compact result; the global tail
+    (eligibility reductions, chains, application) then runs replicated on
+    every device — identical by determinism, so the replicated state stays
+    bit-exact across the mesh."""
+    from .hash_table import ht_lookup
 
     acc = state["accounts"]
     xfr = state["transfers"]
-    N = ev["id_lo"].shape[0]
     A_dump = acc["id_hi"].shape[0] - 1
     T_dump = xfr["id_hi"].shape[0] - 1
-    idxs = jnp.arange(N, dtype=jnp.int32)
     valid = ev["valid"]
-    nn = n.astype(jnp.uint64)
-    ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
 
     flags = ev["flags"]
-    linked = _flag(flags, _F_LINKED) & valid
     pending = _flag(flags, _F_PENDING)
     is_post = _flag(flags, _F_POST)
     is_void = _flag(flags, _F_VOID)
@@ -201,33 +223,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     e_rowc = jnp.where(e_found, e_row, T_dump)
     p_rowc = jnp.where(p_found, p_row, T_dump)
 
-    def acct_gather(rows, found):
-        return dict(
-            exists=found,
-            dp=_gather_balance(acc, "dp", rows),
-            dpos=_gather_balance(acc, "dpos", rows),
-            cp=_gather_balance(acc, "cp", rows),
-            cpos=_gather_balance(acc, "cpos", rows),
-            ledger=acc["ledger"][rows],
-            code=acc["code"][rows],
-            flags=acc["flags"][rows],
-            ts=acc["ts"][rows],
-        )
-
-    def xfer_gather(rows):
-        g = {k: xfr[k][rows] for k in (
-            "dr_hi", "dr_lo", "cr_hi", "cr_lo", "amt_hi", "amt_lo",
-            "pid_hi", "pid_lo", "ud128_hi", "ud128_lo", "ud64", "ud32",
-            "timeout", "ledger", "code", "flags", "ts", "expires",
-            "pstat", "dr_row", "cr_row")}
-        return g
-
-    dr = acct_gather(dr_rowc, dr_found)
-    cr = acct_gather(cr_rowc, cr_found)
-    e = xfer_gather(e_rowc)
-    p = xfer_gather(p_rowc)
-    p_dr = acct_gather(p["dr_row"], p_found)
-    p_cr = acct_gather(p["cr_row"], p_found)
+    dr = _acct_gather(acc, dr_rowc, dr_found)
+    cr = _acct_gather(acc, cr_rowc, cr_found)
+    e = _xfer_gather(xfr, e_rowc)
+    p = _xfer_gather(xfr, p_rowc)
+    p_dr = _acct_gather(acc, p["dr_row"], p_found)
+    p_cr = _acct_gather(acc, p["cr_row"], p_found)
 
     # Resolved post/void amount (sentinel resolution, reference :4101-4112).
     pv_amt_hi, pv_amt_lo = u128.select(
@@ -235,64 +236,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
                   u128.is_zero(ev["amt_hi"], ev["amt_lo"]),
                   u128.is_max(ev["amt_hi"], ev["amt_lo"])),
         p["amt_hi"], p["amt_lo"], ev["amt_hi"], ev["amt_lo"])
-
-    # ---------------- eligibility ----------------
-    hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
-    e1 = jnp.any(valid & _flag(flags, jnp.uint32(hard_flags)))
-
-    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
-    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
-    e2 = _dup_keys(
-        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
-        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
-        jnp.concatenate([tag, ptag]))
-
-    reg = valid & ~pv
-    e3 = jnp.any(reg & (_flag(dr["flags"], _A_DR_LIMIT)
-                        | _flag(cr["flags"], _A_CR_LIMIT)))
-
     amt_res_hi = jnp.where(pv, pv_amt_hi, ev["amt_hi"])
     amt_res_lo = jnp.where(pv, pv_amt_lo, ev["amt_lo"])
-    a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
-    a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
-    l0, l1, l2, l3 = _to_limbs(a_hi, a_lo)
-    s0 = jnp.sum(l0)
-    s1 = jnp.sum(l1)
-    s2 = jnp.sum(l2)
-    s3 = jnp.sum(l3)  # each < 2^45: no u64 overflow
-    # S as 5 limbs (normalized).
-    c = s0 >> jnp.uint64(32); s0 &= _M32
-    s1 += c; c = s1 >> jnp.uint64(32); s1 &= _M32
-    s2 += c; c = s2 >> jnp.uint64(32); s2 &= _M32
-    s3 += c; s4 = s3 >> jnp.uint64(32); s3 &= _M32
-    s_hi = s2 | (s3 << jnp.uint64(32))
-    s_lo = s0 | (s1 << jnp.uint64(32))
-    # The tightest overflow statuses are overflows_debits/credits, which sum
-    # TWO balance fields plus the amount (reference :3874-3884). Bound them
-    # with max over touched accounts of (dp+dpos) and (cp+cpos): any
-    # already-overflowing pair sum, or pair-max + S >= 2^128, falls back.
-    # Every single-field check is dominated by its pair sum.
-    zeros = jnp.zeros_like(ev["amt_hi"])
-    pair_his, pair_los, pair_ovf = [], [], jnp.bool_(False)
-    for acct_g in (dr, cr, p_dr, p_cr):
-        for f1, f2 in (("dp", "dpos"), ("cp", "cpos")):
-            h, l, o = u128.add(acct_g[f1][0], acct_g[f1][1],
-                               acct_g[f2][0], acct_g[f2][1])
-            pair_his.append(jnp.where(valid, h, zeros))
-            pair_los.append(jnp.where(valid, l, zeros))
-            pair_ovf = pair_ovf | jnp.any(valid & o)
-    m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
-    _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
-    e4 = ovf | (s4 > 0) | pair_ovf
-
-    e5 = jnp.any(valid & is_void & p_found
-                 & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
-
-    any_pending_timeout = jnp.any(valid & pending & (ev["timeout"] != 0))
-    any_pv = jnp.any(valid & pv)
-    e6 = any_pending_timeout & any_pv
-
-    fallback_pre = e1 | e2 | e3 | e4 | e5 | e6
 
     # ---------------- status evaluation ----------------
     exists_status, exists_ts = _ct_eval_exists(
@@ -382,6 +327,122 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None):
     # always a mismatch (reference execute_create :3052-3063).
     status = jnp.where(imported, _TS["imported_event_not_expected"], status)
     ts_actual = jnp.where(status == inner, ts_inner, ts_event)
+
+    return dict(
+        status_pre=status, ts_pre=ts_actual,
+        amt_res_hi=amt_res_hi, amt_res_lo=amt_res_lo,
+        dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
+        dr_found=dr_found, cr_found=cr_found, p_found=p_found,
+    )
+
+
+def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
+                          per_event=None):
+    """One batch against the device ledger. Returns (new_state, out) where
+    out = {r_status, r_ts, fallback, created_count}. When out['fallback'] is
+    set, new_state is the input state unchanged (every write is masked to the
+    dump slot, so donated buffers are reusable in place).
+
+    force_fallback: optional bool scalar that aborts the batch uncondition-
+    ally (used by the scan driver to poison batches after a fallback).
+    per_event: optional precomputed per_event_status() result (the sharded
+    SPMD path computes it per device slice and all-gathers)."""
+    from .hash_table import ht_plan, ht_write
+
+    acc = state["accounts"]
+    xfr = state["transfers"]
+    N = ev["id_lo"].shape[0]
+    A_dump = acc["id_hi"].shape[0] - 1
+    T_dump = xfr["id_hi"].shape[0] - 1
+    idxs = jnp.arange(N, dtype=jnp.int32)
+    valid = ev["valid"]
+    nn = n.astype(jnp.uint64)
+    ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
+
+    flags = ev["flags"]
+    linked = _flag(flags, _F_LINKED) & valid
+    pending = _flag(flags, _F_PENDING)
+    is_post = _flag(flags, _F_POST)
+    is_void = _flag(flags, _F_VOID)
+    pv = is_post | is_void
+    timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
+
+    if per_event is None:
+        per_event = per_event_status(state, ev, ts_event)
+    dr_rowc = per_event["dr_row"]
+    cr_rowc = per_event["cr_row"]
+    p_rowc = per_event["p_row"]
+    dr_found = per_event["dr_found"]
+    cr_found = per_event["cr_found"]
+    p_found = per_event["p_found"]
+    amt_res_hi = per_event["amt_res_hi"]
+    amt_res_lo = per_event["amt_res_lo"]
+    status = per_event["status_pre"]
+    ts_actual = per_event["ts_pre"]
+
+    # Re-gather the touched rows (cheap O(N) gathers on replicated state;
+    # keeps the all-gathered per-event bundle compact in the SPMD path).
+    dr = _acct_gather(acc, dr_rowc, dr_found)
+    cr = _acct_gather(acc, cr_rowc, cr_found)
+    p = _xfer_gather(xfr, p_rowc)
+    p_dr = _acct_gather(acc, p["dr_row"], p_found)
+    p_cr = _acct_gather(acc, p["cr_row"], p_found)
+
+    # ---------------- eligibility ----------------
+    hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
+    e1 = jnp.any(valid & _flag(flags, jnp.uint32(hard_flags)))
+
+    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
+    e2 = _dup_keys(
+        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
+        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
+        jnp.concatenate([tag, ptag]))
+
+    reg = valid & ~pv
+    e3 = jnp.any(reg & (_flag(dr["flags"], _A_DR_LIMIT)
+                        | _flag(cr["flags"], _A_CR_LIMIT)))
+
+    a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
+    a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
+    l0, l1, l2, l3 = _to_limbs(a_hi, a_lo)
+    s0 = jnp.sum(l0)
+    s1 = jnp.sum(l1)
+    s2 = jnp.sum(l2)
+    s3 = jnp.sum(l3)  # each < 2^45: no u64 overflow
+    # S as 5 limbs (normalized).
+    c = s0 >> jnp.uint64(32); s0 &= _M32
+    s1 += c; c = s1 >> jnp.uint64(32); s1 &= _M32
+    s2 += c; c = s2 >> jnp.uint64(32); s2 &= _M32
+    s3 += c; s4 = s3 >> jnp.uint64(32); s3 &= _M32
+    s_hi = s2 | (s3 << jnp.uint64(32))
+    s_lo = s0 | (s1 << jnp.uint64(32))
+    # The tightest overflow statuses are overflows_debits/credits, which sum
+    # TWO balance fields plus the amount (reference :3874-3884). Bound them
+    # with max over touched accounts of (dp+dpos) and (cp+cpos): any
+    # already-overflowing pair sum, or pair-max + S >= 2^128, falls back.
+    # Every single-field check is dominated by its pair sum.
+    zeros = jnp.zeros_like(ev["amt_hi"])
+    pair_his, pair_los, pair_ovf = [], [], jnp.bool_(False)
+    for acct_g in (dr, cr, p_dr, p_cr):
+        for f1, f2 in (("dp", "dpos"), ("cp", "cpos")):
+            h, l, o = u128.add(acct_g[f1][0], acct_g[f1][1],
+                               acct_g[f2][0], acct_g[f2][1])
+            pair_his.append(jnp.where(valid, h, zeros))
+            pair_los.append(jnp.where(valid, l, zeros))
+            pair_ovf = pair_ovf | jnp.any(valid & o)
+    m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
+    _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
+    e4 = ovf | (s4 > 0) | pair_ovf
+
+    e5 = jnp.any(valid & is_void & p_found
+                 & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
+
+    any_pending_timeout = jnp.any(valid & pending & (ev["timeout"] != 0))
+    any_pv = jnp.any(valid & pv)
+    e6 = any_pending_timeout & any_pv
+
+    fallback_pre = e1 | e2 | e3 | e4 | e5 | e6
 
     # ---------------- chains: segment first-failure broadcast ----------------
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])

@@ -1,0 +1,97 @@
+"""Full-semantics SPMD create_transfers over a device mesh.
+
+The multi-chip form of the single-chip fast kernel
+(ops/fast_kernels.py), with FULL semantics — eligibility E1-E7, chains,
+idempotency, two-phase post/void, event-ring snapshots — not the
+order-independent subset (parallel/sharded.py, kept as the lightweight
+skeleton).
+
+Decomposition (reference mapping: the batch axis of
+docs/ARCHITECTURE.md:358-362 sharded over ICI):
+
+  1. per-event stage (SHARDED): each device takes its slice of the
+     batch and runs per_event_status() — the 5 hash probes and the ~50
+     order-independent checks — against the REPLICATED ledger state.
+     This is where the per-event FLOPs are; it scales linearly with
+     devices.
+  2. all_gather (ICI): the compact per-event bundle (status, resolved
+     amount, touched rows — ~50 B/event) is gathered so every device
+     holds the full batch's results.
+  3. global tail (REPLICATED): eligibility reductions, the chain
+     first-failure broadcast, row planning, and state application run
+     identically on every device over the gathered bundle — a few
+     O(N log N) sorts on compact arrays. Determinism makes the
+     replicated ledger state bit-identical across the mesh, the SPMD
+     restatement of the reference's determinism doctrine
+     (docs/ARCHITECTURE.md:281-307).
+
+Exactness: the sharded step returns bit-identical (new_state, out) to
+the single-chip create_transfers_fast, which is itself bit-exact vs the
+sequential oracle under eligibility (tests/test_full_sharded.py runs
+the differential on an 8-device CPU mesh).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.fast_kernels import create_transfers_fast, per_event_status
+
+__all__ = ["make_sharded_create_transfers", "shard_batch"]
+
+
+def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch"):
+    """Build the jitted full-semantics SPMD step over `mesh`.
+
+    Returns step(state, ev, timestamp, n) -> (new_state, out), the same
+    contract as create_transfers_fast. `ev` arrays must be divisible by
+    the mesh axis size (pad_transfer_events' N_PAD=8192 divides any
+    power-of-two mesh)."""
+    from jax.experimental.shard_map import shard_map
+
+    n_dev = mesh.shape[axis]
+
+    def step(state, ev, timestamp, n):
+        N = ev["id_lo"].shape[0]
+        assert N % n_dev == 0, (N, n_dev)
+        shard = N // n_dev
+
+        def per_event_shard(state, ev_shard):
+            # Global event positions for this shard: the event timestamp
+            # ts_event = timestamp - n + i + 1 depends on the global index.
+            dev = jax.lax.axis_index(axis)
+            idxs = (dev * shard
+                    + jnp.arange(shard, dtype=jnp.int32)).astype(jnp.uint64)
+            ts_event = timestamp - n.astype(jnp.uint64) + idxs + jnp.uint64(1)
+            pe = per_event_status(state, ev_shard, ts_event)
+            # all_gather(tiled): every device ends with the full batch's
+            # compact bundle, concatenated in device order == batch order.
+            return {k: jax.lax.all_gather(v, axis, tiled=True)
+                    for k, v in pe.items()}
+
+        state_spec = jax.tree.map(lambda _: P(), state)
+        ev_spec = {k: P(axis) for k in ev}
+        pe = shard_map(
+            per_event_shard, mesh=mesh,
+            in_specs=(state_spec, ev_spec),
+            out_specs={k: P() for k in (
+                "status_pre", "ts_pre", "amt_res_hi", "amt_res_lo",
+                "dr_row", "cr_row", "p_row",
+                "dr_found", "cr_found", "p_found")},
+            check_rep=False,
+        )(state, ev)
+        # Global tail on the gathered bundle: replicated, deterministic,
+        # bit-exact vs the single-chip kernel (it IS the single-chip
+        # kernel with the per-event stage plugged in).
+        return create_transfers_fast(state, ev, timestamp, n, per_event=pe)
+
+    return jax.jit(step)
+
+
+def shard_batch(mesh: Mesh, ev: dict, axis: str = "batch"):
+    """Place a padded event dict with the batch axis sharded over `mesh`
+    and return it (state stays replicated via P())."""
+    sharding = NamedSharding(mesh, P(axis))
+    return {k: jax.device_put(v, sharding) for k, v in ev.items()}
