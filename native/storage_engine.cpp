@@ -77,6 +77,7 @@ static const uint64_t OFF_SIZE = 88;
 static const uint64_t OFF_OP = 104;
 static const uint64_t OFF_COMMAND = 138;
 static const uint8_t CMD_PREPARE = 6;
+static const uint8_t CMD_RESERVED = 0;
 
 static uint64_t rd_u64(const uint8_t *p) {
   uint64_t v;
@@ -93,7 +94,12 @@ static int header_valid(const uint8_t *hdr, const uint8_t *hdr_key,
                         uint64_t hdr_key_len) {
   uint8_t digest[16];
   tbs_checksum(hdr + 16, HDR_SIZE - 16, hdr_key, hdr_key_len, digest);
-  return memcmp(digest, hdr, 16) == 0 && hdr[OFF_COMMAND] == CMD_PREPARE;
+  // Accept prepare AND reserved commands: replica format writes valid
+  // RESERVED headers into every slot so recovery can tell formatted-empty
+  // (nack-eligible) from torn (must abstain); see vsr/journal.py.
+  return memcmp(digest, hdr, 16) == 0 &&
+         (hdr[OFF_COMMAND] == CMD_PREPARE ||
+          hdr[OFF_COMMAND] == CMD_RESERVED);
 }
 
 // Scan the WAL rings and classify every slot.
@@ -115,7 +121,8 @@ int tbs_wal_scan(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
 
     uint64_t prep_off = prep_zone_off + (uint64_t)slot * prepare_size_max;
     if (tbs_read(fd, prep_off, scratch, HDR_SIZE) < 0) return -1;
-    int prep_hdr_ok = header_valid(scratch, hdr_key, hdr_key_len);
+    int prep_hdr_ok = header_valid(scratch, hdr_key, hdr_key_len) &&
+                      scratch[OFF_COMMAND] == CMD_PREPARE;
     int prep_ok = 0;
     if (prep_hdr_ok) {
       uint32_t size = rd_u32(scratch + OFF_SIZE);
@@ -132,20 +139,28 @@ int tbs_wal_scan(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
       }
     }
 
+    int ring_prepare = ring_ok && ring_hdr[OFF_COMMAND] == CMD_PREPARE;
+    int ring_reserved = ring_ok && ring_hdr[OFF_COMMAND] == CMD_RESERVED;
     uint8_t *out_hdr = headers_out + (uint64_t)slot * HDR_SIZE;
-    if (ring_ok && prep_ok && memcmp(scratch, ring_hdr, 16) == 0) {
+    if (ring_prepare && prep_ok && memcmp(scratch, ring_hdr, 16) == 0) {
       states_out[slot] = 0;
       memcpy(out_hdr, ring_hdr, HDR_SIZE);
-    } else if (prep_ok && ring_ok &&
+    } else if (prep_ok && ring_prepare &&
                rd_u64(scratch + OFF_OP) > rd_u64(ring_hdr + OFF_OP)) {
       states_out[slot] = 0;
       memcpy(out_hdr, scratch, HDR_SIZE);
-    } else if (prep_ok && !ring_ok) {
+    } else if (prep_ok && !ring_prepare) {
+      // Ring header torn, absent, or still the formatted reserved one
+      // (crash between prepare-body and header write): the prepare wins.
       states_out[slot] = 0;
       memcpy(out_hdr, scratch, HDR_SIZE);
-    } else if (ring_ok) {
+    } else if (ring_prepare) {
       states_out[slot] = 1;
       memcpy(out_hdr, ring_hdr, HDR_SIZE);
+    } else if (ring_reserved) {
+      // Formatted-empty: provably never prepared -> clean, nack-eligible.
+      states_out[slot] = 3;
+      memset(out_hdr, 0, HDR_SIZE);
     } else {
       states_out[slot] = 2;
       memset(out_hdr, 0, HDR_SIZE);

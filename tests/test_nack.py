@@ -1,0 +1,392 @@
+"""NACK / protocol-aware recovery scenarios.
+
+VERDICT r1 #6 — reference: quorum_nack_prepare (src/vsr/replica.zig:254,
+:825), docs/ARCHITECTURE.md:540-563, and the scripted-scenario style of
+src/vsr/replica_test.zig. Message-level tests drive a single sans-io
+replica through exact fault sequences; cluster tests orchestrate the
+crash timing the protocol exists for: a replica advertises a prepare in
+its do_view_change, then dies before serving the body.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.testing.cluster import MS, Cluster
+from tigerbeetle_tpu.types import Account, Operation, Transfer
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+from tigerbeetle_tpu.vsr.replica import Replica, ReplicaOptions
+from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+CLUSTER = 0xABCD01
+
+
+class _CaptureBus:
+    def __init__(self):
+        self.sent: list[tuple[int, Message]] = []
+
+    def send_to_replica(self, dst: int, msg: Message) -> None:
+        self.sent.append((dst, msg))
+
+    def send_to_client(self, client_id: int, msg: Message) -> None:
+        pass
+
+    def of(self, command: Command) -> list[tuple[int, Message]]:
+        return [(d, m) for d, m in self.sent if m.header.command == command]
+
+
+class _FakeTime:
+    def __init__(self):
+        self.now = 1_700_000_000 * 10**9
+
+    def monotonic(self) -> int:
+        return self.now
+
+    def realtime(self) -> int:
+        return self.now
+
+    def advance(self, dt: int) -> None:
+        self.now += dt
+
+
+def _mk_replica(replica_id: int, replica_count: int = 6):
+    storage = MemoryStorage(TEST_LAYOUT)
+    Replica.format(storage, cluster=CLUSTER, replica_id=replica_id,
+                   replica_count=replica_count)
+    bus = _CaptureBus()
+    time = _FakeTime()
+    r = Replica(cluster=CLUSTER, replica_id=replica_id,
+                replica_count=replica_count, storage=storage, bus=bus,
+                time=time,
+                state_machine_factory=lambda: StateMachine(engine="oracle"))
+    r.open()
+    return r, bus, time
+
+
+def _prepare_msg(op: int, *, view: int = 0, parent: int = 0) -> Message:
+    body = b"x" * 16
+    header = Header(command=Command.prepare, cluster=CLUSTER, view=view,
+                    op=op, operation=int(Operation.pulse), parent=parent)
+    return Message(header.finalize(body), body=body)
+
+
+def _dvc(replica: int, view: int, op: int, commit: int, log_view: int,
+         suffix: list[Header]) -> Message:
+    body = b"".join(h.pack() for h in suffix)
+    header = Header(command=Command.do_view_change, cluster=CLUSTER,
+                    replica=replica, view=view, op=op, commit=commit,
+                    context=log_view)
+    return Message(header.finalize(body), body=body)
+
+
+def _svc(replica: int, view: int) -> Message:
+    header = Header(command=Command.start_view_change, cluster=CLUSTER,
+                    replica=replica, view=view)
+    return Message(header.finalize())
+
+
+def _nack(replica: int, view: int, op: int, wanted: int) -> Message:
+    header = Header(command=Command.nack_prepare, cluster=CLUSTER,
+                    replica=replica, view=view, op=op, parent=wanted)
+    return Message(header.finalize())
+
+
+def _enter_pending_view(r, bus, *, lost_op: int, committed_below: int):
+    """Drive replica 2 (of 6) into pending view 2 whose canonical log ends
+    with `lost_op`, advertised by peer 3's DVC but journaled nowhere
+    reachable. Returns the canonical checksum of the lost op."""
+    # Prepares below lost_op exist everywhere (feed them to our journal).
+    parent = 0
+    headers = []
+    for op in range(1, lost_op):
+        m = _prepare_msg(op, parent=parent)
+        r.journal.append(m)
+        headers.append(m.header)
+        parent = m.header.checksum
+    r.op = lost_op - 1
+    r.commit_min = r.commit_max = committed_below
+    lost = _prepare_msg(lost_op, parent=parent)
+
+    # View change to view 2 (primary index 2 == r.replica_id).
+    r.on_message(_svc(3, 2))
+    r.on_message(_svc(4, 2))
+    r.on_message(_svc(5, 2))
+    assert r.status == "view_change" and r.view == 2
+    # DVCs: peer 3 advertises the lost op (it held the prepare when it
+    # sent the DVC); peers 4 and 5 do not.
+    r.on_message(_dvc(3, 2, lost_op, committed_below, 0,
+                      headers + [lost.header]))
+    r.on_message(_dvc(4, 2, lost_op - 1, committed_below, 0, headers))
+    r.on_message(_dvc(5, 2, lost_op - 1, committed_below, 0, headers))
+    assert r._pending_view == 2, "primary must be repairing, not live"
+    assert r.op == lost_op
+    assert r.canonical[lost_op].checksum == lost.header.checksum
+    return lost.header.checksum
+
+
+class TestNackScripted:
+    def test_nack_quorum_truncates_lost_uncommitted_suffix(self):
+        """The headline scenario: op 5 advertised in a DVC, body
+        unobtainable, 3 peer nacks + the primary's own clean slot = the
+        nack quorum (4 of 6) -> truncate, view starts."""
+        r, bus, _ = _mk_replica(2)
+        wanted = _enter_pending_view(r, bus, lost_op=5, committed_below=3)
+        r.on_message(_nack(3, 2, 5, wanted))
+        assert r._pending_view == 2  # 1 peer + self = 2 < 4
+        r.on_message(_nack(4, 2, 5, wanted))
+        assert r._pending_view == 2  # 3 < 4
+        r.on_message(_nack(5, 2, 5, wanted))
+        # 3 peers + self-nack (own slot empty and clean) = 4 = quorum.
+        assert r._pending_view is None and r.status == "normal"
+        assert r.op == 4 and 5 not in r.canonical
+        assert bus.of(Command.start_view), "view must have started"
+
+    def test_committed_op_is_never_truncated(self):
+        """Nacks for an op at or below commit_max are ignored: the
+        view-change quorum proved it committed."""
+        r, bus, _ = _mk_replica(2)
+        wanted = _enter_pending_view(r, bus, lost_op=5, committed_below=3)
+        r.commit_max = 5  # a (late) DVC proved op 5 committed
+        for peer in (3, 4, 5):
+            r.on_message(_nack(peer, 2, 5, wanted))
+        assert r._pending_view == 2, "must keep repairing, not truncate"
+        assert r.op == 5 and 5 in r.canonical
+
+    def test_stale_checksum_nacks_do_not_count(self):
+        r, bus, _ = _mk_replica(2)
+        _enter_pending_view(r, bus, lost_op=5, committed_below=3)
+        for peer in (3, 4, 5):
+            r.on_message(_nack(peer, 2, 5, wanted=0xDEAD))
+        assert r._pending_view == 2 and r.op == 5
+
+    def test_standby_nacks_do_not_count(self):
+        r, bus, _ = _mk_replica(2)
+        wanted = _enter_pending_view(r, bus, lost_op=5, committed_below=3)
+        for peer in (6, 7, 8):  # standby ids >= replica_count
+            r.on_message(_nack(peer, 2, 5, wanted))
+        assert r._pending_view == 2 and r.op == 5
+
+
+class TestNackResponder:
+    def test_clean_empty_slot_nacks(self):
+        r, bus, _ = _mk_replica(1)
+        r.commit_min = 2
+        req = Header(command=Command.request_prepare, cluster=CLUSTER,
+                     replica=2, view=0, op=7, parent=0xBEEF)
+        r.on_message(Message(req.finalize()))
+        nacks = bus.of(Command.nack_prepare)
+        assert len(nacks) == 1
+        dst, m = nacks[0]
+        assert dst == 2 and m.header.op == 7 and m.header.parent == 0xBEEF
+
+    def test_faulty_slot_abstains(self):
+        """A torn slot may BE the prepare in question: no nack."""
+        r, bus, _ = _mk_replica(1)
+        r.commit_min = 2
+        r.journal.faulty.add(r.journal.slot_for_op(7))
+        req = Header(command=Command.request_prepare, cluster=CLUSTER,
+                     replica=2, view=0, op=7)
+        r.on_message(Message(req.finalize()))
+        assert not bus.of(Command.nack_prepare)
+        assert not bus.of(Command.prepare)
+
+    def test_committed_op_not_nacked(self):
+        """We executed the op: it is committed, never nackable (the
+        requester recovers via repair or state sync instead)."""
+        r, bus, _ = _mk_replica(1)
+        r.commit_min = 9
+        req = Header(command=Command.request_prepare, cluster=CLUSTER,
+                     replica=2, view=0, op=7)
+        r.on_message(Message(req.finalize()))
+        assert not bus.of(Command.nack_prepare)
+
+    def test_different_checksum_holder_serves_and_nacks(self):
+        """Holding a different prepare for the op proves we never prepared
+        the canonical one: serve what we have AND nack the wanted one."""
+        r, bus, _ = _mk_replica(1)
+        held = _prepare_msg(7, view=0)
+        r.journal.append(held)
+        r.op = 7
+        req = Header(command=Command.request_prepare, cluster=CLUSTER,
+                     replica=2, view=0, op=7, parent=0xF00D)
+        r.on_message(Message(req.finalize()))
+        served = bus.of(Command.prepare)
+        nacks = bus.of(Command.nack_prepare)
+        assert len(served) == 1
+        assert served[0][1].header.checksum == held.header.checksum
+        assert len(nacks) == 1 and nacks[0][1].header.parent == 0xF00D
+        # Without a wanted checksum there is nothing to nack.
+        bus.sent.clear()
+        req2 = Header(command=Command.request_prepare, cluster=CLUSTER,
+                      replica=2, view=0, op=7, parent=0)
+        r.on_message(Message(req2.finalize()))
+        assert bus.of(Command.prepare) and not bus.of(Command.nack_prepare)
+
+
+def _accounts_body(ids):
+    payload = b"".join(Account(id=i, ledger=1, code=1).pack() for i in ids)
+    return multi_batch.encode([payload], 128)
+
+
+def _transfers_body(specs):
+    payload = b"".join(
+        Transfer(id=i, debit_account_id=dr, credit_account_id=cr,
+                 amount=amt, ledger=1, code=1).pack()
+        for (i, dr, cr, amt) in specs)
+    return multi_batch.encode([payload], 128)
+
+
+class TestNackCluster:
+    def test_advertised_then_lost_prepare_is_truncated(self):
+        """Full-cluster liveness: P0 prepares an op that reaches only P1,
+        then crashes; P1's copy is TORN (storage corruption), so P1
+        advertises the op's header in its do_view_change but cannot serve
+        the body, and must itself abstain from nacking (it prepared it).
+        The four clean peers' nacks prove the op uncommitted: the new
+        primary truncates it and the cluster keeps serving. Without NACK
+        this view change would wedge forever."""
+        cluster = Cluster(seed=21, replica_count=6)
+        client = cluster.client(900)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        ok = cluster.run(4000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        base_op = cluster.replicas[2].op
+
+        # P0 talks only to P1: the next prepare reaches P1 alone and can
+        # never reach its replication quorum of 3.
+        for peer in (2, 3, 4, 5):
+            cluster.cut_links.add(frozenset((0, peer)))
+        client.request(Operation.create_transfers,
+                       _transfers_body([(100, 1, 2, 7)]))
+        lost_op = base_op + 1
+        assert cluster.run(300, until=lambda: cluster.replicas[1].op
+                           >= lost_op), cluster.debug_status()
+        held = cluster.replicas[1].journal.read_prepare(lost_op)
+        assert held is not None
+        assert cluster.replicas[2].op < lost_op
+
+        # Tear P1's prepare body on disk (the header ring stays valid, so
+        # P1 still advertises the op but can neither serve nor nack it).
+        storage = cluster.storages[1]
+        psm = storage.layout.message_size_max
+        slot = lost_op % storage.layout.slot_count
+        raw = storage.read("wal_prepares", slot * psm + 300, 8)
+        storage.write("wal_prepares", slot * psm + 300,
+                      bytes(b ^ 0xFF for b in raw))
+        assert cluster.replicas[1].journal.read_prepare(lost_op) is None
+
+        cluster.crash(0)
+        cluster.heal()
+
+        def truncated_and_live():
+            live = [r for i, r in enumerate(cluster.replicas)
+                    if i not in cluster.crashed]
+            return all(r.status == "normal" and r.view >= 1
+                       and r.op < lost_op for r in live)
+
+        assert cluster.run(60000, until=truncated_and_live), \
+            cluster.debug_status()
+        # The cluster keeps serving (liveness regained), the op is gone.
+        client2 = cluster.client(901)
+        client2.request(Operation.create_transfers,
+                        _transfers_body([(200, 2, 1, 3)]))
+        assert cluster.run(20000, until=lambda: client2.idle), \
+            cluster.debug_status()
+        cluster.settle()
+        # The truncated PREPARE is gone; the client's still-pending request
+        # may legitimately have been retried and re-committed as a NEW op
+        # in the new view (exactly-once is per request, not per attempt).
+        live = [r for i, r in enumerate(cluster.replicas)
+                if i not in cluster.crashed]
+        states = [(dict(r.state_machine.state.accounts),
+                   dict(r.state_machine.state.transfers)) for r in live]
+        for st in states[1:]:
+            assert st == states[0], "live replicas must converge"
+        accounts, transfers = states[0]
+        assert accounts[1].credits_posted == 3
+        if 100 in transfers:
+            # Re-committed via retry: must postdate the truncation (a new
+            # timestamp in the new view), not the torn original.
+            assert transfers[100].timestamp > transfers[200].timestamp - \
+                10**10
+            assert accounts[1].debits_posted == 7
+        else:
+            assert accounts[1].debits_posted == 0
+
+    def test_possibly_committed_op_repaired_not_truncated(self):
+        """Same shape, but the holder stays alive: the new primary must
+        REPAIR the advertised op from it (and re-replicate), never
+        truncate it."""
+        cluster = Cluster(seed=22, replica_count=6)
+        client = cluster.client(910)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        ok = cluster.run(4000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        for peer in (2, 3, 4, 5):
+            cluster.cut_links.add(frozenset((0, peer)))
+        base_op = cluster.replicas[2].op
+        client.request(Operation.create_transfers,
+                       _transfers_body([(300, 1, 2, 9)]))
+        lost_op = base_op + 1
+        assert cluster.run(300, until=lambda: cluster.replicas[1].op
+                           >= lost_op), cluster.debug_status()
+        assert cluster.replicas[2].op < lost_op
+        cluster.crash(0)
+        cluster.heal()
+        # P1 alive and connected: whether it wins the election or serves
+        # repair, the op must survive and commit in the new view.
+        cluster.settle()
+
+        def op_committed():
+            return all(r.commit_min >= lost_op
+                       for i, r in enumerate(cluster.replicas)
+                       if i not in cluster.crashed)
+
+        assert cluster.run(40000, until=op_committed), cluster.debug_status()
+        for i, r in enumerate(cluster.replicas):
+            if i not in cluster.crashed:
+                assert 300 in r.state_machine.state.transfers
+                assert r.state_machine.state.accounts[2].credits_posted == 9
+
+    def test_rejoining_stale_suffix_truncates(self):
+        """A restarted replica holding an uncommitted suffix from an old
+        view truncates it on learning the new canonical log."""
+        cluster = Cluster(seed=23, replica_count=3)
+        client = cluster.client(920)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        ok = cluster.run(4000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        # P0 (primary) prepares an op nobody receives.
+        for peer in (1, 2):
+            cluster.cut_links.add(frozenset((0, peer)))
+        client.request(Operation.create_transfers,
+                       _transfers_body([(400, 1, 2, 5)]))
+        cluster.run(60)
+        stale_op = cluster.replicas[0].op
+        assert cluster.replicas[1].op < stale_op
+        cluster.crash(0)
+        cluster.heal()
+        cluster.settle()
+        # The survivors elected a new view and moved on; commit new work.
+        client2 = cluster.client(921)
+        client2.request(Operation.create_transfers,
+                        _transfers_body([(401, 2, 1, 4)]))
+        assert cluster.run(20000, until=lambda: client2.idle), \
+            cluster.debug_status()
+        cluster.restart(0)
+        cluster.settle()
+        r0 = cluster.replicas[0]
+        assert 401 in r0.state_machine.state.transfers
+        # The stale PREPARE was truncated; the client's pending request may
+        # have been retried into the new view as a fresh op. If so, every
+        # replica agrees on it (it went through consensus, not through
+        # P0's stale journal).
+        if 400 in r0.state_machine.state.transfers:
+            t = r0.state_machine.state.transfers[400]
+            for r in cluster.replicas[1:]:
+                assert r.state_machine.state.transfers[400] == t
+        cluster.check_convergence()

@@ -266,6 +266,7 @@ class DeviceLedger:
         # remains the execution engine.
         self._wt = write_through is not None
         if self._wt:
+            self._enable_dev_tracking(write_through)
             self._hard_regime = False
             self._acct_row: dict[int, int] = {}
             self._xfer_row: dict[int, int] = {}
@@ -651,6 +652,7 @@ class DeviceLedger:
 
     def _enter_mirror(self):
         self.mirror = self.to_host()
+        self._enable_dev_tracking(self.mirror)
         self._mirror_batches = 1
         # Everything in the mirror is already on device.
         for container in (self.mirror.accounts, self.mirror.transfers,
@@ -701,6 +703,17 @@ class DeviceLedger:
                      cols[f"{side}_{f}_lo"][i]) = _split(val)
         return cols
 
+
+
+    @staticmethod
+    def _enable_dev_tracking(sm) -> None:
+        """Turn on the device-push dirty channel for a mirror's containers
+        (off by default: on the oracle/kernel engines nothing consumes —
+        or clears — it)."""
+        for c in (sm.accounts, sm.transfers, sm.pending_status,
+                  sm.expiry, sm.orphaned):
+            c.track_dev = True
+            c.dirty_dev.clear()
 
     def _clear_dirty_dev(self) -> None:
         """Everything the fast delta just applied to the mirror came FROM

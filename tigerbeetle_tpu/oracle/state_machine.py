@@ -64,7 +64,12 @@ class DirtyDict(dict):
     set (cleared by DeviceLedger._push_dirty / the write-through delta).
     Two consumers with different flush cadences must not share one bit —
     e.g. a replica flushes every commit while the device push only runs
-    on hard batches."""
+    on hard batches. The device channel only records when a DeviceLedger
+    is attached (track_dev, see DeviceLedger._enable_dev_tracking) — on
+    the oracle/kernel engines nothing would ever clear it, an unbounded
+    leak over a long soak."""
+
+    track_dev = False  # class default; DeviceLedger flips per instance
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -74,12 +79,14 @@ class DirtyDict(dict):
     def __setitem__(self, key, value):
         super().__setitem__(key, value)
         self.dirty.add(key)
-        self.dirty_dev.add(key)
+        if self.track_dev:
+            self.dirty_dev.add(key)
 
     def __delitem__(self, key):
         if key in self:
             self.dirty.add(key)
-            self.dirty_dev.add(key)
+            if self.track_dev:
+                self.dirty_dev.add(key)
         super().__delitem__(key)
 
     def pop(self, key, *default):
@@ -88,13 +95,16 @@ class DirtyDict(dict):
         # tombstone write downstream.
         if key in self:
             self.dirty.add(key)
-            self.dirty_dev.add(key)
+            if self.track_dev:
+                self.dirty_dev.add(key)
         return super().pop(key, *default)
 
 
 class DirtySet(set):
     """Set that records added members since the last flush (same two
     channels as DirtyDict)."""
+
+    track_dev = False
 
     def __init__(self, *args):
         super().__init__(*args)
@@ -104,7 +114,8 @@ class DirtySet(set):
     def add(self, member):
         super().add(member)
         self.dirty.add(member)
-        self.dirty_dev.add(member)
+        if self.track_dev:
+            self.dirty_dev.add(member)
 
 
 class _Scope:

@@ -53,6 +53,12 @@ class Command(enum.IntEnum):
     eviction = 18
     request_blocks = 19
     block = 20
+    # Protocol-aware recovery (reference: quorum_nack_prepare,
+    # src/vsr/replica.zig:254, docs/ARCHITECTURE.md:540-563): "I can
+    # prove I never prepared this op/checksum" — sent in response to an
+    # unserviceable request_prepare by a replica whose WAL slot for the
+    # op is demonstrably not a torn write of it.
+    nack_prepare = 21
 
 
 _FMT = struct.Struct(

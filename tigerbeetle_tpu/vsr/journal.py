@@ -136,6 +136,13 @@ class Journal:
                 # Header torn, prepare intact.
                 slots.append(Slot(SlotState.clean, prep_header))
                 self.headers[slot] = prep_header
+            elif header is not None and header.command == Command.reserved:
+                # Formatted-empty (replica_format wrote a valid reserved
+                # header and no prepare ever landed): provably never
+                # prepared anything — NOT faulty, so the replica may NACK
+                # ops mapping here (reference: the empty/torn distinction
+                # behind quorum_nack_prepare eligibility).
+                slots.append(Slot(SlotState.clean))
             else:
                 slots.append(Slot(SlotState.unknown))
                 self.faulty.add(slot)
@@ -165,6 +172,11 @@ class Journal:
                 slots.append(Slot(SlotState.faulty, header))
                 self.headers[slot] = header
                 self.faulty.add(slot)
+            elif state == 3:
+                # Formatted-empty slot (valid reserved ring header, no
+                # prepare): clean, nack-eligible — see the Python
+                # classifier above.
+                slots.append(Slot(SlotState.clean))
             else:
                 slots.append(Slot(SlotState.unknown))
                 self.faulty.add(slot)

@@ -35,8 +35,21 @@ sessions + superblock atomically.
 Standbys (ids >= replica_count) follow the replication stream and hold
 checkpoints without voting — warm spares outside the quorums.
 
-Omitted in round 1 (tracked for later rounds): protocol-aware NACK
-recovery.
+NACK / protocol-aware recovery (reference: quorum_nack_prepare,
+src/vsr/replica.zig:254,825; docs/ARCHITECTURE.md:540-563): a new
+primary whose chosen log has an unobtainable prepare (every copy lost or
+corrupted) must decide whether the op could have committed. Peers that
+can PROVE they never prepared it — their WAL slot holds nothing for the
+op (and is not a torn write: a faulty slot abstains, it may be the very
+prepare in question), or holds a different-checksum prepare (a replica
+prepares at most one body per op) — answer request_prepare with
+`nack_prepare`. Collecting `replica_count - quorum_replication + 1`
+distinct nacks proves no replication quorum ever existed, so the op (and
+the suffix above it, which chains through it) is truncated and the view
+starts. Without this, "repairs when a good copy exists" is the best the
+protocol can do; with it, an uncommitted-but-lost prepare can never
+wedge a view change, while a committed prepare is never truncated (the
+nack quorum intersects every replication quorum).
 """
 
 from __future__ import annotations
@@ -109,6 +122,9 @@ class Replica:
         self.journal = Journal(storage)
         self.state_machine: StateMachine = state_machine_factory()
         self.durable = DurableState(storage)
+        # Serve reads from the LSM with a bounded object cache
+        # (state_machine.attach_durable; reference: groove object cache).
+        self.state_machine.attach_durable(self.durable)
         self.superblock: Optional[SuperBlock] = None
         self.fault_detector = FaultDetector(suspect_multiplier=4.0)
         self.repair_budget = RepairBudget()
@@ -150,6 +166,9 @@ class Replica:
         # stale leftover under a committed op number): repair must fetch a
         # replacement even though a prepare is held.
         self.chain_suspect: set[int] = set()
+        # NACK collection (pending-view primary only): op -> set[replica]
+        # of peers proving they never prepared the canonical entry.
+        self.nacks: dict[int, set[int]] = {}
         # Scrub-detected corrupt blocks awaiting peer repair:
         # block index -> (tree, address, size).
         self.block_repair: dict[int, tuple] = {}
@@ -173,6 +192,15 @@ class Replica:
         root = (durable.checkpoint(StateMachine(engine="oracle").state)
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
         storage.write("snapshot", 0, root)
+        # Format the WAL header ring with valid RESERVED headers
+        # (reference: src/vsr/replica_format.zig formats every slot): a
+        # recovering journal can then distinguish formatted-empty slots
+        # (provably never prepared — eligible to NACK) from torn writes
+        # (faulty — must abstain).
+        for slot in range(storage.layout.slot_count):
+            reserved = Header(command=Command.reserved, cluster=cluster,
+                              replica=replica_id, op=slot).finalize()
+            storage.write("wal_headers", slot * HEADER_SIZE, reserved.pack())
         sb = SuperBlock(
             cluster=cluster, replica_id=replica_id,
             replica_count=replica_count, release=RELEASE,
@@ -207,6 +235,7 @@ class Replica:
         self.sessions.restore(sessions_blob)
         self.state_machine = self.state_machine_factory()
         self.state_machine.state = self.durable.open(forest_root)
+        self.state_machine.attach_durable(self.durable)
 
         self.journal.recover()
         self.op = max(sb.op_checkpoint, self._journal_contiguous_max(sb.op_checkpoint))
@@ -292,6 +321,13 @@ class Replica:
         return {1: 1, 2: 2, 3: 2, 4: 2, 5: 3, 6: 3}[self.replica_count]
 
     @property
+    def quorum_nack(self) -> int:
+        """Nacks that prove an op never reached a replication quorum: if it
+        had, at most replica_count - quorum_replication replicas could
+        truthfully lack it (reference: docs/ARCHITECTURE.md:540-563)."""
+        return self.replica_count - self.quorum_replication + 1
+
+    @property
     def quorum_view_change(self) -> int:
         return {1: 1, 2: 2, 3: 2, 4: 3, 5: 3, 6: 4}[self.replica_count]
 
@@ -318,6 +354,7 @@ class Replica:
             Command.headers: self.on_sync_offer,
             Command.request_blocks: self.on_request_blocks,
             Command.block: self.on_block,
+            Command.nack_prepare: self.on_nack_prepare,
             Command.ping: self.on_ping,
             Command.pong: self.on_pong,
         }.get(h.command)
@@ -588,7 +625,8 @@ class Replica:
         self.commit_min = h.op
         # Write-through to the LSM forest + one deterministic compaction
         # beat (reference: commit_compact, one beat per op — §3.4).
-        self.durable.flush(self.state_machine.state)
+        flushed = self.durable.flush(self.state_machine.state)
+        self.state_machine.cache_upsert(*flushed)
         self.durable.compact_beat(h.op)
         if h.client:
             # Reply fields derive from the PREPARE (its view and original
@@ -651,6 +689,7 @@ class Replica:
         self.status = "view_change"
         self.view = new_view
         self.pipeline.clear()
+        self.nacks.clear()
         self._persist_view()
         votes = self.svc_votes.setdefault(new_view, set())
         votes.add(self.replica_id)
@@ -679,10 +718,24 @@ class Replica:
             return
         self._send_do_view_change(v)
 
+    def _dvc_suffix_headers(self) -> list[Header]:
+        """The log suffix as journal-ring HEADERS — including faulty slots
+        whose bodies are torn. A torn-but-headered op MUST be advertised:
+        omitting it could silently drop a committed op whose only
+        surviving quorum-member copy is torn (the new primary resolves
+        presence via repair, absence via the nack quorum — reference:
+        DVC nack/present bitsets, src/vsr/replica.zig:254)."""
+        base = self.superblock.op_checkpoint if self.superblock else 0
+        out = []
+        for op in range(base + 1, self.op + 1):
+            h = self.journal.headers[self.journal.slot_for_op(op)]
+            if h is not None and h.op == op and h.command == Command.prepare:
+                out.append(h)
+        return out
+
     def _send_do_view_change(self, v: int) -> None:
         """Send our log suffix to the new primary (headers above checkpoint)."""
-        body = b"".join(
-            m.header.pack() for m in self._suffix_prepares())
+        body = b"".join(h.pack() for h in self._dvc_suffix_headers())
         header = Header(
             command=Command.do_view_change, cluster=self.cluster,
             replica=self.replica_id, view=v, op=self.op,
@@ -692,15 +745,6 @@ class Replica:
             self.on_do_view_change(msg)
         else:
             self.bus.send_to_replica(self.primary_index(v), msg)
-
-    def _suffix_prepares(self) -> list[Message]:
-        base = self.superblock.op_checkpoint if self.superblock else 0
-        out = []
-        for op in range(base + 1, self.op + 1):
-            m = self.journal.read_prepare(op)
-            if m is not None:
-                out.append(m)
-        return out
 
     def _suffix_headers(self) -> list[Header]:
         """The log suffix as HEADERS: journal-held where possible, else
@@ -730,7 +774,7 @@ class Replica:
         self.dvc_messages.setdefault(v, {})[msg.header.replica] = msg
         dvcs = self.dvc_messages[v]
         if self.replica_id not in dvcs:
-            body = b"".join(m.header.pack() for m in self._suffix_prepares())
+            body = b"".join(h.pack() for h in self._dvc_suffix_headers())
             own = Header(
                 command=Command.do_view_change, cluster=self.cluster,
                 replica=self.replica_id, view=v, op=self.op,
@@ -893,14 +937,85 @@ class Replica:
                                          self._start_view_message())
                 return
         m = self.journal.read_prepare(msg.header.op)
+        wanted = msg.header.parent  # canonical checksum sought (0: unknown)
         if m is not None:
             self.bus.send_to_replica(msg.header.replica, m)
+            if wanted != 0 and m.header.checksum != wanted:
+                # We hold a DIFFERENT prepare for this op. A replica
+                # prepares at most one body per op, so holding another
+                # checksum proves we never prepared the canonical one —
+                # the served prepare won't satisfy the repair, but the
+                # nack can complete a truncation quorum.
+                self._send_nack(msg.header.replica, msg.header.op, wanted)
         elif (self.superblock is not None
               and msg.header.op <= self.superblock.op_checkpoint):
             # We committed past this op and the WAL wrapped: the peer can
             # never repair forward — offer our checkpoint instead
             # (reference: state sync, docs/internals/sync.md:49-79).
             self._send_sync_offer(msg.header.replica)
+        elif msg.header.op > self.commit_min and not self.is_standby:
+            # Nothing servable for this op. We may nack only if we can
+            # PROVE we never prepared it: the slot must not be a torn
+            # write of it (faulty), and the header ring must not hold its
+            # header (a held header with an unreadable body means we DID
+            # prepare it — reference: the nack eligibility rule,
+            # replica.zig:825).
+            slot = self.journal.slot_for_op(msg.header.op)
+            held_hdr = self.journal.headers[slot]
+            prepared_it = (held_hdr is not None
+                           and held_hdr.op == msg.header.op
+                           and held_hdr.command == Command.prepare)
+            if slot not in self.journal.faulty and not prepared_it:
+                self._send_nack(msg.header.replica, msg.header.op, wanted)
+
+    def _send_nack(self, dst: int, op: int, wanted: int) -> None:
+        header = Header(
+            command=Command.nack_prepare, cluster=self.cluster,
+            replica=self.replica_id, view=self.view, op=op, parent=wanted)
+        self.bus.send_to_replica(dst, Message(header.finalize()))
+
+    def on_nack_prepare(self, msg: Message) -> None:
+        """Count nack votes while completing a view change; truncate the
+        uncommitted suffix at nack quorum (reference: replica.zig:254
+        quorum_nack_prepare + docs/ARCHITECTURE.md:540-563)."""
+        h = msg.header
+        if (self._pending_view != self.view or self.status != "view_change"
+                or h.replica >= self.replica_count
+                or h.view != self.view):
+            # The view guard is safety-critical: a delayed nack from an
+            # earlier view-change round could count toward truncating an
+            # op its sender has since acquired (and possibly committed).
+            return
+        op = h.op
+        if op <= max(self.commit_max, self.commit_min) or op > self.op:
+            return
+        want = self.canonical.get(op)
+        if (want.checksum if want is not None else 0) != h.parent:
+            return  # nack for a stale/foreign checksum
+        votes = self.nacks.setdefault(op, set())
+        votes.add(h.replica)
+        # Our own journal votes too, under the same eligibility rule.
+        held = self.journal.read_prepare(op)
+        slot = self.journal.slot_for_op(op)
+        held_hdr = self.journal.headers[slot]
+        prepared_it = (held_hdr is not None and held_hdr.op == op
+                       and held_hdr.command == Command.prepare)
+        if held is not None:
+            if want is not None and held.header.checksum != want.checksum:
+                votes.add(self.replica_id)
+        elif slot not in self.journal.faulty and not prepared_it:
+            votes.add(self.replica_id)
+        if len(votes) < self.quorum_nack:
+            return
+        # Proven uncommitted: truncate op and the suffix that chains
+        # through it, then finalize the view.
+        for o in range(op, self.op + 1):
+            self.canonical.pop(o, None)
+            self.repair_requested.pop(o, None)
+            self.chain_suspect.discard(o)
+            self.nacks.pop(o, None)
+        self.op = op - 1
+        self._try_start_view()
 
     # ---------------------------------------------------------- state sync
     #
@@ -1090,6 +1205,7 @@ class Replica:
         self.block_repair.clear()
         self.state_machine = self.state_machine_factory()
         self.state_machine.state = state
+        self.state_machine.attach_durable(self.durable)
         sb.snapshot_slot = slot
         sb.snapshot_size = len(root)
         sb.snapshot_checksum = checksum(root, domain=b"ckptroot")
@@ -1190,7 +1306,8 @@ class Replica:
             header = Header(
                 command=Command.request_prepare, cluster=self.cluster,
                 replica=self.replica_id, view=self.view, op=op,
-                context=1 if below_floor else 0)
+                context=1 if below_floor else 0,
+                parent=want or 0)  # canonical checksum (nack eligibility)
             msg = Message(header.finalize())
             for r in range(self.peer_count):
                 if r != self.replica_id:
