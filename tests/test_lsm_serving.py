@@ -1,0 +1,149 @@
+"""LSM-served reads with a bounded object cache (VERDICT r1 #4).
+
+reference: src/lsm/groove.zig:885 (get through the object cache),
+:996/:1339 (prefetch), src/lsm/set_associative_cache.zig:1. The serving
+read path (attach_durable) must (a) return exactly what the host-index
+path returns, (b) hit the LSM on cache miss, (c) bound its memory by
+construction even when the data set far exceeds the cache.
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu.lsm.cache_map import ObjectCache
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFilter,
+    AccountFilterFlags,
+    ChangeEventsFilter,
+    QueryFilter,
+    Transfer,
+    TransferFlags,
+)
+from tigerbeetle_tpu.vsr.durable import DurableState
+from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+
+class TestObjectCache:
+    def test_bounded_with_lru_eviction(self):
+        cache = ObjectCache(sets=8, ways=2)  # capacity 16
+        for k in range(100):
+            cache.put(k, k * 10)
+        assert len(cache) <= cache.capacity == 16
+        assert cache.evictions >= 100 - 16
+        # A re-put of an existing key updates in place (no eviction).
+        before = cache.evictions
+        live = [k for k in range(100) if cache.get(k) is not None]
+        for k in live:
+            cache.put(k, k * 11)
+        assert cache.evictions == before
+        assert all(cache.get(k) == k * 11 for k in live)
+
+    def test_lru_within_set(self):
+        cache = ObjectCache(sets=1, ways=2)
+        cache.put(1, "a")
+        cache.put(2, "b")
+        assert cache.get(1) == "a"  # touch 1: now 2 is LRU
+        cache.put(3, "c")  # evicts 2
+        assert cache.get(2) is None
+        assert cache.get(1) == "a" and cache.get(3) == "c"
+
+
+def _mk_attached(n_accounts=300, n_transfers=2000, cache_sets=8, ways=2):
+    """A durable-attached state machine with data far exceeding the
+    object caches (capacity 16 each), plus an identical detached twin."""
+    rng = np.random.default_rng(5)
+    storage = MemoryStorage(TEST_LAYOUT)
+    durable = DurableState(storage)
+    attached = StateMachine(engine="oracle")
+    attached.attach_durable(durable, cache_sets=cache_sets, ways=ways)
+    detached = StateMachine(engine="oracle")
+
+    accts = [Account(id=i, ledger=1, code=1 + i % 3,
+                     user_data_64=i % 7)
+             for i in range(1, n_accounts + 1)]
+    ts = 10**9
+    for sm in (attached, detached):
+        sm.create_accounts(accts, ts)
+    pend = int(TransferFlags.pending)
+    evs = []
+    for i in range(n_transfers):
+        evs.append(Transfer(
+            id=10**6 + i,
+            debit_account_id=int(rng.integers(1, n_accounts + 1)),
+            credit_account_id=int(rng.integers(1, n_accounts + 1)),
+            amount=int(rng.integers(1, 100)), ledger=1,
+            code=1 + i % 3, user_data_64=i % 5,
+            flags=pend if i % 11 == 0 else 0))
+    for e in evs:
+        if e.debit_account_id == e.credit_account_id:
+            e.credit_account_id = e.debit_account_id % n_accounts + 1
+    flushed = durable.flush(attached.state)
+    attached.cache_upsert(*flushed)
+    for lo in range(0, n_transfers, 500):
+        chunk = evs[lo:lo + 500]
+        ts += 600
+        for sm in (attached, detached):
+            sm.create_transfers(chunk, ts)
+        # The replica flushes + cache-upserts after every commit.
+        flushed = durable.flush(attached.state)
+        attached.cache_upsert(*flushed)
+    return attached, detached, durable
+
+
+class TestLsmServing:
+    def test_reads_differential_and_bounded(self):
+        attached, detached, _durable = _mk_attached()
+        # Lookups: data set (300 + 2000 objects) >> cache capacity (16).
+        ids = list(range(1, 301))
+        got = attached.lookup_accounts(ids)
+        want = detached.lookup_accounts(ids)
+        assert got == want
+        assert len(attached._acct_cache) <= attached._acct_cache.capacity
+        assert attached._acct_cache.misses > 0, "must have hit the LSM"
+        tids = [10**6 + i for i in range(0, 2000, 7)]
+        assert attached.lookup_transfers(tids) == \
+            detached.lookup_transfers(tids)
+        assert len(attached._xfer_cache) <= attached._xfer_cache.capacity
+
+        # Queries route through ForestQuery — exactly the host results.
+        f = AccountFilter(
+            account_id=17,
+            flags=int(AccountFilterFlags.debits | AccountFilterFlags.credits),
+            limit=8190)
+        assert [t.id for t in attached.get_account_transfers(f)] == \
+               [t.id for t in detached.get_account_transfers(f)]
+        q = QueryFilter(code=2, user_data_64=3, limit=200)
+        assert [t.id for t in attached.query_transfers(q)] == \
+               [t.id for t in detached.query_transfers(q)]
+        qa = QueryFilter(user_data_64=4, limit=100)
+        assert [a.id for a in attached.query_accounts(qa)] == \
+               [a.id for a in detached.query_accounts(qa)]
+        ce = ChangeEventsFilter(limit=50)
+        assert attached.get_change_events(ce) == \
+            detached.get_change_events(ce)
+
+    def test_cache_written_through_on_flush(self):
+        """A cached account updated by a later batch must serve the NEW
+        balances after the flush upsert (the groove write-through
+        discipline: no read-side invalidation logic)."""
+        attached, detached, durable = _mk_attached(
+            n_accounts=10, n_transfers=0)
+        a1 = attached.lookup_accounts([1])[0]  # warm the cache
+        assert a1.debits_posted == 0
+        ts = 10**10
+        t = [Transfer(id=5_000_000, debit_account_id=1,
+                      credit_account_id=2, amount=42, ledger=1, code=1)]
+        for sm in (attached, detached):
+            sm.create_transfers(t, ts)
+        # Before the flush+upsert the cached copy is the pre-update value.
+        stale = attached.lookup_accounts([1])[0]
+        assert stale.debits_posted == 0
+        flushed = durable.flush(attached.state)
+        assert 1 in flushed[0] and 5_000_000 in flushed[1]
+        attached.cache_upsert(*flushed)
+        fresh = attached.lookup_accounts([1])[0]
+        assert fresh.debits_posted == 42
+        assert fresh == detached.lookup_accounts([1])[0]
+        assert attached.lookup_transfers([5_000_000]) == \
+            detached.lookup_transfers([5_000_000])

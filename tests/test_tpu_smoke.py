@@ -1,0 +1,97 @@
+"""TPU smoke tests (VERDICT r1 #10): run the kernel-parity core cases on
+the REAL chip when the axon tunnel is live.
+
+Deselected by default (pytest.ini addopts -m "not tpu"); opt in with
+`pytest -m tpu`. Each test spawns a fresh subprocess with the axon
+platform pinned (the session's conftest pins CPU, and a wedged tunnel
+must never hang the suite): if backend init doesn't complete within the
+bound, the test SKIPS with the probe diagnostics; a live chip that
+produces wrong results FAILS.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.tpu
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+INIT_TIMEOUT_S = float(os.environ.get("TPU_SMOKE_INIT_TIMEOUT_S", "300"))
+
+_SMOKE_SRC = r'''
+import json, sys
+import numpy as np
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import Account, Transfer, TransferFlags
+import jax
+platform = jax.devices()[0].platform
+rng = np.random.default_rng(77)
+led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
+sm = StateMachineOracle()
+accts = [Account(id=i, ledger=1, code=1) for i in range(1, 51)]
+led.create_accounts(accts, 60)
+sm.create_accounts(accts, 60)
+ts, nid = 10**9, 10**6
+pend = int(TransferFlags.pending)
+linked = int(TransferFlags.linked)
+for b in range(3):
+    evs = []
+    for i in range(128):
+        evs.append(Transfer(
+            id=nid, debit_account_id=int(rng.integers(1, 51)),
+            credit_account_id=1 + int(rng.integers(1, 50)),
+            amount=int(rng.integers(0, 500)), ledger=1,
+            code=int(rng.integers(0, 2)),
+            flags=(linked if i % 9 == 0 else (pend if i % 5 == 0 else 0))))
+        nid += 1
+    for e in evs:
+        if e.debit_account_id == e.credit_account_id:
+            e.credit_account_id = e.debit_account_id % 50 + 1
+    if evs[-1].flags & linked:
+        evs[-1].flags &= ~linked
+    ts += 200
+    got = led.create_transfers(evs, ts)
+    want = sm.create_transfers(evs, ts)
+    if [(r.timestamp, int(r.status)) for r in got] != \
+            [(r.timestamp, int(r.status)) for r in want]:
+        print(json.dumps({"ok": False, "batch": b, "platform": platform}))
+        sys.exit(1)
+host = led.to_host()
+ok = (host.accounts == sm.accounts and host.transfers == sm.transfers
+      and host.account_events == sm.account_events)
+print(json.dumps({"ok": bool(ok), "platform": platform,
+                  "fast_batches": led.fast_batches,
+                  "fallbacks": led.fallbacks}))
+sys.exit(0 if ok else 1)
+'''
+
+
+def _probe_chip() -> dict:
+    """Bounded backend-init probe (no repo code) in a fresh process."""
+    sys.path.insert(0, REPO)
+    try:
+        from bench import probe_platform
+    finally:
+        sys.path.pop(0)
+    return probe_platform("axon", INIT_TIMEOUT_S)
+
+
+def test_kernel_parity_on_chip():
+    probe = _probe_chip()
+    if not probe.get("ok"):
+        pytest.skip(f"TPU tunnel unavailable: {probe.get('error')} "
+                    f"(elapsed {probe.get('elapsed_s')}s)")
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    p = subprocess.run(
+        [sys.executable, "-c", _SMOKE_SRC], capture_output=True, text=True,
+        cwd=REPO, env=env, timeout=1500,
+    )
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no result: rc={p.returncode}\n{p.stderr[-1200:]}"
+    result = json.loads(lines[-1])
+    assert result["ok"], result
+    assert result["platform"] != "cpu", result

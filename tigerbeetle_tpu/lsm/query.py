@@ -249,6 +249,49 @@ class ForestQuery:
     def query_transfers(self, f: QueryFilter) -> list[Transfer]:
         return self._query(f, "transfers", Operation.query_transfers)
 
+    def get_change_events(self, f, limit_cap: int = 0) -> list:
+        """CDC query served from the forest's events tree (reference:
+        src/state_machine.zig:3395-3528): range-scan account_events by
+        timestamp, join transfer + both accounts from their object trees.
+        Must return exactly what the host-index path returns."""
+        from ..constants import TIMESTAMP_MAX as TS_MAX
+        from ..state_machine import (
+            OPERATION_SPECS,
+            build_change_event,
+        )
+        from ..vsr.durable import _unpack_event
+
+        valid = (
+            f.limit != 0
+            and (f.timestamp_min == 0 or 1 <= f.timestamp_min <= TS_MAX)
+            and (f.timestamp_max == 0 or 1 <= f.timestamp_max <= TS_MAX)
+            and (f.timestamp_max == 0 or f.timestamp_min <= f.timestamp_max)
+        )
+        if not valid:
+            return []
+        if not limit_cap:
+            limit_cap = OPERATION_SPECS[
+                Operation.get_change_events].result_max()
+        limit = min(f.limit, limit_cap)
+        ts_min = f.timestamp_min or 1
+        ts_max = f.timestamp_max or TS_MAX
+        scan = TreeScan(self.forest.trees["events"],
+                        ts_min.to_bytes(8, "big"), ts_max.to_bytes(8, "big"))
+
+        def account_by_id(aid: int) -> Account:
+            raw = self.forest.trees["accounts"].get(aid.to_bytes(16, "big"))
+            assert raw is not None, aid
+            return Account.unpack(raw)
+
+        out = []
+        for _, value in scan:
+            rec = _unpack_event(value)
+            out.append(build_change_event(
+                rec, self.transfer_by_timestamp, account_by_id))
+            if len(out) >= limit:
+                break
+        return out
+
     def transfers_by_pending_id(self, pending_id: int) -> list[Transfer]:
         """Resolutions (posts/voids) of a pending transfer, ascending —
         served by the pending_id index tree (reference: the transfers

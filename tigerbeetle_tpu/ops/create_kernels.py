@@ -14,7 +14,7 @@ jnp.where makes the first failing check win — exactly the sequential
 early-return semantics, branch-free.
 
 This sequential kernel is the correctness baseline (bit-identical results vs
-the oracle); the vectorized fast-path kernel lives in ops/parallel_kernel.py.
+the oracle); the vectorized fast-path kernel lives in ops/fast_kernels.py.
 """
 
 from __future__ import annotations

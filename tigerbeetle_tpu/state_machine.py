@@ -145,6 +145,67 @@ class StateMachine:
         self._acct_indexed = 0
         self._events_by_ts: dict[int, AccountEventRecord] = {}
         self._events_indexed = 0
+        # LSM-serving read path (attach_durable): ForestQuery + bounded
+        # object caches. None = standalone mode (host dict indexes).
+        self._fq = None
+        self._acct_cache = None
+        self._xfer_cache = None
+
+    # -------------------------------------------------------- LSM serving
+
+    def attach_durable(self, durable, *, cache_sets: int = 1024,
+                       ways: int = 8) -> None:
+        """Serve reads from the LSM forest with a bounded object cache
+        (VERDICT r1 #4; reference: groove object cache + prefetch,
+        src/lsm/groove.zig:885,996,1339 + set_associative_cache.zig:1).
+        Queries route through ForestQuery; lookups hit the cache first and
+        fall through to the object trees on miss. The caches are written
+        through after every durable flush (cache_upsert), so entries are
+        always current. Memory on the read path is bounded by
+        construction: 2 * cache_sets * ways objects."""
+        from .lsm.cache_map import ObjectCache
+        from .lsm.query import ForestQuery
+
+        self._fq = ForestQuery(durable.forest)
+        self._acct_cache = ObjectCache(sets=cache_sets, ways=ways)
+        self._xfer_cache = ObjectCache(sets=cache_sets, ways=ways)
+
+    def cache_upsert(self, acct_ids, xfer_ids) -> None:
+        """Write-through after a durable flush: refresh cached copies of
+        every object the flush wrote (the groove cache-update-at-commit
+        discipline — reads never need invalidation)."""
+        if self._fq is None:
+            return
+        for aid in acct_ids:
+            a = self.state.accounts.get(aid)
+            if a is not None:
+                self._acct_cache.put(aid, a)
+        for tid in xfer_ids:
+            t = self.state.transfers.get(tid)
+            if t is not None:
+                self._xfer_cache.put(tid, t)
+
+    def _cached_account(self, aid: int) -> Optional[Account]:
+        a = self._acct_cache.get(aid)
+        if a is None:
+            raw = self._fq.forest.trees["accounts"].get(
+                aid.to_bytes(16, "big"))
+            if raw is None:
+                return None
+            a = Account.unpack(raw)
+            self._acct_cache.put(aid, a)
+        return a
+
+    def _cached_transfer(self, tid: int) -> Optional[Transfer]:
+        t = self._xfer_cache.get(tid)
+        if t is None:
+            raw = self._fq.forest.trees["transfers"].get(
+                tid.to_bytes(16, "big"))
+            if raw is None:
+                return None
+            t = Transfer.unpack(raw)
+            self._xfer_cache.put(tid, t)
+        return t
 
     # ------------------------------------------------------------- state
 
@@ -174,6 +235,9 @@ class StateMachine:
         self._acct_indexed = 0
         self._events_by_ts = {}
         self._events_indexed = 0
+        if self._acct_cache is not None:
+            self._acct_cache.clear()
+            self._xfer_cache.clear()
 
     # ------------------------------------------------------------- creates
 
@@ -198,9 +262,23 @@ class StateMachine:
     # ------------------------------------------------------------- lookups
 
     def lookup_accounts(self, ids: list[int]) -> list[Account]:
+        if self._fq is not None:
+            out = []
+            for i in ids:
+                a = self._cached_account(i)
+                if a is not None:
+                    out.append(a)
+            return out
         return [self.state.accounts[i] for i in ids if i in self.state.accounts]
 
     def lookup_transfers(self, ids: list[int]) -> list[Transfer]:
+        if self._fq is not None:
+            out = []
+            for i in ids:
+                t = self._cached_transfer(i)
+                if t is not None:
+                    out.append(t)
+            return out
         return [self.state.transfers[i] for i in ids if i in self.state.transfers]
 
     # ------------------------------------------------------------- indexes
@@ -282,6 +360,8 @@ class StateMachine:
     def get_account_transfers(self, f: AccountFilter) -> list[Transfer]:
         """reference: src/state_machine.zig:3294-3310 + scan construction
         :1737-1831 (debits OR credits, AND user_data/code, range, limit)."""
+        if self._fq is not None:
+            return self._fq.get_account_transfers(f)
         if not self._account_filter_valid(f):
             return []
         limit = min(f.limit,
@@ -294,6 +374,8 @@ class StateMachine:
         """reference: src/state_machine.zig:1568-1666, 3312-3357 — the same
         transfer scan, mapped through account_events history rows; only for
         accounts with flags.history."""
+        if self._fq is not None:
+            return self._fq.get_account_balances(f)
         if not self._account_filter_valid(f):
             return []
         account = self.state.accounts.get(f.account_id)
@@ -371,6 +453,8 @@ class StateMachine:
 
     def query_accounts(self, f: QueryFilter) -> list[Account]:
         """reference: src/state_machine.zig:3359-3375 + :2054-2124."""
+        if self._fq is not None:
+            return self._fq.query_accounts(f)
         if not self._query_filter_valid(f):
             return []
         cap = OPERATION_SPECS[Operation.query_accounts].result_max()
@@ -378,6 +462,8 @@ class StateMachine:
                 for ts in self._query(f, "accounts", cap)]
 
     def query_transfers(self, f: QueryFilter) -> list[Transfer]:
+        if self._fq is not None:
+            return self._fq.query_transfers(f)
         if not self._query_filter_valid(f):
             return []
         cap = OPERATION_SPECS[Operation.query_transfers].result_max()
@@ -396,6 +482,8 @@ class StateMachine:
         )
         if not valid:
             return []
+        if self._fq is not None:
+            return self._fq.get_change_events(f)
         self._refresh_indexes()
         ts_min = f.timestamp_min or 1
         ts_max = f.timestamp_max or TIMESTAMP_MAX
@@ -411,59 +499,12 @@ class StateMachine:
         return out
 
     def _change_event(self, rec: AccountEventRecord) -> ChangeEvent:
-        status = rec.transfer_pending_status
-        if status == TransferPendingStatus.expired:
-            transfer = rec.transfer_pending
-            assert transfer is not None
-            etype = ChangeEventType.two_phase_expired
-        else:
-            transfer = self.state.transfers[
-                self.state.transfer_by_timestamp[rec.timestamp]]
-            etype = {
-                TransferPendingStatus.none: ChangeEventType.single_phase,
-                TransferPendingStatus.pending: ChangeEventType.two_phase_pending,
-                TransferPendingStatus.posted: ChangeEventType.two_phase_posted,
-                TransferPendingStatus.voided: ChangeEventType.two_phase_voided,
-            }[status]
-        dr = self.state.accounts[rec.dr_account.id]
-        cr = self.state.accounts[rec.cr_account.id]
-        return ChangeEvent(
-            transfer_id=transfer.id,
-            transfer_amount=rec.amount,
-            transfer_pending_id=transfer.pending_id,
-            transfer_user_data_128=transfer.user_data_128,
-            transfer_user_data_64=transfer.user_data_64,
-            transfer_user_data_32=transfer.user_data_32,
-            transfer_timeout=transfer.timeout,
-            transfer_code=transfer.code,
-            transfer_flags=transfer.flags,
-            ledger=transfer.ledger,
-            type=etype,
-            debit_account_id=dr.id,
-            debit_account_debits_pending=rec.dr_account.debits_pending,
-            debit_account_debits_posted=rec.dr_account.debits_posted,
-            debit_account_credits_pending=rec.dr_account.credits_pending,
-            debit_account_credits_posted=rec.dr_account.credits_posted,
-            debit_account_user_data_128=dr.user_data_128,
-            debit_account_user_data_64=dr.user_data_64,
-            debit_account_user_data_32=dr.user_data_32,
-            debit_account_code=dr.code,
-            debit_account_flags=rec.dr_account.flags,
-            credit_account_id=cr.id,
-            credit_account_debits_pending=rec.cr_account.debits_pending,
-            credit_account_debits_posted=rec.cr_account.debits_posted,
-            credit_account_credits_pending=rec.cr_account.credits_pending,
-            credit_account_credits_posted=rec.cr_account.credits_posted,
-            credit_account_user_data_128=cr.user_data_128,
-            credit_account_user_data_64=cr.user_data_64,
-            credit_account_user_data_32=cr.user_data_32,
-            credit_account_code=cr.code,
-            credit_account_flags=rec.cr_account.flags,
-            timestamp=rec.timestamp,
-            transfer_timestamp=transfer.timestamp,
-            debit_account_timestamp=dr.timestamp,
-            credit_account_timestamp=cr.timestamp,
-        )
+        return build_change_event(
+            rec,
+            lambda ts: self.state.transfers[
+                self.state.transfer_by_timestamp[ts]],
+            lambda aid: self.state.accounts[aid])
+
 
     # ------------------------------------------------------------- pulse
 
@@ -606,3 +647,62 @@ def _encode_create_results(results, spec: OperationSpec) -> bytes:
             continue
         out += struct.pack("<II", i, int(r.status))
     return out
+
+
+def build_change_event(rec: AccountEventRecord, transfer_by_timestamp,
+                       account_by_id) -> ChangeEvent:
+    """Join one account_events record with its transfer + accounts
+    (reference: src/state_machine.zig:3395-3528). Shared by the host-index
+    path and the forest-backed path (lsm/query.py)."""
+    status = rec.transfer_pending_status
+    if status == TransferPendingStatus.expired:
+        transfer = rec.transfer_pending
+        assert transfer is not None
+        etype = ChangeEventType.two_phase_expired
+    else:
+        transfer = transfer_by_timestamp(rec.timestamp)
+        etype = {
+            TransferPendingStatus.none: ChangeEventType.single_phase,
+            TransferPendingStatus.pending: ChangeEventType.two_phase_pending,
+            TransferPendingStatus.posted: ChangeEventType.two_phase_posted,
+            TransferPendingStatus.voided: ChangeEventType.two_phase_voided,
+        }[status]
+    dr = account_by_id(rec.dr_account.id)
+    cr = account_by_id(rec.cr_account.id)
+    return ChangeEvent(
+        transfer_id=transfer.id,
+        transfer_amount=rec.amount,
+        transfer_pending_id=transfer.pending_id,
+        transfer_user_data_128=transfer.user_data_128,
+        transfer_user_data_64=transfer.user_data_64,
+        transfer_user_data_32=transfer.user_data_32,
+        transfer_timeout=transfer.timeout,
+        transfer_code=transfer.code,
+        transfer_flags=transfer.flags,
+        ledger=transfer.ledger,
+        type=etype,
+        debit_account_id=dr.id,
+        debit_account_debits_pending=rec.dr_account.debits_pending,
+        debit_account_debits_posted=rec.dr_account.debits_posted,
+        debit_account_credits_pending=rec.dr_account.credits_pending,
+        debit_account_credits_posted=rec.dr_account.credits_posted,
+        debit_account_user_data_128=dr.user_data_128,
+        debit_account_user_data_64=dr.user_data_64,
+        debit_account_user_data_32=dr.user_data_32,
+        debit_account_code=dr.code,
+        debit_account_flags=rec.dr_account.flags,
+        credit_account_id=cr.id,
+        credit_account_debits_pending=rec.cr_account.debits_pending,
+        credit_account_debits_posted=rec.cr_account.debits_posted,
+        credit_account_credits_pending=rec.cr_account.credits_pending,
+        credit_account_credits_posted=rec.cr_account.credits_posted,
+        credit_account_user_data_128=cr.user_data_128,
+        credit_account_user_data_64=cr.user_data_64,
+        credit_account_user_data_32=cr.user_data_32,
+        credit_account_code=cr.code,
+        credit_account_flags=rec.cr_account.flags,
+        timestamp=rec.timestamp,
+        transfer_timestamp=transfer.timestamp,
+        debit_account_timestamp=dr.timestamp,
+        credit_account_timestamp=cr.timestamp,
+    )

@@ -239,63 +239,66 @@ class DurableState:
 
     # ------------------------------------------------------------- writes
 
-    def flush(self, state: StateMachineOracle) -> None:
+    def flush(self, state: StateMachineOracle):
         """Write every object mutated since the last flush into the trees
-        (sorted key order: byte-deterministic across replicas)."""
+        (sorted key order: byte-deterministic across replicas). Returns
+        (flushed account ids, flushed transfer ids) so the serving layer
+        can write its bounded object caches through (state_machine.py
+        cache_upsert)."""
         trees = self.forest.trees
         # A dirty key absent from its dict was created then rolled back by a
         # linked-chain scope within one commit — it was never flushed, so
         # skip it (accounts/transfers/pending are never legitimately
         # removed; only expiry needs real tombstones).
         acc = state.accounts
-        for aid in sorted(acc.dirty):
-            if aid in acc:
-                a = acc[aid]
-                trees["accounts"].put(_k16(aid), a.pack())
-                if aid in self._indexed_accounts:
-                    continue  # balances changed; indexed fields immutable
-                self._indexed_accounts.add(aid)
-                ts = a.timestamp
-                trees["acct_by_ts"].put(_k8(ts), _k16(aid))
-                trees["acct_by_ud128"].put(
-                    composite_key(a.user_data_128, ts, 16), b"\x01")
-                trees["acct_by_ud64"].put(
-                    composite_key(a.user_data_64, ts, 8), b"\x01")
-                trees["acct_by_ud32"].put(
-                    composite_key(a.user_data_32, ts, 4), b"\x01")
-                trees["acct_by_ledger"].put(
-                    composite_key(a.ledger, ts, 4), b"\x01")
-                trees["acct_by_code"].put(
-                    composite_key(a.code, ts, 2), b"\x01")
+        flushed_accounts = sorted(a for a in acc.dirty if a in acc)
+        for aid in flushed_accounts:
+            a = acc[aid]
+            trees["accounts"].put(_k16(aid), a.pack())
+            if aid in self._indexed_accounts:
+                continue  # balances changed; indexed fields immutable
+            self._indexed_accounts.add(aid)
+            ts = a.timestamp
+            trees["acct_by_ts"].put(_k8(ts), _k16(aid))
+            trees["acct_by_ud128"].put(
+                composite_key(a.user_data_128, ts, 16), b"\x01")
+            trees["acct_by_ud64"].put(
+                composite_key(a.user_data_64, ts, 8), b"\x01")
+            trees["acct_by_ud32"].put(
+                composite_key(a.user_data_32, ts, 4), b"\x01")
+            trees["acct_by_ledger"].put(
+                composite_key(a.ledger, ts, 4), b"\x01")
+            trees["acct_by_code"].put(
+                composite_key(a.code, ts, 2), b"\x01")
         acc.dirty.clear()
         xfr = state.transfers
-        for tid in sorted(xfr.dirty):
-            if tid in xfr:
-                t = xfr[tid]
-                ts = t.timestamp
-                trees["transfers"].put(_k16(tid), t.pack())
-                trees["xfer_by_ts"].put(_k8(ts), _k16(tid))
-                trees["xfer_by_dr"].put(
-                    composite_key(t.debit_account_id, ts, 16), b"\x01")
-                trees["xfer_by_cr"].put(
-                    composite_key(t.credit_account_id, ts, 16), b"\x01")
-                if t.pending_id:
-                    # Zero means 'not a post/void' — never indexed
-                    # (reference: the pending_id tree likewise only holds
-                    # resolutions; ForestQuery.transfers_by_pending_id
-                    # reads it).
-                    trees["xfer_by_pid"].put(
-                        composite_key(t.pending_id, ts, 16), b"\x01")
-                trees["xfer_by_ud128"].put(
-                    composite_key(t.user_data_128, ts, 16), b"\x01")
-                trees["xfer_by_ud64"].put(
-                    composite_key(t.user_data_64, ts, 8), b"\x01")
-                trees["xfer_by_ud32"].put(
-                    composite_key(t.user_data_32, ts, 4), b"\x01")
-                trees["xfer_by_ledger"].put(
-                    composite_key(t.ledger, ts, 4), b"\x01")
-                trees["xfer_by_code"].put(
-                    composite_key(t.code, ts, 2), b"\x01")
+        flushed_transfers = sorted(t for t in xfr.dirty if t in xfr)
+        for tid in flushed_transfers:
+            t = xfr[tid]
+            ts = t.timestamp
+            trees["transfers"].put(_k16(tid), t.pack())
+            trees["xfer_by_ts"].put(_k8(ts), _k16(tid))
+            trees["xfer_by_dr"].put(
+                composite_key(t.debit_account_id, ts, 16), b"\x01")
+            trees["xfer_by_cr"].put(
+                composite_key(t.credit_account_id, ts, 16), b"\x01")
+            if t.pending_id:
+                # Zero means 'not a post/void' — never indexed
+                # (reference: the pending_id tree likewise only holds
+                # resolutions; ForestQuery.transfers_by_pending_id
+                # reads it).
+                trees["xfer_by_pid"].put(
+                    composite_key(t.pending_id, ts, 16), b"\x01")
+            trees["xfer_by_ud128"].put(
+                composite_key(t.user_data_128, ts, 16), b"\x01")
+            trees["xfer_by_ud64"].put(
+                composite_key(t.user_data_64, ts, 8), b"\x01")
+            trees["xfer_by_ud32"].put(
+                composite_key(t.user_data_32, ts, 4), b"\x01")
+            trees["xfer_by_ledger"].put(
+                composite_key(t.ledger, ts, 4), b"\x01")
+            trees["xfer_by_code"].put(
+                composite_key(t.code, ts, 2), b"\x01")
         xfr.dirty.clear()
         pend = state.pending_status
         for ts in sorted(pend.dirty):
@@ -316,6 +319,7 @@ class DurableState:
         for rec in state.account_events[self.events_persisted:]:
             trees["events"].put(_k8(rec.timestamp), _pack_event(rec))
         self.events_persisted = len(state.account_events)
+        return flushed_accounts, flushed_transfers
 
     def compact_beat(self, op: int) -> None:
         self.forest.compact_beat(op)
