@@ -7,11 +7,18 @@ merge paced in bars/beats; deterministic pacing is load-bearing for
 replica-identical data files), src/lsm/manifest.zig (least-overlap table
 selection, docs/internals/lsm.md:93-108).
 
-Pacing model here: `compact_beat()` is called once per committed op (the
-reference's beat); every `bar_length` beats the mutable memtable flushes to
-level 0 and one compaction step runs per level that exceeds its budget.
-All decisions are pure functions of the op sequence — byte-deterministic
-across replicas (tested)."""
+Pacing model here (incremental, VERDICT r1 #5 — reference:
+src/lsm/compaction.zig:289, docs/internals/lsm.md:37-138): `compact_beat()`
+is called once per committed op (the reference's beat). At each bar
+boundary the mutable memtable flushes to level 0 and one compaction JOB is
+scheduled per over-budget level; the jobs' merge work is then spread
+evenly across the bar's remaining beats (a bounded number of entries
+merged per beat), with grid writes deferred to the completing beat so a
+mid-bar checkpoint never sees partial on-disk state. The last beat of the
+bar drains whatever remains, so a bar always ends with its scheduled jobs
+installed. All decisions are pure functions of the op sequence —
+byte-deterministic across replicas (tested), including across a
+crash/replay (jobs hold only memory until completion)."""
 
 from __future__ import annotations
 
@@ -34,6 +41,38 @@ BAR_LENGTH = 32  # ops per bar (reference: lsm_compaction_ops)
 L0_TABLES_MAX = 4
 
 
+@dataclasses.dataclass
+class _CompactionJob:
+    """One level's in-flight incremental merge: input tables captured at
+    schedule time, merge advanced a bounded number of entries per beat,
+    output written + installed only at completion."""
+
+    level: int
+    table: Table
+    overlapping: list[Table]
+    total: int  # input entries (pacing estimate)
+    merged: dict = dataclasses.field(default_factory=dict)
+    streams: list = dataclasses.field(default_factory=list)
+    stream_i: int = 0
+
+    def advance(self, budget: Optional[int]):
+        """Merge up to `budget` INPUT entries (None = drain). Returns
+        (done, used): done when the inputs are exhausted (caller
+        finalizes); used = entries consumed, which the caller charges
+        against the beat budget (NOT merged-dict growth — duplicate-key
+        merges consume entries without growing the dict)."""
+        used = 0
+        while self.stream_i < len(self.streams):
+            stream = self.streams[self.stream_i]
+            for k, v in stream:
+                self.merged[k] = v
+                used += 1
+                if budget is not None and used >= budget:
+                    return False, used
+            self.stream_i += 1
+        return True, used
+
+
 class Tree:
     def __init__(self, grid: Grid, *, key_size: int, value_size: int,
                  name: str = "tree"):
@@ -44,6 +83,10 @@ class Tree:
         self.memtable: dict[bytes, bytes] = {}
         self.levels: list[list[Table]] = [[] for _ in range(LSM_LEVELS)]
         self.beat = 0
+        # In-flight incremental compaction jobs (scheduled at bar start,
+        # advanced per beat, drained by bar end).
+        self._jobs: list[_CompactionJob] = []
+        self._per_beat = 0
 
     # ------------------------------------------------------------- updates
 
@@ -81,17 +124,28 @@ class Tree:
     # ---------------------------------------------------------- compaction
 
     def compact_beat(self, op: Optional[int] = None) -> None:
-        """One beat; at each bar boundary, flush + rebalance one step.
-        Deterministic in the op sequence (no clocks, no randomness). When
-        `op` is given, the bar phase is derived from the op number itself so
-        a restarted replica replaying the WAL suffix hits the exact same
-        flush points as one that never crashed (the reference derives
-        compaction pacing from op % lsm_compaction_ops the same way,
+        """One beat. At a bar boundary: flush the memtable and SCHEDULE one
+        compaction job per over-budget level; on every beat, advance the
+        in-flight jobs by a bounded number of merged entries (total work /
+        remaining beats), deferring grid writes to each job's completion;
+        the bar's last beat drains the rest. Deterministic in the op
+        sequence (no clocks, no randomness). When `op` is given, the bar
+        phase is derived from the op number itself so a restarted replica
+        replaying the WAL suffix hits the exact same flush and merge
+        points as one that never crashed (the reference derives compaction
+        pacing from op % lsm_compaction_ops the same way,
         docs/internals/lsm.md:37-91)."""
         self.beat = self.beat + 1 if op is None else op
-        if self.beat % BAR_LENGTH == 0:
+        phase = self.beat % BAR_LENGTH
+        if phase == 0:
             self.flush_memtable()
-            self._compact_levels()
+            self._drain_jobs()  # defensive: a bar never leaves work behind
+            self._schedule_jobs()
+        if self._jobs:
+            if phase == BAR_LENGTH - 1:
+                self._drain_jobs()
+            else:
+                self._advance_jobs(self._per_beat)
 
     def flush_memtable(self) -> None:
         if not self.memtable:
@@ -108,10 +162,79 @@ class Tree:
             return L0_TABLES_MAX
         return GROWTH_FACTOR ** level
 
-    def _compact_levels(self) -> None:
+    def _schedule_jobs(self) -> None:
+        """One job per over-budget level, inputs captured now (they stay
+        installed and readable until the job completes). A level whose
+        pick or overlap set intersects an earlier job's captured tables
+        is SKIPPED this bar (adjacent over-budget levels would otherwise
+        double-release a shared level-(L+1) table); it reschedules next
+        bar — deterministic either way."""
+        assert not self._jobs
+        jobs: list[_CompactionJob] = []
+        claimed: set[int] = set()  # id() of captured Table objects
         for level in range(LSM_LEVELS - 1):
             if len(self.levels[level]) > self._level_budget(level):
-                self._compact_one(level)
+                table = self._pick_table(level)
+                overlapping = [
+                    t for t in self.levels[level + 1]
+                    if not (t.info.key_max < table.info.key_min
+                            or t.info.key_min > table.info.key_max)]
+                touched = [table, *overlapping]
+                if any(id(t) in claimed for t in touched):
+                    continue
+                claimed.update(id(t) for t in touched)
+                total = (table.info.entry_count
+                         + sum(t.info.entry_count for t in overlapping))
+                job = _CompactionJob(level=level, table=table,
+                                     overlapping=overlapping, total=total)
+                # Older tables first so the newer input wins the merge.
+                job.streams = [t.iter_entries() for t in overlapping]
+                job.streams.append(table.iter_entries())
+                jobs.append(job)
+        self._jobs = jobs
+        total = sum(j.total for j in jobs)
+        self._per_beat = max(1, -(-total // (BAR_LENGTH - 1)))
+
+    def _advance_jobs(self, budget: int) -> None:
+        while budget > 0 and self._jobs:
+            job = self._jobs[0]
+            done, used = job.advance(budget)
+            if done:
+                self._finalize_job(job)
+                self._jobs.pop(0)
+            budget -= max(1, used)
+
+    def _drain_jobs(self) -> None:
+        for job in self._jobs:
+            done, _ = job.advance(None)
+            assert done
+            self._finalize_job(job)
+        self._jobs = []
+
+    def _finalize_job(self, job: _CompactionJob) -> None:
+        """Write output tables, install, release inputs — the only beat
+        that touches the grid (mid-bar checkpoints therefore never see a
+        partially-written compaction)."""
+        level = job.level
+        self.levels[level].remove(job.table)
+        next_level = self.levels[level + 1]
+        for t in job.overlapping:
+            next_level.remove(t)
+        last_level = level + 1 == LSM_LEVELS - 1
+        dead = TOMBSTONE * self.value_size
+        entries = sorted(
+            (k, v) for k, v in job.merged.items()
+            if not (last_level and v == dead))  # tombstones die at the bottom
+        if entries:
+            # A merge output exceeding one table's capacity splits into
+            # several disjoint tables (all still inside next_level's range).
+            for info in write_tables(self.grid, entries, self.key_size,
+                                     self.value_size):
+                bisect_insert(next_level, Table(
+                    self.grid, info, self.key_size, self.value_size))
+        release_table(self.grid, job.table)
+        for t in job.overlapping:
+            release_table(self.grid, t)
 
     def _pick_table(self, level: int) -> Table:
         """Selection policy: L0 tables overlap each other, so only the
@@ -131,49 +254,29 @@ class Tree:
         return min(self.levels[level],
                    key=lambda t: (overlap(t), t.info.key_min))
 
-    def _compact_one(self, level: int) -> None:
-        table = self._pick_table(level)
-        self.levels[level].remove(table)
-        next_level = self.levels[level + 1]
-        overlapping = [
-            t for t in next_level
-            if not (t.info.key_max < table.info.key_min
-                    or t.info.key_min > table.info.key_max)]
-        for t in overlapping:
-            next_level.remove(t)
-
-        merged: dict[bytes, bytes] = {}
-        for t in overlapping:  # older
-            for k, v in t.iter_entries():
-                merged[k] = v
-        for k, v in table.iter_entries():  # newer wins
-            merged[k] = v
-        last_level = level + 1 == LSM_LEVELS - 1
-        dead = TOMBSTONE * self.value_size
-        entries = sorted(
-            (k, v) for k, v in merged.items()
-            if not (last_level and v == dead))  # tombstones die at the bottom
-        if entries:
-            # A merge output exceeding one table's capacity splits into
-            # several disjoint tables (all still inside next_level's range).
-            for info in write_tables(self.grid, entries, self.key_size,
-                                     self.value_size):
-                bisect_insert(next_level, Table(
-                    self.grid, info, self.key_size, self.value_size))
-        release_table(self.grid, table)
-        for t in overlapping:
-            release_table(self.grid, t)
-
     # ------------------------------------------------------------ manifest
 
     def manifest_pack(self) -> bytes:
-        """Serialize level structure (reference: manifest log replay)."""
+        """Serialize the level structure AND any in-flight compaction
+        jobs (reference: manifest log replay). Persisting the job plans
+        is load-bearing for physical determinism: a mid-bar checkpoint
+        precedes the bar-end install, so a replica restarting from it
+        must resume the SAME merges (same inputs, same install beat) a
+        never-crashed replica completes — the merge output is a pure
+        function of the inputs, so the grids stay byte-identical even
+        though the restarted replica redoes the merge work."""
         self.flush_memtable()
         parts = [struct.pack("<B", LSM_LEVELS)]
         for level in self.levels:
             parts.append(struct.pack("<I", len(level)))
             for table in level:
                 parts.append(table.info.pack())
+        parts.append(struct.pack("<I", len(self._jobs)))
+        for job in self._jobs:
+            parts.append(struct.pack("<BI", job.level, len(job.overlapping)))
+            parts.append(job.table.info.pack())
+            for t in job.overlapping:
+                parts.append(t.info.pack())
         return b"".join(parts)
 
     def manifest_restore(self, raw: bytes) -> None:
@@ -189,6 +292,42 @@ class Tree:
                 self.levels[level].append(Table(
                     self.grid, info, self.key_size, self.value_size))
         self.memtable.clear()
+        # Rebuild in-flight jobs against the RESTORED Table objects
+        # (identity matters: finalize removes job tables from the level
+        # lists by identity). Merge progress restarts from zero — the
+        # output is input-deterministic, so only pacing differs.
+        self._jobs = []
+        if pos < len(raw):
+            (n_jobs,) = struct.unpack_from("<I", raw, pos)
+            pos += 4
+            for _ in range(n_jobs):
+                level, n_over = struct.unpack_from("<BI", raw, pos)
+                pos += 5
+                t_info, pos = TableInfo.unpack(raw, pos)
+                over_infos = []
+                for _ in range(n_over):
+                    info, pos = TableInfo.unpack(raw, pos)
+                    over_infos.append(info)
+
+                def resident(lvl: int, info: TableInfo) -> Table:
+                    for t in self.levels[lvl]:
+                        if (t.info.index_address == info.index_address
+                                and t.info.index_size == info.index_size):
+                            return t
+                    raise AssertionError(
+                        f"job table missing from restored level {lvl}")
+
+                table = resident(level, t_info)
+                overlapping = [resident(level + 1, i) for i in over_infos]
+                total = (table.info.entry_count
+                         + sum(t.info.entry_count for t in overlapping))
+                job = _CompactionJob(level=level, table=table,
+                                     overlapping=overlapping, total=total)
+                job.streams = [t.iter_entries() for t in overlapping]
+                job.streams.append(table.iter_entries())
+                self._jobs.append(job)
+            total = sum(j.total for j in self._jobs)
+            self._per_beat = max(1, -(-total // (BAR_LENGTH - 1)))
 
 
 def bisect_insert(level: list[Table], table: Table) -> None:
