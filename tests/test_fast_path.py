@@ -371,3 +371,74 @@ class TestDeviceHistoryRing:
         assert led.fallbacks == 0, "must exercise the DEVICE history path"
         host = led.to_host()
         assert host.account_events == sm.account_events
+
+
+class TestLimitHeadroomEligibility:
+    """E3 relaxed: limit-flagged accounts ride the fast path when the
+    batch provably fits their headroom; a potential breach falls back to
+    the exact path (bit-exact either way)."""
+
+    def _pair(self, funded):
+        from tigerbeetle_tpu.oracle import StateMachineOracle
+        from tigerbeetle_tpu.ops.ledger import DeviceLedger
+        from tigerbeetle_tpu.types import Account, AccountFlags, Transfer
+
+        limit = int(AccountFlags.debits_must_not_exceed_credits)
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
+        sm = StateMachineOracle()
+        accts = [Account(id=i, ledger=1, code=1,
+                         flags=limit if i % 2 == 0 else 0)
+                 for i in range(1, 21)]
+        for eng in (led, sm):
+            eng.create_accounts(accts, 30)
+        if funded:
+            fund = [Transfer(id=100 + i, debit_account_id=1 + (i % 9) * 2,
+                             credit_account_id=2 + (i % 10) * 2,
+                             amount=10**6, ledger=1, code=1)
+                    for i in range(10)]
+            for eng in (led, sm):
+                eng.create_transfers(fund, 10**6)
+        return led, sm
+
+    def test_healthy_limits_stay_fast(self):
+        import numpy as np
+        from tigerbeetle_tpu.types import Transfer
+
+        led, sm = self._pair(funded=True)
+        rng = np.random.default_rng(8)
+        ts, nid = 10**9, 10**6
+        for b in range(3):
+            evs = [Transfer(id=nid + i,
+                            debit_account_id=2 + int(rng.integers(0, 10)) * 2,
+                            credit_account_id=1 + int(rng.integers(0, 10)) * 2,
+                            amount=int(rng.integers(1, 50)), ledger=1, code=1)
+                   for i in range(200)]
+            nid += 200
+            ts += 300
+            got = led.create_transfers(evs, ts)
+            want = sm.create_transfers(evs, ts)
+            assert [(r.timestamp, r.status) for r in got] == \
+                   [(r.timestamp, r.status) for r in want], b
+        assert led.fallbacks == 0, "funded limits must stay on device"
+        host = led.to_host()
+        assert host.accounts == sm.accounts
+
+    def test_breachable_limits_fall_back_exactly(self):
+        import numpy as np
+        from tigerbeetle_tpu.types import Transfer
+
+        led, sm = self._pair(funded=False)  # zero balances: breaches real
+        rng = np.random.default_rng(9)
+        ts, nid = 10**9, 10**6
+        evs = [Transfer(id=nid + i,
+                        debit_account_id=2 + int(rng.integers(0, 10)) * 2,
+                        credit_account_id=1 + int(rng.integers(0, 10)) * 2,
+                        amount=int(rng.integers(1, 50)), ledger=1, code=1)
+               for i in range(100)]
+        ts += 150
+        got = led.create_transfers(evs, ts)
+        want = sm.create_transfers(evs, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        assert led.fallbacks == 1, "potential breach must take exact path"
+        assert any(r.status.name == "exceeds_credits" for r in want)

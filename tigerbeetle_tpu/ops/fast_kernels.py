@@ -15,8 +15,11 @@ are provably order-independent. The kernel verifies eligibility on device
       (imported regress checks and balance clamps are order-dependent);
   E2  no duplicate ids within the batch, no pending_id referencing an id in
       the batch, no duplicate pending_ids (intra-batch object dependencies);
-  E3  no balance-limit-flagged account is touched by a regular transfer
-      (exceeds_credits/debits would depend on running balances);
+  E3  every balance-limit-flagged account touched by regular transfers
+      provably fits the batch's WORST-CASE load in its pre-batch headroom
+      (sum of all candidate amounts, ignoring mid-batch relief): then no
+      prefix order can trip exceeds_credits/debits, so the checks are
+      order-independent; a potential breach falls back;
   E4  no u128 balance overflow is possible: max touched balance plus the
       exact 160-bit sum of all batch amounts stays below 2^128, so the six
       overflow statuses (src/state_machine.zig:3856-3884) cannot fire;
@@ -399,9 +402,54 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
         jnp.concatenate([tag, ptag]))
 
+    # E3 relaxed (headroom proof): balance-limit-flagged accounts no
+    # longer force a fallback outright. A limit check
+    # (debits_exceed_credits: dp+dpos+amount > cpos — tigerbeetle.zig:34)
+    # is order-dependent only if some prefix of the batch could breach
+    # it. We admit the batch when, for every limited account, the
+    # WORST-CASE load (sum of ALL candidate amounts against it, ignoring
+    # any mid-batch relief from credits/voids — both only widen
+    # headroom) still fits the pre-batch headroom: then no event can
+    # fail the limit in any prefix, so parallel == sequential. Only a
+    # potential breach falls back to the exact path.
     reg = valid & ~pv
-    e3 = jnp.any(reg & (_flag(dr["flags"], _A_DR_LIMIT)
-                        | _flag(cr["flags"], _A_CR_LIMIT)))
+    A_rows = acc["id_hi"].shape[0]
+    z64 = jnp.uint64(0)
+    ral0, ral1, ral2, ral3 = _to_limbs(
+        jnp.where(reg, amt_res_hi, z64), jnp.where(reg, amt_res_lo, z64))
+
+    def _acct_load(rows):
+        return [jax.ops.segment_sum(l, rows, num_segments=A_rows)
+                for l in (ral0, ral1, ral2, ral3)]
+
+    def _breach(load, held1, held2, against1, limit_bit):
+        # (held1 + held2 + load) > against1, evaluated in 5 limbs
+        # (each limb sum < 2^46: no u64 overflow before normalize).
+        lft = [acc[f"{held1}{j}"] + acc[f"{held2}{j}"] + load[j]
+               for j in range(4)]
+        c = lft[0] >> jnp.uint64(32); f0 = lft[0] & _M32
+        lft[1] = lft[1] + c
+        c = lft[1] >> jnp.uint64(32); f1 = lft[1] & _M32
+        lft[2] = lft[2] + c
+        c = lft[2] >> jnp.uint64(32); f2 = lft[2] & _M32
+        lft[3] = lft[3] + c
+        l4 = lft[3] >> jnp.uint64(32); f3 = lft[3] & _M32
+        left_hi = f2 | (f3 << jnp.uint64(32))
+        left_lo = f0 | (f1 << jnp.uint64(32))
+        right_hi = acc[f"{against1}2"] | (acc[f"{against1}3"]
+                                          << jnp.uint64(32))
+        right_lo = acc[f"{against1}0"] | (acc[f"{against1}1"]
+                                          << jnp.uint64(32))
+        limited = _flag(acc["flags"], limit_bit)
+        # The dump row (last) is scratch: failed creates scatter raw
+        # flags there and masked transfers scatter-add amounts into its
+        # balances — it must never latch a breach.
+        limited = limited.at[A_rows - 1].set(False)
+        over = (l4 > 0) | u128.lt(right_hi, right_lo, left_hi, left_lo)
+        return jnp.any(limited & over)
+
+    e3 = (_breach(_acct_load(dr_rowc), "dp", "dpos", "cpos", _A_DR_LIMIT)
+          | _breach(_acct_load(cr_rowc), "cp", "cpos", "dpos", _A_CR_LIMIT))
 
     a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
     a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
