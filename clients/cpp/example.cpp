@@ -1,0 +1,90 @@
+// C++ client sample: double-entry session against a live cluster.
+//
+// Usage: example <cluster> <addresses>        (or: example echo)
+// Exit 0 iff every expectation holds — the integration test's contract
+// (reference pattern: src/clients/c sample + per-language ci samples).
+
+#include <cstdio>
+#include <cstdlib>
+
+#include "tb_client.hpp"
+
+int main(int argc, char **argv) {
+  if (argc < 2) {
+    std::fprintf(stderr, "usage: %s <cluster> <addresses> | echo\n",
+                 argv[0]);
+    return 2;
+  }
+  try {
+    if (std::string(argv[1]) == "echo") {
+      tb::Client client(1, "", /*echo=*/true);
+      std::vector<uint8_t> body = {1, 2, 3, 4, 5};
+      auto reply = client.request(tb::Operation::create_transfers, body);
+      if (reply != body) {
+        std::fprintf(stderr, "echo mismatch\n");
+        return 1;
+      }
+      std::printf("echo ok\n");
+      return 0;
+    }
+
+    uint64_t cluster = std::strtoull(argv[1], nullptr, 10);
+    tb::Client client(cluster, argv[2]);
+
+    std::vector<tb::Account> accounts(2);
+    accounts[0].id = 1;
+    accounts[0].ledger = 700;
+    accounts[0].code = 10;
+    accounts[1].id = 2;
+    accounts[1].ledger = 700;
+    accounts[1].code = 10;
+    auto acct_results = client.create_accounts(accounts);
+    for (auto &r : acct_results) {
+      // 'exists' (idempotent retry) is also acceptable on reconnects.
+      if (r.status != tb::kCreated && r.status != tb::kAccountExists) {
+        std::fprintf(stderr, "create_accounts status=%u\n", r.status);
+        return 1;
+      }
+    }
+
+    std::vector<tb::Transfer> transfers(2);
+    transfers[0].id = 100;
+    transfers[0].debit_account_id = 1;
+    transfers[0].credit_account_id = 2;
+    transfers[0].amount = 77;
+    transfers[0].ledger = 700;
+    transfers[0].code = 10;
+    transfers[1].id = 101;  // debit account missing: transient failure
+    transfers[1].debit_account_id = 999;
+    transfers[1].credit_account_id = 2;
+    transfers[1].amount = 1;
+    transfers[1].ledger = 700;
+    transfers[1].code = 10;
+    auto xfer_results = client.create_transfers(transfers);
+    bool first_ok = xfer_results[0].status == tb::kCreated ||
+                    xfer_results[0].status == tb::kTransferExists;
+    if (xfer_results.size() != 2 || !first_ok ||
+        xfer_results[1].status == tb::kCreated) {
+      std::fprintf(stderr, "create_transfers unexpected statuses\n");
+      return 1;
+    }
+
+    auto looked = client.lookup_accounts({tb::u128(1), tb::u128(2)});
+    if (looked.size() != 2 || looked[0].debits_posted.lo != 77 ||
+        looked[1].credits_posted.lo != 77) {
+      std::fprintf(stderr, "lookup_accounts balances wrong\n");
+      return 1;
+    }
+    auto xfers = client.lookup_transfers({tb::u128(100)});
+    if (xfers.size() != 1 || xfers[0].amount.lo != 77) {
+      std::fprintf(stderr, "lookup_transfers wrong\n");
+      return 1;
+    }
+    std::printf("cpp client ok: balance=%llu\n",
+                (unsigned long long)looked[1].credits_posted.lo);
+    return 0;
+  } catch (const std::exception &e) {
+    std::fprintf(stderr, "error: %s\n", e.what());
+    return 1;
+  }
+}

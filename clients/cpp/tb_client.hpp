@@ -1,0 +1,266 @@
+// C++ client for tigerbeetle_tpu over the shared C ABI.
+//
+// The reference ships per-language clients as thin typed wrappers over
+// one C client (src/clients/c/tb_client.zig; e.g. src/clients/go,
+// src/clients/node are codegen'd bindings around it). This header is
+// that pattern for C++: typed 128-byte Account/Transfer structs
+// (tigerbeetle_tpu/types.py wire layout), the multi-batch codec
+// (vsr/multi_batch.py), and a synchronous Client over the thread-safe
+// packet queue in native/tb_client.cpp.
+//
+// Build: compile your program together with native/tb_client.cpp, e.g.
+//   g++ -O2 -std=c++17 example.cpp ../../native/tb_client.cpp -o example
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+// ---------------------------------------------------------------- C ABI
+// (mirrors native/tb_client.cpp; kept in sync by the integration test)
+extern "C" {
+enum tbp_packet_status : uint8_t {
+  TBP_PACKET_PENDING = 0,
+  TBP_PACKET_OK = 1,
+  TBP_PACKET_CLIENT_SHUTDOWN = 2,
+  TBP_PACKET_INVALID = 3,
+};
+struct tbp_packet {
+  struct tbp_packet *next;
+  void *user_data;
+  uint16_t operation;
+  uint8_t status;
+  uint8_t reserved;
+  uint32_t data_size;
+  const uint8_t *data;
+  uint8_t *reply;
+  uint32_t reply_size;
+};
+typedef void (*tbp_completion_t)(void *ctx, struct tbp_packet *packet);
+struct tbp_client;
+int tbp_client_init(tbp_client **out, uint64_t cluster,
+                    const uint8_t client_id[16], const char *addresses,
+                    tbp_completion_t on_completion, void *ctx);
+int tbp_client_init_echo(tbp_client **out, uint64_t cluster,
+                         const uint8_t client_id[16],
+                         tbp_completion_t on_completion, void *ctx);
+void tbp_client_submit(tbp_client *c, tbp_packet *p);
+uint8_t tbp_client_wait(tbp_client *c, tbp_packet *p, uint32_t timeout_ms);
+void tbp_client_packet_free(tbp_packet *p);
+void tbp_client_deinit(tbp_client *c);
+}
+
+namespace tb {
+
+// ------------------------------------------------------------ data model
+// (tigerbeetle_tpu/types.py; reference: src/tigerbeetle.zig:10-148)
+
+struct u128 {
+  uint64_t lo = 0, hi = 0;  // little-endian in memory: lo first
+  u128() = default;
+  u128(uint64_t v) : lo(v), hi(0) {}
+  bool operator==(const u128 &o) const { return lo == o.lo && hi == o.hi; }
+};
+
+#pragma pack(push, 1)
+struct Account {
+  u128 id;
+  u128 debits_pending;
+  u128 debits_posted;
+  u128 credits_pending;
+  u128 credits_posted;
+  u128 user_data_128;
+  uint64_t user_data_64 = 0;
+  uint32_t user_data_32 = 0;
+  uint32_t reserved = 0;
+  uint32_t ledger = 0;
+  uint16_t code = 0;
+  uint16_t flags = 0;
+  uint64_t timestamp = 0;
+};
+struct Transfer {
+  u128 id;
+  u128 debit_account_id;
+  u128 credit_account_id;
+  u128 amount;
+  u128 pending_id;
+  u128 user_data_128;
+  uint64_t user_data_64 = 0;
+  uint32_t user_data_32 = 0;
+  uint32_t timeout = 0;
+  uint32_t ledger = 0;
+  uint16_t code = 0;
+  uint16_t flags = 0;
+  uint64_t timestamp = 0;
+};
+struct CreateResult {  // reference: src/tigerbeetle.zig:471-493
+  uint64_t timestamp = 0;
+  uint32_t status = 0;
+  uint32_t reserved = 0;
+};
+#pragma pack(pop)
+static_assert(sizeof(Account) == 128, "wire layout");
+static_assert(sizeof(Transfer) == 128, "wire layout");
+static_assert(sizeof(CreateResult) == 16, "wire layout");
+
+// Status codes (tigerbeetle_tpu/types.py).
+constexpr uint32_t kCreated = 0xFFFFFFFFu;
+constexpr uint32_t kAccountExists = 21;   // idempotent re-create
+constexpr uint32_t kTransferExists = 46;  // idempotent re-create
+
+// Operations (tigerbeetle_tpu/types.py Operation; offsets from
+// vsr_operations_reserved = 128).
+enum class Operation : uint16_t {
+  lookup_accounts = 128 + 12,
+  lookup_transfers = 128 + 13,
+  get_account_transfers = 128 + 14,
+  get_account_balances = 128 + 15,
+  query_accounts = 128 + 16,
+  query_transfers = 128 + 17,
+  create_accounts = 128 + 18,
+  create_transfers = 128 + 19,
+};
+
+// -------------------------------------------------------- multi-batch
+// (vsr/multi_batch.py: payload then a u16 trailer, padded to the
+// element size, written backwards: [..counts..][batch_count])
+
+inline std::vector<uint8_t> multi_batch_encode(
+    const std::vector<uint8_t> &payload, size_t element_size) {
+  if (element_size == 0 || payload.size() % element_size != 0)
+    throw std::invalid_argument("payload not element-aligned");
+  size_t raw = 2 * 2;  // one batch count + postamble
+  size_t tsize = (raw + element_size - 1) / element_size * element_size;
+  std::vector<uint8_t> out = payload;
+  size_t base = out.size();
+  out.resize(base + tsize, 0xFF);
+  uint16_t count = static_cast<uint16_t>(payload.size() / element_size);
+  uint16_t batches = 1;
+  std::memcpy(&out[base + tsize - 2], &batches, 2);
+  std::memcpy(&out[base + tsize - 4], &count, 2);
+  return out;
+}
+
+inline std::vector<uint8_t> multi_batch_decode_one(
+    const std::vector<uint8_t> &body, size_t element_size) {
+  if (body.size() < 2) throw std::runtime_error("short multi-batch body");
+  uint16_t batches;
+  std::memcpy(&batches, &body[body.size() - 2], 2);
+  if (batches != 1) throw std::runtime_error("expected one batch");
+  size_t raw = (static_cast<size_t>(batches) + 1) * 2;
+  size_t tsize = (raw + element_size - 1) / element_size * element_size;
+  uint16_t count;
+  std::memcpy(&count, &body[body.size() - 4], 2);
+  size_t payload = static_cast<size_t>(count) * element_size;
+  if (payload + tsize != body.size())
+    throw std::runtime_error("trailer/count mismatch");
+  return std::vector<uint8_t>(body.begin(), body.begin() + payload);
+}
+
+// ---------------------------------------------------------------- client
+
+class Client {
+ public:
+  // addresses: "host:port,host:port,..." (empty + echo=true for the
+  // echo harness — reference: tb_client init_echo).
+  Client(uint64_t cluster, const std::string &addresses, bool echo = false,
+         uint32_t timeout_ms = 60000)
+      : timeout_ms_(timeout_ms) {
+    uint8_t id[16];
+    std::random_device rd;  // unique per process (rand() would collide)
+    for (int i = 0; i < 16; i++)
+      id[i] = static_cast<uint8_t>(rd() & 0xFF);
+    id[0] |= 1;  // non-zero client id
+    int rc = echo ? tbp_client_init_echo(&client_, cluster, id, nullptr,
+                                         nullptr)
+                  : tbp_client_init(&client_, cluster, id,
+                                    addresses.c_str(), nullptr, nullptr);
+    if (rc != 0)
+      throw std::runtime_error("tbp_client_init failed rc=" +
+                               std::to_string(rc));
+  }
+  ~Client() {
+    if (client_) tbp_client_deinit(client_);
+  }
+  Client(const Client &) = delete;
+  Client &operator=(const Client &) = delete;
+
+  std::vector<uint8_t> request(Operation op,
+                               const std::vector<uint8_t> &body) {
+    // Heap-allocate the packet + body: the IO thread still owns them
+    // after a timeout, so they must outlive this frame (abandoned, not
+    // freed — the thread will write the completion into them later;
+    // tbp_client_deinit drains the queue at teardown).
+    auto *owned_body = new std::vector<uint8_t>(body);
+    auto *p = new tbp_packet();
+    std::memset(p, 0, sizeof(*p));
+    p->operation = static_cast<uint16_t>(op);
+    p->data = owned_body->data();
+    p->data_size = static_cast<uint32_t>(owned_body->size());
+    tbp_client_submit(client_, p);
+    uint8_t status = tbp_client_wait(client_, p, timeout_ms_);
+    if (status == TBP_PACKET_PENDING) {
+      // Intentionally leak p + owned_body: still referenced by the IO
+      // thread. A timed-out client should be torn down by the caller.
+      throw std::runtime_error("request timed out");
+    }
+    std::vector<uint8_t> reply;
+    if (status == TBP_PACKET_OK)
+      reply.assign(p->reply, p->reply + p->reply_size);
+    tbp_client_packet_free(p);
+    delete p;
+    delete owned_body;
+    if (status != TBP_PACKET_OK)
+      throw std::runtime_error("request failed status=" +
+                               std::to_string(status));
+    return reply;
+  }
+
+  std::vector<CreateResult> create_accounts(
+      const std::vector<Account> &accounts) {
+    return create_(Operation::create_accounts,
+                   reinterpret_cast<const uint8_t *>(accounts.data()),
+                   accounts.size());
+  }
+  std::vector<CreateResult> create_transfers(
+      const std::vector<Transfer> &transfers) {
+    return create_(Operation::create_transfers,
+                   reinterpret_cast<const uint8_t *>(transfers.data()),
+                   transfers.size());
+  }
+  std::vector<Account> lookup_accounts(const std::vector<u128> &ids) {
+    return lookup_<Account>(Operation::lookup_accounts, ids);
+  }
+  std::vector<Transfer> lookup_transfers(const std::vector<u128> &ids) {
+    return lookup_<Transfer>(Operation::lookup_transfers, ids);
+  }
+
+ private:
+  std::vector<CreateResult> create_(Operation op, const uint8_t *data,
+                                    size_t n) {
+    std::vector<uint8_t> payload(data, data + n * 128);
+    auto reply = request(op, multi_batch_encode(payload, 128));
+    auto results_raw = multi_batch_decode_one(reply, 16);
+    std::vector<CreateResult> out(results_raw.size() / 16);
+    std::memcpy(out.data(), results_raw.data(), results_raw.size());
+    return out;
+  }
+  template <typename T>
+  std::vector<T> lookup_(Operation op, const std::vector<u128> &ids) {
+    std::vector<uint8_t> payload(ids.size() * 16);
+    std::memcpy(payload.data(), ids.data(), payload.size());
+    auto reply = request(op, multi_batch_encode(payload, 16));
+    auto raw = multi_batch_decode_one(reply, sizeof(T));
+    std::vector<T> out(raw.size() / sizeof(T));
+    std::memcpy(out.data(), raw.data(), raw.size());
+    return out;
+  }
+
+  tbp_client *client_ = nullptr;
+  uint32_t timeout_ms_;
+};
+
+}  // namespace tb
