@@ -1,0 +1,153 @@
+"""Memory-bounds doctrine (VERDICT r1 #9; docs/ARCHITECTURE.md:189-230).
+
+Serving memory must not grow with history: the host account_events tail
+prunes at every checkpoint (history lives in the forest's events tree),
+the device event ring recycles per batch in serving mode, the object
+caches are bounded by construction, and the session table / bus send
+buffers carry hard caps. The soak drives enough commits that unbounded
+structures would visibly grow, then asserts they didn't — with replica
+convergence intact (pruning is deterministic) and history still
+queryable from the LSM.
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import (
+    Account,
+    ChangeEventsFilter,
+    Operation,
+    Transfer,
+)
+
+
+def _accounts_body(ids):
+    payload = b"".join(Account(id=i, ledger=1, code=1).pack() for i in ids)
+    return multi_batch.encode([payload], 128)
+
+
+def _transfers_body(specs):
+    payload = b"".join(
+        Transfer(id=i, debit_account_id=dr, credit_account_id=cr,
+                 amount=amt, ledger=1, code=1).pack()
+        for (i, dr, cr, amt) in specs)
+    return multi_batch.encode([payload], 128)
+
+
+class TestEventPruningSoak:
+    def test_cluster_events_stay_bounded_and_converged(self):
+        cluster = Cluster(seed=31, replica_count=3)
+        client = cluster.client(700)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        ok = cluster.run(4000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        per_batch = 40
+        n_batches = 40  # >> checkpoint_interval (16): several prunes
+        nid = 10**6
+        for b in range(n_batches):
+            specs = [(nid + i, 1 + (i % 2), 2 - (i % 2), 1 + i)
+                     for i in range(per_batch)]
+            nid += per_batch
+            client.request(Operation.create_transfers,
+                           _transfers_body(specs))
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+        cluster.settle()
+        interval = cluster.replicas[0].options.checkpoint_interval
+        # The host tail holds at most the post-checkpoint window (+ the
+        # current bar), NOT the whole history.
+        bound = (interval + 2) * per_batch
+        total = n_batches * per_batch
+        for r in cluster.replicas:
+            st = r.state_machine.state
+            assert len(st.account_events) <= bound, len(st.account_events)
+            assert st.events_base + len(st.account_events) >= total
+            # History is still fully queryable (forest-served).
+            got = r.state_machine.get_change_events(
+                ChangeEventsFilter(limit=5))
+            assert len(got) == 5  # the OLDEST events — long since pruned
+        # Deterministic pruning: replicas still byte-identical.
+        cluster.check_convergence()
+
+    def test_restarted_replica_matches_pruned_peers(self):
+        cluster = Cluster(seed=32, replica_count=3)
+        client = cluster.client(701)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        ok = cluster.run(4000, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        nid = 10**6
+        for b in range(25):
+            specs = [(nid + i, 1, 2, 1) for i in range(20)]
+            nid += 20
+            client.request(Operation.create_transfers,
+                           _transfers_body(specs))
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+        cluster.settle()
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.crash(victim)
+        cluster.restart(victim)
+        cluster.settle()
+        cluster.check_convergence()
+
+
+class TestDeviceServingBounds:
+    def test_ring_recycles_and_mirror_prunes(self):
+        from tigerbeetle_tpu.vsr.durable import DurableState
+        from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+        durable = DurableState(MemoryStorage(TEST_LAYOUT))
+        sm = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 14)
+        sm.attach_durable(durable)
+        assert sm.led.recycle_events
+        sm.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 11)], 20)
+        rng = np.random.default_rng(33)
+        ts, nid = 10**9, 10**6
+        for b in range(30):
+            evs = [Transfer(id=nid + i,
+                            debit_account_id=1 + int(rng.integers(0, 10)),
+                            credit_account_id=1 + int(rng.integers(0, 10)),
+                            amount=1 + int(rng.integers(0, 50)),
+                            ledger=1, code=1)
+                   for i in range(100)]
+            for e in evs:
+                if e.debit_account_id == e.credit_account_id:
+                    e.credit_account_id = e.debit_account_id % 10 + 1
+            nid += 100
+            ts += 150
+            sm.create_transfers(evs, ts)
+            flushed = durable.flush(sm.state)
+            sm.cache_upsert(*flushed)
+            # The replica prunes at checkpoints; emulate every 4 batches.
+            if b % 4 == 3:
+                sm.state.prune_account_events(durable.events_persisted)
+        assert sm.led.fallbacks == 0
+        # The device ring rewound after every consumed batch.
+        assert int(np.asarray(sm.led.state["events"]["count"])) == 0
+        assert sm.led._events_pushed == 0
+        # The mirror tail holds only the un-pruned window.
+        assert len(sm.state.account_events) <= 4 * 100
+        assert sm.state.events_base + len(sm.state.account_events) == 3000
+        # Caches bounded; serving still correct from the forest.
+        assert len(sm._acct_cache) <= sm._acct_cache.capacity
+        got = sm.get_change_events(ChangeEventsFilter(limit=3))
+        assert len(got) == 3
+        # Hard batches (mirror path) still work after recycling.
+        from tigerbeetle_tpu.types import TransferFlags
+
+        hard = [
+            Transfer(id=nid, debit_account_id=1, credit_account_id=2,
+                     amount=5, ledger=1, code=1,
+                     flags=int(TransferFlags.pending), timeout=1),
+            Transfer(id=nid + 1, pending_id=nid, amount=0,
+                     flags=int(TransferFlags.void_pending_transfer)),
+        ]
+        ts += 10
+        res = sm.create_transfers(hard, ts)
+        assert [r.status.name for r in res] == ["created", "created"]
+        assert sm.led.fallbacks == 1
+        assert int(np.asarray(sm.led.state["events"]["count"])) == 0

@@ -246,7 +246,15 @@ class DeviceLedger:
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.state = init_state(a_cap, t_cap)
-        self._events_pushed = 0  # mirror-regime ring watermark
+        self._events_pushed = 0  # device event-ring cursor
+        # Absolute count of mirror events already materialized on device
+        # (diverges from the ring cursor when the ring recycles or the
+        # mirror prunes its flushed prefix).
+        self._events_seen_abs = 0
+        # Replica serving mode (set via StateMachine.attach_durable):
+        # consumed event-ring rows are recycled after every batch — the
+        # ring is delta-transport, not history (the forest keeps history).
+        self.recycle_events = False
         self.fallbacks = 0
         self.fast_batches = 0
         # Host-mirror fallback regime (see _fallback_transfers): a live
@@ -452,8 +460,16 @@ class DeviceLedger:
         sm.transfers_key_max = int(self.state["xfer_key_max"]) or None
         sm.pulse_next_timestamp = int(self.state["pulse_next"])
         sm.commit_timestamp = int(self.state["commit_ts"])
-        sm.account_events = self._events_to_host(acc, xfr)
-        self._events_pushed = len(sm.account_events)
+        if self._wt and self.recycle_events:
+            # The ring is recycled per batch in serving mode: the
+            # write-through mirror (kept exact batch-for-batch) is the
+            # authoritative host copy of the unpruned tail.
+            sm.account_events = list(self.mirror.account_events)
+            sm.events_base = self.mirror.events_base
+        else:
+            sm.account_events = self._events_to_host(acc, xfr)
+            self._events_pushed = len(sm.account_events)
+            self._events_seen_abs = sm.events_base + len(sm.account_events)
         return sm
 
     def _events_to_host(self, acc, xfr) -> list:
@@ -603,6 +619,7 @@ class DeviceLedger:
         st["events"] = {k: (jnp.asarray(v) if hasattr(v, "shape")
                             else jnp.int32(v)) for k, v in evr.items()}
         self._events_pushed = n_e
+        self._events_seen_abs = sm.events_base + n_e
         # Everything is now device-resident: drop any push-pending marks
         # the host state carried in (e.g. from a durable-restore rebuild).
         for c in (sm.accounts, sm.transfers, sm.pending_status,
@@ -714,6 +731,21 @@ class DeviceLedger:
                   sm.expiry, sm.orphaned):
             c.track_dev = True
             c.dirty_dev.clear()
+
+    def _maybe_recycle_ring(self) -> None:
+        """Serving mode: every ring row has been consumed (delta-applied
+        to the mirror or sourced from it), so rewind the cursor — the
+        ring stays a bounded per-batch transport and the e8 capacity
+        fallback can never trip from accumulated history (memory-bounds
+        doctrine; the forest's events tree holds the history)."""
+        if not (self._wt and self.recycle_events):
+            return
+        if self._events_pushed == 0:
+            return
+        import jax.numpy as jnp
+
+        self.state["events"]["count"] = jnp.int32(0)
+        self._events_pushed = 0
 
     def _clear_dirty_dev(self) -> None:
         """Everything the fast delta just applied to the mirror came FROM
@@ -868,7 +900,9 @@ class DeviceLedger:
                 amount_requested=areq, amount=amount))
             sm.commit_timestamp = ts
         self._events_pushed += n_new
+        self._events_seen_abs += n_new
         self._clear_dirty_dev()
+        self._maybe_recycle_ring()
 
     def _apply_fast_delta_accounts(self, st_np) -> None:
         """Write-through: apply one fast account batch to the host mirror
@@ -1147,7 +1181,8 @@ class DeviceLedger:
             assert bool(ok), "orphan hash overflow: raise capacities"
 
         # ---- account_events: append the mirror's new history rows
-        new_events = sm.account_events[self._events_pushed:]
+        new_events = sm.account_events[self._events_seen_abs
+                                       - sm.events_base:]
         if new_events:
             evr = st["events"]
             e_cap = evr["ts"].shape[0] - 1
@@ -1163,6 +1198,8 @@ class DeviceLedger:
                 {k: jnp.asarray(pad(v, 0)) for k, v in cols.items()})
             st["events"]["count"] = count
             self._events_pushed += len(new_events)
+        self._events_seen_abs += len(new_events)
+        self._maybe_recycle_ring()
 
         # ---- scalars
         st["acct_key_max"] = np.uint64(sm.accounts_key_max or 0)

@@ -162,10 +162,28 @@ class StateMachineOracle:
         self.account_by_timestamp: dict[int, int] = {}
         self.transfer_by_timestamp: dict[int, int] = {}
         self.account_events: list[AccountEventRecord] = []
+        # Absolute index of account_events[0]: the prefix below it has
+        # been pruned after durable flush (the forest's events tree is
+        # the full history; the host list is only the unflushed tail +
+        # the post-checkpoint window). Pruning happens at deterministic
+        # (checkpoint) points so replicas stay byte-identical.
+        self.events_base: int = 0
         self.commit_timestamp: int = 0
         # reference: src/state_machine.zig:4915-4920.
         self.pulse_next_timestamp: int = TIMESTAMP_MIN
         self._scope: Optional[_Scope] = None
+
+    def prune_account_events(self, up_to_abs: int) -> None:
+        """Drop flushed history below the absolute index `up_to_abs`
+        (memory-bounds doctrine, docs/ARCHITECTURE.md:189-230: the host
+        tail stays bounded by the checkpoint window; history reads come
+        from the LSM events tree)."""
+        keep_from = up_to_abs - self.events_base
+        if keep_from <= 0:
+            return
+        assert keep_from <= len(self.account_events)
+        del self.account_events[:keep_from]
+        self.events_base = up_to_abs
 
     # ------------------------------------------------------------------ scopes
 

@@ -169,6 +169,11 @@ class StateMachine:
         self._fq = ForestQuery(durable.forest)
         self._acct_cache = ObjectCache(sets=cache_sets, ways=ways)
         self._xfer_cache = ObjectCache(sets=cache_sets, ways=ways)
+        if self.led is not None:
+            # Serving mode: the device event ring becomes per-batch
+            # transport (recycled after consumption) — history lives in
+            # the forest, so ring capacity can never wedge the fast path.
+            self.led.recycle_events = True
 
     def cache_upsert(self, acct_ids, xfer_ids) -> None:
         """Write-through after a durable flush: refresh cached copies of

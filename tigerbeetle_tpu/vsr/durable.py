@@ -316,9 +316,10 @@ class DurableState:
         for oid in sorted(orph.dirty):
             trees["orphaned"].put(_k16(oid), b"\x01")
         orph.dirty.clear()
-        for rec in state.account_events[self.events_persisted:]:
+        for rec in state.account_events[self.events_persisted
+                                        - state.events_base:]:
             trees["events"].put(_k8(rec.timestamp), _pack_event(rec))
-        self.events_persisted = len(state.account_events)
+        self.events_persisted = state.events_base + len(state.account_events)
         return flushed_accounts, flushed_transfers
 
     def compact_beat(self, op: int) -> None:
@@ -340,9 +341,15 @@ class DurableState:
 
     # ------------------------------------------------------------- recover
 
-    def open(self, root: Optional[bytes]) -> StateMachineOracle:
+    def open(self, root: Optional[bytes],
+             load_events: bool = True) -> StateMachineOracle:
         """Restore the forest from a checkpoint root and rebuild the
-        in-memory state (object dicts + derived timestamp indexes)."""
+        in-memory state (object dicts + derived timestamp indexes).
+
+        load_events=False (the replica serving path) leaves the event
+        history in the forest's events tree and starts the host list at
+        events_base = the persisted count — bounded memory regardless of
+        history size (history queries are forest-served)."""
         state = StateMachineOracle()
         if root is not None:
             meta = root[-_META_SIZE:]
@@ -367,17 +374,21 @@ class DurableState:
                     struct.unpack("<Q", v)[0]
             for k, _ in trees["orphaned"].scan(lo16, hi16):
                 state.orphaned.add(int.from_bytes(k, "big"))
-            for _, v in trees["events"].scan(lo8, hi8):
-                state.account_events.append(_unpack_event(v))
+            if load_events:
+                for _, v in trees["events"].scan(lo8, hi8):
+                    state.account_events.append(_unpack_event(v))
             akm, tkm, pulse, commit_ts, events_len = struct.unpack("<QQQQQ", meta)
             state.accounts_key_max = akm or None
             state.transfers_key_max = tkm or None
             state.pulse_next_timestamp = pulse
             state.commit_timestamp = commit_ts
-            assert events_len == len(state.account_events)
+            if load_events:
+                assert events_len == len(state.account_events)
+            else:
+                state.events_base = events_len
         # Everything just loaded is already durable.
         for container in (state.accounts, state.transfers,
                           state.pending_status, state.expiry, state.orphaned):
             container.dirty.clear()
-        self.events_persisted = len(state.account_events)
+        self.events_persisted = state.events_base + len(state.account_events)
         return state

@@ -234,7 +234,8 @@ class Replica:
         forest_root, sessions_blob = _split_root(root)
         self.sessions.restore(sessions_blob)
         self.state_machine = self.state_machine_factory()
-        self.state_machine.state = self.durable.open(forest_root)
+        self.state_machine.state = self.durable.open(forest_root,
+                                                     load_events=False)
         self.state_machine.attach_durable(self.durable)
 
         self.journal.recover()
@@ -679,6 +680,12 @@ class Replica:
         sb.checkpoint_id = checksum(
             sb.checkpoint_id.to_bytes(16, "little") + root[:64], domain=b"ckpt")
         sb.store(self.storage)
+        # Memory-bounds doctrine: everything below the checkpoint is
+        # durable in the forest's events tree — prune the host tail at
+        # this DETERMINISTIC point (same op on every replica, so states
+        # stay byte-identical; restart restores the same base).
+        self.state_machine.state.prune_account_events(
+            self.durable.events_persisted)
 
     # ---------------------------------------------------------- view change
 
@@ -1198,7 +1205,7 @@ class Replica:
         self.storage.write(
             "snapshot", slot * self.storage.layout.snapshot_size_max, root)
         durable = DurableState(self.storage)
-        state = durable.open(forest_root)
+        state = durable.open(forest_root, load_events=False)
         self.sessions.restore(sessions_blob)
         self.durable = durable
         self.scrubber = GridScrubber(self.durable.forest)

@@ -53,6 +53,7 @@ def encode(state: StateMachineOracle) -> bytes:
         state.pulse_next_timestamp, state.commit_timestamp))
 
     events = state.account_events
+    out.append(struct.pack("<Q", state.events_base))
     out.append(struct.pack("<Q", len(events)))
     for rec in events:
         has_p = rec.transfer_pending is not None
@@ -104,6 +105,7 @@ def decode(raw: bytes) -> StateMachineOracle:
     state.transfers_key_max = tkm or None
     state.pulse_next_timestamp = pulse
     state.commit_timestamp = commit_ts
+    state.events_base = count()
     for _ in range(count()):
         ts, tflags, pstat, has_p = struct.unpack("<QHB?", take(12))
         dr = Account.unpack(take(128))
