@@ -185,3 +185,213 @@ int tbs_wal_append(int fd, uint64_t hdr_zone_off, uint64_t prep_zone_off,
 }
 
 }  // extern "C"
+
+// ===================================================== async IO engine
+//
+// The submission/completion engine under the event loop (reference:
+// src/io/linux.zig io_uring submission — same contract, thread-pool
+// backed here: submit read/write, poll completions, drain as the
+// checkpoint barrier). Lock-based MPSC queues; worker threads execute
+// pread/pwrite against the data file.
+
+#include <pthread.h>
+
+#include <deque>
+#include <map>
+#include <vector>
+
+extern "C" {
+
+struct tbio_op {
+  uint64_t id;
+  int is_write;
+  uint64_t off;
+  std::vector<uint8_t> buf;  // write payload, or read destination
+  int64_t result;
+};
+
+struct tbio {
+  int fd;
+  pthread_mutex_t mu;
+  pthread_cond_t cv_submit;   // workers wait for submissions
+  pthread_cond_t cv_complete; // drain/fetch wait for completions
+  std::deque<tbio_op *> submitted;
+  std::map<uint64_t, tbio_op *> completed;  // READ completions only
+  std::map<uint64_t, int> live;             // read ids not yet fetched
+  uint64_t next_id;
+  uint64_t inflight;
+  bool failed;  // STICKY: any write ever failed (checked by every drain)
+  bool shutdown;
+  std::vector<pthread_t> workers;
+};
+
+}  // extern "C"
+
+namespace {
+
+void *tbio_worker(void *arg) {
+  tbio *e = static_cast<tbio *>(arg);
+  pthread_mutex_lock(&e->mu);
+  for (;;) {
+    while (e->submitted.empty() && !e->shutdown)
+      pthread_cond_wait(&e->cv_submit, &e->mu);
+    if (e->shutdown && e->submitted.empty()) break;
+    tbio_op *op = e->submitted.front();
+    e->submitted.pop_front();
+    pthread_mutex_unlock(&e->mu);
+    if (op->is_write)
+      op->result = tbs_write(e->fd, op->off, op->buf.data(), op->buf.size());
+    else
+      op->result = tbs_read(e->fd, op->off, op->buf.data(), op->buf.size());
+    pthread_mutex_lock(&e->mu);
+    if (op->is_write) {
+      // Writes auto-reap at completion: the payload is freed immediately
+      // (no RAM held across a checkpoint interval) and a failure latches
+      // the STICKY flag so every later drain/sync reports it — a lost
+      // LSM block write can never be silently consumed.
+      if (op->result < 0) e->failed = true;
+      delete op;
+    } else {
+      e->completed[op->id] = op;
+    }
+    e->inflight--;
+    pthread_cond_broadcast(&e->cv_complete);
+  }
+  pthread_mutex_unlock(&e->mu);
+  return nullptr;
+}
+
+}  // namespace
+
+extern "C" {
+
+tbio *tbio_create(int fd, int workers) {
+  if (workers < 1 || workers > 64) return nullptr;
+  tbio *e = new tbio();
+  e->fd = fd;
+  e->next_id = 1;
+  e->inflight = 0;
+  e->failed = false;
+  e->shutdown = false;
+  pthread_mutex_init(&e->mu, nullptr);
+  pthread_cond_init(&e->cv_submit, nullptr);
+  pthread_cond_init(&e->cv_complete, nullptr);
+  for (int i = 0; i < workers; i++) {
+    pthread_t t;
+    if (pthread_create(&t, nullptr, tbio_worker, e) != 0) {
+      e->shutdown = true;
+      pthread_cond_broadcast(&e->cv_submit);
+      for (pthread_t w : e->workers) pthread_join(w, nullptr);
+      delete e;
+      return nullptr;
+    }
+    e->workers.push_back(t);
+  }
+  return e;
+}
+
+long tbio_submit_write(tbio *e, uint64_t off, const uint8_t *data,
+                       uint64_t len) {
+  tbio_op *op = new tbio_op();
+  op->is_write = 1;
+  op->off = off;
+  op->buf.assign(data, data + len);
+  pthread_mutex_lock(&e->mu);
+  op->id = e->next_id++;
+  e->inflight++;
+  e->submitted.push_back(op);
+  pthread_cond_signal(&e->cv_submit);
+  long id = static_cast<long>(op->id);
+  pthread_mutex_unlock(&e->mu);
+  return id;
+}
+
+long tbio_submit_read(tbio *e, uint64_t off, uint64_t len) {
+  tbio_op *op = new tbio_op();
+  op->is_write = 0;
+  op->off = off;
+  op->buf.resize(len);
+  pthread_mutex_lock(&e->mu);
+  op->id = e->next_id++;
+  e->inflight++;
+  e->live[op->id] = 1;
+  e->submitted.push_back(op);
+  pthread_cond_signal(&e->cv_submit);
+  long id = static_cast<long>(op->id);
+  pthread_mutex_unlock(&e->mu);
+  return id;
+}
+
+// Nonblocking: copy up to `max` completed ids out; the entries stay
+// until fetched (reads) or reaped (writes) via tbio_fetch.
+long tbio_poll(tbio *e, uint64_t *ids, long max) {
+  pthread_mutex_lock(&e->mu);
+  long n = 0;
+  for (auto &kv : e->completed) {
+    if (n >= max) break;
+    ids[n++] = kv.first;
+  }
+  pthread_mutex_unlock(&e->mu);
+  return n;
+}
+
+// Blocking fetch of one READ completion: waits for `id`, copies read
+// data into buf (len bytes max), frees the entry. Returns the op's io
+// result (bytes transferred) or -2 if the id is unknown, already
+// fetched, or was a write (writes auto-reap; never wait on them).
+long tbio_fetch(tbio *e, uint64_t id, uint8_t *buf, uint64_t len) {
+  pthread_mutex_lock(&e->mu);
+  std::map<uint64_t, tbio_op *>::iterator it;
+  for (;;) {
+    it = e->completed.find(id);
+    if (it != e->completed.end()) break;
+    if (e->live.find(id) == e->live.end()) {
+      pthread_mutex_unlock(&e->mu);
+      return -2;
+    }
+    pthread_cond_wait(&e->cv_complete, &e->mu);
+  }
+  tbio_op *op = it->second;
+  e->completed.erase(it);
+  e->live.erase(id);
+  pthread_mutex_unlock(&e->mu);
+  long result = static_cast<long>(op->result);
+  if (!op->is_write && buf != nullptr && result > 0) {
+    uint64_t n = static_cast<uint64_t>(result) < len
+                     ? static_cast<uint64_t>(result)
+                     : len;
+    memcpy(buf, op->buf.data(), n);
+  }
+  delete op;
+  return result;
+}
+
+// Barrier: every submitted op is complete, optionally followed by
+// fsync — the checkpoint durability point. A write failure is STICKY:
+// once any async write has failed, every subsequent drain reports it
+// (the caller must treat the storage as compromised).
+int tbio_drain(tbio *e, int do_sync) {
+  pthread_mutex_lock(&e->mu);
+  while (e->inflight > 0) pthread_cond_wait(&e->cv_complete, &e->mu);
+  int failed = e->failed ? 1 : 0;
+  pthread_mutex_unlock(&e->mu);
+  if (failed) return -1;
+  if (do_sync) return tbs_sync(e->fd);
+  return 0;
+}
+
+void tbio_destroy(tbio *e) {
+  pthread_mutex_lock(&e->mu);
+  e->shutdown = true;
+  pthread_cond_broadcast(&e->cv_submit);
+  pthread_mutex_unlock(&e->mu);
+  for (pthread_t w : e->workers) pthread_join(w, nullptr);
+  for (tbio_op *op : e->submitted) delete op;
+  for (auto &kv : e->completed) delete kv.second;
+  pthread_mutex_destroy(&e->mu);
+  pthread_cond_destroy(&e->cv_submit);
+  pthread_cond_destroy(&e->cv_complete);
+  delete e;
+}
+
+}  // extern "C"

@@ -1,0 +1,151 @@
+"""Scripted consensus scenarios (reference: src/vsr/replica_test.zig —
+exact fault sequences that randomized simulation rarely hits;
+docs/internals/vopr.md:44-46). Message-level tests drive a single
+sans-io replica; the NACK-specific scenarios live in tests/test_nack.py.
+"""
+
+from tests.test_nack import (
+    CLUSTER,
+    _dvc,
+    _mk_replica,
+    _prepare_msg,
+    _svc,
+)
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+
+def _chain(n, start_op=1, parent=0, view=0):
+    msgs = []
+    for op in range(start_op, start_op + n):
+        m = _prepare_msg(op, view=view, parent=parent)
+        parent = m.header.checksum
+        msgs.append(m)
+    return msgs
+
+
+class TestViewChangeScenarios:
+    def test_view_change_with_gap_repairs_before_start(self):
+        """The new primary's journal has a hole inside the chosen suffix:
+        it must repair the body from a peer BEFORE broadcasting
+        start_view (a suffix with holes would strand backups)."""
+        r, bus, _ = _mk_replica(2)
+        msgs = _chain(5)
+        for m in msgs:
+            if m.header.op != 3:  # the hole
+                r.journal.append(m)
+        r.op = 5
+        r.commit_min = r.commit_max = 2
+        for peer in (3, 4, 5):
+            r.on_message(_svc(peer, 2))
+        headers = [m.header for m in msgs]
+        r.on_message(_dvc(3, 2, 5, 2, 0, headers))
+        r.on_message(_dvc(4, 2, 5, 2, 0, headers))
+        r.on_message(_dvc(5, 2, 5, 2, 0, headers))
+        # Pending: op 3's body is missing; no start_view yet.
+        assert r._pending_view == 2
+        assert not bus.of(Command.start_view)
+        # Requests go out on the repair tick; a peer serves the body.
+        # (Small advances: a long gap would escalate the view-change
+        # timer to the next view.)
+        r.time.advance(60 * 10**6)
+        r.tick()
+        assert any(m.header.op == 3
+                   for _, m in bus.of(Command.request_prepare))
+        r.on_message(msgs[2])  # the prepare for op 3 arrives
+        r.time.advance(60 * 10**6)
+        r.tick()  # repair completion check finalizes the view
+        assert r._pending_view is None and r.status == "normal"
+        assert bus.of(Command.start_view)
+        assert r.journal.read_prepare(3) is not None
+
+    def test_duplicate_and_stale_prepares_are_idempotent(self):
+        """Replayed/duplicated prepares must not corrupt the journal or
+        double-ack (the bus contract allows duplication)."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        r.view = 0
+        msgs = _chain(3)
+        for m in msgs:
+            r.on_message(m)
+        assert r.op == 3
+        acked_ops = {m.header.op for _, m in bus.of(Command.prepare_ok)}
+        assert acked_ops, "backup must ack prepares"
+        for m in msgs:  # replay all (the bus may duplicate)
+            r.on_message(m)
+        assert r.op == 3
+        # Re-acks are fine (idempotent at the primary); journal intact.
+        for op in (1, 2, 3):
+            held = r.journal.read_prepare(op)
+            assert held is not None
+            assert held.header.checksum == msgs[op - 1].header.checksum
+
+    def test_lower_view_messages_rejected(self):
+        """A replica that moved to view 2 ignores view-0 prepares (an
+        isolated stale primary cannot fork it)."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        msgs = _chain(2)
+        for m in msgs:
+            r.on_message(m)
+        r.view = 2
+        r.log_view = 2
+        stale = _prepare_msg(3, view=0,
+                             parent=msgs[-1].header.checksum)
+        r.on_message(stale)
+        assert r.journal.read_prepare(3) is None
+        assert r.op == 2
+
+    def test_dvc_from_two_elections_highest_log_view_wins(self):
+        """Log selection is (log_view, op)-max: a shorter suffix from a
+        NEWER log_view beats a longer stale one (VSR's core rule)."""
+        r, bus, _ = _mk_replica(2)
+        old_chain = _chain(5)
+        new_chain = _chain(3, view=1)
+        r.op = 0
+        for peer in (3, 4, 5):
+            r.on_message(_svc(peer, 2))
+        # Peer 3: long suffix but log_view 0; peer 4: short, log_view 1.
+        r.on_message(_dvc(3, 2, 5, 0, 0, [m.header for m in old_chain]))
+        r.on_message(_dvc(4, 2, 3, 0, 1, [m.header for m in new_chain]))
+        r.on_message(_dvc(5, 2, 0, 0, 0, []))
+        # The chosen log is peer 4's: ops 1..3 with view-1 checksums.
+        assert r.op == 3
+        for op in (1, 2, 3):
+            assert r.canonical[op].checksum == \
+                new_chain[op - 1].header.checksum
+        assert 4 not in r.canonical and 5 not in r.canonical
+
+    def test_backup_truncates_on_start_view(self):
+        """A backup holding uncommitted ops beyond the new canonical log
+        truncates them when the start_view arrives."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        msgs = _chain(5)
+        for m in msgs:
+            r.on_message(m)
+        assert r.op == 5
+        # New view's canonical log ends at op 3.
+        body = b"".join(m.header.pack() for m in msgs[:3])
+        sv = Header(command=Command.start_view, cluster=CLUSTER,
+                    replica=2, view=2, op=3, commit=3)
+        r.on_message(Message(sv.finalize(body), body=body))
+        assert r.view == 2 and r.op == 3
+
+    def test_request_start_view_answered_by_primary(self):
+        """A lagging replica probing with request_start_view gets the
+        current view's start_view back (standby/rejoin catch-up path)."""
+        r, bus, _ = _mk_replica(2)
+        for m in _chain(2, view=2):
+            r.journal.append(m)
+        r.op = 2
+        r.commit_min = r.commit_max = 2
+        r.status = "normal"
+        r.view = 2
+        r.log_view = 2
+        assert r.is_primary
+        probe = Header(command=Command.request_start_view, cluster=CLUSTER,
+                       replica=5, view=2)
+        r.on_message(Message(probe.finalize()))
+        svs = bus.of(Command.start_view)
+        assert svs and svs[-1][0] == 5
+        assert svs[-1][1].header.op == 2

@@ -45,8 +45,18 @@ class TestTable:
         grid = _grid()
         info = write_table(grid, [(k(1), v(1))], KEY, VAL)
         grid.device.data[info.index_address.index * grid.block_size] ^= 0xFF
+        grid.cache.clear()  # cold read (a warm cache legitimately serves
+        # the immutable copy; detection is the media-read path's job)
         with pytest.raises(IOError):
             Table(grid, info, KEY, VAL)
+        # The scrubber's bypass path detects it even through a warm cache.
+        info2 = write_table(grid, [(k(2), v(2))], KEY, VAL)
+        grid.device.data[info2.index_address.index * grid.block_size] ^= 0xFF
+        with pytest.raises(IOError):
+            grid.read_block(info2.index_address, info2.index_size,
+                            bypass_cache=True)
+        # While the serving path still reads the cached immutable copy.
+        assert grid.read_block(info2.index_address, info2.index_size)
 
 
 class TestTree:

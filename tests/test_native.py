@@ -98,3 +98,132 @@ def test_native_file_roundtrip(tmp_path):
     # beyond-EOF reads are zero-filled like the Python path
     assert fs.read("snapshot", 0, 8) == b"\x00" * 8
     fs.close()
+
+
+class TestAsyncEngine:
+    """The native submission/completion IO engine (reference: the
+    io_uring layer, src/io/linux.zig — submit, poll, drain barrier)."""
+
+    def _engine(self, tmp_path):
+        from tigerbeetle_tpu import native
+
+        f = native.NativeFile(str(tmp_path / "aio.bin"), 1 << 20, True)
+        return native.AsyncEngine(f), f
+
+    def test_writes_visible_after_drain(self, tmp_path):
+        from tigerbeetle_tpu import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        e, f = self._engine(tmp_path)
+        for i in range(32):
+            e.submit_write(i * 256, bytes([i]) * 256)
+        e.drain(sync=True)
+        for i in range(32):
+            assert f.read(i * 256, 256) == bytes([i]) * 256
+        e.close()
+
+    def test_read_completion_fetch(self, tmp_path):
+        from tigerbeetle_tpu import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        e, f = self._engine(tmp_path)
+        e.submit_write(1000, b"hello world!")
+        e.drain()
+        rid = e.submit_read(1000, 12)
+        assert e.fetch(rid, 12) == b"hello world!"
+        e.close()
+
+    def test_file_storage_async_grid_roundtrip(self, tmp_path):
+        """Grid-zone writes go through the engine; overlapping cold
+        reads drain first; sync() is the durability barrier."""
+        from tigerbeetle_tpu import native
+        from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, FileStorage
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        st = FileStorage(str(tmp_path / "data.tb"), TEST_LAYOUT, create=True)
+        if st.aio is None:
+            pytest.skip("async engine not active")
+        blocks = {off: bytes([off % 251]) * 512 for off in
+                  range(0, 8192, 512)}
+        for off, data in blocks.items():
+            st.write("grid", off, data)
+        # Reads force a drain of overlapping pending writes.
+        for off, data in blocks.items():
+            assert st.read("grid", off, 512) == data
+        st.write("grid", 0, b"\xAA" * 512)
+        st.sync()
+        assert st.read("grid", 0, 512) == b"\xAA" * 512
+        # WAL/superblock zones stay synchronous (durability-ordered).
+        st.write("superblock", 0, b"\x55" * 64)
+        assert st.read("superblock", 0, 64) == b"\x55" * 64
+        st.close()
+
+    def test_replica_on_async_file_storage(self, tmp_path):
+        """Format + restart recovery over the async-grid FileStorage."""
+        from tigerbeetle_tpu import native
+        from tigerbeetle_tpu.state_machine import StateMachine
+        from tigerbeetle_tpu.vsr.replica import Replica
+        from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, FileStorage
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        path = str(tmp_path / "r0.tb")
+        st = FileStorage(path, TEST_LAYOUT, create=True)
+        Replica.format(st, cluster=5, replica_id=0, replica_count=1)
+        st.sync()
+
+        class _NullBus:
+            def send_to_replica(self, dst, msg):
+                pass
+
+            def send_to_client(self, cid, msg):
+                pass
+
+        class _Time:
+            now = 1_700_000_000 * 10**9
+
+            def monotonic(self):
+                return self.now
+
+            def realtime(self):
+                return self.now
+
+        r = Replica(cluster=5, replica_id=0, replica_count=1, storage=st,
+                    bus=_NullBus(), time=_Time(),
+                    state_machine_factory=lambda: StateMachine(
+                        engine="oracle"))
+        r.open()
+        assert r.status == "normal"
+        st.close()
+
+    def test_sticky_write_failure_and_double_fetch(self, tmp_path):
+        """A failed async write latches: every later drain reports it;
+        fetching a consumed/unknown id errors instead of hanging."""
+        from tigerbeetle_tpu import native
+
+        if not native.available():
+            pytest.skip("native engine unavailable")
+        e, f = self._engine(tmp_path)
+        e.submit_write(0, b"ok" * 8)
+        rid = e.submit_read(0, 4)
+        e.fetch(rid, 4)
+        with pytest.raises(KeyError):
+            e.fetch(rid, 4)  # already fetched: no deadlock
+        with pytest.raises(KeyError):
+            e.fetch(999999, 4)  # never issued
+        e.drain()
+        # Write beyond any plausible file bound via a bad fd engine:
+        bad = native.AsyncEngine.__new__(native.AsyncEngine)
+        bad.lib = e.lib
+        bad.handle = e.lib.tbio_create(-1, 1)  # invalid fd: writes fail
+        assert bad.handle
+        bad.submit_write(0, b"x")
+        with pytest.raises(RuntimeError):
+            bad.drain()
+        with pytest.raises(RuntimeError):
+            bad.drain()  # sticky
+        bad.close()
+        e.close()

@@ -47,11 +47,24 @@ class Grid:
     crash recovery never sees a block overwritten mid-interval."""
 
     def __init__(self, device, block_size: int = BLOCK_SIZE_DEFAULT,
-                 block_count: int = 4096):
+                 block_count: int = 4096, cache_sets: int = 64,
+                 cache_ways: int = 8):
+        from .cache_map import ObjectCache
+
         self.device = device  # .read(off, size) / .write(off, data)
         self.block_size = block_size
         self.block_count = block_count
         self.free: list[bool] = [True] * block_count
+        # Bounded block cache (reference: the set-associative grid block
+        # cache, src/vsr/grid.zig:30). Keys are (checksum, index), so a
+        # freed-and-reused index can never serve stale bytes — blocks
+        # are immutable under copy-on-write, making entries forever valid.
+        self.cache = ObjectCache(sets=cache_sets, ways=cache_ways)
+        # Standing missing-block hook (reference: grid_blocks_missing,
+        # src/vsr/grid_blocks_missing.zig:24): the replica wires this to
+        # its repair queue so ANY corrupt read — serving path included,
+        # not just the scrubber's tour — queues a peer repair.
+        self.on_corrupt = None
         self.freed_pending: list[int] = []  # released at next checkpoint
         self.acquire_cursor = 0
 
@@ -93,12 +106,26 @@ class Grid:
         assert len(data) <= self.block_size
         index = self.acquire()
         self.device.write(index * self.block_size, data)
-        return BlockAddress(index, checksum(data, domain=b"blk"))
+        address = BlockAddress(index, checksum(data, domain=b"blk"))
+        self.cache.put((address.checksum << 64) | index, data)
+        return address
 
-    def read_block(self, address: BlockAddress, size: int) -> bytes:
+    def read_block(self, address: BlockAddress, size: int,
+                   bypass_cache: bool = False) -> bytes:
+        """bypass_cache: the scrubber's latent-fault tour must touch the
+        MEDIA, not the cache (reference: scrub reads skip the block
+        cache so cached copies can't mask sector rot)."""
+        key = (address.checksum << 64) | address.index
+        if not bypass_cache:
+            cached = self.cache.get(key)
+            if cached is not None and len(cached) == size:
+                return cached
         data = self.device.read(address.index * self.block_size, size)
         if checksum(data, domain=b"blk") != address.checksum:
+            if self.on_corrupt is not None:
+                self.on_corrupt(address, size)
             raise IOError(f"grid block {address.index} corrupt")
+        self.cache.put(key, data)
         return data
 
 

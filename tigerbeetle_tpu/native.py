@@ -54,7 +54,7 @@ def load() -> Optional[ctypes.CDLL]:
         if not os.path.exists(_SRC):
             return None
         if _stale(_LIB, _SRC, _HDR):
-            if not _build(_SRC, _LIB):
+            if not _build(_SRC, _LIB, "-pthread"):
                 return None
         try:
             lib = ctypes.CDLL(_LIB)
@@ -78,6 +78,20 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tbs_wal_append.argtypes = [
             ctypes.c_int, u64, u64, u32, u64, p, u64]
         lib.tbs_wal_append.restype = ctypes.c_int
+        vp = ctypes.c_void_p
+        lib.tbio_create.argtypes = [ctypes.c_int, ctypes.c_int]
+        lib.tbio_create.restype = vp
+        lib.tbio_submit_write.argtypes = [vp, u64, p, u64]
+        lib.tbio_submit_write.restype = ctypes.c_long
+        lib.tbio_submit_read.argtypes = [vp, u64, u64]
+        lib.tbio_submit_read.restype = ctypes.c_long
+        lib.tbio_poll.argtypes = [vp, ctypes.POINTER(u64), ctypes.c_long]
+        lib.tbio_poll.restype = ctypes.c_long
+        lib.tbio_fetch.argtypes = [vp, u64, p, u64]
+        lib.tbio_fetch.restype = ctypes.c_long
+        lib.tbio_drain.argtypes = [vp, ctypes.c_int]
+        lib.tbio_drain.restype = ctypes.c_int
+        lib.tbio_destroy.argtypes = [vp]
         _lib = lib
         return _lib
 
@@ -178,3 +192,56 @@ def load_client() -> Optional[ctypes.CDLL]:
             return None
         _client_lib = lib
         return _client_lib
+
+
+class AsyncEngine:
+    """Submission/completion IO engine over a native file descriptor
+    (native/storage_engine.cpp tbio_* — the io_uring-shaped layer,
+    reference: src/io/linux.zig). Writes copy their payload at submit;
+    drain() is the completion + durability barrier."""
+
+    def __init__(self, native_file: "NativeFile", workers: int = 4):
+        self.lib = native_file.lib
+        self.handle = self.lib.tbio_create(native_file.fd, workers)
+        if not self.handle:
+            raise OSError("tbio_create failed")
+
+    def submit_write(self, offset: int, data: bytes) -> int:
+        op = self.lib.tbio_submit_write(self.handle, offset, data, len(data))
+        assert op > 0
+        return op
+
+    def submit_read(self, offset: int, size: int) -> int:
+        op = self.lib.tbio_submit_read(self.handle, offset, size)
+        assert op > 0
+        return op
+
+    def fetch(self, op_id: int, size: int = 0) -> bytes:
+        buf = ctypes.create_string_buffer(size) if size else None
+        n = self.lib.tbio_fetch(self.handle, op_id, buf, size)
+        if n == -2:
+            raise KeyError(f"async op {op_id} unknown or already fetched")
+        if n < 0:
+            raise OSError(f"async op {op_id} failed ({n})")
+        return buf.raw[:n] if buf is not None else b""
+
+    def drain(self, sync: bool = False) -> None:
+        rc = self.lib.tbio_drain(self.handle, 1 if sync else 0)
+        if rc != 0:
+            # Distinct from IOError: block-level IOError is handled by
+            # repair paths; a failed async WRITE means durability is
+            # compromised and must propagate (the failure is sticky in
+            # the engine — every later drain re-reports it).
+            raise RuntimeError(
+                "async write failed (sticky): storage compromised")
+
+    def close(self) -> None:
+        if self.handle:
+            self.lib.tbio_destroy(self.handle)
+            self.handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

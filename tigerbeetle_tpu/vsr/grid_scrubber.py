@@ -63,7 +63,8 @@ class GridScrubber:
                 break
             self.checked += 1
             try:
-                self.forest.grid.read_block(address, size)
+                self.forest.grid.read_block(address, size,
+                                            bypass_cache=True)
             except IOError:
                 if self.still_referenced(address):
                     found.append((name, address, size))

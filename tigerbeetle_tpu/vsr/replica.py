@@ -125,6 +125,10 @@ class Replica:
         # Serve reads from the LSM with a bounded object cache
         # (state_machine.attach_durable; reference: groove object cache).
         self.state_machine.attach_durable(self.durable)
+        # Standing missing-block tracker (reference: grid_blocks_missing):
+        # a corrupt read ANYWHERE (serving path, not just the scrubber)
+        # queues the block for peer repair.
+        self.durable.grid.on_corrupt = self._note_missing_block
         self.superblock: Optional[SuperBlock] = None
         self.fault_detector = FaultDetector(suspect_multiplier=4.0)
         self.repair_budget = RepairBudget()
@@ -797,7 +801,25 @@ class Replica:
         # truncate below commit_min — committed ops are final.
         if self.op > best.header.op:
             self.op = max(best.header.op, self.commit_min)
-        best_headers = _unpack_headers(best.body)
+        # UNION-merge headers across every DVC of the winning log_view:
+        # two replicas in the same log_view hold identical prepares per op
+        # (one primary, one prepare per op), so a peer's copy can fill a
+        # hole in the chosen suffix — without this, a tie-broken DVC with
+        # a gap would drop the canonical header and the repair prepare
+        # would then be rejected as non-canonical (liveness).
+        merged: dict[int, Header] = {}
+        for m in dvcs.values():
+            if m.header.context != best.header.context:
+                continue
+            for hh in _unpack_headers(m.body):
+                if hh.op > best.header.op:
+                    continue
+                prev = merged.get(hh.op)
+                if prev is None:
+                    merged[hh.op] = hh
+                else:
+                    assert prev.checksum == hh.checksum,                         "same-log_view divergence (protocol invariant)"
+        best_headers = [merged[op] for op in sorted(merged)]
         suffix_base = (min(hh.op for hh in best_headers) if best_headers
                        else best.header.op + 1)
         if suffix_base > self.commit_min + 1:
@@ -1174,7 +1196,9 @@ class Replica:
             original = self.storage.read("grid", index * block_size, block_size)
             self.storage.write("grid", index * block_size, msg.body)
             try:
-                self.durable.grid.read_block(address, size)
+                # Validate the repaired MEDIA bytes, not a cached copy.
+                self.durable.grid.read_block(address, size,
+                                             bypass_cache=True)
             except IOError:
                 self.storage.write("grid", index * block_size, original)
                 return
@@ -1208,6 +1232,7 @@ class Replica:
         state = durable.open(forest_root, load_events=False)
         self.sessions.restore(sessions_blob)
         self.durable = durable
+        self.durable.grid.on_corrupt = self._note_missing_block
         self.scrubber = GridScrubber(self.durable.forest)
         self.block_repair.clear()
         self.state_machine = self.state_machine_factory()
@@ -1261,6 +1286,11 @@ class Replica:
         """A peer answered our request_reply (replicas otherwise never
         receive reply messages)."""
         self.sessions.repair_reply(msg.header.client, msg)
+
+    def _note_missing_block(self, address, size: int) -> None:
+        """Grid read-path corruption callback: queue the block for peer
+        repair (byte-identical grids make any peer a donor)."""
+        self.block_repair[address.index] = ("read", address, size)
 
     def _repair(self, now: int) -> None:
         if now - self.last_repair_tick < self.options.repair_interval_ns:

@@ -121,23 +121,46 @@ class FileStorage(Storage):
     read_sectors/write_sectors). Falls back to os.pread/pwrite."""
 
     def __init__(self, path: str, layout: StorageLayout = StorageLayout(),
-                 create: bool = False):
+                 create: bool = False, async_grid: bool = True):
         from .. import native as native_mod
 
         self.layout = layout
         self.path = path
         self.native = None
+        # Async grid-zone writes through the native submission engine
+        # (reference: the io_uring layer, src/io/linux.zig): LSM block
+        # writes (compaction, flush) no longer block the replica loop.
+        # Correctness: grid blocks are immutable copy-on-write and cached
+        # at write, so the only read that could race a pending write is a
+        # cold/bypass read — those drain first (`_drain_grid`); sync()
+        # drains + fsyncs (the checkpoint barrier).
+        self.aio = None
+        self._grid_pending: list[tuple[int, int]] = []  # (pos, end)
         if native_mod.available():
             self.native = native_mod.NativeFile(path, layout.size, create)
             self.fd = -1
+            if async_grid:
+                self.aio = native_mod.AsyncEngine(self.native)
             return
         flags = os.O_RDWR | (os.O_CREAT if create else 0)
         self.fd = os.open(path, flags, 0o644)
         if create:
             os.ftruncate(self.fd, layout.size)
 
+    def _drain_grid(self, pos: int = None, size: int = None) -> None:
+        if self.aio is None or not self._grid_pending:
+            return
+        if pos is not None:
+            end = pos + size
+            if not any(p < end and pos < e for p, e in self._grid_pending):
+                return
+        self.aio.drain()
+        self._grid_pending.clear()
+
     def read(self, zone: str, offset: int, size: int) -> bytes:
         pos = self._check(zone, offset, size)
+        if zone == "grid":
+            self._drain_grid(pos, size)
         if self.native is not None:
             return self.native.read(pos, size)
         data = os.pread(self.fd, size, pos)
@@ -147,18 +170,34 @@ class FileStorage(Storage):
 
     def write(self, zone: str, offset: int, data: bytes) -> None:
         pos = self._check(zone, offset, len(data))
+        if zone == "grid" and self.aio is not None:
+            self.aio.submit_write(pos, data)
+            self._grid_pending.append((pos, pos + len(data)))
+            return
         if self.native is not None:
             self.native.write(pos, data)
             return
         os.pwrite(self.fd, data, pos)
 
     def sync(self) -> None:
+        if self.aio is not None:
+            self.aio.drain(sync=True)
+            self._grid_pending.clear()
+            return
         if self.native is not None:
             self.native.sync()
             return
         os.fsync(self.fd)
 
     def close(self) -> None:
+        if self.aio is not None:
+            try:
+                self.aio.drain(sync=True)
+            finally:
+                # Even a failed final drain must release the worker
+                # threads and the fd.
+                self.aio.close()
+                self.aio = None
         if self.native is not None:
             self.native.close()
             return
