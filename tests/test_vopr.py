@@ -11,6 +11,7 @@ import random
 import pytest
 
 from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.testing.cluster import Cluster, MS, NetworkOptions
 from tigerbeetle_tpu.types import (
     Account,
@@ -32,12 +33,22 @@ def _transfers_body(specs):
     return multi_batch.encode([payload], 128)
 
 
-@pytest.mark.parametrize("seed", [101, 202, 303, 404])
-def test_vopr_swarm(seed):
+@pytest.mark.parametrize("seed,engine", [
+    (101, "kernel"), (202, "kernel"), (303, "kernel"), (404, "kernel"),
+    # The serving (device) engine under the same chaos: crashes,
+    # partitions, restarts — regime transitions + write-through mirror
+    # + NACK all under fire (round-2 soak in test form).
+    (515, "device"), (626, "device"),
+])
+def test_vopr_swarm(seed, engine):
     rng = random.Random(seed)
     replica_count = rng.choice([3, 5])
+    factory = (StateMachine if engine == "kernel"
+               else (lambda: StateMachine(engine="device", a_cap=1 << 10,
+                                          t_cap=1 << 13)))
     cluster = Cluster(
         seed=seed, replica_count=replica_count,
+        state_machine_factory=factory,
         network=NetworkOptions(
             loss_probability=rng.choice([0.0, 0.02, 0.10]),
             duplicate_probability=rng.choice([0.0, 0.05]),

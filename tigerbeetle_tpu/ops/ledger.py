@@ -40,6 +40,11 @@ from ..types import (
 # mirror the device's orphan inserts on the host.
 _TRANSIENT_CODES = frozenset(
     int(s) for s in CreateTransferStatus if s.transient())
+
+# Enum.__call__ per event is a measurable serving-path cost at 8190
+# events/batch: precomputed code->member maps instead.
+_CTS_BY_CODE = {int(m): m for m in CreateTransferStatus}
+_CAS_BY_CODE = {int(m): m for m in CreateAccountStatus}
 from . import u128
 from .hash_table import ht_init
 
@@ -311,9 +316,11 @@ class DeviceLedger:
         ts = np.asarray(out["r_ts"][:n])
         if self._wt:
             self._apply_fast_delta_accounts(st)
+        ts_l = ts.tolist()
+        st_l = st.tolist()
         return [
-            CreateAccountResult(timestamp=int(ts[i]),
-                                status=CreateAccountStatus(int(st[i])))
+            CreateAccountResult(timestamp=ts_l[i],
+                                status=_CAS_BY_CODE[st_l[i]])
             for i in range(n)
         ]
 
@@ -350,9 +357,11 @@ class DeviceLedger:
         ts = np.asarray(out["r_ts"][:n])
         if self._wt:
             self._apply_fast_delta_transfers(ev, st)
+        ts_l = ts.tolist()
+        st_l = st.tolist()
         return [
-            CreateTransferResult(timestamp=int(ts[i]),
-                                 status=CreateTransferStatus(int(st[i])))
+            CreateTransferResult(timestamp=ts_l[i],
+                                 status=_CTS_BY_CODE[st_l[i]])
             for i in range(n)
         ]
 
@@ -813,11 +822,18 @@ class DeviceLedger:
         closed = int(AccountFlags.closed)
         P = TransferPendingStatus
 
+        # Bulk-convert device columns to Python scalars ONCE (tolist is a
+        # single C call; per-element int() on numpy scalars dominates the
+        # apply loop otherwise — this is the serving path's host edge).
+        t = {k2: v.tolist() for k2, v in t.items()}
+        e = {k2: v.tolist() for k2, v in e.items()}
+        der = {k2: v.tolist() for k2, v in der.items()}
+
         def u(hi, lo, k):
-            return (int(hi[k]) << 64) | int(lo[k])
+            return (hi[k] << 64) | lo[k]
 
         for k in range(n_new):
-            ts = int(e["ts"][k])
+            ts = e["ts"][k]
             tid = u(t["id_hi"], t["id_lo"], k)
             tr = Transfer(
                 id=tid,
@@ -826,13 +842,13 @@ class DeviceLedger:
                 amount=u(t["amt_hi"], t["amt_lo"], k),
                 pending_id=u(t["pid_hi"], t["pid_lo"], k),
                 user_data_128=u(t["ud128_hi"], t["ud128_lo"], k),
-                user_data_64=int(t["ud64"][k]),
-                user_data_32=int(t["ud32"][k]),
-                timeout=int(t["timeout"][k]),
-                ledger=int(t["ledger"][k]),
-                code=int(t["code"][k]),
-                flags=int(t["flags"][k]),
-                timestamp=int(t["ts"][k]),
+                user_data_64=t["ud64"][k],
+                user_data_32=t["ud32"][k],
+                timeout=t["timeout"][k],
+                ledger=t["ledger"][k],
+                code=t["code"][k],
+                flags=t["flags"][k],
+                timestamp=t["ts"][k],
             )
             assert tr.timestamp == ts, (tr.timestamp, ts)
             sm.transfers[tid] = tr
@@ -840,10 +856,10 @@ class DeviceLedger:
             self._xfer_row[tid] = t0 + k
             if sm.transfers_key_max is None or ts > sm.transfers_key_max:
                 sm.transfers_key_max = ts
-            pstat = P(int(e["pstat"][k]))
+            pstat = P(e["pstat"][k])
             amount = u(e["amt_hi"], e["amt_lo"], k)
             areq = u(e["areq_hi"], e["areq_lo"], k)
-            tflags_raw = int(e["tflags"][k])
+            tflags_raw = e["tflags"][k]
             sides = {}
             for side, hik, lok in (("dr", "dr_id_hi", "dr_id_lo"),
                                    ("cr", "cr_id_hi", "cr_id_lo")):
@@ -858,12 +874,12 @@ class DeviceLedger:
                                       e[f"{side}_cp_lo"], k),
                     credits_posted=u(e[f"{side}_cpos_hi"],
                                      e[f"{side}_cpos_lo"], k),
-                    flags=int(e[f"{side}_flags"][k]),
+                    flags=e[f"{side}_flags"][k],
                 )
                 sides[side] = (aid, prev, new)
             p_obj = None
             if pstat in (P.posted, P.voided):
-                pts = int(der["p_ts"][k])
+                pts = der["p_ts"][k]
                 pid = sm.transfer_by_timestamp[pts]
                 p_obj = sm.transfers[pid]
                 sm.pending_status[pts] = pstat
@@ -922,23 +938,23 @@ class DeviceLedger:
         a = jax.device_get(
             _acct_delta_gather_jit(self.state, np.int32(a_start), size))
         off = a0 - a_start
-        a = {k: v[off:off + n_new] for k, v in a.items()}
+        a = {k: v[off:off + n_new].tolist() for k, v in a.items()}
         for k in range(n_new):
-            aid = (int(a["id_hi"][k]) << 64) | int(a["id_lo"][k])
+            aid = (a["id_hi"][k] << 64) | a["id_lo"][k]
             acct = Account(
                 id=aid,
                 debits_pending=_balance_int(a, "dp", k),
                 debits_posted=_balance_int(a, "dpos", k),
                 credits_pending=_balance_int(a, "cp", k),
                 credits_posted=_balance_int(a, "cpos", k),
-                user_data_128=(int(a["ud128_hi"][k]) << 64)
-                | int(a["ud128_lo"][k]),
-                user_data_64=int(a["ud64"][k]),
-                user_data_32=int(a["ud32"][k]),
-                ledger=int(a["ledger"][k]),
-                code=int(a["code"][k]),
-                flags=int(a["flags"][k]),
-                timestamp=int(a["ts"][k]),
+                user_data_128=(a["ud128_hi"][k] << 64)
+                | a["ud128_lo"][k],
+                user_data_64=a["ud64"][k],
+                user_data_32=a["ud32"][k],
+                ledger=a["ledger"][k],
+                code=a["code"][k],
+                flags=a["flags"][k],
+                timestamp=a["ts"][k],
             )
             sm.accounts[aid] = acct
             sm.account_by_timestamp[acct.timestamp] = aid
