@@ -296,6 +296,21 @@ def inner_main() -> None:
             None if not acc2 else round(8190 / (acc2 / el2) * 1000, 3)),
         "engine": "device_ledger_scan",
     }
+    # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.
+    # config2 is the pure on-device scan; config6 is the replica commit
+    # boundary (wire decode + kernel + write-through mirror + encode) —
+    # their ratio isolates the HOST share of the serving path.
+    if acc2 and acc6:
+        scan_tps = acc2 / el2
+        serve_tps = acc6 / el6
+        out["bottleneck"] = {
+            "device_scan_tps": round(scan_tps, 1),
+            "serving_tps": round(serve_tps, 1),
+            "host_share_of_serving": round(
+                max(0.0, 1.0 - serve_tps / scan_tps), 4),
+            "note": ("serving cost beyond the device scan is host-side: "
+                     "wire codecs + the write-through mirror apply"),
+        }
     print(json.dumps(out), flush=True)
 
 

@@ -22,6 +22,7 @@ crash/replay (jobs hold only memory until completion)."""
 
 from __future__ import annotations
 
+import bisect
 import dataclasses
 import struct
 from typing import Optional
@@ -101,14 +102,26 @@ class Tree:
     def get(self, key: bytes) -> Optional[bytes]:
         value = self.memtable.get(key)
         if value is None:
-            for level in self.levels:
-                # Newest-first within a level (L0 tables may overlap).
-                for table in reversed(level):
-                    value = table.get(key)
-                    if value is not None:
-                        break
+            # L0 tables may overlap: newest-first linear probe.
+            for table in reversed(self.levels[0]):
+                value = table.get(key)
                 if value is not None:
                     break
+        if value is None:
+            # Deeper levels are disjoint and kept sorted by key_min
+            # (bisect_insert): binary-search the ONE candidate table per
+            # level instead of probing them all (reference: the manifest
+            # level structure's key-range lookup,
+            # src/lsm/manifest_level.zig).
+            for level in self.levels[1:]:
+                if not level:
+                    continue
+                i = bisect.bisect_right(
+                    level, key, key=lambda t: t.info.key_min) - 1
+                if i >= 0 and key <= level[i].info.key_max:
+                    value = level[i].get(key)
+                    if value is not None:
+                        break
         if value is None or value == TOMBSTONE * self.value_size:
             return None
         return value
