@@ -300,7 +300,7 @@ def inner_main() -> None:
     # config2 is the pure on-device scan; config6 is the replica commit
     # boundary (wire decode + kernel + write-through mirror + encode) —
     # their ratio isolates the HOST share of the serving path.
-    if acc2 and acc6:
+    if acc2 and acc6 and el2 > 0 and el6 > 0:
         scan_tps = acc2 / el2
         serve_tps = acc6 / el6
         out["bottleneck"] = {

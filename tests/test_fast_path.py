@@ -442,3 +442,113 @@ class TestLimitHeadroomEligibility:
                [(r.timestamp, r.status) for r in want]
         assert led.fallbacks == 1, "potential breach must take exact path"
         assert any(r.status.name == "exceeds_credits" for r in want)
+
+
+class TestExactPulseScheduling:
+    """E6 retired: mixed pending-with-timeout + post/void batches run on
+    the fast path with the EXACT sequential pulse evolution computed in
+    closed form (prefix-min + reset detection)."""
+
+    def _pair(self):
+        from tigerbeetle_tpu.oracle import StateMachineOracle
+        from tigerbeetle_tpu.ops.ledger import DeviceLedger
+        from tigerbeetle_tpu.types import Account
+
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
+        sm = StateMachineOracle()
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 21)]
+        for eng in (led, sm):
+            eng.create_accounts(accts, 30)
+        return led, sm
+
+    def test_mixed_timeout_and_resolve_stays_fast(self):
+        from tigerbeetle_tpu.types import Transfer, TransferFlags
+
+        pend = int(TransferFlags.pending)
+        post = int(TransferFlags.post_pending_transfer)
+        void = int(TransferFlags.void_pending_transfer)
+        led, sm = self._pair()
+        ts = 10**9
+        setup = [Transfer(id=100 + i, debit_account_id=1 + i,
+                          credit_account_id=2 + i, amount=5, ledger=1,
+                          code=1, flags=pend, timeout=100 + i)
+                 for i in range(4)]
+        ts += 10
+        for eng in (led, sm):
+            r = eng.create_transfers(setup, ts)
+            assert all(x.status.name == "created" for x in r)
+        # One batch mixing: a void of the EARLIEST pending (whose expiry
+        # is the current pulse_next -> reset fires), new pendings with
+        # earlier/later timeouts, and a post — interleaved so the
+        # sequential evolution matters.
+        mixed = [
+            Transfer(id=200, debit_account_id=5, credit_account_id=6,
+                     amount=3, ledger=1, code=1, flags=pend, timeout=500),
+            Transfer(id=201, pending_id=100, amount=0, flags=void),
+            Transfer(id=202, debit_account_id=7, credit_account_id=8,
+                     amount=3, ledger=1, code=1, flags=pend, timeout=1),
+            Transfer(id=203, pending_id=101, amount=5, flags=post),
+        ]
+        ts += 10
+        got = led.create_transfers(mixed, ts)
+        want = sm.create_transfers(mixed, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        assert led.fallbacks == 0, "mixed batch must stay on device"
+        host = led.to_host()
+        assert host.pulse_next_timestamp == sm.pulse_next_timestamp
+        assert host.expiry == sm.expiry
+        # Expiry pulse after the mix behaves identically.
+        later = ts + 10**12
+        assert (led.pulse_needed(later), sm.pulse_needed(later)) == \
+            (True, True)
+        led.expire_pending_transfers(later)
+        sm.expire_pending_transfers(later)
+        host = led.to_host()
+        assert host.pending_status == sm.pending_status
+        assert host.pulse_next_timestamp == sm.pulse_next_timestamp
+
+    def test_reset_fires_only_on_exact_running_pulse(self):
+        """A void whose pending's expiry is NOT the running pulse must
+        not reset it (the closed form's fired-detection edge)."""
+        from tigerbeetle_tpu.types import Transfer, TransferFlags
+
+        pend = int(TransferFlags.pending)
+        void = int(TransferFlags.void_pending_transfer)
+        led, sm = self._pair()
+        ts = 10**9
+        setup = [
+            Transfer(id=100, debit_account_id=1, credit_account_id=2,
+                     amount=5, ledger=1, code=1, flags=pend, timeout=50),
+            Transfer(id=101, debit_account_id=3, credit_account_id=4,
+                     amount=5, ledger=1, code=1, flags=pend, timeout=900),
+        ]
+        ts += 10
+        for eng in (led, sm):
+            eng.create_transfers(setup, ts)
+        # A pulse scan (nothing due) recomputes pulse_next to the real
+        # minimum (it sits at TIMESTAMP_MIN until then).
+        led.expire_pending_transfers(ts + 1)
+        sm.expire_pending_transfers(ts + 1)
+        host0 = led.to_host()
+        assert host0.pulse_next_timestamp == sm.pulse_next_timestamp != 1
+        # expire() put the standalone ledger into its mirror regime; drop
+        # the mirror so the next batch exercises the device kernel.
+        led.mirror = None
+        led._mirror_batches = 0
+        # Void the LATER-expiring pending: pulse_next tracks id=100's
+        # earlier expiry, so no reset fires.
+        batch = [
+            Transfer(id=200, pending_id=101, amount=0, flags=void),
+            Transfer(id=201, debit_account_id=5, credit_account_id=6,
+                     amount=1, ledger=1, code=1, flags=pend, timeout=2000),
+        ]
+        ts += 10
+        got = led.create_transfers(batch, ts)
+        want = sm.create_transfers(batch, ts)
+        assert [(r.timestamp, r.status) for r in got] == \
+               [(r.timestamp, r.status) for r in want]
+        assert led.fallbacks == 0
+        host = led.to_host()
+        assert host.pulse_next_timestamp == sm.pulse_next_timestamp
+        assert host.pulse_next_timestamp != 1  # no spurious reset

@@ -143,15 +143,17 @@ class TestFullSharded:
         assert host.account_events == oracle.account_events
 
     def test_fallback_flag_propagates(self, mesh):
-        """An ineligible batch (E6: pending-with-timeout + post/void) must
-        report fallback with state untouched — identically to single-chip."""
+        """An ineligible batch (E1: balancing flag) must report fallback
+        with state untouched — identically to single-chip."""
         led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
         accts = [Account(id=i, ledger=1, code=1) for i in (1, 2, 3)]
         led.create_accounts(accts, 10)
         evs = [
             Transfer(id=100, debit_account_id=1, credit_account_id=2,
-                     amount=5, ledger=1, code=1, flags=PEND, timeout=1),
-            Transfer(id=101, pending_id=99, amount=0, flags=VOID),
+                     amount=5, ledger=1, code=1,
+                     flags=int(TransferFlags.balancing_debit)),
+            Transfer(id=101, debit_account_id=2, credit_account_id=3,
+                     amount=1, ledger=1, code=1),
         ]
         ev = pad_transfer_events(transfers_to_arrays(evs))
         step = make_sharded_create_transfers(mesh)

@@ -142,9 +142,9 @@ class TestDeviceServingBounds:
         hard = [
             Transfer(id=nid, debit_account_id=1, credit_account_id=2,
                      amount=5, ledger=1, code=1,
-                     flags=int(TransferFlags.pending), timeout=1),
-            Transfer(id=nid + 1, pending_id=nid, amount=0,
-                     flags=int(TransferFlags.void_pending_transfer)),
+                     flags=int(TransferFlags.balancing_debit)),
+            Transfer(id=nid + 1, debit_account_id=2, credit_account_id=3,
+                     amount=1, ledger=1, code=1),
         ]
         ts += 10
         res = sm.create_transfers(hard, ts)

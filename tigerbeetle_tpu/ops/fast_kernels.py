@@ -25,8 +25,9 @@ are provably order-independent. The kernel verifies eligibility on device
       overflow statuses (src/state_machine.zig:3856-3884) cannot fire;
   E5  a voided pending transfer has no closing flags (void would reopen a
       closed account mid-batch);
-  E6  pulse scheduling stays closed-form: not both pending-with-timeout and
-      post/void events in one batch;
+  E6  (retired) pulse scheduling no longer constrains eligibility: the
+      kernel computes the exact sequential pulse evolution in closed form
+      (prefix-min + reset detection — see the pulse block);
   E7  hash/row capacity suffices.
 
 Under E1-E7, statuses depend only on pre-batch state and per-event fields
@@ -486,11 +487,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     e5 = jnp.any(valid & is_void & p_found
                  & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
 
-    any_pending_timeout = jnp.any(valid & pending & (ev["timeout"] != 0))
-    any_pv = jnp.any(valid & pv)
-    e6 = any_pending_timeout & any_pv
-
-    fallback_pre = e1 | e2 | e3 | e4 | e5 | e6
+    fallback_pre = e1 | e2 | e3 | e4 | e5
 
     # ---------------- chains: segment first-failure broadcast ----------------
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
@@ -760,18 +757,32 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                         state["xfer_key_max"])
     commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
 
-    # Pulse scheduling, closed-form under E6. Uses applied_ever, not created:
-    # chain rollback does not restore pulse_next (state-machine state, not
-    # groove state — reference keeps the early wake-up, which is safe).
+    # Pulse scheduling: EXACT sequential evolution in closed form
+    # (oracle/state_machine.py:594 min-update, :744 reset). Per applied
+    # event in order: a pending-with-timeout does pulse = min(pulse,
+    # expires); a post/void of a timed pending resets pulse to
+    # TIMESTAMP_MIN iff pulse == expires(p) at that moment. Key facts:
+    # once ANY reset fires, pulse is pinned at TIMESTAMP_MIN (mins can't
+    # go lower; later resets need pulse == expires > MIN); and absent
+    # earlier fires, the pulse seen by event j is min(P0, prefix-min of
+    # earlier mins) — one cummin. So: fired_j = applied_pv_j with
+    # p.timeout whose expires equals that running value; final is
+    # TIMESTAMP_MIN if any fired, else min(P0, all mins). Uses
+    # applied_ever, not created: chain rollback does not restore
+    # pulse_next (state-machine state, not groove state — the reference
+    # keeps the early wake-up, which is safe), for the resets too.
     expires_new = jnp.where(
         applied_ever & pending & (ev["timeout"] != 0),
         ts_event + timeout_ns, jnp.uint64(0xFFFFFFFFFFFFFFFF))
-    min_exp = jnp.min(expires_new)
-    pulse = state["pulse_next"]
-    pulse = jnp.where(any_pending_timeout & (min_exp < pulse), min_exp, pulse)
-    pv_reset = jnp.any(ap_pv & (p["timeout"] != 0)
-                       & (p["expires"] == state["pulse_next"]))
-    pulse = jnp.where(pv_reset, jnp.uint64(1), pulse)
+    p0 = state["pulse_next"]
+    cm = jax.lax.cummin(expires_new)
+    before_min = jnp.concatenate([
+        jnp.full((1,), 0xFFFFFFFFFFFFFFFF, dtype=jnp.uint64), cm[:-1]])
+    run_pulse = jnp.minimum(p0, before_min)
+    applied_pv = applied_ever & pv
+    fired = applied_pv & (p["timeout"] != 0) & (p["expires"] == run_pulse)
+    pulse = jnp.where(jnp.any(fired), jnp.uint64(1),
+                      jnp.minimum(p0, jnp.min(expires_new)))
     pulse = jnp.where(ok, pulse, state["pulse_next"])
 
     new_state = dict(
