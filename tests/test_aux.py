@@ -346,3 +346,77 @@ class TestMultiversionCli:
         assert main(["format", "--cluster=1", "--replica=0",
                      "--replica-count=1", "--small", path]) == 0
         assert main(["multiversion", "--small", path]) == 0
+
+
+class TestClusterConfigEnforcement:
+    def test_mismatched_fingerprint_peer_is_dropped(self):
+        """reference: ConfigCluster must match across the cluster
+        (src/config.zig:153-163); pings carry a fingerprint and a
+        mismatched peer's traffic is refused."""
+        from tests.test_nack import _FakeTime, _CaptureBus, _mk_replica
+        from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+        r, bus, _ = _mk_replica(0, replica_count=3)
+        fp = r._config_fp32
+        good = Header(command=Command.ping, cluster=0xABCD01, replica=1,
+                      view=0, timestamp=123, request=fp)
+        r.on_message(Message(good.finalize()))
+        assert bus.of(Command.pong), "matching peer must get a pong"
+        bus.sent.clear()
+        bad = Header(command=Command.ping, cluster=0xABCD01, replica=2,
+                     view=0, timestamp=124, request=fp ^ 0x1)
+        r.on_message(Message(bad.finalize()))
+        assert not bus.of(Command.pong), "mismatched peer must be dropped"
+        # Legacy pings without a fingerprint (0) stay accepted.
+        legacy = Header(command=Command.ping, cluster=0xABCD01, replica=1,
+                        view=0, timestamp=125)
+        r.on_message(Message(legacy.finalize()))
+        assert bus.of(Command.pong)
+
+
+class TestCommitMetrics:
+    def test_per_op_timing_table(self):
+        """reference: per-op timings recorded at commit
+        (src/state_machine.zig:729-780, :2637-2667)."""
+        from tigerbeetle_tpu import multi_batch
+        from tigerbeetle_tpu.state_machine import StateMachine
+        from tigerbeetle_tpu.types import Account, Operation
+
+        sm = StateMachine(engine="oracle")
+        body = multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128)
+        sm.commit(Operation.create_accounts, body, 100)
+        lookup = multi_batch.encode([(1).to_bytes(16, "little")], 16)
+        sm.commit(Operation.lookup_accounts, lookup, 200)
+        sm.commit(Operation.lookup_accounts, lookup, 300)
+        m = sm.metrics
+        assert m["create_accounts"]["count"] == 1
+        assert m["lookup_accounts"]["count"] == 2
+        assert m["lookup_accounts"]["total_ns"] >= \
+            m["lookup_accounts"]["max_ns"] > 0
+
+    def test_mismatched_peer_consensus_traffic_gated(self):
+        """The mismatch flag gates ALL replica traffic (prepare etc.),
+        not just pongs — and a matching ping clears it."""
+        from tests.test_nack import _mk_replica, _prepare_msg
+        from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+        r, bus, _ = _mk_replica(1, replica_count=3)
+        r.status = "normal"
+        fp = r._config_fp32
+        bad_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
+                          view=0, timestamp=1, request=fp ^ 0x2)
+        r.on_message(Message(bad_ping.finalize()))
+        assert 0 in r._config_mismatch
+        # A prepare from the flagged primary is dropped.
+        m = _prepare_msg(1)
+        r.on_message(m)
+        assert r.op == 0 and r.journal.read_prepare(1) is None
+        # The peer upgrades (matching ping): flag clears, traffic flows.
+        good_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
+                           view=0, timestamp=2, request=fp)
+        r.on_message(Message(good_ping.finalize()))
+        assert 0 not in r._config_mismatch
+        r.on_message(m)
+        assert r.op == 1 and r.journal.read_prepare(1) is not None

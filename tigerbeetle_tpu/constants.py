@@ -54,3 +54,21 @@ BLOCK_SIZE = 512 * 1024  # grid block size
 def timestamp_valid(timestamp: int) -> bool:
     """reference: src/lsm/timestamp_range.zig:36-39"""
     return TIMESTAMP_MIN <= timestamp <= TIMESTAMP_MAX
+
+
+def config_fingerprint(extra: tuple = ()) -> int:
+    """Fingerprint of the CLUSTER-critical configuration (the reference's
+    ConfigCluster, src/config.zig:153-163: parameters that must match
+    across every replica of a cluster). Covers the protocol constants
+    here plus `extra` — the replica passes its storage-layout geometry
+    (WAL slot count, message size, grid block size), which lives on the
+    layout rather than in this module. Replicas exchange the fingerprint
+    on pings and refuse a mismatched peer's traffic: a mixed-config
+    cluster would corrupt journals and quorum math silently."""
+    import hashlib
+
+    material = ",".join(str(x) for x in (
+        MESSAGE_SIZE_MAX, MESSAGE_BODY_SIZE_MAX, BATCH_MAX,
+        PIPELINE_PREPARE_QUEUE_MAX, TIMESTAMP_MAX, *extra))
+    return int.from_bytes(
+        hashlib.blake2b(material.encode(), digest_size=8).digest(), "little")

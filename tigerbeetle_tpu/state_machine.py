@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import struct
+import time as _time
 from typing import Optional
 
 from . import multi_batch
@@ -150,6 +151,8 @@ class StateMachine:
         self._fq = None
         self._acct_cache = None
         self._xfer_cache = None
+        # Per-operation commit timing table (op name -> count/total/max).
+        self.metrics: dict[str, dict] = {}
 
     # -------------------------------------------------------- LSM serving
 
@@ -550,7 +553,23 @@ class StateMachine:
         """Execute one operation body (reference StateMachine.commit,
         src/state_machine.zig:2564-2669): decode (multi-batch aware),
         dispatch, encode results. Raises ProtocolError on malformed input
-        (callers validate first via input_valid)."""
+        (callers validate first via input_valid). Per-op timings aggregate
+        into `metrics` (reference: the commit Metrics table,
+        src/state_machine.zig:729-780, :2637-2667)."""
+        t0 = _time.perf_counter_ns()
+        try:
+            return self._commit_timed(op, body, timestamp)
+        finally:
+            m = self.metrics.setdefault(
+                op.name, {"count": 0, "total_ns": 0, "max_ns": 0})
+            dt = _time.perf_counter_ns() - t0
+            m["count"] += 1
+            m["total_ns"] += dt
+            if dt > m["max_ns"]:
+                m["max_ns"] = dt
+
+    def _commit_timed(self, op: Operation, body: bytes,
+                      timestamp: int) -> bytes:
         if not self.input_valid(op, body):
             raise ProtocolError(f"malformed body for {op!r}")
         spec = OPERATION_SPECS[op]

@@ -119,6 +119,16 @@ class Replica:
         self.options = options
         self.state_machine_factory = state_machine_factory
 
+        from ..constants import config_fingerprint
+
+        # Cluster-config fingerprint (constants + THIS replica's storage
+        # geometry), cached: exchanged on pings, enforced in on_message.
+        self._config_fp32 = config_fingerprint(
+            (storage.layout.slot_count, storage.layout.message_size_max,
+             storage.layout.grid_block_size)) & 0xFFFFFFFF
+        # Peers whose fingerprint mismatched: ALL their replica-to-replica
+        # traffic is dropped until a matching ping clears them.
+        self._config_mismatch: set[int] = set()
         self.journal = Journal(storage)
         self.state_machine: StateMachine = state_machine_factory()
         self.durable = DurableState(storage)
@@ -343,6 +353,13 @@ class Replica:
             return
         h = msg.header
         if h.cluster != self.cluster:
+            return
+        if (h.replica in self._config_mismatch
+                and h.command not in (Command.request, Command.ping,
+                                      Command.ping_client)):
+            # A config-mismatched peer must not participate in consensus
+            # (its geometry could corrupt journals/quorum math); pings
+            # stay visible so a fixed peer can clear the flag.
             return
         handler = {
             Command.request: self.on_request,
@@ -1380,6 +1397,17 @@ class Replica:
     # ---------------------------------------------------------------- time
 
     def on_ping(self, msg: Message) -> None:
+        # Cluster-config fingerprint enforcement (reference:
+        # ConfigCluster must match across the cluster, config.zig:153):
+        # a peer built with different journal/message/batch geometry
+        # would corrupt shared state — flag it; on_message drops all its
+        # replica traffic while flagged. A later MATCHING ping (e.g.
+        # after an upgrade) clears the flag.
+        if msg.header.request not in (0, self._config_fp32):
+            self.tracer.count("config_mismatch_peer", 1)
+            self._config_mismatch.add(msg.header.replica)
+            return
+        self._config_mismatch.discard(msg.header.replica)
         self.releases.observe(msg.header.replica, msg.header.release)
         pong = Header(
             command=Command.pong, cluster=self.cluster,
@@ -1402,7 +1430,8 @@ class Replica:
             ping = Header(
                 command=Command.ping, cluster=self.cluster,
                 replica=self.replica_id, view=self.view,
-                release=self.release, timestamp=now)
+                release=self.release, timestamp=now,
+                request=self._config_fp32)
             msg = Message(ping.finalize())
             for r in range(self.peer_count):
                 if r != self.replica_id:
