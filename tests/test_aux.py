@@ -357,21 +357,55 @@ class TestClusterConfigEnforcement:
         from tigerbeetle_tpu.vsr.header import Command, Header, Message
 
         r, bus, _ = _mk_replica(0, replica_count=3)
-        fp = r._config_fp32
+        fp = r._config_fp
         good = Header(command=Command.ping, cluster=0xABCD01, replica=1,
-                      view=0, timestamp=123, request=fp)
+                      view=0, timestamp=123, context=fp)
         r.on_message(Message(good.finalize()))
         assert bus.of(Command.pong), "matching peer must get a pong"
         bus.sent.clear()
         bad = Header(command=Command.ping, cluster=0xABCD01, replica=2,
-                     view=0, timestamp=124, request=fp ^ 0x1)
+                     view=0, timestamp=124, context=fp ^ 0x1)
         r.on_message(Message(bad.finalize()))
         assert not bus.of(Command.pong), "mismatched peer must be dropped"
-        # Legacy pings without a fingerprint (0) stay accepted.
+        # Fingerprint-less pings (legacy / handshake hello) stay accepted
+        # for unflagged peers...
         legacy = Header(command=Command.ping, cluster=0xABCD01, replica=1,
                         view=0, timestamp=125)
         r.on_message(Message(legacy.finalize()))
         assert bus.of(Command.pong)
+        # ...but must NOT un-gate a flagged peer (reconnect handshake
+        # would otherwise reopen the gate every connection churn).
+        bus.sent.clear()
+        hello = Header(command=Command.ping, cluster=0xABCD01, replica=2,
+                       view=0, timestamp=126)
+        r.on_message(Message(hello.finalize()))
+        assert not bus.of(Command.pong)
+        assert 2 in r._config_mismatch
+
+    def test_mismatched_peer_consensus_traffic_gated(self):
+        """The mismatch flag gates ALL replica traffic (prepare etc.),
+        not just pongs — and a matching ping clears it."""
+        from tests.test_nack import _mk_replica, _prepare_msg
+        from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+        r, bus, _ = _mk_replica(1, replica_count=3)
+        r.status = "normal"
+        fp = r._config_fp
+        bad_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
+                          view=0, timestamp=1, context=fp ^ 0x2)
+        r.on_message(Message(bad_ping.finalize()))
+        assert 0 in r._config_mismatch
+        # A prepare from the flagged primary is dropped.
+        m = _prepare_msg(1)
+        r.on_message(m)
+        assert r.op == 0 and r.journal.read_prepare(1) is None
+        # The peer upgrades (matching ping): flag clears, traffic flows.
+        good_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
+                           view=0, timestamp=2, context=fp)
+        r.on_message(Message(good_ping.finalize()))
+        assert 0 not in r._config_mismatch
+        r.on_message(m)
+        assert r.op == 1 and r.journal.read_prepare(1) is not None
 
 
 class TestCommitMetrics:
@@ -395,28 +429,3 @@ class TestCommitMetrics:
         assert m["lookup_accounts"]["count"] == 2
         assert m["lookup_accounts"]["total_ns"] >= \
             m["lookup_accounts"]["max_ns"] > 0
-
-    def test_mismatched_peer_consensus_traffic_gated(self):
-        """The mismatch flag gates ALL replica traffic (prepare etc.),
-        not just pongs — and a matching ping clears it."""
-        from tests.test_nack import _mk_replica, _prepare_msg
-        from tigerbeetle_tpu.vsr.header import Command, Header, Message
-
-        r, bus, _ = _mk_replica(1, replica_count=3)
-        r.status = "normal"
-        fp = r._config_fp32
-        bad_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
-                          view=0, timestamp=1, request=fp ^ 0x2)
-        r.on_message(Message(bad_ping.finalize()))
-        assert 0 in r._config_mismatch
-        # A prepare from the flagged primary is dropped.
-        m = _prepare_msg(1)
-        r.on_message(m)
-        assert r.op == 0 and r.journal.read_prepare(1) is None
-        # The peer upgrades (matching ping): flag clears, traffic flows.
-        good_ping = Header(command=Command.ping, cluster=0xABCD01, replica=0,
-                           view=0, timestamp=2, request=fp)
-        r.on_message(Message(good_ping.finalize()))
-        assert 0 not in r._config_mismatch
-        r.on_message(m)
-        assert r.op == 1 and r.journal.read_prepare(1) is not None

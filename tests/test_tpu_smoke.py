@@ -95,3 +95,56 @@ def test_kernel_parity_on_chip():
     result = json.loads(lines[-1])
     assert result["ok"], result
     assert result["platform"] != "cpu", result
+
+
+_SERVING_SRC = r'''
+import json, sys
+import numpy as np
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import Account, Transfer
+import jax
+platform = jax.devices()[0].platform
+sm = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 12)
+rng = np.random.default_rng(11)
+sm.create_accounts([Account(id=i, ledger=1, code=1)
+                    for i in range(1, 21)], 30)
+ts, nid = 10**9, 10**6
+for b in range(3):
+    evs = [Transfer(id=nid + i, debit_account_id=1 + int(rng.integers(0, 20)),
+                    credit_account_id=1 + int(rng.integers(0, 20)),
+                    amount=1 + int(rng.integers(0, 100)), ledger=1, code=1)
+           for i in range(64)]
+    for e in evs:
+        if e.debit_account_id == e.credit_account_id:
+            e.credit_account_id = e.debit_account_id % 20 + 1
+    nid += 64
+    ts += 100
+    res = sm.create_transfers(evs, ts)
+    if not all(r.status.name == "created" for r in res):
+        print(json.dumps({"ok": False, "batch": b}))
+        sys.exit(1)
+total_d = sum(a.debits_posted for a in sm.state.accounts.values())
+total_c = sum(a.credits_posted for a in sm.state.accounts.values())
+ok = (total_d == total_c > 0 and sm.led.fallbacks == 0)
+print(json.dumps({"ok": bool(ok), "platform": platform,
+                  "fast": sm.led.fast_batches, "total": total_d}))
+sys.exit(0 if ok else 1)
+'''
+
+
+def test_serving_engine_on_chip():
+    """The database serving engine (device StateMachine + write-through
+    mirror) on the real chip."""
+    probe = _probe_chip()
+    if not probe.get("ok"):
+        pytest.skip(f"TPU tunnel unavailable: {probe.get('error')}")
+    env = dict(os.environ, JAX_PLATFORMS="axon")
+    p = subprocess.run(
+        [sys.executable, "-c", _SERVING_SRC], capture_output=True,
+        text=True, cwd=REPO, env=env, timeout=1500,
+    )
+    lines = [ln for ln in p.stdout.splitlines() if ln.startswith("{")]
+    assert lines, f"no result: rc={p.returncode}\n{p.stderr[-1200:]}"
+    result = json.loads(lines[-1])
+    assert result["ok"], result
+    assert result["platform"] != "cpu", result

@@ -123,9 +123,9 @@ class Replica:
 
         # Cluster-config fingerprint (constants + THIS replica's storage
         # geometry), cached: exchanged on pings, enforced in on_message.
-        self._config_fp32 = config_fingerprint(
+        self._config_fp = config_fingerprint(
             (storage.layout.slot_count, storage.layout.message_size_max,
-             storage.layout.grid_block_size)) & 0xFFFFFFFF
+             storage.layout.grid_block_size))
         # Peers whose fingerprint mismatched: ALL their replica-to-replica
         # traffic is dropped until a matching ping clears them.
         self._config_mismatch: set[int] = set()
@@ -355,11 +355,12 @@ class Replica:
         if h.cluster != self.cluster:
             return
         if (h.replica in self._config_mismatch
-                and h.command not in (Command.request, Command.ping,
-                                      Command.ping_client)):
+                and h.command not in (Command.request, Command.ping)):
             # A config-mismatched peer must not participate in consensus
             # (its geometry could corrupt journals/quorum math); pings
-            # stay visible so a fixed peer can clear the flag.
+            # stay visible so a fixed peer can clear the flag, and
+            # `request` is exempt because clients default to
+            # header.replica=0, which can collide with a replica id.
             return
         handler = {
             Command.request: self.on_request,
@@ -1401,13 +1402,21 @@ class Replica:
         # ConfigCluster must match across the cluster, config.zig:153):
         # a peer built with different journal/message/batch geometry
         # would corrupt shared state — flag it; on_message drops all its
-        # replica traffic while flagged. A later MATCHING ping (e.g.
-        # after an upgrade) clears the flag.
-        if msg.header.request not in (0, self._config_fp32):
+        # replica traffic while flagged. ONLY a MATCHING fingerprint
+        # clears the flag: a fingerprint-less ping (legacy, or the
+        # message bus's connection-handshake hello) is accepted but must
+        # never un-gate a confirmed-mismatched peer, or every reconnect
+        # would reopen the gate. The full 64-bit fingerprint rides the
+        # ping's otherwise-unused u128 `context`.
+        fp = msg.header.context
+        if fp != 0 and fp != self._config_fp:
             self.tracer.count("config_mismatch_peer", 1)
             self._config_mismatch.add(msg.header.replica)
             return
-        self._config_mismatch.discard(msg.header.replica)
+        if fp == self._config_fp:
+            self._config_mismatch.discard(msg.header.replica)
+        elif msg.header.replica in self._config_mismatch:
+            return  # absent fingerprint: stay gated, no pong
         self.releases.observe(msg.header.replica, msg.header.release)
         pong = Header(
             command=Command.pong, cluster=self.cluster,
@@ -1431,7 +1440,7 @@ class Replica:
                 command=Command.ping, cluster=self.cluster,
                 replica=self.replica_id, view=self.view,
                 release=self.release, timestamp=now,
-                request=self._config_fp32)
+                context=self._config_fp)
             msg = Message(ping.finalize())
             for r in range(self.peer_count):
                 if r != self.replica_id:
