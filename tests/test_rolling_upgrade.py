@@ -1,0 +1,112 @@
+"""Rolling upgrade of a live cluster (VERDICT r1 missing #10).
+
+reference: src/multiversion.zig + docs/internals/upgrades.md — the
+reference re-execs into the release matching the cluster checkpoint;
+this runtime upgrades by restarting processes with newer code, guarded
+by release gating (multiversion.py): newer binaries may open older data
+files, never the reverse, and peers' advertised releases let operators
+see upgrade progress. These tests restart one replica at a time under a
+live workload and assert serving continuity, release visibility, and
+the downgrade refusal.
+"""
+
+from unittest import mock
+
+import pytest
+
+from tigerbeetle_tpu import multi_batch, multiversion
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Account, Operation, Transfer
+
+
+def _accounts_body(ids):
+    payload = b"".join(Account(id=i, ledger=1, code=1).pack() for i in ids)
+    return multi_batch.encode([payload], 128)
+
+
+def _transfers_body(specs):
+    payload = b"".join(
+        Transfer(id=i, debit_account_id=dr, credit_account_id=cr,
+                 amount=amt, ledger=1, code=1).pack()
+        for (i, dr, cr, amt) in specs)
+    return multi_batch.encode([payload], 128)
+
+
+class TestRollingUpgrade:
+    def test_one_at_a_time_upgrade_keeps_serving(self):
+        old = multiversion.RELEASE
+        new = old + 1
+        cluster = Cluster(seed=41, replica_count=3)
+        client = cluster.client(800)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+        nid = 10**6
+
+        def commit_one():
+            nonlocal nid
+            client.request(Operation.create_transfers,
+                           _transfers_body([(nid, 1, 2, 1)]))
+            nid += 1
+            assert cluster.run(20000, until=lambda: client.idle), \
+                cluster.debug_status()
+
+        # Upgrade replicas one at a time, committing work between each
+        # restart: the cluster must keep serving throughout.
+        for victim in range(3):
+            commit_one()
+            cluster.crash(victim)
+            commit_one()  # quorum of 2 still serves
+            with mock.patch.object(multiversion, "RELEASE", new):
+                cluster.restart(victim)  # comes back on the new release
+            commit_one()
+        cluster.settle()
+        # Every live replica now advertises the new release, and each
+        # replica's tracker has seen the whole cluster reach it.
+        for r in cluster.replicas:
+            assert r.release == new
+        # Pings propagate releases; after settle every tracker's view of
+        # the cluster floor is the new release.
+        for r in cluster.replicas:
+            assert r.releases.cluster_min == new, (
+                r.replica_id, r.releases.peers)
+        # All the work committed during the rolling upgrade survived.
+        st = cluster.replicas[0].state_machine.state
+        assert st.accounts[1].debits_posted == nid - 10**6
+        cluster.check_convergence()
+
+    def test_downgrade_refused_after_new_release_checkpoint(self):
+        """A data file checkpointed by a newer release must refuse to
+        open under the old binary (reference: the multiversion re-exec
+        decision — here, the gating assertion)."""
+        old = multiversion.RELEASE
+        new = old + 1
+        cluster = Cluster(seed=42, replica_count=3)
+        client = cluster.client(801)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+        # Upgrade replica 0 and drive enough commits to checkpoint
+        # (checkpoint_interval=16) so its superblock stamps the new
+        # release.
+        cluster.crash(0)
+        with mock.patch.object(multiversion, "RELEASE", new):
+            cluster.restart(0)
+        nid = 10**6
+        for k in range(20):
+            client.request(Operation.create_transfers,
+                           _transfers_body([(nid, 1, 2, 1)]))
+            nid += 1
+            assert cluster.run(20000, until=lambda: client.idle), \
+                cluster.debug_status()
+        cluster.settle()
+        assert cluster.replicas[0].superblock.release == new
+        # Restarting it with the OLD binary must refuse loudly.
+        cluster.crash(0)
+        with pytest.raises(RuntimeError, match="upgrade"):
+            cluster.restart(0)
+        # And the new binary opens it fine.
+        with mock.patch.object(multiversion, "RELEASE", new):
+            cluster.restart(0)
+        cluster.settle()
+        cluster.check_convergence()

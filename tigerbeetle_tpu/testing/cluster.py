@@ -236,7 +236,13 @@ class Cluster:
         assert replica_id in self.crashed
         self.crashed.discard(replica_id)
         self.replicas[replica_id] = self._make_replica(replica_id)
-        self.replicas[replica_id].open()
+        try:
+            self.replicas[replica_id].open()
+        except Exception:
+            # A refused open (e.g. release gating) leaves the replica
+            # down, not half-up: it can be restarted again later.
+            self.crashed.add(replica_id)
+            raise
 
     def partition(self, endpoint) -> None:
         self.partitioned.add(endpoint)

@@ -107,7 +107,10 @@ class Replica:
         self.tracer = tracer if tracer is not None else NullTracer()
         self.aof = aof
         self.release = RELEASE
-        self.releases = ReleaseTracker()
+        # own= explicitly: the dataclass default binds the module RELEASE
+        # at class-definition time, which would go stale across an
+        # in-process upgrade (rolling-upgrade test).
+        self.releases = ReleaseTracker(own=self.release)
         self.clock = Clock(replica_id, replica_count, time)
         self.last_ping_tx = 0
         self.cluster = cluster
@@ -1417,6 +1420,12 @@ class Replica:
             self._config_mismatch.discard(msg.header.replica)
         elif msg.header.replica in self._config_mismatch:
             return  # absent fingerprint: stay gated, no pong
+        if msg.header.release == 0 and msg.header.timestamp == 0:
+            # Bus-handshake hello (identification only): observing its
+            # zero release would clobber the peer's real one, and the
+            # pong echo would feed a degenerate (timestamp=0) clock
+            # sample back to the sender.
+            return
         self.releases.observe(msg.header.replica, msg.header.release)
         pong = Header(
             command=Command.pong, cluster=self.cluster,
