@@ -75,6 +75,85 @@ class TestRollingUpgrade:
         assert st.accounts[1].debits_posted == nid - 10**6
         cluster.check_convergence()
 
+    def test_state_sync_gates_and_stamps_checkpoint_release(self):
+        """A lagging OLD-binary replica must refuse to install a
+        checkpoint written by a NEWER release (running new-format data
+        under an old binary bypasses the upgrade gate); once upgraded, the
+        sync installs and stamps the checkpoint's release into the
+        superblock so a later downgrade is refused too."""
+        old = multiversion.RELEASE
+        new = old + 1
+        cluster = Cluster(seed=43, replica_count=3)
+        client = cluster.client(802)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.crash(victim)
+        # Upgrade the live majority one at a time; their checkpoints now
+        # stamp the new release.
+        for r in range(3):
+            if r == victim:
+                continue
+            cluster.crash(r)
+            with mock.patch.object(multiversion, "RELEASE", new):
+                cluster.restart(r)
+            assert cluster.run(8000, until=lambda: client.idle), \
+                cluster.debug_status()
+        nid = 10**6
+        for k in range(40):  # > slot_count: WAL wraps past the victim
+            client.request(Operation.create_transfers,
+                           _transfers_body([(nid, 1, 2, 1)]))
+            nid += 1
+            assert cluster.run(20000, until=lambda: client.idle), \
+                cluster.debug_status()
+        # Old binary back up: repair can't bridge the wrap, and the sync
+        # offers carry release=new — it must refuse to install them.
+        cluster.restart(victim)
+        cluster.run(6000)
+        lagging = cluster.replicas[victim]
+        assert lagging.release == old
+        assert lagging.syncing is None
+        assert lagging.superblock.release == old
+        assert lagging.commit_min < cluster.replicas[
+            (victim + 1) % 3].commit_min
+        # Upgrade the victim: the same sync now installs and stamps the
+        # checkpoint's release.
+        cluster.crash(victim)
+        with mock.patch.object(multiversion, "RELEASE", new):
+            cluster.restart(victim)
+        cluster.settle(ticks=8000)
+        synced = cluster.replicas[victim]
+        assert synced.superblock.release == new
+        assert synced.state_machine.state.accounts[1].debits_posted == 40
+        # The stamp makes a post-sync downgrade refuse at open.
+        cluster.crash(victim)
+        with pytest.raises(RuntimeError, match="upgrade"):
+            cluster.restart(victim)
+        with mock.patch.object(multiversion, "RELEASE", new):
+            cluster.restart(victim)
+        cluster.settle()
+        cluster.check_convergence()
+
+    def test_format_floor_refuses_prefloor_checkpoint(self):
+        """Checkpoints below FORMAT_FLOOR (r1 files) are refused with a
+        rebuild instruction instead of silently opening with the new
+        index trees empty (the r2 schema bump requirement)."""
+        cluster = Cluster(seed=44, replica_count=3)
+        client = cluster.client(803)
+        client.request(Operation.create_accounts, _accounts_body([1, 2]))
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+        cluster.crash(0)
+        # Forge a pre-floor data file: stamp the superblock as release
+        # floor-1 (what an r1 binary's checkpoint would have written).
+        r0 = cluster.replicas[0]
+        sb = r0.superblock
+        sb.release = multiversion.FORMAT_FLOOR - 1
+        sb.store(r0.storage)
+        with pytest.raises(RuntimeError, match="rebuild"):
+            cluster.restart(0)
+
     def test_downgrade_refused_after_new_release_checkpoint(self):
         """A data file checkpointed by a newer release must refuse to
         open under the old binary (reference: the multiversion re-exec

@@ -292,6 +292,92 @@ class ForestQuery:
                 break
         return out
 
+    def account_history_events(self, account_timestamp: int,
+                               ts_min: int = 1,
+                               ts_max: int = TIMESTAMP_MAX,
+                               limit: int = 8190,
+                               reverse: bool = False) -> list:
+        """Balance-history rows of one history-flagged account, by the
+        account_timestamp event index (reference: tree id 27,
+        src/state_machine.zig:534-538 — "balance as-of" / "last time
+        account=X was updated" queries). Returns AccountBalance rows of
+        the requested side."""
+        from ..types import AccountBalance
+        from ..vsr.durable import _unpack_event
+
+        events = self.forest.trees["events"]
+        scan = TreeScan(
+            self.forest.trees["ev_by_acct_ts"],
+            composite_key(account_timestamp, ts_min, 8),
+            composite_key(account_timestamp, ts_max, 8))
+        # Index keys are cheap ints; unpack only the `limit` rows served.
+        # (History rows are never prunable — both sides' flags gate the
+        # prunable index — so every index key resolves to a row.)
+        # Reverse keeps the LAST `limit` ascending keys via a bounded
+        # deque: O(range) scan but O(limit) memory.
+        from collections import deque
+
+        keys = deque(maxlen=limit) if reverse else []
+        for key, _ in scan:
+            keys.append(int.from_bytes(key[-8:], "big"))
+            if not reverse and len(keys) >= limit:
+                break
+        if reverse:
+            keys = list(reversed(keys))
+        rows = []
+        for ets in keys:
+            raw = events.get(ets.to_bytes(8, "big"))
+            assert raw is not None, ets
+            rec = _unpack_event(raw)
+            side = (rec.dr_account
+                    if rec.dr_account.timestamp == account_timestamp
+                    else rec.cr_account)
+            rows.append(AccountBalance(
+                debits_pending=side.debits_pending,
+                debits_posted=side.debits_posted,
+                credits_pending=side.credits_pending,
+                credits_posted=side.credits_posted,
+                timestamp=ets))
+        return rows
+
+    def expiry_event_of_pending(self, pending_id: int):
+        """The expiry event of a pending transfer, if it expired
+        (reference: transfer_pending_id_expired index, tree id 31 —
+        "when transfer=X has expired")."""
+        from ..vsr.durable import _unpack_event
+
+        scan = TreeScan(
+            self.forest.trees["ev_by_pid_expired"],
+            composite_key(pending_id, 1, 16),
+            composite_key(pending_id, TIMESTAMP_MAX, 16))
+        for key, _ in scan:
+            raw = self.forest.trees["events"].get(key[-8:])
+            if raw is not None:
+                return _unpack_event(raw)
+        return None
+
+    def expired_events_by_account(self, account_id: int,
+                                  side: str = "dr",
+                                  limit: int = 8190) -> list:
+        """Expiry events touching an account on the given side
+        (reference: dr/cr_account_id_expired indexes, tree ids 29-30 —
+        "all expired debits where account=X")."""
+        from ..vsr.durable import _unpack_event
+
+        assert side in ("dr", "cr")
+        scan = TreeScan(
+            self.forest.trees[f"ev_by_{side}_expired"],
+            composite_key(account_id, 1, 16),
+            composite_key(account_id, TIMESTAMP_MAX, 16))
+        out = []
+        for key, _ in scan:
+            raw = self.forest.trees["events"].get(key[-8:])
+            if raw is not None:
+                out.append(_unpack_event(raw))
+                if len(out) >= limit:
+                    break
+        return out
+
     def transfers_by_pending_id(self, pending_id: int) -> list[Transfer]:
         """Resolutions (posts/voids) of a pending transfer, ascending —
         served by the pending_id index tree (reference: the transfers

@@ -92,18 +92,27 @@ def cmd_start(args) -> int:
         from .aof import AOF
 
         aof = AOF(args.aof)
+    # Production capacities match the DeviceLedger defaults (the
+    # static-allocation bound, reference: config.zig limits); --small
+    # keeps test clusters light. Shared by the serving factory AND the
+    # warmup so the pre-compiled executables always match serving shapes.
+    a_cap = (1 << 12) if args.small else (1 << 17)
+    t_cap = (1 << 14) if args.small else (1 << 21)
     replica = Replica(
         cluster=args.cluster, replica_id=args.replica,
         replica_count=len(addresses), storage=storage, bus=bus,
         time=_WallTime(), tracer=tracer, aof=aof,
         state_machine_factory=lambda: StateMachine(
-            engine=args.engine,
-            # Production capacities match the DeviceLedger defaults (the
-            # static-allocation bound, reference: config.zig limits);
-            # --small keeps test clusters light.
-            a_cap=(1 << 12) if args.small else (1 << 17),
-            t_cap=(1 << 14) if args.small else (1 << 21)))
+            engine=args.engine, a_cap=a_cap, t_cap=t_cap))
     replica_holder.append(replica)
+    if args.engine == "device":
+        # Compile the serving kernels BEFORE accepting connections: the
+        # first create_transfers compile (~10s+ cold) must not land on a
+        # client request's timeout budget.
+        from .ops.ledger import warmup_kernels
+
+        warm_s = warmup_kernels(a_cap=a_cap, t_cap=t_cap)
+        print(f"kernels warm in {warm_s:.1f}s", flush=True)
     replica.open()
     print(f"replica {args.replica} listening on "
           f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "

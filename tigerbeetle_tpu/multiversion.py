@@ -26,6 +26,12 @@ import dataclasses
 #     files must be rebuilt via `recover`).
 RELEASE = 2
 
+# Oldest checkpoint format this binary still opens. Checkpoints below the
+# floor are refused at open with a rebuild instruction — enforcing the
+# "r1 data files must be rebuilt" requirement instead of silently opening
+# them with the 12 new index trees empty for all pre-upgrade rows.
+FORMAT_FLOOR = 2
+
 
 def release_str(release: int) -> str:
     """Human form: the reference renders releases as triples
@@ -51,3 +57,9 @@ class ReleaseTracker:
         """A data file written by a newer release cannot be opened by an
         older binary (reference: multiversion re-exec decision)."""
         return checkpoint_release <= self.own
+
+    def openable(self, checkpoint_release: int) -> bool:
+        """compatible() plus the format floor: too-old checkpoints need a
+        `recover` rebuild, too-new ones need a binary upgrade."""
+        return (FORMAT_FLOOR <= checkpoint_release
+                and self.compatible(checkpoint_release))

@@ -86,6 +86,21 @@ def _flag(flags, bit):
     return (flags & bit) != 0
 
 
+# --------------------------------------------------- cumulative reductions
+# jnp.cumsum / lax.cummin lower to reduce-window on TPU, whose scoped vmem
+# scales with O(axis * window): on v5e the (4, 4, 2N) limb cumsum blows the
+# 16 MiB scoped-vmem budget at N=64 already (observed: 64.25M requested).
+# lax.associative_scan lowers to log2(N) slice+add steps instead — same
+# exact integer semantics, vmem-flat.
+
+def _cumsum(x, axis=-1):
+    return jax.lax.associative_scan(jnp.add, x, axis=axis % x.ndim)
+
+
+def _cummin(x, axis=-1):
+    return jax.lax.associative_scan(jnp.minimum, x, axis=axis % x.ndim)
+
+
 # ------------------------------------------------------------ limb helpers
 
 def _to_limbs(hi, lo):
@@ -493,7 +508,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
     in_chain = linked | l_prev
     start = linked & ~l_prev
-    chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
+    chain_id = _cumsum(start.astype(jnp.int32))
     is_last = idxs == (n - 1)
     chain_open_evt = linked & is_last
     status = jnp.where(chain_open_evt, _TS["linked_event_chain_open"], status)
@@ -523,7 +538,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # All remaining fallback causes are resolved BEFORE any state write, so
     # the abort path is "mask every scatter to the dump slot" — the donated
     # state buffers are updated in place and never copied.
-    row_off = (jnp.cumsum(created.astype(jnp.int32), dtype=jnp.int32)
+    row_off = (_cumsum(created.astype(jnp.int32))
                - created.astype(jnp.int32))
     n_created = jnp.sum(created, dtype=jnp.int32)
     new_rows = xfr["count"] + row_off
@@ -688,7 +703,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         jnp.ones(1, dtype=jnp.bool_), rows_sorted[1:] != rows_sorted[:-1]])
     start_positions = jnp.where(
         is_start, jnp.arange(2 * N, dtype=jnp.int32), jnp.int32(0))
-    seg_id = jnp.cumsum(is_start.astype(jnp.int32)) - 1
+    seg_id = _cumsum(is_start.astype(jnp.int32)) - 1
     seg_start = jax.ops.segment_max(
         start_positions, seg_id, num_segments=2 * N)[seg_id]
 
@@ -700,7 +715,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                    for j in range(4)])
         for field in fields])                        # (4, 4, 2N)
     lanes_sorted = lanes2[:, :, perm]
-    cs = jnp.cumsum(lanes_sorted, axis=2)
+    cs = _cumsum(lanes_sorted, axis=2)
     offsets = jnp.where(
         seg_start > 0,
         jnp.take(cs, jnp.maximum(seg_start - 1, 0), axis=2), z64)
@@ -775,7 +790,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         applied_ever & pending & (ev["timeout"] != 0),
         ts_event + timeout_ns, jnp.uint64(0xFFFFFFFFFFFFFFFF))
     p0 = state["pulse_next"]
-    cm = jax.lax.cummin(expires_new)
+    cm = _cummin(expires_new)
     before_min = jnp.concatenate([
         jnp.full((1,), 0xFFFFFFFFFFFFFFFF, dtype=jnp.uint64), cm[:-1]])
     run_pulse = jnp.minimum(p0, before_min)
@@ -900,7 +915,7 @@ def create_accounts_fast(state, ev, timestamp, n):
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
     in_chain = linked | l_prev
     start = linked & ~l_prev
-    chain_id = jnp.cumsum(start.astype(jnp.int32), dtype=jnp.int32)
+    chain_id = _cumsum(start.astype(jnp.int32))
     chain_open_evt = linked & (idxs == (n - 1))
     status = jnp.where(chain_open_evt, _AS["linked_event_chain_open"], status)
     fail = in_chain & valid & (status != _CREATED)
@@ -917,7 +932,7 @@ def create_accounts_fast(state, ev, timestamp, n):
     status = jnp.where(valid, status, jnp.uint32(0))
     created = valid & (status == _CREATED)
 
-    row_off = (jnp.cumsum(created.astype(jnp.int32), dtype=jnp.int32)
+    row_off = (_cumsum(created.astype(jnp.int32))
                - created.astype(jnp.int32))
     n_created = jnp.sum(created, dtype=jnp.int32)
     e7 = (acc["count"] + n_created) > jnp.int32(A_dump)

@@ -28,7 +28,8 @@ from ..lsm.forest import Forest
 from ..lsm.grid import Grid
 from ..lsm.scan import composite_key
 from ..oracle.state_machine import AccountEventRecord, StateMachineOracle
-from ..types import Account, Transfer, TransferPendingStatus
+from ..types import (Account, AccountFlags, Transfer, TransferFlags,
+                     TransferPendingStatus)
 from .storage import Storage
 
 # Fixed-size AccountEventRecord row (reference: 256-byte AccountEvent,
@@ -62,6 +63,26 @@ SCHEMA = {
     "xfer_by_ud32": (12, 1),
     "xfer_by_ledger": (12, 1),
     "xfer_by_code": (10, 1),
+    # Flag indexes (reference: tree_ids 23-26 — presence-keyed; `closed`
+    # and `closing` are the only mutable indexed attributes, maintained
+    # put/remove on every dirty flush, which is deterministic and
+    # idempotent across replicas):
+    "acct_by_imported": (9, 1),
+    "acct_by_closed": (9, 1),
+    "xfer_by_amount": (24, 1),
+    "xfer_by_imported": (9, 1),
+    "xfer_by_closing": (9, 1),
+    # account_events secondary trees (reference: tree_ids 27-33,
+    # src/state_machine.zig:525-605 — account_timestamp put per
+    # history-flagged side in account_event() :4452-4466; *_expired only
+    # for expiry rows; prunable when neither side keeps history):
+    "ev_by_acct_ts": (16, 1),
+    "ev_by_pstat": (9, 1),
+    "ev_by_dr_expired": (24, 1),
+    "ev_by_cr_expired": (24, 1),
+    "ev_by_pid_expired": (24, 1),
+    "ev_by_ledger_expired": (12, 1),
+    "ev_by_prunable": (8, 1),
 }
 
 _META_SIZE = 40  # scalars appended to the checkpoint root blob
@@ -204,6 +225,7 @@ def validate_staged_checkpoint(blocks: dict, layout,
     staged.forest = Forest(staged.grid, SCHEMA)
     staged.events_persisted = 0
     staged._indexed_accounts = set()
+    staged._closed_indexed = set()
     return staged.open(root_forest)
 
 
@@ -236,6 +258,10 @@ class DurableState:
         # trees: balance updates re-dirty accounts every batch, but only
         # the object row changes — index keys are written once.
         self._indexed_accounts: set[int] = set()
+        # Accounts whose key is currently present in acct_by_closed —
+        # the one mutable account index writes only on transitions
+        # (rebuilt from the tree at open()).
+        self._closed_indexed: set[int] = set()
 
     # ------------------------------------------------------------- writes
 
@@ -255,10 +281,25 @@ class DurableState:
         for aid in flushed_accounts:
             a = acc[aid]
             trees["accounts"].put(_k16(aid), a.pack())
+            # `closed` is the one mutable indexed account attribute
+            # (closing transfers set it; voiding them clears it) —
+            # written only on transitions.
+            closed = bool(a.flags & AccountFlags.closed)
+            if closed != (aid in self._closed_indexed):
+                closed_key = composite_key(1, a.timestamp, 1)
+                if closed:
+                    trees["acct_by_closed"].put(closed_key, b"\x01")
+                    self._closed_indexed.add(aid)
+                else:
+                    trees["acct_by_closed"].remove(closed_key)
+                    self._closed_indexed.discard(aid)
             if aid in self._indexed_accounts:
                 continue  # balances changed; indexed fields immutable
             self._indexed_accounts.add(aid)
             ts = a.timestamp
+            if a.flags & AccountFlags.imported:
+                trees["acct_by_imported"].put(
+                    composite_key(1, ts, 1), b"\x01")
             trees["acct_by_ts"].put(_k8(ts), _k16(aid))
             trees["acct_by_ud128"].put(
                 composite_key(a.user_data_128, ts, 16), b"\x01")
@@ -299,6 +340,15 @@ class DurableState:
                 composite_key(t.ledger, ts, 4), b"\x01")
             trees["xfer_by_code"].put(
                 composite_key(t.code, ts, 2), b"\x01")
+            trees["xfer_by_amount"].put(
+                composite_key(t.amount, ts, 16), b"\x01")
+            if t.flags & TransferFlags.imported:
+                trees["xfer_by_imported"].put(
+                    composite_key(1, ts, 1), b"\x01")
+            if t.flags & (TransferFlags.closing_debit
+                          | TransferFlags.closing_credit):
+                trees["xfer_by_closing"].put(
+                    composite_key(1, ts, 1), b"\x01")
         xfr.dirty.clear()
         pend = state.pending_status
         for ts in sorted(pend.dirty):
@@ -318,9 +368,64 @@ class DurableState:
         orph.dirty.clear()
         for rec in state.account_events[self.events_persisted
                                         - state.events_base:]:
-            trees["events"].put(_k8(rec.timestamp), _pack_event(rec))
+            ets = rec.timestamp
+            trees["events"].put(_k8(ets), _pack_event(rec))
+            if rec.dr_account.flags & AccountFlags.history:
+                trees["ev_by_acct_ts"].put(
+                    composite_key(rec.dr_account.timestamp, ets, 8), b"\x01")
+            if rec.cr_account.flags & AccountFlags.history:
+                trees["ev_by_acct_ts"].put(
+                    composite_key(rec.cr_account.timestamp, ets, 8), b"\x01")
+            trees["ev_by_pstat"].put(
+                composite_key(int(rec.transfer_pending_status), ets, 1),
+                b"\x01")
+            if rec.transfer_pending_status == TransferPendingStatus.expired:
+                trees["ev_by_dr_expired"].put(
+                    composite_key(rec.dr_account.id, ets, 16), b"\x01")
+                trees["ev_by_cr_expired"].put(
+                    composite_key(rec.cr_account.id, ets, 16), b"\x01")
+                trees["ev_by_pid_expired"].put(
+                    composite_key(rec.transfer_pending.id, ets, 16), b"\x01")
+                trees["ev_by_ledger_expired"].put(
+                    composite_key(rec.dr_account.ledger, ets, 4), b"\x01")
+            if not ((rec.dr_account.flags | rec.cr_account.flags)
+                    & AccountFlags.history):
+                trees["ev_by_prunable"].put(_k8(ets), b"\x01")
         self.events_persisted = state.events_base + len(state.account_events)
         return flushed_accounts, flushed_transfers
+
+    def prune_events(self, before_ts: int) -> int:
+        """Delete prunable (no-history) event rows older than `before_ts`
+        (the CDC consumer watermark) — the cleanup job the reference's
+        `prunable` index exists for (src/state_machine.zig:590-601).
+        Returns the number of rows pruned. Deterministic: driven purely by
+        tree contents and the argument, so replicas pruning at the same
+        op produce byte-identical grids."""
+        from ..lsm.scan import TreeScan
+
+        trees = self.forest.trees
+        doomed = [key for key, _ in TreeScan(
+            trees["ev_by_prunable"], _k8(0), _k8(max(0, before_ts - 1)))]
+        for key in doomed:
+            raw = trees["events"].get(key)
+            if raw is not None:  # groove delete: object + every index row
+                rec = _unpack_event(raw)
+                ets = rec.timestamp
+                trees["ev_by_pstat"].remove(
+                    composite_key(int(rec.transfer_pending_status), ets, 1))
+                if (rec.transfer_pending_status
+                        == TransferPendingStatus.expired):
+                    trees["ev_by_dr_expired"].remove(
+                        composite_key(rec.dr_account.id, ets, 16))
+                    trees["ev_by_cr_expired"].remove(
+                        composite_key(rec.cr_account.id, ets, 16))
+                    trees["ev_by_pid_expired"].remove(
+                        composite_key(rec.transfer_pending.id, ets, 16))
+                    trees["ev_by_ledger_expired"].remove(
+                        composite_key(rec.dr_account.ledger, ets, 4))
+            trees["events"].remove(key)
+            trees["ev_by_prunable"].remove(key)
+        return len(doomed)
 
     def compact_beat(self, op: int) -> None:
         self.forest.compact_beat(op)
@@ -374,6 +479,10 @@ class DurableState:
                     struct.unpack("<Q", v)[0]
             for k, _ in trees["orphaned"].scan(lo16, hi16):
                 state.orphaned.add(int.from_bytes(k, "big"))
+            for k, _ in trees["acct_by_closed"].scan(
+                    b"\x00" * 9, b"\xff" * 9):
+                ats = int.from_bytes(k[-8:], "big")
+                self._closed_indexed.add(state.account_by_timestamp[ats])
             if load_events:
                 for _, v in trees["events"].scan(lo8, hi8):
                     state.account_events.append(_unpack_event(v))
@@ -383,7 +492,12 @@ class DurableState:
             state.pulse_next_timestamp = pulse
             state.commit_timestamp = commit_ts
             if load_events:
-                assert events_len == len(state.account_events)
+                # prune_events removes rows from the events tree, but
+                # events_len is the monotonic persisted COUNT — start the
+                # host list past the pruned prefix so flush's
+                # un-persisted-tail slice stays exact.
+                assert events_len >= len(state.account_events)
+                state.events_base = events_len - len(state.account_events)
             else:
                 state.events_base = events_len
         # Everything just loaded is already durable.

@@ -232,7 +232,12 @@ class Replica:
         assert sb is not None, "data file not formatted"
         assert sb.cluster == self.cluster
         assert sb.replica_id == self.replica_id
-        if not self.releases.compatible(sb.release):
+        if not self.releases.openable(sb.release):
+            if self.releases.compatible(sb.release):
+                raise RuntimeError(
+                    f"data file checkpointed by release {sb.release} is "
+                    f"below this binary's format floor — rebuild it via "
+                    "`recover` (r2 changed the index-tree schema)")
             raise RuntimeError(
                 f"data file checkpointed by release {sb.release}; this "
                 f"binary is release {self.release} — upgrade before starting "
@@ -1086,7 +1091,10 @@ class Replica:
         header = Header(
             command=Command.headers, cluster=self.cluster,
             replica=self.replica_id, view=self.view, op=sb.op_checkpoint,
-            commit=self.commit_max, context=sb.checkpoint_id)
+            commit=self.commit_max, context=sb.checkpoint_id,
+            # The release that CHECKPOINTED this root (not our binary's):
+            # the receiver must gate on it and stamp it at install.
+            release=sb.release)
         self.bus.send_to_replica(dst, Message(header.finalize(root), body=root))
 
     def on_sync_offer(self, msg: Message) -> None:
@@ -1095,6 +1103,12 @@ class Replica:
         h = msg.header
         if h.op <= self.commit_min:
             return  # not ahead of us
+        if not self.releases.openable(h.release):
+            # A checkpoint from a release this binary can't run (rolling
+            # upgrade: we're the lagging binary). Installing it would run
+            # new-format data under an old binary — wait for the operator
+            # upgrade instead; consensus keeps us in view as a follower.
+            return
         if self.syncing is not None and self.syncing["target_op"] >= h.op:
             return  # already syncing to an equal-or-newer target
         try:
@@ -1105,7 +1119,7 @@ class Replica:
             return  # malformed offer
         self.syncing = {
             "target_op": h.op, "root": msg.body, "source": h.replica,
-            "commit_max": h.commit,
+            "commit_max": h.commit, "release": h.release,
             # block index -> full zone-stride bytes (validated)
             "have": {},
             # block index -> (kind, address, size, key_size) to fetch
@@ -1265,6 +1279,10 @@ class Replica:
         sb.op_checkpoint = sync["target_op"]
         sb.commit_min = sync["target_op"]
         sb.commit_max = max(sb.commit_max, sync["commit_max"])
+        # Stamp the release that checkpointed the synced root: a restart
+        # must gate on the DATA's release, not on whatever we last wrote
+        # (downgrade refusal would otherwise be bypassed for synced state).
+        sb.release = sync["release"]
         sb.view = self.view
         sb.log_view = self.log_view
         sb.store(self.storage)
