@@ -60,47 +60,43 @@ def _make_ledger(account_count, a_cap=1 << 15, t_cap=1 << 21):
     return led
 
 
-# Fixed on-device scan length: every config dispatches chunks of exactly
-# B_CHUNK batches (ragged tails padded with empty batches), so ONE compiled
-# program serves all configs and batch counts — compile cost through a slow
-# TPU tunnel is paid once, not per config.
+# Warmup dispatches one small fixed set of batches so the single compiled
+# program (one batch shape) serves all configs and batch counts — compile
+# cost through a slow TPU tunnel is paid once, not per config.
 B_CHUNK = 8
 
 
 def _run_scan(led, evs, ts0):
-    """Dispatch batches as fixed-size on-device scan chunks; returns
-    (accepted, elapsed). Host-side stacking is staged before the clock."""
-    from .ops.fast_kernels import create_transfers_scan_jit
+    """Dispatch batches back-to-back with no mid-run host sync; returns
+    (accepted, elapsed). Host-side padding is staged before the clock.
+
+    One straight-line (control-flow-free) program per batch; the poison
+    flag threads through dispatches as a DEVICE value, so a mid-run
+    fallback masks every later batch exactly like the old on-device scan
+    did — without a lax.scan op (while-style programs execute
+    pathologically through the remote-TPU tunnel) and without waiting on
+    any per-batch result."""
+    import jax
+
+    from .ops.fast_kernels import _accum_jit, create_transfers_fast_jit
     from .ops.ledger import pad_transfer_events
 
-    padded = [pad_transfer_events(e) for e in evs]
-    ns = [N] * len(padded)
-    while len(padded) % B_CHUNK:
-        padded.append({k: np.zeros_like(v) for k, v in padded[0].items()})
-        ns.append(0)  # empty batch: every event masked invalid
-    chunks = []
-    for lo in range(0, len(padded), B_CHUNK):
-        chunk = padded[lo:lo + B_CHUNK]
-        stacked = {k: np.stack([p[k] for p in chunk]) for k in chunk[0]}
-        tss = (ts0 + (lo + np.arange(B_CHUNK, dtype=np.uint64))
-               * np.uint64(N + 10)).astype(np.uint64)
-        chunks.append((stacked, tss,
-                       np.asarray(ns[lo:lo + B_CHUNK], dtype=np.int32)))
-    # Dispatch all chunks without intermediate host syncs (the state pytree
-    # chains on device; outputs are fetched once at the end so the timed
-    # region pays a single host round trip, not one per chunk).
-    outs_all = []
+    padded = [{k: jax.device_put(v) for k, v in
+               pad_transfer_events(e).items()} for e in evs]
+    tss = [np.uint64(int(ts0) + i * (N + 10)) for i in range(len(padded))]
+    n_arr = np.int32(N)
+    poisoned = jax.device_put(np.bool_(False))
+    accepted_dev = jax.device_put(np.int64(0))
     t0 = time.perf_counter()
-    for stacked, tss, ns_c in chunks:
-        led.state, outs = create_transfers_scan_jit(
-            led.state, stacked, tss, ns_c)
-        outs_all.append(outs)
-    accepted = sum(int(np.asarray(o["created_count"]).sum())
-                   for o in outs_all)
+    for ev, ts in zip(padded, tss):
+        led.state, outs = create_transfers_fast_jit(
+            led.state, ev, ts, n_arr, force_fallback=poisoned)
+        poisoned = outs["fallback"]
+        accepted_dev = _accum_jit(accepted_dev, outs["created_count"])
+    accepted, bad = jax.device_get((accepted_dev, poisoned))
     elapsed = time.perf_counter() - t0
-    assert not any(bool(np.asarray(o["fallback"]).any()) for o in outs_all), \
-        "unexpected fallback"
-    return accepted, elapsed
+    assert not bool(bad), "unexpected fallback"
+    return int(accepted), elapsed
 
 
 def bench_config1(batches):

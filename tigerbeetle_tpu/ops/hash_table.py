@@ -1,21 +1,32 @@
-"""Device-resident open-addressing hash table for u128 keys.
+"""Device-resident bucketized two-choice hash table for u128 keys.
 
 The TPU-native analog of the reference's groove object cache / cache_map
 (src/lsm/cache_map.zig, src/lsm/set_associative_cache.zig): id -> row-index
 lookups for accounts and transfers, entirely on device, so prefetch needs no
-host round-trip.
+host round-trip. The bucketized layout is the same shape as the reference's
+set-associative cache (src/lsm/set_associative_cache.zig:1 — ways per set),
+chosen here for a harder reason: **no data-dependent control flow**. A
+linear-probing table needs a probe loop, and `lax.while_loop` programs
+execute pathologically through the remote-TPU tunnel (measured: one
+while_loop in any executed program degrades every subsequent dispatch in
+the process from ~20us to ~5-8ms). Two-choice bucketed hashing bounds every
+lookup to exactly two bucket gathers — straight-line data flow.
 
-Layout: three arrays of length cap+1 (cap a power of two); index `cap` is a
-write-dump scratch slot so masked-out scatter lanes never alias a live slot.
+Layout: arrays shaped (B+1, S) with S = 8 slots per bucket; bucket B is a
+write-dump scratch row so masked-out scatter lanes never alias a live slot.
 Key 0 is the empty sentinel — valid object ids are never 0
-(id_must_not_be_zero precedes every insert). Linear probing; batch insert
-resolves intra-batch slot contention with a deterministic scatter-min claim
-round, so table contents are bit-identical for identical inputs regardless
-of scheduling.
+(id_must_not_be_zero precedes every insert). A key lives in one of two
+buckets chosen by independent hashes; inserts fill buckets as prefix of the
+slot axis (occupancy == number of leading non-empty slots, an invariant the
+planner relies on; the table is insert-only). Two-choice with S = 8 keeps
+overflow probability negligible below ~90% load; tables are sized 2x, and
+an insert that finds both buckets full reports failure (the caller treats
+it as a capacity fallback) instead of probing unboundedly.
 
-All entry points are shape-stable and jit-friendly; MAX_PROBES bounds every
-probe chain, and inserts report failure (host resizes and rebuilds) instead
-of looping unboundedly.
+All entry points are shape-stable, loop-free, and deterministic: batch
+inserts resolve intra-batch bucket contention by ranking contenders with a
+stable sort on (bucket, batch index), so table contents are bit-identical
+for identical inputs regardless of scheduling.
 """
 
 from __future__ import annotations
@@ -24,141 +35,184 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-MAX_PROBES = 32
+SLOTS = 8
 
 _C1 = np.uint64(0x9E3779B97F4A7C15)
 _C2 = np.uint64(0xBF58476D1CE4E5B9)
+_C3 = np.uint64(0xD6E8FEB86659FD93)
+_C4 = np.uint64(0x2545F4914F6CDD1D)
 
 
 def ht_init(cap: int) -> dict:
-    """cap must be a power of two, sized >= 2x expected live keys."""
-    assert cap & (cap - 1) == 0
+    """cap must be a power of two >= 2*SLOTS, sized >= 2x expected live
+    keys; B = cap // SLOTS buckets of SLOTS slots (+ one dump bucket)."""
+    assert cap & (cap - 1) == 0 and cap >= 2 * SLOTS
+    b = cap // SLOTS
     return dict(
-        key_hi=jnp.zeros(cap + 1, dtype=jnp.uint64),
-        key_lo=jnp.zeros(cap + 1, dtype=jnp.uint64),
-        val=jnp.zeros(cap + 1, dtype=jnp.int32),
+        key_hi=jnp.zeros((b + 1, SLOTS), dtype=jnp.uint64),
+        key_lo=jnp.zeros((b + 1, SLOTS), dtype=jnp.uint64),
+        val=jnp.zeros((b + 1, SLOTS), dtype=jnp.int32),
     )
 
 
 def ht_cap(table: dict) -> int:
-    return table["key_hi"].shape[0] - 1
+    return (table["key_hi"].shape[0] - 1) * SLOTS
 
 
-def _hash(k_hi, k_lo, cap: int):
-    h = (k_lo ^ (k_hi * _C1)) * _C2
-    h = h ^ (h >> jnp.uint64(31))
-    return (h & jnp.uint64(cap - 1)).astype(jnp.int32)
+def _buckets(k_hi, k_lo, b: int):
+    """Two independent bucket choices in [0, b)."""
+    h1 = (k_lo ^ (k_hi * _C1)) * _C2
+    h1 = h1 ^ (h1 >> jnp.uint64(31))
+    h2 = (k_hi ^ (k_lo * _C3)) * _C4
+    h2 = h2 ^ (h2 >> jnp.uint64(29))
+    mask = jnp.uint64(b - 1)
+    return ((h1 & mask).astype(jnp.int32), (h2 & mask).astype(jnp.int32))
+
+
+def _gather_bucket(table, rows):
+    """Rows of all three arrays at `rows`: each (N, SLOTS)."""
+    return (table["key_hi"][rows], table["key_lo"][rows], table["val"][rows])
 
 
 def ht_lookup(table: dict, k_hi, k_lo):
     """Vectorized lookup. Returns (found: bool[N], val: int32[N]).
 
-    Empty slot terminates the probe chain; keys equal to the sentinel (0)
-    are reported as absent without probing.
-    """
-    cap = ht_cap(table)
-    pos0 = _hash(k_hi, k_lo, cap)
+    Exactly two bucket gathers per query; keys equal to the sentinel (0)
+    are reported as absent. Absence is definitive: a key can only ever
+    reside in one of its two buckets."""
+    b = table["key_hi"].shape[0] - 1
     querying = ~((k_hi == 0) & (k_lo == 0))
-
-    def cond(carry):
-        i, found, val, alive = carry
-        return (i < MAX_PROBES) & jnp.any(alive)
-
-    def body(carry):
-        i, found, val, alive = carry
-        pos = (pos0 + i) & (cap - 1)
-        s_hi = table["key_hi"][pos]
-        s_lo = table["key_lo"][pos]
-        empty = (s_hi == 0) & (s_lo == 0)
-        match = alive & (s_hi == k_hi) & (s_lo == k_lo)
-        found = found | match
-        val = jnp.where(match, table["val"][pos], val)
-        alive = alive & ~empty & ~match
-        return i + 1, found, val, alive
-
+    b1, b2 = _buckets(k_hi, k_lo, b)
     found = jnp.zeros_like(querying)
-    val = jnp.full_like(pos0, -1)
-    _, found, val, _ = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), found, val, querying)
-    )
+    val = jnp.full(k_hi.shape, -1, dtype=jnp.int32)
+    for rows in (b1, b2):
+        s_hi, s_lo, s_val = _gather_bucket(table, rows)
+        match = ((s_hi == k_hi[:, None]) & (s_lo == k_lo[:, None])
+                 & querying[:, None])
+        hit = jnp.any(match, axis=1)
+        lane_val = jnp.max(jnp.where(match, s_val, jnp.int32(-1)), axis=1)
+        found = found | hit
+        val = jnp.where(hit, lane_val, val)
     return found, val
+
+
+def _rank_within(bucket, active, n):
+    """Stable rank of each active lane among active lanes with the same
+    bucket value (0-based, in batch order). Loop-free: one stable argsort
+    of (bucket, lane) with inactive lanes pushed to the end."""
+    idx = jnp.arange(n, dtype=jnp.int32)
+    big = jnp.int64(1) << jnp.int64(62)
+    key = jnp.where(
+        active,
+        (bucket.astype(jnp.int64) << jnp.int64(32)) | idx.astype(jnp.int64),
+        big + idx.astype(jnp.int64))
+    order = jnp.argsort(key).astype(jnp.int32)  # stable
+    b_sorted = bucket[order]
+    a_sorted = active[order]
+    is_start = jnp.concatenate([
+        jnp.ones(1, dtype=jnp.bool_),
+        (b_sorted[1:] != b_sorted[:-1]) | ~a_sorted[:-1]])
+    pos = jnp.arange(n, dtype=jnp.int32)
+    # associative_scan, not jnp.cumsum: cumsum lowers to reduce-window on
+    # TPU, whose scoped-vmem footprint blows the v5e budget (see the
+    # fast-kernels _cumsum note).
+    seg_id = jax.lax.associative_scan(
+        jnp.add, is_start.astype(jnp.int32)) - 1
+    seg_start = jax.ops.segment_min(
+        jnp.where(is_start, pos, jnp.int32(n)), seg_id,
+        num_segments=n)[seg_id]
+    rank_sorted = pos - seg_start
+    rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted)
+    return jnp.where(active, rank, jnp.int32(0))
 
 
 def ht_plan(table: dict, k_hi, k_lo, mask):
     """Plan a batch insert WITHOUT touching the table: returns
-    (pos: int32[N], ok: bool scalar) where pos[i] is the slot key i will
-    occupy. Caller guarantees masked keys are unique and absent.
+    (pos: int32[N] flat slot index, ok: bool scalar). Caller guarantees
+    masked keys are unique and absent.
 
-    Deterministic parallel claim: each probe round, every unplaced key
-    scatter-mins its batch index into a claim grid at its probe slot; the
-    winner (lowest batch index) takes an empty unclaimed slot, losers
-    advance their probe. The claim grid persists across rounds so a slot
-    claimed in round r is occupied for round r+1. ok=False if any key is
-    unplaced after MAX_PROBES (caller treats as capacity fallback).
+    Round 1 places each key at the tail of its less-loaded bucket, ranking
+    intra-batch contenders stably by batch index; lanes that overflow SLOTS
+    retry in their other bucket in round 2 (accounting for round-1
+    placements). ok=False if any masked lane remains unplaced — the caller
+    treats that as a capacity fallback and aborts the batch's writes.
 
     Separating plan from write lets callers compute a global commit/abort
     decision first and then apply all writes masked — no state copies for
-    the abort path.
-    """
-    cap = ht_cap(table)
-    N = k_hi.shape[0]
-    pos0 = _hash(k_hi, k_lo, cap)
-    idx = jnp.arange(N, dtype=jnp.int32)
-    big = jnp.int32(N)
-    dump = jnp.int32(cap)
+    the abort path."""
+    b = table["key_hi"].shape[0] - 1
+    n = k_hi.shape[0]
+    dump = jnp.int32(b * SLOTS)
+    b1, b2 = _buckets(k_hi, k_lo, b)
 
-    def cond(carry):
-        i, claim, placed, probe, out = carry
-        return (i < MAX_PROBES) & ~jnp.all(placed | ~mask)
+    occ1 = jnp.sum(
+        (table["key_hi"][b1] != 0) | (table["key_lo"][b1] != 0), axis=1
+    ).astype(jnp.int32)
+    occ2 = jnp.sum(
+        (table["key_hi"][b2] != 0) | (table["key_lo"][b2] != 0), axis=1
+    ).astype(jnp.int32)
 
-    def body(carry):
-        i, claim, placed, probe, out = carry
-        pos = (pos0 + probe) & (cap - 1)
-        slot_free = ((table["key_hi"][pos] == 0)
-                     & (table["key_lo"][pos] == 0)
-                     & (claim[pos] == big))
-        want = ~placed & mask & slot_free
-        tpos = jnp.where(want, pos, dump)
-        claim = claim.at[tpos].min(idx)
-        won = want & (claim[pos] == idx)
-        out = jnp.where(won, pos, out)
-        placed = placed | won
-        probe = jnp.where(~placed & mask, probe + 1, probe)
-        return i + 1, claim, placed, probe, out
+    take1 = occ1 <= occ2
+    tgt = jnp.where(take1, b1, b2)
+    alt = jnp.where(take1, b2, b1)
+    occ_t = jnp.where(take1, occ1, occ2)
+    occ_a = jnp.where(take1, occ2, occ1)
 
-    claim0 = jnp.full(cap + 1, big, dtype=jnp.int32)
-    placed0 = jnp.zeros(N, dtype=jnp.bool_)
-    probe0 = jnp.zeros(N, dtype=jnp.int32)
-    out0 = jnp.full(N, dump, dtype=jnp.int32)
-    _, _, placed, _, out = jax.lax.while_loop(
-        cond, body, (jnp.int32(0), claim0, placed0, probe0, out0)
-    )
-    ok = jnp.all(placed | ~mask)
-    return out, ok
+    # Round 1: rank contenders per target bucket, append after occupancy.
+    r1 = _rank_within(tgt, mask, n)
+    slot1 = occ_t + r1
+    placed1 = mask & (slot1 < SLOTS)
+
+    # Round 2: overflow lanes retry their other bucket. Effective occupancy
+    # includes round-1 placements into that bucket.
+    retry = mask & ~placed1
+    placed1_per_bucket = jax.ops.segment_sum(
+        placed1.astype(jnp.int32), jnp.where(placed1, tgt, b),
+        num_segments=b + 1)
+    r2 = _rank_within(alt, retry, n)
+    slot2 = occ_a + placed1_per_bucket[alt] + r2
+    placed2 = retry & (slot2 < SLOTS)
+
+    pos = jnp.where(
+        placed1, tgt * SLOTS + slot1,
+        jnp.where(placed2, alt * SLOTS + slot2, dump))
+    ok = jnp.all(placed1 | placed2 | ~mask)
+    return pos, ok
 
 
 def ht_write(table: dict, pos, k_hi, k_lo, vals, mask):
-    """Apply a planned insert: one masked scatter per array (index cap is the
-    dump slot for masked-out lanes)."""
-    cap = ht_cap(table)
-    wpos = jnp.where(mask, pos, jnp.int32(cap))
-    return dict(
-        key_hi=table["key_hi"].at[wpos].set(k_hi),
-        key_lo=table["key_lo"].at[wpos].set(k_lo),
-        val=table["val"].at[wpos].set(vals),
-    )
+    """Apply a planned insert: one masked scatter per array (the dump
+    bucket absorbs masked-out lanes)."""
+    b = table["key_hi"].shape[0] - 1
+    shape = table["key_hi"].shape
+    flat = shape[0] * shape[1]
+    wpos = jnp.where(mask, pos, jnp.int32(b * SLOTS))
+    out = {}
+    for name, v in (("key_hi", k_hi), ("key_lo", k_lo), ("val", vals)):
+        out[name] = (table[name].reshape(flat).at[wpos].set(v)
+                     .reshape(shape))
+    return out
 
 
 def ht_insert(table: dict, k_hi, k_lo, vals, mask):
-    """plan + write in one call. Returns (table, ok). On ok=False the table
-    still received the keys that did place; callers that need atomicity use
-    ht_plan/ht_write with their own commit mask."""
+    """plan + write in one call. Returns (table, ok). On ok=False nothing
+    is written (the whole masked set is rejected atomically, matching the
+    capacity-fallback contract)."""
     pos, ok = ht_plan(table, k_hi, k_lo, mask)
     table = ht_write(table, pos, k_hi, k_lo, vals, mask & ok)
     return table, ok
 
 
+def ht_live_keys(table: dict):
+    """Host helper: (key_hi, key_lo) numpy arrays of all live slots
+    (dump bucket excluded)."""
+    kh = np.asarray(table["key_hi"])[:-1].reshape(-1)
+    kl = np.asarray(table["key_lo"])[:-1].reshape(-1)
+    live = (kh != 0) | (kl != 0)
+    return kh[live], kl[live]
+
+
 # Jitted entry point for host-driven batch inserts (the mirror regime's
-# delta pushes call this repeatedly; without jit the while_loop inside
-# would re-trace and re-compile on every call).
+# delta pushes call this repeatedly; without jit the sort inside would
+# re-trace on every call).
 ht_insert_jit = jax.jit(ht_insert, donate_argnums=0)

@@ -148,7 +148,7 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
 
     if orphan_cap is None:
         # Orphaned (transient-failure) ids are never evicted; keep the table
-        # load low enough that 32-probe chains stay improbable even for
+        # load low enough that bucket overflow stays improbable even for
         # failure-heavy workloads.
         orphan_cap = max(1 << 16, t_cap)
     return dict(
@@ -459,11 +459,11 @@ class DeviceLedger:
                         and t.timeout != 0):
                     sm.expiry[t.timestamp] = t.timestamp + t.timeout * NS_PER_S
 
-        orph = {k: np.asarray(v) for k, v in self.state["orphan_ht"].items()}
-        live = (orph["key_hi"][:-1] != 0) | (orph["key_lo"][:-1] != 0)
-        for pos in np.nonzero(live)[0]:
-            sm.orphaned.add(
-                u128.to_int(orph["key_hi"][pos], orph["key_lo"][pos]))
+        from .hash_table import ht_live_keys
+
+        o_hi, o_lo = ht_live_keys(self.state["orphan_ht"])
+        for hi_k, lo_k in zip(o_hi.tolist(), o_lo.tolist()):
+            sm.orphaned.add(u128.to_int(hi_k, lo_k))
 
         sm.accounts_key_max = int(self.state["acct_key_max"]) or None
         sm.transfers_key_max = int(self.state["xfer_key_max"]) or None
