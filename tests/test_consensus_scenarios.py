@@ -11,7 +11,44 @@ from tests.test_nack import (
     _prepare_msg,
     _svc,
 )
+from tigerbeetle_tpu.types import Operation
 from tigerbeetle_tpu.vsr.header import Command, Header, Message
+
+
+def _genesis() -> int:
+    from tigerbeetle_tpu.vsr.checksum import checksum
+
+    return checksum(CLUSTER.to_bytes(16, "little"), domain=b"genesis")
+
+
+def _pulse_msg(op: int, *, view: int = 0, parent: int = 0,
+               commit: int = 0) -> Message:
+    """A committable prepare (pulse, empty body — scripted scenarios that
+    advance commit_min execute the real state machine)."""
+    header = Header(command=Command.prepare, cluster=CLUSTER, view=view,
+                    op=op, operation=int(Operation.pulse), parent=parent,
+                    commit=commit, timestamp=op * 10**9)
+    return Message(header.finalize())
+
+
+def _pulse_chain(n, start_op=1, parent=None, view=0, commit=0):
+    """A hash chain of committable prepares; op 1 chains from the genesis
+    checksum (the cluster's op-0 parent)."""
+    if parent is None:
+        parent = _genesis() if start_op == 1 else 0
+    msgs = []
+    for op in range(start_op, start_op + n):
+        m = _pulse_msg(op, view=view, parent=parent, commit=commit)
+        parent = m.header.checksum
+        msgs.append(m)
+    return msgs
+
+
+def _ok(replica: int, view: int, prepare: Message) -> Message:
+    h = Header(command=Command.prepare_ok, cluster=CLUSTER, replica=replica,
+               view=view, op=prepare.header.op,
+               context=prepare.header.checksum)
+    return Message(h.finalize())
 
 
 def _chain(n, start_op=1, parent=0, view=0):
@@ -131,6 +168,37 @@ class TestViewChangeScenarios:
         r.on_message(Message(sv.finalize(body), body=body))
         assert r.view == 2 and r.op == 3
 
+    def test_uncommitted_suffix_recommitted_in_new_view(self):
+        """Possibly-committed ops survive a view change: the new primary
+        re-replicates the canonical uncommitted suffix and commits it once
+        the new view's quorum acks (VSR safety — the view-change quorum
+        intersects every replication quorum; reference: replica.zig
+        primary repair + re-replication after start_view)."""
+        r, bus, _ = _mk_replica(2)
+        msgs = _pulse_chain(3)
+        for m in msgs:
+            r.journal.append(m)
+        r.op = 3
+        for peer in (3, 4, 5):
+            r.on_message(_svc(peer, 2))
+        headers = [m.header for m in msgs]
+        for peer in (3, 4, 5):
+            r.on_message(_dvc(peer, 2, 3, 0, 0, headers))
+        # Log complete -> the view finalized and the suffix was
+        # re-replicated (fresh quorum gathering).
+        assert r.status == "normal" and r._pending_view is None
+        assert set(r.pipeline) == {1, 2, 3}
+        resent = {m.header.op for _, m in bus.of(Command.prepare)}
+        assert resent == {1, 2, 3}
+        # Two peer acks (+ self) = replication quorum of 3: all commit.
+        for m in msgs:
+            r.on_message(_ok(3, 2, m))
+        assert r.commit_min == 0, "one ack + self is below quorum"
+        for m in msgs:
+            r.on_message(_ok(4, 2, m))
+        assert r.commit_min == 3
+        assert not r.pipeline
+
     def test_request_start_view_answered_by_primary(self):
         """A lagging replica probing with request_start_view gets the
         current view's start_view back (standby/rejoin catch-up path)."""
@@ -149,3 +217,137 @@ class TestViewChangeScenarios:
         svs = bus.of(Command.start_view)
         assert svs and svs[-1][0] == 5
         assert svs[-1][1].header.op == 2
+
+
+class TestCommitPipeline:
+    def test_quorum_commits_in_pipeline_order(self):
+        """Out-of-order quorum completion must not commit out of order:
+        op 2's quorum completing before op 1's commits nothing until op 1
+        completes (reference: commit_dispatch executes strictly in op
+        order, replica.zig:4374)."""
+        r, bus, _ = _mk_replica(0)
+        r.status = "normal"
+        assert r.is_primary
+        msgs = _pulse_chain(2)
+        for m in msgs:
+            r.journal.append(m)
+            r.pipeline[m.header.op] = {
+                "message": m, "oks": {r.replica_id}}
+        r.op = 2
+        # Quorum for op 2 first: nothing commits (op 1 incomplete).
+        r.on_message(_ok(1, 0, msgs[1]))
+        r.on_message(_ok(2, 0, msgs[1]))
+        assert r.commit_min == 0 and 2 in r.pipeline
+        # Op 1 completes: both commit, in order.
+        r.on_message(_ok(1, 0, msgs[0]))
+        r.on_message(_ok(2, 0, msgs[0]))
+        assert r.commit_min == 2
+        assert not r.pipeline
+
+    def test_mismatched_ok_checksum_does_not_count(self):
+        """A prepare_ok for a different prepare under the same op number
+        (stale view) must not count toward the quorum."""
+        r, bus, _ = _mk_replica(0)
+        r.status = "normal"
+        m = _pulse_chain(1)[0]
+        r.journal.append(m)
+        r.pipeline[1] = {"message": m, "oks": {r.replica_id}}
+        r.op = 1
+        impostor = _prepare_msg(1)  # different body -> different checksum
+        r.on_message(_ok(1, 0, impostor))
+        r.on_message(_ok(2, 0, impostor))
+        assert r.commit_min == 0
+        r.on_message(_ok(1, 0, m))
+        r.on_message(_ok(2, 0, m))
+        assert r.commit_min == 1
+
+    def test_backup_executes_via_heartbeat_commit(self):
+        """Backups learn commits from the primary's commit heartbeat and
+        execute from their journal (reference: commit heartbeats,
+        docs/internals/vsr.md:79-81)."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        for m in _pulse_chain(3):
+            r.on_message(m)
+        assert r.op == 3 and r.commit_min == 0
+        hb = Header(command=Command.commit, cluster=CLUSTER, replica=0,
+                    view=0, commit=3)
+        r.on_message(Message(hb.finalize()))
+        assert r.commit_min == 3
+
+    def test_faulty_slot_blocks_then_repairs_then_commits(self):
+        """A backup with a corrupt WAL slot inside the committed prefix
+        requests the prepare, re-journals the served body, and resumes
+        execution (reference: journal repair, docs/internals/vsr.md:
+        188-257)."""
+        r, bus, time = _mk_replica(1)
+        r.status = "normal"
+        msgs = _pulse_chain(3)
+        for m in msgs:
+            r.on_message(m)
+        # Corrupt op 2: header ring forgets it, slot marked faulty.
+        slot = r.journal.slot_for_op(2)
+        r.journal.headers[slot] = None
+        r.journal.faulty.add(slot)
+        hb = Header(command=Command.commit, cluster=CLUSTER, replica=0,
+                    view=0, commit=3)
+        r.on_message(Message(hb.finalize()))
+        assert r.commit_min == 1, "execution must stop at the hole"
+        assert 2 in r.repair_requested
+        time.advance(60 * 10**6)
+        r.tick()
+        assert any(m.header.op == 2
+                   for _, m in bus.of(Command.request_prepare))
+        r.on_message(msgs[1])  # a peer serves the prepare
+        assert r.journal.read_prepare(2) is not None
+        assert r.commit_min == 3
+
+
+class TestStaleLeftovers:
+    def test_chain_tripwire_quarantines_stale_same_op_prepare(self):
+        """A deposed primary's prepare under a reused op number chains
+        from nothing we executed: the backward-chain tripwire must
+        quarantine it (chain_suspect) and repair, never execute it
+        (reference: the reuse-op hazard behind protocol-aware recovery,
+        docs/ARCHITECTURE.md:540-563)."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        good = _pulse_chain(2)
+        for m in good:
+            r.on_message(m)
+        # Stale op 3 from a deposed primary: parent checksum garbage.
+        stale = _pulse_msg(3, parent=0xDEAD)
+        r.journal.append(stale)
+        r.op = 3
+        hb = Header(command=Command.commit, cluster=CLUSTER, replica=0,
+                    view=0, commit=3)
+        r.on_message(Message(hb.finalize()))
+        assert r.commit_min == 2, "stale prepare must not execute"
+        assert 3 in r.chain_suspect and 3 in r.repair_requested
+        # The true op 3 (chains from op 2) arrives: replaces and executes.
+        true3 = _pulse_msg(3, parent=good[-1].header.checksum)
+        r.on_message(true3)
+        assert r.commit_min == 3
+        held = r.journal.read_prepare(3)
+        assert held.header.checksum == true3.header.checksum
+        assert 3 not in r.chain_suspect
+
+    def test_sync_floor_blocks_unverifiable_prefix(self):
+        """A start_view whose suffix begins beyond our position proves the
+        electorate checkpointed past us: our journaled leftovers below the
+        suffix base are unverifiable and must never execute — repair leads
+        to state sync instead (reference: sync.md's checkpoint-jump
+        trigger)."""
+        r, bus, _ = _mk_replica(1)
+        r.status = "normal"
+        for m in _pulse_chain(3):
+            r.on_message(m)
+        assert r.commit_min == 0
+        # New primary's start_view: suffix covers only ops 50..52.
+        far = _pulse_chain(3, start_op=50)
+        body = b"".join(m.header.pack() for m in far)
+        sv = Header(command=Command.start_view, cluster=CLUSTER, replica=2,
+                    view=2, op=52, commit=52)
+        r.on_message(Message(sv.finalize(body), body=body))
+        assert r.sync_floor >= 50
+        assert r.commit_min == 0, "unverifiable ops 1..3 must not execute"

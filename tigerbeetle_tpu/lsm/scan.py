@@ -40,23 +40,34 @@ class TreeScan:
     lazily with newest-first dedupe; tombstones are filtered. Implements
     the SeekableStream protocol for zig-zag intersection."""
 
-    def __init__(self, tree: Tree, key_min: bytes, key_max: bytes):
+    def __init__(self, tree: Tree, key_min: bytes, key_max: bytes,
+                 snapshot: Optional[int] = None):
         self.tree = tree
         self.key_min = key_min
         self.key_max = key_max
+        # snapshot=None scans the latest state (memtable included);
+        # snapshot=s scans the table set visible at op s — the view stays
+        # consistent while compaction mutates the levels mid-scan
+        # (reference: scans pin a snapshot in manifest_level.zig).
+        self.snapshot = snapshot
         self._head: Optional[tuple] = None
         self._exhausted = False
         self._iter = self._merged(key_min)
         self._advance()
 
     def _sources(self, start: bytes):
-        memtable = sorted(
-            (k, v) for k, v in self.tree.memtable.items()
-            if start <= k <= self.key_max)
-        sources = [memtable]
+        if self.snapshot is None:
+            memtable = sorted(
+                (k, v) for k, v in self.tree.memtable.items()
+                if start <= k <= self.key_max)
+            sources = [memtable]
+        else:
+            sources = []
         # Levels newest-first; within L0, newest table first (L0 overlaps).
         for level_i, level in enumerate(self.tree.levels):
-            tables = reversed(level) if level_i == 0 else level
+            entries = level.visible(self.snapshot)
+            tables = [e.table for e in
+                      (reversed(entries) if level_i == 0 else entries)]
             for table in tables:
                 if (table.info.key_max < start
                         or table.info.key_min > self.key_max):

@@ -22,12 +22,12 @@ crash/replay (jobs hold only memory until completion)."""
 
 from __future__ import annotations
 
-import bisect
 import dataclasses
 import struct
 from typing import Optional
 
 from .grid import Grid
+from .manifest_level import SNAPSHOT_LATEST, ManifestLevel
 from .table import (
     Table,
     TableInfo,
@@ -82,7 +82,12 @@ class Tree:
         self.value_size = value_size
         self.name = name
         self.memtable: dict[bytes, bytes] = {}
-        self.levels: list[list[Table]] = [[] for _ in range(LSM_LEVELS)]
+        # Per-level manifest structures over (key range x snapshot range)
+        # (reference: src/lsm/manifest_level.zig). L0 tables overlap
+        # (insertion order, recency decides); deeper levels are disjoint
+        # per snapshot (key_min order, binary-searched).
+        self.levels: list[ManifestLevel] = [
+            ManifestLevel(keep_sorted=(i > 0)) for i in range(LSM_LEVELS)]
         self.beat = 0
         # In-flight incremental compaction jobs (scheduled at bar start,
         # advanced per beat, drained by bar end).
@@ -99,40 +104,38 @@ class Tree:
         assert len(key) == self.key_size
         self.memtable[key] = TOMBSTONE * self.value_size
 
-    def get(self, key: bytes) -> Optional[bytes]:
-        value = self.memtable.get(key)
+    def get(self, key: bytes,
+            snapshot: Optional[int] = None) -> Optional[bytes]:
+        """Point lookup. snapshot=None serves the latest state (memtable
+        included); snapshot=s reads the table set visible at op s — a
+        point-in-time view that stays consistent while compaction installs
+        and removes tables around it (valid within the tree's one-bar
+        retention window; reference: manifest snapshot queries,
+        src/lsm/manifest_level.zig)."""
+        value = self.memtable.get(key) if snapshot is None else None
         if value is None:
-            # L0 tables may overlap: newest-first linear probe.
-            for table in reversed(self.levels[0]):
-                value = table.get(key)
-                if value is not None:
-                    break
-        if value is None:
-            # Deeper levels are disjoint and kept sorted by key_min
-            # (bisect_insert): binary-search the ONE candidate table per
-            # level instead of probing them all (reference: the manifest
-            # level structure's key-range lookup,
-            # src/lsm/manifest_level.zig).
-            for level in self.levels[1:]:
-                if not level:
-                    continue
-                i = bisect.bisect_right(
-                    level, key, key=lambda t: t.info.key_min) - 1
-                if i >= 0 and key <= level[i].info.key_max:
-                    value = level[i].get(key)
+            # L0 tables may overlap: newest-first probe; deeper levels
+            # yield at most one candidate per snapshot (binary-searched on
+            # the live set for the latest snapshot).
+            for level in self.levels:
+                for table in level.lookup(key, snapshot):
+                    value = table.get(key)
                     if value is not None:
                         break
+                if value is not None:
+                    break
         if value is None or value == TOMBSTONE * self.value_size:
             return None
         return value
 
-    def scan(self, key_min: bytes, key_max: bytes) -> list[tuple[bytes, bytes]]:
+    def scan(self, key_min: bytes, key_max: bytes,
+             snapshot: Optional[int] = None) -> list[tuple[bytes, bytes]]:
         """Merged range scan, newest version wins (streaming k-way merge
         over memtable + levels — reference: scan_tree.zig; the lazy
         iterator API is lsm/scan.py's TreeScan)."""
         from .scan import TreeScan
 
-        return list(TreeScan(self, key_min, key_max))
+        return list(TreeScan(self, key_min, key_max, snapshot=snapshot))
 
     # ---------------------------------------------------------- compaction
 
@@ -153,6 +156,11 @@ class Tree:
         if phase == 0:
             self.flush_memtable()
             self._drain_jobs()  # defensive: a bar never leaves work behind
+            # Physically release tables removed at least one full bar ago
+            # (snapshot reads within the retention window stay valid; a
+            # pure function of the op sequence, so every replica frees the
+            # identical block set — physical determinism).
+            self._prune(self.beat - BAR_LENGTH)
             self._schedule_jobs()
         if self._jobs:
             if phase == BAR_LENGTH - 1:
@@ -166,9 +174,15 @@ class Tree:
         entries = sorted(self.memtable.items())
         for info in write_tables(self.grid, entries, self.key_size,
                                  self.value_size):
-            self.levels[0].append(
-                Table(self.grid, info, self.key_size, self.value_size))
+            self.levels[0].insert(
+                Table(self.grid, info, self.key_size, self.value_size),
+                snapshot=self.beat)
         self.memtable.clear()
+
+    def _prune(self, snapshot_oldest: int) -> None:
+        for level in self.levels:
+            for table in level.prune(snapshot_oldest):
+                release_table(self.grid, table)
 
     def _level_budget(self, level: int) -> int:
         if level == 0:
@@ -225,14 +239,17 @@ class Tree:
         self._jobs = []
 
     def _finalize_job(self, job: _CompactionJob) -> None:
-        """Write output tables, install, release inputs — the only beat
-        that touches the grid (mid-bar checkpoints therefore never see a
-        partially-written compaction)."""
+        """Write output tables, install, logically remove inputs — the
+        only beat that touches the grid (mid-bar checkpoints therefore
+        never see a partially-written compaction). Inputs move to the
+        manifest's history (snapshot_max = this op) and stay readable for
+        snapshots taken before this beat; their blocks are freed by
+        `_prune` a bar later."""
         level = job.level
-        self.levels[level].remove(job.table)
+        self.levels[level].remove(job.table, snapshot=self.beat)
         next_level = self.levels[level + 1]
         for t in job.overlapping:
-            next_level.remove(t)
+            next_level.remove(t, snapshot=self.beat)
         last_level = level + 1 == LSM_LEVELS - 1
         dead = TOMBSTONE * self.value_size
         entries = sorted(
@@ -243,11 +260,9 @@ class Tree:
             # several disjoint tables (all still inside next_level's range).
             for info in write_tables(self.grid, entries, self.key_size,
                                      self.value_size):
-                bisect_insert(next_level, Table(
-                    self.grid, info, self.key_size, self.value_size))
-        release_table(self.grid, job.table)
-        for t in job.overlapping:
-            release_table(self.grid, t)
+                next_level.insert(Table(
+                    self.grid, info, self.key_size, self.value_size),
+                    snapshot=self.beat)
 
     def _pick_table(self, level: int) -> Table:
         """Selection policy: L0 tables overlap each other, so only the
@@ -281,9 +296,12 @@ class Tree:
         self.flush_memtable()
         parts = [struct.pack("<B", LSM_LEVELS)]
         for level in self.levels:
-            parts.append(struct.pack("<I", len(level)))
-            for table in level:
-                parts.append(table.info.pack())
+            entries = list(level.live) + list(level.history)
+            parts.append(struct.pack("<I", len(entries)))
+            for e in entries:
+                parts.append(struct.pack("<QQ", e.snapshot_min,
+                                         e.snapshot_max))
+                parts.append(e.table.info.pack())
         parts.append(struct.pack("<I", len(self._jobs)))
         for job in self._jobs:
             parts.append(struct.pack("<BI", job.level, len(job.overlapping)))
@@ -293,17 +311,28 @@ class Tree:
         return b"".join(parts)
 
     def manifest_restore(self, raw: bytes) -> None:
+        from .manifest_level import LevelEntry
+
         (n_levels,) = struct.unpack_from("<B", raw)
         assert n_levels == LSM_LEVELS
         pos = 1
-        self.levels = [[] for _ in range(LSM_LEVELS)]
+        self.levels = [ManifestLevel(keep_sorted=(i > 0))
+                       for i in range(LSM_LEVELS)]
         for level in range(n_levels):
             (count,) = struct.unpack_from("<I", raw, pos)
             pos += 4
             for _ in range(count):
+                snap_min, snap_max = struct.unpack_from("<QQ", raw, pos)
+                pos += 16
                 info, pos = TableInfo.unpack(raw, pos)
-                self.levels[level].append(Table(
-                    self.grid, info, self.key_size, self.value_size))
+                table = Table(self.grid, info, self.key_size,
+                              self.value_size)
+                if snap_max == SNAPSHOT_LATEST:
+                    self.levels[level].insert(table, snapshot=snap_min)
+                else:
+                    self.levels[level].history.append(LevelEntry(
+                        table=table, snapshot_min=snap_min,
+                        snapshot_max=snap_max))
         self.memtable.clear()
         # Rebuild in-flight jobs against the RESTORED Table objects
         # (identity matters: finalize removes job tables from the level
@@ -343,9 +372,3 @@ class Tree:
             self._per_beat = max(1, -(-total // (BAR_LENGTH - 1)))
 
 
-def bisect_insert(level: list[Table], table: Table) -> None:
-    """Keep levels ordered by key_min (disjoint above L0)."""
-    i = 0
-    while i < len(level) and level[i].info.key_min < table.info.key_min:
-        i += 1
-    level.insert(i, table)

@@ -24,13 +24,16 @@ import dataclasses
 # Bump on every protocol-visible change.
 # r2: manifest chain headers + full secondary-index tree schema (r1 data
 #     files must be rebuilt via `recover`).
-RELEASE = 2
+# r3: manifest entries carry (snapshot_min, snapshot_max) ranges
+#     (lsm/manifest_level.py) — the packed layout shifted by 16 bytes per
+#     table entry.
+RELEASE = 3
 
 # Oldest checkpoint format this binary still opens. Checkpoints below the
 # floor are refused at open with a rebuild instruction — enforcing the
-# "r1 data files must be rebuilt" requirement instead of silently opening
-# them with the 12 new index trees empty for all pre-upgrade rows.
-FORMAT_FLOOR = 2
+# "old data files must be rebuilt" requirement instead of silently
+# misparsing the shifted manifest layout.
+FORMAT_FLOOR = 3
 
 
 def release_str(release: int) -> str:

@@ -433,10 +433,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     z64 = jnp.uint64(0)
     ral0, ral1, ral2, ral3 = _to_limbs(
         jnp.where(reg, amt_res_hi, z64), jnp.where(reg, amt_res_lo, z64))
+    ral = jnp.stack([ral0, ral1, ral2, ral3], axis=1)  # (N, 4)
 
     def _acct_load(rows):
-        return [jax.ops.segment_sum(l, rows, num_segments=A_rows)
-                for l in (ral0, ral1, ral2, ral3)]
+        # One batched segment_sum for all four limbs (rows sum per limb).
+        s = jax.ops.segment_sum(ral, rows, num_segments=A_rows)
+        return [s[:, j] for j in range(4)]
 
     def _breach(load, held1, held2, against1, limit_bit):
         # (held1 + held2 + load) > against1, evaluated in 5 limbs

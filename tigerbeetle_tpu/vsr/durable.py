@@ -159,6 +159,11 @@ def manifest_children(manifest_raw: bytes) -> list:
             (n_tables,) = struct.unpack_from("<I", raw, tpos)
             tpos += 4
             for _ in range(n_tables):
+                # Each entry: snapshot range (2x u64, lsm.manifest_level)
+                # then the TableInfo. History entries (removed, unpruned)
+                # are reachable too — their blocks are still allocated
+                # until the retention bar elapses.
+                tpos += 16
                 info, tpos = TableInfo.unpack(raw, tpos)
                 out.append((name, key_size, info))
     return out
