@@ -1,0 +1,10 @@
+{
+  "targets": [
+    {
+      "target_name": "tb_client",
+      "sources": ["addon/addon.c"],
+      "libraries": ["-ltb_client", "-L<(module_root_dir)/../../native"],
+      "ldflags": ["-Wl,-rpath,<(module_root_dir)/../../native"]
+    }
+  ]
+}

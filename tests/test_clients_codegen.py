@@ -1,0 +1,120 @@
+"""Client binding generator: drift, layout parity, enum completeness.
+
+reference: the per-language binding codegen under src/clients/ — the
+reference CI regenerates bindings and fails on drift; the layout-parity
+test here is the analog of its comptime size/offset asserts.
+"""
+
+import os
+
+from tigerbeetle_tpu import types as T
+from tigerbeetle_tpu.clients import codegen
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestLayouts:
+    def test_struct_sizes(self):
+        assert codegen.struct_size("Account") == 128
+        assert codegen.struct_size("Transfer") == 128
+        assert codegen.struct_size("AccountBalance") == 128
+        assert codegen.struct_size("AccountFilter") == 128
+        assert codegen.struct_size("QueryFilter") == 64
+        assert codegen.struct_size("CreateAccountResult") == 16
+        assert codegen.struct_size("CreateTransferResult") == 16
+
+    def test_layout_matches_types_pack(self):
+        """Byte-for-byte: each field's slice at the generator's offset must
+        equal the field's own encoding in types.py's pack() output — the
+        property the generated Go/Node marshallers are built on."""
+        for name, fields in codegen.LAYOUTS.items():
+            cls = codegen.PY_CLASSES[name]
+            # Distinct sentinel per field (within each field's range).
+            sentinels = {}
+            for i, (field, kind) in enumerate(fields):
+                if kind.startswith("pad"):
+                    continue
+                bits = 128 if kind == "u128" else int(kind[1:])
+                sentinels[field] = (0x0101010101010101 * (i + 1)) % (1 << bits)
+            kwargs = dict(sentinels)
+            if name.endswith("Result"):
+                # status is an enum field on the Python class.
+                enum_cls = (T.CreateAccountStatus if "Account" in name
+                            else T.CreateTransferStatus)
+                kwargs["status"] = enum_cls.linked_event_failed
+                sentinels["status"] = int(enum_cls.linked_event_failed)
+                kwargs.pop("reserved", None)
+                sentinels["reserved"] = 0
+            if name == "Account":
+                pass  # reserved is a real (zero-required) wire field
+            packed = cls(**{k: v for k, v in kwargs.items()
+                            if k in cls.__dataclass_fields__}).pack()
+            assert len(packed) == codegen.struct_size(name), name
+            for field, kind, off in codegen.offsets(name):
+                size = codegen.field_size(kind)
+                got = packed[off:off + size]
+                if kind.startswith("pad"):
+                    assert got == b"\x00" * size, (name, field)
+                    continue
+                want_val = sentinels.get(field, 0)
+                if field in cls.__dataclass_fields__:
+                    want = want_val.to_bytes(size, "little")
+                else:
+                    want = (0).to_bytes(size, "little")
+                assert got == want, (name, field, got.hex(), want.hex())
+
+    def test_unpack_round_trip_at_offsets(self):
+        """The generated unpackers read the same offsets pack writes."""
+        t = T.Transfer(id=(1 << 127) | 5, debit_account_id=2,
+                       credit_account_id=3, amount=(1 << 64) + 7,
+                       pending_id=9, user_data_128=11, user_data_64=13,
+                       user_data_32=17, timeout=19, ledger=23, code=29,
+                       flags=int(T.TransferFlags.pending), timestamp=31)
+        raw = t.pack()
+        off = dict((f, o) for f, _, o in codegen.offsets("Transfer"))
+        assert int.from_bytes(raw[off["id"]:off["id"] + 16],
+                              "little") == t.id
+        assert int.from_bytes(raw[off["amount"]:off["amount"] + 16],
+                              "little") == t.amount
+        assert int.from_bytes(raw[off["flags"]:off["flags"] + 2],
+                              "little") == t.flags
+
+
+class TestGeneratedSources:
+    def test_committed_sources_match_generator(self):
+        """Drift check: clients/go + clients/node must be exactly what the
+        generator emits (regenerate with `python -m tigerbeetle_tpu
+        clients`)."""
+        for rel, want in codegen.generate_all().items():
+            path = os.path.join(REPO, "clients", rel)
+            assert os.path.exists(path), f"missing generated file: {rel}"
+            with open(path) as f:
+                assert f.read() == want, f"stale generated file: {rel}"
+
+    def test_status_enums_complete(self):
+        go_types = codegen.generate_go()["go/tigerbeetle/types.go"]
+        node_types = codegen.generate_node()["node/lib/types.js"]
+        for status in T.CreateTransferStatus:
+            go_name = "CreateTransferStatus" + "".join(
+                p.capitalize() for p in status.name.split("_"))
+            assert f"{go_name} CreateTransferStatus = {int(status)}" \
+                in go_types, status.name
+            assert f"{status.name}: {int(status)}," in node_types, status.name
+        for op in T.Operation:
+            assert f"{op.name}: {int(op)}," in node_types, op.name
+
+    def test_generated_c_abi_matches_native(self):
+        """The addon/cgo extern declarations must cover exactly the tbp_*
+        functions native/tb_client.cpp exports."""
+        with open(os.path.join(REPO, "native", "tb_client.cpp")) as f:
+            native = f.read()
+        exported = {"tbp_client_init", "tbp_client_init_echo",
+                    "tbp_client_submit", "tbp_client_wait",
+                    "tbp_client_packet_free", "tbp_client_deinit"}
+        for fn in exported:
+            assert fn in native, fn
+        go_client = codegen.generate_go()["go/tigerbeetle/client.go"]
+        addon = codegen.generate_node()["node/addon/addon.c"]
+        for fn in exported - {"tbp_client_packet_free"}:
+            assert fn in go_client, fn
+            assert fn in addon, fn

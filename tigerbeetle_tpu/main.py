@@ -509,6 +509,18 @@ def cmd_cfo(args) -> int:
     return 1 if failures else 0
 
 
+def cmd_clients(args) -> int:
+    """Regenerate the Go/Node client packages (reference: the per-language
+    codegen under src/clients/, run via `zig build clients:*`)."""
+    from .clients import codegen
+
+    written = codegen.write_out(args.out)
+    for path in written:
+        print(path)
+    print(f"clients: {len(written)} files generated into {args.out}/")
+    return 0
+
+
 def cmd_version(args) -> int:
     from . import __version__
 
@@ -633,6 +645,11 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=None,
                    help="deterministic pair selection (CI); default: random")
     p.set_defaults(fn=cmd_cfo)
+
+    p = sub.add_parser("clients")
+    p.add_argument("--out", default="clients",
+                   help="output root (clients/go, clients/node)")
+    p.set_defaults(fn=cmd_clients)
 
     p = sub.add_parser("version")
     p.set_defaults(fn=cmd_version)
