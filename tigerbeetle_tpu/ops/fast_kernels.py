@@ -120,32 +120,6 @@ def _neg_limbs(hi, lo):
     return _to_limbs(n_hi, n_lo)
 
 
-def _normalize_rows(bal, rows):
-    """Carry-propagate the 4-limb balances at `rows` (dup rows write the same
-    value, so the scatter is deterministic). Result limbs are u32-normalized
-    mod 2^128."""
-    out = dict(bal)
-    for field in ("dp", "dpos", "cp", "cpos"):
-        l0 = bal[f"{field}0"][rows]
-        l1 = bal[f"{field}1"][rows]
-        l2 = bal[f"{field}2"][rows]
-        l3 = bal[f"{field}3"][rows]
-        c = l0 >> jnp.uint64(32)
-        l0 = l0 & _M32
-        l1 = l1 + c
-        c = l1 >> jnp.uint64(32)
-        l1 = l1 & _M32
-        l2 = l2 + c
-        c = l2 >> jnp.uint64(32)
-        l2 = l2 & _M32
-        l3 = (l3 + c) & _M32
-        out[f"{field}0"] = out[f"{field}0"].at[rows].set(l0)
-        out[f"{field}1"] = out[f"{field}1"].at[rows].set(l1)
-        out[f"{field}2"] = out[f"{field}2"].at[rows].set(l2)
-        out[f"{field}3"] = out[f"{field}3"].at[rows].set(l3)
-    return out
-
-
 def _gather_balance(bal, field, rows):
     return _from_limbs(
         bal[f"{field}0"][rows], bal[f"{field}1"][rows],
@@ -204,7 +178,7 @@ def _xfer_gather(xfr, rows):
         "pstat", "dr_row", "cr_row")}
 
 
-def per_event_status(state, ev, ts_event):
+def per_event_status(state, ev, ts_event, return_gathers=False):
     """The per-event phase of create_transfers: hash lookups, row gathers,
     and the order-independent status evaluation (exists/idempotency,
     post/void checks, regular checks, imported/timestamp rules — reference
@@ -215,14 +189,20 @@ def per_event_status(state, ev, ts_event):
     the batch and all-gathers this compact result; the global tail
     (eligibility reductions, chains, application) then runs replicated on
     every device — identical by determinism, so the replicated state stays
-    bit-exact across the mesh."""
+    bit-exact across the mesh.
+
+    return_gathers=True additionally returns the (dr, cr, p, p_dr, p_cr)
+    row gathers for the single-device caller to reuse (the SPMD path must
+    NOT ship them — it re-gathers locally to keep the all-gather
+    compact)."""
     from .hash_table import ht_lookup
 
     acc = state["accounts"]
     xfr = state["transfers"]
     A_dump = acc["id_hi"].shape[0] - 1
     T_dump = xfr["id_hi"].shape[0] - 1
-    valid = ev["valid"]
+    # Note: statuses returned here are NOT valid-masked — the tail in
+    # create_transfers_fast applies the valid mask after chain handling.
 
     flags = ev["flags"]
     pending = _flag(flags, _F_PENDING)
@@ -347,12 +327,15 @@ def per_event_status(state, ev, ts_event):
     status = jnp.where(imported, _TS["imported_event_not_expected"], status)
     ts_actual = jnp.where(status == inner, ts_inner, ts_event)
 
-    return dict(
+    out = dict(
         status_pre=status, ts_pre=ts_actual,
         amt_res_hi=amt_res_hi, amt_res_lo=amt_res_lo,
         dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
         dr_found=dr_found, cr_found=cr_found, p_found=p_found,
     )
+    if return_gathers:
+        out["_gathers"] = (dr, cr, p, p_dr, p_cr)
+    return out
 
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
@@ -387,7 +370,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
 
     if per_event is None:
-        per_event = per_event_status(state, ev, ts_event)
+        per_event = per_event_status(state, ev, ts_event,
+                                     return_gathers=True)
     dr_rowc = per_event["dr_row"]
     cr_rowc = per_event["cr_row"]
     p_rowc = per_event["p_row"]
@@ -399,13 +383,17 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     status = per_event["status_pre"]
     ts_actual = per_event["ts_pre"]
 
-    # Re-gather the touched rows (cheap O(N) gathers on replicated state;
-    # keeps the all-gathered per-event bundle compact in the SPMD path).
-    dr = _acct_gather(acc, dr_rowc, dr_found)
-    cr = _acct_gather(acc, cr_rowc, cr_found)
-    p = _xfer_gather(xfr, p_rowc)
-    p_dr = _acct_gather(acc, p["dr_row"], p_found)
-    p_cr = _acct_gather(acc, p["cr_row"], p_found)
+    if "_gathers" in per_event:
+        dr, cr, p, p_dr, p_cr = per_event["_gathers"]
+    else:
+        # SPMD path: re-gather the touched rows locally (cheap O(N)
+        # gathers on replicated state; keeps the all-gathered per-event
+        # bundle compact).
+        dr = _acct_gather(acc, dr_rowc, dr_found)
+        cr = _acct_gather(acc, cr_rowc, cr_found)
+        p = _xfer_gather(xfr, p_rowc)
+        p_dr = _acct_gather(acc, p["dr_row"], p_found)
+        p_cr = _acct_gather(acc, p["cr_row"], p_found)
 
     # ---------------- eligibility ----------------
     hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
@@ -575,34 +563,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     al0, al1, al2, al3 = _to_limbs(amt_res_hi, amt_res_lo)
     nl0, nl1, nl2, nl3 = _neg_limbs(p["amt_hi"], p["amt_lo"])
-
-    bal = {k: acc[k] for k in acc}
-
-    def scat_add(field, rows, limbs, mask):
-        tpos = jnp.where(mask, rows, A_dump)
-        for j, lim in enumerate(limbs):
-            bal[f"{field}{j}"] = bal[f"{field}{j}"].at[tpos].add(
-                jnp.where(mask, lim, jnp.uint64(0)))
-
-    scat_add("dpos", dr_rowc, (al0, al1, al2, al3), ap_reg)
-    scat_add("cpos", cr_rowc, (al0, al1, al2, al3), ap_reg)
-    scat_add("dp", dr_rowc, (al0, al1, al2, al3), ap_pend)
-    scat_add("cp", cr_rowc, (al0, al1, al2, al3), ap_pend)
-    # post/void: release pending amounts from p's accounts...
-    scat_add("dp", p["dr_row"], (nl0, nl1, nl2, nl3), ap_pv)
-    scat_add("cp", p["cr_row"], (nl0, nl1, nl2, nl3), ap_pv)
-    # ...and post the resolved amount.
-    scat_add("dpos", p["dr_row"], (al0, al1, al2, al3), ap_post)
-    scat_add("cpos", p["cr_row"], (al0, al1, al2, al3), ap_post)
-
-    touched = jnp.concatenate([
-        jnp.where(ap & ~pv, dr_rowc, A_dump),
-        jnp.where(ap & ~pv, cr_rowc, A_dump),
-        jnp.where(ap_pv, p["dr_row"], A_dump),
-        jnp.where(ap_pv, p["cr_row"], A_dump),
-    ])
-    bal = _normalize_rows(bal, touched)
-    new_acc = bal
+    # Balance application happens below, fused into the account_events
+    # snapshot computation: the snapshot's segmented prefix sums already
+    # produce every touched account's exact post-event balances, and the
+    # LAST entry per account row is the post-BATCH balance — one masked
+    # scatter per limb replaces per-delta scatter-adds plus a separate
+    # carry-normalize pass.
 
     # Pending-status flips on committed pendings (E2 guarantees unique rows).
     flip_pos = jnp.where(ap_pv, p_rowc, T_dump)
@@ -733,6 +699,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     l3 = (l3 + c) & _M32
     hi_sorted = l2 | (l3 << jnp.uint64(32))          # (4, 2N)
     lo_sorted = l0 | (l1 << jnp.uint64(32))
+
+    # ---- balance application: the last entry per account row carries the
+    # exact post-batch balance — scatter it back. Non-final and masked
+    # entries write a uniform 0 to the dump row (duplicate-index scatter-
+    # set stays deterministic only if every duplicate writes one value).
+    is_final = jnp.concatenate([
+        is_start[1:], jnp.ones(1, dtype=jnp.bool_)])  # next start ends me
+    real = is_final & (rows_sorted != A_dump)
+    tgt = jnp.where(real, rows_sorted, A_dump)
+    new_acc = dict(acc)
+    for fi, field in enumerate(fields):
+        for j, lane in enumerate((l0, l1, l2, l3)):
+            new_acc[f"{field}{j}"] = acc[f"{field}{j}"].at[tgt].set(
+                jnp.where(real, lane[fi], jnp.uint64(0)))
     inv = jnp.zeros(2 * N, dtype=jnp.int32).at[perm].set(
         jnp.arange(2 * N, dtype=jnp.int32))
     hi_all = jnp.take(hi_sorted, inv, axis=1)        # original entry order
