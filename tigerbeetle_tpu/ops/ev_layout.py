@@ -1,0 +1,83 @@
+"""Packed account_events ring layout, shared by the kernel and the ledger.
+
+One matrix per dtype so a batch's ring append is THREE row scatters, not
+~44 column scatters (per-op dispatch overhead is the TPU serving
+bottleneck). Logical column -> matrix index maps; ev_col() gives named
+access. Reference data model: the account_events groove row,
+src/state_machine.zig:104-220.
+"""
+
+from __future__ import annotations
+
+EV_U64 = ("ts", "amt_hi", "amt_lo", "areq_hi", "areq_lo") + tuple(
+    f"{side}_{f}_{half}"
+    for side in ("dr", "cr")
+    for f in ("dp", "dpos", "cp", "cpos")
+    for half in ("hi", "lo"))
+EV_I32 = ("pstat", "p_row", "dr_row", "cr_row")
+EV_U32 = ("tflags", "dr_flags", "cr_flags")
+EV_U64_IDX = {n: i for i, n in enumerate(EV_U64)}
+EV_I32_IDX = {n: i for i, n in enumerate(EV_I32)}
+EV_U32_IDX = {n: i for i, n in enumerate(EV_U32)}
+
+
+def ev_col(evr: dict, name: str):
+    """Named column view of a packed events ring (device or numpy)."""
+    if name in EV_U64_IDX:
+        return evr["u64"][:, EV_U64_IDX[name]]
+    if name in EV_I32_IDX:
+        return evr["i32"][:, EV_I32_IDX[name]]
+    return evr["u32"][:, EV_U32_IDX[name]]
+
+
+def ev_cap(evr: dict) -> int:
+    return evr["u64"].shape[0] - 1
+
+
+def ev_named(rows: dict) -> dict:
+    """Packed event rows ({'u64','i32','u32'} matrices) -> named column
+    dict (works on device arrays, numpy, or row-sliced views)."""
+    out = {n: rows["u64"][:, i] for n, i in EV_U64_IDX.items()}
+    out.update({n: rows["i32"][:, i] for n, i in EV_I32_IDX.items()})
+    out.update({n: rows["u32"][:, i] for n, i in EV_U32_IDX.items()})
+    return out
+
+
+# Packed account balance layout: acc["bal"] is (rows, 16) u64 — four u128
+# fields x four u32-normalized limbs. Column = BAL_FIELDS index * 4 + limb.
+BAL_FIELDS = ("dp", "dpos", "cp", "cpos")
+BAL_IDX = {f: i * 4 for i, f in enumerate(BAL_FIELDS)}
+
+
+def bal_col(field: str, limb: int) -> int:
+    return BAL_IDX[field] + limb
+
+
+# Packed transfers store layout (reference data model: the 128-byte
+# Transfer, src/tigerbeetle.zig:85-116, plus device-side derived columns).
+XF_U64 = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
+          "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi", "ud128_lo",
+          "ud64", "ts", "expires")
+XF_U32 = ("ud32", "timeout", "ledger", "code", "flags")
+XF_I32 = ("pstat", "dr_row", "cr_row")
+XF_U64_IDX = {n: i for i, n in enumerate(XF_U64)}
+XF_U32_IDX = {n: i for i, n in enumerate(XF_U32)}
+XF_I32_IDX = {n: i for i, n in enumerate(XF_I32)}
+
+
+def xf_col(xfr: dict, name: str):
+    """Named column view of a packed transfers store (device or numpy)."""
+    if name in XF_U64_IDX:
+        return xfr["u64"][:, XF_U64_IDX[name]]
+    if name in XF_U32_IDX:
+        return xfr["u32"][:, XF_U32_IDX[name]]
+    return xfr["i32"][:, XF_I32_IDX[name]]
+
+
+def xf_named(rows: dict) -> dict:
+    """Packed transfer rows ({'u64','u32','i32'} matrices) -> named
+    column dict (works on device arrays, numpy, or row-sliced views)."""
+    out = {n: rows["u64"][:, i] for n, i in XF_U64_IDX.items()}
+    out.update({n: rows["u32"][:, i] for n, i in XF_U32_IDX.items()})
+    out.update({n: rows["i32"][:, i] for n, i in XF_I32_IDX.items()})
+    return out

@@ -47,6 +47,18 @@ import numpy as np
 
 from ..constants import NS_PER_S, U63_MAX
 from . import u128
+from .ev_layout import (
+    BAL_IDX,
+    EV_I32,
+    EV_U32,
+    EV_U64,
+    XF_I32,
+    XF_I32_IDX,
+    XF_U32,
+    XF_U64,
+    ev_cap,
+    xf_named,
+)
 from .create_kernels import (
     _A_CLOSED,
     _A_CR_LIMIT,
@@ -120,12 +132,6 @@ def _neg_limbs(hi, lo):
     return _to_limbs(n_hi, n_lo)
 
 
-def _gather_balance(bal, field, rows):
-    return _from_limbs(
-        bal[f"{field}0"][rows], bal[f"{field}1"][rows],
-        bal[f"{field}2"][rows], bal[f"{field}3"][rows])
-
-
 def _u128_max_reduce(his, los):
     """Exact max over a list of (hi, lo) arrays of equal shape."""
     hi = his[0]
@@ -156,13 +162,21 @@ def _dup_keys(k_hi, k_lo, tags):
 # ================================================== create_transfers (fast)
 
 def _acct_gather(acc, rows, found):
-    """Gather the account fields the kernel needs at `rows` (clamped)."""
+    """Gather the account fields the kernel needs at `rows` (clamped).
+    Balances come from ONE row gather of the packed (rows, 16) limb
+    matrix instead of 16 column gathers."""
+    g = acc["bal"][rows]
+
+    def field(name):
+        i = BAL_IDX[name]
+        return _from_limbs(g[:, i], g[:, i + 1], g[:, i + 2], g[:, i + 3])
+
     return dict(
         exists=found,
-        dp=_gather_balance(acc, "dp", rows),
-        dpos=_gather_balance(acc, "dpos", rows),
-        cp=_gather_balance(acc, "cp", rows),
-        cpos=_gather_balance(acc, "cpos", rows),
+        dp=field("dp"),
+        dpos=field("dpos"),
+        cp=field("cp"),
+        cpos=field("cpos"),
         ledger=acc["ledger"][rows],
         code=acc["code"][rows],
         flags=acc["flags"][rows],
@@ -171,11 +185,10 @@ def _acct_gather(acc, rows, found):
 
 
 def _xfer_gather(xfr, rows):
-    return {k: xfr[k][rows] for k in (
-        "dr_hi", "dr_lo", "cr_hi", "cr_lo", "amt_hi", "amt_lo",
-        "pid_hi", "pid_lo", "ud128_hi", "ud128_lo", "ud64", "ud32",
-        "timeout", "ledger", "code", "flags", "ts", "expires",
-        "pstat", "dr_row", "cr_row")}
+    """Row gather of the packed transfers store: three matrix gathers,
+    returned as a named column dict."""
+    return xf_named({"u64": xfr["u64"][rows], "u32": xfr["u32"][rows],
+                     "i32": xfr["i32"][rows]})
 
 
 def per_event_status(state, ev, ts_event, return_gathers=False):
@@ -200,7 +213,7 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     acc = state["accounts"]
     xfr = state["transfers"]
     A_dump = acc["id_hi"].shape[0] - 1
-    T_dump = xfr["id_hi"].shape[0] - 1
+    T_dump = xfr["u64"].shape[0] - 1
     # Note: statuses returned here are NOT valid-masked — the tail in
     # create_transfers_fast applies the valid mask after chain handling.
 
@@ -355,7 +368,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     xfr = state["transfers"]
     N = ev["id_lo"].shape[0]
     A_dump = acc["id_hi"].shape[0] - 1
-    T_dump = xfr["id_hi"].shape[0] - 1
+    T_dump = xfr["u64"].shape[0] - 1
     idxs = jnp.arange(N, dtype=jnp.int32)
     valid = ev["valid"]
     nn = n.astype(jnp.uint64)
@@ -431,7 +444,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     def _breach(load, held1, held2, against1, limit_bit):
         # (held1 + held2 + load) > against1, evaluated in 5 limbs
         # (each limb sum < 2^46: no u64 overflow before normalize).
-        lft = [acc[f"{held1}{j}"] + acc[f"{held2}{j}"] + load[j]
+        balm = acc["bal"]
+        h1, h2, ag = BAL_IDX[held1], BAL_IDX[held2], BAL_IDX[against1]
+        lft = [balm[:, h1 + j] + balm[:, h2 + j] + load[j]
                for j in range(4)]
         c = lft[0] >> jnp.uint64(32); f0 = lft[0] & _M32
         lft[1] = lft[1] + c
@@ -442,10 +457,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         l4 = lft[3] >> jnp.uint64(32); f3 = lft[3] & _M32
         left_hi = f2 | (f3 << jnp.uint64(32))
         left_lo = f0 | (f1 << jnp.uint64(32))
-        right_hi = acc[f"{against1}2"] | (acc[f"{against1}3"]
-                                          << jnp.uint64(32))
-        right_lo = acc[f"{against1}0"] | (acc[f"{against1}1"]
-                                          << jnp.uint64(32))
+        right_hi = balm[:, ag + 2] | (balm[:, ag + 3] << jnp.uint64(32))
+        right_lo = balm[:, ag] | (balm[:, ag + 1] << jnp.uint64(32))
         limited = _flag(acc["flags"], limit_bit)
         # The dump row (last) is scratch: failed creates scatter raw
         # flags there and masked transfers scatter-add amounts into its
@@ -536,8 +549,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     e7 = ((xfr["count"] + n_created) > jnp.int32(T_dump))
     # Event-ring capacity (expiry rows pushed from the host can make the
     # events count exceed the transfers count, so it needs its own guard).
-    E_dump_cap = jnp.int32(state["events"]["ts"].shape[0] - 1)
-    e8 = ((state["events"]["count"] + n_created) > E_dump_cap)
+    e8 = ((state["events"]["count"] + n_created) > jnp.int32(
+        ev_cap(state["events"])))
 
     transient = jnp.zeros_like(valid)
     for code in _TRANSIENT_CODES:
@@ -570,10 +583,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # scatter per limb replaces per-delta scatter-adds plus a separate
     # carry-normalize pass.
 
-    # Pending-status flips on committed pendings (E2 guarantees unique rows).
+    # Pending-status flips on committed pendings (E2 guarantees unique
+    # rows; masked lanes write a uniform 0 to the dump slot so the
+    # duplicate-index scatter stays deterministic).
     flip_pos = jnp.where(ap_pv, p_rowc, T_dump)
-    new_pstat = xfr["pstat"].at[flip_pos].set(
-        jnp.where(is_post, _PS_POSTED, _PS_VOIDED))
+    i32_flipped = xfr["i32"].at[flip_pos, XF_I32_IDX["pstat"]].set(
+        jnp.where(ap_pv, jnp.where(is_post, _PS_POSTED, _PS_VOIDED),
+                  jnp.int32(0)))
 
     # Insert created transfer rows (compacted).
     trow = jnp.where(ap, new_rows, T_dump)
@@ -601,14 +617,22 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
     )
-    new_xfr = {"pstat": new_pstat, "count": xfr["count"]}
-    for k, v in stores.items():
-        if k == "pstat":
-            new_xfr["pstat"] = new_xfr["pstat"].at[trow].set(
-                jnp.where(ap, v, new_xfr["pstat"][T_dump]))
-        else:
-            new_xfr[k] = xfr[k].at[trow].set(v)
-    new_xfr["count"] = xfr["count"] + jnp.where(ok, n_created, 0)
+    # Packed row inserts: one scatter per dtype matrix. Masked lanes
+    # write uniform zero rows to the dump slot (duplicate-index scatters
+    # stay deterministic only if every duplicate writes one value).
+    u64_rows = jnp.stack([stores[n] for n in XF_U64], axis=1)
+    u32_rows = jnp.stack([stores[n] for n in XF_U32], axis=1)
+    i32_rows = jnp.stack([stores[n] for n in XF_I32], axis=1)
+    apn = ap[:, None]
+    new_xfr = {
+        "u64": xfr["u64"].at[trow].set(
+            jnp.where(apn, u64_rows, jnp.uint64(0))),
+        "u32": xfr["u32"].at[trow].set(
+            jnp.where(apn, u32_rows, jnp.uint32(0))),
+        "i32": i32_flipped.at[trow].set(
+            jnp.where(apn, i32_rows, jnp.int32(0))),
+        "count": xfr["count"] + jnp.where(ok, n_created, 0),
+    }
 
     new_xfer_ht = ht_write(
         state["xfer_ht"], xfer_pos, ev["id_hi"], ev["id_lo"], new_rows, ap)
@@ -623,7 +647,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # event i's snapshot includes every earlier created event's delta on
     # that account. Computed exactly with a sort + segmented limb cumsum.
     evr = state["events"]
-    E_dump = evr["ts"].shape[0] - 1
+    E_dump = ev_cap(evr)
     z64 = jnp.uint64(0)
     side_rows = [
         jnp.where(ap, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
@@ -687,9 +711,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     offsets = jnp.where(
         seg_start > 0,
         jnp.take(cs, jnp.maximum(seg_start - 1, 0), axis=2), z64)
-    base = jnp.stack([
-        jnp.stack([acc[f"{field}{j}"][rows_sorted] for j in range(4)])
-        for field in fields])
+    # Packed-balance base: one row gather, reshaped to [field][limb][entry]
+    # (column = field * 4 + limb, matching the `fields` order).
+    base = acc["bal"][rows_sorted].T.reshape(4, 4, 2 * N)
     limbs = base + cs - offsets                      # (4, 4, 2N)
     # Carry-normalize mod 2^128 along the limb axis (3 carry steps).
     l0 = limbs[:, 0]; l1 = limbs[:, 1]; l2 = limbs[:, 2]; l3 = limbs[:, 3]
@@ -708,11 +732,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         is_start[1:], jnp.ones(1, dtype=jnp.bool_)])  # next start ends me
     real = is_final & (rows_sorted != A_dump)
     tgt = jnp.where(real, rows_sorted, A_dump)
+    vals = jnp.stack([l0, l1, l2, l3], axis=1).reshape(16, 2 * N).T
     new_acc = dict(acc)
-    for fi, field in enumerate(fields):
-        for j, lane in enumerate((l0, l1, l2, l3)):
-            new_acc[f"{field}{j}"] = acc[f"{field}{j}"].at[tgt].set(
-                jnp.where(real, lane[fi], jnp.uint64(0)))
+    new_acc["bal"] = acc["bal"].at[tgt].set(
+        jnp.where(real[:, None], vals, jnp.uint64(0)))
     inv = jnp.zeros(2 * N, dtype=jnp.int32).at[perm].set(
         jnp.arange(2 * N, dtype=jnp.int32))
     hi_all = jnp.take(hi_sorted, inv, axis=1)        # original entry order
@@ -723,7 +746,6 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         snap[f"cr_{field}"] = (hi_all[fi, N:], lo_all[fi, N:])
 
     erow = jnp.where(ap, evr["count"] + row_off, E_dump)
-    new_evr = {"count": evr["count"] + jnp.where(ok, n_created, 0)}
     stores_ev = dict(
         ts=ts_event,
         amt_hi=amt_res_hi, amt_lo=amt_res_lo,
@@ -744,8 +766,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             hi_arr, lo_arr = snap[f"{sside}_{field}"]
             stores_ev[f"{sside}_{field}_hi"] = hi_arr
             stores_ev[f"{sside}_{field}_lo"] = lo_arr
-    for k, v in stores_ev.items():
-        new_evr[k] = evr[k].at[erow].set(v)
+    # Packed ring append: one row scatter per dtype matrix (44 -> 3);
+    # masked lanes write uniform zero rows to the dump slot (determinism).
+    new_evr = {
+        "u64": evr["u64"].at[erow].set(jnp.where(
+            ap[:, None], jnp.stack([stores_ev[n] for n in EV_U64], axis=1),
+            jnp.uint64(0))),
+        "i32": evr["i32"].at[erow].set(jnp.where(
+            ap[:, None], jnp.stack([stores_ev[n] for n in EV_I32], axis=1),
+            jnp.int32(0))),
+        "u32": evr["u32"].at[erow].set(jnp.where(
+            ap[:, None], jnp.stack([stores_ev[n] for n in EV_U32], axis=1),
+            jnp.uint32(0))),
+        "count": evr["count"] + jnp.where(ok, n_created, 0),
+    }
 
     # Scalars.
     last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
@@ -920,9 +954,8 @@ def create_accounts_fast(state, ev, timestamp, n):
         ts=ts_event,
     ).items():
         new_acc[k] = acc[k].at[arow].set(v)
-    for f in ("dp", "dpos", "cp", "cpos"):
-        for j in range(4):
-            new_acc[f"{f}{j}"] = acc[f"{f}{j}"].at[arow].set(z64)
+    new_acc["bal"] = acc["bal"].at[arow].set(
+        jnp.zeros((N, 16), dtype=jnp.uint64))
     new_acc["count"] = acc["count"] + jnp.where(ok, n_created, 0)
 
     new_ht = ht_write(

@@ -51,6 +51,63 @@ from .hash_table import ht_init
 N_PAD = 8192
 assert N_PAD >= BATCH_MAX
 
+from .ev_layout import (  # noqa: F401 — re-exported ring layout
+    BAL_FIELDS,
+    BAL_IDX,
+    EV_I32,
+    EV_I32_IDX,
+    EV_U32,
+    EV_U32_IDX,
+    EV_U64,
+    EV_U64_IDX,
+    XF_I32,
+    XF_I32_IDX,
+    XF_U32,
+    XF_U32_IDX,
+    XF_U64,
+    XF_U64_IDX,
+    bal_col,
+    ev_cap,
+    ev_col,
+    ev_named,
+    xf_col,
+    xf_named,
+)
+
+
+
+def _pack_transfer_rows(objs, pstat_of, acct_row_of, a_dump):
+    """Transfer objects -> packed row matrices (shared by the full rebuild
+    and the incremental dirty push, so the two paths cannot drift)."""
+    n = len(objs)
+    u64m = np.zeros((n, len(XF_U64)), dtype=np.uint64)
+    u32m = np.zeros((n, len(XF_U32)), dtype=np.uint32)
+    i32m = np.zeros((n, len(XF_I32)), dtype=np.int32)
+    U, V, I = XF_U64_IDX, XF_U32_IDX, XF_I32_IDX
+    for i, o in enumerate(objs):
+        u64m[i, U["id_hi"]], u64m[i, U["id_lo"]] = _split(o.id)
+        (u64m[i, U["dr_hi"]],
+         u64m[i, U["dr_lo"]]) = _split(o.debit_account_id)
+        (u64m[i, U["cr_hi"]],
+         u64m[i, U["cr_lo"]]) = _split(o.credit_account_id)
+        u64m[i, U["amt_hi"]], u64m[i, U["amt_lo"]] = _split(o.amount)
+        u64m[i, U["pid_hi"]], u64m[i, U["pid_lo"]] = _split(o.pending_id)
+        (u64m[i, U["ud128_hi"]],
+         u64m[i, U["ud128_lo"]]) = _split(o.user_data_128)
+        u64m[i, U["ud64"]] = o.user_data_64
+        u64m[i, U["ts"]] = o.timestamp
+        u64m[i, U["expires"]] = (
+            o.timestamp + o.timeout * NS_PER_S if o.timeout else 0)
+        u32m[i, V["ud32"]] = o.user_data_32
+        u32m[i, V["timeout"]] = o.timeout
+        u32m[i, V["ledger"]] = o.ledger
+        u32m[i, V["code"]] = o.code
+        u32m[i, V["flags"]] = o.flags
+        i32m[i, I["pstat"]] = pstat_of(o)
+        i32m[i, I["dr_row"]] = acct_row_of(o.debit_account_id, a_dump)
+        i32m[i, I["cr_row"]] = acct_row_of(o.credit_account_id, a_dump)
+    return u64m, u32m, i32m
+
 
 def _scatter_cols(table, rows, cols):
     """Jitted fused row-scatter: one dispatch per push instead of one per
@@ -82,7 +139,9 @@ def _limbs4(value: int):
 
 
 def _balance_int(acc, field, row) -> int:
-    return sum(int(acc[f"{field}{j}"][row]) << (32 * j) for j in range(4))
+    bal_row = acc["bal"][row]
+    return sum(int(bal_row[bal_col(field, j)]) << (32 * j)
+               for j in range(4))
 
 
 def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
@@ -96,7 +155,7 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         e_cap = t_cap  # one history row per created transfer (+ expiries)
 
     def rows_accounts():
-        d = dict(
+        return dict(
             id_hi=jnp.zeros(a_cap + 1, jnp.uint64),
             id_lo=jnp.zeros(a_cap + 1, jnp.uint64),
             ud128_hi=jnp.zeros(a_cap + 1, jnp.uint64),
@@ -107,44 +166,37 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
             code=jnp.zeros(a_cap + 1, jnp.uint32),
             flags=jnp.zeros(a_cap + 1, jnp.uint32),
             ts=jnp.zeros(a_cap + 1, jnp.uint64),
+            # Packed balances: (rows, 16) u64 — see ev_layout.BAL_FIELDS.
+            bal=jnp.zeros((a_cap + 1, 16), jnp.uint64),
             count=jnp.int32(0),
         )
-        for f in ("dp", "dpos", "cp", "cpos"):
-            for j in range(4):
-                d[f"{f}{j}"] = jnp.zeros(a_cap + 1, jnp.uint64)
-        return d
 
     def rows_transfers():
-        u64s = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
-                "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi",
-                "ud128_lo", "ud64", "ts", "expires")
-        u32s = ("ud32", "timeout", "ledger", "code", "flags")
-        d = {k: jnp.zeros(t_cap + 1, jnp.uint64) for k in u64s}
-        d.update({k: jnp.zeros(t_cap + 1, jnp.uint32) for k in u32s})
-        d["pstat"] = jnp.zeros(t_cap + 1, jnp.int32)
-        d["dr_row"] = jnp.zeros(t_cap + 1, jnp.int32)
-        d["cr_row"] = jnp.zeros(t_cap + 1, jnp.int32)
-        d["count"] = jnp.int32(0)
-        return d
+        # Packed per-dtype (see ev_layout.XF_*): row appends are three
+        # scatters; row gathers are three gathers.
+        return dict(
+            u64=jnp.zeros((t_cap + 1, len(XF_U64)), jnp.uint64),
+            u32=jnp.zeros((t_cap + 1, len(XF_U32)), jnp.uint32),
+            i32=jnp.zeros((t_cap + 1, len(XF_I32)), jnp.int32),
+            count=jnp.int32(0),
+        )
 
     def rows_events():
         # The account_events history ring (reference: the account_events
         # groove, src/state_machine.zig:104-220): per created transfer,
         # POST-application u128 balance snapshots of both touched accounts,
-        # computed exactly in-kernel via segmented prefix sums.
-        d = {k: jnp.zeros(e_cap + 1, jnp.uint64) for k in (
-            "ts", "amt_hi", "amt_lo", "areq_hi", "areq_lo")}
-        for side in ("dr", "cr"):
-            for f in ("dp", "dpos", "cp", "cpos"):
-                d[f"{side}_{f}_hi"] = jnp.zeros(e_cap + 1, jnp.uint64)
-                d[f"{side}_{f}_lo"] = jnp.zeros(e_cap + 1, jnp.uint64)
-            d[f"{side}_row"] = jnp.zeros(e_cap + 1, jnp.int32)
-            d[f"{side}_flags"] = jnp.zeros(e_cap + 1, jnp.uint32)
-        d["tflags"] = jnp.full(e_cap + 1, 0xFFFFFFFF, dtype=jnp.uint32)
-        d["pstat"] = jnp.zeros(e_cap + 1, jnp.int32)
-        d["p_row"] = jnp.full(e_cap + 1, -1, dtype=jnp.int32)
-        d["count"] = jnp.int32(0)
-        return d
+        # computed exactly in-kernel via segmented prefix sums. Packed
+        # per-dtype (see EV_U64/EV_I32/EV_U32) so appends are row scatters.
+        i32 = np.zeros((e_cap + 1, len(EV_I32)), dtype=np.int32)
+        i32[:, EV_I32_IDX["p_row"]] = -1
+        u32 = np.zeros((e_cap + 1, len(EV_U32)), dtype=np.uint32)
+        u32[:, EV_U32_IDX["tflags"]] = 0xFFFFFFFF
+        return dict(
+            u64=jnp.zeros((e_cap + 1, len(EV_U64)), jnp.uint64),
+            i32=jnp.asarray(i32),
+            u32=jnp.asarray(u32),
+            count=jnp.int32(0),
+        )
 
     if orphan_cap is None:
         # Orphaned (transient-failure) ids are never evicted; keep the table
@@ -178,12 +230,14 @@ def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
          for k, v in xfr.items() if k != "count"}
     e = {k: lax.dynamic_slice_in_dim(v, e_start, size_e)
          for k, v in evr.items() if k != "count"}
-    p_rows = jnp.maximum(e["p_row"], 0)
+    dr_row = ev_col(e, "dr_row")
+    cr_row = ev_col(e, "cr_row")
+    p_rows = jnp.maximum(ev_col(e, "p_row"), 0)
     return dict(
         t=t, e=e,
-        dr_id_hi=acc["id_hi"][e["dr_row"]], dr_id_lo=acc["id_lo"][e["dr_row"]],
-        cr_id_hi=acc["id_hi"][e["cr_row"]], cr_id_lo=acc["id_lo"][e["cr_row"]],
-        p_ts=xfr["ts"][p_rows],
+        dr_id_hi=acc["id_hi"][dr_row], dr_id_lo=acc["id_lo"][dr_row],
+        cr_id_hi=acc["id_hi"][cr_row], cr_id_lo=acc["id_lo"][cr_row],
+        p_ts=xf_col(xfr, "ts")[p_rows],
     )
 
 
@@ -382,6 +436,8 @@ class DeviceLedger:
         store = self.state[store_key]
         gathered = {k: np.asarray(store[k][rows]) for k in store
                     if k != "count"}
+        if store_key == "transfers":
+            gathered = xf_named(gathered)
         return np.asarray(found), gathered
 
     def lookup_accounts(self, ids: list[int]) -> list[Account]:
@@ -445,8 +501,10 @@ class DeviceLedger:
             sm.account_by_timestamp[a.timestamp] = a.id
             self._acct_row[a.id] = r
 
-        xfr = {k: np.asarray(v) for k, v in self.state["transfers"].items()}
-        n_t = int(xfr["count"])
+        t_rows = {k: np.asarray(v)
+                  for k, v in self.state["transfers"].items()}
+        n_t = int(t_rows["count"])
+        xfr = xf_named(t_rows)
         for r in range(n_t):
             t = _transfer_from_row(xfr, r, None)
             sm.transfers[t.id] = t
@@ -488,9 +546,10 @@ class DeviceLedger:
 
         n_e = int(self.state["events"]["count"])
         # Slice on device FIRST: only the live rows cross to the host, not
-        # the full-capacity columns.
-        evr = {k: np.asarray(v[:n_e]) for k, v in self.state["events"].items()
-               if k != "count"}
+        # the full-capacity matrices; then expand to named columns.
+        evr = ev_named({k: np.asarray(v[:n_e])
+                        for k, v in self.state["events"].items()
+                        if k != "count"})
         out = []
 
         def side_account(side: str, r: int) -> Account:
@@ -566,7 +625,7 @@ class DeviceLedger:
             for f, val in (("dp", a.debits_pending), ("dpos", a.debits_posted),
                            ("cp", a.credits_pending), ("cpos", a.credits_posted)):
                 for j, lim in enumerate(_limbs4(val)):
-                    acc[f"{f}{j}"][r] = lim
+                    acc["bal"][r, bal_col(f, j)] = lim
             acc["ud128_hi"][r], acc["ud128_lo"][r] = _split(a.user_data_128)
             acc["ud64"][r] = a.user_data_64
             acc["ud32"][r] = a.user_data_32
@@ -584,26 +643,16 @@ class DeviceLedger:
         transfers = list(sm.transfers.values())
         xfr = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["transfers"].items()}
-        for r, t in enumerate(transfers):
-            xfr["id_hi"][r], xfr["id_lo"][r] = _split(t.id)
-            xfr["dr_hi"][r], xfr["dr_lo"][r] = _split(t.debit_account_id)
-            xfr["cr_hi"][r], xfr["cr_lo"][r] = _split(t.credit_account_id)
-            xfr["amt_hi"][r], xfr["amt_lo"][r] = _split(t.amount)
-            xfr["pid_hi"][r], xfr["pid_lo"][r] = _split(t.pending_id)
-            xfr["ud128_hi"][r], xfr["ud128_lo"][r] = _split(t.user_data_128)
-            xfr["ud64"][r] = t.user_data_64
-            xfr["ud32"][r] = t.user_data_32
-            xfr["timeout"][r] = t.timeout
-            xfr["ledger"][r] = t.ledger
-            xfr["code"][r] = t.code
-            xfr["flags"][r] = t.flags
-            xfr["ts"][r] = t.timestamp
-            xfr["pstat"][r] = int(
-                sm.pending_status.get(t.timestamp, TransferPendingStatus.none))
-            xfr["expires"][r] = (
-                t.timestamp + t.timeout * NS_PER_S if t.timeout else 0)
-            xfr["dr_row"][r] = acct_row.get(t.debit_account_id, self.a_cap)
-            xfr["cr_row"][r] = acct_row.get(t.credit_account_id, self.a_cap)
+        u64m, u32m, i32m = _pack_transfer_rows(
+            transfers,
+            lambda o: int(sm.pending_status.get(
+                o.timestamp, TransferPendingStatus.none)),
+            lambda aid, dump: acct_row.get(aid, dump),
+            self.a_cap)
+        n_t = len(transfers)
+        xfr["u64"][:n_t] = u64m
+        xfr["u32"][:n_t] = u32m
+        xfr["i32"][:n_t] = i32m
         xfr["count"] = np.int32(len(transfers))
         st["transfers"] = {k: jnp.asarray(v) for k, v in xfr.items()}
         st["xfer_ht"] = batch_insert(
@@ -620,7 +669,7 @@ class DeviceLedger:
                for k, v in st["events"].items()}
         cols = self._event_cols(sm.account_events)
         n_e = len(sm.account_events)
-        e_cap = len(evr["ts"]) - 1
+        e_cap = evr["u64"].shape[0] - 1
         assert n_e <= e_cap, "e_cap exceeded: raise capacities"
         for k, v in cols.items():
             evr[k][:n_e] = v
@@ -689,45 +738,34 @@ class DeviceLedger:
         return self.mirror
 
     def _event_cols(self, records: list) -> dict:
-        """Host AccountEventRecords -> ring column arrays (push/from_host)."""
+        """Host AccountEventRecords -> packed ring row matrices
+        (push/from_host)."""
         n = len(records)
-        cols = {
-            "ts": np.zeros(n, dtype=np.uint64),
-            "amt_hi": np.zeros(n, dtype=np.uint64),
-            "amt_lo": np.zeros(n, dtype=np.uint64),
-            "areq_hi": np.zeros(n, dtype=np.uint64),
-            "areq_lo": np.zeros(n, dtype=np.uint64),
-            "tflags": np.zeros(n, dtype=np.uint32),
-            "pstat": np.zeros(n, dtype=np.int32),
-            "p_row": np.zeros(n, dtype=np.int32),
-        }
-        for side in ("dr", "cr"):
-            cols[f"{side}_row"] = np.zeros(n, dtype=np.int32)
-            cols[f"{side}_flags"] = np.zeros(n, dtype=np.uint32)
-            for f in ("dp", "dpos", "cp", "cpos"):
-                cols[f"{side}_{f}_hi"] = np.zeros(n, dtype=np.uint64)
-                cols[f"{side}_{f}_lo"] = np.zeros(n, dtype=np.uint64)
+        u64 = np.zeros((n, len(EV_U64)), dtype=np.uint64)
+        i32 = np.zeros((n, len(EV_I32)), dtype=np.int32)
+        u32 = np.zeros((n, len(EV_U32)), dtype=np.uint32)
+        U, I, V = EV_U64_IDX, EV_I32_IDX, EV_U32_IDX
         for i, rec in enumerate(records):
-            cols["ts"][i] = rec.timestamp
-            cols["amt_hi"][i], cols["amt_lo"][i] = _split(rec.amount)
-            cols["areq_hi"][i], cols["areq_lo"][i] = _split(
+            u64[i, U["ts"]] = rec.timestamp
+            u64[i, U["amt_hi"]], u64[i, U["amt_lo"]] = _split(rec.amount)
+            u64[i, U["areq_hi"]], u64[i, U["areq_lo"]] = _split(
                 rec.amount_requested)
-            cols["tflags"][i] = (0xFFFFFFFF if rec.transfer_flags is None
-                                 else rec.transfer_flags)
-            cols["pstat"][i] = int(rec.transfer_pending_status)
-            cols["p_row"][i] = (
+            u32[i, V["tflags"]] = (0xFFFFFFFF if rec.transfer_flags is None
+                                   else rec.transfer_flags)
+            i32[i, I["pstat"]] = int(rec.transfer_pending_status)
+            i32[i, I["p_row"]] = (
                 self._xfer_row[rec.transfer_pending.id]
                 if rec.transfer_pending is not None else -1)
             for side, a in (("dr", rec.dr_account), ("cr", rec.cr_account)):
-                cols[f"{side}_row"][i] = self._acct_row[a.id]
-                cols[f"{side}_flags"][i] = a.flags
+                i32[i, I[f"{side}_row"]] = self._acct_row[a.id]
+                u32[i, V[f"{side}_flags"]] = a.flags
                 for f, val in (("dp", a.debits_pending),
                                ("dpos", a.debits_posted),
                                ("cp", a.credits_pending),
                                ("cpos", a.credits_posted)):
-                    (cols[f"{side}_{f}_hi"][i],
-                     cols[f"{side}_{f}_lo"][i]) = _split(val)
-        return cols
+                    (u64[i, U[f"{side}_{f}_hi"]],
+                     u64[i, U[f"{side}_{f}_lo"]]) = _split(val)
+        return {"u64": u64, "i32": i32, "u32": u32}
 
 
 
@@ -777,8 +815,8 @@ class DeviceLedger:
 
         t0 = len(self._xfer_row)
         e0 = self._events_pushed
-        t_len = int(self.state["transfers"]["id_hi"].shape[0])
-        e_len = int(self.state["events"]["ts"].shape[0])
+        t_len = int(self.state["transfers"]["u64"].shape[0])
+        e_len = ev_cap(self.state["events"]) + 1
         size = 256 if n_new <= 256 else N_PAD
         size_t = min(size, t_len)
         size_e = min(size, e_len)
@@ -789,8 +827,10 @@ class DeviceLedger:
             self.state, np.int32(t_start), np.int32(e_start), size_t, size_e)
         out = jax.device_get(out)
         t_off, e_off = t0 - t_start, e0 - e_start
-        t = {k: v[t_off:t_off + n_new] for k, v in out["t"].items()}
-        e = {k: v[e_off:e_off + n_new] for k, v in out["e"].items()}
+        t = xf_named({k: v[t_off:t_off + n_new]
+                      for k, v in out["t"].items()})
+        e = ev_named({k: v[e_off:e_off + n_new]
+                      for k, v in out["e"].items()})
         der = {k: out[k][e_off:e_off + n_new]
                for k in ("dr_id_hi", "dr_id_lo", "cr_id_hi", "cr_id_lo",
                          "p_ts")}
@@ -1020,7 +1060,7 @@ class DeviceLedger:
             n = bucket(len(arr))
             if len(arr) == n:
                 return arr
-            out = np.full(n, fill, dtype=arr.dtype)
+            out = np.full((n, *arr.shape[1:]), fill, dtype=arr.dtype)
             out[:len(arr)] = arr
             return out
 
@@ -1044,13 +1084,14 @@ class DeviceLedger:
                            dtype=np.int32), self.a_cap)
             objs = [sm.accounts[a] for a in dirty_accounts]
             cols: dict[str, np.ndarray] = {}
+            bal = np.zeros((len(objs), 16), dtype=np.uint64)
             for f, attr in (("dp", "debits_pending"), ("dpos", "debits_posted"),
                             ("cp", "credits_pending"), ("cpos", "credits_posted")):
-                vals = [getattr(o, attr) for o in objs]
-                for j in range(4):
-                    cols[f"{f}{j}"] = np.array(
-                        [(v >> (32 * j)) & 0xFFFFFFFF for v in vals],
-                        dtype=np.uint64)
+                for i, o in enumerate(objs):
+                    v = getattr(o, attr)
+                    for j in range(4):
+                        bal[i, bal_col(f, j)] = (v >> (32 * j)) & 0xFFFFFFFF
+            cols["bal"] = bal
             cols["id_hi"] = np.array([o.id >> 64 for o in objs], dtype=np.uint64)
             cols["id_lo"] = np.array([o.id & (1 << 64) - 1 for o in objs],
                                      dtype=np.uint64)
@@ -1100,49 +1141,12 @@ class DeviceLedger:
             rows = np.array(rows, dtype=np.int32)
             rows_padded = pad(rows, self.t_cap)
             objs = [sm.transfers[t] for t in new_tids]
-            cols = dict(
-                id_hi=np.array([o.id >> 64 for o in objs], dtype=np.uint64),
-                id_lo=np.array([o.id & (1 << 64) - 1 for o in objs],
-                               dtype=np.uint64),
-                dr_hi=np.array([o.debit_account_id >> 64 for o in objs],
-                               dtype=np.uint64),
-                dr_lo=np.array([o.debit_account_id & (1 << 64) - 1
-                                for o in objs], dtype=np.uint64),
-                cr_hi=np.array([o.credit_account_id >> 64 for o in objs],
-                               dtype=np.uint64),
-                cr_lo=np.array([o.credit_account_id & (1 << 64) - 1
-                                for o in objs], dtype=np.uint64),
-                amt_hi=np.array([o.amount >> 64 for o in objs], dtype=np.uint64),
-                amt_lo=np.array([o.amount & (1 << 64) - 1 for o in objs],
-                                dtype=np.uint64),
-                pid_hi=np.array([o.pending_id >> 64 for o in objs],
-                                dtype=np.uint64),
-                pid_lo=np.array([o.pending_id & (1 << 64) - 1 for o in objs],
-                                dtype=np.uint64),
-                ud128_hi=np.array([o.user_data_128 >> 64 for o in objs],
-                                  dtype=np.uint64),
-                ud128_lo=np.array([o.user_data_128 & (1 << 64) - 1
-                                   for o in objs], dtype=np.uint64),
-                ud64=np.array([o.user_data_64 for o in objs], dtype=np.uint64),
-                ud32=np.array([o.user_data_32 for o in objs], dtype=np.uint32),
-                timeout=np.array([o.timeout for o in objs], dtype=np.uint32),
-                ledger=np.array([o.ledger for o in objs], dtype=np.uint32),
-                code=np.array([o.code for o in objs], dtype=np.uint32),
-                flags=np.array([o.flags for o in objs], dtype=np.uint32),
-                ts=np.array([o.timestamp for o in objs], dtype=np.uint64),
-                pstat=np.array(
-                    [int(sm.pending_status.get(o.timestamp, 0)) for o in objs],
-                    dtype=np.int32),
-                expires=np.array(
-                    [o.timestamp + o.timeout * NS_PER_S if o.timeout else 0
-                     for o in objs], dtype=np.uint64),
-                dr_row=np.array(
-                    [self._acct_row.get(o.debit_account_id, self.a_cap)
-                     for o in objs], dtype=np.int32),
-                cr_row=np.array(
-                    [self._acct_row.get(o.credit_account_id, self.a_cap)
-                     for o in objs], dtype=np.int32),
-            )
+            u64m, u32m, i32m = _pack_transfer_rows(
+                objs,
+                lambda o: int(sm.pending_status.get(o.timestamp, 0)),
+                lambda aid, dump: self._acct_row.get(aid, dump),
+                self.a_cap)
+            cols = {"u64": u64m, "u32": u32m, "i32": i32m}
             count = jnp.int32(next_row)
             xfr = st["transfers"] = scatter_cols(
                 {k: v for k, v in xfr.items() if k != "count"},
@@ -1151,8 +1155,8 @@ class DeviceLedger:
             xfr["count"] = count
             st["xfer_ht"], ok = ht_insert(
                 st["xfer_ht"],
-                jnp.asarray(pad(cols["id_hi"], 0)),
-                jnp.asarray(pad(cols["id_lo"], 0)),
+                jnp.asarray(pad(u64m[:, XF_U64_IDX["id_hi"]].copy(), 0)),
+                jnp.asarray(pad(u64m[:, XF_U64_IDX["id_lo"]].copy(), 0)),
                 jnp.asarray(rows_padded),
                 pad_mask(len(new_tids)))
             assert bool(ok), "xfer hash overflow: raise capacities"
@@ -1168,7 +1172,8 @@ class DeviceLedger:
             rows = pad(np.array([r for r, _ in flip], dtype=np.int32),
                        self.t_cap)
             vals = pad(np.array([v for _, v in flip], dtype=np.int32), 0)
-            xfr["pstat"] = xfr["pstat"].at[rows].set(jnp.asarray(vals))
+            xfr["i32"] = xfr["i32"].at[rows, XF_I32_IDX["pstat"]].set(
+                jnp.asarray(vals))
         dirty_expiry = sorted(sm.expiry.dirty_dev)
         sm.expiry.dirty_dev.clear()
         exp = [(self._xfer_row[sm.transfer_by_timestamp[ts]],
@@ -1179,7 +1184,8 @@ class DeviceLedger:
             rows = pad(np.array([r for r, _ in exp], dtype=np.int32),
                        self.t_cap)
             vals = pad(np.array([v for _, v in exp], dtype=np.uint64), 0)
-            xfr["expires"] = xfr["expires"].at[rows].set(jnp.asarray(vals))
+            xfr["u64"] = xfr["u64"].at[rows, XF_U64_IDX["expires"]].set(
+                jnp.asarray(vals))
 
         # ---- orphaned ids
         dirty_orphans = sorted(sm.orphaned.dirty_dev)
@@ -1201,7 +1207,7 @@ class DeviceLedger:
                                        - sm.events_base:]
         if new_events:
             evr = st["events"]
-            e_cap = evr["ts"].shape[0] - 1
+            e_cap = ev_cap(evr)
             next_row = int(evr["count"])
             assert next_row + len(new_events) <= e_cap, "e_cap exceeded"
             rows = pad(np.arange(next_row, next_row + len(new_events),
