@@ -351,3 +351,81 @@ class TestStaleLeftovers:
         r.on_message(Message(sv.finalize(body), body=body))
         assert r.sync_floor >= 50
         assert r.commit_min == 0, "unverifiable ops 1..3 must not execute"
+
+
+class TestCheckpointRollback:
+    def _commit_through(self, r, msgs, commit):
+        for m in msgs:
+            r.on_message(m)
+        hb = Header(command=Command.commit, cluster=CLUSTER, replica=0,
+                    view=r.view, commit=commit)
+        r.on_message(Message(hb.finalize()))
+
+    def test_divergence_rolls_back_and_reexecutes(self):
+        """A replica that executed a deposed primary's prepares under
+        reused op numbers rolls back to its last checkpoint and re-executes
+        the canonical history zipped down from the view-change suffix —
+        instead of stalling until a peer checkpoint covers it (reference:
+        the protocol-aware recovery goal, docs/ARCHITECTURE.md:540-563)."""
+        r, bus, time = _mk_replica(1)
+        r.status = "normal"
+        # Ops 1..16 commit; checkpoint_interval=16 -> checkpoint at 16.
+        good = _pulse_chain(16)
+        self._commit_through(r, good, 16)
+        assert r.commit_min == 16
+        assert r.superblock.op_checkpoint == 16
+        c16 = good[-1].header.checksum
+        # A deposed primary's divergent suffix: B17, B18 (view 0) commit
+        # locally on false evidence.
+        b_chain = _pulse_chain(2, start_op=17, parent=c16)
+        self._commit_through(r, b_chain, 18)
+        assert r.commit_min == 18
+        # The cluster actually committed A17..A20 (view 2): start_view.
+        a_chain = _pulse_chain(4, start_op=17, parent=c16, view=2)
+        body = b"".join(m.header.pack() for m in a_chain)
+        sv = Header(command=Command.start_view, cluster=CLUSTER, replica=2,
+                    view=2, op=20, commit=20)
+        r.on_message(Message(sv.finalize(body), body=body))
+        # Feed A19: executing it exposes the divergence (its parent is
+        # A18, not our executed B18) -> rollback to checkpoint 16.
+        r.on_message(a_chain[2])
+        assert r._rollback_checkpoint == 16
+        assert r.commit_min == 16, "state must rewind to the checkpoint"
+        assert {17, 18} <= r.chain_suspect
+        # The canonical prepares zip in; everything re-executes.
+        for m in a_chain:
+            r.on_message(m)
+        assert r.commit_min == 20
+        for op, m in zip(range(17, 21), a_chain):
+            held = r.journal.read_prepare(op)
+            assert held.header.checksum == m.header.checksum
+        assert not r.chain_suspect
+        assert r.sync_floor == 0, "recovered without state sync"
+
+    def test_second_divergence_at_same_checkpoint_escalates_to_sync(self):
+        """If the checkpoint itself is off the canonical history, the
+        re-executed chain trips again — the second detection at the same
+        checkpoint must NOT loop on rollback but fall to the sync floor."""
+        r, bus, time = _mk_replica(1)
+        r.status = "normal"
+        good = _pulse_chain(16)
+        self._commit_through(r, good, 16)
+        assert r.superblock.op_checkpoint == 16
+        c16 = good[-1].header.checksum
+        b_chain = _pulse_chain(2, start_op=17, parent=c16)
+        self._commit_through(r, b_chain, 18)
+        # Canonical suffix chains from a DIFFERENT op-16 history: parent
+        # unknown to us (our whole prefix diverged before the checkpoint).
+        a_chain = _pulse_chain(4, start_op=17, parent=0xBEEF, view=2)
+        body = b"".join(m.header.pack() for m in a_chain)
+        sv = Header(command=Command.start_view, cluster=CLUSTER, replica=2,
+                    view=2, op=20, commit=20)
+        r.on_message(Message(sv.finalize(body), body=body))
+        r.on_message(a_chain[2])  # A19 exposes divergence -> rollback
+        assert r._rollback_checkpoint == 16 and r.commit_min == 16
+        # A17 arrives; it does NOT chain from our op 16 -> second
+        # divergence at the same checkpoint -> sync floor, no loop.
+        for m in a_chain:
+            r.on_message(m)
+        assert r.commit_min == 16, "divergent checkpoint must not execute"
+        assert r.sync_floor > 16

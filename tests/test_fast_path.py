@@ -162,13 +162,14 @@ def test_hard_batches_fall_back():
         Account(id=2, ledger=1, code=1),
         Account(id=3, ledger=1, code=1, flags=int(AF.debits_must_not_exceed_credits)),
     ])
-    # balance limits touched -> fallback, still exact
+    # balance limits breached -> resolved on device by the limit
+    # fixpoint (no host fallback), still exact
     d.transfers([
         Transfer(id=1, debit_account_id=1, credit_account_id=3, amount=10, ledger=1, code=1),
         Transfer(id=2, debit_account_id=3, credit_account_id=2, amount=5, ledger=1, code=1),
         Transfer(id=3, debit_account_id=3, credit_account_id=2, amount=6, ledger=1, code=1),
     ])
-    assert d.led.fallbacks == 1
+    assert d.led.fallbacks == 0 and d.led.fixpoint_batches == 1
     # balancing flag -> fallback
     d.transfers([
         Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=U128_MAX, ledger=1, code=1,
@@ -440,7 +441,8 @@ class TestLimitHeadroomEligibility:
         want = sm.create_transfers(evs, ts)
         assert [(r.timestamp, r.status) for r in got] == \
                [(r.timestamp, r.status) for r in want]
-        assert led.fallbacks == 1, "potential breach must take exact path"
+        assert led.fallbacks == 0, "breaches resolve on device now"
+        assert led.fixpoint_batches == 1
         assert any(r.status.name == "exceeds_credits" for r in want)
 
 

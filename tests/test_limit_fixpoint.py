@@ -1,0 +1,183 @@
+"""Order-dependent balance limits on device: the K-round status fixpoint.
+
+reference: the exceeds_credits/exceeds_debits checks
+(src/tigerbeetle.zig:34-42, src/state_machine.zig:3903-3904) whose
+sequential semantics (event i sees every successful earlier event's
+balances) previously forced a host fallback whenever the worst-case
+headroom proof failed.
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags,
+    Transfer,
+    TransferFlags,
+)
+
+DR_LIMIT = int(AccountFlags.debits_must_not_exceed_credits)
+CR_LIMIT = int(AccountFlags.credits_must_not_exceed_debits)
+LINKED = int(TransferFlags.linked)
+PENDING = int(TransferFlags.pending)
+VOID = int(TransferFlags.void_pending_transfer)
+
+
+def _pair():
+    led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+    sm = StateMachineOracle()
+    return led, sm
+
+
+def _both(led, sm, events, ts):
+    got = led.create_transfers(events, ts)
+    want = sm.create_transfers(events, ts)
+    assert ([(r.timestamp, r.status) for r in got]
+            == [(r.timestamp, r.status) for r in want]), (
+        [r.status.name for r in got], [r.status.name for r in want])
+    return [r.status.name for r in got]
+
+
+def _setup(led, sm, accounts, fund=()):
+    for eng in (led, sm):
+        res = eng.create_accounts(accounts, 100)
+        assert all(r.status.name == "created" for r in res)
+    ts = 10**12
+    for i, (dr, cr, amt) in enumerate(fund):
+        _both(led, sm, [Transfer(id=900 + i, debit_account_id=dr,
+                                 credit_account_id=cr, amount=amt,
+                                 ledger=1, code=1)], ts)
+        ts += 10
+    return ts
+
+
+class TestLimitFixpoint:
+    def test_simple_breach_resolved_on_device(self):
+        """Two debits whose sum breaches the headroom: the first passes,
+        the second fails exceeds_credits — on device (no host fallback)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=2, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                     amount=60, ledger=1, code=1),
+            Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                     amount=60, ledger=1, code=1)], ts)
+        assert st == ["created", "exceeds_credits"]
+        assert led.fallbacks == 0 and led.fixpoint_batches == 1
+
+    def test_mid_batch_void_relief_honored(self):
+        """A void earlier in the batch releases pending debits; the later
+        debit passes exactly as the sequential semantics dictate (the
+        worst-case proof ignores relief and must NOT decide this)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=2, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                     amount=50, ledger=1, code=1, flags=PENDING)], ts)
+        assert st == ["created"]
+        ts += 10
+        st = _both(led, sm, [
+            Transfer(id=11, pending_id=10, flags=VOID),
+            Transfer(id=12, debit_account_id=1, credit_account_id=2,
+                     amount=90, ledger=1, code=1)], ts)
+        assert st == ["created", "created"]
+        assert led.fixpoint_batches >= 1 and led.fallbacks == 0
+
+    def test_cascade_failure_frees_room_for_later_event(self):
+        """[80, 80, 15] against headroom 100: the middle failure releases
+        its load, so the third passes — a two-wave cascade the fixpoint
+        resolves (round 1 fails both; round 2 re-admits the third)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=2, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=20, debit_account_id=1, credit_account_id=2,
+                     amount=80, ledger=1, code=1),
+            Transfer(id=21, debit_account_id=1, credit_account_id=2,
+                     amount=80, ledger=1, code=1),
+            Transfer(id=22, debit_account_id=1, credit_account_id=2,
+                     amount=15, ledger=1, code=1)], ts)
+        assert st == ["created", "exceeds_credits", "created"]
+        assert led.fixpoint_batches == 1 and led.fallbacks == 0
+
+    def test_chain_rollback_interacts_with_limits(self):
+        """A limit failure breaks its chain; the rolled-back member's load
+        disappears, which re-admits a later event on the OTHER account."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=3, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=2, ledger=1, code=1)],
+                    fund=[(2, 1, 100), (2, 3, 100)])
+        st = _both(led, sm, [
+            # Chain: the breach on account 1 rolls back the account-3 leg.
+            Transfer(id=30, debit_account_id=1, credit_account_id=2,
+                     amount=150, ledger=1, code=1, flags=LINKED),
+            Transfer(id=31, debit_account_id=3, credit_account_id=2,
+                     amount=70, ledger=1, code=1),
+            # Passes only because id=31 was rolled back (70+70 > 100).
+            Transfer(id=32, debit_account_id=3, credit_account_id=2,
+                     amount=70, ledger=1, code=1)], ts)
+        assert st == ["exceeds_credits", "linked_event_failed", "created"]
+        assert led.fixpoint_batches == 1 and led.fallbacks == 0
+
+    def test_credit_side_limit(self):
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1, flags=CR_LIMIT)],
+                    fund=[(2, 1, 40)])
+        st = _both(led, sm, [
+            Transfer(id=40, debit_account_id=1, credit_account_id=2,
+                     amount=30, ledger=1, code=1),
+            Transfer(id=41, debit_account_id=1, credit_account_id=2,
+                     amount=30, ledger=1, code=1)], ts)
+        assert st == ["created", "exceeds_debits"]
+        assert led.fixpoint_batches == 1 and led.fallbacks == 0
+
+    def test_randomized_limit_heavy_parity(self):
+        """Randomized limit-heavy workload: device (fast + fixpoint) stays
+        bit-exact vs the oracle, and the final states match."""
+        rng = np.random.default_rng(17)
+        led, sm = _pair()
+        accounts = [Account(id=i, ledger=1, code=1,
+                            flags=DR_LIMIT if i % 3 == 0 else
+                            (CR_LIMIT if i % 3 == 1 else 0))
+                    for i in range(1, 17)]
+        ts = _setup(led, sm, accounts,
+                    fund=[(2, i, 200) for i in range(3, 16, 3)])
+        next_id = 1000
+        for _ in range(6):
+            events = []
+            for _ in range(64):
+                dr = int(rng.integers(1, 17))
+                cr = int(rng.integers(1, 17))
+                if dr == cr:
+                    cr = dr % 16 + 1
+                events.append(Transfer(
+                    id=next_id, debit_account_id=dr, credit_account_id=cr,
+                    amount=int(rng.integers(1, 120)), ledger=1, code=1,
+                    flags=LINKED if rng.random() < 0.1 else 0))
+                next_id += 1
+            if events[-1].flags & LINKED:
+                events[-1] = Transfer(
+                    id=events[-1].id,
+                    debit_account_id=events[-1].debit_account_id,
+                    credit_account_id=events[-1].credit_account_id,
+                    amount=events[-1].amount, ledger=1, code=1)
+            ts += 100
+            _both(led, sm, events, ts)
+        host = led.to_host()
+        assert host.accounts == sm.accounts
+        assert host.transfers == sm.transfers
+        assert led.fixpoint_batches >= 1, "workload must hit the fixpoint"

@@ -184,8 +184,10 @@ def bench_config3(batches, account_count=1000):
 
 
 def bench_config4(batches=2, n=1024, account_count=64):
-    """Two-phase under balance limits: exact fallback path (host sequential
-    kernel). Deliberately small — this is the hard-semantics config."""
+    """Two-phase under balance limits — the hard-semantics config: breach
+    batches run the on-device limit fixpoint (ops/fast_kernels.py
+    LIMIT_FIXPOINT_ROUNDS); only cascades deeper than the round budget
+    would fall back to the exact host path."""
     from .ops.ledger import DeviceLedger
 
     led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)

@@ -38,11 +38,16 @@ SNAPSHOT_LATEST = (1 << 64) - 1
 class LevelEntry:
     """One table's manifest entry (reference: manifest.zig TableInfo —
     address/checksum live in lsm/table.py's TableInfo; this adds the
-    snapshot dimension)."""
+    snapshot dimension). `seq` is the level-local insertion sequence:
+    recency for overlapping level-0 tables is decided by it, never by
+    snapshot_min (two tables can share a snapshot — e.g. a bar-boundary
+    flush plus a checkpoint-time flush at the same op — and a restore
+    must not re-derive recency from op numbers)."""
 
     table: object  # lsm.table.Table
     snapshot_min: int
     snapshot_max: int = SNAPSHOT_LATEST
+    seq: int = 0
 
     @property
     def key_min(self) -> bytes:
@@ -74,11 +79,16 @@ class ManifestLevel:
         self.keep_sorted = keep_sorted
         self.live: list[LevelEntry] = []
         self.history: list[LevelEntry] = []
+        self.next_seq = 0
 
     # ------------------------------------------------------------ mutation
 
-    def insert(self, table, snapshot: int) -> None:
-        entry = LevelEntry(table=table, snapshot_min=snapshot)
+    def insert(self, table, snapshot: int,
+               seq: Optional[int] = None) -> None:
+        if seq is None:
+            seq = self.next_seq
+        self.next_seq = max(self.next_seq, seq + 1)
+        entry = LevelEntry(table=table, snapshot_min=snapshot, seq=seq)
         if self.keep_sorted:
             i = bisect.bisect_left(self.live, entry.key_min,
                                    key=lambda e: e.key_min)
@@ -117,7 +127,7 @@ class ManifestLevel:
         if self.keep_sorted:
             out.sort(key=lambda e: e.key_min)
         else:
-            out.sort(key=lambda e: e.snapshot_min)
+            out.sort(key=lambda e: e.seq)  # oldest first (scan reverses)
         return out
 
     def lookup(self, key: bytes, snapshot: Optional[int] = None):
@@ -132,7 +142,7 @@ class ManifestLevel:
             return []
         cands = [e for e in self.visible(snapshot)
                  if e.key_min <= key <= e.key_max]
-        cands.sort(key=lambda e: -e.snapshot_min)
+        cands.sort(key=lambda e: -e.seq)  # newest insertion first
         return [e.table for e in cands]
 
     def query(self, key_min: bytes, key_max: bytes,

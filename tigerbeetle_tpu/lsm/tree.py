@@ -294,13 +294,21 @@ class Tree:
         function of the inputs, so the grids stay byte-identical even
         though the restarted replica redoes the merge work."""
         self.flush_memtable()
-        parts = [struct.pack("<B", LSM_LEVELS)]
+        # The beat is persisted so a restored tree keeps stamping snapshots
+        # and pruning on the same op clock (a reset-to-zero beat would
+        # invert level-0 recency for post-restore flushes and re-extend
+        # the retention window).
+        parts = [struct.pack("<QB", self.beat, LSM_LEVELS)]
         for level in self.levels:
             entries = list(level.live) + list(level.history)
-            parts.append(struct.pack("<I", len(entries)))
+            # next_seq is persisted (not re-derived from surviving
+            # entries): once the max-seq entry is pruned, a re-derived
+            # counter would diverge from never-restarted replicas and
+            # break byte-identical checkpoints.
+            parts.append(struct.pack("<QI", level.next_seq, len(entries)))
             for e in entries:
-                parts.append(struct.pack("<QQ", e.snapshot_min,
-                                         e.snapshot_max))
+                parts.append(struct.pack("<QQQ", e.snapshot_min,
+                                         e.snapshot_max, e.seq))
                 parts.append(e.table.info.pack())
         parts.append(struct.pack("<I", len(self._jobs)))
         for job in self._jobs:
@@ -313,26 +321,30 @@ class Tree:
     def manifest_restore(self, raw: bytes) -> None:
         from .manifest_level import LevelEntry
 
-        (n_levels,) = struct.unpack_from("<B", raw)
+        beat, n_levels = struct.unpack_from("<QB", raw)
         assert n_levels == LSM_LEVELS
-        pos = 1
+        self.beat = beat
+        pos = 9
         self.levels = [ManifestLevel(keep_sorted=(i > 0))
                        for i in range(LSM_LEVELS)]
         for level in range(n_levels):
-            (count,) = struct.unpack_from("<I", raw, pos)
-            pos += 4
+            next_seq, count = struct.unpack_from("<QI", raw, pos)
+            pos += 12
             for _ in range(count):
-                snap_min, snap_max = struct.unpack_from("<QQ", raw, pos)
-                pos += 16
+                snap_min, snap_max, seq = struct.unpack_from(
+                    "<QQQ", raw, pos)
+                pos += 24
                 info, pos = TableInfo.unpack(raw, pos)
                 table = Table(self.grid, info, self.key_size,
                               self.value_size)
                 if snap_max == SNAPSHOT_LATEST:
-                    self.levels[level].insert(table, snapshot=snap_min)
+                    self.levels[level].insert(table, snapshot=snap_min,
+                                              seq=seq)
                 else:
                     self.levels[level].history.append(LevelEntry(
                         table=table, snapshot_min=snap_min,
-                        snapshot_max=snap_max))
+                        snapshot_max=snap_max, seq=seq))
+            self.levels[level].next_seq = next_seq
         self.memtable.clear()
         # Rebuild in-flight jobs against the RESTORED Table objects
         # (identity matters: finalize removes job tables from the level

@@ -27,13 +27,16 @@ import dataclasses
 # r3: manifest entries carry (snapshot_min, snapshot_max) ranges
 #     (lsm/manifest_level.py) — the packed layout shifted by 16 bytes per
 #     table entry.
-RELEASE = 3
+# r4: tree manifests persist the op clock (beat) and per-level insertion
+#     sequences (next_seq + per-entry seq) so restores preserve level-0
+#     recency and seq determinism.
+RELEASE = 4
 
 # Oldest checkpoint format this binary still opens. Checkpoints below the
 # floor are refused at open with a rebuild instruction — enforcing the
 # "old data files must be rebuilt" requirement instead of silently
 # misparsing the shifted manifest layout.
-FORMAT_FLOOR = 3
+FORMAT_FLOOR = 4
 
 
 def release_str(release: int) -> str:

@@ -159,6 +159,81 @@ def _dup_keys(k_hi, k_lo, tags):
     return jnp.any(eq & both)
 
 
+_FIELDS = ("dp", "dpos", "cp", "cpos")
+_FI = {f: i for i, f in enumerate(_FIELDS)}
+
+
+def _delta_lanes2(ap_reg, ap_pend, ap_pv, ap_post, al, nl):
+    """(4 fields, 4 limbs, 2N) per-entry balance delta lanes — debit-side
+    entries then credit-side entries — from pre-ANDed application masks.
+    Shared by the snapshot/application stage and the limit fixpoint. All
+    lanes are < 2^32 (u32-normalized limbs incl. the two's-complement
+    pv releases), so segment prefix sums stay carry-safe in u64."""
+    z64 = jnp.uint64(0)
+
+    def ln(cond_pos, limbs, cond_neg=None, nlimbs=None):
+        out = []
+        for j in range(4):
+            lane = jnp.where(cond_pos, limbs[j], z64)
+            if cond_neg is not None:
+                lane = lane + jnp.where(cond_neg, nlimbs[j], z64)
+            out.append(lane)
+        return out
+
+    zero4 = [jnp.zeros_like(al[0])] * 4
+    dr_side = {
+        "dp": ln(ap_pend, al, ap_pv, nl),
+        "dpos": ln(ap_reg | ap_post, al),
+        "cp": zero4, "cpos": zero4,
+    }
+    cr_side = {
+        "dp": zero4, "dpos": zero4,
+        "cp": ln(ap_pend, al, ap_pv, nl),
+        "cpos": ln(ap_reg | ap_post, al),
+    }
+    return jnp.stack([
+        jnp.stack([jnp.concatenate([dr_side[f][j], cr_side[f][j]])
+                   for j in range(4)])
+        for f in _FIELDS])
+
+
+def _normalize_limbs(limbs):
+    """(4, 4, 2N) un-normalized limb stacks -> mod-2^128 u32-normalized
+    (3 carry steps; the final carry-out is discarded = mod 2^128)."""
+    l0 = limbs[:, 0]; l1 = limbs[:, 1]; l2 = limbs[:, 2]; l3 = limbs[:, 3]
+    c = l0 >> jnp.uint64(32); l0 = l0 & _M32
+    l1 = l1 + c; c = l1 >> jnp.uint64(32); l1 = l1 & _M32
+    l2 = l2 + c; c = l2 >> jnp.uint64(32); l2 = l2 & _M32
+    l3 = (l3 + c) & _M32
+    return l0, l1, l2, l3
+
+
+def _chain_pass(status, linked, valid, idxs, n, N):
+    """Linked-chain first-failure broadcast (reference execute_create
+    :3033-3150): returns (status, not_the_failure, my_first, in_chain)
+    where not_the_failure marks members overridden to linked_event_failed.
+    Pure in `status` — the limit fixpoint re-runs it per round."""
+    l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
+    in_chain = linked | l_prev
+    start = linked & ~l_prev
+    chain_id = _cumsum(start.astype(jnp.int32))
+    is_last = idxs == (n - 1)
+    chain_open_evt = linked & is_last
+    status = jnp.where(chain_open_evt, _TS["linked_event_chain_open"],
+                       status)
+    fail = in_chain & valid & (status != _CREATED)
+    fail_pos = jnp.where(fail, idxs, _INF)
+    seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
+    my_first = seg_first[chain_id]
+    broken = in_chain & (my_first != _INF)
+    # chain_open is applied AFTER chain_broken in the sequential order
+    # (reference execute_create :3096-3104), so the open-chain terminator
+    # keeps linked_event_chain_open even when an earlier member failed.
+    not_the_failure = broken & (idxs != my_first) & ~chain_open_evt
+    status = jnp.where(not_the_failure, _TS["linked_event_failed"], status)
+    return status, not_the_failure, my_first, in_chain
+
+
 # ================================================== create_transfers (fast)
 
 def _acct_gather(acc, rows, found):
@@ -352,16 +427,24 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
 
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
-                          per_event=None):
+                          per_event=None, limit_rounds=1):
     """One batch against the device ledger. Returns (new_state, out) where
-    out = {r_status, r_ts, fallback, created_count}. When out['fallback'] is
-    set, new_state is the input state unchanged (every write is masked to the
-    dump slot, so donated buffers are reusable in place).
+    out = {r_status, r_ts, fallback, limit_only, created_count}. When
+    out['fallback'] is set, new_state is the input state unchanged (every
+    write is masked to the dump slot, so donated buffers are reusable in
+    place); out['limit_only'] marks a fallback whose ONLY cause was the
+    balance-limit headroom proof — the caller redispatches those to the
+    fixpoint variant instead of the host.
 
     force_fallback: optional bool scalar that aborts the batch uncondition-
     ally (used by the scan driver to poison batches after a fallback).
     per_event: optional precomputed per_event_status() result (the sharded
-    SPMD path computes it per device slice and all-gathers)."""
+    SPMD path computes it per device slice and all-gathers).
+    limit_rounds (static): 1 = gate order-dependent balance limits behind
+    the worst-case headroom proof (fallback on a potential breach);
+    K > 1 = resolve breaches natively with a K-round status fixpoint
+    against exact per-event prefix balances (falls back only if the
+    limit-decision cascade is deeper than K rounds)."""
     from .hash_table import ht_plan, ht_write
 
     acc = state["accounts"]
@@ -505,26 +588,106 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     e5 = jnp.any(valid & is_void & p_found
                  & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
 
+    if limit_rounds > 1:
+        # ---- order-dependent balance limits: K-round status fixpoint ----
+        # Sequential semantics: event i's limit check reads the balances
+        # produced by every SUCCESSFUL earlier event (incl. pending adds
+        # and pv releases). Iterate: start optimistic (no limit failures),
+        # each round re-derive chains + applied deltas + exact per-event
+        # PRE-event balances (segmented exclusive prefix sums over a
+        # status-independent sort), re-evaluate the limit checks, repeat.
+        # Each round fixes at least the earliest event whose status
+        # disagrees with the sequential truth (its own prefix is already
+        # correct and stays correct), so K rounds resolve any batch whose
+        # limit-decision cascade is shallower than K; deeper cascades
+        # fall back to the exact host path.
+        alx = _to_limbs(amt_res_hi, amt_res_lo)
+        nlx = _neg_limbs(p["amt_hi"], p["amt_lo"])
+        frows2 = jnp.concatenate([
+            jnp.where(valid, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
+            jnp.where(valid, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
+        ])
+        forder = jnp.concatenate([idxs, idxs])
+        fpos = jnp.arange(2 * N, dtype=jnp.int64)
+        fcomb = ((frows2.astype(jnp.int64) << jnp.int64(34))
+                 | (forder.astype(jnp.int64) << jnp.int64(17))
+                 | fpos & jnp.int64((1 << 17) - 1))
+        fperm = jnp.argsort(fcomb).astype(jnp.int32)
+        frows_sorted = frows2[fperm]
+        fstart = jnp.concatenate([
+            jnp.ones(1, dtype=jnp.bool_),
+            frows_sorted[1:] != frows_sorted[:-1]])
+        fseg_id = _cumsum(fstart.astype(jnp.int32)) - 1
+        fseg_start = jax.ops.segment_max(
+            jnp.where(fstart, jnp.arange(2 * N, dtype=jnp.int32),
+                      jnp.int32(0)),
+            fseg_id, num_segments=2 * N)[fseg_id]
+        finv = jnp.zeros(2 * N, dtype=jnp.int32).at[fperm].set(
+            jnp.arange(2 * N, dtype=jnp.int32))
+        fbase = acc["bal"][frows_sorted].T.reshape(4, 4, 2 * N)
+        cand_dr = (valid & ~pv & _flag(dr["flags"], _A_DR_LIMIT)
+                   & (status == _CREATED))
+        cand_cr = (valid & ~pv & _flag(cr["flags"], _A_CR_LIMIT)
+                   & (status == _CREATED))
+
+        def _over(pre_evt, held1, held2, against, amt):
+            # (held1_pre + held2_pre + amount) > against_pre, 5 limbs.
+            lft = [pre_evt[_FI[held1], j] + pre_evt[_FI[held2], j] + amt[j]
+                   for j in range(4)]
+            c = lft[0] >> jnp.uint64(32); f0 = lft[0] & _M32
+            lft[1] = lft[1] + c
+            c = lft[1] >> jnp.uint64(32); f1 = lft[1] & _M32
+            lft[2] = lft[2] + c
+            c = lft[2] >> jnp.uint64(32); f2 = lft[2] & _M32
+            lft[3] = lft[3] + c
+            l4 = lft[3] >> jnp.uint64(32); f3 = lft[3] & _M32
+            left_hi = f2 | (f3 << jnp.uint64(32))
+            left_lo = f0 | (f1 << jnp.uint64(32))
+            right_hi = (pre_evt[_FI[against], 2]
+                        | (pre_evt[_FI[against], 3] << jnp.uint64(32)))
+            right_lo = (pre_evt[_FI[against], 0]
+                        | (pre_evt[_FI[against], 1] << jnp.uint64(32)))
+            return (l4 > 0) | u128.lt(right_hi, right_lo,
+                                      left_hi, left_lo)
+
+        over_dr = jnp.zeros_like(valid)
+        over_cr = jnp.zeros_like(valid)
+        fix_converged = jnp.bool_(True)
+        for _round in range(limit_rounds):
+            st_r = jnp.where(over_dr, _TS["exceeds_credits"], status)
+            st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
+                             st_r)
+            st_r, _, _, _ = _chain_pass(st_r, linked, valid, idxs, n, N)
+            ap_r = valid & (st_r == _CREATED)
+            fl = _delta_lanes2(ap_r & ~pv & ~pending, ap_r & ~pv & pending,
+                               ap_r & pv, ap_r & pv & is_post, alx, nlx)
+            fls = fl[:, :, fperm]
+            fcs = _cumsum(fls, axis=2)
+            foff = jnp.where(
+                fseg_start > 0,
+                jnp.take(fcs, jnp.maximum(fseg_start - 1, 0), axis=2),
+                jnp.uint64(0))
+            # EXCLUSIVE prefix = pre-event balances (subtract own delta);
+            # all lane limbs < 2^32, prefixes < 2^45: carry-safe.
+            pre = jnp.stack(_normalize_limbs(fbase + fcs - foff - fls),
+                            axis=1)
+            pre_dr = jnp.take(pre, finv[:N], axis=2)
+            pre_cr = jnp.take(pre, finv[N:], axis=2)
+            new_over_dr = cand_dr & _over(pre_dr, "dp", "dpos", "cpos", alx)
+            new_over_cr = cand_cr & _over(pre_cr, "cp", "cpos", "dpos", alx)
+            fix_converged = jnp.all((new_over_dr == over_dr)
+                                    & (new_over_cr == over_cr))
+            over_dr, over_cr = new_over_dr, new_over_cr
+        status = jnp.where(over_dr, _TS["exceeds_credits"], status)
+        status = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
+                           status)
+        e3 = ~fix_converged
+
     fallback_pre = e1 | e2 | e3 | e4 | e5
 
     # ---------------- chains: segment first-failure broadcast ----------------
-    l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
-    in_chain = linked | l_prev
-    start = linked & ~l_prev
-    chain_id = _cumsum(start.astype(jnp.int32))
-    is_last = idxs == (n - 1)
-    chain_open_evt = linked & is_last
-    status = jnp.where(chain_open_evt, _TS["linked_event_chain_open"], status)
-    fail = in_chain & valid & (status != _CREATED)
-    fail_pos = jnp.where(fail, idxs, _INF)
-    seg_first = jax.ops.segment_min(fail_pos, chain_id, num_segments=N + 1)
-    my_first = seg_first[chain_id]
-    broken = in_chain & (my_first != _INF)
-    # chain_open is applied AFTER chain_broken in the sequential order
-    # (reference execute_create :3096-3104), so the open-chain terminator
-    # keeps linked_event_chain_open even when an earlier member failed.
-    not_the_failure = broken & (idxs != my_first) & ~chain_open_evt
-    status = jnp.where(not_the_failure, _TS["linked_event_failed"], status)
+    status, not_the_failure, my_first, in_chain = _chain_pass(
+        status, linked, valid, idxs, n, N)
     ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
 
     status = jnp.where(valid, status, jnp.uint32(0))
@@ -562,9 +725,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     orph_pos, orph_ok = ht_plan(
         state["orphan_ht"], ev["id_hi"], ev["id_lo"], orphan_new)
 
-    fallback = fallback_pre | e7 | e8 | ~ins_ok | ~orph_ok
+    others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok | ~orph_ok
     if force_fallback is not None:
-        fallback = fallback | force_fallback
+        others = others | force_fallback
+    fallback = others | e3
+    # A fallback caused ONLY by the balance-limit headroom proof is
+    # resolvable on device: the caller redispatches it to the fixpoint
+    # variant (limit_rounds > 1) instead of the exact host path.
+    limit_only = e3 & ~others & jnp.bool_(limit_rounds == 1)
     ok = ~fallback
 
     # ---------------- application (all masked by ok) ----------------
@@ -653,34 +821,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         jnp.where(ap, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
         jnp.where(ap, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
     ]
-    # Per-entry limb deltas for the 4 balance fields (16 lanes/side).
-    zl = (z64, z64, z64, z64)
-
-    def lanes(cond_pos, pos_limbs, cond_neg=None, neg_limbs=zl):
-        out = []
-        for j in range(4):
-            lane = jnp.where(cond_pos & ap, pos_limbs[j], z64)
-            if cond_neg is not None:
-                lane = lane + jnp.where(cond_neg & ap, neg_limbs[j], z64)
-            out.append(lane)
-        return out
-
     al = (al0, al1, al2, al3)
     nl = (nl0, nl1, nl2, nl3)
-    deltas = [  # [side][field] -> 4 limb lanes
-        {  # debit side
-            "dp": lanes(ap_pend, al, ap_pv, nl),
-            "dpos": lanes(ap_reg | ap_post, al),
-            "cp": lanes(jnp.zeros_like(ap), al),
-            "cpos": lanes(jnp.zeros_like(ap), al),
-        },
-        {  # credit side
-            "dp": lanes(jnp.zeros_like(ap), al),
-            "dpos": lanes(jnp.zeros_like(ap), al),
-            "cp": lanes(ap_pend, al, ap_pv, nl),
-            "cpos": lanes(ap_reg | ap_post, al),
-        },
-    ]
     rows2 = jnp.concatenate(side_rows)  # 2N entries: dr sides then cr sides
     order2 = jnp.concatenate([idxs, idxs])
     # Single-key sort: (row, event order) packed into one int64 — one sort
@@ -701,11 +843,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     # Stacked (4 fields, 4 limbs, 2N): ONE sort-gather, ONE cumsum, ONE
     # segment-offset gather, ONE base add — not 16 scalar-lane pipelines.
-    fields = ("dp", "dpos", "cp", "cpos")
-    lanes2 = jnp.stack([
-        jnp.stack([jnp.concatenate([deltas[0][field][j], deltas[1][field][j]])
-                   for j in range(4)])
-        for field in fields])                        # (4, 4, 2N)
+    fields = _FIELDS
+    lanes2 = _delta_lanes2(ap_reg, ap_pend, ap_pv, ap_post, al, nl)
     lanes_sorted = lanes2[:, :, perm]
     cs = _cumsum(lanes_sorted, axis=2)
     offsets = jnp.where(
@@ -715,12 +854,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # (column = field * 4 + limb, matching the `fields` order).
     base = acc["bal"][rows_sorted].T.reshape(4, 4, 2 * N)
     limbs = base + cs - offsets                      # (4, 4, 2N)
-    # Carry-normalize mod 2^128 along the limb axis (3 carry steps).
-    l0 = limbs[:, 0]; l1 = limbs[:, 1]; l2 = limbs[:, 2]; l3 = limbs[:, 3]
-    c = l0 >> jnp.uint64(32); l0 = l0 & _M32
-    l1 = l1 + c; c = l1 >> jnp.uint64(32); l1 = l1 & _M32
-    l2 = l2 + c; c = l2 >> jnp.uint64(32); l2 = l2 & _M32
-    l3 = (l3 + c) & _M32
+    l0, l1, l2, l3 = _normalize_limbs(limbs)
     hi_sorted = l2 | (l3 << jnp.uint64(32))          # (4, 2N)
     lo_sorted = l0 | (l1 << jnp.uint64(32))
 
@@ -833,12 +967,25 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         r_ts=jnp.where(ok, jnp.where(valid, ts_actual, jnp.uint64(0)),
                        jnp.zeros_like(ts_actual)),
         fallback=fallback,
+        limit_only=limit_only,
         created_count=jnp.where(ok, n_created, 0),
     )
     return new_state, out
 
 
 create_transfers_fast_jit = jax.jit(create_transfers_fast, donate_argnums=0)
+
+# The order-dependent-limits variant: resolves headroom-proof breaches
+# natively with a K-round status fixpoint (cascades deeper than K
+# limit-decision waves fall back to the exact host path; each wave needs
+# a limit failure whose rollback flips a LATER event's limit outcome —
+# K=8 empirically covers even the adversarial config4 workload with ~16
+# breach-boundary events per limited account per batch).
+LIMIT_FIXPOINT_ROUNDS = 8
+create_transfers_fixpoint_jit = jax.jit(
+    functools.partial(create_transfers_fast,
+                      limit_rounds=LIMIT_FIXPOINT_ROUNDS),
+    donate_argnums=0)
 
 # Tiny on-device accumulator for back-to-back batch drivers: summing
 # created_counts on device keeps the dispatch loop free of per-batch host

@@ -316,6 +316,7 @@ class DeviceLedger:
         self.recycle_events = False
         self.fallbacks = 0
         self.fast_batches = 0
+        self.fixpoint_batches = 0
         # Host-mirror fallback regime (see _fallback_transfers): a live
         # oracle mirror of the device state, reused across consecutive
         # hard batches so each one costs an oracle apply + a dirty-delta
@@ -386,7 +387,10 @@ class DeviceLedger:
 
     def create_transfers_arrays(self, ev: dict, timestamp: int, transfers=None):
         """ev: unpadded SoA dict (the zero-host-cost entry point)."""
-        from .fast_kernels import create_transfers_fast_jit
+        from .fast_kernels import (
+            create_transfers_fast_jit,
+            create_transfers_fixpoint_jit,
+        )
 
         if self._mirror_route():
             self.fallbacks += 1
@@ -399,12 +403,20 @@ class DeviceLedger:
         evp = pad_transfer_events(ev)
         new_state, out = create_transfers_fast_jit(
             self.state, evp, np.uint64(timestamp), np.int32(n))
-        if bool(out["fallback"]):
+        self.state = new_state
+        if bool(out["fallback"]) and bool(out["limit_only"]):
+            # The only obstacle was the balance-limit headroom proof:
+            # order-dependent limits resolve natively on the fixpoint
+            # variant (only the state was donated — evp is intact).
+            new_state, out = create_transfers_fixpoint_jit(
+                self.state, evp, np.uint64(timestamp), np.int32(n))
             self.state = new_state
+            if not bool(out["fallback"]):
+                self.fixpoint_batches += 1
+        if bool(out["fallback"]):
             if transfers is None:
                 transfers = _transfers_from_arrays(ev)
             return self._fallback_transfers(transfers, timestamp)
-        self.state = new_state
         self.fast_batches += 1
         self._probe_succeeded()
         st = np.asarray(out["r_status"][:n])
@@ -1297,11 +1309,24 @@ def warmup_kernels(a_cap: int = 1 << 17, t_cap: int = 1 << 21) -> float:
     from ..types import Transfer as _Transfer
     from ..types import TransferFlags as _TF
 
+    from ..types import AccountFlags as _AF
+
     t0 = _time.time()
     led = DeviceLedger(a_cap=a_cap, t_cap=t_cap)
     led.create_accounts(
-        [_Account(id=1, ledger=1, code=1), _Account(id=2, ledger=1, code=1)],
+        [_Account(id=1, ledger=1, code=1), _Account(id=2, ledger=1, code=1),
+         _Account(id=3, ledger=1, code=1,
+                  flags=int(_AF.debits_must_not_exceed_credits))],
         1_000)
+    # Warm the limit-fixpoint kernel first (a breach batch): its first
+    # compile must never land on a live request — and it must run BEFORE
+    # any fallback batch puts the throwaway ledger into the mirror regime
+    # (mirror-routed batches never reach the kernels).
+    led.create_transfers(
+        [_Transfer(id=4, debit_account_id=3, credit_account_id=2, amount=1,
+                   ledger=1, code=1)],
+        2_000)
+    assert led.fixpoint_batches == 1, "breach batch must warm the fixpoint"
     led.create_transfers(
         [_Transfer(id=1, debit_account_id=1, credit_account_id=2, amount=1,
                    ledger=1, code=1),
@@ -1309,5 +1334,5 @@ def warmup_kernels(a_cap: int = 1 << 17, t_cap: int = 1 << 21) -> float:
                    ledger=1, code=1, flags=int(_TF.pending), timeout=3600),
          _Transfer(id=3, pending_id=2, amount=1, ledger=1, code=1,
                    flags=int(_TF.post_pending_transfer))],
-        2_000)
+        3_000)
     return _time.time() - t0

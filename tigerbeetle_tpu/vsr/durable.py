@@ -153,17 +153,19 @@ def manifest_children(manifest_raw: bytes) -> list:
         raw = manifest_raw[pos:pos + size]
         pos += size
         key_size = SCHEMA[name][0]
-        (n_levels,) = struct.unpack_from("<B", raw)
-        tpos = 1
+        # Tree blob: u64 beat, u8 level count (lsm.tree manifest_pack);
+        # per level: u64 next_seq, u32 entry count.
+        (n_levels,) = struct.unpack_from("<B", raw, 8)
+        tpos = 9
         for _ in range(n_levels):
-            (n_tables,) = struct.unpack_from("<I", raw, tpos)
-            tpos += 4
+            (n_tables,) = struct.unpack_from("<I", raw, tpos + 8)
+            tpos += 12
             for _ in range(n_tables):
-                # Each entry: snapshot range (2x u64, lsm.manifest_level)
-                # then the TableInfo. History entries (removed, unpruned)
-                # are reachable too — their blocks are still allocated
-                # until the retention bar elapses.
-                tpos += 16
+                # Each entry: snapshot range + seq (3x u64,
+                # lsm.manifest_level) then the TableInfo. History entries
+                # (removed, unpruned) are reachable too — their blocks
+                # stay allocated until the retention bar elapses.
+                tpos += 24
                 info, tpos = TableInfo.unpack(raw, tpos)
                 out.append((name, key_size, info))
     return out

@@ -55,6 +55,7 @@ nack quorum intersects every replication quorum).
 from __future__ import annotations
 
 import dataclasses
+import logging
 from typing import Callable, Optional
 
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
@@ -179,6 +180,13 @@ class Replica:
         # Ops below this are unverifiable from our journal (a start_view's
         # suffix began beyond them): execute only canonical entries there.
         self.sync_floor = 0
+        # Checkpoint-rollback recovery: at most one attempt per persisted
+        # checkpoint (a second divergence at the same checkpoint proves
+        # the checkpoint itself diverged — only state sync can help).
+        self._rollback_checkpoint = -1
+        # op -> monotonic time it entered rollback quarantine; lingering
+        # entries escalate to the state-sync path.
+        self._suspect_since: dict[int, int] = {}
         # Ops whose journaled prepare failed the forward-chain check (a
         # stale leftover under a committed op number): repair must fetch a
         # replacement even though a prepare is held.
@@ -624,12 +632,25 @@ class Replica:
             if prev_checksum and msg.header.parent != prev_checksum:
                 if want is not None:
                     # The CANONICAL prepare doesn't chain from what we
-                    # executed: our own prefix diverged. SAFE failure mode:
-                    # refuse to execute further (mark the journal
-                    # unverifiable; repair solicits a state-sync offer once
-                    # a peer checkpoint covers us). A checkpoint-rollback
-                    # re-execution recovery is the round-2 item here —
-                    # divergence is always preferred stalled over executed.
+                    # executed: our own prefix diverged (we executed a
+                    # deposed primary's prepare under a reused op number).
+                    # Recovery, in preference order:
+                    #   1. checkpoint rollback + re-execution: reload the
+                    #      last persisted checkpoint (a pure function of
+                    #      the committed prefix IF that prefix was
+                    #      canonical), quarantine the stale journal range,
+                    #      and let peer repairs — validated by forward
+                    #      hash-chaining down from the canonical suffix —
+                    #      replace and re-execute it;
+                    #   2. if the rollback was already tried at this
+                    #      checkpoint (the checkpoint itself diverged) or
+                    #      the checkpoint doesn't precede the divergence:
+                    #      refuse to execute (sync floor) and solicit a
+                    #      state-sync offer once a peer checkpoint covers
+                    #      us. Divergence is always preferred stalled over
+                    #      executed.
+                    if self._rollback_to_checkpoint(op):
+                        return
                     self.sync_floor = max(self.sync_floor,
                                           max(self.commit_max, op) + 1)
                     self.canonical.pop(op, None)
@@ -642,6 +663,63 @@ class Replica:
             self.chain_suspect.discard(op)
             self._commit_op(msg)
             prev_checksum = msg.header.checksum
+
+    def _rollback_to_checkpoint(self, first_divergent_op: int) -> bool:
+        """In-process checkpoint rollback for divergence recovery: reload
+        the last persisted checkpoint's state (forest, sessions, state
+        machine) exactly as a restart would, rewind commit_min to it, and
+        quarantine the stale journal range (chain_suspect) so repairs can
+        replace it with prepares that forward-chain from the canonical
+        suffix. Returns False when rollback cannot help: no superblock, a
+        corrupt snapshot, a checkpoint at/after the divergence, or a prior
+        attempt at this same checkpoint (re-divergence proves the
+        checkpoint itself is off the canonical history — the sync-floor /
+        state-sync path is then the only recovery).
+
+        Soundness: the rolled-back state re-executes ONLY prepares that
+        hash-chain down from view-change-quorum-installed canonical
+        headers; if our checkpoint prefix itself diverged, the first
+        re-executed op fails the backward-chain tripwire again and falls
+        through to the sync path — a wrong prefix is never extended."""
+        sb = self.superblock
+        if (sb is None or sb.op_checkpoint >= first_divergent_op
+                or self._rollback_checkpoint == sb.op_checkpoint):
+            return False
+        root = self.storage.read(
+            "snapshot",
+            sb.snapshot_slot * self.storage.layout.snapshot_size_max,
+            sb.snapshot_size)
+        if checksum(root, domain=b"ckptroot") != sb.snapshot_checksum:
+            return False
+        self._rollback_checkpoint = sb.op_checkpoint
+        forest_root, sessions_blob = _split_root(root)
+        # Fresh durable engine over the same storage: drops every
+        # in-memory LSM/grid structure the divergent suffix built (the
+        # copy-on-write grid still holds the checkpoint's blocks; blocks
+        # written after it are unreferenced from this root).
+        self.durable = DurableState(self.storage)
+        self.sessions.restore(sessions_blob)
+        self.state_machine = self.state_machine_factory()
+        self.state_machine.state = self.durable.open(forest_root,
+                                                     load_events=False)
+        self.state_machine.attach_durable(self.durable)
+        old_commit_min = self.commit_min
+        self.commit_min = sb.op_checkpoint
+        self.prepare_timestamp = self.state_machine.state.commit_timestamp
+        now = self.time.monotonic()
+        for op in range(sb.op_checkpoint + 1, first_divergent_op):
+            # The stale executed range: replaceable only by prepares that
+            # chain down from the canonical suffix.
+            self.chain_suspect.add(op)
+            self.repair_requested.setdefault(op, 0)
+            self._suspect_since.setdefault(op, now)
+        self.tracer.count("rollbacks")
+        logging.getLogger("tigerbeetle_tpu.vsr").warning(
+            "replica %d: divergence at op %d — rolled back to checkpoint "
+            "%d (was %d); re-executing the canonical history",
+            self.replica_id, first_divergent_op, sb.op_checkpoint,
+            old_commit_min)
+        return True
 
     def _commit_op(self, prepare: Message) -> None:
         h = prepare.header
@@ -1388,6 +1466,20 @@ class Replica:
             for r in range(self.peer_count):
                 if r != self.replica_id:
                     self.bus.send_to_replica(r, msg)
+        # Rollback-recovery escalation: a quarantined op whose true
+        # prepare no peer journal still holds can never zip down from the
+        # canonical suffix — once it lingers past the horizon, fall back
+        # to the state-sync path (peers checkpoint eventually, and their
+        # checkpoint then covers us).
+        horizon = 64 * self.options.repair_interval_ns
+        for op, since in list(self._suspect_since.items()):
+            if op <= self.commit_min or op not in self.chain_suspect:
+                del self._suspect_since[op]
+            elif now - since > horizon:
+                self.sync_floor = max(self.sync_floor,
+                                      max(self.commit_max, op) + 1)
+                self.chain_suspect.discard(op)
+                del self._suspect_since[op]
         self._try_start_view()  # a pending primary finalizes when complete
         self._sync_request_blocks(now)  # re-request lost sync blocks
         # Scrub repair: ask peers for fresh copies of corrupt blocks. A
