@@ -56,13 +56,17 @@ class TreeScan:
         self._advance()
 
     def _sources(self, start: bytes):
+        sources = []
         if self.snapshot is None:
-            memtable = sorted(
+            sources.append(sorted(
                 (k, v) for k, v in self.tree.memtable.items()
-                if start <= k <= self.key_max)
-            sources = [memtable]
-        else:
-            sources = []
+                if start <= k <= self.key_max))
+        if self.tree._frozen_visible(self.snapshot):
+            # The frozen memtable is table-visible from its freeze op on,
+            # even while its flush job is still streaming it out.
+            sources.append(sorted(
+                (k, v) for k, v in self.tree.immutable_map.items()
+                if start <= k <= self.key_max))
         # Levels newest-first; within L0, newest table first (L0 overlaps).
         for level_i, level in enumerate(self.tree.levels):
             entries = level.visible(self.snapshot)

@@ -102,12 +102,36 @@ class Table:
             yield from zip(keys, vals)
 
 
+def value_block_entry_max(grid: Grid, key_size: int,
+                          value_size: int) -> int:
+    """Entries per value block (u32 count header + packed k||v rows)."""
+    return max(1, (grid.block_size - 4) // (key_size + value_size))
+
+
 def table_entry_max(grid: Grid, key_size: int, value_size: int) -> int:
     """Largest entry count whose index still fits one block (reference:
     tables have a fixed value_count_max per comptime layout)."""
-    per_block = max(1, (grid.block_size - 4) // (key_size + value_size))
+    per_block = value_block_entry_max(grid, key_size, value_size)
     index_entries_max = (grid.block_size - 4) // (ADDRESS_SIZE + 4 + key_size)
     return per_block * index_entries_max
+
+
+def write_value_block(grid: Grid, chunk: list[tuple[bytes, bytes]]):
+    """One value block; returns (address, size, first_key) — the index
+    entry triple. The SINGLE encoder for the value-block layout (shared
+    by whole-table writes and the incremental memtable flush)."""
+    raw = struct.pack("<I", len(chunk)) + b"".join(k + v for k, v in chunk)
+    addr = grid.write_block(raw)
+    return addr, len(raw), chunk[0][0]
+
+
+def write_index_block(grid: Grid, blocks: list) -> tuple[BlockAddress, int]:
+    """The table's index block over (address, size, first_key) triples."""
+    index_raw = struct.pack("<I", len(blocks)) + b"".join(
+        addr.pack() + struct.pack("<I", size) + first
+        for addr, size, first in blocks)
+    assert len(index_raw) <= grid.block_size, "table too large for one index"
+    return grid.write_block(index_raw), len(index_raw)
 
 
 def write_tables(grid: Grid, entries: list[tuple[bytes, bytes]],
@@ -124,22 +148,12 @@ def write_table(grid: Grid, entries: list[tuple[bytes, bytes]],
                 key_size: int, value_size: int) -> TableInfo:
     """Serialize one sorted run (caller guarantees sort order + unique keys)."""
     assert entries
-    entry_size = key_size + value_size
-    per_block = max(1, (grid.block_size - 4) // entry_size)
-    index_parts = [b""]  # placeholder for count
-    block_count = 0
-    for base in range(0, len(entries), per_block):
-        chunk = entries[base:base + per_block]
-        raw = struct.pack("<I", len(chunk)) + b"".join(k + v for k, v in chunk)
-        addr = grid.write_block(raw)
-        index_parts.append(addr.pack() + struct.pack("<I", len(raw))
-                           + chunk[0][0])
-        block_count += 1
-    index_raw = struct.pack("<I", block_count) + b"".join(index_parts[1:])
-    assert len(index_raw) <= grid.block_size, "table too large for one index"
-    index_addr = grid.write_block(index_raw)
+    per_block = value_block_entry_max(grid, key_size, value_size)
+    blocks = [write_value_block(grid, entries[base:base + per_block])
+              for base in range(0, len(entries), per_block)]
+    index_addr, index_size = write_index_block(grid, blocks)
     return TableInfo(
-        index_address=index_addr, index_size=len(index_raw),
+        index_address=index_addr, index_size=index_size,
         key_min=entries[0][0], key_max=entries[-1][0],
         entry_count=len(entries))
 

@@ -10,15 +10,18 @@ selection, docs/internals/lsm.md:93-108).
 Pacing model here (incremental, VERDICT r1 #5 — reference:
 src/lsm/compaction.zig:289, docs/internals/lsm.md:37-138): `compact_beat()`
 is called once per committed op (the reference's beat). At each bar
-boundary the mutable memtable flushes to level 0 and one compaction JOB is
-scheduled per over-budget level; the jobs' merge work is then spread
-evenly across the bar's remaining beats (a bounded number of entries
-merged per beat), with grid writes deferred to the completing beat so a
-mid-bar checkpoint never sees partial on-disk state. The last beat of the
-bar drains whatever remains, so a bar always ends with its scheduled jobs
-installed. All decisions are pure functions of the op sequence —
-byte-deterministic across replicas (tested), including across a
-crash/replay (jobs hold only memory until completion)."""
+boundary the mutable memtable FREEZES (mutable/immutable swap,
+tree.zig:543) and one compaction JOB is scheduled per over-budget level;
+both kinds of work then spread evenly across the bar's remaining beats.
+The frozen memtable streams value blocks to the grid each beat but its
+tables INSTALL only at completion; compaction merges in memory and writes
+only at its completing beat. Either way no manifest ever references
+partial state: checkpoints drain in-flight work first (manifest_pack),
+and blocks written by an abandoned mid-bar job are unreferenced (freed at
+the next checkpoint). The last beat of the bar drains whatever remains,
+so a bar always ends with its scheduled work installed. All decisions are
+pure functions of the op sequence — byte-deterministic across replicas
+(tested), including across a crash/replay."""
 
 from __future__ import annotations
 
@@ -33,13 +36,32 @@ from .table import (
     TableInfo,
     TOMBSTONE,
     release_table,
+    table_entry_max,
+    value_block_entry_max,
+    write_index_block,
     write_tables,
+    write_value_block,
 )
 
 LSM_LEVELS = 7
 GROWTH_FACTOR = 8
 BAR_LENGTH = 32  # ops per bar (reference: lsm_compaction_ops)
 L0_TABLES_MAX = 4
+
+
+@dataclasses.dataclass
+class _FlushJob:
+    """The frozen (immutable) memtable being written out incrementally
+    (reference: the mutable/immutable memtable pair, src/lsm/tree.zig +
+    table_memory.zig — the immutable side streams to disk across the
+    bar's beats while staying readable)."""
+
+    entries: list  # sorted (key, value)
+    snapshot: int  # freeze op: installed tables carry this snapshot_min
+    pos: int = 0
+    # Current table's completed value blocks: (address, size, first_key).
+    blocks: list = dataclasses.field(default_factory=list)
+    infos: list = dataclasses.field(default_factory=list)
 
 
 @dataclasses.dataclass
@@ -82,6 +104,11 @@ class Tree:
         self.value_size = value_size
         self.name = name
         self.memtable: dict[bytes, bytes] = {}
+        # Frozen previous memtable: readable while its flush job streams
+        # it into level-0 tables across the bar's beats.
+        self.immutable_map: dict[bytes, bytes] = {}
+        self._flush: Optional[_FlushJob] = None
+        self._flush_per_beat = 0
         # Per-level manifest structures over (key range x snapshot range)
         # (reference: src/lsm/manifest_level.zig). L0 tables overlap
         # (insertion order, recency decides); deeper levels are disjoint
@@ -113,6 +140,13 @@ class Tree:
         retention window; reference: manifest snapshot queries,
         src/lsm/manifest_level.zig)."""
         value = self.memtable.get(key) if snapshot is None else None
+        if value is None and self._frozen_visible(snapshot):
+            # The frozen memtable became logically table-visible at its
+            # freeze op: snapshots at or past it must read it even while
+            # the flush job is still streaming it out (otherwise the same
+            # (key, snapshot) would answer differently before and after
+            # the install).
+            value = self.immutable_map.get(key)
         if value is None:
             # L0 tables may overlap: newest-first probe; deeper levels
             # yield at most one candidate per snapshot (binary-searched on
@@ -154,7 +188,8 @@ class Tree:
         self.beat = self.beat + 1 if op is None else op
         phase = self.beat % BAR_LENGTH
         if phase == 0:
-            self.flush_memtable()
+            self._drain_flush()  # defensive: the previous freeze is done
+            self._freeze_memtable()
             self._drain_jobs()  # defensive: a bar never leaves work behind
             # Physically release tables removed at least one full bar ago
             # (snapshot reads within the retention window stay valid; a
@@ -162,6 +197,11 @@ class Tree:
             # identical block set — physical determinism).
             self._prune(self.beat - BAR_LENGTH)
             self._schedule_jobs()
+        if self._flush is not None:
+            if phase == BAR_LENGTH - 1:
+                self._drain_flush()
+            else:
+                self._advance_flush(self._flush_per_beat)
         if self._jobs:
             if phase == BAR_LENGTH - 1:
                 self._drain_jobs()
@@ -169,15 +209,81 @@ class Tree:
                 self._advance_jobs(self._per_beat)
 
     def flush_memtable(self) -> None:
+        """Synchronous freeze + drain (checkpoints and callers that need
+        every row table-resident NOW; the beat path streams instead)."""
+        self._freeze_memtable()
+        self._drain_flush()
+
+    # -------------------------------------------------- memtable flushing
+
+    def _frozen_visible(self, snapshot: Optional[int]) -> bool:
+        """Is the frozen memtable part of the view at `snapshot`?"""
+        if snapshot is None:
+            return True
+        return self._flush is not None and snapshot >= self._flush.snapshot
+
+    def _freeze_memtable(self) -> None:
+        """Swap mutable -> immutable (reference tree.zig:543): the frozen
+        rows stay readable from `immutable_map` while a flush job streams
+        them into level-0 tables across the bar's beats."""
         if not self.memtable:
             return
-        entries = sorted(self.memtable.items())
-        for info in write_tables(self.grid, entries, self.key_size,
-                                 self.value_size):
+        self._drain_flush()  # at most one frozen memtable at a time
+        self.immutable_map = self.memtable
+        self.memtable = {}
+        self._flush = _FlushJob(
+            entries=sorted(self.immutable_map.items()),
+            snapshot=self.beat)
+        self._flush_per_beat = max(
+            1, -(-len(self._flush.entries) // (BAR_LENGTH - 1)))
+
+    def _advance_flush(self, budget: Optional[int]) -> None:
+        """Write up to `budget` entries (whole value blocks; None = all).
+        Value blocks hit the grid each beat, but tables INSTALL only at
+        job completion: the mid-bar blocks stay unreferenced from any
+        manifest, and checkpoints drain the job first (manifest_pack ->
+        flush_memtable), so no checkpoint ever references a partial
+        table."""
+        job = self._flush
+        if job is None:
+            return
+        per_block = value_block_entry_max(self.grid, self.key_size,
+                                          self.value_size)
+        cap = table_entry_max(self.grid, self.key_size, self.value_size)
+        while job.pos < len(job.entries):
+            if budget is not None and budget <= 0:
+                return
+            table_end = min(len(job.entries),
+                            (job.pos // cap + 1) * cap)
+            chunk = job.entries[job.pos:min(job.pos + per_block, table_end)]
+            job.blocks.append(write_value_block(self.grid, chunk))
+            job.pos += len(chunk)
+            if budget is not None:
+                budget -= len(chunk)
+            if job.pos == table_end:
+                job.infos.append(self._finish_flush_table(job, cap))
+        # All entries written: install every produced table.
+        for info in job.infos:
             self.levels[0].insert(
                 Table(self.grid, info, self.key_size, self.value_size),
-                snapshot=self.beat)
-        self.memtable.clear()
+                snapshot=job.snapshot)
+        self.immutable_map = {}
+        self._flush = None
+
+    def _finish_flush_table(self, job: _FlushJob, cap: int) -> TableInfo:
+        index_addr, index_size = write_index_block(self.grid, job.blocks)
+        first_key = job.blocks[0][2]
+        # job.pos sits at this table's end; recover its entry range.
+        start = (job.pos - 1) // cap * cap
+        info = TableInfo(
+            index_address=index_addr, index_size=index_size,
+            key_min=first_key, key_max=job.entries[job.pos - 1][0],
+            entry_count=job.pos - start)
+        job.blocks = []
+        return info
+
+    def _drain_flush(self) -> None:
+        self._advance_flush(None)
 
     def _prune(self, snapshot_oldest: int) -> None:
         for level in self.levels:
@@ -346,6 +452,8 @@ class Tree:
                         snapshot_max=snap_max, seq=seq))
             self.levels[level].next_seq = next_seq
         self.memtable.clear()
+        self.immutable_map = {}
+        self._flush = None
         # Rebuild in-flight jobs against the RESTORED Table objects
         # (identity matters: finalize removes job tables from the level
         # lists by identity). Merge progress restarts from zero — the

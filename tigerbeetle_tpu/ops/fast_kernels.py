@@ -299,11 +299,22 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     pv = is_post | is_void
 
     # ---------------- lookups ----------------
-    dr_found, dr_row = ht_lookup(state["acct_ht"], ev["dr_hi"], ev["dr_lo"])
-    cr_found, cr_row = ht_lookup(state["acct_ht"], ev["cr_hi"], ev["cr_lo"])
-    e_found, e_row = ht_lookup(state["xfer_ht"], ev["id_hi"], ev["id_lo"])
+    # One batched probe per table (concatenated key sets): 3 lookups
+    # instead of 5 — bucket gathers dominate this stage's op count.
+    N_ev = ev["id_lo"].shape[0]
+    a_found, a_row = ht_lookup(
+        state["acct_ht"],
+        jnp.concatenate([ev["dr_hi"], ev["cr_hi"]]),
+        jnp.concatenate([ev["dr_lo"], ev["cr_lo"]]))
+    dr_found, cr_found = a_found[:N_ev], a_found[N_ev:]
+    dr_row, cr_row = a_row[:N_ev], a_row[N_ev:]
+    x_found, x_row = ht_lookup(
+        state["xfer_ht"],
+        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
+        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]))
+    e_found, p_found = x_found[:N_ev], x_found[N_ev:]
+    e_row, p_row = x_row[:N_ev], x_row[N_ev:]
     o_found, _ = ht_lookup(state["orphan_ht"], ev["id_hi"], ev["id_lo"])
-    p_found, p_row = ht_lookup(state["xfer_ht"], ev["pid_hi"], ev["pid_lo"])
 
     dr_rowc = jnp.where(dr_found, dr_row, A_dump)
     cr_rowc = jnp.where(cr_found, cr_row, A_dump)
