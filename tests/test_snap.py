@@ -1,0 +1,95 @@
+"""Snapshot-testing utility (reference: stdx.Snap, src/stdx/stdx.zig:16)
+and snapshot coverage of stable renderings."""
+
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from tigerbeetle_tpu.testing.snap import snap
+
+
+class TestSnapCore:
+    def test_match_passes(self):
+        snap("a\nb\n", expected="""\
+        a
+        b
+        """)
+
+    def test_mismatch_shows_diff(self):
+        with pytest.raises(AssertionError) as e:
+            snap("actual\n", expected="""\
+            expected
+            """)
+        assert "-expected" in str(e.value) and "+actual" in str(e.value)
+
+    def test_update_rewrites_source(self, tmp_path):
+        test_src = textwrap.dedent('''\
+            import sys
+            sys.path.insert(0, "/root/repo")
+            from tigerbeetle_tpu.testing.snap import snap
+
+            def check():
+                snap("new one\\nnew two\\n", expected="""\\
+                stale
+                """)
+
+            check()
+            print("ok")
+        ''')
+        path = tmp_path / "snapped.py"
+        path.write_text(test_src)
+        # First run with SNAP_UPDATE=1 rewrites the literal in place.
+        p = subprocess.run([sys.executable, str(path)], env={
+            "PATH": "/usr/bin:/bin", "SNAP_UPDATE": "1"},
+            capture_output=True, text=True)
+        assert p.returncode == 0, p.stderr
+        assert "new one" in path.read_text()
+        # Second run (no update) passes against the rewritten literal.
+        p = subprocess.run([sys.executable, str(path)], env={
+            "PATH": "/usr/bin:/bin"}, capture_output=True, text=True)
+        assert p.returncode == 0, p.stderr
+
+
+class TestSnapshots:
+    """Snapshot assertions over stable user-facing renderings."""
+
+    def test_account_repr_layout(self):
+        from tigerbeetle_tpu.types import Account, AccountFlags
+
+        a = Account(id=7, debits_posted=250, credits_posted=50,
+                    ledger=700, code=10,
+                    flags=int(AccountFlags.history))
+        got = "\n".join(
+            f"{f}={getattr(a, f)}" for f in (
+                "id", "debits_pending", "debits_posted", "credits_pending",
+                "credits_posted", "ledger", "code", "flags"))
+        snap(got + "\n", expected="""\
+        id=7
+        debits_pending=0
+        debits_posted=250
+        credits_pending=0
+        credits_posted=50
+        ledger=700
+        code=10
+        flags=8
+        """)
+
+    def test_operation_wire_codes(self):
+        from tigerbeetle_tpu.types import Operation
+
+        live = [op for op in Operation if not op.name.startswith("deprec")]
+        got = "\n".join(f"{int(op)} {op.name}" for op in live)
+        snap(got + "\n", expected="""\
+        128 pulse
+        137 get_change_events
+        140 lookup_accounts
+        141 lookup_transfers
+        142 get_account_transfers
+        143 get_account_balances
+        144 query_accounts
+        145 query_transfers
+        146 create_accounts
+        147 create_transfers
+        """)

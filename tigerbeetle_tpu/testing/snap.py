@@ -1,0 +1,83 @@
+"""Snapshot ("snaptest") assertions: inline expected values that update
+themselves.
+
+reference: src/stdx/stdx.zig:16 `Snap` (and src/testing/snaptest.zig) —
+a test writes `snap(__file__, '''...''')` with the expected rendering
+inline; on mismatch the failure shows a diff, and running with
+SNAP_UPDATE=1 rewrites the expectation in place in the test source. Keeps
+golden values next to the assertion instead of in sidecar files.
+
+Usage:
+
+    from tigerbeetle_tpu.testing.snap import snap
+
+    def test_render():
+        snap(got_text, expected='''\\
+        line one
+        line two
+        ''')
+
+The expected block is dedented before comparison. SNAP_UPDATE=1 rewrites
+the triple-quoted literal at the failing call site.
+"""
+
+from __future__ import annotations
+
+import difflib
+import inspect
+import os
+import re
+import textwrap
+
+_UPDATE = os.environ.get("SNAP_UPDATE") == "1"
+
+
+def snap(got: str, expected: str) -> None:
+    """Assert `got` equals the dedented `expected` block; with
+    SNAP_UPDATE=1, rewrite the call site's literal instead of failing."""
+    want = textwrap.dedent(expected)
+    if got == want:
+        return
+    if _UPDATE:
+        _rewrite_call_site(got)
+        return
+    diff = "\n".join(difflib.unified_diff(
+        want.splitlines(), got.splitlines(),
+        fromfile="expected", tofile="got", lineterm=""))
+    raise AssertionError(
+        f"snapshot mismatch (run with SNAP_UPDATE=1 to accept):\n{diff}")
+
+
+def _rewrite_call_site(got: str) -> None:
+    """Replace the triple-quoted expected literal of the calling `snap()`
+    with `got` (re-indented to the literal's original indentation)."""
+    frame = inspect.stack()[2]
+    path, lineno = frame.filename, frame.lineno
+    with open(path) as f:
+        src = f.read()
+    lines = src.splitlines(keepends=True)
+    # Find the snap( call at/after the reported line, then its literal.
+    start = sum(len(ln) for ln in lines[:lineno - 1])
+    m = re.compile(
+        r"snap\(", re.S).search(src, start)
+    assert m is not None, f"snap() call not found at {path}:{lineno}"
+    lit = re.compile(
+        r"(?P<q>'''|\"\"\")(?P<body>.*?)(?P=q)", re.S).search(src, m.end())
+    assert lit is not None, f"no triple-quoted literal after {path}:{lineno}"
+    indent = _literal_indent(lit.group("body"))
+    body = "\\\n" + textwrap.indent(got, indent)
+    if not body.endswith("\n"):
+        body += "\n" + indent
+    else:
+        body += indent
+    new_src = src[:lit.start()] + lit.group("q") + body + lit.group("q") \
+        + src[lit.end():]
+    with open(path, "w") as f:
+        f.write(new_src)
+
+
+def _literal_indent(body: str) -> str:
+    for line in body.splitlines():
+        if line.strip():
+            return line[:len(line) - len(line.lstrip())]
+    return "        "
