@@ -25,13 +25,24 @@ class TestSnapCore:
         assert "-expected" in str(e.value) and "+actual" in str(e.value)
 
     def test_update_rewrites_source(self, tmp_path):
+        import os
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
         test_src = textwrap.dedent('''\
-            import sys
-            sys.path.insert(0, "/root/repo")
             from tigerbeetle_tpu.testing.snap import snap
 
             def check():
-                snap("new one\\nnew two\\n", expected="""\\
+                # Two stale snaps, the first shrinking: the rewriter must
+                # track line deltas so the second still lands correctly.
+                snap("one\\n", expected="""\\
+                stale line a
+                stale line b
+                stale line c
+                """)
+                snap("x\\ny\\nz\\n", expected="""\\
+                stale
+                """)
+                snap("no trailing newline", expected="""\\
                 stale
                 """)
 
@@ -40,15 +51,19 @@ class TestSnapCore:
         ''')
         path = tmp_path / "snapped.py"
         path.write_text(test_src)
-        # First run with SNAP_UPDATE=1 rewrites the literal in place.
-        p = subprocess.run([sys.executable, str(path)], env={
-            "PATH": "/usr/bin:/bin", "SNAP_UPDATE": "1"},
-            capture_output=True, text=True)
+        env = {"PATH": "/usr/bin:/bin", "PYTHONPATH": repo}
+        # First run with SNAP_UPDATE=1 rewrites every literal in place.
+        p = subprocess.run([sys.executable, str(path)],
+                           env={**env, "SNAP_UPDATE": "1"},
+                           capture_output=True, text=True)
         assert p.returncode == 0, p.stderr
-        assert "new one" in path.read_text()
-        # Second run (no update) passes against the rewritten literal.
-        p = subprocess.run([sys.executable, str(path)], env={
-            "PATH": "/usr/bin:/bin"}, capture_output=True, text=True)
+        text = path.read_text()
+        assert "one" in text
+        assert "stale line" not in text and '"""\\\nstale' not in text
+        # Second run (no update) passes against the rewritten literals —
+        # including the no-trailing-newline value (convergence).
+        p = subprocess.run([sys.executable, str(path)], env=env,
+                           capture_output=True, text=True)
         assert p.returncode == 0, p.stderr
 
 

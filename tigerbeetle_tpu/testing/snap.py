@@ -31,6 +31,11 @@ import textwrap
 
 _UPDATE = os.environ.get("SNAP_UPDATE") == "1"
 
+# Earlier SNAP_UPDATE rewrites shift line numbers within a file; later
+# call frames still report COMPILE-TIME linenos, so track the deltas and
+# adjust (path -> [(original lineno, line delta)]).
+_REWRITE_DELTAS: dict[str, list[tuple[int, int]]] = {}
+
 
 def snap(got: str, expected: str) -> None:
     """Assert `got` equals the dedented `expected` block; with
@@ -49,35 +54,41 @@ def snap(got: str, expected: str) -> None:
 
 
 def _rewrite_call_site(got: str) -> None:
-    """Replace the triple-quoted expected literal of the calling `snap()`
-    with `got` (re-indented to the literal's original indentation)."""
+    """Replace the triple-quoted `expected=` literal of the calling
+    `snap()` with `got` (re-indented to the literal's indentation)."""
     frame = inspect.stack()[2]
     path, lineno = frame.filename, frame.lineno
+    lineno += sum(d for at, d in _REWRITE_DELTAS.get(path, ())
+                  if at < lineno)
     with open(path) as f:
         src = f.read()
     lines = src.splitlines(keepends=True)
-    # Find the snap( call at/after the reported line, then its literal.
     start = sum(len(ln) for ln in lines[:lineno - 1])
-    m = re.compile(
-        r"snap\(", re.S).search(src, start)
+    m = re.compile(r"(?<![\w.])snap\(").search(src, start)
     assert m is not None, f"snap() call not found at {path}:{lineno}"
+    # Anchor on the expected= keyword so a triple-quoted `got` argument
+    # can never be mistaken for the expectation.
+    kw = re.compile(r"expected\s*=").search(src, m.end())
+    lit_from = kw.end() if kw is not None else m.end()
     lit = re.compile(
-        r"(?P<q>'''|\"\"\")(?P<body>.*?)(?P=q)", re.S).search(src, m.end())
+        r"(?P<q>'''|\"\"\")(?P<body>.*?)(?P=q)", re.S).search(src, lit_from)
     assert lit is not None, f"no triple-quoted literal after {path}:{lineno}"
     indent = _literal_indent(lit.group("body"))
     body = "\\\n" + textwrap.indent(got, indent)
-    if not body.endswith("\n"):
-        body += "\n" + indent
-    else:
-        body += indent
+    if got.endswith("\n"):
+        body += indent  # align the closing quotes; dedent strips it
     new_src = src[:lit.start()] + lit.group("q") + body + lit.group("q") \
         + src[lit.end():]
+    delta = new_src.count("\n") - src.count("\n")
+    _REWRITE_DELTAS.setdefault(path, []).append((lineno, delta))
     with open(path, "w") as f:
         f.write(new_src)
 
 
 def _literal_indent(body: str) -> str:
     for line in body.splitlines():
-        if line.strip():
+        # Skip the leading line-continuation backslash ('''\) — it is
+        # part of the literal syntax, not indented content.
+        if line.strip() and line.strip() != "\\":
             return line[:len(line) - len(line.lstrip())]
     return "        "
