@@ -271,9 +271,13 @@ def inner_main() -> None:
         parity = parity_config5(n_batches=3 if quick else 6)
         emit("config5_oracle_parity", parity)
     acc6 = el6 = None
+    serving_latency = None
     if "6" in run:
-        acc6, el6 = bench_config6_serving(batches=4 if quick else 24)
+        acc6, el6, serving_latency = bench_config6_serving(
+            batches=4 if quick else 24)
         emit("config6_serving_tps", tps(acc6, el6))
+        if serving_latency:
+            emit("serving_batch_latency", serving_latency)
 
     value = None if acc2 is None else (acc2 / el2 if el2 > 0 else 0.0)
     out = {
@@ -288,12 +292,15 @@ def inner_main() -> None:
         "config4_twophase_limits_tps": tps(acc4, el4),
         "config5_oracle_parity": parity,
         "config6_serving_tps": tps(acc6, el6),
-        # Mean 8190-event batch latency at config2 rate. (The reference
-        # reports p100 — benchmark_load.zig:587; a true max needs
-        # per-batch syncs, which would serialize the on-device scan, so
-        # the mean is reported under an honest name instead.)
+        # Mean 8190-event batch latency at config2 rate. (True per-batch
+        # syncs would serialize the pipelined dispatch, so the mean is
+        # reported under an honest name; REAL percentiles come from the
+        # serving config below, whose commits are synchronous.)
         "batch_latency_mean_ms": (
             None if not acc2 else round(8190 / (acc2 / el2) * 1000, 3)),
+        # Per-batch serving-commit latency percentiles (reference reports
+        # p100 — benchmark_load.zig:587).
+        "serving_batch_latency": serving_latency,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.

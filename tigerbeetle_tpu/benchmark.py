@@ -286,14 +286,33 @@ def bench_config6_serving(batches=24, account_count=10_000):
     ts += nb + 10
     sm.commit(Operation.create_transfers, bodies[0], ts)  # warmup compile
     n_before = len(sm.state.transfers)
+    lat_ms = []
     t0 = time.perf_counter()
     for body in bodies[1:]:
         ts += nb + 10
+        tb = time.perf_counter()
         sm.commit(Operation.create_transfers, body, ts)
+        lat_ms.append((time.perf_counter() - tb) * 1000)
     elapsed = time.perf_counter() - t0
     assert sm.led.fallbacks == 0, "serving bench unexpectedly fell back"
     accepted = len(sm.state.transfers) - n_before
-    return accepted, elapsed
+    # Per-batch commit latency percentiles (each commit is synchronous on
+    # the serving path, so these are true percentiles — the reference
+    # reports p100, src/tigerbeetle/benchmark_load.zig:587).
+    lat_ms.sort()
+    latency = None
+    if lat_ms:
+        import math
+
+        def rank(q):  # nearest-rank percentile
+            return lat_ms[max(0, math.ceil(q * len(lat_ms)) - 1)]
+
+        latency = {
+            "p50_ms": round(rank(0.50), 3),
+            "p99_ms": round(rank(0.99), 3),
+            "p100_ms": round(lat_ms[-1], 3),
+        }
+    return accepted, elapsed, latency
 
 
 def parity_config5(n_batches=6, batch=256):

@@ -32,8 +32,9 @@ import textwrap
 _UPDATE = os.environ.get("SNAP_UPDATE") == "1"
 
 # Earlier SNAP_UPDATE rewrites shift line numbers within a file; later
-# call frames still report COMPILE-TIME linenos, so track the deltas and
-# adjust (path -> [(original lineno, line delta)]).
+# call frames still report COMPILE-TIME linenos, so track each rewrite's
+# COMPILE-TIME position and line delta (path -> [(compile lineno, delta)])
+# and shift a frame's lineno by the deltas of rewrites above it.
 _REWRITE_DELTAS: dict[str, list[tuple[int, int]]] = {}
 
 
