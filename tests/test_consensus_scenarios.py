@@ -389,7 +389,7 @@ class TestCheckpointRollback:
         # Feed A19: executing it exposes the divergence (its parent is
         # A18, not our executed B18) -> rollback to checkpoint 16.
         r.on_message(a_chain[2])
-        assert r._rollback_checkpoint == 16
+        assert r._rollback_checkpoint == (16, 2)
         assert r.commit_min == 16, "state must rewind to the checkpoint"
         assert {17, 18} <= r.chain_suspect
         # The canonical prepares zip in; everything re-executes.
@@ -422,7 +422,7 @@ class TestCheckpointRollback:
                     view=2, op=20, commit=20)
         r.on_message(Message(sv.finalize(body), body=body))
         r.on_message(a_chain[2])  # A19 exposes divergence -> rollback
-        assert r._rollback_checkpoint == 16 and r.commit_min == 16
+        assert r._rollback_checkpoint == (16, 2) and r.commit_min == 16
         # A17 arrives; it does NOT chain from our op 16 -> second
         # divergence at the same checkpoint -> sync floor, no loop.
         for m in a_chain:

@@ -39,6 +39,17 @@ def _transfers_body(specs):
     # partitions, restarts — regime transitions + write-through mirror
     # + NACK all under fire (round-2 soak in test form).
     (515, "device"), (626, "device"),
+    # Soak-found liveness seeds: a replica stranded on a deposed
+    # primary's multi-op suffix with no canonical anchor — recovers via
+    # stalled-repair start_view re-solicitation + checkpoint rollback.
+    (446681642, "oracle"), (866557783, "oracle"),
+    # Soak-found: same-log_view DVCs conflicting at an op (unrepaired
+    # reused-op leftovers) — resolved by the hash-chain walk-down merge.
+    (517731180, "oracle"),
+    # Soak-found: a rolled-back quarantine range re-executing its stale
+    # fork (shared ancestry defeats the parent tripwire) — suspects now
+    # execute only after replacement or forward-chain confirmation.
+    (834858532, "oracle"),
 ])
 def test_vopr_swarm(seed, engine):
     rng = random.Random(seed)

@@ -180,13 +180,25 @@ class Replica:
         # Ops below this are unverifiable from our journal (a start_view's
         # suffix began beyond them): execute only canonical entries there.
         self.sync_floor = 0
-        # Checkpoint-rollback recovery: at most one attempt per persisted
-        # checkpoint (a second divergence at the same checkpoint proves
-        # the checkpoint itself diverged — only state sync can help).
-        self._rollback_checkpoint = -1
+        # Checkpoint-rollback recovery: at most one attempt per
+        # (checkpoint, log_view) — re-divergence against the SAME
+        # canonical knowledge proves the checkpoint itself diverged (only
+        # state sync can help), while a later view's new canonical suffix
+        # legitimately warrants a fresh attempt.
+        self._rollback_checkpoint: tuple[int, int] | None = None
         # op -> monotonic time it entered rollback quarantine; lingering
         # entries escalate to the state-sync path.
         self._suspect_since: dict[int, int] = {}
+        # op -> re-request count; stalled repairs re-solicit the current
+        # view's start_view (canonical anchor) every 8th attempt
+        # (throttled to one solicitation per interval, replica-wide).
+        self._repair_attempts: dict[int, int] = {}
+        self._rsv_last = 0
+        # Ops the DVC merge could not resolve (same-log_view conflict
+        # with no chain pin): the view must NOT finalize over them — the
+        # view-change timer escalates to the next view instead, where a
+        # different electorate can resolve the fork.
+        self._dvc_ambiguous: set[int] = set()
         # Ops whose journaled prepare failed the forward-chain check (a
         # stale leftover under a committed op number): repair must fetch a
         # replacement even though a prepare is held.
@@ -625,6 +637,18 @@ class Replica:
                 # Unverifiable leftover below the electorate's checkpoint.
                 self.repair_requested.setdefault(op, 0)
                 return
+            if op in self.chain_suspect:
+                # Quarantined (e.g. the rollback range): a stale chain can
+                # share ancestry with the truth up to its fork, so parent
+                # linkage alone cannot clear it. A canonical match IS the
+                # confirmation (the mismatch case returned above);
+                # otherwise execution waits for a replacement or a
+                # forward-chain confirmation from a trusted op above
+                # (repair tick).
+                if want is None:
+                    self.repair_requested.setdefault(op, 0)
+                    return
+                self.chain_suspect.discard(op)
             if prev_checksum is None:
                 # 0 = base unknown (e.g. the op behind a synced checkpoint
                 # is not in our journal): the tripwire can't fire there.
@@ -683,7 +707,8 @@ class Replica:
         through to the sync path — a wrong prefix is never extended."""
         sb = self.superblock
         if (sb is None or sb.op_checkpoint >= first_divergent_op
-                or self._rollback_checkpoint == sb.op_checkpoint):
+                or self._rollback_checkpoint == (sb.op_checkpoint,
+                                                 self.log_view)):
             return False
         root = self.storage.read(
             "snapshot",
@@ -691,7 +716,7 @@ class Replica:
             sb.snapshot_size)
         if checksum(root, domain=b"ckptroot") != sb.snapshot_checksum:
             return False
-        self._rollback_checkpoint = sb.op_checkpoint
+        self._rollback_checkpoint = (sb.op_checkpoint, self.log_view)
         forest_root, sessions_blob = _split_root(root)
         # Fresh durable engine over the same storage: drops every
         # in-memory LSM/grid structure the divergent suffix built (the
@@ -805,6 +830,8 @@ class Replica:
         self.view = new_view
         self.pipeline.clear()
         self.nacks.clear()
+        self._dvc_ambiguous.clear()
+        self._repair_attempts.clear()
         self._persist_view()
         votes = self.svc_votes.setdefault(new_view, set())
         votes.add(self.replica_id)
@@ -862,18 +889,21 @@ class Replica:
             self.bus.send_to_replica(self.primary_index(v), msg)
 
     def _suffix_headers(self) -> list[Header]:
-        """The log suffix as HEADERS: journal-held where possible, else
-        the canonical header (a new primary knows the chosen log's headers
+        """The log suffix as HEADERS: canonical knowledge FIRST (the
+        view-change quorum's truth — our journal may still hold a deposed
+        primary's unrepaired prepare under a reused op number), else the
+        journal-held header (a new primary knows the chosen log's headers
         before it has repaired the bodies — backups must still learn them,
         or they silently drop the re-replicated old-view prepares)."""
         base = self.superblock.op_checkpoint if self.superblock else 0
         out = []
         for op in range(base + 1, self.op + 1):
+            if op in self.canonical:
+                out.append(self.canonical[op])
+                continue
             m = self.journal.read_prepare(op)
             if m is not None:
                 out.append(m.header)
-            elif op in self.canonical:
-                out.append(self.canonical[op])
         return out
 
     def on_do_view_change(self, msg: Message) -> None:
@@ -906,24 +936,51 @@ class Replica:
         if self.op > best.header.op:
             self.op = max(best.header.op, self.commit_min)
         # UNION-merge headers across every DVC of the winning log_view:
-        # two replicas in the same log_view hold identical prepares per op
-        # (one primary, one prepare per op), so a peer's copy can fill a
-        # hole in the chosen suffix — without this, a tie-broken DVC with
-        # a gap would drop the canonical header and the repair prepare
-        # would then be rejected as non-canonical (liveness).
-        merged: dict[int, Header] = {}
+        # the true log of one log_view is unique, so a peer's copy can
+        # fill a hole in the chosen suffix — without this, a tie-broken
+        # DVC with a gap would drop the canonical header and the repair
+        # prepare would then be rejected as non-canonical (liveness).
+        # Same-log_view DVCs CAN conflict at an op: a replica that joined
+        # the log_view via start_view may still journal a deposed
+        # primary's unrepaired prepare under a reused op number (soak
+        # seed 517731180). Resolve by hash-chain walk-down from the tip:
+        # the accepted header at op+1 pins op's checksum via its parent;
+        # an op with no pinned resolution becomes a HOLE (left out of the
+        # canonical set — repair/nack decide it later, and the commit
+        # path's chain tripwire guards execution regardless).
+        cands: dict[int, list[Header]] = {}
         for m in dvcs.values():
             if m.header.context != best.header.context:
                 continue
             for hh in _unpack_headers(m.body):
                 if hh.op > best.header.op:
                     continue
-                prev = merged.get(hh.op)
-                if prev is None:
-                    merged[hh.op] = hh
-                else:
-                    assert prev.checksum == hh.checksum,                         "same-log_view divergence (protocol invariant)"
-        best_headers = [merged[op] for op in sorted(merged)]
+                bucket = cands.setdefault(hh.op, [])
+                if all(c.checksum != hh.checksum for c in bucket):
+                    bucket.append(hh)
+        best_headers = []
+        expect = None  # checksum pinned by the accepted header above
+        prev_op = None
+        for op in sorted(cands, reverse=True):
+            if prev_op is not None and op != prev_op - 1:
+                expect = None  # gap: the chain pin does not carry across
+            prev_op = op
+            bucket = cands[op]
+            if expect is not None:
+                chosen = next(
+                    (c for c in bucket if c.checksum == expect), None)
+            elif len(bucket) == 1:
+                chosen = bucket[0]
+            else:
+                chosen = None  # ambiguous with no pin from above
+            if chosen is None:
+                if len(bucket) > 1:
+                    self._dvc_ambiguous.add(op)
+                expect = None
+                continue
+            best_headers.append(chosen)
+            expect = chosen.parent
+        best_headers.reverse()
         suffix_base = (min(hh.op for hh in best_headers) if best_headers
                        else best.header.op + 1)
         if suffix_base > self.commit_min + 1:
@@ -946,6 +1003,12 @@ class Replica:
     def _try_start_view(self) -> None:
         """Finalize a pending view once the primary's log is complete."""
         if self._pending_view != self.view or self.status != "view_change":
+            return
+        if self._dvc_ambiguous:
+            # Same-log_view fork with no local resolution: finalizing
+            # would let this primary's own journal copy masquerade as
+            # canonical truth. Stall; the view-change timer escalates to
+            # the next view, whose electorate can resolve it.
             return
         for op in range(max(self.commit_min, self.sync_floor - 1) + 1,
                         self.op + 1):
@@ -1443,11 +1506,53 @@ class Replica:
             want_hdr = self.canonical.get(op)
             want = None if want_hdr is None else want_hdr.checksum
             below_floor = want is None and op < self.sync_floor
+            # A chain suspicion is moot once the held prepare matches a
+            # canonical header (the view-change quorum's truth needs no
+            # chain proof) — without this, an already-correct suspect is
+            # re-requested forever and starves the repair budget.
+            if (op in self.chain_suspect and want is not None
+                    and held is not None and held.header.checksum == want):
+                self.chain_suspect.discard(op)
+            # Forward-chain confirmation: a suspect whose SUCCESSOR is
+            # trusted (canonical-matched or unsuspected) and whose
+            # successor's parent pins our checksum is the true prepare —
+            # this zips a quarantined rollback range down from the
+            # canonical suffix one op per pass.
+            if op in self.chain_suspect and held is not None:
+                nxt = self.journal.read_prepare(op + 1)
+                nxt_want = self.canonical.get(op + 1)
+                nxt_trusted = nxt is not None and (
+                    (nxt_want is not None
+                     and nxt.header.checksum == nxt_want.checksum)
+                    or (nxt_want is None
+                        and (op + 1) not in self.chain_suspect))
+                if nxt_trusted and nxt.header.parent == held.header.checksum:
+                    # ...but NOT when the committed predecessor contradicts
+                    # it: op+1 vouching for op while op-1 (executed)
+                    # refuses it is a FORK between our executed prefix and
+                    # the forward-chained suffix — without canonical truth
+                    # neither side is provably right, so the suspicion
+                    # persists and the resend/escalation path (stalled
+                    # repair -> request_start_view) resolves it.
+                    prev_ok = True
+                    if op == self.commit_min + 1:
+                        prev_c = self._prepare_checksum(self.commit_min)
+                        prev_ok = (not prev_c
+                                   or held.header.parent == prev_c)
+                    if prev_ok:
+                        self.chain_suspect.discard(op)
             satisfied = held is not None and (
                 want is None or held.header.checksum == want) and \
                 op not in self.chain_suspect and not below_floor
             if op <= self.commit_min or satisfied:
                 del self.repair_requested[op]
+                if op <= self.commit_min:
+                    # Attempts stay sticky for merely-"satisfied" ops: a
+                    # fork can ping-pong between forward confirmation and
+                    # the backward tripwire (each neighbor vouching
+                    # differently), and only an accumulating count ever
+                    # reaches the start_view escalation that resolves it.
+                    self._repair_attempts.pop(op, None)
                 self.chain_suspect.discard(op)
                 continue
             if now - last < self.options.repair_interval_ns:
@@ -1455,6 +1560,23 @@ class Replica:
             if not self.repair_budget.spend(now):
                 break  # rate limit: repair must not starve the normal path
             self.repair_requested[op] = now
+            attempts = self._repair_attempts.get(op, 0) + 1
+            self._repair_attempts[op] = attempts
+            if (attempts % 8 == 0 and self.status == "normal"
+                    and not self.is_primary and want is None
+                    and now - self._rsv_last
+                    >= 8 * self.options.repair_interval_ns):
+                # Repair is stalling without a canonical anchor: a stale
+                # multi-op suffix (a deposed primary's prepares under ops
+                # the cluster later committed differently) cannot be
+                # replaced one-by-one, because each replacement's
+                # hash-chain validation needs a true NEIGHBOR. Re-solicit
+                # the CURRENT view's start_view: its canonical suffix pins
+                # the checksums (canonical-match acceptance needs no
+                # chaining), or — if the suffix base is beyond us — routes
+                # to state sync via the sync-floor path.
+                self._rsv_last = now
+                self._request_start_view(self.view)
             # Below the sync floor a served prepare is untrustworthy —
             # solicit a state-sync offer instead (context=1).
             header = Header(
