@@ -53,6 +53,26 @@ def bal_col(field: str, limb: int) -> int:
     return BAL_IDX[field] + limb
 
 
+# Packed accounts store layout (reference data model: the 128-byte
+# Account, src/tigerbeetle.zig:10-43; balances live in the separate
+# (rows, 16) "bal" limb matrix — see BAL_FIELDS).
+AC_U64 = ("id_hi", "id_lo", "ud128_hi", "ud128_lo", "ud64", "ts")
+AC_U32 = ("ud32", "ledger", "code", "flags")
+AC_U64_IDX = {n: i for i, n in enumerate(AC_U64)}
+AC_U32_IDX = {n: i for i, n in enumerate(AC_U32)}
+
+
+def ac_named(rows: dict) -> dict:
+    """Packed account rows ({'u64','u32'[,'bal']} matrices) -> named
+    column dict (works on device arrays, numpy, or row-sliced views).
+    The balance limb matrix passes through under 'bal' when present."""
+    out = {n: rows["u64"][:, i] for n, i in AC_U64_IDX.items()}
+    out.update({n: rows["u32"][:, i] for n, i in AC_U32_IDX.items()})
+    if "bal" in rows:
+        out["bal"] = rows["bal"]
+    return out
+
+
 # Packed transfers store layout (reference data model: the 128-byte
 # Transfer, src/tigerbeetle.zig:85-116, plus device-side derived columns).
 XF_U64 = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",

@@ -48,6 +48,8 @@ import numpy as np
 from ..constants import NS_PER_S, U63_MAX
 from . import u128
 from .ev_layout import (
+    AC_U32_IDX,
+    AC_U64_IDX,
     BAL_IDX,
     EV_I32,
     EV_U32,
@@ -237,10 +239,10 @@ def _chain_pass(status, linked, valid, idxs, n, N):
 # ================================================== create_transfers (fast)
 
 def _acct_gather(acc, rows, found):
-    """Gather the account fields the kernel needs at `rows` (clamped).
-    Balances come from ONE row gather of the packed (rows, 16) limb
-    matrix instead of 16 column gathers."""
+    """Gather the account fields the kernel needs at `rows` (clamped):
+    three row gathers total (balance limbs + u64/u32 meta matrices)."""
     g = acc["bal"][rows]
+    g32 = acc["u32"][rows]
 
     def field(name):
         i = BAL_IDX[name]
@@ -252,10 +254,10 @@ def _acct_gather(acc, rows, found):
         dpos=field("dpos"),
         cp=field("cp"),
         cpos=field("cpos"),
-        ledger=acc["ledger"][rows],
-        code=acc["code"][rows],
-        flags=acc["flags"][rows],
-        ts=acc["ts"][rows],
+        ledger=g32[:, AC_U32_IDX["ledger"]],
+        code=g32[:, AC_U32_IDX["code"]],
+        flags=g32[:, AC_U32_IDX["flags"]],
+        ts=acc["u64"][rows, AC_U64_IDX["ts"]],
     )
 
 
@@ -287,7 +289,7 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
 
     acc = state["accounts"]
     xfr = state["transfers"]
-    A_dump = acc["id_hi"].shape[0] - 1
+    A_dump = acc["u64"].shape[0] - 1
     T_dump = xfr["u64"].shape[0] - 1
     # Note: statuses returned here are NOT valid-masked — the tail in
     # create_transfers_fast applies the valid mask after chain handling.
@@ -461,7 +463,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     acc = state["accounts"]
     xfr = state["transfers"]
     N = ev["id_lo"].shape[0]
-    A_dump = acc["id_hi"].shape[0] - 1
+    A_dump = acc["u64"].shape[0] - 1
     T_dump = xfr["u64"].shape[0] - 1
     idxs = jnp.arange(N, dtype=jnp.int32)
     valid = ev["valid"]
@@ -524,7 +526,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # fail the limit in any prefix, so parallel == sequential. Only a
     # potential breach falls back to the exact path.
     reg = valid & ~pv
-    A_rows = acc["id_hi"].shape[0]
+    A_rows = acc["u64"].shape[0]
     z64 = jnp.uint64(0)
     ral0, ral1, ral2, ral3 = _to_limbs(
         jnp.where(reg, amt_res_hi, z64), jnp.where(reg, amt_res_lo, z64))
@@ -553,7 +555,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         left_lo = f0 | (f1 << jnp.uint64(32))
         right_hi = balm[:, ag + 2] | (balm[:, ag + 3] << jnp.uint64(32))
         right_lo = balm[:, ag] | (balm[:, ag + 1] << jnp.uint64(32))
-        limited = _flag(acc["flags"], limit_bit)
+        limited = _flag(acc["u32"][:, AC_U32_IDX["flags"]], limit_bit)
         # The dump row (last) is scratch: failed creates scatter raw
         # flags there and masked transfers scatter-add amounts into its
         # balances — it must never latch a breach.
@@ -903,8 +905,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         p_row=jnp.where(ap_pv, p_rowc, jnp.int32(-1)),
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
-        dr_flags=acc["flags"][jnp.where(pv, p["dr_row"], dr_rowc)],
-        cr_flags=acc["flags"][jnp.where(pv, p["cr_row"], cr_rowc)],
+        dr_flags=acc["u32"][jnp.where(pv, p["dr_row"], dr_rowc),
+                            AC_U32_IDX["flags"]],
+        cr_flags=acc["u32"][jnp.where(pv, p["cr_row"], cr_rowc),
+                            AC_U32_IDX["flags"]],
     )
     for sside in ("dr", "cr"):
         for field in ("dp", "dpos", "cp", "cpos"):
@@ -1013,7 +1017,7 @@ def create_accounts_fast(state, ev, timestamp, n):
     from .hash_table import ht_lookup, ht_plan, ht_write
 
     acc = state["accounts"]
-    A_dump = acc["id_hi"].shape[0] - 1
+    A_dump = acc["u64"].shape[0] - 1
     N = ev["id_lo"].shape[0]
     idxs = jnp.arange(N, dtype=jnp.int32)
     valid = ev["valid"]
@@ -1032,19 +1036,26 @@ def create_accounts_fast(state, ev, timestamp, n):
     e2 = _dup_keys(ev["id_hi"], ev["id_lo"], tag)
     fallback_pre = e1 | e2
 
+    g64 = acc["u64"][e_rowc]
+    g32 = acc["u32"][e_rowc]
+    AU, AV = AC_U64_IDX, AC_U32_IDX
     exists_checks = [
-        ((flags & 0xFFFF) != (acc["flags"][e_rowc] & 0xFFFF),
+        ((flags & 0xFFFF) != (g32[:, AV["flags"]] & 0xFFFF),
          _AS["exists_with_different_flags"]),
         (~u128.eq(ev["ud128_hi"], ev["ud128_lo"],
-                  acc["ud128_hi"][e_rowc], acc["ud128_lo"][e_rowc]),
+                  g64[:, AU["ud128_hi"]], g64[:, AU["ud128_lo"]]),
          _AS["exists_with_different_user_data_128"]),
-        (ev["ud64"] != acc["ud64"][e_rowc], _AS["exists_with_different_user_data_64"]),
-        (ev["ud32"] != acc["ud32"][e_rowc], _AS["exists_with_different_user_data_32"]),
-        (ev["ledger"] != acc["ledger"][e_rowc], _AS["exists_with_different_ledger"]),
-        (ev["code"] != acc["code"][e_rowc], _AS["exists_with_different_code"]),
+        (ev["ud64"] != g64[:, AU["ud64"]],
+         _AS["exists_with_different_user_data_64"]),
+        (ev["ud32"] != g32[:, AV["ud32"]],
+         _AS["exists_with_different_user_data_32"]),
+        (ev["ledger"] != g32[:, AV["ledger"]],
+         _AS["exists_with_different_ledger"]),
+        (ev["code"] != g32[:, AV["code"]],
+         _AS["exists_with_different_code"]),
     ]
     exists_status = _first_failure(exists_checks, created=_AS["exists"])
-    exists_ts = acc["ts"][e_rowc]
+    exists_ts = g64[:, AU["ts"]]
 
     checks = [
         (ev["reserved"] != 0, _AS["reserved_field"]),
@@ -1103,15 +1114,25 @@ def create_accounts_fast(state, ev, timestamp, n):
     arow = jnp.where(ap, new_rows, A_dump)
 
     z64 = jnp.uint64(0)
+    # Packed row inserts: one scatter per matrix; masked lanes write
+    # uniform zero rows to the dump slot (scatter determinism).
+    u64_vals = {AU["id_hi"]: ev["id_hi"], AU["id_lo"]: ev["id_lo"],
+                AU["ud128_hi"]: ev["ud128_hi"],
+                AU["ud128_lo"]: ev["ud128_lo"],
+                AU["ud64"]: ev["ud64"], AU["ts"]: ts_event}
+    u32_vals = {AV["ud32"]: ev["ud32"], AV["ledger"]: ev["ledger"],
+                AV["code"]: ev["code"], AV["flags"]: flags}
+    apn = ap[:, None]
     new_acc = dict(acc)
-    for k, v in dict(
-        id_hi=ev["id_hi"], id_lo=ev["id_lo"],
-        ud128_hi=ev["ud128_hi"], ud128_lo=ev["ud128_lo"],
-        ud64=ev["ud64"], ud32=ev["ud32"],
-        ledger=ev["ledger"], code=ev["code"], flags=flags,
-        ts=ts_event,
-    ).items():
-        new_acc[k] = acc[k].at[arow].set(v)
+    new_acc["u64"] = acc["u64"].at[arow].set(jnp.where(
+        apn,
+        jnp.stack([u64_vals[i] for i in range(len(AC_U64_IDX))], axis=1),
+        z64))
+    new_acc["u32"] = acc["u32"].at[arow].set(jnp.where(
+        apn,
+        jnp.stack([u32_vals[i].astype(jnp.uint32)
+                   for i in range(len(AC_U32_IDX))], axis=1),
+        jnp.uint32(0)))
     new_acc["bal"] = acc["bal"].at[arow].set(
         jnp.zeros((N, 16), dtype=jnp.uint64))
     new_acc["count"] = acc["count"] + jnp.where(ok, n_created, 0)

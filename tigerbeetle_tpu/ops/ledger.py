@@ -52,8 +52,13 @@ N_PAD = 8192
 assert N_PAD >= BATCH_MAX
 
 from .ev_layout import (  # noqa: F401 — re-exported ring layout
+    AC_U32,
+    AC_U32_IDX,
+    AC_U64,
+    AC_U64_IDX,
     BAL_FIELDS,
     BAL_IDX,
+    ac_named,
     EV_I32,
     EV_I32_IDX,
     EV_U32,
@@ -155,17 +160,11 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         e_cap = t_cap  # one history row per created transfer (+ expiries)
 
     def rows_accounts():
+        # Packed per-dtype (see ev_layout.AC_*): row appends are three
+        # scatters; row gathers are three gathers (meta x2 + balances).
         return dict(
-            id_hi=jnp.zeros(a_cap + 1, jnp.uint64),
-            id_lo=jnp.zeros(a_cap + 1, jnp.uint64),
-            ud128_hi=jnp.zeros(a_cap + 1, jnp.uint64),
-            ud128_lo=jnp.zeros(a_cap + 1, jnp.uint64),
-            ud64=jnp.zeros(a_cap + 1, jnp.uint64),
-            ud32=jnp.zeros(a_cap + 1, jnp.uint32),
-            ledger=jnp.zeros(a_cap + 1, jnp.uint32),
-            code=jnp.zeros(a_cap + 1, jnp.uint32),
-            flags=jnp.zeros(a_cap + 1, jnp.uint32),
-            ts=jnp.zeros(a_cap + 1, jnp.uint64),
+            u64=jnp.zeros((a_cap + 1, len(AC_U64)), jnp.uint64),
+            u32=jnp.zeros((a_cap + 1, len(AC_U32)), jnp.uint32),
             # Packed balances: (rows, 16) u64 — see ev_layout.BAL_FIELDS.
             bal=jnp.zeros((a_cap + 1, 16), jnp.uint64),
             count=jnp.int32(0),
@@ -233,10 +232,12 @@ def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
     dr_row = ev_col(e, "dr_row")
     cr_row = ev_col(e, "cr_row")
     p_rows = jnp.maximum(ev_col(e, "p_row"), 0)
+    au = acc["u64"]
+    hi_c, lo_c = AC_U64_IDX["id_hi"], AC_U64_IDX["id_lo"]
     return dict(
         t=t, e=e,
-        dr_id_hi=acc["id_hi"][dr_row], dr_id_lo=acc["id_lo"][dr_row],
-        cr_id_hi=acc["id_hi"][cr_row], cr_id_lo=acc["id_lo"][cr_row],
+        dr_id_hi=au[dr_row, hi_c], dr_id_lo=au[dr_row, lo_c],
+        cr_id_hi=au[cr_row, hi_c], cr_id_lo=au[cr_row, lo_c],
         p_ts=xf_col(xfr, "ts")[p_rows],
     )
 
@@ -450,6 +451,8 @@ class DeviceLedger:
                     if k != "count"}
         if store_key == "transfers":
             gathered = xf_named(gathered)
+        elif store_key == "accounts":
+            gathered = ac_named(gathered)
         return np.asarray(found), gathered
 
     def lookup_accounts(self, ids: list[int]) -> list[Account]:
@@ -492,8 +495,10 @@ class DeviceLedger:
         self._acct_row: dict[int, int] = {}
         self._xfer_row: dict[int, int] = {}
         sm = StateMachineOracle()
-        acc = {k: np.asarray(v) for k, v in self.state["accounts"].items()}
-        n_a = int(acc["count"])
+        a_rows = {k: np.asarray(v)
+                  for k, v in self.state["accounts"].items()}
+        n_a = int(a_rows["count"])
+        acc = ac_named(a_rows)
         for r in range(n_a):
             a = Account(
                 id=u128.to_int(acc["id_hi"][r], acc["id_lo"][r]),
@@ -632,19 +637,22 @@ class DeviceLedger:
         assert len(accounts) <= self.a_cap and len(sm.transfers) <= self.t_cap
         acc = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["accounts"].items()}
+        AU, AV = AC_U64_IDX, AC_U32_IDX
         for r, a in enumerate(accounts):
-            acc["id_hi"][r], acc["id_lo"][r] = _split(a.id)
+            (acc["u64"][r, AU["id_hi"]],
+             acc["u64"][r, AU["id_lo"]]) = _split(a.id)
             for f, val in (("dp", a.debits_pending), ("dpos", a.debits_posted),
                            ("cp", a.credits_pending), ("cpos", a.credits_posted)):
                 for j, lim in enumerate(_limbs4(val)):
                     acc["bal"][r, bal_col(f, j)] = lim
-            acc["ud128_hi"][r], acc["ud128_lo"][r] = _split(a.user_data_128)
-            acc["ud64"][r] = a.user_data_64
-            acc["ud32"][r] = a.user_data_32
-            acc["ledger"][r] = a.ledger
-            acc["code"][r] = a.code
-            acc["flags"][r] = a.flags
-            acc["ts"][r] = a.timestamp
+            (acc["u64"][r, AU["ud128_hi"]],
+             acc["u64"][r, AU["ud128_lo"]]) = _split(a.user_data_128)
+            acc["u64"][r, AU["ud64"]] = a.user_data_64
+            acc["u64"][r, AU["ts"]] = a.timestamp
+            acc["u32"][r, AV["ud32"]] = a.user_data_32
+            acc["u32"][r, AV["ledger"]] = a.ledger
+            acc["u32"][r, AV["code"]] = a.code
+            acc["u32"][r, AV["flags"]] = a.flags
         acc["count"] = np.int32(len(accounts))
         st["accounts"] = {k: jnp.asarray(v) for k, v in acc.items()}
 
@@ -983,14 +991,15 @@ class DeviceLedger:
         import jax
 
         a0 = len(self._acct_row)
-        a_len = int(self.state["accounts"]["id_hi"].shape[0])
+        a_len = int(self.state["accounts"]["u64"].shape[0])
         size = min(256 if n_new <= 256 else N_PAD, a_len)
         assert n_new <= size
         a_start = max(0, min(a0, a_len - size))
-        a = jax.device_get(
+        a_rows = jax.device_get(
             _acct_delta_gather_jit(self.state, np.int32(a_start), size))
         off = a0 - a_start
-        a = {k: v[off:off + n_new].tolist() for k, v in a.items()}
+        a_rows = {k: v[off:off + n_new] for k, v in a_rows.items()}
+        a = {k: v.tolist() for k, v in ac_named(a_rows).items()}
         for k in range(n_new):
             aid = (a["id_hi"][k] << 64) | a["id_lo"][k]
             acct = Account(
@@ -1095,28 +1104,28 @@ class DeviceLedger:
             rows = pad(np.array([self._acct_row[a] for a in dirty_accounts],
                            dtype=np.int32), self.a_cap)
             objs = [sm.accounts[a] for a in dirty_accounts]
-            cols: dict[str, np.ndarray] = {}
-            bal = np.zeros((len(objs), 16), dtype=np.uint64)
-            for f, attr in (("dp", "debits_pending"), ("dpos", "debits_posted"),
-                            ("cp", "credits_pending"), ("cpos", "credits_posted")):
-                for i, o in enumerate(objs):
-                    v = getattr(o, attr)
+            n = len(objs)
+            bal = np.zeros((n, 16), dtype=np.uint64)
+            u64m = np.zeros((n, len(AC_U64)), dtype=np.uint64)
+            u32m = np.zeros((n, len(AC_U32)), dtype=np.uint32)
+            AU, AV = AC_U64_IDX, AC_U32_IDX
+            for i, o in enumerate(objs):
+                for f, val in (("dp", o.debits_pending),
+                               ("dpos", o.debits_posted),
+                               ("cp", o.credits_pending),
+                               ("cpos", o.credits_posted)):
                     for j in range(4):
-                        bal[i, bal_col(f, j)] = (v >> (32 * j)) & 0xFFFFFFFF
-            cols["bal"] = bal
-            cols["id_hi"] = np.array([o.id >> 64 for o in objs], dtype=np.uint64)
-            cols["id_lo"] = np.array([o.id & (1 << 64) - 1 for o in objs],
-                                     dtype=np.uint64)
-            cols["ud128_hi"] = np.array([o.user_data_128 >> 64 for o in objs],
-                                        dtype=np.uint64)
-            cols["ud128_lo"] = np.array(
-                [o.user_data_128 & (1 << 64) - 1 for o in objs], dtype=np.uint64)
-            cols["ud64"] = np.array([o.user_data_64 for o in objs], dtype=np.uint64)
-            cols["ud32"] = np.array([o.user_data_32 for o in objs], dtype=np.uint32)
-            cols["ledger"] = np.array([o.ledger for o in objs], dtype=np.uint32)
-            cols["code"] = np.array([o.code for o in objs], dtype=np.uint32)
-            cols["flags"] = np.array([o.flags for o in objs], dtype=np.uint32)
-            cols["ts"] = np.array([o.timestamp for o in objs], dtype=np.uint64)
+                        bal[i, bal_col(f, j)] = (val >> (32 * j)) & 0xFFFFFFFF
+                u64m[i, AU["id_hi"]], u64m[i, AU["id_lo"]] = _split(o.id)
+                (u64m[i, AU["ud128_hi"]],
+                 u64m[i, AU["ud128_lo"]]) = _split(o.user_data_128)
+                u64m[i, AU["ud64"]] = o.user_data_64
+                u64m[i, AU["ts"]] = o.timestamp
+                u32m[i, AV["ud32"]] = o.user_data_32
+                u32m[i, AV["ledger"]] = o.ledger
+                u32m[i, AV["code"]] = o.code
+                u32m[i, AV["flags"]] = o.flags
+            cols = {"bal": bal, "u64": u64m, "u32": u32m}
             count = jnp.int32(next_row)
             acc = st["accounts"] = scatter_cols(
                 {k: v for k, v in acc.items() if k != "count"},
