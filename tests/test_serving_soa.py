@@ -1,0 +1,169 @@
+"""The vectorized serving path (wire -> SoA -> kernel -> wire with
+deferred mirror materialization) must be bit-identical to the oracle
+engine run over the same wire bodies, and the lazily-drained mirror must
+be exact at every read boundary.
+
+Reference analog: src/state_machine.zig:2564-2669 (commit) and the VOPR
+state-machine differential (-Dvopr-state-machine).
+"""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.ops.batch import (
+    RESULT_WIRE,
+    TRANSFER_WIRE,
+    encode_create_results,
+    transfers_soa_from_bytes,
+)
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFilter,
+    AccountFilterFlags,
+    Operation,
+    Transfer,
+    TransferFlags,
+)
+
+
+def _mk_body(rng, base, nb, account_count, pend_frac=0.2):
+    dr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+    cr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+    clash = dr == cr
+    cr[clash] = dr[clash] % account_count + 1
+    amt = rng.integers(1, 10**6, nb)
+    flags = np.where(rng.random(nb) < pend_frac,
+                     np.uint32(int(TransferFlags.pending)), np.uint32(0))
+    payload = b"".join(
+        Transfer(id=int(base + i), debit_account_id=int(dr[i]),
+                 credit_account_id=int(cr[i]), amount=int(amt[i]),
+                 ledger=1, code=1, flags=int(flags[i]),
+                 timeout=3600 if flags[i] else 0).pack()
+        for i in range(nb))
+    return multi_batch.encode([payload], 128)
+
+
+def _setup(engine, account_count=200):
+    sm = StateMachine(engine=engine, a_cap=1 << 12, t_cap=1 << 14)
+    ts = 1000
+    accounts = [Account(id=i, ledger=1, code=1)
+                for i in range(1, account_count + 1)]
+    ts += len(accounts) + 10
+    sm.create_accounts(accounts, ts)
+    return sm, ts
+
+
+def test_wire_codec_roundtrip():
+    rng = np.random.default_rng(11)
+    xs = [Transfer(id=(1 << 100) + i, debit_account_id=int(rng.integers(1, 99)),
+                   credit_account_id=(1 << 77) + i,
+                   amount=(1 << 90) + int(rng.integers(0, 10**9)),
+                   pending_id=i % 3, user_data_128=(1 << 127) | i,
+                   user_data_64=2**63 + i, user_data_32=7 + i, timeout=i,
+                   ledger=3, code=55, flags=9, timestamp=10**15 + i)
+          for i in range(17)]
+    body = b"".join(t.pack() for t in xs)
+    ev = transfers_soa_from_bytes(body)
+    for i, t in enumerate(xs):
+        assert (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i]) == t.id
+        assert (int(ev["dr_hi"][i]) << 64) | int(ev["dr_lo"][i]) \
+            == t.debit_account_id
+        assert (int(ev["cr_hi"][i]) << 64) | int(ev["cr_lo"][i]) \
+            == t.credit_account_id
+        assert (int(ev["amt_hi"][i]) << 64) | int(ev["amt_lo"][i]) == t.amount
+        assert (int(ev["pid_hi"][i]) << 64) | int(ev["pid_lo"][i]) \
+            == t.pending_id
+        assert int(ev["ud64"][i]) == t.user_data_64
+        assert int(ev["ud32"][i]) == t.user_data_32
+        assert int(ev["timeout"][i]) == t.timeout
+        assert int(ev["ledger"][i]) == t.ledger
+        assert int(ev["code"][i]) == t.code
+        assert int(ev["flags"][i]) == t.flags
+        assert int(ev["ts"][i]) == t.timestamp
+    assert TRANSFER_WIRE.itemsize == 128 and RESULT_WIRE.itemsize == 16
+    st = np.arange(5, dtype=np.uint32)
+    ts = np.arange(5, dtype=np.uint64) * 7
+    enc = encode_create_results(st, ts)
+    rec = np.frombuffer(enc, dtype=RESULT_WIRE)
+    assert (rec["status"] == st).all() and (rec["ts"] == ts).all()
+
+
+def test_device_commit_matches_oracle_commit():
+    """Same wire bodies through both engines -> identical reply bytes and
+    identical post-drain object state."""
+    dev, ts_d = _setup("device")
+    ora, ts_o = _setup("oracle")
+    assert ts_d == ts_o
+    ts = ts_d
+    rng = np.random.default_rng(5)
+    nb = 500
+    next_id = 10**7
+    for b in range(4):
+        body = _mk_body(np.random.default_rng(100 + b), next_id, nb, 200)
+        next_id += nb
+        ts += nb + 10
+        r_dev = dev.commit(Operation.create_transfers, body, ts)
+        r_ora = ora.commit(Operation.create_transfers, body, ts)
+        assert r_dev == r_ora
+    # Mirror exactness at the read boundary (drains lazily).
+    assert dev.state.accounts == ora.state.accounts
+    assert dev.state.transfers == ora.state.transfers
+    assert dev.state.pending_status == ora.state.pending_status
+    assert dev.state.account_events == ora.state.account_events
+    assert dev.state.orphaned == ora.state.orphaned
+    assert dev.led.fallbacks == 0
+
+
+def test_queries_see_deferred_batches():
+    """A query immediately after a commit must observe that batch (the
+    drain gate on the state property)."""
+    sm, ts = _setup("device")
+    nb = 64
+    body = _mk_body(np.random.default_rng(1), 10**7, nb, 200, pend_frac=0.0)
+    ts += nb + 10
+    sm.commit(Operation.create_transfers, body, ts)
+    assert sm.led._mirror_chunks, "expected a deferred chunk"
+    f = AccountFilter(account_id=1, limit=100,
+                      flags=int(AccountFilterFlags.debits
+                                | AccountFilterFlags.credits))
+    got = sm.get_account_transfers(f)
+    want = [t for t in sm.state.transfers.values()
+            if 1 in (t.debit_account_id, t.credit_account_id)]
+    assert [t.id for t in got] == [t.id for t in want]
+    assert not sm.led._mirror_chunks
+
+
+def test_lookups_after_commit_drain():
+    sm, ts = _setup("device")
+    nb = 32
+    body = _mk_body(np.random.default_rng(2), 10**7, nb, 200, pend_frac=0.0)
+    ts += nb + 10
+    reply = sm.commit(Operation.create_transfers, body, ts)
+    rec = np.frombuffer(
+        multi_batch.decode(reply, 16)[0], dtype=RESULT_WIRE)
+    created_ts = [int(t) for t, s in zip(rec["ts"], rec["status"])
+                  if s == 0xFFFFFFFF]  # created = maxInt(u32)
+    xs = sm.lookup_transfers([10**7 + i for i in range(nb)])
+    assert sorted(t.timestamp for t in xs) == sorted(created_ts)
+
+
+def test_sparse_deprecated_encoding_matches():
+    dev, ts = _setup("device")
+    ora, _ = _setup("oracle")
+    nb = 100
+    rng = np.random.default_rng(3)
+    # Half the events reference a missing debit account -> failures.
+    payload = b"".join(
+        Transfer(id=10**7 + i,
+                 debit_account_id=int(rng.integers(1, 400)),
+                 credit_account_id=int(rng.integers(1, 201)),
+                 amount=1, ledger=1, code=1).pack()
+        for i in range(nb))
+    ts += nb + 10
+    op = Operation.deprecated_create_transfers_sparse
+    body = multi_batch.encode([payload], 128)
+    r_dev = dev.commit(op, body, ts)
+    r_ora = ora.commit(op, body, ts)
+    assert r_dev == r_ora

@@ -294,6 +294,14 @@ def bench_config6_serving(batches=24, account_count=10_000):
         sm.commit(Operation.create_transfers, body, ts)
         lat_ms.append((time.perf_counter() - tb) * 1000)
     elapsed = time.perf_counter() - t0
+    # The commit path defers mirror materialization (columnar chunks,
+    # drained lazily at read boundaries). Time the drain separately and
+    # report it — nothing hidden: config6 tps is the commit boundary,
+    # drain_ms is the deferred host-object cost a query/durability reader
+    # would pay once, amortized over the whole run.
+    td = time.perf_counter()
+    sm.led.drain_mirror()
+    drain_ms = (time.perf_counter() - td) * 1000
     assert sm.led.fallbacks == 0, "serving bench unexpectedly fell back"
     accepted = len(sm.state.transfers) - n_before
     # Per-batch commit latency percentiles (each commit is synchronous on
@@ -311,6 +319,9 @@ def bench_config6_serving(batches=24, account_count=10_000):
             "p50_ms": round(rank(0.50), 3),
             "p99_ms": round(rank(0.99), 3),
             "p100_ms": round(lat_ms[-1], 3),
+            "drain_ms_total": round(drain_ms, 1),
+            "sustained_tps": round(
+                accepted / (elapsed + drain_ms / 1000), 1),
         }
     return accepted, elapsed, latency
 

@@ -39,6 +39,67 @@ def _pad(arr: np.ndarray, n: int, fill=0):
     return out
 
 
+# ------------------------------------------------------- vectorized wire codec
+#
+# The serving path's body decode / result encode as single numpy frombuffer /
+# tobytes passes over structured views of the 128-byte wire records
+# (reference layout: src/tigerbeetle.zig:85-116 Transfer, :483-493
+# CreateTransfersResult) — no per-event Python objects on the commit path.
+
+TRANSFER_WIRE = np.dtype({
+    "names": [
+        "id_lo", "id_hi", "dr_lo", "dr_hi", "cr_lo", "cr_hi",
+        "amt_lo", "amt_hi", "pid_lo", "pid_hi", "ud128_lo", "ud128_hi",
+        "ud64", "ud32", "timeout", "ledger", "code", "flags", "ts",
+    ],
+    "formats": [
+        "<u8", "<u8", "<u8", "<u8", "<u8", "<u8",
+        "<u8", "<u8", "<u8", "<u8", "<u8", "<u8",
+        "<u8", "<u4", "<u4", "<u4", "<u2", "<u2", "<u8",
+    ],
+    "offsets": [
+        0, 8, 16, 24, 32, 40,
+        48, 56, 64, 72, 80, 88,
+        96, 104, 108, 112, 116, 118, 120,
+    ],
+    "itemsize": 128,
+})
+
+RESULT_WIRE = np.dtype({
+    "names": ["ts", "status", "reserved"],
+    "formats": ["<u8", "<u4", "<u4"],
+    "offsets": [0, 8, 12],
+    "itemsize": 16,
+})
+
+
+def transfers_soa_from_bytes(body: bytes) -> dict:
+    """128-byte wire records -> the kernel's SoA event dict, one
+    vectorized pass (the u16 wire fields widen to the kernel's u32)."""
+    rec = np.frombuffer(body, dtype=TRANSFER_WIRE)
+    return dict(
+        id_hi=rec["id_hi"].copy(), id_lo=rec["id_lo"].copy(),
+        dr_hi=rec["dr_hi"].copy(), dr_lo=rec["dr_lo"].copy(),
+        cr_hi=rec["cr_hi"].copy(), cr_lo=rec["cr_lo"].copy(),
+        amt_hi=rec["amt_hi"].copy(), amt_lo=rec["amt_lo"].copy(),
+        pid_hi=rec["pid_hi"].copy(), pid_lo=rec["pid_lo"].copy(),
+        ud128_hi=rec["ud128_hi"].copy(), ud128_lo=rec["ud128_lo"].copy(),
+        ud64=rec["ud64"].copy(), ud32=rec["ud32"].copy(),
+        timeout=rec["timeout"].copy(), ledger=rec["ledger"].copy(),
+        code=rec["code"].astype(np.uint32),
+        flags=rec["flags"].astype(np.uint32),
+        ts=rec["ts"].copy(),
+    )
+
+
+def encode_create_results(st: np.ndarray, ts: np.ndarray) -> bytes:
+    """(status codes u32, timestamps u64) -> dense 16-byte result records."""
+    out = np.zeros(len(st), dtype=RESULT_WIRE)
+    out["ts"] = ts
+    out["status"] = st
+    return out.tobytes()
+
+
 def transfers_to_arrays(transfers: list[Transfer]) -> dict:
     """Convert a list of Transfer objects to SoA numpy arrays (slow path;
     benchmarks generate arrays directly)."""

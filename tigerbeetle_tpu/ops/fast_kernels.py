@@ -565,6 +565,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     e3 = (_breach(_acct_load(dr_rowc), "dp", "dpos", "cpos", _A_DR_LIMIT)
           | _breach(_acct_load(cr_rowc), "cp", "cpos", "dpos", _A_CR_LIMIT))
+    # The headroom-proof outcome, preserved across the fixpoint override
+    # below: the adaptive router drops back to the proof-gated kernel only
+    # once the PROOF would pass (dropping back on "no actual breach" would
+    # oscillate on workloads that sit near their limits without crossing).
+    proof_breach = e3
 
     a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
     a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
@@ -983,6 +988,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                        jnp.zeros_like(ts_actual)),
         fallback=fallback,
         limit_only=limit_only,
+        # Would the headroom proof have failed this batch? The adaptive
+        # router drops back to the cheaper proof-gated kernel only once
+        # the proof itself would pass again.
+        limit_hit=proof_breach,
         created_count=jnp.where(ok, n_created, 0),
     )
     return new_state, out

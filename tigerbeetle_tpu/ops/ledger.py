@@ -45,11 +45,24 @@ _TRANSIENT_CODES = frozenset(
 # events/batch: precomputed code->member maps instead.
 _CTS_BY_CODE = {int(m): m for m in CreateTransferStatus}
 _CAS_BY_CODE = {int(m): m for m in CreateAccountStatus}
+_TRANSIENT_ARR = np.fromiter(_TRANSIENT_CODES, dtype=np.uint32)
 from . import u128
 from .hash_table import ht_init
 
 N_PAD = 8192
 assert N_PAD >= BATCH_MAX
+
+# Padded-shape buckets for the transfer kernels: a batch compiles and runs
+# at the smallest bucket that fits instead of always paying BATCH_MAX-row
+# kernel work (jit keeps one cached executable per bucket actually used).
+PAD_BUCKETS = (1024, 2048, 4096, N_PAD)
+
+
+def _pad_bucket(n: int) -> int:
+    for b in PAD_BUCKETS:
+        if n <= b:
+            return b
+    raise AssertionError(f"batch of {n} exceeds BATCH_MAX padding")
 
 from .ev_layout import (  # noqa: F401 — re-exported ring layout
     AC_U32,
@@ -318,6 +331,18 @@ class DeviceLedger:
         self.fallbacks = 0
         self.fast_batches = 0
         self.fixpoint_batches = 0
+        # Adaptive kernel routing: after a batch resolves breaches via the
+        # limit fixpoint, later batches dispatch the fixpoint kernel first
+        # (skipping the headroom-proof attempt that would fail anyway)
+        # until a breach-free batch cools the workload back down.
+        self._fixpoint_first = False
+        # Deferred write-through: fast batches queue their device deltas
+        # as columnar chunks; drain_mirror materializes them into the host
+        # mirror's object stores at the next mirror read.
+        self._mirror_chunks: list = []
+        # Device transfer-row count INCLUDING queued chunks (len(_xfer_row)
+        # lags it until the next drain).
+        self._xfer_rows_dev = 0
         # Host-mirror fallback regime (see _fallback_transfers): a live
         # oracle mirror of the device state, reused across consecutive
         # hard batches so each one costs an oracle apply + a dirty-delta
@@ -353,6 +378,7 @@ class DeviceLedger:
 
         if self._mirror_route():
             self.fallbacks += 1
+            self.drain_mirror()
             results = self.mirror.create_accounts(accounts, timestamp)
             self._push_dirty()
             return results
@@ -386,8 +412,25 @@ class DeviceLedger:
         ev = transfers_to_arrays(transfers)
         return self.create_transfers_arrays(ev, timestamp, transfers=transfers)
 
-    def create_transfers_arrays(self, ev: dict, timestamp: int, transfers=None):
+    def create_transfers_soa(self, ev: dict, timestamp: int):
+        """The zero-object serving entry: SoA events in, (status u32,
+        timestamp u64) arrays out — no per-event Python on the happy path
+        (reference: commit is the cheap part, src/state_machine.zig:2564)."""
+        out = self.create_transfers_arrays(ev, timestamp, raw=True)
+        if isinstance(out, tuple):
+            return out
+        # Host-mirror path produced result objects (rare): flatten.
+        st = np.fromiter((int(r.status) for r in out), dtype=np.uint32,
+                         count=len(out))
+        ts = np.fromiter((r.timestamp for r in out), dtype=np.uint64,
+                         count=len(out))
+        return st, ts
+
+    def create_transfers_arrays(self, ev: dict, timestamp: int,
+                                transfers=None, raw=False):
         """ev: unpadded SoA dict (the zero-host-cost entry point)."""
+        import jax
+
         from .fast_kernels import (
             create_transfers_fast_jit,
             create_transfers_fixpoint_jit,
@@ -397,24 +440,46 @@ class DeviceLedger:
             self.fallbacks += 1
             if transfers is None:
                 transfers = _transfers_from_arrays(ev)
+            self.drain_mirror()
             results = self.mirror.create_transfers(transfers, timestamp)
             self._push_dirty()
             return results
         n = len(ev["id_lo"])
-        evp = pad_transfer_events(ev)
-        new_state, out = create_transfers_fast_jit(
-            self.state, evp, np.uint64(timestamp), np.int32(n))
-        self.state = new_state
-        if bool(out["fallback"]) and bool(out["limit_only"]):
-            # The only obstacle was the balance-limit headroom proof:
-            # order-dependent limits resolve natively on the fixpoint
-            # variant (only the state was donated — evp is intact).
+        # Small batches compile + run at the smallest padded shape that
+        # fits (jit caches one executable per bucket): a 1k-event batch
+        # costs 1k-row kernel work, not BATCH_MAX-row work.
+        evp = pad_transfer_events(ev, n_pad=_pad_bucket(n))
+        if self._fixpoint_first:
+            # The workload has been breaching balance limits: skip the
+            # doomed headroom-proof dispatch and go straight to the
+            # fixpoint kernel; drop back once a batch reports no breach.
             new_state, out = create_transfers_fixpoint_jit(
                 self.state, evp, np.uint64(timestamp), np.int32(n))
             self.state = new_state
-            if not bool(out["fallback"]):
+            fallback, limit_hit = (bool(x) for x in jax.device_get(
+                (out["fallback"], out["limit_hit"])))
+            if not fallback:
                 self.fixpoint_batches += 1
-        if bool(out["fallback"]):
+                if not limit_hit:
+                    self._fixpoint_first = False
+        else:
+            new_state, out = create_transfers_fast_jit(
+                self.state, evp, np.uint64(timestamp), np.int32(n))
+            self.state = new_state
+            fallback, limit_only = (bool(x) for x in jax.device_get(
+                (out["fallback"], out["limit_only"])))
+            if fallback and limit_only:
+                # The only obstacle was the balance-limit headroom proof:
+                # order-dependent limits resolve natively on the fixpoint
+                # variant (only the state was donated — evp is intact).
+                new_state, out = create_transfers_fixpoint_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                fallback = bool(out["fallback"])
+                if not fallback:
+                    self.fixpoint_batches += 1
+                    self._fixpoint_first = True
+        if fallback:
             if transfers is None:
                 transfers = _transfers_from_arrays(ev)
             return self._fallback_transfers(transfers, timestamp)
@@ -423,7 +488,9 @@ class DeviceLedger:
         st = np.asarray(out["r_status"][:n])
         ts = np.asarray(out["r_ts"][:n])
         if self._wt:
-            self._apply_fast_delta_transfers(ev, st)
+            self._capture_fast_delta_transfers(ev, st)
+        if raw:
+            return st, ts
         ts_l = ts.tolist()
         st_l = st.tolist()
         return [
@@ -492,6 +559,8 @@ class DeviceLedger:
         incremental deltas back without a full rebuild."""
         from ..oracle.state_machine import StateMachineOracle
 
+        if self._wt:
+            self.drain_mirror()
         self._acct_row: dict[int, int] = {}
         self._xfer_row: dict[int, int] = {}
         sm = StateMachineOracle()
@@ -554,6 +623,7 @@ class DeviceLedger:
             sm.account_events = self._events_to_host(acc, xfr)
             self._events_pushed = len(sm.account_events)
             self._events_seen_abs = sm.events_base + len(sm.account_events)
+        self._xfer_rows_dev = len(self._xfer_row)
         return sm
 
     def _events_to_host(self, acc, xfr) -> list:
@@ -616,9 +686,16 @@ class DeviceLedger:
 
         from .hash_table import ht_insert
 
+        # Queued fast-batch deltas drain into the old mirror first: when
+        # `sm` IS that mirror they are preserved; when `sm` replaces it
+        # wholesale they are then discarded with it.
+        if self.mirror is not None:
+            self.drain_mirror()
+        self._mirror_chunks = []
         self.state = init_state(self.a_cap, self.t_cap)
         self._acct_row = {a: r for r, a in enumerate(sm.accounts)}
         self._xfer_row = {t: r for r, t in enumerate(sm.transfers)}
+        self._xfer_rows_dev = len(self._xfer_row)
         st = self.state
 
         def batch_insert(table, keys_vals):
@@ -833,7 +910,7 @@ class DeviceLedger:
         slice sizes (256 / N_PAD) keep the compile count at two."""
         import jax
 
-        t0 = len(self._xfer_row)
+        t0 = self._xfer_rows_dev
         e0 = self._events_pushed
         t_len = int(self.state["transfers"]["u64"].shape[0])
         e_len = ev_cap(self.state["events"]) + 1
@@ -856,10 +933,53 @@ class DeviceLedger:
                          "p_ts")}
         return t, e, der, t0
 
-    def _apply_fast_delta_transfers(self, ev: dict, st_np) -> None:
-        """Write-through: apply one fast transfer batch's effects to the
-        host mirror from bounded device slices. Mirrors the oracle's
-        success-path application exactly (oracle/state_machine.py
+    def _capture_fast_delta_transfers(self, ev: dict, st_np) -> None:
+        """Write-through, deferred: fetch the batch's bounded device delta
+        and queue it as a columnar chunk. Materialization into the host
+        mirror's object stores happens lazily at the next mirror READ
+        (drain_mirror) — the serving commit path itself stays object-free
+        (the same lazy discipline as StateMachine._refresh_indexes;
+        reference: commit is the cheap part, src/state_machine.zig:2564)."""
+        created_code = np.uint32(int(CreateTransferStatus.created))
+        orph_mask = np.isin(st_np, _TRANSIENT_ARR)
+        orphan_ids = ([
+            (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i])
+            for i in np.nonzero(orph_mask)[0]
+        ] if orph_mask.any() else [])
+        n_new = int((st_np == created_code).sum())
+        if n_new == 0:
+            if orphan_ids:
+                self._mirror_chunks.append((None, None, None, 0, 0,
+                                            orphan_ids))
+            self._clear_dirty_dev()
+            return
+        t, e, der, t0 = self._xfer_delta_fetch(n_new)
+        self._mirror_chunks.append((t, e, der, t0, n_new, orphan_ids))
+        self._xfer_rows_dev += n_new
+        self._events_pushed += n_new
+        self._events_seen_abs += n_new
+        self._clear_dirty_dev()
+        self._maybe_recycle_ring()
+
+    def drain_mirror(self) -> None:
+        """Materialize every queued fast-batch delta into the host mirror.
+        Called before ANY mirror read (queries, lookups via the state
+        machine, durability flush, hard-batch fallback, to_host); no-op
+        when nothing is queued, so it is safe to call liberally."""
+        if not self._mirror_chunks:
+            return
+        chunks, self._mirror_chunks = self._mirror_chunks, []
+        for t, e, der, t0, n_new, orphan_ids in chunks:
+            for oid in orphan_ids:
+                self.mirror.orphaned.add(oid)
+            if n_new:
+                self._materialize_delta_transfers(t, e, der, t0, n_new)
+        self._clear_dirty_dev()
+
+    def _materialize_delta_transfers(self, t, e, der, t0,
+                                     n_new: int) -> None:
+        """Apply one captured chunk to the host mirror. Mirrors the
+        oracle's success-path application exactly (oracle/state_machine.py
         _create_transfer :417 and _post_or_void_pending_transfer :639,
         including the _put_account conditions), so mirror state stays
         value-identical to an oracle run, batch for batch."""
@@ -868,17 +988,6 @@ class DeviceLedger:
         from ..oracle.state_machine import AccountEventRecord
 
         sm = self.mirror
-        created_code = int(CreateTransferStatus.created)
-        for i in range(len(st_np)):
-            code = int(st_np[i])
-            if code != created_code and code in _TRANSIENT_CODES:
-                sm.orphaned.add(
-                    (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i]))
-        n_new = int((st_np == np.uint32(created_code)).sum())
-        if n_new == 0:
-            self._clear_dirty_dev()
-            return
-        t, e, der, t0 = self._xfer_delta_fetch(n_new)
         closed = int(AccountFlags.closed)
         P = TransferPendingStatus
 
@@ -975,14 +1084,12 @@ class DeviceLedger:
                 transfer_pending=p_obj,
                 amount_requested=areq, amount=amount))
             sm.commit_timestamp = ts
-        self._events_pushed += n_new
-        self._events_seen_abs += n_new
-        self._clear_dirty_dev()
-        self._maybe_recycle_ring()
 
     def _apply_fast_delta_accounts(self, st_np) -> None:
         """Write-through: apply one fast account batch to the host mirror
-        (oracle _create_account :326 success path)."""
+        (oracle _create_account :326 success path). Queued transfer chunks
+        drain first so mirror commit_timestamp stays monotonic."""
+        self.drain_mirror()
         sm = self.mirror
         created_code = int(CreateAccountStatus.created)
         n_new = int((st_np == np.uint32(created_code)).sum())
@@ -1028,6 +1135,7 @@ class DeviceLedger:
 
     def _fallback_transfers(self, transfers, timestamp):
         self.fallbacks += 1
+        self.drain_mirror()
         if self._probe_pending:
             self._probe_pending = False
             self._mirror_batches = 1  # probe failed: regime continues
@@ -1044,6 +1152,7 @@ class DeviceLedger:
 
     def _fallback_accounts(self, accounts, timestamp):
         self.fallbacks += 1
+        self.drain_mirror()
         if self._probe_pending:
             self._probe_pending = False
             self._mirror_batches = 1  # probe failed: regime continues
@@ -1249,6 +1358,9 @@ class DeviceLedger:
         st["xfer_key_max"] = np.uint64(sm.transfers_key_max or 0)
         st["pulse_next"] = np.uint64(sm.pulse_next_timestamp)
         st["commit_ts"] = np.uint64(sm.commit_timestamp)
+        # Chunks are always drained before a push, so the row map is the
+        # authoritative device row count again.
+        self._xfer_rows_dev = len(self._xfer_row)
 
     # ------------------------------------------------------------- pulse
 
@@ -1258,6 +1370,7 @@ class DeviceLedger:
     def expire_pending_transfers(self, timestamp: int) -> int:
         """Expiry runs on the exact host path (rare, pulse-driven),
         through the mirror regime like any other hard batch."""
+        self.drain_mirror()
         sm = self.mirror if self.mirror is not None else self._enter_mirror()
         n = sm.expire_pending_transfers(timestamp)
         self._push_dirty()

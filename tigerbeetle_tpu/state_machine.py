@@ -39,6 +39,7 @@ from .types import (
     ChangeEventsFilter,
     CreateAccountResult,
     CreateTransferResult,
+    CreateTransferStatus,
     Operation,
     QueryFilter,
     QueryFilterFlags,
@@ -219,6 +220,11 @@ class StateMachine:
 
     @property
     def state(self) -> StateMachineOracle:
+        # The device engine defers write-through materialization (columnar
+        # chunks); every object-level read goes through this property, so
+        # draining here keeps the mirror exact at every read boundary.
+        if self.led is not None:
+            self.led.drain_mirror()
         return self._state
 
     @state.setter
@@ -518,6 +524,11 @@ class StateMachine:
 
     def pulse_needed(self, timestamp: int) -> bool:
         """reference: src/state_machine.zig:1138-1144"""
+        if self.led is not None:
+            # Answered from the device pulse_next scalar: the primary asks
+            # this once per prepare, and a drain-on-read here would negate
+            # the deferred mirror materialization on the serving path.
+            return self.led.pulse_needed(timestamp)
         return self.state.pulse_needed(timestamp)
 
     # ------------------------------------------------------------- wire
@@ -601,10 +612,19 @@ class StateMachine:
 
     def _commit_one(self, op: Operation, spec: OperationSpec, body: bytes,
                     timestamp: int) -> bytes:
-        events = [body[i:i + spec.event_size]
-                  for i in range(0, len(body), spec.event_size)]
         O = Operation
         base = _base_operation(op)
+        if base == O.create_transfers and self.engine == "device":
+            # Vectorized serving path: wire -> SoA -> kernel -> wire with
+            # no per-event Python objects (reference: commit is the cheap
+            # part, src/state_machine.zig:2564-2669).
+            from .ops.batch import transfers_soa_from_bytes
+
+            ev = transfers_soa_from_bytes(body)
+            st, ts = self.led.create_transfers_soa(ev, timestamp)
+            return _encode_results_soa(st, ts, spec)
+        events = [body[i:i + spec.event_size]
+                  for i in range(0, len(body), spec.event_size)]
         if base == O.create_accounts:
             accounts = [Account.unpack(e) for e in events]
             results = self.create_accounts(accounts, timestamp)
@@ -658,6 +678,24 @@ def _base_operation(op: Operation) -> Operation:
         O.deprecated_query_accounts_unbatched: O.query_accounts,
         O.deprecated_query_transfers_unbatched: O.query_transfers,
     }.get(op, op)
+
+
+def _encode_results_soa(st, ts, spec: OperationSpec) -> bytes:
+    """Vectorized result encode from (status, timestamp) arrays."""
+    import numpy as np
+
+    from .ops.batch import encode_create_results
+
+    if not spec.sparse_results:
+        return encode_create_results(st, ts)
+    # Deprecated sparse encoding: {index, result} u32 pairs, non-created only.
+    created = np.uint32(int(CreateTransferStatus.created))
+    idx = np.nonzero(st != created)[0]
+    out = np.empty(len(idx), dtype=np.dtype(
+        {"names": ["index", "result"], "formats": ["<u4", "<u4"]}))
+    out["index"] = idx
+    out["result"] = st[idx]
+    return out.tobytes()
 
 
 def _encode_create_results(results, spec: OperationSpec) -> bytes:
