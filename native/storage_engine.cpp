@@ -205,8 +205,14 @@ extern "C" {
 struct tbio_op {
   uint64_t id;
   int is_write;
+  int tracked;  // write whose completion the caller reaps via tbio_fetch
   uint64_t off;
   std::vector<uint8_t> buf;  // write payload, or read destination
+  // Optional second ordered write (the WAL prepare->header pair: the
+  // redundant header must hit the disk strictly AFTER its prepare body,
+  // or torn-write recovery misclassifies the slot).
+  uint64_t off2;
+  std::vector<uint8_t> buf2;
   int64_t result;
 };
 
@@ -239,19 +245,33 @@ void *tbio_worker(void *arg) {
     tbio_op *op = e->submitted.front();
     e->submitted.pop_front();
     pthread_mutex_unlock(&e->mu);
-    if (op->is_write)
-      op->result = tbs_write(e->fd, op->off, op->buf.data(), op->buf.size());
-    else
-      op->result = tbs_read(e->fd, op->off, op->buf.data(), op->buf.size());
-    pthread_mutex_lock(&e->mu);
     if (op->is_write) {
-      // Writes auto-reap at completion: the payload is freed immediately
-      // (no RAM held across a checkpoint interval) and a failure latches
-      // the STICKY flag so every later drain/sync reports it — a lost
-      // LSM block write can never be silently consumed.
+      op->result = tbs_write(e->fd, op->off, op->buf.data(), op->buf.size());
+      if (op->result >= 0 && !op->buf2.empty()) {
+        int64_t r2 =
+            tbs_write(e->fd, op->off2, op->buf2.data(), op->buf2.size());
+        op->result = r2 < 0 ? r2 : op->result + r2;
+      }
+    } else {
+      op->result = tbs_read(e->fd, op->off, op->buf.data(), op->buf.size());
+    }
+    pthread_mutex_lock(&e->mu);
+    if (op->is_write && !op->tracked) {
+      // Untracked writes auto-reap at completion: the payload is freed
+      // immediately (no RAM held across a checkpoint interval) and a
+      // failure latches the STICKY flag so every later drain/sync reports
+      // it — a lost LSM block write can never be silently consumed.
       if (op->result < 0) e->failed = true;
       delete op;
     } else {
+      if (op->is_write && op->result < 0) e->failed = true;
+      if (op->is_write) {
+        // Payloads are dead weight once written; completions only carry
+        // the result code. swap() actually releases the heap allocation
+        // (clear() keeps capacity — up to message_size_max per op).
+        std::vector<uint8_t>().swap(op->buf);
+        std::vector<uint8_t>().swap(op->buf2);
+      }
       e->completed[op->id] = op;
     }
     e->inflight--;
@@ -294,11 +314,38 @@ long tbio_submit_write(tbio *e, uint64_t off, const uint8_t *data,
                        uint64_t len) {
   tbio_op *op = new tbio_op();
   op->is_write = 1;
+  op->tracked = 0;
   op->off = off;
   op->buf.assign(data, data + len);
   pthread_mutex_lock(&e->mu);
   op->id = e->next_id++;
   e->inflight++;
+  e->submitted.push_back(op);
+  pthread_cond_signal(&e->cv_submit);
+  long id = static_cast<long>(op->id);
+  pthread_mutex_unlock(&e->mu);
+  return id;
+}
+
+// Tracked ordered write pair: data1@off1 then (strictly after) data2@off2,
+// completion reported through tbio_poll/tbio_fetch like a read. This is
+// the async WAL append (prepare body, then redundant header — reference:
+// the journal's write_prepare -> write_header ordering,
+// src/vsr/journal.zig).
+long tbio_submit_write_pair(tbio *e, uint64_t off1, const uint8_t *data1,
+                            uint64_t len1, uint64_t off2,
+                            const uint8_t *data2, uint64_t len2) {
+  tbio_op *op = new tbio_op();
+  op->is_write = 1;
+  op->tracked = 1;
+  op->off = off1;
+  op->buf.assign(data1, data1 + len1);
+  op->off2 = off2;
+  op->buf2.assign(data2, data2 + len2);
+  pthread_mutex_lock(&e->mu);
+  op->id = e->next_id++;
+  e->inflight++;
+  e->live[op->id] = 1;
   e->submitted.push_back(op);
   pthread_cond_signal(&e->cv_submit);
   long id = static_cast<long>(op->id);
@@ -335,10 +382,11 @@ long tbio_poll(tbio *e, uint64_t *ids, long max) {
   return n;
 }
 
-// Blocking fetch of one READ completion: waits for `id`, copies read
-// data into buf (len bytes max), frees the entry. Returns the op's io
-// result (bytes transferred) or -2 if the id is unknown, already
-// fetched, or was a write (writes auto-reap; never wait on them).
+// Blocking fetch of one READ or TRACKED-WRITE completion: waits for
+// `id`, copies read data into buf (len bytes max; writes carry no data),
+// frees the entry. Returns the op's io result (bytes transferred) or -2
+// if the id is unknown, already fetched, or was an untracked write
+// (those auto-reap; never wait on them).
 long tbio_fetch(tbio *e, uint64_t id, uint8_t *buf, uint64_t len) {
   pthread_mutex_lock(&e->mu);
   std::map<uint64_t, tbio_op *>::iterator it;

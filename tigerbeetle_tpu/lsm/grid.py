@@ -128,6 +128,38 @@ class Grid:
         self.cache.put(key, data)
         return data
 
+    def read_blocks(self, reqs: list) -> list:
+        """Batched point reads: all cache misses are issued as ONE
+        concurrent fan-out to the device (reference: the prefetch
+        fan-out, src/lsm/groove.zig:996,1339). reqs: [(address, size)];
+        returns the block bytes in request order."""
+        out: list = [None] * len(reqs)
+        # Requesters per unique missing block (a clustered key batch maps
+        # many keys to ONE value block — read it once, not per key).
+        misses: dict = {}
+        for i, (address, size) in enumerate(reqs):
+            cached = self.cache.get((address.checksum << 64) | address.index)
+            if cached is not None and len(cached) == size:
+                out[i] = cached
+            else:
+                misses.setdefault((address, size), []).append(i)
+        if misses:
+            unique = list(misses)
+            batch = getattr(self.device, "read_batch", None)
+            extents = [(address.index * self.block_size, size)
+                       for address, size in unique]
+            datas = (batch(extents) if batch is not None else
+                     [self.device.read(off, size) for off, size in extents])
+            for (address, size), data in zip(unique, datas):
+                if checksum(data, domain=b"blk") != address.checksum:
+                    if self.on_corrupt is not None:
+                        self.on_corrupt(address, size)
+                    raise IOError(f"grid block {address.index} corrupt")
+                self.cache.put((address.checksum << 64) | address.index, data)
+                for i in misses[(address, size)]:
+                    out[i] = data
+        return out
+
 
 class MemoryDevice:
     def __init__(self, size: int):

@@ -85,15 +85,36 @@ class Table:
         return keys, vals
 
     def get(self, key: bytes):
+        blk = self.block_for(key)
+        if blk is None:
+            return None
+        address, size = blk
+        return self.get_in_block(key, self.grid.read_block(address, size))
+
+    def block_for(self, key: bytes):
+        """(address, size) of the one value block that could hold `key`,
+        or None — the read-free planning half of a point lookup (the
+        batched prefetch fan-out plans ALL of a batch's reads first)."""
         if not (self.info.key_min <= key <= self.info.key_max):
             return None
         i = bisect.bisect_right(self.block_first_keys, key) - 1
         if i < 0:
             return None
-        keys, vals = self._block_entries(i)
-        j = bisect.bisect_left(keys, key)
-        if j < len(keys) and keys[j] == key:
-            return vals[j]
+        return self.block_addresses[i], self.block_sizes[i]
+
+    def get_in_block(self, key: bytes, raw: bytes):
+        """Binary-search `key` inside a fetched value block."""
+        (n,) = struct.unpack_from("<I", raw)
+        entry = self.key_size + self.value_size
+        lo, hi = 0, n
+        while lo < hi:
+            mid = (lo + hi) // 2
+            if raw[4 + mid * entry: 4 + mid * entry + self.key_size] < key:
+                lo = mid + 1
+            else:
+                hi = mid
+        if lo < n and raw[4 + lo * entry: 4 + lo * entry + self.key_size] == key:
+            return raw[4 + lo * entry + self.key_size: 4 + (lo + 1) * entry]
         return None
 
     def iter_entries(self):

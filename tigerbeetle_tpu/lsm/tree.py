@@ -162,6 +162,58 @@ class Tree:
             return None
         return value
 
+    def get_many(self, keys, snapshot: Optional[int] = None) -> dict:
+        """Batched point lookups: per level, every unresolved key's value
+        block is issued in ONE concurrent fan-out (Grid.read_blocks),
+        then resolved in place — a cold cache costs one round trip per
+        level touched, not one per key (reference: the prefetch fan-out,
+        src/lsm/groove.zig:996,1339). Returns {key: value} for keys
+        found live (tombstoned/missing keys are absent)."""
+        found: dict = {}
+        remaining = []
+        for key in keys:
+            value = self.memtable.get(key) if snapshot is None else None
+            if value is None and self._frozen_visible(snapshot):
+                value = self.immutable_map.get(key)
+            if value is not None:
+                found[key] = value
+            else:
+                remaining.append(key)
+        for level in self.levels:
+            if not remaining:
+                break
+            # Per-key candidate queues (L0 may yield several overlapping
+            # tables, newest first; deeper levels at most one).
+            active = []
+            for key in remaining:
+                tables = [t for t in level.lookup(key, snapshot)]
+                if tables:
+                    active.append((key, tables))
+            while active:
+                reqs, slots, nxt = [], [], []
+                for key, tables in active:
+                    blk = None
+                    while tables and blk is None:
+                        blk = tables[0].block_for(key)
+                        table = tables.pop(0)
+                    if blk is None:
+                        continue
+                    reqs.append(blk)
+                    slots.append((key, table, tables))
+                if not reqs:
+                    break
+                for (key, table, tables), raw in zip(
+                        slots, self.grid.read_blocks(reqs)):
+                    value = table.get_in_block(key, raw)
+                    if value is not None:
+                        found[key] = value  # tombstones shadow deeper levels
+                    elif tables:
+                        nxt.append((key, tables))
+                active = nxt
+            remaining = [k for k in remaining if k not in found]
+        dead = TOMBSTONE * self.value_size
+        return {k: v for k, v in found.items() if v != dead}
+
     def scan(self, key_min: bytes, key_max: bytes,
              snapshot: Optional[int] = None) -> list[tuple[bytes, bytes]]:
         """Merged range scan, newest version wins (streaming k-way merge

@@ -83,6 +83,8 @@ def load() -> Optional[ctypes.CDLL]:
         lib.tbio_create.restype = vp
         lib.tbio_submit_write.argtypes = [vp, u64, p, u64]
         lib.tbio_submit_write.restype = ctypes.c_long
+        lib.tbio_submit_write_pair.argtypes = [vp, u64, p, u64, u64, p, u64]
+        lib.tbio_submit_write_pair.restype = ctypes.c_long
         lib.tbio_submit_read.argtypes = [vp, u64, u64]
         lib.tbio_submit_read.restype = ctypes.c_long
         lib.tbio_poll.argtypes = [vp, ctypes.POINTER(u64), ctypes.c_long]
@@ -215,6 +217,33 @@ class AsyncEngine:
         op = self.lib.tbio_submit_read(self.handle, offset, size)
         assert op > 0
         return op
+
+    def submit_write_pair(self, off1: int, data1: bytes,
+                          off2: int, data2: bytes) -> int:
+        """Tracked ordered write pair (the async WAL append: prepare body
+        strictly before its redundant header); completion via poll/fetch."""
+        op = self.lib.tbio_submit_write_pair(
+            self.handle, off1, data1, len(data1), off2, data2, len(data2))
+        assert op > 0
+        return op
+
+    def submit_write_tracked(self, offset: int, data: bytes) -> int:
+        """Tracked single write (a pair with an empty second leg): the
+        caller reaps the completion via fetch — used where the reader
+        needs to wait on ONE write, not the whole engine."""
+        op = self.lib.tbio_submit_write_pair(
+            self.handle, offset, data, len(data), 0, b"", 0)
+        assert op > 0
+        return op
+
+    def poll(self, max_ids: int = 4096) -> list[int]:
+        """Nonblocking: ids of completions ready to fetch (reads and
+        tracked writes). The window must exceed any realistic number of
+        unreaped completions, or tokens beyond it are invisible to
+        callers that gate progress on them."""
+        arr = (ctypes.c_uint64 * max_ids)()
+        n = self.lib.tbio_poll(self.handle, arr, max_ids)
+        return [int(arr[i]) for i in range(n)]
 
     def fetch(self, op_id: int, size: int = 0) -> bytes:
         buf = ctypes.create_string_buffer(size) if size else None

@@ -377,6 +377,18 @@ class _CrashingStorage:
     def sync(self):
         self.inner.sync()
 
+    # Async surface: decline, so the journal takes its synchronous path —
+    # every write then flows through the crash counter above.
+    def write_pair_async(self, *args):
+        return None
+
+    def io_poll(self):
+        return []
+
+    def read_batch(self, zone, reqs):
+        # Through self.read so every extent flows through the injector.
+        return [self.read(zone, off, size) for off, size in reqs]
+
 
 def fuzz_durability(prng: random.Random, iterations: int) -> None:
     """Crash at a random WRITE boundary while a replica commits and

@@ -246,6 +246,9 @@ class _ZoneDevice:
     def read(self, off: int, size: int) -> bytes:
         return self.storage.read(self.zone, off, size)
 
+    def read_batch(self, reqs: list) -> list:
+        return self.storage.read_batch(self.zone, reqs)
+
     def write(self, off: int, data: bytes) -> None:
         self.storage.write(self.zone, off, data)
 

@@ -40,34 +40,122 @@ class Journal:
         self.headers: list[Optional[Header]] = [None] * self.slot_count
         self.dirty: set[int] = set()
         self.faulty: set[int] = set()
+        # In-flight async appends: token -> (slot, message, callbacks);
+        # reads of a pending slot are served from the retained message, so
+        # the disk write never blocks the replica loop (reference: the
+        # journal overlaps write_prepare with replication,
+        # src/io/linux.zig + src/vsr/journal.zig:137).
+        self._pending: dict[int, tuple[int, Message, list]] = {}
+        self._pending_by_slot: dict[int, int] = {}
+        # Durability callbacks reaped at a no-fire barrier (checkpoint) or
+        # mid-append; fired in order at the next poll_io.
+        self._deferred: list = []
 
     def slot_for_op(self, op: int) -> int:
         return op % self.slot_count
 
     # ---------------------------------------------------------------- write
 
-    def append(self, message: Message) -> None:
+    def append(self, message: Message, on_durable=None) -> bool:
         """Write prepare body then its redundant header (ordering matters:
         a crash between the two leaves the old header pointing at the old,
-        still-valid prepare, or the new prepare not yet referenced). Uses
-        the native engine's ordered append when available."""
+        still-valid prepare, or the new prepare not yet referenced).
+
+        When the storage has an async engine the ordered pair is submitted
+        without blocking and `on_durable` fires at a later poll_io() /
+        wait barrier; otherwise the write is synchronous (the
+        deterministic simulator path) and `on_durable` fires before
+        return. Returns True if the append is already durable."""
         header = message.header
         assert header.command == Command.prepare
         assert header.size <= self.prepare_size_max
         slot = self.slot_for_op(header.op)
         raw = message.pack()
-        native_file = getattr(self.storage, "native", None)
-        if native_file is not None:
-            zones = self.storage.layout.zone_offsets
-            native_file.wal_append(
-                zones["wal_headers"], zones["wal_prepares"], slot,
-                self.prepare_size_max, raw)
-        else:
-            self.storage.write("wal_prepares", slot * self.prepare_size_max, raw)
-            self.storage.write("wal_headers", slot * HEADER_SIZE, header.pack())
+        # Same-slot appends must not reorder across the worker pool:
+        # settle the in-flight one first (rare — a wrapped ring reusing a
+        # slot, or a repair overwrite racing the original write).
+        prev = self._pending_by_slot.get(slot)
+        if prev is not None:
+            # Callbacks are deferred, not fired here: firing mid-append
+            # could reenter the replica (quorum -> commit) from inside
+            # another replica action.
+            self._finish(prev, fire=False)
+        token = self.storage.write_pair_async(
+            "wal_prepares", slot * self.prepare_size_max, raw,
+            "wal_headers", slot * HEADER_SIZE, header.pack())
         self.headers[slot] = header
         self.dirty.discard(slot)
         self.faulty.discard(slot)
+        if token is None:
+            native_file = getattr(self.storage, "native", None)
+            if native_file is not None:
+                zones = self.storage.layout.zone_offsets
+                native_file.wal_append(
+                    zones["wal_headers"], zones["wal_prepares"], slot,
+                    self.prepare_size_max, raw)
+            else:
+                self.storage.write(
+                    "wal_prepares", slot * self.prepare_size_max, raw)
+                self.storage.write(
+                    "wal_headers", slot * HEADER_SIZE, header.pack())
+            if on_durable is not None:
+                on_durable()
+            return True
+        self._pending[token] = (
+            slot, message, [on_durable] if on_durable is not None else [])
+        self._pending_by_slot[slot] = token
+        return False
+
+    def on_slot_durable(self, op: int, callback) -> None:
+        """Run `callback` once the slot holding `op` is durable — now, if
+        no append is in flight for it."""
+        token = self._pending_by_slot.get(self.slot_for_op(op))
+        if token is None:
+            callback()
+        else:
+            self._pending[token][2].append(callback)
+
+    def _fire_deferred(self) -> None:
+        while self._deferred:
+            deferred, self._deferred = self._deferred, []
+            for cb in deferred:
+                cb()
+
+    def poll_io(self) -> None:
+        """Reap completed async appends and fire their callbacks in append
+        order (called from the replica tick; cheap no-op when nothing is
+        in flight)."""
+        self._fire_deferred()
+        if not self._pending:
+            return
+        for token in self.storage.io_poll():
+            if token in self._pending:
+                self._finish(token)
+
+    def wait_all(self, fire: bool = True) -> None:
+        """Durability barrier: every in-flight append lands. With
+        fire=False the callbacks are DEFERRED to the next poll_io — the
+        checkpoint barrier must not let a quorum callback advance
+        commit_min (and reenter the checkpoint) mid-flip."""
+        while self._pending:
+            self._finish(next(iter(self._pending)), fire=fire)
+        if fire:
+            self._fire_deferred()
+
+    def _finish(self, token: int, fire: bool = True) -> None:
+        slot, _message, callbacks = self._pending.pop(token)
+        if self._pending_by_slot.get(slot) == token:
+            del self._pending_by_slot[slot]
+        # Blocks if still in flight; raises if the write failed (sticky in
+        # the engine — durability is compromised, never paper over it).
+        self.storage.io_reap(token)
+        if fire and not self._deferred:
+            for cb in callbacks:
+                cb()
+        else:
+            # Keep append order: once anything is deferred, everything
+            # later defers behind it.
+            self._deferred.extend(callbacks)
 
     # ---------------------------------------------------------------- read
 
@@ -76,6 +164,12 @@ class Journal:
         header = self.headers[slot]
         if header is None or header.op != op:
             return None
+        # An in-flight async append is served from the retained message —
+        # the write-buffer read path (the disk bytes are not there yet).
+        token = self._pending_by_slot.get(slot)
+        if token is not None:
+            msg = self._pending[token][1]
+            return msg if msg.header.op == op else None
         raw = self.storage.read(
             "wal_prepares", slot * self.prepare_size_max,
             min(self.prepare_size_max, max(header.size, HEADER_SIZE)))

@@ -466,13 +466,25 @@ class Replica:
             parent=parent, release=self.release,
         )
         prepare = Message(header=header.finalize(body), body=body)
-        self.journal.append(prepare)
         self.op = op
-        self.pipeline[op] = {"message": prepare, "oks": {self.replica_id}}
+        self.pipeline[op] = {"message": prepare, "oks": set()}
+        # The local journal write and the network replication proceed
+        # CONCURRENTLY (reference: src/io/linux.zig overlap); the primary
+        # counts its own ack only once its WAL slot is durable.
+        self.journal.append(prepare, on_durable=self._self_ack_fn(prepare))
         for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, prepare)
         self._check_quorum(op)
+
+    def _self_ack_fn(self, prepare: Message):
+        op, csum = prepare.header.op, prepare.header.checksum
+        def _ack():
+            entry = self.pipeline.get(op)
+            if entry is not None and entry["message"].header.checksum == csum:
+                entry["oks"].add(self.replica_id)
+                self._check_quorum(op)
+        return _ack
 
     def _prepare_checksum(self, op: int) -> int:
         if op == 0:
@@ -495,7 +507,8 @@ class Replica:
             if self.is_standby or self._pending_view is not None:
                 pass  # no vote; a pending primary finalizes below instead
             elif not self.is_primary:
-                self._send_prepare_ok(h)
+                self.journal.on_slot_durable(
+                    h.op, lambda h=h: self._send_prepare_ok(h))
             else:
                 self._primary_adopt_canonical(msg)
             self._commit_journal(self.commit_max)
@@ -524,12 +537,16 @@ class Replica:
                 self._commit_journal(self.commit_max)
             if held is not None and held.header.checksum == h.checksum \
                     and not self.is_standby:
-                self._send_prepare_ok(h)  # ack only what we actually hold
+                # Ack only what we actually hold — and only once the slot
+                # is durable (an in-flight async append is not yet ours
+                # to vouch for).
+                self.journal.on_slot_durable(
+                    h.op, lambda h=h: self._send_prepare_ok(h))
         elif h.op == self.op + 1 and h.parent == self._prepare_checksum(self.op):
-            self.journal.append(msg)
+            self.journal.append(
+                msg, on_durable=(None if self.is_standby
+                                 else lambda h=h: self._send_prepare_ok(h)))
             self.op = h.op
-            if not self.is_standby:
-                self._send_prepare_ok(h)
         else:
             # Gap or chain break: repair.
             for missing in range(self.op + 1, h.op):
@@ -560,7 +577,8 @@ class Replica:
         op = msg.header.op
         if op <= self.commit_min or op in self.pipeline:
             return
-        self.pipeline[op] = {"message": msg, "oks": {self.replica_id}}
+        self.pipeline[op] = {"message": msg, "oks": set()}
+        self.journal.on_slot_durable(op, self._self_ack_fn(msg))
         for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
@@ -793,6 +811,12 @@ class Replica:
         Only manifests + the free set are serialized — table data is already
         durable in the copy-on-write grid, so the flip is incremental."""
         sb = self.superblock
+        # WAL durability barrier: every in-flight async append lands
+        # before state derived from those prepares is checkpointed.
+        # fire=False: a quorum callback firing here could advance
+        # commit_min mid-flip (and reenter _checkpoint); the callbacks
+        # run at the next tick's poll_io instead.
+        self.journal.wait_all(fire=False)
         sessions_blob = self.sessions.pack()
         root = (self.durable.checkpoint(self.state_machine.state)
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
@@ -1674,6 +1698,10 @@ class Replica:
             msg.header.timestamp, self.time.monotonic())
 
     def tick(self) -> None:
+        # Reap async WAL completions first: deferred prepare_oks / the
+        # primary's self-acks fire here (sans-io: the engine never calls
+        # back into the replica on its own threads).
+        self.journal.poll_io()
         now = self.time.monotonic()
         if now - self.last_ping_tx >= self.options.heartbeat_interval_ns * 5:
             self.last_ping_tx = now

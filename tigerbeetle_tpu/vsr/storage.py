@@ -86,6 +86,32 @@ class Storage:
     def sync(self) -> None:
         pass
 
+    # ------------------------------------------------ async (optional)
+    # Overlapped IO for the WAL path (reference: src/io/linux.zig). The
+    # default implementation is synchronous-only: write_pair_async
+    # returns None and the caller falls back to blocking writes — the
+    # deterministic simulator keeps this behavior.
+
+    def write_pair_async(self, zone1: str, off1: int, data1: bytes,
+                         zone2: str, off2: int, data2: bytes):
+        """Submit an ordered write pair (data2 strictly after data1);
+        returns a completion token, or None when unsupported."""
+        return None
+
+    def io_poll(self) -> list:
+        """Nonblocking: completion tokens ready to reap."""
+        return []
+
+    def io_reap(self, token) -> None:
+        """Block until `token` completes; raises on write failure."""
+        raise KeyError(f"unknown io token {token!r}")
+
+    def read_batch(self, zone: str, reqs: list) -> list:
+        """Read many (offset, size) extents; concurrent when the engine
+        supports it (reference: the prefetch fan-out issues all of a
+        batch's reads at once, src/lsm/groove.zig:996,1339)."""
+        return [self.read(zone, off, size) for off, size in reqs]
+
     def _check(self, zone: str, offset: int, size: int) -> int:
         zones = self.layout.zone_offsets
         base = zones[zone]
@@ -135,7 +161,7 @@ class FileStorage(Storage):
         # cold/bypass read — those drain first (`_drain_grid`); sync()
         # drains + fsyncs (the checkpoint barrier).
         self.aio = None
-        self._grid_pending: list[tuple[int, int]] = []  # (pos, end)
+        self._grid_pending: dict[int, tuple[int, int]] = {}  # token -> (pos, end)
         if native_mod.available():
             self.native = native_mod.NativeFile(path, layout.size, create)
             self.fd = -1
@@ -148,14 +174,32 @@ class FileStorage(Storage):
             os.ftruncate(self.fd, layout.size)
 
     def _drain_grid(self, pos: int = None, size: int = None) -> None:
+        """Settle pending grid writes overlapping [pos, pos+size) — or all
+        of them. Waits only on the overlapping grid tokens, never on
+        unrelated in-flight ops (the journal's async WAL pairs share the
+        engine; a cold grid read must not stall behind them)."""
         if self.aio is None or not self._grid_pending:
             return
-        if pos is not None:
+        if pos is None:
+            tokens = list(self._grid_pending)
+        else:
             end = pos + size
-            if not any(p < end and pos < e for p, e in self._grid_pending):
+            tokens = [tok for tok, (p, e) in self._grid_pending.items()
+                      if p < end and pos < e]
+            if not tokens:
                 return
-        self.aio.drain()
-        self._grid_pending.clear()
+        for token in tokens:
+            del self._grid_pending[token]
+            self._reap_grid(token)
+
+    def _reap_grid(self, token: int) -> None:
+        try:
+            self.aio.fetch(token)
+        except OSError:
+            # Same contract as the drain barrier: a lost grid write
+            # means durability is compromised (sticky in the engine).
+            raise RuntimeError(
+                "async write failed (sticky): storage compromised")
 
     def read(self, zone: str, offset: int, size: int) -> bytes:
         pos = self._check(zone, offset, size)
@@ -171,18 +215,69 @@ class FileStorage(Storage):
     def write(self, zone: str, offset: int, data: bytes) -> None:
         pos = self._check(zone, offset, len(data))
         if zone == "grid" and self.aio is not None:
-            self.aio.submit_write(pos, data)
-            self._grid_pending.append((pos, pos + len(data)))
+            token = self.aio.submit_write_tracked(pos, data)
+            self._grid_pending[token] = (pos, pos + len(data))
             return
         if self.native is not None:
             self.native.write(pos, data)
             return
         os.pwrite(self.fd, data, pos)
 
+    def write_pair_async(self, zone1: str, off1: int, data1: bytes,
+                         zone2: str, off2: int, data2: bytes):
+        if self.aio is None:
+            return None
+        pos1 = self._check(zone1, off1, len(data1))
+        pos2 = self._check(zone2, off2, len(data2))
+        return self.aio.submit_write_pair(pos1, data1, pos2, data2)
+
+    def io_poll(self) -> list:
+        """Completion tokens for OTHER subsystems (the journal's WAL
+        pairs). Completed grid-write records are reaped here as a side
+        effect — left unfetched they would pile up in the engine and
+        crowd real tokens out of the poll window (a stalled WAL callback
+        is a stalled commit)."""
+        if self.aio is None:
+            return []
+        out = []
+        for token in self.aio.poll():
+            if token in self._grid_pending:
+                del self._grid_pending[token]
+                self._reap_grid(token)
+            else:
+                out.append(token)
+        return out
+
+    def io_reap(self, token) -> None:
+        assert self.aio is not None
+        self.aio.fetch(token)
+
+    def read_batch(self, zone: str, reqs: list) -> list:
+        if self.aio is None or len(reqs) <= 1:
+            return [self.read(zone, off, size) for off, size in reqs]
+        positions = []
+        for off, size in reqs:
+            pos = self._check(zone, off, size)
+            if zone == "grid":
+                self._drain_grid(pos, size)
+            positions.append(pos)
+        tokens = [self.aio.submit_read(pos, size)
+                  for pos, (_, size) in zip(positions, reqs)]
+        out = []
+        for tok, (_, size) in zip(tokens, reqs):
+            data = self.aio.fetch(tok, size)
+            if len(data) < size:
+                data += b"\x00" * (size - len(data))
+            out.append(data)
+        return out
+
     def sync(self) -> None:
         if self.aio is not None:
+            # Reap tracked grid tokens first (drain alone would leave
+            # their completion records unfetched in the engine), then the
+            # engine-wide durability barrier.
+            self._drain_grid()
             self.aio.drain(sync=True)
-            self._grid_pending.clear()
             return
         if self.native is not None:
             self.native.sync()
