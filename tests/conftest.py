@@ -20,3 +20,19 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _verify_flag_isolated():
+    """constants.VERIFY is process-global and the simulator flips it on
+    (VOPR doctrine); restore it around every test so a Cluster in one
+    test cannot silently enable extra checks (or fire their asserts) in
+    unrelated later tests."""
+    from tigerbeetle_tpu import constants
+
+    was = constants.VERIFY
+    yield
+    constants.set_verify(was)

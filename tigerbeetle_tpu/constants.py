@@ -56,6 +56,22 @@ def timestamp_valid(timestamp: int) -> bool:
     return TIMESTAMP_MIN <= timestamp <= TIMESTAMP_MAX
 
 
+
+# --- Extra-check mode (reference: constants.verify, src/fuzz_tests.zig:11-16,
+# docs/internals/vopr.md:48-57): expensive cross-structure invariant checks
+# kept OFF on the serving path and switched ON under fuzz / VOPR / the
+# deterministic simulator. Call sites read `constants.VERIFY` through the
+# module (never `from ... import VERIFY` — that would freeze the value).
+import os as _os
+
+VERIFY = _os.environ.get("TB_VERIFY", "") == "1"
+
+
+def set_verify(on: bool) -> None:
+    global VERIFY
+    VERIFY = bool(on)
+
+
 def config_fingerprint(extra: tuple = ()) -> int:
     """Fingerprint of the CLUSTER-critical configuration (the reference's
     ConfigCluster, src/config.zig:153-163: parameters that must match

@@ -39,6 +39,29 @@ class BlockAddress:
 ADDRESS_SIZE = 24
 
 
+class GridReservation:
+    """A pre-claimed run of grid blocks (see Grid.reserve)."""
+
+    __slots__ = ("grid", "indices", "cursor", "closed")
+
+    def __init__(self, grid: "Grid", indices: list):
+        self.grid = grid
+        self.indices = indices
+        self.cursor = 0
+        self.closed = False
+
+    def next_index(self) -> int:
+        assert not self.closed, "reservation already forfeited"
+        assert self.cursor < len(self.indices), \
+            "reservation exhausted: worst-case bound was wrong"
+        idx = self.indices[self.cursor]
+        self.cursor += 1
+        return idx
+
+    def unused(self) -> list:
+        return self.indices[self.cursor:]
+
+
 class Grid:
     """Block store over a flat byte device (file or memory).
 
@@ -67,6 +90,10 @@ class Grid:
         self.on_corrupt = None
         self.freed_pending: list[int] = []  # released at next checkpoint
         self.acquire_cursor = 0
+        # Live reservations (reserve() .. forfeit()): their unwritten
+        # blocks are excluded from checkpointed free sets — a crash mid-
+        # job must not leak them (the restored job re-reserves afresh).
+        self._reservations: set = set()
 
     # ------------------------------------------------------------ alloc
 
@@ -80,18 +107,58 @@ class Grid:
                 return idx
         raise RuntimeError("grid full")
 
+    # Two-stage reserve/acquire (reference: src/vsr/free_set.zig:28-35):
+    # a long-running job claims its WORST-CASE block count up front, then
+    # acquires from its reservation as it writes, and forfeits the unused
+    # remainder at completion. Guarantees (a) a job can never die of
+    # "grid full" mid-write, and (b) allocation stays deterministic no
+    # matter how concurrent jobs interleave their writes.
+
+    def reserve(self, count: int) -> "GridReservation":
+        indices = []
+        try:
+            for _ in range(count):
+                indices.append(self.acquire())
+        except RuntimeError:
+            for idx in indices:  # all-or-nothing
+                self.free[idx] = True
+            raise RuntimeError(
+                f"grid cannot reserve {count} blocks (full)")
+        res = GridReservation(self, indices)
+        self._reservations.add(res)
+        return res
+
+    def forfeit(self, reservation: "GridReservation") -> None:
+        """Return a reservation's unwritten blocks to the free set (they
+        were never written, so immediate reuse is crash-safe)."""
+        for idx in reservation.unused():
+            assert not self.free[idx]
+            self.free[idx] = True
+        reservation.closed = True
+        self._reservations.discard(reservation)
+
     def release(self, index: int) -> None:
         """Free a block at the NEXT checkpoint (two-phase, crash-safe)."""
         assert not self.free[index]
         self.freed_pending.append(index)
 
     def checkpoint_free_set(self) -> bytes:
-        """Apply pending frees and serialize the free set (EWAH)."""
+        """Apply pending frees and serialize the free set (EWAH). Live
+        reservations serialize as FREE in their entirety — an incomplete
+        job's blocks (written or not) are referenced by no manifest
+        (tables install and manifests pack only after a job drains), so
+        a crash must not leak them: the restored job re-reserves and
+        rewrites from scratch."""
         for idx in self.freed_pending:
             self.free[idx] = True
         self.freed_pending.clear()
         self.acquire_cursor = 0
-        return ewah.encode_bitset(self.free)
+        bits = list(self.free)
+        for res in self._reservations:
+            for idx in res.indices:
+                assert not bits[idx]
+                bits[idx] = True
+        return ewah.encode_bitset(bits)
 
     def restore_free_set(self, blob: bytes) -> None:
         bits = ewah.decode_bitset(blob)
@@ -99,12 +166,15 @@ class Grid:
         self.free = bits
         self.freed_pending.clear()
         self.acquire_cursor = 0
+        self._reservations.clear()
 
     # ------------------------------------------------------------- blocks
 
-    def write_block(self, data: bytes) -> BlockAddress:
+    def write_block(self, data: bytes,
+                    reservation: "GridReservation" = None) -> BlockAddress:
         assert len(data) <= self.block_size
-        index = self.acquire()
+        index = (self.acquire() if reservation is None
+                 else reservation.next_index())
         self.device.write(index * self.block_size, data)
         address = BlockAddress(index, checksum(data, domain=b"blk"))
         self.cache.put((address.checksum << 64) | index, data)

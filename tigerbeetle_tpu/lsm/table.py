@@ -137,42 +137,63 @@ def table_entry_max(grid: Grid, key_size: int, value_size: int) -> int:
     return per_block * index_entries_max
 
 
-def write_value_block(grid: Grid, chunk: list[tuple[bytes, bytes]]):
+def write_value_block(grid: Grid, chunk: list[tuple[bytes, bytes]],
+                      reservation=None):
     """One value block; returns (address, size, first_key) — the index
     entry triple. The SINGLE encoder for the value-block layout (shared
     by whole-table writes and the incremental memtable flush)."""
     raw = struct.pack("<I", len(chunk)) + b"".join(k + v for k, v in chunk)
-    addr = grid.write_block(raw)
+    addr = grid.write_block(raw, reservation=reservation)
     return addr, len(raw), chunk[0][0]
 
 
-def write_index_block(grid: Grid, blocks: list) -> tuple[BlockAddress, int]:
+def write_index_block(grid: Grid, blocks: list,
+                      reservation=None) -> tuple[BlockAddress, int]:
     """The table's index block over (address, size, first_key) triples."""
     index_raw = struct.pack("<I", len(blocks)) + b"".join(
         addr.pack() + struct.pack("<I", size) + first
         for addr, size, first in blocks)
     assert len(index_raw) <= grid.block_size, "table too large for one index"
-    return grid.write_block(index_raw), len(index_raw)
+    return grid.write_block(index_raw, reservation=reservation), len(index_raw)
+
+
+def table_block_bound(grid: Grid, n_entries: int, key_size: int,
+                      value_size: int) -> int:
+    """Worst-case grid blocks (value + index) for writing `n_entries` as
+    tables — the reservation bound for flush/compaction jobs (reference:
+    compactions reserve their worst case, src/vsr/free_set.zig:28-35)."""
+    per_block = value_block_entry_max(grid, key_size, value_size)
+    cap = table_entry_max(grid, key_size, value_size)
+    n = max(1, n_entries)
+    tables = -(-n // cap)
+    # Value blocks: ceil(n/per_block) plus one possible short block per
+    # table boundary; one index block per table.
+    return -(-n // per_block) + 2 * tables
 
 
 def write_tables(grid: Grid, entries: list[tuple[bytes, bytes]],
-                 key_size: int, value_size: int) -> list["TableInfo"]:
+                 key_size: int, value_size: int,
+                 reservation=None) -> list["TableInfo"]:
     """Serialize a sorted run as one or more bounded tables (a single merge
     output may exceed one table's index capacity — split, like the
     reference's compaction emitting multiple output tables)."""
     cap = table_entry_max(grid, key_size, value_size)
-    return [write_table(grid, entries[i:i + cap], key_size, value_size)
+    return [write_table(grid, entries[i:i + cap], key_size, value_size,
+                        reservation=reservation)
             for i in range(0, len(entries), cap)]
 
 
 def write_table(grid: Grid, entries: list[tuple[bytes, bytes]],
-                key_size: int, value_size: int) -> TableInfo:
+                key_size: int, value_size: int,
+                reservation=None) -> TableInfo:
     """Serialize one sorted run (caller guarantees sort order + unique keys)."""
     assert entries
     per_block = value_block_entry_max(grid, key_size, value_size)
-    blocks = [write_value_block(grid, entries[base:base + per_block])
+    blocks = [write_value_block(grid, entries[base:base + per_block],
+                                reservation=reservation)
               for base in range(0, len(entries), per_block)]
-    index_addr, index_size = write_index_block(grid, blocks)
+    index_addr, index_size = write_index_block(grid, blocks,
+                                               reservation=reservation)
     return TableInfo(
         index_address=index_addr, index_size=index_size,
         key_min=entries[0][0], key_max=entries[-1][0],

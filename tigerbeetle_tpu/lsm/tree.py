@@ -36,6 +36,7 @@ from .table import (
     TableInfo,
     TOMBSTONE,
     release_table,
+    table_block_bound,
     table_entry_max,
     value_block_entry_max,
     write_index_block,
@@ -62,6 +63,8 @@ class _FlushJob:
     # Current table's completed value blocks: (address, size, first_key).
     blocks: list = dataclasses.field(default_factory=list)
     infos: list = dataclasses.field(default_factory=list)
+    # Worst-case grid reservation claimed at freeze (free_set.zig:28-35).
+    reservation: object = None
 
 
 @dataclasses.dataclass
@@ -77,6 +80,8 @@ class _CompactionJob:
     merged: dict = dataclasses.field(default_factory=dict)
     streams: list = dataclasses.field(default_factory=list)
     stream_i: int = 0
+    # Worst-case grid reservation claimed at schedule (free_set.zig:28-35).
+    reservation: object = None
 
     def advance(self, budget: Optional[int]):
         """Merge up to `budget` INPUT entries (None = drain). Returns
@@ -283,9 +288,12 @@ class Tree:
         self._drain_flush()  # at most one frozen memtable at a time
         self.immutable_map = self.memtable
         self.memtable = {}
+        entries = sorted(self.immutable_map.items())
         self._flush = _FlushJob(
-            entries=sorted(self.immutable_map.items()),
-            snapshot=self.beat)
+            entries=entries,
+            snapshot=self.beat,
+            reservation=self.grid.reserve(table_block_bound(
+                self.grid, len(entries), self.key_size, self.value_size)))
         self._flush_per_beat = max(
             1, -(-len(self._flush.entries) // (BAR_LENGTH - 1)))
 
@@ -308,7 +316,8 @@ class Tree:
             table_end = min(len(job.entries),
                             (job.pos // cap + 1) * cap)
             chunk = job.entries[job.pos:min(job.pos + per_block, table_end)]
-            job.blocks.append(write_value_block(self.grid, chunk))
+            job.blocks.append(write_value_block(
+                self.grid, chunk, reservation=job.reservation))
             job.pos += len(chunk)
             if budget is not None:
                 budget -= len(chunk)
@@ -319,11 +328,14 @@ class Tree:
             self.levels[0].insert(
                 Table(self.grid, info, self.key_size, self.value_size),
                 snapshot=job.snapshot)
+        if job.reservation is not None:
+            self.grid.forfeit(job.reservation)
         self.immutable_map = {}
         self._flush = None
 
     def _finish_flush_table(self, job: _FlushJob, cap: int) -> TableInfo:
-        index_addr, index_size = write_index_block(self.grid, job.blocks)
+        index_addr, index_size = write_index_block(
+            self.grid, job.blocks, reservation=job.reservation)
         first_key = job.blocks[0][2]
         # job.pos sits at this table's end; recover its entry range.
         start = (job.pos - 1) // cap * cap
@@ -370,8 +382,11 @@ class Tree:
                 claimed.update(id(t) for t in touched)
                 total = (table.info.entry_count
                          + sum(t.info.entry_count for t in overlapping))
-                job = _CompactionJob(level=level, table=table,
-                                     overlapping=overlapping, total=total)
+                job = _CompactionJob(
+                    level=level, table=table,
+                    overlapping=overlapping, total=total,
+                    reservation=self.grid.reserve(table_block_bound(
+                        self.grid, total, self.key_size, self.value_size)))
                 # Older tables first so the newer input wins the merge.
                 job.streams = [t.iter_entries() for t in overlapping]
                 job.streams.append(table.iter_entries())
@@ -417,10 +432,13 @@ class Tree:
             # A merge output exceeding one table's capacity splits into
             # several disjoint tables (all still inside next_level's range).
             for info in write_tables(self.grid, entries, self.key_size,
-                                     self.value_size):
+                                     self.value_size,
+                                     reservation=job.reservation):
                 next_level.insert(Table(
                     self.grid, info, self.key_size, self.value_size),
                     snapshot=self.beat)
+        if job.reservation is not None:
+            self.grid.forfeit(job.reservation)
 
     def _pick_table(self, level: int) -> Table:
         """Selection policy: L0 tables overlap each other, so only the
@@ -535,8 +553,11 @@ class Tree:
                 overlapping = [resident(level + 1, i) for i in over_infos]
                 total = (table.info.entry_count
                          + sum(t.info.entry_count for t in overlapping))
-                job = _CompactionJob(level=level, table=table,
-                                     overlapping=overlapping, total=total)
+                job = _CompactionJob(
+                    level=level, table=table,
+                    overlapping=overlapping, total=total,
+                    reservation=self.grid.reserve(table_block_bound(
+                        self.grid, total, self.key_size, self.value_size)))
                 job.streams = [t.iter_entries() for t in overlapping]
                 job.streams.append(table.iter_entries())
                 self._jobs.append(job)

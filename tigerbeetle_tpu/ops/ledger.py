@@ -975,6 +975,40 @@ class DeviceLedger:
             if n_new:
                 self._materialize_delta_transfers(t, e, der, t0, n_new)
         self._clear_dirty_dev()
+        from .. import constants
+
+        if constants.VERIFY:
+            # Extra-check mode: spot-audit device rows against the just-
+            # drained mirror (the write-through contract, fuzz_tests.zig
+            # :11-16 doctrine).
+            for t, e, der, t0, n_new, _ in reversed(chunks):
+                if n_new:
+                    k = min(2, n_new)
+                    xfer_ids = [u128.to_int(t["id_hi"][i], t["id_lo"][i])
+                                for i in range(k)]
+                    # Plus a STABLE anchor — the oldest transfer — so
+                    # drift on rows the batch never touched (stale
+                    # pending flips, bad pushes) is caught too.
+                    if self.mirror.transfers:
+                        xfer_ids.append(next(iter(self.mirror.transfers)))
+                    self._verify_mirror_spot(
+                        [u128.to_int(der["dr_id_hi"][i], der["dr_id_lo"][i])
+                         for i in range(k)],
+                        xfer_ids)
+                    break
+
+    def _verify_mirror_spot(self, acct_ids: list, xfer_ids: list) -> None:
+        """VERIFY check: device-resident rows and the host mirror must
+        agree object-for-object after a drain."""
+        sm = self.mirror
+        got_a = {a.id: a for a in self.lookup_accounts(acct_ids)}
+        for aid in acct_ids:
+            assert got_a.get(aid) == sm.accounts.get(aid), \
+                f"verify: device/mirror divergence on account {aid}"
+        got_t = {t.id: t for t in self.lookup_transfers(xfer_ids)}
+        for tid in xfer_ids:
+            assert got_t.get(tid) == sm.transfers.get(tid), \
+                f"verify: device/mirror divergence on transfer {tid}"
 
     def _materialize_delta_transfers(self, t, e, der, t0,
                                      n_new: int) -> None:

@@ -194,28 +194,6 @@ class StateMachine:
             if t is not None:
                 self._xfer_cache.put(tid, t)
 
-    def _cached_account(self, aid: int) -> Optional[Account]:
-        a = self._acct_cache.get(aid)
-        if a is None:
-            raw = self._fq.forest.trees["accounts"].get(
-                aid.to_bytes(16, "big"))
-            if raw is None:
-                return None
-            a = Account.unpack(raw)
-            self._acct_cache.put(aid, a)
-        return a
-
-    def _cached_transfer(self, tid: int) -> Optional[Transfer]:
-        t = self._xfer_cache.get(tid)
-        if t is None:
-            raw = self._fq.forest.trees["transfers"].get(
-                tid.to_bytes(16, "big"))
-            if raw is None:
-                return None
-            t = Transfer.unpack(raw)
-            self._xfer_cache.put(tid, t)
-        return t
-
     # ------------------------------------------------------------- state
 
     @property
@@ -311,6 +289,17 @@ class StateMachine:
                     obj = cls.unpack(raw)
                     cache.put(i, obj)
                     hit[i] = obj
+        from . import constants
+
+        if constants.VERIFY and hit:
+            # Extra-check mode: cached objects must match their tree-
+            # resident copies (cache-vs-tree coherence; both are updated
+            # at the durable flush boundary).
+            tree = self._fq.forest.trees[tree_name]
+            for i, obj in list(hit.items())[:4]:
+                raw = tree.get(i.to_bytes(16, "big"))
+                assert raw is not None and cls.unpack(raw) == obj, \
+                    f"verify: cache/tree divergence on {tree_name} {i}"
         return [hit[i] for i in ids if i in hit]
 
     # ------------------------------------------------------------- indexes

@@ -153,6 +153,12 @@ class Cluster:
                  state_machine_factory=StateMachine,
                  clock_drift_ppm_max: int = 0,
                  clock_offset_ns_max: int = 0):
+        # Simulated clusters always run with the extra-check mode on
+        # (reference: VOPR builds compile constants.verify in,
+        # docs/internals/vopr.md:48-57).
+        from .. import constants as _constants
+
+        _constants.set_verify(True)
         self.cluster_id = 0xC1A57E12
         self.rng = random.Random(seed)
         self.time = TimeSim()

@@ -531,7 +531,11 @@ DEFAULT_ITERATIONS = {
 
 
 def run(name: str, seed: int, iterations: int | None = None) -> None:
-    """Run one fuzzer (or 'smoke' = every fuzzer briefly)."""
+    """Run one fuzzer (or 'smoke' = every fuzzer briefly). Fuzzers always
+    run with the extra-check mode on (reference: fuzz builds compile
+    constants.verify in, src/fuzz_tests.zig:11-16)."""
+    from .. import constants
+
     if name == "smoke":
         for sub in FUZZERS:
             run(sub, seed,
@@ -539,6 +543,11 @@ def run(name: str, seed: int, iterations: int | None = None) -> None:
                 else max(1, DEFAULT_ITERATIONS[sub] // 10))
         return
     fuzzer = FUZZERS[name]
-    fuzzer(random.Random(seed),
-           iterations if iterations is not None
-           else DEFAULT_ITERATIONS[name])
+    was = constants.VERIFY
+    constants.set_verify(True)
+    try:
+        fuzzer(random.Random(seed),
+               iterations if iterations is not None
+               else DEFAULT_ITERATIONS[name])
+    finally:
+        constants.set_verify(was)

@@ -58,6 +58,7 @@ import dataclasses
 import logging
 from typing import Callable, Optional
 
+from .. import constants
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
 from ..state_machine import StateMachine
 from ..types import Operation
@@ -817,6 +818,20 @@ class Replica:
         # commit_min mid-flip (and reenter _checkpoint); the callbacks
         # run at the next tick's poll_io instead.
         self.journal.wait_all(fire=False)
+        if constants.VERIFY:
+            # Extra-check mode: walk the committed WAL suffix's hash
+            # chain (parent linkage across held neighbors).
+            prev = None
+            for op in range(max(1, self.commit_min - 64),
+                            self.commit_min + 1):
+                m = self.journal.read_prepare(op)
+                if m is None:
+                    prev = None
+                    continue
+                if prev is not None:
+                    assert m.header.parent == prev, \
+                        f"verify: journal chain break at op {op}"
+                prev = m.header.checksum
         sessions_blob = self.sessions.pack()
         root = (self.durable.checkpoint(self.state_machine.state)
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
