@@ -764,3 +764,243 @@ class TestScopeRollbackIndexes:
             TS_BASE + 20,
         )
         assert r[0].status == TS.created
+
+
+class TestReferenceTables:
+    """Round-3 additions mirroring the remaining state_machine_tests.zig
+    tables (reference line refs per test)."""
+
+    def test_linked_chain_open_at_batch_end(self):
+        """reference: "linked_event_chain_open" :1186 — a batch ending on
+        a linked event fails that trailing open chain."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1),
+             Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1, flags=int(TF.linked))],
+            TS_BASE + 100)
+        assert [x.status for x in r] == [
+            TS.created, TS.linked_event_chain_open]
+        assert 2 not in oracle.transfers
+        assert oracle.accounts[1].debits_posted == 1
+
+    def test_linked_chain_open_batch_of_one(self):
+        """reference: :1225 — a single-event batch with flags.linked is an
+        open chain."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1, flags=int(TF.linked))],
+            TS_BASE + 100)
+        assert [x.status for x in r] == [TS.linked_event_chain_open]
+        assert not oracle.transfers
+
+    def test_linked_chain_open_after_failed_chain(self):
+        """reference: :1207 — an earlier failed chain does not absorb a
+        trailing open chain; both fail independently."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=99,
+                      amount=1, ledger=1, code=1, flags=int(TF.linked)),
+             Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1),
+             Transfer(id=3, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1, flags=int(TF.linked))],
+            TS_BASE + 100)
+        assert [x.status for x in r] == [
+            TS.credit_account_not_found, TS.linked_event_failed,
+            TS.linked_event_chain_open]
+        assert not oracle.transfers
+
+    def test_failed_chain_undone_within_commit(self):
+        """reference: :1579 — later events in the SAME batch observe the
+        rolled-back state, not the chain's intermediate effects."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=100, ledger=1, code=1, flags=int(TF.linked)),
+             Transfer(id=2, debit_account_id=1, credit_account_id=99,
+                      amount=1, ledger=1, code=1),
+             # Same batch, after the rollback: balances must be pristine.
+             Transfer(id=3, debit_account_id=1, credit_account_id=2,
+                      amount=7, ledger=1, code=1)],
+            TS_BASE + 100)
+        assert [x.status for x in r] == [
+            TS.linked_event_failed, TS.credit_account_not_found, TS.created]
+        assert oracle.accounts[1].debits_posted == 7
+        assert 1 not in oracle.transfers and 2 not in oracle.transfers
+
+    def test_failed_transfer_does_not_exist(self):
+        """reference: :1533 — a failed (non-transient) create leaves no
+        object behind; the id stays usable."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=5, debit_account_id=1, credit_account_id=1,
+                      amount=1, ledger=1, code=1)],
+            TS_BASE + 100)
+        assert r[0].status == TS.accounts_must_be_different
+        assert 5 not in oracle.transfers
+        # Non-transient failure does not poison the id.
+        r = oracle.create_transfers(
+            [Transfer(id=5, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1)],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+
+    def test_two_phase_amount_max_int(self):
+        """reference: :1446 — pending amount=maxInt posts in full via the
+        maxInt sentinel."""
+        oracle = setup_two_accounts(StateMachineOracle())
+        r = oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                      amount=U128_MAX, ledger=1, code=1,
+                      flags=int(TF.pending))],
+            TS_BASE + 100)
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].debits_pending == U128_MAX
+        r = oracle.create_transfers(
+            [Transfer(id=2, pending_id=1, amount=U128_MAX,
+                      flags=int(TF.post_pending_transfer))],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+        assert oracle.accounts[1].debits_pending == 0
+        assert oracle.accounts[1].debits_posted == U128_MAX
+        assert oracle.transfers[2].amount == U128_MAX
+
+    def test_balancing_amount_zero(self):
+        """reference: :1723 — balancing with amount=0 clamps to zero and
+        still creates (a zero-amount transfer)."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1,
+                 flags=int(AccountFlags.debits_must_not_exceed_credits)),
+            dict(id=2, ledger=1, code=1)])
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                      amount=50, ledger=1, code=1)], TS_BASE + 100)
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                      amount=0, ledger=1, code=1,
+                      flags=int(TF.balancing_debit))],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+        assert oracle.transfers[2].amount == 0
+        assert oracle.accounts[1].debits_posted == 0
+
+    def test_balancing_amount_max_near_full_balance(self):
+        """reference: :1763 — balancing amount=maxInt against a balance
+        near maxInt clamps without tripping the overflow guards."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1),
+            dict(id=2, ledger=1, code=1)])
+        big = U128_MAX - 5
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                      amount=big, ledger=1, code=1)], TS_BASE + 100)
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                      amount=U128_MAX, ledger=1, code=1,
+                      flags=int(TF.balancing_debit))],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+        assert oracle.transfers[2].amount == big
+        assert oracle.accounts[1].debits_posted == big
+
+    def test_balancing_debit_and_credit_combined(self):
+        """reference: :1790 — both flags clamp against BOTH accounts; the
+        tighter side wins."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1),
+            dict(id=2, ledger=1, code=1),
+            dict(id=3, ledger=1, code=1)])
+        # Debit headroom on 1: 40 credits; credit headroom on 2: 25 debits.
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=3, credit_account_id=1,
+                      amount=40, ledger=1, code=1),
+             Transfer(id=2, debit_account_id=2, credit_account_id=3,
+                      amount=25, ledger=1, code=1)], TS_BASE + 100)
+        r = oracle.create_transfers(
+            [Transfer(id=3, debit_account_id=1, credit_account_id=2,
+                      amount=100, ledger=1, code=1,
+                      flags=int(TF.balancing_debit | TF.balancing_credit))],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+        assert oracle.transfers[3].amount == 25  # tighter (credit) side
+
+    def test_balancing_with_pending(self):
+        """reference: :1822 — a balancing PENDING transfer clamps against
+        posted+pending and holds the clamped amount."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1,
+                 flags=int(AccountFlags.debits_must_not_exceed_credits)),
+            dict(id=2, ledger=1, code=1)])
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                      amount=60, ledger=1, code=1)], TS_BASE + 100)
+        r = oracle.create_transfers(
+            [Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                      amount=100, ledger=1, code=1,
+                      flags=int(TF.balancing_debit | TF.pending))],
+            TS_BASE + 200)
+        assert r[0].status == TS.created
+        assert oracle.transfers[2].amount == 60
+        assert oracle.accounts[1].debits_pending == 60
+        # A second balancing debit now has zero headroom.
+        r = oracle.create_transfers(
+            [Transfer(id=3, debit_account_id=1, credit_account_id=2,
+                      amount=10, ledger=1, code=1,
+                      flags=int(TF.balancing_debit))],
+            TS_BASE + 300)
+        assert r[0].status == TS.created
+        assert oracle.transfers[3].amount == 0
+
+    def test_multiple_balancing_debits_single_credit(self):
+        """reference: :1853 — successive balancing debits drain the same
+        funding credit until headroom is exhausted."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1,
+                 flags=int(AccountFlags.debits_must_not_exceed_credits)),
+            dict(id=2, ledger=1, code=1)])
+        oracle.create_transfers(
+            [Transfer(id=1, debit_account_id=2, credit_account_id=1,
+                      amount=100, ledger=1, code=1)], TS_BASE + 100)
+        amounts = []
+        for k, want in enumerate((40, 40, 20, 0)):
+            r = oracle.create_transfers(
+                [Transfer(id=10 + k, debit_account_id=1,
+                          credit_account_id=2, amount=40, ledger=1, code=1,
+                          flags=int(TF.balancing_debit))],
+                TS_BASE + 200 + k * 100)
+            assert r[0].status == TS.created
+            amounts.append(oracle.transfers[10 + k].amount)
+        assert amounts == [40, 40, 20, 0]
+        assert oracle.accounts[1].debits_posted == 100
+
+    def test_per_transfer_balance_invariant(self):
+        """reference: :1915 — with flags.history, every account_events
+        row carries exact post-event balances; debits-credits invariants
+        hold row by row."""
+        oracle = StateMachineOracle()
+        make_accounts(oracle, [
+            dict(id=1, ledger=1, code=1, flags=int(AccountFlags.history)),
+            dict(id=2, ledger=1, code=1, flags=int(AccountFlags.history))])
+        for k in range(5):
+            r = oracle.create_transfers(
+                [Transfer(id=100 + k, debit_account_id=1,
+                          credit_account_id=2, amount=k + 1,
+                          ledger=1, code=1)], TS_BASE + 100 * (k + 1))
+            assert r[0].status == TS.created
+        running = 0
+        rows = [rec for rec in oracle.account_events
+                if rec.dr_account.id == 1]
+        assert len(rows) == 5
+        for k, rec in enumerate(rows):
+            running += k + 1
+            assert rec.dr_account.debits_posted == running
+            assert rec.cr_account.credits_posted == running
+            assert rec.dr_account.debits_pending == 0

@@ -286,14 +286,18 @@ class Tree:
         if not self.memtable:
             return
         self._drain_flush()  # at most one frozen memtable at a time
+        # Reserve BEFORE the swap: a "grid full" reserve failure must
+        # leave the tree unchanged (a post-swap failure would strand the
+        # frozen rows with no flush job and lose them at the next freeze).
+        entries = sorted(self.memtable.items())
+        reservation = self.grid.reserve(table_block_bound(
+            self.grid, len(entries), self.key_size, self.value_size))
         self.immutable_map = self.memtable
         self.memtable = {}
-        entries = sorted(self.immutable_map.items())
         self._flush = _FlushJob(
             entries=entries,
             snapshot=self.beat,
-            reservation=self.grid.reserve(table_block_bound(
-                self.grid, len(entries), self.key_size, self.value_size)))
+            reservation=reservation)
         self._flush_per_beat = max(
             1, -(-len(self._flush.entries) // (BAR_LENGTH - 1)))
 

@@ -178,6 +178,97 @@ def fuzz_lsm_tree(prng: random.Random, iterations: int) -> None:
         assert dict(tree.scan(lo, hi)) == model
 
 
+def fuzz_manifest_level(prng: random.Random, iterations: int) -> None:
+    """ManifestLevel insert/remove/prune/query interleavings vs a brute-
+    force model (reference: the lsm_manifest_level fuzzer,
+    src/fuzz_tests.zig + src/lsm/manifest_level.zig). Checks the
+    (key range x snapshot range) algebra: visibility at random snapshots,
+    lookup candidate sets and their recency order, and prune timing."""
+    import dataclasses as _dc
+
+    from ..lsm.manifest_level import SNAPSHOT_LATEST, ManifestLevel
+
+    @_dc.dataclass
+    class _FakeInfo:
+        key_min: bytes
+        key_max: bytes
+
+    @_dc.dataclass
+    class _FakeTable:
+        info: _FakeInfo
+        tag: int = 0  # stable identity (id() reuses addresses after GC)
+
+        @property
+        def key_min(self):
+            return self.info.key_min
+
+        @property
+        def key_max(self):
+            return self.info.key_max
+
+    def key(x: int) -> bytes:
+        return x.to_bytes(4, "big")
+
+    for it in range(iterations):
+        keep_sorted = prng.random() < 0.5  # L1+ vs L0 flavor
+        lvl = ManifestLevel(keep_sorted=keep_sorted)
+        # Model: list of [table, smin, smax, seq] in insertion order.
+        model: list = []
+        seq = 0
+        op = 1
+        for _ in range(prng.randrange(20, 120)):
+            op += prng.randrange(1, 4)
+            live_model = [m for m in model if m[2] == SNAPSHOT_LATEST]
+            roll = prng.random()
+            if roll < 0.45 or not live_model:
+                lo = prng.randrange(0, 900)
+                hi = lo + prng.randrange(1, 80)
+                if keep_sorted:
+                    # Disjoint-level contract: avoid overlapping the
+                    # live set (the tree guarantees this for L1+).
+                    busy = [(m[0].info.key_min, m[0].info.key_max)
+                            for m in live_model]
+                    if any(not (key(hi) < a or key(lo) > b)
+                           for a, b in busy):
+                        continue
+                t = _FakeTable(_FakeInfo(key(lo), key(hi)), tag=seq)
+                lvl.insert(t, op)
+                model.append([t, op, SNAPSHOT_LATEST, seq])
+                seq += 1
+            elif roll < 0.75:
+                victim = prng.choice(live_model)
+                lvl.remove(victim[0], op)
+                victim[2] = op
+            else:
+                oldest = op - prng.randrange(0, 64)
+                got = {t.tag for t in lvl.prune(oldest)}
+                want = {m[0].tag for m in model
+                        if m[2] != SNAPSHOT_LATEST and m[2] <= oldest}
+                assert got == want, f"prune mismatch (iter {it})"
+                model = [m for m in model if m[0].tag not in want]
+            # ---- differential queries at random snapshots
+            for snap in (None, op, prng.randrange(1, op + 1)):
+                vis = lvl.visible(snap)
+                if snap is None:
+                    want_ids = [m[0].tag for m in model
+                                if m[2] == SNAPSHOT_LATEST]
+                else:
+                    want_ids = [m[0].tag for m in model
+                                if m[1] <= snap < m[2]]
+                assert {e.table.tag for e in vis} == set(want_ids), \
+                    f"visible mismatch (iter {it}, snap {snap})"
+                k = key(prng.randrange(0, 1000))
+                got_l = lvl.lookup(k, snap)
+                want_l = [m for m in model
+                          if (m[2] == SNAPSHOT_LATEST if snap is None
+                              else m[1] <= snap < m[2])
+                          and m[0].info.key_min <= k <= m[0].info.key_max]
+                want_l.sort(key=lambda m: -m[3])  # newest first
+                assert [t.tag for t in got_l] == \
+                    [m[0].tag for m in want_l], \
+                    f"lookup mismatch (iter {it})"
+
+
 def fuzz_state_machine(prng: random.Random, iterations: int) -> None:
     """Random op batches with bit-edge ints, kernel vs oracle differential
     (reference: state_machine_fuzz — the poison-pill hunt)."""
@@ -509,6 +600,7 @@ FUZZERS: dict[str, Callable[[random.Random, int], None]] = {
     "superblock_quorums": fuzz_superblock_quorums,
     "journal": fuzz_journal,
     "lsm_tree": fuzz_lsm_tree,
+    "lsm_manifest_level": fuzz_manifest_level,
     "state_machine": fuzz_state_machine,
     "client_sessions": fuzz_client_sessions,
     "device_ledger": fuzz_device_ledger,
@@ -522,6 +614,7 @@ DEFAULT_ITERATIONS = {
     "superblock_quorums": 150,
     "journal": 60,
     "lsm_tree": 10,
+    "lsm_manifest_level": 40,
     "state_machine": 60,
     "client_sessions": 80,
     "device_ledger": 30,

@@ -215,6 +215,10 @@ class Replica:
         self.last_heartbeat_rx = 0
         self.last_heartbeat_tx = 0
         self.last_repair_tick = 0
+        # Commit-progress watchdog (send-only-primary liveness).
+        self._progress_commit = 0
+        self._progress_view = 0
+        self._progress_ts = 0
 
     # ------------------------------------------------------------ lifecycle
 
@@ -1745,6 +1749,29 @@ class Replica:
                     and self.state_machine.pulse_needed(self.prepare_timestamp)):
                 self._primary_prepare(Operation.pulse, b"")
         elif self.status == "normal":
+            # Commit-progress watchdog (reference: replica_test.zig:479
+            # "partition primary-all, send-only"): a primary whose SENDS
+            # arrive but who receives nothing keeps heartbeating while
+            # commit stalls — heartbeats alone must not renew its lease
+            # when this replica holds uncommitted prepares that stopped
+            # advancing.
+            if (self.commit_max > self._progress_commit
+                    or self.view > self._progress_view):
+                # Progress, or a fresh view: give the (new) primary a
+                # full window before suspecting it — a stale timer firing
+                # right after an election would depose the new primary
+                # before it can re-replicate the uncommitted suffix.
+                self._progress_commit = self.commit_max
+                self._progress_view = self.view
+                self._progress_ts = now
+            elif self.op <= self.commit_max:
+                self._progress_ts = now  # nothing outstanding: no stall
+            elif (not self.is_standby
+                  and now - self._progress_ts
+                  >= 2 * self.options.view_change_timeout_ns):
+                self._progress_ts = now
+                self._start_view_change(self.view + 1)
+                return
             # Adaptive liveness: the EWMA fault detector may suspect the
             # primary before the hard timeout (reference fault_detector +
             # timeout battery); the hard timeout stays as the ceiling.
