@@ -1,0 +1,123 @@
+"""TCP bus: static message pool + suspend/resume backpressure
+(reference: src/message_pool.zig:107, src/message_bus.zig:1217-1223 —
+overload turns into TCP backpressure on clients, not reply drops)."""
+
+import socket
+import time
+
+from tigerbeetle_tpu.vsr import message_bus as mb
+from tigerbeetle_tpu.vsr.header import Command, Header, Message
+from tigerbeetle_tpu.vsr.message_bus import MessageBus
+
+CLUSTER = 7
+
+
+def _mk_server(on_message):
+    bus = MessageBus(
+        cluster=CLUSTER, on_message=on_message,
+        replica_addresses=[("127.0.0.1", 0)], replica_id=0, listen=True)
+    return bus
+
+
+def _request(client_id: int, request: int, body: bytes = b"") -> bytes:
+    h = Header(command=Command.request, cluster=CLUSTER, client=client_id,
+               request=request, operation=128)
+    return Message(h.finalize(body), body=body).pack()
+
+
+def test_pool_watermark_suspends_and_resumes_client_reads(monkeypatch):
+    """Flood a bus past the pool's high watermark with a client that does
+    not drain its replies: the bus must SUSPEND reading that client (no
+    reply drops), then resume once the client drains below the low
+    watermark."""
+    # Small pool so the test is fast.
+    monkeypatch.setattr(mb, "MESSAGE_POOL_SIZE", 40)
+    monkeypatch.setattr(mb, "POOL_SUSPEND_AT", 30)
+    monkeypatch.setattr(mb, "POOL_RESUME_AT", 15)
+
+    received = []
+    replies: list = []
+    server = _mk_server(lambda m: received.append(m))
+    host, port = server.listen_address
+
+    cli = socket.create_connection((host, port))
+    cli.setblocking(True)
+    # One request identifies the connection as a client peer.
+    cli.sendall(_request(42, 1))
+    deadline = time.time() + 5
+    while not received and time.time() < deadline:
+        server.poll(0.05)
+    assert received, "request did not arrive"
+    conn = server.by_peer[("client", 42)]
+
+    # Big bodies: the queue must exceed kernel socket buffering, or the
+    # flush legitimately drains the pool and resumes.
+    reply_body = b"x" * (512 * 1024)
+    rh = Header(command=Command.reply, cluster=CLUSTER, client=42,
+                request=1, replica=0)
+    reply = Message(rh.finalize(reply_body), body=reply_body)
+    # Queue replies past the high watermark WITHOUT the client reading.
+    for _ in range(35):
+        server.send_to_client(42, reply)
+    server.poll(0.02)  # one flush round: kernel buffers fill, queue stays
+    assert server.dropped_client == 0, "suspension must preempt drops"
+    assert conn.read_suspended, "client reads must suspend at the watermark"
+
+    # While suspended, inbound client bytes are NOT read.
+    cli.sendall(_request(42, 2))
+    for _ in range(10):
+        server.poll(0.02)
+    assert len(received) == 1, "suspended connection must not be read"
+
+    # The client drains: flushes release pool slots and reads resume.
+    cli.setblocking(False)
+    got = 0
+    deadline = time.time() + 10
+    while time.time() < deadline and (conn.read_suspended or got == 0):
+        try:
+            chunk = cli.recv(1 << 20)
+            got += len(chunk)
+        except BlockingIOError:
+            pass
+        server.poll(0.02)
+    assert got > 0
+    assert not conn.read_suspended, "reads must resume below low watermark"
+    # The request sent during suspension is now delivered.
+    deadline = time.time() + 5
+    while len(received) < 2 and time.time() < deadline:
+        try:
+            cli.recv(1 << 20)
+        except BlockingIOError:
+            pass
+        server.poll(0.02)
+    assert len(received) == 2
+    server.close()
+    cli.close()
+
+
+def test_replica_traffic_never_suspended(monkeypatch):
+    """Replica peers are exempt from suspension (VSR liveness rides on
+    them; its delivery contract tolerates drops instead)."""
+    monkeypatch.setattr(mb, "MESSAGE_POOL_SIZE", 8)
+    monkeypatch.setattr(mb, "POOL_SUSPEND_AT", 6)
+    monkeypatch.setattr(mb, "POOL_RESUME_AT", 3)
+
+    received = []
+    server = _mk_server(lambda m: received.append(m))
+    host, port = server.listen_address
+    peer = socket.create_connection((host, port))
+    hello = Header(command=Command.ping, cluster=CLUSTER, replica=2)
+    peer.sendall(Message(hello.finalize()).pack())
+    deadline = time.time() + 5
+    while not received and time.time() < deadline:
+        server.poll(0.05)
+    conn = server.by_peer[("replica", 2)]
+
+    pong = Header(command=Command.pong, cluster=CLUSTER, replica=0)
+    msg = Message(pong.finalize())
+    for _ in range(20):  # far past the tiny pool
+        server.send_to_replica(2, msg)
+    assert not conn.read_suspended
+    assert server.dropped_replica > 0  # drops, never suspension
+    server.close()
+    peer.close()

@@ -75,20 +75,25 @@ RESULT_WIRE = np.dtype({
 
 def transfers_soa_from_bytes(body: bytes) -> dict:
     """128-byte wire records -> the kernel's SoA event dict, one
-    vectorized pass (the u16 wire fields widen to the kernel's u32)."""
+    vectorized pass (the u16 wire fields widen to the kernel's u32).
+
+    The u64/u32 columns are read-only VIEWS into `body` (every consumer —
+    padding, delta capture, object fallback — only reads them; the next
+    copy is the padded kernel input itself, so copying here would double
+    the decode traffic)."""
     rec = np.frombuffer(body, dtype=TRANSFER_WIRE)
     return dict(
-        id_hi=rec["id_hi"].copy(), id_lo=rec["id_lo"].copy(),
-        dr_hi=rec["dr_hi"].copy(), dr_lo=rec["dr_lo"].copy(),
-        cr_hi=rec["cr_hi"].copy(), cr_lo=rec["cr_lo"].copy(),
-        amt_hi=rec["amt_hi"].copy(), amt_lo=rec["amt_lo"].copy(),
-        pid_hi=rec["pid_hi"].copy(), pid_lo=rec["pid_lo"].copy(),
-        ud128_hi=rec["ud128_hi"].copy(), ud128_lo=rec["ud128_lo"].copy(),
-        ud64=rec["ud64"].copy(), ud32=rec["ud32"].copy(),
-        timeout=rec["timeout"].copy(), ledger=rec["ledger"].copy(),
+        id_hi=rec["id_hi"], id_lo=rec["id_lo"],
+        dr_hi=rec["dr_hi"], dr_lo=rec["dr_lo"],
+        cr_hi=rec["cr_hi"], cr_lo=rec["cr_lo"],
+        amt_hi=rec["amt_hi"], amt_lo=rec["amt_lo"],
+        pid_hi=rec["pid_hi"], pid_lo=rec["pid_lo"],
+        ud128_hi=rec["ud128_hi"], ud128_lo=rec["ud128_lo"],
+        ud64=rec["ud64"], ud32=rec["ud32"],
+        timeout=rec["timeout"], ledger=rec["ledger"],
         code=rec["code"].astype(np.uint32),
         flags=rec["flags"].astype(np.uint32),
-        ts=rec["ts"].copy(),
+        ts=rec["ts"],
     )
 
 

@@ -275,8 +275,8 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     create_transfer :3719-3904 minus running-balance effects).
 
     Pure per event given replicated state: this is the SHARDABLE stage of
-    the SPMD kernel. parallel/sharded.py runs it on each device's slice of
-    the batch and all-gathers this compact result; the global tail
+    the SPMD kernel. parallel/full_sharded.py runs it on each device's
+    slice of the batch and all-gathers this compact result; the global tail
     (eligibility reductions, chains, application) then runs replicated on
     every device — identical by determinism, so the replicated state stays
     bit-exact across the mesh.

@@ -3,11 +3,12 @@
 The reference's only multi-node axis is state-machine replication (VSR,
 SURVEY §2.5); its intra-batch axis is the 8190-event hot loop
 (reference: docs/ARCHITECTURE.md:358-362). On TPU the intra-batch axis maps
-to SPMD over a `jax.sharding.Mesh`: events are sharded across devices,
-account-balance deltas are combined with `psum` over ICI, and the account
-cache stays replicated (it is the small, hot working set).
+to SPMD over a `jax.sharding.Mesh`: the FULL create_transfers kernel runs
+sharded — per-event validation on each device's slice of the batch,
+a compact per-event bundle all-gathered over ICI, and the deterministic
+global tail replicated (parallel/full_sharded.py).
 """
 
-from .sharded import make_sharded_validate, sharded_demo_inputs
+from .full_sharded import make_sharded_create_transfers, shard_batch
 
-__all__ = ["make_sharded_validate", "sharded_demo_inputs"]
+__all__ = ["make_sharded_create_transfers", "shard_batch"]

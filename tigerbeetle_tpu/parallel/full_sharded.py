@@ -2,9 +2,7 @@
 
 The multi-chip form of the single-chip fast kernel
 (ops/fast_kernels.py), with FULL semantics — eligibility E1-E7, chains,
-idempotency, two-phase post/void, event-ring snapshots — not the
-order-independent subset (parallel/sharded.py, kept as the lightweight
-skeleton).
+idempotency, two-phase post/void, event-ring snapshots.
 
 Decomposition (reference mapping: the batch axis of
 docs/ARCHITECTURE.md:358-362 sharded over ICI):

@@ -29,13 +29,28 @@ from .header import HEADER_SIZE, Command, Header, Message
 RECV_CHUNK = 256 * 1024
 SEND_BUFFER_MAX = 64 * 1024 * 1024
 
+# Static message pool (reference: src/message_pool.zig:107 — a fixed
+# buffer budget shared by every connection; exhaustion SUSPENDS reads
+# instead of growing memory). Here the pooled resource is queued outbound
+# messages: client reads stop at the high watermark and resume at the low
+# one, so overload turns into TCP backpressure on clients instead of
+# reply drops + retry storms (reference: message_bus suspend/resume,
+# src/message_bus.zig:1217-1223). Replica-to-replica traffic is never
+# suspended — VSR liveness rides on it (its contract tolerates drops).
+MESSAGE_POOL_SIZE = 4096
+POOL_SUSPEND_AT = MESSAGE_POOL_SIZE * 3 // 4
+POOL_RESUME_AT = MESSAGE_POOL_SIZE // 2
+
 
 class _Connection:
     def __init__(self, sock: socket.socket):
         self.sock = sock
         self.rx = bytearray()
         self.tx = bytearray()
+        self.tx_sizes: list[int] = []  # per-message byte sizes (pool acct)
+        self.tx_sent = 0  # bytes sent of tx_sizes[0]
         self.peer: Optional[tuple] = None  # ("replica", i) | ("client", id)
+        self.read_suspended = False
 
     def want_write(self) -> bool:
         return bool(self.tx)
@@ -57,6 +72,10 @@ class MessageBus:
         self.selector = selectors.DefaultSelector()
         self.connections: dict[socket.socket, _Connection] = {}
         self.by_peer: dict[tuple, _Connection] = {}
+        # Pool accounting + drop counters (observable backpressure).
+        self.pool_used = 0
+        self.dropped_replica = 0
+        self.dropped_client = 0
         self.listener: Optional[socket.socket] = None
         if listen:
             assert replica_id is not None
@@ -95,10 +114,46 @@ class MessageBus:
             self._enqueue(conn, msg)
 
     def _enqueue(self, conn: _Connection, msg: Message) -> None:
-        if len(conn.tx) > SEND_BUFFER_MAX:
-            return  # backpressure: drop (peer will retry)
-        conn.tx += msg.pack()
+        if self.pool_used >= MESSAGE_POOL_SIZE or len(conn.tx) > SEND_BUFFER_MAX:
+            # Pool exhausted / peer not draining: drop is the last resort
+            # (the suspend watermarks below make this rare for clients).
+            if conn.peer is not None and conn.peer[0] == "client":
+                self.dropped_client += 1
+            else:
+                self.dropped_replica += 1
+            return
+        raw = msg.pack()
+        conn.tx += raw
+        conn.tx_sizes.append(len(raw))
+        self.pool_used += 1
+        if self.pool_used >= POOL_SUSPEND_AT:
+            self._suspend_client_reads()
+        elif (conn.peer is not None and conn.peer[0] == "client"
+                and not conn.read_suspended
+                and len(conn.tx) > SEND_BUFFER_MAX // 2):
+            # A single slow client: stop reading ITS requests before its
+            # reply queue forces drops (per-connection backpressure).
+            conn.read_suspended = True
         self._update_events(conn)
+
+    def _suspend_client_reads(self) -> None:
+        for conn in self.connections.values():
+            if (not conn.read_suspended and conn.peer is not None
+                    and conn.peer[0] == "client"):
+                conn.read_suspended = True
+                self._update_events(conn)
+
+    def _maybe_resume_reads(self) -> None:
+        if self.pool_used > POOL_RESUME_AT:
+            return
+        for conn in self.connections.values():
+            # Hysteresis on BOTH axes: a per-connection suspension (tx
+            # above half the cap) resumes only once the queue falls back
+            # below that same watermark — resuming at the cap would
+            # oscillate straight into hard drops.
+            if conn.read_suspended and len(conn.tx) <= SEND_BUFFER_MAX // 2:
+                conn.read_suspended = False
+                self._update_events(conn)
 
     def _dial(self, dst: int) -> Optional[_Connection]:
         host, port = self.replica_addresses[dst]
@@ -120,9 +175,12 @@ class MessageBus:
         if self.replica_id is not None:
             # Identify ourselves so the peer can route prepare_oks back
             # (reference: peer handshake via header fields, src/vsr.zig:88-94).
+            # Through _enqueue like any message: the pool accounting reaps
+            # per tx_sizes entry, and an unaccounted prefix would skew it
+            # one message early forever.
             hello = Header(command=Command.ping, cluster=self.cluster,
                            replica=self.replica_id)
-            conn.tx += Message(hello.finalize()).pack()
+            self._enqueue(conn, Message(hello.finalize()))
         return conn
 
     # ------------------------------------------------------------ the loop
@@ -155,11 +213,20 @@ class MessageBus:
                 if sent == 0:
                     break
                 del conn.tx[:sent]
+                self._reap_sent(conn, sent)
         except OSError as e:
             if e.errno not in (errno.EAGAIN, errno.EWOULDBLOCK):
                 self._close(conn)
                 return
+        self._maybe_resume_reads()
         self._update_events(conn)
+
+    def _reap_sent(self, conn: _Connection, sent: int) -> None:
+        """Release pool slots for fully-transmitted messages."""
+        conn.tx_sent += sent
+        while conn.tx_sizes and conn.tx_sent >= conn.tx_sizes[0]:
+            conn.tx_sent -= conn.tx_sizes.pop(0)
+            self.pool_used -= 1
 
     def _drain(self, conn: _Connection) -> None:
         try:
@@ -211,16 +278,32 @@ class MessageBus:
     def _update_events(self, conn: _Connection) -> None:
         if conn.sock not in self.connections:
             return
-        events = selectors.EVENT_READ
+        events = 0 if conn.read_suspended else selectors.EVENT_READ
         if conn.want_write():
             events |= selectors.EVENT_WRITE
         try:
-            self.selector.modify(conn.sock, events, conn)
-        except KeyError:
+            if events:
+                try:
+                    self.selector.modify(conn.sock, events, conn)
+                except KeyError:
+                    self.selector.register(conn.sock, events, conn)
+            else:
+                # selectors cannot watch for "nothing": park the socket
+                # (resume re-registers it).
+                try:
+                    self.selector.unregister(conn.sock)
+                except KeyError:
+                    pass
+        except ValueError:
             pass
 
     def _close(self, conn: _Connection, forget_peer: bool = True) -> None:
+        self.pool_used -= len(conn.tx_sizes)  # unsent slots return
+        conn.tx_sizes = []
         self.connections.pop(conn.sock, None)
+        # Slots released by the close may be what suspended clients were
+        # waiting for — a quiet bus would otherwise never resume them.
+        self._maybe_resume_reads()
         if forget_peer and conn.peer is not None:
             if self.by_peer.get(conn.peer) is conn:
                 del self.by_peer[conn.peer]
