@@ -56,12 +56,18 @@ def test_pool_watermark_suspends_and_resumes_client_reads(monkeypatch):
     rh = Header(command=Command.reply, cluster=CLUSTER, client=42,
                 request=1, replica=0)
     reply = Message(rh.finalize(reply_body), body=reply_body)
-    # Queue replies past the high watermark WITHOUT the client reading.
-    for _ in range(35):
+    # Queue replies up to the high watermark WITHOUT the client reading.
+    for _ in range(30):
         server.send_to_client(42, reply)
-    server.poll(0.02)  # one flush round: kernel buffers fill, queue stays
     assert server.dropped_client == 0, "suspension must preempt drops"
     assert conn.read_suspended, "client reads must suspend at the watermark"
+    # Beyond the watermark, client enqueues drop: the headroom up to
+    # MESSAGE_POOL_SIZE is RESERVED for replica traffic (a wedged client
+    # must never starve consensus messages of pool slots).
+    server.send_to_client(42, reply)
+    assert server.dropped_client == 1
+    server.poll(0.02)  # one flush round: kernel buffers fill, queue stays
+    assert conn.read_suspended
 
     # While suspended, inbound client bytes are NOT read.
     cli.sendall(_request(42, 2))

@@ -238,12 +238,8 @@ def _chain_pass(status, linked, valid, idxs, n, N):
 
 # ================================================== create_transfers (fast)
 
-def _acct_gather(acc, rows, found):
-    """Gather the account fields the kernel needs at `rows` (clamped):
-    three row gathers total (balance limbs + u64/u32 meta matrices)."""
-    g = acc["bal"][rows]
-    g32 = acc["u32"][rows]
-
+def _acct_unpack(g, g32, g_ts, found):
+    """Named account fields from pre-gathered row slices."""
     def field(name):
         i = BAL_IDX[name]
         return _from_limbs(g[:, i], g[:, i + 1], g[:, i + 2], g[:, i + 3])
@@ -257,8 +253,33 @@ def _acct_gather(acc, rows, found):
         ledger=g32[:, AC_U32_IDX["ledger"]],
         code=g32[:, AC_U32_IDX["code"]],
         flags=g32[:, AC_U32_IDX["flags"]],
-        ts=acc["u64"][rows, AC_U64_IDX["ts"]],
+        ts=g_ts,
     )
+
+
+def _acct_gather(acc, rows, found):
+    """Gather the account fields the kernel needs at `rows` (clamped):
+    three row gathers total (balance limbs + u64/u32 meta matrices)."""
+    return _acct_unpack(acc["bal"][rows], acc["u32"][rows],
+                        acc["u64"][rows, AC_U64_IDX["ts"]], found)
+
+
+def _acct_gather_multi(acc, rows_list, found_list):
+    """K account-role gathers as THREE matrix gathers over the
+    concatenated row set (per-dispatch overhead dominates on TPU: 3K
+    gathers -> 3). Returns one named dict per role."""
+    rows = jnp.concatenate(rows_list)
+    g_bal = acc["bal"][rows]
+    g32 = acc["u32"][rows]
+    g_ts = acc["u64"][rows, AC_U64_IDX["ts"]]
+    outs = []
+    off = 0
+    for r, found in zip(rows_list, found_list):
+        n = r.shape[0]
+        outs.append(_acct_unpack(g_bal[off:off + n], g32[off:off + n],
+                                 g_ts[off:off + n], found))
+        off += n
+    return outs
 
 
 def _xfer_gather(xfr, rows):
@@ -266,6 +287,23 @@ def _xfer_gather(xfr, rows):
     returned as a named column dict."""
     return xf_named({"u64": xfr["u64"][rows], "u32": xfr["u32"][rows],
                      "i32": xfr["i32"][rows]})
+
+
+def _xfer_gather_multi(xfr, rows_list):
+    """K transfer-role gathers as three concatenated matrix gathers."""
+    rows = jnp.concatenate(rows_list)
+    g64 = xfr["u64"][rows]
+    g32 = xfr["u32"][rows]
+    g32i = xfr["i32"][rows]
+    outs = []
+    off = 0
+    for r in rows_list:
+        n = r.shape[0]
+        outs.append(xf_named({"u64": g64[off:off + n],
+                              "u32": g32[off:off + n],
+                              "i32": g32i[off:off + n]}))
+        off += n
+    return outs
 
 
 def per_event_status(state, ev, ts_event, return_gathers=False):
@@ -323,12 +361,10 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     e_rowc = jnp.where(e_found, e_row, T_dump)
     p_rowc = jnp.where(p_found, p_row, T_dump)
 
-    dr = _acct_gather(acc, dr_rowc, dr_found)
-    cr = _acct_gather(acc, cr_rowc, cr_found)
-    e = _xfer_gather(xfr, e_rowc)
-    p = _xfer_gather(xfr, p_rowc)
-    p_dr = _acct_gather(acc, p["dr_row"], p_found)
-    p_cr = _acct_gather(acc, p["cr_row"], p_found)
+    e, p = _xfer_gather_multi(xfr, [e_rowc, p_rowc])
+    dr, cr, p_dr, p_cr = _acct_gather_multi(
+        acc, [dr_rowc, cr_rowc, p["dr_row"], p["cr_row"]],
+        [dr_found, cr_found, p_found, p_found])
 
     # Resolved post/void amount (sentinel resolution, reference :4101-4112).
     pv_amt_hi, pv_amt_lo = u128.select(
@@ -498,11 +534,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # SPMD path: re-gather the touched rows locally (cheap O(N)
         # gathers on replicated state; keeps the all-gathered per-event
         # bundle compact).
-        dr = _acct_gather(acc, dr_rowc, dr_found)
-        cr = _acct_gather(acc, cr_rowc, cr_found)
-        p = _xfer_gather(xfr, p_rowc)
-        p_dr = _acct_gather(acc, p["dr_row"], p_found)
-        p_cr = _acct_gather(acc, p["cr_row"], p_found)
+        (p,) = _xfer_gather_multi(xfr, [p_rowc])
+        dr, cr, p_dr, p_cr = _acct_gather_multi(
+            acc, [dr_rowc, cr_rowc, p["dr_row"], p["cr_row"]],
+            [dr_found, cr_found, p_found, p_found])
 
     # ---------------- eligibility ----------------
     hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
@@ -574,10 +609,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
     a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
     l0, l1, l2, l3 = _to_limbs(a_hi, a_lo)
-    s0 = jnp.sum(l0)
-    s1 = jnp.sum(l1)
-    s2 = jnp.sum(l2)
-    s3 = jnp.sum(l3)  # each < 2^45: no u64 overflow
+    # One stacked reduction instead of four (dispatch-count discipline).
+    s0, s1, s2, s3 = jnp.sum(jnp.stack([l0, l1, l2, l3]), axis=1)
     # S as 5 limbs (normalized).
     c = s0 >> jnp.uint64(32); s0 &= _M32
     s1 += c; c = s1 >> jnp.uint64(32); s1 &= _M32
@@ -591,14 +624,16 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # already-overflowing pair sum, or pair-max + S >= 2^128, falls back.
     # Every single-field check is dominated by its pair sum.
     zeros = jnp.zeros_like(ev["amt_hi"])
-    pair_his, pair_los, pair_ovf = [], [], jnp.bool_(False)
+    pair_his, pair_los, pair_ovfs = [], [], []
     for acct_g in (dr, cr, p_dr, p_cr):
         for f1, f2 in (("dp", "dpos"), ("cp", "cpos")):
             h, l, o = u128.add(acct_g[f1][0], acct_g[f1][1],
                                acct_g[f2][0], acct_g[f2][1])
             pair_his.append(jnp.where(valid, h, zeros))
             pair_los.append(jnp.where(valid, l, zeros))
-            pair_ovf = pair_ovf | jnp.any(valid & o)
+            pair_ovfs.append(valid & o)
+    # One stacked any over all eight overflow lanes (was eight reduces).
+    pair_ovf = jnp.any(jnp.stack(pair_ovfs))
     m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
     _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
     e4 = ovf | (s4 > 0) | pair_ovf
@@ -910,10 +945,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         p_row=jnp.where(ap_pv, p_rowc, jnp.int32(-1)),
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
-        dr_flags=acc["u32"][jnp.where(pv, p["dr_row"], dr_rowc),
-                            AC_U32_IDX["flags"]],
-        cr_flags=acc["u32"][jnp.where(pv, p["cr_row"], cr_rowc),
-                            AC_U32_IDX["flags"]],
+        # Effective-side account flags: already gathered in the per-event
+        # stage (dr/cr/p_dr/p_cr) — select, don't re-gather.
+        dr_flags=jnp.where(pv, p_dr["flags"], dr["flags"]),
+        cr_flags=jnp.where(pv, p_cr["flags"], cr["flags"]),
     )
     for sside in ("dr", "cr"):
         for field in ("dp", "dpos", "cp", "cpos"):

@@ -45,18 +45,23 @@ _C4 = np.uint64(0x2545F4914F6CDD1D)
 
 def ht_init(cap: int) -> dict:
     """cap must be a power of two >= 2*SLOTS, sized >= 2x expected live
-    keys; B = cap // SLOTS buckets of SLOTS slots (+ one dump bucket)."""
+    keys; B = cap // SLOTS buckets of SLOTS slots (+ one dump bucket).
+
+    Layout: ONE u64 matrix of (key_hi | key_lo | val) column groups —
+    a bucket probe is a single row gather instead of three (per-dispatch
+    overhead dominates the serving path on TPU; see the cost model in
+    ARCHITECTURE.md)."""
     assert cap & (cap - 1) == 0 and cap >= 2 * SLOTS
     b = cap // SLOTS
     return dict(
-        key_hi=jnp.zeros((b + 1, SLOTS), dtype=jnp.uint64),
-        key_lo=jnp.zeros((b + 1, SLOTS), dtype=jnp.uint64),
-        val=jnp.zeros((b + 1, SLOTS), dtype=jnp.int32),
+        packed=jnp.zeros((b + 1, 3 * SLOTS), dtype=jnp.uint64),
     )
 
 
 def ht_cap(table: dict) -> int:
-    return (table["key_hi"].shape[0] - 1) * SLOTS
+    return (table["packed"].shape[0] - 1) * SLOTS
+
+
 
 
 def _buckets(k_hi, k_lo, b: int):
@@ -70,17 +75,20 @@ def _buckets(k_hi, k_lo, b: int):
 
 
 def _gather_bucket(table, rows):
-    """Rows of all three arrays at `rows`: each (N, SLOTS)."""
-    return (table["key_hi"][rows], table["key_lo"][rows], table["val"][rows])
+    """One packed row gather at `rows`, split into (key_hi, key_lo, val)
+    views of shape (N, SLOTS) each."""
+    g = table["packed"][rows]
+    return (g[:, :SLOTS], g[:, SLOTS:2 * SLOTS],
+            g[:, 2 * SLOTS:].astype(jnp.int32))
 
 
 def ht_lookup(table: dict, k_hi, k_lo):
     """Vectorized lookup. Returns (found: bool[N], val: int32[N]).
 
-    Exactly two bucket gathers per query; keys equal to the sentinel (0)
-    are reported as absent. Absence is definitive: a key can only ever
-    reside in one of its two buckets."""
-    b = table["key_hi"].shape[0] - 1
+    Exactly two bucket gathers per query (ONE packed row each); keys
+    equal to the sentinel (0) are reported as absent. Absence is
+    definitive: a key can only ever reside in one of its two buckets."""
+    b = table["packed"].shape[0] - 1
     querying = ~((k_hi == 0) & (k_lo == 0))
     b1, b2 = _buckets(k_hi, k_lo, b)
     found = jnp.zeros_like(querying)
@@ -140,16 +148,18 @@ def ht_plan(table: dict, k_hi, k_lo, mask):
     Separating plan from write lets callers compute a global commit/abort
     decision first and then apply all writes masked — no state copies for
     the abort path."""
-    b = table["key_hi"].shape[0] - 1
+    b = table["packed"].shape[0] - 1
     n = k_hi.shape[0]
     dump = jnp.int32(b * SLOTS)
     b1, b2 = _buckets(k_hi, k_lo, b)
 
+    g1 = table["packed"][b1]
+    g2 = table["packed"][b2]
     occ1 = jnp.sum(
-        (table["key_hi"][b1] != 0) | (table["key_lo"][b1] != 0), axis=1
+        (g1[:, :SLOTS] != 0) | (g1[:, SLOTS:2 * SLOTS] != 0), axis=1
     ).astype(jnp.int32)
     occ2 = jnp.sum(
-        (table["key_hi"][b2] != 0) | (table["key_lo"][b2] != 0), axis=1
+        (g2[:, :SLOTS] != 0) | (g2[:, SLOTS:2 * SLOTS] != 0), axis=1
     ).astype(jnp.int32)
 
     take1 = occ1 <= occ2
@@ -181,17 +191,23 @@ def ht_plan(table: dict, k_hi, k_lo, mask):
 
 
 def ht_write(table: dict, pos, k_hi, k_lo, vals, mask):
-    """Apply a planned insert: one masked scatter per array (the dump
-    bucket absorbs masked-out lanes)."""
-    b = table["key_hi"].shape[0] - 1
-    shape = table["key_hi"].shape
+    """Apply a planned insert: ONE masked scatter into the packed matrix
+    (the dump bucket absorbs masked-out lanes). `pos` is a flat
+    bucket*SLOTS+slot index; the packed flat index per column group is
+    bucket*(3*SLOTS) + group*SLOTS + slot."""
+    b = table["packed"].shape[0] - 1
+    shape = table["packed"].shape
     flat = shape[0] * shape[1]
     wpos = jnp.where(mask, pos, jnp.int32(b * SLOTS))
-    out = {}
-    for name, v in (("key_hi", k_hi), ("key_lo", k_lo), ("val", vals)):
-        out[name] = (table[name].reshape(flat).at[wpos].set(v)
-                     .reshape(shape))
-    return out
+    bucket = wpos // SLOTS
+    slot = wpos % SLOTS
+    base = bucket * jnp.int32(3 * SLOTS) + slot
+    idx = jnp.concatenate([base, base + jnp.int32(SLOTS),
+                           base + jnp.int32(2 * SLOTS)])
+    val64 = vals.astype(jnp.uint64)
+    data = jnp.concatenate([k_hi, k_lo, val64])
+    packed = table["packed"].reshape(flat).at[idx].set(data).reshape(shape)
+    return {"packed": packed}
 
 
 def ht_insert(table: dict, k_hi, k_lo, vals, mask):
@@ -206,8 +222,9 @@ def ht_insert(table: dict, k_hi, k_lo, vals, mask):
 def ht_live_keys(table: dict):
     """Host helper: (key_hi, key_lo) numpy arrays of all live slots
     (dump bucket excluded)."""
-    kh = np.asarray(table["key_hi"])[:-1].reshape(-1)
-    kl = np.asarray(table["key_lo"])[:-1].reshape(-1)
+    p = np.asarray(table["packed"])[:-1]
+    kh = p[:, :SLOTS].reshape(-1)
+    kl = p[:, SLOTS:2 * SLOTS].reshape(-1)
     live = (kh != 0) | (kl != 0)
     return kh[live], kl[live]
 

@@ -22,6 +22,7 @@ from __future__ import annotations
 import errno
 import selectors
 import socket
+from collections import deque
 from typing import Callable, Optional
 
 from .header import HEADER_SIZE, Command, Header, Message
@@ -47,7 +48,7 @@ class _Connection:
         self.sock = sock
         self.rx = bytearray()
         self.tx = bytearray()
-        self.tx_sizes: list[int] = []  # per-message byte sizes (pool acct)
+        self.tx_sizes: deque = deque()  # per-message byte sizes (pool acct)
         self.tx_sent = 0  # bytes sent of tx_sizes[0]
         self.peer: Optional[tuple] = None  # ("replica", i) | ("client", id)
         self.read_suspended = False
@@ -76,6 +77,9 @@ class MessageBus:
         self.pool_used = 0
         self.dropped_replica = 0
         self.dropped_client = 0
+        # Regime flags: O(1) hot-path checks instead of per-message scans.
+        self._global_suspended = False
+        self._suspended_count = 0
         self.listener: Optional[socket.socket] = None
         if listen:
             assert replica_id is not None
@@ -114,10 +118,15 @@ class MessageBus:
             self._enqueue(conn, msg)
 
     def _enqueue(self, conn: _Connection, msg: Message) -> None:
-        if self.pool_used >= MESSAGE_POOL_SIZE or len(conn.tx) > SEND_BUFFER_MAX:
+        is_client = conn.peer is not None and conn.peer[0] == "client"
+        # Replica traffic may use the FULL pool; client replies stop at
+        # the suspend watermark — wedged clients (connected, never
+        # draining) must not starve consensus messages of slots.
+        budget = POOL_SUSPEND_AT if is_client else MESSAGE_POOL_SIZE
+        if self.pool_used >= budget or len(conn.tx) > SEND_BUFFER_MAX:
             # Pool exhausted / peer not draining: drop is the last resort
             # (the suspend watermarks below make this rare for clients).
-            if conn.peer is not None and conn.peer[0] == "client":
+            if is_client:
                 self.dropped_client += 1
             else:
                 self.dropped_replica += 1
@@ -126,14 +135,15 @@ class MessageBus:
         conn.tx += raw
         conn.tx_sizes.append(len(raw))
         self.pool_used += 1
-        if self.pool_used >= POOL_SUSPEND_AT:
+        if self.pool_used >= POOL_SUSPEND_AT and not self._global_suspended:
+            self._global_suspended = True
             self._suspend_client_reads()
-        elif (conn.peer is not None and conn.peer[0] == "client"
-                and not conn.read_suspended
+        elif (is_client and not conn.read_suspended
                 and len(conn.tx) > SEND_BUFFER_MAX // 2):
             # A single slow client: stop reading ITS requests before its
             # reply queue forces drops (per-connection backpressure).
             conn.read_suspended = True
+            self._suspended_count += 1
         self._update_events(conn)
 
     def _suspend_client_reads(self) -> None:
@@ -141,18 +151,24 @@ class MessageBus:
             if (not conn.read_suspended and conn.peer is not None
                     and conn.peer[0] == "client"):
                 conn.read_suspended = True
+                self._suspended_count += 1
                 self._update_events(conn)
 
     def _maybe_resume_reads(self) -> None:
-        if self.pool_used > POOL_RESUME_AT:
+        if not self._suspended_count:
             return
+        if self._global_suspended:
+            if self.pool_used > POOL_RESUME_AT:
+                return  # the GLOBAL regime holds everyone parked
+            self._global_suspended = False
+        # Per-connection hysteresis: resume once the connection's own
+        # queue falls back below its suspend watermark (the global axis,
+        # once cleared above, must not keep an individually-drained
+        # client parked forever).
         for conn in self.connections.values():
-            # Hysteresis on BOTH axes: a per-connection suspension (tx
-            # above half the cap) resumes only once the queue falls back
-            # below that same watermark — resuming at the cap would
-            # oscillate straight into hard drops.
             if conn.read_suspended and len(conn.tx) <= SEND_BUFFER_MAX // 2:
                 conn.read_suspended = False
+                self._suspended_count -= 1
                 self._update_events(conn)
 
     def _dial(self, dst: int) -> Optional[_Connection]:
@@ -225,7 +241,7 @@ class MessageBus:
         """Release pool slots for fully-transmitted messages."""
         conn.tx_sent += sent
         while conn.tx_sizes and conn.tx_sent >= conn.tx_sizes[0]:
-            conn.tx_sent -= conn.tx_sizes.pop(0)
+            conn.tx_sent -= conn.tx_sizes.popleft()
             self.pool_used -= 1
 
     def _drain(self, conn: _Connection) -> None:
@@ -299,7 +315,10 @@ class MessageBus:
 
     def _close(self, conn: _Connection, forget_peer: bool = True) -> None:
         self.pool_used -= len(conn.tx_sizes)  # unsent slots return
-        conn.tx_sizes = []
+        conn.tx_sizes = deque()
+        if conn.read_suspended:
+            conn.read_suspended = False
+            self._suspended_count -= 1
         self.connections.pop(conn.sock, None)
         # Slots released by the close may be what suspended clients were
         # waiting for — a quiet bus would otherwise never resume them.
