@@ -1016,14 +1016,21 @@ class DeviceLedger:
         oracle's success-path application exactly (oracle/state_machine.py
         _create_transfer :417 and _post_or_void_pending_transfer :639,
         including the _put_account conditions), so mirror state stays
-        value-identical to an oracle run, batch for batch."""
-        import dataclasses
+        value-identical to an oracle run, batch for batch.
+
+        Hot-loop discipline (this is the deferred serving drain):
+        copy.copy + attribute sets instead of dataclasses.replace (which
+        re-runs field introspection per call), raw dict stores with the
+        DirtyDict channels bulk-updated once per chunk, and a single
+        tolist per column."""
+        from copy import copy as _copy
 
         from ..oracle.state_machine import AccountEventRecord
 
         sm = self.mirror
         closed = int(AccountFlags.closed)
         P = TransferPendingStatus
+        _P_BY = {int(m): m for m in P}
 
         # Bulk-convert device columns to Python scalars ONCE (tolist is a
         # single C call; per-element int() on numpy scalars dominates the
@@ -1035,6 +1042,14 @@ class DeviceLedger:
         def u(hi, lo, k):
             return (hi[k] << 64) | lo[k]
 
+        transfers_raw = sm.transfers
+        accounts_raw = sm.accounts
+        pending_raw = sm.pending_status
+        tset = dict.__setitem__
+        touched_xfers: list = []
+        touched_accts: list = []
+        touched_pending: list = []
+        events_append = sm.account_events.append
         for k in range(n_new):
             ts = e["ts"][k]
             tid = u(t["id_hi"], t["id_lo"], k)
@@ -1054,12 +1069,13 @@ class DeviceLedger:
                 timestamp=t["ts"][k],
             )
             assert tr.timestamp == ts, (tr.timestamp, ts)
-            sm.transfers[tid] = tr
+            tset(transfers_raw, tid, tr)
+            touched_xfers.append(tid)
             sm.transfer_by_timestamp[ts] = tid
             self._xfer_row[tid] = t0 + k
             if sm.transfers_key_max is None or ts > sm.transfers_key_max:
                 sm.transfers_key_max = ts
-            pstat = P(e["pstat"][k])
+            pstat = _P_BY[e["pstat"][k]]
             amount = u(e["amt_hi"], e["amt_lo"], k)
             areq = u(e["areq_hi"], e["areq_lo"], k)
             tflags_raw = e["tflags"][k]
@@ -1067,25 +1083,25 @@ class DeviceLedger:
             for side, hik, lok in (("dr", "dr_id_hi", "dr_id_lo"),
                                    ("cr", "cr_id_hi", "cr_id_lo")):
                 aid = u(der[hik], der[lok], k)
-                prev = sm.accounts[aid]
-                new = dataclasses.replace(
-                    prev,
-                    debits_pending=u(e[f"{side}_dp_hi"], e[f"{side}_dp_lo"], k),
-                    debits_posted=u(e[f"{side}_dpos_hi"],
-                                    e[f"{side}_dpos_lo"], k),
-                    credits_pending=u(e[f"{side}_cp_hi"],
-                                      e[f"{side}_cp_lo"], k),
-                    credits_posted=u(e[f"{side}_cpos_hi"],
-                                     e[f"{side}_cpos_lo"], k),
-                    flags=e[f"{side}_flags"][k],
-                )
+                prev = accounts_raw[aid]
+                new = _copy(prev)
+                new.debits_pending = u(e[side + "_dp_hi"],
+                                       e[side + "_dp_lo"], k)
+                new.debits_posted = u(e[side + "_dpos_hi"],
+                                      e[side + "_dpos_lo"], k)
+                new.credits_pending = u(e[side + "_cp_hi"],
+                                        e[side + "_cp_lo"], k)
+                new.credits_posted = u(e[side + "_cpos_hi"],
+                                       e[side + "_cpos_lo"], k)
+                new.flags = e[side + "_flags"][k]
                 sides[side] = (aid, prev, new)
             p_obj = None
             if pstat in (P.posted, P.voided):
                 pts = der["p_ts"][k]
                 pid = sm.transfer_by_timestamp[pts]
-                p_obj = sm.transfers[pid]
-                sm.pending_status[pts] = pstat
+                p_obj = transfers_raw[pid]
+                tset(pending_raw, pts, pstat)
+                touched_pending.append(pts)
                 if p_obj.timeout:
                     expires_at = pts + p_obj.timeout * NS_PER_S
                     if pts in sm.expiry:
@@ -1096,10 +1112,12 @@ class DeviceLedger:
                     aid, prev, new = sides[side]
                     if (amount > 0 or p_obj.amount > 0
                             or (new.flags ^ prev.flags) & closed):
-                        sm.accounts[aid] = new
+                        tset(accounts_raw, aid, new)
+                        touched_accts.append(aid)
             else:
                 if pstat == P.pending:
-                    sm.pending_status[ts] = P.pending
+                    tset(pending_raw, ts, P.pending)
+                    touched_pending.append(ts)
                     if tr.timeout:
                         expires_at = ts + tr.timeout * NS_PER_S
                         sm.expiry[ts] = expires_at
@@ -1108,8 +1126,9 @@ class DeviceLedger:
                 for side in ("dr", "cr"):
                     aid, prev, new = sides[side]
                     if amount > 0 or (new.flags & closed):
-                        sm.accounts[aid] = new
-            sm.account_events.append(AccountEventRecord(
+                        tset(accounts_raw, aid, new)
+                        touched_accts.append(aid)
+            events_append(AccountEventRecord(
                 timestamp=ts,
                 dr_account=sides["dr"][2], cr_account=sides["cr"][2],
                 transfer_flags=(None if tflags_raw == 0xFFFFFFFF
@@ -1118,6 +1137,14 @@ class DeviceLedger:
                 transfer_pending=p_obj,
                 amount_requested=areq, amount=amount))
             sm.commit_timestamp = ts
+        # Bulk dirty-channel updates (raw dict stores above bypassed the
+        # per-key DirtyDict bookkeeping).
+        for container, keys in ((transfers_raw, touched_xfers),
+                                (accounts_raw, touched_accts),
+                                (pending_raw, touched_pending)):
+            container.dirty.update(keys)
+            if container.track_dev:
+                container.dirty_dev.update(keys)
 
     def _apply_fast_delta_accounts(self, st_np) -> None:
         """Write-through: apply one fast account batch to the host mirror
