@@ -1137,14 +1137,14 @@ class DeviceLedger:
                 transfer_pending=p_obj,
                 amount_requested=areq, amount=amount))
             sm.commit_timestamp = ts
-        # Bulk dirty-channel updates (raw dict stores above bypassed the
-        # per-key DirtyDict bookkeeping).
+        # Bulk dirty-channel update for the durable flusher (raw dict
+        # stores above bypassed the per-key DirtyDict bookkeeping). The
+        # device channel is deliberately NOT updated: everything here came
+        # FROM the device, and drain_mirror clears dirty_dev right after.
         for container, keys in ((transfers_raw, touched_xfers),
                                 (accounts_raw, touched_accts),
                                 (pending_raw, touched_pending)):
             container.dirty.update(keys)
-            if container.track_dev:
-                container.dirty_dev.update(keys)
 
     def _apply_fast_delta_accounts(self, st_np) -> None:
         """Write-through: apply one fast account batch to the host mirror
