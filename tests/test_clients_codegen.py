@@ -118,3 +118,52 @@ class TestGeneratedSources:
         for fn in exported - {"tbp_client_packet_free"}:
             assert fn in go_client, fn
             assert fn in addon, fn
+
+
+class TestConformance:
+    """The offline conformance contract (clients/conformance.json) must
+    stay regenerable, self-consistent, and byte-true to types.py."""
+
+    def test_committed_conformance_matches_generator(self):
+        with open(os.path.join(REPO, "clients", "conformance.json")) as f:
+            committed = f.read()
+        assert committed == codegen.generate_conformance()
+
+    def test_struct_vectors_decode_with_types(self):
+        import json
+
+        doc = json.loads(codegen.generate_conformance())
+        for vec in doc["struct_vectors"]:
+            cls = codegen.PY_CLASSES[vec["struct"]]
+            obj = cls.unpack(bytes.fromhex(vec["encoded_hex"]))
+            for field, want in vec["fields"].items():
+                got = getattr(obj, field)
+                assert int(got) == int(want), (vec["struct"], field)
+
+    def test_vector_offsets_agree_with_layout(self):
+        import json
+
+        doc = json.loads(codegen.generate_conformance())
+        layouts = doc["structs"]
+        for vec in doc["struct_vectors"]:
+            raw = bytes.fromhex(vec["encoded_hex"])
+            spec = layouts[vec["struct"]]
+            assert len(raw) == spec["size"]
+            for f in spec["fields"]:
+                if f["kind"].startswith("pad"):
+                    continue
+                want = int(vec["fields"].get(f["name"], 0))
+                got = int.from_bytes(
+                    raw[f["offset"]:f["offset"] + f["size"]], "little")
+                assert got == want, (vec["struct"], f["name"])
+
+    def test_multi_batch_vectors_decode(self):
+        import json
+
+        from tigerbeetle_tpu import multi_batch
+
+        doc = json.loads(codegen.generate_conformance())
+        for vec in doc["multi_batch_vectors"]:
+            payloads = [bytes.fromhex(p) for p in vec["payloads_hex"]]
+            body = bytes.fromhex(vec["encoded_hex"])
+            assert multi_batch.decode(body, vec["element_size"]) == payloads
