@@ -323,7 +323,9 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     row gathers for the single-device caller to reuse (the SPMD path must
     NOT ship them — it re-gathers locally to keep the all-gather
     compact)."""
-    from .hash_table import ht_lookup
+    # TB_PALLAS=1 routes VMEM-admissible probes through the fused Pallas
+    # kernel (ops/pallas_kernels.py); default is the XLA path.
+    from .pallas_kernels import ht_lookup_auto as ht_lookup
 
     acc = state["accounts"]
     xfr = state["transfers"]

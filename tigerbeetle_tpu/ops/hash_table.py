@@ -74,12 +74,18 @@ def _buckets(k_hi, k_lo, b: int):
     return ((h1 & mask).astype(jnp.int32), (h2 & mask).astype(jnp.int32))
 
 
-def _gather_bucket(table, rows):
-    """One packed row gather at `rows`, split into (key_hi, key_lo, val)
-    views of shape (N, SLOTS) each."""
-    g = table["packed"][rows]
-    return (g[:, :SLOTS], g[:, SLOTS:2 * SLOTS],
-            g[:, 2 * SLOTS:].astype(jnp.int32))
+def match_bucket(g, k_hi, k_lo, querying):
+    """Slot match + value select over one gathered packed-row block
+    (N, 3*SLOTS). The ONE source of truth for probe semantics — shared
+    by the XLA lookup and the fused Pallas kernel body."""
+    s_hi = g[:, :SLOTS]
+    s_lo = g[:, SLOTS:2 * SLOTS]
+    s_val = g[:, 2 * SLOTS:].astype(jnp.int32)
+    match = ((s_hi == k_hi[:, None]) & (s_lo == k_lo[:, None])
+             & querying[:, None])
+    hit = jnp.any(match, axis=1)
+    lane_val = jnp.max(jnp.where(match, s_val, jnp.int32(-1)), axis=1)
+    return hit, lane_val
 
 
 def ht_lookup(table: dict, k_hi, k_lo):
@@ -94,11 +100,8 @@ def ht_lookup(table: dict, k_hi, k_lo):
     found = jnp.zeros_like(querying)
     val = jnp.full(k_hi.shape, -1, dtype=jnp.int32)
     for rows in (b1, b2):
-        s_hi, s_lo, s_val = _gather_bucket(table, rows)
-        match = ((s_hi == k_hi[:, None]) & (s_lo == k_lo[:, None])
-                 & querying[:, None])
-        hit = jnp.any(match, axis=1)
-        lane_val = jnp.max(jnp.where(match, s_val, jnp.int32(-1)), axis=1)
+        hit, lane_val = match_bucket(
+            table["packed"][rows], k_hi, k_lo, querying)
         found = found | hit
         val = jnp.where(hit, lane_val, val)
     return found, val

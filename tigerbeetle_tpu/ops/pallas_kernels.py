@@ -1,0 +1,109 @@
+"""Pallas TPU prototypes for the serving kernel's fusion frontier.
+
+PERF.md's path to 10M tps replaces the dominant op groups with
+megakernels. This module holds the first one — the fused two-choice hash
+probe (`ht_lookup_fused`) keeping the packed table VMEM-resident — plus
+the adoption gate. The XLA path stays the default everywhere:
+
+- the cost-model doctrine (ARCHITECTURE.md) demands a REAL-hardware
+  profile before a hand-scheduled kernel replaces XLA's lowering — a
+  Pallas kernel that loses to the native gather path is a regression;
+- VMEM residency bounds applicability: the packed table must fit the
+  ~16 MiB v5e budget (capacity gate below).
+
+Enable with TB_PALLAS=1 to dispatch the fused probe where the gate
+admits it; tests run the kernel in interpreter mode on CPU, so the
+semantics are pinned before the first on-chip window profiles it.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+
+from .hash_table import SLOTS, _buckets, match_bucket
+
+# VMEM working-set budget for the ungridded fused probe (v5e has ~16 MiB
+# per core): packed table + key/bucket inputs + the two gathered
+# (N, 3*SLOTS) row blocks must all fit.
+VMEM_BUDGET_BYTES = 12 * (1 << 20)
+
+
+def pallas_enabled() -> bool:
+    return os.environ.get("TB_PALLAS", "") == "1"
+
+
+def probe_fusable(table: dict, n: int = 8192) -> bool:
+    """Admission gate: the WHOLE working set — table plus this batch's
+    inputs, outputs, and both gathered row blocks — fits VMEM."""
+    packed = table["packed"]
+    table_bytes = packed.size * packed.dtype.itemsize
+    per_event = (
+        8 + 8          # key hi/lo
+        + 4 + 4        # bucket indices
+        + 2 * 3 * SLOTS * 8  # two gathered packed rows
+        + 1 + 4        # found + val outputs
+    )
+    return table_bytes + n * per_event <= VMEM_BUDGET_BYTES
+
+
+def _probe_kernel(khi_ref, klo_ref, b1_ref, b2_ref, table_ref,
+                  found_ref, val_ref):
+    """One fused pass: both bucket gathers + slot match + value select.
+
+    The table rides in VMEM for the whole batch; the per-event work is
+    two row gathers from VMEM plus elementwise lane matching — no HBM
+    round-trips for intermediates (the XLA path materializes each
+    (N, SLOTS) bucket view in HBM). Match semantics come from
+    hash_table.match_bucket — the shared source of truth."""
+    k_hi = khi_ref[:]
+    k_lo = klo_ref[:]
+    querying = ~((k_hi == 0) & (k_lo == 0))
+    found = jnp.zeros(k_hi.shape, dtype=jnp.bool_)
+    val = jnp.full(k_hi.shape, -1, dtype=jnp.int32)
+    for rows_ref in (b1_ref, b2_ref):
+        g = jnp.take(table_ref[:], rows_ref[:], axis=0)
+        hit, lane_val = match_bucket(g, k_hi, k_lo, querying)
+        found = found | hit
+        val = jnp.where(hit, lane_val, val)
+    found_ref[:] = found
+    val_ref[:] = val
+
+
+def ht_lookup_fused(table: dict, k_hi, k_lo, *, interpret: bool = False):
+    """Fused ht_lookup: same contract as hash_table.ht_lookup.
+
+    interpret=True runs the Pallas interpreter (CPU differential tests);
+    on TPU the kernel compiles via Mosaic. Bucket indices are computed
+    OUTSIDE the kernel (cheap elementwise XLA, fuses with the callers'
+    key prep) so the kernel body is pure probe."""
+    from jax.experimental import pallas as pl
+
+    b = table["packed"].shape[0] - 1
+    b1, b2 = _buckets(k_hi, k_lo, b)
+    n = k_hi.shape[0]
+    out_shape = (
+        jax.ShapeDtypeStruct((n,), jnp.bool_),
+        jax.ShapeDtypeStruct((n,), jnp.int32),
+    )
+    return pl.pallas_call(
+        _probe_kernel,
+        out_shape=out_shape,
+        interpret=interpret,
+    )(k_hi, k_lo, b1, b2, table["packed"])
+
+
+def ht_lookup_auto(table: dict, k_hi, k_lo):
+    """Adoption gate: fused probe when enabled + on a TPU backend +
+    VMEM-admissible, else the XLA path (identical results either way —
+    differential-tested). The backend check matters: pallas_call has no
+    CPU/GPU lowering, and TB_PALLAS=1 on a CPU host must degrade to the
+    XLA path, not crash the serving kernel."""
+    from .hash_table import ht_lookup
+
+    if (pallas_enabled() and jax.default_backend() == "tpu"
+            and probe_fusable(table, int(k_hi.shape[0]))):
+        return ht_lookup_fused(table, k_hi, k_lo)
+    return ht_lookup(table, k_hi, k_lo)
