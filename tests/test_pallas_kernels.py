@@ -4,7 +4,6 @@ profiles them — see PERF.md and ops/pallas_kernels.py's adoption gate).
 """
 
 import numpy as np
-import pytest
 
 import jax.numpy as jnp
 

@@ -17,21 +17,25 @@ from typing import Optional
 from .grid import ADDRESS_SIZE, BlockAddress, Grid
 from .tree import Tree
 
-# Per-chain-block header: next address (24) + next block size (4).
+from .schema import BLOCK_HEADER_SIZE, BlockKind, unwrap, wrap
+
+# Per-chain-block header (inside the unified block header,
+# lsm/schema.py): next address (24) + next block size (4).
 # next size == 0 marks the tail.
 CHAIN_HEADER = ADDRESS_SIZE + 4
 
 
 def chain_next(block_raw: bytes) -> Optional[tuple[BlockAddress, int]]:
     """(next address, next size) of a manifest chain block, or None."""
-    (next_size,) = struct.unpack_from("<I", block_raw, ADDRESS_SIZE)
+    inner = unwrap(block_raw, BlockKind.manifest)
+    (next_size,) = struct.unpack_from("<I", inner, ADDRESS_SIZE)
     if next_size == 0:
         return None
-    return BlockAddress.unpack(block_raw[:ADDRESS_SIZE]), next_size
+    return BlockAddress.unpack(inner[:ADDRESS_SIZE]), next_size
 
 
 def chain_payload(block_raw: bytes) -> bytes:
-    return block_raw[CHAIN_HEADER:]
+    return unwrap(block_raw, BlockKind.manifest)[CHAIN_HEADER:]
 
 
 class Forest:
@@ -40,9 +44,12 @@ class Forest:
         (the reference's comptime groove schema)."""
         self.grid = grid
         self.schema = dict(sorted(schema.items()))
+        # Deterministic tree ids (sorted-name order, 1-based; 0 means
+        # standalone) — stamped into every block a tree writes.
         self.trees: dict[str, Tree] = {
-            name: Tree(grid, key_size=k, value_size=v, name=name)
-            for name, (k, v) in self.schema.items()}
+            name: Tree(grid, key_size=k, value_size=v, name=name,
+                       tree_id=i + 1)
+            for i, (name, (k, v)) in enumerate(self.schema.items())}
         self._manifest_chain: list[int] = []  # previous checkpoint's blocks
 
     def compact_beat(self, op=None) -> None:
@@ -70,16 +77,18 @@ class Forest:
             self.grid.release(index)
         # Write the chain tail-first so each block can embed its
         # successor's address.
-        chunk_max = self.grid.block_size - CHAIN_HEADER
+        chunk_max = self.grid.block_size - CHAIN_HEADER - BLOCK_HEADER_SIZE
         chunks = [manifest_blob[off:off + chunk_max]
                   for off in range(0, len(manifest_blob), chunk_max)] or [b""]
         next_address: Optional[BlockAddress] = None
         next_size = 0
         chain: list[int] = []
         for chunk in reversed(chunks):
-            raw = ((next_address.pack() if next_address is not None
-                    else b"\x00" * ADDRESS_SIZE)
-                   + struct.pack("<I", next_size) + chunk)
+            raw = wrap(
+                BlockKind.manifest,
+                (next_address.pack() if next_address is not None
+                 else b"\x00" * ADDRESS_SIZE)
+                + struct.pack("<I", next_size) + chunk)
             next_address = self.grid.write_block(raw)
             next_size = len(raw)
             chain.append(next_address.index)

@@ -14,6 +14,7 @@ import dataclasses
 import struct
 
 from .grid import ADDRESS_SIZE, BlockAddress, Grid
+from .schema import BLOCK_HEADER_SIZE, BlockKind, unwrap, wrap
 
 TOMBSTONE = b"\xff"  # value prefix marking a deletion
 
@@ -56,7 +57,8 @@ class Table:
         self.info = info
         self.key_size = key_size
         self.value_size = value_size
-        raw = grid.read_block(info.index_address, info.index_size)
+        raw = unwrap(grid.read_block(info.index_address, info.index_size),
+                     BlockKind.index)
         (count,) = struct.unpack_from("<I", raw)
         self.block_first_keys: list[bytes] = []
         self.block_addresses: list[BlockAddress] = []
@@ -74,7 +76,9 @@ class Table:
             self.block_first_keys.append(first)
 
     def _block_entries(self, i: int) -> tuple[list[bytes], list[bytes]]:
-        raw = self.grid.read_block(self.block_addresses[i], self.block_sizes[i])
+        raw = unwrap(self.grid.read_block(self.block_addresses[i],
+                                          self.block_sizes[i]),
+                     BlockKind.value)
         (n,) = struct.unpack_from("<I", raw)
         pos = 4
         entry = self.key_size + self.value_size
@@ -104,6 +108,7 @@ class Table:
 
     def get_in_block(self, key: bytes, raw: bytes):
         """Binary-search `key` inside a fetched value block."""
+        raw = unwrap(raw, BlockKind.value)
         (n,) = struct.unpack_from("<I", raw)
         entry = self.key_size + self.value_size
         lo, hi = 0, n
@@ -125,34 +130,43 @@ class Table:
 
 def value_block_entry_max(grid: Grid, key_size: int,
                           value_size: int) -> int:
-    """Entries per value block (u32 count header + packed k||v rows)."""
-    return max(1, (grid.block_size - 4) // (key_size + value_size))
+    """Entries per value block (block header + u32 count + k||v rows)."""
+    return max(1, (grid.block_size - BLOCK_HEADER_SIZE - 4)
+               // (key_size + value_size))
 
 
 def table_entry_max(grid: Grid, key_size: int, value_size: int) -> int:
     """Largest entry count whose index still fits one block (reference:
     tables have a fixed value_count_max per comptime layout)."""
     per_block = value_block_entry_max(grid, key_size, value_size)
-    index_entries_max = (grid.block_size - 4) // (ADDRESS_SIZE + 4 + key_size)
+    index_entries_max = ((grid.block_size - BLOCK_HEADER_SIZE - 4)
+                         // (ADDRESS_SIZE + 4 + key_size))
     return per_block * index_entries_max
 
 
 def write_value_block(grid: Grid, chunk: list[tuple[bytes, bytes]],
-                      reservation=None):
+                      reservation=None, tree_id: int = 0):
     """One value block; returns (address, size, first_key) — the index
     entry triple. The SINGLE encoder for the value-block layout (shared
     by whole-table writes and the incremental memtable flush)."""
-    raw = struct.pack("<I", len(chunk)) + b"".join(k + v for k, v in chunk)
+    raw = wrap(BlockKind.value,
+               struct.pack("<I", len(chunk)) + b"".join(
+                   k + v for k, v in chunk),
+               tree_id=tree_id)
     addr = grid.write_block(raw, reservation=reservation)
     return addr, len(raw), chunk[0][0]
 
 
 def write_index_block(grid: Grid, blocks: list,
-                      reservation=None) -> tuple[BlockAddress, int]:
+                      reservation=None,
+                      tree_id: int = 0) -> tuple[BlockAddress, int]:
     """The table's index block over (address, size, first_key) triples."""
-    index_raw = struct.pack("<I", len(blocks)) + b"".join(
-        addr.pack() + struct.pack("<I", size) + first
-        for addr, size, first in blocks)
+    index_raw = wrap(
+        BlockKind.index,
+        struct.pack("<I", len(blocks)) + b"".join(
+            addr.pack() + struct.pack("<I", size) + first
+            for addr, size, first in blocks),
+        tree_id=tree_id)
     assert len(index_raw) <= grid.block_size, "table too large for one index"
     return grid.write_block(index_raw, reservation=reservation), len(index_raw)
 
@@ -173,27 +187,28 @@ def table_block_bound(grid: Grid, n_entries: int, key_size: int,
 
 def write_tables(grid: Grid, entries: list[tuple[bytes, bytes]],
                  key_size: int, value_size: int,
-                 reservation=None) -> list["TableInfo"]:
+                 reservation=None, tree_id: int = 0) -> list["TableInfo"]:
     """Serialize a sorted run as one or more bounded tables (a single merge
     output may exceed one table's index capacity — split, like the
     reference's compaction emitting multiple output tables)."""
     cap = table_entry_max(grid, key_size, value_size)
     return [write_table(grid, entries[i:i + cap], key_size, value_size,
-                        reservation=reservation)
+                        reservation=reservation, tree_id=tree_id)
             for i in range(0, len(entries), cap)]
 
 
 def write_table(grid: Grid, entries: list[tuple[bytes, bytes]],
                 key_size: int, value_size: int,
-                reservation=None) -> TableInfo:
+                reservation=None, tree_id: int = 0) -> TableInfo:
     """Serialize one sorted run (caller guarantees sort order + unique keys)."""
     assert entries
     per_block = value_block_entry_max(grid, key_size, value_size)
     blocks = [write_value_block(grid, entries[base:base + per_block],
-                                reservation=reservation)
+                                reservation=reservation, tree_id=tree_id)
               for base in range(0, len(entries), per_block)]
     index_addr, index_size = write_index_block(grid, blocks,
-                                               reservation=reservation)
+                                               reservation=reservation,
+                                               tree_id=tree_id)
     return TableInfo(
         index_address=index_addr, index_size=index_size,
         key_min=entries[0][0], key_max=entries[-1][0],

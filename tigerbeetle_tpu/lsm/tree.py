@@ -103,11 +103,14 @@ class _CompactionJob:
 
 class Tree:
     def __init__(self, grid: Grid, *, key_size: int, value_size: int,
-                 name: str = "tree"):
+                 name: str = "tree", tree_id: int = 0):
         self.grid = grid
         self.key_size = key_size
         self.value_size = value_size
         self.name = name
+        # Stamped into every block this tree writes (lsm/schema.py);
+        # 0 = standalone. The forest assigns deterministic ids.
+        self.tree_id = tree_id
         self.memtable: dict[bytes, bytes] = {}
         # Frozen previous memtable: readable while its flush job streams
         # it into level-0 tables across the bar's beats.
@@ -321,7 +324,8 @@ class Tree:
                             (job.pos // cap + 1) * cap)
             chunk = job.entries[job.pos:min(job.pos + per_block, table_end)]
             job.blocks.append(write_value_block(
-                self.grid, chunk, reservation=job.reservation))
+                self.grid, chunk, reservation=job.reservation,
+                tree_id=self.tree_id))
             job.pos += len(chunk)
             if budget is not None:
                 budget -= len(chunk)
@@ -339,7 +343,8 @@ class Tree:
 
     def _finish_flush_table(self, job: _FlushJob, cap: int) -> TableInfo:
         index_addr, index_size = write_index_block(
-            self.grid, job.blocks, reservation=job.reservation)
+            self.grid, job.blocks, reservation=job.reservation,
+            tree_id=self.tree_id)
         first_key = job.blocks[0][2]
         # job.pos sits at this table's end; recover its entry range.
         start = (job.pos - 1) // cap * cap
@@ -437,7 +442,8 @@ class Tree:
             # several disjoint tables (all still inside next_level's range).
             for info in write_tables(self.grid, entries, self.key_size,
                                      self.value_size,
-                                     reservation=job.reservation):
+                                     reservation=job.reservation,
+                                     tree_id=self.tree_id):
                 next_level.insert(Table(
                     self.grid, info, self.key_size, self.value_size),
                     snapshot=self.beat)

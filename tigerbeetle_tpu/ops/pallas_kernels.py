@@ -14,6 +14,11 @@ the adoption gate. The XLA path stays the default everywhere:
 Enable with TB_PALLAS=1 to dispatch the fused probe where the gate
 admits it; tests run the kernel in interpreter mode on CPU, so the
 semantics are pinned before the first on-chip window profiles it.
+
+TB_PALLAS is read at TRACE time: it must be set before the process's
+first kernel dispatch (jit caches bake the chosen branch in). An on-chip
+A/B profile must therefore run each arm in a FRESH process — flipping
+the env var mid-process silently measures the cached arm twice.
 """
 
 from __future__ import annotations

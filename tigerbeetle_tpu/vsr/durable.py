@@ -175,7 +175,9 @@ def index_children(index_raw: bytes, key_size: int) -> list:
     """(BlockAddress, size) of every value block an index block references
     (mirrors lsm.table.Table.__init__'s parse)."""
     from ..lsm.grid import ADDRESS_SIZE, BlockAddress
+    from ..lsm.schema import BlockKind, unwrap
 
+    index_raw = unwrap(index_raw, BlockKind.index)
     (count,) = struct.unpack_from("<I", index_raw)
     out = []
     pos = 4
