@@ -194,3 +194,69 @@ class TestClusterDurability:
             assert ok, cluster.debug_status()
         cluster.settle()
         assert all(r.superblock.op_checkpoint > 0 for r in cluster.replicas)
+
+
+def test_vectorized_column_flush_matches_object_flush():
+    """durable.flush's vectorized transfer path (device-engine columns)
+    must produce byte-identical trees to the object path (oracle engine)
+    over the same commits."""
+    import numpy as np
+
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import StateMachine
+    from tigerbeetle_tpu.types import Operation, TransferFlags
+    from tigerbeetle_tpu.vsr.durable import DurableState
+    from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+    def build(engine):
+        storage = MemoryStorage(TEST_LAYOUT)
+        durable = DurableState(storage)
+        sm = StateMachine(engine=engine, a_cap=1 << 12, t_cap=1 << 14)
+        sm.attach_durable(durable)
+        ts = 1000
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 60)]
+        ts += len(accts) + 10
+        sm.create_accounts(accts, ts)
+        led = sm.led
+        cols = led.take_flush_columns() if led is not None else None
+        durable.flush(sm.state, flush_columns=cols)
+        rng = np.random.default_rng(5)
+        nb = 300
+        next_id = 10**7
+        pend = int(TransferFlags.pending)
+        post = int(TransferFlags.post_pending_transfer)
+        for b in range(3):
+            evs = []
+            for i in range(nb):
+                tid = next_id
+                next_id += 1
+                if b == 2 and i % 5 == 0:
+                    evs.append(Transfer(
+                        id=tid, pending_id=10**7 + nb + i,
+                        amount=(1 << 128) - 1, flags=post))
+                else:
+                    dr = int(rng.integers(1, 60))
+                    cr = dr % 59 + 1
+                    evs.append(Transfer(
+                        id=tid, debit_account_id=dr, credit_account_id=cr,
+                        amount=int(rng.integers(1, 1000)), ledger=1, code=1,
+                        user_data_128=(1 << 100) + i, user_data_64=i % 7,
+                        user_data_32=i % 5,
+                        flags=pend if i % 4 == 0 else 0,
+                        timeout=60 if i % 4 == 0 else 0))
+            payload = b"".join(e.pack() for e in evs)
+            body = multi_batch.encode([payload], 128)
+            ts += nb + 10
+            sm.commit(Operation.create_transfers, body, ts)
+            state = sm.state
+            led = sm.led
+            cols = led.take_flush_columns() if led is not None else None
+            durable.flush(state, flush_columns=cols)
+        return durable
+
+    dev = build("device")
+    ora = build("oracle")
+    for name in dev.forest.trees:
+        t_dev = dev.forest.trees[name]
+        t_ora = ora.forest.trees[name]
+        assert t_dev.memtable == t_ora.memtable, f"tree {name} diverged"

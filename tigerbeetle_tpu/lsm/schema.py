@@ -67,6 +67,8 @@ def classify(raw: bytes):
     magic, kind, version, tree_id, payload_len, _ = _FMT.unpack_from(raw)
     if magic != MAGIC or version != VERSION:
         return None
+    if BLOCK_HEADER_SIZE + payload_len > len(raw):
+        return None  # torn header: length does not fit the block
     try:
         return BlockKind(kind), tree_id, payload_len
     except ValueError:

@@ -340,6 +340,11 @@ class DeviceLedger:
         # as columnar chunks; drain_mirror materializes them into the host
         # mirror's object stores at the next mirror read.
         self._mirror_chunks: list = []
+        # Drained transfer columns retained for the durable flusher's
+        # vectorized path (attach_durable turns this on; the flusher pops
+        # them every commit, so retention is bounded by one bar).
+        self.retain_flush_columns = False
+        self._flush_columns: list = []
         # Device transfer-row count INCLUDING queued chunks (len(_xfer_row)
         # lags it until the next drain).
         self._xfer_rows_dev = 0
@@ -974,6 +979,8 @@ class DeviceLedger:
                 self.mirror.orphaned.add(oid)
             if n_new:
                 self._materialize_delta_transfers(t, e, der, t0, n_new)
+                if self.retain_flush_columns:
+                    self._flush_columns.append((t, n_new))
         self._clear_dirty_dev()
         from .. import constants
 
@@ -1009,6 +1016,12 @@ class DeviceLedger:
         for tid in xfer_ids:
             assert got_t.get(tid) == sm.transfers.get(tid), \
                 f"verify: device/mirror divergence on transfer {tid}"
+
+    def take_flush_columns(self) -> list:
+        """Pop the drained chunks' transfer columns (numpy) for the
+        durable flusher's vectorized index-key path."""
+        cols, self._flush_columns = self._flush_columns, []
+        return cols
 
     def _materialize_delta_transfers(self, t, e, der, t0,
                                      n_new: int) -> None:

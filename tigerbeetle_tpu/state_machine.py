@@ -178,6 +178,9 @@ class StateMachine:
             # transport (recycled after consumption) — history lives in
             # the forest, so ring capacity can never wedge the fast path.
             self.led.recycle_events = True
+            # The durable flusher consumes drained transfer columns
+            # through the vectorized path (durable._flush_transfer_columns).
+            self.led.retain_flush_columns = True
 
     def cache_upsert(self, acct_ids, xfer_ids) -> None:
         """Write-through after a durable flush: refresh cached copies of

@@ -277,13 +277,27 @@ class DurableState:
 
     # ------------------------------------------------------------- writes
 
-    def flush(self, state: StateMachineOracle):
+    def flush(self, state: StateMachineOracle, flush_columns=None):
         """Write every object mutated since the last flush into the trees
         (sorted key order: byte-deterministic across replicas). Returns
         (flushed account ids, flushed transfer ids) so the serving layer
         can write its bounded object caches through (state_machine.py
-        cache_upsert)."""
+        cache_upsert).
+
+        flush_columns: drained device-delta transfer columns
+        (DeviceLedger.take_flush_columns). Transfers covered by them are
+        flushed through the VECTORIZED path — values and index keys built
+        in numpy passes instead of per-object int.to_bytes — and skipped
+        by the object loop. Same puts, same bytes; memtable freeze sorts,
+        so put order cannot affect the on-grid result."""
         trees = self.forest.trees
+        vector_tids: list = []
+        for t_cols, n_new in flush_columns or ():
+            # Filtered by the dirty set: a stale chunk (its transfers
+            # already flushed by an object-path flush, e.g. an interleaved
+            # checkpoint) re-puts nothing.
+            vector_tids.extend(self._flush_transfer_columns(
+                trees, t_cols, n_new, state.transfers.dirty))
         # A dirty key absent from its dict was created then rolled back by a
         # linked-chain scope within one commit — it was never flushed, so
         # skip it (accounts/transfers/pending are never legitimately
@@ -325,6 +339,7 @@ class DurableState:
                 composite_key(a.code, ts, 2), b"\x01")
         acc.dirty.clear()
         xfr = state.transfers
+        xfr.dirty.difference_update(vector_tids)
         flushed_transfers = sorted(t for t in xfr.dirty if t in xfr)
         for tid in flushed_transfers:
             t = xfr[tid]
@@ -404,7 +419,87 @@ class DurableState:
                     & AccountFlags.history):
                 trees["ev_by_prunable"].put(_k8(ets), b"\x01")
         self.events_persisted = state.events_base + len(state.account_events)
-        return flushed_accounts, flushed_transfers
+        return flushed_accounts, flushed_transfers + vector_tids
+
+    def _flush_transfer_columns(self, trees, t, n: int, dirty: set) -> list:
+        """Vectorized transfer flush from drained device columns: value
+        bytes and every index key built in whole-column numpy passes; the
+        per-row Python work is the memtable puts themselves. Returns the
+        flushed transfer ids. Bit-identical to the object path (the wire
+        codec IS the object pack format)."""
+        import numpy as np
+
+        from ..ops.batch import TRANSFER_WIRE
+        from ..types import TransferFlags as TF
+
+        hard = int(TF.imported | TF.closing_debit | TF.closing_credit)
+        flags = t["flags"][:n]
+        assert not np.any(flags & np.uint32(hard)), \
+            "hard-flag transfers never come from the fast path"
+
+        rec = np.zeros(n, dtype=TRANSFER_WIRE)
+        for f in ("id_lo", "id_hi", "dr_lo", "dr_hi", "cr_lo", "cr_hi",
+                  "amt_lo", "amt_hi", "pid_lo", "pid_hi",
+                  "ud128_lo", "ud128_hi", "ud64", "ud32", "timeout", "ts"):
+            rec[f] = t[f][:n]
+        rec["ledger"] = t["ledger"][:n]
+        rec["code"] = t["code"][:n].astype(np.uint16)
+        rec["flags"] = flags.astype(np.uint16)
+        valb = rec.tobytes()
+
+        def be(*cols):
+            return np.ascontiguousarray(
+                np.stack([c[:n] for c in cols], axis=1).astype(">u8")
+            ).tobytes()
+
+        ts = t["ts"]
+        idb = be(t["id_hi"], t["id_lo"])                      # 16B rows
+        ts8 = be(ts)                                          # 8B rows
+        drk = be(t["dr_hi"], t["dr_lo"], ts)                  # 24B rows
+        crk = be(t["cr_hi"], t["cr_lo"], ts)
+        pidk = be(t["pid_hi"], t["pid_lo"], ts)
+        ud128k = be(t["ud128_hi"], t["ud128_lo"], ts)
+        amtk = be(t["amt_hi"], t["amt_lo"], ts)
+        ud64k = be(t["ud64"], ts)
+        ud32p = np.ascontiguousarray(t["ud32"][:n].astype(">u4")).tobytes()
+        ledp = np.ascontiguousarray(t["ledger"][:n].astype(">u4")).tobytes()
+        codep = np.ascontiguousarray(
+            t["code"][:n].astype(np.uint16).astype(">u2")).tobytes()
+        pid_live = ((t["pid_hi"][:n] != 0) | (t["pid_lo"][:n] != 0)).tolist()
+
+        put_obj = trees["transfers"].put
+        put_ts = trees["xfer_by_ts"].put
+        put_dr = trees["xfer_by_dr"].put
+        put_cr = trees["xfer_by_cr"].put
+        put_pid = trees["xfer_by_pid"].put
+        put_ud128 = trees["xfer_by_ud128"].put
+        put_ud64 = trees["xfer_by_ud64"].put
+        put_ud32 = trees["xfer_by_ud32"].put
+        put_led = trees["xfer_by_ledger"].put
+        put_code = trees["xfer_by_code"].put
+        put_amt = trees["xfer_by_amount"].put
+        ONE = b"\x01"
+        tids = []
+        for i in range(n):
+            k16 = idb[16 * i:16 * i + 16]
+            t8 = ts8[8 * i:8 * i + 8]
+            tid = int.from_bytes(k16, "big")
+            if tid not in dirty:
+                continue  # stale chunk: already flushed elsewhere
+            tids.append(tid)
+            put_obj(k16, valb[128 * i:128 * i + 128])
+            put_ts(t8, k16)
+            put_dr(drk[24 * i:24 * i + 24], ONE)
+            put_cr(crk[24 * i:24 * i + 24], ONE)
+            if pid_live[i]:
+                put_pid(pidk[24 * i:24 * i + 24], ONE)
+            put_ud128(ud128k[24 * i:24 * i + 24], ONE)
+            put_ud64(ud64k[16 * i:16 * i + 16], ONE)
+            put_ud32(ud32p[4 * i:4 * i + 4] + t8, ONE)
+            put_led(ledp[4 * i:4 * i + 4] + t8, ONE)
+            put_code(codep[2 * i:2 * i + 2] + t8, ONE)
+            put_amt(amtk[24 * i:24 * i + 24], ONE)
+        return tids
 
     def prune_events(self, before_ts: int) -> int:
         """Delete prunable (no-history) event rows older than `before_ts`
@@ -442,13 +537,14 @@ class DurableState:
     def compact_beat(self, op: int) -> None:
         self.forest.compact_beat(op)
 
-    def checkpoint(self, state: StateMachineOracle) -> bytes:
+    def checkpoint(self, state: StateMachineOracle,
+                   flush_columns=None) -> bytes:
         """Flush + forest checkpoint; returns the root blob to persist.
         The 40 scalar bytes (key maxes, pulse, commit timestamp, event
         count) ride in the root blob itself — they are only ever read at
         restore, so they don't belong in a tree (reference analog: the
         superblock's VSRState vs the checkpoint trailer)."""
-        self.flush(state)
+        self.flush(state, flush_columns=flush_columns)
         meta = struct.pack(
             "<QQQQQ",
             state.accounts_key_max or 0, state.transfers_key_max or 0,

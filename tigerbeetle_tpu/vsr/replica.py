@@ -782,7 +782,12 @@ class Replica:
         self.commit_min = h.op
         # Write-through to the LSM forest + one deterministic compaction
         # beat (reference: commit_compact, one beat per op — §3.4).
-        flushed = self.durable.flush(self.state_machine.state)
+        state = self.state_machine.state  # drains the device mirror first
+        led = self.state_machine.led
+        flushed = self.durable.flush(
+            state,
+            flush_columns=(led.take_flush_columns()
+                           if led is not None else None))
         self.state_machine.cache_upsert(*flushed)
         self.durable.compact_beat(h.op)
         if h.client:
@@ -837,7 +842,12 @@ class Replica:
                         f"verify: journal chain break at op {op}"
                 prev = m.header.checksum
         sessions_blob = self.sessions.pack()
-        root = (self.durable.checkpoint(self.state_machine.state)
+        ckpt_state = self.state_machine.state  # drains the mirror first
+        led = self.state_machine.led
+        root = (self.durable.checkpoint(
+                    ckpt_state,
+                    flush_columns=(led.take_flush_columns()
+                                   if led is not None else None))
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
         assert len(root) <= self.storage.layout.snapshot_size_max, \
             "checkpoint root exceeds slot (raise snapshot_size_max)"
