@@ -196,6 +196,24 @@ class TestClusterDurability:
         assert all(r.superblock.op_checkpoint > 0 for r in cluster.replicas)
 
 
+def _policy_flush(sm, durable):
+    """The replica's flush policy (vsr/replica.py): columns against a
+    quiescent mirror, else drain + object path."""
+    led = sm.led
+    cols = led.take_flush_columns() if led is not None else None
+    raw = sm.raw_state
+    if cols and (raw.accounts.dirty or raw.transfers.dirty
+                 or raw.pending_status.dirty or raw.expiry.dirty
+                 or raw.orphaned.dirty
+                 or durable.events_persisted < (
+                     raw.events_base + len(raw.account_events))):
+        sm.state  # drain
+        cols = None
+    flushed = durable.flush(raw, flush_columns=cols)
+    sm.cache_upsert(*flushed)
+    return flushed
+
+
 def test_vectorized_column_flush_matches_object_flush():
     """durable.flush's vectorized transfer path (device-engine columns)
     must produce byte-identical trees to the object path (oracle engine)
@@ -217,9 +235,7 @@ def test_vectorized_column_flush_matches_object_flush():
         accts = [Account(id=i, ledger=1, code=1) for i in range(1, 60)]
         ts += len(accts) + 10
         sm.create_accounts(accts, ts)
-        led = sm.led
-        cols = led.take_flush_columns() if led is not None else None
-        durable.flush(sm.state, flush_columns=cols)
+        _policy_flush(sm, durable)
         rng = np.random.default_rng(5)
         nb = 300
         next_id = 10**7
@@ -248,10 +264,7 @@ def test_vectorized_column_flush_matches_object_flush():
             body = multi_batch.encode([payload], 128)
             ts += nb + 10
             sm.commit(Operation.create_transfers, body, ts)
-            state = sm.state
-            led = sm.led
-            cols = led.take_flush_columns() if led is not None else None
-            durable.flush(state, flush_columns=cols)
+            _policy_flush(sm, durable)
         return durable
 
     dev = build("device")
@@ -260,3 +273,100 @@ def test_vectorized_column_flush_matches_object_flush():
         t_dev = dev.forest.trees[name]
         t_ora = ora.forest.trees[name]
         assert t_dev.memtable == t_ora.memtable, f"tree {name} diverged"
+
+
+def test_column_flush_hard_batch_interleave_matches_oracle():
+    """The hard-regime handoff (review scenario): a closing transfer runs
+    on the mirror between fast-path chunks; the policy flush must drain
+    and serialize through ONE authority — trees must match the oracle
+    twin exactly across the handoff."""
+    import numpy as np
+
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import StateMachine
+    from tigerbeetle_tpu.types import Operation, TransferFlags
+    from tigerbeetle_tpu.vsr.durable import DurableState
+    from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+    def build(engine):
+        storage = MemoryStorage(TEST_LAYOUT)
+        durable = DurableState(storage)
+        sm = StateMachine(engine=engine, a_cap=1 << 12, t_cap=1 << 14)
+        sm.attach_durable(durable)
+        ts = 1000
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 40)]
+        ts += len(accts) + 10
+        sm.create_accounts(accts, ts)
+        _policy_flush(sm, durable)
+        rng = np.random.default_rng(9)
+        next_id = 10**7
+
+        def commit(evs):
+            nonlocal ts
+            payload = b"".join(e.pack() for e in evs)
+            ts += len(evs) + 10
+            sm.commit(Operation.create_transfers,
+                      multi_batch.encode([payload], 128), ts)
+            _policy_flush(sm, durable)
+
+        def fast_batch(n):
+            nonlocal next_id
+            evs = []
+            for i in range(n):
+                dr = int(rng.integers(1, 40))
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=dr,
+                    credit_account_id=dr % 39 + 1,
+                    amount=int(rng.integers(1, 100)), ledger=1, code=1))
+                next_id += 1
+            commit(evs)
+
+        fast_batch(50)
+        # HARD batch: closing flags route to the mirror (hard regime).
+        commit([Transfer(id=next_id, debit_account_id=5,
+                         credit_account_id=6, amount=1, ledger=1, code=1,
+                         flags=int(TransferFlags.closing_debit
+                                   | TransferFlags.pending))])
+        next_id += 1
+        # Fast batches again (regime probe -> fast path resumes).
+        for _ in range(10):
+            fast_batch(20)
+        return durable
+
+    dev = build("device")
+    ora = build("oracle")
+    for name in dev.forest.trees:
+        assert dev.forest.trees[name].memtable == \
+            ora.forest.trees[name].memtable, f"tree {name} diverged"
+
+
+def test_cache_invalidated_after_column_flush():
+    """Review scenario: a cached account must never serve its pre-chunk
+    balance after a column-path flush (cache invalidation contract)."""
+    import numpy as np
+
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import StateMachine
+    from tigerbeetle_tpu.types import Operation
+    from tigerbeetle_tpu.vsr.durable import DurableState
+    from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+
+    storage = MemoryStorage(TEST_LAYOUT)
+    durable = DurableState(storage)
+    sm = StateMachine(engine="device", a_cap=1 << 12, t_cap=1 << 14)
+    sm.attach_durable(durable)
+    ts = 1000
+    sm.create_accounts([Account(id=1, ledger=1, code=1),
+                        Account(id=2, ledger=1, code=1)], ts)
+    _policy_flush(sm, durable)
+    got = sm.lookup_accounts([1])  # caches account 1 (balance 0)
+    assert got and got[0].debits_posted == 0
+    payload = Transfer(id=10, debit_account_id=1, credit_account_id=2,
+                       amount=77, ledger=1, code=1).pack()
+    ts += 20
+    sm.commit(Operation.create_transfers,
+              multi_batch.encode([payload], 128), ts)
+    _policy_flush(sm, durable)
+    got = sm.lookup_accounts([1])
+    assert got and got[0].debits_posted == 77, \
+        "stale cached balance after column flush"

@@ -87,7 +87,12 @@ def cluster_processes(tmp_path):
     ports = _free_ports(3)
     addresses = ",".join(f"127.0.0.1:{p}" for p in ports)
     procs = []
-    env = dict(os.environ)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # Strip the axon hook trigger: with it set, sitecustomize imports jax
+    # at INTERPRETER STARTUP in every child (tens of seconds under load),
+    # racing every boot/shutdown timeout in this fixture (same discipline
+    # as bench.py _pinned_env).
+    env.pop("PALLAS_AXON_POOL_IPS", None)
     try:
         for i in range(3):
             path = tmp_path / f"r{i}.tigerbeetle"
@@ -97,12 +102,18 @@ def cluster_processes(tmp_path):
                  "--small", str(path)],
                 check=True, cwd="/root/repo", env=env, timeout=60,
                 stdout=subprocess.DEVNULL)
+            # Server output goes to a FILE, not an unread pipe: a chatty
+            # replica (e.g. repair warnings after its peers die) would
+            # fill a 64 KiB pipe and then block at exit-time log flush —
+            # the shutdown would hang on our own capture.
+            log = open(tmp_path / f"r{i}.log", "wb")
             procs.append(subprocess.Popen(
                 [sys.executable, "-m", "tigerbeetle_tpu", "start",
                  f"--addresses={addresses}", f"--replica={i}", "--cluster=7",
                  "--engine=oracle", "--small", str(path)],
                 cwd="/root/repo", env=env,
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
+                stdout=log, stderr=subprocess.STDOUT))
+            log.close()
         yield addresses, procs, tmp_path
     finally:
         for p in procs:

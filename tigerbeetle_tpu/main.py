@@ -118,15 +118,28 @@ def cmd_start(args) -> int:
           f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
           f"(cluster={args.cluster}, engine={args.engine})", flush=True)
     # The reference main loop: tick + io.run_for_ns
-    # (src/tigerbeetle/main.zig:522-525).
+    # (src/tigerbeetle/main.zig:522-525). Shutdown rides a signal FLAG,
+    # not KeyboardInterrupt: a SIGINT delivered while the interpreter is
+    # inside a C callback (e.g. JAX's gc hook) raises there and is
+    # swallowed as "exception ignored in callback" — the loop would
+    # never see it and the server would ignore the shutdown.
+    import signal as _signal
+
+    stop = []
+    prev_int = _signal.signal(_signal.SIGINT, lambda *_: stop.append(1))
+    prev_term = _signal.signal(_signal.SIGTERM, lambda *_: stop.append(1))
     try:
-        while True:
+        while not stop:
             bus.poll(0.01)
             replica.tick()
     except KeyboardInterrupt:
-        if tracer is not None and args.trace:
-            tracer.dump_chrome_trace(args.trace)
-        return 0
+        pass  # belt and braces: a late-registered handler race
+    finally:
+        _signal.signal(_signal.SIGINT, prev_int)
+        _signal.signal(_signal.SIGTERM, prev_term)
+    if tracer is not None and args.trace:
+        tracer.dump_chrome_trace(args.trace)
+    return 0
 
 
 def cmd_repl(args) -> int:

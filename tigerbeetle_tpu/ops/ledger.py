@@ -956,10 +956,23 @@ class DeviceLedger:
             if orphan_ids:
                 self._mirror_chunks.append((None, None, None, 0, 0,
                                             orphan_ids))
+                if self.retain_flush_columns:
+                    self._flush_columns.append(
+                        (None, None, None, 0, self._events_seen_abs,
+                         orphan_ids))
             self._clear_dirty_dev()
             return
         t, e, der, t0 = self._xfer_delta_fetch(n_new)
         self._mirror_chunks.append((t, e, der, t0, n_new, orphan_ids))
+        if self.retain_flush_columns:
+            # The durable flusher consumes these columns directly (the
+            # vectorized flush path) — retained at CAPTURE, so flushing
+            # does not require materializing the mirror first. abs_start
+            # is the chunk's absolute event index (the flusher's
+            # double-flush watermark); orphan ids ride along so the
+            # orphaned tree stays in lockstep without a drain.
+            self._flush_columns.append(
+                (t, e, der, n_new, self._events_seen_abs, orphan_ids))
         self._xfer_rows_dev += n_new
         self._events_pushed += n_new
         self._events_seen_abs += n_new
@@ -979,8 +992,6 @@ class DeviceLedger:
                 self.mirror.orphaned.add(oid)
             if n_new:
                 self._materialize_delta_transfers(t, e, der, t0, n_new)
-                if self.retain_flush_columns:
-                    self._flush_columns.append((t, n_new))
         self._clear_dirty_dev()
         from .. import constants
 

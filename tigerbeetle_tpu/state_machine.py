@@ -183,10 +183,19 @@ class StateMachine:
             self.led.retain_flush_columns = True
 
     def cache_upsert(self, acct_ids, xfer_ids) -> None:
-        """Write-through after a durable flush: refresh cached copies of
-        every object the flush wrote (the groove cache-update-at-commit
-        discipline — reads never need invalidation)."""
+        """Cache coherence after a durable flush. Device engine: the
+        flush consumed device delta COLUMNS (no mirror objects exist
+        yet), so drop the flushed ids — the next read misses into the
+        just-written trees, and the mirror drain stays deferred. Other
+        engines: refresh cached copies from the state (the groove
+        cache-update-at-commit discipline)."""
         if self._fq is None:
+            return
+        if self.led is not None:
+            for aid in acct_ids:
+                self._acct_cache.remove(aid)
+            for tid in xfer_ids:
+                self._xfer_cache.remove(tid)
             return
         for aid in acct_ids:
             a = self.state.accounts.get(aid)
@@ -206,6 +215,15 @@ class StateMachine:
         # draining here keeps the mirror exact at every read boundary.
         if self.led is not None:
             self.led.drain_mirror()
+        return self._state
+
+    @property
+    def raw_state(self) -> StateMachineOracle:
+        """The state WITHOUT draining the deferred device mirror. For the
+        durable flush only: it consumes the device delta columns directly
+        (durable._flush_*_columns), so forcing a per-commit object
+        materialization here would throw the deferral away. Any
+        object-level READER must use `state`."""
         return self._state
 
     @state.setter

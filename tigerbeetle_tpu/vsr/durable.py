@@ -292,12 +292,40 @@ class DurableState:
         so put order cannot affect the on-grid result."""
         trees = self.forest.trees
         vector_tids: list = []
-        for t_cols, n_new in flush_columns or ():
-            # Filtered by the dirty set: a stale chunk (its transfers
-            # already flushed by an object-path flush, e.g. an interleaved
-            # checkpoint) re-puts nothing.
+        vector_aids: list = []
+        if flush_columns:
+            # Contract: the column path is only valid against a QUIESCENT
+            # mirror — interleaved mirror writes (hard-regime handoffs,
+            # account creations, expiries) carry ordering the two paths
+            # cannot merge; the caller must drain and flush the object
+            # path instead (vsr/replica.py does exactly that).
+            assert not (state.accounts.dirty or state.transfers.dirty
+                        or state.pending_status.dirty or state.expiry.dirty
+                        or state.orphaned.dirty), \
+                "column flush with a dirty mirror: drain first"
+            assert self.events_persisted >= (
+                state.events_base + len(state.account_events)), \
+                "column flush with unpersisted mirror events: drain first"
+        for (t_cols, e_cols, der_cols, n_new, abs_start,
+             orphan_ids) in flush_columns or ():
+            # Orphan puts are idempotent: flushed even for zero-create
+            # chunks (transient failures poison ids without creating).
+            for oid in orphan_ids:
+                trees["orphaned"].put(_k16(oid), b"\x01")
+            if n_new == 0:
+                continue
+            if abs_start + n_new <= self.events_persisted:
+                # Stale chunk: an object-path flush (after a mirror
+                # drain) already covered it — every put would be a
+                # re-put of identical bytes.
+                continue
+            assert abs_start >= self.events_persisted, \
+                "flush chunks must arrive whole and in order"
             vector_tids.extend(self._flush_transfer_columns(
-                trees, t_cols, n_new, state.transfers.dirty))
+                trees, t_cols, n_new))
+            vector_aids.extend(self._flush_side_columns(
+                trees, t_cols, e_cols, der_cols, n_new))
+            self.events_persisted = abs_start + n_new
         # A dirty key absent from its dict was created then rolled back by a
         # linked-chain scope within one commit — it was never flushed, so
         # skip it (accounts/transfers/pending are never legitimately
@@ -418,10 +446,15 @@ class DurableState:
             if not ((rec.dr_account.flags | rec.cr_account.flags)
                     & AccountFlags.history):
                 trees["ev_by_prunable"].put(_k8(ets), b"\x01")
-        self.events_persisted = state.events_base + len(state.account_events)
-        return flushed_accounts, flushed_transfers + vector_tids
+        # max(): with the drain deferred, the mirror's event list lags the
+        # column watermark — never rewind it.
+        self.events_persisted = max(
+            self.events_persisted,
+            state.events_base + len(state.account_events))
+        return (flushed_accounts + vector_aids,
+                flushed_transfers + vector_tids)
 
-    def _flush_transfer_columns(self, trees, t, n: int, dirty: set) -> list:
+    def _flush_transfer_columns(self, trees, t, n: int) -> list:
         """Vectorized transfer flush from drained device columns: value
         bytes and every index key built in whole-column numpy passes; the
         per-row Python work is the memtable puts themselves. Returns the
@@ -483,10 +516,7 @@ class DurableState:
         for i in range(n):
             k16 = idb[16 * i:16 * i + 16]
             t8 = ts8[8 * i:8 * i + 8]
-            tid = int.from_bytes(k16, "big")
-            if tid not in dirty:
-                continue  # stale chunk: already flushed elsewhere
-            tids.append(tid)
+            tids.append(int.from_bytes(k16, "big"))
             put_obj(k16, valb[128 * i:128 * i + 128])
             put_ts(t8, k16)
             put_dr(drk[24 * i:24 * i + 24], ONE)
@@ -500,6 +530,141 @@ class DurableState:
             put_code(codep[2 * i:2 * i + 2] + t8, ONE)
             put_amt(amtk[24 * i:24 * i + 24], ONE)
         return tids
+
+    def _flush_side_columns(self, trees, t, e, der, n: int) -> None:
+        """Vectorized flush of one chunk's NON-transfer effects: the
+        account_events rows (+ their index trees), the touched accounts'
+        object rows, and the pending/expiry trees — all from device delta
+        columns, so the flush does not require materializing the mirror.
+
+        Immutable account metadata (user_data/ledger/code/timestamp) is
+        spliced from the account's PREVIOUS tree value (the fast path
+        never mutates it — closing/imported are hard flags); per-event
+        balances come from the event columns. Byte-identical to the
+        object path (oracle-exact snapshots either way)."""
+        import numpy as np
+
+        from ..types import AccountFlags as AF
+        from ..types import TransferFlags as TF
+
+        hist = int(AF.history)
+
+        def le(*cols):
+            return np.ascontiguousarray(
+                np.stack([c[:n] for c in cols], axis=1).astype("<u8")
+            ).tobytes()
+
+        ets8 = np.ascontiguousarray(t["ts"][:n].astype(">u8")).tobytes()
+        amt16 = le(e["amt_lo"], e["amt_hi"])
+        areq16 = le(e["areq_lo"], e["areq_hi"])
+        # Per-side account front half (id + four balances, wire LE).
+        fronts = {}
+        for side, idh, idl in (("dr", "dr_id_hi", "dr_id_lo"),
+                               ("cr", "cr_id_hi", "cr_id_lo")):
+            fronts[side] = le(
+                der[idl], der[idh],
+                e[f"{side}_dp_lo"], e[f"{side}_dp_hi"],
+                e[f"{side}_dpos_lo"], e[f"{side}_dpos_hi"],
+                e[f"{side}_cp_lo"], e[f"{side}_cp_hi"],
+                e[f"{side}_cpos_lo"], e[f"{side}_cpos_hi"])
+        flags2 = {
+            side: np.ascontiguousarray(
+                e[f"{side}_flags"][:n].astype("<u2")).tobytes()
+            for side in ("dr", "cr")}
+        idbe = {
+            side: np.ascontiguousarray(np.stack(
+                [der[f"{side}_id_hi"][:n], der[f"{side}_id_lo"][:n]],
+                axis=1).astype(">u8")).tobytes()
+            for side in ("dr", "cr")}
+        pstat_l = e["pstat"][:n].tolist()
+        p_row_l = e["p_row"][:n].tolist()
+        tflags_l = e["tflags"][:n].tolist()
+        side_flags_l = {side: e[f"{side}_flags"][:n].tolist()
+                        for side in ("dr", "cr")}
+        p_ts_l = der["p_ts"][:n].tolist()
+        timeout_l = t["timeout"][:n].tolist()
+        expires_l = t["expires"][:n].tolist()
+        ts_l = t["ts"][:n].tolist()
+
+        acct_tree = trees["accounts"]
+        xfer_tree = trees["transfers"]
+        by_ts = trees["xfer_by_ts"]
+        put_ev = trees["events"].put
+        put_ev_acct = trees["ev_by_acct_ts"].put
+        put_ev_pstat = trees["ev_by_pstat"].put
+        put_ev_prun = trees["ev_by_prunable"].put
+        put_pending = trees["pending"].put
+        put_expiry = trees["expiry"].put
+        rm_expiry = trees["expiry"].remove
+        ONE = b"\x01"
+        meta_cache: dict = {}  # acct key16be -> (meta bytes, ts_be8)
+        p_cache: dict = {}  # p_ts -> pending transfer value bytes
+        acct_last: dict = {}  # acct key16be -> final account value bytes
+
+        def acct_meta(k16):
+            got = meta_cache.get(k16)
+            if got is None:
+                old = acct_tree.get(k16)
+                assert old is not None, "account flushed before transfers"
+                got = (old[80:118], old[120:128])
+                meta_cache[k16] = got
+            return got
+
+        for i in range(n):
+            pstat = pstat_l[i]
+            assert 0 <= pstat <= 3, "expiry events never come from chunks"
+            has_p = 1 if p_row_l[i] >= 0 else 0
+            tflags = tflags_l[i]
+            tflags16 = _FLAGS_NONE if tflags == 0xFFFFFFFF else tflags
+            sides_bytes = {}
+            for side in ("dr", "cr"):
+                k16 = idbe[side][16 * i:16 * i + 16]
+                meta, ts_le = acct_meta(k16)
+                acct = (fronts[side][80 * i:80 * i + 80] + meta
+                        + flags2[side][2 * i:2 * i + 2] + ts_le)
+                sides_bytes[side] = acct
+                acct_last[k16] = acct
+            p_val = _NO_PENDING
+            if has_p:
+                pts = p_ts_l[i]
+                p_val = p_cache.get(pts)
+                if p_val is None:
+                    ptid = by_ts.get(pts.to_bytes(8, "big"))
+                    assert ptid is not None, "pending flushed before resolve"
+                    p_val = xfer_tree.get(ptid)
+                    p_cache[pts] = p_val
+            ets = ets8[8 * i:8 * i + 8]
+            put_ev(ets, struct.pack("<QHBB", ts_l[i], tflags16, pstat, has_p)
+                   + sides_bytes["dr"] + sides_bytes["cr"]
+                   + areq16[16 * i:16 * i + 16] + amt16[16 * i:16 * i + 16]
+                   + p_val)
+            dr_hist = side_flags_l["dr"][i] & hist
+            cr_hist = side_flags_l["cr"][i] & hist
+            if dr_hist:
+                put_ev_acct(sides_bytes["dr"][120:128][::-1] + ets, ONE)
+            if cr_hist:
+                put_ev_acct(sides_bytes["cr"][120:128][::-1] + ets, ONE)
+            if not (dr_hist or cr_hist):
+                put_ev_prun(ets, ONE)
+            put_ev_pstat(bytes([pstat]) + ets, ONE)
+            # Pending-status + expiry effects (oracle semantics).
+            if pstat == 1:
+                put_pending(ets, ONE)
+                if timeout_l[i]:
+                    put_expiry(ets, struct.pack("<Q", expires_l[i]))
+            elif pstat in (2, 3):
+                pts = p_ts_l[i]
+                pk8 = pts.to_bytes(8, "big")
+                put_pending(pk8, bytes([pstat]))
+                p_timeout = int.from_bytes(p_val[108:112], "little")
+                if p_timeout:
+                    rm_expiry(pk8)
+        put_acct = acct_tree.put
+        for k16, val in acct_last.items():
+            put_acct(k16, val)
+        # The touched account ids: the caller invalidates their cache
+        # entries (reads must never serve pre-chunk balances).
+        return [int.from_bytes(k16, "big") for k16 in acct_last]
 
     def prune_events(self, before_ts: int) -> int:
         """Delete prunable (no-history) event rows older than `before_ts`

@@ -782,12 +782,25 @@ class Replica:
         self.commit_min = h.op
         # Write-through to the LSM forest + one deterministic compaction
         # beat (reference: commit_compact, one beat per op — §3.4).
-        state = self.state_machine.state  # drains the device mirror first
+        # raw_state: the flush consumes device delta columns directly —
+        # the mirror drain stays DEFERRED (it runs at read boundaries and
+        # checkpoints, amortized), which is most of the serving win.
         led = self.state_machine.led
-        flushed = self.durable.flush(
-            state,
-            flush_columns=(led.take_flush_columns()
-                           if led is not None else None))
+        cols = led.take_flush_columns() if led is not None else None
+        raw = self.state_machine.raw_state
+        if cols and (
+                raw.accounts.dirty or raw.transfers.dirty
+                or raw.pending_status.dirty or raw.expiry.dirty
+                or raw.orphaned.dirty
+                or self.durable.events_persisted < (
+                    raw.events_base + len(raw.account_events))):
+            # Interleaved history (hard-regime handoff, account creation,
+            # expiry): the mirror and the chunks describe overlapping
+            # order that only ONE authority may serialize — drain, then
+            # flush everything through the object path.
+            self.state_machine.state  # drains; chunks become stale
+            cols = None
+        flushed = self.durable.flush(raw, flush_columns=cols)
         self.state_machine.cache_upsert(*flushed)
         self.durable.compact_beat(h.op)
         if h.client:
@@ -844,10 +857,12 @@ class Replica:
         sessions_blob = self.sessions.pack()
         ckpt_state = self.state_machine.state  # drains the mirror first
         led = self.state_machine.led
-        root = (self.durable.checkpoint(
-                    ckpt_state,
-                    flush_columns=(led.take_flush_columns()
-                                   if led is not None else None))
+        if led is not None:
+            # The drain above made any queued columns stale (the object
+            # path now covers everything) — pop them so they cannot leak
+            # or trip the column path's quiescent-mirror contract.
+            led.take_flush_columns()
+        root = (self.durable.checkpoint(ckpt_state)
                 + sessions_blob + struct.pack("<I", len(sessions_blob)))
         assert len(root) <= self.storage.layout.snapshot_size_max, \
             "checkpoint root exceeds slot (raise snapshot_size_max)"
