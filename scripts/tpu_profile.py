@@ -1,0 +1,191 @@
+"""On-chip microprofiles that decide the round-3 perf strategy.
+
+The cost model (PERF.md) says dispatch overhead, not compute, bounds the
+serving kernel: ~20 us/op on a local chip, ~1 ms/op through the axon
+tunnel. Three questions decide where kernel-fusion effort goes, and each
+needs real-hardware evidence:
+
+  op-cost   How does wall time scale with executed-op count? (Chains of
+            K data-dependent gathers — unfusable by XLA.) Confirms or
+            corrects the per-op model and measures the current regime
+            (tunnel vs local).
+  pallas    Does a pallas_call count as ONE dispatch? A/B of the fused
+            two-choice probe (ops/pallas_kernels.py) vs the XLA lookup
+            at serving shapes. If Pallas collapses its op group to one
+            dispatch, megakernels win in BOTH regimes.
+  scan      Does lax.scan amortize dispatch? K kernel batches inside one
+            scanned program vs K separate dispatches. If scan pays once
+            per program rather than per iteration-op, batch-pipelining
+            beats kernel fusion through the tunnel.
+
+Each mode runs in THIS process (callers launch fresh processes per mode;
+TB_PALLAS is trace-time — see ops/pallas_kernels.py). Results append to
+onchip/PROFILE_<utc>.json.
+
+Usage: JAX_PLATFORMS=axon python scripts/tpu_profile.py [op-cost|pallas|scan|all]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _timeit(fn, *args, warmup=2, iters=5):
+    import jax
+
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def profile_op_cost() -> dict:
+    """Chains of K data-dependent gathers: slope = per-op dispatch cost."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    table = jnp.arange(n, dtype=jnp.int32)
+
+    def chain(k):
+        @jax.jit
+        def f(idx):
+            x = idx
+            for _ in range(k):
+                x = table[(x + 1) & (n - 1)]
+            return x
+        return f
+
+    out = {}
+    for k in (1, 8, 32, 96):
+        f = chain(k)
+        idx = jnp.arange(n, dtype=jnp.int32)
+        out[f"gather_chain_{k}_s"] = round(_timeit(f, idx), 6)
+    ks = [1, 8, 32, 96]
+    ts = [out[f"gather_chain_{k}_s"] for k in ks]
+    slope = (ts[-1] - ts[0]) / (ks[-1] - ks[0])
+    out["per_op_cost_us"] = round(slope * 1e6, 2)
+    return out
+
+
+def profile_pallas() -> dict:
+    """Fused Pallas probe vs XLA two-choice lookup at serving shapes."""
+    import jax
+    import jax.numpy as jnp
+
+    from tigerbeetle_tpu.ops.hash_table import (
+        ht_init, ht_insert, ht_lookup)
+    from tigerbeetle_tpu.ops.pallas_kernels import (
+        ht_lookup_fused, probe_fusable)
+
+    cap = 1 << 15
+    table = ht_init(cap)
+    m = cap // 2
+    keys_hi = jnp.arange(1, m + 1, dtype=jnp.uint64)
+    keys_lo = jnp.arange(1, m + 1, dtype=jnp.uint64) * jnp.uint64(7)
+    table, ok = ht_insert(table, keys_hi, keys_lo,
+                          jnp.arange(m, dtype=jnp.int32),
+                          jnp.ones(m, dtype=bool))
+    n = 8192
+    q_hi = keys_hi[:n]
+    q_lo = keys_lo[:n]
+
+    xla = jax.jit(lambda t, h, l: ht_lookup(t, h, l))
+    fused = jax.jit(lambda t, h, l: ht_lookup_fused(t, h, l))
+    out = {
+        "insert_ok": bool(ok),
+        "fusable": probe_fusable(table, n),
+        "xla_lookup_s": round(_timeit(xla, table, q_hi, q_lo), 6),
+    }
+    try:
+        out["pallas_lookup_s"] = round(
+            _timeit(fused, table, q_hi, q_lo), 6)
+        f1, v1 = jax.jit(lambda: ht_lookup(table, q_hi, q_lo))()
+        f2, v2 = jax.jit(lambda: ht_lookup_fused(table, q_hi, q_lo))()
+        out["parity"] = bool(
+            (f1 == f2).all() and (v1 == v2)[f1].all())
+    except Exception as e:  # Mosaic lowering can fail; that IS the result.
+        out["pallas_error"] = f"{type(e).__name__}: {e}"[:500]
+    return out
+
+
+def profile_scan() -> dict:
+    """K dispatches of one gather-heavy step vs one scanned program."""
+    import jax
+    import jax.numpy as jnp
+
+    n = 8192
+    table = jnp.arange(n, dtype=jnp.int32)
+    K = 16
+    OPS = 8
+
+    def step(x):
+        for _ in range(OPS):
+            x = table[(x + 1) & (n - 1)]
+        return x
+
+    jstep = jax.jit(step)
+
+    @jax.jit
+    def scanned(x):
+        def body(c, _):
+            return step(c), ()
+        c, _ = jax.lax.scan(body, x, None, length=K)
+        return c
+
+    idx = jnp.arange(n, dtype=jnp.int32)
+
+    def k_dispatches(x):
+        for _ in range(K):
+            x = jstep(x)
+        return x
+
+    t_loop = _timeit(k_dispatches, idx)
+    t_scan = _timeit(scanned, idx)
+    return {
+        "k": K, "ops_per_step": OPS,
+        "k_dispatch_s": round(t_loop, 6),
+        "scan_s": round(t_scan, 6),
+        "scan_speedup": round(t_loop / t_scan, 2) if t_scan > 0 else None,
+    }
+
+
+def main() -> None:
+    mode = sys.argv[1] if len(sys.argv) > 1 else "all"
+    import jax
+
+    record = {
+        "utc": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+        "mode": mode,
+        "backend": jax.default_backend(),
+        "device": str(jax.devices()[0]),
+    }
+    t0 = time.time()
+    if mode in ("op-cost", "all"):
+        record["op_cost"] = profile_op_cost()
+    if mode in ("pallas", "all"):
+        record["pallas"] = profile_pallas()
+    if mode in ("scan", "all"):
+        record["scan"] = profile_scan()
+    record["elapsed_s"] = round(time.time() - t0, 1)
+    os.makedirs(os.path.join(REPO, "onchip"), exist_ok=True)
+    path = os.path.join(
+        REPO, "onchip", f"PROFILE_{record['utc']}_{mode}.json")
+    with open(path, "w") as f:
+        json.dump(record, f, indent=2)
+        f.write("\n")
+    print(json.dumps(record))
+
+
+if __name__ == "__main__":
+    main()
