@@ -244,9 +244,11 @@ def inner_main() -> None:
     run = {t.strip() for t in (subset or "1,2,3,4,5,6").split(",")}
     unknown = run - {"1", "2", "3", "4", "5", "6"}
     assert not unknown, f"BENCH_CONFIGS has unknown tokens: {sorted(unknown)}"
-    b1 = 8 if quick else 24
-    b2 = 8 if quick else 120  # 120 * 8190 ~ 1M transfers
-    b3 = 8 if quick else 24
+    # Full-mode counts are multiples of SUPERBATCH_MAX=32 so the scan
+    # configs run whole commit windows (one compiled program shape).
+    b1 = 8 if quick else 32
+    b2 = 8 if quick else 128  # 128 * 8190 ~ 1M transfers
+    b3 = 8 if quick else 32
 
     def emit(key, val):
         print(f"##bench {json.dumps({key: val})}", flush=True)

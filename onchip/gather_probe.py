@@ -1,0 +1,88 @@
+"""Which gather formulations lower in Mosaic on this chip?
+Mosaic has no 64-bit types in-kernel, so everything tests u32
+(the real kernel will view its u64 table as u32 pairs)."""
+import json
+import sys
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+B, W, N = 4097, 48, 8192
+table = (jnp.arange(B * W, dtype=jnp.uint32)).reshape(B, W)
+rows = (jnp.arange(N, dtype=jnp.int32) * 7) % B
+out = {}
+
+
+def attempt(name, fn):
+    try:
+        r = jax.jit(fn)(table, rows)
+        jax.block_until_ready(r)
+        ref = jnp.take(table, rows, axis=0)
+        out[name] = {"ok": True, "match": bool((r == ref).all())}
+    except Exception as e:
+        out[name] = {"ok": False,
+                     "err": f"{type(e).__name__}: {e}".splitlines()[0][:300]}
+    print(name, out[name], flush=True)
+
+
+def k_take(t_ref, r_ref, o_ref):
+    o_ref[:] = jnp.take(t_ref[:], r_ref[:], axis=0)
+
+
+def k_taa(t_ref, r_ref, o_ref):
+    idx = jnp.broadcast_to(r_ref[:][:, None], (N, W))
+    o_ref[:] = jnp.take_along_axis(t_ref[:], idx, axis=0)
+
+
+def k_loop(t_ref, r_ref, o_ref):
+    def body(i, _):
+        o_ref[i, :] = t_ref[r_ref[i], :]
+        return 0
+    jax.lax.fori_loop(0, N, body, 0)
+
+
+def k_onehot(t_ref, r_ref, o_ref):
+    limb = (t_ref[:] & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    oh = (r_ref[:][:, None] == jax.lax.broadcasted_iota(
+        jnp.int32, (N, B), 1)).astype(jnp.float32)
+    acc = jax.lax.dot_general(
+        oh, limb, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+    o_ref[:] = acc.astype(jnp.uint32)
+
+
+def mk(kernel):
+    def f(t, r):
+        return pl.pallas_call(
+            kernel,
+            out_shape=jax.ShapeDtypeStruct((N, W), jnp.uint32),
+        )(t, r)
+    return f
+
+
+attempt("xla_take_baseline", lambda t, r: jnp.take(t, r, axis=0))
+attempt("pl_take", mk(k_take))
+attempt("pl_take_along_axis", mk(k_taa))
+attempt("pl_loop_dynslice", mk(k_loop))
+
+
+def attempt_onehot():
+    name = "pl_onehot_limb"
+    try:
+        r = jax.jit(mk(k_onehot))(table, rows)
+        jax.block_until_ready(r)
+        ref = jnp.take(table & jnp.uint32(0xFFFF), rows, axis=0)
+        out[name] = {"ok": True, "match": bool((r == ref).all())}
+    except Exception as e:
+        out[name] = {"ok": False,
+                     "err": f"{type(e).__name__}: {e}".splitlines()[0][:300]}
+    print(name, out[name], flush=True)
+
+
+attempt_onehot()
+json.dump(out, open(sys.argv[1] if len(sys.argv) > 1 else
+                    "/root/repo/onchip/gather_probe_result.json", "w"),
+          indent=2)

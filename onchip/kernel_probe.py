@@ -1,0 +1,64 @@
+"""Where do the bench's 84ms/batch go? Time the real kernel dispatch
+at several state sizes and stack factors, solo on the chip."""
+import sys; sys.path.insert(0, "/root/repo")
+import json, time
+import numpy as np
+import jax
+from tigerbeetle_tpu.benchmark import _make_ledger, _soa, N
+from tigerbeetle_tpu.ops.fast_kernels import (
+    create_transfers_fast_jit, create_transfers_super_jit, _accum_jit)
+from tigerbeetle_tpu.ops.ledger import pad_transfer_events, stack_superbatch
+
+out = {}
+rng = np.random.default_rng(2)
+
+def mk(b, account_count=10_000):
+    base = 10**7 + b * N
+    ids = np.arange(base, base + N)
+    dr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+    cr = rng.integers(1, account_count + 1, N, dtype=np.uint64)
+    clash = dr == cr
+    cr[clash] = dr[clash] % account_count + 1
+    return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
+
+for t_cap_log in (18, 21):
+    led = _make_ledger(10_000, a_cap=1 << 15, t_cap=1 << t_cap_log)
+    # single-batch timing, 12 batches, first 4 = warmup
+    evs = [mk(b) for b in range(12)]
+    padded = [{k: jax.device_put(v) for k, v in pad_transfer_events(e).items()}
+              for e in evs]
+    ts0 = 10**12
+    times = []
+    poisoned = jax.device_put(np.bool_(False))
+    for i, ev in enumerate(padded):
+        t0 = time.perf_counter()
+        led.state, outs = create_transfers_fast_jit(
+            led.state, ev, np.uint64(ts0 + i * (N + 10)), np.int32(N),
+            force_fallback=poisoned)
+        poisoned = outs["fallback"]
+        jax.block_until_ready(poisoned)   # force full sync per batch
+        times.append(time.perf_counter() - t0)
+    out[f"tcap{t_cap_log}_single_ms"] = [round(t*1e3, 1) for t in times]
+
+    # superbatch (8) timing, 3 groups after 1 warmup
+    led2 = _make_ledger(10_000, a_cap=1 << 15, t_cap=1 << t_cap_log)
+    groups = []
+    for g in range(4):
+        evs = [mk(100 + g * 8 + i) for i in range(8)]
+        tss = [10**13 + (g * 8 + i) * (N + 10) for i in range(8)]
+        ev_s, seg = stack_superbatch(evs, tss)
+        groups.append(({k: jax.device_put(v) for k, v in ev_s.items()},
+                       {k: jax.device_put(v) for k, v in seg.items()}))
+    poisoned = jax.device_put(np.bool_(False))
+    times = []
+    for ev_s, seg in groups:
+        t0 = time.perf_counter()
+        led2.state, outs = create_transfers_super_jit(
+            led2.state, ev_s, seg, force_fallback=poisoned)
+        poisoned = outs["fallback"]
+        jax.block_until_ready(poisoned)
+        times.append(time.perf_counter() - t0)
+    out[f"tcap{t_cap_log}_super8_ms"] = [round(t*1e3, 1) for t in times]
+
+print(json.dumps(out, indent=1))
+json.dump(out, open("/root/repo/onchip/kernel_probe_result.json", "w"), indent=2)

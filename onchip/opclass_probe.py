@@ -1,0 +1,43 @@
+"""Per-op-class cost scaling on the real chip: which ops break the
+size-independence the superbatch relies on?"""
+import json, time
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+def timeit(fn, *a, warm=2, iters=4):
+    for _ in range(warm):
+        jax.block_until_ready(fn(*a))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(*a))
+    return (time.perf_counter() - t0) / iters
+
+out = {}
+for n in (16384, 65536, 262144):
+    key = jnp.arange(n, dtype=jnp.int64)[::-1] ^ jnp.int64(0x5A5A5A)
+    u = (jnp.arange(n, dtype=jnp.uint64) * jnp.uint64(2654435761)) % jnp.uint64(n)
+    idx = (jnp.arange(n, dtype=jnp.int32) * 7) % n
+    seg = idx // 8
+
+    probes = {
+        "argsort_i64": jax.jit(lambda k: jnp.argsort(k)),
+        "sort_u64": jax.jit(lambda k: jnp.sort(k)),
+        "gather_u64": jax.jit(lambda x, i: x[i]),
+        "scatter_set_u64": jax.jit(lambda x, i: x.at[i].set(x)),
+        "segsum_u64": jax.jit(lambda x, s: jax.ops.segment_sum(x, s, num_segments=n)),
+        "ascan_u64": jax.jit(lambda x: jax.lax.associative_scan(jnp.add, x)),
+        "where_u64": jax.jit(lambda x: jnp.where(x > 5, x, x + 1)),
+    }
+    for name, f in probes.items():
+        if name == "argsort_i64" or name == "sort_u64":
+            t = timeit(f, key)
+        elif name in ("gather_u64", "scatter_set_u64"):
+            t = timeit(f, u, idx)
+        elif name == "segsum_u64":
+            t = timeit(f, u, seg)
+        else:
+            t = timeit(f, u)
+        out[f"{name}_n{n}_ms"] = round(t * 1e3, 2)
+print(json.dumps(out, indent=1))
+json.dump(out, open("/root/repo/onchip/opclass_probe_result.json", "w"), indent=2)

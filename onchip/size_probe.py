@@ -1,0 +1,29 @@
+"""Does tunnel per-op cost depend on array size? Decides superbatching."""
+import json, time
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+def timeit(fn, *a, warm=2, iters=6):
+    for _ in range(warm):
+        jax.block_until_ready(fn(*a))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(*a))
+    return (time.perf_counter() - t0) / iters
+
+OPS = 16
+out = {}
+for n in (8192, 65536, 524288):
+    tab = jnp.arange(n, dtype=jnp.uint64)
+    @jax.jit
+    def f(x, tab=tab, n=n):
+        for _ in range(OPS):
+            x = tab[((x + jnp.uint64(1)) & jnp.uint64(n - 1)).astype(jnp.int32)]
+        return x
+    x = jnp.arange(n, dtype=jnp.uint64)
+    t = timeit(f, x)
+    out[f"chain{OPS}_n{n}_ms"] = round(t * 1e3, 2)
+    out[f"per_op_us_n{n}"] = round(t / OPS * 1e6, 1)
+print(json.dumps(out))
+json.dump(out, open("/root/repo/onchip/size_probe_result.json", "w"), indent=2)

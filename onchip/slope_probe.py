@@ -1,0 +1,27 @@
+"""Solo per-op slope: K-gather chains at n=65536, K=1..64."""
+import json, time
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+
+n = 65536
+tab = jnp.arange(n, dtype=jnp.uint64)
+def timeit(fn, *a, warm=2, iters=5):
+    for _ in range(warm):
+        jax.block_until_ready(fn(*a))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        jax.block_until_ready(fn(*a))
+    return (time.perf_counter() - t0) / iters
+
+out = {}
+for K in (1, 2, 4, 8, 16, 32, 64):
+    @jax.jit
+    def f(x, K=K):
+        for _ in range(K):
+            x = tab[((x + jnp.uint64(1)) & jnp.uint64(n - 1)).astype(jnp.int32)]
+        return x
+    t = timeit(f, jnp.arange(n, dtype=jnp.uint64))
+    out[f"chain{K}_ms"] = round(t * 1e3, 2)
+print(json.dumps(out))
+json.dump(out, open("/root/repo/onchip/slope_probe_result.json", "w"), indent=2)

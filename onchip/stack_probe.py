@@ -1,0 +1,48 @@
+"""Find the superbatch knee: steady-state dispatch time at stack 8/16/32."""
+import json, time
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax
+from tigerbeetle_tpu.benchmark import _make_ledger, _soa, N
+from tigerbeetle_tpu.ops.fast_kernels import create_transfers_super_jit
+from tigerbeetle_tpu.ops.ledger import stack_superbatch
+
+out = {}
+rng = np.random.default_rng(2)
+AC = 10_000
+
+def mk(b):
+    base = 10**7 + b * N
+    ids = np.arange(base, base + N)
+    dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+    cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+    clash = dr == cr
+    cr[clash] = dr[clash] % AC + 1
+    return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
+
+bi = 0
+for stack in (16, 32):
+    led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 21)
+    groups = []
+    for g in range(3):
+        evs = []
+        tss = []
+        for i in range(stack):
+            evs.append(mk(bi)); tss.append(10**13 + bi * (N + 10)); bi += 1
+        ev_s, seg = stack_superbatch(evs, tss)
+        groups.append(({k: jax.device_put(v) for k, v in ev_s.items()},
+                       {k: jax.device_put(v) for k, v in seg.items()}))
+    poisoned = jax.device_put(np.bool_(False))
+    times = []
+    for ev_s, seg in groups:
+        t0 = time.perf_counter()
+        led.state, outs = create_transfers_super_jit(
+            led.state, ev_s, seg, force_fallback=poisoned)
+        poisoned = outs["fallback"]
+        jax.block_until_ready(poisoned)
+        times.append(time.perf_counter() - t0)
+    assert not bool(jax.device_get(poisoned))
+    out[f"stack{stack}_ms"] = [round(t*1e3, 1) for t in times]
+    out[f"stack{stack}_tps_steady"] = round(stack * N / (times[-1]), 1)
+print(json.dumps(out, indent=1))
+json.dump(out, open("/root/repo/onchip/stack_probe_result.json", "w"), indent=2)

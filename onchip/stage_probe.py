@@ -1,0 +1,57 @@
+"""Bisect the superbatch dispatch cost: per_event_status vs full kernel,
+plus a no-application variant (statuses only), at stack=8."""
+import json, time
+import sys; sys.path.insert(0, "/root/repo")
+import numpy as np
+import jax, jax.numpy as jnp
+from tigerbeetle_tpu.benchmark import _make_ledger, _soa, N
+from tigerbeetle_tpu.ops import fast_kernels as fk
+from tigerbeetle_tpu.ops.ledger import stack_superbatch
+
+rng = np.random.default_rng(2)
+AC = 10_000
+def mk(b):
+    base = 10**7 + b * N
+    ids = np.arange(base, base + N)
+    dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+    cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+    clash = dr == cr
+    cr[clash] = dr[clash] % AC + 1
+    return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
+
+led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 21)
+state = led.state
+bi = 0
+def group():
+    global bi
+    evs, tss = [], []
+    for i in range(8):
+        evs.append(mk(bi)); tss.append(10**13 + bi * (N + 10)); bi += 1
+    ev_s, seg = stack_superbatch(evs, tss)
+    return ({k: jax.device_put(v) for k, v in ev_s.items()},
+            {k: jax.device_put(v) for k, v in seg.items()})
+
+groups = [group() for _ in range(4)]
+
+pe_jit = jax.jit(lambda st, ev, seg: fk.per_event_status(
+    st, ev, seg["ts_event"]))
+
+out = {}
+def timeit(name, fn):
+    ts = []
+    for ev_s, seg in groups:
+        t0 = time.perf_counter()
+        r = fn(ev_s, seg)
+        jax.block_until_ready(r)
+        ts.append(round((time.perf_counter() - t0) * 1e3, 1))
+    out[name] = ts
+    print(name, ts, flush=True)
+
+timeit("per_event_status_ms", lambda ev, seg: pe_jit(state, ev, seg))
+
+# Full kernel WITHOUT state mutation visible: still runs application, so
+# time the real thing against a copy each call (undonated timing control).
+full = jax.jit(lambda st, ev, seg: fk.create_transfers_fast(
+    st, ev, jnp.uint64(0), jnp.int32(0), seg=seg)[1]["r_status"])
+timeit("full_kernel_ms", lambda ev, seg: full(state, ev, seg))
+json.dump(out, open("/root/repo/onchip/stage_probe_result.json", "w"), indent=2)

@@ -1,0 +1,226 @@
+"""Superbatch (commit-window) kernel: bit-exact vs sequential dispatch.
+
+K prepares stacked into one create_transfers_super_jit dispatch must
+produce exactly the statuses, timestamps, and final device state of K
+sequential create_transfers_fast_jit dispatches (the semantics the
+replica relies on when aggregating a committed window). Reference
+analog: the 8-deep prepare pipeline, src/config.zig:155 — batching is a
+scheduling choice and must never be observable in results.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+from tigerbeetle_tpu.ops.fast_kernels import (
+    create_transfers_fast_jit,
+    create_transfers_super_jit,
+)
+from tigerbeetle_tpu.ops.ledger import (
+    DeviceLedger,
+    pad_transfer_events,
+    stack_superbatch,
+)
+from tigerbeetle_tpu.types import Account, Transfer, TransferFlags as TF
+
+TS = 10_000_000_000_000
+PAD = 256
+
+
+def _fresh_state(n_accounts=8):
+    led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, n_accounts + 1)],
+        timestamp=TS,
+    )
+    assert led.fallbacks == 0
+    return led.state
+
+
+def _copy(state):
+    return jax.tree.map(jnp.copy, state)
+
+
+def _run_sequential(state, batches, tss):
+    outs = []
+    for tr, ts in zip(batches, tss):
+        ev = {k: jax.device_put(v) for k, v in pad_transfer_events(
+            transfers_to_arrays(tr), PAD).items()}
+        state, out = create_transfers_fast_jit(
+            state, ev, np.uint64(ts), np.int32(len(tr)))
+        assert not bool(out["fallback"]), "sequential arm fell back"
+        outs.append(out)
+    return state, outs
+
+
+def _run_super(state, batches, tss):
+    ev_s, seg = stack_superbatch(
+        [transfers_to_arrays(tr) for tr in batches], tss, PAD)
+    ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
+    seg = {k: jax.device_put(v) for k, v in seg.items()}
+    return create_transfers_super_jit(state, ev_s, seg)
+
+
+def _ht_content(table):
+    """Logical content of a hash table: sorted (key_hi, key_lo, val)
+    triples. Slot LAYOUT legitimately differs between sequential and
+    superbatch arms (two-choice placement reads bucket occupancy at
+    plan time, and the superbatch plans the whole window against the
+    pre-window table) — but the mapping, hence every lookup and every
+    derived result, must be identical."""
+    from tigerbeetle_tpu.ops.hash_table import SLOTS
+
+    p = np.asarray(table["packed"])[:-1]
+    kh = p[:, :SLOTS].reshape(-1)
+    kl = p[:, SLOTS:2 * SLOTS].reshape(-1)
+    v = p[:, 2 * SLOTS:].reshape(-1)
+    live = (kh != 0) | (kl != 0)
+    trips = sorted(zip(kh[live].tolist(), kl[live].tolist(),
+                       v[live].tolist()))
+    return trips
+
+
+def _assert_equal(seq_state, seq_outs, sup_state, sup_out, k):
+    assert not bool(sup_out["fallback"]), "superbatch fell back"
+    st = np.asarray(sup_out["r_status"]).reshape(k, PAD)
+    ts = np.asarray(sup_out["r_ts"]).reshape(k, PAD)
+    for b, out in enumerate(seq_outs):
+        np.testing.assert_array_equal(st[b], np.asarray(out["r_status"]))
+        np.testing.assert_array_equal(ts[b], np.asarray(out["r_ts"]))
+    for key in seq_state:
+        if key.endswith("_ht"):
+            assert _ht_content(seq_state[key]) == _ht_content(
+                sup_state[key]), key
+            continue
+        flat_seq = jax.tree.leaves(seq_state[key])
+        flat_sup = jax.tree.leaves(sup_state[key])
+        for a, b in zip(flat_seq, flat_sup):
+            np.testing.assert_array_equal(
+                np.asarray(a), np.asarray(b), err_msg=key)
+
+
+def _diff_case(batches, tss):
+    state = _fresh_state()
+    seq_state, seq_outs = _run_sequential(_copy(state), batches, tss)
+    sup_state, sup_out = _run_super(_copy(state), batches, tss)
+    _assert_equal(seq_state, seq_outs, sup_state, sup_out, len(batches))
+
+
+def test_regular_window():
+    rng = np.random.default_rng(11)
+    batches = []
+    next_id = 1000
+    for _ in range(3):
+        trs = []
+        for _ in range(40):
+            dr = int(rng.integers(1, 9))
+            cr = dr % 8 + 1
+            trs.append(Transfer(id=next_id, debit_account_id=dr,
+                                credit_account_id=cr, ledger=1, code=1,
+                                amount=int(rng.integers(1, 100))))
+            next_id += 1
+        batches.append(trs)
+    tss = [TS + 1000 + b * (PAD + 10) for b in range(3)]
+    _diff_case(batches, tss)
+
+
+def test_mixed_statuses_and_pendings():
+    """Pendings with timeouts (pulse evolution spans the window), failures
+    (not-found accounts), and posts of pendings committed BEFORE the
+    window."""
+    state = _fresh_state()
+    # Commit a pending first (separate prepare, before the window).
+    pend = [Transfer(id=500, debit_account_id=1, credit_account_id=2,
+                     ledger=1, code=1, amount=50, timeout=3600,
+                     flags=TF.pending)]
+    ts0 = TS + 500
+    ev = {k: jax.device_put(v) for k, v in pad_transfer_events(
+        transfers_to_arrays(pend), PAD).items()}
+    state, out = create_transfers_fast_jit(
+        state, ev, np.uint64(ts0), np.int32(1))
+    assert not bool(out["fallback"])
+
+    batches = [
+        # window batch 1: regular + a failing transfer + a new pending
+        [Transfer(id=600, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=10),
+         Transfer(id=601, debit_account_id=99, credit_account_id=2,
+                  ledger=1, code=1, amount=10),
+         Transfer(id=602, debit_account_id=3, credit_account_id=4,
+                  ledger=1, code=1, amount=7, timeout=60,
+                  flags=TF.pending)],
+        # window batch 2: post the pre-window pending (full amount)
+        [Transfer(id=700, pending_id=500, ledger=0, code=0,
+                  amount=(1 << 128) - 1,
+                  flags=TF.post_pending_transfer)],
+    ]
+    tss = [ts0 + 1000, ts0 + 2000]
+    seq_state, seq_outs = _run_sequential(_copy(state), batches, tss)
+    sup_state, sup_out = _run_super(_copy(state), batches, tss)
+    _assert_equal(seq_state, seq_outs, sup_state, sup_out, 2)
+
+
+def test_chain_at_boundary_does_not_merge():
+    """A linked chain open at a sub-batch's end errors with
+    linked_event_chain_open and must NOT absorb the next sub-batch's
+    head (chains never span prepares)."""
+    batches = [
+        # ends with an OPEN chain: last event has linked set
+        [Transfer(id=800, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=1),
+         Transfer(id=801, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=1, flags=TF.linked)],
+        # next sub-batch starts with a clean chain pair
+        [Transfer(id=810, debit_account_id=3, credit_account_id=4,
+                  ledger=1, code=1, amount=1, flags=TF.linked),
+         Transfer(id=811, debit_account_id=3, credit_account_id=4,
+                  ledger=1, code=1, amount=1)],
+    ]
+    tss = [TS + 1000, TS + 2000]
+    _diff_case(batches, tss)
+    # And the failing-chain case: poison inside a chain in batch 2.
+    batches2 = [
+        [Transfer(id=820, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=1)],
+        [Transfer(id=830, debit_account_id=3, credit_account_id=4,
+                  ledger=1, code=1, amount=1, flags=TF.linked),
+         Transfer(id=831, debit_account_id=77, credit_account_id=4,
+                  ledger=1, code=1, amount=1)],
+    ]
+    _diff_case(batches2, [TS + 3000, TS + 4000])
+
+
+def test_cross_batch_duplicate_falls_back():
+    """A duplicate id across the window's sub-batches is a cross-prepare
+    dependency: the superbatch must fall back (the caller then executes
+    the window sequentially), never silently diverge."""
+    state = _fresh_state()
+    batches = [
+        [Transfer(id=900, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=1)],
+        [Transfer(id=900, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=1)],
+    ]
+    tss = [TS + 1000, TS + 2000]
+    _, sup_out = _run_super(_copy(state), batches, tss)
+    assert bool(sup_out["fallback"])
+
+
+def test_varying_batch_sizes():
+    rng = np.random.default_rng(13)
+    batches = []
+    next_id = 2000
+    for n in (1, 37, 200):
+        trs = []
+        for _ in range(n):
+            dr = int(rng.integers(1, 9))
+            cr = dr % 8 + 1
+            trs.append(Transfer(id=next_id, debit_account_id=dr,
+                                credit_account_id=cr, ledger=1, code=1,
+                                amount=int(rng.integers(1, 100))))
+            next_id += 1
+        batches.append(trs)
+    tss = [TS + 1000 + b * (PAD + 10) for b in range(3)]
+    _diff_case(batches, tss)

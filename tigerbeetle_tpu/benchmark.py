@@ -65,8 +65,31 @@ def _make_ledger(account_count, a_cap=1 << 15, t_cap=1 << 21):
 # cost through a slow TPU tunnel is paid once, not per config.
 B_CHUNK = 8
 
+# Prepares executed per kernel dispatch in the scan configs (commit-window
+# aggregation). Measured steady-state on the chip (onchip/
+# stack_probe_result.json): stack 1 -> ~97ms/dispatch (84k tps),
+# 8 -> 256ms (256k), 16 -> 463ms (283k), 32 -> 800ms (327k) — dispatch
+# cost has a large fixed term, so stacking wins sublinearly up to ~32.
+# On CPU the kernel is compute-bound (no dispatch overhead to amortize,
+# and the window-sized sorts cost more than K batch-sized ones), so
+# stacking is TPU-only.
+SUPERBATCH_MAX = 32
 
-def _run_scan(led, evs, ts0):
+
+def _superbatch_default(n_batches):
+    import jax
+
+    if jax.default_backend() != "tpu":
+        return 1
+    s = SUPERBATCH_MAX
+    while s >= 2:
+        if n_batches % s == 0:
+            return s
+        s //= 2
+    return 1
+
+
+def _run_scan(led, evs, ts0, stack=None):
     """Dispatch batches back-to-back with no mid-run host sync; returns
     (accepted, elapsed). Host-side padding is staged before the clock.
 
@@ -75,28 +98,74 @@ def _run_scan(led, evs, ts0):
     fallback masks every later batch exactly like the old on-device scan
     did — without a lax.scan op (while-style programs execute
     pathologically through the remote-TPU tunnel) and without waiting on
-    any per-batch result."""
+    any per-batch result.
+
+    stack=K executes K prepares per dispatch via the superbatch kernel
+    (commit-window aggregation, the group-commit analog of the
+    reference's 8-deep prepare pipeline — src/config.zig:155): per-op
+    dispatch cost is size-independent to ~64k rows, so tunnel-regime
+    throughput scales ~K. Semantics are unchanged — the eligibility
+    proofs extend to the concatenated window and any cross-batch
+    dependency falls back."""
     import jax
 
-    from .ops.fast_kernels import _accum_jit, create_transfers_fast_jit
-    from .ops.ledger import pad_transfer_events
+    from .ops.fast_kernels import (
+        _accum_jit,
+        create_transfers_fast_jit,
+        create_transfers_super_jit,
+    )
+    from .ops.ledger import pad_transfer_events, stack_superbatch
+
+    stack = stack or _superbatch_default(len(evs))
+    tss = [int(ts0) + i * (N + 10) for i in range(len(evs))]
+    poisoned = jax.device_put(np.bool_(False))
+    accepted_dev = jax.device_put(np.int64(0))
+    if stack > 1:
+        # A short tail group would compile a second program shape, so
+        # drivers send batch counts that are multiples of `stack`.
+        assert len(evs) % stack == 0, "stack must divide the batch count"
+        groups = []
+        for lo in range(0, len(evs), stack):
+            ev_s, seg = stack_superbatch(
+                evs[lo:lo + stack], tss[lo:lo + stack])
+            groups.append((
+                {k: jax.device_put(v) for k, v in ev_s.items()},
+                {k: jax.device_put(v) for k, v in seg.items()}))
+        t0 = time.perf_counter()
+        for ev_s, seg in groups:
+            led.state, outs = create_transfers_super_jit(
+                led.state, ev_s, seg, force_fallback=poisoned)
+            poisoned = outs["fallback"]
+            accepted_dev = _accum_jit(accepted_dev, outs["created_count"])
+        accepted, bad = jax.device_get((accepted_dev, poisoned))
+        elapsed = time.perf_counter() - t0
+        assert not bool(bad), "unexpected fallback"
+        return int(accepted), elapsed
 
     padded = [{k: jax.device_put(v) for k, v in
                pad_transfer_events(e).items()} for e in evs]
-    tss = [np.uint64(int(ts0) + i * (N + 10)) for i in range(len(padded))]
     n_arr = np.int32(N)
-    poisoned = jax.device_put(np.bool_(False))
-    accepted_dev = jax.device_put(np.int64(0))
     t0 = time.perf_counter()
     for ev, ts in zip(padded, tss):
         led.state, outs = create_transfers_fast_jit(
-            led.state, ev, ts, n_arr, force_fallback=poisoned)
+            led.state, ev, np.uint64(ts), n_arr, force_fallback=poisoned)
         poisoned = outs["fallback"]
         accepted_dev = _accum_jit(accepted_dev, outs["created_count"])
     accepted, bad = jax.device_get((accepted_dev, poisoned))
     elapsed = time.perf_counter() - t0
     assert not bool(bad), "unexpected fallback"
     return int(accepted), elapsed
+
+
+def _warm_and_run(led, mk, batches):
+    """Warm up the exact program shape the timed run will use (compile
+    through a slow tunnel is paid once, outside the clock), then measure."""
+    stack = _superbatch_default(batches)
+    warm = stack if stack > 1 else B_CHUNK
+    _run_scan(led, [mk(b) for b in range(-warm, 0)],
+              np.uint64(10**11), stack=stack)
+    return _run_scan(led, [mk(b) for b in range(batches)],
+                     np.uint64(10**12), stack=stack)
 
 
 def bench_config1(batches):
@@ -111,9 +180,7 @@ def bench_config1(batches):
         cr = np.full(N, 2)
         return _soa(ids, dr, cr, rng.integers(1, 1000, N))
 
-    _run_scan(led, [mk(b) for b in range(-B_CHUNK, 0)],
-              np.uint64(10**11))  # warmup: one chunk (shared compile cache)
-    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+    return _warm_and_run(led, mk, batches)
 
 
 def bench_config2(batches, account_count=10_000):
@@ -130,9 +197,7 @@ def bench_config2(batches, account_count=10_000):
         cr[clash] = dr[clash] % account_count + 1
         return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
 
-    _run_scan(led, [mk(b) for b in range(-B_CHUNK, 0)],
-              np.uint64(10**11))  # warmup: one chunk (shared compile cache)
-    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+    return _warm_and_run(led, mk, batches)
 
 
 def bench_config_zipfian(batches, account_count=10_000, theta=0.99):
@@ -153,9 +218,7 @@ def bench_config_zipfian(batches, account_count=10_000, theta=0.99):
         cr[clash] = dr[clash] % account_count + 1
         return _soa(ids, dr, cr, rng.integers(1, 1000, N))
 
-    _run_scan(led, [mk(b) for b in range(-B_CHUNK, 0)],
-              np.uint64(10**11))  # warmup: one chunk (shared compile cache)
-    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+    return _warm_and_run(led, mk, batches)
 
 
 def bench_config3(batches, account_count=1000):
@@ -178,9 +241,7 @@ def bench_config3(batches, account_count=1000):
         dr[1::2][bad] = account_count + 10**6
         return _soa(ids, dr, cr, rng.integers(1, 1000, N), flags=flags)
 
-    _run_scan(led, [mk(b) for b in range(-B_CHUNK, 0)],
-              np.uint64(10**11))  # warmup: one chunk (shared compile cache)
-    return _run_scan(led, [mk(b) for b in range(batches)], np.uint64(10**12))
+    return _warm_and_run(led, mk, batches)
 
 
 def bench_config4(batches=2, n=1024, account_count=64):

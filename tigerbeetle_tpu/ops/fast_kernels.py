@@ -210,16 +210,43 @@ def _normalize_limbs(limbs):
     return l0, l1, l2, l3
 
 
-def _chain_pass(status, linked, valid, idxs, n, N):
+def _packed_perm(rows2, order2, row_cap):
+    """Stable (row, event-order) sort permutation via ONE int64 sort:
+    rows and event order packed into a single key (a lexsort would cost
+    two stable passes). Field widths are static: pb bits each for order
+    and the entry-position tiebreak, the rest for the row. Shared by the
+    snapshot/application sort and the limit fixpoint so the two can
+    never desynchronize."""
+    n2 = rows2.shape[0]
+    pb = max(17, (n2 - 1).bit_length())  # static; superbatch-safe
+    assert 2 * pb + (int(row_cap) - 1).bit_length() <= 62
+    pos = jnp.arange(n2, dtype=jnp.int64)
+    combined = ((rows2.astype(jnp.int64) << jnp.int64(2 * pb))
+                | (order2.astype(jnp.int64) << jnp.int64(pb))
+                | pos & jnp.int64((1 << pb) - 1))
+    return jnp.argsort(combined).astype(jnp.int32)
+
+
+def _chain_pass(status, linked, valid, idxs, n, N, seg_start=None,
+                chain_term=None):
     """Linked-chain first-failure broadcast (reference execute_create
     :3033-3150): returns (status, not_the_failure, my_first, in_chain)
     where not_the_failure marks members overridden to linked_event_failed.
-    Pure in `status` — the limit fixpoint re-runs it per round."""
+    Pure in `status` — the limit fixpoint re-runs it per round.
+
+    seg_start/chain_term generalize to superbatches (K stacked prepares
+    in one dispatch): seg_start marks each sub-batch's first lane (chains
+    never span prepares — a trailing open chain must NOT merge with the
+    next sub-batch's head) and chain_term marks each sub-batch's last
+    VALID event (the open-chain terminator position). Defaults reproduce
+    the single-batch semantics."""
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
+    if seg_start is not None:
+        l_prev = l_prev & ~seg_start
     in_chain = linked | l_prev
     start = linked & ~l_prev
     chain_id = _cumsum(start.astype(jnp.int32))
-    is_last = idxs == (n - 1)
+    is_last = (idxs == (n - 1)) if chain_term is None else chain_term
     chain_open_evt = linked & is_last
     status = jnp.where(chain_open_evt, _TS["linked_event_chain_open"],
                        status)
@@ -478,7 +505,7 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
 
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
-                          per_event=None, limit_rounds=1):
+                          per_event=None, limit_rounds=1, seg=None):
     """One batch against the device ledger. Returns (new_state, out) where
     out = {r_status, r_ts, fallback, limit_only, created_count}. When
     out['fallback'] is set, new_state is the input state unchanged (every
@@ -495,7 +522,22 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     the worst-case headroom proof (fallback on a potential breach);
     K > 1 = resolve breaches natively with a K-round status fixpoint
     against exact per-event prefix balances (falls back only if the
-    limit-decision cascade is deeper than K rounds)."""
+    limit-decision cascade is deeper than K rounds).
+    seg: superbatch descriptor for K stacked prepares executed in ONE
+    dispatch (tunnel per-op cost is size-independent to ~64k rows —
+    onchip/size_probe_result.json — so stacking multiplies throughput
+    by ~K): {"ts_event": u64[N] per-event commit timestamps,
+    "seg_start": bool[N] sub-batch first lanes, "chain_term": bool[N]
+    sub-batch last-valid lanes}. The eligibility proofs (E1-E8) are
+    already whole-array reductions, so they extend verbatim to the
+    concatenated stream; sequential cross-sub-batch effects (dup ids,
+    pending posted earlier in the superbatch, headroom, pulse evolution)
+    are exactly the intra-batch cases they already cover. timestamp/n
+    are ignored when seg is given (timestamps arrive per event). The one
+    observable difference vs K sequential dispatches is hash-table slot
+    LAYOUT (two-choice placement reads occupancy at plan time); the
+    key->row mapping and every derived result are identical
+    (tests/test_superbatch.py pins this)."""
     from .hash_table import ht_plan, ht_write
 
     acc = state["accounts"]
@@ -505,8 +547,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     T_dump = xfr["u64"].shape[0] - 1
     idxs = jnp.arange(N, dtype=jnp.int32)
     valid = ev["valid"]
-    nn = n.astype(jnp.uint64)
-    ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
+    if seg is None:
+        nn = n.astype(jnp.uint64)
+        ts_event = timestamp - nn + idxs.astype(jnp.uint64) + jnp.uint64(1)
+        seg_start = chain_term = None
+    else:
+        ts_event = seg["ts_event"]
+        seg_start = seg["seg_start"]
+        chain_term = seg["chain_term"]
 
     flags = ev["flags"]
     linked = _flag(flags, _F_LINKED) & valid
@@ -663,11 +711,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             jnp.where(valid, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
         ])
         forder = jnp.concatenate([idxs, idxs])
-        fpos = jnp.arange(2 * N, dtype=jnp.int64)
-        fcomb = ((frows2.astype(jnp.int64) << jnp.int64(34))
-                 | (forder.astype(jnp.int64) << jnp.int64(17))
-                 | fpos & jnp.int64((1 << 17) - 1))
-        fperm = jnp.argsort(fcomb).astype(jnp.int32)
+        fperm = _packed_perm(frows2, forder, A_rows)
         frows_sorted = frows2[fperm]
         fstart = jnp.concatenate([
             jnp.ones(1, dtype=jnp.bool_),
@@ -712,7 +756,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             st_r = jnp.where(over_dr, _TS["exceeds_credits"], status)
             st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                              st_r)
-            st_r, _, _, _ = _chain_pass(st_r, linked, valid, idxs, n, N)
+            st_r, _, _, _ = _chain_pass(st_r, linked, valid, idxs, n, N,
+                                        seg_start, chain_term)
             ap_r = valid & (st_r == _CREATED)
             fl = _delta_lanes2(ap_r & ~pv & ~pending, ap_r & ~pv & pending,
                                ap_r & pv, ap_r & pv & is_post, alx, nlx)
@@ -742,7 +787,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     # ---------------- chains: segment first-failure broadcast ----------------
     status, not_the_failure, my_first, in_chain = _chain_pass(
-        status, linked, valid, idxs, n, N)
+        status, linked, valid, idxs, n, N, seg_start, chain_term)
     ts_actual = jnp.where(not_the_failure, ts_event, ts_actual)
 
     status = jnp.where(valid, status, jnp.uint32(0))
@@ -880,13 +925,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     nl = (nl0, nl1, nl2, nl3)
     rows2 = jnp.concatenate(side_rows)  # 2N entries: dr sides then cr sides
     order2 = jnp.concatenate([idxs, idxs])
-    # Single-key sort: (row, event order) packed into one int64 — one sort
-    # pass instead of lexsort's two stable passes.
-    entry_pos = jnp.arange(2 * N, dtype=jnp.int64)
-    combined = ((rows2.astype(jnp.int64) << jnp.int64(34))
-                | (order2.astype(jnp.int64) << jnp.int64(17))
-                | entry_pos & jnp.int64((1 << 17) - 1))
-    perm = jnp.argsort(combined).astype(jnp.int32)
+    perm = _packed_perm(rows2, order2, acc["u64"].shape[0])
     rows_sorted = rows2[perm]
     is_start = jnp.concatenate([
         jnp.ones(1, dtype=jnp.bool_), rows_sorted[1:] != rows_sorted[:-1]])
@@ -1035,6 +1074,19 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
 
 create_transfers_fast_jit = jax.jit(create_transfers_fast, donate_argnums=0)
+
+
+def _create_transfers_super(state, ev, seg, force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg)
+
+
+# Superbatch entry: K stacked prepares, one dispatch. Tunnel-regime
+# throughput scales ~K (per-op cost is size-independent to ~64k rows);
+# on a local chip it amortizes fixed dispatch overhead the same way.
+create_transfers_super_jit = jax.jit(
+    _create_transfers_super, donate_argnums=0)
 
 # The order-dependent-limits variant: resolves headroom-proof breaches
 # natively with a K-round status fixpoint (cascades deeper than K

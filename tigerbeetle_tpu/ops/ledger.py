@@ -307,6 +307,38 @@ def pad_account_events(ev: dict, n_pad: int = N_PAD) -> dict:
     return pad_transfer_events(ev, n_pad)
 
 
+def stack_superbatch(evs: list[dict], timestamps: list[int],
+                     n_pad: int = N_PAD):
+    """Concatenate K prepares into one kernel superbatch (host side).
+
+    Each ev is an UNPADDED transfers_to_arrays SoA dict; sub-batch b is
+    padded to n_pad and assigned commit timestamps
+    `timestamps[b] - n_b + i + 1` (reference execute_create :3031 —
+    per-prepare timestamp bases must be monotone across the window, which
+    the replica's prepare timestamping guarantees). Returns (ev_super,
+    seg) ready for create_transfers_super_jit: one dispatch executes the
+    whole window, multiplying tunnel-regime throughput by ~K (per-op
+    dispatch cost is size-independent — onchip/size_probe_result.json)."""
+    assert len(evs) == len(timestamps) and evs
+    padded = [pad_transfer_events(e, n_pad) for e in evs]
+    ev_super = {k: np.concatenate([p[k] for p in padded])
+                for k in padded[0]}
+    K = len(padded)
+    local = np.arange(n_pad, dtype=np.int64)
+    ts_parts, term_parts = [], []
+    for e, ts in zip(evs, timestamps):
+        n_b = len(e["id_lo"])
+        ts_parts.append((np.uint64(ts) - np.uint64(n_b)
+                         + local.astype(np.uint64) + np.uint64(1)))
+        term_parts.append(local == n_b - 1)
+    seg_start = np.zeros(K * n_pad, dtype=bool)
+    seg_start[::n_pad] = True
+    seg = dict(ts_event=np.concatenate(ts_parts),
+               seg_start=seg_start,
+               chain_term=np.concatenate(term_parts))
+    return ev_super, seg
+
+
 class DeviceLedger:
     """Stateful wrapper: owns the device pytree + fallback orchestration."""
 
