@@ -47,7 +47,7 @@ def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch"):
     contract as create_transfers_fast. `ev` arrays must be divisible by
     the mesh axis size (pad_transfer_events' N_PAD=8192 divides any
     power-of-two mesh)."""
-    from jax.experimental.shard_map import shard_map
+    from jax import shard_map
 
     n_dev = mesh.shape[axis]
 
@@ -78,7 +78,7 @@ def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch"):
                 "status_pre", "ts_pre", "amt_res_hi", "amt_res_lo",
                 "dr_row", "cr_row", "p_row",
                 "dr_found", "cr_found", "p_found")},
-            check_rep=False,
+            check_vma=False,
         )(state, ev)
         # Global tail on the gathered bundle: replicated, deterministic,
         # bit-exact vs the single-chip kernel (it IS the single-chip
