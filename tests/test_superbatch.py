@@ -208,6 +208,88 @@ def test_cross_batch_duplicate_falls_back():
     assert bool(sup_out["fallback"])
 
 
+def test_state_machine_commit_window_parity():
+    """StateMachine.commit_window replies byte-identically to per-body
+    commit, including multi-inner-batch bodies and served lookups
+    afterward."""
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import (
+        OPERATION_SPECS,
+        StateMachine,
+    )
+    from tigerbeetle_tpu.types import Operation
+
+    def fresh():
+        sm = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 12)
+        sm.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 9)], TS)
+        return sm
+
+    spec = OPERATION_SPECS[Operation.create_transfers]
+
+    def payload(ids):
+        return b"".join(
+            Transfer(id=i, debit_account_id=(i % 8) + 1,
+                     credit_account_id=(i % 8) % 8 + 2
+                     if (i % 8) + 1 != (i % 8) % 8 + 2 else 1,
+                     ledger=1, code=1, amount=1 + i % 97).pack()
+            for i in ids)
+
+    bodies = [
+        multi_batch.encode([payload(range(1000, 1020))], spec.event_size),
+        # two inner batches in one prepare
+        multi_batch.encode([payload(range(2000, 2010)),
+                            payload(range(2100, 2130))], spec.event_size),
+        multi_batch.encode([payload(range(3000, 3040))], spec.event_size),
+        multi_batch.encode([payload(range(4000, 4004))], spec.event_size),
+    ]
+    tss = [TS + 10_000 + i * 1000 for i in range(4)]
+
+    sm_a = fresh()
+    seq = [sm_a.commit(Operation.create_transfers, b, ts)
+           for b, ts in zip(bodies, tss)]
+    sm_b = fresh()
+    win = sm_b.commit_window(Operation.create_transfers, bodies, tss)
+    assert seq == win
+    assert sm_b.led.window_fallbacks == 0
+    # Served state agrees.
+    a = sm_a.lookup_accounts(list(range(1, 9)))
+    b = sm_b.lookup_accounts(list(range(1, 9)))
+    assert [(x.id, x.debits_posted, x.credits_posted) for x in a] == \
+           [(x.id, x.debits_posted, x.credits_posted) for x in b]
+
+
+def test_commit_window_cross_prepare_dup_seq_fallback():
+    """A window with a duplicate id across prepares produces the same
+    replies as sequential commits (via the in-ledger fallback)."""
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import (
+        OPERATION_SPECS,
+        StateMachine,
+    )
+    from tigerbeetle_tpu.types import Operation
+
+    def fresh():
+        sm = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 12)
+        sm.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 9)], TS)
+        return sm
+
+    spec = OPERATION_SPECS[Operation.create_transfers]
+    tr = Transfer(id=5000, debit_account_id=1, credit_account_id=2,
+                  ledger=1, code=1, amount=9).pack()
+    bodies = [multi_batch.encode([tr], spec.event_size),
+              multi_batch.encode([tr], spec.event_size)]
+    tss = [TS + 50_000, TS + 51_000]
+    sm_a = fresh()
+    seq = [sm_a.commit(Operation.create_transfers, b, ts)
+           for b, ts in zip(bodies, tss)]
+    sm_b = fresh()
+    win = sm_b.commit_window(Operation.create_transfers, bodies, tss)
+    assert seq == win
+    assert sm_b.led.window_fallbacks == 1
+
+
 def test_varying_batch_sizes():
     rng = np.random.default_rng(13)
     batches = []

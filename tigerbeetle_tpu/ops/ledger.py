@@ -363,6 +363,7 @@ class DeviceLedger:
         self.fallbacks = 0
         self.fast_batches = 0
         self.fixpoint_batches = 0
+        self.window_fallbacks = 0
         # Adaptive kernel routing: after a batch resolves breaches via the
         # limit fixpoint, later batches dispatch the fixpoint kernel first
         # (skipping the headroom-proof attempt that would fail anyway)
@@ -462,6 +463,52 @@ class DeviceLedger:
         ts = np.fromiter((r.timestamp for r in out), dtype=np.uint64,
                          count=len(out))
         return st, ts
+
+    def create_transfers_window(self, evs: list[dict],
+                                timestamps: list[int]):
+        """K prepares in ONE superbatch dispatch (commit-window
+        aggregation; the group-commit analog of the reference's 8-deep
+        prepare pipeline, src/config.zig:155). Returns a list of
+        (status u32[n_b], ts u64[n_b]) pairs, one per prepare.
+
+        Any cross-prepare dependency (duplicate ids, posts of in-window
+        pendings, headroom/overflow proof failures) makes the superbatch
+        kernel fall back with state untouched; the window then executes
+        per-prepare through create_transfers_arrays, which preserves the
+        exact sequential semantics (including the fixpoint redispatch
+        and the host-mirror path)."""
+        import jax
+
+        from .fast_kernels import create_transfers_super_jit
+
+        assert len(evs) == len(timestamps) and evs
+        ns = [len(e["id_lo"]) for e in evs]
+        eligible = (len(evs) > 1 and not self._mirror_route()
+                    and not self._fixpoint_first)
+        if eligible:
+            n_pad = _pad_bucket(max(ns))
+            ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
+            ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
+            seg = {k: jax.device_put(v) for k, v in seg.items()}
+            new_state, out = create_transfers_super_jit(
+                self.state, ev_s, seg)
+            self.state = new_state
+            if not bool(jax.device_get(out["fallback"])):
+                self.fast_batches += len(evs)
+                self._probe_succeeded()
+                st_all = np.asarray(out["r_status"])
+                ts_all = np.asarray(out["r_ts"])
+                results = []
+                for b, (ev, n_b) in enumerate(zip(evs, ns)):
+                    st = st_all[b * n_pad:b * n_pad + n_b]
+                    ts = ts_all[b * n_pad:b * n_pad + n_b]
+                    if self._wt:
+                        self._capture_fast_delta_transfers(ev, st)
+                    results.append((st, ts))
+                return results
+            self.window_fallbacks += 1
+        return [self.create_transfers_soa(ev, ts)
+                for ev, ts in zip(evs, timestamps)]
 
     def create_transfers_arrays(self, ev: dict, timestamp: int,
                                 transfers=None, raw=False):
