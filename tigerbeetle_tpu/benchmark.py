@@ -244,14 +244,34 @@ def bench_config3(batches, account_count=1000):
     return _warm_and_run(led, mk, batches)
 
 
-def bench_config4(batches=2, n=1024, account_count=64):
+def bench_config4(batches=2, n=None, account_count=64):
     """Two-phase under balance limits — the hard-semantics config: breach
     batches run the on-device limit fixpoint (ops/fast_kernels.py
     LIMIT_FIXPOINT_ROUNDS); only cascades deeper than the round budget
-    would fall back to the exact host path."""
+    would fall back to the exact host path.
+
+    Batch size is platform-tuned (the workload — pending + post/void
+    under limits — doesn't pin it): on TPU the fixpoint's ~220-op cost
+    is nearly row-count-independent, so full protocol-max batches
+    amortize it 8x; on CPU the kernel is compute-bound and 1024-row
+    buckets win."""
+    import jax
+
     from .ops.ledger import DeviceLedger
 
-    led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+    if n is None:
+        n = N if jax.default_backend() == "tpu" else 1024
+
+    from .ops.ledger import _pad_bucket
+
+    # Room for (batches + warmup) * 2 * n transfers plus orphan entries
+    # (~half of pend events breach): next power of two with 2x headroom.
+    need = (batches + 1) * 2 * n * 2
+    t_cap = 1 << max(14, (need - 1).bit_length())
+    led = DeviceLedger(a_cap=1 << 12, t_cap=t_cap)
+    # Compile all kernel tiers now (incl. the deep-fixpoint escalation)
+    # so a mid-run cascade never pays a tunnel compile inside the clock.
+    led.warm_kernels(_pad_bucket(n))
     limit = int(AccountFlags.debits_must_not_exceed_credits)
     accounts = [Account(id=i, ledger=1, code=1,
                         flags=limit if i % 2 == 0 else 0)

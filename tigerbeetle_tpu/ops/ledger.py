@@ -345,6 +345,9 @@ class DeviceLedger:
     # After this many consecutive batches in the host-mirror regime, drop
     # the mirror and probe the device fast path again (hysteresis).
     MIRROR_PROBE_INTERVAL = 8
+    # After an 8->32-round escalation, dispatch the deep tier directly
+    # for this many breach batches before re-probing the shallow one.
+    DEEP_PROBE_INTERVAL = 8
 
     def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21,
                  write_through=None):
@@ -363,7 +366,9 @@ class DeviceLedger:
         self.fallbacks = 0
         self.fast_batches = 0
         self.fixpoint_batches = 0
+        self.deep_fixpoint_batches = 0
         self.window_fallbacks = 0
+        self._deep_first = 0
         # Adaptive kernel routing: after a batch resolves breaches via the
         # limit fixpoint, later batches dispatch the fixpoint kernel first
         # (skipping the headroom-proof attempt that would fail anyway)
@@ -510,6 +515,44 @@ class DeviceLedger:
         return [self.create_transfers_soa(ev, ts)
                 for ev, ts in zip(evs, timestamps)]
 
+    def _escalate_fixpoint(self, evp, timestamp, n):
+        """The 8-round fixpoint reported a limit cascade deeper than its
+        budget (and no other obstacle): resolve it on device with the
+        32-round variant before considering the host path. Returns
+        (fallback, out) from the deep run and enters the deep-first
+        regime (the shallow dispatch is a known waste while cascades
+        stay deep)."""
+        from .fast_kernels import create_transfers_fixpoint_deep_jit
+
+        new_state, deep_out = create_transfers_fixpoint_deep_jit(
+            self.state, evp, np.uint64(timestamp), np.int32(n))
+        self.state = new_state
+        self.deep_fixpoint_batches += 1
+        self._deep_first = self.DEEP_PROBE_INTERVAL
+        return bool(deep_out["fallback"]), deep_out
+
+    def warm_kernels(self, n_pad: int = N_PAD) -> None:
+        """Compile every transfer-kernel variant (fast / fixpoint /
+        deep fixpoint) at the given padded shape with an all-invalid
+        batch — no state change, no events created. Drivers call this
+        once so a mid-run escalation never pays a tunnel compile inside
+        a timed region."""
+        import jax
+
+        from .batch import transfers_to_arrays
+        from .fast_kernels import (
+            create_transfers_fast_jit,
+            create_transfers_fixpoint_deep_jit,
+            create_transfers_fixpoint_jit,
+        )
+
+        evp = pad_transfer_events(transfers_to_arrays([]), n_pad)
+        evp = {k: jax.device_put(v) for k, v in evp.items()}
+        for f in (create_transfers_fast_jit, create_transfers_fixpoint_jit,
+                  create_transfers_fixpoint_deep_jit):
+            self.state, out = f(self.state, evp, np.uint64(1), np.int32(0))
+            assert not bool(out["fallback"])
+
     def create_transfers_arrays(self, ev: dict, timestamp: int,
                                 transfers=None, raw=False):
         """ev: unpadded SoA dict (the zero-host-cost entry point)."""
@@ -537,11 +580,29 @@ class DeviceLedger:
             # The workload has been breaching balance limits: skip the
             # doomed headroom-proof dispatch and go straight to the
             # fixpoint kernel; drop back once a batch reports no breach.
-            new_state, out = create_transfers_fixpoint_jit(
-                self.state, evp, np.uint64(timestamp), np.int32(n))
-            self.state = new_state
-            fallback, limit_hit = (bool(x) for x in jax.device_get(
-                (out["fallback"], out["limit_hit"])))
+            # While cascades have been exceeding the shallow budget, go
+            # straight to the DEEP tier too, re-probing the shallow one
+            # every DEEP_PROBE_INTERVAL batches (same hysteresis shape
+            # as the mirror probe).
+            from .fast_kernels import create_transfers_fixpoint_deep_jit
+
+            if self._deep_first > 0:
+                self._deep_first -= 1
+                new_state, out = create_transfers_fixpoint_deep_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                self.deep_fixpoint_batches += 1
+                fallback, limit_hit = (bool(x) for x in jax.device_get(
+                    (out["fallback"], out["limit_hit"])))
+            else:
+                new_state, out = create_transfers_fixpoint_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                fallback, limit_hit = (bool(x) for x in jax.device_get(
+                    (out["fallback"], out["limit_hit"])))
+                if fallback and bool(out["fix_unconverged"]):
+                    fallback, out = self._escalate_fixpoint(
+                        evp, timestamp, n)
             if not fallback:
                 self.fixpoint_batches += 1
                 if not limit_hit:
@@ -560,6 +621,9 @@ class DeviceLedger:
                     self.state, evp, np.uint64(timestamp), np.int32(n))
                 self.state = new_state
                 fallback = bool(out["fallback"])
+                if fallback and bool(out["fix_unconverged"]):
+                    fallback, out = self._escalate_fixpoint(
+                        evp, timestamp, n)
                 if not fallback:
                     self.fixpoint_batches += 1
                     self._fixpoint_first = True
