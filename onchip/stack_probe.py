@@ -1,4 +1,4 @@
-"""Find the superbatch knee: steady-state dispatch time at stack 8/16/32."""
+"""Find the superbatch knee: steady-state dispatch time per stack factor."""
 import json, time
 import sys; sys.path.insert(0, "/root/repo")
 import numpy as np
@@ -21,7 +21,7 @@ def mk(b):
     return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
 
 bi = 0
-for stack in (16, 32):
+for stack in (32, 64):
     led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 21)
     groups = []
     for g in range(3):

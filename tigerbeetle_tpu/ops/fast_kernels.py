@@ -368,8 +368,13 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     pv = is_post | is_void
 
     # ---------------- lookups ----------------
-    # One batched probe per table (concatenated key sets): 3 lookups
-    # instead of 5 — bucket gathers dominate this stage's op count.
+    # One batched probe per table (concatenated key sets): 2 lookups
+    # instead of 5 — bucket gathers dominate this stage's op count. The
+    # transfer table carries ORPHANED (transiently-failed) ids inline
+    # with val = ORPHAN_VAL: the two sets are disjoint forever (a
+    # transient failure permanently poisons its id — reference
+    # id_already_failed, src/state_machine.zig:3734), so one probe of
+    # ev.id answers both exists and already-failed.
     N_ev = ev["id_lo"].shape[0]
     a_found, a_row = ht_lookup(
         state["acct_ht"],
@@ -377,13 +382,16 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
         jnp.concatenate([ev["dr_lo"], ev["cr_lo"]]))
     dr_found, cr_found = a_found[:N_ev], a_found[N_ev:]
     dr_row, cr_row = a_row[:N_ev], a_row[N_ev:]
-    x_found, x_row = ht_lookup(
+    x_found, x_val = ht_lookup(
         state["xfer_ht"],
         jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
         jnp.concatenate([ev["id_lo"], ev["pid_lo"]]))
-    e_found, p_found = x_found[:N_ev], x_found[N_ev:]
-    e_row, p_row = x_row[:N_ev], x_row[N_ev:]
-    o_found, _ = ht_lookup(state["orphan_ht"], ev["id_hi"], ev["id_lo"])
+    live = x_val >= 0
+    e_found = x_found[:N_ev] & live[:N_ev]
+    o_found = x_found[:N_ev] & ~live[:N_ev]
+    # A pid pointing at an orphaned id is "pending transfer not found".
+    p_found = x_found[N_ev:] & live[N_ev:]
+    e_row, p_row = x_val[:N_ev], x_val[N_ev:]
 
     dr_rowc = jnp.where(dr_found, dr_row, A_dump)
     cr_rowc = jnp.where(cr_found, cr_row, A_dump)
@@ -538,7 +546,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     LAYOUT (two-choice placement reads occupancy at plan time); the
     key->row mapping and every derived result are identical
     (tests/test_superbatch.py pins this)."""
-    from .hash_table import ht_plan, ht_write
+    from .hash_table import ORPHAN_VAL, ht_plan, ht_write
 
     acc = state["accounts"]
     xfr = state["transfers"]
@@ -820,12 +828,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         transient = transient | (status == code)
     orphan_new = valid & transient
 
+    # Created rows and new orphans are disjoint id sets in the SAME
+    # table (orphans carry ORPHAN_VAL): one plan + one write.
+    ins_mask = created | orphan_new
     xfer_pos, ins_ok = ht_plan(
-        state["xfer_ht"], ev["id_hi"], ev["id_lo"], created)
-    orph_pos, orph_ok = ht_plan(
-        state["orphan_ht"], ev["id_hi"], ev["id_lo"], orphan_new)
+        state["xfer_ht"], ev["id_hi"], ev["id_lo"], ins_mask)
 
-    others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok | ~orph_ok
+    others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok
     if force_fallback is not None:
         others = others | force_fallback
     fallback = others | e3
@@ -903,10 +912,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     }
 
     new_xfer_ht = ht_write(
-        state["xfer_ht"], xfer_pos, ev["id_hi"], ev["id_lo"], new_rows, ap)
-    new_orphan_ht = ht_write(
-        state["orphan_ht"], orph_pos, ev["id_hi"], ev["id_lo"],
-        jnp.zeros(N, dtype=jnp.int32), orphan_new & ok)
+        state["xfer_ht"], xfer_pos, ev["id_hi"], ev["id_lo"],
+        jnp.where(created, new_rows, jnp.int32(ORPHAN_VAL)),
+        ins_mask & ok)
 
     # ------- account_events history ring (reference: account_event(),
     # src/state_machine.zig:4384-4470 — POST-application balance snapshots
@@ -1052,7 +1060,6 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         events=new_evr,
         acct_ht=state["acct_ht"],
         xfer_ht=new_xfer_ht,
-        orphan_ht=new_orphan_ht,
         acct_key_max=state["acct_key_max"],
         xfer_key_max=key_max,
         pulse_next=pulse,

@@ -37,6 +37,12 @@ import numpy as np
 
 SLOTS = 8
 
+# Sentinel value for orphaned (transiently-failed) transfer ids stored
+# inline in the transfer table: the id sets are disjoint forever
+# (id_already_failed is permanent), so sign distinguishes a live row
+# index (>= 0) from an orphan marker with one probe.
+ORPHAN_VAL = -2
+
 _C1 = np.uint64(0x9E3779B97F4A7C15)
 _C2 = np.uint64(0xBF58476D1CE4E5B9)
 _C3 = np.uint64(0xD6E8FEB86659FD93)
@@ -93,7 +99,13 @@ def ht_lookup(table: dict, k_hi, k_lo):
 
     Exactly two bucket gathers per query (ONE packed row each); keys
     equal to the sentinel (0) are reported as absent. Absence is
-    definitive: a key can only ever reside in one of its two buckets."""
+    definitive: a key can only ever reside in one of its two buckets.
+
+    NOTE: negative stored vals (ORPHAN_VAL) surface as -1, not their
+    stored value — the miss filler (-1) wins the lane max-reduce. Test
+    `found & (val >= 0)` for a live row and `found & (val < 0)` for an
+    orphan marker; never compare a lookup val to ORPHAN_VAL itself
+    (ht_live_items returns exact stored vals when those are needed)."""
     b = table["packed"].shape[0] - 1
     querying = ~((k_hi == 0) & (k_lo == 0))
     b1, b2 = _buckets(k_hi, k_lo, b)
@@ -222,14 +234,16 @@ def ht_insert(table: dict, k_hi, k_lo, vals, mask):
     return table, ok
 
 
-def ht_live_keys(table: dict):
-    """Host helper: (key_hi, key_lo) numpy arrays of all live slots
-    (dump bucket excluded)."""
+def ht_live_items(table: dict):
+    """Host helper: (key_hi, key_lo, val) numpy arrays of all live slots
+    (dump bucket excluded). val is int32 — negative values are sentinel
+    markers (ORPHAN_VAL), non-negative are row indexes."""
     p = np.asarray(table["packed"])[:-1]
     kh = p[:, :SLOTS].reshape(-1)
     kl = p[:, SLOTS:2 * SLOTS].reshape(-1)
+    v = p[:, 2 * SLOTS:].reshape(-1).astype(np.int64).astype(np.int32)
     live = (kh != 0) | (kl != 0)
-    return kh[live], kl[live]
+    return kh[live], kl[live], v[live]
 
 
 # Jitted entry point for host-driven batch inserts (the mirror regime's

@@ -47,7 +47,7 @@ _CTS_BY_CODE = {int(m): m for m in CreateTransferStatus}
 _CAS_BY_CODE = {int(m): m for m in CreateAccountStatus}
 _TRANSIENT_ARR = np.fromiter(_TRANSIENT_CODES, dtype=np.uint32)
 from . import u128
-from .hash_table import ht_init
+from .hash_table import ORPHAN_VAL, ht_init
 
 N_PAD = 8192
 assert N_PAD >= BATCH_MAX
@@ -215,13 +215,17 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         # load low enough that bucket overflow stays improbable even for
         # failure-heavy workloads.
         orphan_cap = max(1 << 16, t_cap)
+    # Orphans live INLINE in the transfer table (val = ORPHAN_VAL; the id
+    # sets are disjoint forever), so one probe serves exists +
+    # already-failed and one plan serves both insert kinds. Size for both
+    # populations at <= 50% load.
+    xfer_cap = 1 << (2 * t_cap + 2 * orphan_cap - 1).bit_length()
     return dict(
         accounts=rows_accounts(),
         transfers=rows_transfers(),
         events=rows_events(),
         acct_ht=ht_init(2 * a_cap),
-        xfer_ht=ht_init(2 * t_cap),
-        orphan_ht=ht_init(orphan_cap),
+        xfer_ht=ht_init(xfer_cap),
         acct_key_max=np.uint64(0),
         xfer_key_max=np.uint64(0),
         pulse_next=np.uint64(1),
@@ -660,6 +664,9 @@ class DeviceLedger:
         lo = np.array([i & (1 << 64) - 1 for i in ids], dtype=np.uint64)
         found, rows = ht_lookup(self.state[table_key], jnp.asarray(hi),
                                 jnp.asarray(lo))
+        # Orphan sentinels (negative vals in the transfer table) are not
+        # live objects — a lookup must miss them.
+        found = found & (rows >= 0)
         rows = jnp.maximum(rows, 0)
         store = self.state[store_key]
         gathered = {k: np.asarray(store[k][rows]) for k in store
@@ -751,10 +758,12 @@ class DeviceLedger:
                         and t.timeout != 0):
                     sm.expiry[t.timestamp] = t.timestamp + t.timeout * NS_PER_S
 
-        from .hash_table import ht_live_keys
+        from .hash_table import ht_live_items
 
-        o_hi, o_lo = ht_live_keys(self.state["orphan_ht"])
-        for hi_k, lo_k in zip(o_hi.tolist(), o_lo.tolist()):
+        o_hi, o_lo, o_val = ht_live_items(self.state["xfer_ht"])
+        orphan = o_val < 0
+        for hi_k, lo_k in zip(o_hi[orphan].tolist(),
+                              o_lo[orphan].tolist()):
             sm.orphaned.add(u128.to_int(hi_k, lo_k))
 
         sm.accounts_key_max = int(self.state["acct_key_max"]) or None
@@ -901,9 +910,9 @@ class DeviceLedger:
         xfr["count"] = np.int32(len(transfers))
         st["transfers"] = {k: jnp.asarray(v) for k, v in xfr.items()}
         st["xfer_ht"] = batch_insert(
-            st["xfer_ht"], [(t.id, r) for r, t in enumerate(transfers)])
-        st["orphan_ht"] = batch_insert(
-            st["orphan_ht"], [(oid, 0) for oid in sorted(sm.orphaned)])
+            st["xfer_ht"],
+            [(t.id, r) for r, t in enumerate(transfers)]
+            + [(oid, ORPHAN_VAL) for oid in sorted(sm.orphaned)])
 
         st["acct_key_max"] = np.uint64(sm.accounts_key_max or 0)
         st["xfer_key_max"] = np.uint64(sm.transfers_key_max or 0)
@@ -1545,18 +1554,19 @@ class DeviceLedger:
             xfr["u64"] = xfr["u64"].at[rows, XF_U64_IDX["expires"]].set(
                 jnp.asarray(vals))
 
-        # ---- orphaned ids
+        # ---- orphaned ids (inline in the transfer table, val sentinel)
         dirty_orphans = sorted(sm.orphaned.dirty_dev)
         sm.orphaned.dirty_dev.clear()
         if dirty_orphans:
-            st["orphan_ht"], ok = ht_insert(
-                st["orphan_ht"],
+            st["xfer_ht"], ok = ht_insert(
+                st["xfer_ht"],
                 jnp.asarray(pad(np.array([o >> 64 for o in dirty_orphans],
                                          dtype=np.uint64), 0)),
                 jnp.asarray(pad(np.array(
                     [o & (1 << 64) - 1 for o in dirty_orphans],
                     dtype=np.uint64), 0)),
-                jnp.zeros(bucket(len(dirty_orphans)), dtype=np.int32),
+                jnp.full(bucket(len(dirty_orphans)), ORPHAN_VAL,
+                         dtype=np.int32),
                 pad_mask(len(dirty_orphans)))
             assert bool(ok), "orphan hash overflow: raise capacities"
 
