@@ -1,0 +1,62 @@
+"""Release tooling: version bump, commit classification, changelog
+assembly, preflight (reference analog: src/scripts/release.zig +
+changelog.zig)."""
+
+import importlib.util
+import os
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "release", os.path.join(os.path.dirname(__file__), "..", "scripts",
+                            "release.py"))
+release = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(release)
+
+
+def test_bump_levels():
+    assert release.bump("1.2.3", "patch") == "1.2.4"
+    assert release.bump("1.2.3", "minor") == "1.3.0"
+    assert release.bump("1.2.3", "major") == "2.0.0"
+    with pytest.raises(AssertionError):
+        release.bump("1.2.3", "nightly")
+
+
+def test_classify_routes_by_first_matching_prefix():
+    assert release.classify(
+        ["tigerbeetle_tpu/ops/ledger.py"]) == "Kernel & device engine"
+    assert release.classify(
+        ["tigerbeetle_tpu/vsr/replica.py"]) == "Consensus & durability"
+    assert release.classify(["native/tb_client.cpp"]) == "Native runtime"
+    assert release.classify(["clients/go/types.go"]) == "Clients"
+    assert release.classify(["README.md"]) == "Other"
+    # package fallback comes after the specific subtrees
+    assert release.classify(
+        ["tigerbeetle_tpu/state_machine.py"]) == "State machine & framework"
+
+
+def test_changelog_section_grouping_and_order():
+    commits = [
+        {"sha": "aaa", "subject": "Fix replica repair",
+         "files": ["tigerbeetle_tpu/vsr/replica.py"]},
+        {"sha": "bbb", "subject": "Faster kernel",
+         "files": ["tigerbeetle_tpu/ops/fast_kernels.py"]},
+        {"sha": "ccc", "subject": "Go client fix",
+         "files": ["clients/go/types.go"]},
+    ]
+    sec = release.changelog_section("1.0.0", commits, date="2026-08-01")
+    assert sec.startswith("## 1.0.0 — 2026-08-01")
+    k = sec.index("Kernel & device engine")
+    v = sec.index("Consensus & durability")
+    c = sec.index("Clients")
+    assert k < v < c  # canonical area order
+    assert "- Faster kernel (`bbb`)" in sec
+
+
+def test_current_version_and_preflight():
+    v = release.current_version()
+    assert len(v.split(".")) == 3
+    # Not asserting cleanliness (the working tree varies in dev); the
+    # version-shape check must pass on the real repo.
+    problems = release.preflight(require_clean=False)
+    assert all("semver" not in p for p in problems)
