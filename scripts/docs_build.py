@@ -1,0 +1,180 @@
+"""Static docs-site generator (reference analog: src/docs_website/ —
+a Zig build that renders docs/ markdown through pandoc to
+docs.tigerbeetle.com; here a dependency-free renderer for the markdown
+subset the docs use).
+
+Usage: python scripts/docs_build.py [--out DIR]
+
+Renders every docs/**/*.md to HTML with a section nav, rewrites
+intra-docs .md links to .html, and fails the build on a broken internal
+link (link checking is the part that actually rots)."""
+
+from __future__ import annotations
+
+import argparse
+import html
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOCS = os.path.join(REPO, "docs")
+
+PAGE = """<!doctype html>
+<html lang="en"><head><meta charset="utf-8">
+<title>{title} — tigerbeetle_tpu</title>
+<style>
+body {{ font: 16px/1.55 system-ui, sans-serif; max-width: 72ch;
+       margin: 2rem auto; padding: 0 1rem; color: #1a1a1a; }}
+pre {{ background: #f6f6f6; padding: .8rem; overflow-x: auto; }}
+code {{ background: #f6f6f6; padding: 0 .2em; }}
+table {{ border-collapse: collapse; }}
+td, th {{ border: 1px solid #ccc; padding: .3em .6em; text-align: left; }}
+nav {{ font-size: .9em; border-bottom: 1px solid #ddd;
+      margin-bottom: 1.5rem; padding-bottom: .5rem; }}
+</style></head><body>
+<nav><a href="{root}index.html">docs</a> · tigerbeetle_tpu</nav>
+{body}
+</body></html>
+"""
+
+
+def _inline(text: str) -> str:
+    text = html.escape(text, quote=False)
+    text = re.sub(r"`([^`]+)`", r"<code>\1</code>", text)
+    text = re.sub(r"\*\*([^*]+)\*\*", r"<b>\1</b>", text)
+    text = re.sub(r"\[([^\]]+)\]\(([^)]+)\)",
+                  lambda m: '<a href="%s">%s</a>' % (
+                      re.sub(r"\.md\b", ".html", m.group(2)), m.group(1)),
+                  text)
+    return text
+
+
+def render(md: str) -> tuple[str, str]:
+    """Markdown subset -> (title, html body)."""
+    lines = md.splitlines()
+    out: list[str] = []
+    title = "docs"
+    i = 0
+    in_list = False
+
+    def close_list():
+        nonlocal in_list
+        if in_list:
+            out.append("</ul>")
+            in_list = False
+
+    while i < len(lines):
+        ln = lines[i]
+        if ln.startswith("```"):
+            close_list()
+            block = []
+            i += 1
+            while i < len(lines) and not lines[i].startswith("```"):
+                block.append(lines[i])
+                i += 1
+            out.append("<pre><code>%s</code></pre>"
+                       % html.escape("\n".join(block)))
+        elif ln.startswith("#"):
+            close_list()
+            level = len(ln) - len(ln.lstrip("#"))
+            text = ln.lstrip("#").strip()
+            if level == 1:
+                title = text
+            out.append(f"<h{level}>{_inline(text)}</h{level}>")
+        elif ln.startswith("|"):
+            close_list()
+            rows = []
+            while i < len(lines) and lines[i].startswith("|"):
+                cells = [c.strip() for c in
+                         lines[i].strip("|").split("|")]
+                if not all(re.fullmatch(r":?-+:?", c) for c in cells):
+                    rows.append(cells)
+                i += 1
+            i -= 1
+            tag = "th"
+            out.append("<table>")
+            for row in rows:
+                out.append("<tr>" + "".join(
+                    f"<{tag}>{_inline(c)}</{tag}>" for c in row) + "</tr>")
+                tag = "td"
+            out.append("</table>")
+        elif ln.lstrip().startswith("- "):
+            if not in_list:
+                out.append("<ul>")
+                in_list = True
+            item = [ln.lstrip()[2:]]
+            while (i + 1 < len(lines) and lines[i + 1].startswith("  ")
+                   and not lines[i + 1].lstrip().startswith("- ")):
+                i += 1
+                item.append(lines[i].strip())
+            out.append(f"<li>{_inline(' '.join(item))}</li>")
+        elif not ln.strip():
+            close_list()
+        else:
+            close_list()
+            para = [ln]
+            while (i + 1 < len(lines) and lines[i + 1].strip()
+                   and not re.match(r"[#`|]|- ", lines[i + 1])):
+                i += 1
+                para.append(lines[i])
+            out.append(f"<p>{_inline(' '.join(para))}</p>")
+        i += 1
+    close_list()
+    return title, "\n".join(out)
+
+
+def collect() -> list[str]:
+    pages = []
+    for root, _dirs, files in os.walk(DOCS):
+        for f in sorted(files):
+            if f.endswith(".md"):
+                pages.append(os.path.relpath(os.path.join(root, f), DOCS))
+    return pages
+
+
+def check_links(pages: list[str]) -> list[str]:
+    known = set(pages)
+    broken = []
+    for rel in pages:
+        src = open(os.path.join(DOCS, rel)).read()
+        for m in re.finditer(r"\]\(([^)#]+\.md)", src):
+            target = os.path.normpath(
+                os.path.join(os.path.dirname(rel), m.group(1)))
+            if target not in known:
+                broken.append(f"{rel}: {m.group(1)}")
+    return broken
+
+
+def build(out_dir: str) -> list[str]:
+    pages = collect()
+    broken = check_links(pages)
+    if broken:
+        raise SystemExit("broken internal links:\n  " + "\n  ".join(broken))
+    for rel in pages:
+        md = open(os.path.join(DOCS, rel)).read()
+        title, body = render(md)
+        dest_rel = re.sub(
+            r"README\.md$", "index.html", rel)
+        if dest_rel.endswith(".md"):
+            dest_rel = dest_rel[:-3] + ".html"
+        dest = os.path.join(out_dir, dest_rel)
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        depth = dest_rel.count(os.sep)
+        with open(dest, "w") as f:
+            f.write(PAGE.format(title=html.escape(title), body=body,
+                                root="../" * depth))
+    return pages
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--out", default=os.path.join(REPO, "docs", "_site"))
+    args = ap.parse_args()
+    pages = build(args.out)
+    print(f"built {len(pages)} pages -> {args.out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

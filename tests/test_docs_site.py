@@ -1,0 +1,47 @@
+"""Docs site generator: markdown rendering, link rewriting/checking,
+full-tree build (reference analog: src/docs_website/)."""
+
+import importlib.util
+import os
+
+import pytest
+
+spec = importlib.util.spec_from_file_location(
+    "docs_build", os.path.join(os.path.dirname(__file__), "..",
+                               "scripts", "docs_build.py"))
+docs_build = importlib.util.module_from_spec(spec)
+spec.loader.exec_module(docs_build)
+
+
+def test_render_subset():
+    title, body = docs_build.render(
+        "# Title\n\nPara with `code` and **bold** and "
+        "[a link](other.md).\n\n"
+        "```\nraw <code>\n```\n\n"
+        "- item one\n- item two\n\n"
+        "| a | b |\n|---|---|\n| 1 | 2 |\n")
+    assert title == "Title"
+    assert "<h1>Title</h1>" in body
+    assert "<code>code</code>" in body and "<b>bold</b>" in body
+    assert '<a href="other.html">a link</a>' in body
+    assert "raw &lt;code&gt;" in body
+    assert body.count("<li>") == 2
+    assert "<th>a</th>" in body and "<td>1</td>" in body
+
+
+def test_full_build_and_links(tmp_path):
+    pages = docs_build.build(str(tmp_path))
+    assert "start.md" in pages
+    assert (tmp_path / "index.html").exists()  # README.md -> index
+    assert (tmp_path / "internals" / "serving-kernel.html").exists()
+    html = (tmp_path / "start.html").read_text()
+    assert 'href="concepts/debit-credit.html"' in html
+
+
+def test_broken_link_fails(tmp_path, monkeypatch):
+    d = tmp_path / "docs"
+    d.mkdir()
+    (d / "a.md").write_text("# A\n\n[missing](nope.md)\n")
+    monkeypatch.setattr(docs_build, "DOCS", str(d))
+    with pytest.raises(SystemExit, match="broken internal links"):
+        docs_build.build(str(tmp_path / "out"))
