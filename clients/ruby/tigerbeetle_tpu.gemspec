@@ -1,0 +1,11 @@
+# Generated package; compile-level CI runs wherever a
+# ruby interpreter exists (stdlib only: Fiddle + minitest).
+Gem::Specification.new do |s|
+  s.name = 'tigerbeetle_tpu'
+  s.version = '0.2.0'
+  s.summary = 'Ruby client for the tigerbeetle_tpu cluster protocol'
+  s.authors = ['tigerbeetle_tpu']
+  s.files = Dir['lib/**/*.rb']
+  s.license = 'Apache-2.0'
+  s.required_ruby_version = '>= 3.0'
+end
