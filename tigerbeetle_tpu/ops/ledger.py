@@ -508,12 +508,13 @@ class DeviceLedger:
                 st_all = np.asarray(out["r_status"])
                 ts_all = np.asarray(out["r_ts"])
                 results = []
-                for b, (ev, n_b) in enumerate(zip(evs, ns)):
-                    st = st_all[b * n_pad:b * n_pad + n_b]
-                    ts = ts_all[b * n_pad:b * n_pad + n_b]
-                    if self._wt:
-                        self._capture_fast_delta_transfers(ev, st)
-                    results.append((st, ts))
+                for b, n_b in enumerate(ns):
+                    results.append(
+                        (st_all[b * n_pad:b * n_pad + n_b],
+                         ts_all[b * n_pad:b * n_pad + n_b]))
+                if self._wt:
+                    self._capture_window_delta(
+                        evs, [st for st, _ in results])
                 return results
             self.window_fallbacks += 1
         return [self.create_transfers_soa(ev, ts)
@@ -1064,14 +1065,18 @@ class DeviceLedger:
         """Bounded device->host fetch of one fast batch's effects: the
         n_new appended transfer rows + event-ring rows, plus derived
         gathers (touched account ids, pending-transfer timestamps). Fixed
-        slice sizes (256 / N_PAD) keep the compile count at two."""
+        slice sizes (256 / N_PAD / 8*N_PAD) keep the compile count at
+        three — point batches, one prepare, a full commit window."""
         import jax
 
         t0 = self._xfer_rows_dev
         e0 = self._events_pushed
         t_len = int(self.state["transfers"]["u64"].shape[0])
         e_len = ev_cap(self.state["events"]) + 1
-        size = 256 if n_new <= 256 else N_PAD
+        # Buckets: point batches, one prepare, a full commit window.
+        for size in (256, N_PAD, 8 * N_PAD):
+            if n_new <= size:
+                break
         size_t = min(size, t_len)
         size_e = min(size, e_len)
         assert n_new <= size_t and n_new <= size_e
@@ -1090,6 +1095,78 @@ class DeviceLedger:
                          "p_ts")}
         return t, e, der, t0
 
+    def _capture_window_delta(self, evs: list, st_slices: list) -> None:
+        """Window-level write-through capture: ONE bounded device fetch
+        for a whole commit window's effects (the window kernel appends
+        all created rows contiguously in commit order), split into
+        per-prepare chunks so the drain and the durable flush keep their
+        per-prepare watermark semantics. Replaces W per-body fetches —
+        each a full device round-trip — with one (the dominant serving
+        cost on chip once the kernel itself is windowed)."""
+        per = [self._batch_delta_stats(ev, st_np)
+               for ev, st_np in zip(evs, st_slices)]
+
+        def flush_group(group):
+            total = sum(n for n, _ in group)
+            if total:
+                t, e, der, t0 = self._xfer_delta_fetch(total)
+            off = 0
+            for n_new, orphan_ids in group:
+                if n_new:
+                    # Copies, not views: a view would pin the whole
+                    # group-sized fetch buffer in the retained flush
+                    # queue until the durable flush consumes it.
+                    tc = {k: v[off:off + n_new].copy()
+                          for k, v in t.items()}
+                    ec = {k: v[off:off + n_new].copy()
+                          for k, v in e.items()}
+                    derc = {k: v[off:off + n_new].copy()
+                            for k, v in der.items()}
+                    self._mirror_chunks.append(
+                        (tc, ec, derc, t0 + off, n_new, orphan_ids))
+                    if self.retain_flush_columns:
+                        self._flush_columns.append(
+                            (tc, ec, derc, n_new, self._events_seen_abs,
+                             orphan_ids))
+                    self._xfer_rows_dev += n_new
+                    self._events_pushed += n_new
+                    self._events_seen_abs += n_new
+                    off += n_new
+                elif orphan_ids:
+                    self._mirror_chunks.append((None, None, None, 0, 0,
+                                                orphan_ids))
+                    if self.retain_flush_columns:
+                        self._flush_columns.append(
+                            (None, None, None, 0, self._events_seen_abs,
+                             orphan_ids))
+
+        # One fetch per <= 8*N_PAD created rows (the fetch's largest
+        # static bucket); a serving window of 8 prepares fits in one.
+        group: list = []
+        group_new = 0
+        for n_new, orphan_ids in per:
+            if group and group_new + n_new > 8 * N_PAD:
+                flush_group(group)
+                group, group_new = [], 0
+            group.append((n_new, orphan_ids))
+            group_new += n_new
+        if group:
+            flush_group(group)
+        self._clear_dirty_dev()
+        self._maybe_recycle_ring()
+
+    @staticmethod
+    def _batch_delta_stats(ev: dict, st_np):
+        """(created count, orphan ids) of one batch's statuses — the
+        shared per-prepare summary both capture paths queue from."""
+        created_code = np.uint32(int(CreateTransferStatus.created))
+        orph_mask = np.isin(st_np, _TRANSIENT_ARR)
+        orphan_ids = ([
+            (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i])
+            for i in np.nonzero(orph_mask)[0]
+        ] if orph_mask.any() else [])
+        return int((st_np == created_code).sum()), orphan_ids
+
     def _capture_fast_delta_transfers(self, ev: dict, st_np) -> None:
         """Write-through, deferred: fetch the batch's bounded device delta
         and queue it as a columnar chunk. Materialization into the host
@@ -1097,13 +1174,7 @@ class DeviceLedger:
         (drain_mirror) — the serving commit path itself stays object-free
         (the same lazy discipline as StateMachine._refresh_indexes;
         reference: commit is the cheap part, src/state_machine.zig:2564)."""
-        created_code = np.uint32(int(CreateTransferStatus.created))
-        orph_mask = np.isin(st_np, _TRANSIENT_ARR)
-        orphan_ids = ([
-            (int(ev["id_hi"][i]) << 64) | int(ev["id_lo"][i])
-            for i in np.nonzero(orph_mask)[0]
-        ] if orph_mask.any() else [])
-        n_new = int((st_np == created_code).sum())
+        n_new, orphan_ids = self._batch_delta_stats(ev, st_np)
         if n_new == 0:
             if orphan_ids:
                 self._mirror_chunks.append((None, None, None, 0, 0,
