@@ -60,11 +60,21 @@ class TestMarzullo:
 
         clock = Clock(0, 3, T())
         assert clock.offset() is None  # no quorum yet
-        clock.learn(1, 900, 1040, 1000)  # rtt 100 -> offset 90 +- 50
+        # rtt 100 -> offset 40 +- 50: interval [-10, 90] OVERLAPS our own
+        # zero-offset interval, so 2 of 3 sources agree = quorum.
+        clock.learn(1, 900, 990, 1000)
         iv = clock.offset()
         assert iv is not None
-        assert iv.lo <= 90 <= iv.hi or iv.hi <= 90  # overlapping with own 0?
+        # Own [0,0] against peer [-10,90]: the overlap is exactly [0,0].
+        assert iv.lo <= 0 <= iv.hi
         assert clock.realtime_synchronized() is not None
+        # A peer sample DISJOINT from every other source is not
+        # agreement, even though two sources were sampled (reference
+        # clock.zig: the smallest interval must be consistent with a
+        # replica quorum).
+        lonely = Clock(0, 3, T())
+        lonely.learn(1, 900, 1040, 1000)  # offset 90 +- 50: [40, 140]
+        assert lonely.offset() is None
 
 
 class TestTracer:
@@ -277,7 +287,8 @@ def test_clock_samples_expire():
 
     t = T()
     clock = Clock(0, 3, t)
-    clock.learn(1, t.now - 100, t.now + 50, t.now)
+    # offset 0 +- 50 (agrees with our own zero interval).
+    clock.learn(1, t.now - 100, t.now - 50, t.now)
     assert clock.offset() is not None
     t.now += clock.window_ns + 1
     assert clock.offset() is None  # stale sample no longer counts

@@ -1,0 +1,257 @@
+"""Client-session and asymmetric-partition scenarios (reference:
+src/vsr/replica_test.zig "Cluster: eviction: ...", "Cluster: network:
+partition client-primary (asymmetric, drop requests/replies)",
+"Cluster: network: partition flexible quorum", "Cluster: prepare beyond
+checkpoint trigger"). Session semantics under faults are where
+at-most-once either holds or silently double-executes — scripted here
+because randomized simulation rarely lines the faults up."""
+
+import struct
+
+import pytest
+
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Account, Operation, Transfer
+from tigerbeetle_tpu import multi_batch
+
+
+def _accounts_body(ids):
+    payload = b"".join(Account(id=i, ledger=1, code=1).pack() for i in ids)
+    return multi_batch.encode([payload], 128)
+
+
+def _transfers_body(specs):
+    payload = b"".join(
+        Transfer(id=t, debit_account_id=d, credit_account_id=c,
+                 ledger=1, code=1, amount=a).pack()
+        for t, d, c, a in specs)
+    return multi_batch.encode([payload], 128)
+
+
+def _drive(cluster, client, requests, ticks=3000):
+    replies = []
+    for op, body in requests:
+        client.request(op, body)
+        ok = cluster.run(ticks, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+        replies.append(client.replies[-1])
+    return replies
+
+
+def _result_statuses(reply):
+    """Decode a create_* reply body to status ints."""
+    out = []
+    for batch in multi_batch.decode(reply.body, 16):
+        for off in range(0, len(batch), 16):
+            _ts, status, _r = struct.unpack_from("<QII", batch, off)
+            out.append(status)
+    return out
+
+
+class TestSessionScenarios:
+    def test_session_eviction_on_overflow(self):
+        """clients_max sessions are live; one more client evicts the
+        lowest-request session (reference client_sessions.zig eviction
+        order) and the table stays at capacity."""
+        cluster = Cluster(seed=21, replica_count=3)
+        cap = cluster.replicas[0].storage.layout.clients_max
+        boot = cluster.client(1)
+        _drive(cluster, boot, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        # boot client has request=1; newer clients get higher numbers.
+        for k in range(2, cap + 1):
+            c = cluster.client(100 + k)
+            _drive(cluster, c, [
+                (Operation.create_transfers,
+                 _transfers_body([(1000 + k, 1, 2, 1)]))])
+            _drive(cluster, c, [
+                (Operation.create_transfers,
+                 _transfers_body([(2000 + k, 1, 2, 1)]))])
+        primary = cluster.replicas[cluster.replicas[0].primary_index()]
+        assert len(primary.sessions.entries) == cap
+        assert 1 in primary.sessions.entries
+        # One more client: the boot session (lowest request number) is
+        # evicted; the table stays at capacity.
+        extra = cluster.client(999)
+        _drive(cluster, extra, [
+            (Operation.create_transfers, _transfers_body([(3000, 1, 2, 1)]))])
+        assert len(primary.sessions.entries) == cap
+        assert 1 not in primary.sessions.entries
+        assert 999 in primary.sessions.entries
+
+    def test_evicted_client_retry_is_idempotent_by_id(self):
+        """After eviction the session's dedupe memory is gone; the
+        DATA-MODEL idempotency (transfer id exists) is what still
+        prevents double-effects (reference doctrine: eviction tells the
+        client to re-register; replays surface as .exists)."""
+        cluster = Cluster(seed=22, replica_count=3)
+        a = cluster.client(1)
+        _drive(cluster, a, [
+            (Operation.create_accounts, _accounts_body([1, 2])),
+            (Operation.create_transfers, _transfers_body([(77, 1, 2, 9)])),
+        ])
+        primary = cluster.replicas[cluster.replicas[0].primary_index()]
+        # Force-evict client 1's session (as a full table would).
+        del primary.sessions.entries[1]
+        # The client retries the SAME logical transfer (fresh request
+        # number — its session is gone): the id check reports exists,
+        # balances move exactly once.
+        replies = _drive(cluster, a, [
+            (Operation.create_transfers, _transfers_body([(77, 1, 2, 9)]))])
+        from tigerbeetle_tpu.types import CreateTransferStatus
+
+        assert _result_statuses(replies[0]) == [
+            int(CreateTransferStatus.exists)]
+        cluster.settle()
+        acct = cluster.replicas[0].state_machine.state.accounts[2]
+        assert acct.credits_posted == 9  # once, not twice
+
+    def test_drop_replies_no_double_execution(self):
+        """Asymmetric client-primary partition, reply direction only:
+        the request commits, the reply is lost, the client's retry is
+        answered from the session table WITHOUT re-execution
+        (reference: partition client-primary asymmetric drop replies)."""
+        cluster = Cluster(seed=23, replica_count=3)
+        c = cluster.client(7)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        primary_id = cluster.replicas[0].primary_index()
+        # Cut ONLY primary -> client replies.
+        cluster.cut(("replica", primary_id), ("client", 7))
+        c.request(Operation.create_transfers,
+                  _transfers_body([(500, 1, 2, 21)]))
+        # The request itself still flows: it commits cluster-wide.
+        ok = cluster.run(
+            4000,
+            until=lambda: 500 in cluster.replicas[primary_id]
+            .state_machine.state.transfers)
+        assert ok, cluster.debug_status()
+        assert not c.idle  # reply was dropped
+        cluster.heal()
+        # The client's periodic resend hits the session table: the
+        # recorded reply is returned, nothing re-executes.
+        ok = cluster.run(5000, until=lambda: c.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        acct = cluster.replicas[0].state_machine.state.accounts[2]
+        assert acct.credits_posted == 21
+        assert sum(
+            1 for t in cluster.replicas[0]
+            .state_machine.state.transfers.values() if t.id == 500) == 1
+
+    def test_drop_requests_retry_after_heal(self):
+        """Asymmetric partition, request direction only: nothing commits
+        while cut; the retry after heal executes exactly once."""
+        cluster = Cluster(seed=24, replica_count=3)
+        c = cluster.client(8)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        for r in range(3):
+            cluster.cut(("client", 8), ("replica", r))
+        c.request(Operation.create_transfers,
+                  _transfers_body([(600, 1, 2, 5)]))
+        cluster.run(1500, until=lambda: False)  # let the cut soak
+        assert not c.idle
+        assert all(600 not in r.state_machine.state.transfers
+                   for r in cluster.replicas)
+        cluster.heal()
+        ok = cluster.run(5000, until=lambda: c.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        acct = cluster.replicas[0].state_machine.state.accounts[2]
+        assert acct.credits_posted == 5
+
+    def test_flexible_quorum_commits_with_backup_cut(self):
+        """R=3 keeps committing with one backup fully cut from its peers
+        (replication quorum 2/3); the backup catches up after heal
+        (reference: partition flexible quorum)."""
+        cluster = Cluster(seed=25, replica_count=3)
+        c = cluster.client(3)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        primary_id = cluster.replicas[0].primary_index()
+        backup = (primary_id + 1) % 3
+        for peer in range(3):
+            if peer != backup:
+                cluster.cut_links.add(frozenset((backup, peer)))
+        _drive(cluster, c, [
+            (Operation.create_transfers, _transfers_body(
+                [(700 + k, 1, 2, 1) for k in range(5)]))])
+        assert 700 in cluster.replicas[primary_id] \
+            .state_machine.state.transfers
+        assert 700 not in cluster.replicas[backup] \
+            .state_machine.state.transfers
+        cluster.heal()
+        cluster.settle()
+        assert 704 in cluster.replicas[backup] \
+            .state_machine.state.transfers
+
+    def test_primary_no_clock_sync_makes_no_progress(self):
+        """A primary whose peers' clocks disagree beyond any common
+        interval has no Marzullo quorum: it must NOT stamp prepares, so
+        the cluster makes no progress until clocks re-agree (reference:
+        "Cluster: network: primary no clock sync"; consensus drives
+        time, src/vsr/clock.zig:1-45)."""
+        cluster = Cluster(seed=27, replica_count=3)
+        c = cluster.client(6)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        # Split the peers' wall clocks beyond any overlap: one far
+        # future, one far past. The primary's own interval is [0,0];
+        # best coverage = 1 < quorum 2. (The default cluster shares one
+        # TimeSim, so give each peer its own DriftedTime view — both the
+        # replica and its Clock read it.)
+        from tigerbeetle_tpu.testing.cluster import DriftedTime
+
+        primary_id = cluster.replicas[0].primary_index()
+        peers = [i for i in range(3) if i != primary_id]
+        drifted = []
+        for p, off in ((peers[0], 10**15), (peers[1], -(10**15))):
+            t = DriftedTime(cluster.time, offset_ns=off)
+            cluster.replicas[p].time = t
+            cluster.replicas[p].clock.time = t
+            drifted.append(t)
+        # Old agreeing samples must expire (the clock window), then the
+        # request goes unanswered.
+        cluster.run(1500, until=lambda: False)
+        c.request(Operation.create_transfers,
+                  _transfers_body([(950, 1, 2, 3)]))
+        progressed = cluster.run(1500, until=lambda: c.idle)
+        assert not progressed, "prepared without clock agreement"
+        assert all(950 not in r.state_machine.state.transfers
+                   for r in cluster.replicas)
+        # Clocks re-agree: the retried request commits.
+        for t in drifted:
+            t.offset_ns = 0
+        ok = cluster.run(8000, until=lambda: c.idle)
+        assert ok, cluster.debug_status()
+        cluster.settle()
+        assert 950 in cluster.replicas[0].state_machine.state.transfers
+
+    def test_prepare_beyond_checkpoint_trigger(self):
+        """Commits straddle the checkpoint trigger while more prepares
+        queue behind it; a post-checkpoint crash+restart replays the WAL
+        suffix on top of the checkpoint and converges byte-identically
+        (reference: prepare beyond checkpoint trigger)."""
+        cluster = Cluster(seed=26, replica_count=3)
+        interval = cluster.replicas[0].options.checkpoint_interval
+        c = cluster.client(4)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        # Drive well past one checkpoint boundary.
+        n = interval + 3
+        for k in range(n):
+            _drive(cluster, c, [
+                (Operation.create_transfers,
+                 _transfers_body([(800 + k, 1, 2, 1)]))])
+        assert any(r.superblock.op_checkpoint > 0
+                   for r in cluster.replicas)
+        victim = (cluster.replicas[0].primary_index() + 2) % 3
+        cluster.crash(victim)
+        _drive(cluster, c, [
+            (Operation.create_transfers, _transfers_body([(900, 1, 2, 2)]))])
+        cluster.restart(victim)
+        cluster.settle()
+        acct = cluster.replicas[victim].state_machine.state.accounts[2]
+        assert acct.credits_posted == n + 2
+        cluster.check_storage()

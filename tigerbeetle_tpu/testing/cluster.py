@@ -173,6 +173,9 @@ class Cluster:
         self._seq = 0
         self.partitioned: set = set()  # endpoints whose links are cut
         self.cut_links: set[frozenset] = set()  # replica-pair partitions
+        # Directional (src, dst) endpoint cuts — asymmetric partitions
+        # (reference packet_simulator models send-only/receive-only).
+        self.cut_directed: set[tuple] = set()
         self.crashed: set[int] = set()
         self.clock_drift_ppm_max = clock_drift_ppm_max
         self.clock_offset_ns_max = clock_offset_ns_max
@@ -215,6 +218,8 @@ class Cluster:
     def _post(self, src, dst, raw: bytes) -> None:
         if src in self.partitioned or dst in self.partitioned:
             return
+        if (src, dst) in self.cut_directed:
+            return
         if src[0] == "replica" and dst[0] == "replica" \
                 and frozenset((src[1], dst[1])) in self.cut_links:
             return
@@ -253,6 +258,12 @@ class Cluster:
     def partition(self, endpoint) -> None:
         self.partitioned.add(endpoint)
 
+    def cut(self, src, dst) -> None:
+        """Drop traffic in ONE direction between two endpoints
+        (asymmetric partition; reference packet_simulator's
+        send-only/receive-only modes)."""
+        self.cut_directed.add((src, dst))
+
     def partition_mode(self, mode: str) -> None:
         """Link-level partition in one of the reference's modes
         (src/testing/packet_simulator.zig partition_mode): cut replica<->
@@ -279,8 +290,12 @@ class Cluster:
         if endpoint is None:
             self.partitioned.clear()
             self.cut_links.clear()
+            self.cut_directed.clear()
         else:
             self.partitioned.discard(endpoint)
+            self.cut_directed = {
+                (s, d) for s, d in self.cut_directed
+                if s != endpoint and d != endpoint}
             if endpoint[0] == "replica":
                 self.cut_links = {
                     link for link in self.cut_links

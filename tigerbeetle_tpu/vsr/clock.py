@@ -24,8 +24,17 @@ class Interval:
 def marzullo(intervals: list[Interval]) -> Optional[Interval]:
     """The smallest interval consistent with the largest number of sources
     (reference: src/vsr/marzullo.zig:8 smallest_interval)."""
+    iv, _ = marzullo_with_count(intervals)
+    return iv
+
+
+def marzullo_with_count(intervals: list[Interval]):
+    """(best interval, number of sources covering it). The count is what
+    agreement means: sources merely SAMPLED is not sources AGREEING
+    (reference clock.zig synchronizes only when the smallest interval is
+    consistent with a replica quorum of sources)."""
     if not intervals:
-        return None
+        return None, 0
     edges: list[tuple[int, int]] = []
     for iv in intervals:
         edges.append((iv.lo, -1))  # -1 sorts starts before ends at a tie
@@ -44,8 +53,8 @@ def marzullo(intervals: list[Interval]) -> Optional[Interval]:
         else:
             count -= 1
     if best_lo is None:
-        return None
-    return Interval(best_lo, best_hi)
+        return None, 0
+    return Interval(best_lo, best_hi), best
 
 
 class Clock:
@@ -83,13 +92,20 @@ class Clock:
         return [iv for at, iv in self.samples.values() if at >= horizon]
 
     def offset(self) -> Optional[Interval]:
-        """Agreed offset interval (None without a quorum of fresh samples)."""
+        """Agreed offset interval — None unless a QUORUM of sources (our
+        own zero-offset interval plus fresh peer samples) actually
+        overlap. Peers sampled but wildly disagreeing are not agreement
+        (reference clock.zig: the smallest interval must be consistent
+        with a replica quorum)."""
         own = [Interval(0, 0)]  # our own clock, zero offset
         intervals = own + self._fresh()
         quorum = self.replica_count // 2 + 1
         if len(intervals) < quorum:
             return None
-        return marzullo(intervals)
+        iv, covered = marzullo_with_count(intervals)
+        if covered < quorum:
+            return None
+        return iv
 
     def realtime_synchronized(self) -> Optional[int]:
         iv = self.offset()

@@ -459,9 +459,21 @@ class Replica:
                          client: int = 0, request: int = 0) -> None:
         assert self.is_primary
         op = self.op + 1
+        # Consensus drives time, not vice versa (reference clock.zig:1-45;
+        # replica.zig prepare_timestamp via realtime_synchronized): a
+        # primary without Marzullo agreement from a quorum of fresh clock
+        # samples must NOT stamp prepares — it drops the request and the
+        # client retries (a multi-replica cluster with an unsynchronizable
+        # primary makes no progress, replica_test.zig "primary no clock
+        # sync"). A solo replica is trivially synchronized with itself.
+        if self.replica_count > 1:
+            now = self.clock.realtime_synchronized()
+            if now is None:
+                return
+        else:
+            now = self.time.realtime()
         self.prepare_timestamp = max(
-            self.prepare_timestamp + _event_count(operation, body),
-            self.time.realtime())
+            self.prepare_timestamp + _event_count(operation, body), now)
         parent = self._prepare_checksum(self.op)
         header = Header(
             command=Command.prepare, cluster=self.cluster,
@@ -1737,9 +1749,14 @@ class Replica:
         """Clock sample: context echoes our ping's monotonic tx time
         (reference: clock sampling via ping/pong, src/vsr/clock.zig)."""
         self.releases.observe(msg.header.replica, msg.header.release)
-        self.clock.learn(
-            msg.header.replica, msg.header.context,
-            msg.header.timestamp, self.time.monotonic())
+        # Only ACTIVE replicas are clock-quorum sources: a standby's
+        # agreeing clock must never let a primary call itself
+        # synchronized without a replica quorum (clock.zig samples the
+        # replica set only; standbys follow, they don't vouch).
+        if msg.header.replica < self.replica_count:
+            self.clock.learn(
+                msg.header.replica, msg.header.context,
+                msg.header.timestamp, self.time.monotonic())
 
     def tick(self) -> None:
         # Reap async WAL completions first: deferred prepare_oks / the
