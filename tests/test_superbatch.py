@@ -290,6 +290,67 @@ def test_commit_window_cross_prepare_dup_seq_fallback():
     assert sm_b.led.window_fallbacks == 1
 
 
+def test_replica_catchup_windows_preserve_determinism():
+    """A lagging device-engine replica catches up through WINDOWED
+    commits (commit_journal forms windows over the replayed suffix)
+    while its peers committed the same ops one at a time — physical
+    checkpoints must still be byte-identical across replicas (the
+    storage checker is the arbiter; per-op flush cadence with exact
+    chunk attribution is what makes this hold)."""
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.state_machine import StateMachine
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.types import Operation
+
+    cluster = Cluster(
+        seed=31, replica_count=3,
+        state_machine_factory=lambda: StateMachine(
+            engine="device", a_cap=1 << 10, t_cap=1 << 12))
+    client = cluster.client(77)
+
+    def drive(op, body, ticks=4000):
+        client.request(op, body)
+        ok = cluster.run(ticks, until=lambda: client.idle)
+        assert ok, cluster.debug_status()
+
+    drive(Operation.create_accounts, multi_batch.encode(
+        [b"".join(Account(id=i, ledger=1, code=1).pack()
+                  for i in (1, 2, 3))], 128))
+    victim = (cluster.replicas[0].primary_index() + 1) % 3
+    cluster.crash(victim)
+    # Lag by a multi-op suffix SMALL enough to stay below the state-sync
+    # threshold (WAL replay, where windows form), then cross the
+    # checkpoint boundary after the restart so every replica checkpoints
+    # the same op for the byte-identity check.
+    interval = cluster.replicas[0].options.checkpoint_interval
+    lagged = max(4, interval - 8)
+    k = 0
+    for _ in range(lagged):
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=5000 + k, debit_account_id=1,
+                      credit_account_id=2, amount=1 + (k % 7),
+                      ledger=1, code=1).pack()], 128))
+        k += 1
+    cluster.restart(victim)
+    cluster.settle()
+    r = cluster.replicas[victim]
+    assert getattr(r, "_windows_committed", 0) >= 1, \
+        "catch-up replay never formed a commit window"
+    for _ in range(12):
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=5000 + k, debit_account_id=1,
+                      credit_account_id=2, amount=1 + (k % 7),
+                      ledger=1, code=1).pack()], 128))
+        k += 1
+    cluster.settle()
+    assert all(rep.superblock.op_checkpoint > 0
+               for rep in cluster.replicas)
+    total = sum(1 + (j % 7) for j in range(k))
+    assert r.state_machine.state.accounts[2].credits_posted == total
+    cluster.check_convergence()
+    cluster.check_storage()
+
+
 def test_varying_batch_sizes():
     rng = np.random.default_rng(13)
     batches = []

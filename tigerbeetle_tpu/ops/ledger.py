@@ -474,7 +474,8 @@ class DeviceLedger:
         return st, ts
 
     def create_transfers_window(self, evs: list[dict],
-                                timestamps: list[int]):
+                                timestamps: list[int],
+                                all_or_nothing: bool = False):
         """K prepares in ONE superbatch dispatch (commit-window
         aggregation; the group-commit analog of the reference's 8-deep
         prepare pipeline, src/config.zig:155). Returns a list of
@@ -482,10 +483,18 @@ class DeviceLedger:
 
         Any cross-prepare dependency (duplicate ids, posts of in-window
         pendings, headroom/overflow proof failures) makes the superbatch
-        kernel fall back with state untouched; the window then executes
-        per-prepare through create_transfers_arrays, which preserves the
-        exact sequential semantics (including the fixpoint redispatch
-        and the host-mirror path)."""
+        kernel fall back with STATE UNTOUCHED. What happens next depends
+        on the caller:
+        - all_or_nothing=False: the window executes per-prepare through
+          create_transfers_soa right here (exact sequential semantics,
+          including fixpoint redispatch and the host-mirror path);
+        - all_or_nothing=True (the replica commit loop): return None
+          with nothing applied — the caller re-commits op by op through
+          its normal path, so flush cadence and physical determinism
+          are exactly those of a replica that never formed the window.
+          In this mode every sub-batch queues exactly one flush chunk
+          (empty ones included) so the caller can attribute chunks to
+          prepares."""
         import jax
 
         from .fast_kernels import create_transfers_super_jit
@@ -514,9 +523,12 @@ class DeviceLedger:
                          ts_all[b * n_pad:b * n_pad + n_b]))
                 if self._wt:
                     self._capture_window_delta(
-                        evs, [st for st, _ in results])
+                        evs, [st for st, _ in results],
+                        exact_chunks=all_or_nothing)
                 return results
             self.window_fallbacks += 1
+        if all_or_nothing:
+            return None
         return [self.create_transfers_soa(ev, ts)
                 for ev, ts in zip(evs, timestamps)]
 
@@ -1095,14 +1107,20 @@ class DeviceLedger:
                          "p_ts")}
         return t, e, der, t0
 
-    def _capture_window_delta(self, evs: list, st_slices: list) -> None:
+    def _capture_window_delta(self, evs: list, st_slices: list,
+                              exact_chunks: bool = False) -> None:
         """Window-level write-through capture: ONE bounded device fetch
         for a whole commit window's effects (the window kernel appends
         all created rows contiguously in commit order), split into
         per-prepare chunks so the drain and the durable flush keep their
         per-prepare watermark semantics. Replaces W per-body fetches —
         each a full device round-trip — with one (the dominant serving
-        cost on chip once the kernel itself is windowed)."""
+        cost on chip once the kernel itself is windowed).
+
+        exact_chunks: queue one flush chunk per sub-batch even when it
+        is empty — the replica commit loop attributes chunks to
+        prepares positionally (its per-op flush cadence is what keeps
+        physical checkpoints byte-identical across replicas)."""
         per = [self._batch_delta_stats(ev, st_np)
                for ev, st_np in zip(evs, st_slices)]
 
@@ -1132,10 +1150,12 @@ class DeviceLedger:
                     self._events_pushed += n_new
                     self._events_seen_abs += n_new
                     off += n_new
-                elif orphan_ids:
-                    self._mirror_chunks.append((None, None, None, 0, 0,
-                                                orphan_ids))
-                    if self.retain_flush_columns:
+                else:
+                    if orphan_ids:
+                        self._mirror_chunks.append(
+                            (None, None, None, 0, 0, orphan_ids))
+                    if self.retain_flush_columns and (orphan_ids
+                                                      or exact_chunks):
                         self._flush_columns.append(
                             (None, None, None, 0, self._events_seen_abs,
                              orphan_ids))
@@ -1251,10 +1271,24 @@ class DeviceLedger:
             assert got_t.get(tid) == sm.transfers.get(tid), \
                 f"verify: device/mirror divergence on transfer {tid}"
 
-    def take_flush_columns(self) -> list:
+    def take_flush_columns(self, count: int = None) -> list:
         """Pop the drained chunks' transfer columns (numpy) for the
-        durable flusher's vectorized index-key path."""
-        cols, self._flush_columns = self._flush_columns, []
+        durable flusher's vectorized index-key path. count=None pops
+        everything; the replica's window commit pops exactly one
+        prepare's worth (exact_chunks mode) so each op's flush carries
+        only that op's effects — per-op flush cadence is what keeps
+        physical checkpoints byte-identical across replicas."""
+        if count is None:
+            cols, self._flush_columns = self._flush_columns, []
+            return cols
+        # A short pop would attribute the WRONG chunks to later ops and
+        # surface only as a distant cross-replica byte divergence — fail
+        # here instead (same tripwire style as durable.py's in-order
+        # chunk assert).
+        assert len(self._flush_columns) >= count, \
+            (len(self._flush_columns), count)
+        cols = self._flush_columns[:count]
+        self._flush_columns = self._flush_columns[count:]
         return cols
 
     def _materialize_delta_transfers(self, t, e, der, t0,

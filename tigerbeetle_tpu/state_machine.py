@@ -608,7 +608,8 @@ class StateMachine:
                 m["max_ns"] = dt
 
     def commit_window(self, op: Operation, bodies: list[bytes],
-                      timestamps: list[int]) -> list[bytes]:
+                      timestamps: list[int],
+                      all_or_nothing: bool = False):
         """Commit a contiguous run of already-ordered prepares in one
         device dispatch (commit-window aggregation). Replicas may call
         this whenever several committed prepares are queued behind the
@@ -618,7 +619,13 @@ class StateMachine:
         falls back to the sequential path inside the ledger.
 
         Only device-engine create_transfers windows aggregate; anything
-        else (mixed ops, pulse, host engine) commits per body."""
+        else (mixed ops, pulse, host engine) commits per body.
+
+        all_or_nothing=True (the replica commit loop): never executes
+        per body on any obstacle — returns None with state untouched
+        (the caller re-commits op by op through its normal path), and
+        on success returns (replies, chunks_per_body) so the caller can
+        attribute flush chunks to prepares."""
         O = Operation
         can_window = (
             self.engine == "device" and len(bodies) > 1
@@ -626,6 +633,8 @@ class StateMachine:
             and op.is_multi_batch()
             and all(self.input_valid(op, b) for b in bodies))
         if not can_window:
+            if all_or_nothing:
+                return None
             return [self.commit(op, b, ts)
                     for b, ts in zip(bodies, timestamps)]
 
@@ -647,7 +656,11 @@ class StateMachine:
                 evs.append(transfers_soa_from_bytes(b))
                 tss.append(running)
             shape.append(len(batches))
-        outs = self.led.create_transfers_window(evs, tss)
+        outs = self.led.create_transfers_window(
+            evs, tss, all_or_nothing=all_or_nothing)
+        if outs is None:
+            assert all_or_nothing
+            return None
         replies = []
         i = 0
         for body, ts, k in zip(bodies, timestamps, shape):
@@ -662,6 +675,8 @@ class StateMachine:
         m["total_ns"] += dt
         if dt > m["max_ns"]:
             m["max_ns"] = dt
+        if all_or_nothing:
+            return replies, shape
         return replies
 
     def _commit_timed(self, op: Operation, body: bytes,

@@ -127,6 +127,21 @@ def _unpack_event(raw: bytes) -> AccountEventRecord:
         amount_requested=amount_requested, amount=amount)
 
 
+def mirror_quiescent(state, events_persisted: int) -> bool:
+    """True when the host mirror holds nothing the durable flush would
+    have to serialize object-side: no dirty stores and every mirror
+    event already persisted. The ONE predicate behind (a) the column
+    flush contract, (b) the replica's drain-before-flush decision, and
+    (c) commit-window formation — they must agree or the window path's
+    per-op flush cadence silently diverges."""
+    return not (
+        state.accounts.dirty or state.transfers.dirty
+        or state.pending_status.dirty or state.expiry.dirty
+        or state.orphaned.dirty
+        or events_persisted < (state.events_base
+                               + len(state.account_events)))
+
+
 def checkpoint_manifest(root_with_meta: bytes):
     """(manifest BlockAddress, manifest size) of a checkpoint root."""
     from ..lsm.grid import ADDRESS_SIZE, BlockAddress
@@ -299,13 +314,8 @@ class DurableState:
             # account creations, expiries) carry ordering the two paths
             # cannot merge; the caller must drain and flush the object
             # path instead (vsr/replica.py does exactly that).
-            assert not (state.accounts.dirty or state.transfers.dirty
-                        or state.pending_status.dirty or state.expiry.dirty
-                        or state.orphaned.dirty), \
-                "column flush with a dirty mirror: drain first"
-            assert self.events_persisted >= (
-                state.events_base + len(state.account_events)), \
-                "column flush with unpersisted mirror events: drain first"
+            assert mirror_quiescent(state, self.events_persisted), \
+                "column flush with a dirty/unpersisted mirror: drain first"
         for (t_cols, e_cols, der_cols, n_new, abs_start,
              orphan_ids) in flush_columns or ():
             # Orphan puts are idempotent: flushed even for zero-create

@@ -60,7 +60,7 @@ from typing import Callable, Optional
 
 from .. import constants
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
-from ..state_machine import StateMachine
+from ..state_machine import StateMachine, _base_operation
 from ..types import Operation
 import struct
 
@@ -204,6 +204,7 @@ class Replica:
         # stale leftover under a committed op number): repair must fetch a
         # replacement even though a prepare is held.
         self.chain_suspect: set[int] = set()
+        self._windows_committed = 0  # commit-window aggregations served
         # NACK collection (pending-view primary only): op -> set[replica]
         # of peers proving they never prepared the canonical entry.
         self.nacks: dict[int, set[int]] = {}
@@ -659,6 +660,7 @@ class Replica:
           checksum must be its parent — a mismatch means one of the two is
           stale, so repair rather than execute."""
         prev_checksum = None
+        window_backoff = False
         while self.commit_min < commit_target:
             op = self.commit_min + 1
             msg = self.journal.read_prepare(op)
@@ -720,6 +722,28 @@ class Replica:
                 self.repair_requested.setdefault(op, 0)
                 return
             self.chain_suspect.discard(op)
+            window = (None if window_backoff
+                      else self._collect_commit_window(msg, commit_target))
+            if window is not None:
+                out = self.state_machine.commit_window(
+                    Operation(window[0].header.operation),
+                    [m.body for m in window],
+                    [m.header.timestamp for m in window],
+                    all_or_nothing=True)
+                if out is None:
+                    # Cross-prepare dependency in this suffix: stop
+                    # attempting windows for the rest of this call (the
+                    # per-op path handles it exactly; retrying per
+                    # iteration would pay a doomed dispatch per op).
+                    window_backoff = True
+                if out is not None:
+                    replies, shape = out
+                    self.tracer.count("commit_windows")
+                    self._windows_committed += 1
+                    for m, res, k in zip(window, replies, shape):
+                        self._post_commit(m, res, chunk_count=k)
+                    prev_checksum = window[-1].header.checksum
+                    continue
             self._commit_op(msg)
             prev_checksum = msg.header.checksum
 
@@ -781,6 +805,72 @@ class Replica:
             old_commit_min)
         return True
 
+    COMMIT_WINDOW_MAX = 8
+
+    def _mirror_quiescent(self) -> bool:
+        """The regime in which window commits keep per-op flush content
+        identical to single commits (shared predicate: durable.py)."""
+        from .durable import mirror_quiescent
+
+        return mirror_quiescent(self.state_machine.raw_state,
+                                self.durable.events_persisted)
+
+    def _collect_commit_window(self, head: Message,
+                               commit_target: int) -> Optional[list]:
+        """Extend the validated head prepare into a contiguous run of
+        same-operation create_transfers prepares the state machine may
+        execute as ONE device dispatch (commit-window aggregation; the
+        reference pipelines 8 prepares, src/config.zig:155). Lookahead
+        prepares get the same safety checks the head already passed
+        (canonical match, sync floor, quarantine, hash chain); any
+        obstacle just ends the run — the head path re-examines it on
+        the next loop iteration. Windows never span a checkpoint
+        boundary: each op's _post_commit must checkpoint state that
+        contains exactly the ops up to it."""
+        sm = self.state_machine
+        if getattr(sm, "engine", None) != "device" or sm.led is None:
+            return None
+        # Mirror the ledger's own eligibility gate: in the host-mirror
+        # or fixpoint-first regime the window dispatch would be a
+        # guaranteed waste (collected, decoded, then refused).
+        if sm.led._mirror_route() or sm.led._fixpoint_first:
+            return None
+        try:
+            o = Operation(head.header.operation)
+        except ValueError:
+            return None
+        if (_base_operation(o) != Operation.create_transfers
+                or not o.is_multi_batch()):
+            return None
+        if not self._mirror_quiescent():
+            return None
+        run = [head]
+        prev = head.header.checksum
+        interval = self.options.checkpoint_interval
+        while len(run) < self.COMMIT_WINDOW_MAX:
+            last_op = head.header.op + len(run) - 1
+            if last_op % interval == 0:
+                break  # a checkpoint fires right after last_op
+            nop = last_op + 1
+            if nop > commit_target:
+                break
+            m = self.journal.read_prepare(nop)
+            if m is None or m.header.operation != head.header.operation:
+                break
+            want_hdr = self.canonical.get(nop)
+            if want_hdr is not None and m.header.checksum != \
+                    want_hdr.checksum:
+                break
+            if want_hdr is None and nop < self.sync_floor:
+                break
+            if nop in self.chain_suspect:
+                break
+            if m.header.parent != prev:
+                break
+            run.append(m)
+            prev = m.header.checksum
+        return run if len(run) > 1 else None
+
     def _commit_op(self, prepare: Message) -> None:
         h = prepare.header
         assert h.op == self.commit_min + 1
@@ -788,6 +878,17 @@ class Replica:
         with self.tracer.span("commit", op=h.op, operation=int(operation)):
             result = self.state_machine.commit(operation, prepare.body,
                                                h.timestamp)
+        self._post_commit(prepare, result)
+
+    def _post_commit(self, prepare: Message, result: bytes,
+                     chunk_count: int = None) -> None:
+        """Everything a committed op owes besides state-machine
+        execution: AOF, commit_min, durable flush + compaction beat,
+        reply recording, checkpoint trigger. chunk_count attributes
+        flush chunks to this op in window commits (None = pop all, the
+        single-op path)."""
+        h = prepare.header
+        assert h.op == self.commit_min + 1
         self.tracer.count("commits")
         if self.aof is not None:
             self.aof.append(prepare)
@@ -798,18 +899,20 @@ class Replica:
         # the mirror drain stays DEFERRED (it runs at read boundaries and
         # checkpoints, amortized), which is most of the serving win.
         led = self.state_machine.led
-        cols = led.take_flush_columns() if led is not None else None
+        cols = (led.take_flush_columns(chunk_count)
+                if led is not None else None)
         raw = self.state_machine.raw_state
-        if cols and (
-                raw.accounts.dirty or raw.transfers.dirty
-                or raw.pending_status.dirty or raw.expiry.dirty
-                or raw.orphaned.dirty
-                or self.durable.events_persisted < (
-                    raw.events_base + len(raw.account_events))):
+        if cols and not self._mirror_quiescent():
             # Interleaved history (hard-regime handoff, account creation,
             # expiry): the mirror and the chunks describe overlapping
             # order that only ONE authority may serialize — drain, then
-            # flush everything through the object path.
+            # flush everything through the object path. Window commits
+            # form only in the quiescent regime and execute purely on
+            # device, so this must never fire mid-window (a drain here
+            # would serialize LATER window ops' chunks into THIS op's
+            # flush and break cross-replica physical determinism).
+            assert chunk_count is None, \
+                "window commit entered a dirty-mirror regime"
             self.state_machine.state  # drains; chunks become stale
             cols = None
         flushed = self.durable.flush(raw, flush_columns=cols)
