@@ -240,6 +240,74 @@ class TestScrubRepairEndToEnd:
         r0.durable.grid.read_block(victim, tables[0].info.index_size)
         assert raw is not None  # read_block above validated the checksum
 
+    def test_fully_corrupt_grid_repaired_from_peers(self):
+        """The reference's hardest scrub case (replica_test.zig:1561
+        "background scrubber, fully corrupt grid"): EVERY grid block of
+        one replica is corrupted; the scrubber tour + peer repair must
+        restore the whole referenced set while the cluster keeps
+        serving, ending with a clean tour and identical storage."""
+        cluster = Cluster(seed=93, replica_count=3)
+        client = cluster.client(4)
+
+        def drive(op, body):
+            client.request(op, body)
+            ok = cluster.run(4000, until=lambda: client.idle)
+            assert ok, cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(18):  # past a checkpoint: tables exist on the grid
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=300 + k, debit_account_id=1,
+                          credit_account_id=2, amount=2, ledger=1,
+                          code=1).pack()], 128))
+        cluster.settle()
+
+        r0 = cluster.replicas[0]
+        # Fully corrupt the replica's REACHABLE grid: every block the
+        # current checkpoint root references (the sim's storage checker
+        # byte-compares the reachable set, so unreferenced scratch blocks
+        # stay out of scope — the reference's checker scopes the same
+        # way).
+        from tigerbeetle_tpu.vsr.durable import allocated_blocks
+
+        sb = r0.superblock
+        root = cluster.storages[0].read(
+            "snapshot",
+            sb.snapshot_slot * cluster.layout.snapshot_size_max,
+            sb.snapshot_size)
+        from tigerbeetle_tpu.vsr.replica import _split_root
+
+        forest_root, _ = _split_root(root)
+        reachable = allocated_blocks(forest_root)
+        assert len(reachable) > 3, "expected a populated grid"
+        zones = cluster.layout.zone_offsets
+        bs = cluster.layout.grid_block_size
+        for i in reachable:
+            cluster.storages[0].data[zones["grid"] + i * bs + 8] ^= 0xFF
+
+        r0.scrubber.reads_per_tick = 64
+        cycles0 = r0.scrubber.cycles
+        ok = cluster.run(40000, until=lambda: (
+            r0.scrubber.cycles >= cycles0 + 2
+            and not r0.block_repair and not r0.scrubber.faults))
+        assert ok, (len(r0.block_repair), len(r0.scrubber.faults),
+                    cluster.debug_status())
+        # And the repaired replica keeps serving: one more commit lands.
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=400, debit_account_id=1, credit_account_id=2,
+                      amount=3, ledger=1, code=1).pack()], 128))
+        cluster.settle()
+        # Every referenced block reads back checksum-valid.
+        tables = [t for tree in r0.durable.forest.trees.values()
+                  for level in tree.levels for t in level]
+        assert tables
+        for t in tables[:8]:
+            r0.durable.grid.read_block(t.info.index_address,
+                                       t.info.index_size)
+        cluster.check_convergence()
+
     def test_missing_reply_repaired_from_peer(self):
         """Blow away a replica's reply slot + restart: the periodic reply
         repair refills it from peers (reference: client_replies repair)."""

@@ -51,6 +51,8 @@ class Forest:
                        tree_id=i + 1)
             for i, (name, (k, v)) in enumerate(self.schema.items())}
         self._manifest_chain: list[int] = []  # previous checkpoint's blocks
+        # (address, size) of the live chain — the scrubber's tour set.
+        self.manifest_chain_blocks: list = []
 
     def compact_beat(self, op=None) -> None:
         for tree in self.trees.values():
@@ -83,6 +85,7 @@ class Forest:
         next_address: Optional[BlockAddress] = None
         next_size = 0
         chain: list[int] = []
+        chain_blocks: list[tuple[BlockAddress, int]] = []
         for chunk in reversed(chunks):
             raw = wrap(
                 BlockKind.manifest,
@@ -92,7 +95,15 @@ class Forest:
             next_address = self.grid.write_block(raw)
             next_size = len(raw)
             chain.append(next_address.index)
-        self._manifest_chain = chain
+            chain_blocks.append((next_address, next_size))
+        # ONE canonical store, head-first; the release-index list is
+        # derived (order is irrelevant for release). The scrubber tours
+        # these: manifest blocks are reachable checkpoint state and must
+        # be scrubbed/repairable like table blocks (reference
+        # grid_scrubber tours the manifest log too).
+        self.manifest_chain_blocks = list(reversed(chain_blocks))
+        self._manifest_chain = [a.index
+                                for a, _ in self.manifest_chain_blocks]
         head_address, head_size = next_address, next_size
         free_blob = self.grid.checkpoint_free_set()
         return (head_address.pack() + struct.pack("<I", head_size)
@@ -106,15 +117,17 @@ class Forest:
         free_blob = root[ADDRESS_SIZE + 8:ADDRESS_SIZE + 8 + free_size]
         self.grid.restore_free_set(free_blob)
         payload_parts = []
-        chain: list[int] = []
+        chain_blocks: list[tuple[BlockAddress, int]] = []
         link: Optional[tuple[BlockAddress, int]] = (address, size)
         while link is not None:
             block_address, block_size = link
             raw = self.grid.read_block(block_address, block_size)
-            chain.append(block_address.index)
+            chain_blocks.append((block_address, block_size))
             payload_parts.append(chain_payload(raw))
             link = chain_next(raw)
-        self._manifest_chain = list(reversed(chain))  # tail-first like write
+        # Head-first, matching checkpoint() — one canonical order.
+        self.manifest_chain_blocks = chain_blocks
+        self._manifest_chain = [a.index for a, _ in chain_blocks]
         raw = b"".join(payload_parts)
         (count,) = struct.unpack_from("<I", raw)
         pos = 4

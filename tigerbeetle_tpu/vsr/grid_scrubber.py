@@ -39,6 +39,11 @@ class GridScrubber:
                     yield name, table.info.index_address, table.info.index_size
                     for i, addr in enumerate(table.block_addresses):
                         yield name, addr, table.block_sizes[i]
+        # The checkpoint's manifest chain is reachable grid state too —
+        # a decayed chain block would make the NEXT restart unrecoverable
+        # locally even though every table block is fine.
+        for addr, size in self.forest.manifest_chain_blocks:
+            yield "__manifest__", addr, size
 
     def still_referenced(self, address: BlockAddress) -> bool:
         """True iff the CURRENT manifests still reach this exact address.
