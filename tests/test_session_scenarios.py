@@ -228,6 +228,45 @@ class TestSessionScenarios:
         cluster.settle()
         assert 950 in cluster.replicas[0].state_machine.state.transfers
 
+    def test_recovering_head_outdated_view(self):
+        """A replica crashes holding a view-0 WAL head, misses a view
+        change AND further commits, then restarts: it must not trust its
+        own head — it adopts the live view, repairs the divergent
+        suffix, and converges (reference: "recovery: recovering_head,
+        outdated View")."""
+        cluster = Cluster(seed=28, replica_count=3)
+        c = cluster.client(9)
+        _drive(cluster, c, [
+            (Operation.create_accounts, _accounts_body([1, 2]))])
+        old_primary = cluster.replicas[0].primary_index()
+        victim = (old_primary + 1) % 3
+        _drive(cluster, c, [
+            (Operation.create_transfers, _transfers_body([(10, 1, 2, 1)]))])
+        cluster.crash(victim)
+        # Depose the view-0 primary: the survivors elect a new view.
+        cluster.crash(old_primary)
+        cluster.run(1200, until=lambda: False)
+        cluster.restart(old_primary)
+        c.request(Operation.create_transfers,
+                  _transfers_body([(11, 1, 2, 2)]))
+        ok = cluster.run(8000, until=lambda: c.idle)
+        assert ok, cluster.debug_status()
+        live = [r for i, r in enumerate(cluster.replicas)
+                if i not in cluster.crashed]
+        assert any(r.view > 0 for r in live)
+        # More commits in the new view while the victim is still down.
+        _drive(cluster, c, [
+            (Operation.create_transfers, _transfers_body([(12, 1, 2, 4)]))],
+            ticks=8000)
+        # The victim restarts with a view-0 head and an outdated view.
+        cluster.restart(victim)
+        cluster.settle()
+        r = cluster.replicas[victim]
+        assert r.view >= max(x.view for x in live) - 0  # adopted the view
+        acct = r.state_machine.state.accounts[2]
+        assert acct.credits_posted == 1 + 2 + 4
+        cluster.check_storage()
+
     def test_prepare_beyond_checkpoint_trigger(self):
         """Commits straddle the checkpoint trigger while more prepares
         queue behind it; a post-checkpoint crash+restart replays the WAL
