@@ -1,0 +1,160 @@
+"""On-chip serving decomposition: where does the config6 window commit
+spend its time? Stages timed separately, solo on the chip:
+
+  codec     — wire body -> SoA (host)
+  dispatch  — create_transfers_window kernel call, block_until_ready
+  fetch     — the window-level delta device->host fetch
+  encode    — result SoA -> wire replies (host)
+
+Writes onchip/SERVING_PROFILE_<utc>.json. Run SOLO (no concurrent bench
+or pytest): contention skews every number (PERF.md doctrine).
+"""
+
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import numpy as np  # noqa: E402
+
+from tigerbeetle_tpu import multi_batch  # noqa: E402
+from tigerbeetle_tpu.constants import BATCH_MAX as N  # noqa: E402
+from tigerbeetle_tpu.state_machine import StateMachine  # noqa: E402
+from tigerbeetle_tpu.types import Account, Operation, Transfer  # noqa: E402
+
+
+def main() -> None:
+    import jax
+
+    platform = jax.default_backend()
+    account_count = 10_000
+    sm = StateMachine(engine="device", a_cap=1 << 15, t_cap=1 << 19)
+    rng = np.random.default_rng(6)
+    ts = 1000
+    accounts = [Account(id=i, ledger=1, code=1)
+                for i in range(1, account_count + 1)]
+    for lo in range(0, account_count, N):
+        chunk = accounts[lo:lo + N]
+        ts += len(chunk) + 10
+        sm.create_accounts(chunk, ts)
+    nb = N - 1
+
+    def mk_body(base):
+        dr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+        cr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+        amt = rng.integers(1, 10**6, nb)
+        payload = b"".join(
+            Transfer(id=int(base + i), debit_account_id=int(dr[i]),
+                     credit_account_id=int(cr[i]), amount=int(amt[i]),
+                     ledger=1, code=1).pack()
+            for i in range(nb))
+        return multi_batch.encode([payload], 128)
+
+    W = 8
+    ROUNDS = 4
+    next_id = 10**7
+    out = {"platform": platform, "W": W, "rounds": ROUNDS}
+
+    # -- stage: codec (decode only; measured on one window's bodies) ----
+    bodies = [mk_body(next_id + i * nb) for i in range(W)]
+    next_id += W * nb
+    from tigerbeetle_tpu.ops.batch import transfers_soa_from_bytes
+    from tigerbeetle_tpu.state_machine import OPERATION_SPECS
+
+    spec = OPERATION_SPECS[Operation.create_transfers]
+    t0 = time.perf_counter()
+    for body in bodies:
+        for b in multi_batch.decode(body, spec.event_size):
+            transfers_soa_from_bytes(b)
+    out["codec_decode_ms_per_window"] = round(
+        (time.perf_counter() - t0) * 1000, 1)
+
+    # -- warmup: compile the window program ----------------------------
+    ts += W * (nb + 10)
+    wts = []
+    run = ts - W * (nb + 10)
+    for _ in range(W):
+        run += nb + 10
+        wts.append(run)
+    t0 = time.perf_counter()
+    sm.commit_window(Operation.create_transfers, bodies, wts)
+    out["warmup_window_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+
+    # -- steady windows, stage-timed -----------------------------------
+    led = sm.led
+    totals = {"window_total_ms": [], "drain_ms": []}
+    orig_fetch = led._xfer_delta_fetch
+    fetch_ms = []
+
+    def timed_fetch(n_new):
+        f0 = time.perf_counter()
+        r = orig_fetch(n_new)
+        # device_get inside already blocks; this is the host-visible cost
+        fetch_ms.append((time.perf_counter() - f0) * 1000)
+        return r
+
+    led._xfer_delta_fetch = timed_fetch
+    for _ in range(ROUNDS):
+        bodies = [mk_body(next_id + i * nb) for i in range(W)]
+        next_id += W * nb
+        wts = []
+        for _ in range(W):
+            ts += nb + 10
+            wts.append(ts)
+        t0 = time.perf_counter()
+        sm.commit_window(Operation.create_transfers, bodies, wts)
+        totals["window_total_ms"].append(
+            round((time.perf_counter() - t0) * 1000, 1))
+        d0 = time.perf_counter()
+        led.drain_mirror()
+        totals["drain_ms"].append(round((time.perf_counter() - d0) * 1000, 1))
+    led._xfer_delta_fetch = orig_fetch
+
+    out["window_total_ms"] = totals["window_total_ms"]
+    out["drain_ms"] = totals["drain_ms"]
+    out["fetch_ms"] = [round(x, 1) for x in fetch_ms]
+    steady = totals["window_total_ms"][1:] or totals["window_total_ms"]
+    mean_total = sum(steady) / len(steady)
+    out["steady_window_ms"] = round(mean_total, 1)
+    out["steady_tps"] = round(W * nb / (mean_total / 1000), 1)
+
+    # -- dispatch-only estimate: re-run the kernel on prebuilt SoA -----
+    evs, tss = [], []
+    for i in range(W):
+        base = next_id + i * nb
+        dr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+        cr = rng.integers(1, account_count + 1, nb, dtype=np.uint64)
+        clash = dr == cr
+        cr[clash] = dr[clash] % account_count + 1
+        ev = transfers_soa_from_bytes(b"".join(
+            Transfer(id=int(base + j), debit_account_id=int(dr[j]),
+                     credit_account_id=int(cr[j]),
+                     amount=int(rng.integers(1, 10**6)),
+                     ledger=1, code=1).pack() for j in range(nb)))
+        evs.append(ev)
+        ts += nb + 10
+        tss.append(ts)
+    next_id += W * nb
+    t0 = time.perf_counter()
+    outs = led.create_transfers_window(evs, tss)
+    out["soa_window_ms"] = round((time.perf_counter() - t0) * 1000, 1)
+    d0 = time.perf_counter()
+    led.drain_mirror()
+    out["soa_drain_ms"] = round((time.perf_counter() - d0) * 1000, 1)
+
+    stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = os.path.join(REPO, "onchip", f"SERVING_PROFILE_{stamp}.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps(out))
+    print(f"wrote {path}")
+
+
+if __name__ == "__main__":
+    main()

@@ -863,8 +863,15 @@ class DeviceLedger:
             self.drain_mirror()
         self._mirror_chunks = []
         self.state = init_state(self.a_cap, self.t_cap)
+        # Row maps must mirror the PACKING order below: accounts pack in
+        # dict order (eager, creation-ordered), transfers pack in
+        # transfer_by_timestamp (commit) order — under the lazy mirror a
+        # point read moves a key out of dict insertion position, so
+        # enumerate(sm.transfers) could disagree with the packed rows and
+        # scatter later pending flips onto the wrong device rows.
         self._acct_row = {a: r for r, a in enumerate(sm.accounts)}
-        self._xfer_row = {t: r for r, t in enumerate(sm.transfers)}
+        self._xfer_row = {t: r for r, t in
+                          enumerate(sm.transfer_by_timestamp.values())}
         self._xfer_rows_dev = len(self._xfer_row)
         st = self.state
 
@@ -907,7 +914,11 @@ class DeviceLedger:
         st["acct_ht"] = batch_insert(
             st["acct_ht"], [(a.id, r) for r, a in enumerate(accounts)])
 
-        transfers = list(sm.transfers.values())
+        # Commit (timestamp) order, NOT dict order: under the lazy mirror
+        # a point read reorders dict insertion positions, and device row
+        # assignment must stay deterministic across replicas.
+        transfers = [sm.transfers[tid]
+                     for tid in sm.transfer_by_timestamp.values()]
         xfr = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["transfers"].items()}
         u64m, u32m, i32m = _pack_transfer_rows(
@@ -1040,7 +1051,13 @@ class DeviceLedger:
     def _enable_dev_tracking(sm) -> None:
         """Turn on the device-push dirty channel for a mirror's containers
         (off by default: on the oracle/kernel engines nothing consumes —
-        or clears — it)."""
+        or clears — it), and swap the transfers container for the lazy
+        columnar one (ops/lazy_mirror.py) — the write-through delta
+        registers created rows there without building objects."""
+        from .lazy_mirror import LazyEventList, LazyTransferDict
+
+        sm.transfers = LazyTransferDict.adopt(sm.transfers)
+        sm.account_events = LazyEventList.adopt(sm.account_events)
         for c in (sm.accounts, sm.transfers, sm.pending_status,
                   sm.expiry, sm.orphaned):
             c.track_dev = True
@@ -1293,145 +1310,83 @@ class DeviceLedger:
 
     def _materialize_delta_transfers(self, t, e, der, t0,
                                      n_new: int) -> None:
-        """Apply one captured chunk to the host mirror. Mirrors the
-        oracle's success-path application exactly (oracle/state_machine.py
-        _create_transfer :417 and _post_or_void_pending_transfer :639,
-        including the _put_account conditions), so mirror state stays
-        value-identical to an oracle run, batch for batch.
-
-        Hot-loop discipline (this is the deferred serving drain):
-        __dict__-level Account copies (copy.copy routes through
-        __reduce_ex__ and measured as HALF the drain at two copies per
-        event; dataclasses.replace re-runs field introspection), raw
-        dict stores with the DirtyDict channels bulk-updated once per
-        chunk, and a single tolist per column."""
-        from ..oracle.state_machine import AccountEventRecord
-
-        _acct_new = Account.__new__
-
-        def _copy(prev):
-            new = _acct_new(Account)
-            new.__dict__.update(prev.__dict__)
-            return new
+        """Register one captured chunk with the host mirror COLUMNARLY
+        (ops/lazy_mirror.py): created transfers become lazy rows in the
+        LazyTransferDict (keys + (chunk, row) refs, no objects), account
+        write-back is one vectorized last-writer pass (one new Account
+        per touched account, not two __dict__ copies per event), and
+        account_events grow by lazy per-row proxies. Pending-status
+        flips (the only order-dependent scalar work) run as a small loop
+        over just the flip subset. Values any reader can observe are
+        identical to the old eager per-event drain (the oracle success
+        path, oracle/state_machine.py _create_transfer :417) —
+        tests/test_lazy_mirror.py pins this differentially."""
+        from .lazy_mirror import (DeltaChunk, LazyTransferDict,
+                                  apply_account_finals)
 
         sm = self.mirror
-        closed = int(AccountFlags.closed)
-        P = TransferPendingStatus
-        _P_BY = {int(m): m for m in P}
+        n = n_new
 
-        # Bulk-convert device columns to Python scalars ONCE (tolist is a
-        # single C call; per-element int() on numpy scalars dominates the
-        # apply loop otherwise — this is the serving path's host edge).
-        t = {k2: v.tolist() for k2, v in t.items()}
-        e = {k2: v.tolist() for k2, v in e.items()}
-        der = {k2: v.tolist() for k2, v in der.items()}
+        ids = [(h << 64) | l
+               for h, l in zip(t["id_hi"].tolist(), t["id_lo"].tolist())]
+        ts_list = e["ts"].tolist()
+        chunk = DeltaChunk(t, e, der, sm, ids)
 
-        def u(hi, lo, k):
-            return (hi[k] << 64) | lo[k]
+        transfers = sm.transfers
+        assert isinstance(transfers, LazyTransferDict), \
+            "device write-through mirror must hold a LazyTransferDict"
+        transfers.register(ids, chunk)
+        sm.transfer_by_timestamp.update(zip(ts_list, ids))
+        self._xfer_row.update(zip(ids, range(t0, t0 + n)))
+        last_ts = ts_list[-1]
+        if sm.transfers_key_max is None or last_ts > sm.transfers_key_max:
+            sm.transfers_key_max = last_ts
+        sm.commit_timestamp = last_ts
 
-        transfers_raw = sm.transfers
-        accounts_raw = sm.accounts
-        pending_raw = sm.pending_status
-        tset = dict.__setitem__
-        touched_xfers: list = []
-        touched_accts: list = []
-        touched_pending: list = []
-        events_append = sm.account_events.append
-        for k in range(n_new):
-            ts = e["ts"][k]
-            tid = u(t["id_hi"], t["id_lo"], k)
-            tr = Transfer(
-                id=tid,
-                debit_account_id=u(t["dr_hi"], t["dr_lo"], k),
-                credit_account_id=u(t["cr_hi"], t["cr_lo"], k),
-                amount=u(t["amt_hi"], t["amt_lo"], k),
-                pending_id=u(t["pid_hi"], t["pid_lo"], k),
-                user_data_128=u(t["ud128_hi"], t["ud128_lo"], k),
-                user_data_64=t["ud64"][k],
-                user_data_32=t["ud32"][k],
-                timeout=t["timeout"][k],
-                ledger=t["ledger"][k],
-                code=t["code"][k],
-                flags=t["flags"][k],
-                timestamp=t["ts"][k],
-            )
-            assert tr.timestamp == ts, (tr.timestamp, ts)
-            tset(transfers_raw, tid, tr)
-            touched_xfers.append(tid)
-            sm.transfer_by_timestamp[ts] = tid
-            self._xfer_row[tid] = t0 + k
-            if sm.transfers_key_max is None or ts > sm.transfers_key_max:
-                sm.transfers_key_max = ts
-            pstat = _P_BY[e["pstat"][k]]
-            amount = u(e["amt_hi"], e["amt_lo"], k)
-            areq = u(e["areq_hi"], e["areq_lo"], k)
-            tflags_raw = e["tflags"][k]
-            sides = {}
-            for side, hik, lok in (("dr", "dr_id_hi", "dr_id_lo"),
-                                   ("cr", "cr_id_hi", "cr_id_lo")):
-                aid = u(der[hik], der[lok], k)
-                prev = accounts_raw[aid]
-                new = _copy(prev)
-                new.debits_pending = u(e[side + "_dp_hi"],
-                                       e[side + "_dp_lo"], k)
-                new.debits_posted = u(e[side + "_dpos_hi"],
-                                      e[side + "_dpos_lo"], k)
-                new.credits_pending = u(e[side + "_cp_hi"],
-                                        e[side + "_cp_lo"], k)
-                new.credits_posted = u(e[side + "_cpos_hi"],
-                                       e[side + "_cpos_lo"], k)
-                new.flags = e[side + "_flags"][k]
-                sides[side] = (aid, prev, new)
-            p_obj = None
-            if pstat in (P.posted, P.voided):
-                pts = der["p_ts"][k]
-                pid = sm.transfer_by_timestamp[pts]
-                p_obj = transfers_raw[pid]
-                tset(pending_raw, pts, pstat)
-                touched_pending.append(pts)
-                if p_obj.timeout:
-                    expires_at = pts + p_obj.timeout * NS_PER_S
-                    if pts in sm.expiry:
-                        del sm.expiry[pts]
-                    if sm.pulse_next_timestamp == expires_at:
-                        sm.pulse_next_timestamp = TIMESTAMP_MIN
-                for side in ("dr", "cr"):
-                    aid, prev, new = sides[side]
-                    if (amount > 0 or p_obj.amount > 0
-                            or (new.flags ^ prev.flags) & closed):
-                        tset(accounts_raw, aid, new)
-                        touched_accts.append(aid)
-            else:
-                if pstat == P.pending:
-                    tset(pending_raw, ts, P.pending)
+        sm.accounts.dirty.update(apply_account_finals(sm, e, der))
+
+        # Pending-status flips: adds (pending creates) and releases
+        # (post/void) interleave with order-dependent pulse bookkeeping,
+        # so this subset stays a scalar loop — but ONLY this subset.
+        pstat_np = np.asarray(e["pstat"])
+        flips = np.nonzero(pstat_np != 0)[0]
+        if flips.size:
+            P = TransferPendingStatus
+            pend_code = int(P.pending)
+            pstat_l = pstat_np[flips].tolist()
+            ts_l = np.asarray(e["ts"])[flips].tolist()
+            pts_l = np.asarray(der["p_ts"])[flips].tolist()
+            timeout_l = np.asarray(t["timeout"])[flips].tolist()
+            pending_raw = sm.pending_status
+            pset = dict.__setitem__
+            touched_pending: list = []
+            for j in range(len(pstat_l)):
+                pstat = pstat_l[j]
+                if pstat == pend_code:
+                    ts = ts_l[j]
+                    pset(pending_raw, ts, P.pending)
                     touched_pending.append(ts)
-                    if tr.timeout:
-                        expires_at = ts + tr.timeout * NS_PER_S
+                    timeout = timeout_l[j]
+                    if timeout:
+                        expires_at = ts + timeout * NS_PER_S
                         sm.expiry[ts] = expires_at
                         if expires_at < sm.pulse_next_timestamp:
                             sm.pulse_next_timestamp = expires_at
-                for side in ("dr", "cr"):
-                    aid, prev, new = sides[side]
-                    if amount > 0 or (new.flags & closed):
-                        tset(accounts_raw, aid, new)
-                        touched_accts.append(aid)
-            events_append(AccountEventRecord(
-                timestamp=ts,
-                dr_account=sides["dr"][2], cr_account=sides["cr"][2],
-                transfer_flags=(None if tflags_raw == 0xFFFFFFFF
-                                else tflags_raw),
-                transfer_pending_status=pstat,
-                transfer_pending=p_obj,
-                amount_requested=areq, amount=amount))
-            sm.commit_timestamp = ts
-        # Bulk dirty-channel update for the durable flusher (raw dict
-        # stores above bypassed the per-key DirtyDict bookkeeping). The
-        # device channel is deliberately NOT updated: everything here came
-        # FROM the device, and drain_mirror clears dirty_dev right after.
-        for container, keys in ((transfers_raw, touched_xfers),
-                                (accounts_raw, touched_accts),
-                                (pending_raw, touched_pending)):
-            container.dirty.update(keys)
+                else:  # posted / voided release
+                    pts = pts_l[j]
+                    pset(pending_raw, pts, P(pstat))
+                    touched_pending.append(pts)
+                    # expiry[pts] holds exactly pts + p.timeout*NS_PER_S,
+                    # and is present iff the pending transfer had a
+                    # timeout and has not been released/expired — so the
+                    # pop replaces reading p_obj.timeout (no object
+                    # materialization on the flip path).
+                    ea = sm.expiry.pop(pts, None)
+                    if ea is not None and sm.pulse_next_timestamp == ea:
+                        sm.pulse_next_timestamp = TIMESTAMP_MIN
+            pending_raw.dirty.update(touched_pending)
+
+        sm.account_events.extend_lazy(chunk, n)
 
     def _apply_fast_delta_accounts(self, st_np) -> None:
         """Write-through: apply one fast account batch to the host mirror

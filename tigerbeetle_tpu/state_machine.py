@@ -328,23 +328,31 @@ class StateMachine:
     def _refresh_indexes(self) -> None:
         import itertools
 
+        # Walk the by-timestamp maps, not the object dicts: they are the
+        # commit-ordered spine (1:1 with the stores — scope rollbacks pop
+        # both), and stay ordered under the lazy mirror, where a point
+        # read moves a transfer out of dict insertion position
+        # (ops/lazy_mirror.py).
         transfers = self.state.transfers
-        if len(transfers) > self._xfer_indexed:
-            for t in itertools.islice(transfers.values(),
-                                      self._xfer_indexed, None):
-                ts = t.timestamp
+        by_ts_t = self.state.transfer_by_timestamp
+        if len(by_ts_t) > self._xfer_indexed:
+            for ts, tid in itertools.islice(by_ts_t.items(),
+                                            self._xfer_indexed, None):
+                t = transfers[tid]
                 self._xfer_ts.append(ts)
                 for field, idx in self._xfer_by.items():
                     idx.add(getattr(t, field), ts)
-            self._xfer_indexed = len(transfers)
+            self._xfer_indexed = len(by_ts_t)
         accounts = self.state.accounts
-        if len(accounts) > self._acct_indexed:
-            for a in itertools.islice(accounts.values(),
-                                      self._acct_indexed, None):
-                self._acct_ts.append(a.timestamp)
+        by_ts_a = self.state.account_by_timestamp
+        if len(by_ts_a) > self._acct_indexed:
+            for ts, aid in itertools.islice(by_ts_a.items(),
+                                            self._acct_indexed, None):
+                a = accounts[aid]
+                self._acct_ts.append(ts)
                 for field, idx in self._acct_by.items():
-                    idx.add(getattr(a, field), a.timestamp)
-            self._acct_indexed = len(accounts)
+                    idx.add(getattr(a, field), ts)
+            self._acct_indexed = len(by_ts_a)
         events = self.state.account_events
         if len(events) > self._events_indexed:
             for rec in events[self._events_indexed:]:

@@ -27,13 +27,18 @@ def _pack_u128(x: int) -> bytes:
 
 
 def encode(state: StateMachineOracle) -> bytes:
+    """Canonical encoding: containers are serialized in timestamp/key
+    order, NOT dict iteration order — under the lazy mirror
+    (ops/lazy_mirror.py) dict insertion order depends on each replica's
+    READ history, while content must compare byte-identical across
+    replicas (the StorageChecker doctrine)."""
     out = [_MAGIC]
 
-    accounts = list(state.accounts.values())
+    accounts = sorted(state.accounts.values(), key=lambda a: a.timestamp)
     out.append(struct.pack("<Q", len(accounts)))
     out.extend(a.pack() for a in accounts)
 
-    transfers = list(state.transfers.values())
+    transfers = sorted(state.transfers.values(), key=lambda t: t.timestamp)
     out.append(struct.pack("<Q", len(transfers)))
     out.extend(t.pack() for t in transfers)
 
@@ -42,10 +47,11 @@ def encode(state: StateMachineOracle) -> bytes:
 
     out.append(struct.pack("<Q", len(state.pending_status)))
     out.extend(struct.pack("<QB", ts, int(s))
-               for ts, s in state.pending_status.items())
+               for ts, s in sorted(state.pending_status.items()))
 
     out.append(struct.pack("<Q", len(state.expiry)))
-    out.extend(struct.pack("<QQ", ts, exp) for ts, exp in state.expiry.items())
+    out.extend(struct.pack("<QQ", ts, exp)
+               for ts, exp in sorted(state.expiry.items()))
 
     out.append(struct.pack(
         "<QQQQ",
