@@ -88,17 +88,17 @@ def main() -> None:
     # -- steady windows, stage-timed -----------------------------------
     led = sm.led
     totals = {"window_total_ms": [], "drain_ms": []}
-    orig_fetch = led._xfer_delta_fetch
+    orig_fetch = led._delta_fetch_start
     fetch_ms = []
 
     def timed_fetch(n_new):
         f0 = time.perf_counter()
         r = orig_fetch(n_new)
-        # device_get inside already blocks; this is the host-visible cost
+        # issuance only: resolution (device_get) happens at drain
         fetch_ms.append((time.perf_counter() - f0) * 1000)
         return r
 
-    led._xfer_delta_fetch = timed_fetch
+    led._delta_fetch_start = timed_fetch
     for _ in range(ROUNDS):
         bodies = [mk_body(next_id + i * nb) for i in range(W)]
         next_id += W * nb
@@ -113,7 +113,7 @@ def main() -> None:
         d0 = time.perf_counter()
         led.drain_mirror()
         totals["drain_ms"].append(round((time.perf_counter() - d0) * 1000, 1))
-    led._xfer_delta_fetch = orig_fetch
+    led._delta_fetch_start = orig_fetch
 
     out["window_total_ms"] = totals["window_total_ms"]
     out["drain_ms"] = totals["drain_ms"]

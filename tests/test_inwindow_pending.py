@@ -1,0 +1,413 @@
+"""In-batch / in-window pending resolution (ops/fast_kernels.py
+_dup_and_pend_join + the dependency fixpoint): a post/void whose pending
+was created EARLIER in the same batch or commit window resolves on
+device, bit-identically to the sequential oracle.
+
+Reference: post_or_void_pending_transfer resolves against the groove,
+which already contains same-batch creations
+(src/state_machine.zig:4053-4112); failure statuses follow the same
+precedence order (src/tigerbeetle.zig:220)."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (Account, AccountFlags, Transfer,
+                                   TransferFlags)
+
+PEND = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+VOID = int(TransferFlags.void_pending_transfer)
+LINKED = int(TransferFlags.linked)
+U128MAX = (1 << 128) - 1
+
+
+def _mk_pair(a_cap=1 << 10, t_cap=1 << 12, accounts=None):
+    dev = StateMachine(engine="device", a_cap=a_cap, t_cap=t_cap)
+    orc = StateMachine(engine="oracle")
+    accounts = accounts or [Account(id=i, ledger=1, code=1)
+                            for i in range(1, 101)]
+    for sm in (dev, orc):
+        res = sm.create_accounts(accounts, 120)
+        assert all(r.status.name == "created" for r in res)
+    return dev, orc
+
+
+def _diff_batch(dev, orc, events, ts):
+    rd = dev.create_transfers(events, ts)
+    ro = orc.create_transfers(events, ts)
+    got = [(r.timestamp, r.status.name) for r in rd]
+    want = [(r.timestamp, r.status.name) for r in ro]
+    assert got == want, f"status divergence:\n dev={got}\n orc={want}"
+    return [r.status.name for r in rd]
+
+
+def _assert_state_parity(dev, orc):
+    ds, os_ = dev.state, orc.state
+    assert ds.accounts == os_.accounts
+    assert ds.transfers == os_.transfers
+    assert ds.pending_status == os_.pending_status
+    assert ds.expiry == os_.expiry
+    assert set(ds.orphaned) == set(os_.orphaned)
+    assert ds.pulse_next_timestamp == os_.pulse_next_timestamp
+    assert ds.commit_timestamp == os_.commit_timestamp
+
+
+class TestInBatchPending:
+    def test_pend_then_post_same_batch(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=1000, debit_account_id=1, credit_account_id=2,
+                     amount=100, ledger=1, code=1, flags=PEND, timeout=60),
+            Transfer(id=1001, debit_account_id=3, credit_account_id=4,
+                     amount=5, ledger=1, code=1),
+            Transfer(id=1002, pending_id=1000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 10**12)
+        assert st == ["created", "created", "created"]
+        assert dev.led.fallbacks == 0, "must stay on device"
+        _assert_state_parity(dev, orc)
+        a1 = dev.lookup_accounts([1])[0]
+        assert a1.debits_posted == 100 and a1.debits_pending == 0
+
+    def test_pend_then_void_sentinel_amounts(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=2000, debit_account_id=1, credit_account_id=2,
+                     amount=77, ledger=1, code=1, flags=PEND, timeout=9),
+            Transfer(id=2001, pending_id=2000, amount=0,
+                     ledger=1, code=1, flags=VOID),
+        ]
+        st = _diff_batch(dev, orc, events, 2 * 10**12)
+        assert st == ["created", "created"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+
+    def test_post_of_failed_pend_is_not_found(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=3000, debit_account_id=1, credit_account_id=999,
+                     amount=10, ledger=1, code=1, flags=PEND),
+            Transfer(id=3001, pending_id=3000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 3 * 10**12)
+        assert st == ["credit_account_not_found",
+                      "pending_transfer_not_found"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+
+    def test_post_before_pend_is_not_found(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=4001, pending_id=4000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+            Transfer(id=4000, debit_account_id=1, credit_account_id=2,
+                     amount=10, ledger=1, code=1, flags=PEND),
+        ]
+        st = _diff_batch(dev, orc, events, 4 * 10**12)
+        assert st == ["pending_transfer_not_found", "created"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+
+    def test_post_of_chain_rolled_back_pend(self):
+        dev, orc = _mk_pair()
+        events = [
+            # Linked chain: pend + a failing member -> pend rolls back.
+            Transfer(id=5000, debit_account_id=1, credit_account_id=2,
+                     amount=10, ledger=1, code=1, flags=PEND | LINKED),
+            Transfer(id=5001, debit_account_id=1, credit_account_id=999,
+                     amount=1, ledger=1, code=1),
+            Transfer(id=5002, pending_id=5000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 5 * 10**12)
+        assert st == ["linked_event_failed", "credit_account_not_found",
+                      "pending_transfer_not_found"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+
+    def test_use_is_own_chains_first_failure(self):
+        """The def was still applied when its same-chain use evaluated:
+        the use keeps ITS OWN failure code (which then breaks the chain
+        and rolls the def back) — NOT pending_transfer_not_found, which
+        being transient would wrongly poison the use's id."""
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=9100, debit_account_id=1, credit_account_id=2,
+                     amount=10, ledger=1, code=1, flags=PEND | LINKED),
+            Transfer(id=9101, pending_id=9100, amount=50,
+                     ledger=1, code=1, flags=VOID),
+        ]
+        st = _diff_batch(dev, orc, events, 95 * 10**11)
+        assert st == ["linked_event_failed",
+                      "exceeds_pending_transfer_amount"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+        # exceeds_pending_transfer_amount is NOT transient: the id must
+        # stay usable.
+        retry = [Transfer(id=9101, debit_account_id=1,
+                          credit_account_id=2, amount=1, ledger=1, code=1)]
+        st2 = _diff_batch(dev, orc, retry, 96 * 10**11)
+        assert st2 == ["created"]
+        _assert_state_parity(dev, orc)
+
+    def test_post_of_post_is_not_pending(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=6000, debit_account_id=1, credit_account_id=2,
+                     amount=10, ledger=1, code=1, flags=PEND),
+            Transfer(id=6001, pending_id=6000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+            Transfer(id=6002, pending_id=6001, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 6 * 10**12)
+        assert st == ["created", "created",
+                      "pending_transfer_not_pending"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+
+    def test_double_post_same_pid_falls_back_correctly(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=7000, debit_account_id=1, credit_account_id=2,
+                     amount=10, ledger=1, code=1, flags=PEND),
+            Transfer(id=7001, pending_id=7000, amount=U128MAX,
+                     ledger=1, code=1, flags=POST),
+            Transfer(id=7002, pending_id=7000, amount=0,
+                     ledger=1, code=1, flags=VOID),
+        ]
+        st = _diff_batch(dev, orc, events, 7 * 10**12)
+        assert st == ["created", "created",
+                      "pending_transfer_already_posted"]
+        _assert_state_parity(dev, orc)  # host fallback is fine here
+
+    def test_partial_post_amount_in_batch(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=8000, debit_account_id=1, credit_account_id=2,
+                     amount=100, ledger=1, code=1, flags=PEND),
+            Transfer(id=8001, pending_id=8000, amount=40,
+                     ledger=1, code=1, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 8 * 10**12)
+        assert st == ["created", "created"]
+        assert dev.led.fallbacks == 0
+        _assert_state_parity(dev, orc)
+        a1 = dev.lookup_accounts([1])[0]
+        assert a1.debits_posted == 40 and a1.debits_pending == 0
+
+    def test_ud_and_ledger_inheritance_from_inbatch_pend(self):
+        dev, orc = _mk_pair()
+        events = [
+            Transfer(id=9000, debit_account_id=1, credit_account_id=2,
+                     amount=10, user_data_128=7, user_data_64=8,
+                     user_data_32=9, ledger=1, code=3, flags=PEND),
+            Transfer(id=9001, pending_id=9000, amount=U128MAX,
+                     ledger=0, code=0, flags=POST),
+        ]
+        st = _diff_batch(dev, orc, events, 9 * 10**12)
+        assert st == ["created", "created"]
+        assert dev.led.fallbacks == 0
+        t = dev.state.transfers[9001]
+        to = orc.state.transfers[9001]
+        assert (t.user_data_128, t.user_data_64, t.user_data_32,
+                t.ledger, t.code) == (7, 8, 9, 1, 3)
+        assert t == to
+        _assert_state_parity(dev, orc)
+
+    def test_limits_with_inbatch_releases(self):
+        limit = int(AccountFlags.debits_must_not_exceed_credits)
+        accounts = [Account(id=1, ledger=1, code=1, flags=limit),
+                    Account(id=2, ledger=1, code=1)]
+        dev, orc = _mk_pair(accounts=accounts)
+        # Fund the limited account, then alternate pend/void so the
+        # limit headroom depends on in-batch releases.
+        seed = [Transfer(id=100, debit_account_id=2, credit_account_id=1,
+                         amount=100, ledger=1, code=1)]
+        _diff_batch(dev, orc, seed, 10**12)
+        events = []
+        nid = 10_000
+        for k in range(12):
+            events.append(Transfer(
+                id=nid, debit_account_id=1, credit_account_id=2,
+                amount=60, ledger=1, code=1, flags=PEND))
+            events.append(Transfer(
+                id=nid + 1, pending_id=nid, amount=0,
+                ledger=1, code=1, flags=VOID))
+            nid += 2
+        _diff_batch(dev, orc, events, 2 * 10**12)
+        assert dev.led.fallbacks == 0, \
+            "limit cascade with in-batch releases must stay on device"
+        _assert_state_parity(dev, orc)
+
+
+class TestInWindowPending:
+    def test_window_pend_then_post_batches(self):
+        """The config4 shape: one prepare creates pendings, the next
+        posts/voids them — windowed in ONE dispatch."""
+        from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        seq = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 65)]
+        for eng in (led, seq):
+            eng.create_accounts(accts, 120)
+        rng = np.random.default_rng(11)
+        n = 256
+        nid = 10**6
+        batches, tss = [], []
+        ts = 10**12
+        for b in range(4):
+            evs = []
+            if b % 2 == 0:
+                base = nid
+                for i in range(n):
+                    dr = int(rng.integers(1, 65))
+                    evs.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=dr % 64 + 1,
+                        amount=int(rng.integers(1, 100)), ledger=1,
+                        code=1, flags=PEND,
+                        timeout=int(rng.integers(0, 30))))
+                    nid += 1
+            else:
+                for i in range(n):
+                    even = i % 2 == 0
+                    evs.append(Transfer(
+                        id=nid, pending_id=base + i,
+                        amount=U128MAX if even else 0,
+                        ledger=1, code=1, flags=POST if even else VOID))
+                    nid += 1
+            ts += n + 10
+            batches.append([transfers_to_arrays(evs), evs])
+            tss.append(ts)
+
+        outs = led.create_transfers_window(
+            [b[0] for b in batches], tss)
+        assert led.window_fallbacks == 0, \
+            "pend->post window must resolve on device"
+        assert led.fallbacks == 0
+        # Sequential truth: same batches one dispatch at a time.
+        for (ev_arrays, evs), ts_b in zip(batches, tss):
+            seq.create_transfers(evs, ts_b)
+        for (st, ts_out), (_, evs), ts_b in zip(outs, batches, tss):
+            pass
+        host_w = led.to_host()
+        host_s = seq.to_host()
+        assert host_w.accounts == host_s.accounts
+        assert host_w.transfers == host_s.transfers
+        assert host_w.pending_status == host_s.pending_status
+        assert host_w.expiry == host_s.expiry
+        assert host_w.pulse_next_timestamp == host_s.pulse_next_timestamp
+
+    def test_window_mixed_with_failures(self):
+        from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        seq = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 33)]
+        for eng in (led, seq):
+            eng.create_accounts(accts, 120)
+        rng = np.random.default_rng(13)
+        nid = 5 * 10**6
+        ts = 10**12
+        batches, tss, raw = [], [], []
+        pend_pool = []
+        for b in range(5):
+            evs = []
+            fresh = []
+            for i in range(64):
+                roll = rng.random()
+                if roll < 0.4:
+                    dr = int(rng.integers(1, 40))  # some not_found
+                    evs.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=dr % 32 + 1,
+                        amount=int(rng.integers(1, 50)), ledger=1,
+                        code=1, flags=PEND,
+                        timeout=int(rng.integers(0, 5))))
+                    fresh.append(nid)
+                elif roll < 0.8 and pend_pool:
+                    target = pend_pool.pop(0)
+                    even = i % 2 == 0
+                    evs.append(Transfer(
+                        id=nid, pending_id=target,
+                        amount=U128MAX if even else 0,
+                        ledger=1, code=1, flags=POST if even else VOID))
+                else:
+                    evs.append(Transfer(
+                        id=nid, debit_account_id=int(rng.integers(1, 33)),
+                        credit_account_id=int(rng.integers(1, 33)) % 32 + 1,
+                        amount=int(rng.integers(0, 50)), ledger=1, code=1))
+                nid += 1
+            pend_pool.extend(fresh)
+            ts += 80
+            batches.append(transfers_to_arrays(evs))
+            raw.append(evs)
+            tss.append(ts)
+        outs = led.create_transfers_window(batches, tss)
+        for evs, ts_b in zip(raw, tss):
+            seq.create_transfers(evs, ts_b)
+        host_w = led.to_host()
+        host_s = seq.to_host()
+        assert host_w.accounts == host_s.accounts
+        assert host_w.transfers == host_s.transfers
+        assert host_w.pending_status == host_s.pending_status
+        assert set(host_w.orphaned) == set(host_s.orphaned)
+        assert host_w.pulse_next_timestamp == host_s.pulse_next_timestamp
+
+
+class TestFuzzInBatchPending:
+    @pytest.mark.parametrize("seed", [1, 2, 3, 4, 5])
+    def test_fuzz_mixed_pend_post_batches(self, seed):
+        dev, orc = _mk_pair(a_cap=1 << 10, t_cap=1 << 14)
+        rng = np.random.default_rng(seed)
+        nid = 10**6
+        known_ids = []
+        ts = 10**12
+        for b in range(4):
+            events = []
+            batch_ids = []
+            for i in range(128):
+                roll = rng.random()
+                if roll < 0.35:
+                    dr = int(rng.integers(1, 110))  # some invalid
+                    cr = int(rng.integers(1, 110))
+                    if dr == cr:
+                        cr = dr % 100 + 1
+                    events.append(Transfer(
+                        id=nid, debit_account_id=dr, credit_account_id=cr,
+                        amount=int(rng.integers(0, 1000)), ledger=1,
+                        code=1, flags=PEND,
+                        timeout=int(rng.integers(0, 10))))
+                elif roll < 0.7 and (batch_ids or known_ids):
+                    pool = batch_ids if ((rng.random() < 0.6 and batch_ids)
+                                         or not known_ids) else known_ids
+                    target = pool[int(rng.integers(0, len(pool)))]
+                    even = rng.random() < 0.5
+                    events.append(Transfer(
+                        id=nid, pending_id=target,
+                        amount=(U128MAX if even
+                                else int(rng.integers(0, 500))),
+                        ledger=1, code=1,
+                        flags=POST if even else VOID))
+                else:
+                    dr = int(rng.integers(1, 101))
+                    events.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=dr % 100 + 1,
+                        amount=int(rng.integers(0, 1000)),
+                        ledger=1, code=1,
+                        flags=LINKED if rng.random() < 0.1 else 0))
+                batch_ids.append(nid)
+                nid += 1
+            ts += 200
+            _diff_batch(dev, orc, events, ts)
+            known_ids.extend(batch_ids)
+            if len(known_ids) > 400:
+                del known_ids[:200]
+        _assert_state_parity(dev, orc)

@@ -285,17 +285,27 @@ def bench_config4(batches=2, n=None, account_count=64):
     from .types import CreateTransferStatus
 
     created_code = np.uint32(int(CreateTransferStatus.created))
+    # Commit-window aggregation (TPU): the deep superbatch tier resolves
+    # in-window pending references (pend batch i, post/void batch i+1)
+    # natively, so the alternating two-phase workload windows just like
+    # config2's scans — W stacked prepares per dispatch amortizes the
+    # fixed dispatch cost the tunnel regime is bound by. On CPU the
+    # kernel is compute-bound and windowing only adds sort width.
+    # One compiled window shape only: W_PAIRS must divide `batches` (a
+    # tail window of a different K would compile inside the timed region).
+    W_PAIRS = 1
+    if jax.default_backend() == "tpu":
+        for w in (4, 3, 2):
+            if batches % w == 0:
+                W_PAIRS = w
+                break
     accepted = 0
     ts = 10**12
     next_id = 10**7
-    t0 = None  # set after the warmup iteration (compile caches)
-    for b in range(-1, batches):
-        if b == 0:
-            accepted = 0  # warmup events don't count
-            t0 = time.perf_counter()
-        # SoA construction straight to the zero-object serving entry
-        # (create_transfers_soa) — the same discipline as configs 1-3;
-        # per-event Python objects would dominate the timed region.
+
+    def mk_pair_batches(ts_base):
+        nonlocal next_id
+        out = []
         pend_base = next_id
         next_id += n
         dr = rng.integers(1, account_count + 1, n, dtype=np.uint64)
@@ -305,9 +315,7 @@ def bench_config4(batches=2, n=None, account_count=64):
         ev = _soa(np.arange(pend_base, pend_base + n), dr, cr,
                   rng.integers(1, 100, n),
                   flags=np.full(n, pend, dtype=np.uint32))
-        ts += n + 10
-        st, _ = led.create_transfers_soa(ev, ts)
-        accepted += int((np.asarray(st) == created_code).sum())
+        out.append((ev, ts_base + n + 10))
         even = np.arange(n) % 2 == 0
         rev = _soa(np.arange(next_id, next_id + n),
                    np.zeros(n, dtype=np.uint64),
@@ -321,9 +329,30 @@ def bench_config4(batches=2, n=None, account_count=64):
         rev["ledger"] = np.zeros(n, dtype=np.uint32)  # inherit from pending
         rev["code"] = np.zeros(n, dtype=np.uint32)
         next_id += n
-        ts += n + 10
-        st, _ = led.create_transfers_soa(rev, ts)
-        accepted += int((np.asarray(st) == created_code).sum())
+        out.append((rev, ts_base + 2 * (n + 10)))
+        return out
+
+    t0 = None  # set after the warmup iteration (compile caches)
+    b = -1
+    while b < batches:
+        if b == 0 and t0 is None:
+            accepted = 0  # warmup events don't count
+            t0 = time.perf_counter()
+        pairs = W_PAIRS if b < 0 else min(W_PAIRS, batches - b)
+        window = []
+        for _ in range(pairs):
+            window.extend(mk_pair_batches(ts))
+            ts += 2 * (n + 10)
+        if W_PAIRS > 1:
+            outs = led.create_transfers_window(
+                [ev for ev, _ in window], [t for _, t in window])
+            for st, _ in outs:
+                accepted += int((np.asarray(st) == created_code).sum())
+        else:
+            for ev, ts_b in window:
+                st, _ = led.create_transfers_soa(ev, ts_b)
+                accepted += int((np.asarray(st) == created_code).sum())
+        b = b + pairs if b >= 0 else 0
     return accepted, time.perf_counter() - t0
 
 

@@ -161,6 +161,59 @@ def _dup_keys(k_hi, k_lo, tags):
     return jnp.any(eq & both)
 
 
+def _dup_and_pend_join(ev, valid, pv, idxs, N):
+    """Duplicate-key eligibility + in-batch pending join, ONE sort.
+
+    Keys: every tagged id (a potential in-batch pending DEFINITION) and
+    every tagged pid (a USE). Same-kind duplicates (two ids, or two pids)
+    are the fallback condition E2 — duplicate incoming ids and double
+    post/void of one pending stay on the exact host path. A pid matching
+    an id is NOT a fallback anymore: it is the in-window pending join
+    (reference: post_or_void_pending_transfer resolves against the
+    groove which already contains same-batch creations,
+    src/state_machine.zig:4053-4112).
+
+    Returns (dups, inwin, didx): dups = any same-kind duplicate; inwin =
+    this use has an in-batch definition EARLIER in the stream; didx = the
+    definition's event index (0 where absent; always gate on inwin)."""
+    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
+    k_hi = jnp.concatenate([ev["id_hi"], ev["pid_hi"]])
+    k_lo = jnp.concatenate([ev["id_lo"], ev["pid_lo"]])
+    tags = jnp.concatenate([tag, ptag])
+    kind = jnp.concatenate([jnp.zeros(N, dtype=jnp.int32),
+                            jnp.ones(N, dtype=jnp.int32)])
+    seq = jnp.concatenate([idxs, idxs])
+    untag = (~tags).astype(jnp.int32)
+    # Sort: key, tagged-first, defs-before-uses, stream order.
+    order = jnp.lexsort((seq, kind, untag, k_lo, k_hi))
+    s_hi, s_lo = k_hi[order], k_lo[order]
+    s_tag, s_kind, s_seq = tags[order], kind[order], seq[order]
+    eq = (s_hi[1:] == s_hi[:-1]) & (s_lo[1:] == s_lo[:-1])
+    both = s_tag[1:] & s_tag[:-1]
+    dups = jnp.any(eq & both & (s_kind[1:] == s_kind[:-1]))
+    # Runs of equal TAGGED keys; each run holds <= 1 def (else dups).
+    run_start = jnp.concatenate([
+        jnp.ones(1, dtype=jnp.bool_), ~(eq & both)])
+    run_id = _cumsum(run_start.astype(jnp.int32)) - 1
+    def_val = jnp.where(s_tag & (s_kind == 0), s_seq, jnp.int32(-1))
+    run_def = jax.ops.segment_max(def_val, run_id, num_segments=2 * N)
+    didx_sorted = run_def[run_id]
+    use_here = s_tag & (s_kind == 1)
+    hit_sorted = use_here & (didx_sorted >= 0)
+    # Scatter back to event positions (order is a permutation).
+    hit_full = jnp.zeros(2 * N, dtype=jnp.bool_).at[order].set(hit_sorted)
+    didx_full = jnp.zeros(2 * N, dtype=jnp.int32).at[order].set(
+        jnp.maximum(didx_sorted, 0))
+    inwin = hit_full[N:]
+    didx = didx_full[N:]
+    # Sequential truth: only definitions EARLIER in the stream exist at
+    # the use's evaluation point (a later def leaves the use
+    # pending_transfer_not_found and still creates itself).
+    inwin = inwin & (didx < idxs)
+    return dups, inwin, jnp.where(inwin, didx, 0)
+
+
 _FIELDS = ("dp", "dpos", "cp", "cpos")
 _FI = {f: i for i, f in enumerate(_FIELDS)}
 
@@ -333,7 +386,8 @@ def _xfer_gather_multi(xfr, rows_list):
     return outs
 
 
-def per_event_status(state, ev, ts_event, return_gathers=False):
+def per_event_status(state, ev, ts_event, return_gathers=False,
+                     inwin=None, didx=None):
     """The per-event phase of create_transfers: hash lookups, row gathers,
     and the order-independent status evaluation (exists/idempotency,
     post/void checks, regular checks, imported/timestamp rules — reference
@@ -399,6 +453,44 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
     p_rowc = jnp.where(p_found, p_row, T_dump)
 
     e, p = _xfer_gather_multi(xfr, [e_rowc, p_rowc])
+
+    # ---- in-window pending substitution (join computed by the caller;
+    # reference: the groove already holds same-batch creations at
+    # post_or_void time, src/state_machine.zig:4053-4112). A use whose
+    # pid matches an EARLIER in-batch definition reads the pending
+    # transfer's fields from the definition's EVENT lanes instead of the
+    # table gather. Gated off when the definition's id already exists in
+    # the table (live or orphaned): then the definition is not-created
+    # and the table row with that id is the sequential-truth target.
+    if inwin is not None:
+        dg = lambda a: a[didx]  # noqa: E731 — def-side gather
+        inwin = inwin & ~dg(e_found) & ~dg(o_found)
+        d_flags = dg(ev["flags"])
+        d_timeout = dg(ev["timeout"])
+        d_ts = dg(ts_event)
+        p2 = dict(
+            id_hi=dg(ev["id_hi"]), id_lo=dg(ev["id_lo"]),
+            dr_hi=dg(ev["dr_hi"]), dr_lo=dg(ev["dr_lo"]),
+            cr_hi=dg(ev["cr_hi"]), cr_lo=dg(ev["cr_lo"]),
+            amt_hi=dg(ev["amt_hi"]), amt_lo=dg(ev["amt_lo"]),
+            pid_hi=dg(ev["pid_hi"]), pid_lo=dg(ev["pid_lo"]),
+            ud128_hi=dg(ev["ud128_hi"]), ud128_lo=dg(ev["ud128_lo"]),
+            ud64=dg(ev["ud64"]), ud32=dg(ev["ud32"]),
+            timeout=d_timeout,
+            ledger=dg(ev["ledger"]), code=dg(ev["code"]),
+            flags=d_flags,
+            ts=d_ts,
+            expires=jnp.where(
+                d_timeout != 0,
+                d_ts + jnp.uint64(d_timeout) * _NSPS, jnp.uint64(0)),
+            pstat=jnp.where(_flag(d_flags, _F_PENDING),
+                            jnp.int32(_PS_PENDING), jnp.int32(0)),
+            dr_row=dg(dr_rowc), cr_row=dg(cr_rowc),
+        )
+        for key in p:
+            p[key] = jnp.where(inwin, p2[key], p[key])
+        p_found = p_found | inwin
+
     dr, cr, p_dr, p_cr = _acct_gather_multi(
         acc, [dr_rowc, cr_rowc, p["dr_row"], p["cr_row"]],
         [dr_found, cr_found, p_found, p_found])
@@ -453,6 +545,13 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
         (_flag(p_cr["flags"], _A_CLOSED) & ~is_void, _TS["credit_account_already_closed"]),
     ]
     pv_status = _first_failure(pv_checks)
+    # The use's status when its in-window definition turns out dead
+    # (failed creation): the pending transfer does not exist, so the
+    # sequential truth is the same check sequence with the lookup
+    # missing — earlier-precedence field checks still win.
+    pv_status_nf = _first_failure(
+        pv_checks[:6] + [(jnp.ones_like(pid_zero),
+                          _TS["pending_transfer_not_found"])])
 
     dr_zero = u128.is_zero(ev["dr_hi"], ev["dr_lo"])
     dr_max = u128.is_max(ev["dr_hi"], ev["dr_lo"])
@@ -507,6 +606,22 @@ def per_event_status(state, ev, ts_event, return_gathers=False):
         dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
         dr_found=dr_found, cr_found=cr_found, p_found=p_found,
     )
+    if inwin is not None:
+        # Fully-wrapped dead-definition variant (same pre/imported
+        # wrapping as status_pre, pv branch replaced by the not-found
+        # sequence) for the dependency fixpoint's override.
+        inner_nf = jnp.where(
+            e_found, exists_status,
+            jnp.where(o_found, _TS["id_already_failed"],
+                      jnp.where(pv, pv_status_nf, reg_status)))
+        inner_nf = jnp.where(pre != _CREATED, pre, inner_nf)
+        status_nf = jnp.where(~imported & (ev["ts"] != 0),
+                              _TS["timestamp_must_be_zero"], inner_nf)
+        status_nf = jnp.where(imported,
+                              _TS["imported_event_not_expected"], status_nf)
+        out["inwin"] = inwin
+        out["didx"] = didx
+        out["status_pre_dead"] = status_nf
     if return_gathers:
         out["_gathers"] = (dr, cr, p, p_dr, p_cr)
     return out
@@ -573,8 +688,27 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
 
     if per_event is None:
+        e2, inwin_raw, didx = _dup_and_pend_join(ev, valid, pv, idxs, N)
         per_event = per_event_status(state, ev, ts_event,
-                                     return_gathers=True)
+                                     return_gathers=True,
+                                     inwin=inwin_raw, didx=didx)
+        inwin = per_event["inwin"]
+        didx = per_event["didx"]
+        status_dead = per_event["status_pre_dead"]
+    else:
+        # SPMD path (parallel/full_sharded.py): per-shard status was
+        # computed WITHOUT the batch-global join, so keep the legacy
+        # rule — any id/pid collision (incl. in-batch pending refs)
+        # falls back. Same-kind duplicates fall back either way.
+        tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+        ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
+        e2 = _dup_keys(
+            jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
+            jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
+            jnp.concatenate([tag, ptag]))
+        inwin = jnp.zeros(N, dtype=jnp.bool_)
+        didx = jnp.zeros(N, dtype=jnp.int32)
+        status_dead = per_event["status_pre"]
     dr_rowc = per_event["dr_row"]
     cr_rowc = per_event["cr_row"]
     p_rowc = per_event["p_row"]
@@ -601,12 +735,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
     e1 = jnp.any(valid & _flag(flags, jnp.uint32(hard_flags)))
 
-    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
-    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
-    e2 = _dup_keys(
-        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
-        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
-        jnp.concatenate([tag, ptag]))
+    # Eligibility sums below run over the OPTIMISTIC apply set: events
+    # whose per-event status is already a failure can never apply (the
+    # fixpoint only flips events within this set toward failure), so
+    # excluding them keeps every proof a true upper bound — and keeps
+    # doomed events' sentinel amounts (e.g. a post-of-post carrying
+    # amount=u128max) from tripping the overflow proof spuriously.
+    opt = valid & (status == _CREATED)
 
     # E3 relaxed (headroom proof): balance-limit-flagged accounts no
     # longer force a fallback outright. A limit check
@@ -618,7 +753,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # headroom) still fits the pre-batch headroom: then no event can
     # fail the limit in any prefix, so parallel == sequential. Only a
     # potential breach falls back to the exact path.
-    reg = valid & ~pv
+    reg = opt & ~pv
     A_rows = acc["u64"].shape[0]
     z64 = jnp.uint64(0)
     ral0, ral1, ral2, ral3 = _to_limbs(
@@ -664,8 +799,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # oscillate on workloads that sit near their limits without crossing).
     proof_breach = e3
 
-    a_hi = jnp.where(valid, amt_res_hi, jnp.uint64(0))
-    a_lo = jnp.where(valid, amt_res_lo, jnp.uint64(0))
+    a_hi = jnp.where(opt, amt_res_hi, jnp.uint64(0))
+    a_lo = jnp.where(opt, amt_res_lo, jnp.uint64(0))
     l0, l1, l2, l3 = _to_limbs(a_hi, a_lo)
     # One stacked reduction instead of four (dispatch-count discipline).
     s0, s1, s2, s3 = jnp.sum(jnp.stack([l0, l1, l2, l3]), axis=1)
@@ -687,9 +822,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         for f1, f2 in (("dp", "dpos"), ("cp", "cpos")):
             h, l, o = u128.add(acct_g[f1][0], acct_g[f1][1],
                                acct_g[f2][0], acct_g[f2][1])
-            pair_his.append(jnp.where(valid, h, zeros))
-            pair_los.append(jnp.where(valid, l, zeros))
-            pair_ovfs.append(valid & o)
+            pair_his.append(jnp.where(opt, h, zeros))
+            pair_los.append(jnp.where(opt, l, zeros))
+            pair_ovfs.append(opt & o)
     # One stacked any over all eight overflow lanes (was eight reduces).
     pair_ovf = jnp.any(jnp.stack(pair_ovfs))
     m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
@@ -759,13 +894,29 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
         over_dr = jnp.zeros_like(valid)
         over_cr = jnp.zeros_like(valid)
+        dead = jnp.zeros_like(valid)
         fix_converged = jnp.bool_(True)
         for _round in range(limit_rounds):
             st_r = jnp.where(over_dr, _TS["exceeds_credits"], status)
             st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                              st_r)
-            st_r, _, _, _ = _chain_pass(st_r, linked, valid, idxs, n, N,
-                                        seg_start, chain_term)
+            # In-window dependency deaths from the PREVIOUS round's
+            # final statuses: a use whose definition did not create
+            # reads pending_transfer_not_found (sequential truth).
+            st_r = jnp.where(dead, status_dead, st_r)
+            st_c, _, my_first_r, in_chain_r = _chain_pass(
+                st_r, linked, valid, idxs, n, N, seg_start, chain_term)
+            # Definition liveness AS OF THE USE's execution point: the
+            # def is absent iff it failed on its own (pre-chain status)
+            # or its chain broke STRICTLY BEFORE the use — a chain whose
+            # first failure IS the use itself still had the def applied
+            # when the use evaluated (the rollback happens at the use's
+            # failure, after its own status code is assigned; reference
+            # execute_create :3116-3150).
+            def_dead = ((st_r[didx] != _CREATED)
+                        | (in_chain_r[didx] & (my_first_r[didx] < idxs)))
+            new_dead = inwin & def_dead
+            st_r = st_c
             ap_r = valid & (st_r == _CREATED)
             fl = _delta_lanes2(ap_r & ~pv & ~pending, ap_r & ~pv & pending,
                                ap_r & pv, ap_r & pv & is_post, alx, nlx)
@@ -784,11 +935,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             new_over_dr = cand_dr & _over(pre_dr, "dp", "dpos", "cpos", alx)
             new_over_cr = cand_cr & _over(pre_cr, "cp", "cpos", "dpos", alx)
             fix_converged = jnp.all((new_over_dr == over_dr)
-                                    & (new_over_cr == over_cr))
-            over_dr, over_cr = new_over_dr, new_over_cr
+                                    & (new_over_cr == over_cr)
+                                    & (new_dead == dead))
+            over_dr, over_cr, dead = new_over_dr, new_over_cr, new_dead
         status = jnp.where(over_dr, _TS["exceeds_credits"], status)
         status = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                            status)
+        status = jnp.where(dead, status_dead, status)
         e3 = ~fix_converged
 
     fallback_pre = e1 | e2 | e3 | e4 | e5
@@ -834,14 +987,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     xfer_pos, ins_ok = ht_plan(
         state["xfer_ht"], ev["id_hi"], ev["id_lo"], ins_mask)
 
+    # In-window pending references need the dependency fixpoint: the
+    # proof-gated tier (limit_rounds == 1) flags them for escalation to
+    # the fixpoint variants, exactly like headroom-proof breaches.
+    e_dep = (jnp.any(inwin) if limit_rounds == 1
+             else jnp.bool_(False))
     others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok
     if force_fallback is not None:
         others = others | force_fallback
-    fallback = others | e3
-    # A fallback caused ONLY by the balance-limit headroom proof is
-    # resolvable on device: the caller redispatches it to the fixpoint
-    # variant (limit_rounds > 1) instead of the exact host path.
-    limit_only = e3 & ~others & jnp.bool_(limit_rounds == 1)
+    fallback = others | e3 | e_dep
+    # A fallback caused ONLY by the balance-limit headroom proof and/or
+    # in-window pending references is resolvable on device: the caller
+    # redispatches it to the fixpoint variant (limit_rounds > 1)
+    # instead of the exact host path.
+    limit_only = (e3 | e_dep) & ~others & jnp.bool_(limit_rounds == 1)
     ok = ~fallback
 
     # ---------------- application (all masked by ok) ----------------
@@ -860,16 +1019,16 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # scatter per limb replaces per-delta scatter-adds plus a separate
     # carry-normalize pass.
 
-    # Pending-status flips on committed pendings (E2 guarantees unique
-    # rows; masked lanes write a uniform 0 to the dump slot so the
-    # duplicate-index scatter stays deterministic).
-    flip_pos = jnp.where(ap_pv, p_rowc, T_dump)
-    i32_flipped = xfr["i32"].at[flip_pos, XF_I32_IDX["pstat"]].set(
-        jnp.where(ap_pv, jnp.where(is_post, _PS_POSTED, _PS_VOIDED),
-                  jnp.int32(0)))
-
     # Insert created transfer rows (compacted).
     trow = jnp.where(ap, new_rows, T_dump)
+    # Pending-status flips on committed pendings (E2 guarantees unique
+    # rows; masked lanes write a uniform 0 to the dump slot so the
+    # duplicate-index scatter stays deterministic). An in-window use
+    # flips the row its definition is inserting IN THIS DISPATCH —
+    # trow[didx] — so the flip scatter must run AFTER the row insert
+    # (below), or the insert would overwrite the flip with PENDING.
+    flip_pos = jnp.where(ap_pv,
+                         jnp.where(inwin, trow[didx], p_rowc), T_dump)
     ud128z = u128.is_zero(ev["ud128_hi"], ev["ud128_lo"])
     stores = dict(
         id_hi=ev["id_hi"], id_lo=ev["id_lo"],
@@ -901,13 +1060,16 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     u32_rows = jnp.stack([stores[n] for n in XF_U32], axis=1)
     i32_rows = jnp.stack([stores[n] for n in XF_I32], axis=1)
     apn = ap[:, None]
+    i32_inserted = xfr["i32"].at[trow].set(
+        jnp.where(apn, i32_rows, jnp.int32(0)))
     new_xfr = {
         "u64": xfr["u64"].at[trow].set(
             jnp.where(apn, u64_rows, jnp.uint64(0))),
         "u32": xfr["u32"].at[trow].set(
             jnp.where(apn, u32_rows, jnp.uint32(0))),
-        "i32": i32_flipped.at[trow].set(
-            jnp.where(apn, i32_rows, jnp.int32(0))),
+        "i32": i32_inserted.at[flip_pos, XF_I32_IDX["pstat"]].set(
+            jnp.where(ap_pv, jnp.where(is_post, _PS_POSTED, _PS_VOIDED),
+                      jnp.int32(0))),
         "count": xfr["count"] + jnp.where(ok, n_created, 0),
     }
 
@@ -991,7 +1153,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                         jnp.where(is_post, _PS_POSTED,
                                   jnp.where(is_void, _PS_VOIDED,
                                             jnp.int32(0)))),
-        p_row=jnp.where(ap_pv, p_rowc, jnp.int32(-1)),
+        p_row=jnp.where(ap_pv,
+                        jnp.where(inwin, trow[didx], p_rowc),
+                        jnp.int32(-1)),
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
         # Effective-side account flags: already gathered in the per-event
@@ -1099,6 +1263,22 @@ def _create_transfers_super(state, ev, seg, force_fallback=None):
 # on a local chip it amortizes fixed dispatch overhead the same way.
 create_transfers_super_jit = jax.jit(
     _create_transfers_super, donate_argnums=0)
+
+
+def _create_transfers_super_deep(state, ev, seg, force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg,
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP)
+
+
+# Deep-fixpoint superbatch: commit windows whose prepares carry
+# order-dependent balance limits AND/OR in-window pending references
+# (pend in prepare i, post/void in prepare j>i — the config4 shape).
+# Resolves both natively: the K-round fixpoint now also propagates
+# definition deaths to their dependent uses.
+create_transfers_super_deep_jit = jax.jit(
+    _create_transfers_super_deep, donate_argnums=0)
 
 # The order-dependent-limits variant: resolves headroom-proof breaches
 # natively with a K-round status fixpoint (cascades deeper than K

@@ -14,7 +14,7 @@ reader actually asks for one.
     only happens on rare paths (state-sync snapshot encode, host-engine
     query index builds, parity tests).
   - `DeltaChunk` — one drained delta's columns (t/e/der, the
-    _xfer_delta_fetch layout) + row -> object builders that reproduce the
+    _delta_fetch_start layout) + row -> object builders that reproduce the
     eager drain's values field-for-field.
   - `LazyEventRecord` — account_events entry backed by a chunk row;
     builds its AccountEventRecord (including the two per-event account
@@ -45,7 +45,7 @@ _TFLAGS_NONE = 0xFFFFFFFF
 class DeltaChunk:
     """One drained fast-batch delta: the fetched numpy columns plus the
     owning mirror (for account immutable fields and pending-transfer
-    resolution). Columns are the _xfer_delta_fetch layout: `t` = xf_named
+    resolution). Columns are the _delta_fetch_start layout: `t` = xf_named
     transfer rows, `e` = ev_named event rows, `der` = derived gathers
     (touched account ids, pending timestamps)."""
 

@@ -259,6 +259,102 @@ def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
     )
 
 
+_DER_KEYS = ("dr_id_hi", "dr_id_lo", "cr_id_hi", "cr_id_lo", "p_ts")
+
+
+class _DeltaFetchHandle:
+    """One in-flight device-side delta gather. Construction starts an
+    async device->host copy where the backend supports it; `slice_cols`
+    blocks (device_get, memoized) and returns exact-size host copies so
+    the padded bucket buffer is never pinned by long-lived chunks."""
+
+    __slots__ = ("_dev", "_host", "t0", "_t_off", "_e_off")
+
+    def __init__(self, dev_out, t0, t_off, e_off):
+        self._dev = dev_out
+        self._host = None
+        self.t0 = t0
+        self._t_off = t_off
+        self._e_off = e_off
+        try:
+            import jax
+
+            for leaf in jax.tree_util.tree_leaves(dev_out):
+                leaf.copy_to_host_async()
+        except Exception:
+            pass  # backend without async copy: resolve() pays the wait
+
+    def _resolve(self):
+        host = self._host
+        if host is None:
+            import jax
+
+            host = self._host = jax.device_get(self._dev)
+            self._dev = None
+        return host
+
+    def slice_cols(self, which: str, rel: int, n: int) -> dict:
+        out = self._resolve()
+        if which == "t":
+            o = self._t_off + rel
+            return xf_named({k: v[o:o + n].copy()
+                             for k, v in out["t"].items()})
+        o = self._e_off + rel
+        if which == "e":
+            return ev_named({k: v[o:o + n].copy()
+                             for k, v in out["e"].items()})
+        assert which == "der"
+        return {k: out[k][o:o + n].copy() for k in _DER_KEYS}
+
+
+class _LazyCols:
+    """Named-column dict over a _DeltaFetchHandle slice, loaded on first
+    access. Supports exactly the mapping surface the drain, the lazy
+    mirror, and the durable column flusher use."""
+
+    __slots__ = ("_handle", "_which", "_rel", "_n", "_d")
+
+    def __init__(self, handle, which, rel, n):
+        self._handle = handle
+        self._which = which
+        self._rel = rel
+        self._n = n
+        self._d = None
+
+    def load(self) -> dict:
+        d = self._d
+        if d is None:
+            d = self._d = self._handle.slice_cols(
+                self._which, self._rel, self._n)
+            self._handle = None
+        return d
+
+    @property
+    def loaded(self) -> bool:
+        return self._d is not None
+
+    def __getitem__(self, key):
+        return self.load()[key]
+
+    def __contains__(self, key):
+        return key in self.load()
+
+    def keys(self):
+        return self.load().keys()
+
+    def values(self):
+        return self.load().values()
+
+    def items(self):
+        return self.load().items()
+
+    def __iter__(self):
+        return iter(self.load())
+
+    def __len__(self):
+        return len(self.load())
+
+
 def _acct_delta_gather(state, a_start, size):
     from jax import lax
 
@@ -343,6 +439,27 @@ def stack_superbatch(evs: list[dict], timestamps: list[int],
     return ev_super, seg
 
 
+def _window_has_pend_refs(ev_s: dict) -> bool:
+    """Host-side pre-route: does any pid in the stacked window match any
+    id in it? (numpy key-merge; u128 keys as (hi, lo) rows). True routes
+    the window straight to the deep superbatch tier — its dependency
+    fixpoint is the only tier that can keep such a window on device."""
+    pid_hi = np.asarray(ev_s["pid_hi"])
+    pid_lo = np.asarray(ev_s["pid_lo"])
+    nz = (pid_hi != 0) | (pid_lo != 0)
+    if not nz.any():
+        return False
+    valid = np.asarray(ev_s["valid"])
+    ids = np.stack([np.asarray(ev_s["id_hi"])[valid],
+                    np.asarray(ev_s["id_lo"])[valid]], axis=1)
+    pids = np.stack([pid_hi[nz & valid], pid_lo[nz & valid]], axis=1)
+    if not len(pids):
+        return False
+    cat = np.concatenate([np.unique(ids, axis=0), np.unique(pids, axis=0)])
+    _, counts = np.unique(cat, axis=0, return_counts=True)
+    return bool((counts > 1).any())
+
+
 class DeviceLedger:
     """Stateful wrapper: owns the device pytree + fallback orchestration."""
 
@@ -387,6 +504,9 @@ class DeviceLedger:
         # them every commit, so retention is bounded by one bar).
         self.retain_flush_columns = False
         self._flush_columns: list = []
+        # Unloaded lazy fetch columns (device buffers still alive); capped
+        # so a long drain-free run cannot accumulate unbounded HBM.
+        self._pending_cols: list = []
         # Device transfer-row count INCLUDING queued chunks (len(_xfer_row)
         # lags it until the next drain).
         self._xfer_rows_dev = 0
@@ -497,20 +617,42 @@ class DeviceLedger:
           prepares."""
         import jax
 
-        from .fast_kernels import create_transfers_super_jit
+        from .fast_kernels import (create_transfers_super_deep_jit,
+                                   create_transfers_super_jit)
 
         assert len(evs) == len(timestamps) and evs
         ns = [len(e["id_lo"]) for e in evs]
-        eligible = (len(evs) > 1 and not self._mirror_route()
-                    and not self._fixpoint_first)
+        eligible = len(evs) > 1 and not self._mirror_route()
         if eligible:
             n_pad = _pad_bucket(max(ns))
             ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
+            # Route straight to the deep tier when the window carries
+            # in-window pending references or the workload has been
+            # breaching limits (the shallow dispatch is a known waste) —
+            # one numpy key-merge vs an ~800 ms wasted chip dispatch.
+            deep_first = (self._fixpoint_first
+                          or _window_has_pend_refs(ev_s))
             ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
             seg = {k: jax.device_put(v) for k, v in seg.items()}
-            new_state, out = create_transfers_super_jit(
-                self.state, ev_s, seg)
-            self.state = new_state
+            if deep_first:
+                new_state, out = create_transfers_super_deep_jit(
+                    self.state, ev_s, seg)
+                self.state = new_state
+                self.deep_fixpoint_batches += len(evs)
+            else:
+                new_state, out = create_transfers_super_jit(
+                    self.state, ev_s, seg)
+                self.state = new_state
+                fb0, lo0 = (bool(x) for x in jax.device_get(
+                    (out["fallback"], out["limit_only"])))
+                if fb0 and lo0:
+                    # Limits and/or in-window pendings were the ONLY
+                    # obstacle: resolve on the deep superbatch tier
+                    # (state was donated but unchanged on fallback).
+                    new_state, out = create_transfers_super_deep_jit(
+                        self.state, ev_s, seg)
+                    self.state = new_state
+                    self.deep_fixpoint_batches += len(evs)
             if not bool(jax.device_get(out["fallback"])):
                 self.fast_batches += len(evs)
                 self._probe_succeeded()
@@ -1090,14 +1232,23 @@ class DeviceLedger:
 
     # ------------------------------------------------- write-through deltas
 
-    def _xfer_delta_fetch(self, n_new: int):
-        """Bounded device->host fetch of one fast batch's effects: the
-        n_new appended transfer rows + event-ring rows, plus derived
-        gathers (touched account ids, pending-transfer timestamps). Fixed
-        slice sizes (256 / N_PAD / 8*N_PAD) keep the compile count at
-        three — point batches, one prepare, a full commit window."""
-        import jax
+    def _delta_fetch_start(self, n_new: int) -> "_DeltaFetchHandle":
+        """Issue one bounded device-side delta gather WITHOUT blocking on
+        the device->host transfer: the n_new appended transfer rows +
+        event-ring rows, plus derived gathers (touched account ids,
+        pending-transfer timestamps). Fixed slice sizes (256 / N_PAD /
+        8*N_PAD) keep the compile count at three — point batches, one
+        prepare, a full commit window.
 
+        The returned handle starts an async host copy where the backend
+        supports it and resolves (device_get + exact-size slice copies)
+        on first column access — which happens at drain/flush time, NOT
+        on the serving commit path. On chip the transfer is the dominant
+        serving cost beyond the kernel (~25 MB per 8-prepare window), so
+        deferring it moves that cost off the commit boundary and overlaps
+        the DMA with subsequent dispatches (reference doctrine: commit is
+        the cheap part, src/state_machine.zig:2564; prefetch/IO overlaps
+        execution, src/lsm/groove.zig:1339)."""
         t0 = self._xfer_rows_dev
         e0 = self._events_pushed
         t_len = int(self.state["transfers"]["u64"].shape[0])
@@ -1113,16 +1264,18 @@ class DeviceLedger:
         e_start = max(0, min(e0, e_len - size_e))
         out = _xfer_delta_gather_jit(
             self.state, np.int32(t_start), np.int32(e_start), size_t, size_e)
-        out = jax.device_get(out)
-        t_off, e_off = t0 - t_start, e0 - e_start
-        t = xf_named({k: v[t_off:t_off + n_new]
-                      for k, v in out["t"].items()})
-        e = ev_named({k: v[e_off:e_off + n_new]
-                      for k, v in out["e"].items()})
-        der = {k: out[k][e_off:e_off + n_new]
-               for k in ("dr_id_hi", "dr_id_lo", "cr_id_hi", "cr_id_lo",
-                         "p_ts")}
-        return t, e, der, t0
+        return _DeltaFetchHandle(out, t0, t0 - t_start, e0 - e_start)
+
+    def _track_pending_cols(self, *cols) -> None:
+        """Memory-bounds doctrine: at most ~32 unresolved delta fetches
+        may hold device buffers; beyond that the oldest are loaded (their
+        async copies have long completed), releasing the device side."""
+        self._pending_cols = [cs for cs in self._pending_cols
+                              if not cs[0].loaded]
+        self._pending_cols.append(cols)
+        while len(self._pending_cols) > 32:
+            for c in self._pending_cols.pop(0):
+                c.load()
 
     def _capture_window_delta(self, evs: list, st_slices: list,
                               exact_chunks: bool = False) -> None:
@@ -1143,22 +1296,19 @@ class DeviceLedger:
 
         def flush_group(group):
             total = sum(n for n, _ in group)
-            if total:
-                t, e, der, t0 = self._xfer_delta_fetch(total)
+            handle = self._delta_fetch_start(total) if total else None
             off = 0
             for n_new, orphan_ids in group:
                 if n_new:
-                    # Copies, not views: a view would pin the whole
-                    # group-sized fetch buffer in the retained flush
-                    # queue until the durable flush consumes it.
-                    tc = {k: v[off:off + n_new].copy()
-                          for k, v in t.items()}
-                    ec = {k: v[off:off + n_new].copy()
-                          for k, v in e.items()}
-                    derc = {k: v[off:off + n_new].copy()
-                            for k, v in der.items()}
+                    # Lazy column views: the fetch resolves (exact-size
+                    # copies, full buffer released) on first access —
+                    # at drain/flush, off the commit path.
+                    tc = _LazyCols(handle, "t", off, n_new)
+                    ec = _LazyCols(handle, "e", off, n_new)
+                    derc = _LazyCols(handle, "der", off, n_new)
+                    self._track_pending_cols(tc, ec, derc)
                     self._mirror_chunks.append(
-                        (tc, ec, derc, t0 + off, n_new, orphan_ids))
+                        (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
                     if self.retain_flush_columns:
                         self._flush_columns.append(
                             (tc, ec, derc, n_new, self._events_seen_abs,
@@ -1222,8 +1372,12 @@ class DeviceLedger:
                          orphan_ids))
             self._clear_dirty_dev()
             return
-        t, e, der, t0 = self._xfer_delta_fetch(n_new)
-        self._mirror_chunks.append((t, e, der, t0, n_new, orphan_ids))
+        handle = self._delta_fetch_start(n_new)
+        t = _LazyCols(handle, "t", 0, n_new)
+        e = _LazyCols(handle, "e", 0, n_new)
+        der = _LazyCols(handle, "der", 0, n_new)
+        self._track_pending_cols(t, e, der)
+        self._mirror_chunks.append((t, e, der, handle.t0, n_new, orphan_ids))
         if self.retain_flush_columns:
             # The durable flusher consumes these columns directly (the
             # vectorized flush path) — retained at CAPTURE, so flushing
