@@ -161,6 +161,19 @@ def _dup_keys(k_hi, k_lo, tags):
     return jnp.any(eq & both)
 
 
+def _combined_dup_keys(ev, valid, pv):
+    """Legacy combined collision check: any two tagged keys (ids and
+    pids in one pool) equal. One cheap sort; cannot distinguish real
+    duplicates from in-batch pending references — callers that need the
+    split use _dup_and_pend_join."""
+    tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
+    ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
+    return _dup_keys(
+        jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
+        jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
+        jnp.concatenate([tag, ptag]))
+
+
 def _dup_and_pend_join(ev, valid, pv, idxs, N):
     """Duplicate-key eligibility + in-batch pending join, ONE sort.
 
@@ -687,7 +700,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     pv = is_post | is_void
     timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
 
-    if per_event is None:
+    spmd_legacy = per_event is not None
+    if per_event is None and limit_rounds > 1:
+        # Fixpoint tiers: the precise dup/join split + in-window pending
+        # substitution (~50 extra ops — only these tiers can USE the
+        # join, so only they pay for it).
         e2, inwin_raw, didx = _dup_and_pend_join(ev, valid, pv, idxs, N)
         per_event = per_event_status(state, ev, ts_event,
                                      return_gathers=True,
@@ -695,17 +712,25 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         inwin = per_event["inwin"]
         didx = per_event["didx"]
         status_dead = per_event["status_pre_dead"]
+    elif per_event is None:
+        # Plain tier (the scan hot path): the legacy combined dup check —
+        # ONE cheap sort, no join, no substitution. Any collision
+        # (same-kind dup OR an in-batch pending reference) sets e2; the
+        # escalation flag below routes e2-only batches to the fixpoint
+        # tier, whose precise join then either resolves the pending
+        # reference on device or (real duplicates) falls back to host.
+        e2 = _combined_dup_keys(ev, valid, pv)
+        per_event = per_event_status(state, ev, ts_event,
+                                     return_gathers=True)
+        inwin = jnp.zeros(N, dtype=jnp.bool_)
+        didx = jnp.zeros(N, dtype=jnp.int32)
+        status_dead = per_event["status_pre"]
     else:
         # SPMD path (parallel/full_sharded.py): per-shard status was
         # computed WITHOUT the batch-global join, so keep the legacy
         # rule — any id/pid collision (incl. in-batch pending refs)
         # falls back. Same-kind duplicates fall back either way.
-        tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
-        ptag = valid & pv & ~((ev["pid_hi"] == 0) & (ev["pid_lo"] == 0))
-        e2 = _dup_keys(
-            jnp.concatenate([ev["id_hi"], ev["pid_hi"]]),
-            jnp.concatenate([ev["id_lo"], ev["pid_lo"]]),
-            jnp.concatenate([tag, ptag]))
+        e2 = _combined_dup_keys(ev, valid, pv)
         inwin = jnp.zeros(N, dtype=jnp.bool_)
         didx = jnp.zeros(N, dtype=jnp.int32)
         status_dead = per_event["status_pre"]
@@ -944,8 +969,6 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         status = jnp.where(dead, status_dead, status)
         e3 = ~fix_converged
 
-    fallback_pre = e1 | e2 | e3 | e4 | e5
-
     # ---------------- chains: segment first-failure broadcast ----------------
     status, not_the_failure, my_first, in_chain = _chain_pass(
         status, linked, valid, idxs, n, N, seg_start, chain_term)
@@ -987,20 +1010,28 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     xfer_pos, ins_ok = ht_plan(
         state["xfer_ht"], ev["id_hi"], ev["id_lo"], ins_mask)
 
-    # In-window pending references need the dependency fixpoint: the
-    # proof-gated tier (limit_rounds == 1) flags them for escalation to
-    # the fixpoint variants, exactly like headroom-proof breaches.
-    e_dep = (jnp.any(inwin) if limit_rounds == 1
-             else jnp.bool_(False))
-    others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok
+    if limit_rounds == 1 and not spmd_legacy:
+        # Plain tier: e2 is the COMBINED collision check — it may be an
+        # in-batch pending reference the fixpoint tier can resolve, so
+        # it escalates instead of hard-falling-back.
+        others = e1 | e4 | e5 | e7 | e8 | ~ins_ok
+        escalatable = e3 | e2
+    else:
+        # Fixpoint tiers: e2 is precise same-kind duplicates (real
+        # fallback). SPMD path (per_event supplied): per-shard statuses
+        # were computed without the batch-global join, so its combined
+        # e2 stays a HARD fallback too (escalating it would loop — the
+        # sharded driver has no fixpoint tier to redispatch to).
+        others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok
+        escalatable = e3
     if force_fallback is not None:
         others = others | force_fallback
-    fallback = others | e3 | e_dep
+    fallback = others | escalatable
     # A fallback caused ONLY by the balance-limit headroom proof and/or
-    # in-window pending references is resolvable on device: the caller
-    # redispatches it to the fixpoint variant (limit_rounds > 1)
-    # instead of the exact host path.
-    limit_only = (e3 | e_dep) & ~others & jnp.bool_(limit_rounds == 1)
+    # a key collision (possible in-window pending reference) is
+    # resolvable on device: the caller redispatches it to the fixpoint
+    # variant (limit_rounds > 1) instead of the exact host path.
+    limit_only = escalatable & ~others & jnp.bool_(limit_rounds == 1)
     ok = ~fallback
 
     # ---------------- application (all masked by ok) ----------------
