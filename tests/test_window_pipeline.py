@@ -1,0 +1,234 @@
+"""Pipelined commit windows (DeviceLedger.submit_window /
+resolve_windows): depth-N in-flight windows with chained force_fallback
+poisoning must be bit-identical to the synchronous window path — incl.
+a fallback mid-pipeline, write-through capture, flush columns, and the
+event-ring reset mode.
+
+Reference analog: the primary pipelines up to 8 prepares
+(src/config.zig:155); a failed prepare poisons the pipeline suffix."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import Account, Operation, Transfer, TransferFlags
+
+PEND = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+U128MAX = (1 << 128) - 1
+
+
+def _mk_led(t_cap=1 << 13):
+    led = DeviceLedger(a_cap=1 << 10, t_cap=t_cap)
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+    return led
+
+
+def _windows(rng, n_windows, k=3, n=64, base=10**6, with_pend=False,
+             poison_window=None):
+    """n_windows windows of k batches each; optionally a batch with a
+    duplicate id (hard fallback) inside window `poison_window`."""
+    out = []
+    nid = base
+    ts = 10**12
+    pend_pool = []
+    for w in range(n_windows):
+        evs, tss = [], []
+        for b in range(k):
+            batch = []
+            for i in range(n):
+                dr = int(rng.integers(1, 65))
+                if with_pend and pend_pool and i % 5 == 0:
+                    batch.append(Transfer(
+                        id=nid, pending_id=pend_pool.pop(0),
+                        amount=U128MAX, ledger=1, code=1, flags=POST))
+                else:
+                    f = PEND if (with_pend and i % 4 == 0) else 0
+                    batch.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=dr % 64 + 1,
+                        amount=int(rng.integers(1, 100)), ledger=1,
+                        code=1, flags=f, timeout=10 if f else 0))
+                    if f:
+                        pend_pool.append(nid)
+                nid += 1
+            if poison_window == w and b == k // 2:
+                # duplicate id within the batch: hard fallback (E2)
+                batch[-1] = Transfer(
+                    id=batch[0].id, debit_account_id=1,
+                    credit_account_id=2, amount=1, ledger=1, code=1)
+            ts += n + 10
+            evs.append(batch)
+            tss.append(ts)
+        out.append((evs, tss))
+    return out
+
+
+def _state_eq(a, b):
+    assert a.accounts == b.accounts
+    assert a.transfers == b.transfers
+    assert a.pending_status == b.pending_status
+    assert a.expiry == b.expiry
+    assert set(a.orphaned) == set(b.orphaned)
+    assert a.pulse_next_timestamp == b.pulse_next_timestamp
+    assert a.commit_timestamp == b.commit_timestamp
+
+
+@pytest.mark.parametrize("with_pend,poison", [
+    (False, None), (True, None), (False, 1), (True, 2)])
+def test_pipeline_matches_sync(with_pend, poison):
+    rng = np.random.default_rng(3)
+    windows = _windows(rng, 4, with_pend=with_pend, poison_window=poison)
+    led_p = _mk_led()
+    led_s = _mk_led()
+
+    # Pipelined, depth 2. A host-regime stretch (after a hard-fallback
+    # redo) makes submit_window return None — the caller then resolves
+    # and takes the synchronous path, exactly like the serving driver.
+    pending = []
+    results_p = []
+    for evs, tss in windows:
+        arrays = [transfers_to_arrays(b) for b in evs]
+        tk = led_p.submit_window(arrays, tss)
+        if tk is None:
+            led_p.resolve_windows()
+            while pending:
+                results_p.append(pending.pop(0).results)
+            results_p.append(
+                ("sync", led_p.create_transfers_window(arrays, tss)))
+            continue
+        pending.append(tk)
+        if len(pending) > 1:
+            led_p.resolve_windows(count=1)
+            # a fallback resolves the whole pipeline; collect in order
+            while pending and pending[0].results is not None:
+                results_p.append(pending.pop(0).results)
+    led_p.resolve_windows()
+    for tk in pending:
+        results_p.append(tk.results)
+
+    # Synchronous windows.
+    results_s = []
+    for evs, tss in windows:
+        out = led_s.create_transfers_window(
+            [transfers_to_arrays(b) for b in evs], tss)
+        results_s.append(out)
+
+    assert len(results_p) == len(results_s)
+    for (kind_res), outs_s in zip(results_p, results_s):
+        _, outs_p = kind_res
+        for (st_p, ts_p), (st_s, ts_s) in zip(outs_p, outs_s):
+            np.testing.assert_array_equal(np.asarray(st_p),
+                                          np.asarray(st_s))
+            np.testing.assert_array_equal(np.asarray(ts_p),
+                                          np.asarray(ts_s))
+    _state_eq(led_p.to_host(), led_s.to_host())
+
+
+def test_pipeline_ring_reset_serving_mode():
+    """Serving mode (recycle_events): the ring-reset kernel variants
+    keep the event ring bounded per window with no host barrier."""
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+
+    rng = np.random.default_rng(5)
+    windows = _windows(rng, 5, with_pend=True, base=2 * 10**6)
+
+    def mk_serving():
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13,
+                           write_through=StateMachineOracle())
+        led.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+        led.recycle_events = True
+        led.retain_flush_columns = True
+        return led
+
+    led_p = mk_serving()
+    led_s = mk_serving()
+
+    pending = []
+    for evs, tss in windows:
+        tk = led_p.submit_window(
+            [transfers_to_arrays(b) for b in evs], tss)
+        assert tk is not None
+        pending.append(tk)
+        if len(pending) > 1:
+            led_p.resolve_windows(count=1)
+            pending = [t for t in pending if t.results is None]
+    led_p.resolve_windows()
+    for evs, tss in windows:
+        led_s.create_transfers_window(
+            [transfers_to_arrays(b) for b in evs], tss)
+    led_p.drain_mirror()
+    led_s.drain_mirror()
+    cols_p = led_p.take_flush_columns()
+    cols_s = led_s.take_flush_columns()
+    assert len(cols_p) == len(cols_s)
+    for cp, cs in zip(cols_p, cols_s):
+        assert cp[3] == cs[3]  # n_new per chunk
+        if cp[3]:
+            for key in ("id_hi", "id_lo", "ts", "flags"):
+                np.testing.assert_array_equal(
+                    np.asarray(cp[0][key]), np.asarray(cs[0][key]))
+    _state_eq(led_p.mirror, led_s.mirror)
+
+
+def test_reads_resolve_pipeline():
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+
+    rng = np.random.default_rng(9)
+    windows = _windows(rng, 2, base=3 * 10**6)
+    led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13,
+                       write_through=StateMachineOracle())
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+    tk = led.submit_window(
+        [transfers_to_arrays(b) for b in windows[0][0]], windows[0][1])
+    assert tk is not None
+    some_id = windows[0][0][0][0].id
+    # A mirror read (drain boundary) must resolve the pipeline first.
+    state = led.mirror
+    led.drain_mirror()
+    assert tk.results is not None, "drain must resolve in-flight windows"
+    assert state.transfers[some_id].id == some_id
+
+
+def test_statemachine_pipelined_replies_match_sync():
+    sm_p = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 13)
+    sm_s = StateMachine(engine="device", a_cap=1 << 10, t_cap=1 << 13)
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 65)]
+    for sm in (sm_p, sm_s):
+        sm.create_accounts(accts, 120)
+    rng = np.random.default_rng(17)
+    nid = 5 * 10**6
+    ts = 10**12
+    op = Operation.create_transfers
+    all_replies_p, all_replies_s = [], []
+    recs = []
+    for w in range(3):
+        bodies, tss = [], []
+        for b in range(2):
+            evs = []
+            for i in range(128):
+                dr = int(rng.integers(1, 65))
+                evs.append(Transfer(
+                    id=nid, debit_account_id=dr,
+                    credit_account_id=dr % 64 + 1,
+                    amount=int(rng.integers(1, 100)), ledger=1, code=1))
+                nid += 1
+            ts += 200
+            bodies.append(multi_batch.encode(
+                [b"".join(e.pack() for e in evs)], 128))
+            tss.append(ts)
+        rec = sm_p.submit_commit_window(op, bodies, tss)
+        assert rec is not None
+        recs.append(rec)
+        all_replies_s.extend(sm_s.commit_window(op, bodies, tss))
+    sm_p.resolve_commit_windows()
+    for rec in recs:
+        all_replies_p.extend(rec["replies"])
+    assert all_replies_p == all_replies_s
+    assert sm_p.state.transfers == sm_s.state.transfers

@@ -641,7 +641,8 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
 
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
-                          per_event=None, limit_rounds=1, seg=None):
+                          per_event=None, limit_rounds=1, seg=None,
+                          ring_reset=False):
     """One batch against the device ledger. Returns (new_state, out) where
     out = {r_status, r_ts, fallback, limit_only, created_count}. When
     out['fallback'] is set, new_state is the input state unchanged (every
@@ -996,8 +997,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     e7 = ((xfr["count"] + n_created) > jnp.int32(T_dump))
     # Event-ring capacity (expiry rows pushed from the host can make the
     # events count exceed the transfers count, so it needs its own guard).
-    e8 = ((state["events"]["count"] + n_created) > jnp.int32(
-        ev_cap(state["events"])))
+    # ring_reset (static): pipelined serving windows consume the event
+    # ring from offset 0 each dispatch — the window's delta gather is
+    # enqueued BEFORE the next window's kernel, so on the device's FIFO
+    # stream the rows are read before they can be overwritten. Keeps the
+    # ring a bounded per-window transport without a host-side recycle
+    # barrier between pipelined windows.
+    ring_base = jnp.int32(0) if ring_reset else state["events"]["count"]
+    e8 = ((ring_base + n_created) > jnp.int32(ev_cap(state["events"])))
 
     transient = jnp.zeros_like(valid)
     for code in _TRANSIENT_CODES:
@@ -1174,7 +1181,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         snap[f"dr_{field}"] = (hi_all[fi, :N], lo_all[fi, :N])
         snap[f"cr_{field}"] = (hi_all[fi, N:], lo_all[fi, N:])
 
-    erow = jnp.where(ap, evr["count"] + row_off, E_dump)
+    erow = jnp.where(ap, ring_base + row_off, E_dump)
     stores_ev = dict(
         ts=ts_event,
         amt_hi=amt_res_hi, amt_lo=amt_res_lo,
@@ -1211,7 +1218,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         "u32": evr["u32"].at[erow].set(jnp.where(
             ap[:, None], jnp.stack([stores_ev[n] for n in EV_U32], axis=1),
             jnp.uint32(0))),
-        "count": evr["count"] + jnp.where(ok, n_created, 0),
+        "count": jnp.where(ok, ring_base + n_created, evr["count"]),
     }
 
     # Scalars.
@@ -1301,6 +1308,27 @@ def _create_transfers_super_deep(state, ev, seg, force_fallback=None):
         state, ev, jnp.uint64(0), jnp.int32(0),
         force_fallback=force_fallback, seg=seg,
         limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP)
+
+
+def _create_transfers_super_ring(state, ev, seg, force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg, ring_reset=True)
+
+
+def _create_transfers_super_deep_ring(state, ev, seg, force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg,
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP, ring_reset=True)
+
+
+# Pipelined-serving variants: the event ring resets per window (see
+# ring_reset in create_transfers_fast).
+create_transfers_super_ring_jit = jax.jit(
+    _create_transfers_super_ring, donate_argnums=0)
+create_transfers_super_deep_ring_jit = jax.jit(
+    _create_transfers_super_deep_ring, donate_argnums=0)
 
 
 # Deep-fixpoint superbatch: commit windows whose prepares carry

@@ -233,9 +233,10 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
     )
 
 
-def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
-    """Fixed-size slices of the appended transfer/event rows + derived
-    gathers — the device side of the write-through delta."""
+def _delta_gather_body(state, t_start, e_start, size_t, size_e):
+    """Shared device-side delta gather: fixed-size slices of the
+    appended transfer/event rows + derived gathers. Start indices may be
+    host ints (sync fetch) or device scalars (pipelined windows)."""
     import jax.numpy as jnp
     from jax import lax
 
@@ -257,6 +258,10 @@ def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
         cr_id_hi=au[cr_row, hi_c], cr_id_lo=au[cr_row, lo_c],
         p_ts=xf_col(xfr, "ts")[p_rows],
     )
+
+
+def _xfer_delta_gather(state, t_start, e_start, size_t, size_e):
+    return _delta_gather_body(state, t_start, e_start, size_t, size_e)
 
 
 _DER_KEYS = ("dr_id_hi", "dr_id_lo", "cr_id_hi", "cr_id_lo", "p_ts")
@@ -355,6 +360,38 @@ class _LazyCols:
         return len(self.load())
 
 
+def _xfer_delta_gather_window(state, created, size_t, size_e):
+    """Window-pipeline variant of the delta gather: slice starts are
+    computed ON DEVICE from the post-window counts (count - created), so
+    a pipelined caller can issue this gather without ever syncing on the
+    window's results. The start formula mirrors _delta_fetch_start's
+    host clamps exactly; the resolver recomputes the same offsets from
+    host counters at resolve time."""
+    import jax.numpy as jnp
+
+    xfr = state["transfers"]
+    evr = state["events"]
+    t_len = xfr["u64"].shape[0]
+    e_len = ev_cap(evr) + 1
+    t_start = jnp.clip(xfr["count"] - created, 0, t_len - size_t)
+    e_start = jnp.clip(evr["count"] - created, 0, e_len - size_e)
+    return _delta_gather_body(state, t_start, e_start, size_t, size_e)
+
+
+_xfer_delta_gather_window_jit_cache = None
+
+
+def _xfer_delta_gather_window_jit(state, created, size_t, size_e):
+    global _xfer_delta_gather_window_jit_cache
+    if _xfer_delta_gather_window_jit_cache is None:
+        import jax
+
+        _xfer_delta_gather_window_jit_cache = jax.jit(
+            _xfer_delta_gather_window, static_argnums=(2, 3))
+    return _xfer_delta_gather_window_jit_cache(state, created,
+                                               size_t, size_e)
+
+
 def _acct_delta_gather(state, a_start, size):
     from jax import lax
 
@@ -439,6 +476,31 @@ def stack_superbatch(evs: list[dict], timestamps: list[int],
     return ev_super, seg
 
 
+class WindowTicket:
+    """One pipelined commit window in flight: the kernel + delta gather
+    are dispatched, nothing is synced. Resolution (in submission order)
+    recovers exactly the synchronous path's results, capture chunks, and
+    counters — or, on a fallback anywhere in the pipeline, replays the
+    poisoned suffix synchronously (chained force_fallback guarantees
+    poisoned windows left the device state untouched)."""
+
+    __slots__ = ("evs", "tss", "ns", "n_pad", "out", "gather_dev",
+                 "size", "deep", "all_or_nothing", "results")
+
+    def __init__(self, evs, tss, ns, n_pad, out, gather_dev, size, deep,
+                 all_or_nothing):
+        self.evs = evs
+        self.tss = tss
+        self.ns = ns
+        self.n_pad = n_pad
+        self.out = out
+        self.gather_dev = gather_dev
+        self.size = size
+        self.deep = deep
+        self.all_or_nothing = all_or_nothing
+        self.results = None  # set at resolve
+
+
 def _window_has_pend_refs(ev_s: dict) -> bool:
     """Host-side pre-route: does any pid in the stacked window match any
     id in it? (numpy key-merge; u128 keys as (hi, lo) rows). True routes
@@ -507,6 +569,9 @@ class DeviceLedger:
         # Unloaded lazy fetch columns (device buffers still alive); capped
         # so a long drain-free run cannot accumulate unbounded HBM.
         self._pending_cols: list = []
+        # Pipelined commit windows in flight (submit_window), resolved in
+        # order by resolve_windows().
+        self._tickets: list = []
         # Device transfer-row count INCLUDING queued chunks (len(_xfer_row)
         # lags it until the next drain).
         self._xfer_rows_dev = 0
@@ -543,6 +608,7 @@ class DeviceLedger:
         from .batch import accounts_to_arrays
         from .fast_kernels import create_accounts_fast_jit
 
+        self.resolve_windows()  # pipeline ordering
         if self._mirror_route():
             self.fallbacks += 1
             self.drain_mirror()
@@ -593,6 +659,182 @@ class DeviceLedger:
                          count=len(out))
         return st, ts
 
+    def submit_window(self, evs: list[dict], timestamps: list[int]):
+        """Pipelined commit window: dispatch the superbatch kernel AND
+        its delta gather with ZERO host synchronization, chaining the
+        previous in-flight window's fallback scalar as force_fallback —
+        a fallback anywhere poisons every later in-flight window on
+        device, so commit order survives without waiting (the scan
+        driver's poisoning pattern, generalized to serving windows; the
+        reference's analog is the 8-deep prepare pipeline,
+        src/config.zig:155). Returns a WindowTicket, or None when the
+        window is not eligible (caller resolves + takes the sync path).
+        Results, write-through capture, and counters materialize at
+        resolve_windows(). Pipelined windows are the SERVING path only:
+        all-or-nothing replica windows stay on the synchronous
+        create_transfers_window (their per-prepare flush attribution
+        cannot survive a mid-pipeline redo)."""
+        import jax
+
+        from .fast_kernels import (create_transfers_super_deep_jit,
+                                   create_transfers_super_deep_ring_jit,
+                                   create_transfers_super_jit,
+                                   create_transfers_super_ring_jit)
+
+        ns = [len(e["id_lo"]) for e in evs]
+        if not (len(evs) > 1 and not self._mirror_route()):
+            return None
+        if self._wt:
+            # Capacity pre-check BEFORE any device mutation: the window's
+            # created rows must fit one delta-gather bucket (the sync
+            # path splits into groups instead; a pipelined caller just
+            # takes that path).
+            t_len = int(self.state["transfers"]["u64"].shape[0])
+            e_len = ev_cap(self.state["events"]) + 1
+            if sum(ns) > min(32 * N_PAD, t_len, e_len):
+                return None
+        n_pad = _pad_bucket(max(ns))
+        ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
+        deep = self._fixpoint_first or _window_has_pend_refs(ev_s)
+        ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
+        seg = {k: jax.device_put(v) for k, v in seg.items()}
+        prev_fb = self._tickets[-1].out["fallback"] if self._tickets \
+            else None
+        # Serving mode: the ring-reset kernel variants consume the event
+        # ring from offset 0 per window, so the pipeline never needs a
+        # host recycle barrier.
+        ring = self._wt and self.recycle_events
+        if deep:
+            jitfn = (create_transfers_super_deep_ring_jit if ring
+                     else create_transfers_super_deep_jit)
+        else:
+            jitfn = (create_transfers_super_ring_jit if ring
+                     else create_transfers_super_jit)
+        new_state, out = jitfn(self.state, ev_s, seg, prev_fb)
+        self.state = new_state
+        gather = None
+        size_te = (0, 0)
+        if self._wt:
+            # Delta gather with DEVICE-computed slice starts: ordered
+            # after the kernel on device, resolved at drain/flush.
+            total_cap = sum(ns)
+            for size in (N_PAD, 8 * N_PAD, 32 * N_PAD):
+                if total_cap <= size:
+                    break
+            size_te = (min(size, t_len), min(size, e_len))
+            gather = _xfer_delta_gather_window_jit(
+                self.state, out["created_count"], *size_te)
+        ticket = WindowTicket(evs, timestamps, ns, n_pad, out, gather,
+                              size_te, deep, False)
+        self._tickets.append(ticket)
+        return ticket
+
+    def resolve_windows(self, count: int | None = None) -> None:
+        """Resolve in-flight pipelined windows in submission order —
+        all of them, or just the oldest `count` (the pipelined driver
+        resolves one window per submission to keep the overlap).
+        Success recovers exactly the synchronous path's results and
+        write-through chunks; the first fallback switches to redo mode —
+        that window and EVERY later in-flight one (poisoned on device by
+        the chained force_fallback, state untouched) replay through the
+        synchronous window path in order, which escalates tiers or goes
+        per-batch exactly as if the pipeline had never formed. Redo
+        therefore always consumes the whole pipeline, even past `count`."""
+        if not self._tickets:
+            return
+        import jax
+
+        if count is None:
+            tickets, self._tickets = self._tickets, []
+        else:
+            tickets = self._tickets[:count]
+            del self._tickets[:count]
+        redo = False
+        i = 0
+        while i < len(tickets):
+            tk = tickets[i]
+            i += 1
+            if not redo and bool(jax.device_get(tk.out["fallback"])):
+                redo = True
+                # Everything still in flight is poisoned: pull it into
+                # this redo sequence so order is preserved (the sync
+                # path's own resolve guard must find nothing).
+                tickets.extend(self._tickets)
+                self._tickets = []
+            if redo:
+                tk.results = ("redo", self.create_transfers_window(
+                    tk.evs, tk.tss))
+                continue
+            n_pad = tk.n_pad
+            st_all = np.asarray(tk.out["r_status"])
+            ts_all = np.asarray(tk.out["r_ts"])
+            results = []
+            st_slices = []
+            for b, n_b in enumerate(tk.ns):
+                st = st_all[b * n_pad:b * n_pad + n_b]
+                results.append((st, ts_all[b * n_pad:b * n_pad + n_b]))
+                st_slices.append(st)
+            if self._wt:
+                self._register_window_capture(tk, st_slices)
+            if tk.deep:
+                self.deep_fixpoint_batches += len(tk.evs)
+            self.fast_batches += len(tk.evs)
+            self._probe_succeeded()
+            tk.results = ("ok", results)
+        self._maybe_recycle_ring()
+
+    def _register_window_capture(self, tk, st_slices) -> None:
+        """Resolve-time write-through capture for one pipelined window:
+        identical chunk semantics to _capture_window_delta, but the
+        delta gather was already issued at submit (device-start variant)
+        — offsets are recomputed here from the host counters, matching
+        the device's start formula exactly."""
+        per = [self._batch_delta_stats(ev, st)
+               for ev, st in zip(tk.evs, st_slices)]
+        total = sum(n for n, _ in per)
+        handle = None
+        ring = self._wt and self.recycle_events
+        if ring:
+            # Ring-reset windows consumed the ring from offset 0.
+            self._events_pushed = 0
+        if total:
+            t0 = self._xfer_rows_dev
+            e0 = self._events_pushed
+            size_t, size_e = tk.size
+            t_len = int(self.state["transfers"]["u64"].shape[0])
+            e_len = ev_cap(self.state["events"]) + 1
+            t_start = max(0, min(t0, t_len - size_t))
+            e_start = max(0, min(e0, e_len - size_e))
+            handle = _DeltaFetchHandle(tk.gather_dev, t0,
+                                       t0 - t_start, e0 - e_start)
+        off = 0
+        for n_new, orphan_ids in per:
+            if n_new:
+                tc = _LazyCols(handle, "t", off, n_new)
+                ec = _LazyCols(handle, "e", off, n_new)
+                derc = _LazyCols(handle, "der", off, n_new)
+                self._track_pending_cols(tc, ec, derc)
+                self._mirror_chunks.append(
+                    (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
+                if self.retain_flush_columns:
+                    self._flush_columns.append(
+                        (tc, ec, derc, n_new, self._events_seen_abs,
+                         orphan_ids))
+                self._xfer_rows_dev += n_new
+                self._events_pushed += n_new
+                self._events_seen_abs += n_new
+                off += n_new
+            else:
+                if orphan_ids:
+                    self._mirror_chunks.append(
+                        (None, None, None, 0, 0, orphan_ids))
+                if self.retain_flush_columns and (
+                        orphan_ids or tk.all_or_nothing):
+                    self._flush_columns.append(
+                        (None, None, None, 0, self._events_seen_abs,
+                         orphan_ids))
+        self._clear_dirty_dev()
+
     def create_transfers_window(self, evs: list[dict],
                                 timestamps: list[int],
                                 all_or_nothing: bool = False):
@@ -620,6 +862,7 @@ class DeviceLedger:
         from .fast_kernels import (create_transfers_super_deep_jit,
                                    create_transfers_super_jit)
 
+        self.resolve_windows()  # pipeline ordering
         assert len(evs) == len(timestamps) and evs
         ns = [len(e["id_lo"]) for e in evs]
         eligible = len(evs) > 1 and not self._mirror_route()
@@ -715,6 +958,7 @@ class DeviceLedger:
     def create_transfers_arrays(self, ev: dict, timestamp: int,
                                 transfers=None, raw=False):
         """ev: unpadded SoA dict (the zero-host-cost entry point)."""
+        self.resolve_windows()  # pipeline ordering
         import jax
 
         from .fast_kernels import (
@@ -867,6 +1111,7 @@ class DeviceLedger:
         """Reconstruct an oracle-compatible host state from device arrays.
         Also records id -> device row maps so the mirror regime can push
         incremental deltas back without a full rebuild."""
+        self.resolve_windows()  # pipeline ordering
         from ..oracle.state_machine import StateMachineOracle
 
         if self._wt:
@@ -994,6 +1239,7 @@ class DeviceLedger:
 
     def from_host(self, sm) -> None:
         """Rebuild the device state from a host oracle state."""
+        self.resolve_windows()  # pipeline ordering
         import jax.numpy as jnp
 
         from .hash_table import ht_insert
@@ -1213,6 +1459,10 @@ class DeviceLedger:
         doctrine; the forest's events tree holds the history)."""
         if not (self._wt and self.recycle_events):
             return
+        if self._tickets:
+            # Outstanding pipelined windows still append at the current
+            # ring offsets; recycling happens when the pipeline drains.
+            return
         if self._events_pushed == 0:
             return
         import jax.numpy as jnp
@@ -1398,6 +1648,7 @@ class DeviceLedger:
         Called before ANY mirror read (queries, lookups via the state
         machine, durability flush, hard-batch fallback, to_host); no-op
         when nothing is queued, so it is safe to call liberally."""
+        self.resolve_windows()  # pipeline ordering
         if not self._mirror_chunks:
             return
         chunks, self._mirror_chunks = self._mirror_chunks, []
@@ -1828,6 +2079,7 @@ class DeviceLedger:
     def expire_pending_transfers(self, timestamp: int) -> int:
         """Expiry runs on the exact host path (rare, pulse-driven),
         through the mirror regime like any other hard batch."""
+        self.resolve_windows()  # pipeline ordering
         self.drain_mirror()
         sm = self.mirror if self.mirror is not None else self._enter_mirror()
         n = sm.expire_pending_transfers(timestamp)

@@ -154,6 +154,8 @@ class StateMachine:
         self._xfer_cache = None
         # Per-operation commit timing table (op name -> count/total/max).
         self.metrics: dict[str, dict] = {}
+        # Pipelined commit windows awaiting resolution (submit_commit_window).
+        self._pending_windows: list = []
 
     # -------------------------------------------------------- LSM serving
 
@@ -646,36 +648,15 @@ class StateMachine:
             return [self.commit(op, b, ts)
                     for b, ts in zip(bodies, timestamps)]
 
-        from .ops.batch import transfers_soa_from_bytes
-
         spec = OPERATION_SPECS[op]
         t0 = _time.perf_counter_ns()
-        # Flatten: each body may hold several inner batches, each
-        # consuming one timestamp per event ending at the prepare
-        # timestamp (reference: execute_multi_batch,
-        # src/state_machine.zig:2720-2756).
-        evs, tss, shape = [], [], []
-        for body, ts in zip(bodies, timestamps):
-            batches = multi_batch.decode(body, spec.event_size)
-            counts = [len(b) // spec.event_size for b in batches]
-            running = ts - sum(counts)
-            for b, n in zip(batches, counts):
-                running += n
-                evs.append(transfers_soa_from_bytes(b))
-                tss.append(running)
-            shape.append(len(batches))
+        evs, tss, shape = self._flatten_window(op, bodies, timestamps)
         outs = self.led.create_transfers_window(
             evs, tss, all_or_nothing=all_or_nothing)
         if outs is None:
             assert all_or_nothing
             return None
-        replies = []
-        i = 0
-        for body, ts, k in zip(bodies, timestamps, shape):
-            parts = [_encode_results_soa(st, t, spec)
-                     for st, t in outs[i:i + k]]
-            i += k
-            replies.append(multi_batch.encode(parts, spec.result_size))
+        replies = self._encode_window_replies(spec, outs, shape)
         m = self.metrics.setdefault(
             op.name, {"count": 0, "total_ns": 0, "max_ns": 0})
         dt = _time.perf_counter_ns() - t0
@@ -686,6 +667,84 @@ class StateMachine:
         if all_or_nothing:
             return replies, shape
         return replies
+
+    def _flatten_window(self, op: Operation, bodies: list[bytes],
+                        timestamps: list[int]):
+        """Decode a window's bodies into flat (evs, tss, shape): each
+        body may hold several inner batches, each consuming one
+        timestamp per event ending at the prepare timestamp (reference:
+        execute_multi_batch, src/state_machine.zig:2720-2756). Shared by
+        the sync and pipelined window paths so their timestamp
+        attribution can never diverge."""
+        from .ops.batch import transfers_soa_from_bytes
+
+        spec = OPERATION_SPECS[op]
+        evs, tss, shape = [], [], []
+        for body, ts in zip(bodies, timestamps):
+            batches = multi_batch.decode(body, spec.event_size)
+            counts = [len(b) // spec.event_size for b in batches]
+            running = ts - sum(counts)
+            for b, n in zip(batches, counts):
+                running += n
+                evs.append(transfers_soa_from_bytes(b))
+                tss.append(running)
+            shape.append(len(batches))
+        return evs, tss, shape
+
+    @staticmethod
+    def _encode_window_replies(spec, outs, shape) -> list[bytes]:
+        replies = []
+        i = 0
+        for k in shape:
+            parts = [_encode_results_soa(st, t, spec)
+                     for st, t in outs[i:i + k]]
+            i += k
+            replies.append(multi_batch.encode(parts, spec.result_size))
+        return replies
+
+    def submit_commit_window(self, op: Operation, bodies: list[bytes],
+                             timestamps: list[int]):
+        """Pipelined serving: decode + submit one commit window with no
+        device synchronization (DeviceLedger.submit_window — the
+        reference's 8-deep prepare pipeline analog, src/config.zig:155).
+        Returns an opaque pending record, or None when the window cannot
+        pipeline (caller takes the synchronous commit_window path).
+        Replies materialize at resolve_commit_windows()."""
+        O = Operation
+        can_window = (
+            self.engine == "device" and len(bodies) > 1
+            and _base_operation(op) == O.create_transfers
+            and op.is_multi_batch()
+            and all(self.input_valid(op, b) for b in bodies))
+        if not can_window:
+            return None
+        evs, tss, shape = self._flatten_window(op, bodies, timestamps)
+        ticket = self.led.submit_window(evs, tss)
+        if ticket is None:
+            return None
+        rec = {"op": op, "ticket": ticket, "shape": shape,
+               "n_bodies": len(bodies)}
+        self._pending_windows.append(rec)
+        return rec
+
+    def resolve_commit_windows(self, count: int | None = None) -> list:
+        """Resolve pending pipelined windows in order — all, or at least
+        the oldest `count` (a mid-pipeline fallback resolves everything;
+        see DeviceLedger.resolve_windows) — and attach wire replies to
+        each completed record under rec['replies']. Returns the
+        completed records in order."""
+        if not self._pending_windows:
+            return []
+        self.led.resolve_windows(count)
+        done = []
+        while (self._pending_windows
+               and self._pending_windows[0]["ticket"].results is not None):
+            rec = self._pending_windows.pop(0)
+            _, outs = rec["ticket"].results
+            rec["replies"] = self._encode_window_replies(
+                OPERATION_SPECS[rec["op"]], outs, rec["shape"])
+            done.append(rec)
+        return done
 
     def _commit_timed(self, op: Operation, body: bytes,
                       timestamp: int) -> bytes:
