@@ -275,19 +275,25 @@ class _DeltaFetchHandle:
 
     __slots__ = ("_dev", "_host", "t0", "_t_off", "_e_off")
 
-    def __init__(self, dev_out, t0, t_off, e_off):
+    def __init__(self, dev_out, t0, t_off, e_off, eager_copy=True):
         self._dev = dev_out
         self._host = None
         self.t0 = t0
         self._t_off = t_off
         self._e_off = e_off
-        try:
-            import jax
+        # eager_copy=False (pipelined serving): do NOT start the host
+        # copy now — through the tunnel the transfer contends with the
+        # next in-flight window's kernel for the same link (measured:
+        # ~2-3x window latency). The bytes move at drain/flush instead,
+        # wholly off the commit boundary.
+        if eager_copy:
+            try:
+                import jax
 
-            for leaf in jax.tree_util.tree_leaves(dev_out):
-                leaf.copy_to_host_async()
-        except Exception:
-            pass  # backend without async copy: resolve() pays the wait
+                for leaf in jax.tree_util.tree_leaves(dev_out):
+                    leaf.copy_to_host_async()
+            except Exception:
+                pass  # backend without async copy: resolve() pays the wait
 
     def _resolve(self):
         host = self._host
@@ -806,7 +812,8 @@ class DeviceLedger:
             t_start = max(0, min(t0, t_len - size_t))
             e_start = max(0, min(e0, e_len - size_e))
             handle = _DeltaFetchHandle(tk.gather_dev, t0,
-                                       t0 - t_start, e0 - e_start)
+                                       t0 - t_start, e0 - e_start,
+                                       eager_copy=False)
         off = 0
         for n_new, orphan_ids in per:
             if n_new:
