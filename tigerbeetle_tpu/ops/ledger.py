@@ -295,6 +295,20 @@ class _DeltaFetchHandle:
             except Exception:
                 pass  # backend without async copy: resolve() pays the wait
 
+    def start_copy(self) -> None:
+        """Begin the device->host transfer without blocking (idempotent;
+        no-op once resolved). The drain calls this for EVERY queued
+        handle up front so the tunnel streams transfers while the host
+        registers earlier chunks."""
+        if self._host is None and self._dev is not None:
+            try:
+                import jax
+
+                for leaf in jax.tree_util.tree_leaves(self._dev):
+                    leaf.copy_to_host_async()
+            except Exception:
+                pass
+
     def _resolve(self):
         host = self._host
         if host is None:
@@ -1659,6 +1673,13 @@ class DeviceLedger:
         if not self._mirror_chunks:
             return
         chunks, self._mirror_chunks = self._mirror_chunks, []
+        # Stream ALL pending device->host transfers up front: each
+        # chunk's registration then overlaps the next chunk's bytes in
+        # flight instead of ping-ponging transfer/compute per chunk.
+        for t, _e, _d, _t0, n_new, _o in chunks:
+            if n_new and isinstance(t, _LazyCols) and not t.loaded \
+                    and t._handle is not None:
+                t._handle.start_copy()
         for t, e, der, t0, n_new, orphan_ids in chunks:
             for oid in orphan_ids:
                 self.mirror.orphaned.add(oid)
