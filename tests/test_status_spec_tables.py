@@ -1,0 +1,144 @@
+"""Spec tables for status codes no other test exercised: the remaining
+exists_with_* comparisons, the imported debit-account timestamp rule,
+and the four per-field u128 overflow variants. Expected codes are
+written out explicitly (the state_machine_tests.zig table style,
+src/state_machine_tests.zig:1) and asserted on BOTH the sequential
+oracle and the device serving engine.
+
+Reference: create_transfer_exists (src/state_machine.zig:3988-4050),
+imported timestamp rules (:3795-3812), overflow checks (:3856-3884)."""
+
+import pytest
+
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import (Account, AccountFlags, Transfer,
+                                   TransferFlags)
+
+PEND = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+IMPORTED = int(TransferFlags.imported)
+IMPORTED_A = int(AccountFlags.imported)
+U128MAX = (1 << 128) - 1
+HUGE = 1 << 127
+
+
+@pytest.fixture(params=["oracle", "device"])
+def sm(request):
+    m = StateMachine(engine=request.param, a_cap=1 << 10, t_cap=1 << 12)
+    m.create_accounts([Account(id=i, ledger=1, code=1)
+                       for i in range(1, 9)], 100)
+    return m
+
+
+def _one(sm, t, ts):
+    return sm.create_transfers([t], ts)[0].status.name
+
+
+class TestExistsComparisons:
+    def test_exists_with_different_credit_account_id(self, sm):
+        ts = 10**12
+        base = dict(debit_account_id=1, credit_account_id=2, amount=5,
+                    ledger=1, code=1)
+        assert _one(sm, Transfer(id=50, **base), ts) == "created"
+        dup = dict(base, credit_account_id=3)
+        assert _one(sm, Transfer(id=50, **dup), ts + 100) == \
+            "exists_with_different_credit_account_id"
+
+    def test_exists_with_different_timeout(self, sm):
+        ts = 10**12
+        base = dict(debit_account_id=1, credit_account_id=2, amount=5,
+                    ledger=1, code=1, flags=PEND, timeout=10)
+        assert _one(sm, Transfer(id=51, **base), ts) == "created"
+        dup = dict(base, timeout=20)
+        assert _one(sm, Transfer(id=51, **dup), ts + 100) == \
+            "exists_with_different_timeout"
+
+    def test_exists_with_different_pending_id(self, sm):
+        ts = 10**12
+        for i, tid in enumerate((52, 53)):
+            assert _one(sm, Transfer(
+                id=tid, debit_account_id=1, credit_account_id=2,
+                amount=5, ledger=1, code=1, flags=PEND),
+                ts + i * 100) == "created"
+        post = dict(amount=U128MAX, ledger=1, code=1, flags=POST)
+        assert _one(sm, Transfer(id=54, pending_id=52, **post),
+                    ts + 300) == "created"
+        assert _one(sm, Transfer(id=54, pending_id=53, **post),
+                    ts + 400) == "exists_with_different_pending_id"
+
+
+class TestImportedTimestampRules:
+    def test_imported_transfer_must_postdate_debit_account(self, sm):
+        ts = 10**12
+        r = sm.create_accounts([
+            Account(id=21, ledger=1, code=1, flags=IMPORTED_A,
+                    timestamp=4000),
+            Account(id=20, ledger=1, code=1, flags=IMPORTED_A,
+                    timestamp=5000),
+        ], ts)
+        assert [x.status.name for x in r] == ["created", "created"]
+        # Imported transfer at ts 4500: postdates credit (4000) but NOT
+        # debit (5000) -> the debit-account variant, checked first.
+        got = _one(sm, Transfer(
+            id=60, debit_account_id=20, credit_account_id=21, amount=1,
+            ledger=1, code=1, flags=IMPORTED, timestamp=4500), ts + 100)
+        assert got == "imported_event_timestamp_must_postdate_debit_account"
+        # And at 3500 it predates BOTH: debit account still reported
+        # first (precedence, reference :3795-3812).
+        got = _one(sm, Transfer(
+            id=61, debit_account_id=20, credit_account_id=21, amount=1,
+            ledger=1, code=1, flags=IMPORTED, timestamp=3500), ts + 200)
+        assert got == "imported_event_timestamp_must_postdate_debit_account"
+
+
+class TestOverflowVariants:
+    def test_overflows_debits_pending(self, sm):
+        ts = 10**12
+        assert _one(sm, Transfer(
+            id=70, debit_account_id=1, credit_account_id=2, amount=HUGE,
+            ledger=1, code=1, flags=PEND), ts) == "created"
+        assert _one(sm, Transfer(
+            id=71, debit_account_id=1, credit_account_id=3, amount=HUGE,
+            ledger=1, code=1, flags=PEND), ts + 100) == \
+            "overflows_debits_pending"
+
+    def test_overflows_credits_pending(self, sm):
+        ts = 10**12
+        assert _one(sm, Transfer(
+            id=72, debit_account_id=1, credit_account_id=2, amount=HUGE,
+            ledger=1, code=1, flags=PEND), ts) == "created"
+        assert _one(sm, Transfer(
+            id=73, debit_account_id=3, credit_account_id=2, amount=HUGE,
+            ledger=1, code=1, flags=PEND), ts + 100) == \
+            "overflows_credits_pending"
+
+    def test_overflows_credits_posted(self, sm):
+        ts = 10**12
+        assert _one(sm, Transfer(
+            id=74, debit_account_id=1, credit_account_id=2, amount=HUGE,
+            ledger=1, code=1), ts) == "created"
+        assert _one(sm, Transfer(
+            id=75, debit_account_id=3, credit_account_id=2, amount=HUGE,
+            ledger=1, code=1), ts + 100) == "overflows_credits_posted"
+
+    def test_overflows_credits_total(self, sm):
+        """credits_pending + credits_posted + amount > u128 while
+        NEITHER single-field sum overflows — only then does the
+        combined-total variant fire (the posted-field check runs
+        unconditionally first, reference :3864-3884)."""
+        ts = 10**12
+        q = 1 << 126  # quarter of 2^128
+        # credits_posted = 2q, credits_pending = q on account 2.
+        assert _one(sm, Transfer(
+            id=76, debit_account_id=1, credit_account_id=2,
+            amount=2 * q, ledger=1, code=1), ts) == "created"
+        assert _one(sm, Transfer(
+            id=77, debit_account_id=4, credit_account_id=2,
+            amount=q, ledger=1, code=1, flags=PEND),
+            ts + 100) == "created"
+        # amount q+1: posted-sum 3q+1 fits, pending not checked
+        # (non-pending), but the total 4q+1 = 2^128 + 1 overflows.
+        assert _one(sm, Transfer(
+            id=78, debit_account_id=5, credit_account_id=2,
+            amount=q + 1, ledger=1, code=1),
+            ts + 200) == "overflows_credits"

@@ -147,16 +147,28 @@ class TestIdPermutation:
         assert len(seen) == 2003  # injective over the sample
 
 
-@pytest.mark.parametrize("seed", [11, 23])
-def test_vopr_workload_auditor(seed):
+@pytest.mark.parametrize("seed,engine", [
+    (11, "kernel"), (23, "kernel"),
+    # Device-engine soaks: batches mix pendings with SUCCESSFUL
+    # posts/voids of pendings created earlier in the SAME batch, so the
+    # kernel's in-window pending resolution (and its fixpoint
+    # escalation) runs under crash/partition chaos with every reply
+    # audited (VERIFY mode on: the sim's extra-check doctrine).
+    (301, "device"), (302, "device"), (303, "device"),
+])
+def test_vopr_workload_auditor(seed, engine):
     """Swarm run where every reply is audited against the outcome encoded
     in its transfer ids (reference: workload/auditor pair — replies are
     verifiable in O(1) memory, testing/id.zig IdPermutation)."""
     from tigerbeetle_tpu.testing.workload import Auditor, Workload
 
     rng = random.Random(seed)
+    factory = (StateMachine if engine == "kernel"
+               else (lambda: StateMachine(engine="device", a_cap=1 << 10,
+                                          t_cap=1 << 13)))
     cluster = Cluster(
         seed=seed, replica_count=3,
+        state_machine_factory=factory,
         network=NetworkOptions(
             loss_probability=rng.choice([0.0, 0.05]),
             duplicate_probability=0.02,

@@ -80,7 +80,11 @@ class Workload:
         self.account_ids = account_ids
         self.ledger = ledger
         self.sequence = 0
-        self._pending_open: list[tuple[int, int]] = []  # (id, amount)
+        # (id, amount, timeout) of open pendings; success-expectation
+        # resolutions only target UNTIMED ones — a timed pending can
+        # legitimately expire mid-soak (sim time advances ~600 s per
+        # chaos step), flipping the post to pending_transfer_expired.
+        self._pending_open: list[tuple[int, int, int]] = []
 
     def accounts(self) -> list[Account]:
         return [Account(id=i, ledger=self.ledger, code=1)
@@ -111,7 +115,7 @@ class Workload:
                     amount=amount, ledger=self.ledger, code=1,
                     flags=flags, timeout=timeout))
                 if flags:
-                    self._pending_open.append((tid, amount))
+                    self._pending_open.append((tid, amount, timeout))
             elif roll < 0.70:
                 out.append(Transfer(
                     id=self._next_id(Expect.debit_account_not_found),
@@ -135,12 +139,40 @@ class Workload:
                     debit_account_id=dr, credit_account_id=cr,
                     amount=amount, ledger=0, code=1))
             elif self._pending_open:
-                pid, p_amount = self._pending_open.pop(
-                    prng.randrange(len(self._pending_open)))
-                out.append(Transfer(
-                    id=self._next_id(Expect.exceeds_pending),
-                    pending_id=pid, amount=p_amount + 1,
-                    flags=int(TransferFlags.post_pending_transfer)))
+                sub = prng.random()
+                untimed = [i for i, (_, _, to) in
+                           enumerate(self._pending_open) if to == 0]
+                if sub < 0.4 or not untimed:
+                    # Post above the pending amount: must fail — and is
+                    # expiry-immune (the amount check precedes the
+                    # expiry check in both engines), so timed pendings
+                    # are safe targets here.
+                    pid, p_amount, _ = self._pending_open.pop(
+                        prng.randrange(len(self._pending_open)))
+                    out.append(Transfer(
+                        id=self._next_id(Expect.exceeds_pending),
+                        pending_id=pid, amount=p_amount + 1,
+                        flags=int(TransferFlags.post_pending_transfer)))
+                elif sub < 0.7:
+                    # Successful (possibly partial) post of an UNTIMED
+                    # pending — when it was created EARLIER IN THIS
+                    # SAME BATCH this exercises the kernel's in-window
+                    # pending resolution under the swarm.
+                    pid, p_amount, _ = self._pending_open.pop(
+                        untimed[prng.randrange(len(untimed))])
+                    out.append(Transfer(
+                        id=self._next_id(Expect.created),
+                        pending_id=pid,
+                        amount=prng.randrange(0, p_amount + 1),
+                        flags=int(TransferFlags.post_pending_transfer)))
+                else:
+                    # Successful void (amount 0 = full-amount sentinel).
+                    pid, _, _ = self._pending_open.pop(
+                        untimed[prng.randrange(len(untimed))])
+                    out.append(Transfer(
+                        id=self._next_id(Expect.created),
+                        pending_id=pid, amount=0,
+                        flags=int(TransferFlags.void_pending_transfer)))
             else:
                 out.append(Transfer(
                     id=self._next_id(Expect.created),
