@@ -323,6 +323,64 @@ def inner_main() -> None:
     print(json.dumps(out), flush=True)
 
 
+# ------------------------------------------------------- banked artifacts
+def newest_banked_artifact() -> dict | None:
+    """Summary of the newest committed on-chip bench artifact.
+
+    The round's number of record must not depend on the tunnel being
+    alive in the driver's minute (it wedged at end-of-round three rounds
+    running): every on-chip window writes onchip/BENCH_ONCHIP_<utc>.json
+    the moment it exists, and this picks the newest as the fallback
+    record (reference analog: devhub keeps the nightly series,
+    src/scripts/devhub.zig:174-237 — the dashboard survives one dead
+    run)."""
+    import glob
+    import re
+    from datetime import datetime, timezone
+
+    paths = sorted(glob.glob(os.path.join(REPO, "onchip",
+                                          "BENCH_ONCHIP_*.json")))
+    best = None
+    for p in reversed(paths):  # filenames sort by UTC stamp
+        try:
+            d = json.load(open(p))
+        except (OSError, json.JSONDecodeError):
+            continue
+        r = d.get("result") or {}
+        # Never accept a record that is itself a banked fallback (a
+        # re-banked artifact would launder the true capture age).
+        if d.get("quick") or r.get("value") is None \
+                or r.get("value_source"):
+            continue
+        best = (p, d, r)
+        break
+    if best is None:
+        return None
+    p, d, r = best
+    age_h = None
+    m = re.match(r"BENCH_ONCHIP_(\d{8}T\d{6})Z", os.path.basename(p))
+    if m:
+        ts = datetime.strptime(m.group(1), "%Y%m%dT%H%M%S").replace(
+            tzinfo=timezone.utc)
+        age_h = round((datetime.now(timezone.utc) - ts).total_seconds()
+                      / 3600, 2)
+    summary = {
+        "artifact_path": os.path.relpath(p, REPO),
+        "utc": d.get("utc"),
+        "age_hours": age_h,
+        "value": r.get("value"),
+        "unit": r.get("unit", "transfers/s"),
+        "platform": r.get("platform"),
+    }
+    for k in ("config1_2hot_tps", "config2_10k_tps", "config3_chains_tps",
+              "config4_twophase_limits_tps", "config5_oracle_parity",
+              "config6_serving_tps", "serving_batch_latency",
+              "vs_baseline", "vs_target_10m"):
+        if r.get(k) is not None:
+            summary[k] = r[k]
+    return summary
+
+
 # ---------------------------------------------------------------- driver
 def main() -> None:
     ports = listening_loopback_ports()
@@ -384,6 +442,28 @@ def main() -> None:
             "tpu_probe.stderr_tail for the faulthandler stack.")
     elif not bench.get("ok", False) and measured is None:
         out["error"] = bench.get("error", "bench did not complete")
+    # Wedge-proof number of record: if the DRIVER-STYLE invocation (no
+    # BENCH_PLATFORM forced — the probe decided) produced no on-chip
+    # number, the newest committed onchip/BENCH_ONCHIP_*.json becomes the
+    # value of record, clearly labeled with its age + path — three rounds
+    # of null driver numbers behind a dead tunnel is enough. Forced runs
+    # (tpu_watch captures, CI cpu proxies) never take the fallback: a
+    # watcher re-committing a banked value would launder the record's
+    # age, and a deliberate cpu run must stay a cpu record.
+    banked = None
+    if not on_tpu and forced is None:
+        banked = newest_banked_artifact()
+        if banked is not None:
+            out["banked_onchip"] = banked
+            out["value"] = banked["value"]
+            out["vs_baseline"] = banked.get("vs_baseline")
+            out["vs_target_10m"] = banked.get("vs_target_10m")
+            out["value_platform"] = banked.get("platform")
+            out["value_source"] = (
+                "banked_onchip_artifact: live TPU run unavailable in the "
+                "driver window; value is the newest committed solo "
+                f"on-chip full-bench ({banked['artifact_path']}, "
+                f"{banked.get('age_hours')}h old)")
     # Output contract (devhub analog: one parseable record per run): the
     # full diagnostic record goes on its own PRECEDING line; the FINAL
     # stdout line is a compact metric JSON that survives any tail window.
@@ -396,11 +476,32 @@ def main() -> None:
         "vs_target_10m": out.get("vs_target_10m"),
         "platform": platform,
     }
-    for k in ("config1_2hot_tps", "config2_10k_tps", "config3_chains_tps",
-              "config4_twophase_limits_tps", "config5_oracle_parity",
-              "config6_serving_tps", "serving_batch_latency"):
-        if bench.get(k) is not None:
-            compact[k] = bench[k]
+    config_keys = ("config1_2hot_tps", "config2_10k_tps",
+                   "config3_chains_tps", "config4_twophase_limits_tps",
+                   "config5_oracle_parity", "config6_serving_tps",
+                   "serving_batch_latency")
+    if banked is not None:
+        # Self-consistent record: value, per-config numbers AND the
+        # platform tag all come from the banked on-chip artifact (a
+        # value!=null with platform=="cpu" would violate the "CPU proxy
+        # never impersonates the TPU" invariant consumers rely on);
+        # whatever the live proxy run measured is nested under its own
+        # honest key.
+        compact["value_source"] = "banked_onchip_artifact"
+        compact["platform"] = banked.get("platform")
+        compact["live_platform"] = platform
+        compact["banked_onchip"] = banked
+        for k in config_keys:
+            if banked.get(k) is not None:
+                compact[k] = banked[k]
+        live = {k: bench[k] for k in config_keys
+                if bench.get(k) is not None}
+        if live:
+            compact["live_%s_configs" % platform] = live
+    else:
+        for k in config_keys:
+            if bench.get(k) is not None:
+                compact[k] = bench[k]
     if out.get("cpu_proxy_tps") is not None:
         compact["cpu_proxy_tps"] = out["cpu_proxy_tps"]
     if out.get("error"):

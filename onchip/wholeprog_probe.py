@@ -1,0 +1,161 @@
+"""Whole-program execution probe (round 4, VERDICT item 1).
+
+Question: does ONE compiled program that chains K iterations of a
+gather/scatter body on device amortize the tunnel's ~0.5-1 ms per-op
+cost (PERF.md), or does the tunnel op-stream *executed* ops so a
+K-iteration program costs K times one iteration?
+
+Four variants over the same ~10-heavy-op body at 8k rows:
+  A  one body, one dispatch                  -> per-op baseline
+  B  K back-to-back dispatches of A          -> current (tunnel) regime
+  C  one jit with K bodies UNROLLED          -> program op count ~ K*10
+  D  one jit with lax.scan over K iterations -> program op count ~ 10,
+                                                executed op count K*10
+plus a trailing 1-op dispatch after D (round-2 found executed
+while_loops degrade later dispatches; scan lowers to While HLO).
+
+If D(K=32) ~= A + epsilon: whole-program execution is real ->
+build the K-window scan kernel (4-16M model holds).
+If D(K=32) ~= K * A: the tunnel op-streams inside a single jit ->
+the 4-16M whole-program claim is FALSIFIED for this environment.
+"""
+import json
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+import jax
+
+# The real kernels are uint64 end-to-end (tigerbeetle_tpu enables x64 at
+# package import); without this the probe would silently benchmark a
+# 32-bit body — half the memory traffic of the regime under test.
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+
+N = 8192
+KS = (8, 32)
+
+
+def body(carry):
+    table, idx, vals = carry
+    perm = jnp.argsort(idx)                      # sort (heavy)
+    g1 = table[idx]                              # gather
+    g2 = table[perm]                             # gather
+    s = jax.lax.associative_scan(jnp.add, vals)  # log-step scan
+    t2 = table.at[idx].add(vals)                 # scatter-add
+    mix = (g1 ^ s) + g2
+    seg = jax.lax.associative_scan(jnp.maximum, mix)
+    new_idx = ((idx.astype(jnp.uint32) * jnp.uint32(2654435761))
+               % jnp.uint32(N)).astype(jnp.int32)
+    new_vals = (mix + seg) | jnp.uint64(1)
+    new_table = t2.at[new_idx].max(new_vals)     # scatter-max
+    return (new_table, new_idx, new_vals)
+
+
+@jax.jit
+def one(carry):
+    return body(carry)
+
+
+def unrolled(k):
+    @jax.jit
+    def f(carry):
+        for _ in range(k):
+            carry = body(carry)
+        return carry
+    return f
+
+
+def scanned(k):
+    @jax.jit
+    def f(carry):
+        def step(c, _):
+            return body(c), None
+        c, _ = jax.lax.scan(step, carry, None, length=k)
+        return c
+    return f
+
+
+@jax.jit
+def tiny(x):
+    return x * jnp.uint64(2) + jnp.uint64(1)
+
+
+def fresh():
+    rng = np.random.default_rng(7)
+    return (jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)),
+            jax.device_put(rng.integers(0, N, N, dtype=np.int32).astype(np.int32)),
+            jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)))
+
+
+def timed(fn, carry, reps=3):
+    out = fn(carry)
+    jax.block_until_ready(out)                    # compile + warm
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        out = fn(carry)
+        jax.block_until_ready(out)
+        ts.append((time.perf_counter() - t0) * 1e3)
+    return ts, out
+
+
+def main():
+    res = {"platform": jax.devices()[0].platform,
+           "device": str(jax.devices()[0]), "n_rows": N}
+    carry = fresh()
+
+    ts_a, _ = timed(one, carry)
+    res["A_one_body_ms"] = [round(t, 2) for t in ts_a]
+    a = min(ts_a)
+
+    for k in KS:
+        c = fresh()
+        t0 = time.perf_counter()
+        for _ in range(k):
+            c = one(c)
+        jax.block_until_ready(c)
+        res[f"B_seq_k{k}_ms"] = round((time.perf_counter() - t0) * 1e3, 2)
+
+    for k in KS:
+        ts, _ = timed(unrolled(k), fresh())
+        res[f"C_unroll_k{k}_ms"] = [round(t, 2) for t in ts]
+        res[f"C_unroll_k{k}_vs_kA"] = round(min(ts) / (k * a), 3)
+
+    for k in KS + (128,):
+        ts, _ = timed(scanned(k), fresh())
+        res[f"D_scan_k{k}_ms"] = [round(t, 2) for t in ts]
+        res[f"D_scan_k{k}_vs_kA"] = round(min(ts) / (k * a), 3)
+
+    # post-scan poison check (round-2: executed While degrades dispatches)
+    x = jax.device_put(np.arange(N, dtype=np.uint64))
+    jax.block_until_ready(tiny(x))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        jax.block_until_ready(tiny(x))
+        ts.append((time.perf_counter() - t0) * 1e3)
+    res["post_scan_tiny_dispatch_ms"] = [round(t, 3) for t in ts]
+
+    k = 32
+    scan_ok = min(res[f"D_scan_k{k}_ms"]) < 0.35 * k * a
+    unroll_ok = min(res[f"C_unroll_k{k}_ms"]) < 0.35 * k * a
+    if scan_ok:
+        res["verdict"] = ("WHOLE-PROGRAM AMORTIZES (scan form): build "
+                          "the K-window lax.scan kernel")
+    elif unroll_ok:
+        res["verdict"] = ("WHOLE-PROGRAM AMORTIZES (unrolled form ONLY; "
+                          "scan op-streams): build the K-window kernel "
+                          "UNROLLED, not as lax.scan")
+    else:
+        res["verdict"] = ("TUNNEL OP-STREAMS INSIDE A SINGLE JIT (both "
+                          "forms): whole-program claim falsified for "
+                          "this environment")
+    print(json.dumps(res, indent=1))
+    json.dump(res, open("/root/repo/onchip/wholeprog_probe_result.json",
+                        "w"), indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -102,6 +102,12 @@ def main() -> None:
         if probe():
             print(f"[{time.strftime('%H:%M:%S')}] window open", flush=True)
             result = capture(quick=not quick_done)
+            # A banked-fallback record must never be re-committed as a
+            # fresh capture (it would launder the true artifact age).
+            if result and result.get("value_source"):
+                print("bench fell back to a banked record; not banking",
+                      flush=True)
+                result = None
             if result and result.get("value") is not None:
                 path = commit_artifact(result, quick=not quick_done)
                 print(f"captured {path}: value={result.get('value')}",
