@@ -458,6 +458,11 @@ def main() -> None:
             out["value"] = banked["value"]
             out["vs_baseline"] = banked.get("vs_baseline")
             out["vs_target_10m"] = banked.get("vs_target_10m")
+            # The record's platform must track its value (the "CPU
+            # proxy never impersonates the TPU" invariant cuts both
+            # ways); what ran locally is preserved under live_platform.
+            out["platform"] = banked.get("platform")
+            out["live_platform"] = platform
             out["value_platform"] = banked.get("platform")
             out["value_source"] = (
                 "banked_onchip_artifact: live TPU run unavailable in the "

@@ -20,10 +20,9 @@ If D(K=32) ~= K * A: the tunnel op-streams inside a single jit ->
 the 4-16M whole-program claim is FALSIFIED for this environment.
 """
 import json
-import sys
+import os
 import time
 
-sys.path.insert(0, "/root/repo")
 import jax
 
 # The real kernels are uint64 end-to-end (tigerbeetle_tpu enables x64 at
@@ -153,8 +152,9 @@ def main():
                           "forms): whole-program claim falsified for "
                           "this environment")
     print(json.dumps(res, indent=1))
-    json.dump(res, open("/root/repo/onchip/wholeprog_probe_result.json",
-                        "w"), indent=2)
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "wholeprog_probe_result.json")
+    json.dump(res, open(out_path, "w"), indent=2)
 
 
 if __name__ == "__main__":
