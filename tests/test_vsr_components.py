@@ -228,7 +228,7 @@ class TestScrubRepairEndToEnd:
         # Let the scrubber tour (every 64 ticks) and the repair path run:
         # wait for two FULL tours after the corruption (the first detects,
         # a later one confirms the repaired block scans clean).
-        r0.scrubber.reads_per_tick = 32
+        r0.scrubber.cycle_ticks = 4
         cycles0 = r0.scrubber.cycles
         cluster.run(20000, until=lambda: (
             r0.scrubber.cycles >= cycles0 + 2
@@ -287,7 +287,7 @@ class TestScrubRepairEndToEnd:
         for i in reachable:
             cluster.storages[0].data[zones["grid"] + i * bs + 8] ^= 0xFF
 
-        r0.scrubber.reads_per_tick = 64
+        r0.scrubber.cycle_ticks = 4
         cycles0 = r0.scrubber.cycles
         ok = cluster.run(40000, until=lambda: (
             r0.scrubber.cycles >= cycles0 + 2
@@ -399,7 +399,7 @@ class TestGridScrubber:
 
     def test_clean_tour_finds_nothing(self):
         _, forest = self._forest()
-        scrubber = GridScrubber(forest, reads_per_tick=16)
+        scrubber = GridScrubber(forest, cycle_ticks=16)
         while scrubber.cycles == 0:
             assert scrubber.tick() == []
         assert scrubber.checked > 0 and not scrubber.faults
@@ -409,12 +409,48 @@ class TestGridScrubber:
         table = forest.trees["t"].levels[0][0]
         victim = table.block_addresses[0]
         grid.device.data[victim.index * grid.block_size + 4] ^= 0xFF
-        scrubber = GridScrubber(forest, reads_per_tick=16)
+        scrubber = GridScrubber(forest, cycle_ticks=16)
         found = []
         while scrubber.cycles == 0:
             found += scrubber.tick()
         assert any(addr == victim for _, addr, _ in found)
         assert victim.index in scrubber.faults
+
+    def test_full_tour_on_schedule_covers_every_block(self):
+        """Cycle pacing: one full tour completes within cycle_ticks ticks
+        and validates every reachable block exactly once (reference:
+        grid_scrubber.zig tour accounting :135-138)."""
+        _, forest = self._forest()
+        scrubber = GridScrubber(forest, cycle_ticks=10)
+        ticks = 0
+        while scrubber.cycles == 0:
+            scrubber.tick()
+            ticks += 1
+            assert ticks <= 10 + 1, "tour overran its cycle budget"
+        assert scrubber.tour_blocks_scrubbed == scrubber.tour_size
+        assert scrubber.checked == scrubber.tour_size
+
+    def test_pacing_spreads_reads_across_cycle(self):
+        """With cycle_ticks >= tour_size the budget is ~1 block/tick —
+        the scrubber must not burst the whole grid in one tick."""
+        _, forest = self._forest()
+        scrubber = GridScrubber(forest, cycle_ticks=10_000)
+        scrubber.tick()
+        assert 0 < scrubber.tour_blocks_scrubbed <= 2
+
+    def test_origin_rotation_decorrelates_replicas(self):
+        """Different origin seeds tour the same block set in different
+        rotations (grid_scrubber.zig:170-182: per-replica origins so the
+        same latent fault is scrubbed at different times)."""
+        _, forest = self._forest()
+        s0 = GridScrubber(forest, origin_seed=0)
+        s1 = GridScrubber(forest, origin_seed=7 * 2654435761)
+        t0 = list(s0._tour())
+        t1 = list(s1._tour())
+        assert sorted(a.index for _, a, _ in t0) == \
+            sorted(a.index for _, a, _ in t1)
+        assert [a.index for _, a, _ in t0] != \
+            [a.index for _, a, _ in t1]
 
 
 class TestPrimaryRestartAfterViewChange:

@@ -23,10 +23,6 @@ from .codegen import (
 _RUST_TY = {"u128": "u128", "u64": "u64", "u32": "u32", "u16": "u16"}
 
 
-def _camel(snake: str) -> str:
-    return "".join(p.capitalize() for p in snake.split("_"))
-
-
 def _struct(name: str) -> str:
     fields = [(f, k, o) for f, k, o in offsets(name)
               if not k.startswith("pad")]
@@ -241,6 +237,13 @@ use std::ffi::{{c_char, c_int, c_uchar, c_uint, c_void, CString}};
 // status(18,1) reserved(19,1) data_size(20,4) data(24,8)
 // reply(32,8) reply_size(40,4) pad(44,4)
 pub const PACKET_SIZE: usize = 48;
+
+/// Byte image of `struct tbp_packet`. The C side dereferences pointer
+/// and u64 fields through it, so the allocation must carry the struct's
+/// 8-byte alignment — a bare [u8; 48] box (align 1) would be UB.
+#[repr(C, align(8))]
+struct PacketBytes([u8; PACKET_SIZE]);
+
 const OFF_OPERATION: usize = 16;
 const OFF_DATA_SIZE: usize = 20;
 const OFF_DATA: usize = 24;
@@ -320,16 +323,16 @@ impl Client {{
         if self.handle.is_null() {{
             return Err(ClientError::Closed);
         }}
-        let mut pkt: Box<[u8; PACKET_SIZE]> =
-            Box::new([0u8; PACKET_SIZE]);
-        pkt[OFF_OPERATION..OFF_OPERATION + 2]
+        let mut pkt: Box<PacketBytes> =
+            Box::new(PacketBytes([0u8; PACKET_SIZE]));
+        pkt.0[OFF_OPERATION..OFF_OPERATION + 2]
             .copy_from_slice(&operation.to_le_bytes());
-        pkt[OFF_DATA_SIZE..OFF_DATA_SIZE + 4]
+        pkt.0[OFF_DATA_SIZE..OFF_DATA_SIZE + 4]
             .copy_from_slice(&(body.len() as u32).to_le_bytes());
         let data = body.to_vec().into_boxed_slice();
         if !body.is_empty() {{
             let ptr = data.as_ptr() as u64;
-            pkt[OFF_DATA..OFF_DATA + 8]
+            pkt.0[OFF_DATA..OFF_DATA + 8]
                 .copy_from_slice(&ptr.to_le_bytes());
         }}
         let pkt_ptr = Box::into_raw(pkt) as *mut c_void;
@@ -345,17 +348,17 @@ impl Client {{
         // the packet itself when the Box drops (the C++ client's
         // packet_free + delete pair, clients/cpp/tb_client.hpp:213-214).
         let mut pkt = unsafe {{
-            Box::from_raw(pkt_ptr as *mut [u8; PACKET_SIZE])
+            Box::from_raw(pkt_ptr as *mut PacketBytes)
         }};
         drop(data);
         let result = if status != STATUS_OK {{
             Err(ClientError::Packet(status))
         }} else {{
             let len = u32::from_le_bytes(
-                pkt[OFF_REPLY_SIZE..OFF_REPLY_SIZE + 4]
+                pkt.0[OFF_REPLY_SIZE..OFF_REPLY_SIZE + 4]
                     .try_into().unwrap()) as usize;
             let reply_ptr = u64::from_le_bytes(
-                pkt[OFF_REPLY..OFF_REPLY + 8].try_into().unwrap())
+                pkt.0[OFF_REPLY..OFF_REPLY + 8].try_into().unwrap())
                 as *const u8;
             Ok(if len == 0 {{
                 Vec::new()
@@ -365,7 +368,7 @@ impl Client {{
             }})
         }};
         unsafe {{
-            tbp_client_packet_free(pkt.as_mut_ptr() as *mut c_void)
+            tbp_client_packet_free(pkt.0.as_mut_ptr() as *mut c_void)
         }};
         result
     }}

@@ -147,7 +147,12 @@ class Replica:
         self.superblock: Optional[SuperBlock] = None
         self.fault_detector = FaultDetector(suspect_multiplier=4.0)
         self.repair_budget = RepairBudget()
-        self.scrubber = GridScrubber(self.durable.forest)
+        # Origin spread: each replica tours the grid from a different
+        # rotation so the same latent fault is scrubbed at different
+        # times on different replicas (grid_scrubber.zig:170-182).
+        self.scrubber = GridScrubber(
+            self.durable.forest,
+            origin_seed=replica_id * 2654435761)
         self._scrub_phase = 0
 
         self.status = "recovering"
@@ -1592,7 +1597,9 @@ class Replica:
         self.sessions.restore(sessions_blob)
         self.durable = durable
         self.durable.grid.on_corrupt = self._note_missing_block
-        self.scrubber = GridScrubber(self.durable.forest)
+        self.scrubber = GridScrubber(
+            self.durable.forest,
+            origin_seed=self.replica_id * 2654435761)
         self.block_repair.clear()
         self.state_machine = self.state_machine_factory()
         self.state_machine.state = state
