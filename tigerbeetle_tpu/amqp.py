@@ -45,11 +45,16 @@ QUEUE_DECLARE_OK = (50, 11)
 QUEUE_BIND = (50, 20)
 QUEUE_BIND_OK = (50, 21)
 BASIC_PUBLISH = (60, 40)
+BASIC_GET = (60, 70)
+BASIC_GET_OK = (60, 71)
+BASIC_GET_EMPTY = (60, 72)
 BASIC_CLASS = 60
 BASIC_ACK = (60, 80)
 BASIC_NACK = (60, 120)
 CONFIRM_SELECT = (85, 10)
 CONFIRM_SELECT_OK = (85, 11)
+
+RESOURCE_LOCKED = 405
 
 
 class ProtocolError(Exception):
@@ -277,13 +282,84 @@ class AmqpClient:
             + bytes([flags]) + field_table()))
         self._expect(EXCHANGE_DECLARE_OK)
 
-    def queue_declare(self, queue: str, durable: bool = True) -> None:
-        flags = 0b10 if durable else 0
+    def queue_declare(self, queue: str, durable: bool = True,
+                      exclusive: bool = False) -> None:
+        """Declare a queue. `exclusive` queues belong to this connection
+        and make a second declare by another connection fail with
+        RESOURCE_LOCKED — the CDC runner's single-writer lock
+        (reference: the locker queue, src/cdc/runner.zig:35)."""
+        flags = (0b10 if durable else 0) | (0b100 if exclusive else 0)
         self._send(method_frame(
             self.channel, QUEUE_DECLARE,
             struct.pack(">H", 0) + shortstr(queue) + bytes([flags])
             + field_table()))
-        self._expect(QUEUE_DECLARE_OK)
+        got = self._recv_frame()
+        if got.method == QUEUE_DECLARE_OK:
+            return
+        if got.method in (CONNECTION_CLOSE, CHANNEL_CLOSE):
+            args = got.args()
+            code = args.u16()
+            text = args.shortstr()
+            raise ProtocolError(f"queue.declare failed: {code} {text}")
+        raise ProtocolError(f"expected queue.declare-ok, got {got.method}")
+
+    def _apply_confirm(self, got: Frame) -> None:
+        """Fold one broker basic.ack/nack into the outstanding confirm
+        set (shared by wait_confirms and basic_get's absorption path)."""
+        args = got.args()
+        delivery_tag = args.u64()
+        multiple = args.u8() & 1
+        tags = ([t for t in self.outstanding if t <= delivery_tag]
+                if multiple else
+                [delivery_tag] if delivery_tag in self.outstanding
+                else [])
+        self.outstanding.difference_update(tags)
+        if got.method == BASIC_NACK:
+            raise ProtocolError(
+                f"broker nacked delivery tag(s) {tags or [delivery_tag]}")
+
+    def basic_get(self, queue: str,
+                  no_ack: bool = False) -> Optional[tuple[int, bytes]]:
+        """Synchronous single-message fetch: (delivery_tag, body), or
+        None when the queue is empty — how the CDC runner recovers its
+        progress watermark from the broker at startup (reference:
+        runner.zig get_progress_message phase)."""
+        self._send(method_frame(
+            self.channel, BASIC_GET,
+            struct.pack(">H", 0) + shortstr(queue)
+            + bytes([1 if no_ack else 0])))
+        got = self._recv_frame()
+        # Outstanding publisher confirms may interleave ahead of the
+        # get-ok on a shared channel; absorb them into the confirm set.
+        while got.method in (BASIC_ACK, BASIC_NACK) and self.confirm_mode:
+            self._apply_confirm(got)
+            got = self._recv_frame()
+        if got.method == BASIC_GET_EMPTY:
+            return None
+        if got.method != BASIC_GET_OK:
+            raise ProtocolError(f"expected get-ok/empty, got {got.method}")
+        args = got.args()
+        delivery_tag = args.u64()
+        args.u8()  # redelivered
+        args.shortstr()  # exchange
+        args.shortstr()  # routing key
+        args.u32()  # message count
+        header = self._recv_frame()
+        if header.type != FRAME_HEADER:
+            raise ProtocolError("expected content header after get-ok")
+        _, _, body_size, _ = struct.unpack_from(">HHQH", header.payload)
+        body = b""
+        while len(body) < body_size:
+            part = self._recv_frame()
+            if part.type != FRAME_BODY:
+                raise ProtocolError("expected content body frame")
+            body += part.payload
+        return delivery_tag, body
+
+    def basic_ack(self, delivery_tag: int, multiple: bool = False) -> None:
+        self._send(method_frame(
+            self.channel, BASIC_ACK,
+            struct.pack(">QB", delivery_tag, 1 if multiple else 0)))
 
     def queue_bind(self, queue: str, exchange: str, routing_key: str) -> None:
         self._send(method_frame(
@@ -317,24 +393,10 @@ class AmqpClient:
         assert self.confirm_mode
         while self.outstanding:
             got = self._recv_frame()
-            if got.method == BASIC_ACK:
-                nack = False
-            elif got.method == BASIC_NACK:
-                nack = True
-            else:
+            if got.method not in (BASIC_ACK, BASIC_NACK):
                 raise ProtocolError(
                     f"expected basic.ack/nack, got {got.method}")
-            args = got.args()
-            delivery_tag = args.u64()
-            multiple = args.u8() & 1
-            tags = ([t for t in self.outstanding if t <= delivery_tag]
-                    if multiple else
-                    [delivery_tag] if delivery_tag in self.outstanding
-                    else [])
-            self.outstanding.difference_update(tags)
-            if nack:
-                raise ProtocolError(
-                    f"broker nacked delivery tag(s) {tags or [delivery_tag]}")
+            self._apply_confirm(got)
 
     def close(self) -> None:
         try:

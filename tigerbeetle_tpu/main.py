@@ -381,31 +381,39 @@ def cmd_amqp(args) -> int:
     if not sep or not port.isdigit() or not host:
         print(f"--amqp must be host:port, got {args.amqp!r}")
         return 1
-    sink = AmqpSink(host, int(port),
-                    exchange=args.exchange,
-                    user=args.user, password=args.password,
-                    virtual_host=args.vhost)
-    runner = CDCRunner(_ClusterSource(), sink)
-    # Durable progress: resume from --timestamp-last or the progress file
-    # (reference: the runner tracks progress so restarts don't republish
-    # history; at-least-once either way).
+    from .cdc import AmqpProgress, FileProgress, MemoryProgress
+
+    amqp_kwargs = dict(user=args.user, password=args.password,
+                       virtual_host=args.vhost)
+    # Durable progress (reference: the broker-resident progress-tracker
+    # queue, src/cdc/runner.zig:34): by default the watermark lives in
+    # the broker and a restarted runner resumes exactly after the
+    # confirmed stream; --timestamp-last overrides, --progress-file uses
+    # a local sidecar instead. Built before the sink so a failed locker
+    # declare strands no connection (and vice versa).
+    progress_close = None
     if args.timestamp_last:
-        runner.timestamp_processed = args.timestamp_last
-    elif args.progress_file and os.path.exists(args.progress_file):
-        with open(args.progress_file) as f:
-            runner.timestamp_processed = json.load(f)["timestamp_processed"]
-
-    def save_progress():
-        if args.progress_file:
-            with open(args.progress_file, "w") as f:
-                json.dump({"timestamp_processed":
-                           runner.timestamp_processed}, f)
-
+        progress = MemoryProgress(args.timestamp_last)
+    elif args.progress_file:
+        progress = FileProgress(args.progress_file)
+    else:
+        progress = AmqpProgress(host, int(port), cluster=args.cluster,
+                                **amqp_kwargs)
+        progress_close = progress.close
+    try:
+        sink = AmqpSink(host, int(port), exchange=args.exchange,
+                        cluster=args.cluster, lock=not args.no_lock,
+                        **amqp_kwargs)
+    except BaseException:
+        if progress_close:
+            progress_close()
+        raise
+    runner = CDCRunner(_ClusterSource(), sink, progress=progress)
+    runner.recover()
     try:
         while True:
             n = runner.run_until_idle()
             if n:
-                save_progress()
                 print(f"published {n} (total {runner.published}, "
                       f"watermark {runner.timestamp_processed})")
             if args.once:
@@ -414,7 +422,10 @@ def cmd_amqp(args) -> int:
     except KeyboardInterrupt:
         return 0
     finally:
+        runner.close()
         sink.close()
+        if progress_close:
+            progress_close()
         client.close()
 
 
@@ -622,7 +633,11 @@ def main(argv=None) -> int:
     p.add_argument("--timestamp-last", type=int, default=0,
                    help="resume after this change-event timestamp")
     p.add_argument("--progress-file", default=None,
-                   help="persist/resume the watermark here")
+                   help="persist/resume the watermark in this file "
+                        "(default: a durable queue in the broker)")
+    p.add_argument("--no-lock", action="store_true",
+                   help="skip the exclusive locker queue (allows "
+                        "concurrent runners — duplicates likely)")
     p.set_defaults(fn=cmd_amqp)
 
     p = sub.add_parser("fuzz")
