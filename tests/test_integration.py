@@ -71,6 +71,48 @@ class TestReplParser:
         assert parse_statement("  ;") is None
 
 
+class TestReplCompletion:
+    """reference: src/repl/completion.zig — operations at statement
+    start, fields for the active operation, flag names inside flags=."""
+
+    def _c(self, buffer, word):
+        from tigerbeetle_tpu.repl import complete_candidates
+
+        return complete_candidates(buffer, word)
+
+    def test_operations_at_statement_start(self):
+        got = self._c("create_", "create_")
+        assert got == ["create_accounts", "create_transfers"]
+        assert "query_accounts" in self._c("", "")
+        assert "exit" in self._c("ex", "ex")
+        # After a ';' a fresh statement starts.
+        got = self._c("lookup_accounts id=1; look", "look")
+        assert got == ["lookup_accounts", "lookup_transfers"]
+
+    def test_fields_for_operation(self):
+        got = self._c("create_transfers de", "de")
+        assert got == ["debit_account_id="]
+        got = self._c("create_accounts id=1 le", "le")
+        assert got == ["ledger="]
+        # Lookups complete only id=.
+        assert self._c("lookup_accounts i", "i") == ["id="]
+        # Unknown operation: nothing.
+        assert self._c("bogus fie", "fie") == []
+
+    def test_flag_names_inside_flags_value(self):
+        got = self._c("create_transfers flags=pen", "flags=pen")
+        assert got == ["flags=pending"]
+        # After '|' the next flag completes with the prior ones kept.
+        got = self._c("create_transfers flags=linked|pos",
+                      "flags=linked|pos")
+        assert got == ["flags=linked|post_pending_transfer"]
+        got = self._c("query_accounts flags=rev", "flags=rev")
+        assert got == ["flags=reversed"]
+
+    def test_non_flag_values_do_not_complete(self):
+        assert self._c("create_accounts id=4", "id=4") == []
+
+
 def _free_ports(n):
     socks = [socket.socket() for _ in range(n)]
     for s in socks:

@@ -48,6 +48,29 @@ class TestObjectCache:
         assert cache.get(2) is None
         assert cache.get(1) == "a" and cache.get(3) == "c"
 
+    def test_eviction_never_loses_an_update_through_the_lsm(self):
+        """The no-stash substitution's load-bearing property (see
+        cache_map.py docstring): an updated-then-evicted entry must be
+        re-readable with its NEW value from the layer below — updates
+        only enter the cache after the durable flush, so eviction can
+        never lose one (reference keeps a stash for its mid-bar window,
+        src/lsm/cache_map.zig:1-40)."""
+        attached, detached, _durable = _mk_attached(
+            n_accounts=300, cache_sets=4, ways=2)  # capacity 8 << 300
+        ids = list(range(1, 301))
+        # Read a large working set (heavy eviction churn)...
+        first = attached.lookup_accounts(ids)
+        assert attached._acct_cache.evictions > 0
+        # ...then verify every account STILL reads back with the values
+        # the detached twin holds (each miss refills from the LSM;
+        # nothing was lost or staled by eviction).
+        again = attached.lookup_accounts(ids[:64])
+        truth = detached.lookup_accounts(ids[:64])
+        for got, want in zip(again, truth):
+            assert got.debits_posted == want.debits_posted
+            assert got.credits_posted == want.credits_posted
+        assert len(first) == 300
+
 
 def _mk_attached(n_accounts=300, n_transfers=2000, cache_sets=8, ways=2):
     """A durable-attached state machine with data far exceeding the

@@ -12,6 +12,16 @@ Write discipline (reference: the groove object cache is written THROUGH
 at commit, src/lsm/groove.zig:1770): mutated objects are upserted after
 every durable flush, so a cached entry is always the current value —
 reads never need invalidation logic.
+
+Deliberate non-port: the reference CacheMap pairs the cache with a
+"stash" map holding entries evicted mid-bar whose mutations are not yet
+in the LSM, plus scope open/persist/discard for linked-chain rollback
+(src/lsm/cache_map.zig:1-40). Here neither exists by design: mutations
+reach this cache only AFTER the durable flush (the LSM below already
+holds the truth, so an evicted entry is always re-readable), and
+rollback scopes are resolved on device before anything is applied
+(ops/create_kernels.py undo log) — there is no mid-bar mutable window
+to stash.
 """
 
 from __future__ import annotations

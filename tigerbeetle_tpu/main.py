@@ -381,7 +381,7 @@ def cmd_amqp(args) -> int:
     if not sep or not port.isdigit() or not host:
         print(f"--amqp must be host:port, got {args.amqp!r}")
         return 1
-    from .cdc import AmqpProgress, FileProgress, MemoryProgress
+    from .cdc import AmqpProgress, FileProgress
 
     amqp_kwargs = dict(user=args.user, password=args.password,
                        virtual_host=args.vhost)
@@ -392,9 +392,7 @@ def cmd_amqp(args) -> int:
     # a local sidecar instead. Built before the sink so a failed locker
     # declare strands no connection (and vice versa).
     progress_close = None
-    if args.timestamp_last:
-        progress = MemoryProgress(args.timestamp_last)
-    elif args.progress_file:
+    if args.progress_file:
         progress = FileProgress(args.progress_file)
     else:
         progress = AmqpProgress(host, int(port), cluster=args.cluster,
@@ -410,6 +408,12 @@ def cmd_amqp(args) -> int:
         raise
     runner = CDCRunner(_ClusterSource(), sink, progress=progress)
     runner.recover()
+    if args.timestamp_last:
+        # Operator override (reference: recovery_mode .override): seed
+        # the watermark AND persist it, so the next restart resumes from
+        # the confirmed stream, not from the override again.
+        runner.timestamp_processed = args.timestamp_last
+        progress.store(args.timestamp_last)
     try:
         while True:
             n = runner.run_until_idle()

@@ -164,6 +164,80 @@ def _parse_flags(value: str, flag_set) -> int:
     return out
 
 
+# ------------------------------------------------------------ completion
+
+_COMMANDS = ("exit", "quit", "help")
+
+
+def complete_candidates(buffer: str, word: str) -> list[str]:
+    """Context-aware completions for the partial `word` at the end of
+    `buffer` (reference: src/repl/completion.zig — operation names at
+    statement start, then field names for that operation, then flag
+    names inside a flags value). Pure function: the terminal layer below
+    and the tests share it."""
+    stmt = buffer[buffer.rfind(";") + 1:]
+    prior = stmt[:len(stmt) - len(word)] if word else stmt
+    tokens = prior.split()
+    if not tokens:
+        pool = sorted(_OPERATIONS) + list(_COMMANDS)
+        return [c for c in pool if c.startswith(word)]
+    op_name = tokens[0]
+    if op_name not in _OPERATIONS:
+        return []
+    key, eq, value = word.partition("=")
+    if eq:
+        if key == "flags" and op_name in _FLAG_SETS:
+            done, _, part = value.rpartition("|")
+            prefix = f"{key}={done}|" if done else f"{key}="
+            return [prefix + f.name for f in _FLAG_SETS[op_name]
+                    if f.name.startswith(part)]
+        return []
+    if op_name in ("lookup_accounts", "lookup_transfers"):
+        return ["id="] if "id".startswith(word) else []
+    cls = _OBJECTS[op_name]
+    names = [f.name for f in dataclasses.fields(cls)
+             if f.name != "timestamp"]
+    return [f"{n}=" for n in sorted(names) if n.startswith(word)]
+
+
+def setup_terminal(history_path: Optional[str] = None):
+    """Line editing + history + tab completion via GNU readline
+    (reference: src/repl/terminal.zig's raw-mode editor — the runtime-
+    native equivalent is the readline library). No-op where readline is
+    unavailable; returns a save-history callback (or None)."""
+    try:
+        import readline
+    except ImportError:
+        return None
+
+    state = {"matches": []}
+
+    def completer(word, index):
+        if index == 0:
+            buffer = readline.get_line_buffer()[:readline.get_endidx()]
+            state["matches"] = complete_candidates(buffer, word)
+        if index < len(state["matches"]):
+            return state["matches"][index]
+        return None
+
+    readline.set_completer(completer)
+    readline.set_completer_delims(" \t\n,;")
+    readline.parse_and_bind("tab: complete")
+    if history_path:
+        import contextlib
+
+        with contextlib.suppress(OSError):
+            readline.read_history_file(history_path)
+        readline.set_history_length(1000)
+
+        def save():
+            with contextlib.suppress(OSError):
+                readline.write_history_file(history_path)
+
+        return save
+    return None
+
+
 def format_result(obj) -> str:
     """Render a result dataclass like the reference repl: non-zero fields."""
     pairs = []
@@ -176,7 +250,9 @@ def format_result(obj) -> str:
 
 
 def run_repl(client, input_fn=input, print_fn=print) -> None:
-    """Statement loop against a connected client."""
+    """Statement loop against a connected client. When driven by the
+    builtin input() on a tty, the terminal layer (readline: editing,
+    history, tab completion) engages automatically."""
     from . import multi_batch
     from .state_machine import OPERATION_SPECS
     from .types import (
@@ -185,6 +261,15 @@ def run_repl(client, input_fn=input, print_fn=print) -> None:
         CreateAccountResult,
         CreateTransferResult,
     )
+
+    save_history = None
+    if input_fn is input:
+        import os
+        import sys
+
+        if sys.stdin.isatty():
+            save_history = setup_terminal(
+                os.path.expanduser("~/.tigerbeetle_tpu_history"))
 
     result_types = {
         Operation.create_accounts: CreateAccountResult,
@@ -203,9 +288,18 @@ def run_repl(client, input_fn=input, print_fn=print) -> None:
             prompt = "> " if not buffer else ". "
             line = input_fn(prompt)
         except EOFError:
+            if save_history:
+                save_history()
             return
         if line.strip() in ("exit", "quit"):
+            if save_history:
+                save_history()
             return
+        if line.strip() == "help":
+            print_fn("operations: " + ", ".join(sorted(_OPERATIONS)))
+            print_fn("syntax: <operation> key=value ... , key=value ...;")
+            print_fn("tab completes operations, fields, and flag names")
+            continue
         buffer += " " + line
         # Execute every complete statement on the line; a parse error drops
         # only its own statement, never the rest of the buffer.
