@@ -872,7 +872,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # disagrees with the sequential truth (its own prefix is already
         # correct and stays correct), so K rounds resolve any batch whose
         # limit-decision cascade is shallower than K; deeper cascades
-        # fall back to the exact host path.
+        # fall back to the exact host path. Dependency deaths fold into
+        # the SAME round's apply set (a second cheap chain pass), so one
+        # round advances a full over->death->relief wave — without the
+        # fold the wave costs two rounds (measured: the config4 window
+        # workload converges at half the rounds with it).
         alx = _to_limbs(amt_res_hi, amt_res_lo)
         nlx = _neg_limbs(p["amt_hi"], p["amt_lo"])
         frows2 = jnp.concatenate([
@@ -897,6 +901,17 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                    & (status == _CREATED))
         cand_cr = (valid & ~pv & _flag(cr["flags"], _A_CR_LIMIT)
                    & (status == _CREATED))
+        # Round-static sorted-space operands: the per-entry amount limbs
+        # and the entry side never change across rounds, so they sort
+        # ONCE; each round gathers only a packed u8 apply-mask (one
+        # 2N-byte gather) instead of permuting the (4,4,2N) u64 delta
+        # matrix (256N bytes) — the loop's dominant operand traffic.
+        al2_s = [jnp.concatenate([alx[j], alx[j]])[fperm]
+                 for j in range(4)]
+        nl2_s = [jnp.concatenate([nlx[j], nlx[j]])[fperm]
+                 for j in range(4)]
+        cr_side_s = (fperm >= N)  # static: entry index N.. = credit side
+        z64_ = jnp.uint64(0)
 
         def _over(pre_evt, held1, held2, against, amt):
             # (held1_pre + held2_pre + amount) > against_pre, 5 limbs.
@@ -942,11 +957,41 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             def_dead = ((st_r[didx] != _CREATED)
                         | (in_chain_r[didx] & (my_first_r[didx] < idxs)))
             new_dead = inwin & def_dead
-            st_r = st_c
-            ap_r = valid & (st_r == _CREATED)
-            fl = _delta_lanes2(ap_r & ~pv & ~pending, ap_r & ~pv & pending,
-                               ap_r & pv, ap_r & pv & is_post, alx, nlx)
-            fls = fl[:, :, fperm]
+            # Gauss-Seidel fold: apply the NEW deaths to this round's
+            # apply set (chains re-derived over the folded statuses), so
+            # the over->death->lost-relief wave completes in ONE round.
+            # At a fixpoint new_dead == dead and the fold is an identity,
+            # so the converged statuses are unchanged by it.
+            st_f = jnp.where(new_dead & ~dead, status_dead, st_r)
+            st_c, _, _, _ = _chain_pass(
+                st_f, linked, valid, idxs, n, N, seg_start, chain_term)
+            ap_r = valid & (st_c == _CREATED)
+            # Delta lanes directly in sorted entry space: one u8 mask
+            # gather + fused elementwise selects against the hoisted
+            # sorted amount limbs (al2_s/nl2_s).
+            mask8 = ((ap_r & ~pv & ~pending).astype(jnp.uint8)
+                     | ((ap_r & ~pv & pending).astype(jnp.uint8) << 1)
+                     | ((ap_r & pv).astype(jnp.uint8) << 2)
+                     | ((ap_r & pv & is_post).astype(jnp.uint8) << 3))
+            m_s = jnp.concatenate([mask8, mask8])[fperm]
+            reg_s = (m_s & 1) != 0
+            pend_s = (m_s & 2) != 0
+            pv_s = (m_s & 4) != 0
+            post_s = (m_s & 8) != 0
+            held = [jnp.where(pend_s, al2_s[j], z64_)
+                    + jnp.where(pv_s, nl2_s[j], z64_) for j in range(4)]
+            posted = [jnp.where(reg_s | post_s, al2_s[j], z64_)
+                      for j in range(4)]
+            fls = jnp.stack([
+                jnp.stack([jnp.where(cr_side_s, z64_, held[j])
+                           for j in range(4)]),       # dp
+                jnp.stack([jnp.where(cr_side_s, z64_, posted[j])
+                           for j in range(4)]),       # dpos
+                jnp.stack([jnp.where(cr_side_s, held[j], z64_)
+                           for j in range(4)]),       # cp
+                jnp.stack([jnp.where(cr_side_s, posted[j], z64_)
+                           for j in range(4)]),       # cpos
+            ])
             fcs = _cumsum(fls, axis=2)
             foff = jnp.where(
                 fseg_start > 0,
@@ -956,8 +1001,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             # all lane limbs < 2^32, prefixes < 2^45: carry-safe.
             pre = jnp.stack(_normalize_limbs(fbase + fcs - foff - fls),
                             axis=1)
-            pre_dr = jnp.take(pre, finv[:N], axis=2)
-            pre_cr = jnp.take(pre, finv[N:], axis=2)
+            pre_ev = jnp.take(pre, finv, axis=2)
+            pre_dr = pre_ev[:, :, :N]
+            pre_cr = pre_ev[:, :, N:]
             new_over_dr = cand_dr & _over(pre_dr, "dp", "dpos", "cpos", alx)
             new_over_cr = cand_cr & _over(pre_cr, "cp", "cpos", "dpos", alx)
             fix_converged = jnp.all((new_over_dr == over_dr)
