@@ -500,31 +500,60 @@ def cmd_devhub(args) -> int:
 
 
 def cmd_cfo(args) -> int:
-    """Continuous fuzzing orchestrator: run random (fuzzer, seed) pairs
-    until stopped or a budget runs out, recording failing seeds
-    (reference: src/scripts/cfo.zig — fleet machines fuzz 24/7 and push
-    failing seeds to devhub)."""
+    """Continuous fuzzing orchestrator: interleave random single-
+    component fuzzer runs with WHOLE-CLUSTER VOPR swarm seeds (random
+    topology + fault config + audited workload), recording failing
+    seeds and a results artifact (reference: src/scripts/cfo.zig —
+    fleet machines run fuzzers AND VOPR 24/7, failing seeds pushed to
+    devhubdb)."""
     import random as _random
     import time as _time
 
     from .testing import fuzz
+    from .testing.vopr import run_swarm_seed
 
     rng = (_random.Random(args.seed) if args.seed is not None
            else _random.SystemRandom())
     deadline = (_time.monotonic() + args.budget_s) if args.budget_s else None
-    runs = failures = 0
     names = list(fuzz.FUZZERS)
+    counts: dict = {}
+    failing: list = []
+    t0 = _time.monotonic()
+    runs = failures = 0
     try:
         while deadline is None or _time.monotonic() < deadline:
-            name = rng.choice(names)
-            seed = rng.randrange(1 << 30)
+            if args.kind == "fuzz":
+                kind = "fuzz"
+            elif args.kind == "vopr":
+                kind = "vopr"
+            else:
+                # Mix: the cluster seeds are the expensive, high-yield
+                # side; keep them a steady ~1/3 of the stream.
+                kind = "vopr" if rng.random() < (1 / 3) else "fuzz"
+            seed = (args.seed if args.seed is not None
+                    and args.max_runs == 1 else rng.randrange(1 << 30))
+            name = kind if kind == "vopr" else rng.choice(names)
+            key = kind if kind == "vopr" else f"fuzz:{name}"
             try:
-                fuzz.run(name, seed)
+                if kind == "vopr":
+                    run_swarm_seed(seed)
+                else:
+                    fuzz.run(name, seed)
                 runs += 1
+                counts[key] = counts.get(key, 0) + 1
             except Exception as e:  # record and keep hunting
                 failures += 1
+                # Each record carries ITS OWN exact reproduction command
+                # (the fuzzer name cannot be re-derived from the seed).
+                repro = (
+                    f"python -m tigerbeetle_tpu cfo --kind vopr "
+                    f"--seed {seed} --max-runs 1" if kind == "vopr"
+                    else f"python -m tigerbeetle_tpu fuzz {name} {seed}")
+                failing.append({"kind": kind, "name": name, "seed": seed,
+                                "error": repr(e)[:300],
+                                "reproduce": repro})
                 line = f"{name} {seed} {e!r}"
-                print(f"FAIL {line}", flush=True)
+                print(f"FAIL {line}\n  reproduce: {repro}", flush=True)
                 if args.failures_file:
                     with open(args.failures_file, "a") as f:
                         f.write(line + "\n")
@@ -532,8 +561,18 @@ def cmd_cfo(args) -> int:
                 break
     except KeyboardInterrupt:
         pass
+    if args.artifact:
+        with open(args.artifact, "w") as f:
+            json.dump({
+                "runs_clean": runs, "runs_failing": failures,
+                "elapsed_s": round(_time.monotonic() - t0, 1),
+                "counts": dict(sorted(counts.items())),
+                "failing": failing,
+            }, f, indent=1)
+            f.write("\n")
     print(f"cfo: {runs} clean, {failures} failing "
-          f"(reproduce: python -m tigerbeetle_tpu fuzz <name> <seed>)")
+          f"(reproduce: python -m tigerbeetle_tpu fuzz <name> <seed> / "
+          f"cfo --kind vopr --seed <seed> --max-runs 1)")
     return 1 if failures else 0
 
 
@@ -672,10 +711,17 @@ def main(argv=None) -> int:
     p.add_argument("--budget-s", type=float, default=0,
                    help="stop after this many seconds (0 = run forever)")
     p.add_argument("--max-runs", type=int, default=0)
+    p.add_argument("--kind", choices=["mix", "fuzz", "vopr"],
+                   default="mix",
+                   help="mix (default): fuzzer registry + VOPR cluster "
+                        "swarm interleaved; or one side only")
     p.add_argument("--failures-file", default=None,
                    help="append failing (fuzzer, seed) pairs here")
+    p.add_argument("--artifact", default=None,
+                   help="write a JSON results artifact here")
     p.add_argument("--seed", type=int, default=None,
-                   help="deterministic pair selection (CI); default: random")
+                   help="deterministic selection; with --max-runs 1 the "
+                        "seed IS the run seed (reproduction)")
     p.set_defaults(fn=cmd_cfo)
 
     p = sub.add_parser("clients")

@@ -234,9 +234,14 @@ _FI = {f: i for i, f in enumerate(_FIELDS)}
 def _delta_lanes2(ap_reg, ap_pend, ap_pv, ap_post, al, nl):
     """(4 fields, 4 limbs, 2N) per-entry balance delta lanes — debit-side
     entries then credit-side entries — from pre-ANDed application masks.
-    Shared by the snapshot/application stage and the limit fixpoint. All
-    lanes are < 2^32 (u32-normalized limbs incl. the two's-complement
-    pv releases), so segment prefix sums stay carry-safe in u64."""
+    Used by the snapshot/application stage. The limit fixpoint builds
+    the SAME lanes inline in sorted entry space (see the `fls` stack in
+    create_transfers_fast's limit_rounds>1 loop) so it can gather one
+    packed-u8 mask per round instead of this whole matrix — any change
+    to which lane an amount lands in MUST be applied to both sites.
+    All lanes are < 2^32 (u32-normalized limbs incl. the two's-
+    complement pv releases), so segment prefix sums stay carry-safe in
+    u64."""
     z64 = jnp.uint64(0)
 
     def ln(cond_pos, limbs, cond_neg=None, nlimbs=None):
@@ -968,7 +973,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             ap_r = valid & (st_c == _CREATED)
             # Delta lanes directly in sorted entry space: one u8 mask
             # gather + fused elementwise selects against the hoisted
-            # sorted amount limbs (al2_s/nl2_s).
+            # sorted amount limbs (al2_s/nl2_s). Lane semantics MUST
+            # match _delta_lanes2 (the application stage's builder) —
+            # see its docstring.
             mask8 = ((ap_r & ~pv & ~pending).astype(jnp.uint8)
                      | ((ap_r & ~pv & pending).astype(jnp.uint8) << 1)
                      | ((ap_r & pv).astype(jnp.uint8) << 2)
