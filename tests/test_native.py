@@ -343,3 +343,91 @@ def test_journal_same_slot_serializes():
         assert got is not None and got.header.op == 5 + wrap
         assert j.read_prepare(5) is None  # overwritten by the wrap
         st.close()
+
+
+class TestGridReadAhead:
+    """Async block read-ahead through the native engine (reference:
+    every read is an io_uring submission the event loop outlives,
+    src/storage.zig:177): prefetch_async submits, the next read of the
+    block collects the completed data, and a stale buffer (extent
+    rewritten after submit) falls back to the exact synchronous read."""
+
+    def _grid(self, tmp_path):
+        from tigerbeetle_tpu import native as native_mod
+        from tigerbeetle_tpu.lsm.grid import Grid
+        from tigerbeetle_tpu.vsr.durable import _ZoneDevice
+        from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, FileStorage
+
+        if not native_mod.available():
+            pytest.skip("native engine unavailable")
+        st = FileStorage(str(tmp_path / "d"), TEST_LAYOUT, create=True)
+        grid = Grid(_ZoneDevice(st, "grid"),
+                    block_size=TEST_LAYOUT.grid_block_size,
+                    block_count=TEST_LAYOUT.grid_block_count,
+                    cache_sets=2, cache_ways=1)  # tiny: misses are real
+        return st, grid
+
+    def test_prefetch_then_read_collects_inflight(self, tmp_path):
+        st, grid = self._grid(tmp_path)
+        addrs = [grid.write_block(bytes([i]) * 900) for i in range(6)]
+        sizes = [900] * 6
+        grid.cache.clear()
+        n = grid.prefetch_async(list(zip(addrs, sizes)))
+        assert n == 6 and len(grid._inflight) == 6
+        # Duplicate submit is a no-op while in flight.
+        assert grid.prefetch_async(list(zip(addrs, sizes))) == 0
+        for i, (a, s) in enumerate(zip(addrs, sizes)):
+            assert grid.read_block(a, s)[:8] == bytes([i]) * 8
+        assert grid.prefetch_hits == 6 and not grid._inflight
+        # And the batched path collects in-flight buffers too.
+        grid.cache.clear()
+        grid.prefetch_async(list(zip(addrs, sizes)))
+        out = grid.read_blocks(list(zip(addrs, sizes)))
+        assert [o[:4] for o in out] == [bytes([i]) * 4 for i in range(6)]
+        assert grid.prefetch_hits == 12
+        st.close()
+
+    def test_stale_prefetch_falls_back_to_sync_read(self, tmp_path):
+        """A prefetched buffer that no longer matches the requested
+        checksum (its extent was freed and rewritten between submit and
+        completion) must be DISCARDED and the block re-read
+        synchronously — correctness never rests on the read-ahead."""
+        st, grid = self._grid(tmp_path)
+        old = grid.write_block(b"\xaa" * 900)
+        new = grid.write_block(b"\xbb" * 900)
+        grid.cache.clear()
+        # Submit a read of the OLD extent, then rebind its in-flight
+        # token to the NEW block's key — exactly the state a submit-
+        # then-rewrite race leaves behind (the token's buffer holds
+        # bytes that do not checksum as `new`).
+        assert grid.prefetch_async([(old, 900)]) == 1
+        old_key = (old.checksum << 64) | old.index
+        new_key = (new.checksum << 64) | new.index
+        grid._inflight[new_key] = grid._inflight.pop(old_key)
+        data = grid.read_block(new, 900)
+        assert data[:4] == b"\xbb" * 4  # sync re-read won
+        assert not grid._inflight
+        st.close()
+
+    def test_wal_tokens_unaffected_by_read_ahead(self, tmp_path):
+        """Journal WAL completion tokens must keep flowing while read-
+        ahead tokens sit unfetched in the engine (io_poll filters them)."""
+        from tigerbeetle_tpu.vsr.header import Command, Header, Message
+        from tigerbeetle_tpu.vsr.journal import Journal
+
+        st, grid = self._grid(tmp_path)
+        addr = grid.write_block(b"\xcc" * 900)
+        grid.cache.clear()
+        assert grid.prefetch_async([(addr, 900)]) == 1
+        j = Journal(st)
+        fired = []
+        h = Header(command=Command.prepare, cluster=7, replica=0,
+                   view=1, op=3, operation=1)
+        j.append(Message(header=h.finalize(b"y"), body=b"y"),
+                 on_durable=lambda: fired.append(3))
+        j.wait_all()
+        assert fired == [3]
+        # The read-ahead is still collectable afterward.
+        assert grid.read_block(addr, 900)[:4] == b"\xcc" * 4
+        assert grid.prefetch_hits == 1
+        st.close()

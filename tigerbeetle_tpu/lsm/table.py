@@ -123,7 +123,18 @@ class Table:
         return None
 
     def iter_entries(self):
-        for i in range(len(self.block_addresses)):
+        # Read-ahead: block i+1's device read runs while block i's
+        # entries are merged (compaction input no longer stalls the
+        # replica loop per block — reference: compaction reads are
+        # pipelined through io_uring, src/storage.zig:177 +
+        # docs/internals/lsm.md pipelined compaction). No-op on
+        # synchronous devices (the deterministic simulator).
+        n = len(self.block_addresses)
+        for i in range(n):
+            if i + 1 < n:
+                self.grid.prefetch_async(
+                    [(self.block_addresses[i + 1],
+                      self.block_sizes[i + 1])])
             keys, vals = self._block_entries(i)
             yield from zip(keys, vals)
 

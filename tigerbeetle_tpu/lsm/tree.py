@@ -187,36 +187,57 @@ class Tree:
                 found[key] = value
             else:
                 remaining.append(key)
-        for level in self.levels:
+        plans: dict = {}  # key -> [(table, blk)] planned by the lookahead
+        for li, level in enumerate(self.levels):
             if not remaining:
                 break
             # Per-key candidate queues (L0 may yield several overlapping
-            # tables, newest first; deeper levels at most one).
+            # tables, newest first; deeper levels at most one). The
+            # previous level's lookahead already planned (table, block)
+            # pairs for this level — reuse them instead of re-probing.
             active = []
             for key in remaining:
-                tables = [t for t in level.lookup(key, snapshot)]
-                if tables:
-                    active.append((key, tables))
+                cand = plans.get(key)
+                if cand is None:
+                    cand = [(t, t.block_for(key))
+                            for t in level.lookup(key, snapshot)]
+                if cand:
+                    active.append((key, cand))
+            # Overlap: submit the NEXT level's candidate blocks (planned
+            # read-free) while THIS level's fan-out resolves — a superset
+            # read-ahead (keys resolved here waste their submit) bounded
+            # by the grid's in-flight cap; no-op on synchronous devices.
+            plans = {}
+            if li + 1 < len(self.levels) and active:
+                lookahead = []
+                for key, _ in active:
+                    cand2 = [(t, t.block_for(key)) for t in
+                             self.levels[li + 1].lookup(key, snapshot)]
+                    if cand2:
+                        plans[key] = cand2
+                        lookahead.extend(
+                            b for _, b in cand2 if b is not None)
+                if lookahead:
+                    self.grid.prefetch_async(lookahead)
             while active:
                 reqs, slots, nxt = [], [], []
-                for key, tables in active:
+                for key, cand in active:
                     blk = None
-                    while tables and blk is None:
-                        blk = tables[0].block_for(key)
-                        table = tables.pop(0)
+                    while cand and blk is None:
+                        table, blk = cand.pop(0)
                     if blk is None:
                         continue
                     reqs.append(blk)
-                    slots.append((key, table, tables))
+                    slots.append((key, table, cand))
                 if not reqs:
                     break
-                for (key, table, tables), raw in zip(
+                for (key, table, cand), raw in zip(
                         slots, self.grid.read_blocks(reqs)):
                     value = table.get_in_block(key, raw)
                     if value is not None:
                         found[key] = value  # tombstones shadow deeper levels
-                    elif tables:
-                        nxt.append((key, tables))
+                    elif cand:
+                        nxt.append((key, cand))
                 active = nxt
             remaining = [k for k in remaining if k not in found]
         dead = TOMBSTONE * self.value_size
@@ -399,6 +420,13 @@ class Tree:
                 # Older tables first so the newer input wins the merge.
                 job.streams = [t.iter_entries() for t in overlapping]
                 job.streams.append(table.iter_entries())
+                # Warm the first input block of every stream now: the
+                # device reads run during the beats before the job's
+                # first advance (iter_entries read-ahead covers the
+                # rest of each table).
+                self.grid.prefetch_async(
+                    [(t.block_addresses[0], t.block_sizes[0])
+                     for t in touched if t.block_addresses])
                 jobs.append(job)
         self._jobs = jobs
         total = sum(j.total for j in jobs)

@@ -266,6 +266,12 @@ class _ZoneDevice:
     def read_batch(self, reqs: list) -> list:
         return self.storage.read_batch(self.zone, reqs)
 
+    def read_submit(self, reqs: list):
+        return self.storage.read_submit(self.zone, reqs)
+
+    def read_fetch(self, token, size: int) -> bytes:
+        return self.storage.read_fetch(token, size)
+
     def write(self, off: int, data: bytes) -> None:
         self.storage.write(self.zone, off, data)
 

@@ -112,6 +112,19 @@ class Storage:
         batch's reads at once, src/lsm/groove.zig:996,1339)."""
         return [self.read(zone, off, size) for off, size in reqs]
 
+    def read_submit(self, zone: str, reqs: list):
+        """Submit (offset, size) reads WITHOUT waiting; returns tokens
+        for read_fetch, or None when unsupported (the caller reads
+        synchronously instead). This is the fire-and-continue half of
+        the reference's overlapped read path (src/storage.zig:177 —
+        every read is an io_uring submission the event loop outlives);
+        the grid's block read-ahead rides it."""
+        return None
+
+    def read_fetch(self, token, size: int) -> bytes:
+        """Block until a read_submit token completes; returns the data."""
+        raise KeyError(f"unknown read token {token!r}")
+
     def _check(self, zone: str, offset: int, size: int) -> int:
         zones = self.layout.zone_offsets
         base = zones[zone]
@@ -162,6 +175,7 @@ class FileStorage(Storage):
         # drains + fsyncs (the checkpoint barrier).
         self.aio = None
         self._grid_pending: dict[int, tuple[int, int]] = {}  # token -> (pos, end)
+        self._read_pending: set[int] = set()  # read-ahead tokens in flight
         if native_mod.available():
             self.native = native_mod.NativeFile(path, layout.size, create)
             self.fd = -1
@@ -244,7 +258,9 @@ class FileStorage(Storage):
             if token in self._grid_pending:
                 del self._grid_pending[token]
                 self._reap_grid(token)
-            else:
+            elif token not in self._read_pending:
+                # Read-ahead tokens stay in the engine until their
+                # owner fetches them (tbio_poll is non-consuming).
                 out.append(token)
         return out
 
@@ -270,6 +286,26 @@ class FileStorage(Storage):
                 data += b"\x00" * (size - len(data))
             out.append(data)
         return out
+
+    def read_submit(self, zone: str, reqs: list):
+        if self.aio is None:
+            return None
+        tokens = []
+        for off, size in reqs:
+            pos = self._check(zone, off, size)
+            if zone == "grid":
+                self._drain_grid(pos, size)
+            token = self.aio.submit_read(pos, size)
+            self._read_pending.add(token)
+            tokens.append(token)
+        return tokens
+
+    def read_fetch(self, token, size: int) -> bytes:
+        self._read_pending.discard(token)
+        data = self.aio.fetch(token, size)
+        if len(data) < size:
+            data += b"\x00" * (size - len(data))
+        return data
 
     def sync(self) -> None:
         if self.aio is not None:
