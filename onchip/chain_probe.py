@@ -1,0 +1,151 @@
+"""Whole-program window-chain probe on the REAL kernel (round 4,
+VERDICT item 1's deliverable).
+
+Measures config2-shaped commit windows (stack x 8190-event prepares per
+window) three ways on the chip:
+
+  seq      W separate super dispatches (the round-3 regime)
+  chain    ONE compiled program: lax.scan over W windows, donated state
+  unroll   ONE compiled program: W windows unrolled straight-line
+
+If chain/unroll amortize (per PERF.md's whole-program model), the
+transfers/s at W windows per dispatch should approach W x the
+sequential rate; if the tunnel op-streams inside a single jit, they
+won't. Writes onchip/chain_probe_result.json either way: the artifact
+that validates or falsifies the 4-16M whole-program claim for this
+environment.
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from tigerbeetle_tpu.benchmark import N, _make_ledger, _soa
+from tigerbeetle_tpu.ops import fast_kernels as fk
+from tigerbeetle_tpu.ops.ledger import stack_superbatch
+
+STACK = 32
+AC = 10_000
+
+
+def mk_windows(n_windows, bi0=0):
+    rng = np.random.default_rng(2)
+    windows = []
+    bi = bi0
+    for _ in range(n_windows):
+        evs, tss = [], []
+        for _ in range(STACK):
+            base = 10 ** 7 + bi * N
+            ids = np.arange(base, base + N)
+            dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+            cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+            clash = dr == cr
+            cr[clash] = dr[clash] % AC + 1
+            evs.append(_soa(ids, dr, cr, rng.integers(1, 10 ** 6, N)))
+            tss.append(10 ** 13 + bi * (N + 10))
+            bi += 1
+        ev_s, seg = stack_superbatch(evs, tss)
+        windows.append((ev_s, seg))
+    return windows, bi
+
+
+def stack_windows(windows):
+    ev_stack = {k: jax.device_put(
+        np.stack([np.asarray(w[0][k]) for w in windows]))
+        for k in windows[0][0]}
+    seg_stack = {k: jax.device_put(
+        np.stack([np.asarray(w[1][k]) for w in windows]))
+        for k in windows[0][1]}
+    return ev_stack, seg_stack
+
+
+def run_seq(state, windows):
+    poisoned = jax.device_put(np.bool_(False))
+    t0 = time.perf_counter()
+    for ev_s, seg in windows:
+        ev_d = {k: jax.device_put(v) for k, v in ev_s.items()}
+        seg_d = {k: jax.device_put(v) for k, v in seg.items()}
+        state, out = fk.create_transfers_super_jit(
+            state, ev_d, seg_d, poisoned)
+        poisoned = out["fallback"]
+    jax.block_until_ready(poisoned)
+    dt = time.perf_counter() - t0
+    assert not bool(jax.device_get(poisoned))
+    return state, dt
+
+
+def run_chain(state, windows, fn):
+    ev_stack, seg_stack = stack_windows(windows)
+    t0 = time.perf_counter()
+    state, outs = fn(state, ev_stack, seg_stack)
+    jax.block_until_ready(outs["fallback"])
+    dt = time.perf_counter() - t0
+    assert not bool(jax.device_get(outs["fallback"]).any())
+    return state, dt
+
+
+def main():
+    res = {"platform": jax.devices()[0].platform, "stack": STACK,
+           "n_per_batch": N}
+    evs_per_window = STACK * N
+    bi = 0
+
+    led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+    # Warm compiles: one window of each form.
+    warm, bi = mk_windows(1, bi)
+    led.state, _ = run_seq(led.state, warm)
+    for fname, fn in (("chain", fk.create_transfers_chain_jit),
+                      ("unroll", fk.create_transfers_chain_unrolled_jit)):
+        for W in (2, 4, 8):
+            if fname == "unroll" and W > 4:
+                continue  # compile cost grows with W; 4 settles the question
+            key = f"{fname}_w{W}"
+            try:
+                warmw, bi = mk_windows(W, bi)
+                t_c0 = time.perf_counter()
+                led.state, _ = run_chain(led.state, warmw, fn)
+                res[key + "_compile_s"] = round(
+                    time.perf_counter() - t_c0, 1)
+                runs = []
+                for _ in range(2):
+                    ws, bi = mk_windows(W, bi)
+                    led.state, dt = run_chain(led.state, ws, fn)
+                    runs.append(dt)
+                best = min(runs)
+                res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
+                res[key + "_tps"] = round(W * evs_per_window / best, 1)
+            except Exception as e:  # noqa: BLE001 — probe records failures
+                res[key + "_error"] = repr(e)[:300]
+    # Sequential baseline, same session.
+    runs = []
+    for _ in range(3):
+        ws, bi = mk_windows(1, bi)
+        led.state, dt = run_seq(led.state, ws)
+        runs.append(dt)
+    res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
+    res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
+
+    best_tps = max([v for k, v in res.items()
+                    if k.endswith("_tps")] or [0])
+    res["verdict"] = (
+        "WHOLE-PROGRAM AMORTIZES on the real kernel"
+        if best_tps > 1.5 * res["seq_w1_tps"] else
+        "whole-program chain does NOT beat sequential dispatch here")
+    res["best_tps"] = best_tps
+    print(json.dumps(res, indent=1))
+    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "chain_probe_result.json")
+    json.dump(res, open(out, "w"), indent=2)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""Whole-program window chain vs sequential dispatches — bit-exact.
+
+The chain executes W commit windows inside ONE compiled program (scan
+or unrolled form, ops/fast_kernels.py _create_transfers_chain*); its
+statuses, timestamps, created counts, and final ledger state must equal
+W sequential superbatch dispatches, and a mid-chain fallback must
+poison every later window on device (state untouched) exactly like the
+host pipeline's chained force_fallback.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tigerbeetle_tpu.benchmark import _soa
+from tigerbeetle_tpu.ops import fast_kernels as fk
+from tigerbeetle_tpu.ops.ledger import DeviceLedger, stack_superbatch
+from tigerbeetle_tpu.types import Account, AccountFlags
+
+N = 256
+STACK = 2
+W = 3
+
+
+def _mk_windows(seed=5, poison_window=None):
+    rng = np.random.default_rng(seed)
+    nid = 10 ** 6
+    ts = 10 ** 12
+    windows = []
+    for w in range(W):
+        evs, tss = [], []
+        for _ in range(STACK):
+            dr = rng.integers(1, 33, N, dtype=np.uint64)
+            cr = rng.integers(1, 33, N, dtype=np.uint64)
+            clash = dr == cr
+            cr[clash] = dr[clash] % 32 + 1
+            flags = np.zeros(N, dtype=np.uint32)
+            if poison_window == w:
+                # balancing_debit is a hard E1 fallback in the kernel.
+                flags[3] = np.uint32(
+                    int(AccountFlags.debits_must_not_exceed_credits))
+                flags[3] = np.uint32(1 << 5)  # balancing_debit
+            ev = _soa(np.arange(nid, nid + N), dr, cr,
+                      rng.integers(1, 1000, N), flags=flags)
+            nid += N
+            evs.append(ev)
+            tss.append(ts)
+            ts += N + 10
+        ev_s, seg = stack_superbatch(evs, tss)
+        windows.append((ev_s, seg))
+    return windows
+
+
+def _fresh_state():
+    led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 33)], 1000)
+    return led.state
+
+
+def _stack_windows(windows):
+    ev_stack = {k: np.stack([np.asarray(w[0][k]) for w in windows])
+                for k in windows[0][0]}
+    seg_stack = {k: np.stack([np.asarray(w[1][k]) for w in windows])
+                 for k in windows[0][1]}
+    return ev_stack, seg_stack
+
+
+def _sequential(windows):
+    state = _fresh_state()
+    poisoned = None
+    outs = []
+    for ev_s, seg in windows:
+        state, out = fk.create_transfers_super_jit(
+            state, {k: jax.device_put(v) for k, v in ev_s.items()},
+            {k: jax.device_put(v) for k, v in seg.items()}, poisoned)
+        poisoned = out["fallback"]
+        outs.append({k: np.asarray(out[k]) for k in
+                     ("r_status", "r_ts", "fallback", "created_count")})
+    return state, outs
+
+
+@pytest.mark.parametrize("form", ["scan", "unrolled"])
+@pytest.mark.parametrize("poison_window", [None, 1])
+def test_chain_matches_sequential(form, poison_window):
+    windows = _mk_windows(poison_window=poison_window)
+    want_state, want = _sequential(windows)
+
+    ev_stack, seg_stack = _stack_windows(windows)
+    chain = (fk.create_transfers_chain_jit if form == "scan"
+             else fk.create_transfers_chain_unrolled_jit)
+    got_state, outs = chain(_fresh_state(), ev_stack, seg_stack)
+
+    for w in range(W):
+        for key in ("r_status", "r_ts", "created_count", "fallback"):
+            np.testing.assert_array_equal(
+                np.asarray(outs[key])[w], want[w][key],
+                err_msg=f"window {w} {key} ({form})")
+    if poison_window is not None:
+        fbs = np.asarray(outs["fallback"])
+        assert not fbs[0] and fbs[1] and fbs[2]  # suffix poisoned
+    # Final ledger state identical (the poisoned windows left it alone).
+    for table in ("transfers", "accounts"):
+        for mat in ("u64",):
+            np.testing.assert_array_equal(
+                np.asarray(got_state[table][mat]),
+                np.asarray(want_state[table][mat]),
+                err_msg=f"{table}.{mat} diverged ({form})")
+    np.testing.assert_array_equal(
+        np.asarray(got_state["transfers"]["count"]),
+        np.asarray(want_state["transfers"]["count"]))

@@ -100,8 +100,9 @@ class Grid:
         # keeps computing (reference: all reads are issued concurrently
         # through io_uring and the event loop continues,
         # src/storage.zig:177 + src/io/linux.zig).
-        self._inflight: dict[int, tuple] = {}  # key -> (token, size, gen)
-        self._prefetch_gen = 0
+        self._inflight: dict[int, tuple] = {}  # key -> (token, size);
+        # dict insertion order IS submission order (oldest first).
+        self._discard_pending: list[tuple] = []  # evicted, not yet freed
         self.prefetch_inflight_max = 256
         self.prefetched = 0  # blocks submitted, lifetime
         self.prefetch_hits = 0  # reads served from a VALIDATED read-ahead
@@ -232,26 +233,37 @@ class Grid:
                          for _, a, s in wanted])
         if tokens is None:
             return 0
-        self._prefetch_gen += 1
         for (key, _, size), token in zip(wanted, tokens):
-            self._inflight[key] = (token, size, self._prefetch_gen)
+            self._inflight[key] = (token, size)
         self.prefetched += len(wanted)
         return len(wanted)
 
     def _evict_inflight(self, count: int) -> None:
-        oldest = sorted(self._inflight.items(),
-                        key=lambda kv: kv[1][2])[:count]
-        for key, (token, sz, _gen) in oldest:
-            del self._inflight[key]
-            self._discard_token(token, sz)
-            self.prefetch_evicted += 1
+        """Drop the OLDEST in-flight entries (dict order = submission
+        order). Their engine records are freed LATER, at the next
+        collect (which already blocks on a fetch by nature) — the
+        submit path stays fire-and-continue even when an evicted
+        entry's IO hasn't completed yet."""
+        import itertools
 
-    def _discard_token(self, token, sz: int) -> None:
-        """Free an engine completion record we will never use."""
-        try:
-            self.device.read_fetch(token, sz)
-        except OSError:
-            pass
+        for key in list(itertools.islice(self._inflight, count)):
+            self._discard_pending.append(self._inflight.pop(key))
+            self.prefetch_evicted += 1
+        # Backstop: if collects never run (all read-ahead went dead),
+        # don't let deferred discards pin unbounded engine records.
+        if len(self._discard_pending) >= self.prefetch_inflight_max:
+            self._drain_discards()
+
+    def _drain_discards(self) -> None:
+        """Free engine records of evicted entries. Called right after a
+        blocking collect: by then the (older) evicted reads have almost
+        always completed, so the fetch-and-drop rarely waits."""
+        while self._discard_pending:
+            token, sz = self._discard_pending.pop()
+            try:
+                self.device.read_fetch(token, sz)
+            except OSError:
+                pass
 
     def _take_inflight(self, key: int, address: BlockAddress, size: int):
         """Collect a completed, CHECKSUM-VALIDATED read-ahead for `key`,
@@ -262,14 +274,17 @@ class Grid:
         entry = self._inflight.pop(key, None)
         if entry is None:
             return None
-        token, sz, _gen = entry
+        token, sz = entry
         if sz != size:
-            self._discard_token(token, sz)
+            self._discard_pending.append((token, sz))
+            self._drain_discards()
             return None
         try:
             data = self.device.read_fetch(token, sz)
         except OSError:
             return None
+        finally:
+            self._drain_discards()
         if len(data) != size or \
                 checksum(data, domain=b"blk") != address.checksum:
             return None

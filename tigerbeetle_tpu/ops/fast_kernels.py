@@ -1360,7 +1360,7 @@ def _create_transfers_super_deep(state, ev, seg, force_fallback=None):
     return create_transfers_fast(
         state, ev, jnp.uint64(0), jnp.int32(0),
         force_fallback=force_fallback, seg=seg,
-        limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP)
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP)
 
 
 def _create_transfers_super_ring(state, ev, seg, force_fallback=None):
@@ -1373,7 +1373,7 @@ def _create_transfers_super_deep_ring(state, ev, seg, force_fallback=None):
     return create_transfers_fast(
         state, ev, jnp.uint64(0), jnp.int32(0),
         force_fallback=force_fallback, seg=seg,
-        limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP, ring_reset=True)
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP, ring_reset=True)
 
 
 # Pipelined-serving variants: the event ring resets per window (see
@@ -1389,6 +1389,16 @@ create_transfers_super_deep_ring_jit = jax.jit(
 # (pend in prepare i, post/void in prepare j>i — the config4 shape).
 # Resolves both natively: the K-round fixpoint now also propagates
 # definition deaths to their dependent uses.
+#
+# Window round budget: 24 (measured: the config4 window workload at
+# bench scale — 8 x 8190-event prepares, 64 limited accounts —
+# converges at 24 rounds with the same-round death fold, 6/6 windows;
+# scratch/fixpoint_benchscale_probe.py). An unconverged window falls
+# back to the per-batch ladder whose own deep tier keeps the full 32
+# rounds (single batches cascade shallower than windows), so the cut
+# is pure throughput: 25% less round mass on the config4-dominant
+# kernel with an on-device escape hatch.
+LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP = 24
 create_transfers_super_deep_jit = jax.jit(
     _create_transfers_super_deep, donate_argnums=0)
 
@@ -1419,6 +1429,73 @@ create_transfers_fixpoint_deep_jit = jax.jit(
 # syncs (one fetch at the end). Module-level so its compile is absorbed by
 # the driver's warmup pass, not the timed region.
 _accum_jit = jax.jit(lambda acc, c: acc + c, donate_argnums=0)
+
+
+# ===================================== whole-program window chain (W>=2)
+
+def _create_transfers_chain(state, ev_stack, seg_stack,
+                            force_fallback=None):
+    """W commit windows chained entirely ON DEVICE in one compiled
+    program: a lax.scan whose carry is the donated ledger state plus the
+    rolling fallback scalar — window k's fallback poisons every later
+    window exactly like the host pipeline's chained force_fallback, so
+    commit order survives with ZERO host round-trips inside the chain.
+    Inputs arrive stacked on a leading W axis; results (r_status/r_ts/
+    created_count/fallback per window) come back stacked and are fetched
+    once after the whole chain.
+
+    This is the shape PERF.md's whole-program model prices at ~4-16M tps
+    on local silicon (the reference's analog: the prefetch/execute split
+    lets commits run back-to-back with no IO between them,
+    docs/ARCHITECTURE.md:424-434). Through the tunnel its value is
+    empirical — onchip/wholeprog_probe.py decides (scan-form vs
+    unrolled vs op-streamed)."""
+    def step(carry, x):
+        st, poisoned = carry
+        ev, seg = x
+        new_st, out = create_transfers_fast(
+            st, ev, jnp.uint64(0), jnp.int32(0),
+            force_fallback=poisoned, seg=seg)
+        keep = {k: out[k] for k in
+                ("r_status", "r_ts", "fallback", "created_count")}
+        return (new_st, out["fallback"]), keep
+
+    init = (state, jnp.bool_(False) if force_fallback is None
+            else force_fallback)
+    (st, _), outs = jax.lax.scan(step, init, (ev_stack, seg_stack))
+    return st, outs
+
+
+create_transfers_chain_jit = jax.jit(
+    _create_transfers_chain, donate_argnums=0)
+
+
+def _create_transfers_chain_unrolled(state, ev_stack, seg_stack,
+                                     force_fallback=None):
+    """The same W-window chain with the loop UNROLLED at trace time
+    (program op count ~ W x kernel): the fallback variant if the tunnel
+    op-streams scan bodies but amortizes straight-line programs
+    (wholeprog_probe's C-form)."""
+    W = ev_stack["id_lo"].shape[0]
+    poisoned = (jnp.bool_(False) if force_fallback is None
+                else force_fallback)
+    st = state
+    outs = []
+    for k in range(W):
+        ev = {key: v[k] for key, v in ev_stack.items()}
+        seg = {key: v[k] for key, v in seg_stack.items()}
+        st, out = create_transfers_fast(
+            st, ev, jnp.uint64(0), jnp.int32(0),
+            force_fallback=poisoned, seg=seg)
+        poisoned = out["fallback"]
+        outs.append({key: out[key] for key in
+                     ("r_status", "r_ts", "fallback", "created_count")})
+    stacked = {key: jnp.stack([o[key] for o in outs]) for key in outs[0]}
+    return st, stacked
+
+
+create_transfers_chain_unrolled_jit = jax.jit(
+    _create_transfers_chain_unrolled, donate_argnums=0)
 
 
 # ================================================== create_accounts (fast)

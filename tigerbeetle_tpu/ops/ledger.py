@@ -332,26 +332,22 @@ class _DeltaFetchHandle:
         return {k: out[k][o:o + n].copy() for k in _DER_KEYS}
 
 
-class _LazyCols:
-    """Named-column dict over a _DeltaFetchHandle slice, loaded on first
-    access. Supports exactly the mapping surface the drain, the lazy
-    mirror, and the durable column flusher use."""
+class _ColsView:
+    """Lazily-loaded named-column mapping. Subclasses implement _load();
+    this base supplies the ONE mapping surface the drain, the lazy
+    mirror, and the durable column flusher consume — add new consumer
+    methods here so every window type (device-fetched and
+    host-synthesized) gets them together."""
 
-    __slots__ = ("_handle", "_which", "_rel", "_n", "_d")
+    __slots__ = ("_d",)
 
-    def __init__(self, handle, which, rel, n):
-        self._handle = handle
-        self._which = which
-        self._rel = rel
-        self._n = n
-        self._d = None
+    def _load(self) -> dict:
+        raise NotImplementedError
 
     def load(self) -> dict:
         d = self._d
         if d is None:
-            d = self._d = self._handle.slice_cols(
-                self._which, self._rel, self._n)
-            self._handle = None
+            d = self._d = self._load()
         return d
 
     @property
@@ -378,6 +374,153 @@ class _LazyCols:
 
     def __len__(self):
         return len(self.load())
+
+
+class _LazyCols(_ColsView):
+    """Columns over a _DeltaFetchHandle slice (device-fetched)."""
+
+    __slots__ = ("_handle", "_which", "_rel", "_n")
+
+    def __init__(self, handle, which, rel, n):
+        self._handle = handle
+        self._which = which
+        self._rel = rel
+        self._n = n
+        self._d = None
+
+    def _load(self) -> dict:
+        d = self._handle.slice_cols(self._which, self._rel, self._n)
+        self._handle = None
+        return d
+
+
+def _ev_delta_gather_window(state, created, size_e):
+    """Half-width window delta gather: ONLY the event-ring slice (the
+    per-event balance snapshots — genuinely device-computed). For a
+    pv-free serving window the transfer rows and touched-account ids
+    are a pure function of the window's INPUT events + statuses + host-
+    assigned timestamps, so they are re-synthesized on host
+    (_synth_t_cols/_synth_der_cols) instead of crossing the link —
+    roughly half the drain bytes of the full gather."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    evr = state["events"]
+    e_len = ev_cap(evr) + 1
+    e_start = jnp.clip(evr["count"] - created, 0, e_len - size_e)
+    e = {k: lax.dynamic_slice_in_dim(v, e_start, size_e)
+         for k, v in evr.items() if k != "count"}
+    return dict(e=e)
+
+
+_ev_delta_gather_window_jit_cache = None
+
+
+def _ev_delta_gather_window_jit(state, created, size_e):
+    global _ev_delta_gather_window_jit_cache
+    if _ev_delta_gather_window_jit_cache is None:
+        import jax
+
+        _ev_delta_gather_window_jit_cache = jax.jit(
+            _ev_delta_gather_window, static_argnums=(2,))
+    return _ev_delta_gather_window_jit_cache(state, created, size_e)
+
+
+_F_PENDING_HOST = None
+_F_PV_HOST = None
+
+
+def _pending_flag() -> int:
+    global _F_PENDING_HOST
+    if _F_PENDING_HOST is None:
+        from ..types import TransferFlags
+
+        _F_PENDING_HOST = int(TransferFlags.pending)
+    return _F_PENDING_HOST
+
+
+def _F_POST_VOID_HOST() -> int:
+    global _F_PV_HOST
+    if _F_PV_HOST is None:
+        from ..types import TransferFlags
+
+        _F_PV_HOST = int(TransferFlags.post_pending_transfer
+                         | TransferFlags.void_pending_transfer)
+    return _F_PV_HOST
+
+
+def _synth_t_cols(ev: dict, st_np, ts_b: int) -> dict:
+    """Reconstruct the created transfer rows' xf_named columns from the
+    batch INPUT (pv-free batches only: amounts are literal, nothing
+    inherits from a pending). Must agree bit-for-bit with the device
+    row writer (fast_kernels application stage; expires formula
+    fast_kernels.py `ap_pending & timeout != 0` -> f_ts + timeout_ns)."""
+    from ..constants import NS_PER_S
+    from ..types import CreateTransferStatus, TransferPendingStatus
+
+    created_code = np.uint32(int(CreateTransferStatus.created))
+    n_b = len(st_np)
+    idx = np.nonzero(np.asarray(st_np) == created_code)[0]
+
+    def col(name):
+        return np.asarray(ev[name])[idx]
+
+    ts_event = (np.uint64(ts_b) - np.uint64(n_b)
+                + idx.astype(np.uint64) + np.uint64(1))
+    flags = col("flags")
+    pending = (flags & np.uint32(_pending_flag())) != 0
+    timeout = col("timeout")
+    expires = np.where(
+        pending & (timeout != 0),
+        ts_event + timeout.astype(np.uint64) * np.uint64(NS_PER_S),
+        np.uint64(0))
+    cols = {n: col(n) for n in
+            ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
+             "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi",
+             "ud128_lo", "ud64", "ud32", "timeout", "ledger", "code",
+             "flags")}
+    cols["ts"] = ts_event
+    cols["expires"] = expires
+    cols["pstat"] = np.where(
+        pending, np.int32(int(TransferPendingStatus.pending)),
+        np.int32(int(TransferPendingStatus.none)))
+    zrow = np.zeros(len(idx), np.int32)  # device-internal row indices
+    cols["dr_row"] = zrow
+    cols["cr_row"] = zrow
+    return cols
+
+
+def _synth_der_cols(ev: dict, st_np) -> dict:
+    """Derived columns for a pv-free batch: the touched-account ids ARE
+    the input's debit/credit ids; p_ts is unused (no posts/voids)."""
+    from ..types import CreateTransferStatus
+
+    created_code = np.uint32(int(CreateTransferStatus.created))
+    idx = np.nonzero(np.asarray(st_np) == created_code)[0]
+    return {
+        "dr_id_hi": np.asarray(ev["dr_hi"])[idx],
+        "dr_id_lo": np.asarray(ev["dr_lo"])[idx],
+        "cr_id_hi": np.asarray(ev["cr_hi"])[idx],
+        "cr_id_lo": np.asarray(ev["cr_lo"])[idx],
+        "p_ts": np.zeros(len(idx), np.uint64),
+    }
+
+
+class _SynthCols(_ColsView):
+    """Host-synthesized named columns — same surface as _LazyCols with
+    no device buffer behind it (see _ColsView)."""
+
+    __slots__ = ("_builder", "_args")
+
+    def __init__(self, builder, *args):
+        self._builder = builder
+        self._args = args
+        self._d = None
+
+    def _load(self) -> dict:
+        d = self._builder(*self._args)
+        self._builder = self._args = None
+        return d
 
 
 def _xfer_delta_gather_window(state, created, size_t, size_e):
@@ -505,10 +648,10 @@ class WindowTicket:
     poisoned windows left the device state untouched)."""
 
     __slots__ = ("evs", "tss", "ns", "n_pad", "out", "gather_dev",
-                 "size", "deep", "all_or_nothing", "results")
+                 "size", "deep", "all_or_nothing", "e_only", "results")
 
     def __init__(self, evs, tss, ns, n_pad, out, gather_dev, size, deep,
-                 all_or_nothing):
+                 all_or_nothing, e_only=False):
         self.evs = evs
         self.tss = tss
         self.ns = ns
@@ -518,6 +661,9 @@ class WindowTicket:
         self.size = size
         self.deep = deep
         self.all_or_nothing = all_or_nothing
+        # Half-width capture: only the event-ring slice was gathered;
+        # transfer/der columns synthesize on host from the inputs.
+        self.e_only = e_only
         self.results = None  # set at resolve
 
 
@@ -734,6 +880,7 @@ class DeviceLedger:
         self.state = new_state
         gather = None
         size_te = (0, 0)
+        e_only = False
         if self._wt:
             # Delta gather with DEVICE-computed slice starts: ordered
             # after the kernel on device, resolved at drain/flush.
@@ -742,10 +889,21 @@ class DeviceLedger:
                 if total_cap <= size:
                     break
             size_te = (min(size, t_len), min(size, e_len))
-            gather = _xfer_delta_gather_window_jit(
-                self.state, out["created_count"], *size_te)
+            # Pv-free windows fetch HALF the delta (event snapshots
+            # only): the transfer/der columns are host-reconstructible
+            # from the inputs — the drain moves ~half the bytes.
+            pv_bits = np.uint32(_F_POST_VOID_HOST())
+            e_only = all(
+                not (np.asarray(ev["flags"]) & pv_bits).any()
+                for ev in evs)
+            if e_only:
+                gather = _ev_delta_gather_window_jit(
+                    self.state, out["created_count"], size_te[1])
+            else:
+                gather = _xfer_delta_gather_window_jit(
+                    self.state, out["created_count"], *size_te)
         ticket = WindowTicket(evs, timestamps, ns, n_pad, out, gather,
-                              size_te, deep, False)
+                              size_te, deep, False, e_only=e_only)
         self._tickets.append(ticket)
         return ticket
 
@@ -829,11 +987,20 @@ class DeviceLedger:
                                        t0 - t_start, e0 - e_start,
                                        eager_copy=False)
         off = 0
-        for n_new, orphan_ids in per:
+        for b, (n_new, orphan_ids) in enumerate(per):
             if n_new:
-                tc = _LazyCols(handle, "t", off, n_new)
+                if tk.e_only:
+                    # Host-reconstructed transfer/der columns (the
+                    # window carried no post/void — rows are a pure
+                    # function of inputs + statuses + timestamps).
+                    tc = _SynthCols(_synth_t_cols, tk.evs[b],
+                                    st_slices[b], tk.tss[b])
+                    derc = _SynthCols(_synth_der_cols, tk.evs[b],
+                                      st_slices[b])
+                else:
+                    tc = _LazyCols(handle, "t", off, n_new)
+                    derc = _LazyCols(handle, "der", off, n_new)
                 ec = _LazyCols(handle, "e", off, n_new)
-                derc = _LazyCols(handle, "der", off, n_new)
                 self._track_pending_cols(tc, ec, derc)
                 self._mirror_chunks.append(
                     (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
