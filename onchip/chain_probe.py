@@ -97,54 +97,72 @@ def main():
     res = {"platform": jax.devices()[0].platform, "stack": STACK,
            "n_per_batch": N}
     evs_per_window = STACK * N
-    bi = 0
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "chain_probe_result.json")
+    try:
+        bi = 0
+        # Fresh ledger per measured run: W=8 appends 2.1M rows per run,
+        # so a shared ledger would fill its transfer store mid-probe and
+        # every later dispatch would hard-fallback (capacity, not the
+        # kernel, would be measured). id streams never repeat across
+        # ledgers (bi keeps advancing), so dup checks stay cold.
+        for fname, fn in (
+                ("chain", fk.create_transfers_chain_jit),
+                ("unroll", fk.create_transfers_chain_unrolled_jit)):
+            for W in (2, 4, 8):
+                if fname == "unroll" and W > 4:
+                    continue  # compile grows with W; 4 settles it
+                key = f"{fname}_w{W}"
+                try:
+                    led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+                    warmw, bi = mk_windows(W, bi)
+                    t_c0 = time.perf_counter()
+                    led.state, _ = run_chain(led.state, warmw, fn)
+                    res[key + "_compile_s"] = round(
+                        time.perf_counter() - t_c0, 1)
+                    runs = []
+                    for _ in range(2):
+                        led = _make_ledger(AC, a_cap=1 << 15,
+                                           t_cap=1 << 22)
+                        ws, bi = mk_windows(W, bi)
+                        led.state, dt = run_chain(led.state, ws, fn)
+                        runs.append(dt)
+                    best = min(runs)
+                    res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
+                    res[key + "_tps"] = round(
+                        W * evs_per_window / best, 1)
+                except Exception as e:  # noqa: BLE001 — record, go on
+                    res[key + "_error"] = repr(e)[:300]
+        # Sequential baseline, same session.
+        try:
+            led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+            warm, bi = mk_windows(1, bi)
+            led.state, _ = run_seq(led.state, warm)
+            runs = []
+            for _ in range(3):
+                ws, bi = mk_windows(1, bi)
+                led.state, dt = run_seq(led.state, ws)
+                runs.append(dt)
+            res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
+            res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
+        except Exception as e:  # noqa: BLE001
+            res["seq_w1_error"] = repr(e)[:300]
 
-    led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
-    # Warm compiles: one window of each form.
-    warm, bi = mk_windows(1, bi)
-    led.state, _ = run_seq(led.state, warm)
-    for fname, fn in (("chain", fk.create_transfers_chain_jit),
-                      ("unroll", fk.create_transfers_chain_unrolled_jit)):
-        for W in (2, 4, 8):
-            if fname == "unroll" and W > 4:
-                continue  # compile cost grows with W; 4 settles the question
-            key = f"{fname}_w{W}"
-            try:
-                warmw, bi = mk_windows(W, bi)
-                t_c0 = time.perf_counter()
-                led.state, _ = run_chain(led.state, warmw, fn)
-                res[key + "_compile_s"] = round(
-                    time.perf_counter() - t_c0, 1)
-                runs = []
-                for _ in range(2):
-                    ws, bi = mk_windows(W, bi)
-                    led.state, dt = run_chain(led.state, ws, fn)
-                    runs.append(dt)
-                best = min(runs)
-                res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
-                res[key + "_tps"] = round(W * evs_per_window / best, 1)
-            except Exception as e:  # noqa: BLE001 — probe records failures
-                res[key + "_error"] = repr(e)[:300]
-    # Sequential baseline, same session.
-    runs = []
-    for _ in range(3):
-        ws, bi = mk_windows(1, bi)
-        led.state, dt = run_seq(led.state, ws)
-        runs.append(dt)
-    res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
-    res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
-
-    best_tps = max([v for k, v in res.items()
-                    if k.endswith("_tps")] or [0])
-    res["verdict"] = (
-        "WHOLE-PROGRAM AMORTIZES on the real kernel"
-        if best_tps > 1.5 * res["seq_w1_tps"] else
-        "whole-program chain does NOT beat sequential dispatch here")
-    res["best_tps"] = best_tps
-    print(json.dumps(res, indent=1))
-    out = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                       "chain_probe_result.json")
-    json.dump(res, open(out, "w"), indent=2)
+        chain_tps = max([v for k, v in res.items()
+                         if k.endswith("_tps")
+                         and not k.startswith("seq")] or [0])
+        seq = res.get("seq_w1_tps", 0)
+        res["verdict"] = (
+            "WHOLE-PROGRAM AMORTIZES on the real kernel"
+            if seq and chain_tps > 1.5 * seq else
+            "whole-program chain does NOT beat sequential dispatch here")
+        res["best_chain_tps"] = chain_tps
+    finally:
+        # The artifact lands no matter how the measurement dies
+        # (docstring contract: "writes chain_probe_result.json either
+        # way").
+        print(json.dumps(res, indent=1))
+        json.dump(res, open(out_path, "w"), indent=2)
 
 
 if __name__ == "__main__":

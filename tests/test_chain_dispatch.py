@@ -16,7 +16,7 @@ import jax
 from tigerbeetle_tpu.benchmark import _soa
 from tigerbeetle_tpu.ops import fast_kernels as fk
 from tigerbeetle_tpu.ops.ledger import DeviceLedger, stack_superbatch
-from tigerbeetle_tpu.types import Account, AccountFlags
+from tigerbeetle_tpu.types import Account, TransferFlags
 
 N = 256
 STACK = 2
@@ -37,10 +37,8 @@ def _mk_windows(seed=5, poison_window=None):
             cr[clash] = dr[clash] % 32 + 1
             flags = np.zeros(N, dtype=np.uint32)
             if poison_window == w:
-                # balancing_debit is a hard E1 fallback in the kernel.
-                flags[3] = np.uint32(
-                    int(AccountFlags.debits_must_not_exceed_credits))
-                flags[3] = np.uint32(1 << 5)  # balancing_debit
+                # balancing_credit (1<<5) is a hard E1 fallback.
+                flags[3] = np.uint32(int(TransferFlags.balancing_credit))
             ev = _soa(np.arange(nid, nid + N), dr, cr,
                       rng.integers(1, 1000, N), flags=flags)
             nid += N

@@ -259,7 +259,11 @@ class Grid:
         blocking collect: by then the (older) evicted reads have almost
         always completed, so the fetch-and-drop rarely waits."""
         while self._discard_pending:
-            token, sz = self._discard_pending.pop()
+            # FIFO: the oldest eviction was submitted earliest and is
+            # the most likely to have completed — freeing it first
+            # keeps this drain (on the collect path) from waiting on
+            # the freshest in-flight read.
+            token, sz = self._discard_pending.pop(0)
             try:
                 self.device.read_fetch(token, sz)
             except OSError:

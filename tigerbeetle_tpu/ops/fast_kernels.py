@@ -763,8 +763,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             [dr_found, cr_found, p_found, p_found])
 
     # ---------------- eligibility ----------------
+    # Scalar-reduction fusion (dispatch-count discipline): e1/e5 and the
+    # eight overflow lanes are all length-N bools whose ONLY consumer is
+    # the combined `others` OR — they reduce in ONE stacked any below
+    # (hard_vecs) instead of three separate reduces.
     hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
-    e1 = jnp.any(valid & _flag(flags, jnp.uint32(hard_flags)))
+    e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
 
     # Eligibility sums below run over the OPTIMISTIC apply set: events
     # whose per-event status is already a failure can never apply (the
@@ -856,14 +860,16 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             pair_his.append(jnp.where(opt, h, zeros))
             pair_los.append(jnp.where(opt, l, zeros))
             pair_ovfs.append(opt & o)
-    # One stacked any over all eight overflow lanes (was eight reduces).
-    pair_ovf = jnp.any(jnp.stack(pair_ovfs))
     m_hi, m_lo = _u128_max_reduce(pair_his, pair_los)
     _, _, ovf = u128.add(m_hi, m_lo, s_hi, s_lo)
-    e4 = ovf | (s4 > 0) | pair_ovf
-
-    e5 = jnp.any(valid & is_void & p_found
-                 & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
+    e5_vec = (valid & is_void & p_found
+              & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
+    # ONE reduction for every N-length hard-fallback vector: e1 (hard
+    # flags), the eight pair-overflow lanes, and e5 (void of a closing
+    # pending) — their only consumer is the combined OR. The scalar
+    # overflow terms (ovf, s4) join at the OR itself.
+    hard_any = jnp.any(jnp.stack([e1_vec, e5_vec, *pair_ovfs]))
+    e145 = hard_any | ovf | (s4 > 0)
 
     if limit_rounds > 1:
         # ---- order-dependent balance limits: K-round status fixpoint ----
@@ -1074,7 +1080,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # Plain tier: e2 is the COMBINED collision check — it may be an
         # in-batch pending reference the fixpoint tier can resolve, so
         # it escalates instead of hard-falling-back.
-        others = e1 | e4 | e5 | e7 | e8 | ~ins_ok
+        others = e145 | e7 | e8 | ~ins_ok
         escalatable = e3 | e2
     else:
         # Fixpoint tiers: e2 is precise same-kind duplicates (real
@@ -1082,7 +1088,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # were computed without the batch-global join, so its combined
         # e2 stays a HARD fallback too (escalating it would loop — the
         # sharded driver has no fixpoint tier to redispatch to).
-        others = e1 | e2 | e4 | e5 | e7 | e8 | ~ins_ok
+        others = e145 | e2 | e7 | e8 | ~ins_ok
         escalatable = e3
     if force_fallback is not None:
         others = others | force_fallback

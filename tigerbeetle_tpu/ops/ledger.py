@@ -1843,10 +1843,14 @@ class DeviceLedger:
         # Stream ALL pending device->host transfers up front: each
         # chunk's registration then overlaps the next chunk's bytes in
         # flight instead of ping-ponging transfer/compute per chunk.
-        for t, _e, _d, _t0, n_new, _o in chunks:
-            if n_new and isinstance(t, _LazyCols) and not t.loaded \
-                    and t._handle is not None:
-                t._handle.start_copy()
+        # Check every column view (e_only chunks synthesize t/der on
+        # host — their DEVICE bytes live behind the event-ring ec).
+        for cols in chunks:
+            for c in cols[:3]:
+                if cols[4] and isinstance(c, _LazyCols) and \
+                        not c.loaded and c._handle is not None:
+                    c._handle.start_copy()
+                    break
         for t, e, der, t0, n_new, orphan_ids in chunks:
             for oid in orphan_ids:
                 self.mirror.orphaned.add(oid)
