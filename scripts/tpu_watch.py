@@ -108,13 +108,20 @@ PROBES = (
 )
 
 
+_probes_completed: set = set()
+
+
 def run_probes_once() -> bool:
-    """Run the staged probes in order; returns True when ALL completed.
-    A timeout or failure aborts the chain (it is strong evidence the
-    window closed — the next open window retries). An artifact commits
-    only if it was (re)written after the probe started AND parses as
-    JSON — a SIGKILL mid-write must not bank a truncated verdict."""
+    """Run the staged probes in order, skipping ones already banked;
+    returns True when ALL completed. A timeout or failure aborts the
+    chain (it is strong evidence the window closed — the next open
+    window retries the REMAINING probes only). An artifact commits only
+    if it was (re)written after the probe started (with 2 s of mtime
+    slack for coarse filesystems) AND parses as JSON — a SIGKILL
+    mid-write must not bank a truncated verdict."""
     for script, timeout_s, artifact in PROBES:
+        if script in _probes_completed:
+            continue
         print(f"[{time.strftime('%H:%M:%S')}] probe {script}", flush=True)
         t0 = time.time()
         try:
@@ -130,7 +137,8 @@ def run_probes_once() -> bool:
             return False
         print(p.stdout[-1200:], flush=True)
         art = os.path.join(REPO, artifact)
-        fresh = os.path.exists(art) and os.path.getmtime(art) >= t0
+        fresh = os.path.exists(art) and \
+            os.path.getmtime(art) >= t0 - 2.0
         valid = False
         if fresh:
             try:
@@ -150,21 +158,32 @@ def run_probes_once() -> bool:
         if not valid:
             print(f"probe wrote no fresh/valid {artifact}", flush=True)
             return False
+        _probes_completed.add(script)
     return True
+
+
+PROBE_ATTEMPTS_MAX = 3
 
 
 def main() -> None:
     quick_done = False
     probes_done = False
+    probe_attempts = 0
     while True:
         if probe():
             print(f"[{time.strftime('%H:%M:%S')}] window open", flush=True)
-            if not probes_done:
+            if not probes_done and probe_attempts < PROBE_ATTEMPTS_MAX:
                 # The verdict probes are the scarcest artifacts: run
                 # them FIRST, cheapest first, before betting the window
-                # on a 20-40 min full bench.
+                # on a 20-40 min full bench. A persistently failing
+                # probe must not starve the bench forever — after
+                # PROBE_ATTEMPTS_MAX window-opens the watcher falls
+                # through to capturing ("no result can ever again exist
+                # only in prose" outranks the probes).
+                probe_attempts += 1
                 probes_done = run_probes_once()
-                if not probes_done:
+                if not probes_done and \
+                        probe_attempts < PROBE_ATTEMPTS_MAX:
                     time.sleep(PROBE_PERIOD_S)
                     continue
             result = capture(quick=not quick_done)
