@@ -1,23 +1,39 @@
-"""devhub: benchmark history + dashboard.
+"""devhub: benchmark history + regression detection + dashboard.
 
 reference: src/devhub/ + src/scripts/devhub.zig — nightly metrics
-(benchmark tx/s, latency, sizes) recorded to a database and rendered on a
-dashboard. Here: bench JSON lines append to a JSONL history, and `render`
-emits a self-contained HTML dashboard with inline SVG sparklines (no
-external assets, mirroring the reference's static devhub page).
+(benchmark tx/s, latency, sizes) recorded to a database and rendered on
+a dashboard; the CFO fleet pushes failing fuzz seeds to the same place
+(src/scripts/cfo.zig:1-41). Here: bench JSON lines append to a JSONL
+history; `regressions` flags metrics that dropped against their
+trailing median (the reference's nightly-regression purpose); `render`
+emits a self-contained HTML dashboard — metric sparklines, regression
+badges, parity series, and the latest CFO sweep's failing seeds with
+their reproduction commands (no external assets, mirroring the
+reference's static devhub page).
 """
 
 from __future__ import annotations
 
+import glob
 import html
 import json
+import os
 import time
 from typing import Optional
 
 NUMERIC_KEYS = (
     "value", "config1_2hot_tps", "config2_10k_tps", "config3_chains_tps",
-    "config4_twophase_limits_tps",
+    "config4_twophase_limits_tps", "config6_serving_tps",
 )
+
+# Nested metrics: (display key, path into the record).
+NESTED_KEYS = (
+    ("serving_sustained_tps", ("serving_batch_latency", "sustained_tps")),
+    ("serving_p99_ms", ("serving_batch_latency", "p99_ms")),
+)
+
+REGRESSION_WINDOW = 8  # trailing runs forming the baseline median
+REGRESSION_TOLERANCE = 0.10  # flag drops beyond 10% of the median
 
 
 def record(history_path: str, bench_json: dict,
@@ -44,6 +60,74 @@ def load(history_path: str) -> list[dict]:
     return out
 
 
+def _series(entries: list[dict], key: str) -> list:
+    for display, path in NESTED_KEYS:
+        if key == display:
+            out = []
+            for e in entries:
+                v = e
+                for part in path:
+                    v = v.get(part) if isinstance(v, dict) else None
+                out.append(v)
+            return out
+    return [e.get(key) for e in entries]
+
+
+def _median(values: list[float]) -> Optional[float]:
+    vals = sorted(v for v in values if v is not None)
+    if not vals:
+        return None
+    mid = len(vals) // 2
+    if len(vals) % 2:
+        return vals[mid]
+    return (vals[mid - 1] + vals[mid]) / 2
+
+
+# Metrics where a regression is an INCREASE (latency); everything else
+# regresses by dropping (throughput).
+_HIGHER_IS_WORSE = frozenset({"serving_p99_ms"})
+
+
+def regressions(entries: list[dict]) -> dict:
+    """metric -> {latest, baseline, ratio} for metrics whose newest
+    value moved more than REGRESSION_TOLERANCE past the median of the
+    preceding REGRESSION_WINDOW runs, in that metric's bad direction
+    (reference: the devhub dashboard exists to catch exactly these
+    overnight)."""
+    out = {}
+    keys = NUMERIC_KEYS + tuple(d for d, _ in NESTED_KEYS)
+    for key in keys:
+        series = [v for v in _series(entries, key) if v is not None]
+        if len(series) < 2:
+            continue
+        latest = series[-1]
+        baseline = _median(series[-1 - REGRESSION_WINDOW:-1])
+        if not baseline:
+            continue
+        if key in _HIGHER_IS_WORSE:
+            bad = latest > baseline * (1 + REGRESSION_TOLERANCE)
+        else:
+            bad = latest < baseline * (1 - REGRESSION_TOLERANCE)
+        if bad:
+            out[key] = {"latest": latest, "baseline": baseline,
+                        "ratio": round(latest / baseline, 3)}
+    return out
+
+
+def load_cfo(cfo_dir: str) -> Optional[dict]:
+    """Newest CFO sweep artifact (cfo/CFO_*.json), or None."""
+    paths = sorted(glob.glob(os.path.join(cfo_dir, "CFO_*.json")))
+    if not paths:
+        return None
+    try:
+        with open(paths[-1]) as f:
+            d = json.load(f)
+        d["_path"] = paths[-1]
+        return d
+    except (OSError, ValueError):
+        return None
+
+
 def _sparkline(values: list[float], width: int = 320, height: int = 48) -> str:
     values = [v for v in values if v is not None]
     if not values:
@@ -59,18 +143,64 @@ def _sparkline(values: list[float], width: int = 320, height: int = 48) -> str:
             f'points="{points}"/></svg>')
 
 
-def render(history_path: str, out_path: str) -> int:
-    """Render the dashboard; returns the number of history entries."""
-    entries = load(history_path)
+def render(history_path: str, out_path: str,
+           cfo_dir: Optional[str] = None,
+           entries: Optional[list] = None,
+           regress: Optional[dict] = None) -> int:
+    """Render the dashboard; returns the number of history entries.
+    `entries`/`regress` let a caller that already loaded the history
+    (cmd_devhub's gate) avoid parsing and scanning it twice."""
+    if entries is None:
+        entries = load(history_path)
+    if regress is None:
+        regress = regressions(entries)
     rows = []
-    for key in NUMERIC_KEYS:
-        series = [e.get(key) for e in entries]
+    for key in NUMERIC_KEYS + tuple(d for d, _ in NESTED_KEYS):
+        series = _series(entries, key)
         latest = next((v for v in reversed(series) if v is not None), None)
+        flag = ""
+        if key in regress:
+            r = regress[key]
+            flag = (f'<span style="color:#c22;font-weight:600">'
+                    f'REGRESSED {r["ratio"]:.2f}x of median '
+                    f'{r["baseline"]:,.0f}</span>')
         rows.append(
-            "<tr><td>{}</td><td>{}</td><td>{}</td></tr>".format(
+            "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td></tr>".format(
                 html.escape(key),
                 "-" if latest is None else f"{latest:,.0f}",
-                _sparkline(series)))
+                _sparkline(series), flag))
+    # Oracle-parity series: every recorded run must say True.
+    parity = [e.get("config5_oracle_parity") for e in entries
+              if e.get("config5_oracle_parity") is not None]
+    parity_html = (
+        f"<p>oracle parity: {sum(1 for p in parity if p)}/{len(parity)} "
+        f"runs clean"
+        + ("" if all(parity) else
+           ' — <b style="color:#c22">PARITY FAILURE RECORDED</b>')
+        + "</p>") if parity else ""
+    # CFO: the failing-seed feed (reference: cfo.zig pushes failing
+    # seeds to devhubdb; a green fleet is part of the dashboard).
+    cfo_html = ""
+    cfo = load_cfo(cfo_dir) if cfo_dir else None
+    if cfo:
+        failing = cfo.get("failing", [])
+        cfo_html = (
+            f"<h2>continuous fuzzing</h2>"
+            f"<p>{html.escape(os.path.basename(cfo.get('_path', '')))}: "
+            f"{html.escape(str(cfo.get('runs_clean', 0)))} clean, "
+            f"{html.escape(str(cfo.get('runs_failing', 0)))} failing "
+            f"({html.escape(str(cfo.get('elapsed_s', 0)))}s)</p>")
+        if failing:
+            items = "".join(
+                "<li><code>{}</code> seed {} — <code>{}</code></li>".format(
+                    html.escape(str(f.get("name"))),
+                    html.escape(str(f.get("seed"))),
+                    html.escape(str(f.get("reproduce", ""))))
+                for f in failing[:50])
+            cfo_html += f"<ol>{items}</ol>"
+    badge = ("" if not regress else
+             f'<p style="color:#c22;font-weight:700">'
+             f'{len(regress)} metric(s) regressed vs trailing median</p>')
     doc = f"""<!doctype html>
 <html><head><meta charset="utf-8"><title>tigerbeetle-tpu devhub</title>
 <style>
@@ -81,9 +211,12 @@ td {{ padding: .4rem 1rem; border-bottom: 1px solid #ddd; }}
 <h1>tigerbeetle-tpu devhub</h1>
 <p>{len(entries)} recorded runs; latest metric values with history
 sparklines (reference: devhub.tigerbeetle.com).</p>
-<table><tr><th>metric</th><th>latest</th><th>history</th></tr>
+{badge}{parity_html}
+<table><tr><th>metric</th><th>latest</th><th>history</th><th></th></tr>
 {''.join(rows)}
-</table></body></html>"""
+</table>
+{cfo_html}
+</body></html>"""
     with open(out_path, "w") as f:
         f.write(doc)
     return len(entries)

@@ -494,9 +494,17 @@ def cmd_devhub(args) -> int:
     if args.record:
         with open(args.record) as f:
             devhub.record(args.history, json.load(f))
-    n = devhub.render(args.history, args.out)
+    entries = devhub.load(args.history)
+    regress = devhub.regressions(entries)
+    n = devhub.render(args.history, args.out, cfo_dir=args.cfo_dir,
+                      entries=entries, regress=regress)
+    for key, r in regress.items():
+        print(f"devhub: REGRESSION {key}: {r['latest']:,.0f} is "
+              f"{r['ratio']:.2f}x of trailing median {r['baseline']:,.0f}")
     print(f"devhub: {n} runs -> {args.out}")
-    return 0
+    # Nonzero on regression so CI can gate on it (reference: the devhub
+    # run IS the nightly perf gate, src/scripts/devhub.zig:174-237).
+    return 2 if regress and args.strict else 0
 
 
 def cmd_cfo(args) -> int:
@@ -705,6 +713,11 @@ def main(argv=None) -> int:
                    help="bench JSON file to append to the history")
     p.add_argument("--history", default="devhub_history.jsonl")
     p.add_argument("--out", default="devhub.html")
+    p.add_argument("--cfo-dir", default="cfo",
+                   help="directory of CFO sweep artifacts to surface")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 2 when a metric regressed vs its trailing "
+                        "median (the nightly perf gate)")
     p.set_defaults(fn=cmd_devhub)
 
     p = sub.add_parser("cfo")
