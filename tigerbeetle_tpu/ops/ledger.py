@@ -401,16 +401,15 @@ def _ev_delta_gather_window(state, created, size_e):
     are a pure function of the window's INPUT events + statuses + host-
     assigned timestamps, so they are re-synthesized on host
     (_synth_t_cols/_synth_der_cols) instead of crossing the link —
-    roughly half the drain bytes of the full gather."""
+    roughly half the drain bytes of the full gather. Start is computed
+    ON DEVICE (count - created) so pipelined callers never sync; the
+    slice body is shared with the host-start variant."""
     import jax.numpy as jnp
-    from jax import lax
 
     evr = state["events"]
     e_len = ev_cap(evr) + 1
     e_start = jnp.clip(evr["count"] - created, 0, e_len - size_e)
-    e = {k: lax.dynamic_slice_in_dim(v, e_start, size_e)
-         for k, v in evr.items() if k != "count"}
-    return dict(e=e)
+    return _ev_delta_gather_host(state, e_start, size_e)
 
 
 _ev_delta_gather_window_jit_cache = None
@@ -424,6 +423,30 @@ def _ev_delta_gather_window_jit(state, created, size_e):
         _ev_delta_gather_window_jit_cache = jax.jit(
             _ev_delta_gather_window, static_argnums=(2,))
     return _ev_delta_gather_window_jit_cache(state, created, size_e)
+
+
+def _ev_delta_gather_host(state, e_start, size_e):
+    """Host-start variant of the event-only gather (the sync capture
+    path knows its slice start as a host int)."""
+    from jax import lax
+
+    evr = state["events"]
+    e = {k: lax.dynamic_slice_in_dim(v, e_start, size_e)
+         for k, v in evr.items() if k != "count"}
+    return dict(e=e)
+
+
+_ev_delta_gather_host_jit_cache = None
+
+
+def _ev_delta_gather_host_jit(state, e_start, size_e):
+    global _ev_delta_gather_host_jit_cache
+    if _ev_delta_gather_host_jit_cache is None:
+        import jax
+
+        _ev_delta_gather_host_jit_cache = jax.jit(
+            _ev_delta_gather_host, static_argnums=(2,))
+    return _ev_delta_gather_host_jit_cache(state, e_start, size_e)
 
 
 _F_PENDING_HOST = None
@@ -1097,6 +1120,7 @@ class DeviceLedger:
                 if self._wt:
                     self._capture_window_delta(
                         evs, [st for st, _ in results],
+                        timestamps=timestamps,
                         exact_chunks=all_or_nothing)
                 return results
             self.window_fallbacks += 1
@@ -1716,6 +1740,7 @@ class DeviceLedger:
                 c.load()
 
     def _capture_window_delta(self, evs: list, st_slices: list,
+                              timestamps: list = None,
                               exact_chunks: bool = False) -> None:
         """Window-level write-through capture: ONE bounded device fetch
         for a whole commit window's effects (the window kernel appends
@@ -1725,25 +1750,43 @@ class DeviceLedger:
         each a full device round-trip — with one (the dominant serving
         cost on chip once the kernel itself is windowed).
 
+        timestamps: per-batch commit timestamps. When given AND the
+        window carries no post/void, the fetch is HALF-WIDTH (event
+        ring only) and the transfer/der columns synthesize on host —
+        same contract as the pipelined e_only capture.
+
         exact_chunks: queue one flush chunk per sub-batch even when it
         is empty — the replica commit loop attributes chunks to
         prepares positionally (its per-op flush cadence is what keeps
         physical checkpoints byte-identical across replicas)."""
         per = [self._batch_delta_stats(ev, st_np)
                for ev, st_np in zip(evs, st_slices)]
+        pv_bits = np.uint32(_F_POST_VOID_HOST())
+        e_only = timestamps is not None and all(
+            not (np.asarray(ev["flags"]) & pv_bits).any() for ev in evs)
+
+        def fetch_start(total):
+            if e_only:
+                return self._ev_delta_fetch_start(total)
+            return self._delta_fetch_start(total)
 
         def flush_group(group):
-            total = sum(n for n, _ in group)
-            handle = self._delta_fetch_start(total) if total else None
+            total = sum(n for n, _, _, _ in group)
+            handle = fetch_start(total) if total else None
             off = 0
-            for n_new, orphan_ids in group:
+            for n_new, orphan_ids, ev_b, pack in group:
                 if n_new:
                     # Lazy column views: the fetch resolves (exact-size
                     # copies, full buffer released) on first access —
                     # at drain/flush, off the commit path.
-                    tc = _LazyCols(handle, "t", off, n_new)
+                    if e_only:
+                        st_b, ts_b = pack
+                        tc = _SynthCols(_synth_t_cols, ev_b, st_b, ts_b)
+                        derc = _SynthCols(_synth_der_cols, ev_b, st_b)
+                    else:
+                        tc = _LazyCols(handle, "t", off, n_new)
+                        derc = _LazyCols(handle, "der", off, n_new)
                     ec = _LazyCols(handle, "e", off, n_new)
-                    derc = _LazyCols(handle, "der", off, n_new)
                     self._track_pending_cols(tc, ec, derc)
                     self._mirror_chunks.append(
                         (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
@@ -1769,16 +1812,34 @@ class DeviceLedger:
         # static bucket); a serving window of 8 prepares fits in one.
         group: list = []
         group_new = 0
-        for n_new, orphan_ids in per:
+        for b, (n_new, orphan_ids) in enumerate(per):
             if group and group_new + n_new > 8 * N_PAD:
                 flush_group(group)
                 group, group_new = [], 0
-            group.append((n_new, orphan_ids))
+            pack = ((st_slices[b], timestamps[b])
+                    if timestamps is not None else None)
+            group.append((n_new, orphan_ids, evs[b], pack))
             group_new += n_new
         if group:
             flush_group(group)
         self._clear_dirty_dev()
         self._maybe_recycle_ring()
+
+    def _ev_delta_fetch_start(self, n_new: int) -> "_DeltaFetchHandle":
+        """Half-width sync fetch: event-ring slice only (see
+        _ev_delta_gather_window)."""
+        e0 = self._events_pushed
+        e_len = ev_cap(self.state["events"]) + 1
+        for size in (256, N_PAD, 8 * N_PAD):
+            if n_new <= size:
+                break
+        size_e = min(size, e_len)
+        assert n_new <= size_e
+        e_start = max(0, min(e0, e_len - size_e))
+        out = _ev_delta_gather_host_jit(self.state, np.int32(e_start),
+                                        size_e)
+        return _DeltaFetchHandle(out, self._xfer_rows_dev, 0,
+                                 e0 - e_start)
 
     @staticmethod
     def _batch_delta_stats(ev: dict, st_np):
