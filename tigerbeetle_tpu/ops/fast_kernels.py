@@ -115,6 +115,10 @@ def _cummin(x, axis=-1):
     return jax.lax.associative_scan(jnp.minimum, x, axis=axis % x.ndim)
 
 
+def _cummax(x, axis=-1):
+    return jax.lax.associative_scan(jnp.maximum, x, axis=axis % x.ndim)
+
+
 # ------------------------------------------------------------ limb helpers
 
 def _to_limbs(hi, lo):
@@ -405,11 +409,22 @@ def _xfer_gather_multi(xfr, rows_list):
 
 
 def per_event_status(state, ev, ts_event, return_gathers=False,
-                     inwin=None, didx=None):
+                     inwin=None, didx=None, imported_ctx=None):
     """The per-event phase of create_transfers: hash lookups, row gathers,
     and the order-independent status evaluation (exists/idempotency,
     post/void checks, regular checks, imported/timestamp rules — reference
     create_transfer :3719-3904 minus running-balance effects).
+
+    imported_ctx (imported-mode tiers only): {batch_imported (bool[N],
+    per sub-batch homogeneity reference), batch_ts (u64[N], the
+    sub-batch commit timestamp for must_not_advance), acct_ts_collision
+    (bool[N]), key_max (u64 scalar, the state's max transfer timestamp)}
+    — enables the real imported-event rules (reference :3052-3063 +
+    :3800-3833) instead of the default "imported unexpected" rejection.
+    The ORDER-DEPENDENT part of the regress rule (an imported timestamp
+    vs transfers created earlier in the same batch) is NOT handled here:
+    the caller runs the left-to-right maxima chain over these statuses
+    (see create_transfers_fast imported_mode).
 
     Pure per event given replicated state: this is the SHARDABLE stage of
     the SPMD kernel. parallel/full_sharded.py runs it on each device's
@@ -559,9 +574,26 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
         (p["pstat"] == _PS_VOIDED, _TS["pending_transfer_already_voided"]),
         (p["pstat"] == _PS_EXPIRED, _TS["pending_transfer_expired"]),
         (p_expires_due, _TS["pending_transfer_expired"]),
+    ]
+    imported = _flag(flags, _F_IMPORTED)
+    if imported_ctx is not None:
+        # Regress vs STATE (key_max + account-timestamp collision) at
+        # the reference's precedence position (create_transfer :4053
+        # path, mirrored by the sequential kernel's pv list); the
+        # in-batch component is the caller's maxima chain.
+        pv_regress = imported & (
+            (ev["ts"] <= imported_ctx["key_max"])
+            | imported_ctx["acct_ts_collision"])
+        pv_checks.append(
+            (pv_regress, _TS["imported_event_timestamp_must_not_regress"]))
+    # Post-regress tail: ALSO the source of the caller's precedence-
+    # override code set (after_regress_codes) — one literal list, so a
+    # future check added here is automatically override-eligible.
+    pv_tail = [
         (_flag(p_dr["flags"], _A_CLOSED) & ~is_void, _TS["debit_account_already_closed"]),
         (_flag(p_cr["flags"], _A_CLOSED) & ~is_void, _TS["credit_account_already_closed"]),
     ]
+    pv_checks += pv_tail
     pv_status = _first_failure(pv_checks)
     # The use's status when its in-window definition turns out dead
     # (failed creation): the pending transfer does not exist, so the
@@ -592,10 +624,34 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
         (~cr["exists"], _TS["credit_account_not_found"]),
         (dr["ledger"] != cr["ledger"], _TS["accounts_must_have_the_same_ledger"]),
         (ev["ledger"] != dr["ledger"], _TS["transfer_must_have_the_same_ledger_as_accounts"]),
+    ]
+    if imported_ctx is not None:
+        # Imported rules at the reference's precedence position
+        # (:3800-3833): regress vs state, postdate both accounts,
+        # timeout forbidden. In-batch regress = caller's maxima chain.
+        reg_regress = imported & (
+            (ev["ts"] <= imported_ctx["key_max"])
+            | imported_ctx["acct_ts_collision"])
+        reg_checks += [
+            (reg_regress, _TS["imported_event_timestamp_must_not_regress"]),
+        ]
+        reg_post_regress = [
+            (imported & (ev["ts"] <= dr["ts"]),
+             _TS["imported_event_timestamp_must_postdate_debit_account"]),
+            (imported & (ev["ts"] <= cr["ts"]),
+             _TS["imported_event_timestamp_must_postdate_credit_account"]),
+            (imported & (ev["timeout"] != 0),
+             _TS["imported_event_timeout_must_be_zero"]),
+        ]
+        reg_checks += reg_post_regress
+    else:
+        reg_post_regress = []
+    reg_tail = [
         (_flag(dr["flags"], _A_CLOSED), _TS["debit_account_already_closed"]),
         (_flag(cr["flags"], _A_CLOSED), _TS["credit_account_already_closed"]),
         (ovf_timeout, _TS["overflows_timeout"]),
     ]
+    reg_checks += reg_tail
     reg_status = _first_failure(reg_checks)
 
     inner = jnp.where(
@@ -609,13 +665,40 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
     ])
     inner = jnp.where(pre != _CREATED, pre, inner)
     ts_inner = jnp.where(e_found & (inner == _TS["exists"]), exists_ts, ts_event)
+    if imported_ctx is not None:
+        # A created imported event keeps its USER timestamp (the stored
+        # row, the result, and the history row all carry it —
+        # reference :3800-3833 timestamp_actual = t.timestamp).
+        ts_inner = jnp.where((inner == _CREATED) & imported,
+                             ev["ts"], ts_inner)
 
-    imported = _flag(flags, _F_IMPORTED)
     status = inner
-    status = jnp.where(~imported & (ev["ts"] != 0), _TS["timestamp_must_be_zero"], status)
-    # batch_imported batches fall back (E1), so an imported flag here is
-    # always a mismatch (reference execute_create :3052-3063).
-    status = jnp.where(imported, _TS["imported_event_not_expected"], status)
+    if imported_ctx is None:
+        status = jnp.where(~imported & (ev["ts"] != 0),
+                           _TS["timestamp_must_be_zero"], status)
+        # Without the context, imported batches fall back (E1) before
+        # these statuses can matter; an imported flag here is always a
+        # mismatch (reference execute_create :3052-3063).
+        status = jnp.where(imported, _TS["imported_event_not_expected"],
+                           status)
+    else:
+        # The real wrapper rules (reference :3033-3104 mirrored by the
+        # sequential kernel): per-sub-batch homogeneity, timestamp
+        # range, must-not-advance vs the sub-batch commit timestamp.
+        batch_imported = imported_ctx["batch_imported"]
+        ts_valid = (ev["ts"] >= 1) & (ev["ts"] <= _U63)
+        status = jnp.where(~imported & (ev["ts"] != 0),
+                           _TS["timestamp_must_be_zero"], status)
+        status = jnp.where(
+            imported & ts_valid & (ev["ts"] >= imported_ctx["batch_ts"]),
+            _TS["imported_event_timestamp_must_not_advance"], status)
+        status = jnp.where(imported & ~ts_valid,
+                           _TS["imported_event_timestamp_out_of_range"],
+                           status)
+        status = jnp.where(
+            imported != batch_imported,
+            jnp.where(imported, _TS["imported_event_not_expected"],
+                      _TS["imported_event_expected"]), status)
     ts_actual = jnp.where(status == inner, ts_inner, ts_event)
 
     out = dict(
@@ -624,6 +707,14 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
         dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
         dr_found=dr_found, cr_found=cr_found, p_found=p_found,
     )
+    if imported_ctx is not None:
+        # Every status code checked AFTER the regress position (the
+        # in-batch maxima chain must outrank these — see the caller's
+        # precedence override). Derived from the SAME literal lists the
+        # statuses come from, so the two can never drift.
+        out["after_regress_codes"] = tuple(sorted({
+            int(code) for _, code in (reg_post_regress + reg_tail
+                                      + pv_tail)}))
     if inwin is not None:
         # Fully-wrapped dead-definition variant (same pre/imported
         # wrapping as status_pre, pv branch replaced by the not-found
@@ -647,7 +738,7 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                           per_event=None, limit_rounds=1, seg=None,
-                          ring_reset=False):
+                          ring_reset=False, imported_mode=False):
     """One batch against the device ledger. Returns (new_state, out) where
     out = {r_status, r_ts, fallback, limit_only, created_count}. When
     out['fallback'] is set, new_state is the input state unchanged (every
@@ -679,7 +770,22 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     observable difference vs K sequential dispatches is hash-table slot
     LAYOUT (two-choice placement reads occupancy at plan time); the
     key->row mapping and every derived result are identical
-    (tests/test_superbatch.py pins this)."""
+    (tests/test_superbatch.py pins this).
+
+    imported_mode (static): handle imported events natively (reference
+    :3052-3063 wrapper + :3800-3833 transfer rules). The ONLY
+    order-dependent rule — an imported timestamp must exceed every
+    timestamp already applied, including earlier in the batch — has a
+    closed form: the applied set is exactly the strict left-to-right
+    maxima of the otherwise-valid sequence (a failed event never
+    advances the running max, and an event at or below ANY earlier
+    otherwise-valid timestamp is also at or below the applied max), so
+    one exclusive cummax decides every regress status with no fixpoint.
+    Linked chains are the one interaction this form cannot express (a
+    chain rollback rewinds the running max — reference chain_key_max),
+    so imported batches containing chains fall back to the exact path;
+    so do in-window pending references and potential limit breaches
+    (the fixpoint tiers are not imported-aware)."""
     from .hash_table import ORPHAN_VAL, ht_plan, ht_write
 
     acc = state["accounts"]
@@ -707,6 +813,33 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
 
     spmd_legacy = per_event is not None
+    imported_ctx = None
+    if imported_mode:
+        assert per_event is None and limit_rounds == 1, \
+            "imported_mode composes with the plain tier only"
+        imp_lane = _flag(flags, _F_IMPORTED)
+        seg_start_arr = (idxs == 0) if seg_start is None else seg_start
+        # Per-sub-batch homogeneity reference: the FIRST lane's flag
+        # (reference: events[0], execute_create :3052), forward-filled
+        # to every lane of the segment.
+        start_idx = _cummax(jnp.where(seg_start_arr, idxs, jnp.int32(-1)))
+        batch_imported = imp_lane[jnp.maximum(start_idx, 0)]
+        # Per-sub-batch commit timestamp (must_not_advance compares the
+        # user timestamp against it): max valid ts_event of the segment.
+        seg_id = _cumsum(seg_start_arr.astype(jnp.int32)) - 1
+        seg_bts = jax.ops.segment_max(
+            jnp.where(valid, ts_event, jnp.uint64(0)), seg_id,
+            num_segments=N)[seg_id]
+        # Account-timestamp collision (reference :3808): membership of
+        # the user timestamp in the account table's timestamp column.
+        acct_ts_sorted = jnp.sort(acc["u64"][:, AC_U64_IDX["ts"]])
+        pos = jnp.searchsorted(acct_ts_sorted, ev["ts"])
+        pos = jnp.minimum(pos, acct_ts_sorted.shape[0] - 1)
+        coll = imp_lane & (acct_ts_sorted[pos] == ev["ts"]) \
+            & (ev["ts"] != 0)
+        imported_ctx = dict(
+            batch_imported=batch_imported, batch_ts=seg_bts,
+            acct_ts_collision=coll, key_max=state["xfer_key_max"])
     if per_event is None and limit_rounds > 1:
         # Fixpoint tiers: the precise dup/join split + in-window pending
         # substitution (~50 extra ops — only these tiers can USE the
@@ -727,7 +860,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # reference on device or (real duplicates) falls back to host.
         e2 = _combined_dup_keys(ev, valid, pv)
         per_event = per_event_status(state, ev, ts_event,
-                                     return_gathers=True)
+                                     return_gathers=True,
+                                     imported_ctx=imported_ctx)
         inwin = jnp.zeros(N, dtype=jnp.bool_)
         didx = jnp.zeros(N, dtype=jnp.int32)
         status_dead = per_event["status_pre"]
@@ -751,6 +885,36 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     status = per_event["status_pre"]
     ts_actual = per_event["ts_pre"]
 
+    if imported_mode:
+        # ---- in-batch regress: the left-to-right maxima chain ----
+        # (see the imported_mode docstring for why this closed form is
+        # exactly the sequential applied set). actual_ts of an applied
+        # event enters the running max whether imported (user ts) or
+        # not (ts_event) — reference key_max advances on every created
+        # transfer (the sequential kernel's st.key_max).
+        imp_lane = _flag(flags, _F_IMPORTED)
+        actual_vec = jnp.where(imp_lane, ev["ts"], ts_event)
+        base_ok = valid & (status == _CREATED)
+        cand = jnp.where(base_ok, actual_vec, jnp.uint64(0))
+        run_incl = _cummax(cand)
+        run_excl = jnp.maximum(
+            state["xfer_key_max"],
+            jnp.concatenate([state["xfer_key_max"][None], run_incl[:-1]]))
+        chain_low = imp_lane & valid & (ev["ts"] <= run_excl)
+        # Precedence: statuses checked AFTER the regress position in the
+        # sequential order must yield to regress when the event would
+        # also regress in-batch (it can never apply either way, so the
+        # maxima chain is unaffected). The code set is derived from the
+        # check lists themselves (per_event_status after_regress_codes).
+        in_after = jnp.zeros_like(valid)
+        for code in per_event["after_regress_codes"]:
+            in_after = in_after | (status == jnp.uint32(code))
+        override = chain_low & (base_ok | in_after)
+        status = jnp.where(
+            override, _TS["imported_event_timestamp_must_not_regress"],
+            status)
+        ts_actual = jnp.where(override, ts_event, ts_actual)
+
     if "_gathers" in per_event:
         dr, cr, p, p_dr, p_cr = per_event["_gathers"]
     else:
@@ -767,8 +931,23 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # eight overflow lanes are all length-N bools whose ONLY consumer is
     # the combined `others` OR — they reduce in ONE stacked any below
     # (hard_vecs) instead of three separate reduces.
-    hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
-    e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
+    if imported_mode:
+        # Imported events are native here; balancing/closing stay hard.
+        # Chains are the one interaction the maxima chain cannot
+        # express (a rollback rewinds the running max — including a
+        # NON-imported chain whose members' ts_event entered the max
+        # before the rollback), so a dispatch carrying BOTH imported
+        # events and links anywhere falls back to exact (scalar gate
+        # folded into e1 via broadcast).
+        hard_flags = _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
+        impchain = (jnp.any(valid & _flag(flags, _F_IMPORTED))
+                    & jnp.any(linked))
+        e1_vec = valid & (_flag(flags, jnp.uint32(hard_flags))
+                          | impchain)
+    else:
+        hard_flags = (_F_IMPORTED | _F_BAL_DR | _F_BAL_CR
+                      | _F_CLOSE_DR | _F_CLOSE_CR)
+        e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
 
     # Eligibility sums below run over the OPTIMISTIC apply set: events
     # whose per-event status is already a failure can never apply (the
@@ -1076,7 +1255,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     xfer_pos, ins_ok = ht_plan(
         state["xfer_ht"], ev["id_hi"], ev["id_lo"], ins_mask)
 
-    if limit_rounds == 1 and not spmd_legacy:
+    if imported_mode:
+        # Imported tier: the fixpoint tiers are not imported-aware, so
+        # nothing escalates — collisions (possible in-window pending
+        # refs) AND potential limit breaches go straight to the exact
+        # host path.
+        others = e145 | e2 | e3 | e7 | e8 | ~ins_ok
+        escalatable = jnp.bool_(False)
+    elif limit_rounds == 1 and not spmd_legacy:
         # Plain tier: e2 is the COMBINED collision check — it may be an
         # in-batch pending reference the fixpoint tier can resolve, so
         # it escalates instead of hard-falling-back.
@@ -1097,7 +1283,8 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # a key collision (possible in-window pending reference) is
     # resolvable on device: the caller redispatches it to the fixpoint
     # variant (limit_rounds > 1) instead of the exact host path.
-    limit_only = escalatable & ~others & jnp.bool_(limit_rounds == 1)
+    limit_only = (escalatable & ~others
+                  & jnp.bool_(limit_rounds == 1 and not imported_mode))
     ok = ~fallback
 
     # ---------------- application (all masked by ok) ----------------
@@ -1143,10 +1330,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         ledger=jnp.where(pv, p["ledger"], ev["ledger"]),
         code=jnp.where(pv, p["code"], ev["code"]),
         flags=flags,
-        ts=ts_event,
+        # Stored/applied timestamp: the ACTUAL one (imported created
+        # rows keep their user timestamp; == ts_event otherwise).
+        ts=ts_actual,
         pstat=jnp.where(pending & ~pv, _PS_PENDING, jnp.int32(0)),
         expires=jnp.where(pending & ~pv & (ev["timeout"] != 0),
-                          ts_event + timeout_ns, jnp.uint64(0)),
+                          ts_actual + timeout_ns, jnp.uint64(0)),
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
     )
@@ -1242,7 +1431,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     erow = jnp.where(ap, ring_base + row_off, E_dump)
     stores_ev = dict(
-        ts=ts_event,
+        ts=ts_actual,
         amt_hi=amt_res_hi, amt_lo=amt_res_lo,
         areq_hi=ev["amt_hi"], areq_lo=ev["amt_lo"],
         tflags=flags,
@@ -1282,8 +1471,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
     # Scalars.
     last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
+    # key_max tracks the max APPLIED timestamp (imported rows carry user
+    # timestamps; == last_ts otherwise) — the regress reference for
+    # future imported batches. commit_ts stays prepare-derived.
+    last_actual = jnp.max(jnp.where(created, ts_actual, jnp.uint64(0)))
     key_max = jnp.where(created.any() & ok,
-                        jnp.maximum(state["xfer_key_max"], last_ts),
+                        jnp.maximum(state["xfer_key_max"], last_actual),
                         state["xfer_key_max"])
     commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
 
@@ -1347,6 +1540,23 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
 
 
 create_transfers_fast_jit = jax.jit(create_transfers_fast, donate_argnums=0)
+
+# Imported tier (plain eligibility + native imported rules + the
+# left-to-right maxima chain for in-batch regress). Selected by the
+# ledger's host pre-route when a batch/window carries imported flags.
+create_transfers_imported_jit = jax.jit(
+    functools.partial(create_transfers_fast, imported_mode=True),
+    donate_argnums=0)
+
+
+def _create_transfers_super_imported(state, ev, seg, force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg, imported_mode=True)
+
+
+create_transfers_super_imported_jit = jax.jit(
+    _create_transfers_super_imported, donate_argnums=0)
 
 
 def _create_transfers_super(state, ev, seg, force_fallback=None):

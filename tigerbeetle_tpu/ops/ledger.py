@@ -472,6 +472,23 @@ def _F_POST_VOID_HOST() -> int:
     return _F_PV_HOST
 
 
+_F_IMP_HOST = None
+
+
+def _F_IMPORTED_HOST() -> int:
+    global _F_IMP_HOST
+    if _F_IMP_HOST is None:
+        from ..types import TransferFlags
+
+        _F_IMP_HOST = int(TransferFlags.imported)
+    return _F_IMP_HOST
+
+
+def _has_imported(evs) -> bool:
+    bit = np.uint32(_F_IMPORTED_HOST())
+    return any((np.asarray(e["flags"]) & bit).any() for e in evs)
+
+
 def _synth_t_cols(ev: dict, st_np, ts_b: int) -> dict:
     """Reconstruct the created transfer rows' xf_named columns from the
     batch INPUT (pv-free batches only: amounts are literal, nothing
@@ -873,6 +890,11 @@ class DeviceLedger:
         ns = [len(e["id_lo"]) for e in evs]
         if not (len(evs) > 1 and not self._mirror_route()):
             return None
+        if _has_imported(evs):
+            # Imported windows stay on the synchronous path (the
+            # pipelined kernels are not imported-aware; the sync window
+            # routes to the imported super tier).
+            return None
         if self._wt:
             # Capacity pre-check BEFORE any device mutation: the window's
             # created rows must fit one delta-gather bucket (the sync
@@ -915,9 +937,9 @@ class DeviceLedger:
             # Pv-free windows fetch HALF the delta (event snapshots
             # only): the transfer/der columns are host-reconstructible
             # from the inputs — the drain moves ~half the bytes.
-            pv_bits = np.uint32(_F_POST_VOID_HOST())
+            excl = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST())
             e_only = all(
-                not (np.asarray(ev["flags"]) & pv_bits).any()
+                not (np.asarray(ev["flags"]) & excl).any()
                 for ev in evs)
             if e_only:
                 gather = _ev_delta_gather_window_jit(
@@ -1084,11 +1106,21 @@ class DeviceLedger:
             # in-window pending references or the workload has been
             # breaching limits (the shallow dispatch is a known waste) —
             # one numpy key-merge vs an ~800 ms wasted chip dispatch.
-            deep_first = (self._fixpoint_first
-                          or _window_has_pend_refs(ev_s))
+            imported = _has_imported(evs)
+            deep_first = (not imported
+                          and (self._fixpoint_first
+                               or _window_has_pend_refs(ev_s)))
             ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
             seg = {k: jax.device_put(v) for k, v in seg.items()}
-            if deep_first:
+            if imported:
+                from .fast_kernels import (
+                    create_transfers_super_imported_jit,
+                )
+
+                new_state, out = create_transfers_super_imported_jit(
+                    self.state, ev_s, seg)
+                self.state = new_state
+            elif deep_first:
                 new_state, out = create_transfers_super_deep_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
@@ -1160,10 +1192,13 @@ class DeviceLedger:
             create_transfers_fixpoint_jit,
         )
 
+        from .fast_kernels import create_transfers_imported_jit
+
         evp = pad_transfer_events(transfers_to_arrays([]), n_pad)
         evp = {k: jax.device_put(v) for k, v in evp.items()}
         for f in (create_transfers_fast_jit, create_transfers_fixpoint_jit,
-                  create_transfers_fixpoint_deep_jit):
+                  create_transfers_fixpoint_deep_jit,
+                  create_transfers_imported_jit):
             self.state, out = f(self.state, evp, np.uint64(1), np.int32(0))
             assert not bool(out["fallback"])
 
@@ -1191,7 +1226,17 @@ class DeviceLedger:
         # fits (jit caches one executable per bucket): a 1k-event batch
         # costs 1k-row kernel work, not BATCH_MAX-row work.
         evp = pad_transfer_events(ev, n_pad=_pad_bucket(n))
-        if self._fixpoint_first:
+        if _has_imported([ev]):
+            # Imported batches run their own tier (native imported rules
+            # + the in-batch maxima chain); its fallbacks (chains,
+            # collisions, potential breaches) go straight to exact.
+            from .fast_kernels import create_transfers_imported_jit
+
+            new_state, out = create_transfers_imported_jit(
+                self.state, evp, np.uint64(timestamp), np.int32(n))
+            self.state = new_state
+            fallback = bool(jax.device_get(out["fallback"]))
+        elif self._fixpoint_first:
             # The workload has been breaching balance limits: skip the
             # doomed headroom-proof dispatch and go straight to the
             # fixpoint kernel; drop back once a batch reports no breach.
@@ -1761,9 +1806,12 @@ class DeviceLedger:
         physical checkpoints byte-identical across replicas)."""
         per = [self._batch_delta_stats(ev, st_np)
                for ev, st_np in zip(evs, st_slices)]
-        pv_bits = np.uint32(_F_POST_VOID_HOST())
+        # Half-width synthesis requires: no post/void (amounts/fields
+        # inherit from pendings on device) and no imported events (their
+        # stored timestamps are the USER's, not the ts_event formula).
+        excl_bits = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST())
         e_only = timestamps is not None and all(
-            not (np.asarray(ev["flags"]) & pv_bits).any() for ev in evs)
+            not (np.asarray(ev["flags"]) & excl_bits).any() for ev in evs)
 
         def fetch_start(total):
             if e_only:
