@@ -266,3 +266,92 @@ def test_imported_fuzz_differential(seed):
             nid += 1
         _diff(led, ora, xs, ts)
         ts += 10**6
+
+
+AIMP = 1 << 7  # AccountFlags.imported
+
+
+class TestImportedAccounts:
+    """Imported account creation on the device fast path (reference
+    :3648-3667): regress vs acct_key_max + collision with TRANSFER
+    timestamps, maxima chain in-batch, user timestamps stored."""
+
+    def test_monotone_imported_accounts(self):
+        from tigerbeetle_tpu.types import AccountFlags
+
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        ora = StateMachineOracle()
+        accs = [Account(id=100 + i, ledger=1, code=1,
+                        flags=int(AccountFlags.imported),
+                        timestamp=5000 + i * 10) for i in range(16)]
+        g = led.create_accounts(accs, 10**9)
+        w = ora.create_accounts(accs, 10**9)
+        assert [(x.status.name, x.timestamp) for x in g] == \
+            [(x.status.name, x.timestamp) for x in w]
+        assert all(x.status.name == "created" for x in w)
+        assert led.fallbacks == 0
+        got = led.lookup_accounts([100, 115])
+        assert got[0].timestamp == 5000 and got[1].timestamp == 5150
+
+    def test_maxima_chain_and_wrapper_rules(self):
+        from tigerbeetle_tpu.types import AccountFlags
+
+        imp = int(AccountFlags.imported)
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        ora = StateMachineOracle()
+        accs = [
+            Account(id=200, ledger=1, code=1, flags=imp, timestamp=7000),
+            Account(id=201, ledger=1, code=1, flags=imp, timestamp=6500),
+            Account(id=202, ledger=1, code=1, flags=imp, timestamp=7200),
+            Account(id=203, ledger=1, code=1),            # expected
+            Account(id=204, ledger=1, code=1, flags=imp, timestamp=0),
+            Account(id=205, ledger=1, code=1, flags=imp,
+                    timestamp=10**9 + 5),                  # not_advance
+        ]
+        g = led.create_accounts(accs, 10**9)
+        w = ora.create_accounts(accs, 10**9)
+        assert [(x.status.name, x.timestamp) for x in g] == \
+            [(x.status.name, x.timestamp) for x in w]
+        assert [x.status.name for x in w] == [
+            "created", "imported_event_timestamp_must_not_regress",
+            "created", "imported_event_expected",
+            "imported_event_timestamp_out_of_range",
+            "imported_event_timestamp_must_not_advance"]
+
+    def test_collision_with_transfer_timestamp(self):
+        from tigerbeetle_tpu.types import AccountFlags
+
+        imp = int(AccountFlags.imported)
+        led, ora = _pair()
+        # One imported transfer at uts 40000 (device path).
+        _diff(led, ora, [_imp(30000, 1, 2, 1, 40000)], 10**9)
+        accs = [Account(id=300, ledger=1, code=1, flags=imp,
+                        timestamp=40000),   # collides with the transfer
+                Account(id=301, ledger=1, code=1, flags=imp,
+                        timestamp=40001)]
+        g = led.create_accounts(accs, 2 * 10**9)
+        w = ora.create_accounts(accs, 2 * 10**9)
+        assert [(x.status.name, x.timestamp) for x in g] == \
+            [(x.status.name, x.timestamp) for x in w]
+        assert [x.status.name for x in w] == [
+            "imported_event_timestamp_must_not_regress", "created"]
+
+    def test_postdate_uses_imported_account_ts(self):
+        """A later NON-imported transfer on imported accounts: the
+        postdate reference is the stored (user) account timestamp."""
+        from tigerbeetle_tpu.types import AccountFlags
+
+        imp = int(AccountFlags.imported)
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13)
+        ora = StateMachineOracle()
+        accs = [Account(id=1, ledger=1, code=1, flags=imp, timestamp=5000),
+                Account(id=2, ledger=1, code=1, flags=imp, timestamp=5001)]
+        led.create_accounts(accs, 10**9)
+        ora.create_accounts(accs, 10**9)
+        # An imported transfer BELOW the accounts' user ts postdate-fails;
+        # above, it creates.
+        xs = [_imp(31000, 1, 2, 1, 4999), _imp(31001, 1, 2, 1, 6000)]
+        names = _diff(led, ora, xs, 2 * 10**9)
+        assert names == [
+            "imported_event_timestamp_must_postdate_debit_account",
+            "created"]

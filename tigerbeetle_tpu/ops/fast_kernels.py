@@ -58,6 +58,7 @@ from .ev_layout import (
     XF_I32_IDX,
     XF_U32,
     XF_U64,
+    XF_U64_IDX,
     ev_cap,
     xf_named,
 )
@@ -1716,9 +1717,11 @@ create_transfers_chain_unrolled_jit = jax.jit(
 
 # ================================================== create_accounts (fast)
 
-def create_accounts_fast(state, ev, timestamp, n):
+def create_accounts_fast(state, ev, timestamp, n, imported_mode=False):
     """Vectorized create_accounts (reference :3613-3689). Eligibility: no
-    imported flags, no duplicate ids in batch, capacity suffices."""
+    duplicate ids in batch, capacity suffices; imported flags require the
+    imported_mode tier (native rules, reference :3648-3667) — chains +
+    imported still fall back (rollback rewinds the maxima chain)."""
     from .hash_table import ht_lookup, ht_plan, ht_write
 
     acc = state["accounts"]
@@ -1736,7 +1739,10 @@ def create_accounts_fast(state, ev, timestamp, n):
     e_found, e_row = ht_lookup(state["acct_ht"], ev["id_hi"], ev["id_lo"])
     e_rowc = jnp.where(e_found, e_row, A_dump)
 
-    e1 = jnp.any(valid & imported)
+    if imported_mode:
+        e1 = jnp.any(valid & imported) & jnp.any(linked)
+    else:
+        e1 = jnp.any(valid & imported)
     tag = valid & ~((ev["id_hi"] == 0) & (ev["id_lo"] == 0))
     e2 = _dup_keys(ev["id_hi"], ev["id_lo"], tag)
     fallback_pre = e1 | e2
@@ -1777,14 +1783,68 @@ def create_accounts_fast(state, ev, timestamp, n):
         (ev["ledger"] == 0, _AS["ledger_must_not_be_zero"]),
         (ev["code"] == 0, _AS["code_must_not_be_zero"]),
     ]
+    if imported_mode:
+        # Regress vs state (reference :3648-3667): the accounts groove's
+        # key_max plus collision with any existing TRANSFER timestamp
+        # (sorted-column membership; the in-batch component is the
+        # maxima chain below).
+        xfer_ts_sorted = jnp.sort(
+            state["transfers"]["u64"][:, XF_U64_IDX["ts"]])
+        pos = jnp.minimum(jnp.searchsorted(xfer_ts_sorted, ev["ts"]),
+                          xfer_ts_sorted.shape[0] - 1)
+        coll = (xfer_ts_sorted[pos] == ev["ts"]) & (ev["ts"] != 0)
+        regress = imported & (
+            (ev["ts"] <= state["acct_key_max"]) | coll)
+        checks.append(
+            (regress, _AS["imported_event_timestamp_must_not_regress"]))
     inner = _first_failure(checks)
     inner = jnp.where(inner == 0, exists_status, inner)
     ts_inner = jnp.where(inner == _AS["exists"], exists_ts, ts_event)
+    if imported_mode:
+        ts_inner = jnp.where((inner == _CREATED) & imported,
+                             ev["ts"], ts_inner)
 
     status = inner
     status = jnp.where(~imported & (ev["ts"] != 0), _AS["timestamp_must_be_zero"], status)
-    status = jnp.where(imported, _AS["imported_event_not_expected"], status)
+    if imported_mode:
+        # Wrapper rules (reference execute_create :3052-3063): batch
+        # homogeneity vs the FIRST event's flag, timestamp range,
+        # must-not-advance vs the batch commit timestamp.
+        batch_imported = imported[0]
+        ts_valid = (ev["ts"] >= 1) & (ev["ts"] <= _U63)
+        status = jnp.where(imported & ts_valid & (ev["ts"] >= timestamp),
+                           _AS["imported_event_timestamp_must_not_advance"],
+                           status)
+        status = jnp.where(imported & ~ts_valid,
+                           _AS["imported_event_timestamp_out_of_range"],
+                           status)
+        status = jnp.where(
+            imported != batch_imported,
+            jnp.where(imported, _AS["imported_event_not_expected"],
+                      _AS["imported_event_expected"]), status)
+    else:
+        status = jnp.where(imported, _AS["imported_event_not_expected"],
+                           status)
     ts_actual = jnp.where(status == inner, ts_inner, ts_event)
+
+    if imported_mode:
+        # In-batch regress: left-to-right maxima chain over the
+        # otherwise-valid sequence (see create_transfers_fast's
+        # imported_mode docstring; for accounts NO check follows the
+        # regress position, so only base-ok events need the override).
+        actual_vec = jnp.where(imported, ev["ts"], ts_event)
+        base_ok = valid & (status == _CREATED)
+        cand = jnp.where(base_ok, actual_vec, jnp.uint64(0))
+        run_incl = _cummax(cand)
+        run_excl = jnp.maximum(
+            state["acct_key_max"],
+            jnp.concatenate([state["acct_key_max"][None],
+                             run_incl[:-1]]))
+        override = imported & base_ok & (ev["ts"] <= run_excl)
+        status = jnp.where(
+            override, _AS["imported_event_timestamp_must_not_regress"],
+            status)
+        ts_actual = jnp.where(override, ts_event, ts_actual)
 
     l_prev = jnp.concatenate([jnp.zeros(1, dtype=jnp.bool_), linked[:-1]])
     in_chain = linked | l_prev
@@ -1821,10 +1881,13 @@ def create_accounts_fast(state, ev, timestamp, n):
     z64 = jnp.uint64(0)
     # Packed row inserts: one scatter per matrix; masked lanes write
     # uniform zero rows to the dump slot (scatter determinism).
+    # Stored timestamp: the ACTUAL one (imported created accounts keep
+    # their user timestamp; == ts_event otherwise).
+    ts_store = ts_actual if imported_mode else ts_event
     u64_vals = {AU["id_hi"]: ev["id_hi"], AU["id_lo"]: ev["id_lo"],
                 AU["ud128_hi"]: ev["ud128_hi"],
                 AU["ud128_lo"]: ev["ud128_lo"],
-                AU["ud64"]: ev["ud64"], AU["ts"]: ts_event}
+                AU["ud64"]: ev["ud64"], AU["ts"]: ts_store}
     u32_vals = {AV["ud32"]: ev["ud32"], AV["ledger"]: ev["ledger"],
                 AV["code"]: ev["code"], AV["flags"]: flags}
     apn = ap[:, None]
@@ -1846,8 +1909,11 @@ def create_accounts_fast(state, ev, timestamp, n):
         state["acct_ht"], ht_pos, ev["id_hi"], ev["id_lo"], new_rows, ap)
 
     last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
+    last_actual = jnp.max(jnp.where(
+        created, ts_actual if imported_mode else ts_event,
+        jnp.uint64(0)))
     key_max = jnp.where(created.any() & ok,
-                        jnp.maximum(state["acct_key_max"], last_ts),
+                        jnp.maximum(state["acct_key_max"], last_actual),
                         state["acct_key_max"])
     commit_ts = jnp.where(created.any() & ok, last_ts, state["commit_ts"])
 
@@ -1869,3 +1935,6 @@ def create_accounts_fast(state, ev, timestamp, n):
 
 
 create_accounts_fast_jit = jax.jit(create_accounts_fast, donate_argnums=0)
+create_accounts_imported_jit = jax.jit(
+    functools.partial(create_accounts_fast, imported_mode=True),
+    donate_argnums=0)

@@ -489,6 +489,18 @@ def _has_imported(evs) -> bool:
     return any((np.asarray(e["flags"]) & bit).any() for e in evs)
 
 
+_F_A_IMP_HOST = None
+
+
+def _F_A_IMPORTED_HOST() -> int:
+    global _F_A_IMP_HOST
+    if _F_A_IMP_HOST is None:
+        from ..types import AccountFlags
+
+        _F_A_IMP_HOST = int(AccountFlags.imported)
+    return _F_A_IMP_HOST
+
+
 def _synth_t_cols(ev: dict, st_np, ts_b: int) -> dict:
     """Reconstruct the created transfer rows' xf_named columns from the
     batch INPUT (pv-free batches only: amounts are literal, nothing
@@ -823,8 +835,15 @@ class DeviceLedger:
             return results
         ev = pad_account_events(accounts_to_arrays(accounts))
         n = len(accounts)
-        new_state, out = create_accounts_fast_jit(
-            self.state, ev, np.uint64(timestamp), np.int32(n))
+        if (np.asarray(ev["flags"])
+                & np.uint32(_F_A_IMPORTED_HOST())).any():
+            from .fast_kernels import create_accounts_imported_jit
+
+            new_state, out = create_accounts_imported_jit(
+                self.state, ev, np.uint64(timestamp), np.int32(n))
+        else:
+            new_state, out = create_accounts_fast_jit(
+                self.state, ev, np.uint64(timestamp), np.int32(n))
         if bool(out["fallback"]):
             # new_state is the old state (all selects masked); it was donated,
             # so adopt it before syncing down.
