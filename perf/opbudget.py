@@ -1,0 +1,100 @@
+"""Op-budget table: post-optimization HLO op counts for the kernel tiers.
+
+The tunnel regime bills ~0.5-1 ms per *executed top-level HLO op* inside
+large programs (PERF.md); this counts them per kernel tier so the round-4
+op-cut work has a before/after table. Fusions count as one op (one
+dispatch); the table also splits out the op kinds that dominate.
+"""
+import collections
+import functools
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+sys.path.insert(0, "/root/repo")
+
+import jax
+import numpy as np
+
+import tigerbeetle_tpu  # noqa: F401
+from tigerbeetle_tpu.benchmark import _soa
+from tigerbeetle_tpu.ops import fast_kernels as fk
+from tigerbeetle_tpu.ops.ledger import init_state, stack_superbatch
+
+STACK = 8
+N = 1024
+
+
+def hlo_opcount(lowered):
+    mod = lowered.compile()
+    txts = mod.as_text() if isinstance(mod.as_text(), str) else ""
+    counts = collections.Counter()
+    total = 0
+    entry = False
+    for line in txts.splitlines():
+        s = line.strip()
+        if s.startswith("ENTRY "):
+            entry = True
+            continue
+        if entry:
+            if s.startswith("}"):
+                break
+            if "=" in s and not s.startswith("//"):
+                rhs = s.split("=", 2)[-1].strip()
+                # 'f32[...]{...} opname(' — opname after the type
+                parts = rhs.split()
+                if len(parts) >= 2:
+                    op = parts[1].split("(")[0]
+                    counts[op] += 1
+                    total += 1
+    return total, counts
+
+
+def shape_args():
+    state = init_state(1 << 12, 1 << 16)
+    rng = np.random.default_rng(0)
+    evs, tss = [], []
+    nid = 10 ** 6
+    for b in range(STACK):
+        dr = rng.integers(1, 64, N, dtype=np.uint64)
+        cr = (dr % 63) + 1
+        ev = _soa(np.arange(nid, nid + N), dr, cr,
+                  rng.integers(1, 100, N))
+        nid += N
+        evs.append(ev)
+        tss.append(10 ** 12 + b * (N + 10))
+    ev_s, seg = stack_superbatch(evs, tss)
+    return state, ev_s, seg
+
+
+def main():
+    import jax.numpy as jnp
+    state, ev_s, seg = shape_args()
+    tiers = {
+        "plain_super (limit_rounds=1)": 1,
+        "fixpoint_8": 8,
+        "fixpoint_deep_32": 32,
+    }
+    rows = []
+    for name, rounds in tiers.items():
+        fn = functools.partial(fk.create_transfers_fast,
+                               limit_rounds=rounds)
+        low = jax.jit(fn, donate_argnums=0).lower(
+            state, ev_s, jnp.uint64(0), jnp.int32(0), seg=seg)
+        total, counts = hlo_opcount(low)
+        heavy = {k: v for k, v in counts.items()
+                 if k.split(".")[0] in
+                 ("fusion", "scatter", "gather", "sort", "while",
+                  "reduce", "reduce-window", "all-reduce", "copy",
+                  "dynamic-slice", "dynamic-update-slice", "select-and-scatter")}
+        rows.append((name, total, sum(heavy.values()),
+                     counts.most_common(10)))
+    for name, total, heavy, top in rows:
+        print(f"{name:32s} total={total:5d} heavy={heavy:5d} top={top}")
+    base = rows[0][2]
+    for name, total, heavy, _ in rows[1:]:
+        print(f"{name}: heavy-op multiple of plain = {heavy / base:.2f}x")
+
+
+if __name__ == "__main__":
+    main()

@@ -1610,7 +1610,7 @@ create_transfers_super_deep_ring_jit = jax.jit(
 # Window round budget: 24 (measured: the config4 window workload at
 # bench scale — 8 x 8190-event prepares, 64 limited accounts —
 # converges at 24 rounds with the same-round death fold, 6/6 windows;
-# scratch/fixpoint_benchscale_probe.py). An unconverged window falls
+# perf/fixpoint_benchscale_probe.py). An unconverged window falls
 # back to the per-batch ladder whose own deep tier keeps the full 32
 # rounds (single batches cascade shallower than windows), so the cut
 # is pure throughput: 25% less round mass on the config4-dominant
