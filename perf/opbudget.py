@@ -40,7 +40,7 @@ def hlo_opcount(lowered):
             if s.startswith("}"):
                 break
             if "=" in s and not s.startswith("//"):
-                rhs = s.split("=", 2)[-1].strip()
+                rhs = s.split("=", 1)[-1].strip()
                 # 'f32[...]{...} opname(' — opname after the type
                 parts = rhs.split()
                 if len(parts) >= 2:
@@ -71,14 +71,14 @@ def main():
     import jax.numpy as jnp
     state, ev_s, seg = shape_args()
     tiers = {
-        "plain_super (limit_rounds=1)": 1,
-        "fixpoint_8": 8,
-        "fixpoint_deep_32": 32,
+        "plain_super (limit_rounds=1)": dict(limit_rounds=1),
+        "fixpoint_8": dict(limit_rounds=8),
+        "fixpoint_deep_32": dict(limit_rounds=32),
+        "balancing_8": dict(limit_rounds=8, balancing_mode=True),
     }
     rows = []
-    for name, rounds in tiers.items():
-        fn = functools.partial(fk.create_transfers_fast,
-                               limit_rounds=rounds)
+    for name, kw in tiers.items():
+        fn = functools.partial(fk.create_transfers_fast, **kw)
         low = jax.jit(fn, donate_argnums=0).lower(
             state, ev_s, jnp.uint64(0), jnp.int32(0), seg=seg)
         total, counts = hlo_opcount(low)

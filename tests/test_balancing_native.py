@@ -1,0 +1,418 @@
+"""Balancing transfers (balancing_debit/credit) native on device.
+
+reference: the clamp at src/state_machine.zig:3840-3853 — the applied
+amount is min(amount, available headroom), where headroom reads the
+balances produced by every successful EARLIER event (including earlier
+events in the same batch). Previously any balancing flag was an E1 hard
+fallback to the exact host path; the balancing fixpoint tier
+(ops/fast_kernels.py balancing_mode) re-derives clamped amounts per
+round from the exact per-event prefix balances and resolves the whole
+batch on device.
+"""
+
+import numpy as np
+
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags,
+    Transfer,
+    TransferFlags,
+)
+
+DR_LIMIT = int(AccountFlags.debits_must_not_exceed_credits)
+CR_LIMIT = int(AccountFlags.credits_must_not_exceed_debits)
+LINKED = int(TransferFlags.linked)
+PENDING = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+VOID = int(TransferFlags.void_pending_transfer)
+BAL_DR = int(TransferFlags.balancing_debit)
+BAL_CR = int(TransferFlags.balancing_credit)
+CLOSE_DR = int(TransferFlags.closing_debit)
+AMOUNT_MAX = (1 << 128) - 1
+
+
+def _pair():
+    led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+    sm = StateMachineOracle()
+    return led, sm
+
+
+def _both(led, sm, events, ts):
+    got = led.create_transfers(events, ts)
+    want = sm.create_transfers(events, ts)
+    assert ([(r.timestamp, r.status) for r in got]
+            == [(r.timestamp, r.status) for r in want]), (
+        [r.status.name for r in got], [r.status.name for r in want])
+    return [r.status.name for r in got]
+
+
+def _check_state(led, sm, acct_ids, xfer_ids=()):
+    a_led = {a.id: a for a in led.lookup_accounts(list(acct_ids))}
+    a_sm = {a.id: a for a in sm.lookup_accounts(list(acct_ids))}
+    assert a_led == a_sm, (a_led, a_sm)
+    if xfer_ids:
+        x_led = led.lookup_transfers(list(xfer_ids))
+        x_sm = sm.lookup_transfers(list(xfer_ids))
+        assert x_led == x_sm, (x_led, x_sm)
+
+
+def _setup(led, sm, accounts, fund=()):
+    for eng in (led, sm):
+        res = eng.create_accounts(accounts, 100)
+        assert all(r.status.name == "created" for r in res)
+    ts = 10**12
+    for i, (dr, cr, amt) in enumerate(fund):
+        _both(led, sm, [Transfer(id=900 + i, debit_account_id=dr,
+                                 credit_account_id=cr, amount=amt,
+                                 ledger=1, code=1)], ts)
+        ts += 10
+    return ts
+
+
+class TestBalancingNative:
+    def test_amount_max_clamps_to_headroom(self):
+        """AMOUNT_MAX balancing_debit clamps to the full headroom
+        (credits_posted - debits) — stored amount is the clamp, and the
+        batch runs on device (no host fallback)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts)
+        assert st == ["created"]
+        assert led.lookup_transfers([1])[0].amount == 100
+        _check_state(led, sm, [1, 2, 3], [1])
+        assert led.fallbacks == 0 and led.fixpoint_batches == 1
+
+    def test_in_batch_cascade(self):
+        """A balancing transfer reads the headroom left by an EARLIER
+        balancing transfer in the same batch: 60 then 40 then 0."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=60, ledger=1, code=1, flags=BAL_DR),
+            Transfer(id=2, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+            Transfer(id=3, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+        ], ts)
+        assert st == ["created"] * 3
+        amts = [t.amount for t in led.lookup_transfers([1, 2, 3])]
+        assert amts == [60, 40, 0]
+        _check_state(led, sm, [1, 2, 3], [1, 2, 3])
+        assert led.fallbacks == 0
+
+    def test_balancing_credit(self):
+        """balancing_credit clamps against the CREDIT account's
+        debits_posted - (credits_posted + credits_pending)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(1, 2, 80)])
+        # Account 1 has debits_posted=80: balancing_credit INTO account
+        # 1 clamps at 80.
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=3, credit_account_id=1,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_CR)], ts)
+        assert st == ["created"]
+        assert led.lookup_transfers([1])[0].amount == 80
+        _check_state(led, sm, [1, 2, 3], [1])
+        assert led.fallbacks == 0
+
+    def test_both_flags_min_composes(self):
+        """balancing_debit AND balancing_credit: the applied amount is
+        the min of both headrooms (and the nominal)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1),
+                     Account(id=4, ledger=1, code=1)],
+                    fund=[(2, 1, 100), (3, 4, 30)])
+        # dr headroom on 1 = 100; cr headroom on 3 = 30 -> clamp 30.
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR | BAL_CR)], ts)
+        assert st == ["created"]
+        assert led.lookup_transfers([1])[0].amount == 30
+        _check_state(led, sm, [1, 2, 3, 4], [1])
+        assert led.fallbacks == 0
+
+    def test_balancing_pending_holds_headroom(self):
+        """A pending balancing transfer holds debits_pending, shrinking
+        the headroom a later balancing transfer in the same batch
+        sees."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=70, ledger=1, code=1,
+                     flags=BAL_DR | PENDING, timeout=60),
+            Transfer(id=2, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+        ], ts)
+        assert st == ["created"] * 2
+        amts = [t.amount for t in led.lookup_transfers([1, 2])]
+        assert amts == [70, 30]
+        _check_state(led, sm, [1, 2, 3], [1, 2])
+        assert led.fallbacks == 0
+
+    def test_zero_headroom_zero_amount(self):
+        """No headroom at all: the transfer is still created, with
+        amount 0 (reference: the clamp saturates at zero, creation
+        proceeds)."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts)
+        assert st == ["created"]
+        assert led.lookup_transfers([1])[0].amount == 0
+        _check_state(led, sm, [1, 2], [1])
+        assert led.fallbacks == 0
+
+    def test_mid_batch_relief_widens_clamp(self):
+        """A void earlier in the batch releases pending debits; a later
+        balancing transfer's clamp must see the widened headroom."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        # Pre-batch pending holding 90 of the 100 headroom.
+        st = _both(led, sm, [
+            Transfer(id=800, debit_account_id=1, credit_account_id=3,
+                     amount=90, ledger=1, code=1, flags=PENDING,
+                     timeout=3600)], ts)
+        assert st == ["created"]
+        ts += 10
+        st = _both(led, sm, [
+            Transfer(id=801, pending_id=800, flags=VOID,
+                     amount=0, ledger=1, code=1),
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+        ], ts)
+        assert st == ["created"] * 2
+        assert led.lookup_transfers([1])[0].amount == 100
+        _check_state(led, sm, [1, 2, 3], [1, 800, 801])
+        assert led.fallbacks == 0
+
+    def test_balancing_under_limits(self):
+        """Balancing + balance-limit flags on the same fixpoint: the
+        clamp keeps the balancing account inside ITS limit, while the
+        counterparty's limit can still fail the transfer — sequential
+        statuses either way."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1, flags=DR_LIMIT),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1, flags=CR_LIMIT)],
+                    fund=[(2, 1, 50)])
+        # Account 3 has credits_must_not_exceed_debits with zero
+        # debits: ANY positive credit breaches. The balancing clamp on
+        # account 1 yields 50 > 0 -> exceeds_debits on account 3.
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts)
+        assert st == ["exceeds_debits"]
+        # Against a plain counterparty the same event is clamped+created.
+        st = _both(led, sm, [
+            Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts + 10)
+        assert st == ["created"]
+        assert led.lookup_transfers([2])[0].amount == 50
+        _check_state(led, sm, [1, 2, 3], [2])
+        assert led.fallbacks == 0
+
+    def test_linked_chain_rollback(self):
+        """A chain whose later member fails rolls back an earlier
+        balancing transfer — including its clamped deltas."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR | LINKED),
+            Transfer(id=2, debit_account_id=1, credit_account_id=99,
+                     amount=1, ledger=1, code=1),  # account not found
+        ], ts)
+        assert st == ["linked_event_failed", "credit_account_not_found"]
+        # Rolled back: headroom restored, next balancing sees 100.
+        st = _both(led, sm, [
+            Transfer(id=3, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts + 10)
+        assert st == ["created"]
+        assert led.lookup_transfers([3])[0].amount == 100
+        _check_state(led, sm, [1, 2, 3], [3])
+        assert led.fallbacks == 0
+
+    def test_exists_amount_upper_bound(self):
+        """Idempotent resubmission of a balancing transfer compares the
+        nominal amount as an UPPER bound on the stored clamp (reference
+        :4016-4031): amount >= stored -> exists; amount < stored ->
+        exists_with_different_amount."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR)], ts)
+        assert st == ["created"]  # stored amount 100
+        # One resubmission per batch: same-id duplicates WITHIN a batch
+        # are an intentional E2 exact-path fallback.
+        st = []
+        for k, amt in enumerate((AMOUNT_MAX, 100, 99)):
+            st += _both(led, sm, [
+                Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                         amount=amt, ledger=1, code=1, flags=BAL_DR)],
+                ts + 10 * (k + 1))
+        assert st == ["exists", "exists", "exists_with_different_amount"]
+        _check_state(led, sm, [1, 2, 3], [1])
+        assert led.fallbacks == 0
+
+    def test_inwindow_balancing_pending_def_falls_back(self):
+        """A post referencing a balancing pending created EARLIER IN THE
+        SAME BATCH falls back to the exact path (the in-window
+        substitution reads nominal event lanes, not the clamp) — and
+        the results still match the oracle bit-for-bit."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1),
+                     Account(id=3, ledger=1, code=1)],
+                    fund=[(2, 1, 100)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=3,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR | PENDING, timeout=60),
+            Transfer(id=2, pending_id=1, flags=POST,
+                     amount=AMOUNT_MAX, ledger=1, code=1),
+        ], ts)
+        assert st == ["created", "created"]
+        # The post inherits the CLAMPED pending amount (100).
+        assert led.lookup_transfers([2])[0].amount == 100
+        _check_state(led, sm, [1, 2, 3], [1, 2])
+        assert led.fallbacks == 1  # by design
+
+    def test_closing_still_exact(self):
+        """closing_debit stays on the exact path even in a balancing
+        batch — results identical to the oracle."""
+        led, sm = _pair()
+        ts = _setup(led, sm,
+                    [Account(id=1, ledger=1, code=1),
+                     Account(id=2, ledger=1, code=1)],
+                    fund=[(2, 1, 10)])
+        st = _both(led, sm, [
+            Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                     amount=AMOUNT_MAX, ledger=1, code=1,
+                     flags=BAL_DR),
+            Transfer(id=2, debit_account_id=1, credit_account_id=2,
+                     amount=1, ledger=1, code=1,
+                     flags=PENDING | CLOSE_DR, timeout=60),
+        ], ts)
+        assert st == ["created", "created"]
+        _check_state(led, sm, [1, 2], [1, 2])
+        assert led.fallbacks == 1  # closing -> exact path
+
+    def test_seeded_fuzz_differential(self):
+        """Randomized mixed batches (regular / balancing dr+cr / pending
+        balancing / posts+voids of PRIOR-batch pendings / occasional
+        chains / limit-flagged accounts), every batch diffed against the
+        oracle and full account balances compared — all native (the
+        shallow->deep ladder may escalate, but never to the host)."""
+        rng = np.random.default_rng(0xBA1A)
+        led, sm = _pair()
+        n_acct = 10
+        accts = [Account(id=i, ledger=1, code=1,
+                         flags=(DR_LIMIT if i == 3
+                                else CR_LIMIT if i == 7 else 0))
+                 for i in range(1, n_acct + 1)]
+        ts = _setup(led, sm, accts,
+                    fund=[(2, 1, 500), (4, 3, 400), (6, 5, 300),
+                          (8, 7, 200), (10, 9, 100)])
+        next_id = 1000
+        open_pendings = []  # created pending ids from PRIOR batches
+        for batch in range(6):
+            events = []
+            created_pendings = []
+            for k in range(24):
+                kind = rng.integers(0, 10)
+                tid = next_id
+                next_id += 1
+                if kind <= 1 and open_pendings:
+                    pid = int(open_pendings.pop(
+                        rng.integers(0, len(open_pendings))))
+                    events.append(Transfer(
+                        id=tid, pending_id=pid,
+                        flags=POST if kind == 0 else VOID,
+                        amount=AMOUNT_MAX if kind == 0 else 0,
+                        ledger=1, code=1))
+                    continue
+                dr_i, cr_i = rng.choice(n_acct, size=2,
+                                        replace=False) + 1
+                flags = 0
+                if kind in (2, 3):
+                    flags |= BAL_DR
+                elif kind in (4, 5):
+                    flags |= BAL_CR
+                elif kind == 6:
+                    flags |= BAL_DR | BAL_CR
+                amount = int(rng.integers(1, 120))
+                if flags and rng.integers(0, 3) == 0:
+                    amount = AMOUNT_MAX
+                if kind == 7:
+                    flags |= PENDING
+                    created_pendings.append(tid)
+                if flags & (BAL_DR | BAL_CR) and rng.integers(0, 4) == 0:
+                    flags |= PENDING
+                    created_pendings.append(tid)
+                events.append(Transfer(
+                    id=tid, debit_account_id=int(dr_i),
+                    credit_account_id=int(cr_i), amount=amount,
+                    ledger=1, code=1, flags=flags,
+                    timeout=3600 if flags & PENDING else 0))
+            _both(led, sm, events, ts)
+            ts += 100
+            created = {t.id for t in led.lookup_transfers(
+                [e.id for e in events])}
+            open_pendings.extend(i for i in created_pendings
+                                 if i in created)
+            _check_state(led, sm, range(1, n_acct + 1),
+                         [e.id for e in events])
+        assert led.fallbacks == 0
+        assert led.fixpoint_batches > 0

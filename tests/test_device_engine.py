@@ -74,13 +74,19 @@ def _batch(rng, nid, n, hard_mix=False):
                 code=int(rng.integers(0, 2)),
                 flags=LINKED if i % 11 == 0 else 0))
         elif roll < 0.8 and hard_mix:
-            # balancing_debit is an order-dependent clamp (eligibility
-            # E1): the exact host path must serve it.
-            evs.append(Transfer(
+            # Same-id duplicate pair: a same-kind id collision (E2) is
+            # a hard fallback — the exact host path must serve it.
+            # (Balancing, the previous trigger here, now runs natively
+            # on the balancing fixpoint tier.)
+            dup = Transfer(
                 id=tid, debit_account_id=int(rng.integers(1, 101)),
                 credit_account_id=1 + int(rng.integers(1, 100)),
-                amount=int(rng.integers(1, 50)), ledger=1, code=1,
-                flags=int(TransferFlags.balancing_debit)))
+                amount=int(rng.integers(1, 50)), ledger=1, code=1)
+            evs.append(dup)
+            evs.append(Transfer(
+                id=tid, debit_account_id=dup.debit_account_id,
+                credit_account_id=dup.credit_account_id,
+                amount=dup.amount, ledger=1, code=1))
         elif roll < 0.9:
             evs.append(Transfer(
                 id=tid, debit_account_id=int(rng.integers(1, 101)),
@@ -127,7 +133,7 @@ class TestDeviceEngineParity:
         _assert_state_equal(dev.state, orc.state)
 
     def test_hard_regime_and_probe_recovery(self):
-        """Hard batches (E1: balancing-flagged events) push
+        """Hard batches (E2: same-kind duplicate ids) push
         the ledger into the mirror regime; after MIRROR_PROBE_INTERVAL
         easy batches the probe returns it to the fast path — with the
         write-through mirror exact throughout."""
@@ -225,14 +231,13 @@ class TestDirtyChannels:
         # Device-push channel drained; durable channel retained.
         assert not dev.state.orphaned.dirty_dev
         assert dev.state.orphaned.dirty == set(dev.state.orphaned)
-        # Hard batch (E1: balancing flag) -> mirror apply + push; must
-        # not re-insert the fast-path orphans.
+        # Hard batch (E2: same-kind duplicate id) -> mirror apply +
+        # push; must not re-insert the fast-path orphans.
         hard = [
             Transfer(id=10**6 + 100, debit_account_id=1,
-                     credit_account_id=2, amount=1, ledger=1, code=1,
-                     flags=int(TransferFlags.balancing_debit)),
-            Transfer(id=10**6 + 101, debit_account_id=2,
-                     credit_account_id=1, amount=1, ledger=1, code=1),
+                     credit_account_id=2, amount=1, ledger=1, code=1),
+            Transfer(id=10**6 + 100, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1),
         ]
         ts += 20
         got = dev.create_transfers(hard, ts)

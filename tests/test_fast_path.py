@@ -170,27 +170,31 @@ def test_hard_batches_fall_back():
         Transfer(id=3, debit_account_id=3, credit_account_id=2, amount=6, ledger=1, code=1),
     ])
     assert d.led.fallbacks == 0 and d.led.fixpoint_batches == 1
-    # balancing flag -> fallback
+    # balancing flag -> native (the balancing fixpoint tier), no
+    # host fallback
     d.transfers([
         Transfer(id=4, debit_account_id=1, credit_account_id=2, amount=U128_MAX, ledger=1, code=1,
                  flags=int(TF.balancing_debit)),
     ])
-    # in-batch pending+post -> fallback
+    assert d.led.fallbacks == 0
+    # in-batch pending+post -> native (the in-window pending join on
+    # the fixpoint tier)
     d.transfers([
         Transfer(id=5, debit_account_id=1, credit_account_id=2, amount=5, ledger=1, code=1,
                  flags=int(TF.pending)),
         Transfer(id=6, pending_id=5, amount=U128_MAX, flags=int(TF.post_pending_transfer)),
     ])
-    # closing transfer -> fallback
+    assert d.led.fallbacks == 0
+    # closing transfer -> fallback (enters the mirror regime)
     d.transfers([
         Transfer(id=7, debit_account_id=1, credit_account_id=2, amount=1, ledger=1, code=1,
                  flags=int(TF.pending | TF.closing_debit)),
     ])
-    # void of closing pending (reopen) -> fallback
+    # void of closing pending (reopen) -> exact (rides the regime)
     d.transfers([
         Transfer(id=8, pending_id=7, flags=int(TF.void_pending_transfer)),
     ])
-    assert d.led.fallbacks >= 4
+    assert d.led.fallbacks == 2
     d.check_state()
 
 

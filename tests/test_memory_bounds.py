@@ -137,17 +137,16 @@ class TestDeviceServingBounds:
         got = sm.get_change_events(ChangeEventsFilter(limit=3))
         assert len(got) == 3
         # Hard batches (mirror path) still work after recycling.
-        from tigerbeetle_tpu.types import TransferFlags
-
+        # E2 same-kind duplicate id forces the exact path (balancing,
+        # the previous trigger here, now runs natively).
         hard = [
             Transfer(id=nid, debit_account_id=1, credit_account_id=2,
-                     amount=5, ledger=1, code=1,
-                     flags=int(TransferFlags.balancing_debit)),
-            Transfer(id=nid + 1, debit_account_id=2, credit_account_id=3,
-                     amount=1, ledger=1, code=1),
+                     amount=5, ledger=1, code=1),
+            Transfer(id=nid, debit_account_id=1, credit_account_id=2,
+                     amount=5, ledger=1, code=1),
         ]
         ts += 10
         res = sm.create_transfers(hard, ts)
-        assert [r.status.name for r in res] == ["created", "created"]
+        assert [r.status.name for r in res] == ["created", "exists"]
         assert sm.led.fallbacks == 1
         assert int(np.asarray(sm.led.state["events"]["count"])) == 0

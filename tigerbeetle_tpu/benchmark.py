@@ -271,7 +271,9 @@ def bench_config4(batches=2, n=None, account_count=64):
     led = DeviceLedger(a_cap=1 << 12, t_cap=t_cap)
     # Compile all kernel tiers now (incl. the deep-fixpoint escalation)
     # so a mid-run cascade never pays a tunnel compile inside the clock.
-    led.warm_kernels(_pad_bucket(n))
+    # No balancing tiers: the bench workloads carry no balancing flags,
+    # and tunnel-window warmup time is scarce.
+    led.warm_kernels(_pad_bucket(n), balancing=False)
     limit = int(AccountFlags.debits_must_not_exceed_credits)
     accounts = [Account(id=i, ledger=1, code=1,
                         flags=limit if i % 2 == 0 else 0)

@@ -739,7 +739,8 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
 
 def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                           per_event=None, limit_rounds=1, seg=None,
-                          ring_reset=False, imported_mode=False):
+                          ring_reset=False, imported_mode=False,
+                          balancing_mode=False):
     """One batch against the device ledger. Returns (new_state, out) where
     out = {r_status, r_ts, fallback, limit_only, created_count}. When
     out['fallback'] is set, new_state is the input state unchanged (every
@@ -786,7 +787,26 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     chain rollback rewinds the running max — reference chain_key_max),
     so imported batches containing chains fall back to the exact path;
     so do in-window pending references and potential limit breaches
-    (the fixpoint tiers are not imported-aware)."""
+    (the fixpoint tiers are not imported-aware).
+
+    balancing_mode (static, requires limit_rounds > 1): handle
+    balancing_debit/credit natively (reference :3840-3853). The clamp
+    reads the SAME pre-event balances the limit fixpoint already
+    derives each round, so it joins the iteration: round r re-derives
+    every balancing event's clamped amount from round r-1's prefix
+    balances (always clamping the NOMINAL amount — min composes, no
+    ratchet), threads those amounts into the delta lanes and the limit
+    checks, and convergence additionally requires amount stability.
+    The earliest-disagreeing-event induction is unchanged: an event
+    whose prefix is sequential truth gets exact pre-balances, hence the
+    exact clamp and statuses, and stays fixed — K rounds still resolve
+    any cascade of depth < K. Converged amounts flow into the stored
+    rows / event ring / balance application via amt_res. The one new
+    hard fallback: an in-window pending reference whose DEFINITION is
+    balancing (the substitution reads nominal event lanes, but the
+    pending's true stored amount is clamped). The E3/E4 proofs keep
+    nominal amounts — a clamp only shrinks, so both stay upper
+    bounds."""
     from .hash_table import ORPHAN_VAL, ht_plan, ht_write
 
     acc = state["accounts"]
@@ -945,6 +965,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                     & jnp.any(linked))
         e1_vec = valid & (_flag(flags, jnp.uint32(hard_flags))
                           | impchain)
+    elif balancing_mode:
+        assert limit_rounds > 1 and not spmd_legacy, \
+            "balancing_mode rides the limit fixpoint"
+        # Balancing clamps resolve inside the fixpoint; closing stays
+        # hard (closed-account gating is order-dependent with no cheap
+        # per-round form), imported has its own tier. In-window pending
+        # defs that are THEMSELVES balancing fall back: the in-window
+        # substitution reads the def's nominal event lanes, but its
+        # stored (and releasable) amount is the clamp.
+        hard_flags = _F_IMPORTED | _F_CLOSE_DR | _F_CLOSE_CR
+        e1_vec = valid & (
+            _flag(flags, jnp.uint32(hard_flags))
+            | (inwin & _flag(flags[didx],
+                             jnp.uint32(_F_BAL_DR | _F_BAL_CR))))
     else:
         hard_flags = (_F_IMPORTED | _F_BAL_DR | _F_BAL_CR
                       | _F_CLOSE_DR | _F_CLOSE_CR)
@@ -1006,8 +1040,19 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         over = (l4 > 0) | u128.lt(right_hi, right_lo, left_hi, left_lo)
         return jnp.any(limited & over)
 
-    e3 = (_breach(_acct_load(dr_rowc), "dp", "dpos", "cpos", _A_DR_LIMIT)
-          | _breach(_acct_load(cr_rowc), "cp", "cpos", "dpos", _A_CR_LIMIT))
+    if balancing_mode:
+        # The headroom proof is meaningless under balancing (nominal
+        # amounts are near-always AMOUNT_MAX) and its limit_hit output
+        # is unread by the balancing route — skip both segment-sum
+        # reductions; e3 is unconditionally overridden by the fixpoint
+        # convergence outcome below (balancing_mode implies
+        # limit_rounds > 1).
+        e3 = jnp.bool_(False)
+    else:
+        e3 = (_breach(_acct_load(dr_rowc), "dp", "dpos", "cpos",
+                      _A_DR_LIMIT)
+              | _breach(_acct_load(cr_rowc), "cp", "cpos", "dpos",
+                        _A_CR_LIMIT))
     # The headroom-proof outcome, preserved across the fixpoint override
     # below: the adaptive router drops back to the proof-gated kernel only
     # once the PROOF would pass (dropping back on "no actual breach" would
@@ -1049,7 +1094,18 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # pending) — their only consumer is the combined OR. The scalar
     # overflow terms (ovf, s4) join at the OR itself.
     hard_any = jnp.any(jnp.stack([e1_vec, e5_vec, *pair_ovfs]))
-    e145 = hard_any | ovf | (s4 > 0)
+    if balancing_mode:
+        # The E4 amount-sum proof is useless under balancing: the
+        # idiomatic AMOUNT_MAX nominal ("move everything") always trips
+        # it, while the APPLIED amounts are the clamps. The fixpoint
+        # instead evaluates the six balance-overflow statuses
+        # (reference :3856-3884) EXACTLY each round from the same
+        # pre-event balances, with clamped amounts — see the loop. The
+        # pair-overflow lanes stay as a (by-invariant never-firing)
+        # guard on pre-batch state.
+        e145 = hard_any
+    else:
+        e145 = hard_any | ovf | (s4 > 0)
 
     if limit_rounds > 1:
         # ---- order-dependent balance limits: K-round status fixpoint ----
@@ -1104,6 +1160,47 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         cr_side_s = (fperm >= N)  # static: entry index N.. = credit side
         z64_ = jnp.uint64(0)
 
+        if balancing_mode:
+            # Balancing clamp (reference :3840-3853), evaluated against
+            # a pre-event balance view. Always clamps the NOMINAL
+            # amount: min(nominal, dr_headroom?, cr_headroom?) — min
+            # composes, so recomputing from nominal each round cannot
+            # ratchet below the sequential truth.
+            bal_dr_ln = valid & ~pv & _flag(flags, _F_BAL_DR)
+            bal_cr_ln = valid & ~pv & _flag(flags, _F_BAL_CR)
+            bal_ln = bal_dr_ln | bal_cr_ln
+
+            def _bal_clamp(dr_f, cr_f):
+                # dr_f/cr_f: field name -> (hi, lo) pre-event balances
+                # of the debit / credit account.
+                a_hi, a_lo = amt_res_hi, amt_res_lo
+                b_hi, b_lo, _ = u128.add(*dr_f("dp"), *dr_f("dpos"))
+                av_hi, av_lo = u128.sat_sub(*dr_f("cpos"), b_hi, b_lo)
+                m_hi, m_lo = u128.min_(a_hi, a_lo, av_hi, av_lo)
+                a_hi = jnp.where(bal_dr_ln, m_hi, a_hi)
+                a_lo = jnp.where(bal_dr_ln, m_lo, a_lo)
+                b_hi, b_lo, _ = u128.add(*cr_f("cp"), *cr_f("cpos"))
+                av_hi, av_lo = u128.sat_sub(*cr_f("dpos"), b_hi, b_lo)
+                m_hi, m_lo = u128.min_(a_hi, a_lo, av_hi, av_lo)
+                a_hi = jnp.where(bal_cr_ln, m_hi, a_hi)
+                a_lo = jnp.where(bal_cr_ln, m_lo, a_lo)
+                return a_hi, a_lo
+
+            def _pre_fld(m):
+                # 4-limb pre-balance matrix (4 fields, 4 limbs, N) ->
+                # (hi, lo) accessor.
+                return lambda f: (
+                    m[_FI[f], 2] | (m[_FI[f], 3] << jnp.uint64(32)),
+                    m[_FI[f], 0] | (m[_FI[f], 1] << jnp.uint64(32)))
+
+            # Round-0 estimate: clamp against PRE-BATCH balances (the
+            # dr/cr account gathers) — exact for every event whose
+            # touched accounts see no earlier in-batch delta.
+            amt_fx_hi, amt_fx_lo = _bal_clamp(
+                lambda f: dr[f], lambda f: cr[f])
+        else:
+            amt_fx_hi, amt_fx_lo = amt_res_hi, amt_res_lo
+
         def _over(pre_evt, held1, held2, against, amt):
             # (held1_pre + held2_pre + amount) > against_pre, 5 limbs.
             lft = [pre_evt[_FI[held1], j] + pre_evt[_FI[held2], j] + amt[j]
@@ -1127,9 +1224,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         over_dr = jnp.zeros_like(valid)
         over_cr = jnp.zeros_like(valid)
         dead = jnp.zeros_like(valid)
+        ovf_code = jnp.zeros_like(status)  # balancing_mode: exact
+        # balance-overflow statuses (:3856-3884), 0 = none.
         fix_converged = jnp.bool_(True)
         for _round in range(limit_rounds):
-            st_r = jnp.where(over_dr, _TS["exceeds_credits"], status)
+            st_r = jnp.where(ovf_code != 0, ovf_code, status)
+            st_r = jnp.where(over_dr, _TS["exceeds_credits"], st_r)
             st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                              st_r)
             # In-window dependency deaths from the PREVIOUS round's
@@ -1171,9 +1271,19 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             pend_s = (m_s & 2) != 0
             pv_s = (m_s & 4) != 0
             post_s = (m_s & 8) != 0
-            held = [jnp.where(pend_s, al2_s[j], z64_)
+            if balancing_mode:
+                # Amounts are round-varying (the clamp): one stacked
+                # sorted-space gather of the current limbs replaces the
+                # hoisted al2_s (identical on non-balancing lanes).
+                al_ev = jnp.stack(_to_limbs(amt_fx_hi, amt_fx_lo))
+                al_use = jnp.take(
+                    jnp.concatenate([al_ev, al_ev], axis=1), fperm,
+                    axis=1)
+            else:
+                al_use = al2_s
+            held = [jnp.where(pend_s, al_use[j], z64_)
                     + jnp.where(pv_s, nl2_s[j], z64_) for j in range(4)]
-            posted = [jnp.where(reg_s | post_s, al2_s[j], z64_)
+            posted = [jnp.where(reg_s | post_s, al_use[j], z64_)
                       for j in range(4)]
             fls = jnp.stack([
                 jnp.stack([jnp.where(cr_side_s, z64_, held[j])
@@ -1197,16 +1307,79 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             pre_ev = jnp.take(pre, finv, axis=2)
             pre_dr = pre_ev[:, :, :N]
             pre_cr = pre_ev[:, :, N:]
-            new_over_dr = cand_dr & _over(pre_dr, "dp", "dpos", "cpos", alx)
-            new_over_cr = cand_cr & _over(pre_cr, "cp", "cpos", "dpos", alx)
+            if balancing_mode:
+                # Clamp FIRST, then overflow, then the limit checks —
+                # all with the clamped amount against the same
+                # pre-event balances, exactly the sequential order
+                # (reference :3840-3904).
+                amt_new_hi, amt_new_lo = _bal_clamp(
+                    _pre_fld(pre_dr), _pre_fld(pre_cr))
+                alx_r = _to_limbs(amt_new_hi, amt_new_lo)
+                amt_stable = jnp.all((amt_new_hi == amt_fx_hi)
+                                     & (amt_new_lo == amt_fx_lo))
+                amt_fx_hi, amt_fx_lo = amt_new_hi, amt_new_lo
+
+                # The six balance-overflow statuses, exact (the E4
+                # amount-sum proof is bypassed in this mode). They sit
+                # between the clamp and overflows_timeout in the
+                # sequential order, so they override a CREATED or an
+                # overflows_timeout pre-status — nothing earlier.
+                def _sum_ovf(pre_evt, f1, f2=None):
+                    lft = [pre_evt[_FI[f1], j]
+                           + (pre_evt[_FI[f2], j] if f2 else z64_)
+                           + alx_r[j] for j in range(4)]
+                    c = lft[0] >> jnp.uint64(32)
+                    c = (lft[1] + c) >> jnp.uint64(32)
+                    c = (lft[2] + c) >> jnp.uint64(32)
+                    return ((lft[3] + c) >> jnp.uint64(32)) > 0
+
+                ovf_cand = (valid & ~pv
+                            & ((status == _CREATED)
+                               | (status == _TS["overflows_timeout"])))
+                new_ovf = jnp.zeros_like(status)
+                for cond, code in reversed([
+                    (pending & _sum_ovf(pre_dr, "dp"),
+                     _TS["overflows_debits_pending"]),
+                    (pending & _sum_ovf(pre_cr, "cp"),
+                     _TS["overflows_credits_pending"]),
+                    (_sum_ovf(pre_dr, "dpos"),
+                     _TS["overflows_debits_posted"]),
+                    (_sum_ovf(pre_cr, "cpos"),
+                     _TS["overflows_credits_posted"]),
+                    (_sum_ovf(pre_dr, "dp", "dpos"),
+                     _TS["overflows_debits"]),
+                    (_sum_ovf(pre_cr, "cp", "cpos"),
+                     _TS["overflows_credits"]),
+                ]):
+                    new_ovf = jnp.where(ovf_cand & cond, code, new_ovf)
+                no_ovf = new_ovf == 0
+            else:
+                alx_r = alx
+                amt_stable = jnp.bool_(True)
+                new_ovf = ovf_code
+                no_ovf = jnp.bool_(True)
+            new_over_dr = (cand_dr & no_ovf
+                           & _over(pre_dr, "dp", "dpos", "cpos", alx_r))
+            new_over_cr = (cand_cr & no_ovf
+                           & _over(pre_cr, "cp", "cpos", "dpos", alx_r))
             fix_converged = jnp.all((new_over_dr == over_dr)
                                     & (new_over_cr == over_cr)
-                                    & (new_dead == dead))
+                                    & (new_ovf == ovf_code)
+                                    & (new_dead == dead)) & amt_stable
             over_dr, over_cr, dead = new_over_dr, new_over_cr, new_dead
+            ovf_code = new_ovf
+        status = jnp.where(ovf_code != 0, ovf_code, status)
         status = jnp.where(over_dr, _TS["exceeds_credits"], status)
         status = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                            status)
         status = jnp.where(dead, status_dead, status)
+        if balancing_mode:
+            # Converged clamped amounts become the applied/stored
+            # amounts: row inserts, the event ring's amt (areq keeps
+            # the nominal), the application delta lanes, and the
+            # balancing exists-comparison all read amt_res downstream.
+            amt_res_hi = jnp.where(bal_ln, amt_fx_hi, amt_res_hi)
+            amt_res_lo = jnp.where(bal_ln, amt_fx_lo, amt_res_lo)
         e3 = ~fix_converged
 
     # ---------------- chains: segment first-failure broadcast ----------------
@@ -1639,6 +1812,23 @@ LIMIT_FIXPOINT_ROUNDS_DEEP = 32
 create_transfers_fixpoint_deep_jit = jax.jit(
     functools.partial(create_transfers_fast,
                       limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP),
+    donate_argnums=0)
+
+# Balancing tier (reference :3840-3853): balancing_debit/credit clamps
+# ride the limit fixpoint — per-round clamped amounts from the exact
+# prefix balances (see the balancing_mode docstring). Selected by the
+# ledger's host pre-route when a batch carries balancing flags; its
+# fallbacks (closing flags, deep cascades, balancing in-window defs) go
+# to the exact host path via the same shallow->deep ladder as limits.
+create_transfers_balancing_jit = jax.jit(
+    functools.partial(create_transfers_fast,
+                      limit_rounds=LIMIT_FIXPOINT_ROUNDS,
+                      balancing_mode=True),
+    donate_argnums=0)
+create_transfers_balancing_deep_jit = jax.jit(
+    functools.partial(create_transfers_fast,
+                      limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP,
+                      balancing_mode=True),
     donate_argnums=0)
 
 # Tiny on-device accumulator for back-to-back batch drivers: summing

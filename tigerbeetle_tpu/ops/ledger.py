@@ -489,6 +489,24 @@ def _has_imported(evs) -> bool:
     return any((np.asarray(e["flags"]) & bit).any() for e in evs)
 
 
+_F_BAL_HOST_BITS = None
+
+
+def _F_BALANCING_HOST() -> int:
+    global _F_BAL_HOST_BITS
+    if _F_BAL_HOST_BITS is None:
+        from ..types import TransferFlags
+
+        _F_BAL_HOST_BITS = int(TransferFlags.balancing_debit
+                               | TransferFlags.balancing_credit)
+    return _F_BAL_HOST_BITS
+
+
+def _has_balancing(evs) -> bool:
+    bit = np.uint32(_F_BALANCING_HOST())
+    return any((np.asarray(e["flags"]) & bit).any() for e in evs)
+
+
 _F_A_IMP_HOST = None
 
 
@@ -770,6 +788,7 @@ class DeviceLedger:
         self.deep_fixpoint_batches = 0
         self.window_fallbacks = 0
         self._deep_first = 0
+        self._bal_deep_first = 0
         # Adaptive kernel routing: after a batch resolves breaches via the
         # limit fixpoint, later batches dispatch the fixpoint kernel first
         # (skipping the headroom-proof attempt that would fail anyway)
@@ -956,7 +975,8 @@ class DeviceLedger:
             # Pv-free windows fetch HALF the delta (event snapshots
             # only): the transfer/der columns are host-reconstructible
             # from the inputs — the drain moves ~half the bytes.
-            excl = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST())
+            excl = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST()
+                             | _F_BALANCING_HOST())
             e_only = all(
                 not (np.asarray(ev["flags"]) & excl).any()
                 for ev in evs)
@@ -1180,32 +1200,46 @@ class DeviceLedger:
         return [self.create_transfers_soa(ev, ts)
                 for ev, ts in zip(evs, timestamps)]
 
-    def _escalate_fixpoint(self, evp, timestamp, n):
+    def _escalate_fixpoint(self, evp, timestamp, n, balancing=False):
         """The 8-round fixpoint reported a limit cascade deeper than its
         budget (and no other obstacle): resolve it on device with the
         32-round variant before considering the host path. Returns
-        (fallback, out) from the deep run and enters the deep-first
-        regime (the shallow dispatch is a known waste while cascades
-        stay deep)."""
-        from .fast_kernels import create_transfers_fixpoint_deep_jit
+        (fallback, out) from the deep run and enters the matching
+        deep-first regime (the shallow dispatch is a known waste while
+        cascades stay deep). balancing selects the balancing deep tier
+        and its own regime counter."""
+        from .fast_kernels import (
+            create_transfers_balancing_deep_jit,
+            create_transfers_fixpoint_deep_jit,
+        )
 
-        new_state, deep_out = create_transfers_fixpoint_deep_jit(
+        deep = (create_transfers_balancing_deep_jit if balancing
+                else create_transfers_fixpoint_deep_jit)
+        new_state, deep_out = deep(
             self.state, evp, np.uint64(timestamp), np.int32(n))
         self.state = new_state
         self.deep_fixpoint_batches += 1
-        self._deep_first = self.DEEP_PROBE_INTERVAL
+        if balancing:
+            self._bal_deep_first = self.DEEP_PROBE_INTERVAL
+        else:
+            self._deep_first = self.DEEP_PROBE_INTERVAL
         return bool(deep_out["fallback"]), deep_out
 
-    def warm_kernels(self, n_pad: int = N_PAD) -> None:
+    def warm_kernels(self, n_pad: int = N_PAD,
+                     balancing: bool = True) -> None:
         """Compile every transfer-kernel variant (fast / fixpoint /
-        deep fixpoint) at the given padded shape with an all-invalid
-        batch — no state change, no events created. Drivers call this
-        once so a mid-run escalation never pays a tunnel compile inside
-        a timed region."""
+        deep fixpoint, plus the balancing tiers unless balancing=False)
+        at the given padded shape with an all-invalid batch — no state
+        change, no events created. Drivers call this once so a mid-run
+        escalation never pays a tunnel compile inside a timed region;
+        the bench passes balancing=False (its workloads carry no
+        balancing flags, and tunnel-window warmup time is scarce)."""
         import jax
 
         from .batch import transfers_to_arrays
         from .fast_kernels import (
+            create_transfers_balancing_deep_jit,
+            create_transfers_balancing_jit,
             create_transfers_fast_jit,
             create_transfers_fixpoint_deep_jit,
             create_transfers_fixpoint_jit,
@@ -1215,9 +1249,14 @@ class DeviceLedger:
 
         evp = pad_transfer_events(transfers_to_arrays([]), n_pad)
         evp = {k: jax.device_put(v) for k, v in evp.items()}
-        for f in (create_transfers_fast_jit, create_transfers_fixpoint_jit,
-                  create_transfers_fixpoint_deep_jit,
-                  create_transfers_imported_jit):
+        variants = [create_transfers_fast_jit,
+                    create_transfers_fixpoint_jit,
+                    create_transfers_fixpoint_deep_jit,
+                    create_transfers_imported_jit]
+        if balancing:
+            variants += [create_transfers_balancing_jit,
+                         create_transfers_balancing_deep_jit]
+        for f in variants:
             self.state, out = f(self.state, evp, np.uint64(1), np.int32(0))
             assert not bool(out["fallback"])
 
@@ -1255,6 +1294,34 @@ class DeviceLedger:
                 self.state, evp, np.uint64(timestamp), np.int32(n))
             self.state = new_state
             fallback = bool(jax.device_get(out["fallback"]))
+        elif _has_balancing([ev]):
+            # Balancing clamps are order-dependent through the prefix
+            # balances: route straight to the balancing fixpoint tier
+            # (the plain kernel would hard-fall-back). Same
+            # shallow->deep ladder + deep-first hysteresis as the limit
+            # tiers.
+            from .fast_kernels import (
+                create_transfers_balancing_deep_jit,
+                create_transfers_balancing_jit,
+            )
+
+            if self._bal_deep_first > 0:
+                self._bal_deep_first -= 1
+                new_state, out = create_transfers_balancing_deep_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                self.deep_fixpoint_batches += 1
+                fallback = bool(jax.device_get(out["fallback"]))
+            else:
+                new_state, out = create_transfers_balancing_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                fallback = bool(jax.device_get(out["fallback"]))
+                if fallback and bool(out["fix_unconverged"]):
+                    fallback, out = self._escalate_fixpoint(
+                        evp, timestamp, n, balancing=True)
+            if not fallback:
+                self.fixpoint_batches += 1
         elif self._fixpoint_first:
             # The workload has been breaching balance limits: skip the
             # doomed headroom-proof dispatch and go straight to the
@@ -1826,9 +1893,12 @@ class DeviceLedger:
         per = [self._batch_delta_stats(ev, st_np)
                for ev, st_np in zip(evs, st_slices)]
         # Half-width synthesis requires: no post/void (amounts/fields
-        # inherit from pendings on device) and no imported events (their
-        # stored timestamps are the USER's, not the ts_event formula).
-        excl_bits = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST())
+        # inherit from pendings on device), no imported events (their
+        # stored timestamps are the USER's, not the ts_event formula),
+        # and no balancing (stored amounts are the device's clamp, not
+        # the input's nominal amount).
+        excl_bits = np.uint32(_F_POST_VOID_HOST() | _F_IMPORTED_HOST()
+                              | _F_BALANCING_HOST())
         e_only = timestamps is not None and all(
             not (np.asarray(ev["flags"]) & excl_bits).any() for ev in evs)
 
