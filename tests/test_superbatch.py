@@ -367,3 +367,122 @@ def test_varying_batch_sizes():
         batches.append(trs)
     tss = [TS + 1000 + b * (PAD + 10) for b in range(3)]
     _diff_case(batches, tss)
+
+
+def test_balancing_window():
+    """Balancing clamps whose cascades span prepare boundaries run
+    natively on the balancing super tier, bit-exact vs sequential
+    dispatches of the per-batch balancing kernel (amounts re-derived
+    from exact prefix balances across the WHOLE window)."""
+    from tigerbeetle_tpu.ops.fast_kernels import (
+        create_transfers_balancing_jit,
+        create_transfers_super_balancing_jit,
+    )
+
+    AMOUNT_MAX = (1 << 128) - 1
+    BAL_DR = int(TF.balancing_debit)
+    BAL_CR = int(TF.balancing_credit)
+    PEND = int(TF.pending)
+
+    state = _fresh_state()
+    # Fund: account 1 gets 300 credits, account 3 gets 120 debits.
+    fund = [Transfer(id=900, debit_account_id=2, credit_account_id=1,
+                     amount=300, ledger=1, code=1),
+            Transfer(id=901, debit_account_id=3, credit_account_id=4,
+                     amount=120, ledger=1, code=1)]
+    ev = {k: jax.device_put(v) for k, v in pad_transfer_events(
+        transfers_to_arrays(fund), PAD).items()}
+    state, out = create_transfers_fast_jit(
+        state, ev, np.uint64(TS + 500), np.int32(2))
+    assert not bool(out["fallback"])
+
+    batches = [
+        # prepare 1: sweep most of account 1's headroom, hold some.
+        [Transfer(id=1000, debit_account_id=1, credit_account_id=5,
+                  amount=200, ledger=1, code=1, flags=BAL_DR),
+         Transfer(id=1001, debit_account_id=1, credit_account_id=5,
+                  amount=AMOUNT_MAX, ledger=1, code=1,
+                  flags=BAL_DR | PEND, timeout=3600)],
+        # prepare 2: the clamp here must see prepare 1's effects: zero
+        # headroom left on 1; balancing_credit into 3 clamps at 120.
+        [Transfer(id=1010, debit_account_id=1, credit_account_id=5,
+                  amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+         Transfer(id=1011, debit_account_id=6, credit_account_id=3,
+                  amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_CR)],
+        # prepare 3: both flags; headroom restored by new funding.
+        [Transfer(id=1020, debit_account_id=5, credit_account_id=1,
+                  amount=50, ledger=1, code=1),
+         Transfer(id=1021, debit_account_id=1, credit_account_id=3,
+                  amount=AMOUNT_MAX, ledger=1, code=1,
+                  flags=BAL_DR | BAL_CR)],
+    ]
+    tss = [TS + 1000 + b * (PAD + 10) for b in range(3)]
+
+    # Sequential arm on the per-batch balancing tier.
+    seq_state = _copy(state)
+    seq_outs = []
+    for tr, ts in zip(batches, tss):
+        evb = {k: jax.device_put(v) for k, v in pad_transfer_events(
+            transfers_to_arrays(tr), PAD).items()}
+        seq_state, o = create_transfers_balancing_jit(
+            seq_state, evb, np.uint64(ts), np.int32(len(tr)))
+        assert not bool(o["fallback"]), "sequential balancing arm fell back"
+        seq_outs.append(o)
+
+    ev_s, seg = stack_superbatch(
+        [transfers_to_arrays(tr) for tr in batches], tss, PAD)
+    ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
+    seg = {k: jax.device_put(v) for k, v in seg.items()}
+    sup_state, sup_out = create_transfers_super_balancing_jit(
+        _copy(state), ev_s, seg)
+    _assert_equal(seq_state, seq_outs, sup_state, sup_out, len(batches))
+
+
+def test_balancing_window_through_ledger_vs_oracle():
+    """create_transfers_window with balancing prepares: native (no
+    window fallback), results and balances identical to the oracle fed
+    the same prepares sequentially."""
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+
+    AMOUNT_MAX = (1 << 128) - 1
+    BAL_DR = int(TF.balancing_debit)
+
+    led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 12)
+    sm = StateMachineOracle()
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 9)]
+    for eng in (led, sm):
+        r = eng.create_accounts(accts, TS)
+        assert all(x.status.name == "created" for x in r)
+    fund = [Transfer(id=900, debit_account_id=2, credit_account_id=1,
+                     amount=100, ledger=1, code=1)]
+    got = led.create_transfers(fund, TS + 500)
+    want = sm.create_transfers(fund, TS + 500)
+    assert [(r.timestamp, r.status) for r in got] == \
+           [(r.timestamp, r.status) for r in want]
+
+    batches = [
+        [Transfer(id=1000, debit_account_id=1, credit_account_id=5,
+                  amount=60, ledger=1, code=1, flags=BAL_DR)],
+        [Transfer(id=1010, debit_account_id=1, credit_account_id=5,
+                  amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR),
+         Transfer(id=1011, debit_account_id=1, credit_account_id=5,
+                  amount=AMOUNT_MAX, ledger=1, code=1, flags=BAL_DR)],
+    ]
+    tss = [TS + 1000, TS + 1000 + PAD + 10]
+    evs = [transfers_to_arrays(tr) for tr in batches]
+    res = led.create_transfers_window(evs, tss)
+    assert res is not None and led.window_fallbacks == 0
+    flat = []
+    for (st, ts_arr), tr in zip(res, batches):
+        flat += [(int(t), int(s)) for s, t in zip(st, ts_arr)]
+    want = []
+    for tr, ts in zip(batches, tss):
+        want += [(r.timestamp, int(r.status))
+                 for r in sm.create_transfers(tr, ts)]
+    assert flat == want
+    # Clamp cascade across the window: 60, then 40, then 0.
+    assert [t.amount for t in led.lookup_transfers([1000, 1010, 1011])] \
+        == [60, 40, 0]
+    a_led = {a.id: a for a in led.lookup_accounts([1, 5])}
+    a_sm = {a.id: a for a in sm.lookup_accounts([1, 5])}
+    assert a_led == a_sm

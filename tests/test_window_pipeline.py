@@ -232,3 +232,97 @@ def test_statemachine_pipelined_replies_match_sync():
         all_replies_p.extend(rec["replies"])
     assert all_replies_p == all_replies_s
     assert sm_p.state.transfers == sm_s.state.transfers
+
+
+def test_pipeline_balancing_windows():
+    """Balancing windows ride the pipelined serving path natively (the
+    balancing ring super tier): results and final state bit-identical
+    to the sync window path AND to an oracle fed the same prepares —
+    including a poisoned window mid-pipeline (the prev_fb chain through
+    the balancing branch) and clamped amounts in the write-through
+    flush columns."""
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+
+    BAL_DR = int(TransferFlags.balancing_debit)
+    BAL_CR = int(TransferFlags.balancing_credit)
+
+    rng = np.random.default_rng(7)
+    nid = 3 * 10**6
+    ts = 10**12
+    windows = []
+    for w in range(4):
+        evs, tss = [], []
+        for b in range(3):
+            batch = []
+            for i in range(48):
+                dr = int(rng.integers(1, 65))
+                flags = (BAL_DR if i % 3 == 0
+                         else (BAL_CR if i % 7 == 0 else 0))
+                amt = (U128MAX if (flags and i % 6 == 0)
+                       else int(rng.integers(1, 100)))
+                batch.append(Transfer(
+                    id=nid, debit_account_id=dr,
+                    credit_account_id=dr % 64 + 1, amount=amt,
+                    ledger=1, code=1, flags=flags))
+                nid += 1
+            if w == 2 and b == 1:
+                # duplicate id within the batch: hard fallback (E2)
+                batch[-1] = Transfer(
+                    id=batch[0].id, debit_account_id=1,
+                    credit_account_id=2, amount=1, ledger=1, code=1)
+            ts += 70
+            evs.append(batch)
+            tss.append(ts)
+        windows.append((evs, tss))
+
+    def mk_serving():
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13,
+                           write_through=StateMachineOracle())
+        led.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+        led.recycle_events = True
+        led.retain_flush_columns = True
+        return led
+
+    led_p = mk_serving()
+    led_s = mk_serving()
+    orc = StateMachineOracle()
+    r = orc.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+    assert all(x.status.name == "created" for x in r)
+
+    pending = []
+    for evs, tss in windows:
+        arrays = [transfers_to_arrays(b) for b in evs]
+        tk = led_p.submit_window(arrays, tss)
+        if tk is None:
+            led_p.resolve_windows()
+            pending.clear()
+            led_p.create_transfers_window(arrays, tss)
+            continue
+        pending.append(tk)
+        if len(pending) > 1:
+            led_p.resolve_windows(count=1)
+            pending = [t for t in pending if t.results is None]
+    led_p.resolve_windows()
+    for evs, tss in windows:
+        led_s.create_transfers_window(
+            [transfers_to_arrays(b) for b in evs], tss)
+        for b, tsb in zip(evs, tss):
+            orc.create_transfers(b, tsb)
+
+    led_p.drain_mirror()
+    led_s.drain_mirror()
+    cols_p = led_p.take_flush_columns()
+    cols_s = led_s.take_flush_columns()
+    assert len(cols_p) == len(cols_s)
+    for cp, cs in zip(cols_p, cols_s):
+        assert cp[3] == cs[3]  # n_new per chunk
+        if cp[3]:
+            # Clamped (not nominal) amounts must flow through capture.
+            for key in ("id_hi", "id_lo", "ts", "flags",
+                        "amt_hi", "amt_lo"):
+                np.testing.assert_array_equal(
+                    np.asarray(cp[0][key]), np.asarray(cs[0][key]))
+    _state_eq(led_p.mirror, led_s.mirror)
+    _state_eq(led_p.mirror, orc)

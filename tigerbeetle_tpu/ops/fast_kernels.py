@@ -1792,6 +1792,35 @@ LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP = 24
 create_transfers_super_deep_jit = jax.jit(
     _create_transfers_super_deep, donate_argnums=0)
 
+
+def _create_transfers_super_balancing(state, ev, seg,
+                                      force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg,
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP,
+        balancing_mode=True)
+
+
+def _create_transfers_super_balancing_ring(state, ev, seg,
+                                           force_fallback=None):
+    return create_transfers_fast(
+        state, ev, jnp.uint64(0), jnp.int32(0),
+        force_fallback=force_fallback, seg=seg,
+        limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP,
+        balancing_mode=True, ring_reset=True)
+
+
+# Balancing superbatch tiers: commit windows whose prepares carry
+# balancing_debit/credit clamps run natively at the deep-window round
+# budget (clamp cascades stack across prepares exactly like limit
+# waves; an unconverged window falls back to the per-batch balancing
+# ladder). Selected by the window routers' host pre-check.
+create_transfers_super_balancing_jit = jax.jit(
+    _create_transfers_super_balancing, donate_argnums=0)
+create_transfers_super_balancing_ring_jit = jax.jit(
+    _create_transfers_super_balancing_ring, donate_argnums=0)
+
 # The order-dependent-limits variant: resolves headroom-proof breaches
 # natively with a K-round status fixpoint (cascades deeper than K
 # limit-decision waves fall back to the exact host path; each wave needs

@@ -953,7 +953,15 @@ class DeviceLedger:
         # ring from offset 0 per window, so the pipeline never needs a
         # host recycle barrier.
         ring = self._wt and self.recycle_events
-        if deep:
+        if _has_balancing(evs):
+            from .fast_kernels import (
+                create_transfers_super_balancing_jit,
+                create_transfers_super_balancing_ring_jit,
+            )
+
+            jitfn = (create_transfers_super_balancing_ring_jit if ring
+                     else create_transfers_super_balancing_jit)
+        elif deep:
             jitfn = (create_transfers_super_deep_ring_jit if ring
                      else create_transfers_super_deep_jit)
         else:
@@ -1145,8 +1153,11 @@ class DeviceLedger:
             # in-window pending references or the workload has been
             # breaching limits (the shallow dispatch is a known waste) —
             # one numpy key-merge vs an ~800 ms wasted chip dispatch.
+            # The key-merge is skipped when a flag pre-route (imported /
+            # balancing, both cheap host scans) decides the tier anyway.
             imported = _has_imported(evs)
-            deep_first = (not imported
+            balancing = not imported and _has_balancing(evs)
+            deep_first = (not imported and not balancing
                           and (self._fixpoint_first
                                or _window_has_pend_refs(ev_s)))
             ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
@@ -1157,6 +1168,18 @@ class DeviceLedger:
                 )
 
                 new_state, out = create_transfers_super_imported_jit(
+                    self.state, ev_s, seg)
+                self.state = new_state
+            elif balancing:
+                # Balancing windows run natively at the deep-window
+                # budget (their NORMAL tier — not counted as deep
+                # escalations); an unconverged window falls back below
+                # to the per-batch balancing ladder (exact semantics).
+                from .fast_kernels import (
+                    create_transfers_super_balancing_jit,
+                )
+
+                new_state, out = create_transfers_super_balancing_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
             elif deep_first:
