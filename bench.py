@@ -36,6 +36,17 @@ import time
 REPO = os.path.dirname(os.path.abspath(__file__))
 T0 = time.time()
 
+# Persistent executable cache for every on-chip child (bench inner runs
+# and the watcher's probes share it): tunnel windows are scarce and the
+# superbatch kernels take minutes to compile remotely — a cache hit in a
+# later window turns the recompile into a disk read. Harmless if the
+# axon PJRT plugin doesn't support serialization (JAX logs and compiles
+# as usual).
+CACHE_ENV = {
+    "JAX_COMPILATION_CACHE_DIR": os.path.join(REPO, "scratch", "xla_cache"),
+    "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "5",
+}
+
 
 def _budget() -> float:
     return float(os.environ.get("BENCH_WATCHDOG_S", "1500"))
@@ -89,6 +100,9 @@ def _pinned_env(platform: str) -> dict:
     env.pop("BENCH_PLATFORM", None)
     if platform != "axon":
         env.pop("PALLAS_AXON_POOL_IPS", None)
+    else:
+        for k, v in CACHE_ENV.items():
+            env.setdefault(k, v)
     return env
 
 
@@ -170,6 +184,11 @@ def run_bench(platform: str, timeout_s: float) -> dict:
     import threading
 
     env = _pinned_env(platform)
+    # The child deadlines ITSELF (watchdog thread -> clean exit, see
+    # inner_main) well before the parent's SIGKILL backstop: a mid-RPC
+    # kill of an axon client can take the tunnel relay down with it
+    # (observed 20260802).
+    env.setdefault("BENCH_INNER_DEADLINE_S", str(max(30.0, timeout_s)))
     # stderr goes to a temp file (not a pipe): a verbose child must never
     # deadlock on a full pipe buffer while the parent reads stdout.
     with tempfile.TemporaryFile(mode="w+") as errf:
@@ -180,7 +199,7 @@ def run_bench(platform: str, timeout_s: float) -> dict:
         )
         partial: dict = {}
         final: dict | None = None
-        deadline = time.time() + timeout_s
+        deadline = time.time() + timeout_s + 90.0
 
         def _kill_at_deadline():
             while proc.poll() is None:
@@ -228,6 +247,26 @@ def inner_main() -> None:
         import jax
 
         jax.config.update("jax_platforms", platform)
+    if os.environ.get("BENCH_INNER_DEADLINE_S"):
+        # Self-deadline via watchdog thread: the already-streamed
+        # ##bench lines stand and the process exits before the parent's
+        # SIGKILL backstop can fire mid-RPC (killing an axon client
+        # mid-RPC coincided with losing the whole tunnel relay on
+        # 20260802). A thread, not SIGALRM: a signal handler cannot
+        # preempt a main thread blocked inside a PJRT C call.
+        import threading
+
+        _deadline = time.time() + float(
+            os.environ["BENCH_INNER_DEADLINE_S"])
+
+        def _inner_watchdog():
+            while time.time() < _deadline:
+                time.sleep(5.0)
+            print("##bench " + json.dumps(
+                {"inner_deadline_hit": True}), flush=True)
+            os._exit(4)
+
+        threading.Thread(target=_inner_watchdog, daemon=True).start()
     from tigerbeetle_tpu.benchmark import (
         BASELINE_TPS,
         TARGET_TPS,

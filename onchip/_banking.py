@@ -1,0 +1,81 @@
+"""Shared incremental-banking harness for on-chip probes.
+
+Doctrine (learned 20260802, the hard way): a probe's artifact must land
+no matter how the measurement dies, and the watcher's SIGKILL backstop
+must never fire mid-RPC — killing an axon client mid-call coincided
+with losing the whole tunnel relay. Every probe therefore
+
+  * dumps its result dict atomically after every completed arm,
+  * resumes from the existing artifact instead of re-measuring arms,
+  * self-deadlines via a watchdog THREAD (a SIGALRM handler cannot
+    preempt a main thread blocked inside a PJRT C call) that banks a
+    snapshot and exits hard, strictly before the watcher's timeout.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+
+def make_dumper(res: dict, out_path: str):
+    """Atomic, thread-safe-enough artifact writer.
+
+    Per-writer tmp names keep the watchdog thread and the main thread
+    from interleaving into one file; the watchdog always dumps a
+    SNAPSHOT so the main thread's json.dump never races a mutation.
+    """
+
+    def dump(snapshot: dict | None = None) -> None:
+        snapshot = dict(res) if snapshot is None else snapshot
+        tmp = f"{out_path}.tmp{os.getpid()}-{threading.get_ident()}"
+        json.dump(snapshot, open(tmp, "w"), indent=2)
+        os.replace(tmp, out_path)
+
+    return dump
+
+
+def resume_from(out_path: str, res: dict, keep=lambda k: True) -> None:
+    """Seed `res` with previously banked arms so a re-run resumes
+    instead of regressing the artifact (keys chosen by `keep`; control
+    keys like complete/alarm/error/verdict are never carried over)."""
+    drop = {"complete", "alarm", "error", "verdict", "deadline_hit"}
+    try:
+        with open(out_path) as f:
+            old = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return
+    if not isinstance(old, dict):
+        return
+    for k, v in old.items():
+        if k not in drop and k not in res and keep(k):
+            res[k] = v
+
+
+def start_watchdog(deadline_env: str, default_s: float, on_deadline,
+                   grace_s: float = 0.0) -> float:
+    """Start the hard-exit watchdog; returns the monotonic deadline.
+
+    `on_deadline()` runs in the watchdog thread at deadline+grace: it
+    must bank a snapshot itself; then the process exits(4). `grace_s`
+    gives a probe's own in-loop deadline checks first shot at a clean
+    between-arms exit.
+    """
+    deadline = time.monotonic() + float(
+        os.environ.get(deadline_env, str(default_s)))
+
+    def _watchdog():
+        while time.monotonic() < deadline + grace_s:
+            time.sleep(5.0)
+        try:
+            on_deadline()
+        finally:
+            # The hard exit must survive a failing callback (e.g. a
+            # dict-changed-size race while snapshotting): blocking past
+            # the deadline reinstates the SIGKILL-mid-RPC hazard.
+            os._exit(4)
+
+    threading.Thread(target=_watchdog, daemon=True).start()
+    return deadline

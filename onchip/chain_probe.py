@@ -28,6 +28,7 @@ jax.config.update("jax_enable_x64", True)
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # _banking
 
 from tigerbeetle_tpu.benchmark import N, _make_ledger, _soa
 from tigerbeetle_tpu.ops import fast_kernels as fk
@@ -99,20 +100,92 @@ def main():
     evs_per_window = STACK * N
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "chain_probe_result.json")
+
+    from _banking import make_dumper, resume_from, start_watchdog
+
+    # Resume: arms banked by an earlier (deadline-cut) run are carried
+    # over and skipped, so a re-run extends the artifact instead of
+    # regressing it.
+    resume_from(out_path, res,
+                keep=lambda k: k.startswith(("seq_w1_", "chain_w")))
+    dump = make_dumper(res, out_path)
+
+    def verdict(target=None):
+        target = res if target is None else target
+        # Only the measured arms (chain_wN_tps) — NOT best_chain_tps,
+        # which an earlier verdict() call may have written (the watchdog
+        # can re-enter verdict() on a snapshot taken after finally).
+        chain_arms = [v for k, v in target.items()
+                      if k.startswith("chain_w") and k.endswith("_tps")
+                      and v is not None]
+        seq = target.get("seq_w1_tps", 0)
+        if not chain_arms:
+            # A deadline-cut run with zero chain arms must not bank a
+            # definitive negative for the round's central claim.
+            target["verdict"] = "INSUFFICIENT DATA: no chain arm completed"
+            target["best_chain_tps"] = None
+            return
+        chain_tps = max(chain_arms)
+        target["verdict"] = (
+            "WHOLE-PROGRAM AMORTIZES on the real kernel"
+            if seq and chain_tps > 1.5 * seq else
+            "whole-program chain does NOT beat sequential dispatch here")
+        target["best_chain_tps"] = chain_tps
+
+    def _on_deadline():
+        # Work on a snapshot: mutating res while the main thread is
+        # mid-json.dump would corrupt BOTH writers' output.
+        snap = dict(res)
+        snap["alarm"] = "watchdog: deadline exceeded mid-call"
+        verdict(snap)
+        dump(snap)
+
+    # Self-deadline (see onchip/_banking.py doctrine): the in-loop
+    # deadline ends the probe between arms; the watchdog thread is the
+    # backstop for a single over-budget blocking compile.
+    deadline = start_watchdog("PROBE_DEADLINE_S", 2700.0, _on_deadline,
+                              grace_s=60.0)
+
     try:
         bi = 0
+        # Sequential baseline FIRST (it reuses the bench's already-
+        # proven kernel shape and anchors every later ratio even if the
+        # window closes mid-probe). Resumed runs skip it.
+        if "seq_w1_tps" not in res:
+            try:
+                led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+                warm, bi = mk_windows(1, bi)
+                t_c0 = time.perf_counter()
+                led.state, _ = run_seq(led.state, warm)
+                res["seq_w1_compile_s"] = round(
+                    time.perf_counter() - t_c0, 1)
+                runs = []
+                for _ in range(3):
+                    ws, bi = mk_windows(1, bi)
+                    led.state, dt = run_seq(led.state, ws)
+                    runs.append(dt)
+                res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
+                res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
+            except Exception as e:  # noqa: BLE001
+                res["seq_w1_error"] = repr(e)[:300]
+            dump()
         # Fresh ledger per measured run: W=8 appends 2.1M rows per run,
         # so a shared ledger would fill its transfer store mid-probe and
         # every later dispatch would hard-fallback (capacity, not the
         # kernel, would be measured). id streams never repeat across
         # ledgers (bi keeps advancing), so dup checks stay cold.
+        # Scan-form only: wholeprog_probe's banked verdict (20260802)
+        # says the scan form amortizes, and the unrolled programs are
+        # what blew the first run's compile budget.
         for fname, fn in (
-                ("chain", fk.create_transfers_chain_jit),
-                ("unroll", fk.create_transfers_chain_unrolled_jit)):
+                ("chain", fk.create_transfers_chain_jit),):
             for W in (2, 4, 8):
-                if fname == "unroll" and W > 4:
-                    continue  # compile grows with W; 4 settles it
                 key = f"{fname}_w{W}"
+                if key + "_tps" in res:
+                    continue  # banked by an earlier run
+                if time.monotonic() > deadline:
+                    res["deadline_hit"] = f"before {key}"
+                    break
                 try:
                     led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
                     warmw, bi = mk_windows(W, bi)
@@ -133,36 +206,20 @@ def main():
                         W * evs_per_window / best, 1)
                 except Exception as e:  # noqa: BLE001 — record, go on
                     res[key + "_error"] = repr(e)[:300]
-        # Sequential baseline, same session.
-        try:
-            led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
-            warm, bi = mk_windows(1, bi)
-            led.state, _ = run_seq(led.state, warm)
-            runs = []
-            for _ in range(3):
-                ws, bi = mk_windows(1, bi)
-                led.state, dt = run_seq(led.state, ws)
-                runs.append(dt)
-            res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
-            res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
-        except Exception as e:  # noqa: BLE001
-            res["seq_w1_error"] = repr(e)[:300]
+                dump()
 
-        chain_tps = max([v for k, v in res.items()
-                         if k.endswith("_tps")
-                         and not k.startswith("seq")] or [0])
-        seq = res.get("seq_w1_tps", 0)
-        res["verdict"] = (
-            "WHOLE-PROGRAM AMORTIZES on the real kernel"
-            if seq and chain_tps > 1.5 * seq else
-            "whole-program chain does NOT beat sequential dispatch here")
-        res["best_chain_tps"] = chain_tps
+        if "deadline_hit" not in res and "alarm" not in res:
+            # The watcher re-runs this probe in later windows until a
+            # COMPLETE artifact lands (partial ones bank data but must
+            # not suppress the remaining arms).
+            res["complete"] = True
     finally:
         # The artifact lands no matter how the measurement dies
         # (docstring contract: "writes chain_probe_result.json either
         # way").
+        verdict()
         print(json.dumps(res, indent=1))
-        json.dump(res, open(out_path, "w"), indent=2)
+        dump()
 
 
 if __name__ == "__main__":

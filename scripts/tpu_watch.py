@@ -52,16 +52,34 @@ def probe() -> bool:
     return out.returncode == 0 and "PROBE_OK" in out.stdout
 
 
+# One source of truth for the shared persistent-compile-cache env
+# (remote compiles are the dominant cost of a window; a cache hit in a
+# later window skips them).
+sys.path.insert(0, REPO)
+from bench import CACHE_ENV  # noqa: E402
+
+
+QUICK_TIMEOUT_S = 1200.0  # round-3 quick captures completed in <5 min
+
+
 def capture(quick: bool) -> dict | None:
+    # The quick capture runs at the head of the first window and must
+    # not gamble the whole window: it gets a 20-minute budget (4x its
+    # historical cost), the full bench the 40-minute one.
+    budget = QUICK_TIMEOUT_S if quick else BENCH_TIMEOUT_S
     env = dict(os.environ, BENCH_PLATFORM="axon",
-               BENCH_WATCHDOG_S=str(int(BENCH_TIMEOUT_S - 60)))
+               BENCH_WATCHDOG_S=str(int(budget - 60)),
+               **CACHE_ENV)
     if quick:
         env["BENCH_QUICK"] = "1"
     try:
         out = subprocess.run(
             [sys.executable, os.path.join(REPO, "bench.py")],
             env=env, capture_output=True, text=True,
-            timeout=BENCH_TIMEOUT_S, cwd=REPO,
+            # The driver kills its own wedged child at watchdog+90s and
+            # then exits with the partial record; this outer SIGKILL is
+            # a pure backstop and must come strictly AFTER that.
+            timeout=budget + 240, cwd=REPO,
         )
     except subprocess.TimeoutExpired:
         return None
@@ -100,15 +118,50 @@ def commit_artifact(result: dict, quick: bool) -> str:
 PROBES = (
     # (script, timeout_s, result_artifact) — the round-4 whole-program
     # verdict artifacts (VERDICT item 1), cheapest first. They run
-    # EARLY in the first open window (bench doctrine: never rely on the
-    # window lasting; the 3-minute synthetic is the highest-priority
-    # artifact), solo, once per session.
+    # after the bounded quick bench (which banks the round's first
+    # number of record) but before the 40-min full bench, and resume
+    # from their banked artifacts across runs.
     ("onchip/wholeprog_probe.py", 900, "onchip/wholeprog_probe_result.json"),
     ("onchip/chain_probe.py", 2400, "onchip/chain_probe_result.json"),
 )
 
 
+def _measured_keys(path: str) -> int:
+    """How many actually-measured arms an artifact carries (used to
+    distinguish a run that made progress from one that only banked
+    errors — only progress refunds a probe attempt)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return 0
+    if not isinstance(data, dict):
+        return 0
+    return sum(1 for k, v in data.items()
+               if k.endswith(("_tps", "_ms")) and v is not None)
+
+
+def _artifact_complete(path: str) -> bool:
+    """A probe artifact counts as done only if it parses AND carries the
+    probe's own completion marker — a partial (deadline-cut) artifact
+    banks its arms but must not suppress the remaining ones."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return isinstance(data, dict) and bool(data.get("complete"))
+    except (OSError, json.JSONDecodeError):
+        return False
+
+
 _probes_completed: set = set()
+_probe_banked = False  # did the LAST run_probes_once bank any artifact?
+# A verdict banked earlier TODAY survives a watcher restart — re-running
+# a completed probe would burn window minutes re-proving a banked fact.
+for _script, _t, _artifact in PROBES:
+    _p = os.path.join(REPO, _artifact)
+    if os.path.exists(_p) and time.time() - os.path.getmtime(_p) < 12 * 3600 \
+            and _artifact_complete(_p):
+        _probes_completed.add(_script)
 
 
 def run_probes_once() -> bool:
@@ -124,13 +177,17 @@ def run_probes_once() -> bool:
             continue
         print(f"[{time.strftime('%H:%M:%S')}] probe {script}", flush=True)
         t0 = time.time()
+        art = os.path.join(REPO, artifact)
+        measured_before = _measured_keys(art)
         timed_out = False
         rc = 0
         try:
             p = subprocess.run(
                 [sys.executable, os.path.join(REPO, script)],
-                env=dict(os.environ, JAX_PLATFORMS="axon"),
-                capture_output=True, text=True, timeout=timeout_s,
+                env=dict(os.environ, JAX_PLATFORMS="axon",
+                         PROBE_DEADLINE_S=str(int(timeout_s)),
+                         **CACHE_ENV),
+                capture_output=True, text=True, timeout=timeout_s + 120,
                 cwd=REPO,
             )
             rc = p.returncode
@@ -142,7 +199,6 @@ def run_probes_once() -> bool:
             timed_out = True
             print(f"probe {script} timed out; window likely closed",
                   flush=True)
-        art = os.path.join(REPO, artifact)
         fresh = os.path.exists(art) and \
             os.path.getmtime(art) >= t0 - 2.0
         valid = False
@@ -154,12 +210,22 @@ def run_probes_once() -> bool:
             except (OSError, json.JSONDecodeError):
                 pass
         if valid:
+            if _measured_keys(art) > measured_before:
+                # Real progress (a new measured arm) — the attempt
+                # wasn't wasted. An artifact of errors is NOT progress
+                # and must still burn an attempt, or a persistently
+                # failing probe starves the full bench forever.
+                global _probe_banked
+                _probe_banked = True
             commit_file(art, "On-chip probe artifact "
                              f"{os.path.basename(artifact)}")
             print(f"committed {artifact}", flush=True)
-            # A banked verdict is a completed probe even if the process
-            # died after the write — never re-run it.
-            _probes_completed.add(script)
+            # A COMPLETE banked verdict is a completed probe even if
+            # the process died after the write — never re-run it. A
+            # partial artifact is banked but the probe re-runs next
+            # window for its remaining arms.
+            if _artifact_complete(art):
+                _probes_completed.add(script)
         if timed_out:
             return False
         if rc != 0:
@@ -178,35 +244,62 @@ def main() -> None:
     quick_done = False
     probes_done = False
     probe_attempts = 0
+
+    def bank(quick: bool) -> bool:
+        """Run one capture and bank it; True iff a value was banked."""
+        result = capture(quick=quick)
+        # A banked-fallback record must never be re-committed as a
+        # fresh capture (it would launder the true artifact age).
+        if result and result.get("value_source"):
+            print("bench fell back to a banked record; not banking",
+                  flush=True)
+            return False
+        if result and result.get("value") is not None:
+            path = commit_artifact(result, quick=quick)
+            print(f"captured {path}: value={result.get('value')}",
+                  flush=True)
+            return True
+        return False
+
     while True:
         if probe():
             print(f"[{time.strftime('%H:%M:%S')}] window open", flush=True)
+            if not quick_done:
+                # The quick bench banks the round's FIRST number of
+                # record with this round's kernels — since 20260802 it
+                # outranks the remaining verdict probes (wholeprog is
+                # already banked; chain can follow in the same window).
+                quick_done = bank(quick=True)
+                if not quick_done:
+                    # The head-of-window quick bench just failed: the
+                    # window is flaky or closed — don't immediately
+                    # gamble more of it on probes or a full bench.
+                    print("quick bench yielded no value", flush=True)
+                    time.sleep(PROBE_PERIOD_S)
+                    continue
             if not probes_done and probe_attempts < PROBE_ATTEMPTS_MAX:
-                # The verdict probes are the scarcest artifacts: run
-                # them FIRST, cheapest first, before betting the window
-                # on a 20-40 min full bench. A persistently failing
-                # probe must not starve the bench forever — after
-                # PROBE_ATTEMPTS_MAX window-opens the watcher falls
-                # through to capturing ("no result can ever again exist
-                # only in prose" outranks the probes).
+                # The verdict probes run after the bounded quick bench
+                # but before the 40-min full bench, cheapest first. A
+                # persistently failing probe must not starve the full
+                # bench forever — after PROBE_ATTEMPTS_MAX fruitless
+                # window-opens (attempts that banked NEW measured arms
+                # are refunded) the watcher falls through to capturing
+                # ("no result can ever again exist only in prose"
+                # outranks the probes).
+                global _probe_banked
+                _probe_banked = False
                 probe_attempts += 1
                 probes_done = run_probes_once()
+                if _probe_banked:
+                    # Partial progress (an artifact banked) means the
+                    # attempt wasn't wasted — don't let ATTEMPTS_MAX
+                    # starve a probe that re-runs until complete.
+                    probe_attempts -= 1
                 if not probes_done and \
                         probe_attempts < PROBE_ATTEMPTS_MAX:
                     time.sleep(PROBE_PERIOD_S)
                     continue
-            result = capture(quick=not quick_done)
-            # A banked-fallback record must never be re-committed as a
-            # fresh capture (it would launder the true artifact age).
-            if result and result.get("value_source"):
-                print("bench fell back to a banked record; not banking",
-                      flush=True)
-                result = None
-            if result and result.get("value") is not None:
-                path = commit_artifact(result, quick=not quick_done)
-                print(f"captured {path}: value={result.get('value')}",
-                      flush=True)
-                quick_done = True
+            if bank(quick=False):
                 time.sleep(WINDOW_COOLDOWN_S)
                 continue
             print("window open but bench yielded no value", flush=True)
