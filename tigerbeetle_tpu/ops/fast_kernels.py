@@ -619,6 +619,10 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
          _TS["accounts_must_be_different"]),
         (~pid_zero, _TS["pending_id_must_be_zero"]),
         (~pending & (ev["timeout"] != 0), _TS["timeout_reserved_for_pending_transfer"]),
+        # reference :3761-3763 — inside the same !pending block as the
+        # timeout check, before ledger/code.
+        (~pending & _flag(flags, jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)),
+         _TS["closing_transfer_must_be_pending"]),
         (ev["ledger"] == 0, _TS["ledger_must_not_be_zero"]),
         (ev["code"] == 0, _TS["code_must_not_be_zero"]),
         (~dr["exists"], _TS["debit_account_not_found"]),
