@@ -31,7 +31,19 @@ def make_dumper(res: dict, out_path: str):
     def dump(snapshot: dict | None = None) -> None:
         snapshot = dict(res) if snapshot is None else snapshot
         tmp = f"{out_path}.tmp{os.getpid()}-{threading.get_ident()}"
-        json.dump(snapshot, open(tmp, "w"), indent=2)
+        try:
+            with open(tmp, "w") as f:
+                json.dump(snapshot, f, indent=2)
+                f.flush()
+                os.fsync(f.fileno())
+        except BaseException:
+            # A failed dump (e.g. a non-serializable value) must not
+            # leak the tmp file or clobber the last good artifact.
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
         os.replace(tmp, out_path)
 
     return dump

@@ -14,90 +14,163 @@ sequential rate; if the tunnel op-streams inside a single jit, they
 won't. Writes onchip/chain_probe_result.json either way: the artifact
 that validates or falsifies the 4-16M whole-program claim for this
 environment.
+
+Watchdog doctrine (ADVICE r4): the self-deadline arms BEFORE the first
+jax import / backend touch — a wedged PJRT_Client_Create must hit the
+in-process deadline (which banks a marker artifact) and never the
+watcher's SIGKILL-mid-RPC backstop.
 """
 import json
 import os
-import time
-
-import numpy as np
-
-import jax
-
-jax.config.update("jax_enable_x64", True)
-
 import sys
+import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))  # _banking
-
-from tigerbeetle_tpu.benchmark import N, _make_ledger, _soa
-from tigerbeetle_tpu.ops import fast_kernels as fk
-from tigerbeetle_tpu.ops.ledger import stack_superbatch
 
 STACK = 32
 AC = 10_000
 
 
-def mk_windows(n_windows, bi0=0):
-    rng = np.random.default_rng(2)
-    windows = []
-    bi = bi0
-    for _ in range(n_windows):
-        evs, tss = [], []
-        for _ in range(STACK):
-            base = 10 ** 7 + bi * N
-            ids = np.arange(base, base + N)
-            dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
-            cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
-            clash = dr == cr
-            cr[clash] = dr[clash] % AC + 1
-            evs.append(_soa(ids, dr, cr, rng.integers(1, 10 ** 6, N)))
-            tss.append(10 ** 13 + bi * (N + 10))
-            bi += 1
-        ev_s, seg = stack_superbatch(evs, tss)
-        windows.append((ev_s, seg))
-    return windows, bi
+def _run(res, dump, deadline):
+    # First backend touch strictly after the watchdog is armed.
+    import numpy as np
 
+    import jax
 
-def stack_windows(windows):
-    ev_stack = {k: jax.device_put(
-        np.stack([np.asarray(w[0][k]) for w in windows]))
-        for k in windows[0][0]}
-    seg_stack = {k: jax.device_put(
-        np.stack([np.asarray(w[1][k]) for w in windows]))
-        for k in windows[0][1]}
-    return ev_stack, seg_stack
+    jax.config.update("jax_enable_x64", True)
 
+    from tigerbeetle_tpu.benchmark import N, _make_ledger, _soa
+    from tigerbeetle_tpu.ops import fast_kernels as fk
+    from tigerbeetle_tpu.ops.ledger import stack_superbatch
 
-def run_seq(state, windows):
-    poisoned = jax.device_put(np.bool_(False))
-    t0 = time.perf_counter()
-    for ev_s, seg in windows:
-        ev_d = {k: jax.device_put(v) for k, v in ev_s.items()}
-        seg_d = {k: jax.device_put(v) for k, v in seg.items()}
-        state, out = fk.create_transfers_super_jit(
-            state, ev_d, seg_d, poisoned)
-        poisoned = out["fallback"]
-    jax.block_until_ready(poisoned)
-    dt = time.perf_counter() - t0
-    assert not bool(jax.device_get(poisoned))
-    return state, dt
+    res["platform"] = jax.devices()[0].platform
+    res["n_per_batch"] = N
+    dump()
+    evs_per_window = STACK * N
 
+    def mk_windows(n_windows, bi0=0):
+        rng = np.random.default_rng(2)
+        windows = []
+        bi = bi0
+        for _ in range(n_windows):
+            evs, tss = [], []
+            for _ in range(STACK):
+                base = 10 ** 7 + bi * N
+                ids = np.arange(base, base + N)
+                dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+                cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+                clash = dr == cr
+                cr[clash] = dr[clash] % AC + 1
+                evs.append(_soa(ids, dr, cr, rng.integers(1, 10 ** 6, N)))
+                tss.append(10 ** 13 + bi * (N + 10))
+                bi += 1
+            ev_s, seg = stack_superbatch(evs, tss)
+            windows.append((ev_s, seg))
+        return windows, bi
 
-def run_chain(state, windows, fn):
-    ev_stack, seg_stack = stack_windows(windows)
-    t0 = time.perf_counter()
-    state, outs = fn(state, ev_stack, seg_stack)
-    jax.block_until_ready(outs["fallback"])
-    dt = time.perf_counter() - t0
-    assert not bool(jax.device_get(outs["fallback"]).any())
-    return state, dt
+    def stack_windows(windows):
+        ev_stack = {k: jax.device_put(
+            np.stack([np.asarray(w[0][k]) for w in windows]))
+            for k in windows[0][0]}
+        seg_stack = {k: jax.device_put(
+            np.stack([np.asarray(w[1][k]) for w in windows]))
+            for k in windows[0][1]}
+        return ev_stack, seg_stack
+
+    def run_seq(state, windows):
+        poisoned = jax.device_put(np.bool_(False))
+        t0 = time.perf_counter()
+        for ev_s, seg in windows:
+            ev_d = {k: jax.device_put(v) for k, v in ev_s.items()}
+            seg_d = {k: jax.device_put(v) for k, v in seg.items()}
+            state, out = fk.create_transfers_super_jit(
+                state, ev_d, seg_d, poisoned)
+            poisoned = out["fallback"]
+        jax.block_until_ready(poisoned)
+        dt = time.perf_counter() - t0
+        assert not bool(jax.device_get(poisoned))
+        return state, dt
+
+    def run_chain(state, windows, fn):
+        ev_stack, seg_stack = stack_windows(windows)
+        t0 = time.perf_counter()
+        state, outs = fn(state, ev_stack, seg_stack)
+        jax.block_until_ready(outs["fallback"])
+        dt = time.perf_counter() - t0
+        assert not bool(jax.device_get(outs["fallback"]).any())
+        return state, dt
+
+    bi = 0
+    # Sequential baseline FIRST (it reuses the bench's already-proven
+    # kernel shape and anchors every later ratio even if the window
+    # closes mid-probe). Resumed runs skip it.
+    if "seq_w1_tps" not in res:
+        try:
+            led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+            warm, bi = mk_windows(1, bi)
+            t_c0 = time.perf_counter()
+            led.state, _ = run_seq(led.state, warm)
+            res["seq_w1_compile_s"] = round(
+                time.perf_counter() - t_c0, 1)
+            runs = []
+            for _ in range(3):
+                ws, bi = mk_windows(1, bi)
+                led.state, dt = run_seq(led.state, ws)
+                runs.append(dt)
+            res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
+            res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
+        except Exception as e:  # noqa: BLE001
+            res["seq_w1_error"] = repr(e)[:300]
+        dump()
+    # Fresh ledger per measured run: W=8 appends 2.1M rows per run,
+    # so a shared ledger would fill its transfer store mid-probe and
+    # every later dispatch would hard-fallback (capacity, not the
+    # kernel, would be measured). id streams never repeat across
+    # ledgers (bi keeps advancing), so dup checks stay cold.
+    # Scan-form only: wholeprog_probe's banked verdict (20260802)
+    # says the scan form amortizes, and the unrolled programs are
+    # what blew the first run's compile budget.
+    for fname, fn in (
+            ("chain", fk.create_transfers_chain_jit),):
+        for W in (2, 4, 8):
+            key = f"{fname}_w{W}"
+            if key + "_tps" in res:
+                continue  # banked by an earlier run
+            if time.monotonic() > deadline:
+                res["deadline_hit"] = f"before {key}"
+                break
+            try:
+                led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+                warmw, bi = mk_windows(W, bi)
+                t_c0 = time.perf_counter()
+                led.state, _ = run_chain(led.state, warmw, fn)
+                res[key + "_compile_s"] = round(
+                    time.perf_counter() - t_c0, 1)
+                runs = []
+                for _ in range(2):
+                    led = _make_ledger(AC, a_cap=1 << 15,
+                                       t_cap=1 << 22)
+                    ws, bi = mk_windows(W, bi)
+                    led.state, dt = run_chain(led.state, ws, fn)
+                    runs.append(dt)
+                best = min(runs)
+                res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
+                res[key + "_tps"] = round(
+                    W * evs_per_window / best, 1)
+            except Exception as e:  # noqa: BLE001 — record, go on
+                res[key + "_error"] = repr(e)[:300]
+            dump()
+
+    if "deadline_hit" not in res and "alarm" not in res:
+        # The watcher re-runs this probe in later windows until a
+        # COMPLETE artifact lands (partial ones bank data but must
+        # not suppress the remaining arms).
+        res["complete"] = True
 
 
 def main():
-    res = {"platform": jax.devices()[0].platform, "stack": STACK,
-           "n_per_batch": N}
-    evs_per_window = STACK * N
+    res = {"stack": STACK}
     out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                             "chain_probe_result.json")
 
@@ -136,83 +209,21 @@ def main():
         # Work on a snapshot: mutating res while the main thread is
         # mid-json.dump would corrupt BOTH writers' output.
         snap = dict(res)
-        snap["alarm"] = "watchdog: deadline exceeded mid-call"
+        snap["alarm"] = ("watchdog: deadline exceeded mid-call" +
+                         ("" if "platform" in res
+                          else " (wedged during PJRT init)"))
         verdict(snap)
         dump(snap)
 
-    # Self-deadline (see onchip/_banking.py doctrine): the in-loop
-    # deadline ends the probe between arms; the watchdog thread is the
-    # backstop for a single over-budget blocking compile.
+    # Self-deadline (see onchip/_banking.py doctrine): armed BEFORE the
+    # first jax import (ADVICE r4 medium); the in-loop deadline ends
+    # the probe between arms; the watchdog thread is the backstop for a
+    # single over-budget blocking compile.
     deadline = start_watchdog("PROBE_DEADLINE_S", 2700.0, _on_deadline,
                               grace_s=60.0)
 
     try:
-        bi = 0
-        # Sequential baseline FIRST (it reuses the bench's already-
-        # proven kernel shape and anchors every later ratio even if the
-        # window closes mid-probe). Resumed runs skip it.
-        if "seq_w1_tps" not in res:
-            try:
-                led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
-                warm, bi = mk_windows(1, bi)
-                t_c0 = time.perf_counter()
-                led.state, _ = run_seq(led.state, warm)
-                res["seq_w1_compile_s"] = round(
-                    time.perf_counter() - t_c0, 1)
-                runs = []
-                for _ in range(3):
-                    ws, bi = mk_windows(1, bi)
-                    led.state, dt = run_seq(led.state, ws)
-                    runs.append(dt)
-                res["seq_w1_ms"] = [round(r * 1e3, 1) for r in runs]
-                res["seq_w1_tps"] = round(evs_per_window / min(runs), 1)
-            except Exception as e:  # noqa: BLE001
-                res["seq_w1_error"] = repr(e)[:300]
-            dump()
-        # Fresh ledger per measured run: W=8 appends 2.1M rows per run,
-        # so a shared ledger would fill its transfer store mid-probe and
-        # every later dispatch would hard-fallback (capacity, not the
-        # kernel, would be measured). id streams never repeat across
-        # ledgers (bi keeps advancing), so dup checks stay cold.
-        # Scan-form only: wholeprog_probe's banked verdict (20260802)
-        # says the scan form amortizes, and the unrolled programs are
-        # what blew the first run's compile budget.
-        for fname, fn in (
-                ("chain", fk.create_transfers_chain_jit),):
-            for W in (2, 4, 8):
-                key = f"{fname}_w{W}"
-                if key + "_tps" in res:
-                    continue  # banked by an earlier run
-                if time.monotonic() > deadline:
-                    res["deadline_hit"] = f"before {key}"
-                    break
-                try:
-                    led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
-                    warmw, bi = mk_windows(W, bi)
-                    t_c0 = time.perf_counter()
-                    led.state, _ = run_chain(led.state, warmw, fn)
-                    res[key + "_compile_s"] = round(
-                        time.perf_counter() - t_c0, 1)
-                    runs = []
-                    for _ in range(2):
-                        led = _make_ledger(AC, a_cap=1 << 15,
-                                           t_cap=1 << 22)
-                        ws, bi = mk_windows(W, bi)
-                        led.state, dt = run_chain(led.state, ws, fn)
-                        runs.append(dt)
-                    best = min(runs)
-                    res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
-                    res[key + "_tps"] = round(
-                        W * evs_per_window / best, 1)
-                except Exception as e:  # noqa: BLE001 — record, go on
-                    res[key + "_error"] = repr(e)[:300]
-                dump()
-
-        if "deadline_hit" not in res and "alarm" not in res:
-            # The watcher re-runs this probe in later windows until a
-            # COMPLETE artifact lands (partial ones bank data but must
-            # not suppress the remaining arms).
-            res["complete"] = True
+        _run(res, dump, deadline)
     finally:
         # The artifact lands no matter how the measurement dies
         # (docstring contract: "writes chain_probe_result.json either

@@ -18,111 +18,91 @@ If D(K=32) ~= A + epsilon: whole-program execution is real ->
 build the K-window scan kernel (4-16M model holds).
 If D(K=32) ~= K * A: the tunnel op-streams inside a single jit ->
 the 4-16M whole-program claim is FALSIFIED for this environment.
+
+Watchdog doctrine (ADVICE r4): the self-deadline arms BEFORE the first
+jax import / backend touch — a wedged PJRT_Client_Create must hit the
+in-process deadline (which banks a marker artifact) and never the
+watcher's SIGKILL-mid-RPC backstop.
 """
 import json
 import os
+import sys
 import time
-
-import jax
-
-# The real kernels are uint64 end-to-end (tigerbeetle_tpu enables x64 at
-# package import); without this the probe would silently benchmark a
-# 32-bit body — half the memory traffic of the regime under test.
-jax.config.update("jax_enable_x64", True)
-import jax.numpy as jnp
-import numpy as np
 
 N = 8192
 KS = (8, 32)
 
 
-def body(carry):
-    table, idx, vals = carry
-    perm = jnp.argsort(idx)                      # sort (heavy)
-    g1 = table[idx]                              # gather
-    g2 = table[perm]                             # gather
-    s = jax.lax.associative_scan(jnp.add, vals)  # log-step scan
-    t2 = table.at[idx].add(vals)                 # scatter-add
-    mix = (g1 ^ s) + g2
-    seg = jax.lax.associative_scan(jnp.maximum, mix)
-    new_idx = ((idx.astype(jnp.uint32) * jnp.uint32(2654435761))
-               % jnp.uint32(N)).astype(jnp.int32)
-    new_vals = (mix + seg) | jnp.uint64(1)
-    new_table = t2.at[new_idx].max(new_vals)     # scatter-max
-    return (new_table, new_idx, new_vals)
+def _run(res, dump):
+    # First backend touch strictly after the watchdog is armed.
+    import jax
 
+    # The real kernels are uint64 end-to-end (tigerbeetle_tpu enables
+    # x64 at package import); without this the probe would silently
+    # benchmark a 32-bit body — half the memory traffic of the regime
+    # under test.
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
 
-@jax.jit
-def one(carry):
-    return body(carry)
+    res["platform"] = jax.devices()[0].platform
+    res["device"] = str(jax.devices()[0])
+    dump()
 
+    def body(carry):
+        table, idx, vals = carry
+        perm = jnp.argsort(idx)                      # sort (heavy)
+        g1 = table[idx]                              # gather
+        g2 = table[perm]                             # gather
+        s = jax.lax.associative_scan(jnp.add, vals)  # log-step scan
+        t2 = table.at[idx].add(vals)                 # scatter-add
+        mix = (g1 ^ s) + g2
+        seg = jax.lax.associative_scan(jnp.maximum, mix)
+        new_idx = ((idx.astype(jnp.uint32) * jnp.uint32(2654435761))
+                   % jnp.uint32(N)).astype(jnp.int32)
+        new_vals = (mix + seg) | jnp.uint64(1)
+        new_table = t2.at[new_idx].max(new_vals)     # scatter-max
+        return (new_table, new_idx, new_vals)
 
-def unrolled(k):
-    @jax.jit
-    def f(carry):
-        for _ in range(k):
-            carry = body(carry)
-        return carry
-    return f
+    one = jax.jit(body)
 
+    def unrolled(k):
+        @jax.jit
+        def f(carry):
+            for _ in range(k):
+                carry = body(carry)
+            return carry
+        return f
 
-def scanned(k):
-    @jax.jit
-    def f(carry):
-        def step(c, _):
-            return body(c), None
-        c, _ = jax.lax.scan(step, carry, None, length=k)
-        return c
-    return f
+    def scanned(k):
+        @jax.jit
+        def f(carry):
+            def step(c, _):
+                return body(c), None
+            c, _ = jax.lax.scan(step, carry, None, length=k)
+            return c
+        return f
 
+    tiny = jax.jit(lambda x: x * jnp.uint64(2) + jnp.uint64(1))
 
-@jax.jit
-def tiny(x):
-    return x * jnp.uint64(2) + jnp.uint64(1)
+    def fresh():
+        rng = np.random.default_rng(7)
+        return (jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)),
+                jax.device_put(
+                    rng.integers(0, N, N, dtype=np.int32).astype(np.int32)),
+                jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)))
 
-
-def fresh():
-    rng = np.random.default_rng(7)
-    return (jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)),
-            jax.device_put(rng.integers(0, N, N, dtype=np.int32).astype(np.int32)),
-            jax.device_put(rng.integers(0, 1 << 62, N, dtype=np.uint64)))
-
-
-def timed(fn, carry, reps=3):
-    out = fn(carry)
-    jax.block_until_ready(out)                    # compile + warm
-    ts = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
+    def timed(fn, carry, reps=3):
         out = fn(carry)
-        jax.block_until_ready(out)
-        ts.append((time.perf_counter() - t0) * 1e3)
-    return ts, out
+        jax.block_until_ready(out)                    # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(carry)
+            jax.block_until_ready(out)
+            ts.append((time.perf_counter() - t0) * 1e3)
+        return ts, out
 
-
-def main():
-    import sys
-
-    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
-    from _banking import make_dumper, resume_from, start_watchdog
-
-    res = {"platform": jax.devices()[0].platform,
-           "device": str(jax.devices()[0]), "n_rows": N}
-    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                            "wholeprog_probe_result.json")
-    # Resume: banked arms survive a re-run (an error-only re-run must
-    # never regress a COMPLETE verdict artifact).
-    resume_from(out_path, res,
-                keep=lambda k: k[:1] in "ABCD" or k.startswith("post_"))
-    dump = make_dumper(res, out_path)
-
-    def _on_deadline():
-        snap = dict(res)
-        snap["alarm"] = "watchdog: deadline exceeded mid-call"
-        dump(snap)
-
-    # See onchip/_banking.py for the watchdog/banking doctrine.
-    start_watchdog("PROBE_DEADLINE_S", 840.0, _on_deadline)
     carry = fresh()
 
     # Incremental banking after each arm (same doctrine as chain_probe):
@@ -195,6 +175,32 @@ def main():
     res["complete"] = True
     print(json.dumps(res, indent=1))
     dump()
+
+
+def main():
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from _banking import make_dumper, resume_from, start_watchdog
+
+    res = {"n_rows": N}
+    out_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "wholeprog_probe_result.json")
+    # Resume: banked arms survive a re-run (an error-only re-run must
+    # never regress a COMPLETE verdict artifact).
+    resume_from(out_path, res,
+                keep=lambda k: k[:1] in "ABCD" or k.startswith("post_"))
+    dump = make_dumper(res, out_path)
+
+    def _on_deadline():
+        snap = dict(res)
+        snap["alarm"] = ("watchdog: deadline exceeded mid-call" +
+                         ("" if "platform" in res
+                          else " (wedged during PJRT init)"))
+        dump(snap)
+
+    # See onchip/_banking.py for the watchdog/banking doctrine. Armed
+    # BEFORE the first jax import (ADVICE r4 medium).
+    start_watchdog("PROBE_DEADLINE_S", 840.0, _on_deadline)
+    _run(res, dump)
 
 
 if __name__ == "__main__":

@@ -238,12 +238,21 @@ def run_probes_once() -> bool:
 
 
 PROBE_ATTEMPTS_MAX = 3
+# ADVICE r4: the refund for making-progress probe runs must be bounded,
+# or a probe that banks one arm per window and never completes defers
+# the full bench forever. Likewise the head-of-window quick bench: if
+# it persistently fails while the tunnel is healthy, fall through to
+# the probes instead of starving them.
+PROBE_RUNS_HARD_MAX = 8
+QUICK_FAILURES_MAX = 3
 
 
 def main() -> None:
     quick_done = False
     probes_done = False
     probe_attempts = 0
+    probe_runs_total = 0
+    quick_failures = 0
 
     def bank(quick: bool) -> bool:
         """Run one capture and bank it; True iff a value was banked."""
@@ -274,10 +283,21 @@ def main() -> None:
                     # The head-of-window quick bench just failed: the
                     # window is flaky or closed — don't immediately
                     # gamble more of it on probes or a full bench.
-                    print("quick bench yielded no value", flush=True)
-                    time.sleep(PROBE_PERIOD_S)
-                    continue
-            if not probes_done and probe_attempts < PROBE_ATTEMPTS_MAX:
+                    # But a bench-side bug with a healthy tunnel must
+                    # not starve the probes forever (ADVICE r4): after
+                    # QUICK_FAILURES_MAX consecutive failures, fall
+                    # through and let the probes have the window.
+                    quick_failures += 1
+                    print(f"quick bench yielded no value "
+                          f"({quick_failures}/{QUICK_FAILURES_MAX})",
+                          flush=True)
+                    if quick_failures < QUICK_FAILURES_MAX:
+                        time.sleep(PROBE_PERIOD_S)
+                        continue
+                else:
+                    quick_failures = 0
+            if not probes_done and probe_attempts < PROBE_ATTEMPTS_MAX \
+                    and probe_runs_total < PROBE_RUNS_HARD_MAX:
                 # The verdict probes run after the bounded quick bench
                 # but before the 40-min full bench, cheapest first. A
                 # persistently failing probe must not starve the full
@@ -289,14 +309,19 @@ def main() -> None:
                 global _probe_banked
                 _probe_banked = False
                 probe_attempts += 1
+                probe_runs_total += 1
                 probes_done = run_probes_once()
                 if _probe_banked:
                     # Partial progress (an artifact banked) means the
                     # attempt wasn't wasted — don't let ATTEMPTS_MAX
-                    # starve a probe that re-runs until complete.
-                    probe_attempts -= 1
+                    # starve a probe that re-runs until complete. The
+                    # refund is bounded by PROBE_RUNS_HARD_MAX total
+                    # runs (ADVICE r4): slow progress must not defer
+                    # the full bench without bound.
+                    probe_attempts = max(0, probe_attempts - 1)
                 if not probes_done and \
-                        probe_attempts < PROBE_ATTEMPTS_MAX:
+                        probe_attempts < PROBE_ATTEMPTS_MAX and \
+                        probe_runs_total < PROBE_RUNS_HARD_MAX:
                     time.sleep(PROBE_PERIOD_S)
                     continue
             if bank(quick=False):

@@ -706,8 +706,21 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
                       _TS["imported_event_expected"]), status)
     ts_actual = jnp.where(status == inner, ts_inner, ts_event)
 
+    # Closed-check-stripped status (closing-native fixpoint tiers): the
+    # already_closed decisions are re-evaluated per round against the
+    # EVOLVING in-batch closed state, so those tiers need this event's
+    # status with only the closed codes removed. First-failure structure
+    # makes the strip local: already_closed can only come from reg_tail
+    # (where the one check sequenced after it is overflows_timeout,
+    # reference :3837 vs :3898) or pv_tail (where it is last).
+    is_closed_st = ((status == _TS["debit_account_already_closed"])
+                    | (status == _TS["credit_account_already_closed"]))
+    status_nc = jnp.where(
+        is_closed_st & ~pv & ovf_timeout, _TS["overflows_timeout"],
+        jnp.where(is_closed_st, _CREATED, status))
+
     out = dict(
-        status_pre=status, ts_pre=ts_actual,
+        status_pre=status, ts_pre=ts_actual, status_nc=status_nc,
         amt_res_hi=amt_res_hi, amt_res_lo=amt_res_lo,
         dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
         dr_found=dr_found, cr_found=cr_found, p_found=p_found,
@@ -907,8 +920,19 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     p_found = per_event["p_found"]
     amt_res_hi = per_event["amt_res_hi"]
     amt_res_lo = per_event["amt_res_lo"]
-    status = per_event["status_pre"]
     ts_actual = per_event["ts_pre"]
+    # Closing-native (fixpoint tiers): closing_debit/closing_credit and
+    # void-reopens run on device — the closed-state evolution joins the
+    # K-round fixpoint (reference :3837 close gate, :3941-3944 set,
+    # :4184-4189 void exception, :4254-4261 reopen). The base status is
+    # then the closed-STRIPPED variant; the closed codes are reapplied
+    # each round from the evolving in-batch closed state. The imported
+    # tier keeps closing hard (its maxima chain has no rounds to host
+    # the evolution); the SPMD legacy path too (per-shard statuses).
+    closing_native = (limit_rounds > 1 and not spmd_legacy
+                      and not imported_mode)
+    status = (per_event["status_nc"] if closing_native
+              else per_event["status_pre"])
 
     if imported_mode:
         # ---- in-batch regress: the left-to-right maxima chain ----
@@ -972,21 +996,40 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     elif balancing_mode:
         assert limit_rounds > 1 and not spmd_legacy, \
             "balancing_mode rides the limit fixpoint"
-        # Balancing clamps resolve inside the fixpoint; closing stays
-        # hard (closed-account gating is order-dependent with no cheap
-        # per-round form), imported has its own tier. In-window pending
-        # defs that are THEMSELVES balancing fall back: the in-window
-        # substitution reads the def's nominal event lanes, but its
-        # stored (and releasable) amount is the clamp.
-        hard_flags = _F_IMPORTED | _F_CLOSE_DR | _F_CLOSE_CR
+        # Balancing clamps AND closing resolve inside the fixpoint;
+        # imported has its own tier. In-window pending defs that are
+        # THEMSELVES balancing fall back: the in-window substitution
+        # reads the def's nominal event lanes, but its stored (and
+        # releasable) amount is the clamp.
+        hard_flags = _F_IMPORTED
         e1_vec = valid & (
             _flag(flags, jnp.uint32(hard_flags))
             | (inwin & _flag(flags[didx],
                              jnp.uint32(_F_BAL_DR | _F_BAL_CR))))
-    else:
-        hard_flags = (_F_IMPORTED | _F_BAL_DR | _F_BAL_CR
-                      | _F_CLOSE_DR | _F_CLOSE_CR)
+    elif closing_native:
+        # Plain fixpoint tier: closing is native (closed-state evolution
+        # joins the rounds); balancing still needs the balancing tier's
+        # amount iteration.
+        hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR
         e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
+    else:
+        hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR
+        close_bits = jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)
+        if spmd_legacy:
+            # Sharded driver has no fixpoint tier to redispatch to:
+            # closing stays a hard fallback per shard.
+            e1_vec = valid & (_flag(flags, jnp.uint32(hard_flags))
+                              | _flag(flags, close_bits))
+        else:
+            # Plain tier: closing flags are RESOLVABLE on the fixpoint
+            # tier — they escalate (limit_only redispatch) instead of
+            # hard-falling-back to the host (e_close_vec below).
+            e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
+    e_close_vec = (valid & _flag(flags, jnp.uint32(_F_CLOSE_DR
+                                                   | _F_CLOSE_CR))
+                   if (limit_rounds == 1 and not spmd_legacy
+                       and not imported_mode)
+                   else jnp.zeros_like(valid))
 
     # Eligibility sums below run over the OPTIMISTIC apply set: events
     # whose per-event status is already a failure can never apply (the
@@ -1095,9 +1138,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
               & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
     # ONE reduction for every N-length hard-fallback vector: e1 (hard
     # flags), the eight pair-overflow lanes, and e5 (void of a closing
-    # pending) — their only consumer is the combined OR. The scalar
-    # overflow terms (ovf, s4) join at the OR itself.
-    hard_any = jnp.any(jnp.stack([e1_vec, e5_vec, *pair_ovfs]))
+    # pending; native reopen in the closing-native tiers, escalatable
+    # in the plain tier, hard for imported/SPMD) — their only consumer
+    # is the combined OR. The scalar terms (ovf, s4) join at the OR.
+    hard_vecs = [e1_vec, *pair_ovfs]
+    if not closing_native and (imported_mode or spmd_legacy):
+        hard_vecs.append(e5_vec)
+    hard_any = jnp.any(jnp.stack(hard_vecs))
     if balancing_mode:
         # The E4 amount-sum proof is useless under balancing: the
         # idiomatic AMOUNT_MAX nominal ("move everything") always trips
@@ -1163,6 +1210,47 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                  for j in range(4)]
         cr_side_s = (fperm >= N)  # static: entry index N.. = credit side
         z64_ = jnp.uint64(0)
+
+        if closing_native:
+            # ---- in-batch closed-state evolution (reference :3837 gate,
+            # :3941-3944 set, :4184-4189 void exception, :4254-4261
+            # reopen). closed is per-account last-writer-wins state: an
+            # applied closing create sets it, an applied void of a
+            # closing pending clears it. Per round, the closed value an
+            # event observes is the latest applied set/clear op strictly
+            # BEFORE it in its account segment (initial = the pre-batch
+            # flag) — one segmented exclusive running-max over op
+            # positions, riding the same sorted entry space as the
+            # balance prefixes (pv entries already carry the pending's
+            # accounts, exactly the rows the pv closed checks read).
+            # The circularity (closed -> status -> applied -> closed)
+            # resolves like limit waves: prefix-stable cascades converge
+            # in <= K rounds; chain-rollback interactions (a closing
+            # member applied then rolled back mid-batch) oscillate and
+            # fall back to the exact host path.
+            close_dr_f = _flag(flags, _F_CLOSE_DR)
+            close_cr_f = _flag(flags, _F_CLOSE_CR)
+            p_cl_dr = _flag(p["flags"], _F_CLOSE_DR)
+            p_cl_cr = _flag(p["flags"], _F_CLOSE_CR)
+            # Closed-check candidates: the check is reachable iff every
+            # earlier-precedence check passed — status_nc (the base
+            # `status` here) is CREATED or a code sequenced after the
+            # closed position (reg: overflows_timeout; pv: none). Voids
+            # are exempt (:4184-4189).
+            cand_close = valid & (
+                (~pv & ((status == _CREATED)
+                        | (status == _TS["overflows_timeout"])))
+                | (pv & is_post & (status == _CREATED)))
+            aflags_col = acc["u32"][:, AC_U32_IDX["flags"]]
+            init_closed_s = _flag(aflags_col[frows_sorted], _A_CLOSED)
+            idx2 = jnp.arange(2 * N, dtype=jnp.int32)
+            # Round 0: pre-batch closed flags (the per-event gathers).
+            cdr_ln = cand_close & _flag(
+                jnp.where(pv, p_dr["flags"], dr["flags"]), _A_CLOSED)
+            ccr_ln = cand_close & _flag(
+                jnp.where(pv, p_cr["flags"], cr["flags"]), _A_CLOSED)
+        else:
+            cdr_ln = ccr_ln = jnp.zeros_like(valid)
 
         if balancing_mode:
             # Balancing clamp (reference :3840-3853), evaluated against
@@ -1236,6 +1324,15 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             st_r = jnp.where(over_dr, _TS["exceeds_credits"], st_r)
             st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                              st_r)
+            if closing_native:
+                # Earlier sequential precedence than the overflow/limit
+                # codes (:3837 precedes :3856/:3904) — applied after, so
+                # it wins; dr checked before cr.
+                st_r = jnp.where(
+                    cdr_ln, _TS["debit_account_already_closed"], st_r)
+                st_r = jnp.where(
+                    ccr_ln & ~cdr_ln,
+                    _TS["credit_account_already_closed"], st_r)
             # In-window dependency deaths from the PREVIOUS round's
             # final statuses: a use whose definition did not create
             # reads pending_transfer_not_found (sequential truth).
@@ -1270,11 +1367,44 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                      | ((ap_r & ~pv & pending).astype(jnp.uint8) << 1)
                      | ((ap_r & pv).astype(jnp.uint8) << 2)
                      | ((ap_r & pv & is_post).astype(jnp.uint8) << 3))
+            if closing_native:
+                # Closed-op bits ride the SAME u8 gather: 4/5 = applied
+                # closing create (dr/cr side), 6/7 = applied void of a
+                # closing pending (clears the pending's dr/cr account).
+                mask8 = (mask8
+                         | ((ap_r & ~pv & close_dr_f)
+                            .astype(jnp.uint8) << 4)
+                         | ((ap_r & ~pv & close_cr_f)
+                            .astype(jnp.uint8) << 5)
+                         | ((ap_r & pv & is_void & p_cl_dr)
+                            .astype(jnp.uint8) << 6)
+                         | ((ap_r & pv & is_void & p_cl_cr)
+                            .astype(jnp.uint8) << 7))
             m_s = jnp.concatenate([mask8, mask8])[fperm]
             reg_s = (m_s & 1) != 0
             pend_s = (m_s & 2) != 0
             pv_s = (m_s & 4) != 0
             post_s = (m_s & 8) != 0
+            if closing_native:
+                set_s = jnp.where(cr_side_s, (m_s & 32) != 0,
+                                  (m_s & 16) != 0)
+                clr_s = jnp.where(cr_side_s, (m_s & 128) != 0,
+                                  (m_s & 64) != 0)
+                op_pos = jnp.where(set_s | clr_s, idx2, jnp.int32(-1))
+                incl_op = _cummax(op_pos)
+                excl_op = jnp.concatenate(
+                    [jnp.full((1,), -1, jnp.int32), incl_op[:-1]])
+                # In-segment iff the latest op position is at/after my
+                # segment's start (the sort is segment-contiguous).
+                has_prev = excl_op >= fseg_start
+                closed_pre_s = jnp.where(
+                    has_prev, set_s[jnp.maximum(excl_op, 0)],
+                    init_closed_s)
+                closed_pre = closed_pre_s[finv]
+                new_cdr = cand_close & closed_pre[:N]
+                new_ccr = cand_close & closed_pre[N:]
+            else:
+                new_cdr, new_ccr = cdr_ln, ccr_ln
             if balancing_mode:
                 # Amounts are round-varying (the clamp): one stacked
                 # sorted-space gather of the current limbs replaces the
@@ -1369,13 +1499,22 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             fix_converged = jnp.all((new_over_dr == over_dr)
                                     & (new_over_cr == over_cr)
                                     & (new_ovf == ovf_code)
-                                    & (new_dead == dead)) & amt_stable
+                                    & (new_dead == dead)
+                                    & (new_cdr == cdr_ln)
+                                    & (new_ccr == ccr_ln)) & amt_stable
             over_dr, over_cr, dead = new_over_dr, new_over_cr, new_dead
+            cdr_ln, ccr_ln = new_cdr, new_ccr
             ovf_code = new_ovf
         status = jnp.where(ovf_code != 0, ovf_code, status)
         status = jnp.where(over_dr, _TS["exceeds_credits"], status)
         status = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
                            status)
+        if closing_native:
+            status = jnp.where(
+                cdr_ln, _TS["debit_account_already_closed"], status)
+            status = jnp.where(
+                ccr_ln & ~cdr_ln,
+                _TS["credit_account_already_closed"], status)
         status = jnp.where(dead, status_dead, status)
         if balancing_mode:
             # Converged clamped amounts become the applied/stored
@@ -1443,9 +1582,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     elif limit_rounds == 1 and not spmd_legacy:
         # Plain tier: e2 is the COMBINED collision check — it may be an
         # in-batch pending reference the fixpoint tier can resolve, so
-        # it escalates instead of hard-falling-back.
+        # it escalates instead of hard-falling-back. Closing flags and
+        # voids of closing pendings (e5) likewise: the fixpoint tier
+        # runs them natively.
         others = e145 | e7 | e8 | ~ins_ok
-        escalatable = e3 | e2
+        escalatable = (e3 | e2
+                       | jnp.any(jnp.stack([e_close_vec, e5_vec])))
     else:
         # Fixpoint tiers: e2 is precise same-kind duplicates (real
         # fallback). SPMD path (per_event supplied): per-shard statuses
@@ -1607,6 +1749,50 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         snap[f"dr_{field}"] = (hi_all[fi, :N], lo_all[fi, :N])
         snap[f"cr_{field}"] = (hi_all[fi, N:], lo_all[fi, N:])
 
+    eff_dr_flags = jnp.where(pv, p_dr["flags"], dr["flags"])
+    eff_cr_flags = jnp.where(pv, p_cr["flags"], cr["flags"])
+    if closing_native:
+        # ---- closed-flag application + POST-event ring flags. The
+        # reference's account_event stores dr_account_NEW (:3948-3963:
+        # flags after the event), and the mirror's account write-back
+        # (lazy_mirror.apply_account_finals) takes the LAST ring row's
+        # flags per account — so the ring must carry the evolved closed
+        # bit, and the account store the post-batch value. Same
+        # last-op-wins scan as the fixpoint, over the application's own
+        # sorted space (whose ops come from the FINAL applied set).
+        cl_u = jnp.uint32(_A_CLOSED)
+        set2 = jnp.concatenate([ap & ~pv & close_dr_f,
+                                ap & ~pv & close_cr_f])[perm]
+        clr2 = jnp.concatenate([ap & pv & is_void & p_cl_dr,
+                                ap & pv & is_void & p_cl_cr])[perm]
+        idx2a = jnp.arange(2 * N, dtype=jnp.int32)
+        op_pos2 = jnp.where(set2 | clr2, idx2a, jnp.int32(-1))
+        incl2 = _cummax(op_pos2)
+        # Inclusive (post-event) closed per entry; seg_start here is the
+        # application sort's per-entry segment-start position.
+        has2 = incl2 >= seg_start
+        aflags_col2 = acc["u32"][:, AC_U32_IDX["flags"]]
+        base_flags_s = aflags_col2[rows_sorted]
+        closed_incl_s = jnp.where(has2, set2[jnp.maximum(incl2, 0)],
+                                  _flag(base_flags_s, _A_CLOSED))
+        # Post-batch flag word per account: last entry of each real
+        # segment; only segments that carried an op write (untouched
+        # accounts keep their word byte-identical).
+        seg_has_op = jax.ops.segment_max(
+            op_pos2, seg_id, num_segments=2 * N)[seg_id] >= 0
+        wrf = real & seg_has_op
+        new_word = jnp.where(closed_incl_s, base_flags_s | cl_u,
+                             base_flags_s & ~cl_u)
+        new_acc["u32"] = acc["u32"].at[
+            jnp.where(wrf, rows_sorted, A_dump),
+            AC_U32_IDX["flags"]].set(
+            jnp.where(wrf, new_word, jnp.uint32(0)))
+        closed_incl = closed_incl_s[inv]
+        eff_dr_flags = jnp.where(closed_incl[:N], eff_dr_flags | cl_u,
+                                 eff_dr_flags & ~cl_u)
+        eff_cr_flags = jnp.where(closed_incl[N:], eff_cr_flags | cl_u,
+                                 eff_cr_flags & ~cl_u)
+
     erow = jnp.where(ap, ring_base + row_off, E_dump)
     stores_ev = dict(
         ts=ts_actual,
@@ -1623,9 +1809,10 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
         # Effective-side account flags: already gathered in the per-event
-        # stage (dr/cr/p_dr/p_cr) — select, don't re-gather.
-        dr_flags=jnp.where(pv, p_dr["flags"], dr["flags"]),
-        cr_flags=jnp.where(pv, p_cr["flags"], cr["flags"]),
+        # stage (dr/cr/p_dr/p_cr) — select, don't re-gather. Closing-
+        # native tiers patch the closed bit to its POST-event value.
+        dr_flags=eff_dr_flags,
+        cr_flags=eff_cr_flags,
     )
     for sside in ("dr", "cr"):
         for field in ("dp", "dpos", "cp", "cpos"):
