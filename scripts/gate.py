@@ -1,0 +1,109 @@
+#!/usr/bin/env python
+"""Pre-snapshot gate: the quick test tier + the 8-device SPMD dryrun.
+
+Run before banking a snapshot:
+
+    python scripts/gate.py            # quick tier + dryrun_multichip(8)
+    python scripts/gate.py --no-mesh  # quick tier only
+    python scripts/gate.py --tier slow   # one of: quick, slow, soak, all
+
+Tiers (markers documented in pytest.ini):
+
+  quick  (default) every test not marked slow/soak — the jit-light
+         correctness surface; finishes well inside the tier-1 budget.
+  slow   the jit-heavy parity/differential tiers (kernel parity, the
+         fixpoint/balancing/imported/sharded differential suites, VOPR
+         scenario sweeps): each file compiles many XLA programs.
+  soak   long randomized soaks; run when touching the matching
+         subsystem, not per snapshot.
+
+Exit status is nonzero on ANY red (test failure, collection error,
+timeout, dryrun assertion), so `python scripts/gate.py && snapshot`
+cannot bank a broken tree.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TIER_EXPR = {
+    "quick": "not slow and not soak",
+    "slow": "slow",
+    "soak": "soak",
+    "all": "",
+}
+
+
+def run_tests(tier: str, timeout: int) -> int:
+    expr = TIER_EXPR[tier]
+    cmd = [
+        sys.executable, "-m", "pytest", "tests/", "-q",
+        "--continue-on-collection-errors",
+        "-p", "no:cacheprovider", "-p", "no:randomly",
+    ]
+    if expr:
+        cmd += ["-m", expr]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print(f"[gate] {tier} tier: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: {tier} tier timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] {tier} tier rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
+def run_mesh(n_devices: int) -> int:
+    # dryrun_multichip handles its own harness-proofing (re-execs into a
+    # pinned virtual-CPU-mesh subprocess when needed).
+    print(f"[gate] dryrun_multichip({n_devices})", flush=True)
+    t0 = time.time()
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import __graft_entry__ as g; "
+         f"g.dryrun_multichip({n_devices}); print('[gate] mesh ok')"],
+        cwd=REPO)
+    print(f"[gate] mesh rc={p.returncode} in {time.time() - t0:.0f}s",
+          flush=True)
+    return p.returncode
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--tier", default="quick", choices=sorted(TIER_EXPR))
+    ap.add_argument("--no-mesh", action="store_true",
+                    help="skip the 8-device SPMD dryrun")
+    ap.add_argument("--mesh-devices", type=int, default=8)
+    ap.add_argument("--timeout", type=int, default=840,
+                    help="test-tier wall clock budget (s)")
+    args = ap.parse_args()
+
+    reds = []
+    rc = run_tests(args.tier, args.timeout)
+    if rc != 0:
+        reds.append(f"{args.tier} tier rc={rc}")
+    if not args.no_mesh:
+        rc = run_mesh(args.mesh_devices)
+        if rc != 0:
+            reds.append(f"dryrun_multichip({args.mesh_devices}) rc={rc}")
+    if reds:
+        print(f"[gate] RED: {'; '.join(reds)}", flush=True)
+        return 1
+    print("[gate] GREEN", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

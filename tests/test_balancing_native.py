@@ -10,6 +10,12 @@ round from the exact per-event prefix balances and resolves the whole
 batch on device.
 """
 
+import pytest
+
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from tigerbeetle_tpu.oracle import StateMachineOracle
@@ -329,9 +335,11 @@ class TestBalancingNative:
         _check_state(led, sm, [1, 2, 3], [1, 2])
         assert led.fallbacks == 1  # by design
 
-    def test_closing_still_exact(self):
-        """closing_debit stays on the exact path even in a balancing
-        batch — results identical to the oracle."""
+    def test_closing_native_in_balancing_batch(self):
+        """closing_debit in a balancing batch runs NATIVE (the balancing
+        tier is closing-native: the closed-state evolution joins the
+        clamp fixpoint) — results identical to the oracle, zero host
+        fallbacks."""
         led, sm = _pair()
         ts = _setup(led, sm,
                     [Account(id=1, ledger=1, code=1),
@@ -347,7 +355,7 @@ class TestBalancingNative:
         ], ts)
         assert st == ["created", "created"]
         _check_state(led, sm, [1, 2], [1, 2])
-        assert led.fallbacks == 1  # closing -> exact path
+        assert led.fallbacks == 0  # closing is native now
 
     def test_seeded_fuzz_differential(self):
         """Randomized mixed batches (regular / balancing dr+cr / pending

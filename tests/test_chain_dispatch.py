@@ -11,6 +11,10 @@ host pipeline's chained force_fallback.
 import numpy as np
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 import jax
 
 from tigerbeetle_tpu.benchmark import _soa

@@ -10,6 +10,12 @@ one chain per wave — resolvable only by a fixpoint with >= K rounds
 rollback, src/state_machine.zig:3116-3150).
 """
 
+import pytest
+
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 import numpy as np
 
 from tigerbeetle_tpu.oracle import StateMachineOracle

@@ -3,6 +3,10 @@ CLI surface works (reference: src/fuzz_tests.zig + `zig build fuzz`)."""
 
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu.main import main
 from tigerbeetle_tpu.testing import fuzz
 

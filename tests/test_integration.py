@@ -14,6 +14,10 @@ import time
 
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu.main import _parse_addresses
 from tigerbeetle_tpu.repl import ParseError, Statement, parse_statement
 from tigerbeetle_tpu.types import (

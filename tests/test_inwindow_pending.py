@@ -11,6 +11,10 @@ precedence order (src/tigerbeetle.zig:220)."""
 import numpy as np
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu.ops.ledger import DeviceLedger
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.types import (Account, AccountFlags, Transfer,

@@ -9,6 +9,10 @@ import random
 
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu.constants import NS_PER_S, U128_MAX
 from tigerbeetle_tpu.oracle import StateMachineOracle
 from tigerbeetle_tpu.ops import run_create_accounts, run_create_transfers

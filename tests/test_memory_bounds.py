@@ -13,6 +13,10 @@ queryable from the LSM.
 import numpy as np
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu import multi_batch
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.testing.cluster import Cluster

@@ -13,6 +13,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu.ops.batch import transfers_to_arrays
 from tigerbeetle_tpu.ops.fast_kernels import (
     create_transfers_fast_jit,

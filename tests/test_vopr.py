@@ -10,6 +10,10 @@ import random
 
 import pytest
 
+# Tier: randomized cluster soak (see pytest.ini) — slow+soak,
+# run when touching VOPR/consensus, not per snapshot.
+pytestmark = [pytest.mark.slow, pytest.mark.soak]
+
 from tigerbeetle_tpu import multi_batch
 from tigerbeetle_tpu.state_machine import StateMachine
 from tigerbeetle_tpu.testing.cluster import Cluster, MS, NetworkOptions

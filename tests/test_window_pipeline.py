@@ -10,6 +10,10 @@ Reference analog: the primary pipelines up to 8 prepares
 import numpy as np
 import pytest
 
+# Tier: jit-heavy parity/differential suite (see pytest.ini) —
+# excluded from the quick gate; run via scripts/gate.py --tier slow.
+pytestmark = pytest.mark.slow
+
 from tigerbeetle_tpu import multi_batch
 from tigerbeetle_tpu.ops.batch import transfers_to_arrays
 from tigerbeetle_tpu.ops.ledger import DeviceLedger

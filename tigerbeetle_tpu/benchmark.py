@@ -47,6 +47,20 @@ def _soa(ids, dr, cr, amount, flags=None, pid=None, timeout=None):
     )
 
 
+# Per-config routing/fallback diagnostics, recorded by each config as
+# it finishes and emitted by bench.py into the run record — "no host
+# fallbacks" is a measured invariant of every bench config, not an
+# assumption. Keyed "configN" -> DeviceLedger.fallback_stats().
+CONFIG_DIAGNOSTICS: dict = {}
+
+
+def _record_diag(key, led) -> None:
+    try:
+        CONFIG_DIAGNOSTICS[key] = led.fallback_stats()
+    except Exception:  # diagnostics must never fail a bench run
+        pass
+
+
 def _make_ledger(account_count, a_cap=1 << 15, t_cap=1 << 21):
     from .ops.ledger import DeviceLedger
 
@@ -157,15 +171,18 @@ def _run_scan(led, evs, ts0, stack=None):
     return int(accepted), elapsed
 
 
-def _warm_and_run(led, mk, batches):
+def _warm_and_run(led, mk, batches, diag_key=None):
     """Warm up the exact program shape the timed run will use (compile
     through a slow tunnel is paid once, outside the clock), then measure."""
     stack = _superbatch_default(batches)
     warm = stack if stack > 1 else B_CHUNK
     _run_scan(led, [mk(b) for b in range(-warm, 0)],
               np.uint64(10**11), stack=stack)
-    return _run_scan(led, [mk(b) for b in range(batches)],
-                     np.uint64(10**12), stack=stack)
+    out = _run_scan(led, [mk(b) for b in range(batches)],
+                    np.uint64(10**12), stack=stack)
+    if diag_key is not None:
+        _record_diag(diag_key, led)
+    return out
 
 
 def bench_config1(batches):
@@ -180,7 +197,7 @@ def bench_config1(batches):
         cr = np.full(N, 2)
         return _soa(ids, dr, cr, rng.integers(1, 1000, N))
 
-    return _warm_and_run(led, mk, batches)
+    return _warm_and_run(led, mk, batches, diag_key="config1")
 
 
 def bench_config2(batches, account_count=10_000):
@@ -197,7 +214,7 @@ def bench_config2(batches, account_count=10_000):
         cr[clash] = dr[clash] % account_count + 1
         return _soa(ids, dr, cr, rng.integers(1, 10**6, N))
 
-    return _warm_and_run(led, mk, batches)
+    return _warm_and_run(led, mk, batches, diag_key="config2")
 
 
 def bench_config_zipfian(batches, account_count=10_000, theta=0.99):
@@ -241,7 +258,7 @@ def bench_config3(batches, account_count=1000):
         dr[1::2][bad] = account_count + 10**6
         return _soa(ids, dr, cr, rng.integers(1, 1000, N), flags=flags)
 
-    return _warm_and_run(led, mk, batches)
+    return _warm_and_run(led, mk, batches, diag_key="config3")
 
 
 def bench_config4(batches=2, n=None, account_count=64):
@@ -377,7 +394,9 @@ def bench_config4(batches=2, n=None, account_count=64):
     led.resolve_windows()
     for tk in pending:
         accepted += ticket_created(tk)
-    return accepted, time.perf_counter() - t0
+    elapsed = time.perf_counter() - t0
+    _record_diag("config4", led)
+    return accepted, elapsed
 
 
 def bench_config6_serving(batches=24, account_count=10_000):
@@ -506,6 +525,7 @@ def bench_config6_serving(batches=24, account_count=10_000):
     sm.led.drain_mirror()
     drain_ms = (time.perf_counter() - td) * 1000
     assert sm.led.fallbacks == 0, "serving bench unexpectedly fell back"
+    _record_diag("config6", sm.led)
     accepted = len(sm.state.transfers) - n_before
     # Per-batch commit latency percentiles (each commit is synchronous on
     # the serving path, so these are true percentiles — the reference

@@ -178,6 +178,37 @@ def render(history_path: str, out_path: str,
         + ("" if all(parity) else
            ' — <b style="color:#c22">PARITY FAILURE RECORDED</b>')
         + "</p>") if parity else ""
+    # Fallback observability: the newest run's per-config per-cause
+    # host-fallback counters (bench fallback_diagnostics). "Zero host
+    # fallbacks" is a measured invariant — a nonzero count is rendered
+    # as loudly as a throughput regression.
+    fb_html = ""
+    fb = next((e.get("fallback_diagnostics") for e in reversed(entries)
+               if isinstance(e.get("fallback_diagnostics"), dict)), None)
+    if fb:
+        rows_fb = []
+        any_host_fb = False
+        for cfg in sorted(fb):
+            d = fb[cfg] or {}
+            host = (d.get("host_fallbacks", 0) or 0) + \
+                (d.get("window_fallbacks", 0) or 0)
+            any_host_fb = any_host_fb or host > 0
+            causes = d.get("causes") or {}
+            cause_txt = ", ".join(
+                f"{k}={v}" for k, v in sorted(causes.items())) or "-"
+            rows_fb.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "</tr>".format(
+                    html.escape(cfg), host,
+                    d.get("escalations", 0) or 0, html.escape(cause_txt)))
+        badge_fb = ("" if not any_host_fb else
+                    '<p style="color:#c22;font-weight:700">HOST FALLBACKS '
+                    'RECORDED — the fast path left the device</p>')
+        fb_html = (
+            "<h2>fallback diagnostics (latest run)</h2>" + badge_fb
+            + "<table><tr><th>config</th><th>host fallbacks</th>"
+              "<th>escalations</th><th>causes</th></tr>"
+            + "".join(rows_fb) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -215,6 +246,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 <table><tr><th>metric</th><th>latest</th><th>history</th><th></th></tr>
 {''.join(rows)}
 </table>
+{fb_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

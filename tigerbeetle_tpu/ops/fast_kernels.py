@@ -409,6 +409,162 @@ def _xfer_gather_multi(xfr, rows_list):
     return outs
 
 
+def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
+    """Pending-transfer view of an in-batch DEFINITION read from its
+    event lanes (reference: the groove already holds same-batch
+    creations at post_or_void time, src/state_machine.zig:4053-4112).
+    Shared by per_event_status's internal substitution and the SPMD
+    tail's bundle fixup (create_transfers_fast spmd join path) so the
+    two can never drift. dr_rowc/cr_rowc are the per-event account-row
+    probe results the definition's rows are gathered from."""
+    dg = lambda a: a[didx]  # noqa: E731 — def-side gather
+    d_flags = dg(ev["flags"])
+    d_timeout = dg(ev["timeout"])
+    d_ts = dg(ts_event)
+    return dict(
+        id_hi=dg(ev["id_hi"]), id_lo=dg(ev["id_lo"]),
+        dr_hi=dg(ev["dr_hi"]), dr_lo=dg(ev["dr_lo"]),
+        cr_hi=dg(ev["cr_hi"]), cr_lo=dg(ev["cr_lo"]),
+        amt_hi=dg(ev["amt_hi"]), amt_lo=dg(ev["amt_lo"]),
+        pid_hi=dg(ev["pid_hi"]), pid_lo=dg(ev["pid_lo"]),
+        ud128_hi=dg(ev["ud128_hi"]), ud128_lo=dg(ev["ud128_lo"]),
+        ud64=dg(ev["ud64"]), ud32=dg(ev["ud32"]),
+        timeout=d_timeout,
+        ledger=dg(ev["ledger"]), code=dg(ev["code"]),
+        flags=d_flags,
+        ts=d_ts,
+        expires=jnp.where(
+            d_timeout != 0,
+            d_ts + jnp.uint64(d_timeout) * _NSPS, jnp.uint64(0)),
+        pstat=jnp.where(_flag(d_flags, _F_PENDING),
+                        jnp.int32(_PS_PENDING), jnp.int32(0)),
+        dr_row=dg(dr_rowc), cr_row=dg(cr_rowc),
+    )
+
+
+def _pv_eval(ev, p, p_found, p_dr, p_cr, ts_event, imported_ctx=None):
+    """Post/void evaluation (reference :4053-4112): sentinel amount
+    resolution + the ordered check list. ONE definition shared by
+    per_event_status and the SPMD tail's in-window substitution fixup
+    (create_transfers_fast spmd join path) so the two can never drift.
+
+    Returns (pv_status, pv_status_nf, pv_amt_hi, pv_amt_lo, pv_tail)
+    where pv_status_nf is the dead/missing-definition variant (the same
+    sequence with the lookup missing) and pv_tail is the post-regress
+    tail list — the source of the caller's precedence-override code
+    set."""
+    flags = ev["flags"]
+    pending = _flag(flags, _F_PENDING)
+    is_post = _flag(flags, _F_POST)
+    is_void = _flag(flags, _F_VOID)
+    imported = _flag(flags, _F_IMPORTED)
+
+    # Resolved post/void amount (sentinel resolution, reference :4101-4112).
+    pv_amt_hi, pv_amt_lo = u128.select(
+        jnp.where(is_void,
+                  u128.is_zero(ev["amt_hi"], ev["amt_lo"]),
+                  u128.is_max(ev["amt_hi"], ev["amt_lo"])),
+        p["amt_hi"], p["amt_lo"], ev["amt_hi"], ev["amt_lo"])
+
+    p_expires_due = (p["timeout"] != 0) & (p["expires"] <= ts_event)
+    pid_zero = u128.is_zero(ev["pid_hi"], ev["pid_lo"])
+    pid_max = u128.is_max(ev["pid_hi"], ev["pid_lo"])
+    pv_checks = [
+        (is_post & is_void, _TS["flags_are_mutually_exclusive"]),
+        (pending | _flag(flags, _F_BAL_DR) | _flag(flags, _F_BAL_CR)
+         | _flag(flags, _F_CLOSE_DR) | _flag(flags, _F_CLOSE_CR),
+         _TS["flags_are_mutually_exclusive"]),
+        (pid_zero, _TS["pending_id_must_not_be_zero"]),
+        (pid_max, _TS["pending_id_must_not_be_int_max"]),
+        (u128.eq(ev["pid_hi"], ev["pid_lo"], ev["id_hi"], ev["id_lo"]),
+         _TS["pending_id_must_be_different"]),
+        (ev["timeout"] != 0, _TS["timeout_reserved_for_pending_transfer"]),
+        (~p_found, _TS["pending_transfer_not_found"]),
+        (~_flag(p["flags"], _F_PENDING), _TS["pending_transfer_not_pending"]),
+        ((~u128.is_zero(ev["dr_hi"], ev["dr_lo"])) &
+         ~u128.eq(ev["dr_hi"], ev["dr_lo"], p["dr_hi"], p["dr_lo"]),
+         _TS["pending_transfer_has_different_debit_account_id"]),
+        ((~u128.is_zero(ev["cr_hi"], ev["cr_lo"])) &
+         ~u128.eq(ev["cr_hi"], ev["cr_lo"], p["cr_hi"], p["cr_lo"]),
+         _TS["pending_transfer_has_different_credit_account_id"]),
+        ((ev["ledger"] != 0) & (ev["ledger"] != p["ledger"]),
+         _TS["pending_transfer_has_different_ledger"]),
+        ((ev["code"] != 0) & (ev["code"] != p["code"]),
+         _TS["pending_transfer_has_different_code"]),
+        (u128.lt(p["amt_hi"], p["amt_lo"], pv_amt_hi, pv_amt_lo),
+         _TS["exceeds_pending_transfer_amount"]),
+        (is_void & u128.lt(pv_amt_hi, pv_amt_lo, p["amt_hi"], p["amt_lo"]),
+         _TS["pending_transfer_has_different_amount"]),
+        (p["pstat"] == _PS_POSTED, _TS["pending_transfer_already_posted"]),
+        (p["pstat"] == _PS_VOIDED, _TS["pending_transfer_already_voided"]),
+        (p["pstat"] == _PS_EXPIRED, _TS["pending_transfer_expired"]),
+        (p_expires_due, _TS["pending_transfer_expired"]),
+    ]
+    if imported_ctx is not None:
+        # Regress vs STATE (key_max + account-timestamp collision) at
+        # the reference's precedence position (create_transfer :4053
+        # path, mirrored by the sequential kernel's pv list); the
+        # in-batch component is the caller's maxima chain.
+        pv_regress = imported & (
+            (ev["ts"] <= imported_ctx["key_max"])
+            | imported_ctx["acct_ts_collision"])
+        pv_checks.append(
+            (pv_regress, _TS["imported_event_timestamp_must_not_regress"]))
+    # Post-regress tail: ALSO the source of the caller's precedence-
+    # override code set (after_regress_codes) — one literal list, so a
+    # future check added here is automatically override-eligible.
+    pv_tail = [
+        (_flag(p_dr["flags"], _A_CLOSED) & ~is_void,
+         _TS["debit_account_already_closed"]),
+        (_flag(p_cr["flags"], _A_CLOSED) & ~is_void,
+         _TS["credit_account_already_closed"]),
+    ]
+    pv_checks = pv_checks + pv_tail
+    pv_status = _first_failure(pv_checks)
+    # The use's status when its in-window definition turns out dead
+    # (failed creation): the pending transfer does not exist, so the
+    # sequential truth is the same check sequence with the lookup
+    # missing — earlier-precedence field checks still win.
+    pv_status_nf = _first_failure(
+        pv_checks[:6] + [(jnp.ones_like(pid_zero),
+                          _TS["pending_transfer_not_found"])])
+    return pv_status, pv_status_nf, pv_amt_hi, pv_amt_lo, pv_tail
+
+
+def imported_batch_ctx(state, ev, ts_event, valid, idxs, seg_start=None):
+    """imported_ctx for per_event_status (the real imported-event rules,
+    reference :3052-3063 wrapper + :3800-3833): per-sub-batch
+    homogeneity reference + commit timestamp, account-timestamp
+    collision membership, and the state's key_max. Factored out of
+    create_transfers_fast so the SPMD driver (parallel/full_sharded.py)
+    can compute it replicated and feed the sharded per-event stage."""
+    acc = state["accounts"]
+    N = idxs.shape[0]
+    imp_lane = _flag(ev["flags"], _F_IMPORTED)
+    seg_start_arr = (idxs == 0) if seg_start is None else seg_start
+    # Per-sub-batch homogeneity reference: the FIRST lane's flag
+    # (reference: events[0], execute_create :3052), forward-filled
+    # to every lane of the segment.
+    start_idx = _cummax(jnp.where(seg_start_arr, idxs, jnp.int32(-1)))
+    batch_imported = imp_lane[jnp.maximum(start_idx, 0)]
+    # Per-sub-batch commit timestamp (must_not_advance compares the
+    # user timestamp against it): max valid ts_event of the segment.
+    seg_id = _cumsum(seg_start_arr.astype(jnp.int32)) - 1
+    seg_bts = jax.ops.segment_max(
+        jnp.where(valid, ts_event, jnp.uint64(0)), seg_id,
+        num_segments=N)[seg_id]
+    # Account-timestamp collision (reference :3808): membership of
+    # the user timestamp in the account table's timestamp column.
+    acct_ts_sorted = jnp.sort(acc["u64"][:, AC_U64_IDX["ts"]])
+    pos = jnp.searchsorted(acct_ts_sorted, ev["ts"])
+    pos = jnp.minimum(pos, acct_ts_sorted.shape[0] - 1)
+    coll = imp_lane & (acct_ts_sorted[pos] == ev["ts"]) \
+        & (ev["ts"] != 0)
+    return dict(
+        batch_imported=batch_imported, batch_ts=seg_bts,
+        acct_ts_collision=coll, key_max=state["xfer_key_max"])
+
+
 def per_event_status(state, ev, ts_event, return_gathers=False,
                      inwin=None, didx=None, imported_ctx=None):
     """The per-event phase of create_transfers: hash lookups, row gathers,
@@ -499,28 +655,7 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
     if inwin is not None:
         dg = lambda a: a[didx]  # noqa: E731 — def-side gather
         inwin = inwin & ~dg(e_found) & ~dg(o_found)
-        d_flags = dg(ev["flags"])
-        d_timeout = dg(ev["timeout"])
-        d_ts = dg(ts_event)
-        p2 = dict(
-            id_hi=dg(ev["id_hi"]), id_lo=dg(ev["id_lo"]),
-            dr_hi=dg(ev["dr_hi"]), dr_lo=dg(ev["dr_lo"]),
-            cr_hi=dg(ev["cr_hi"]), cr_lo=dg(ev["cr_lo"]),
-            amt_hi=dg(ev["amt_hi"]), amt_lo=dg(ev["amt_lo"]),
-            pid_hi=dg(ev["pid_hi"]), pid_lo=dg(ev["pid_lo"]),
-            ud128_hi=dg(ev["ud128_hi"]), ud128_lo=dg(ev["ud128_lo"]),
-            ud64=dg(ev["ud64"]), ud32=dg(ev["ud32"]),
-            timeout=d_timeout,
-            ledger=dg(ev["ledger"]), code=dg(ev["code"]),
-            flags=d_flags,
-            ts=d_ts,
-            expires=jnp.where(
-                d_timeout != 0,
-                d_ts + jnp.uint64(d_timeout) * _NSPS, jnp.uint64(0)),
-            pstat=jnp.where(_flag(d_flags, _F_PENDING),
-                            jnp.int32(_PS_PENDING), jnp.int32(0)),
-            dr_row=dg(dr_rowc), cr_row=dg(cr_rowc),
-        )
+        p2 = _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc)
         for key in p:
             p[key] = jnp.where(inwin, p2[key], p[key])
         p_found = p_found | inwin
@@ -529,81 +664,17 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
         acc, [dr_rowc, cr_rowc, p["dr_row"], p["cr_row"]],
         [dr_found, cr_found, p_found, p_found])
 
-    # Resolved post/void amount (sentinel resolution, reference :4101-4112).
-    pv_amt_hi, pv_amt_lo = u128.select(
-        jnp.where(is_void,
-                  u128.is_zero(ev["amt_hi"], ev["amt_lo"]),
-                  u128.is_max(ev["amt_hi"], ev["amt_lo"])),
-        p["amt_hi"], p["amt_lo"], ev["amt_hi"], ev["amt_lo"])
-    amt_res_hi = jnp.where(pv, pv_amt_hi, ev["amt_hi"])
-    amt_res_lo = jnp.where(pv, pv_amt_lo, ev["amt_lo"])
-
     # ---------------- status evaluation ----------------
     exists_status, exists_ts = _ct_eval_exists(
         {k: ev[k] for k in ev}, e, p)
 
-    p_expires_due = (p["timeout"] != 0) & (p["expires"] <= ts_event)
-    pid_zero = u128.is_zero(ev["pid_hi"], ev["pid_lo"])
-    pid_max = u128.is_max(ev["pid_hi"], ev["pid_lo"])
-    pv_checks = [
-        (is_post & is_void, _TS["flags_are_mutually_exclusive"]),
-        (pending | _flag(flags, _F_BAL_DR) | _flag(flags, _F_BAL_CR)
-         | _flag(flags, _F_CLOSE_DR) | _flag(flags, _F_CLOSE_CR),
-         _TS["flags_are_mutually_exclusive"]),
-        (pid_zero, _TS["pending_id_must_not_be_zero"]),
-        (pid_max, _TS["pending_id_must_not_be_int_max"]),
-        (u128.eq(ev["pid_hi"], ev["pid_lo"], ev["id_hi"], ev["id_lo"]),
-         _TS["pending_id_must_be_different"]),
-        (ev["timeout"] != 0, _TS["timeout_reserved_for_pending_transfer"]),
-        (~p_found, _TS["pending_transfer_not_found"]),
-        (~_flag(p["flags"], _F_PENDING), _TS["pending_transfer_not_pending"]),
-        ((~u128.is_zero(ev["dr_hi"], ev["dr_lo"])) &
-         ~u128.eq(ev["dr_hi"], ev["dr_lo"], p["dr_hi"], p["dr_lo"]),
-         _TS["pending_transfer_has_different_debit_account_id"]),
-        ((~u128.is_zero(ev["cr_hi"], ev["cr_lo"])) &
-         ~u128.eq(ev["cr_hi"], ev["cr_lo"], p["cr_hi"], p["cr_lo"]),
-         _TS["pending_transfer_has_different_credit_account_id"]),
-        ((ev["ledger"] != 0) & (ev["ledger"] != p["ledger"]),
-         _TS["pending_transfer_has_different_ledger"]),
-        ((ev["code"] != 0) & (ev["code"] != p["code"]),
-         _TS["pending_transfer_has_different_code"]),
-        (u128.lt(p["amt_hi"], p["amt_lo"], pv_amt_hi, pv_amt_lo),
-         _TS["exceeds_pending_transfer_amount"]),
-        (is_void & u128.lt(pv_amt_hi, pv_amt_lo, p["amt_hi"], p["amt_lo"]),
-         _TS["pending_transfer_has_different_amount"]),
-        (p["pstat"] == _PS_POSTED, _TS["pending_transfer_already_posted"]),
-        (p["pstat"] == _PS_VOIDED, _TS["pending_transfer_already_voided"]),
-        (p["pstat"] == _PS_EXPIRED, _TS["pending_transfer_expired"]),
-        (p_expires_due, _TS["pending_transfer_expired"]),
-    ]
     imported = _flag(flags, _F_IMPORTED)
-    if imported_ctx is not None:
-        # Regress vs STATE (key_max + account-timestamp collision) at
-        # the reference's precedence position (create_transfer :4053
-        # path, mirrored by the sequential kernel's pv list); the
-        # in-batch component is the caller's maxima chain.
-        pv_regress = imported & (
-            (ev["ts"] <= imported_ctx["key_max"])
-            | imported_ctx["acct_ts_collision"])
-        pv_checks.append(
-            (pv_regress, _TS["imported_event_timestamp_must_not_regress"]))
-    # Post-regress tail: ALSO the source of the caller's precedence-
-    # override code set (after_regress_codes) — one literal list, so a
-    # future check added here is automatically override-eligible.
-    pv_tail = [
-        (_flag(p_dr["flags"], _A_CLOSED) & ~is_void, _TS["debit_account_already_closed"]),
-        (_flag(p_cr["flags"], _A_CLOSED) & ~is_void, _TS["credit_account_already_closed"]),
-    ]
-    pv_checks += pv_tail
-    pv_status = _first_failure(pv_checks)
-    # The use's status when its in-window definition turns out dead
-    # (failed creation): the pending transfer does not exist, so the
-    # sequential truth is the same check sequence with the lookup
-    # missing — earlier-precedence field checks still win.
-    pv_status_nf = _first_failure(
-        pv_checks[:6] + [(jnp.ones_like(pid_zero),
-                          _TS["pending_transfer_not_found"])])
+    pv_status, pv_status_nf, pv_amt_hi, pv_amt_lo, pv_tail = _pv_eval(
+        ev, p, p_found, p_dr, p_cr, ts_event, imported_ctx)
+    amt_res_hi = jnp.where(pv, pv_amt_hi, ev["amt_hi"])
+    amt_res_lo = jnp.where(pv, pv_amt_lo, ev["amt_lo"])
 
+    pid_zero = u128.is_zero(ev["pid_hi"], ev["pid_lo"])
     dr_zero = u128.is_zero(ev["dr_hi"], ev["dr_lo"])
     dr_max = u128.is_max(ev["dr_hi"], ev["dr_lo"])
     cr_zero = u128.is_zero(ev["cr_hi"], ev["cr_lo"])
@@ -724,6 +795,10 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
         amt_res_hi=amt_res_hi, amt_res_lo=amt_res_lo,
         dr_row=dr_rowc, cr_row=cr_rowc, p_row=p_rowc,
         dr_found=dr_found, cr_found=cr_found, p_found=p_found,
+        # Own-id probe results: the SPMD tail's in-window join fixup
+        # gates the substitution on the DEFINITION's id being absent
+        # from the table (live or orphaned).
+        e_found=e_found, o_found=o_found,
     )
     if imported_ctx is not None:
         # Every status code checked AFTER the regress position (the
@@ -850,35 +925,21 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     pv = is_post | is_void
     timeout_ns = jnp.uint64(ev["timeout"]) * _NSPS
 
-    spmd_legacy = per_event is not None
+    spmd = per_event is not None
+    # The in-window join fixup path: a sharded per-event bundle feeding
+    # a fixpoint tail — the join is computed here, replicated, and the
+    # substitution re-applied to the bundle (parallel/full_sharded.py).
+    spmd_join = spmd and limit_rounds > 1 and not imported_mode
     imported_ctx = None
     if imported_mode:
-        assert per_event is None and limit_rounds == 1, \
-            "imported_mode composes with the plain tier only"
-        imp_lane = _flag(flags, _F_IMPORTED)
-        seg_start_arr = (idxs == 0) if seg_start is None else seg_start
-        # Per-sub-batch homogeneity reference: the FIRST lane's flag
-        # (reference: events[0], execute_create :3052), forward-filled
-        # to every lane of the segment.
-        start_idx = _cummax(jnp.where(seg_start_arr, idxs, jnp.int32(-1)))
-        batch_imported = imp_lane[jnp.maximum(start_idx, 0)]
-        # Per-sub-batch commit timestamp (must_not_advance compares the
-        # user timestamp against it): max valid ts_event of the segment.
-        seg_id = _cumsum(seg_start_arr.astype(jnp.int32)) - 1
-        seg_bts = jax.ops.segment_max(
-            jnp.where(valid, ts_event, jnp.uint64(0)), seg_id,
-            num_segments=N)[seg_id]
-        # Account-timestamp collision (reference :3808): membership of
-        # the user timestamp in the account table's timestamp column.
-        acct_ts_sorted = jnp.sort(acc["u64"][:, AC_U64_IDX["ts"]])
-        pos = jnp.searchsorted(acct_ts_sorted, ev["ts"])
-        pos = jnp.minimum(pos, acct_ts_sorted.shape[0] - 1)
-        coll = imp_lane & (acct_ts_sorted[pos] == ev["ts"]) \
-            & (ev["ts"] != 0)
-        imported_ctx = dict(
-            batch_imported=batch_imported, batch_ts=seg_bts,
-            acct_ts_collision=coll, key_max=state["xfer_key_max"])
-    if per_event is None and limit_rounds > 1:
+        assert not balancing_mode, \
+            "the imported and balancing tiers do not compose"
+        assert not (spmd and limit_rounds == 1), \
+            "the sharded imported tail always runs the fixpoint rounds"
+        if per_event is None:
+            imported_ctx = imported_batch_ctx(
+                state, ev, ts_event, valid, idxs, seg_start)
+    if per_event is None and limit_rounds > 1 and not imported_mode:
         # Fixpoint tiers: the precise dup/join split + in-window pending
         # substitution (~50 extra ops — only these tiers can USE the
         # join, so only they pay for it).
@@ -890,12 +951,15 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         didx = per_event["didx"]
         status_dead = per_event["status_pre_dead"]
     elif per_event is None:
-        # Plain tier (the scan hot path): the legacy combined dup check —
-        # ONE cheap sort, no join, no substitution. Any collision
-        # (same-kind dup OR an in-batch pending reference) sets e2; the
-        # escalation flag below routes e2-only batches to the fixpoint
-        # tier, whose precise join then either resolves the pending
-        # reference on device or (real duplicates) falls back to host.
+        # Plain tier (the scan hot path) and the imported tiers: the
+        # legacy combined dup check — ONE cheap sort, no join, no
+        # substitution. Any collision (same-kind dup OR an in-batch
+        # pending reference) sets e2; the plain tier's escalation flag
+        # routes e2-only batches to the fixpoint tier, whose precise
+        # join then either resolves the pending reference on device or
+        # (real duplicates) falls back to host. The imported tiers keep
+        # e2 hard (the join's substitution is not imported-aware: an
+        # imported definition's stored timestamp is the USER's).
         e2 = _combined_dup_keys(ev, valid, pv)
         per_event = per_event_status(state, ev, ts_event,
                                      return_gathers=True,
@@ -903,11 +967,29 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         inwin = jnp.zeros(N, dtype=jnp.bool_)
         didx = jnp.zeros(N, dtype=jnp.int32)
         status_dead = per_event["status_pre"]
+    elif spmd_join:
+        # SPMD fixpoint tail: the bundle was computed per shard WITHOUT
+        # the batch-global join — compute the join replicated here and
+        # re-apply the substitution to the re-gathered view below. The
+        # substitution gate (definition id absent from the table) reads
+        # the bundle's own-id probe lanes; a use whose OWN id collides
+        # with the table would need the substituted exists evaluation —
+        # that vanishing edge stays a hard fallback (folded into e2).
+        e2, inwin_raw, didx = _dup_and_pend_join(ev, valid, pv, idxs, N)
+        ef_b = per_event["e_found"]
+        of_b = per_event["o_found"]
+        inwin = inwin_raw & ~ef_b[didx] & ~of_b[didx]
+        e2 = e2 | jnp.any(inwin_raw & (ef_b | of_b))
+        didx = jnp.where(inwin, didx, 0)
+        # The unsubstituted bundle status IS the dead-definition
+        # variant: for a gated in-window use the table lookup missed,
+        # which is exactly the missing-definition sequence.
+        status_dead = per_event["status_pre"]
     else:
-        # SPMD path (parallel/full_sharded.py): per-shard status was
-        # computed WITHOUT the batch-global join, so keep the legacy
-        # rule — any id/pid collision (incl. in-batch pending refs)
-        # falls back. Same-kind duplicates fall back either way.
+        # SPMD plain/imported tail: per-shard statuses were computed
+        # without the batch-global join — any id/pid collision (incl.
+        # in-batch pending refs) escalates (plain) or falls back
+        # (imported). Same-kind duplicates fall back either way.
         e2 = _combined_dup_keys(ev, valid, pv)
         inwin = jnp.zeros(N, dtype=jnp.bool_)
         didx = jnp.zeros(N, dtype=jnp.int32)
@@ -921,20 +1003,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     amt_res_hi = per_event["amt_res_hi"]
     amt_res_lo = per_event["amt_res_lo"]
     ts_actual = per_event["ts_pre"]
-    # Closing-native (fixpoint tiers): closing_debit/closing_credit and
-    # void-reopens run on device — the closed-state evolution joins the
-    # K-round fixpoint (reference :3837 close gate, :3941-3944 set,
-    # :4184-4189 void exception, :4254-4261 reopen). The base status is
-    # then the closed-STRIPPED variant; the closed codes are reapplied
-    # each round from the evolving in-batch closed state. The imported
-    # tier keeps closing hard (its maxima chain has no rounds to host
-    # the evolution); the SPMD legacy path too (per-shard statuses).
-    closing_native = (limit_rounds > 1 and not spmd_legacy
-                      and not imported_mode)
+    # Closing-native (every fixpoint tier, imported and SPMD included):
+    # closing_debit/closing_credit and void-reopens run on device — the
+    # closed-state evolution joins the K-round fixpoint (reference
+    # :3837 close gate, :3941-3944 set, :4184-4189 void exception,
+    # :4254-4261 reopen). The base status is then the closed-STRIPPED
+    # variant; the closed codes are reapplied each round from the
+    # evolving in-batch closed state. Eligibility is uniform across
+    # single-chip and SPMD: the plain tiers escalate closing to their
+    # fixpoint sibling instead of hard-falling-back to the host.
+    closing_native = limit_rounds > 1
     status = (per_event["status_nc"] if closing_native
               else per_event["status_pre"])
 
-    if imported_mode:
+    if imported_mode and limit_rounds == 1:
         # ---- in-batch regress: the left-to-right maxima chain ----
         # (see the imported_mode docstring for why this closed form is
         # exactly the sequential applied set). actual_ts of an applied
@@ -971,9 +1053,39 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # gathers on replicated state; keeps the all-gathered per-event
         # bundle compact).
         (p,) = _xfer_gather_multi(xfr, [p_rowc])
+        if spmd_join:
+            # Re-apply the in-window pending substitution to the
+            # re-gathered view — same builder as per_event_status's
+            # internal substitution, so the two cannot drift.
+            p2 = _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc)
+            p = {k: jnp.where(inwin, p2[k], p[k]) for k in p}
+            p_found = p_found | inwin
         dr, cr, p_dr, p_cr = _acct_gather_multi(
             acc, [dr_rowc, cr_rowc, p["dr_row"], p["cr_row"]],
             [dr_found, cr_found, p_found, p_found])
+        if spmd_join:
+            # Status fixup for substituted lanes: the shard bundle
+            # evaluated them against a MISSING pending, so the only
+            # possible p-dependent status is pending_transfer_not_found
+            # (every check sequenced before it is p-independent, and
+            # the wrapper codes are too). Re-run the shared post/void
+            # evaluation with the substituted view and replace exactly
+            # those lanes; the resolved sentinel amount rides along.
+            pv_status_s, _, pv_amt_hi_s, pv_amt_lo_s, _ = _pv_eval(
+                ev, p, p_found, p_dr, p_cr, ts_event)
+            fix = inwin & pv & (
+                status == _TS["pending_transfer_not_found"])
+            # This path is always a fixpoint tail (closing-native): the
+            # working status is the closed-STRIPPED variant, so strip
+            # the substituted code the same way (pv lanes: closed ->
+            # CREATED; the rounds re-derive the closed decision).
+            is_cl_s = (
+                (pv_status_s == _TS["debit_account_already_closed"])
+                | (pv_status_s == _TS["credit_account_already_closed"]))
+            status = jnp.where(
+                fix, jnp.where(is_cl_s, _CREATED, pv_status_s), status)
+            amt_res_hi = jnp.where(inwin & pv, pv_amt_hi_s, amt_res_hi)
+            amt_res_lo = jnp.where(inwin & pv, pv_amt_lo_s, amt_res_lo)
 
     # ---------------- eligibility ----------------
     # Scalar-reduction fusion (dispatch-count discipline): e1/e5 and the
@@ -981,20 +1093,23 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # the combined `others` OR — they reduce in ONE stacked any below
     # (hard_vecs) instead of three separate reduces.
     if imported_mode:
-        # Imported events are native here; balancing/closing stay hard.
-        # Chains are the one interaction the maxima chain cannot
-        # express (a rollback rewinds the running max — including a
-        # NON-imported chain whose members' ts_event entered the max
-        # before the rollback), so a dispatch carrying BOTH imported
-        # events and links anywhere falls back to exact (scalar gate
-        # folded into e1 via broadcast).
-        hard_flags = _F_BAL_DR | _F_BAL_CR | _F_CLOSE_DR | _F_CLOSE_CR
+        # Imported events are native here; balancing stays hard, and
+        # closing is ESCALATABLE on the plain imported tier (to the
+        # imported fixpoint tier, where it runs native) — uniform
+        # closing eligibility across tiers. Chains are the one
+        # interaction the maxima chain cannot express (a rollback
+        # rewinds the running max — including a NON-imported chain
+        # whose members' ts_event entered the max before the rollback),
+        # so a dispatch carrying BOTH imported events and links
+        # anywhere falls back to exact (scalar gate folded into e1 via
+        # broadcast).
+        hard_flags = _F_BAL_DR | _F_BAL_CR
         impchain = (jnp.any(valid & _flag(flags, _F_IMPORTED))
                     & jnp.any(linked))
         e1_vec = valid & (_flag(flags, jnp.uint32(hard_flags))
                           | impchain)
     elif balancing_mode:
-        assert limit_rounds > 1 and not spmd_legacy, \
+        assert limit_rounds > 1, \
             "balancing_mode rides the limit fixpoint"
         # Balancing clamps AND closing resolve inside the fixpoint;
         # imported has its own tier. In-window pending defs that are
@@ -1013,22 +1128,15 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR
         e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
     else:
+        # Plain tier, single-chip or sharded: closing flags are
+        # RESOLVABLE on the fixpoint tier — they escalate (limit_only
+        # redispatch, or the sharded router's fixpoint step) instead of
+        # hard-falling-back to the host (e_close_vec below).
         hard_flags = _F_IMPORTED | _F_BAL_DR | _F_BAL_CR
-        close_bits = jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)
-        if spmd_legacy:
-            # Sharded driver has no fixpoint tier to redispatch to:
-            # closing stays a hard fallback per shard.
-            e1_vec = valid & (_flag(flags, jnp.uint32(hard_flags))
-                              | _flag(flags, close_bits))
-        else:
-            # Plain tier: closing flags are RESOLVABLE on the fixpoint
-            # tier — they escalate (limit_only redispatch) instead of
-            # hard-falling-back to the host (e_close_vec below).
-            e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
+        e1_vec = valid & _flag(flags, jnp.uint32(hard_flags))
     e_close_vec = (valid & _flag(flags, jnp.uint32(_F_CLOSE_DR
                                                    | _F_CLOSE_CR))
-                   if (limit_rounds == 1 and not spmd_legacy
-                       and not imported_mode)
+                   if limit_rounds == 1
                    else jnp.zeros_like(valid))
 
     # Eligibility sums below run over the OPTIMISTIC apply set: events
@@ -1137,13 +1245,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     e5_vec = (valid & is_void & p_found
               & _flag(p["flags"], jnp.uint32(_F_CLOSE_DR | _F_CLOSE_CR)))
     # ONE reduction for every N-length hard-fallback vector: e1 (hard
-    # flags), the eight pair-overflow lanes, and e5 (void of a closing
-    # pending; native reopen in the closing-native tiers, escalatable
-    # in the plain tier, hard for imported/SPMD) — their only consumer
-    # is the combined OR. The scalar terms (ovf, s4) join at the OR.
+    # flags) and the eight pair-overflow lanes — their only consumer is
+    # the combined OR. The scalar terms (ovf, s4) join at the OR. e5
+    # (void of a closing pending) is never hard anymore: native reopen
+    # in the closing-native (fixpoint) tiers, escalatable everywhere
+    # else.
     hard_vecs = [e1_vec, *pair_ovfs]
-    if not closing_native and (imported_mode or spmd_legacy):
-        hard_vecs.append(e5_vec)
     hard_any = jnp.any(jnp.stack(hard_vecs))
     if balancing_mode:
         # The E4 amount-sum proof is useless under balancing: the
@@ -1316,9 +1423,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         over_dr = jnp.zeros_like(valid)
         over_cr = jnp.zeros_like(valid)
         dead = jnp.zeros_like(valid)
+        reg_low = jnp.zeros_like(valid)  # imported: in-batch regress
         ovf_code = jnp.zeros_like(status)  # balancing_mode: exact
         # balance-overflow statuses (:3856-3884), 0 = none.
         fix_converged = jnp.bool_(True)
+        if imported_mode:
+            # Imported fixpoint tier: the in-batch regress decision (the
+            # left-to-right maxima chain — see the imported_mode
+            # docstring) is round-dependent here, because the applied
+            # set it runs over now evolves with the closed-state /
+            # limit decisions. It joins the rounds: same induction, the
+            # earliest event whose prefix is sequential truth gets the
+            # exact running max and stays fixed.
+            imp_lane = _flag(flags, _F_IMPORTED)
+            actual_vec = jnp.where(imp_lane, ev["ts"], ts_event)
         for _round in range(limit_rounds):
             st_r = jnp.where(ovf_code != 0, ovf_code, status)
             st_r = jnp.where(over_dr, _TS["exceeds_credits"], st_r)
@@ -1333,6 +1451,31 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                 st_r = jnp.where(
                     ccr_ln & ~cdr_ln,
                     _TS["credit_account_already_closed"], st_r)
+            if imported_mode:
+                # Regress outranks every code checked after its position
+                # (closed / overflow / limit codes — applied above, so
+                # this where wins); the override can only hit lanes that
+                # could never apply either way, leaving the maxima chain
+                # unaffected (same argument as the closed form).
+                base_ok_r = valid & (st_r == _CREATED)
+                cand_r = jnp.where(base_ok_r, actual_vec, jnp.uint64(0))
+                run_incl_r = _cummax(cand_r)
+                run_excl_r = jnp.maximum(
+                    state["xfer_key_max"],
+                    jnp.concatenate([state["xfer_key_max"][None],
+                                     run_incl_r[:-1]]))
+                chain_low_r = imp_lane & valid & (ev["ts"] <= run_excl_r)
+                in_after_r = ((st_r == _TS["exceeds_credits"])
+                              | (st_r == _TS["exceeds_debits"]))
+                for code in per_event["after_regress_codes"]:
+                    in_after_r = in_after_r | (st_r == jnp.uint32(code))
+                new_reg_low = chain_low_r & (base_ok_r | in_after_r)
+                st_r = jnp.where(
+                    new_reg_low,
+                    _TS["imported_event_timestamp_must_not_regress"],
+                    st_r)
+            else:
+                new_reg_low = reg_low
             # In-window dependency deaths from the PREVIOUS round's
             # final statuses: a use whose definition did not create
             # reads pending_transfer_not_found (sequential truth).
@@ -1501,9 +1644,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                                     & (new_ovf == ovf_code)
                                     & (new_dead == dead)
                                     & (new_cdr == cdr_ln)
-                                    & (new_ccr == ccr_ln)) & amt_stable
+                                    & (new_ccr == ccr_ln)
+                                    & (new_reg_low == reg_low)) & amt_stable
             over_dr, over_cr, dead = new_over_dr, new_over_cr, new_dead
             cdr_ln, ccr_ln = new_cdr, new_ccr
+            reg_low = new_reg_low
             ovf_code = new_ovf
         status = jnp.where(ovf_code != 0, ovf_code, status)
         status = jnp.where(over_dr, _TS["exceeds_credits"], status)
@@ -1515,7 +1660,26 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             status = jnp.where(
                 ccr_ln & ~cdr_ln,
                 _TS["credit_account_already_closed"], status)
+        if imported_mode:
+            # Regress precedes the closed/overflow/limit positions in
+            # the sequential order — applied after them, so it wins; a
+            # regress-overridden lane reverts to its event timestamp.
+            status = jnp.where(
+                reg_low, _TS["imported_event_timestamp_must_not_regress"],
+                status)
+            ts_actual = jnp.where(reg_low, ts_event, ts_actual)
         status = jnp.where(dead, status_dead, status)
+        if imported_mode:
+            # ts_pre followed the PER-EVENT status, but the rounds can
+            # flip an imported lane either way (closed-stripped base ->
+            # applies; in-batch close -> dies): the result/applied
+            # timestamp follows the FINAL status — created -> the user
+            # timestamp, exists -> the stored row's (ts_pre carries it),
+            # any other failure -> the event timestamp.
+            ts_actual = jnp.where(
+                imp_lane & (status != _TS["exists"]),
+                jnp.where(status == _CREATED, ev["ts"], ts_event),
+                ts_actual)
         if balancing_mode:
             # Converged clamped amounts become the applied/stored
             # amounts: row inserts, the event ring's amt (areq keeps
@@ -1572,39 +1736,41 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     xfer_pos, ins_ok = ht_plan(
         state["xfer_ht"], ev["id_hi"], ev["id_lo"], ins_mask)
 
-    if imported_mode:
-        # Imported tier: the fixpoint tiers are not imported-aware, so
-        # nothing escalates — collisions (possible in-window pending
-        # refs) AND potential limit breaches go straight to the exact
-        # host path.
-        others = e145 | e2 | e3 | e7 | e8 | ~ins_ok
-        escalatable = jnp.bool_(False)
-    elif limit_rounds == 1 and not spmd_legacy:
-        # Plain tier: e2 is the COMBINED collision check — it may be an
-        # in-batch pending reference the fixpoint tier can resolve, so
-        # it escalates instead of hard-falling-back. Closing flags and
-        # voids of closing pendings (e5) likewise: the fixpoint tier
-        # runs them natively.
+    if imported_mode and limit_rounds == 1:
+        # Plain imported tier: closing flags, voids of closing pendings
+        # and potential limit breaches escalate to the imported
+        # FIXPOINT tier (closing/limits run native there — uniform
+        # eligibility). Collisions stay hard: the join's in-window
+        # substitution is not imported-aware.
+        others = e145 | e2 | e7 | e8 | ~ins_ok
+        escalatable = (e3
+                       | jnp.any(jnp.stack([e_close_vec, e5_vec])))
+    elif limit_rounds == 1:
+        # Plain tier (single-chip or the sharded plain tail): e2 is the
+        # COMBINED collision check — it may be an in-batch pending
+        # reference the fixpoint tier can resolve (the sharded fixpoint
+        # tail computes the join replicated), so it escalates instead
+        # of hard-falling-back. Closing flags and voids of closing
+        # pendings (e5) likewise: the fixpoint tier runs them natively.
         others = e145 | e7 | e8 | ~ins_ok
         escalatable = (e3 | e2
                        | jnp.any(jnp.stack([e_close_vec, e5_vec])))
     else:
-        # Fixpoint tiers: e2 is precise same-kind duplicates (real
-        # fallback). SPMD path (per_event supplied): per-shard statuses
-        # were computed without the batch-global join, so its combined
-        # e2 stays a HARD fallback too (escalating it would loop — the
-        # sharded driver has no fixpoint tier to redispatch to).
+        # Fixpoint tiers (incl. the SPMD join tail and the imported
+        # fixpoint tier): e2 is precise same-kind duplicates (real
+        # fallback; for imported/SPMD it also carries the join's hard
+        # edges). Only an unconverged cascade escalates (deeper tier).
         others = e145 | e2 | e7 | e8 | ~ins_ok
         escalatable = e3
     if force_fallback is not None:
         others = others | force_fallback
     fallback = others | escalatable
-    # A fallback caused ONLY by the balance-limit headroom proof and/or
-    # a key collision (possible in-window pending reference) is
-    # resolvable on device: the caller redispatches it to the fixpoint
-    # variant (limit_rounds > 1) instead of the exact host path.
-    limit_only = (escalatable & ~others
-                  & jnp.bool_(limit_rounds == 1 and not imported_mode))
+    # A fallback caused ONLY by the balance-limit headroom proof, a key
+    # collision (possible in-window pending reference), a closing flag
+    # or a void of a closing pending is resolvable on device: the
+    # caller redispatches it to the matching fixpoint variant
+    # (limit_rounds > 1) instead of the exact host path.
+    limit_only = escalatable & ~others & jnp.bool_(limit_rounds == 1)
     ok = ~fallback
 
     # ---------------- application (all masked by ok) ----------------
@@ -1884,12 +2050,33 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         pulse_next=pulse,
         commit_ts=commit_ts,
     )
+    # Per-cause fallback observability (scalar bools, nonzero only when
+    # the batch actually fell back): the host drivers accumulate these
+    # into counters so "zero host fallbacks on a mixed window" is a
+    # MEASURED invariant (bench.py diagnostics / devhub.py), not an
+    # assumption. `limit`/`closing`/`e5`/`e2` may be escalations the
+    # caller resolves on a deeper tier — the drivers count those
+    # separately from true host fallbacks.
+    fb_causes = {
+        "e1_hard_flags": jnp.any(e1_vec),
+        "e2_collision": e2,
+        "e3_limit": e3,
+        "e4_overflow": (jnp.any(jnp.stack(pair_ovfs))
+                        | (jnp.bool_(False) if balancing_mode
+                           else (ovf | (s4 > 0)))),
+        "e5_void_closing": jnp.any(e5_vec),
+        "closing": jnp.any(e_close_vec),
+        "capacity": e7 | e8 | ~ins_ok,
+        "forced": (jnp.bool_(False) if force_fallback is None
+                   else force_fallback),
+    }
     out = dict(
         r_status=jnp.where(ok, status, jnp.zeros_like(status)),
         r_ts=jnp.where(ok, jnp.where(valid, ts_actual, jnp.uint64(0)),
                        jnp.zeros_like(ts_actual)),
         fallback=fallback,
         limit_only=limit_only,
+        fb_causes={k: v & fallback for k, v in fb_causes.items()},
         # Fixpoint variants: the ONLY obstacle was a limit-decision
         # cascade deeper than this variant's round budget — a deeper
         # variant resolves it on device (the caller escalates before
@@ -2031,6 +2218,22 @@ create_transfers_fixpoint_jit = jax.jit(
 LIMIT_FIXPOINT_ROUNDS_DEEP = 32
 create_transfers_fixpoint_deep_jit = jax.jit(
     functools.partial(create_transfers_fast,
+                      limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP),
+    donate_argnums=0)
+
+# Imported fixpoint tier: the plain imported tier's escalation target
+# (closing flags, voids of closing pendings, potential limit breaches).
+# Runs the imported rules AND the closing-native/limit fixpoint in one
+# kernel — the in-batch regress maxima chain joins the rounds (the
+# applied set it runs over evolves with the closed/limit decisions).
+# Uniform closing eligibility across tiers is what lets the SPMD driver
+# run mixed imported+closing windows with zero host fallbacks.
+create_transfers_imported_fixpoint_jit = jax.jit(
+    functools.partial(create_transfers_fast, imported_mode=True,
+                      limit_rounds=LIMIT_FIXPOINT_ROUNDS),
+    donate_argnums=0)
+create_transfers_imported_fixpoint_deep_jit = jax.jit(
+    functools.partial(create_transfers_fast, imported_mode=True,
                       limit_rounds=LIMIT_FIXPOINT_ROUNDS_DEEP),
     donate_argnums=0)
 

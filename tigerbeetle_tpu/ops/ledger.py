@@ -787,6 +787,14 @@ class DeviceLedger:
         self.fixpoint_batches = 0
         self.deep_fixpoint_batches = 0
         self.window_fallbacks = 0
+        # On-device tier redispatches (plain->fixpoint, shallow->deep,
+        # imported->imported-fixpoint): resolved WITHOUT the host.
+        self.escalations = 0
+        # Per-cause host-fallback counters (kernel fb_causes flags,
+        # accumulated at every final-fallback decision): the measured
+        # "why did we leave the device" record surfaced through
+        # bench.py diagnostics and devhub.py.
+        self.fallback_causes: dict = {}
         self._deep_first = 0
         self._bal_deep_first = 0
         # Adaptive kernel routing: after a batch resolves breaches via the
@@ -1026,6 +1034,7 @@ class DeviceLedger:
             i += 1
             if not redo and bool(jax.device_get(tk.out["fallback"])):
                 redo = True
+                self._note_fb(tk.out)
                 # Everything still in flight is poisoned: pull it into
                 # this redo sequence so order is preserved (the sync
                 # path's own resolve guard must find nothing).
@@ -1218,33 +1227,40 @@ class DeviceLedger:
                         exact_chunks=all_or_nothing)
                 return results
             self.window_fallbacks += 1
+            self._note_fb(out)
         if all_or_nothing:
             return None
         return [self.create_transfers_soa(ev, ts)
                 for ev, ts in zip(evs, timestamps)]
 
-    def _escalate_fixpoint(self, evp, timestamp, n, balancing=False):
+    def _escalate_fixpoint(self, evp, timestamp, n, balancing=False,
+                           imported=False):
         """The 8-round fixpoint reported a limit cascade deeper than its
         budget (and no other obstacle): resolve it on device with the
         32-round variant before considering the host path. Returns
         (fallback, out) from the deep run and enters the matching
         deep-first regime (the shallow dispatch is a known waste while
-        cascades stay deep). balancing selects the balancing deep tier
-        and its own regime counter."""
+        cascades stay deep). balancing/imported select that tier's deep
+        variant (balancing keeps its own regime counter; imported has
+        none — imported windows are rare enough that re-probing costs
+        nothing)."""
         from .fast_kernels import (
             create_transfers_balancing_deep_jit,
             create_transfers_fixpoint_deep_jit,
+            create_transfers_imported_fixpoint_deep_jit,
         )
 
         deep = (create_transfers_balancing_deep_jit if balancing
-                else create_transfers_fixpoint_deep_jit)
+                else create_transfers_imported_fixpoint_deep_jit
+                if imported else create_transfers_fixpoint_deep_jit)
         new_state, deep_out = deep(
             self.state, evp, np.uint64(timestamp), np.int32(n))
         self.state = new_state
         self.deep_fixpoint_batches += 1
+        self.escalations += 1
         if balancing:
             self._bal_deep_first = self.DEEP_PROBE_INTERVAL
-        else:
+        elif not imported:
             self._deep_first = self.DEEP_PROBE_INTERVAL
         return bool(deep_out["fallback"]), deep_out
 
@@ -1309,14 +1325,33 @@ class DeviceLedger:
         evp = pad_transfer_events(ev, n_pad=_pad_bucket(n))
         if _has_imported([ev]):
             # Imported batches run their own tier (native imported rules
-            # + the in-batch maxima chain); its fallbacks (chains,
-            # collisions, potential breaches) go straight to exact.
-            from .fast_kernels import create_transfers_imported_jit
+            # + the in-batch maxima chain). Closing flags, voids of
+            # closing pendings and potential limit breaches escalate to
+            # the imported FIXPOINT tier (uniform closing eligibility);
+            # chains and collisions go straight to exact.
+            from .fast_kernels import (
+                create_transfers_imported_fixpoint_jit,
+                create_transfers_imported_jit,
+            )
 
             new_state, out = create_transfers_imported_jit(
                 self.state, evp, np.uint64(timestamp), np.int32(n))
             self.state = new_state
-            fallback = bool(jax.device_get(out["fallback"]))
+            fallback, limit_only = (bool(x) for x in jax.device_get(
+                (out["fallback"], out["limit_only"])))
+            if fallback and limit_only:
+                # Resolvable on device (state was donated but unchanged
+                # on fallback — evp is intact).
+                self.escalations += 1
+                new_state, out = create_transfers_imported_fixpoint_jit(
+                    self.state, evp, np.uint64(timestamp), np.int32(n))
+                self.state = new_state
+                fallback = bool(jax.device_get(out["fallback"]))
+                if fallback and bool(out["fix_unconverged"]):
+                    fallback, out = self._escalate_fixpoint(
+                        evp, timestamp, n, imported=True)
+                if not fallback:
+                    self.fixpoint_batches += 1
         elif _has_balancing([ev]):
             # Balancing clamps are order-dependent through the prefix
             # balances: route straight to the balancing fixpoint tier
@@ -1383,9 +1418,11 @@ class DeviceLedger:
             fallback, limit_only = (bool(x) for x in jax.device_get(
                 (out["fallback"], out["limit_only"])))
             if fallback and limit_only:
-                # The only obstacle was the balance-limit headroom proof:
-                # order-dependent limits resolve natively on the fixpoint
-                # variant (only the state was donated — evp is intact).
+                # The only obstacle was the balance-limit headroom proof,
+                # a collision, a closing flag or a void of a closing
+                # pending: all resolve natively on the fixpoint variant
+                # (only the state was donated — evp is intact).
+                self.escalations += 1
                 new_state, out = create_transfers_fixpoint_jit(
                     self.state, evp, np.uint64(timestamp), np.int32(n))
                 self.state = new_state
@@ -1397,6 +1434,7 @@ class DeviceLedger:
                     self.fixpoint_batches += 1
                     self._fixpoint_first = True
         if fallback:
+            self._note_fb(out)
             if transfers is None:
                 transfers = _transfers_from_arrays(ev)
             return self._fallback_transfers(transfers, timestamp)
@@ -2260,6 +2298,33 @@ class DeviceLedger:
                 sm.accounts_key_max = acct.timestamp
             sm.commit_timestamp = acct.timestamp
         self._clear_dirty_dev()
+
+    def _note_fb(self, out) -> None:
+        """Accumulate one kernel dispatch's per-cause fallback flags
+        (out["fb_causes"]) into the host counters. Called at every FINAL
+        fallback decision — escalations resolved on a deeper device tier
+        never reach here."""
+        causes = out.get("fb_causes") if hasattr(out, "get") else None
+        if causes is None:
+            return
+        import jax
+
+        for k, v in jax.device_get(causes).items():
+            if bool(v):
+                self.fallback_causes[k] = self.fallback_causes.get(k, 0) + 1
+
+    def fallback_stats(self) -> dict:
+        """Host-visible routing/fallback counters (bench diagnostics +
+        devhub): 'zero host fallbacks' is a measured invariant."""
+        return {
+            "host_fallbacks": self.fallbacks,
+            "window_fallbacks": self.window_fallbacks,
+            "fast_batches": self.fast_batches,
+            "fixpoint_batches": self.fixpoint_batches,
+            "deep_fixpoint_batches": self.deep_fixpoint_batches,
+            "escalations": self.escalations,
+            "causes": dict(self.fallback_causes),
+        }
 
     def _fallback_transfers(self, transfers, timestamp):
         self.fallbacks += 1

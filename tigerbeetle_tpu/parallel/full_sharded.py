@@ -1,8 +1,10 @@
-"""Full-semantics SPMD create_transfers over a device mesh.
+"""Full-semantics SPMD create_transfers over a device mesh — deep tiers.
 
-The multi-chip form of the single-chip fast kernel
+The multi-chip form of the single-chip kernel stack
 (ops/fast_kernels.py), with FULL semantics — eligibility E1-E7, chains,
-idempotency, two-phase post/void, event-ring snapshots.
+idempotency, two-phase post/void, event-ring snapshots — across EVERY
+kernel tier: plain, limit-fixpoint (closing-native, in-window pending
+refs), balancing, and imported.
 
 Decomposition (reference mapping: the batch axis of
 docs/ARCHITECTURE.md:358-362 sharded over ICI):
@@ -11,59 +13,123 @@ docs/ARCHITECTURE.md:358-362 sharded over ICI):
      batch and runs per_event_status() — the 5 hash probes and the ~50
      order-independent checks — against the REPLICATED ledger state.
      This is where the per-event FLOPs are; it scales linearly with
-     devices.
+     devices. The imported tier's batch context (homogeneity flag,
+     commit timestamp, account-ts collision) is computed replicated and
+     fed in sliced.
   2. all_gather (ICI): the compact per-event bundle (status, resolved
      amount, touched rows — ~50 B/event) is gathered so every device
      holds the full batch's results.
-  3. global tail (REPLICATED): eligibility reductions, the chain
-     first-failure broadcast, row planning, and state application run
-     identically on every device over the gathered bundle — a few
-     O(N log N) sorts on compact arrays. Determinism makes the
-     replicated ledger state bit-identical across the mesh, the SPMD
-     restatement of the reference's determinism doctrine
-     (docs/ARCHITECTURE.md:281-307).
+  3. global tail (REPLICATED): eligibility reductions, the in-window
+     join + substitution fixup (fixpoint tiers), the K-round
+     limit/closing/balancing/imported fixpoint, the chain first-failure
+     broadcast, row planning, and state application run identically on
+     every device over the gathered bundle — a few O(N log N) sorts on
+     compact arrays. Determinism makes the replicated ledger state
+     bit-identical across the mesh, the SPMD restatement of the
+     reference's determinism doctrine (docs/ARCHITECTURE.md:281-307).
 
-Exactness: the sharded step returns bit-identical (new_state, out) to
-the single-chip create_transfers_fast, which is itself bit-exact vs the
-sequential oracle under eligibility (tests/test_full_sharded.py runs
-the differential on an 8-device CPU mesh).
+Exactness: each sharded step returns bit-identical (new_state, out) to
+its single-chip sibling, which is itself bit-exact vs the sequential
+oracle under eligibility (tests/test_full_sharded.py runs the
+differentials on an 8-device CPU mesh).
+
+`ShardedRouter` is the host-side driver: per-batch flag routing to the
+matching tier (the SPMD analog of DeviceLedger's pre-route), on-device
+escalation (plain -> fixpoint), and per-cause fallback counters — a
+mixed balancing+imported+closing window executes with ZERO per-shard
+host fallbacks, and that is a measured number, not an assumption.
 """
 
 from __future__ import annotations
+
+import numpy as np
 
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.fast_kernels import create_transfers_fast, per_event_status
+from ..ops.fast_kernels import (
+    LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP,
+    create_transfers_fast,
+    imported_batch_ctx,
+    per_event_status,
+)
 
-__all__ = ["make_sharded_create_transfers", "shard_batch"]
+__all__ = ["make_sharded_create_transfers", "shard_batch", "ShardedRouter",
+           "MODES"]
+
+MODES = ("plain", "fixpoint", "balancing", "imported")
+
+# Tail kwargs per tier — the SAME static flags the single-chip jit
+# entries use, so the sharded step IS the single-chip kernel with the
+# per-event stage plugged in.
+_MODE_KWARGS = {
+    "plain": {},
+    "fixpoint": dict(limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP),
+    "balancing": dict(limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP,
+                      balancing_mode=True),
+    "imported": dict(limit_rounds=LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP,
+                     imported_mode=True),
+}
 
 
-def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch"):
-    """Build the jitted full-semantics SPMD step over `mesh`.
+def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch",
+                                  mode: str = "plain"):
+    """Build the jitted full-semantics SPMD step over `mesh` for one
+    kernel tier (`mode` in MODES).
 
     Returns step(state, ev, timestamp, n) -> (new_state, out), the same
-    contract as create_transfers_fast. `ev` arrays must be divisible by
-    the mesh axis size (pad_transfer_events' N_PAD=8192 divides any
-    power-of-two mesh)."""
-    from jax import shard_map
+    contract as the matching single-chip jit entry. `ev` arrays must be
+    divisible by the mesh axis size (pad_transfer_events' N_PAD=8192
+    divides any power-of-two mesh)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax keeps it under experimental
+        from jax.experimental.shard_map import shard_map
 
+    assert mode in MODES, mode
     n_dev = mesh.shape[axis]
+    # The imported tier's after_regress_codes is a STATIC tuple derived
+    # inside per_event_status from its literal check lists; it cannot
+    # ride the shard_map outputs (arrays only), so the traced body
+    # captures it here and the tail re-attaches it.
+    static_codes: list = []
 
     def step(state, ev, timestamp, n):
         N = ev["id_lo"].shape[0]
         assert N % n_dev == 0, (N, n_dev)
         shard = N // n_dev
+        idxs = jnp.arange(N, dtype=jnp.int32)
+        ts_full = (timestamp - n.astype(jnp.uint64)
+                   + idxs.astype(jnp.uint64) + jnp.uint64(1))
+        if mode == "imported":
+            # Batch context replicated (global reductions + one sorted-
+            # column membership probe), then sliced into the shards;
+            # key_max stays a replicated scalar.
+            ctx_full = imported_batch_ctx(state, ev, ts_full,
+                                          ev["valid"], idxs)
+            key_max = ctx_full.pop("key_max")
+        else:
+            ctx_full = key_max = None
 
-        def per_event_shard(state, ev_shard):
+        def per_event_shard(state, ev_shard, *ctx_args):
             # Global event positions for this shard: the event timestamp
             # ts_event = timestamp - n + i + 1 depends on the global index.
             dev = jax.lax.axis_index(axis)
-            idxs = (dev * shard
-                    + jnp.arange(shard, dtype=jnp.int32)).astype(jnp.uint64)
-            ts_event = timestamp - n.astype(jnp.uint64) + idxs + jnp.uint64(1)
-            pe = per_event_status(state, ev_shard, ts_event)
+            sh_idx = (dev * shard
+                      + jnp.arange(shard, dtype=jnp.int32)).astype(
+                          jnp.uint64)
+            ts_event = (timestamp - n.astype(jnp.uint64) + sh_idx
+                        + jnp.uint64(1))
+            ictx = None
+            if mode == "imported":
+                (ctx_shard,) = ctx_args
+                ictx = dict(ctx_shard, key_max=key_max)
+            pe = per_event_status(state, ev_shard, ts_event,
+                                  imported_ctx=ictx)
+            codes = pe.pop("after_regress_codes", None)
+            if codes is not None and not static_codes:
+                static_codes.append(codes)
             # all_gather(tiled): every device ends with the full batch's
             # compact bundle, concatenated in device order == batch order.
             return {k: jax.lax.all_gather(v, axis, tiled=True)
@@ -71,19 +137,45 @@ def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch"):
 
         state_spec = jax.tree.map(lambda _: P(), state)
         ev_spec = {k: P(axis) for k in ev}
-        pe = shard_map(
-            per_event_shard, mesh=mesh,
-            in_specs=(state_spec, ev_spec),
-            out_specs={k: P() for k in (
-                "status_pre", "ts_pre", "amt_res_hi", "amt_res_lo",
-                "dr_row", "cr_row", "p_row",
-                "dr_found", "cr_found", "p_found")},
-            check_vma=False,
-        )(state, ev)
+        # out_specs derived programmatically from the per-event pytree
+        # (never a hardcoded key set): eval_shape the shard body's
+        # bundle and map every leaf to the replicated spec.
+        def _pe_struct(state, ev):
+            ev_s = {k: v[:shard] for k, v in ev.items()}
+            ictx = None
+            if mode == "imported":
+                ictx = dict({k: v[:shard] for k, v in ctx_full.items()},
+                            key_max=key_max)
+            pe = per_event_status(state, ev_s, ts_full[:shard],
+                                  imported_ctx=ictx)
+            pe.pop("after_regress_codes", None)
+            return pe
+
+        pe_struct = jax.eval_shape(_pe_struct, state, ev)
+        out_specs = jax.tree.map(lambda _: P(), pe_struct)
+        args = (state, ev)
+        in_specs = (state_spec, ev_spec)
+        if mode == "imported":
+            args = args + ({k: v for k, v in ctx_full.items()},)
+            in_specs = in_specs + ({k: P(axis) for k in ctx_full},)
+        try:
+            smapped = shard_map(
+                per_event_shard, mesh=mesh,
+                in_specs=in_specs, out_specs=out_specs, check_vma=False)
+        except TypeError:  # pre-0.5 jax spells the kwarg check_rep
+            smapped = shard_map(
+                per_event_shard, mesh=mesh,
+                in_specs=in_specs, out_specs=out_specs, check_rep=False)
+        pe = smapped(*args)
+        if mode == "imported":
+            pe["after_regress_codes"] = static_codes[0]
         # Global tail on the gathered bundle: replicated, deterministic,
-        # bit-exact vs the single-chip kernel (it IS the single-chip
-        # kernel with the per-event stage plugged in).
-        return create_transfers_fast(state, ev, timestamp, n, per_event=pe)
+        # bit-exact vs the single-chip tier (it IS the single-chip
+        # kernel with the per-event stage plugged in; the fixpoint
+        # tiers additionally compute the in-window join here and
+        # re-apply the substitution to the bundle).
+        return create_transfers_fast(state, ev, timestamp, n,
+                                     per_event=pe, **_MODE_KWARGS[mode])
 
     return jax.jit(step)
 
@@ -93,3 +185,81 @@ def shard_batch(mesh: Mesh, ev: dict, axis: str = "batch"):
     and return it (state stays replicated via P())."""
     sharding = NamedSharding(mesh, P(axis))
     return {k: jax.device_put(v, sharding) for k, v in ev.items()}
+
+
+class ShardedRouter:
+    """Host-side tier router over the sharded steps — the SPMD analog of
+    DeviceLedger's flag pre-route. Inspects each batch's flags, runs the
+    matching sharded step, redispatches device-resolvable escalations
+    (plain -> fixpoint, exactly the single-chip limit_only contract:
+    the failed kernel leaves donated state untouched), and accumulates
+    per-cause host-fallback counters so "zero fallbacks on a mixed
+    balancing+imported+closing window" is a measured invariant."""
+
+    def __init__(self, mesh: Mesh, axis: str = "batch"):
+        self.mesh = mesh
+        self.axis = axis
+        self._steps: dict = {}
+        self.batches = 0
+        self.escalations = 0
+        self.host_fallbacks = 0
+        self.fallback_causes: dict = {}
+
+    def _step(self, mode: str):
+        fn = self._steps.get(mode)
+        if fn is None:
+            fn = self._steps[mode] = make_sharded_create_transfers(
+                self.mesh, self.axis, mode=mode)
+        return fn
+
+    @staticmethod
+    def route(ev: dict) -> str:
+        """Flag-derived tier for one (padded or raw) event dict. Same
+        precedence as DeviceLedger: imported > balancing > closing;
+        limit breaches and in-batch pending refs are invisible to flags
+        and escalate from the plain step instead."""
+        from ..types import TransferFlags as TF
+
+        flags = np.asarray(ev["flags"])
+        if (flags & np.uint32(int(TF.imported))).any():
+            return "imported"
+        if (flags & np.uint32(int(TF.balancing_debit
+                                  | TF.balancing_credit))).any():
+            return "balancing"
+        if (flags & np.uint32(int(TF.closing_debit
+                                  | TF.closing_credit))).any():
+            return "fixpoint"
+        return "plain"
+
+    def step(self, state, ev: dict, timestamp: int, n: int):
+        """Run one padded batch. Returns (new_state, out, fell_back).
+        On fell_back=True the state is untouched (masked writes) and the
+        caller owns the exact-path replay."""
+        self.batches += 1
+        mode = self.route(ev)
+        new_state, out = self._step(mode)(
+            state, ev, np.uint64(timestamp), np.int32(n))
+        fallback, limit_only = (bool(x) for x in jax.device_get(
+            (out["fallback"], out["limit_only"])))
+        if fallback and limit_only and mode == "plain":
+            # Breach / collision / closing: resolvable on the sharded
+            # fixpoint step (the plain kernel left state untouched).
+            self.escalations += 1
+            new_state, out = self._step("fixpoint")(
+                new_state, ev, np.uint64(timestamp), np.int32(n))
+            fallback = bool(jax.device_get(out["fallback"]))
+        if fallback:
+            self.host_fallbacks += 1
+            for k, v in jax.device_get(out["fb_causes"]).items():
+                if bool(v):
+                    self.fallback_causes[k] = (
+                        self.fallback_causes.get(k, 0) + 1)
+        return new_state, out, fallback
+
+    def stats(self) -> dict:
+        return {
+            "batches": self.batches,
+            "escalations": self.escalations,
+            "host_fallbacks": self.host_fallbacks,
+            "causes": dict(self.fallback_causes),
+        }

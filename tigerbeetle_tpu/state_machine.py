@@ -157,6 +157,14 @@ class StateMachine:
         # Pipelined commit windows awaiting resolution (submit_commit_window).
         self._pending_windows: list = []
 
+    def fallback_stats(self) -> dict:
+        """Device-engine routing/fallback counters (per-cause host
+        fallbacks + on-device escalations); empty for host engines.
+        Surfaced by bench.py per-config diagnostics and devhub.py."""
+        if self.led is None:
+            return {}
+        return self.led.fallback_stats()
+
     # -------------------------------------------------------- LSM serving
 
     def attach_durable(self, durable, *, cache_sets: int = 1024,

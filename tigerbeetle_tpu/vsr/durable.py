@@ -481,10 +481,15 @@ class DurableState:
         from ..ops.batch import TRANSFER_WIRE
         from ..types import TransferFlags as TF
 
-        hard = int(TF.imported | TF.closing_debit | TF.closing_credit)
+        # Closing and imported transfers come through the fast path now
+        # (closing-native fixpoint tiers / the imported tiers), so the
+        # column flush maintains their flag indexes exactly like the
+        # object path does.
         flags = t["flags"][:n]
-        assert not np.any(flags & np.uint32(hard)), \
-            "hard-flag transfers never come from the fast path"
+        closing_l = ((flags & np.uint32(int(TF.closing_debit
+                                            | TF.closing_credit))) != 0
+                     ).tolist()
+        imported_l = ((flags & np.uint32(int(TF.imported))) != 0).tolist()
 
         rec = np.zeros(n, dtype=TRANSFER_WIRE)
         for f in ("id_lo", "id_hi", "dr_lo", "dr_hi", "cr_lo", "cr_hi",
@@ -527,6 +532,8 @@ class DurableState:
         put_led = trees["xfer_by_ledger"].put
         put_code = trees["xfer_by_code"].put
         put_amt = trees["xfer_by_amount"].put
+        put_closing = trees["xfer_by_closing"].put
+        put_imported = trees["xfer_by_imported"].put
         ONE = b"\x01"
         tids = []
         for i in range(n):
@@ -545,6 +552,11 @@ class DurableState:
             put_led(ledp[4 * i:4 * i + 4] + t8, ONE)
             put_code(codep[2 * i:2 * i + 2] + t8, ONE)
             put_amt(amtk[24 * i:24 * i + 24], ONE)
+            # Flag indexes (composite_key(1, ts, 1) == b"\x01" + ts_be).
+            if closing_l[i]:
+                put_closing(ONE + t8, ONE)
+            if imported_l[i]:
+                put_imported(ONE + t8, ONE)
         return tids
 
     def _flush_side_columns(self, trees, t, e, der, n: int) -> None:
@@ -555,9 +567,12 @@ class DurableState:
 
         Immutable account metadata (user_data/ledger/code/timestamp) is
         spliced from the account's PREVIOUS tree value (the fast path
-        never mutates it — closing/imported are hard flags); per-event
-        balances come from the event columns. Byte-identical to the
-        object path (oracle-exact snapshots either way)."""
+        never mutates it); the FLAGS word comes from the event columns,
+        which carry the closing-native tiers' evolved closed bit — the
+        closed-flag index transitions are maintained here exactly like
+        the object path. Per-event balances come from the event columns.
+        Byte-identical to the object path (oracle-exact snapshots either
+        way)."""
         import numpy as np
 
         from ..types import AccountFlags as AF
@@ -676,8 +691,24 @@ class DurableState:
                 if p_timeout:
                     rm_expiry(pk8)
         put_acct = acct_tree.put
+        closed_bit = int(AF.closed)
+        by_closed = trees["acct_by_closed"]
         for k16, val in acct_last.items():
             put_acct(k16, val)
+            # `closed` transitions (closing-native tiers evolve it on
+            # the fast path): same put/remove-on-transition contract as
+            # the object flush, keyed by the account's timestamp.
+            aid = int.from_bytes(k16, "big")
+            closed = bool(val[118] & closed_bit)  # flags u16 LE low byte
+            if closed != (aid in self._closed_indexed):
+                a_ts = int.from_bytes(val[120:128], "little")
+                ckey = composite_key(1, a_ts, 1)
+                if closed:
+                    by_closed.put(ckey, b"\x01")
+                    self._closed_indexed.add(aid)
+                else:
+                    by_closed.remove(ckey)
+                    self._closed_indexed.discard(aid)
         # The touched account ids: the caller invalidates their cache
         # entries (reads must never serve pre-chunk balances).
         return [int.from_bytes(k16, "big") for k16 in acct_last]
