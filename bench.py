@@ -335,6 +335,25 @@ def inner_main() -> None:
         if serving_latency:
             emit("serving_batch_latency", serving_latency)
 
+    # Op-budget summary (light tier subset, pure tracing — no device
+    # execution): the per-run record of the kernels' heavy-op footprint
+    # on its own ##opbudget line; devhub renders it next to the
+    # fallback-diagnostics table. The full table incl. deep/sharded
+    # tiers plus the gate ceilings live in perf/opbudget.py +
+    # perf/opbudget_r06.json.
+    opbudget = None
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "tb_opbudget", os.path.join(REPO, "perf", "opbudget.py"))
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        opbudget = mod.summary_line()
+    except Exception as e:  # never let the census kill a bench run
+        opbudget = {"error": str(e)[:200]}
+    print("##opbudget " + json.dumps(opbudget), flush=True)
+
     value = None if acc2 is None else (acc2 / el2 if el2 > 0 else 0.0)
     out = {
         "metric": "create_transfers_validated_per_sec",
@@ -360,6 +379,9 @@ def inner_main() -> None:
         # Per-config routing/fallback counters (per-cause): the measured
         # "zero host fallbacks" record behind every number above.
         "fallback_diagnostics": dict(CONFIG_DIAGNOSTICS),
+        # Heavy-op census of the kernels this run dispatched (see the
+        # ##opbudget line / perf/opbudget.py).
+        "opbudget": opbudget,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.

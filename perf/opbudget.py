@@ -1,100 +1,352 @@
-"""Op-budget table: post-optimization HLO op counts for the kernel tiers.
+"""Per-kernel op-budget ledger: heavy-op counts + operand bytes, gated.
 
-The tunnel regime bills ~0.5-1 ms per *executed top-level HLO op* inside
-large programs (PERF.md); this counts them per kernel tier so the round-4
-op-cut work has a before/after table. Fusions count as one op (one
-dispatch); the table also splits out the op kinds that dominate.
+The tunnel regime bills ~0.5-1 ms per *executed op* inside large
+programs, with a bytes-dependent term (PERF.md dispatch model), so the
+one portable lever is fewer, fatter ops. This module makes that lever
+un-regressable:
+
+  - census: jaxpr-level heavy-op counts by class (sort / gather /
+    scatter / segment_sum / scan — tigerbeetle_tpu.jaxhound.heavy_census)
+    plus the operand bytes those ops read, for every create_transfers
+    kernel tier INCLUDING the SPMD lowerings (8-device CPU mesh).
+  - budgets: perf/opbudget_r06.json commits a per-tier budget. A kernel
+    change that raises any tier's heavy-op count or operand bytes past
+    its budget fails `--check` (wired into scripts/gate.py) — raising a
+    budget is an explicit, reviewed edit of the JSON (see
+    ARCHITECTURE.md "Op-budget workflow").
+  - lints: `--lint` runs the jaxhound static checks over the serving-
+    path jit entries: no closure constant > 4 KiB (the measured
+    ~64 ms/call tunnel intercept), no while/fori loop in any serving
+    lowering (the measured 5-8 ms process-wide degradation), and every
+    state-carrying entry donates its ledger buffers (donated-input
+    count == state leaf count in the lowered artifact).
+
+CLI:
+    python perf/opbudget.py             # print the census table
+    python perf/opbudget.py --check    # fail (rc=1) on budget excess
+    python perf/opbudget.py --lint     # fail (rc=1) on lint violations
+    python perf/opbudget.py --write    # refresh the 'post' column of
+                                       # the budget file IN PLACE
 """
-import collections
+from __future__ import annotations
+
+import argparse
 import functools
+import json
 import os
 import sys
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
-sys.path.insert(0, "/root/repo")
+# The sharded tiers trace against an 8-device CPU mesh in-process.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax
-import numpy as np
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
 
-import tigerbeetle_tpu  # noqa: F401
-from tigerbeetle_tpu.benchmark import _soa
-from tigerbeetle_tpu.ops import fast_kernels as fk
-from tigerbeetle_tpu.ops.ledger import init_state, stack_superbatch
+from tigerbeetle_tpu import jaxhound  # noqa: E402
 
-STACK = 8
-N = 1024
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r06.json")
 
-
-def hlo_opcount(lowered):
-    mod = lowered.compile()
-    txts = mod.as_text() if isinstance(mod.as_text(), str) else ""
-    counts = collections.Counter()
-    total = 0
-    entry = False
-    for line in txts.splitlines():
-        s = line.strip()
-        if s.startswith("ENTRY "):
-            entry = True
-            continue
-        if entry:
-            if s.startswith("}"):
-                break
-            if "=" in s and not s.startswith("//"):
-                rhs = s.split("=", 1)[-1].strip()
-                # 'f32[...]{...} opname(' — opname after the type
-                parts = rhs.split()
-                if len(parts) >= 2:
-                    op = parts[1].split("(")[0]
-                    counts[op] += 1
-                    total += 1
-    return total, counts
+STACK = 4
+N_SUPER = 1024
 
 
-def shape_args():
-    state = init_state(1 << 12, 1 << 16)
+def _fixtures():
+    from tigerbeetle_tpu.benchmark import _soa
+    from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+    from tigerbeetle_tpu.ops.ledger import (
+        init_state, pad_transfer_events, stack_superbatch)
+    from tigerbeetle_tpu.types import Transfer
+
+    state = init_state(1 << 10, 1 << 12)
+    ev = pad_transfer_events(transfers_to_arrays(
+        [Transfer(id=1, debit_account_id=1, credit_account_id=2,
+                  amount=1, ledger=1, code=1)]))
     rng = np.random.default_rng(0)
     evs, tss = [], []
     nid = 10 ** 6
     for b in range(STACK):
-        dr = rng.integers(1, 64, N, dtype=np.uint64)
+        dr = rng.integers(1, 64, N_SUPER, dtype=np.uint64)
         cr = (dr % 63) + 1
-        ev = _soa(np.arange(nid, nid + N), dr, cr,
-                  rng.integers(1, 100, N))
-        nid += N
-        evs.append(ev)
-        tss.append(10 ** 12 + b * (N + 10))
+        evs.append(_soa(np.arange(nid, nid + N_SUPER), dr, cr,
+                        rng.integers(1, 100, N_SUPER)))
+        nid += N_SUPER
+        tss.append(10 ** 12 + b * (N_SUPER + 10))
     ev_s, seg = stack_superbatch(evs, tss)
-    return state, ev_s, seg
+    return state, ev, ev_s, seg
 
 
-def main():
-    import jax.numpy as jnp
-    state, ev_s, seg = shape_args()
+def census_tiers(include_sharded: bool = True,
+                 only: tuple | None = None) -> dict:
+    """tier name -> heavy_census dict for every kernel tier. `only`
+    restricts to a named subset (bench.py's light ##opbudget line)."""
+    from tigerbeetle_tpu.ops import fast_kernels as fk
+
+    state, ev, ev_s, seg = _fixtures()
+    N = ev["id_lo"].shape[0]
+    ts = np.uint64(1000)
+    n = np.int32(1)
+    ts_vec = jnp.full((N,), 1000, jnp.uint64)
+    idxs = jnp.arange(N, dtype=jnp.int32)
+
+    def pe_plain(state, ev, ts_vec):
+        return fk.per_event_status(state, ev, ts_vec)
+
+    def pe_imported(state, ev, ts_vec):
+        ctx = fk.imported_batch_ctx(state, ev, ts_vec, ev["valid"], idxs)
+        return fk.per_event_status(state, ev, ts_vec, imported_ctx=ctx)
+
+    def super_(limit_rounds):
+        def f(state, ev_s, seg):
+            return fk.create_transfers_fast(
+                state, ev_s, jnp.uint64(0), jnp.int32(0), seg=seg,
+                limit_rounds=limit_rounds)
+        return f
+
     tiers = {
-        "plain_super (limit_rounds=1)": dict(limit_rounds=1),
-        "fixpoint_8": dict(limit_rounds=8),
-        "fixpoint_deep_32": dict(limit_rounds=32),
-        "balancing_8": dict(limit_rounds=8, balancing_mode=True),
+        "per_event_plain": (pe_plain, (state, ev, ts_vec)),
+        "per_event_imported": (pe_imported, (state, ev, ts_vec)),
+        "plain": (fk.create_transfers_fast, (state, ev, ts, n)),
+        "imported": (functools.partial(
+            fk.create_transfers_fast, imported_mode=True),
+            (state, ev, ts, n)),
+        "fixpoint_8": (functools.partial(
+            fk.create_transfers_fast, limit_rounds=8), (state, ev, ts, n)),
+        "fixpoint_deep_32": (functools.partial(
+            fk.create_transfers_fast, limit_rounds=32),
+            (state, ev, ts, n)),
+        "balancing_8": (functools.partial(
+            fk.create_transfers_fast, limit_rounds=8,
+            balancing_mode=True), (state, ev, ts, n)),
+        "imported_fixpoint_8": (functools.partial(
+            fk.create_transfers_fast, imported_mode=True, limit_rounds=8),
+            (state, ev, ts, n)),
+        "super_plain_s4": (super_(1), (state, ev_s, seg)),
+        "super_deep24_s4": (super_(
+            fk.LIMIT_FIXPOINT_ROUNDS_WINDOW_DEEP), (state, ev_s, seg)),
     }
-    rows = []
-    for name, kw in tiers.items():
-        fn = functools.partial(fk.create_transfers_fast, **kw)
-        low = jax.jit(fn, donate_argnums=0).lower(
-            state, ev_s, jnp.uint64(0), jnp.int32(0), seg=seg)
-        total, counts = hlo_opcount(low)
-        heavy = {k: v for k, v in counts.items()
-                 if k.split(".")[0] in
-                 ("fusion", "scatter", "gather", "sort", "while",
-                  "reduce", "reduce-window", "all-reduce", "copy",
-                  "dynamic-slice", "dynamic-update-slice", "select-and-scatter")}
-        rows.append((name, total, sum(heavy.values()),
-                     counts.most_common(10)))
-    for name, total, heavy, top in rows:
-        print(f"{name:32s} total={total:5d} heavy={heavy:5d} top={top}")
-    base = rows[0][2]
-    for name, total, heavy, _ in rows[1:]:
-        print(f"{name}: heavy-op multiple of plain = {heavy / base:.2f}x")
+    out = {}
+    for name, (fn, args) in tiers.items():
+        if only is not None and name not in only:
+            continue
+        out[name] = jaxhound.heavy_census(jax.make_jaxpr(fn)(*args))
+    if only is not None:
+        include_sharded = False
+    if include_sharded and len(jax.devices()) >= 8:
+        from jax.sharding import Mesh
+        from tigerbeetle_tpu.parallel.full_sharded import (
+            make_sharded_create_transfers)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+        for mode in ("plain", "fixpoint"):
+            step = make_sharded_create_transfers(mesh, mode=mode)
+            with mesh:
+                cj = jax.make_jaxpr(
+                    lambda st, e: step.__wrapped__(
+                        st, e, jnp.uint64(1000), jnp.int32(1)))(state, ev)
+            out[f"sharded_{mode}"] = jaxhound.heavy_census(cj)
+    return out
+
+
+def serving_entries() -> dict:
+    """name -> (lowered artifact, expected donated-input count) for the
+    state-carrying jit entries on the serving/scan paths."""
+    from tigerbeetle_tpu.ops import fast_kernels as fk
+
+    state, ev, ev_s, seg = _fixtures()
+    n_leaves = len(jax.tree_util.tree_leaves(state))
+    ts = np.uint64(1000)
+    n = np.int32(1)
+    entries = {}
+
+    def add(name, jitfn, *args):
+        entries[name] = (jitfn.lower(*args), n_leaves)
+
+    add("create_transfers_fast_jit", fk.create_transfers_fast_jit,
+        state, ev, ts, n)
+    add("create_transfers_fixpoint_jit", fk.create_transfers_fixpoint_jit,
+        state, ev, ts, n)
+    add("create_transfers_fixpoint_deep_jit",
+        fk.create_transfers_fixpoint_deep_jit, state, ev, ts, n)
+    add("create_transfers_balancing_jit",
+        fk.create_transfers_balancing_jit, state, ev, ts, n)
+    add("create_transfers_imported_jit",
+        fk.create_transfers_imported_jit, state, ev, ts, n)
+    add("create_transfers_imported_fixpoint_jit",
+        fk.create_transfers_imported_fixpoint_jit, state, ev, ts, n)
+    add("create_transfers_super_jit", fk.create_transfers_super_jit,
+        state, ev_s, seg)
+    add("create_transfers_super_deep_jit",
+        fk.create_transfers_super_deep_jit, state, ev_s, seg)
+    add("create_transfers_super_ring_jit",
+        fk.create_transfers_super_ring_jit, state, ev_s, seg)
+    add("create_transfers_super_deep_ring_jit",
+        fk.create_transfers_super_deep_ring_jit, state, ev_s, seg)
+    add("create_transfers_super_balancing_jit",
+        fk.create_transfers_super_balancing_jit, state, ev_s, seg)
+    # Sharded steps (8-device CPU mesh): same donation contract.
+    if len(jax.devices()) >= 8:
+        from jax.sharding import Mesh
+        from tigerbeetle_tpu.parallel.full_sharded import (
+            make_sharded_create_transfers)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+        for mode in ("plain", "fixpoint"):
+            step = make_sharded_create_transfers(mesh, mode=mode)
+            with mesh:
+                entries[f"sharded_{mode}_step"] = (
+                    step.lower(state, ev, np.uint64(1000), np.int32(1)),
+                    n_leaves)
+    return entries
+
+
+def run_lints() -> list[str]:
+    """Serving-path static lints (jaxhound): closure constants, while
+    loops, donation. Returns human-readable failure strings."""
+    fails = []
+    for name, (lowered, n_donate) in serving_entries().items():
+        # The serving path must stay straight-line: lax.scan/while both
+        # lower to stablehlo.while (the deliberate whole-program chain
+        # entries are NOT in this registry for that reason).
+        text = lowered.as_text()
+        n_while = text.count("stablehlo.while")
+        if n_while:
+            fails.append(
+                f"{name}: {n_while} while loop(s) in the lowering "
+                "(one executed while degrades every later dispatch to "
+                "5-8 ms — PERF.md)")
+        donated = jaxhound.donated_inputs(lowered)
+        if donated < n_donate:
+            fails.append(
+                f"{name}: {donated} donated inputs < {n_donate} state "
+                "leaves (missing donate_argnums => every dispatch pays "
+                "a full state copy)")
+    # Closure constants are a trace-level property: re-trace the raw fns.
+    from tigerbeetle_tpu.ops import fast_kernels as fk
+
+    state, ev, ev_s, seg = _fixtures()
+    for name, fn, args in (
+            ("create_transfers_fast", fk.create_transfers_fast,
+             (state, ev, np.uint64(1000), np.int32(1))),
+            ("create_transfers_super",
+             lambda st, e, s: fk.create_transfers_fast(
+                 st, e, jnp.uint64(0), jnp.int32(0), seg=s),
+             (state, ev_s, seg)),
+    ):
+        big = jaxhound.closure_constants(jax.make_jaxpr(fn)(*args))
+        for label, size in big:
+            fails.append(
+                f"{name}: closure constant {label} = {size} B > "
+                f"{jaxhound.CLOSURE_CONST_LIMIT} B (the tunnel re-ships "
+                "baked constants every call: ~64 ms at 0.5 MB — PERF.md)")
+    return fails
+
+
+def check_budgets(current: dict | None = None) -> list[str]:
+    """Compare the current census against the committed budgets.
+    Returns failure strings (empty = within budget)."""
+    with open(BUDGET_PATH) as f:
+        committed = json.load(f)
+    budgets = committed.get("budget", {})
+    if current is None:
+        current = census_tiers()
+    fails = []
+    for tier, budget in budgets.items():
+        cur = current.get(tier)
+        if cur is None:
+            fails.append(f"{tier}: no current census (tier removed? "
+                         "update perf/opbudget_r06.json)")
+            continue
+        if cur["heavy_total"] > budget["heavy_total"]:
+            fails.append(
+                f"{tier}: heavy_total {cur['heavy_total']} > budget "
+                f"{budget['heavy_total']}")
+        for cls, limit in budget.get("heavy", {}).items():
+            if cur["heavy"].get(cls, 0) > limit:
+                fails.append(
+                    f"{tier}: {cls} count {cur['heavy'].get(cls, 0)} > "
+                    f"budget {limit}")
+        limit_b = budget.get("heavy_operand_bytes")
+        if limit_b is not None and cur["heavy_operand_bytes"] > limit_b:
+            fails.append(
+                f"{tier}: heavy operand bytes "
+                f"{cur['heavy_operand_bytes']} > budget {limit_b}")
+    return fails
+
+
+# Light subset for bench.py's per-run ##opbudget line (the full table
+# incl. deep/sharded tiers is the gate's job; tracing them every bench
+# run would eat the bench budget).
+BENCH_TIERS = ("per_event_plain", "plain", "fixpoint_8", "super_plain_s4")
+
+
+def summary_line(current: dict | None = None) -> dict:
+    """Compact per-tier summary for bench.py's ##opbudget line and the
+    devhub table."""
+    if current is None:
+        current = census_tiers(only=BENCH_TIERS)
+    return {
+        tier: {
+            "heavy_total": c["heavy_total"],
+            "heavy": c["heavy"],
+            "operand_mb": round(c["heavy_operand_bytes"] / 1e6, 2),
+        } for tier, c in current.items()
+    }
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--check", action="store_true",
+                    help="fail when any tier exceeds its budget")
+    ap.add_argument("--lint", action="store_true",
+                    help="run the jaxhound serving-path lints")
+    ap.add_argument("--write", action="store_true",
+                    help="refresh the budget file's 'post'+'budget' "
+                         "columns from the current census")
+    args = ap.parse_args()
+
+    current = census_tiers()
+    for tier, c in current.items():
+        print(f"{tier:24s} heavy={c['heavy_total']:4d} "
+              + " ".join(f"{k}={v}" for k, v in c["heavy"].items())
+              + f" operand_MB={c['heavy_operand_bytes'] / 1e6:.2f}")
+
+    rc = 0
+    if args.write:
+        with open(BUDGET_PATH) as f:
+            committed = json.load(f)
+        committed["post"] = current
+        committed["budget"] = {
+            t: {"heavy_total": c["heavy_total"], "heavy": c["heavy"],
+                "heavy_operand_bytes": c["heavy_operand_bytes"]}
+            for t, c in current.items()}
+        with open(BUDGET_PATH, "w") as f:
+            json.dump(committed, f, indent=1)
+        print(f"[opbudget] wrote {BUDGET_PATH}")
+    if args.check:
+        fails = check_budgets(current)
+        for f_ in fails:
+            print(f"[opbudget] OVER BUDGET: {f_}")
+        if fails:
+            rc = 1
+        else:
+            print("[opbudget] within budget")
+    if args.lint:
+        fails = run_lints()
+        for f_ in fails:
+            print(f"[opbudget] LINT: {f_}")
+        if fails:
+            rc = 1
+        else:
+            print("[opbudget] lints clean")
+    return rc
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

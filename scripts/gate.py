@@ -17,9 +17,17 @@ Tiers (markers documented in pytest.ini):
   soak   long randomized soaks; run when touching the matching
          subsystem, not per snapshot.
 
+The gate also runs the op-budget check + jaxhound serving-path lints
+(`perf/opbudget.py --check --lint`): a kernel change that raises any
+tier's heavy-op count or operand bytes past its committed budget
+(perf/opbudget_r06.json), bakes a >4 KiB closure constant into a
+serving entry, drops state-buffer donation, or introduces a while loop
+into a serving lowering is a RED. See ARCHITECTURE.md "Op-budget
+workflow" for reading a failure / intentionally raising a budget.
+
 Exit status is nonzero on ANY red (test failure, collection error,
-timeout, dryrun assertion), so `python scripts/gate.py && snapshot`
-cannot bank a broken tree.
+timeout, dryrun assertion, budget excess, lint), so
+`python scripts/gate.py && snapshot` cannot bank a broken tree.
 """
 
 from __future__ import annotations
@@ -65,6 +73,26 @@ def run_tests(tier: str, timeout: int) -> int:
     return rc
 
 
+def run_opbudget(timeout: int = 900) -> int:
+    """Op-budget check + jaxhound serving-path lints (see module doc)."""
+    cmd = [sys.executable, os.path.join(REPO, "perf", "opbudget.py"),
+           "--check", "--lint"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print(f"[gate] opbudget: {' '.join(cmd)}", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: opbudget timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] opbudget rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -85,6 +113,8 @@ def main() -> int:
     ap.add_argument("--tier", default="quick", choices=sorted(TIER_EXPR))
     ap.add_argument("--no-mesh", action="store_true",
                     help="skip the 8-device SPMD dryrun")
+    ap.add_argument("--no-opbudget", action="store_true",
+                    help="skip the op-budget check + jaxhound lints")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -94,6 +124,10 @@ def main() -> int:
     rc = run_tests(args.tier, args.timeout)
     if rc != 0:
         reds.append(f"{args.tier} tier rc={rc}")
+    if not args.no_opbudget:
+        rc = run_opbudget()
+        if rc != 0:
+            reds.append(f"opbudget rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

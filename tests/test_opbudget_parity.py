@@ -1,0 +1,225 @@
+"""Differential parity for the round-6 packed/fused kernel variants.
+
+The op-budget campaign repacked every store (32-bit columns pair-packed
+into the u64 matrices), fused the role-set gathers, rebuilt the dup/join
+checks on variadic sorts, and made the fixpoint tiers' application stage
+reuse the fixpoint's sorted entry space. Every one of those is a
+bit-exactness hazard, so this suite runs MIXED flag workloads — the
+plain x balancing x closing x imported cross the issue names — through
+DeviceLedger (which pre-routes each batch to the matching tier) against
+the sequential oracle, asserting statuses, timestamps and the full
+reconstructed host state match exactly.
+"""
+
+import random
+
+import pytest
+
+# Tier: jit-heavy differential suite (compiles several kernel tiers).
+pytestmark = pytest.mark.slow
+
+from tigerbeetle_tpu.oracle import StateMachineOracle
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import (
+    Account,
+    AccountFlags as AF,
+    Transfer,
+    TransferFlags as TF,
+)
+
+TS = 10_000_000_000_000
+
+
+class Differ:
+    def __init__(self, a_cap=1 << 12, t_cap=1 << 14):
+        self.led = DeviceLedger(a_cap=a_cap, t_cap=t_cap)
+        self.sm = StateMachineOracle()
+        self.ts = TS
+
+    def _step(self, fn, events):
+        self.ts += len(events) + 7
+        got = getattr(self.led, fn)(events, self.ts)
+        want = getattr(self.sm, fn)(events, self.ts)
+        assert [(r.timestamp, r.status.name) for r in got] == [
+            (r.timestamp, r.status.name) for r in want
+        ], fn
+        return want
+
+    def accounts(self, events):
+        return self._step("create_accounts", events)
+
+    def transfers(self, events):
+        return self._step("create_transfers", events)
+
+    def check_state(self):
+        host = self.led.to_host()
+        for f in ("accounts", "transfers", "pending_status", "orphaned",
+                  "expiry", "pulse_next_timestamp", "commit_timestamp",
+                  "accounts_key_max", "transfers_key_max",
+                  "account_events"):
+            assert getattr(host, f) == getattr(self.sm, f), f
+
+
+def _base_accounts(d, n=16, limits=True):
+    evs = []
+    for i in range(1, n + 1):
+        fl = 0
+        if limits and i % 5 == 0:
+            fl = int(AF.debits_must_not_exceed_credits)
+        elif limits and i % 7 == 0:
+            fl = int(AF.credits_must_not_exceed_debits)
+        evs.append(Account(id=i, ledger=1, code=1, flags=fl))
+    d.accounts(evs)
+    # Fund everyone so limited accounts have headroom to spend.
+    d.transfers([Transfer(id=10_000 + i, debit_account_id=1,
+                          credit_account_id=i, amount=1_000_000,
+                          ledger=1, code=1)
+                 for i in range(2, n + 1)])
+
+
+def test_mixed_pending_closing_balancing_stream():
+    """One stream interleaving plain, pending/post/void, closing and
+    balancing batches: the ledger routes each to a different packed
+    kernel tier; every result and the final state must equal the
+    oracle's."""
+    d = Differ()
+    _base_accounts(d)
+    # pending + closing create (routes to the fixpoint tier).
+    d.transfers([
+        Transfer(id=1, debit_account_id=2, credit_account_id=3,
+                 amount=100, ledger=1, code=1, flags=int(TF.pending),
+                 timeout=1000),
+        Transfer(id=2, debit_account_id=4, credit_account_id=5,
+                 amount=50, ledger=1, code=1,
+                 flags=int(TF.pending | TF.closing_debit), timeout=500),
+        Transfer(id=3, debit_account_id=5, credit_account_id=6,
+                 amount=10, ledger=1, code=1),
+    ])
+    # post/void incl. the closed account (void reopens).
+    d.transfers([
+        Transfer(id=4, pending_id=1, amount=(1 << 128) - 1, ledger=1,
+                 code=1, flags=int(TF.post_pending_transfer)),
+        Transfer(id=5, pending_id=2, amount=0, ledger=1, code=1,
+                 flags=int(TF.void_pending_transfer)),
+    ])
+    # balancing batch (routes to the balancing tier).
+    d.transfers([
+        Transfer(id=6, debit_account_id=5, credit_account_id=10,
+                 amount=(1 << 128) - 1, ledger=1, code=1,
+                 flags=int(TF.balancing_debit)),
+        Transfer(id=7, debit_account_id=7, credit_account_id=14,
+                 amount=123, ledger=1, code=1),
+    ])
+    # plain batch again (back to the fast tier).
+    d.transfers([
+        Transfer(id=8, debit_account_id=3, credit_account_id=9,
+                 amount=77, ledger=1, code=1),
+    ])
+    d.check_state()
+
+
+def test_imported_batch_after_mixed_stream():
+    """Imported tier over the packed layout: user timestamps, in-batch
+    regress maxima chain, account-ts collision probe (the
+    searchsorted method='sort' path)."""
+    d = Differ()
+    _base_accounts(d, n=8, limits=False)
+    base = d.sm.commit_timestamp + 10
+    d.transfers([
+        Transfer(id=21, debit_account_id=2, credit_account_id=3,
+                 amount=5, ledger=1, code=1, flags=int(TF.imported),
+                 timestamp=base + 1),
+        # regresses in-batch (same ts as the previous event).
+        Transfer(id=22, debit_account_id=3, credit_account_id=4,
+                 amount=5, ledger=1, code=1, flags=int(TF.imported),
+                 timestamp=base + 1),
+        Transfer(id=23, debit_account_id=4, credit_account_id=5,
+                 amount=5, ledger=1, code=1, flags=int(TF.imported),
+                 timestamp=base + 2),
+    ])
+    d.transfers([
+        Transfer(id=24, debit_account_id=2, credit_account_id=5,
+                 amount=1, ledger=1, code=1),
+    ])
+    d.check_state()
+
+
+def test_inwindow_pending_chain_deaths_superbatch_shape():
+    """In-window pending definition + use with a chain death: exercises
+    the variadic-sort join, the packed def-view gathers, and the
+    fixpoint application reusing the fixpoint's sorted entry space."""
+    d = Differ()
+    _base_accounts(d, n=8, limits=False)
+    d.transfers([
+        # def (pending) ... use (post) in ONE batch.
+        Transfer(id=31, debit_account_id=2, credit_account_id=3,
+                 amount=40, ledger=1, code=1, flags=int(TF.pending),
+                 timeout=100),
+        Transfer(id=32, pending_id=31, amount=(1 << 128) - 1, ledger=1,
+                 code=1, flags=int(TF.post_pending_transfer)),
+        # linked chain whose failure kills a def; its use must read
+        # pending_transfer_not_found (dead-definition status).
+        Transfer(id=33, debit_account_id=4, credit_account_id=5,
+                 amount=10, ledger=1, code=1,
+                 flags=int(TF.linked | TF.pending), timeout=50),
+        Transfer(id=34, debit_account_id=99, credit_account_id=5,
+                 amount=1, ledger=1, code=1),  # fails: no such account
+        Transfer(id=35, pending_id=33, amount=0, ledger=1, code=1,
+                 flags=int(TF.void_pending_transfer)),
+    ])
+    d.check_state()
+
+
+@pytest.mark.parametrize("seed", range(3))
+def test_mixed_flag_fuzz(seed):
+    """Randomized mixed-flag stream (plain x pending x post/void x
+    closing x balancing) — the round-6 analog of the fast-path fuzz
+    differential, biased toward the repacked/fused code paths."""
+    rng = random.Random(0xB06 + seed)
+    d = Differ()
+    _base_accounts(d, n=12)
+    live_pending = []
+    next_id = 100
+    for _batch in range(6):
+        evs = []
+        for _ in range(rng.randrange(2, 7)):
+            kind = rng.random()
+            next_id += 1
+            a = rng.randrange(2, 13)
+            b = rng.randrange(2, 13)
+            if a == b:
+                b = 2 if a != 2 else 3
+            if kind < 0.25:
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=a, credit_account_id=b,
+                    amount=rng.randrange(1, 500), ledger=1, code=1,
+                    flags=int(TF.pending), timeout=rng.randrange(0, 50)))
+                live_pending.append(next_id)
+            elif kind < 0.4 and live_pending:
+                pid = rng.choice(live_pending)
+                post = rng.random() < 0.5
+                evs.append(Transfer(
+                    id=next_id, pending_id=pid,
+                    amount=((1 << 128) - 1) if post else 0, ledger=1,
+                    code=1,
+                    flags=int(TF.post_pending_transfer if post
+                              else TF.void_pending_transfer)))
+            elif kind < 0.55:
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=a, credit_account_id=b,
+                    amount=(1 << 128) - 1, ledger=1, code=1,
+                    flags=int(TF.balancing_debit if rng.random() < 0.5
+                              else TF.balancing_credit)))
+            elif kind < 0.65:
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=a, credit_account_id=b,
+                    amount=rng.randrange(1, 100), ledger=1, code=1,
+                    flags=int(TF.pending | TF.closing_debit),
+                    timeout=20))
+                live_pending.append(next_id)
+            else:
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=a, credit_account_id=b,
+                    amount=rng.randrange(1, 300), ledger=1, code=1))
+        d.transfers(evs)
+    d.check_state()

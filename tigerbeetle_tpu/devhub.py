@@ -209,6 +209,55 @@ def render(history_path: str, out_path: str,
             + "<table><tr><th>config</th><th>host fallbacks</th>"
               "<th>escalations</th><th>causes</th></tr>"
             + "".join(rows_fb) + "</table>")
+    # Op-budget table (next to the fallback diagnostics): the newest
+    # run's heavy-op census per kernel tier vs the committed gate
+    # ceilings (perf/opbudget_r06.json) — compile-footprint regressions
+    # are rendered as loudly as throughput ones.
+    ob_html = ""
+    ob = next((e.get("opbudget") for e in reversed(entries)
+               if isinstance(e.get("opbudget"), dict)
+               and "error" not in e.get("opbudget", {})), None)
+    if ob:
+        budgets = {}
+        try:
+            bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                 "..", "perf", "opbudget_r06.json")
+            with open(bpath) as f:
+                budgets = json.load(f).get("budget", {})
+        except (OSError, ValueError):
+            pass
+        rows_ob = []
+        any_over = False
+        for tier in sorted(ob):
+            d = ob[tier] or {}
+            total = d.get("heavy_total")
+            limit = (budgets.get(tier) or {}).get("heavy_total")
+            over = (total is not None and limit is not None
+                    and total > limit)
+            any_over = any_over or over
+            classes = d.get("heavy") or {}
+            cls_txt = " ".join(f"{k}={v}" for k, v in classes.items()
+                               if v) or "-"
+            flag = ('<span style="color:#c22;font-weight:600">OVER '
+                    'BUDGET</span>' if over else "")
+            rows_ob.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td></tr>".format(
+                    html.escape(tier),
+                    "-" if total is None else total,
+                    "-" if limit is None else limit,
+                    html.escape(cls_txt),
+                    d.get("operand_mb", "-"), flag))
+        badge_ob = ("" if not any_over else
+                    '<p style="color:#c22;font-weight:700">OP BUDGET '
+                    'EXCEEDED — scripts/gate.py would be RED</p>')
+        ob_html = (
+            "<h2>op budget (latest run vs committed ceilings)</h2>"
+            + badge_ob
+            + "<table><tr><th>kernel tier</th><th>heavy ops</th>"
+              "<th>budget</th><th>by class</th><th>operand MB</th>"
+              "<th></th></tr>"
+            + "".join(rows_ob) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -247,6 +296,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {''.join(rows)}
 </table>
 {fb_html}
+{ob_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

@@ -15,6 +15,135 @@ import collections
 import re
 from typing import Callable
 
+# ------------------------------------------------------------- op budgets
+# Heavy-op classes (the ops the tunnel bills ~0.5-1 ms each inside large
+# programs — PERF.md dispatch model). jaxpr-primitive -> budget class.
+# segment_* reductions lower through scatter-add/min/max; associative
+# scans and lax.scan/while are the 'scan' class.
+HEAVY_CLASSES = {
+    "sort": "sort",
+    "gather": "gather",
+    "scatter": "scatter",
+    "scatter-add": "segment_sum",
+    "scatter-max": "segment_sum",
+    "scatter-min": "segment_sum",
+    "scatter-mul": "segment_sum",
+    "scan": "scan",
+    "while": "scan",
+    "cumsum": "scan",
+    "cummax": "scan",
+    "cummin": "scan",
+    "cumprod": "scan",
+    "reduce_window": "scan",
+    "reduce_window_sum": "scan",
+    "reduce_window_max": "scan",
+    "reduce_window_min": "scan",
+}
+HEAVY_CLASS_ORDER = ("sort", "gather", "scatter", "segment_sum", "scan")
+
+
+def _aval_bytes(aval) -> int:
+    shape = getattr(aval, "shape", None)
+    dtype = getattr(aval, "dtype", None)
+    if shape is None or dtype is None:
+        return 0
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n * dtype.itemsize
+
+
+def _walk_jaxpr(jaxpr, visit) -> None:
+    """Depth-first over a jaxpr and every sub-jaxpr (pjit/cond/scan/...)."""
+    for eqn in jaxpr.eqns:
+        visit(eqn)
+        for sub in eqn.params.values():
+            subs = sub if isinstance(sub, (list, tuple)) else (sub,)
+            for s in subs:
+                inner = getattr(s, "jaxpr", None)
+                if inner is not None:
+                    _walk_jaxpr(inner if hasattr(inner, "eqns") else s,
+                                visit)
+
+
+def heavy_census(closed_jaxpr) -> dict:
+    """Per-class heavy-op counts + heavy operand bytes of a traced fn.
+
+    Input: a ClosedJaxpr (jax.make_jaxpr(fn)(*args)). Counts the
+    primitives in HEAVY_CLASSES recursively (one count per *executed*
+    op instance in the unrolled program — a scan body counts once, like
+    the dispatch layer sees it) and sums the operand bytes those ops
+    read (the bytes-dependent term of the tunnel's per-op cost).
+    Deterministic: no XLA compile, trace-level only."""
+    counts = collections.Counter({c: 0 for c in HEAVY_CLASS_ORDER})
+    nbytes = [0]
+
+    def visit(eqn):
+        cls = HEAVY_CLASSES.get(eqn.primitive.name)
+        if cls is None:
+            return
+        counts[cls] += 1
+        for v in eqn.invars:
+            nbytes[0] += _aval_bytes(getattr(v, "aval", None))
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    out = {"heavy": {c: counts[c] for c in HEAVY_CLASS_ORDER}}
+    out["heavy_total"] = sum(out["heavy"].values())
+    out["heavy_operand_bytes"] = nbytes[0]
+    return out
+
+
+# ----------------------------------------------------------- static lints
+
+CLOSURE_CONST_LIMIT = 4096  # bytes; PERF.md: ~64 ms/call at 0.5 MB
+
+
+def closure_constants(closed_jaxpr) -> list[tuple[str, int]]:
+    """(dtype/shape label, bytes) of every closed-over constant above
+    CLOSURE_CONST_LIMIT. The tunnel re-ships baked-in constants every
+    call (~64 ms at 0.5 MB — PERF.md 'closure constants are poison'), so
+    serving-path entries must take every table as an argument."""
+    out = []
+    for c in closed_jaxpr.consts:
+        shape = getattr(c, "shape", ())
+        dtype = getattr(c, "dtype", None)
+        if dtype is None:
+            continue
+        n = 1
+        for d in shape:
+            n *= int(d)
+        size = n * dtype.itemsize
+        if size > CLOSURE_CONST_LIMIT:
+            out.append((f"{dtype}{list(shape)}", size))
+    return out
+
+
+def while_ops(closed_jaxpr) -> int:
+    """Count of while/fori loops anywhere in the program. One executed
+    lax.while_loop degrades every later dispatch in the process to
+    5-8 ms (PERF.md round-2 finding) — serving-path lowerings must stay
+    straight-line."""
+    n = [0]
+
+    def visit(eqn):
+        if eqn.primitive.name == "while":
+            n[0] += 1
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return n[0]
+
+
+def donated_inputs(lowered) -> int:
+    """Number of donated parameters reported by a lowered artifact.
+    State-carrying entries must donate their ledger buffers
+    (donate_argnums) or every dispatch pays a full state copy. Donation
+    appears as input->output aliasing (`tf.aliasing_output`) when
+    resolvable at lowering time, or as a `jax.buffer_donor` mark (e.g.
+    sharded programs) when the pairing is deferred to the runtime."""
+    text = lowered.as_text()
+    return (len(re.findall(r"tf\.aliasing_output", text))
+            + len(re.findall(r"jax\.buffer_donor", text)))
+
 
 def analyze_lowered(lowered) -> dict:
     """Instruction histogram + size stats from a lowered jax computation."""

@@ -1,14 +1,62 @@
-"""Packed account_events ring layout, shared by the kernel and the ledger.
+"""Packed store layouts shared by the kernel and the ledger.
 
-One matrix per dtype so a batch's ring append is THREE row scatters, not
-~44 column scatters (per-op dispatch overhead is the TPU serving
-bottleneck). Logical column -> matrix index maps; ev_col() gives named
-access. Reference data model: the account_events groove row,
-src/state_machine.zig:104-220.
+One u64 matrix per store, with every 32-bit column PAIR-PACKED into u64
+lanes (low half | high half << 32): a row append is ONE scatter and a
+row-set gather is ONE gather (accounts keep the separate (rows, 16)
+balance-limb matrix, so account appends/gathers are two). Per-op
+dispatch overhead is the TPU serving bottleneck (PERF.md) — the round-6
+op-budget campaign folded the former u32/i32 side matrices into the u64
+store for exactly that reason. Logical column -> (matrix column, half)
+maps; *_col()/*_named() give named access and hide the packing.
+
+Packing rules the writers rely on:
+  - a 32-bit field that takes PARTIAL-row updates after insert (the
+    transfer pstat flip scatter) lives ALONE in its packed column, so
+    the update cannot clobber a neighbor;
+  - signed 32-bit fields are stored as their uint32 bit pattern
+    (zero-extended into the u64 lane) and sign-restored on read — cast
+    through uint32 when packing (a plain int->u64 cast would sign-extend
+    across the partner's half).
+
+Reference data model: the account_events groove row
+(src/state_machine.zig:104-220), the 128-byte Account
+(src/tigerbeetle.zig:10-43) and Transfer (src/tigerbeetle.zig:85-116).
 """
 
 from __future__ import annotations
 
+import numpy as np
+
+_M32 = np.uint64(0xFFFFFFFF)
+
+
+def _p32_maps(u64_names, p32_pairs):
+    """(field -> (column, half)) for the packed 32-bit tail columns."""
+    pos = {}
+    for j, pair in enumerate(p32_pairs):
+        for h, name in enumerate(pair):
+            pos[name] = (len(u64_names) + j, h)
+    return pos
+
+
+def _read32(mat, name, pos, signed):
+    col, half = pos[name]
+    w = mat[:, col]
+    v = (w >> np.uint64(32)) if half else (w & _M32)
+    return v.astype(np.int32 if name in signed else np.uint32)
+
+
+def pack32(lo, hi=None):
+    """Pack one or two 32-bit columns into a u64 word column. Works on
+    numpy and jax arrays; signed inputs go through uint32 so the high
+    half is never sign-smeared."""
+    w = lo.astype(np.uint32).astype(np.uint64)
+    if hi is not None:
+        w = w | (hi.astype(np.uint32).astype(np.uint64) << np.uint64(32))
+    return w
+
+
+# ------------------------------------------------- account_events ring
 EV_U64 = ("ts", "amt_hi", "amt_lo", "areq_hi", "areq_lo") + tuple(
     f"{side}_{f}_{half}"
     for side in ("dr", "cr")
@@ -16,18 +64,20 @@ EV_U64 = ("ts", "amt_hi", "amt_lo", "areq_hi", "areq_lo") + tuple(
     for half in ("hi", "lo"))
 EV_I32 = ("pstat", "p_row", "dr_row", "cr_row")
 EV_U32 = ("tflags", "dr_flags", "cr_flags")
+# Packed 32-bit tail: append order defines the matrix columns.
+EV_P32 = (("pstat", "p_row"), ("dr_row", "cr_row"),
+          ("tflags", "dr_flags"), ("cr_flags",))
 EV_U64_IDX = {n: i for i, n in enumerate(EV_U64)}
-EV_I32_IDX = {n: i for i, n in enumerate(EV_I32)}
-EV_U32_IDX = {n: i for i, n in enumerate(EV_U32)}
+EV_P32_POS = _p32_maps(EV_U64, EV_P32)
+EV_NCOLS = len(EV_U64) + len(EV_P32)
+_EV_SIGNED = frozenset(EV_I32)
 
 
 def ev_col(evr: dict, name: str):
     """Named column view of a packed events ring (device or numpy)."""
     if name in EV_U64_IDX:
         return evr["u64"][:, EV_U64_IDX[name]]
-    if name in EV_I32_IDX:
-        return evr["i32"][:, EV_I32_IDX[name]]
-    return evr["u32"][:, EV_U32_IDX[name]]
+    return _read32(evr["u64"], name, EV_P32_POS, _EV_SIGNED)
 
 
 def ev_cap(evr: dict) -> int:
@@ -35,11 +85,11 @@ def ev_cap(evr: dict) -> int:
 
 
 def ev_named(rows: dict) -> dict:
-    """Packed event rows ({'u64','i32','u32'} matrices) -> named column
-    dict (works on device arrays, numpy, or row-sliced views)."""
+    """Packed event rows ({'u64'} matrix) -> named column dict (works on
+    device arrays, numpy, or row-sliced views)."""
     out = {n: rows["u64"][:, i] for n, i in EV_U64_IDX.items()}
-    out.update({n: rows["i32"][:, i] for n, i in EV_I32_IDX.items()})
-    out.update({n: rows["u32"][:, i] for n, i in EV_U32_IDX.items()})
+    for n in EV_P32_POS:
+        out[n] = _read32(rows["u64"], n, EV_P32_POS, _EV_SIGNED)
     return out
 
 
@@ -53,51 +103,64 @@ def bal_col(field: str, limb: int) -> int:
     return BAL_IDX[field] + limb
 
 
-# Packed accounts store layout (reference data model: the 128-byte
-# Account, src/tigerbeetle.zig:10-43; balances live in the separate
-# (rows, 16) "bal" limb matrix — see BAL_FIELDS).
+# ------------------------------------------------------- accounts store
 AC_U64 = ("id_hi", "id_lo", "ud128_hi", "ud128_lo", "ud64", "ts")
 AC_U32 = ("ud32", "ledger", "code", "flags")
+# flags shares its packed column with code only: the closing-native
+# flag write-back RMWs the whole word, preserving the code half.
+AC_P32 = (("ud32", "ledger"), ("code", "flags"))
 AC_U64_IDX = {n: i for i, n in enumerate(AC_U64)}
-AC_U32_IDX = {n: i for i, n in enumerate(AC_U32)}
+AC_P32_POS = _p32_maps(AC_U64, AC_P32)
+AC_NCOLS = len(AC_U64) + len(AC_P32)
+_AC_SIGNED = frozenset()
+
+
+def ac_col(acc: dict, name: str):
+    """Named column view of a packed accounts store (device or numpy)."""
+    if name in AC_U64_IDX:
+        return acc["u64"][:, AC_U64_IDX[name]]
+    return _read32(acc["u64"], name, AC_P32_POS, _AC_SIGNED)
 
 
 def ac_named(rows: dict) -> dict:
-    """Packed account rows ({'u64','u32'[,'bal']} matrices) -> named
-    column dict (works on device arrays, numpy, or row-sliced views).
-    The balance limb matrix passes through under 'bal' when present."""
+    """Packed account rows ({'u64'[, 'bal']} matrices) -> named column
+    dict (works on device arrays, numpy, or row-sliced views). The
+    balance limb matrix passes through under 'bal' when present."""
     out = {n: rows["u64"][:, i] for n, i in AC_U64_IDX.items()}
-    out.update({n: rows["u32"][:, i] for n, i in AC_U32_IDX.items()})
+    for n in AC_P32_POS:
+        out[n] = _read32(rows["u64"], n, AC_P32_POS, _AC_SIGNED)
     if "bal" in rows:
         out["bal"] = rows["bal"]
     return out
 
 
-# Packed transfers store layout (reference data model: the 128-byte
-# Transfer, src/tigerbeetle.zig:85-116, plus device-side derived columns).
+# ------------------------------------------------------ transfers store
 XF_U64 = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
           "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi", "ud128_lo",
           "ud64", "ts", "expires")
 XF_U32 = ("ud32", "timeout", "ledger", "code", "flags")
 XF_I32 = ("pstat", "dr_row", "cr_row")
+# pstat lives alone: the post/void flip scatter rewrites it on existing
+# rows after the row insert and must not clobber a partner field.
+XF_P32 = (("ud32", "timeout"), ("ledger", "code"), ("dr_row", "cr_row"),
+          ("flags",), ("pstat",))
 XF_U64_IDX = {n: i for i, n in enumerate(XF_U64)}
-XF_U32_IDX = {n: i for i, n in enumerate(XF_U32)}
-XF_I32_IDX = {n: i for i, n in enumerate(XF_I32)}
+XF_P32_POS = _p32_maps(XF_U64, XF_P32)
+XF_NCOLS = len(XF_U64) + len(XF_P32)
+_XF_SIGNED = frozenset(XF_I32)
 
 
 def xf_col(xfr: dict, name: str):
     """Named column view of a packed transfers store (device or numpy)."""
     if name in XF_U64_IDX:
         return xfr["u64"][:, XF_U64_IDX[name]]
-    if name in XF_U32_IDX:
-        return xfr["u32"][:, XF_U32_IDX[name]]
-    return xfr["i32"][:, XF_I32_IDX[name]]
+    return _read32(xfr["u64"], name, XF_P32_POS, _XF_SIGNED)
 
 
 def xf_named(rows: dict) -> dict:
-    """Packed transfer rows ({'u64','u32','i32'} matrices) -> named
-    column dict (works on device arrays, numpy, or row-sliced views)."""
+    """Packed transfer rows ({'u64'} matrix) -> named column dict (works
+    on device arrays, numpy, or row-sliced views)."""
     out = {n: rows["u64"][:, i] for n, i in XF_U64_IDX.items()}
-    out.update({n: rows["u32"][:, i] for n, i in XF_U32_IDX.items()})
-    out.update({n: rows["i32"][:, i] for n, i in XF_I32_IDX.items()})
+    for n in XF_P32_POS:
+        out[n] = _read32(rows["u64"], n, XF_P32_POS, _XF_SIGNED)
     return out

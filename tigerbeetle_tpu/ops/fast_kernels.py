@@ -48,18 +48,19 @@ import numpy as np
 from ..constants import NS_PER_S, U63_MAX
 from . import u128
 from .ev_layout import (
-    AC_U32_IDX,
+    AC_P32,
+    AC_P32_POS,
+    AC_U64,
     AC_U64_IDX,
     BAL_IDX,
-    EV_I32,
-    EV_U32,
+    EV_P32,
     EV_U64,
-    XF_I32,
-    XF_I32_IDX,
-    XF_U32,
+    XF_P32,
+    XF_P32_POS,
     XF_U64,
     XF_U64_IDX,
     ev_cap,
+    pack32,
     xf_named,
 )
 from .create_kernels import (
@@ -155,12 +156,11 @@ def _u128_max_reduce(his, los):
 def _dup_keys(k_hi, k_lo, tags):
     """True if any two tagged keys are equal. Sort by (key, tagged-first) so
     tagged duplicates are adjacent even when untagged copies of the same key
-    sit between them."""
+    sit between them. ONE variadic sort — the tag lane rides the sort as a
+    carried operand instead of three post-sort gathers (op budget)."""
     untag = (~tags).astype(jnp.int32)
-    order = jnp.lexsort((untag, k_lo, k_hi))
-    s_hi = k_hi[order]
-    s_lo = k_lo[order]
-    s_tag = tags[order]
+    s_hi, s_lo, _, s_tag = jax.lax.sort(
+        (k_hi, k_lo, untag, tags), num_keys=3, is_stable=True)
     eq = (s_hi[1:] == s_hi[:-1]) & (s_lo[1:] == s_lo[:-1])
     both = s_tag[1:] & s_tag[:-1]
     return jnp.any(eq & both)
@@ -203,28 +203,37 @@ def _dup_and_pend_join(ev, valid, pv, idxs, N):
                             jnp.ones(N, dtype=jnp.int32)])
     seq = jnp.concatenate([idxs, idxs])
     untag = (~tags).astype(jnp.int32)
-    # Sort: key, tagged-first, defs-before-uses, stream order.
-    order = jnp.lexsort((seq, kind, untag, k_lo, k_hi))
-    s_hi, s_lo = k_hi[order], k_lo[order]
-    s_tag, s_kind, s_seq = tags[order], kind[order], seq[order]
+    pos = jnp.arange(2 * N, dtype=jnp.int32)
+    # ONE variadic sort: key, tagged-first, defs-before-uses, stream
+    # order — tag/kind/seq/pos ride as carried operands (no post-sort
+    # gathers; op budget).
+    s_hi, s_lo, _, s_kind, s_seq, s_tag, order = jax.lax.sort(
+        (k_hi, k_lo, untag, kind, seq, tags, pos),
+        num_keys=5, is_stable=True)
     eq = (s_hi[1:] == s_hi[:-1]) & (s_lo[1:] == s_lo[:-1])
     both = s_tag[1:] & s_tag[:-1]
     dups = jnp.any(eq & both & (s_kind[1:] == s_kind[:-1]))
-    # Runs of equal TAGGED keys; each run holds <= 1 def (else dups).
+    # Runs of equal TAGGED keys; each run holds <= 1 def (else dups),
+    # and the sort puts it FIRST in its run (defs-before-uses key). The
+    # run's def index forward-fills with one (run_id, def+1)-packed
+    # running max — no segment reduce, no gather.
     run_start = jnp.concatenate([
         jnp.ones(1, dtype=jnp.bool_), ~(eq & both)])
     run_id = _cumsum(run_start.astype(jnp.int32)) - 1
     def_val = jnp.where(s_tag & (s_kind == 0), s_seq, jnp.int32(-1))
-    run_def = jax.ops.segment_max(def_val, run_id, num_segments=2 * N)
-    didx_sorted = run_def[run_id]
+    enc = ((run_id.astype(jnp.int64) << jnp.int64(32))
+           | (def_val + 1).astype(jnp.int64))
+    fill = _cummax(enc)
+    didx_sorted = (fill & jnp.int64(0xFFFFFFFF)).astype(jnp.int32) - 1
+    same_run = (fill >> jnp.int64(32)).astype(jnp.int32) == run_id
     use_here = s_tag & (s_kind == 1)
-    hit_sorted = use_here & (didx_sorted >= 0)
-    # Scatter back to event positions (order is a permutation).
-    hit_full = jnp.zeros(2 * N, dtype=jnp.bool_).at[order].set(hit_sorted)
-    didx_full = jnp.zeros(2 * N, dtype=jnp.int32).at[order].set(
-        jnp.maximum(didx_sorted, 0))
-    inwin = hit_full[N:]
-    didx = didx_full[N:]
+    hit_sorted = use_here & same_run & (didx_sorted >= 0)
+    # Scatter back to event positions (order is a permutation): hit and
+    # didx packed as one (didx+1 | 0) lane -> ONE scatter.
+    val_sorted = jnp.where(hit_sorted, didx_sorted + 1, jnp.int32(0))
+    val_full = jnp.zeros(2 * N, dtype=jnp.int32).at[order].set(val_sorted)
+    inwin = val_full[N:] > 0
+    didx = jnp.maximum(val_full[N:] - 1, 0)
     # Sequential truth: only definitions EARLIER in the stream exist at
     # the use's evaluation point (a later def leaves the use
     # pending_transfer_not_found and still creates itself).
@@ -341,72 +350,83 @@ def _chain_pass(status, linked, valid, idxs, n, N, seg_start=None,
 
 # ================================================== create_transfers (fast)
 
-def _acct_unpack(g, g32, g_ts, found):
-    """Named account fields from pre-gathered row slices."""
+# Packed 32-bit account meta positions (ev_layout.AC_P32): ledger is
+# the high half of the (ud32|ledger) column, code/flags the halves of
+# the next one.
+_AC_UL_COL = AC_P32_POS["ud32"][0]
+_AC_CF_COL = AC_P32_POS["code"][0]
+
+
+def _acct_unpack(g_bal, g64, found):
+    """Named account fields from pre-gathered row slices (balance limb
+    rows + packed u64 meta rows)."""
     def field(name):
         i = BAL_IDX[name]
-        return _from_limbs(g[:, i], g[:, i + 1], g[:, i + 2], g[:, i + 3])
+        return _from_limbs(g_bal[:, i], g_bal[:, i + 1],
+                           g_bal[:, i + 2], g_bal[:, i + 3])
 
+    cf = g64[:, _AC_CF_COL]
     return dict(
         exists=found,
         dp=field("dp"),
         dpos=field("dpos"),
         cp=field("cp"),
         cpos=field("cpos"),
-        ledger=g32[:, AC_U32_IDX["ledger"]],
-        code=g32[:, AC_U32_IDX["code"]],
-        flags=g32[:, AC_U32_IDX["flags"]],
-        ts=g_ts,
+        ledger=(g64[:, _AC_UL_COL] >> jnp.uint64(32)).astype(jnp.uint32),
+        code=(cf & _M32).astype(jnp.uint32),
+        flags=(cf >> jnp.uint64(32)).astype(jnp.uint32),
+        ts=g64[:, AC_U64_IDX["ts"]],
     )
 
 
 def _acct_gather(acc, rows, found):
     """Gather the account fields the kernel needs at `rows` (clamped):
-    three row gathers total (balance limbs + u64/u32 meta matrices)."""
-    return _acct_unpack(acc["bal"][rows], acc["u32"][rows],
-                        acc["u64"][rows, AC_U64_IDX["ts"]], found)
+    TWO row gathers total (balance limbs + the packed u64 matrix whose
+    tail columns carry the 32-bit meta)."""
+    return _acct_unpack(acc["bal"][rows], acc["u64"][rows], found)
 
 
 def _acct_gather_multi(acc, rows_list, found_list):
-    """K account-role gathers as THREE matrix gathers over the
-    concatenated row set (per-dispatch overhead dominates on TPU: 3K
-    gathers -> 3). Returns one named dict per role."""
+    """K account-role gathers as TWO matrix gathers over the
+    concatenated row set (per-dispatch overhead dominates on TPU: 2K
+    gathers -> 2). Returns one named dict per role."""
     rows = jnp.concatenate(rows_list)
     g_bal = acc["bal"][rows]
-    g32 = acc["u32"][rows]
-    g_ts = acc["u64"][rows, AC_U64_IDX["ts"]]
+    g64 = acc["u64"][rows]
     outs = []
     off = 0
     for r, found in zip(rows_list, found_list):
         n = r.shape[0]
-        outs.append(_acct_unpack(g_bal[off:off + n], g32[off:off + n],
-                                 g_ts[off:off + n], found))
+        outs.append(_acct_unpack(g_bal[off:off + n], g64[off:off + n],
+                                 found))
         off += n
     return outs
 
 
 def _xfer_gather(xfr, rows):
-    """Row gather of the packed transfers store: three matrix gathers,
-    returned as a named column dict."""
-    return xf_named({"u64": xfr["u64"][rows], "u32": xfr["u32"][rows],
-                     "i32": xfr["i32"][rows]})
+    """Row gather of the packed transfers store: ONE matrix gather (the
+    32-bit columns ride pair-packed in the u64 tail), returned as a
+    named column dict."""
+    return xf_named({"u64": xfr["u64"][rows]})
 
 
 def _xfer_gather_multi(xfr, rows_list):
-    """K transfer-role gathers as three concatenated matrix gathers."""
+    """K transfer-role gathers as ONE concatenated matrix gather."""
     rows = jnp.concatenate(rows_list)
     g64 = xfr["u64"][rows]
-    g32 = xfr["u32"][rows]
-    g32i = xfr["i32"][rows]
     outs = []
     off = 0
     for r in rows_list:
         n = r.shape[0]
-        outs.append(xf_named({"u64": g64[off:off + n],
-                              "u32": g32[off:off + n],
-                              "i32": g32i[off:off + n]}))
+        outs.append(xf_named({"u64": g64[off:off + n]}))
         off += n
     return outs
+
+
+_IDV_U64 = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
+            "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi",
+            "ud128_lo", "ud64")
+_IDV_32 = ("ud32", "timeout", "ledger", "code", "flags")
 
 
 def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
@@ -416,30 +436,32 @@ def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
     Shared by per_event_status's internal substitution and the SPMD
     tail's bundle fixup (create_transfers_fast spmd join path) so the
     two can never drift. dr_rowc/cr_rowc are the per-event account-row
-    probe results the definition's rows are gathered from."""
-    dg = lambda a: a[didx]  # noqa: E731 — def-side gather
-    d_flags = dg(ev["flags"])
-    d_timeout = dg(ev["timeout"])
-    d_ts = dg(ts_event)
-    return dict(
-        id_hi=dg(ev["id_hi"]), id_lo=dg(ev["id_lo"]),
-        dr_hi=dg(ev["dr_hi"]), dr_lo=dg(ev["dr_lo"]),
-        cr_hi=dg(ev["cr_hi"]), cr_lo=dg(ev["cr_lo"]),
-        amt_hi=dg(ev["amt_hi"]), amt_lo=dg(ev["amt_lo"]),
-        pid_hi=dg(ev["pid_hi"]), pid_lo=dg(ev["pid_lo"]),
-        ud128_hi=dg(ev["ud128_hi"]), ud128_lo=dg(ev["ud128_lo"]),
-        ud64=dg(ev["ud64"]), ud32=dg(ev["ud32"]),
-        timeout=d_timeout,
-        ledger=dg(ev["ledger"]), code=dg(ev["code"]),
-        flags=d_flags,
+    probe results the definition's rows are gathered from.
+
+    Op-budget discipline: the ~20 def-side lanes gather as TWO stacked
+    matrix gathers (u64 lanes + 32-bit lanes), not one gather per lane
+    — this view sits inside every fixpoint-tier lowering."""
+    g64 = jnp.stack([ev[k] for k in _IDV_U64] + [ts_event])[:, didx]
+    g32 = jnp.stack(
+        [ev[k] for k in _IDV_32]
+        + [dr_rowc.astype(jnp.uint32), cr_rowc.astype(jnp.uint32)]
+    )[:, didx]
+    out = {k: g64[i] for i, k in enumerate(_IDV_U64)}
+    out.update({k: g32[i] for i, k in enumerate(_IDV_32)})
+    d_flags = out["flags"]
+    d_timeout = out["timeout"]
+    d_ts = g64[len(_IDV_U64)]
+    out.update(
         ts=d_ts,
         expires=jnp.where(
             d_timeout != 0,
             d_ts + jnp.uint64(d_timeout) * _NSPS, jnp.uint64(0)),
         pstat=jnp.where(_flag(d_flags, _F_PENDING),
                         jnp.int32(_PS_PENDING), jnp.int32(0)),
-        dr_row=dg(dr_rowc), cr_row=dg(cr_rowc),
+        dr_row=g32[len(_IDV_32)].astype(jnp.int32),
+        cr_row=g32[len(_IDV_32) + 1].astype(jnp.int32),
     )
+    return out
 
 
 def _pv_eval(ev, p, p_found, p_dr, p_cr, ts_event, imported_ctx=None):
@@ -555,8 +577,12 @@ def imported_batch_ctx(state, ev, ts_event, valid, idxs, seg_start=None):
         num_segments=N)[seg_id]
     # Account-timestamp collision (reference :3808): membership of
     # the user timestamp in the account table's timestamp column.
+    # method='sort': the default 'scan' method lowers to a while loop,
+    # which degrades every later dispatch in the process to 5-8 ms
+    # (PERF.md round-2 finding; jaxhound's serving-path lint enforces
+    # while-free lowerings).
     acct_ts_sorted = jnp.sort(acc["u64"][:, AC_U64_IDX["ts"]])
-    pos = jnp.searchsorted(acct_ts_sorted, ev["ts"])
+    pos = jnp.searchsorted(acct_ts_sorted, ev["ts"], method="sort")
     pos = jnp.minimum(pos, acct_ts_sorted.shape[0] - 1)
     coll = imp_lane & (acct_ts_sorted[pos] == ev["ts"]) \
         & (ev["ts"] != 0)
@@ -653,8 +679,11 @@ def per_event_status(state, ev, ts_event, return_gathers=False,
     # the table (live or orphaned): then the definition is not-created
     # and the table row with that id is the sequential-truth target.
     if inwin is not None:
-        dg = lambda a: a[didx]  # noqa: E731 — def-side gather
-        inwin = inwin & ~dg(e_found) & ~dg(o_found)
+        # Def-side table-collision gate: ONE packed-u8 gather for both
+        # probe lanes (op budget).
+        eo = (e_found.astype(jnp.uint8)
+              | (o_found.astype(jnp.uint8) << 1))
+        inwin = inwin & (eo[didx] == 0)
         p2 = _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc)
         for key in p:
             p[key] = jnp.where(inwin, p2[key], p[key])
@@ -978,8 +1007,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         e2, inwin_raw, didx = _dup_and_pend_join(ev, valid, pv, idxs, N)
         ef_b = per_event["e_found"]
         of_b = per_event["o_found"]
-        inwin = inwin_raw & ~ef_b[didx] & ~of_b[didx]
-        e2 = e2 | jnp.any(inwin_raw & (ef_b | of_b))
+        eo_b = (ef_b.astype(jnp.uint8) | (of_b.astype(jnp.uint8) << 1))
+        inwin = inwin_raw & (eo_b[didx] == 0)
+        e2 = e2 | jnp.any(inwin_raw & (eo_b != 0))
         didx = jnp.where(inwin, didx, 0)
         # The unsubstituted bundle status IS the dead-definition
         # variant: for a gated in-window use the table lookup missed,
@@ -1164,14 +1194,20 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         jnp.where(reg, amt_res_hi, z64), jnp.where(reg, amt_res_lo, z64))
     ral = jnp.stack([ral0, ral1, ral2, ral3], axis=1)  # (N, 4)
 
-    def _acct_load(rows):
-        # One batched segment_sum for all four limbs (rows sum per limb).
-        s = jax.ops.segment_sum(ral, rows, num_segments=A_rows)
-        return [s[:, j] for j in range(4)]
+    aflags_full = (acc["u64"][:, _AC_CF_COL]
+                   >> jnp.uint64(32)).astype(jnp.uint32)
+    # The dump row (last) is scratch: failed creates scatter raw flags
+    # there and masked transfers scatter-add amounts into its balances —
+    # it must never latch a breach. A static iota mask, not a one-slot
+    # scatter (op budget).
+    not_dump = (jnp.arange(A_rows, dtype=jnp.int32)
+                != jnp.int32(A_rows - 1))
 
     def _breach(load, held1, held2, against1, limit_bit):
         # (held1 + held2 + load) > against1, evaluated in 5 limbs
         # (each limb sum < 2^46: no u64 overflow before normalize).
+        # Returns the per-account breach VECTOR; both sides reduce in
+        # one stacked any below.
         balm = acc["bal"]
         h1, h2, ag = BAL_IDX[held1], BAL_IDX[held2], BAL_IDX[against1]
         lft = [balm[:, h1 + j] + balm[:, h2 + j] + load[j]
@@ -1187,27 +1223,30 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         left_lo = f0 | (f1 << jnp.uint64(32))
         right_hi = balm[:, ag + 2] | (balm[:, ag + 3] << jnp.uint64(32))
         right_lo = balm[:, ag] | (balm[:, ag + 1] << jnp.uint64(32))
-        limited = _flag(acc["u32"][:, AC_U32_IDX["flags"]], limit_bit)
-        # The dump row (last) is scratch: failed creates scatter raw
-        # flags there and masked transfers scatter-add amounts into its
-        # balances — it must never latch a breach.
-        limited = limited.at[A_rows - 1].set(False)
+        limited = _flag(aflags_full, limit_bit) & not_dump
         over = (l4 > 0) | u128.lt(right_hi, right_lo, left_hi, left_lo)
-        return jnp.any(limited & over)
+        return limited & over
 
     if balancing_mode:
         # The headroom proof is meaningless under balancing (nominal
         # amounts are near-always AMOUNT_MAX) and its limit_hit output
-        # is unread by the balancing route — skip both segment-sum
-        # reductions; e3 is unconditionally overridden by the fixpoint
+        # is unread by the balancing route — skip the segment-sum
+        # reduction; e3 is unconditionally overridden by the fixpoint
         # convergence outcome below (balancing_mode implies
         # limit_rounds > 1).
         e3 = jnp.bool_(False)
     else:
-        e3 = (_breach(_acct_load(dr_rowc), "dp", "dpos", "cpos",
-                      _A_DR_LIMIT)
-              | _breach(_acct_load(cr_rowc), "cp", "cpos", "dpos",
-                        _A_CR_LIMIT))
+        # ONE segment-sum covers BOTH sides' worst-case loads (credit
+        # rows offset by A_rows) and ONE stacked any reduces both
+        # breach vectors.
+        rows2l = jnp.concatenate([dr_rowc, cr_rowc + jnp.int32(A_rows)])
+        s2 = jax.ops.segment_sum(jnp.concatenate([ral, ral]), rows2l,
+                                 num_segments=2 * A_rows)
+        e3 = jnp.any(jnp.stack([
+            _breach([s2[:A_rows, j] for j in range(4)],
+                    "dp", "dpos", "cpos", _A_DR_LIMIT),
+            _breach([s2[A_rows:, j] for j in range(4)],
+                    "cp", "cpos", "dpos", _A_CR_LIMIT)]))
     # The headroom-proof outcome, preserved across the fixpoint override
     # below: the adaptive router drops back to the proof-gated kernel only
     # once the PROOF would pass (dropping back on "no actual breach" would
@@ -1295,10 +1334,11 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             jnp.ones(1, dtype=jnp.bool_),
             frows_sorted[1:] != frows_sorted[:-1]])
         fseg_id = _cumsum(fstart.astype(jnp.int32)) - 1
-        fseg_start = jax.ops.segment_max(
-            jnp.where(fstart, jnp.arange(2 * N, dtype=jnp.int32),
-                      jnp.int32(0)),
-            fseg_id, num_segments=2 * N)[fseg_id]
+        # Per-entry segment-start position: forward-fill of start
+        # positions (one running max — start positions increase), not a
+        # segment reduce + gather (op budget).
+        fseg_start = _cummax(jnp.where(
+            fstart, jnp.arange(2 * N, dtype=jnp.int32), jnp.int32(-1)))
         finv = jnp.zeros(2 * N, dtype=jnp.int32).at[fperm].set(
             jnp.arange(2 * N, dtype=jnp.int32))
         fbase = acc["bal"][frows_sorted].T.reshape(4, 4, 2 * N)
@@ -1348,8 +1388,12 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                 (~pv & ((status == _CREATED)
                         | (status == _TS["overflows_timeout"])))
                 | (pv & is_post & (status == _CREATED)))
-            aflags_col = acc["u32"][:, AC_U32_IDX["flags"]]
-            init_closed_s = _flag(aflags_col[frows_sorted], _A_CLOSED)
+            # One gather of the packed (code|flags) column serves the
+            # round-0 closed view AND the application stage's flag
+            # write-back (which must preserve the code half).
+            cf_s = acc["u64"][frows_sorted, _AC_CF_COL]
+            base_flags_s = (cf_s >> jnp.uint64(32)).astype(jnp.uint32)
+            init_closed_s = _flag(base_flags_s, _A_CLOSED)
             idx2 = jnp.arange(2 * N, dtype=jnp.int32)
             # Round 0: pre-batch closed flags (the per-event gathers).
             cdr_ln = cand_close & _flag(
@@ -1488,10 +1532,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             # first failure IS the use itself still had the def applied
             # when the use evaluated (the rollback happens at the use's
             # failure, after its own status code is assigned; reference
-            # execute_create :3116-3150).
-            def_dead = ((st_r[didx] != _CREATED)
-                        | (in_chain_r[didx] & (my_first_r[didx] < idxs)))
-            new_dead = inwin & def_dead
+            # execute_create :3116-3150). Packed into ONE def-side
+            # gather (op budget): -1 = own failure (always < use idx),
+            # the chain's first-failure position when in a chain, else
+            # +INF (never < use idx).
+            dead_enc = jnp.where(
+                st_r != _CREATED, jnp.int32(-1),
+                jnp.where(in_chain_r, my_first_r, _INF))
+            new_dead = inwin & (dead_enc[didx] < idxs)
             # Gauss-Seidel fold: apply the NEW deaths to this round's
             # apply set (chains re-derived over the folded statuses), so
             # the over->death->lost-relief wave completes in ONE round.
@@ -1797,8 +1845,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # flips the row its definition is inserting IN THIS DISPATCH —
     # trow[didx] — so the flip scatter must run AFTER the row insert
     # (below), or the insert would overwrite the flip with PENDING.
-    flip_pos = jnp.where(ap_pv,
-                         jnp.where(inwin, trow[didx], p_rowc), T_dump)
+    if limit_rounds > 1 and not imported_mode:
+        flip_row = jnp.where(inwin, trow[didx], p_rowc)
+    else:
+        # inwin is statically all-False on these tiers: skip the
+        # def-side gather entirely (op budget).
+        flip_row = p_rowc
+    flip_pos = jnp.where(ap_pv, flip_row, T_dump)
     ud128z = u128.is_zero(ev["ud128_hi"], ev["ud128_lo"])
     stores = dict(
         id_hi=ev["id_hi"], id_lo=ev["id_lo"],
@@ -1825,23 +1878,26 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
     )
-    # Packed row inserts: one scatter per dtype matrix. Masked lanes
+    # Packed row insert: ONE row scatter (the 32-bit columns ride
+    # pair-packed in the u64 tail — ev_layout.XF_P32). Masked lanes
     # write uniform zero rows to the dump slot (duplicate-index scatters
-    # stay deterministic only if every duplicate writes one value).
-    u64_rows = jnp.stack([stores[n] for n in XF_U64], axis=1)
-    u32_rows = jnp.stack([stores[n] for n in XF_U32], axis=1)
-    i32_rows = jnp.stack([stores[n] for n in XF_I32], axis=1)
+    # stay deterministic only if every duplicate writes one value). The
+    # pstat flip is a second scatter into pstat's OWN packed column
+    # (sequenced after the insert — see flip_pos above).
+    u64_rows = jnp.stack(
+        [stores[n] for n in XF_U64]
+        + [pack32(stores[pr[0]],
+                  stores[pr[1]] if len(pr) > 1 else None)
+           for pr in XF_P32],
+        axis=1)
     apn = ap[:, None]
-    i32_inserted = xfr["i32"].at[trow].set(
-        jnp.where(apn, i32_rows, jnp.int32(0)))
+    u64_inserted = xfr["u64"].at[trow].set(
+        jnp.where(apn, u64_rows, jnp.uint64(0)))
     new_xfr = {
-        "u64": xfr["u64"].at[trow].set(
-            jnp.where(apn, u64_rows, jnp.uint64(0))),
-        "u32": xfr["u32"].at[trow].set(
-            jnp.where(apn, u32_rows, jnp.uint32(0))),
-        "i32": i32_inserted.at[flip_pos, XF_I32_IDX["pstat"]].set(
-            jnp.where(ap_pv, jnp.where(is_post, _PS_POSTED, _PS_VOIDED),
-                      jnp.int32(0))),
+        "u64": u64_inserted.at[flip_pos, XF_P32_POS["pstat"][0]].set(
+            pack32(jnp.where(ap_pv,
+                             jnp.where(is_post, _PS_POSTED, _PS_VOIDED),
+                             jnp.int32(0)))),
         "count": xfr["count"] + jnp.where(ok, n_created, 0),
     }
 
@@ -1859,36 +1915,98 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     evr = state["events"]
     E_dump = ev_cap(evr)
     z64 = jnp.uint64(0)
-    side_rows = [
-        jnp.where(ap, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
-        jnp.where(ap, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
-    ]
     al = (al0, al1, al2, al3)
     nl = (nl0, nl1, nl2, nl3)
-    rows2 = jnp.concatenate(side_rows)  # 2N entries: dr sides then cr sides
-    order2 = jnp.concatenate([idxs, idxs])
-    perm = _packed_perm(rows2, order2, acc["u64"].shape[0])
-    rows_sorted = rows2[perm]
-    is_start = jnp.concatenate([
-        jnp.ones(1, dtype=jnp.bool_), rows_sorted[1:] != rows_sorted[:-1]])
-    start_positions = jnp.where(
-        is_start, jnp.arange(2 * N, dtype=jnp.int32), jnp.int32(0))
-    seg_id = _cumsum(is_start.astype(jnp.int32)) - 1
-    seg_start = jax.ops.segment_max(
-        start_positions, seg_id, num_segments=2 * N)[seg_id]
-
-    # Stacked (4 fields, 4 limbs, 2N): ONE sort-gather, ONE cumsum, ONE
-    # segment-offset gather, ONE base add — not 16 scalar-lane pipelines.
     fields = _FIELDS
-    lanes2 = _delta_lanes2(ap_reg, ap_pend, ap_pv, ap_post, al, nl)
-    lanes_sorted = lanes2[:, :, perm]
+    if limit_rounds > 1:
+        # Reuse the fixpoint's sorted entry space WHOLESALE — perm,
+        # base limbs, segment structure (it sorted the same (row,
+        # event-order) entries; its valid-mask is a superset of the
+        # application's ap-mask, and a valid-but-unapplied entry only
+        # contributes a ZERO delta, so prefixes and final balances are
+        # bit-identical while fully-failed accounts rewrite their own
+        # base limbs unchanged). The application is then ONE more round
+        # body at the FINAL applied set plus the state writes — the
+        # former second sort + base/segment re-derivation lowered the
+        # same subcomputation twice (op budget).
+        perm = fperm
+        rows_sorted = frows_sorted
+        is_start = fstart
+        seg_id = fseg_id
+        seg_start = fseg_start
+        inv = finv
+        base = fbase
+        mask8f = ((ap & ~pv & ~pending).astype(jnp.uint8)
+                  | ((ap & ~pv & pending).astype(jnp.uint8) << 1)
+                  | ((ap & pv).astype(jnp.uint8) << 2)
+                  | ((ap & pv & is_post).astype(jnp.uint8) << 3)
+                  | ((ap & ~pv & close_dr_f).astype(jnp.uint8) << 4)
+                  | ((ap & ~pv & close_cr_f).astype(jnp.uint8) << 5)
+                  | ((ap & pv & is_void & p_cl_dr).astype(jnp.uint8) << 6)
+                  | ((ap & pv & is_void & p_cl_cr).astype(jnp.uint8) << 7))
+        m_s2 = jnp.concatenate([mask8f, mask8f])[perm]
+        reg_s2 = (m_s2 & 1) != 0
+        pend_s2 = (m_s2 & 2) != 0
+        pv_s2 = (m_s2 & 4) != 0
+        post_s2 = (m_s2 & 8) != 0
+        if balancing_mode:
+            # Amounts include the converged clamps: one stacked gather
+            # of the final limbs (the hoisted al2_s is nominal).
+            al_ev2 = jnp.stack(al)
+            al_use2 = jnp.take(jnp.concatenate([al_ev2, al_ev2], axis=1),
+                               perm, axis=1)
+        else:
+            al_use2 = al2_s
+        held_f = [jnp.where(pend_s2, al_use2[j], z64)
+                  + jnp.where(pv_s2, nl2_s[j], z64) for j in range(4)]
+        posted_f = [jnp.where(reg_s2 | post_s2, al_use2[j], z64)
+                    for j in range(4)]
+        # Lane semantics MUST match _delta_lanes2 — see its docstring.
+        lanes_sorted = jnp.stack([
+            jnp.stack([jnp.where(cr_side_s, z64, held_f[j])
+                       for j in range(4)]),       # dp
+            jnp.stack([jnp.where(cr_side_s, z64, posted_f[j])
+                       for j in range(4)]),       # dpos
+            jnp.stack([jnp.where(cr_side_s, held_f[j], z64)
+                       for j in range(4)]),       # cp
+            jnp.stack([jnp.where(cr_side_s, posted_f[j], z64)
+                       for j in range(4)]),       # cpos
+        ])
+    else:
+        side_rows = [
+            jnp.where(ap, jnp.where(pv, p["dr_row"], dr_rowc), A_dump),
+            jnp.where(ap, jnp.where(pv, p["cr_row"], cr_rowc), A_dump),
+        ]
+        rows2 = jnp.concatenate(side_rows)  # 2N: dr sides then cr sides
+        order2 = jnp.concatenate([idxs, idxs])
+        perm = _packed_perm(rows2, order2, acc["u64"].shape[0])
+        rows_sorted = rows2[perm]
+        is_start = jnp.concatenate([
+            jnp.ones(1, dtype=jnp.bool_),
+            rows_sorted[1:] != rows_sorted[:-1]])
+        seg_id = _cumsum(is_start.astype(jnp.int32)) - 1
+        # Forward-fill of start positions (one running max), not a
+        # segment reduce + gather (op budget).
+        seg_start = _cummax(jnp.where(
+            is_start, jnp.arange(2 * N, dtype=jnp.int32), jnp.int32(-1)))
+        inv = jnp.zeros(2 * N, dtype=jnp.int32).at[perm].set(
+            jnp.arange(2 * N, dtype=jnp.int32))
+        # Packed-balance base: one row gather, reshaped to
+        # [field][limb][entry] (column = field * 4 + limb, matching the
+        # `fields` order).
+        base = acc["bal"][rows_sorted].T.reshape(4, 4, 2 * N)
+        # Stacked (4 fields, 4 limbs, 2N): ONE sort-gather, ONE cumsum,
+        # ONE segment-offset gather, ONE base add — not 16 scalar-lane
+        # pipelines. The permute runs on u32 lanes (all delta limbs are
+        # u32-normalized) and widens AFTER the gather: half the operand
+        # bytes of a u64 permute.
+        lanes2 = _delta_lanes2(ap_reg, ap_pend, ap_pv, ap_post, al, nl)
+        lanes_sorted = lanes2.astype(jnp.uint32)[:, :, perm].astype(
+            jnp.uint64)
     cs = _cumsum(lanes_sorted, axis=2)
     offsets = jnp.where(
         seg_start > 0,
         jnp.take(cs, jnp.maximum(seg_start - 1, 0), axis=2), z64)
-    # Packed-balance base: one row gather, reshaped to [field][limb][entry]
-    # (column = field * 4 + limb, matching the `fields` order).
-    base = acc["bal"][rows_sorted].T.reshape(4, 4, 2 * N)
     limbs = base + cs - offsets                      # (4, 4, 2N)
     l0, l1, l2, l3 = _normalize_limbs(limbs)
     hi_sorted = l2 | (l3 << jnp.uint64(32))          # (4, 2N)
@@ -1906,14 +2024,14 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     new_acc = dict(acc)
     new_acc["bal"] = acc["bal"].at[tgt].set(
         jnp.where(real[:, None], vals, jnp.uint64(0)))
-    inv = jnp.zeros(2 * N, dtype=jnp.int32).at[perm].set(
-        jnp.arange(2 * N, dtype=jnp.int32))
-    hi_all = jnp.take(hi_sorted, inv, axis=1)        # original entry order
-    lo_all = jnp.take(lo_sorted, inv, axis=1)
+    # Snapshot rows back to entry order: ONE stacked take for the hi and
+    # lo halves together (op budget).
+    hilo_all = jnp.take(jnp.concatenate([hi_sorted, lo_sorted]),
+                        inv, axis=1)                 # (8, 2N)
     snap = {}
     for fi, field in enumerate(fields):
-        snap[f"dr_{field}"] = (hi_all[fi, :N], lo_all[fi, :N])
-        snap[f"cr_{field}"] = (hi_all[fi, N:], lo_all[fi, N:])
+        snap[f"dr_{field}"] = (hilo_all[fi, :N], hilo_all[4 + fi, :N])
+        snap[f"cr_{field}"] = (hilo_all[fi, N:], hilo_all[4 + fi, N:])
 
     eff_dr_flags = jnp.where(pv, p_dr["flags"], dr["flags"])
     eff_cr_flags = jnp.where(pv, p_cr["flags"], cr["flags"])
@@ -1927,32 +2045,33 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # last-op-wins scan as the fixpoint, over the application's own
         # sorted space (whose ops come from the FINAL applied set).
         cl_u = jnp.uint32(_A_CLOSED)
-        set2 = jnp.concatenate([ap & ~pv & close_dr_f,
-                                ap & ~pv & close_cr_f])[perm]
-        clr2 = jnp.concatenate([ap & pv & is_void & p_cl_dr,
-                                ap & pv & is_void & p_cl_cr])[perm]
+        # Closed-op lanes come out of the SAME packed mask gather the
+        # delta lanes ride (bits 4..7 of m_s2) — no extra gathers.
+        set2 = jnp.where(cr_side_s, (m_s2 & 32) != 0, (m_s2 & 16) != 0)
+        clr2 = jnp.where(cr_side_s, (m_s2 & 128) != 0, (m_s2 & 64) != 0)
         idx2a = jnp.arange(2 * N, dtype=jnp.int32)
         op_pos2 = jnp.where(set2 | clr2, idx2a, jnp.int32(-1))
         incl2 = _cummax(op_pos2)
         # Inclusive (post-event) closed per entry; seg_start here is the
-        # application sort's per-entry segment-start position.
+        # (shared) sorted entry space's per-entry segment-start position.
         has2 = incl2 >= seg_start
-        aflags_col2 = acc["u32"][:, AC_U32_IDX["flags"]]
-        base_flags_s = aflags_col2[rows_sorted]
         closed_incl_s = jnp.where(has2, set2[jnp.maximum(incl2, 0)],
-                                  _flag(base_flags_s, _A_CLOSED))
+                                  init_closed_s)
         # Post-batch flag word per account: last entry of each real
         # segment; only segments that carried an op write (untouched
-        # accounts keep their word byte-identical).
+        # accounts keep their word byte-identical). The write-back RMWs
+        # the packed (code|flags) column gathered once in the fixpoint
+        # setup (cf_s), preserving the code half.
         seg_has_op = jax.ops.segment_max(
             op_pos2, seg_id, num_segments=2 * N)[seg_id] >= 0
         wrf = real & seg_has_op
         new_word = jnp.where(closed_incl_s, base_flags_s | cl_u,
                              base_flags_s & ~cl_u)
-        new_acc["u32"] = acc["u32"].at[
-            jnp.where(wrf, rows_sorted, A_dump),
-            AC_U32_IDX["flags"]].set(
-            jnp.where(wrf, new_word, jnp.uint32(0)))
+        new_word64 = ((cf_s & _M32)
+                      | (new_word.astype(jnp.uint64) << jnp.uint64(32)))
+        new_acc["u64"] = acc["u64"].at[
+            jnp.where(wrf, rows_sorted, A_dump), _AC_CF_COL].set(
+            jnp.where(wrf, new_word64, jnp.uint64(0)))
         closed_incl = closed_incl_s[inv]
         eff_dr_flags = jnp.where(closed_incl[:N], eff_dr_flags | cl_u,
                                  eff_dr_flags & ~cl_u)
@@ -1969,9 +2088,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
                         jnp.where(is_post, _PS_POSTED,
                                   jnp.where(is_void, _PS_VOIDED,
                                             jnp.int32(0)))),
-        p_row=jnp.where(ap_pv,
-                        jnp.where(inwin, trow[didx], p_rowc),
-                        jnp.int32(-1)),
+        p_row=jnp.where(ap_pv, flip_row, jnp.int32(-1)),
         dr_row=jnp.where(pv, p["dr_row"], dr_rowc),
         cr_row=jnp.where(pv, p["cr_row"], cr_rowc),
         # Effective-side account flags: already gathered in the per-event
@@ -1985,27 +2102,30 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             hi_arr, lo_arr = snap[f"{sside}_{field}"]
             stores_ev[f"{sside}_{field}_hi"] = hi_arr
             stores_ev[f"{sside}_{field}_lo"] = lo_arr
-    # Packed ring append: one row scatter per dtype matrix (44 -> 3);
+    # Packed ring append: ONE row scatter (44 logical columns -> 1; the
+    # 32-bit columns ride pair-packed in the u64 tail, ev_layout.EV_P32);
     # masked lanes write uniform zero rows to the dump slot (determinism).
+    ev_u64_rows = jnp.stack(
+        [stores_ev[n] for n in EV_U64]
+        + [pack32(stores_ev[pr[0]],
+                  stores_ev[pr[1]] if len(pr) > 1 else None)
+           for pr in EV_P32],
+        axis=1)
     new_evr = {
         "u64": evr["u64"].at[erow].set(jnp.where(
-            ap[:, None], jnp.stack([stores_ev[n] for n in EV_U64], axis=1),
-            jnp.uint64(0))),
-        "i32": evr["i32"].at[erow].set(jnp.where(
-            ap[:, None], jnp.stack([stores_ev[n] for n in EV_I32], axis=1),
-            jnp.int32(0))),
-        "u32": evr["u32"].at[erow].set(jnp.where(
-            ap[:, None], jnp.stack([stores_ev[n] for n in EV_U32], axis=1),
-            jnp.uint32(0))),
+            ap[:, None], ev_u64_rows, jnp.uint64(0))),
         "count": jnp.where(ok, ring_base + n_created, evr["count"]),
     }
 
-    # Scalars.
-    last_ts = jnp.max(jnp.where(created, ts_event, jnp.uint64(0)))
+    # Scalars: both running maxima in ONE stacked reduce.
+    last2 = jnp.max(jnp.where(created[None, :],
+                              jnp.stack([ts_event, ts_actual]),
+                              jnp.uint64(0)), axis=1)
     # key_max tracks the max APPLIED timestamp (imported rows carry user
     # timestamps; == last_ts otherwise) — the regress reference for
     # future imported batches. commit_ts stays prepare-derived.
-    last_actual = jnp.max(jnp.where(created, ts_actual, jnp.uint64(0)))
+    last_ts = last2[0]
+    last_actual = last2[1]
     key_max = jnp.where(created.any() & ok,
                         jnp.maximum(state["xfer_key_max"], last_actual),
                         state["xfer_key_max"])
@@ -2360,22 +2480,26 @@ def create_accounts_fast(state, ev, timestamp, n, imported_mode=False):
     e2 = _dup_keys(ev["id_hi"], ev["id_lo"], tag)
     fallback_pre = e1 | e2
 
+    # ONE meta gather: the 32-bit fields unpack from the u64 tail
+    # columns (ev_layout.AC_P32).
     g64 = acc["u64"][e_rowc]
-    g32 = acc["u32"][e_rowc]
-    AU, AV = AC_U64_IDX, AC_U32_IDX
+    AU = AC_U64_IDX
+    g_ul = g64[:, _AC_UL_COL]
+    g_cf = g64[:, _AC_CF_COL]
+    g_flags = (g_cf >> jnp.uint64(32)).astype(jnp.uint32)
     exists_checks = [
-        ((flags & 0xFFFF) != (g32[:, AV["flags"]] & 0xFFFF),
+        ((flags & 0xFFFF) != (g_flags & 0xFFFF),
          _AS["exists_with_different_flags"]),
         (~u128.eq(ev["ud128_hi"], ev["ud128_lo"],
                   g64[:, AU["ud128_hi"]], g64[:, AU["ud128_lo"]]),
          _AS["exists_with_different_user_data_128"]),
         (ev["ud64"] != g64[:, AU["ud64"]],
          _AS["exists_with_different_user_data_64"]),
-        (ev["ud32"] != g32[:, AV["ud32"]],
+        (ev["ud32"] != (g_ul & _M32).astype(jnp.uint32),
          _AS["exists_with_different_user_data_32"]),
-        (ev["ledger"] != g32[:, AV["ledger"]],
+        (ev["ledger"] != (g_ul >> jnp.uint64(32)).astype(jnp.uint32),
          _AS["exists_with_different_ledger"]),
-        (ev["code"] != g32[:, AV["code"]],
+        (ev["code"] != (g_cf & _M32).astype(jnp.uint32),
          _AS["exists_with_different_code"]),
     ]
     exists_status = _first_failure(exists_checks, created=_AS["exists"])
@@ -2401,10 +2525,13 @@ def create_accounts_fast(state, ev, timestamp, n, imported_mode=False):
         # key_max plus collision with any existing TRANSFER timestamp
         # (sorted-column membership; the in-batch component is the
         # maxima chain below).
+        # method='sort', not the while-lowering default (see
+        # imported_batch_ctx).
         xfer_ts_sorted = jnp.sort(
             state["transfers"]["u64"][:, XF_U64_IDX["ts"]])
-        pos = jnp.minimum(jnp.searchsorted(xfer_ts_sorted, ev["ts"]),
-                          xfer_ts_sorted.shape[0] - 1)
+        pos = jnp.minimum(
+            jnp.searchsorted(xfer_ts_sorted, ev["ts"], method="sort"),
+            xfer_ts_sorted.shape[0] - 1)
         coll = (xfer_ts_sorted[pos] == ev["ts"]) & (ev["ts"] != 0)
         regress = imported & (
             (ev["ts"] <= state["acct_key_max"]) | coll)
@@ -2492,28 +2619,27 @@ def create_accounts_fast(state, ev, timestamp, n, imported_mode=False):
     arow = jnp.where(ap, new_rows, A_dump)
 
     z64 = jnp.uint64(0)
-    # Packed row inserts: one scatter per matrix; masked lanes write
-    # uniform zero rows to the dump slot (scatter determinism).
-    # Stored timestamp: the ACTUAL one (imported created accounts keep
-    # their user timestamp; == ts_event otherwise).
+    # Packed row insert: ONE meta scatter (32-bit fields pair-packed in
+    # the u64 tail — ev_layout.AC_P32) + the balance-zero scatter;
+    # masked lanes write uniform zero rows to the dump slot (scatter
+    # determinism). Stored timestamp: the ACTUAL one (imported created
+    # accounts keep their user timestamp; == ts_event otherwise).
     ts_store = ts_actual if imported_mode else ts_event
-    u64_vals = {AU["id_hi"]: ev["id_hi"], AU["id_lo"]: ev["id_lo"],
-                AU["ud128_hi"]: ev["ud128_hi"],
-                AU["ud128_lo"]: ev["ud128_lo"],
-                AU["ud64"]: ev["ud64"], AU["ts"]: ts_store}
-    u32_vals = {AV["ud32"]: ev["ud32"], AV["ledger"]: ev["ledger"],
-                AV["code"]: ev["code"], AV["flags"]: flags}
+    named_vals = {"id_hi": ev["id_hi"], "id_lo": ev["id_lo"],
+                  "ud128_hi": ev["ud128_hi"], "ud128_lo": ev["ud128_lo"],
+                  "ud64": ev["ud64"], "ts": ts_store,
+                  "ud32": ev["ud32"], "ledger": ev["ledger"],
+                  "code": ev["code"], "flags": flags}
+    u64_rows_a = jnp.stack(
+        [named_vals[n] for n in AC_U64]
+        + [pack32(named_vals[pr[0]],
+                  named_vals[pr[1]] if len(pr) > 1 else None)
+           for pr in AC_P32],
+        axis=1)
     apn = ap[:, None]
     new_acc = dict(acc)
-    new_acc["u64"] = acc["u64"].at[arow].set(jnp.where(
-        apn,
-        jnp.stack([u64_vals[i] for i in range(len(AC_U64_IDX))], axis=1),
-        z64))
-    new_acc["u32"] = acc["u32"].at[arow].set(jnp.where(
-        apn,
-        jnp.stack([u32_vals[i].astype(jnp.uint32)
-                   for i in range(len(AC_U32_IDX))], axis=1),
-        jnp.uint32(0)))
+    new_acc["u64"] = acc["u64"].at[arow].set(
+        jnp.where(apn, u64_rows_a, z64))
     new_acc["bal"] = acc["bal"].at[arow].set(
         jnp.zeros((N, 16), dtype=jnp.uint64))
     new_acc["count"] = acc["count"] + jnp.where(ok, n_created, 0)

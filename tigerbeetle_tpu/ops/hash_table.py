@@ -138,12 +138,11 @@ def _rank_within(bucket, active, n):
     pos = jnp.arange(n, dtype=jnp.int32)
     # associative_scan, not jnp.cumsum: cumsum lowers to reduce-window on
     # TPU, whose scoped-vmem footprint blows the v5e budget (see the
-    # fast-kernels _cumsum note).
-    seg_id = jax.lax.associative_scan(
-        jnp.add, is_start.astype(jnp.int32)) - 1
-    seg_start = jax.ops.segment_min(
-        jnp.where(is_start, pos, jnp.int32(n)), seg_id,
-        num_segments=n)[seg_id]
+    # fast-kernels _cumsum note). Per-entry segment start = forward-fill
+    # of start positions with ONE running max (start positions increase)
+    # — not a segment reduce + gather (op budget).
+    seg_start = jax.lax.associative_scan(
+        jnp.maximum, jnp.where(is_start, pos, jnp.int32(-1)))
     rank_sorted = pos - seg_start
     rank = jnp.zeros(n, dtype=jnp.int32).at[order].set(rank_sorted)
     return jnp.where(active, rank, jnp.int32(0))

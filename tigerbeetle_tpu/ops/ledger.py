@@ -65,43 +65,49 @@ def _pad_bucket(n: int) -> int:
     raise AssertionError(f"batch of {n} exceeds BATCH_MAX padding")
 
 from .ev_layout import (  # noqa: F401 — re-exported ring layout
+    AC_NCOLS,
+    AC_P32_POS,
     AC_U32,
-    AC_U32_IDX,
     AC_U64,
     AC_U64_IDX,
     BAL_FIELDS,
     BAL_IDX,
     ac_named,
-    EV_I32,
-    EV_I32_IDX,
-    EV_U32,
-    EV_U32_IDX,
+    EV_NCOLS,
+    EV_P32_POS,
     EV_U64,
     EV_U64_IDX,
-    XF_I32,
-    XF_I32_IDX,
-    XF_U32,
-    XF_U32_IDX,
+    XF_NCOLS,
+    XF_P32_POS,
     XF_U64,
     XF_U64_IDX,
     bal_col,
     ev_cap,
     ev_col,
     ev_named,
+    pack32,
     xf_col,
     xf_named,
 )
 
 
 
+def _set32(mat: np.ndarray, pos: dict, name: str, vals) -> None:
+    """Write a 32-bit logical column into its packed u64 half (host
+    builder counterpart of ev_layout's *_col readers)."""
+    col, half = pos[name]
+    v = np.asarray(vals).astype(np.uint32).astype(np.uint64)
+    mat[:, col] |= (v << np.uint64(32)) if half else v
+
+
 def _pack_transfer_rows(objs, pstat_of, acct_row_of, a_dump):
-    """Transfer objects -> packed row matrices (shared by the full rebuild
-    and the incremental dirty push, so the two paths cannot drift)."""
+    """Transfer objects -> one packed u64 row matrix (shared by the full
+    rebuild and the incremental dirty push, so the two paths cannot
+    drift)."""
     n = len(objs)
-    u64m = np.zeros((n, len(XF_U64)), dtype=np.uint64)
-    u32m = np.zeros((n, len(XF_U32)), dtype=np.uint32)
-    i32m = np.zeros((n, len(XF_I32)), dtype=np.int32)
-    U, V, I = XF_U64_IDX, XF_U32_IDX, XF_I32_IDX
+    u64m = np.zeros((n, XF_NCOLS), dtype=np.uint64)
+    w32 = {name: np.zeros(n, dtype=np.int64) for name in XF_P32_POS}
+    U = XF_U64_IDX
     for i, o in enumerate(objs):
         u64m[i, U["id_hi"]], u64m[i, U["id_lo"]] = _split(o.id)
         (u64m[i, U["dr_hi"]],
@@ -116,15 +122,17 @@ def _pack_transfer_rows(objs, pstat_of, acct_row_of, a_dump):
         u64m[i, U["ts"]] = o.timestamp
         u64m[i, U["expires"]] = (
             o.timestamp + o.timeout * NS_PER_S if o.timeout else 0)
-        u32m[i, V["ud32"]] = o.user_data_32
-        u32m[i, V["timeout"]] = o.timeout
-        u32m[i, V["ledger"]] = o.ledger
-        u32m[i, V["code"]] = o.code
-        u32m[i, V["flags"]] = o.flags
-        i32m[i, I["pstat"]] = pstat_of(o)
-        i32m[i, I["dr_row"]] = acct_row_of(o.debit_account_id, a_dump)
-        i32m[i, I["cr_row"]] = acct_row_of(o.credit_account_id, a_dump)
-    return u64m, u32m, i32m
+        w32["ud32"][i] = o.user_data_32
+        w32["timeout"][i] = o.timeout
+        w32["ledger"][i] = o.ledger
+        w32["code"][i] = o.code
+        w32["flags"][i] = o.flags
+        w32["pstat"][i] = pstat_of(o)
+        w32["dr_row"][i] = acct_row_of(o.debit_account_id, a_dump)
+        w32["cr_row"][i] = acct_row_of(o.credit_account_id, a_dump)
+    for name, vals in w32.items():
+        _set32(u64m, XF_P32_POS, name, vals)
+    return u64m
 
 
 def _scatter_cols(table, rows, cols):
@@ -173,23 +181,21 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         e_cap = t_cap  # one history row per created transfer (+ expiries)
 
     def rows_accounts():
-        # Packed per-dtype (see ev_layout.AC_*): row appends are three
-        # scatters; row gathers are three gathers (meta x2 + balances).
+        # One packed u64 matrix (32-bit meta pair-packed into the tail
+        # columns, see ev_layout.AC_P32): row appends/gathers are two
+        # ops (meta + balances), not three.
         return dict(
-            u64=jnp.zeros((a_cap + 1, len(AC_U64)), jnp.uint64),
-            u32=jnp.zeros((a_cap + 1, len(AC_U32)), jnp.uint32),
+            u64=jnp.zeros((a_cap + 1, AC_NCOLS), jnp.uint64),
             # Packed balances: (rows, 16) u64 — see ev_layout.BAL_FIELDS.
             bal=jnp.zeros((a_cap + 1, 16), jnp.uint64),
             count=jnp.int32(0),
         )
 
     def rows_transfers():
-        # Packed per-dtype (see ev_layout.XF_*): row appends are three
-        # scatters; row gathers are three gathers.
+        # One packed u64 matrix (see ev_layout.XF_P32): row appends and
+        # row-set gathers are ONE op each.
         return dict(
-            u64=jnp.zeros((t_cap + 1, len(XF_U64)), jnp.uint64),
-            u32=jnp.zeros((t_cap + 1, len(XF_U32)), jnp.uint32),
-            i32=jnp.zeros((t_cap + 1, len(XF_I32)), jnp.int32),
+            u64=jnp.zeros((t_cap + 1, XF_NCOLS), jnp.uint64),
             count=jnp.int32(0),
         )
 
@@ -197,16 +203,16 @@ def init_state(a_cap: int = 1 << 17, t_cap: int = 1 << 21,
         # The account_events history ring (reference: the account_events
         # groove, src/state_machine.zig:104-220): per created transfer,
         # POST-application u128 balance snapshots of both touched accounts,
-        # computed exactly in-kernel via segmented prefix sums. Packed
-        # per-dtype (see EV_U64/EV_I32/EV_U32) so appends are row scatters.
-        i32 = np.zeros((e_cap + 1, len(EV_I32)), dtype=np.int32)
-        i32[:, EV_I32_IDX["p_row"]] = -1
-        u32 = np.zeros((e_cap + 1, len(EV_U32)), dtype=np.uint32)
-        u32[:, EV_U32_IDX["tflags"]] = 0xFFFFFFFF
+        # computed exactly in-kernel via segmented prefix sums. One
+        # packed u64 matrix (see ev_layout.EV_P32) so an append is ONE
+        # row scatter.
+        u64 = np.zeros((e_cap + 1, EV_NCOLS), dtype=np.uint64)
+        _set32(u64, EV_P32_POS, "p_row",
+               np.full(e_cap + 1, -1, dtype=np.int64))
+        _set32(u64, EV_P32_POS, "tflags",
+               np.full(e_cap + 1, 0xFFFFFFFF, dtype=np.int64))
         return dict(
-            u64=jnp.zeros((e_cap + 1, len(EV_U64)), jnp.uint64),
-            i32=jnp.asarray(i32),
-            u32=jnp.asarray(u32),
+            u64=jnp.asarray(u64),
             count=jnp.int32(0),
         )
 
@@ -1683,7 +1689,9 @@ class DeviceLedger:
         assert len(accounts) <= self.a_cap and len(sm.transfers) <= self.t_cap
         acc = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["accounts"].items()}
-        AU, AV = AC_U64_IDX, AC_U32_IDX
+        AU = AC_U64_IDX
+        aw32 = {name: np.zeros(len(accounts), dtype=np.int64)
+                for name in AC_P32_POS}
         for r, a in enumerate(accounts):
             (acc["u64"][r, AU["id_hi"]],
              acc["u64"][r, AU["id_lo"]]) = _split(a.id)
@@ -1695,10 +1703,14 @@ class DeviceLedger:
              acc["u64"][r, AU["ud128_lo"]]) = _split(a.user_data_128)
             acc["u64"][r, AU["ud64"]] = a.user_data_64
             acc["u64"][r, AU["ts"]] = a.timestamp
-            acc["u32"][r, AV["ud32"]] = a.user_data_32
-            acc["u32"][r, AV["ledger"]] = a.ledger
-            acc["u32"][r, AV["code"]] = a.code
-            acc["u32"][r, AV["flags"]] = a.flags
+            aw32["ud32"][r] = a.user_data_32
+            aw32["ledger"][r] = a.ledger
+            aw32["code"][r] = a.code
+            aw32["flags"][r] = a.flags
+        n_a_rows = len(accounts)
+        acc["u64"][:n_a_rows, len(AC_U64):] = 0
+        for name, vals in aw32.items():
+            _set32(acc["u64"][:n_a_rows], AC_P32_POS, name, vals)
         acc["count"] = np.int32(len(accounts))
         st["accounts"] = {k: jnp.asarray(v) for k, v in acc.items()}
 
@@ -1713,7 +1725,7 @@ class DeviceLedger:
                      for tid in sm.transfer_by_timestamp.values()]
         xfr = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["transfers"].items()}
-        u64m, u32m, i32m = _pack_transfer_rows(
+        u64m = _pack_transfer_rows(
             transfers,
             lambda o: int(sm.pending_status.get(
                 o.timestamp, TransferPendingStatus.none)),
@@ -1721,8 +1733,6 @@ class DeviceLedger:
             self.a_cap)
         n_t = len(transfers)
         xfr["u64"][:n_t] = u64m
-        xfr["u32"][:n_t] = u32m
-        xfr["i32"][:n_t] = i32m
         xfr["count"] = np.int32(len(transfers))
         st["transfers"] = {k: jnp.asarray(v) for k, v in xfr.items()}
         st["xfer_ht"] = batch_insert(
@@ -1808,34 +1818,35 @@ class DeviceLedger:
         return self.mirror
 
     def _event_cols(self, records: list) -> dict:
-        """Host AccountEventRecords -> packed ring row matrices
+        """Host AccountEventRecords -> the packed ring row matrix
         (push/from_host)."""
         n = len(records)
-        u64 = np.zeros((n, len(EV_U64)), dtype=np.uint64)
-        i32 = np.zeros((n, len(EV_I32)), dtype=np.int32)
-        u32 = np.zeros((n, len(EV_U32)), dtype=np.uint32)
-        U, I, V = EV_U64_IDX, EV_I32_IDX, EV_U32_IDX
+        u64 = np.zeros((n, EV_NCOLS), dtype=np.uint64)
+        w32 = {name: np.zeros(n, dtype=np.int64) for name in EV_P32_POS}
+        U = EV_U64_IDX
         for i, rec in enumerate(records):
             u64[i, U["ts"]] = rec.timestamp
             u64[i, U["amt_hi"]], u64[i, U["amt_lo"]] = _split(rec.amount)
             u64[i, U["areq_hi"]], u64[i, U["areq_lo"]] = _split(
                 rec.amount_requested)
-            u32[i, V["tflags"]] = (0xFFFFFFFF if rec.transfer_flags is None
-                                   else rec.transfer_flags)
-            i32[i, I["pstat"]] = int(rec.transfer_pending_status)
-            i32[i, I["p_row"]] = (
+            w32["tflags"][i] = (0xFFFFFFFF if rec.transfer_flags is None
+                                else rec.transfer_flags)
+            w32["pstat"][i] = int(rec.transfer_pending_status)
+            w32["p_row"][i] = (
                 self._xfer_row[rec.transfer_pending.id]
                 if rec.transfer_pending is not None else -1)
             for side, a in (("dr", rec.dr_account), ("cr", rec.cr_account)):
-                i32[i, I[f"{side}_row"]] = self._acct_row[a.id]
-                u32[i, V[f"{side}_flags"]] = a.flags
+                w32[f"{side}_row"][i] = self._acct_row[a.id]
+                w32[f"{side}_flags"][i] = a.flags
                 for f, val in (("dp", a.debits_pending),
                                ("dpos", a.debits_posted),
                                ("cp", a.credits_pending),
                                ("cpos", a.credits_posted)):
                     (u64[i, U[f"{side}_{f}_hi"]],
                      u64[i, U[f"{side}_{f}_lo"]]) = _split(val)
-        return {"u64": u64, "i32": i32, "u32": u32}
+        for name, vals in w32.items():
+            _set32(u64, EV_P32_POS, name, vals)
+        return {"u64": u64}
 
 
 
@@ -2408,9 +2419,10 @@ class DeviceLedger:
             objs = [sm.accounts[a] for a in dirty_accounts]
             n = len(objs)
             bal = np.zeros((n, 16), dtype=np.uint64)
-            u64m = np.zeros((n, len(AC_U64)), dtype=np.uint64)
-            u32m = np.zeros((n, len(AC_U32)), dtype=np.uint32)
-            AU, AV = AC_U64_IDX, AC_U32_IDX
+            u64m = np.zeros((n, AC_NCOLS), dtype=np.uint64)
+            aw32 = {name: np.zeros(n, dtype=np.int64)
+                    for name in AC_P32_POS}
+            AU = AC_U64_IDX
             for i, o in enumerate(objs):
                 for f, val in (("dp", o.debits_pending),
                                ("dpos", o.debits_posted),
@@ -2423,11 +2435,13 @@ class DeviceLedger:
                  u64m[i, AU["ud128_lo"]]) = _split(o.user_data_128)
                 u64m[i, AU["ud64"]] = o.user_data_64
                 u64m[i, AU["ts"]] = o.timestamp
-                u32m[i, AV["ud32"]] = o.user_data_32
-                u32m[i, AV["ledger"]] = o.ledger
-                u32m[i, AV["code"]] = o.code
-                u32m[i, AV["flags"]] = o.flags
-            cols = {"bal": bal, "u64": u64m, "u32": u32m}
+                aw32["ud32"][i] = o.user_data_32
+                aw32["ledger"][i] = o.ledger
+                aw32["code"][i] = o.code
+                aw32["flags"][i] = o.flags
+            for name, vals in aw32.items():
+                _set32(u64m, AC_P32_POS, name, vals)
+            cols = {"bal": bal, "u64": u64m}
             count = jnp.int32(next_row)
             acc = st["accounts"] = scatter_cols(
                 {k: v for k, v in acc.items() if k != "count"},
@@ -2464,12 +2478,12 @@ class DeviceLedger:
             rows = np.array(rows, dtype=np.int32)
             rows_padded = pad(rows, self.t_cap)
             objs = [sm.transfers[t] for t in new_tids]
-            u64m, u32m, i32m = _pack_transfer_rows(
+            u64m = _pack_transfer_rows(
                 objs,
                 lambda o: int(sm.pending_status.get(o.timestamp, 0)),
                 lambda aid, dump: self._acct_row.get(aid, dump),
                 self.a_cap)
-            cols = {"u64": u64m, "u32": u32m, "i32": i32m}
+            cols = {"u64": u64m}
             count = jnp.int32(next_row)
             xfr = st["transfers"] = scatter_cols(
                 {k: v for k, v in xfr.items() if k != "count"},
@@ -2495,8 +2509,11 @@ class DeviceLedger:
             rows = pad(np.array([r for r, _ in flip], dtype=np.int32),
                        self.t_cap)
             vals = pad(np.array([v for _, v in flip], dtype=np.int32), 0)
-            xfr["i32"] = xfr["i32"].at[rows, XF_I32_IDX["pstat"]].set(
-                jnp.asarray(vals))
+            # pstat lives ALONE in its packed column (ev_layout.XF_P32),
+            # so the flip write cannot clobber a partner field.
+            xfr["u64"] = xfr["u64"].at[
+                rows, XF_P32_POS["pstat"][0]].set(
+                jnp.asarray(pack32(vals)))
         dirty_expiry = sorted(sm.expiry.dirty_dev)
         sm.expiry.dirty_dev.clear()
         exp = [(self._xfer_row[sm.transfer_by_timestamp[ts]],

@@ -177,7 +177,12 @@ def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch",
         return create_transfers_fast(state, ev, timestamp, n,
                                      per_event=pe, **_MODE_KWARGS[mode])
 
-    return jax.jit(step)
+    # Donate the replicated ledger buffers like every single-chip tier
+    # (jaxhound's donation audit checks the lowered artifact): callers
+    # consume the RETURNED state only — on fallback the masked writes
+    # leave it bit-identical, so the escalation/replay contract is
+    # unchanged. Platforms without donation support simply ignore it.
+    return jax.jit(step, donate_argnums=0)
 
 
 def shard_batch(mesh: Mesh, ev: dict, axis: str = "batch"):
