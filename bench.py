@@ -335,6 +335,18 @@ def inner_main() -> None:
         if serving_latency:
             emit("serving_batch_latency", serving_latency)
 
+    # Chaos/recovery counters (retries, backoff time, replayed windows,
+    # checksum epochs verified, recoveries by cause) per config — zeros
+    # in a healthy run, and MEASURED zeros: the ledger always carries
+    # the record (DeviceLedger.fallback_stats()["recovery"]), so a
+    # bench that ever exercises the serving supervisor reports its
+    # recoveries in the same record as its fallbacks.
+    recovery = {cfg: d.get("recovery")
+                for cfg, d in CONFIG_DIAGNOSTICS.items()
+                if isinstance(d, dict) and d.get("recovery") is not None}
+    if recovery:
+        emit("recovery_diagnostics", recovery)
+
     # Op-budget summary (light tier subset, pure tracing — no device
     # execution): the per-run record of the kernels' heavy-op footprint
     # on its own ##opbudget line; devhub renders it next to the
@@ -379,6 +391,9 @@ def inner_main() -> None:
         # Per-config routing/fallback counters (per-cause): the measured
         # "zero host fallbacks" record behind every number above.
         "fallback_diagnostics": dict(CONFIG_DIAGNOSTICS),
+        # Chaos/recovery counters next to the fallback record (zeros in
+        # a healthy run — and recorded, not assumed).
+        "recovery_diagnostics": recovery,
         # Heavy-op census of the kernels this run dispatched (see the
         # ##opbudget line / perf/opbudget.py).
         "opbudget": opbudget,

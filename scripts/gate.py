@@ -17,7 +17,10 @@ Tiers (markers documented in pytest.ini):
   soak   long randomized soaks; run when touching the matching
          subsystem, not per snapshot.
 
-The gate also runs the op-budget check + jaxhound serving-path lints
+The gate also runs the fixed CHAOS seed set (testing/chaos.py
+gate_main: seeded device-fault injection against the serving
+supervisor — zero-silent-corruption asserted per seed; skip with
+--no-chaos) and the op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
 (perf/opbudget_r06.json), bakes a >4 KiB closure constant into a
@@ -93,6 +96,29 @@ def run_opbudget(timeout: int = 900) -> int:
     return rc
 
 
+def run_chaos(timeout: int = 900) -> int:
+    """Fixed chaos seed set (CPU engine, small workloads): the serving
+    recovery path — verified epochs, bounded replay, retry/backoff,
+    shard-loss reroute — can never silently rot. One subprocess so the
+    seeds share jit caches; see testing/chaos.py gate_main/GATE_SEEDS.
+    Any undetected corruption or parity break is a RED."""
+    cmd = [sys.executable, "-c",
+           "import sys; from tigerbeetle_tpu.testing import chaos; "
+           "sys.exit(chaos.gate_main())"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] chaos: fixed seed set (testing/chaos.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: chaos timed out after {timeout}s", flush=True)
+        return 124
+    print(f"[gate] chaos rc={rc} in {time.time() - t0:.0f}s", flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -115,6 +141,9 @@ def main() -> int:
                     help="skip the 8-device SPMD dryrun")
     ap.add_argument("--no-opbudget", action="store_true",
                     help="skip the op-budget check + jaxhound lints")
+    ap.add_argument("--no-chaos", action="store_true",
+                    help="skip the fixed chaos seed set (serving "
+                         "recovery path)")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -128,6 +157,10 @@ def main() -> int:
         rc = run_opbudget()
         if rc != 0:
             reds.append(f"opbudget rc={rc}")
+    if not args.no_chaos:
+        rc = run_chaos()
+        if rc != 0:
+            reds.append(f"chaos rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

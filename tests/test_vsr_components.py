@@ -371,6 +371,58 @@ class TestFaultDetector:
         assert fd.deadline_ns() == 4 * fd.ceil_ns
         assert not fd.suspect(t + 1000 * MS)
 
+    def test_ewma_clamps_at_floor(self):
+        # A burst of sub-floor intervals must not drive the expectation
+        # below floor_ns (a hyperactive primary would otherwise set an
+        # unmeetably tight deadline for its successor intervals).
+        fd = FaultDetector(floor_ns=50 * MS, suspect_multiplier=4.0)
+        t = 0
+        for _ in range(200):
+            t += 1 * MS  # far below the floor
+            fd.observe_progress(t)
+        assert fd.ewma_ns == float(fd.floor_ns)
+        assert fd.deadline_ns() == int(4.0 * fd.floor_ns)
+
+    def test_ewma_clamps_at_ceil(self):
+        # Huge gaps (e.g. across a partition heal) must not inflate the
+        # expectation past ceil_ns — the detector has to stay able to
+        # suspect a primary within a bounded horizon.
+        fd = FaultDetector(ceil_ns=1000 * MS, suspect_multiplier=4.0)
+        t = 0
+        for _ in range(10):
+            t += 60_000 * MS
+            fd.observe_progress(t)
+        assert fd.ewma_ns == float(fd.ceil_ns)
+        assert fd.suspect(t + 4001 * MS)
+
+    def test_reset_after_view_change_starts_fresh(self):
+        # A view change installs a new primary: the OLD primary's
+        # observed rate must not carry over — the new one gets the full
+        # ceiling-based grace period, and the first post-reset interval
+        # re-seeds the estimate from scratch.
+        fd = FaultDetector(suspect_multiplier=4.0)
+        t = 0
+        for _ in range(100):
+            t += 10 * MS  # old primary was fast
+            fd.observe_progress(t)
+        tight = fd.deadline_ns()
+        fd.reset(t)
+        assert fd.ewma_ns == float(fd.ceil_ns)
+        assert fd.last_progress_ns == t
+        assert fd.deadline_ns() > tight
+        # The new primary progressing slowly is NOT suspect inside the
+        # restored generous deadline.
+        assert not fd.suspect(t + 3000 * MS)
+
+    def test_no_suspicion_before_first_progress(self):
+        # Before ANY observed progress there is no baseline to be late
+        # against (startup: the replica must not instantly escalate to
+        # a view change on a cold clock).
+        fd = FaultDetector()
+        assert not fd.suspect(10 ** 18)
+        fd.observe_progress(10 ** 18)
+        assert not fd.suspect(10 ** 18 + 1)
+
 
 class TestRepairBudget:
     def test_spend_and_refill(self):
@@ -384,6 +436,37 @@ class TestRepairBudget:
         t2 = t + 50 * MS + 4 * 50 * MS
         rb.refill(t2)
         assert rb.tokens == 4  # capped at capacity
+
+    def test_first_refill_only_anchors_the_clock(self):
+        # The first refill observation sets last_refill_ns without
+        # granting tokens for the (undefined) interval before it.
+        rb = RepairBudget(capacity=2, refill_interval_ns=50 * MS)
+        for _ in range(2):
+            assert rb.spend(10 ** 15)  # spends anchor the clock too
+        assert not rb.spend(10 ** 15)
+        # Elapsed time counts from the ANCHOR, not from zero.
+        assert not rb.spend(10 ** 15 + 49 * MS)
+        assert rb.spend(10 ** 15 + 50 * MS)
+
+    def test_multi_token_spend_is_all_or_nothing(self):
+        rb = RepairBudget(capacity=4, refill_interval_ns=50 * MS)
+        t = 10 ** 9
+        assert rb.spend(t, amount=3)
+        # One token left: a 2-token request must not partially deduct.
+        assert not rb.spend(t, amount=2)
+        assert rb.tokens == 1
+        assert rb.spend(t, amount=1)
+
+    def test_partial_interval_earns_nothing(self):
+        rb = RepairBudget(capacity=1, refill_interval_ns=50 * MS)
+        t = 10 ** 9
+        assert rb.spend(t)
+        assert not rb.spend(t + 49 * MS)
+        # last_refill_ns advances by WHOLE intervals only, so fractional
+        # progress accumulates instead of being lost.
+        assert rb.spend(t + 50 * MS)
+        assert not rb.spend(t + 99 * MS)
+        assert rb.spend(t + 100 * MS)
 
 
 class TestGridScrubber:

@@ -209,6 +209,55 @@ def render(history_path: str, out_path: str,
             + "<table><tr><th>config</th><th>host fallbacks</th>"
               "<th>escalations</th><th>causes</th></tr>"
             + "".join(rows_fb) + "</table>")
+    # Recovery panel (next to the fallback diagnostics): the newest
+    # run's chaos/recovery counters — retries, backoff, replayed
+    # windows, verified checksum epochs, recoveries by cause. A nonzero
+    # recovery or checksum mismatch in a bench run means the serving
+    # pipeline quarantined device state mid-run: rendered as loudly as
+    # a host fallback.
+    rec_html = ""
+    rec = next((e.get("recovery_diagnostics")
+                for e in reversed(entries)
+                if isinstance(e.get("recovery_diagnostics"), dict)
+                and e.get("recovery_diagnostics")), None)
+    if rec is None:
+        fbd = next((e.get("fallback_diagnostics")
+                    for e in reversed(entries)
+                    if isinstance(e.get("fallback_diagnostics"), dict)),
+                   None) or {}
+        rec = {cfg: d.get("recovery") for cfg, d in fbd.items()
+               if isinstance(d, dict)
+               and isinstance(d.get("recovery"), dict)}
+    if rec:
+        rows_rec = []
+        any_rec = False
+        for cfg in sorted(rec):
+            d = rec[cfg] or {}
+            causes = d.get("recoveries") or {}
+            n_rec = sum(causes.values()) if causes else 0
+            mism = d.get("checksum_mismatches", 0) or 0
+            any_rec = any_rec or n_rec > 0 or mism > 0
+            cause_txt = ", ".join(
+                f"{k}={v}" for k, v in sorted(causes.items())) or "-"
+            rows_rec.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td><td>{}</td></tr>".format(
+                    html.escape(cfg), d.get("retries", 0) or 0,
+                    d.get("backoff_s", 0) or 0,
+                    d.get("replayed_windows", 0) or 0,
+                    d.get("epochs_verified", 0) or 0, mism,
+                    html.escape(cause_txt)))
+        badge_rec = ("" if not any_rec else
+                     '<p style="color:#c22;font-weight:700">RECOVERIES '
+                     'RECORDED — device state was quarantined and '
+                     'replayed</p>')
+        rec_html = (
+            "<h2>recovery / verified epochs (latest run)</h2>" + badge_rec
+            + "<table><tr><th>config</th><th>retries</th>"
+              "<th>backoff s</th><th>replayed windows</th>"
+              "<th>epochs verified</th><th>checksum mismatches</th>"
+              "<th>recoveries by cause</th></tr>"
+            + "".join(rows_rec) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
     # ceilings (perf/opbudget_r06.json) — compile-footprint regressions
@@ -296,6 +345,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {''.join(rows)}
 </table>
 {fb_html}
+{rec_html}
 {ob_html}
 {cfo_html}
 </body></html>"""

@@ -518,8 +518,13 @@ def cmd_cfo(args) -> int:
     import time as _time
 
     from .testing import fuzz
+    from .testing.chaos import run_chaos_seed
     from .testing.vopr import run_swarm_seed
 
+    if args.kind == "chaos" and args.seed is not None and not args.max_runs:
+        # `cfo --kind chaos --seed S` IS the documented reproduction
+        # command for a failing chaos seed: one run of exactly S.
+        args.max_runs = 1
     rng = (_random.Random(args.seed) if args.seed is not None
            else _random.SystemRandom())
     deadline = (_time.monotonic() + args.budget_s) if args.budget_s else None
@@ -530,21 +535,24 @@ def cmd_cfo(args) -> int:
     runs = failures = 0
     try:
         while deadline is None or _time.monotonic() < deadline:
-            if args.kind == "fuzz":
-                kind = "fuzz"
-            elif args.kind == "vopr":
-                kind = "vopr"
+            if args.kind in ("fuzz", "vopr", "chaos"):
+                kind = args.kind
             else:
                 # Mix: the cluster seeds are the expensive, high-yield
-                # side; keep them a steady ~1/3 of the stream.
-                kind = "vopr" if rng.random() < (1 / 3) else "fuzz"
+                # side; keep them a steady ~1/3 of the stream, with the
+                # serving-chaos seeds a further ~1/6.
+                roll = rng.random()
+                kind = ("vopr" if roll < (1 / 3)
+                        else "chaos" if roll < (1 / 2) else "fuzz")
             seed = (args.seed if args.seed is not None
                     and args.max_runs == 1 else rng.randrange(1 << 30))
-            name = kind if kind == "vopr" else rng.choice(names)
-            key = kind if kind == "vopr" else f"fuzz:{name}"
+            name = kind if kind != "fuzz" else rng.choice(names)
+            key = kind if kind != "fuzz" else f"fuzz:{name}"
             try:
                 if kind == "vopr":
                     run_swarm_seed(seed)
+                elif kind == "chaos":
+                    run_chaos_seed(seed)
                 else:
                     fuzz.run(name, seed)
                 runs += 1
@@ -556,6 +564,8 @@ def cmd_cfo(args) -> int:
                 repro = (
                     f"python -m tigerbeetle_tpu cfo --kind vopr "
                     f"--seed {seed} --max-runs 1" if kind == "vopr"
+                    else f"python -m tigerbeetle_tpu cfo --kind chaos "
+                    f"--seed {seed}" if kind == "chaos"
                     else f"python -m tigerbeetle_tpu fuzz {name} {seed}")
                 failing.append({"kind": kind, "name": name, "seed": seed,
                                 "error": repr(e)[:300],
@@ -724,10 +734,13 @@ def main(argv=None) -> int:
     p.add_argument("--budget-s", type=float, default=0,
                    help="stop after this many seconds (0 = run forever)")
     p.add_argument("--max-runs", type=int, default=0)
-    p.add_argument("--kind", choices=["mix", "fuzz", "vopr"],
+    p.add_argument("--kind", choices=["mix", "fuzz", "vopr", "chaos"],
                    default="mix",
                    help="mix (default): fuzzer registry + VOPR cluster "
-                        "swarm interleaved; or one side only")
+                        "swarm + serving-chaos seeds interleaved; or "
+                        "one side only (chaos = seeded device-fault "
+                        "injection against the serving supervisor, "
+                        "testing/chaos.py)")
     p.add_argument("--failures-file", default=None,
                    help="append failing (fuzzer, seed) pairs here")
     p.add_argument("--artifact", default=None,

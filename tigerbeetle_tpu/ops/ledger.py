@@ -135,6 +135,42 @@ def _pack_transfer_rows(objs, pstat_of, acct_row_of, a_dump):
     return u64m
 
 
+def _pack_account_rows(objs):
+    """Account objects -> (packed u64 row matrix, balance-limb matrix)
+    (shared by the full rebuild, the dirty push, and the epoch digest's
+    expected pack, so the three paths cannot drift)."""
+    n = len(objs)
+    u64m = np.zeros((n, AC_NCOLS), dtype=np.uint64)
+    bal = np.zeros((n, 16), dtype=np.uint64)
+    aw32 = {name: np.zeros(n, dtype=np.int64) for name in AC_P32_POS}
+    AU = AC_U64_IDX
+    for i, o in enumerate(objs):
+        u64m[i, AU["id_hi"]], u64m[i, AU["id_lo"]] = _split(o.id)
+        for f, val in (("dp", o.debits_pending), ("dpos", o.debits_posted),
+                       ("cp", o.credits_pending),
+                       ("cpos", o.credits_posted)):
+            for j, lim in enumerate(_limbs4(val)):
+                bal[i, bal_col(f, j)] = lim
+        (u64m[i, AU["ud128_hi"]],
+         u64m[i, AU["ud128_lo"]]) = _split(o.user_data_128)
+        u64m[i, AU["ud64"]] = o.user_data_64
+        u64m[i, AU["ts"]] = o.timestamp
+        aw32["ud32"][i] = o.user_data_32
+        aw32["ledger"][i] = o.ledger
+        aw32["code"][i] = o.code
+        aw32["flags"][i] = o.flags
+    for name, vals in aw32.items():
+        _set32(u64m, AC_P32_POS, name, vals)
+    return u64m, bal
+
+
+class MirrorDivergence(AssertionError):
+    """VERIFY spot-check failure: a device-resident row disagrees with
+    the host mirror. Subclasses AssertionError (existing fail-loudly
+    consumers keep working); the serving supervisor catches it
+    specifically and routes to bounded replay recovery."""
+
+
 def _scatter_cols(table, rows, cols):
     """Jitted fused row-scatter: one dispatch per push instead of one per
     column (the mirror regime's hot edge)."""
@@ -764,6 +800,14 @@ def _window_has_pend_refs(ev_s: dict) -> bool:
     return bool((counts > 1).any())
 
 
+def default_recovery_stats() -> dict:
+    """The zero-valued recovery-counter record every ledger carries (the
+    serving supervisor swaps in its live dict; see fallback_stats)."""
+    return {"retries": 0, "backoff_s": 0.0, "replayed_windows": 0,
+            "epochs_verified": 0, "checksum_mismatches": 0,
+            "recoveries": {}}
+
+
 class DeviceLedger:
     """Stateful wrapper: owns the device pytree + fallback orchestration."""
 
@@ -801,6 +845,15 @@ class DeviceLedger:
         # "why did we leave the device" record surfaced through
         # bench.py diagnostics and devhub.py.
         self.fallback_causes: dict = {}
+        # Monotone per-batch op sequence: every captured write-through
+        # chunk carries the op number it belongs to, so a VERIFY spot
+        # divergence can name which batch produced the bad rows.
+        self._op_seq = 0
+        # Recovery counters (serving.py's ServingSupervisor replaces
+        # this dict with its live one when it adopts the ledger): zeros
+        # here so fallback_stats() always carries the recovery record —
+        # "no recoveries" is a measured number in every bench run.
+        self.recovery_stats: dict = default_recovery_stats()
         self._deep_first = 0
         self._bal_deep_first = 0
         # Adaptive kernel routing: after a batch resolves breaches via the
@@ -1095,6 +1148,8 @@ class DeviceLedger:
                                        eager_copy=False)
         off = 0
         for b, (n_new, orphan_ids) in enumerate(per):
+            op_no = self._op_seq
+            self._op_seq += 1
             if n_new:
                 if tk.e_only:
                     # Host-reconstructed transfer/der columns (the
@@ -1110,7 +1165,8 @@ class DeviceLedger:
                 ec = _LazyCols(handle, "e", off, n_new)
                 self._track_pending_cols(tc, ec, derc)
                 self._mirror_chunks.append(
-                    (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
+                    (tc, ec, derc, handle.t0 + off, n_new, orphan_ids,
+                     op_no))
                 if self.retain_flush_columns:
                     self._flush_columns.append(
                         (tc, ec, derc, n_new, self._events_seen_abs,
@@ -1122,7 +1178,7 @@ class DeviceLedger:
             else:
                 if orphan_ids:
                     self._mirror_chunks.append(
-                        (None, None, None, 0, 0, orphan_ids))
+                        (None, None, None, 0, 0, orphan_ids, op_no))
                 if self.retain_flush_columns and (
                         orphan_ids or tk.all_or_nothing):
                     self._flush_columns.append(
@@ -1689,28 +1745,10 @@ class DeviceLedger:
         assert len(accounts) <= self.a_cap and len(sm.transfers) <= self.t_cap
         acc = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["accounts"].items()}
-        AU = AC_U64_IDX
-        aw32 = {name: np.zeros(len(accounts), dtype=np.int64)
-                for name in AC_P32_POS}
-        for r, a in enumerate(accounts):
-            (acc["u64"][r, AU["id_hi"]],
-             acc["u64"][r, AU["id_lo"]]) = _split(a.id)
-            for f, val in (("dp", a.debits_pending), ("dpos", a.debits_posted),
-                           ("cp", a.credits_pending), ("cpos", a.credits_posted)):
-                for j, lim in enumerate(_limbs4(val)):
-                    acc["bal"][r, bal_col(f, j)] = lim
-            (acc["u64"][r, AU["ud128_hi"]],
-             acc["u64"][r, AU["ud128_lo"]]) = _split(a.user_data_128)
-            acc["u64"][r, AU["ud64"]] = a.user_data_64
-            acc["u64"][r, AU["ts"]] = a.timestamp
-            aw32["ud32"][r] = a.user_data_32
-            aw32["ledger"][r] = a.ledger
-            aw32["code"][r] = a.code
-            aw32["flags"][r] = a.flags
         n_a_rows = len(accounts)
-        acc["u64"][:n_a_rows, len(AC_U64):] = 0
-        for name, vals in aw32.items():
-            _set32(acc["u64"][:n_a_rows], AC_P32_POS, name, vals)
+        a_u64, a_bal = _pack_account_rows(accounts)
+        acc["u64"][:n_a_rows] = a_u64
+        acc["bal"][:n_a_rows] = a_bal
         acc["count"] = np.int32(len(accounts))
         st["accounts"] = {k: jnp.asarray(v) for k, v in acc.items()}
 
@@ -1984,6 +2022,8 @@ class DeviceLedger:
             handle = fetch_start(total) if total else None
             off = 0
             for n_new, orphan_ids, ev_b, pack in group:
+                op_no = self._op_seq
+                self._op_seq += 1
                 if n_new:
                     # Lazy column views: the fetch resolves (exact-size
                     # copies, full buffer released) on first access —
@@ -1998,7 +2038,8 @@ class DeviceLedger:
                     ec = _LazyCols(handle, "e", off, n_new)
                     self._track_pending_cols(tc, ec, derc)
                     self._mirror_chunks.append(
-                        (tc, ec, derc, handle.t0 + off, n_new, orphan_ids))
+                        (tc, ec, derc, handle.t0 + off, n_new, orphan_ids,
+                         op_no))
                     if self.retain_flush_columns:
                         self._flush_columns.append(
                             (tc, ec, derc, n_new, self._events_seen_abs,
@@ -2010,7 +2051,7 @@ class DeviceLedger:
                 else:
                     if orphan_ids:
                         self._mirror_chunks.append(
-                            (None, None, None, 0, 0, orphan_ids))
+                            (None, None, None, 0, 0, orphan_ids, op_no))
                     if self.retain_flush_columns and (orphan_ids
                                                       or exact_chunks):
                         self._flush_columns.append(
@@ -2070,10 +2111,12 @@ class DeviceLedger:
         (the same lazy discipline as StateMachine._refresh_indexes;
         reference: commit is the cheap part, src/state_machine.zig:2564)."""
         n_new, orphan_ids = self._batch_delta_stats(ev, st_np)
+        op_no = self._op_seq
+        self._op_seq += 1
         if n_new == 0:
             if orphan_ids:
                 self._mirror_chunks.append((None, None, None, 0, 0,
-                                            orphan_ids))
+                                            orphan_ids, op_no))
                 if self.retain_flush_columns:
                     self._flush_columns.append(
                         (None, None, None, 0, self._events_seen_abs,
@@ -2085,7 +2128,8 @@ class DeviceLedger:
         e = _LazyCols(handle, "e", 0, n_new)
         der = _LazyCols(handle, "der", 0, n_new)
         self._track_pending_cols(t, e, der)
-        self._mirror_chunks.append((t, e, der, handle.t0, n_new, orphan_ids))
+        self._mirror_chunks.append((t, e, der, handle.t0, n_new, orphan_ids,
+                                    op_no))
         if self.retain_flush_columns:
             # The durable flusher consumes these columns directly (the
             # vectorized flush path) — retained at CAPTURE, so flushing
@@ -2121,7 +2165,7 @@ class DeviceLedger:
                         not c.loaded and c._handle is not None:
                     c._handle.start_copy()
                     break
-        for t, e, der, t0, n_new, orphan_ids in chunks:
+        for t, e, der, t0, n_new, orphan_ids, _op in chunks:
             for oid in orphan_ids:
                 self.mirror.orphaned.add(oid)
             if n_new:
@@ -2132,35 +2176,74 @@ class DeviceLedger:
         if constants.VERIFY:
             # Extra-check mode: spot-audit device rows against the just-
             # drained mirror (the write-through contract, fuzz_tests.zig
-            # :11-16 doctrine).
-            for t, e, der, t0, n_new, _ in reversed(chunks):
-                if n_new:
-                    k = min(2, n_new)
-                    xfer_ids = [u128.to_int(t["id_hi"][i], t["id_lo"][i])
-                                for i in range(k)]
-                    # Plus a STABLE anchor — the oldest transfer — so
-                    # drift on rows the batch never touched (stale
-                    # pending flips, bad pushes) is caught too.
-                    if self.mirror.transfers:
-                        xfer_ids.append(next(iter(self.mirror.transfers)))
-                    self._verify_mirror_spot(
-                        [u128.to_int(der["dr_id_hi"][i], der["dr_id_lo"][i])
-                         for i in range(k)],
-                        xfer_ids)
+            # :11-16 doctrine). Sampling is configurable via
+            # TB_VERIFY_SPOT_RATE: default audits 2 rows of the newest
+            # chunk; >=1.0 audits EVERY row of EVERY chunk (chaos runs
+            # crank it to 100% so "auditor-clean" is exhaustive).
+            import os as _os
+
+            try:
+                rate = float(
+                    _os.environ.get("TB_VERIFY_SPOT_RATE", "") or 0.0)
+            except ValueError:
+                rate = 0.0
+            checked = 0
+            for t, e, der, t0, n_new, _, op_no in reversed(chunks):
+                if not n_new:
+                    continue
+                k = n_new if rate >= 1.0 else min(2, n_new)
+                xfer_ids = [u128.to_int(t["id_hi"][i], t["id_lo"][i])
+                            for i in range(k)]
+                # Plus a STABLE anchor — the oldest transfer — so
+                # drift on rows the batch never touched (stale
+                # pending flips, bad pushes) is caught too.
+                if checked == 0 and self.mirror.transfers:
+                    xfer_ids.append(next(iter(self.mirror.transfers)))
+                self._verify_mirror_spot(
+                    [u128.to_int(der["dr_id_hi"][i], der["dr_id_lo"][i])
+                     for i in range(k)],
+                    xfer_ids,
+                    ctx=f"op {op_no}, device rows {t0}..{t0 + n_new}")
+                checked += 1
+                if rate < 1.0:
                     break
 
-    def _verify_mirror_spot(self, acct_ids: list, xfer_ids: list) -> None:
+    def _verify_mirror_spot(self, acct_ids: list, xfer_ids: list,
+                            ctx: str = "") -> None:
         """VERIFY check: device-resident rows and the host mirror must
-        agree object-for-object after a drain."""
+        agree object-for-object after a drain. A divergence raises
+        MirrorDivergence naming the op/prepare that produced the chunk
+        and every differing field — triageable straight from the log."""
+        import dataclasses as _dc
+
         sm = self.mirror
+        where = f" at {ctx}" if ctx else ""
+
+        def diff(got, want) -> str:
+            if got is None:
+                return "object missing on device"
+            if want is None:
+                return "object missing in mirror"
+            return "differing fields: " + ", ".join(
+                f"{f.name}(device={getattr(got, f.name)!r}, "
+                f"mirror={getattr(want, f.name)!r})"
+                for f in _dc.fields(got)
+                if getattr(got, f.name) != getattr(want, f.name))
+
         got_a = {a.id: a for a in self.lookup_accounts(acct_ids)}
         for aid in acct_ids:
-            assert got_a.get(aid) == sm.accounts.get(aid), \
-                f"verify: device/mirror divergence on account {aid}"
+            got, want = got_a.get(aid), sm.accounts.get(aid)
+            if got != want:
+                raise MirrorDivergence(
+                    f"verify: device/mirror divergence on account "
+                    f"{aid}{where}: {diff(got, want)}")
         got_t = {t.id: t for t in self.lookup_transfers(xfer_ids)}
         for tid in xfer_ids:
-            assert got_t.get(tid) == sm.transfers.get(tid), \
-                f"verify: device/mirror divergence on transfer {tid}"
+            got, want = got_t.get(tid), sm.transfers.get(tid)
+            if got != want:
+                raise MirrorDivergence(
+                    f"verify: device/mirror divergence on transfer "
+                    f"{tid}{where}: {diff(got, want)}")
 
     def take_flush_columns(self, count: int = None) -> list:
         """Pop the drained chunks' transfer columns (numpy) for the
@@ -2335,6 +2418,12 @@ class DeviceLedger:
             "deep_fixpoint_batches": self.deep_fixpoint_batches,
             "escalations": self.escalations,
             "causes": dict(self.fallback_causes),
+            # Chaos/recovery counters (zeros unless a ServingSupervisor
+            # owns this ledger): retries, backoff time, replayed
+            # windows, verified checksum epochs, recoveries by cause.
+            "recovery": {
+                k: (dict(v) if isinstance(v, dict) else v)
+                for k, v in self.recovery_stats.items()},
         }
 
     def _fallback_transfers(self, transfers, timestamp):
@@ -2417,30 +2506,7 @@ class DeviceLedger:
             rows = pad(np.array([self._acct_row[a] for a in dirty_accounts],
                            dtype=np.int32), self.a_cap)
             objs = [sm.accounts[a] for a in dirty_accounts]
-            n = len(objs)
-            bal = np.zeros((n, 16), dtype=np.uint64)
-            u64m = np.zeros((n, AC_NCOLS), dtype=np.uint64)
-            aw32 = {name: np.zeros(n, dtype=np.int64)
-                    for name in AC_P32_POS}
-            AU = AC_U64_IDX
-            for i, o in enumerate(objs):
-                for f, val in (("dp", o.debits_pending),
-                               ("dpos", o.debits_posted),
-                               ("cp", o.credits_pending),
-                               ("cpos", o.credits_posted)):
-                    for j in range(4):
-                        bal[i, bal_col(f, j)] = (val >> (32 * j)) & 0xFFFFFFFF
-                u64m[i, AU["id_hi"]], u64m[i, AU["id_lo"]] = _split(o.id)
-                (u64m[i, AU["ud128_hi"]],
-                 u64m[i, AU["ud128_lo"]]) = _split(o.user_data_128)
-                u64m[i, AU["ud64"]] = o.user_data_64
-                u64m[i, AU["ts"]] = o.timestamp
-                aw32["ud32"][i] = o.user_data_32
-                aw32["ledger"][i] = o.ledger
-                aw32["code"][i] = o.code
-                aw32["flags"][i] = o.flags
-            for name, vals in aw32.items():
-                _set32(u64m, AC_P32_POS, name, vals)
+            u64m, bal = _pack_account_rows(objs)
             cols = {"bal": bal, "u64": u64m}
             count = jnp.int32(next_row)
             acc = st["accounts"] = scatter_cols(

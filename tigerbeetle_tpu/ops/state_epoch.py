@@ -1,0 +1,228 @@
+"""Verified state epochs: a cheap digest of the ledger state pytree.
+
+TigerBeetle's doctrine is that determinism turns faults into repairable
+events: corrupted blocks are *detected* by checksums and healed from a
+known-good source (docs/ARCHITECTURE.md fault model; reference
+src/vsr/checksum.zig + the grid scrubber). The device ledger had no
+analog — a bit flipped in an HBM-resident account balance would serve
+wrong answers forever. This module is the detection half of the serving
+robustness layer (tigerbeetle_tpu/serving.py is the recovery half):
+
+  - `device_state_digest(state)` — ONE tiny jitted reduction over the
+    ledger state pytree (a few fused element-wise ops + a sum per
+    component; its own jit entry, never part of any serving lowering —
+    the op-budget gate and every kernel tier are untouched). Returns a
+    dict of named u64 component digests.
+  - `oracle_state_digest(sm, a_cap)` — the SAME fold computed on host
+    from an oracle state (the last-verified-epoch replay target),
+    packed through the ledger's own canonical row packers
+    (`_pack_account_rows` / `_pack_transfer_rows` — the exact code
+    `from_host` rebuilds a device from). If device and oracle disagree
+    on any digested bit, the digests differ.
+  - `combine(comps)` — one u64 over the component dict (host-side,
+    order-independent of dict ordering).
+
+What is digested (and what deliberately is not):
+
+  covered   accounts u64 matrix (all columns), the balance-limb matrix,
+            transfers u64 matrix, and the scalar vector (row counts,
+            key maxima, commit_ts) — exactly the fields the VOPR/fuzz
+            differentials pin as path-canonical (identical whether a
+            row was written by the fast kernel, a mirror push, or a
+            from_host rebuild).
+  excluded  the transfer `expires` column and the dr_row/cr_row cache
+            column (not canonical across write paths: the mirror push
+            zeroes expires on release, the fast kernel leaves it), the
+            hash tables (probe-order-dependent layout; a corrupt bucket
+            surfaces as a lookup/result divergence instead), the event
+            ring (recycled per window in serving mode; rows beyond the
+            consumed cursor are scratch), and pulse_next (maintained
+            with equivalent but not bit-pinned logic on both sides).
+
+The fold is sum-of-mixed-rows: per row, a column-Horner fold is mixed
+(splitmix64 finalizer) with the row index and a per-component salt,
+rows at/after `count` are zeroed, and the rows are summed (wrapping
+u64). Addition keeps the fold shape-independent: a host pack holding
+only the live rows digests identically to the full-capacity device
+matrix with masked tails.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .ev_layout import AC_NCOLS, XF_NCOLS, XF_P32_POS, XF_U64_IDX
+
+_U64_MASK = (1 << 64) - 1
+_PHI = 0x9E3779B97F4A7C15  # odd golden-ratio constant (also the Horner base)
+_MIX1 = 0xBF58476D1CE4E5B9
+_MIX2 = 0x94D049BB133111EB
+
+# Per-column digest masks (None = all columns fully covered). The
+# transfers store excludes the two non-canonical columns; see module doc.
+AC_COL_MASKS = None
+
+
+def _xf_col_masks() -> tuple:
+    masks = [_U64_MASK] * XF_NCOLS
+    masks[XF_U64_IDX["expires"]] = 0
+    # (dr_row, cr_row) pair-pack into one u64 column — drop the whole word.
+    masks[XF_P32_POS["dr_row"][0]] = 0
+    return tuple(masks)
+
+
+XF_COL_MASKS = _xf_col_masks()
+
+
+def _mix64(x, xp):
+    """splitmix64 finalizer over a u64 array (numpy or jax.numpy)."""
+    u = xp.uint64
+    x = x ^ (x >> u(30))
+    x = x * u(_MIX1)
+    x = x ^ (x >> u(27))
+    x = x * u(_MIX2)
+    x = x ^ (x >> u(31))
+    return x
+
+
+def _mix_int(x: int) -> int:
+    x &= _U64_MASK
+    x ^= x >> 30
+    x = (x * _MIX1) & _U64_MASK
+    x ^= x >> 27
+    x = (x * _MIX2) & _U64_MASK
+    x ^= x >> 31
+    return x
+
+
+def _matrix_digest(m, count, col_masks, salt: int, xp):
+    """Sum over rows < count of mix(column-Horner(row) ^ row-index ^ salt).
+
+    `m` is a (rows, cols) u64 matrix; `count` the live-row count (host
+    int or traced scalar). Identical results for numpy and jax.numpy —
+    both wrap u64 arithmetic — and independent of the matrix's
+    capacity beyond `count` (masked to zero before the sum)."""
+    rows = m.shape[0]
+    u = xp.uint64
+    acc = xp.zeros(rows, dtype=xp.uint64)
+    for j in range(m.shape[1]):
+        mask = _U64_MASK if col_masks is None else int(col_masks[j])
+        if mask == 0:
+            continue
+        col = m[:, j]
+        if mask != _U64_MASK:
+            col = col & u(mask)
+        acc = acc * u(_PHI) + col
+    iota = xp.arange(rows, dtype=xp.uint64)
+    rowd = _mix64(acc ^ (iota * u(_PHI)) ^ u(salt & _U64_MASK), xp)
+    live = iota < xp.asarray(count).astype(xp.uint64)
+    return xp.sum(xp.where(live, rowd, u(0)))
+
+
+# Component salts: fixed, so digests are comparable across processes.
+_SALT = {"accounts_u64": 0xA1, "accounts_bal": 0xB2,
+         "transfers_u64": 0xC3, "scalars": 0xD4}
+
+
+def _digest_components(state: dict, xp) -> dict:
+    """The shared fold over a ledger state pytree (device jnp arrays or
+    a host numpy pack from `pack_oracle_state`)."""
+    acc = state["accounts"]
+    xfr = state["transfers"]
+    comps = {
+        "accounts_u64": _matrix_digest(
+            acc["u64"], acc["count"], AC_COL_MASKS,
+            _SALT["accounts_u64"], xp),
+        "accounts_bal": _matrix_digest(
+            acc["bal"], acc["count"], None, _SALT["accounts_bal"], xp),
+        "transfers_u64": _matrix_digest(
+            xfr["u64"], xfr["count"], XF_COL_MASKS,
+            _SALT["transfers_u64"], xp),
+    }
+    scalars = xp.stack([
+        xp.asarray(state["acct_key_max"]).astype(xp.uint64),
+        xp.asarray(state["xfer_key_max"]).astype(xp.uint64),
+        xp.asarray(state["commit_ts"]).astype(xp.uint64),
+        xp.asarray(acc["count"]).astype(xp.uint64),
+        xp.asarray(xfr["count"]).astype(xp.uint64),
+    ])
+    comps["scalars"] = _matrix_digest(
+        scalars[None, :], 1, None, _SALT["scalars"], xp)
+    return comps
+
+
+_digest_jit = None
+
+
+def device_state_digest(state: dict) -> dict:
+    """Digest the DEVICE ledger state: one jitted reduction (read-only —
+    the state is NOT donated), resolved to host ints."""
+    global _digest_jit
+    import jax
+
+    if _digest_jit is None:
+        import jax.numpy as jnp
+
+        _digest_jit = jax.jit(lambda s: _digest_components(s, jnp))
+    out = jax.device_get(_digest_jit(state))
+    return {k: int(v) for k, v in out.items()}
+
+
+def pack_oracle_state(sm, a_cap: int) -> dict:
+    """Pack an oracle state's digested components through the ledger's
+    canonical host packers (the `from_host` rebuild path), as numpy.
+    Only the live rows are materialized — the fold is capacity-blind."""
+    from ..types import TransferPendingStatus
+    from .ledger import _pack_account_rows, _pack_transfer_rows
+
+    accounts = list(sm.accounts.values())
+    if accounts:
+        a_u64, a_bal = _pack_account_rows(accounts)
+    else:
+        a_u64 = np.zeros((0, AC_NCOLS), dtype=np.uint64)
+        a_bal = np.zeros((0, 16), dtype=np.uint64)
+    acct_row = {a.id: r for r, a in enumerate(accounts)}
+    # Commit (timestamp) order — device transfer rows append in commit
+    # order, and from_host packs the same way.
+    transfers = [sm.transfers[tid]
+                 for tid in sm.transfer_by_timestamp.values()]
+    if transfers:
+        x_u64 = _pack_transfer_rows(
+            transfers,
+            lambda o: int(sm.pending_status.get(
+                o.timestamp, TransferPendingStatus.none)),
+            lambda aid, dump: acct_row.get(aid, dump),
+            a_cap)
+    else:
+        x_u64 = np.zeros((0, XF_NCOLS), dtype=np.uint64)
+    return dict(
+        accounts=dict(u64=a_u64, bal=a_bal,
+                      count=np.int32(len(accounts))),
+        transfers=dict(u64=x_u64, count=np.int32(len(transfers))),
+        acct_key_max=np.uint64(sm.accounts_key_max or 0),
+        xfer_key_max=np.uint64(sm.transfers_key_max or 0),
+        commit_ts=np.uint64(sm.commit_timestamp),
+    )
+
+
+def oracle_state_digest(sm, a_cap: int) -> dict:
+    """The host-side expected digest of an oracle state (numpy fold over
+    the canonical pack) — bit-comparable with `device_state_digest`."""
+    comps = _digest_components(pack_oracle_state(sm, a_cap), np)
+    return {k: int(v) for k, v in comps.items()}
+
+
+def combine(comps: dict) -> int:
+    """One u64 digest over the component dict (key-sorted, so dict
+    ordering never matters)."""
+    d = 0
+    for k in sorted(comps):
+        d = _mix_int(d ^ (int(comps[k]) & _U64_MASK))
+    return d
+
+
+def diverging_components(got: dict, want: dict) -> list[str]:
+    """Component names where two digest dicts disagree (fault
+    attribution for the recovery log)."""
+    return sorted(k for k in set(got) | set(want)
+                  if got.get(k) != want.get(k))

@@ -205,16 +205,49 @@ class ShardedRouter:
         self.mesh = mesh
         self.axis = axis
         self._steps: dict = {}
+        self._single_steps: dict = {}
         self.batches = 0
         self.escalations = 0
         self.host_fallbacks = 0
         self.fallback_causes: dict = {}
+        # Chaos/degraded mode: mesh devices marked lost. While any
+        # device is lost, every batch re-routes to the single-chip step
+        # (the SAME create_transfers_fast math without the shard_map) —
+        # results stay bit-exact, throughput degrades, and the reroute
+        # is a counted event (testing/chaos.py injects the loss).
+        self.lost_devices: set = set()
+        self.shard_loss_reroutes = 0
+
+    def drop_device(self, device) -> None:
+        """Mark one mesh device lost (simulated ICI/host failure). The
+        replicated ledger state means ANY surviving chip — or the
+        single-chip path — can serve; we take the single-chip path
+        until restore_devices() (re-meshing is a driver concern)."""
+        self.lost_devices.add(device)
+
+    def restore_devices(self) -> None:
+        """The mesh healed: route back to the sharded steps."""
+        self.lost_devices.clear()
 
     def _step(self, mode: str):
         fn = self._steps.get(mode)
         if fn is None:
             fn = self._steps[mode] = make_sharded_create_transfers(
                 self.mesh, self.axis, mode=mode)
+        return fn
+
+    def _single_step(self, mode: str):
+        """Single-chip sibling of the sharded step: the same
+        create_transfers_fast tail with the same static tier kwargs, no
+        mesh — the degraded-mode target when a shard is lost."""
+        fn = self._single_steps.get(mode)
+        if fn is None:
+            import functools
+
+            fn = self._single_steps[mode] = jax.jit(
+                functools.partial(create_transfers_fast,
+                                  **_MODE_KWARGS[mode]),
+                donate_argnums=0)
         return fn
 
     @staticmethod
@@ -242,7 +275,11 @@ class ShardedRouter:
         caller owns the exact-path replay."""
         self.batches += 1
         mode = self.route(ev)
-        new_state, out = self._step(mode)(
+        degraded = bool(self.lost_devices)
+        if degraded:
+            self.shard_loss_reroutes += 1
+        pick = self._single_step if degraded else self._step
+        new_state, out = pick(mode)(
             state, ev, np.uint64(timestamp), np.int32(n))
         fallback, limit_only = (bool(x) for x in jax.device_get(
             (out["fallback"], out["limit_only"])))
@@ -250,7 +287,7 @@ class ShardedRouter:
             # Breach / collision / closing: resolvable on the sharded
             # fixpoint step (the plain kernel left state untouched).
             self.escalations += 1
-            new_state, out = self._step("fixpoint")(
+            new_state, out = pick("fixpoint")(
                 new_state, ev, np.uint64(timestamp), np.int32(n))
             fallback = bool(jax.device_get(out["fallback"]))
         if fallback:
@@ -267,4 +304,6 @@ class ShardedRouter:
             "escalations": self.escalations,
             "host_fallbacks": self.host_fallbacks,
             "causes": dict(self.fallback_causes),
+            "lost_devices": len(self.lost_devices),
+            "shard_loss_reroutes": self.shard_loss_reroutes,
         }

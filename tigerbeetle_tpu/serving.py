@@ -1,0 +1,391 @@
+"""Serving supervisor: chaos-hardened orchestration around DeviceLedger.
+
+The VSR/LSM layer already treats faults as repairable events (checksums
+detect, peers heal, the VOPR proves it under a seed). This module gives
+the TPU serving path the same property, in three parts:
+
+1. **Bounded retry with backoff** — every device dispatch runs under a
+   retry policy (exponential backoff with seeded jitter, a bounded
+   attempt count, and a per-window deadline checked between attempts).
+   Transient dispatch faults (`TransientDispatchError`, the class the
+   chaos harness injects at the dispatch boundary) retry; exhaustion
+   escalates to recovery instead of crashing or silently dropping the
+   window.
+
+2. **Verified state epochs** — every `epoch_interval` windows the
+   supervisor quiesces the pipeline (resolve + drain), replays the
+   epoch's logged inputs through the ORACLE engine (the pure-Python
+   exact semantics — unreachable by device corruption), and checks
+   three invariants: (a) the device-returned results match the oracle
+   replay bit-for-bit, (b) the on-device state digest
+   (ops/state_epoch.py — one tiny jitted fold, never part of a serving
+   lowering) matches the digest of the replayed oracle state, and
+   (c) the write-through mirror matches the replayed oracle object for
+   object. A clean epoch advances the verified base (the replayed
+   oracle IS the next epoch's replay source, so verification costs no
+   extra snapshotting); any divergence quarantines the device state.
+
+3. **Bounded replay recovery** — on quarantine (digest mismatch, result
+   divergence, mirror divergence, retry exhaustion), the supervisor
+   replays AT MOST the windows since the last verified epoch (asserted)
+   through the oracle, revises the authoritative result history with
+   the oracle's answers, rebuilds a fresh mirror + device state from
+   the recovered oracle (`from_host`, the same path a restart takes),
+   and resumes kernel serving. Per-cause recovery counters surface
+   through `DeviceLedger.fallback_stats()["recovery"]`, bench.py's
+   ``##bench`` line, and the devhub dashboard.
+
+Fault model, detection latency, and the reproduction workflow are
+documented in ARCHITECTURE.md ("Fault model & recovery"); the seeded
+injection harness lives in testing/chaos.py and runs as
+``python -m tigerbeetle_tpu cfo --kind chaos --seed <seed>``.
+"""
+
+from __future__ import annotations
+
+import copy
+import random
+import time
+from dataclasses import dataclass
+
+from .ops.ledger import DeviceLedger, MirrorDivergence, default_recovery_stats
+from .oracle.state_machine import StateMachineOracle
+
+
+class TransientDispatchError(RuntimeError):
+    """A device dispatch failed in a way worth retrying (the chaos
+    harness's injected dispatch failures subclass this; a real backend
+    wrapper would translate transient PJRT/tunnel errors into it)."""
+
+
+class DispatchTimeout(TransientDispatchError):
+    """A dispatch exceeded its deadline (injected or wrapped)."""
+
+
+class RecoveryNeeded(RuntimeError):
+    """Internal escalation: the serving pipeline must quarantine device
+    state and replay from the last verified epoch."""
+
+    def __init__(self, cause: str, detail: str = ""):
+        super().__init__(cause + (f": {detail}" if detail else ""))
+        self.cause = cause
+        self.detail = detail
+
+
+@dataclass
+class RetryPolicy:
+    """Bounded-retry parameters for one device dispatch. Backoff is
+    exponential from base_delay_s, capped at max_delay_s, with
+    multiplicative seeded jitter in [1, 1+jitter); deadline_s bounds the
+    whole attempt sequence (checked between attempts — a dispatch
+    blocked inside the runtime cannot be preempted, only not retried)."""
+
+    max_retries: int = 3
+    base_delay_s: float = 0.01
+    max_delay_s: float = 1.0
+    deadline_s: float = 30.0
+    jitter: float = 0.25
+
+    def delay_s(self, attempt: int, rng: random.Random) -> float:
+        base = min(self.max_delay_s,
+                   self.base_delay_s * (2.0 ** max(0, attempt - 1)))
+        return base * (1.0 + self.jitter * rng.random())
+
+
+# Structural faults while consuming device-produced bytes (the drain
+# materializes fetched delta chunks into the mirror): an unknown
+# account/transfer id, an invalid enum code, or a bad index there is
+# DETECTED corruption — corrupted device rows fed the chunk — so it
+# routes to quarantine+replay, never to a retry or a raw crash.
+_STRUCTURAL_FAULTS = (KeyError, IndexError, ValueError)
+
+
+def call_with_retries(fn, policy: RetryPolicy, rng: random.Random,
+                      counters: dict, *, sleep=time.sleep,
+                      clock=time.monotonic):
+    """Run `fn()` under `policy`. Transient faults retry with backoff;
+    exhaustion (attempts or deadline) raises RecoveryNeeded, as do a
+    MirrorDivergence and the structural drain faults (retrying cannot
+    fix divergent state). Counters accumulate into the shared
+    recovery-stats dict."""
+    t0 = clock()
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except MirrorDivergence as e:
+            raise RecoveryNeeded("mirror_divergence", str(e)) from e
+        except _STRUCTURAL_FAULTS as e:
+            raise RecoveryNeeded("drain_fault", repr(e)) from e
+        except TransientDispatchError as e:
+            attempt += 1
+            counters["retries"] += 1
+            if attempt > policy.max_retries:
+                raise RecoveryNeeded(
+                    "dispatch_exhausted",
+                    f"{attempt} attempts: {e!r}") from e
+            if clock() - t0 > policy.deadline_s:
+                raise RecoveryNeeded(
+                    "dispatch_deadline",
+                    f"deadline {policy.deadline_s}s: {e!r}") from e
+            delay = policy.delay_s(attempt, rng)
+            counters["backoff_s"] = round(
+                counters["backoff_s"] + delay, 6)
+            sleep(delay)
+
+
+class ServingSupervisor:
+    """Owns a write-through DeviceLedger and supervises its serving
+    loop: retries, verified epochs, and bounded replay recovery.
+
+    The caller submits Transfer/Account OBJECT batches (the supervisor
+    keeps them as the epoch's replayable log); device dispatch uses the
+    ledger's array paths underneath. `history` is the authoritative
+    normalized result record — one entry per submitted op, revised with
+    the oracle's answers whenever a recovery replays a suffix."""
+
+    def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21, *,
+                 epoch_interval: int = 8, retry: RetryPolicy | None = None,
+                 seed: int = 0, mirror_audit: str = "full",
+                 fault_hook=None, sleep=time.sleep):
+        assert mirror_audit in ("full", "spot", "off")
+        self.a_cap = a_cap
+        self.t_cap = t_cap
+        self.epoch_interval = epoch_interval
+        self.retry = retry or RetryPolicy()
+        self.rng = random.Random(seed)
+        self.mirror_audit = mirror_audit
+        # Chaos-injection point: called as hook(window_index, what) at
+        # every dispatch attempt; raising TransientDispatchError /
+        # DispatchTimeout injects a dispatch fault (testing/chaos.py).
+        self.fault_hook = fault_hook
+        self._sleep = sleep
+        self.counters = default_recovery_stats()
+        # The last VERIFIED epoch's state: a pure oracle advanced only
+        # by replaying logged inputs — device corruption cannot reach
+        # it. After each clean epoch it equals the live state.
+        self.epoch_base = StateMachineOracle()
+        self.log: list = []       # ops since the last verified epoch
+        self.history: list = []   # normalized results, one per op ever
+        self.last_recovery: dict | None = None
+        self._windows_since_epoch = 0
+        self.windows_total = 0
+        self._attach(DeviceLedger(a_cap, t_cap,
+                                  write_through=StateMachineOracle()))
+
+    def _attach(self, led: DeviceLedger) -> None:
+        self.led = led
+        # The ledger surfaces OUR counters through fallback_stats() so
+        # bench/devhub records carry them next to the fallback causes.
+        led.recovery_stats = self.counters
+
+    # ------------------------------------------------------------ serving
+
+    def create_accounts(self, accounts: list, timestamp: int):
+        accounts = list(accounts)
+        res = self._dispatch(
+            lambda: self.led.create_accounts(accounts, timestamp),
+            what="create_accounts")
+        norm = [(r.timestamp, int(r.status)) for r in res]
+        self.log.append(("accounts", accounts, timestamp))
+        self.history.append(norm)
+        return res
+
+    def create_transfers_window(self, batches: list, timestamps: list):
+        """Submit one commit window: `batches` is a list of Transfer
+        object lists, `timestamps` the per-prepare commit timestamps.
+        Returns the ledger's per-prepare (status u32[n], ts u64[n])
+        pairs. Runs the epoch check when the interval elapses."""
+        from .ops.batch import transfers_to_arrays
+
+        batches = [list(b) for b in batches]
+        timestamps = list(timestamps)
+        win = self.windows_total
+
+        def thunk():
+            evs = [transfers_to_arrays(b) for b in batches]
+            return self.led.create_transfers_window(evs, timestamps)
+
+        out = self._dispatch(thunk, what="window", win=win)
+        norm = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts.tolist())]
+                for st, ts in out]
+        self.log.append(("window", batches, timestamps))
+        self.history.append(norm)
+        self.windows_total += 1
+        self._windows_since_epoch += 1
+        if self._windows_since_epoch >= self.epoch_interval:
+            self.verify_epoch()
+        return out
+
+    def expire_pending_transfers(self, timestamp: int) -> int:
+        n = self._dispatch(
+            lambda: self.led.expire_pending_transfers(timestamp),
+            what="expire")
+        self.log.append(("expire", None, timestamp))
+        self.history.append(n)
+        return n
+
+    def _dispatch(self, thunk, *, what: str = "", win: int | None = None):
+        hook = self.fault_hook
+        idx = self.windows_total if win is None else win
+
+        def run():
+            if hook is not None:
+                hook(idx, what)
+            return thunk()
+
+        try:
+            return call_with_retries(run, self.retry, self.rng,
+                                     self.counters, sleep=self._sleep)
+        except RecoveryNeeded as e:
+            self._recover(e.cause, detail=e.detail)
+            # Fresh, verified state: one post-recovery re-dispatch of
+            # the op itself (no fault hook — the injected fault was a
+            # property of the quarantined attempt sequence).
+            return thunk()
+
+    # ------------------------------------------------------------- epochs
+
+    def verify_epoch(self) -> bool:
+        """Quiesce, replay the epoch's log through the oracle, and check
+        results / state digest / mirror. Clean -> advance the verified
+        base and return True; any divergence -> recover and return
+        False. Calling with an empty log is a cheap no-op epoch."""
+        from .ops import state_epoch
+
+        led = self.led
+        try:
+            led.resolve_windows()
+            led.drain_mirror()
+        except MirrorDivergence as e:
+            self._recover("mirror_divergence", detail=str(e))
+            return False
+        except _STRUCTURAL_FAULTS as e:
+            self._recover("drain_fault", detail=repr(e))
+            return False
+        n_entries = len(self.log)
+        replayed = self._replay_log_into_base()
+        cause = None
+        detail = ""
+        # (a) result parity: device answers vs the oracle replay.
+        start = len(self.history) - n_entries
+        for i, want in enumerate(replayed):
+            if self.history[start + i] != want:
+                cause = "result_divergence"
+                detail = f"op {start + i}"
+                break
+        # (b) state digest: device fold vs the replayed-oracle fold.
+        if cause is None:
+            got = state_epoch.device_state_digest(led.state)
+            want_d = state_epoch.oracle_state_digest(self.epoch_base,
+                                                     self.a_cap)
+            if got != want_d:
+                self.counters["checksum_mismatches"] += 1
+                cause = "state_digest"
+                detail = ",".join(
+                    state_epoch.diverging_components(got, want_d))
+        # (c) mirror audit: write-through mirror vs the replayed oracle.
+        if cause is None and self.mirror_audit != "off":
+            bad = self._mirror_audit_fields(
+                full=self.mirror_audit == "full")
+            if bad:
+                cause = "mirror_divergence"
+                detail = ",".join(bad)
+        if cause is None:
+            self.counters["epochs_verified"] += 1
+            self.log.clear()
+            self._windows_since_epoch = 0
+            return True
+        self._recover(cause, detail=detail, replayed=replayed)
+        return False
+
+    def _replay_log_into_base(self) -> list:
+        """Apply the epoch log to the verified base oracle, returning
+        normalized results per entry (the authoritative answers)."""
+        base = self.epoch_base
+        out = []
+        for kind, payload, ts in self.log:
+            if kind == "accounts":
+                res = base.create_accounts(payload, ts)
+                out.append([(r.timestamp, int(r.status)) for r in res])
+            elif kind == "window":
+                out.append([
+                    [(r.timestamp, int(r.status))
+                     for r in base.create_transfers(b, bts)]
+                    for b, bts in zip(payload, ts)])
+            else:
+                assert kind == "expire", kind
+                out.append(base.expire_pending_transfers(ts))
+        return out
+
+    def _mirror_audit_fields(self, full: bool) -> list[str]:
+        """Object-level audit of the write-through mirror against the
+        replayed oracle. full=True compares every container; spot mode
+        compares sizes/scalars plus a seeded object sample."""
+        sm = self.led.mirror
+        base = self.epoch_base
+        bad: list[str] = []
+        if full:
+            for field in ("accounts", "transfers", "pending_status",
+                          "orphaned", "expiry"):
+                if getattr(sm, field) != getattr(base, field):
+                    bad.append(field)
+            off = sm.events_base - base.events_base
+            if not (0 <= off <= len(base.account_events)) or \
+                    sm.account_events != base.account_events[off:]:
+                bad.append("account_events")
+            return bad
+        if (len(sm.accounts) != len(base.accounts)
+                or len(sm.transfers) != len(base.transfers)
+                or sm.commit_timestamp != base.commit_timestamp):
+            return ["sizes"]
+        ids = list(base.transfers)
+        for tid in (self.rng.sample(ids, min(4, len(ids))) if ids else ()):
+            if sm.transfers.get(tid) != base.transfers.get(tid):
+                bad.append(f"transfer:{tid}")
+        return bad
+
+    # ----------------------------------------------------------- recovery
+
+    def _recover(self, cause: str, detail: str = "",
+                 replayed: list | None = None) -> None:
+        """Quarantine the device state and recover from the last
+        verified epoch: oracle-replay the logged suffix (bounded),
+        revise the authoritative history, rebuild mirror + device from
+        the recovered oracle, resume serving."""
+        n_entries = len(self.log)
+        n_windows = sum(1 for e in self.log if e[0] == "window")
+        # Bounded-replay invariant: recovery never replays more windows
+        # than fit between two epoch checks.
+        assert n_windows <= self.epoch_interval, \
+            (n_windows, self.epoch_interval)
+        if replayed is None:
+            replayed = self._replay_log_into_base()
+        start = len(self.history) - n_entries
+        self.history[start:] = replayed
+        self.counters["replayed_windows"] += n_windows
+        recs = self.counters["recoveries"]
+        recs[cause] = recs.get(cause, 0) + 1
+        self.last_recovery = {"cause": cause, "detail": detail,
+                              "replayed_entries": n_entries,
+                              "replayed_windows": n_windows}
+        # Fresh mirror from the recovered oracle (a deep copy: the
+        # mirror evolves by write-through deltas, the base only by
+        # replay) and a device rebuild through from_host — the same
+        # path a restart/state-sync takes.
+        new_mirror = copy.deepcopy(self.epoch_base)
+        self._attach(DeviceLedger(self.a_cap, self.t_cap,
+                                  write_through=new_mirror))
+        self.log.clear()
+        self._windows_since_epoch = 0
+
+    # -------------------------------------------------------------- stats
+
+    def stats(self) -> dict:
+        out = {k: (dict(v) if isinstance(v, dict) else v)
+               for k, v in self.counters.items()}
+        out["windows_total"] = self.windows_total
+        out["windows_since_epoch"] = self._windows_since_epoch
+        out["last_recovery"] = self.last_recovery
+        out["ledger"] = self.led.fallback_stats()
+        return out

@@ -48,15 +48,16 @@ def run_swarm_seed(seed: int, engine: str | None = None,
     else:
         factory = lambda: StateMachine(  # noqa: E731
             engine="device", a_cap=1 << 10, t_cap=1 << 13)
+    net = NetworkOptions(
+        loss_probability=rng.choice([0.0, 0.02, 0.05, 0.10]),
+        duplicate_probability=rng.choice([0.0, 0.02, 0.05]),
+        delay_min_ns=1 * MS,
+        delay_max_ns=rng.choice([10 * MS, 30 * MS, 50 * MS]))
     cluster = Cluster(
         seed=seed, replica_count=replica_count,
         standby_count=standby_count,
         state_machine_factory=factory,
-        network=NetworkOptions(
-            loss_probability=rng.choice([0.0, 0.02, 0.05, 0.10]),
-            duplicate_probability=rng.choice([0.0, 0.02, 0.05]),
-            delay_min_ns=1 * MS,
-            delay_max_ns=rng.choice([10 * MS, 30 * MS, 50 * MS])))
+        network=net)
     client = cluster.client(1)
     workload = Workload(seed, account_ids=list(range(1, 9)))
     auditor = Auditor(workload.permutation)
@@ -104,6 +105,14 @@ def run_swarm_seed(seed: int, engine: str | None = None,
         cluster.restart(r)
     cluster.settle(ticks=60_000)
     assert auditor.checked > 0
+    # The summary records the network fault configuration ACTUALLY
+    # drawn, so a failing seed is triageable straight from the cfo log
+    # without re-deriving the rng sequence.
     return dict(seed=seed, engine=engine, replica_count=replica_count,
                 standby_count=standby_count, steps=steps,
-                audited=auditor.checked)
+                audited=auditor.checked,
+                network=dict(
+                    loss_probability=net.loss_probability,
+                    duplicate_probability=net.duplicate_probability,
+                    delay_min_ns=net.delay_min_ns,
+                    delay_max_ns=net.delay_max_ns))
