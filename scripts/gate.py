@@ -20,7 +20,11 @@ Tiers (markers documented in pytest.ini):
 The gate also runs the fixed CHAOS seed set (testing/chaos.py
 gate_main: seeded device-fault injection against the serving
 supervisor — zero-silent-corruption asserted per seed; skip with
---no-chaos) and the op-budget check + jaxhound serving-path lints
+--no-chaos), the REBUILD smoke (3-replica in-process cluster, zero one
+data file under load, recover-from-cluster, state-epoch digest match,
+plus one fixed seed each of the message_bus and storage_faults
+fuzzers; skip with --no-rebuild), and the op-budget check + jaxhound
+serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
 (perf/opbudget_r06.json), bakes a >4 KiB closure constant into a
@@ -119,6 +123,34 @@ def run_chaos(timeout: int = 900) -> int:
     return rc
 
 
+def run_rebuild(timeout: int = 600) -> int:
+    """Rebuild-from-cluster smoke: 3-replica in-process cluster, traffic
+    past a WAL wrap, zero one replica's data file, rebuild it from its
+    peers, state-epoch digest match (testing/cluster.py rebuild_smoke) —
+    plus one fixed seed of each rebuild-adjacent fuzzer (message_bus,
+    storage_faults). Skip with --no-rebuild."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing.cluster import rebuild_smoke; "
+           "from tigerbeetle_tpu.testing import fuzz; "
+           "rebuild_smoke(); "
+           "fuzz.run('message_bus', 1); "
+           "fuzz.run('storage_faults', 1, iterations=2); "
+           "print('[gate] rebuild ok')"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] rebuild: zero-one-data-file smoke + new fuzzer seeds",
+          flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: rebuild timed out after {timeout}s", flush=True)
+        return 124
+    print(f"[gate] rebuild rc={rc} in {time.time() - t0:.0f}s", flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -144,6 +176,9 @@ def main() -> int:
     ap.add_argument("--no-chaos", action="store_true",
                     help="skip the fixed chaos seed set (serving "
                          "recovery path)")
+    ap.add_argument("--no-rebuild", action="store_true",
+                    help="skip the rebuild-from-cluster smoke + new "
+                         "fuzzer seeds")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -161,6 +196,10 @@ def main() -> int:
         rc = run_chaos()
         if rc != 0:
             reds.append(f"chaos rc={rc}")
+    if not args.no_rebuild:
+        rc = run_rebuild()
+        if rc != 0:
+            reds.append(f"rebuild rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

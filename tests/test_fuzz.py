@@ -11,7 +11,7 @@ from tigerbeetle_tpu.main import main
 from tigerbeetle_tpu.testing import fuzz
 
 FAST = ["ewah", "multi_batch", "superblock_quorums", "journal",
-        "client_sessions"]
+        "client_sessions", "message_bus"]
 
 
 @pytest.mark.parametrize("name", FAST)
@@ -47,6 +47,14 @@ def test_durability_fuzzer(seed):
     """Crash-point recovery: reopening after a crash at ANY write boundary
     must succeed with balanced books."""
     fuzz.run("durability", seed, iterations=6)
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_storage_faults_fuzzer(seed):
+    """Zone-fault rules incl. the rebuild window: tolerated faults must
+    always recover with zero silent divergence (byte-identical
+    checkpoints asserted per run)."""
+    fuzz.run("storage_faults", seed, iterations=2)
 
 
 @pytest.mark.parametrize("seed", [4, 5])

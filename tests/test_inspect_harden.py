@@ -1,0 +1,203 @@
+"""`inspect` hardening (ISSUE 4 satellite): the WAL-slot and superblock
+dumps must render against a deliberately corrupted data file — every bad
+checksum FLAGGED in the output, never raised. Each zone is corrupted in
+turn; `main(["inspect", ...])` runs in-process so a crash surfaces as a
+test failure, not a subprocess exit code.
+"""
+
+import pytest
+
+from tigerbeetle_tpu import multi_batch
+from tigerbeetle_tpu.main import main
+from tigerbeetle_tpu.state_machine import StateMachine
+from tigerbeetle_tpu.types import Account, Operation, Transfer
+from tigerbeetle_tpu.vsr.header import HEADER_SIZE
+from tigerbeetle_tpu.vsr.replica import Replica
+from tigerbeetle_tpu.vsr.storage import (
+    SUPERBLOCK_COPY_SIZE,
+    TEST_LAYOUT,
+    FileStorage,
+)
+from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+
+class _NullBus:
+    def send_to_replica(self, dst, msg):
+        pass
+
+    def send_to_client(self, client, msg):
+        pass
+
+
+class _Time:
+    now = 1_700_000_000 * 10**9
+
+    def monotonic(self):
+        self.now += 1_000_000
+        return self.now
+
+    def realtime(self):
+        return self.now
+
+
+def _encode(payloads):
+    return multi_batch.encode([b"".join(payloads)], 128)
+
+
+def _build_data_file(path) -> None:
+    """Single-replica data file with commits across a checkpoint, so the
+    WAL, snapshot, and grid zones all hold real content."""
+    storage = FileStorage(str(path), layout=TEST_LAYOUT, create=True)
+    Replica.format(storage, cluster=1, replica_id=0, replica_count=1)
+    replica = Replica(
+        cluster=1, replica_id=0, replica_count=1, storage=storage,
+        bus=_NullBus(), time=_Time(),
+        state_machine_factory=lambda: StateMachine(engine="oracle"))
+    replica.open()
+    replica._primary_prepare(
+        Operation.create_accounts,
+        _encode([Account(id=i, ledger=1, code=1).pack() for i in (1, 2)]))
+    replica.tick()  # async WAL appends ack (and commit) at poll_io
+    for k in range(20):  # crosses checkpoint_interval=16
+        replica._primary_prepare(
+            Operation.create_transfers,
+            _encode([Transfer(id=100 + k, debit_account_id=1,
+                              credit_account_id=2, amount=1,
+                              ledger=1, code=1).pack()]))
+        replica.tick()
+    replica.journal.wait_all()
+    replica.tick()
+    assert replica.superblock.op_checkpoint > 0
+    storage.sync()
+    storage.close()
+
+
+def _flip(path, offset: int, n: int = 8) -> None:
+    with open(path, "r+b") as f:
+        f.seek(offset)
+        data = bytearray(f.read(n))
+        for i in range(len(data)):
+            data[i] ^= 0xFF
+        f.seek(offset)
+        f.write(bytes(data))
+
+
+ZONES = TEST_LAYOUT.zone_offsets
+
+
+@pytest.fixture()
+def data_file(tmp_path):
+    path = tmp_path / "r0.tigerbeetle"
+    _build_data_file(path)
+    return str(path)
+
+
+def _active_snapshot_offset(path) -> int:
+    storage = FileStorage(path, layout=TEST_LAYOUT)
+    sb = SuperBlock.load(storage)
+    storage.close()
+    return ZONES["snapshot"] \
+        + sb.snapshot_slot * TEST_LAYOUT.snapshot_size_max
+
+
+class TestInspectCorruptZones:
+    def test_clean_file_renders_ok(self, data_file, capsys):
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert "superblock: cluster=1" in out
+        assert "root=ok" in out
+        assert "CORRUPT" not in out
+
+    def test_all_superblock_copies_corrupt(self, data_file, capsys):
+        for copy in range(4):
+            _flip(data_file,
+                  ZONES["superblock"] + copy * SUPERBLOCK_COPY_SIZE + 4)
+        assert main(["inspect", "--small", data_file]) == 1
+        out = capsys.readouterr().out
+        assert out.count("CORRUPT (bad checksum)") >= 4
+        assert "no quorum" in out
+
+    def test_one_superblock_copy_corrupt_still_opens(self, data_file,
+                                                     capsys):
+        _flip(data_file, ZONES["superblock"] + 4)
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert "superblock copy 0: CORRUPT" in out
+        assert "superblock: cluster=1" in out  # quorum survives
+
+    def test_wal_header_corrupt_recovers_from_prepare(self, data_file,
+                                                      capsys):
+        # A torn redundant header with an intact prepare is legitimately
+        # recovered (not a fault) — the dump must render it, not die.
+        slot = 2  # op 2's slot in the 32-slot ring
+        _flip(data_file, ZONES["wal_headers"] + slot * HEADER_SIZE + 4)
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert f"wal slot {slot:4d}: op=2" in out
+
+    def test_wal_both_rings_corrupt_flagged(self, data_file, capsys):
+        slot = 2
+        _flip(data_file, ZONES["wal_headers"] + slot * HEADER_SIZE + 4)
+        _flip(data_file, ZONES["wal_prepares"]
+              + slot * TEST_LAYOUT.message_size_max + 4)
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert f"wal slot {slot:4d}: no valid header " \
+               "CORRUPT (unrecognizable)" in out
+
+    def test_wal_prepare_corrupt_flagged(self, data_file, capsys):
+        slot = 3
+        _flip(data_file, ZONES["wal_prepares"]
+              + slot * TEST_LAYOUT.message_size_max + HEADER_SIZE + 8)
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert "faulty" in out
+        assert f"wal slot {slot:4d}:" in out
+        assert "CORRUPT (bad checksum)" in out
+
+    def test_snapshot_root_corrupt_flagged(self, data_file, capsys):
+        _flip(data_file, _active_snapshot_offset(data_file) + 16)
+        assert main(["inspect", "--small", data_file]) == 1
+        out = capsys.readouterr().out
+        assert "root=CORRUPT" in out
+        # The WAL dump still renders below the corrupt root.
+        assert "journal:" in out
+
+    def test_grid_corrupt_integrity_flags_not_raises(self, data_file,
+                                                     capsys):
+        # Carpet-bomb the first bytes of many grid blocks: --integrity
+        # must enumerate faults (and a failed state rebuild) tolerantly.
+        bs = TEST_LAYOUT.grid_block_size
+        for block in range(0, 64):
+            _flip(data_file, ZONES["grid"] + block * bs + 1, n=4)
+        assert main(["inspect", "--small", "--integrity",
+                     data_file]) == 1
+        out = capsys.readouterr().out
+        assert "CORRUPT" in out or "FAILED" in out
+
+    def test_zeroed_file_renders_no_quorum(self, data_file, capsys):
+        storage = FileStorage(data_file, layout=TEST_LAYOUT)
+        storage.erase()
+        storage.close()
+        assert main(["inspect", "--small", data_file]) == 1
+        out = capsys.readouterr().out
+        assert "no quorum" in out
+
+    def test_mid_rebuild_record_rendered(self, data_file, capsys):
+        storage = FileStorage(data_file, layout=TEST_LAYOUT)
+        sb = SuperBlock.load(storage)
+        sb.sync_op = 48
+        sb.store(storage)
+        storage.close()
+        assert main(["inspect", "--small", data_file]) == 0
+        out = capsys.readouterr().out
+        assert "MID-REBUILD" in out
+        # ...and a normal open refuses the file outright.
+        storage = FileStorage(data_file, layout=TEST_LAYOUT)
+        replica = Replica(
+            cluster=1, replica_id=0, replica_count=1, storage=storage,
+            bus=_NullBus(), time=_Time(),
+            state_machine_factory=lambda: StateMachine(engine="oracle"))
+        with pytest.raises(RuntimeError, match="mid-rebuild"):
+            replica.open()
+        storage.close()

@@ -1,0 +1,197 @@
+"""Rebuild-from-cluster (ISSUE 4): blank-replica recovery over state
+sync. A replica whose data file was lost or zeroed solicits a peer
+checkpoint, installs it staged (superblock sync_op record), repairs the
+WAL suffix through normal VSR repair, certifies the grid with a full
+scrub tour, and only then votes again. Deterministic in-process
+coverage; the real-process acceptance scenario lives in test_vortex.py.
+"""
+
+import pytest
+
+from tests.test_vsr import (
+    _create_accounts_body,
+    _create_transfers_body,
+    _drive,
+)
+from tigerbeetle_tpu.ops.state_epoch import combine, oracle_state_digest
+from tigerbeetle_tpu.testing.cluster import Cluster
+from tigerbeetle_tpu.types import Operation
+from tigerbeetle_tpu.vsr.header import Command
+from tigerbeetle_tpu.vsr.storage import TEST_LAYOUT, MemoryStorage
+from tigerbeetle_tpu.vsr.superblock import SuperBlock
+
+
+def _setup(seed, n_transfers):
+    cluster = Cluster(seed=seed, replica_count=3)
+    client = cluster.client(60 + seed)
+    _drive(cluster, client, [
+        (Operation.create_accounts, _create_accounts_body([1, 2]))])
+    for k in range(n_transfers):
+        _drive(cluster, client, [
+            (Operation.create_transfers,
+             _create_transfers_body([(100 + k, 1, 2, 1)]))])
+    cluster.settle()
+    return cluster, client
+
+
+def _digests(cluster):
+    return [combine(oracle_state_digest(r.state_machine.state, 1 << 8))
+            for i, r in enumerate(cluster.replicas)
+            if i not in cluster.crashed]
+
+
+class TestRebuildFromCluster:
+    def test_blank_rebuild_state_syncs_and_matches(self):
+        """Past a WAL wrap (>32 ops) the rebuild MUST take the state-sync
+        path; the rebuilt replica's state-epoch digest is bit-identical
+        to its peers' and the storage checker passes."""
+        cluster, client = _setup(31, 40)
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.destroy_data_file(victim)
+        for k in range(5):  # live traffic while the data file is gone
+            _drive(cluster, client, [
+                (Operation.create_transfers,
+                 _create_transfers_body([(300 + k, 1, 2, 1)]))])
+        rebuilt = cluster.rebuild(victim)
+        assert rebuilt._rebuild_synced, \
+            "rebuild converged without exercising state sync"
+        assert rebuilt._rebuild_certified
+        cluster.settle()
+        digests = _digests(cluster)
+        assert len(set(digests)) == 1, digests
+
+    def test_rebuild_without_peer_checkpoint_repairs_wal(self):
+        """A young cluster (no checkpoint yet) has nothing to offer over
+        state sync: the rebuild catches up through ordinary WAL repair
+        under the primary's start_view and still converges."""
+        cluster, client = _setup(32, 5)  # 6 ops < checkpoint_interval
+        victim = (cluster.replicas[0].primary_index() + 2) % 3
+        assert all(r.superblock.op_checkpoint == 0
+                   for r in cluster.replicas)
+        cluster.destroy_data_file(victim)
+        rebuilt = cluster.rebuild(victim)
+        assert not rebuilt._rebuild_synced  # WAL-only path
+        cluster.settle()
+        digests = _digests(cluster)
+        assert len(set(digests)) == 1, digests
+
+    def test_rebuilding_replica_never_votes(self):
+        """No half-installed state ever votes: while rebuilding, the
+        replica sends no prepare_ok, no nack, and joins no view change —
+        its lost promise history must not weigh in any quorum."""
+        cluster, client = _setup(33, 40)
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.destroy_data_file(victim)
+        rebuilt = cluster.begin_rebuild(victim)
+        sent = []
+        orig = rebuilt.bus.send_to_replica
+
+        def spy(dst, msg):
+            if rebuilt.rebuilding:
+                sent.append(msg.header.command)
+            orig(dst, msg)
+
+        rebuilt.bus.send_to_replica = spy
+        ok = cluster.run(12000, until=lambda: rebuilt.rebuild_complete)
+        assert ok, rebuilt.rebuild_progress()
+        forbidden = {Command.prepare_ok, Command.nack_prepare,
+                     Command.start_view_change, Command.do_view_change}
+        assert not (set(sent) & forbidden), set(sent) & forbidden
+        assert not rebuilt.is_primary
+        rebuilt.finish_rebuild()
+        cluster.settle()
+
+    def test_crash_mid_install_refuses_normal_open(self):
+        """A crash between the staged sync_op record and the final
+        superblock flip leaves a half-installed grid: a normal open must
+        REFUSE the file (RuntimeError naming recover --from-cluster) and
+        a re-run of the rebuild must complete cleanly."""
+        cluster, client = _setup(34, 40)
+        victim = (cluster.replicas[0].primary_index() + 1) % 3
+        cluster.destroy_data_file(victim)
+        rebuilt = cluster.begin_rebuild(victim)
+        ok = cluster.run(8000, until=lambda: rebuilt.syncing is not None)
+        assert ok, "rebuild never began syncing"
+
+        class _Crash(Exception):
+            pass
+
+        class _CrashAfter:
+            """Write-through until the budget runs out, then crash — the
+            4 superblock copies (sync_op record) land, grid writes tear."""
+
+            def __init__(self, inner, writes_left):
+                self.inner = inner
+                self.layout = inner.layout
+                self.writes_left = writes_left
+
+            def read(self, zone, off, size):
+                return self.inner.read(zone, off, size)
+
+            def write(self, zone, off, data):
+                if self.writes_left <= 0:
+                    raise _Crash()
+                self.writes_left -= 1
+                self.inner.write(zone, off, data)
+
+            def sync(self):
+                self.inner.sync()
+
+            def write_pair_async(self, *a):
+                return None
+
+            def io_poll(self):
+                return []
+
+            def read_batch(self, zone, reqs):
+                return [self.read(zone, o, s) for o, s in reqs]
+
+        storage = cluster.storages[victim]
+        rebuilt.storage = _CrashAfter(storage, writes_left=5)
+        with pytest.raises(_Crash):
+            cluster.run(8000, until=lambda: rebuilt.rebuild_complete)
+        cluster.crash(victim)
+        sb = SuperBlock.load(storage)
+        assert sb is not None and sb.sync_op > 0, \
+            "torn install left no sync-progress record"
+        # The half-installed file must never serve reads or vote.
+        doomed = cluster._make_replica(victim)
+        with pytest.raises(RuntimeError, match="mid-rebuild"):
+            doomed.open()
+        # The rebuild path restarts cleanly on the same bytes.
+        rebuilt = cluster.rebuild(victim)
+        assert rebuilt._rebuild_synced
+        cluster.settle()
+        digests = _digests(cluster)
+        assert len(set(digests)) == 1, digests
+
+    def test_rebuild_under_live_traffic(self):
+        """Client load keeps committing through the whole rebuild; the
+        rebuilt replica converges to the moving cluster state."""
+        cluster, client = _setup(35, 36)
+        victim = (cluster.replicas[0].primary_index() + 2) % 3
+        cluster.destroy_data_file(victim)
+        rebuilt = cluster.begin_rebuild(victim)
+        for k in range(8):  # interleave traffic with rebuild progress
+            _drive(cluster, client, [
+                (Operation.create_transfers,
+                 _create_transfers_body([(500 + k, 1, 2, 1)]))])
+        ok = cluster.run(12000, until=lambda: rebuilt.rebuild_complete)
+        assert ok, rebuilt.rebuild_progress()
+        rebuilt.finish_rebuild()
+        cluster.settle()
+        digests = _digests(cluster)
+        assert len(set(digests)) == 1, digests
+
+
+class TestSuperBlockSyncOp:
+    def test_sync_op_roundtrips(self):
+        storage = MemoryStorage(TEST_LAYOUT)
+        sb = SuperBlock(cluster=3, replica_id=1, replica_count=3,
+                        sync_op=77)
+        sb.store(storage)
+        got = SuperBlock.load(storage)
+        assert got.sync_op == 77
+        sb.sync_op = 0
+        sb.store(storage)
+        assert SuperBlock.load(storage).sync_op == 0

@@ -4,9 +4,10 @@ reference: src/tigerbeetle/main.zig (commands :146-186) + cli.zig. Commands:
 
   format     --cluster=N --replica=I --replica-count=N <path>
   start      --addresses=a:p,b:p,... --replica=I [--engine=device|kernel|oracle] <path>
+  recover    <aof> <path>  |  --from-cluster --addresses=... <path>
   repl       --addresses=... [--cluster=N]
   benchmark  [--transfer-count=N] [--account-count=N]
-  inspect    <path>
+  inspect    [--integrity] [--digest] <path>
   version
 """
 
@@ -180,9 +181,100 @@ def cmd_benchmark(args) -> int:
     return 0
 
 
+def _recover_from_cluster(args) -> int:
+    """Rebuild a blank/lost data file from the cluster's live peers
+    (reference: src/vsr/replica_reformat.zig): solicit the newest durable
+    checkpoint over the state-sync path, install it staged (the
+    superblock's sync_op record makes a crash mid-install restart the
+    rebuild instead of leaving a half-written file), repair the WAL
+    suffix through normal VSR repair, certify the installed grid with a
+    full scrub tour, then exit 0 — `start` rejoins as a voter."""
+    import signal as _signal
+    import time as _time
+
+    from .state_machine import StateMachine
+    from .vsr.message_bus import MessageBus
+    from .vsr.replica import Replica
+    from .vsr.storage import FileStorage, StorageLayout, TEST_LAYOUT
+
+    if not args.addresses:
+        print("error: recover --from-cluster requires --addresses")
+        return 2
+    addresses = _parse_addresses(args.addresses)
+    if args.replica_count != len(addresses):
+        print(f"error: --replica-count={args.replica_count} but "
+              f"--addresses lists {len(addresses)} replicas")
+        return 2
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout, create=True)
+    holder: list = []
+    bus = MessageBus(cluster=args.cluster,
+                     on_message=lambda m: holder[0].on_message(m),
+                     replica_addresses=addresses, replica_id=args.replica,
+                     listen=True, listen_port=args.listen_port)
+    replica = Replica(
+        cluster=args.cluster, replica_id=args.replica,
+        replica_count=args.replica_count, storage=storage, bus=bus,
+        time=_WallTime(),
+        state_machine_factory=lambda: StateMachine(engine="oracle"))
+    holder.append(replica)
+    replica.open_rebuild()
+    print(f"rebuild: replica {args.replica} rebuilding from cluster "
+          f"{args.cluster} ({len(addresses) - 1} peers)", flush=True)
+    stop: list = []
+    prev_int = _signal.signal(_signal.SIGINT, lambda *_: stop.append(1))
+    prev_term = _signal.signal(_signal.SIGTERM, lambda *_: stop.append(1))
+    t0 = _time.monotonic()
+    deadline = t0 + args.timeout_s if args.timeout_s else None
+    last_progress, last_print = "", 0.0
+    try:
+        while not replica.rebuild_complete and not stop:
+            bus.poll(0.01)
+            replica.tick()
+            now = _time.monotonic()
+            progress = replica.rebuild_progress()
+            if progress != last_progress and now - last_print >= 0.2:
+                last_progress, last_print = progress, now
+                print(f"rebuild: {progress}", flush=True)
+            if deadline is not None and now > deadline:
+                print(f"rebuild: TIMED OUT after {args.timeout_s:.0f}s "
+                      f"({progress})", flush=True)
+                return 1
+    finally:
+        _signal.signal(_signal.SIGINT, prev_int)
+        _signal.signal(_signal.SIGTERM, prev_term)
+        bus.close()
+        storage.sync()
+        storage.close()
+    if not replica.rebuild_complete:
+        print(f"rebuild: interrupted ({replica.rebuild_progress()}); "
+              "re-run recover --from-cluster to resume", flush=True)
+        return 1
+    replica.finish_rebuild()
+    sb = replica.superblock
+    print(f"rebuilt {args.path} from cluster: checkpoint op "
+          f"{sb.op_checkpoint}, commit {replica.commit_min}, "
+          f"{'state-synced' if replica._rebuild_synced else 'WAL-repaired'}"
+          f", grid certified, in {_time.monotonic() - t0:.1f}s",
+          flush=True)
+    return 0
+
+
 def cmd_recover(args) -> int:
     """Rebuild a fresh data file from an append-only file (reference:
-    `tigerbeetle recover` replaying src/aof.zig frames)."""
+    `tigerbeetle recover` replaying src/aof.zig frames) — or, with
+    --from-cluster, from the cluster's live peers over state sync."""
+    if args.from_cluster:
+        if args.path is None:  # only one positional given
+            args.path = args.aof
+        if args.path is None:
+            print("error: recover --from-cluster requires <path>")
+            return 2
+        return _recover_from_cluster(args)
+    if args.aof is None or args.path is None:
+        print("error: recover requires <aof> <path> "
+              "(or --from-cluster <path>)")
+        return 2
     from .aof import recover
     from .state_machine import StateMachine
     from .vsr.checksum import checksum
@@ -236,23 +328,104 @@ def _open_superblock(args):
 
 
 def cmd_inspect(args) -> int:
+    """Render superblock and WAL-slot dumps — against a healthy file OR
+    a deliberately corrupted one: every bad checksum is FLAGGED in the
+    output, never raised (an inspector that dies on the damage it exists
+    to show is useless). Exit 1 when the file is unopenable (no
+    superblock quorum / corrupt active checkpoint root)."""
     from .vsr.journal import Journal
+    from .vsr.checksum import checksum
+    from .vsr.storage import (SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE,
+                              FileStorage, StorageLayout, TEST_LAYOUT)
+    from .vsr.superblock import SuperBlock
 
-    storage, sb = _open_superblock(args)
+    layout = TEST_LAYOUT if args.small else StorageLayout()
+    storage = FileStorage(args.path, layout=layout)
+    # Per-copy superblock dump (the quorum rule tolerates torn/corrupt
+    # copies — show which ones).
+    for copy in range(SUPERBLOCK_COPIES):
+        raw = storage.read(
+            "superblock", copy * SUPERBLOCK_COPY_SIZE, SUPERBLOCK_COPY_SIZE)
+        sb_copy = SuperBlock.unpack_copy(raw)
+        if sb_copy is None:
+            print(f"superblock copy {copy}: CORRUPT (bad checksum)")
+        else:
+            print(f"superblock copy {copy}: seq={sb_copy.sequence} "
+                  f"view={sb_copy.view} "
+                  f"checkpoint_op={sb_copy.op_checkpoint}")
+    sb = SuperBlock.load(storage)
+    root_ok = False
     if sb is None:
-        return 1
-    print(f"superblock: cluster={sb.cluster} replica={sb.replica_id}/"
-          f"{sb.replica_count} seq={sb.sequence} view={sb.view} "
-          f"checkpoint_op={sb.op_checkpoint} commit_max={sb.commit_max}")
-    print(f"snapshot: slot={sb.snapshot_slot} size={sb.snapshot_size}")
+        print("superblock: no quorum (unformatted or corrupt)")
+    else:
+        print(f"superblock: cluster={sb.cluster} replica={sb.replica_id}/"
+              f"{sb.replica_count} seq={sb.sequence} view={sb.view} "
+              f"checkpoint_op={sb.op_checkpoint} commit_max={sb.commit_max}")
+        if sb.sync_op:
+            print(f"superblock: MID-REBUILD — state-sync install to op "
+                  f"{sb.sync_op} was interrupted; only `recover "
+                  "--from-cluster` may open this file")
+        if sb.snapshot_size <= layout.snapshot_size_max:
+            root = storage.read(
+                "snapshot", sb.snapshot_slot * layout.snapshot_size_max,
+                sb.snapshot_size)
+            root_ok = checksum(root, domain=b"ckptroot") \
+                == sb.snapshot_checksum
+        print(f"snapshot: slot={sb.snapshot_slot} size={sb.snapshot_size} "
+              f"root={'ok' if root_ok else 'CORRUPT (bad checksum)'}")
     journal = Journal(storage)
-    slots = journal.recover()
+    try:
+        slots = journal.recover()
+    except Exception as e:  # defensive: the dump must outlive bad bytes
+        print(f"journal: scan FAILED ({e!r})")
+        slots = []
     clean = sum(1 for s in slots if s.state.value == "clean")
     faulty = sum(1 for s in slots if s.state.value == "faulty")
     print(f"journal: {clean} clean, {faulty} faulty, "
           f"{len(slots) - clean - faulty} unknown; op_max={journal.op_max()}")
+    # WAL-slot dump: every slot holding a prepare (or failing to).
+    for slot, s in enumerate(slots):
+        if s.state.value == "clean" and s.header is None:
+            continue  # formatted-empty
+        if s.header is not None:
+            where = f"op={s.header.op} view={s.header.view}"
+        else:
+            where = "no valid header"
+        mark = {"clean": "ok", "faulty": "CORRUPT (bad checksum)",
+                "unknown": "CORRUPT (unrecognizable)"}[s.state.value]
+        print(f"wal slot {slot:4d}: {where} {mark}")
+    if sb is None or not root_ok:
+        return 1
+    if args.digest:
+        return _inspect_digest(storage, sb)
     if args.integrity:
         return _inspect_integrity(storage, sb)
+    return 0
+
+
+def _inspect_digest(storage, sb) -> int:
+    """State-epoch digest of the checkpointed forest (ops/state_epoch):
+    bit-identical across replicas at the same op_checkpoint, so two
+    offline data files can be compared without byte-diffing grids — the
+    vortex rebuild scenario's acceptance check."""
+    from .ops.state_epoch import combine, oracle_state_digest
+    from .vsr.durable import DurableState
+    from .vsr.replica import _split_root
+
+    root = storage.read(
+        "snapshot", sb.snapshot_slot * storage.layout.snapshot_size_max,
+        sb.snapshot_size)
+    forest_root, _ = _split_root(root)
+    try:
+        state = DurableState(storage).open(forest_root, load_events=False)
+    except Exception as e:
+        print(f"digest: forest open FAILED ({e!r})")
+        return 1
+    comps = oracle_state_digest(state, a_cap=1 << 12)
+    for k in sorted(comps):
+        print(f"digest {k}: {comps[k]:016x}")
+    print(f"digest: checkpoint_op={sb.op_checkpoint} "
+          f"combined={combine(comps):016x}")
     return 0
 
 
@@ -652,8 +825,21 @@ def main(argv=None) -> int:
     p.add_argument("--replica", type=int, required=True)
     p.add_argument("--replica-count", type=int, required=True)
     p.add_argument("--small", action="store_true")
-    p.add_argument("aof")
-    p.add_argument("path")
+    p.add_argument("--from-cluster", action="store_true",
+                   help="rebuild the data file from live peers over "
+                        "state sync instead of an AOF (usage: recover "
+                        "--from-cluster --addresses=... <path>)")
+    p.add_argument("--addresses", default=None,
+                   help="cluster addresses (--from-cluster)")
+    p.add_argument("--listen-port", type=int, default=None,
+                   help="bind this port instead of the advertised one "
+                        "(--from-cluster; lets a fault proxy sit in "
+                        "front — vortex)")
+    p.add_argument("--timeout-s", type=float, default=0,
+                   help="--from-cluster: give up after this many "
+                        "seconds (0 = wait forever)")
+    p.add_argument("aof", nargs="?", default=None)
+    p.add_argument("path", nargs="?", default=None)
     p.set_defaults(fn=cmd_recover)
 
     p = sub.add_parser("repl")
@@ -676,6 +862,10 @@ def main(argv=None) -> int:
     p.add_argument("--integrity", action="store_true",
                    help="verify every reachable grid block, reply slot, "
                    "and the state rebuild (exit 1 on any fault)")
+    p.add_argument("--digest", action="store_true",
+                   help="print the checkpointed forest's state-epoch "
+                        "digest (bit-comparable across replicas at the "
+                        "same checkpoint)")
     p.add_argument("path")
     p.set_defaults(fn=cmd_inspect)
 

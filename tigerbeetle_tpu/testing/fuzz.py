@@ -569,6 +569,200 @@ def _encode_batch(payloads: list) -> bytes:
     return multi_batch.encode([b"".join(payloads)], 128)
 
 
+def fuzz_message_bus(prng: random.Random, iterations: int) -> None:
+    """Frame truncation/corruption/reorder/garbage against the TCP bus's
+    weak delivery contract (reference: message_buffer.zig framing): for
+    ANY byte stream, every delivered message must be a valid frame that
+    was actually sent (drop / duplicate / reorder are allowed; delivering
+    corruption never is) and the event loop must survive."""
+    import selectors as _selectors
+    import socket as _socket
+
+    from ..vsr import message_bus as mb
+    from ..vsr.header import Command, Header, Message
+
+    for _ in range(iterations):
+        got: list = []
+        bus = mb.MessageBus(cluster=7, on_message=got.append,
+                            replica_addresses=[("127.0.0.1", 1)])
+        a, b = _socket.socketpair()
+        b.setblocking(False)
+        conn = mb._Connection(b)
+        bus.connections[b] = conn
+        bus.selector.register(b, _selectors.EVENT_READ, conn)
+        frames = []
+        for i in range(prng.randrange(1, 12)):
+            body = bytes(prng.randrange(256)
+                         for _ in range(prng.randrange(0, 200)))
+            h = Header(command=prng.choice(
+                (Command.ping, Command.commit, Command.prepare_ok)),
+                cluster=7, replica=prng.randrange(3), op=i)
+            frames.append(Message(h.finalize(body), body=body).pack())
+        sent = {Message.unpack(f).header.checksum for f in frames}
+        order = list(frames)
+        if prng.random() < 0.5:
+            prng.shuffle(order)  # reorder: allowed by the contract
+        if prng.random() < 0.3:
+            order.append(prng.choice(order))  # duplicate: allowed too
+        stream = bytearray(b"".join(order))
+        roll = prng.random()
+        if roll < 0.4:
+            # single-bit corruption anywhere (header or body checksum
+            # must catch it: skip-frame for a bad body, connection close
+            # for a bad header)
+            stream[prng.randrange(len(stream))] ^= 1 << prng.randrange(8)
+        elif roll < 0.6:
+            del stream[prng.randrange(len(stream)):]  # truncate the tail
+        elif roll < 0.75:
+            # garbage spliced mid-stream: the bus must close the
+            # connection rather than deliver anything derived from it
+            cut = prng.randrange(len(stream) + 1)
+            junk = bytes(prng.randrange(256)
+                         for _ in range(prng.randrange(1, 64)))
+            stream = stream[:cut] + junk + stream[cut:]
+        try:
+            a.sendall(bytes(stream))
+        except OSError:
+            pass
+        a.close()
+        for _ in range(64):
+            bus.poll(0)
+            if b not in bus.connections:
+                break
+        for m in got:
+            assert m.valid()
+            assert m.header.checksum in sent, \
+                "bus delivered a frame that was never sent"
+        bus.close()
+
+
+def fuzz_storage_faults(prng: random.Random, iterations: int) -> None:
+    """Zone-fault rules (reference: src/testing/storage.zig fault spec):
+    inject only faults the design tolerates — <= 2 of 4 superblock
+    copies, WAL slots in either ring (peer-repairable), the INACTIVE
+    snapshot slot, reachable grid blocks (scrub + peer repair) — plus
+    faults during the rebuild-from-cluster window (decay of freshly
+    installed blocks before certification, crashes between rebuild
+    phases). Recovery must then converge with zero silent divergence
+    (settle() asserts byte-identical checkpoints)."""
+    from .. import multi_batch
+    from ..types import Account, Operation, Transfer
+    from ..vsr.grid_scrubber import GridScrubber
+    from ..vsr.header import HEADER_SIZE
+    from ..vsr.storage import SUPERBLOCK_COPY_SIZE, TEST_LAYOUT
+    from ..vsr.superblock import SuperBlock
+    from .cluster import Cluster
+
+    def transfers_body(specs):
+        payload = b"".join(
+            Transfer(id=i, debit_account_id=1, credit_account_id=2,
+                     amount=amt, ledger=1, code=1).pack()
+            for (i, amt) in specs)
+        return multi_batch.encode([payload], 128)
+
+    zones = TEST_LAYOUT.zone_offsets
+    bs = TEST_LAYOUT.grid_block_size
+
+    def reachable_blocks(replica):
+        # (index, logical size): flips must land inside the checksummed
+        # region — padding beyond `size` is never read back, so rot
+        # there is (by design) invisible and unrepaired.
+        return sorted({(a.index, size)
+                       for _, a, size in replica.scrubber._blocks()})
+
+    for _ in range(iterations):
+        cluster = Cluster(seed=prng.randrange(1 << 30), replica_count=3)
+        client = cluster.client(5)
+
+        def drive(op, body):
+            client.request(op, body)
+            assert cluster.run(4000, until=lambda: client.idle), \
+                cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(prng.randrange(18, 40)):
+            drive(Operation.create_transfers,
+                  transfers_body([(100 + k, 1)]))
+        victim = prng.randrange(3)
+        st = cluster.storages[victim]
+        mode = prng.choice(("restart", "scrub", "rebuild"))
+        if mode == "restart":
+            cluster.crash(victim)
+            # Superblock: at most copies - read_quorum corrupt copies.
+            for copy in prng.sample(range(4), prng.randrange(0, 3)):
+                st.data[zones["superblock"]
+                        + copy * SUPERBLOCK_COPY_SIZE
+                        + prng.randrange(64)] ^= 0xFF
+            # WAL: random bytes in either ring (repair refills them).
+            for _ in range(prng.randrange(0, 6)):
+                ring = prng.choice(("wal_headers", "wal_prepares"))
+                span = (TEST_LAYOUT.slot_count * HEADER_SIZE
+                        if ring == "wal_headers"
+                        else TEST_LAYOUT.slot_count
+                        * TEST_LAYOUT.message_size_max)
+                st.data[zones[ring] + prng.randrange(span)] ^= 0xFF
+            # Snapshot: only the INACTIVE slot — losing the active root
+            # means total data loss, which is the rebuild mode below.
+            sb = SuperBlock.load(st)
+            if sb is not None:
+                off = zones["snapshot"] + (1 - sb.snapshot_slot) \
+                    * TEST_LAYOUT.snapshot_size_max
+                st.data[off + prng.randrange(
+                    TEST_LAYOUT.snapshot_size_max)] ^= 0xFF
+            cluster.restart(victim)
+            cluster.settle(8000)
+        elif mode == "scrub":
+            # Live grid decay: the scrubber must surface it and peer
+            # repair must restore the exact bytes.
+            replica = cluster.replicas[victim]
+            replica.scrubber = GridScrubber(
+                replica.durable.forest, cycle_ticks=8, origin_seed=victim)
+            blocks = reachable_blocks(replica)
+            for block, size in prng.sample(blocks,
+                                           min(len(blocks),
+                                               prng.randrange(1, 4))):
+                st.data[zones["grid"] + block * bs
+                        + prng.randrange(size)] ^= 0xFF
+            ok = cluster.run(8000, until=lambda: (
+                replica.scrubber.cycles >= 1
+                and not replica.scrubber.faults
+                and not replica.block_repair))
+            assert ok, "scrub repair did not converge"
+            cluster.settle()
+        else:  # the rebuild window
+            cluster.destroy_data_file(victim)
+            for k in range(prng.randrange(2, 8)):
+                drive(Operation.create_transfers,
+                      transfers_body([(400 + k, 1)]))
+            replica = cluster.begin_rebuild(victim)
+            if prng.random() < 0.4:
+                # Crash between rebuild phases: throw the half-rebuilt
+                # replica away and start over — must still converge.
+                cluster.run(prng.randrange(10, 200))
+                cluster.crash(victim)
+                replica = cluster.begin_rebuild(victim)
+            ok = cluster.run(16000, until=lambda: (
+                replica._rebuild_synced or replica.rebuild_complete))
+            assert ok, replica.rebuild_progress()
+            if replica._rebuild_synced and not replica._rebuild_certified:
+                # Decay during the rebuild window: a freshly installed
+                # block rots before certification — the certify tour
+                # must catch it and route it through peer repair.
+                blocks = reachable_blocks(replica)
+                if blocks:
+                    block, size = prng.choice(blocks)
+                    st.data[zones["grid"] + block * bs
+                            + prng.randrange(size)] ^= 0xFF
+            ok = cluster.run(16000,
+                             until=lambda: replica.rebuild_complete)
+            assert ok, replica.rebuild_progress() + " | " \
+                + cluster.debug_status()
+            replica.finish_rebuild()
+            cluster.settle()
+
+
 def fuzz_vopr_smoke(prng: random.Random, iterations: int) -> None:
     """One short randomized cluster run per iteration (the full VOPR swarm
     lives in tests/test_vopr.py; this is the registry's smoke entry)."""
@@ -605,6 +799,8 @@ FUZZERS: dict[str, Callable[[random.Random, int], None]] = {
     "client_sessions": fuzz_client_sessions,
     "device_ledger": fuzz_device_ledger,
     "durability": fuzz_durability,
+    "message_bus": fuzz_message_bus,
+    "storage_faults": fuzz_storage_faults,
     "vopr_smoke": fuzz_vopr_smoke,
 }
 
@@ -619,6 +815,8 @@ DEFAULT_ITERATIONS = {
     "client_sessions": 80,
     "device_ledger": 30,
     "durability": 12,
+    "message_bus": 60,
+    "storage_faults": 3,
     "vopr_smoke": 2,
 }
 

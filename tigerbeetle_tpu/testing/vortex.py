@@ -171,6 +171,61 @@ class VortexSupervisor:
 
     # -------------------------------------------------------------- faults
 
+    def destroy_data_file(self, i: int) -> None:
+        """Kill the replica and ZERO its data file in place (total
+        single-replica durable-state loss — the fault `recover
+        --from-cluster` exists for). Zeroing rather than unlinking keeps
+        the torn-media flavor: the file is present, sized, and garbage."""
+        self.kill_replica(i)
+        path = self._data_path(i)
+        size = os.path.getsize(path)
+        with open(path, "r+b") as f:
+            chunk = 1 << 20
+            for off in range(0, size, chunk):
+                f.write(b"\x00" * min(chunk, size - off))
+            f.flush()
+            os.fsync(f.fileno())
+
+    def run_rebuild(self, i: int, *, timeout_s: float = 180,
+                    crash_after_s: Optional[float] = None) -> int:
+        """Run `recover --from-cluster` for replica i as a real process
+        (the replica itself must be down). With crash_after_s the
+        process is SIGKILLed after that delay — the crash-mid-rebuild
+        injection; a re-run must then restart the rebuild cleanly.
+        Returns the process's exit code (negative = killed)."""
+        assert self.procs[i] is None, "stop the replica before rebuilding"
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tigerbeetle_tpu", "recover",
+             "--from-cluster", f"--addresses={self.addresses}",
+             f"--replica={i}", f"--cluster={self.cluster}",
+             f"--replica-count={self.replica_count}", "--small",
+             f"--listen-port={self.real_ports[i]}",
+             f"--timeout-s={timeout_s}", self._data_path(i)],
+            cwd="/root/repo", env=dict(os.environ),
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        if crash_after_s is not None:
+            time.sleep(crash_after_s)
+            proc.kill()
+        return proc.wait(timeout=timeout_s + 30)
+
+    def forest_digest(self, i: int) -> tuple[int, int]:
+        """(op_checkpoint, combined state-epoch digest) of replica i's
+        data file, offline (the replica must be stopped). Replicas at
+        the same op_checkpoint must digest bit-identically."""
+        out = subprocess.run(
+            [sys.executable, "-m", "tigerbeetle_tpu", "inspect",
+             "--small", "--digest", self._data_path(i)],
+            capture_output=True, text=True, cwd="/root/repo", timeout=120)
+        assert out.returncode == 0, f"r{i} digest: {out.stdout}"
+        ckpt = digest = None
+        for line in out.stdout.splitlines():
+            if line.startswith("digest: "):
+                parts = dict(kv.split("=") for kv in line.split()[1:])
+                ckpt = int(parts["checkpoint_op"])
+                digest = int(parts["combined"], 16)
+        assert ckpt is not None, out.stdout
+        return ckpt, digest
+
     def kill_replica(self, i: int) -> None:
         proc = self.procs[i]
         if proc is None:

@@ -92,6 +92,25 @@ class GridScrubber:
         start = self.origin_seed % len(blocks)
         return iter(blocks[start:] + blocks[:start])
 
+    def certify(self) -> list[tuple[str, BlockAddress, int]]:
+        """One immediate, unpaced full tour: validate EVERY reachable
+        block now and return the faults (also recorded in self.faults).
+        This is the post-rebuild certification pass — a freshly installed
+        checkpoint (recover --from-cluster) is only trusted once every
+        block it reaches has been read back from the media and matched
+        its parent-held checksum. Orthogonal to the paced background
+        tour: the incremental iterator/pacing state is untouched."""
+        found: list[tuple[str, BlockAddress, int]] = []
+        for name, address, size in self._blocks():
+            self.checked += 1
+            try:
+                self.forest.grid.read_block(address, size,
+                                            bypass_cache=True)
+            except IOError:
+                found.append((name, address, size))
+                self.faults[address.index] = (name, address, size)
+        return found
+
     def still_referenced(self, address: BlockAddress) -> bool:
         """True iff the CURRENT manifests still reach this exact address.
         The tour snapshot is taken at tour start, so a block freed and

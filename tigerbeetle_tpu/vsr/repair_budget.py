@@ -29,6 +29,13 @@ class RepairBudget:
             self.tokens = min(self.capacity, self.tokens + earned)
             self.last_refill_ns += earned * self.refill_interval_ns
 
+    def available(self, now_ns: int) -> int:
+        """Tokens spendable right now (after refill) — lets a caller
+        size a burst (e.g. the post-rebuild certification's block-repair
+        batches) to the budget instead of probing one token at a time."""
+        self.refill(now_ns)
+        return self.tokens
+
     def spend(self, now_ns: int, amount: int = 1) -> bool:
         """True (and deducts) if the budget allows `amount` repair sends."""
         self.refill(now_ns)

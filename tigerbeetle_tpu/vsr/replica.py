@@ -156,6 +156,22 @@ class Replica:
         self._scrub_phase = 0
 
         self.status = "recovering"
+        # Rebuild-from-cluster mode (reference: src/vsr/replica_reformat
+        # .zig): a replica whose data file was lost/zeroed re-enters the
+        # cluster WITHOUT a vote — it solicits a peer checkpoint, installs
+        # it via state sync (staged: superblock sync_op brackets the grid
+        # writes), repairs the WAL suffix through normal VSR repair, and
+        # certifies the installed grid with a full scrub tour before it is
+        # allowed to ack, nack, or elect again. Its lost promises are only
+        # safe to forget because it rejoins at/above the cluster's durable
+        # checkpoint while a healthy quorum carries the log.
+        self.rebuilding = False
+        self._rebuild_goal = 0  # cluster commit to catch up to (frozen)
+        self._rebuild_heard = False  # a peer answered the solicitation
+        self._rebuild_synced = False  # a checkpoint install happened
+        self._rebuild_certified = False  # full scrub tour came back clean
+        self._rebuild_solicit_last = 0
+        self._rebuild_certify_last = 0
         self.view = 0
         self.log_view = 0
         self.op = 0  # highest op appended to our journal
@@ -263,6 +279,15 @@ class Replica:
         assert sb is not None, "data file not formatted"
         assert sb.cluster == self.cluster
         assert sb.replica_id == self.replica_id
+        if sb.sync_op:
+            # A state-sync install was torn by a crash: the grid may hold
+            # a mix of old- and new-checkpoint blocks. Half-installed
+            # state must never serve reads or vote — only the rebuild
+            # path (which re-validates every block it keeps) may open it.
+            raise RuntimeError(
+                f"data file is mid-rebuild (state-sync install to op "
+                f"{sb.sync_op} was interrupted) — run "
+                "`recover --from-cluster` to finish the rebuild")
         if not self.releases.openable(sb.release):
             if self.releases.compatible(sb.release):
                 raise RuntimeError(
@@ -341,6 +366,112 @@ class Replica:
                 if m is not None:
                     self._primary_adopt_canonical(m)
 
+    def open_rebuild(self) -> None:
+        """Open a blank / suspect data file for rebuild-from-cluster
+        (reference: src/vsr/replica_reformat.zig): (re)format if the file
+        is unformatted, mid-install (sync_op), or its checkpoint root is
+        corrupt, then open passively. The grid zone survives a reformat —
+        every block a later sync install reuses is validated against the
+        offered root's checksums, so blocks fetched before a crash resume
+        the transfer for free (delta sync) while clobbered ones are simply
+        re-fetched."""
+        sb = SuperBlock.load(self.storage)
+        needs_format = (sb is None or sb.sync_op != 0
+                        or sb.cluster != self.cluster
+                        or sb.replica_id != self.replica_id)
+        if not needs_format:
+            root = self.storage.read(
+                "snapshot",
+                sb.snapshot_slot * self.storage.layout.snapshot_size_max,
+                sb.snapshot_size)
+            if checksum(root, domain=b"ckptroot") != sb.snapshot_checksum:
+                needs_format = True
+        if needs_format:
+            Replica.format(self.storage, cluster=self.cluster,
+                           replica_id=self.replica_id,
+                           replica_count=self.replica_count)
+        self.rebuilding = True
+        self.open()
+        # A persisted log_view < view would open as "view_change", whose
+        # liveness branch elects — a rebuilding replica never does. It
+        # follows the live electorate passively and adopts whatever view
+        # the cluster's start_view teaches it.
+        self.status = "normal"
+
+    @property
+    def rebuild_complete(self) -> bool:
+        """The rebuild reached its frozen goal: checkpoint installed (or
+        reachable via WAL repair), committed up to the cluster commit
+        observed at first contact, and the grid certified by a clean full
+        scrub tour."""
+        return (self.rebuilding and self._rebuild_heard
+                and self.syncing is None
+                and self.commit_min >= self._rebuild_goal
+                and self._rebuild_certified)
+
+    def finish_rebuild(self) -> None:
+        """Re-enter the voting set (only once the rebuild is complete)."""
+        assert self.rebuild_complete
+        self.rebuilding = False
+
+    def rebuild_progress(self) -> str:
+        """One-line operator-facing progress (recover --from-cluster)."""
+        if self.syncing is not None:
+            have = len(self.syncing["have"])
+            return (f"syncing checkpoint op {self.syncing['target_op']} "
+                    f"from r{self.syncing['source']}: {have} blocks "
+                    f"staged, {len(self.syncing['needed'])} to fetch")
+        if not self._rebuild_heard:
+            return "soliciting a checkpoint from the cluster"
+        if self.commit_min < self._rebuild_goal:
+            return (f"repairing WAL suffix: commit {self.commit_min}/"
+                    f"{self._rebuild_goal}")
+        if not self._rebuild_certified:
+            return (f"certifying grid ({len(self.block_repair)} "
+                    "blocks awaiting peer repair)")
+        return (f"complete: checkpoint op "
+                f"{self.superblock.op_checkpoint}, commit "
+                f"{self.commit_min}")
+
+    def _rebuild_tick(self, now: int) -> None:
+        """Drive the rebuild: solicit a checkpoint until a peer answers,
+        then certify the installed grid once caught up. The actual data
+        movement rides the existing machinery (sync offers, block fetch,
+        WAL repair)."""
+        if (self.syncing is None
+                and not (self._rebuild_heard
+                         and self.commit_min >= self._rebuild_goal)
+                and now - self._rebuild_solicit_last
+                >= 4 * self.options.repair_interval_ns):
+            # context=1: "I cannot trust any served prepare" — a peer
+            # whose checkpoint covers the op answers with a sync offer,
+            # the primary answers with start_view otherwise.
+            self._rebuild_solicit_last = now
+            header = Header(
+                command=Command.request_prepare, cluster=self.cluster,
+                replica=self.replica_id, view=self.view,
+                op=self.commit_min + 1, context=1)
+            msg = Message(header.finalize())
+            for r in range(self.peer_count):
+                if r != self.replica_id:
+                    self.bus.send_to_replica(r, msg)
+        if (self.syncing is None and self._rebuild_heard
+                and self.commit_min >= self._rebuild_goal
+                and not self._rebuild_certified
+                and not self.block_repair
+                and now - self._rebuild_certify_last
+                >= 8 * self.options.repair_interval_ns):
+            # Post-rebuild certification: one immediate full scrub tour.
+            # Faults queue for peer repair (within the repair budget);
+            # only a tour with zero faults AND an empty repair queue
+            # certifies.
+            self._rebuild_certify_last = now
+            faults = self.scrubber.certify()
+            for name, address, size in faults:
+                self.block_repair[address.index] = (name, address, size)
+            if not faults:
+                self._rebuild_certified = True
+
     def _journal_contiguous_max(self, from_op: int) -> int:
         """Highest op such that every (from_op, op] slot holds a valid,
         hash-chained prepare."""
@@ -362,7 +493,11 @@ class Replica:
 
     @property
     def is_primary(self) -> bool:
-        return self.status == "normal" and self.primary_index() == self.replica_id
+        # A rebuilding replica is never primary, whatever the view math
+        # says: half-installed state must not serve reads or assign ops.
+        return (self.status == "normal"
+                and self.primary_index() == self.replica_id
+                and not self.rebuilding)
 
     @property
     def peer_count(self) -> int:
@@ -527,7 +662,8 @@ class Replica:
             if held is None or held.header.checksum != h.checksum:
                 self.journal.append(msg)  # overwrite a stale same-op prepare
             self.op = max(self.op, h.op)
-            if self.is_standby or self._pending_view is not None:
+            if self.is_standby or self.rebuilding \
+                    or self._pending_view is not None:
                 pass  # no vote; a pending primary finalizes below instead
             elif not self.is_primary:
                 self.journal.on_slot_durable(
@@ -559,7 +695,7 @@ class Replica:
                 held = msg
                 self._commit_journal(self.commit_max)
             if held is not None and held.header.checksum == h.checksum \
-                    and not self.is_standby:
+                    and not self.is_standby and not self.rebuilding:
                 # Ack only what we actually hold — and only once the slot
                 # is durable (an in-flight async append is not yet ours
                 # to vouch for).
@@ -567,7 +703,7 @@ class Replica:
                     h.op, lambda h=h: self._send_prepare_ok(h))
         elif h.op == self.op + 1 and h.parent == self._prepare_checksum(self.op):
             self.journal.append(
-                msg, on_durable=(None if self.is_standby
+                msg, on_durable=(None if self.is_standby or self.rebuilding
                                  else lambda h=h: self._send_prepare_ok(h)))
             self.op = h.op
         else:
@@ -1011,7 +1147,9 @@ class Replica:
     # ---------------------------------------------------------- view change
 
     def _start_view_change(self, new_view: int) -> None:
-        assert not self.is_standby  # standbys follow, never elect
+        # Standbys follow, never elect; a rebuilding replica's empty
+        # journal must never weigh in a view change either.
+        assert not self.is_standby and not self.rebuilding
         assert new_view > self.view
         self._pending_view = None
         self.status = "view_change"
@@ -1034,7 +1172,7 @@ class Replica:
 
     def on_start_view_change(self, msg: Message) -> None:
         v = msg.header.view
-        if self.is_standby or v < self.view:
+        if self.is_standby or self.rebuilding or v < self.view:
             return
         if v > self.view:
             self._start_view_change(v)
@@ -1095,7 +1233,7 @@ class Replica:
         return out
 
     def on_do_view_change(self, msg: Message) -> None:
-        if self.is_standby:
+        if self.is_standby or self.rebuilding:
             return
         v = msg.header.view
         if v < self.view or self.primary_index(v) != self.replica_id:
@@ -1257,6 +1395,12 @@ class Replica:
         h = msg.header
         if h.view < self.view or h.replica != self.primary_index(h.view):
             return
+        if self.rebuilding and not self._rebuild_heard:
+            # First contact is the primary itself (no peer checkpoint
+            # covers us yet): the goal is its commit_max — reachable
+            # through ordinary WAL repair under the canonical suffix.
+            self._rebuild_heard = True
+            self._rebuild_goal = h.commit
         self.view = h.view
         self.log_view = h.view
         self.status = "normal"
@@ -1324,12 +1468,15 @@ class Replica:
         wanted = msg.header.parent  # canonical checksum sought (0: unknown)
         if m is not None:
             self.bus.send_to_replica(msg.header.replica, m)
-            if wanted != 0 and m.header.checksum != wanted:
+            if wanted != 0 and m.header.checksum != wanted \
+                    and not self.rebuilding:
                 # We hold a DIFFERENT prepare for this op. A replica
                 # prepares at most one body per op, so holding another
                 # checksum proves we never prepared the canonical one —
                 # the served prepare won't satisfy the repair, but the
-                # nack can complete a truncation quorum.
+                # nack can complete a truncation quorum. (A rebuilding
+                # replica lost its promise history with its data file —
+                # it can prove nothing and must not nack.)
                 self._send_nack(msg.header.replica, msg.header.op, wanted)
         elif (self.superblock is not None
               and msg.header.op <= self.superblock.op_checkpoint):
@@ -1337,7 +1484,8 @@ class Replica:
             # never repair forward — offer our checkpoint instead
             # (reference: state sync, docs/internals/sync.md:49-79).
             self._send_sync_offer(msg.header.replica)
-        elif msg.header.op > self.commit_min and not self.is_standby:
+        elif msg.header.op > self.commit_min and not self.is_standby \
+                and not self.rebuilding:
             # Nothing servable for this op. We may nack only if we can
             # PROVE we never prepared it: the slot must not be a torn
             # write of it (faulty), and the header ring must not hold its
@@ -1430,6 +1578,13 @@ class Replica:
         from . import durable as durable_mod
 
         h = msg.header
+        if self.rebuilding and not self._rebuild_heard:
+            # Freeze the rebuild goal at first contact: the offering
+            # peer's commit_max is a finite catch-up target even under
+            # live traffic (the replica keeps following afterwards; the
+            # goal only gates when the rebuild may DECLARE completion).
+            self._rebuild_heard = True
+            self._rebuild_goal = max(h.commit, h.op)
         if h.op <= self.commit_min:
             return  # not ahead of us
         if not self.releases.openable(h.release):
@@ -1586,9 +1741,18 @@ class Replica:
             # Corrupted transfer or bad offer: drop and re-request later.
             self.syncing = None
             return
+        sb = self.superblock
+        # Staged install: persist the sync-progress record BEFORE the
+        # first grid write. The incoming blocks may land on indices the
+        # current checkpoint still references, so a crash mid-install
+        # leaves a grid that belongs to NEITHER checkpoint — the nonzero
+        # sync_op makes a normal open refuse the file (rebuild-only),
+        # and the final store below clears it in the same flip that
+        # adopts the installed checkpoint (atomic via the copy quorum).
+        sb.sync_op = sync["target_op"]
+        sb.store(self.storage)
         for index, raw in sorted(sync["have"].items()):
             self.storage.write("grid", index * block_size, raw)
-        sb = self.superblock
         slot = 1 - sb.snapshot_slot
         self.storage.write(
             "snapshot", slot * self.storage.layout.snapshot_size_max, root)
@@ -1616,7 +1780,11 @@ class Replica:
         sb.release = sync["release"]
         sb.view = self.view
         sb.log_view = self.log_view
+        sb.sync_op = 0  # install complete: clear the staged record
         sb.store(self.storage)
+        if self.rebuilding:
+            self._rebuild_synced = True
+            self._rebuild_certified = False  # re-certify the new grid
         self.commit_min = sync["target_op"]
         self.commit_max = max(self.commit_max, sync["commit_max"])
         self.op = max(self.op, sync["target_op"])
@@ -1800,17 +1968,25 @@ class Replica:
         for index in [i for i, (_, a, _) in self.block_repair.items()
                       if not self.scrubber.still_referenced(a)]:
             del self.block_repair[index]
-        if self.block_repair and self.syncing is None \
-                and self.repair_budget.spend(now):
-            body = b"".join(struct.pack("<Q", i)
-                            for i in sorted(self.block_repair)[:16])
-            header = Header(
-                command=Command.request_blocks, cluster=self.cluster,
-                replica=self.replica_id, view=self.view)
-            msg = Message(header.finalize(body), body=body)
-            for r in range(self.peer_count):
-                if r != self.replica_id:
-                    self.bus.send_to_replica(r, msg)
+        if self.block_repair and self.syncing is None:
+            # Batch size follows the budget: one token per 16-block
+            # request, bursting up to the available tokens — the
+            # post-rebuild certification can queue a whole grid's worth
+            # of faults, and draining them one token per tick would
+            # stretch the passive window needlessly.
+            batches = min(self.repair_budget.available(now),
+                          -(-len(self.block_repair) // 16))
+            if batches and self.repair_budget.spend(now, batches):
+                body = b"".join(
+                    struct.pack("<Q", i)
+                    for i in sorted(self.block_repair)[:16 * batches])
+                header = Header(
+                    command=Command.request_blocks, cluster=self.cluster,
+                    replica=self.replica_id, view=self.view)
+                msg = Message(header.finalize(body), body=body)
+                for r in range(self.peer_count):
+                    if r != self.replica_id:
+                        self.bus.send_to_replica(r, msg)
         # Reply repair: refill missing client replies from peers.
         missing = self.sessions.missing_replies()
         if missing and now - self._reply_repair_last >= \
@@ -1918,7 +2094,7 @@ class Replica:
                 self._progress_ts = now
             elif self.op <= self.commit_max:
                 self._progress_ts = now  # nothing outstanding: no stall
-            elif (not self.is_standby
+            elif (not self.is_standby and not self.rebuilding
                   and now - self._progress_ts
                   >= 2 * self.options.view_change_timeout_ns):
                 self._progress_ts = now
@@ -1931,7 +2107,7 @@ class Replica:
                            max(self.fault_detector.deadline_ns(),
                                2 * self.options.heartbeat_interval_ns))
             if now - self.last_heartbeat_rx >= deadline:
-                if self.is_standby:
+                if self.is_standby or self.rebuilding:
                     # Follow the electorate: probe every active replica for
                     # the current view instead of electing (whichever is
                     # primary answers with start_view).
@@ -1949,6 +2125,8 @@ class Replica:
             if now - self.last_heartbeat_rx >= 2 * self.options.view_change_timeout_ns:
                 self.last_heartbeat_rx = now
                 self._start_view_change(self.view + 1)
+        if self.rebuilding:
+            self._rebuild_tick(now)
         self._repair(now)
         # Background scrub: a few grid block validations per phase window
         # (reference: grid_scrubber.zig incremental tour); faults queue for

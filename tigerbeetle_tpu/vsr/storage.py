@@ -86,6 +86,21 @@ class Storage:
     def sync(self) -> None:
         pass
 
+    def erase(self) -> None:
+        """Zero the entire data file (the vortex data-file-destruction
+        fault: total single-replica data loss, recoverable only via
+        `recover --from-cluster`). Chunked so a production-size file
+        never materializes in memory at once."""
+        chunk = 1 << 20
+        zones = self.layout.zone_offsets
+        names = [z for z in zones if z != "_end"]
+        for i, zone in enumerate(names):
+            size = (zones[names[i + 1]] if i + 1 < len(names)
+                    else zones["_end"]) - zones[zone]
+            for off in range(0, size, chunk):
+                self.write(zone, off, b"\x00" * min(chunk, size - off))
+        self.sync()
+
     # ------------------------------------------------ async (optional)
     # Overlapped IO for the WAL path (reference: src/io/linux.zig). The
     # default implementation is synchronous-only: write_pair_async

@@ -10,6 +10,14 @@ the flip atomic.
 The superblock here references the current checkpoint snapshot (A/B slot,
 size, checksum) and persists the VSR state the protocol must not forget
 (view, log_view, commit_min/max, checkpoint id chain).
+
+`sync_op` is the staged-install record (reference: the superblock's
+vsr_state.sync_op_min/max brackets a state sync the same way): it is
+persisted BEFORE a state-sync install writes its first grid block and
+cleared in the same store that flips to the installed checkpoint. A
+nonzero sync_op therefore proves the data file is mid-install — grid
+bytes may be half-written — and a normal open must refuse it (recover
+--from-cluster restarts the rebuild cleanly instead).
 """
 
 from __future__ import annotations
@@ -23,7 +31,7 @@ from .storage import SUPERBLOCK_COPIES, SUPERBLOCK_COPY_SIZE, Storage
 
 READ_QUORUM = 2  # of 4 copies (tolerates one torn write + one latent fault)
 
-_FMT = struct.Struct("<16sQQQQQQQQQQIIQ16s")
+_FMT = struct.Struct("<16sQQQQQQQQQQQIIQ16s")
 
 
 @dataclasses.dataclass
@@ -38,6 +46,10 @@ class SuperBlock:
     commit_max: int = 0
     op_checkpoint: int = 0
     checkpoint_id: int = 0  # hash-chained across checkpoints
+    # Staged-install record: target op of an in-progress state-sync
+    # install (0 = none). Nonzero across a restart means the install was
+    # torn — the grid is suspect and a normal open must refuse.
+    sync_op: int = 0
     snapshot_slot: int = 0  # 0 or 1 (A/B)
     release: int = 0  # release that wrote this checkpoint (multiversion)
     snapshot_size: int = 0
@@ -50,6 +62,7 @@ class SuperBlock:
             self.sequence, self.view, self.log_view,
             self.commit_min, self.commit_max, self.op_checkpoint,
             self.checkpoint_id & ((1 << 64) - 1),
+            self.sync_op,
             self.snapshot_slot, self.release,
             self.snapshot_size,
             self.snapshot_checksum.to_bytes(16, "little"),
@@ -71,9 +84,9 @@ class SuperBlock:
             cluster=f[1], replica_id=f[2], replica_count=f[3],
             sequence=f[4], view=f[5], log_view=f[6],
             commit_min=f[7], commit_max=f[8], op_checkpoint=f[9],
-            checkpoint_id=f[10],
-            snapshot_slot=f[11], release=f[12], snapshot_size=f[13],
-            snapshot_checksum=int.from_bytes(f[14], "little"),
+            checkpoint_id=f[10], sync_op=f[11],
+            snapshot_slot=f[12], release=f[13], snapshot_size=f[14],
+            snapshot_checksum=int.from_bytes(f[15], "little"),
         )
 
     # ----------------------------------------------------------------- io
