@@ -217,6 +217,11 @@ def run_bench(platform: str, timeout_s: float) -> dict:
                     partial.update(json.loads(line[len("##bench "):]))
                 except json.JSONDecodeError:
                     pass
+            elif line.startswith("##trace "):
+                try:
+                    partial.update(json.loads(line[len("##trace "):]))
+                except json.JSONDecodeError:
+                    pass
             elif line.startswith("{"):
                 try:
                     final = json.loads(line)
@@ -235,6 +240,74 @@ def run_bench(platform: str, timeout_s: float) -> dict:
         "stderr_tail": err_tail,
     })
     return partial
+
+
+def trace_overhead_probe(quick: bool) -> dict:
+    """Tracing-cost guard: the SAME in-process replica commit loop run
+    twice — once with the NullTracer default, once under recording
+    tracers — so the record carries both wall clocks every run and a
+    tracing-cost regression is visible in the devhub history like any
+    throughput regression. The recording run's per-commit-stage
+    aggregates double as the devhub "commit pipeline" panel's data."""
+    from tigerbeetle_tpu import constants, multi_batch
+    from tigerbeetle_tpu.state_machine import StateMachine
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.trace import Tracer
+    from tigerbeetle_tpu.types import Account, Operation, Transfer
+
+    n_ops = 16 if quick else 48
+    was_verify = constants.VERIFY
+
+    def run(tracer_factory, ops=None):
+        # Oracle engine: a pure-Python commit pipeline, so the two runs
+        # differ ONLY by the tracer (no jit warmup to launder the
+        # comparison) and the tracer's share of the wall clock is at its
+        # honest maximum.
+        t0 = time.perf_counter()
+        cluster = Cluster(seed=17, replica_count=1,
+                          tracer_factory=tracer_factory,
+                          state_machine_factory=lambda: StateMachine(
+                              engine="oracle"))
+        client = cluster.client(5)
+
+        def drive(op, body):
+            client.request(op, body)
+            assert cluster.run(4000, until=lambda: client.idle), \
+                cluster.debug_status()
+
+        drive(Operation.create_accounts, multi_batch.encode(
+            [b"".join(Account(id=i, ledger=1, code=1).pack()
+                      for i in (1, 2))], 128))
+        for k in range(n_ops if ops is None else ops):
+            drive(Operation.create_transfers, multi_batch.encode(
+                [Transfer(id=900 + k, debit_account_id=1,
+                          credit_account_id=2, amount=1 + k,
+                          ledger=1, code=1).pack()], 128))
+        return time.perf_counter() - t0, cluster
+
+    try:
+        run(None, ops=2)  # untimed warmup: imports, first-touch caches
+        null_s, _ = run(None)  # NullTracer default
+        tracers = {}
+
+        def mk(i):
+            tracers[i] = Tracer(pid=i)
+            return tracers[i]
+
+        recording_s, _ = run(mk)
+    finally:
+        constants.set_verify(was_verify)  # Cluster turns it on globally
+    stages = {k: v for k, v in tracers[0].aggregates.snapshot().items()
+              if k.startswith("commit_")}
+    spans = sum(s["count"] for s in stages.values())
+    return {
+        "ops": n_ops + 1,
+        "null_s": round(null_s, 4),
+        "recording_s": round(recording_s, 4),
+        "overhead_ratio": round(recording_s / null_s, 4) if null_s else None,
+        "spans_recorded": spans,
+        "commit_stages": stages,
+    }
 
 
 def inner_main() -> None:
@@ -353,6 +426,16 @@ def inner_main() -> None:
     # fallback-diagnostics table. The full table incl. deep/sharded
     # tiers plus the gate ceilings live in perf/opbudget.py +
     # perf/opbudget_r06.json.
+    # Tracing-cost record (##trace): NullTracer vs recording tracer on
+    # one replica commit loop, plus the recorded per-commit-stage
+    # aggregates (the devhub commit-pipeline panel renders them).
+    trace_probe = None
+    try:
+        trace_probe = trace_overhead_probe(quick)
+    except Exception as e:  # never let the probe kill a bench run
+        trace_probe = {"error": str(e)[:200]}
+    print("##trace " + json.dumps({"trace": trace_probe}), flush=True)
+
     opbudget = None
     try:
         import importlib.util
@@ -397,6 +480,8 @@ def inner_main() -> None:
         # Heavy-op census of the kernels this run dispatched (see the
         # ##opbudget line / perf/opbudget.py).
         "opbudget": opbudget,
+        # Tracing-cost guard + commit-stage shares (##trace line).
+        "trace": trace_probe,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.

@@ -23,8 +23,11 @@ supervisor — zero-silent-corruption asserted per seed; skip with
 --no-chaos), the REBUILD smoke (3-replica in-process cluster, zero one
 data file under load, recover-from-cluster, state-epoch digest match,
 plus one fixed seed each of the message_bus and storage_faults
-fuzzers; skip with --no-rebuild), and the op-budget check + jaxhound
-serving-path lints
+fuzzers; skip with --no-rebuild), the TRACE-CATALOG coverage leg
+(testing/trace_coverage.py: the smokes re-run under recording tracers;
+red when any event in tigerbeetle_tpu/trace/event.py is never emitted
+or an off-catalog name is emitted; skip with --no-trace-cov), and the
+op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
 (perf/opbudget_r06.json), bakes a >4 KiB closure constant into a
@@ -151,6 +154,33 @@ def run_rebuild(timeout: int = 600) -> int:
     return rc
 
 
+def run_trace_coverage(timeout: int = 900) -> int:
+    """Trace-catalog coverage leg: the vopr/chaos/rebuild-style smokes
+    (plus deterministic scenarios for rare events) run under recording
+    tracers; RED if any catalog event (tigerbeetle_tpu/trace/event.py)
+    is never emitted, or any emitted name is off-catalog (the recording
+    tracer hard-errors on those). Skip with --no-trace-cov."""
+    cmd = [sys.executable, "-c",
+           "import sys; "
+           "from tigerbeetle_tpu.testing import trace_coverage; "
+           "sys.exit(trace_coverage.coverage_main())"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] trace-cov: catalog coverage "
+          "(testing/trace_coverage.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: trace-cov timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] trace-cov rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -179,6 +209,9 @@ def main() -> int:
     ap.add_argument("--no-rebuild", action="store_true",
                     help="skip the rebuild-from-cluster smoke + new "
                          "fuzzer seeds")
+    ap.add_argument("--no-trace-cov", action="store_true",
+                    help="skip the trace-catalog coverage leg (dead/"
+                         "off-catalog metric detection)")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -200,6 +233,10 @@ def main() -> int:
         rc = run_rebuild()
         if rc != 0:
             reds.append(f"rebuild rc={rc}")
+    if not args.no_trace_cov:
+        rc = run_trace_coverage()
+        if rc != 0:
+            reds.append(f"trace-cov rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

@@ -79,19 +79,22 @@ class TestMarzullo:
 
 class TestTracer:
     def test_spans_and_chrome_dump(self, tmp_path):
+        from tigerbeetle_tpu.trace import Event
+
         tracer = Tracer()
-        with tracer.span("commit", op=1):
+        with tracer.span(Event.commit_execute, op=1, operation=2,
+                         window=1):
             pass
-        tracer.count("commits")
-        tracer.count("commits", 2)
-        tracer.gauge("pipeline_depth", 3)
+        tracer.count(Event.commits)
+        tracer.count(Event.commits, 2)
+        tracer.gauge(Event.bus_pool_used, 3)
         assert tracer.counters["commits"] == 3
-        assert tracer.gauges["pipeline_depth"] == 3
+        assert tracer.gauges["bus_pool_used"] == 3
         path = tmp_path / "trace.json"
         tracer.dump_chrome_trace(str(path))
         doc = json.loads(path.read_text())
-        assert doc["traceEvents"][0]["name"] == "commit"
-        assert doc["traceEvents"][0]["ph"] == "X"
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert spans[0]["name"] == "commit_execute"
 
     def test_statsd_datagram_format(self):
         captured = []

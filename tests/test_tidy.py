@@ -55,6 +55,44 @@ def test_reference_citations_present():
         assert re.search(r"src/[\w/]+\.zig", text), f"{rel} lacks citations"
 
 
+TRACE_CALL = re.compile(
+    r"\btracer\.(?:span|count|gauge|begin|end)\(\s*(['\"]?)(Event\.(\w+))?")
+
+
+def test_tracer_call_sites_use_catalog_members():
+    """ISSUE 5 satellite: every tracer.span/count/gauge/begin/end call
+    site references a typed catalog member (trace/event.py), never a
+    string literal — the recording tracer would reject a free-form name
+    at runtime, but the lint catches it before anything runs."""
+    from tigerbeetle_tpu.trace import Event
+
+    for path in _python_files():
+        rel = path.relative_to(PACKAGE)
+        if rel.parts and rel.parts[0] == "trace":
+            continue  # the tracer's own internals
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = TRACE_CALL.search(line)
+            if m is None or "# tidy:allow" in line:
+                continue
+            assert not m.group(1), \
+                f"{rel}:{i}: tracer call with a string literal — use " \
+                f"trace.Event members: {line.strip()}"
+            if m.group(3):
+                assert hasattr(Event, m.group(3)), \
+                    f"{rel}:{i}: Event.{m.group(3)} is not in the catalog"
+
+
+def test_monitoring_doc_lists_every_catalog_event():
+    """docs/operating/monitoring.md is the operator rendering of the
+    catalog: a new event without a documented meaning cannot ship."""
+    from tigerbeetle_tpu.trace import Event
+
+    doc = (REPO / "docs" / "operating" / "monitoring.md").read_text()
+    missing = [e.name for e in Event if f"`{e.name}`" not in doc]
+    assert not missing, \
+        f"monitoring.md lacks catalog events: {missing}"
+
+
 def test_no_reference_code_imports():
     """Nothing may read from /root/reference at runtime."""
     for path in _python_files():

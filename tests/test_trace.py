@@ -1,0 +1,349 @@
+"""Trace subsystem tests: typed catalog enforcement, StatsD emitter
+(DogStatsD line format, best-effort, aggregate-flush reset), ring
+eviction self-description, wall-clock anchoring, and the cluster-wide
+trace merge — including the ISSUE 5 acceptance: a 3-replica vortex run
+with tracing enabled yields ONE merged Chrome/Perfetto JSON with
+per-commit-stage spans from every replica on a common timeline."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from tigerbeetle_tpu.trace import (
+    CATALOG,
+    Event,
+    EventKind,
+    NullTracer,
+    StatsD,
+    TID_BASE,
+    Tracer,
+    merge_traces,
+)
+
+COMMIT_STAGES = ("commit_prefetch", "commit_execute", "commit_compact",
+                 "commit_checkpoint")
+
+
+# ------------------------------------------------------------- catalog
+
+class TestCatalog:
+    def test_freeform_names_are_hard_errors(self):
+        t = Tracer()
+        with pytest.raises(KeyError):
+            t.span("commit")  # the pre-catalog free-form name
+        with pytest.raises(KeyError):
+            t.count("made_up_metric")
+        with pytest.raises(KeyError):
+            t.gauge("made_up_gauge", 1.0)
+
+    def test_kind_and_tag_schema_enforced(self):
+        t = Tracer()
+        with pytest.raises(ValueError):
+            t.count(Event.commit_execute)  # a span used as a counter
+        with pytest.raises(ValueError):
+            t.span(Event.commit_execute, op=1, bogus_tag=2)
+        with pytest.raises(ValueError):
+            t.gauge(Event.commits, 1.0)  # a counter used as a gauge
+
+    def test_string_names_resolve_to_catalog(self):
+        t = Tracer()
+        with t.span("commit_execute", op=1, operation=2, window=1):
+            pass
+        assert t.events[-1]["name"] == "commit_execute"
+
+    def test_null_tracer_accepts_anything(self):
+        t = NullTracer()
+        with t.span("anything", foo=1):
+            pass
+        t.count("anything")
+        t.gauge("anything", 2.0)
+        t.begin("whatever")
+        t.end("whatever")
+
+    def test_stable_tid_lanes(self):
+        """Each span event owns a fixed lane range; overlapping
+        occurrences land on distinct lanes within it."""
+        t = Tracer()
+        a = t.span(Event.grid_repair_block)
+        b = t.span(Event.grid_repair_block)
+        with a:
+            with b:
+                pass
+        tids = [e["tid"] for e in t.events]
+        base = TID_BASE[Event.grid_repair_block]
+        assert sorted(tids) == [base, base + 1]
+
+    def test_catalog_members_are_well_formed(self):
+        for ev in Event:
+            assert ev.value.doc, f"{ev.name} lacks a doc line"
+            assert ev.value.slots >= 1
+            assert CATALOG[ev.name] is ev
+            if ev.kind is not EventKind.span:
+                assert ev.slots == 1
+
+
+# ------------------------------------------------------ recording tracer
+
+class TestTracer:
+    def test_counters_gauges_and_dump(self, tmp_path):
+        t = Tracer(pid=3)
+        with t.span(Event.commit_execute, op=1, operation=2, window=1):
+            pass
+        t.count(Event.commits)
+        t.count(Event.commits, 2)
+        t.gauge(Event.bus_pool_used, 7)
+        assert t.counters["commits"] == 3
+        assert t.gauges["bus_pool_used"] == 7
+        assert {"commit_execute", "commits", "bus_pool_used"} <= t.emitted
+        path = tmp_path / "trace.json"
+        t.dump_chrome_trace(str(path))
+        doc = json.loads(path.read_text())
+        spans = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+        assert spans[0]["name"] == "commit_execute"
+        assert spans[0]["pid"] == 3
+        assert doc["metadata"]["counters"]["commits"] == 3
+        names = [e for e in doc["traceEvents"]
+                 if e["ph"] == "M" and e["name"] == "process_name"]
+        assert names[0]["args"]["name"] == "replica 3"
+
+    def test_ring_eviction_is_self_describing(self):
+        """ISSUE 5 satellite: the halved ring records a dropped_events
+        counter AND an in-trace marker, so a truncated trace says so."""
+        t = Tracer(capacity=8)
+        for k in range(20):
+            with t.span(Event.commit_prefetch, op=k):
+                pass
+        assert t.dropped_events > 0
+        assert t.counters["trace_dropped_events"] == t.dropped_events
+        markers = [e for e in t.events
+                   if e["name"] == "trace_dropped_events"]
+        assert markers and markers[0]["ph"] == "i"
+        assert markers[-1]["args"]["dropped_total"] <= t.dropped_events
+        assert "trace_dropped_events" in t.emitted
+
+    def test_wall_clock_anchored_timestamps(self):
+        """ISSUE 5 satellite: ts must be wall-clock comparable across
+        processes — two tracers constructed apart agree on 'now'."""
+        a = Tracer()
+        b = Tracer()
+        with a.span(Event.commit_prefetch, op=1):
+            pass
+        with b.span(Event.commit_prefetch, op=1):
+            pass
+        ts_a = a.events[0]["ts"]
+        ts_b = b.events[0]["ts"]
+        now_us = time.time_ns() / 1000.0
+        assert abs(ts_a - now_us) < 60e6  # within a minute of wall clock
+        assert 0 <= ts_b - ts_a < 10e6  # b's span started after a's
+
+    def test_begin_end_phase_spans(self):
+        t = Tracer()
+        t.begin(Event.view_change, view=2)
+        t.end(Event.view_change)
+        t.end(Event.view_change)  # extra end is a no-op
+        assert [e["name"] for e in t.events] == ["view_change"]
+        assert t.events[0]["args"] == {"view": 2}
+
+
+# -------------------------------------------------------------- statsd
+
+def _udp_pair():
+    sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+    sock.bind(("127.0.0.1", 0))
+    sock.settimeout(2.0)
+    return sock, sock.getsockname()[1]
+
+
+def _recv_lines(sock, n):
+    out = []
+    for _ in range(n):
+        out.append(sock.recv(4096).decode())
+    return out
+
+
+class TestStatsD:
+    def test_dogstatsd_line_format_over_loopback(self):
+        """count/gauge/timing + tag rendering against a REAL loopback
+        UDP socket (ISSUE 5 satellite)."""
+        sock, port = _udp_pair()
+        try:
+            s = StatsD("127.0.0.1", port)
+            s.count("commits", 2, replica=1)
+            s.gauge("bus_pool_used", 7.5)
+            s.timing("commit_execute", 1.25, op=3)
+            lines = _recv_lines(sock, 3)
+            assert lines[0] == "tb_tpu.commits:2|c|#replica:1"
+            assert lines[1] == "tb_tpu.bus_pool_used:7.5|g"
+            assert lines[2] == "tb_tpu.commit_execute:1.25|ms|#op:3"
+            s.close()
+        finally:
+            sock.close()
+
+    def test_best_effort_on_closed_socket(self):
+        s = StatsD("127.0.0.1", 1)  # nothing listens; then close it too
+        s.close()
+        s.count("commits")  # must not raise: metrics are best-effort
+        s.gauge("bus_pool_used", 1)
+        s.timing("commit_execute", 1.0)
+
+    def test_aggregate_flush_resets(self):
+        """Timing aggregates flush as gauges on the emit interval and
+        RESET after emit (reference statsd.zig semantics)."""
+        sock, port = _udp_pair()
+        try:
+            s = StatsD("127.0.0.1", port)
+            t = Tracer(statsd=s, emit_interval_s=0.0)  # flush every record
+            with t.span(Event.commit_prefetch, op=1):
+                pass
+            lines = _recv_lines(sock, 4)
+            byname = {ln.split(":")[0]: ln for ln in lines}
+            assert "tb_tpu.trace.commit_prefetch.count" in byname
+            assert byname["tb_tpu.trace.commit_prefetch.count"] \
+                .endswith("|g")
+            assert {"tb_tpu.trace.commit_prefetch.sum_us",
+                    "tb_tpu.trace.commit_prefetch.min_us",
+                    "tb_tpu.trace.commit_prefetch.max_us"} \
+                <= set(byname)
+            # Reset after emit: the next flush carries ONLY new spans.
+            with t.span(Event.commit_prefetch, op=2):
+                pass
+            lines = _recv_lines(sock, 4)
+            count_line = next(ln for ln in lines if ".count:" in ln)
+            assert count_line == "tb_tpu.trace.commit_prefetch.count:1|g"
+            assert not t.aggregates.snapshot()  # drained
+            s.close()
+        finally:
+            sock.close()
+
+    def test_counters_emit_immediately_with_tags(self):
+        sock, port = _udp_pair()
+        try:
+            s = StatsD("127.0.0.1", port)
+            t = Tracer(statsd=s)
+            t.count(Event.serving_recoveries, cause="state_digest")
+            line = sock.recv(4096).decode()
+            assert line == "tb_tpu.serving_recoveries:1|c|#cause:state_digest"
+            s.close()
+        finally:
+            sock.close()
+
+
+# --------------------------------------------------------------- merge
+
+class TestMerge:
+    def _doc(self, pid, ts0):
+        t = Tracer(pid=pid)
+        with t.span(Event.commit_execute, op=1, operation=2, window=1):
+            pass
+        doc = t.chrome_dict()
+        for e in doc["traceEvents"]:
+            if e["ph"] != "M":
+                e["ts"] = ts0
+        return doc
+
+    def test_merge_rebases_and_keeps_pids(self):
+        merged = merge_traces([self._doc(0, 5_000.0), self._doc(1, 6_000.0),
+                               self._doc(2, 5_500.0)])
+        assert merged["metadata"]["replicas"] == [0, 1, 2]
+        timed = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+        assert [e["ts"] for e in timed] == sorted(e["ts"] for e in timed)
+        assert timed[0]["ts"] == 0  # rebased to the earliest event
+        assert {e["pid"] for e in timed} == {0, 1, 2}
+
+    def test_merge_renumbers_colliding_pids(self):
+        merged = merge_traces([self._doc(0, 1.0), self._doc(0, 2.0)])
+        assert merged["metadata"]["replicas"] == [0, 1]
+
+
+# ---------------------------------------------------- in-process cluster
+
+def test_cluster_merged_trace_has_commit_stages():
+    """A traced in-process cluster merges to one timeline: every replica
+    contributes prefetch/execute/compact/checkpoint spans under its own
+    pid, in monotone order."""
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.types import Account, Operation, Transfer
+
+    cluster = Cluster(seed=3, replica_count=3,
+                      tracer_factory=lambda i: Tracer(pid=i))
+    client = cluster.client(9)
+
+    def drive(op, body):
+        client.request(op, body)
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+
+    drive(Operation.create_accounts, multi_batch.encode(
+        [b"".join(Account(id=i, ledger=1, code=1).pack()
+                  for i in (1, 2))], 128))
+    interval = cluster.replicas[0].options.checkpoint_interval
+    for k in range(interval + 1):
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=100 + k, debit_account_id=1, credit_account_id=2,
+                      amount=1, ledger=1, code=1).pack()], 128))
+    merged = cluster.merged_trace()
+    timed = [e for e in merged["traceEvents"] if e["ph"] != "M"]
+    assert [e["ts"] for e in timed] == sorted(e["ts"] for e in timed)
+    for pid in (0, 1, 2):
+        names = {e["name"] for e in timed if e["pid"] == pid}
+        for stage in COMMIT_STAGES:
+            assert stage in names, f"replica {pid} lacks {stage}"
+
+
+# --------------------------------------------------------------- vortex
+
+@pytest.mark.integration
+def test_vortex_merged_trace(tmp_path):
+    """ISSUE 5 acceptance: a 3-replica vortex run (REAL processes, real
+    TCP) with tracing enabled produces one merged Chrome/Perfetto JSON
+    containing prefetch/execute/compact/checkpoint spans from ALL
+    replicas on a common timeline — stage names, pid-per-replica, and
+    monotone timestamps checked from the loaded JSON."""
+    from tigerbeetle_tpu.main import _parse_addresses
+    from tigerbeetle_tpu.testing.vortex import VortexSupervisor
+    from tigerbeetle_tpu.types import Account, Transfer
+    from tigerbeetle_tpu.vsr.client import Client
+
+    supervisor = VortexSupervisor(str(tmp_path), replica_count=3,
+                                  seed=41, trace=True)
+    try:
+        client = Client(cluster=supervisor.cluster, client_id=13,
+                        replica_addresses=_parse_addresses(
+                            supervisor.addresses))
+        deadline = time.monotonic() + 90
+        while time.monotonic() < deadline:
+            try:
+                client.create_accounts([Account(id=1, ledger=1, code=1),
+                                        Account(id=2, ledger=1, code=1)])
+                break
+            except TimeoutError:
+                continue
+        else:
+            raise AssertionError("cluster never became available")
+        # Cross the checkpoint interval (16) so every replica runs all
+        # four commit stages, checkpoint included.
+        for k in range(17):
+            client.create_transfers([Transfer(
+                id=500 + k, debit_account_id=1, credit_account_id=2,
+                amount=1 + k, ledger=1, code=1)])
+        client.close()
+    finally:
+        supervisor.shutdown()  # SIGINT: each replica dumps its trace
+
+    out = tmp_path / "cluster.trace.json"
+    merged = supervisor.collect_merged_trace(str(out))
+    doc = json.loads(out.read_text())
+    assert doc["metadata"]["replicas"] == [0, 1, 2]
+    timed = [e for e in doc["traceEvents"] if e.get("ph") != "M"]
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts), "merged timeline is not monotone"
+    for pid in (0, 1, 2):
+        names = {e["name"] for e in timed if e["pid"] == pid}
+        for stage in COMMIT_STAGES:
+            assert stage in names, \
+                f"replica {pid} trace lacks {stage}: {sorted(names)}"
+    # The merge wrote what it returned.
+    assert merged["metadata"]["replicas"] == [0, 1, 2]

@@ -30,6 +30,10 @@ NUMERIC_KEYS = (
 NESTED_KEYS = (
     ("serving_sustained_tps", ("serving_batch_latency", "sustained_tps")),
     ("serving_p99_ms", ("serving_batch_latency", "p99_ms")),
+    # Tracing-cost guard (bench ##trace): recording-vs-NullTracer wall
+    # clock on the same commit loop; a creeping ratio is a tracing
+    # regression like any other.
+    ("trace_overhead_ratio", ("trace", "overhead_ratio")),
 )
 
 REGRESSION_WINDOW = 8  # trailing runs forming the baseline median
@@ -85,7 +89,7 @@ def _median(values: list[float]) -> Optional[float]:
 
 # Metrics where a regression is an INCREASE (latency); everything else
 # regresses by dropping (throughput).
-_HIGHER_IS_WORSE = frozenset({"serving_p99_ms"})
+_HIGHER_IS_WORSE = frozenset({"serving_p99_ms", "trace_overhead_ratio"})
 
 
 def regressions(entries: list[dict]) -> dict:
@@ -307,6 +311,43 @@ def render(history_path: str, out_path: str,
               "<th>budget</th><th>by class</th><th>operand MB</th>"
               "<th></th></tr>"
             + "".join(rows_ob) + "</table>")
+    # Commit-pipeline panel: the newest run's per-stage trace aggregates
+    # (bench ##trace, recorded under a recording tracer) as time shares —
+    # the operator-facing answer to "where does a commit go", next to the
+    # tracing-cost guard (NullTracer vs recording wall clock).
+    tr_html = ""
+    tr = next((e.get("trace") for e in reversed(entries)
+               if isinstance(e.get("trace"), dict)
+               and isinstance(e.get("trace").get("commit_stages"), dict)),
+              None)
+    if tr:
+        stages = tr["commit_stages"]
+        total_us = sum(s.get("sum_us", 0) for s in stages.values()) or 1.0
+        rows_tr = []
+        for stage in ("commit_prefetch", "commit_execute",
+                      "commit_compact", "commit_checkpoint"):
+            s = stages.get(stage)
+            if s is None:
+                continue
+            share = s.get("sum_us", 0) / total_us
+            bar = '<div style="background:#2a6;height:10px;width:{}px">' \
+                  '</div>'.format(max(1, round(share * 240)))
+            rows_tr.append(
+                "<tr><td>{}</td><td>{}</td><td>{:.1f}</td><td>{:.1%}</td>"
+                "<td>{}</td></tr>".format(
+                    html.escape(stage), s.get("count", 0),
+                    s.get("sum_us", 0) / 1000.0, share, bar))
+        guard = ""
+        if tr.get("overhead_ratio") is not None:
+            guard = ("<p>tracing cost guard: NullTracer {}s vs recording "
+                     "{}s ({}x) over {} ops</p>").format(
+                tr.get("null_s"), tr.get("recording_s"),
+                tr.get("overhead_ratio"), tr.get("ops"))
+        tr_html = (
+            "<h2>commit pipeline (latest traced run)</h2>" + guard
+            + "<table><tr><th>stage</th><th>spans</th><th>total ms</th>"
+              "<th>share</th><th></th></tr>"
+            + "".join(rows_tr) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -347,6 +388,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {fb_html}
 {rec_html}
 {ob_html}
+{tr_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

@@ -73,9 +73,6 @@ def cmd_start(args) -> int:
     def on_message(msg):
         replica_holder[0].on_message(msg)
 
-    bus = MessageBus(cluster=args.cluster, on_message=on_message,
-                     replica_addresses=addresses, replica_id=args.replica,
-                     listen=True, listen_port=args.listen_port)
     tracer = None
     if args.trace or args.statsd:
         from .trace import StatsD, Tracer
@@ -87,7 +84,14 @@ def cmd_start(args) -> int:
                 print(f"error: --statsd expects host:port, got {args.statsd!r}")
                 return 2
             statsd = StatsD(host or "127.0.0.1", int(port))
-        tracer = Tracer(statsd=statsd)
+        # pid = replica id: merged cluster traces get one process track
+        # per replica (trace/merge.py).
+        tracer = Tracer(statsd=statsd, pid=args.replica,
+                        emit_interval_s=args.trace_emit_interval)
+    bus = MessageBus(cluster=args.cluster, on_message=on_message,
+                     replica_addresses=addresses, replica_id=args.replica,
+                     listen=True, listen_port=args.listen_port,
+                     tracer=tracer)
     aof = None
     if args.aof:
         from .aof import AOF
@@ -138,8 +142,10 @@ def cmd_start(args) -> int:
     finally:
         _signal.signal(_signal.SIGINT, prev_int)
         _signal.signal(_signal.SIGTERM, prev_term)
-    if tracer is not None and args.trace:
-        tracer.dump_chrome_trace(args.trace)
+    if tracer is not None:
+        tracer.flush_statsd()
+        if args.trace:
+            tracer.dump_chrome_trace(args.trace)
     return 0
 
 
@@ -812,6 +818,9 @@ def main(argv=None) -> int:
                    help="dump a Chrome trace JSON here on shutdown")
     p.add_argument("--statsd", default=None,
                    help="emit DogStatsD metrics to host:port")
+    p.add_argument("--trace-emit-interval", type=float, default=10.0,
+                   help="seconds between StatsD timing-aggregate flushes "
+                        "(gauges reset after each emit)")
     p.add_argument("--aof", default=None,
                    help="append committed prepares to this AOF path")
     p.add_argument("--listen-port", type=int, default=None,

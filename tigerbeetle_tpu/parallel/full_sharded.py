@@ -54,6 +54,7 @@ from ..ops.fast_kernels import (
     imported_batch_ctx,
     per_event_status,
 )
+from ..trace import Event, NullTracer
 
 __all__ = ["make_sharded_create_transfers", "shard_batch", "ShardedRouter",
            "MODES"]
@@ -201,9 +202,10 @@ class ShardedRouter:
     per-cause host-fallback counters so "zero fallbacks on a mixed
     balancing+imported+closing window" is a measured invariant."""
 
-    def __init__(self, mesh: Mesh, axis: str = "batch"):
+    def __init__(self, mesh: Mesh, axis: str = "batch", tracer=None):
         self.mesh = mesh
         self.axis = axis
+        self.tracer = tracer if tracer is not None else NullTracer()
         self._steps: dict = {}
         self._single_steps: dict = {}
         self.batches = 0
@@ -278,24 +280,29 @@ class ShardedRouter:
         degraded = bool(self.lost_devices)
         if degraded:
             self.shard_loss_reroutes += 1
+            self.tracer.count(Event.router_reroute)
         pick = self._single_step if degraded else self._step
-        new_state, out = pick(mode)(
-            state, ev, np.uint64(timestamp), np.int32(n))
-        fallback, limit_only = (bool(x) for x in jax.device_get(
-            (out["fallback"], out["limit_only"])))
-        if fallback and limit_only and mode == "plain":
-            # Breach / collision / closing: resolvable on the sharded
-            # fixpoint step (the plain kernel left state untouched).
-            self.escalations += 1
-            new_state, out = pick("fixpoint")(
-                new_state, ev, np.uint64(timestamp), np.int32(n))
-            fallback = bool(jax.device_get(out["fallback"]))
+        with self.tracer.span(Event.router_step, mode=mode,
+                              degraded=int(degraded)):
+            new_state, out = pick(mode)(
+                state, ev, np.uint64(timestamp), np.int32(n))
+            fallback, limit_only = (bool(x) for x in jax.device_get(
+                (out["fallback"], out["limit_only"])))
+            if fallback and limit_only and mode == "plain":
+                # Breach / collision / closing: resolvable on the
+                # sharded fixpoint step (the plain kernel left state
+                # untouched).
+                self.escalations += 1
+                new_state, out = pick("fixpoint")(
+                    new_state, ev, np.uint64(timestamp), np.int32(n))
+                fallback = bool(jax.device_get(out["fallback"]))
         if fallback:
             self.host_fallbacks += 1
             for k, v in jax.device_get(out["fb_causes"]).items():
                 if bool(v):
                     self.fallback_causes[k] = (
                         self.fallback_causes.get(k, 0) + 1)
+                    self.tracer.count(Event.router_fallback, cause=k)
         return new_state, out, fallback
 
     def stats(self) -> dict:

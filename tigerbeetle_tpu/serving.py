@@ -50,6 +50,7 @@ from dataclasses import dataclass
 
 from .ops.ledger import DeviceLedger, MirrorDivergence, default_recovery_stats
 from .oracle.state_machine import StateMachineOracle
+from .trace import Event, NullTracer
 
 
 class TransientDispatchError(RuntimeError):
@@ -102,12 +103,14 @@ _STRUCTURAL_FAULTS = (KeyError, IndexError, ValueError)
 
 def call_with_retries(fn, policy: RetryPolicy, rng: random.Random,
                       counters: dict, *, sleep=time.sleep,
-                      clock=time.monotonic):
+                      clock=time.monotonic, tracer=None):
     """Run `fn()` under `policy`. Transient faults retry with backoff;
     exhaustion (attempts or deadline) raises RecoveryNeeded, as do a
     MirrorDivergence and the structural drain faults (retrying cannot
     fix divergent state). Counters accumulate into the shared
     recovery-stats dict."""
+    if tracer is None:
+        tracer = NullTracer()
     t0 = clock()
     attempt = 0
     while True:
@@ -120,6 +123,7 @@ def call_with_retries(fn, policy: RetryPolicy, rng: random.Random,
         except TransientDispatchError as e:
             attempt += 1
             counters["retries"] += 1
+            tracer.count(Event.serving_retries)
             if attempt > policy.max_retries:
                 raise RecoveryNeeded(
                     "dispatch_exhausted",
@@ -147,8 +151,9 @@ class ServingSupervisor:
     def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21, *,
                  epoch_interval: int = 8, retry: RetryPolicy | None = None,
                  seed: int = 0, mirror_audit: str = "full",
-                 fault_hook=None, sleep=time.sleep):
+                 fault_hook=None, sleep=time.sleep, tracer=None):
         assert mirror_audit in ("full", "spot", "off")
+        self.tracer = tracer if tracer is not None else NullTracer()
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.epoch_interval = epoch_interval
@@ -235,8 +240,10 @@ class ServingSupervisor:
             return thunk()
 
         try:
-            return call_with_retries(run, self.retry, self.rng,
-                                     self.counters, sleep=self._sleep)
+            with self.tracer.span(Event.serving_dispatch, what=what):
+                return call_with_retries(run, self.retry, self.rng,
+                                         self.counters, sleep=self._sleep,
+                                         tracer=self.tracer)
         except RecoveryNeeded as e:
             self._recover(e.cause, detail=e.detail)
             # Fresh, verified state: one post-recovery re-dispatch of
@@ -251,6 +258,10 @@ class ServingSupervisor:
         results / state digest / mirror. Clean -> advance the verified
         base and return True; any divergence -> recover and return
         False. Calling with an empty log is a cheap no-op epoch."""
+        with self.tracer.span(Event.serving_epoch_verify):
+            return self._verify_epoch()
+
+    def _verify_epoch(self) -> bool:
         from .ops import state_epoch
 
         led = self.led
@@ -353,6 +364,12 @@ class ServingSupervisor:
         verified epoch: oracle-replay the logged suffix (bounded),
         revise the authoritative history, rebuild mirror + device from
         the recovered oracle, resume serving."""
+        self.tracer.count(Event.serving_recoveries, cause=cause)
+        with self.tracer.span(Event.serving_recovery_replay, cause=cause):
+            self._recover_replay(cause, detail, replayed)
+
+    def _recover_replay(self, cause: str, detail: str,
+                        replayed: list | None) -> None:
         n_entries = len(self.log)
         n_windows = sum(1 for e in self.log if e[0] == "window")
         # Bounded-replay invariant: recovery never replays more windows

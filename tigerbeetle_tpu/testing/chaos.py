@@ -275,7 +275,8 @@ def _chaos_workload(rng: random.Random, n_accounts: int, next_id: int,
 def run_chaos_seed(seed: int, *, windows: int = 8,
                    batches_per_window: int = 2, events_per_batch: int = 48,
                    kinds=FAULT_KINDS, epoch_interval: int | None = None,
-                   mesh_scenario: bool | None = None) -> dict:
+                   mesh_scenario: bool | None = None,
+                   tracer=None) -> dict:
     """One seed-deterministic audited chaos run against the serving
     supervisor. Raises on ANY silent corruption (the run must either
     recover to bit-exact oracle parity or have failed loudly already);
@@ -296,7 +297,7 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
     try:
         summary = _run_supervisor_chaos(
             seed, rng, windows, batches_per_window, events_per_batch,
-            kinds, epoch_interval)
+            kinds, epoch_interval, tracer)
         if mesh_scenario:
             summary["shard_loss"] = shard_loss_scenario(seed)
     finally:
@@ -309,13 +310,15 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
 
 
 def _run_supervisor_chaos(seed, rng, windows, batches_per_window,
-                          events_per_batch, kinds, epoch_interval) -> dict:
+                          events_per_batch, kinds, epoch_interval,
+                          tracer=None) -> dict:
     n_accounts = 16
     sup = ServingSupervisor(
         a_cap=1 << 9, t_cap=1 << 12, epoch_interval=epoch_interval,
         retry=RetryPolicy(max_retries=2, base_delay_s=1e-3,
                           max_delay_s=4e-3, deadline_s=30.0),
-        seed=seed, mirror_audit="full", sleep=lambda s: None)
+        seed=seed, mirror_audit="full", sleep=lambda s: None,
+        tracer=tracer)
     plan = FaultPlan(seed, windows, kinds=kinds)
     sup.fault_hook = plan.dispatch_hook
 

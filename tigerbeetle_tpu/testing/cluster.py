@@ -152,7 +152,8 @@ class Cluster:
                  options: ReplicaOptions = ReplicaOptions(),
                  state_machine_factory=StateMachine,
                  clock_drift_ppm_max: int = 0,
-                 clock_offset_ns_max: int = 0):
+                 clock_offset_ns_max: int = 0,
+                 tracer_factory=None):
         # Simulated clusters always run with the extra-check mode on
         # (reference: VOPR builds compile constants.verify in,
         # docs/internals/vopr.md:48-57).
@@ -179,6 +180,11 @@ class Cluster:
         self.crashed: set[int] = set()
         self.clock_drift_ppm_max = clock_drift_ppm_max
         self.clock_offset_ns_max = clock_offset_ns_max
+        # Per-replica tracers (tracer_factory(i) -> tracer, pid=i
+        # expected): one tracer per replica id, SHARED across restarts
+        # so a replica's trace is continuous over its crashes.
+        self.tracer_factory = tracer_factory
+        self.tracers: dict[int, object] = {}
 
         self.storages = [MemoryStorage(layout)
                          for _ in range(self.node_count)]
@@ -200,13 +206,18 @@ class Cluster:
                                             self.clock_drift_ppm_max),
                 offset_ns=drift_rng.randint(-self.clock_offset_ns_max,
                                             self.clock_offset_ns_max))
+        tracer = None
+        if self.tracer_factory is not None:
+            if i not in self.tracers:
+                self.tracers[i] = self.tracer_factory(i)
+            tracer = self.tracers[i]
         return Replica(
             cluster=self.cluster_id, replica_id=i,
             replica_count=self.replica_count,
             standby_count=self.standby_count, storage=self.storages[i],
             bus=_ReplicaBus(self, i), time=time,
             state_machine_factory=self.state_machine_factory,
-            options=self.options)
+            options=self.options, tracer=tracer)
 
     def client(self, client_id: int) -> SimClient:
         if client_id not in self.clients:
@@ -426,6 +437,16 @@ class Cluster:
             assert all(r == roots[0] for r in roots[1:]), \
                 f"checkpoint root divergence at {ckpt}"
 
+    def merged_trace(self) -> dict:
+        """One Chrome/Perfetto document for the whole cluster: every
+        replica tracer's events on a common (wall-anchored) timeline,
+        pid = replica id (requires tracer_factory)."""
+        from ..trace import merge_traces
+
+        assert self.tracers, "Cluster built without tracer_factory"
+        return merge_traces([self.tracers[i].chrome_dict()
+                             for i in sorted(self.tracers)])
+
     def debug_status(self) -> str:
         return " | ".join(
             f"r{r.replica_id}:{r.status} v={r.view} op={r.op} "
@@ -433,12 +454,14 @@ class Cluster:
             for r in self.replicas)
 
 
-def rebuild_smoke(seed: int = 11) -> None:
+def rebuild_smoke(seed: int = 11, tracer_factory=None) -> None:
     """The gate's rebuild smoke: 3-replica in-process cluster, traffic
     past a WAL wrap, zero one replica's data file under continued load,
     rebuild it from the cluster, and require the rebuilt replica's
     state-epoch digest to be bit-identical to every healthy peer's (plus
-    the storage checker's byte-identical checkpoints)."""
+    the storage checker's byte-identical checkpoints). With
+    tracer_factory the whole run records (the gate's trace-coverage leg
+    reuses this smoke to prove the rebuild/state-sync catalog events)."""
     from .. import multi_batch
     from ..ops.state_epoch import combine, oracle_state_digest
     from ..types import Account, Transfer
@@ -450,7 +473,8 @@ def rebuild_smoke(seed: int = 11) -> None:
             for (i, amt) in specs)
         return multi_batch.encode([payload], 128)
 
-    cluster = Cluster(seed=seed, replica_count=3)
+    cluster = Cluster(seed=seed, replica_count=3,
+                      tracer_factory=tracer_factory)
     client = cluster.client(77)
 
     def drive(op, body):

@@ -29,8 +29,11 @@ MS = 1_000_000
 
 
 def run_swarm_seed(seed: int, engine: str | None = None,
-                   steps: int | None = None) -> dict:
-    """One seed-deterministic audited chaos run on a random topology."""
+                   steps: int | None = None,
+                   tracer_factory=None) -> dict:
+    """One seed-deterministic audited chaos run on a random topology.
+    tracer_factory(i) injects a per-replica recording tracer (the
+    gate's trace-coverage leg runs a swarm seed this way)."""
     rng = random.Random(seed)
     if engine is None:
         # Device-engine runs cost a jit warmup; keep them a steady
@@ -57,7 +60,7 @@ def run_swarm_seed(seed: int, engine: str | None = None,
         seed=seed, replica_count=replica_count,
         standby_count=standby_count,
         state_machine_factory=factory,
-        network=net)
+        network=net, tracer_factory=tracer_factory)
     client = cluster.client(1)
     workload = Workload(seed, account_ids=list(range(1, 9)))
     auditor = Auditor(workload.permutation)

@@ -122,11 +122,16 @@ class VortexSupervisor:
     faults (reference: testing/vortex/supervisor.zig)."""
 
     def __init__(self, tmp_dir: str, *, replica_count: int = 3,
-                 cluster: int = 0xF0, seed: int = 0):
+                 cluster: int = 0xF0, seed: int = 0,
+                 trace: bool = False):
         self.tmp_dir = tmp_dir
         self.replica_count = replica_count
         self.cluster = cluster
         self.prng = random.Random(seed)
+        # trace=True: every replica runs with --trace and dumps
+        # r<i>.trace.json on SIGINT shutdown; collect_merged_trace()
+        # then yields ONE Perfetto timeline for the whole cluster.
+        self.trace = trace
         ports = free_ports(2 * replica_count)
         self.real_ports = ports[:replica_count]
         self.proxy_ports = ports[replica_count:]
@@ -154,16 +159,22 @@ class VortexSupervisor:
             check=True, cwd="/root/repo", timeout=60,
             stdout=subprocess.DEVNULL)
 
+    def trace_path(self, i: int) -> str:
+        return os.path.join(self.tmp_dir, f"r{i}.trace.json")
+
     def start_replica(self, i: int) -> None:
         assert self.procs[i] is None
         # The replica listens on its REAL port but dials peers through
         # their proxies: addresses are proxy ports, with our own entry
         # overridden via --listen-port.
+        cmd = [sys.executable, "-m", "tigerbeetle_tpu", "start",
+               f"--addresses={self.addresses}", f"--replica={i}",
+               f"--cluster={self.cluster}", "--engine=oracle", "--small",
+               f"--listen-port={self.real_ports[i]}"]
+        if self.trace:
+            cmd.append(f"--trace={self.trace_path(i)}")
         self.procs[i] = subprocess.Popen(
-            [sys.executable, "-m", "tigerbeetle_tpu", "start",
-             f"--addresses={self.addresses}", f"--replica={i}",
-             f"--cluster={self.cluster}", "--engine=oracle", "--small",
-             f"--listen-port={self.real_ports[i]}", self._data_path(i)],
+            cmd + [self._data_path(i)],
             cwd="/root/repo", env=dict(os.environ),
             # Never a PIPE nobody drains: a chatty replica would block on a
             # full pipe buffer and masquerade as a liveness failure.
@@ -303,6 +314,18 @@ class VortexSupervisor:
                     proc.kill()
         for proxy in self.proxies:
             proxy.close()
+
+    def collect_merged_trace(self, out_path: Optional[str] = None) -> dict:
+        """After shutdown: merge every replica's dumped Chrome trace
+        into one cluster-wide Perfetto document (pid = replica id, the
+        tracers' wall-clock anchors give the common timeline). Replicas
+        that died without dumping (SIGKILL) are simply absent."""
+        from ..trace import merge_trace_files
+
+        paths = [self.trace_path(i) for i in range(self.replica_count)
+                 if os.path.exists(self.trace_path(i))]
+        assert paths, "no replica dumped a trace (trace=True required)"
+        return merge_trace_files(paths, out_path)
 
     def verify_data_files(self) -> None:
         """After shutdown: every data file must pass full integrity

@@ -1,0 +1,31 @@
+"""Tracing and metrics subsystem: typed catalog, spans, StatsD, merge.
+
+reference: src/trace.zig + src/trace/event.zig + src/trace/statsd.zig.
+Layout mirrors the reference:
+
+- `event.py`  — the typed event catalog (every legal span/counter/gauge,
+  fixed tag schemas, per-event concurrency lanes). Free-form names are a
+  hard error under the recording tracer; the gate's coverage leg fails
+  on catalog events the smokes never emit.
+- `tracer.py` — NullTracer (production default, zero overhead) and the
+  recording Tracer (bounded ring with self-describing eviction,
+  wall-clock-anchored timestamps, per-event timing aggregates).
+- `statsd.py` — DogStatsD UDP emission + interval-flushed aggregates
+  (gauges reset after emit, like the reference).
+- `merge.py`  — cluster-wide trace merge (pid=replica, common timeline).
+
+The tracer is injected at construction into the replica, journal, grid
+scrubber, message bus, serving supervisor, and sharded router; see
+docs/operating/monitoring.md for the operator-facing catalog.
+"""
+
+from .event import CATALOG, TID_BASE, Event, EventKind, EventSpec, lookup
+from .merge import merge_trace_files, merge_traces
+from .statsd import StatsD, TimingAggregates
+from .tracer import NullTracer, Tracer
+
+__all__ = [
+    "CATALOG", "TID_BASE", "Event", "EventKind", "EventSpec", "lookup",
+    "merge_trace_files", "merge_traces", "StatsD", "TimingAggregates",
+    "NullTracer", "Tracer",
+]

@@ -1,0 +1,166 @@
+"""Typed trace-event catalog: every legal span, counter, and gauge.
+
+reference: src/trace/event.zig — the reference compiles a closed event
+catalog into every hot path (commit stages, storage, grid, message bus)
+and derives both the Chrome-trace lanes and the StatsD metric names from
+it. Here the catalog is the single source of truth for:
+
+- **legal names**: under the recording `Tracer` a span/counter/gauge
+  whose name is not a catalog member is a HARD error (free-form strings
+  cannot ship — scripts/gate.py's coverage leg additionally fails when a
+  catalog member is never emitted by the smokes, so dead metrics cannot
+  ship either);
+- **fixed tag schemas**: each event declares its legal tag keys; an
+  out-of-schema tag is an error, which bounds metric cardinality at the
+  call site instead of in the aggregation backend;
+- **stable Chrome `tid` lanes**: each span event owns a fixed lane range
+  (`TID_BASE[event] .. +slots`), so overlapping occurrences (e.g. two
+  in-flight block repairs) render on stable per-event lanes in any trace
+  from any build (event.zig derives its tids the same way).
+
+The catalog is append-oriented: renaming/removing an event breaks the
+continuity of its StatsD series, so prefer adding. Every event listed
+here is exercised by the gate's trace-coverage leg
+(tigerbeetle_tpu/testing/trace_coverage.py); docs/operating/monitoring.md
+is the operator-facing rendering of this table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+
+
+class EventKind(enum.Enum):
+    span = "span"
+    counter = "counter"
+    gauge = "gauge"
+
+
+@dataclasses.dataclass(frozen=True)
+class EventSpec:
+    kind: EventKind
+    tags: tuple = ()
+    slots: int = 1  # concurrency lanes (spans only)
+    doc: str = ""
+
+
+def _span(doc: str, *tags: str, slots: int = 1) -> EventSpec:
+    return EventSpec(EventKind.span, tuple(tags), slots, doc)
+
+
+def _counter(doc: str, *tags: str) -> EventSpec:
+    return EventSpec(EventKind.counter, tuple(tags), 1, doc)
+
+
+def _gauge(doc: str, *tags: str) -> EventSpec:
+    return EventSpec(EventKind.gauge, tuple(tags), 1, doc)
+
+
+class Event(enum.Enum):
+    """The catalog. Member name == Chrome span name == StatsD metric
+    name (under the `tb_tpu.` prefix)."""
+
+    # ----------------------------------------------- replica commit stages
+    commit_prefetch = _span(
+        "journal read of the next committable prepare", "op")
+    commit_execute = _span(
+        "state-machine execution of one prepare or one aggregated "
+        "commit window", "op", "operation", "window")
+    commit_compact = _span(
+        "durable flush of the committed op + one compaction beat", "op")
+    commit_checkpoint = _span(
+        "forest checkpoint + superblock flip", "op")
+    commits = _counter("prepares committed")
+    commit_windows = _counter("aggregated multi-prepare window commits")
+    rollbacks = _counter("checkpoint rollbacks on divergence detection")
+
+    # ------------------------------------------------------------- journal
+    journal_write = _span("WAL prepare+header pair write (submit)", "op")
+    journal_recover = _span("full WAL two-ring recovery scan")
+
+    # ---------------------------------------------------------------- grid
+    grid_scrub_tick = _span("one paced scrubber tick of block reads")
+    grid_scrub_certify = _span(
+        "unpaced full scrub tour (post-rebuild certification)")
+    grid_repair_block = _span(
+        "peer-provided block validated and installed over a corrupt one",
+        slots=4)
+
+    # -------------------------------------------- view change / sync / rebuild
+    view_change = _span("view change, start to new-view adoption", "view")
+    state_sync = _span("checkpoint state sync, offer to install",
+                       "target_op")
+    rebuild = _span("rebuild-from-cluster, open_rebuild to voter re-entry")
+
+    # --------------------------------------------------------- message bus
+    bus_send = _span("serialize + enqueue one outbound message", "command")
+    bus_recv = _span("deliver one validated inbound message", "command")
+    bus_pool_used = _gauge("outbound message-pool slots in use")
+    config_mismatch_peer = _counter(
+        "pings rejected for a cluster-config fingerprint mismatch")
+
+    # ------------------------------------------------------------- serving
+    serving_dispatch = _span(
+        "one supervised device dispatch (includes retries)", "what")
+    serving_epoch_verify = _span(
+        "epoch verification: quiesce + oracle replay + digest + audit")
+    serving_recovery_replay = _span(
+        "quarantine + bounded oracle replay + device rebuild", "cause")
+    serving_retries = _counter("device dispatch retries")
+    serving_recoveries = _counter("serving recoveries", "cause")
+
+    # ------------------------------------------------------ sharded router
+    router_step = _span("one sharded (or degraded single-chip) batch step",
+                        "mode", "degraded")
+    router_fallback = _counter("host fallbacks off the sharded step",
+                               "cause")
+    router_reroute = _counter(
+        "batches rerouted to the single-chip step under shard loss")
+
+    # ------------------------------------------------------ tracer internal
+    trace_dropped_events = _counter(
+        "span ring evictions (the trace is truncated at its start)")
+
+    @property
+    def kind(self) -> EventKind:
+        return self.value.kind
+
+    @property
+    def tags(self) -> tuple:
+        return self.value.tags
+
+    @property
+    def slots(self) -> int:
+        return self.value.slots
+
+    @property
+    def doc(self) -> str:
+        return self.value.doc
+
+
+CATALOG: dict = {e.name: e for e in Event}
+
+# Stable Chrome lanes: tid 0 is reserved for instant markers/metadata;
+# each span event owns [TID_BASE[e], TID_BASE[e] + e.slots).
+TID_BASE: dict = {}
+_next = 1
+for _e in Event:
+    TID_BASE[_e] = _next
+    if _e.kind == EventKind.span:
+        _next += _e.slots
+del _next, _e
+
+
+def lookup(name) -> Event:
+    """Resolve an Event member or its string name; KeyError text names
+    the offender (the recording tracer's hard-error path)."""
+    if isinstance(name, Event):
+        return name
+    ev = CATALOG.get(name)
+    if ev is None:
+        raise KeyError(
+            f"trace event {name!r} is not in the catalog "
+            f"(tigerbeetle_tpu/trace/event.py); free-form names are "
+            f"rejected under the recording tracer")
+    return ev

@@ -1,0 +1,77 @@
+"""Cluster-wide trace merge: one Perfetto timeline from N replica traces.
+
+The per-replica Chrome traces are already cross-process comparable: each
+recording tracer anchors its span timestamps to the wall clock at
+construction (trace/tracer.py `clock_anchor_ns`), so merging is
+concatenation + a common rebase — no clock inference. `pid` identifies
+the replica (set at tracer construction: `--trace` uses the replica id),
+so one Perfetto load shows the whole cluster's commit/repair/rebuild
+timeline with one process track per replica.
+
+Used by testing/cluster.py (in-process clusters merge their replicas'
+tracers directly) and testing/vortex.py (real processes dump
+`r<i>.trace.json` on shutdown; `collect_merged_trace` merges the files).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Optional
+
+
+def merge_traces(docs: list, rebase: bool = True) -> dict:
+    """Merge Chrome-trace documents (as produced by
+    Tracer.chrome_dict / dump_chrome_trace) into one.
+
+    Replica identity must survive: documents with colliding pids are
+    renumbered (their metadata events follow). With rebase=True every
+    timed event is shifted so the earliest one lands at ts=0 — the
+    common epoch-aligned base a multi-gigasecond wall-clock ts would
+    otherwise bury."""
+    events: list[dict] = []
+    seen_pids: set = set()
+    anchors: dict = {}
+    dropped = 0
+    for doc in docs:
+        meta = doc.get("metadata", {})
+        pid = meta.get("pid", 0)
+        while pid in seen_pids:
+            pid += 1  # collision: renumber deterministically
+        seen_pids.add(pid)
+        anchors[pid] = meta.get("clock_anchor_ns")
+        dropped += meta.get("dropped_events", 0)
+        for e in doc.get("traceEvents", []):
+            e = dict(e)
+            e["pid"] = pid
+            events.append(e)
+    timed = [e for e in events if e.get("ph") != "M"]
+    if rebase and timed:
+        t0 = min(e["ts"] for e in timed)
+        for e in timed:
+            e["ts"] = round(e["ts"] - t0, 3)
+    # Metadata first, then time order — Perfetto wants names early and
+    # the acceptance checker wants a monotone stream.
+    events.sort(key=lambda e: (0, 0) if e.get("ph") == "M"
+                else (1, e["ts"]))
+    return {
+        "traceEvents": events,
+        "metadata": {
+            "replicas": sorted(seen_pids),
+            "clock_anchors_ns": anchors,
+            "dropped_events": dropped,
+        },
+    }
+
+
+def merge_trace_files(paths: list, out_path: Optional[str] = None) -> dict:
+    """Load per-replica trace files and merge; optionally write the
+    merged document (the operator-facing `one Perfetto load` artifact)."""
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            docs.append(json.load(f))
+    merged = merge_traces(docs)
+    if out_path is not None:
+        with open(out_path, "w") as f:
+            json.dump(merged, f)
+    return merged

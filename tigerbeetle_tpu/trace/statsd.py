@@ -1,0 +1,82 @@
+"""DogStatsD-format UDP emission + per-event timing aggregation.
+
+reference: src/trace/statsd.zig — the reference does not emit one packet
+per span; it AGGREGATES per-event timings (count/sum/min/max) between
+emission intervals, flushes them as gauges, and resets the aggregates
+after each emit so a quiet interval reads as zero instead of a stale
+plateau. Counters and gauges emit immediately (the server aggregates
+counts; gauges are last-write-wins anyway). All emission is best-effort:
+a dead collector must never take a replica down with it.
+"""
+
+from __future__ import annotations
+
+import socket
+
+
+class StatsD:
+    """DogStatsD-format UDP emitter (reference: src/trace/statsd.zig)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 8125,
+                 prefix: str = "tb_tpu"):
+        self.addr = (host, port)
+        self.prefix = prefix
+        self.sock = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        self.sock.setblocking(False)
+
+    def _emit(self, metric: str, value, kind: str, tags: dict) -> None:
+        line = f"{self.prefix}.{metric}:{value}|{kind}"
+        if tags:
+            line += "|#" + ",".join(f"{k}:{v}" for k, v in tags.items())
+        try:
+            self.sock.sendto(line.encode(), self.addr)
+        except OSError:
+            pass  # metrics are best-effort
+
+    def count(self, metric: str, value: int = 1, **tags) -> None:
+        self._emit(metric, value, "c", tags)
+
+    def gauge(self, metric: str, value: float, **tags) -> None:
+        self._emit(metric, value, "g", tags)
+
+    def timing(self, metric: str, ms: float, **tags) -> None:
+        self._emit(metric, ms, "ms", tags)
+
+    def close(self) -> None:
+        self.sock.close()
+
+
+class TimingAggregates:
+    """Per-event span-duration aggregates between StatsD emits:
+    count / sum / min / max in microseconds, reset after each flush
+    (reference statsd.zig behavior: gauges reset after emit)."""
+
+    def __init__(self):
+        self._agg: dict[str, list] = {}
+
+    def record(self, name: str, dur_us: float) -> None:
+        a = self._agg.get(name)
+        if a is None:
+            self._agg[name] = [1, dur_us, dur_us, dur_us]
+        else:
+            a[0] += 1
+            a[1] += dur_us
+            if dur_us < a[2]:
+                a[2] = dur_us
+            if dur_us > a[3]:
+                a[3] = dur_us
+
+    def snapshot(self) -> dict:
+        """{event: {count, sum_us, min_us, max_us}} without resetting."""
+        return {name: {"count": a[0], "sum_us": round(a[1], 3),
+                       "min_us": round(a[2], 3), "max_us": round(a[3], 3)}
+                for name, a in self._agg.items()}
+
+    def flush_to(self, statsd: StatsD) -> None:
+        """Emit every aggregate as four gauges, then reset."""
+        for name, a in self._agg.items():
+            statsd.gauge(f"trace.{name}.count", a[0])
+            statsd.gauge(f"trace.{name}.sum_us", round(a[1], 3))
+            statsd.gauge(f"trace.{name}.min_us", round(a[2], 3))
+            statsd.gauge(f"trace.{name}.max_us", round(a[3], 3))
+        self._agg.clear()

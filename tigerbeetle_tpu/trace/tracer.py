@@ -1,0 +1,262 @@
+"""Tracers: the no-op production default and the recording tracer.
+
+reference: src/trace.zig — span start/stop compiled into the hot path,
+Chrome/Perfetto JSON via --trace, StatsD aggregation via trace/statsd.zig.
+The tracer is injected at construction (replica, journal, scrubber,
+message bus, serving supervisor, sharded router); the default NullTracer
+keeps every hot path free of overhead (bench.py's ##trace probe records
+that cost every run).
+
+The recording `Tracer` enforces the typed catalog (trace/event.py): a
+span/counter/gauge outside the catalog, or a tag key outside the event's
+schema, is a hard error. Spans land in a bounded ring; eviction is
+SELF-DESCRIBING (a dropped_events counter plus an instant marker event,
+so a truncated Chrome trace says so instead of silently starting late).
+
+Cross-process alignment: span timestamps are wall-clock anchored — the
+tracer records `time.time_ns() - perf_counter_ns()` once at construction
+and bakes the offset into every emitted `ts`, so per-replica traces from
+different processes merge onto one timeline (trace/merge.py) without any
+post-hoc clock guessing.
+"""
+
+from __future__ import annotations
+
+import json
+import time as _time
+from typing import Optional
+
+from .event import TID_BASE, Event, EventKind, lookup
+from .statsd import StatsD, TimingAggregates
+
+
+class NullTracer:
+    """No-op tracer (production default unless --trace/--statsd is set).
+    Accepts anything: enforcement is the recording tracer's job — the
+    null path must stay a handful of attribute lookups."""
+
+    def span(self, event, **tags):
+        return _NULL_SPAN
+
+    def begin(self, event, **tags) -> None:
+        pass
+
+    def end(self, event, **tags) -> None:
+        pass
+
+    def count(self, event, value: int = 1, **tags) -> None:
+        pass
+
+    def gauge(self, event, value: float, **tags) -> None:
+        pass
+
+    def dump_chrome_trace(self, path: str) -> None:
+        pass
+
+    def flush_statsd(self) -> None:
+        pass
+
+
+class _NullSpan:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class Tracer(NullTracer):
+    """Recording tracer: bounded ring of completed spans, counters,
+    gauges, per-event timing aggregates, and the emitted-name set the
+    gate's coverage leg audits."""
+
+    def __init__(self, capacity: int = 65536,
+                 statsd: Optional[StatsD] = None, pid: int = 0,
+                 emit_interval_s: float = 10.0):
+        self.capacity = capacity
+        self.statsd = statsd
+        self.pid = pid
+        self.emit_interval_s = emit_interval_s
+        self.events: list[dict] = []
+        self.counters: dict[str, int] = {}
+        self.gauges: dict[str, float] = {}
+        self.dropped_events = 0
+        # Catalog coverage record: every event name this tracer emitted.
+        self.emitted: set[str] = set()
+        # Wall-clock anchor: perf_counter_ns + _epoch_ns == time_ns, so
+        # emitted ts values are comparable ACROSS processes.
+        self._epoch_ns = _time.time_ns() - _time.perf_counter_ns()
+        self.aggregates = TimingAggregates()
+        self._last_flush_ns = _time.perf_counter_ns()
+        # Concurrency lanes: event name -> busy slot set (sync spans),
+        # and event name -> {slot: (start_ns, tags)} (begin/end spans).
+        self._busy: dict[str, set] = {}
+        self._open: dict[str, dict] = {}
+        self._lanes_used: dict[int, str] = {}
+
+    # ------------------------------------------------------------ catalog
+
+    def _check(self, event, kind: EventKind, tags: dict) -> Event:
+        ev = lookup(event)
+        if ev.kind is not kind:
+            raise ValueError(
+                f"trace event {ev.name} is a {ev.kind.value}, used as a "
+                f"{kind.value}")
+        if tags and not set(tags) <= set(ev.tags):
+            raise ValueError(
+                f"trace event {ev.name}: tags {sorted(set(tags) - set(ev.tags))} "
+                f"are outside its schema {ev.tags}")
+        return ev
+
+    def _lane(self, ev: Event) -> int:
+        busy = self._busy.setdefault(ev.name, set())
+        slot = next((s for s in range(ev.slots) if s not in busy),
+                    ev.slots - 1)  # saturated: share the last lane
+        busy.add(slot)
+        tid = TID_BASE[ev] + slot
+        self._lanes_used.setdefault(tid, f"{ev.name}[{slot}]")
+        return slot
+
+    # -------------------------------------------------------------- spans
+
+    def span(self, event, **tags):
+        ev = self._check(event, EventKind.span, tags)
+        return _Span(self, ev, tags)
+
+    def begin(self, event, **tags) -> None:
+        """Open a multi-tick phase span (view change, state sync,
+        rebuild). A begin while the event is already open (same slot
+        semantics as overlapping sync spans) first closes the open one."""
+        ev = self._check(event, EventKind.span, tags)
+        open_ = self._open.setdefault(ev.name, {})
+        if len(open_) >= ev.slots:
+            self.end(ev)  # saturated: close the oldest occurrence
+        slot = self._lane(ev)
+        open_[slot] = (_time.perf_counter_ns(), tags)
+
+    def end(self, event, **tags) -> None:
+        """Close the oldest open occurrence of a begin() span; a no-op
+        when none is open (phases may end from several call sites)."""
+        ev = self._check(event, EventKind.span, tags)
+        open_ = self._open.get(ev.name)
+        if not open_:
+            return
+        slot = min(open_)
+        start_ns, begin_tags = open_.pop(slot)
+        self._busy[ev.name].discard(slot)
+        merged = dict(begin_tags, **tags)
+        self._record(ev, start_ns, _time.perf_counter_ns() - start_ns,
+                     merged, TID_BASE[ev] + slot)
+
+    # --------------------------------------------------- counters / gauges
+
+    def count(self, event, value: int = 1, **tags) -> None:
+        ev = self._check(event, EventKind.counter, tags)
+        self.emitted.add(ev.name)
+        self.counters[ev.name] = self.counters.get(ev.name, 0) + value
+        if self.statsd is not None:
+            self.statsd.count(ev.name, value, **tags)
+            self._maybe_flush()
+
+    def gauge(self, event, value: float, **tags) -> None:
+        ev = self._check(event, EventKind.gauge, tags)
+        self.emitted.add(ev.name)
+        self.gauges[ev.name] = value
+        if self.statsd is not None:
+            self.statsd.gauge(ev.name, value, **tags)
+            self._maybe_flush()
+
+    # ----------------------------------------------------------- recording
+
+    def _record(self, ev: Event, start_ns: int, dur_ns: int,
+                tags: dict, tid: int) -> None:
+        self.emitted.add(ev.name)
+        if len(self.events) >= self.capacity:
+            dropped = self.capacity // 2
+            del self.events[:dropped]
+            self.dropped_events += dropped
+            # Self-describing truncation (satellite: a halved ring must
+            # say so): a counter plus an instant marker INSIDE the trace.
+            self.count(Event.trace_dropped_events, dropped)
+            self.events.append({
+                "name": Event.trace_dropped_events.name, "ph": "i",
+                "ts": (start_ns + self._epoch_ns) / 1000.0,
+                "pid": self.pid, "tid": 0, "s": "p",
+                "args": {"dropped_total": self.dropped_events},
+            })
+        self.events.append({
+            "name": ev.name, "ph": "X",
+            "ts": (start_ns + self._epoch_ns) / 1000.0,
+            "dur": dur_ns / 1000.0,
+            "pid": self.pid, "tid": tid, "args": tags,
+        })
+        self.aggregates.record(ev.name, dur_ns / 1000.0)
+        if self.statsd is not None:
+            self._maybe_flush()
+
+    def _maybe_flush(self) -> None:
+        now = _time.perf_counter_ns()
+        if now - self._last_flush_ns >= self.emit_interval_s * 1e9:
+            self._last_flush_ns = now
+            self.aggregates.flush_to(self.statsd)
+
+    def flush_statsd(self) -> None:
+        """Force-flush the timing aggregates (shutdown path)."""
+        if self.statsd is not None:
+            self._last_flush_ns = _time.perf_counter_ns()
+            self.aggregates.flush_to(self.statsd)
+
+    # --------------------------------------------------------------- dump
+
+    def chrome_dict(self) -> dict:
+        """Chrome/Perfetto-loadable document with process/thread names
+        and the metadata block trace/merge.py keys on."""
+        meta_events = [{
+            "name": "process_name", "ph": "M", "pid": self.pid, "tid": 0,
+            "args": {"name": f"replica {self.pid}"},
+        }]
+        for tid in sorted(self._lanes_used):
+            meta_events.append({
+                "name": "thread_name", "ph": "M", "pid": self.pid,
+                "tid": tid, "args": {"name": self._lanes_used[tid]},
+            })
+        return {
+            "traceEvents": meta_events + self.events,
+            "metadata": {
+                "pid": self.pid,
+                "clock_anchor_ns": self._epoch_ns,
+                "dropped_events": self.dropped_events,
+                "counters": dict(self.counters),
+                "gauges": dict(self.gauges),
+                "aggregates": self.aggregates.snapshot(),
+            },
+        }
+
+    def dump_chrome_trace(self, path: str) -> None:
+        """Chrome/Perfetto-loadable trace (reference: --trace=file)."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_dict(), f)
+
+
+class _Span:
+    __slots__ = ("tracer", "event", "tags", "start", "slot")
+
+    def __init__(self, tracer: Tracer, event: Event, tags: dict):
+        self.tracer = tracer
+        self.event = event
+        self.tags = tags
+
+    def __enter__(self):
+        self.slot = self.tracer._lane(self.event)
+        self.start = _time.perf_counter_ns()
+        return self
+
+    def __exit__(self, *exc):
+        dur = _time.perf_counter_ns() - self.start
+        self.tracer._busy[self.event.name].discard(self.slot)
+        self.tracer._record(self.event, self.start, dur, self.tags,
+                            TID_BASE[self.event] + self.slot)
+        return False

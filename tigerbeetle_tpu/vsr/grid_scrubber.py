@@ -43,12 +43,15 @@ from typing import Iterator, Optional
 
 from ..lsm.forest import Forest
 from ..lsm.grid import BlockAddress
+from ..trace import Event, NullTracer
 
 
 class GridScrubber:
     def __init__(self, forest: Forest, *, cycle_ticks: int = 1024,
-                 reads_per_tick_max: int = 64, origin_seed: int = 0):
+                 reads_per_tick_max: int = 64, origin_seed: int = 0,
+                 tracer=None):
         self.forest = forest
+        self.tracer = tracer if tracer is not None else NullTracer()
         # Tour pacing: finish one full cycle per `cycle_ticks` ticks.
         self.cycle_ticks = max(1, cycle_ticks)
         self.reads_per_tick_max = reads_per_tick_max
@@ -101,14 +104,15 @@ class GridScrubber:
         its parent-held checksum. Orthogonal to the paced background
         tour: the incremental iterator/pacing state is untouched."""
         found: list[tuple[str, BlockAddress, int]] = []
-        for name, address, size in self._blocks():
-            self.checked += 1
-            try:
-                self.forest.grid.read_block(address, size,
-                                            bypass_cache=True)
-            except IOError:
-                found.append((name, address, size))
-                self.faults[address.index] = (name, address, size)
+        with self.tracer.span(Event.grid_scrub_certify):
+            for name, address, size in self._blocks():
+                self.checked += 1
+                try:
+                    self.forest.grid.read_block(address, size,
+                                                bypass_cache=True)
+                except IOError:
+                    found.append((name, address, size))
+                    self.faults[address.index] = (name, address, size)
         return found
 
     def still_referenced(self, address: BlockAddress) -> bool:
@@ -133,6 +137,10 @@ class GridScrubber:
     def tick(self) -> list[tuple[str, BlockAddress, int]]:
         """Validate the tick's block budget; returns faults found now
         (the replica queues them for peer repair via request_blocks)."""
+        with self.tracer.span(Event.grid_scrub_tick):
+            return self._tick()
+
+    def _tick(self) -> list[tuple[str, BlockAddress, int]]:
         found: list[tuple[str, BlockAddress, int]] = []
         if self._iter is None:
             self._iter = self._tour()

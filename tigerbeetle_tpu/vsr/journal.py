@@ -14,6 +14,7 @@ import dataclasses
 import enum
 from typing import Optional
 
+from ..trace import Event, NullTracer
 from .header import HEADER_SIZE, Command, Header, Message
 from .storage import Storage
 
@@ -31,8 +32,9 @@ class Slot:
 
 
 class Journal:
-    def __init__(self, storage: Storage):
+    def __init__(self, storage: Storage, tracer=None):
         self.storage = storage
+        self.tracer = tracer if tracer is not None else NullTracer()
         self.slot_count = storage.layout.slot_count
         self.prepare_size_max = storage.layout.message_size_max
         # In-memory copy of the header ring (reference keeps headers
@@ -66,6 +68,10 @@ class Journal:
         wait barrier; otherwise the write is synchronous (the
         deterministic simulator path) and `on_durable` fires before
         return. Returns True if the append is already durable."""
+        with self.tracer.span(Event.journal_write, op=message.header.op):
+            return self._append(message, on_durable)
+
+    def _append(self, message: Message, on_durable) -> bool:
         header = message.header
         assert header.command == Command.prepare
         assert header.size <= self.prepare_size_max
@@ -188,6 +194,10 @@ class Journal:
         ring (reference: journal recovery in src/vsr/journal.zig; decision
         table in docs/internals/vsr.md:188-217). Runs on the native engine
         when the storage is native-backed."""
+        with self.tracer.span(Event.journal_recover):
+            return self._recover_scan()
+
+    def _recover_scan(self) -> list[Slot]:
         native_file = getattr(self.storage, "native", None)
         if native_file is not None:
             return self._recover_native(native_file)

@@ -25,6 +25,7 @@ import socket
 from collections import deque
 from typing import Callable, Optional
 
+from ..trace import Event, NullTracer
 from .header import HEADER_SIZE, Command, Header, Message
 
 RECV_CHUNK = 256 * 1024
@@ -65,9 +66,11 @@ class MessageBus:
                  replica_addresses: list[tuple[str, int]],
                  replica_id: Optional[int] = None,
                  listen: bool = False,
-                 listen_port: Optional[int] = None):
+                 listen_port: Optional[int] = None,
+                 tracer=None):
         self.cluster = cluster
         self.on_message = on_message
+        self.tracer = tracer if tracer is not None else NullTracer()
         self.replica_addresses = replica_addresses
         self.replica_id = replica_id
         self.selector = selectors.DefaultSelector()
@@ -131,10 +134,13 @@ class MessageBus:
             else:
                 self.dropped_replica += 1
             return
-        raw = msg.pack()
-        conn.tx += raw
+        with self.tracer.span(Event.bus_send,
+                              command=Command(msg.header.command).name):
+            raw = msg.pack()
+            conn.tx += raw
         conn.tx_sizes.append(len(raw))
         self.pool_used += 1
+        self.tracer.gauge(Event.bus_pool_used, self.pool_used)
         if self.pool_used >= POOL_SUSPEND_AT and not self._global_suspended:
             self._global_suspended = True
             self._suspend_client_reads()
@@ -274,8 +280,11 @@ class MessageBus:
             msg = Message.unpack(raw)
             if not msg.valid() or msg.header.cluster != self.cluster:
                 continue
-            self._identify(conn, msg.header)
-            self.on_message(msg)
+            with self.tracer.span(
+                    Event.bus_recv,
+                    command=Command(msg.header.command).name):
+                self._identify(conn, msg.header)
+                self.on_message(msg)
 
     def _identify(self, conn: _Connection, header: Header) -> None:
         if conn.peer is not None:

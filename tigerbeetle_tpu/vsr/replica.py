@@ -61,6 +61,7 @@ from typing import Callable, Optional
 from .. import constants
 from ..constants import PIPELINE_PREPARE_QUEUE_MAX
 from ..state_machine import StateMachine, _base_operation
+from ..trace import Event
 from ..types import Operation
 import struct
 
@@ -134,7 +135,7 @@ class Replica:
         # Peers whose fingerprint mismatched: ALL their replica-to-replica
         # traffic is dropped until a matching ping clears them.
         self._config_mismatch: set[int] = set()
-        self.journal = Journal(storage)
+        self.journal = Journal(storage, tracer=self.tracer)
         self.state_machine: StateMachine = state_machine_factory()
         self.durable = DurableState(storage)
         # Serve reads from the LSM with a bounded object cache
@@ -152,7 +153,7 @@ class Replica:
         # times on different replicas (grid_scrubber.zig:170-182).
         self.scrubber = GridScrubber(
             self.durable.forest,
-            origin_seed=replica_id * 2654435761)
+            origin_seed=replica_id * 2654435761, tracer=self.tracer)
         self._scrub_phase = 0
 
         self.status = "recovering"
@@ -391,6 +392,7 @@ class Replica:
                            replica_id=self.replica_id,
                            replica_count=self.replica_count)
         self.rebuilding = True
+        self.tracer.begin(Event.rebuild)
         self.open()
         # A persisted log_view < view would open as "view_change", whose
         # liveness branch elects — a rebuilding replica never does. It
@@ -413,6 +415,7 @@ class Replica:
         """Re-enter the voting set (only once the rebuild is complete)."""
         assert self.rebuild_complete
         self.rebuilding = False
+        self.tracer.end(Event.rebuild)
 
     def rebuild_progress(self) -> str:
         """One-line operator-facing progress (recover --from-cluster)."""
@@ -766,8 +769,16 @@ class Replica:
     def _check_quorum(self, op: int) -> None:
         """Commit in order as quorums complete (reference commit_dispatch)."""
         while True:
-            entry = self.pipeline.get(self.commit_min + 1)
-            if entry is None or len(entry["oks"]) < self.quorum_replication:
+            # The primary's prefetch stage: its prepare comes from the
+            # in-memory pipeline, not a journal read — the span still
+            # measures the fetch + quorum check so all four commit
+            # stages appear on every replica's trace.
+            with self.tracer.span(Event.commit_prefetch,
+                                  op=self.commit_min + 1):
+                entry = self.pipeline.get(self.commit_min + 1)
+                ready = (entry is not None and
+                         len(entry["oks"]) >= self.quorum_replication)
+            if not ready:
                 return
             self.commit_max = max(self.commit_max, self.commit_min + 1)
             self._commit_op(entry["message"])
@@ -804,7 +815,8 @@ class Replica:
         window_backoff = False
         while self.commit_min < commit_target:
             op = self.commit_min + 1
-            msg = self.journal.read_prepare(op)
+            with self.tracer.span(Event.commit_prefetch, op=op):
+                msg = self.journal.read_prepare(op)
             want_hdr = self.canonical.get(op)
             want = None if want_hdr is None else want_hdr.checksum
             if msg is None or (want is not None
@@ -866,11 +878,15 @@ class Replica:
             window = (None if window_backoff
                       else self._collect_commit_window(msg, commit_target))
             if window is not None:
-                out = self.state_machine.commit_window(
-                    Operation(window[0].header.operation),
-                    [m.body for m in window],
-                    [m.header.timestamp for m in window],
-                    all_or_nothing=True)
+                with self.tracer.span(
+                        Event.commit_execute, op=window[0].header.op,
+                        operation=int(window[0].header.operation),
+                        window=len(window)):
+                    out = self.state_machine.commit_window(
+                        Operation(window[0].header.operation),
+                        [m.body for m in window],
+                        [m.header.timestamp for m in window],
+                        all_or_nothing=True)
                 if out is None:
                     # Cross-prepare dependency in this suffix: stop
                     # attempting windows for the rest of this call (the
@@ -879,7 +895,7 @@ class Replica:
                     window_backoff = True
                 if out is not None:
                     replies, shape = out
-                    self.tracer.count("commit_windows")
+                    self.tracer.count(Event.commit_windows)
                     self._windows_committed += 1
                     for m, res, k in zip(window, replies, shape):
                         self._post_commit(m, res, chunk_count=k)
@@ -938,7 +954,7 @@ class Replica:
             self.chain_suspect.add(op)
             self.repair_requested.setdefault(op, 0)
             self._suspect_since.setdefault(op, now)
-        self.tracer.count("rollbacks")
+        self.tracer.count(Event.rollbacks)
         logging.getLogger("tigerbeetle_tpu.vsr").warning(
             "replica %d: divergence at op %d — rolled back to checkpoint "
             "%d (was %d); re-executing the canonical history",
@@ -1016,7 +1032,8 @@ class Replica:
         h = prepare.header
         assert h.op == self.commit_min + 1
         operation = Operation(h.operation)
-        with self.tracer.span("commit", op=h.op, operation=int(operation)):
+        with self.tracer.span(Event.commit_execute, op=h.op,
+                              operation=int(operation), window=1):
             result = self.state_machine.commit(operation, prepare.body,
                                                h.timestamp)
         self._post_commit(prepare, result)
@@ -1030,7 +1047,7 @@ class Replica:
         single-op path)."""
         h = prepare.header
         assert h.op == self.commit_min + 1
-        self.tracer.count("commits")
+        self.tracer.count(Event.commits)
         if self.aof is not None:
             self.aof.append(prepare)
         self.commit_min = h.op
@@ -1039,26 +1056,28 @@ class Replica:
         # raw_state: the flush consumes device delta columns directly —
         # the mirror drain stays DEFERRED (it runs at read boundaries and
         # checkpoints, amortized), which is most of the serving win.
-        led = self.state_machine.led
-        cols = (led.take_flush_columns(chunk_count)
-                if led is not None else None)
-        raw = self.state_machine.raw_state
-        if cols and not self._mirror_quiescent():
-            # Interleaved history (hard-regime handoff, account creation,
-            # expiry): the mirror and the chunks describe overlapping
-            # order that only ONE authority may serialize — drain, then
-            # flush everything through the object path. Window commits
-            # form only in the quiescent regime and execute purely on
-            # device, so this must never fire mid-window (a drain here
-            # would serialize LATER window ops' chunks into THIS op's
-            # flush and break cross-replica physical determinism).
-            assert chunk_count is None, \
-                "window commit entered a dirty-mirror regime"
-            self.state_machine.state  # drains; chunks become stale
-            cols = None
-        flushed = self.durable.flush(raw, flush_columns=cols)
-        self.state_machine.cache_upsert(*flushed)
-        self.durable.compact_beat(h.op)
+        with self.tracer.span(Event.commit_compact, op=h.op):
+            led = self.state_machine.led
+            cols = (led.take_flush_columns(chunk_count)
+                    if led is not None else None)
+            raw = self.state_machine.raw_state
+            if cols and not self._mirror_quiescent():
+                # Interleaved history (hard-regime handoff, account
+                # creation, expiry): the mirror and the chunks describe
+                # overlapping order that only ONE authority may
+                # serialize — drain, then flush everything through the
+                # object path. Window commits form only in the quiescent
+                # regime and execute purely on device, so this must
+                # never fire mid-window (a drain here would serialize
+                # LATER window ops' chunks into THIS op's flush and
+                # break cross-replica physical determinism).
+                assert chunk_count is None, \
+                    "window commit entered a dirty-mirror regime"
+                self.state_machine.state  # drains; chunks become stale
+                cols = None
+            flushed = self.durable.flush(raw, flush_columns=cols)
+            self.state_machine.cache_upsert(*flushed)
+            self.durable.compact_beat(h.op)
         if h.client:
             # Reply fields derive from the PREPARE (its view and original
             # primary), never from this replica's identity/current view —
@@ -1082,7 +1101,9 @@ class Replica:
             if self.is_primary:
                 self.bus.send_to_client(h.client, reply)
         if self.commit_min % self.options.checkpoint_interval == 0:
-            self._checkpoint()
+            with self.tracer.span(Event.commit_checkpoint,
+                                  op=self.commit_min):
+                self._checkpoint()
 
     def _checkpoint(self) -> None:
         """Forest checkpoint + superblock flip (reference
@@ -1151,6 +1172,10 @@ class Replica:
         # journal must never weigh in a view change either.
         assert not self.is_standby and not self.rebuilding
         assert new_view > self.view
+        # One span per attempted view: an escalation (view+1 while still
+        # changing) closes the stalled attempt and opens the next.
+        self.tracer.end(Event.view_change)
+        self.tracer.begin(Event.view_change, view=new_view)
         self._pending_view = None
         self.status = "view_change"
         self.view = new_view
@@ -1350,6 +1375,7 @@ class Replica:
         self._pending_view = None
         self.log_view = v
         self.status = "normal"
+        self.tracer.end(Event.view_change)
         self._persist_view()
         self._broadcast_start_view()
         self._commit_journal(self.commit_max)
@@ -1403,6 +1429,8 @@ class Replica:
             self._rebuild_goal = h.commit
         self.view = h.view
         self.log_view = h.view
+        if self.status == "view_change":
+            self.tracer.end(Event.view_change)
         self.status = "normal"
         self.pipeline.clear()
         self._persist_view()
@@ -1601,6 +1629,9 @@ class Replica:
                 durable_mod.checkpoint_manifest(root_forest)
         except Exception:
             return  # malformed offer
+        # A fresh (or retargeted) sync is one phase span, offer→install.
+        self.tracer.end(Event.state_sync)
+        self.tracer.begin(Event.state_sync, target_op=h.op)
         self.syncing = {
             "target_op": h.op, "root": msg.body, "source": h.replica,
             "commit_max": h.commit, "release": h.release,
@@ -1712,17 +1743,20 @@ class Replica:
         if fault is not None:
             _, address, size = fault
             block_size = self.storage.layout.grid_block_size
-            original = self.storage.read("grid", index * block_size, block_size)
-            self.storage.write("grid", index * block_size, msg.body)
-            try:
-                # Validate the repaired MEDIA bytes, not a cached copy.
-                self.durable.grid.read_block(address, size,
-                                             bypass_cache=True)
-            except IOError:
-                self.storage.write("grid", index * block_size, original)
-                return
-            del self.block_repair[index]
-            self.scrubber.faults.pop(index, None)
+            with self.tracer.span(Event.grid_repair_block):
+                original = self.storage.read(
+                    "grid", index * block_size, block_size)
+                self.storage.write("grid", index * block_size, msg.body)
+                try:
+                    # Validate the repaired MEDIA bytes, not a cache.
+                    self.durable.grid.read_block(address, size,
+                                                 bypass_cache=True)
+                except IOError:
+                    self.storage.write(
+                        "grid", index * block_size, original)
+                    return
+                del self.block_repair[index]
+                self.scrubber.faults.pop(index, None)
 
     def _sync_install(self) -> None:
         from .durable import validate_staged_checkpoint
@@ -1740,6 +1774,7 @@ class Replica:
         except Exception:
             # Corrupted transfer or bad offer: drop and re-request later.
             self.syncing = None
+            self.tracer.end(Event.state_sync)
             return
         sb = self.superblock
         # Staged install: persist the sync-progress record BEFORE the
@@ -1763,7 +1798,7 @@ class Replica:
         self.durable.grid.on_corrupt = self._note_missing_block
         self.scrubber = GridScrubber(
             self.durable.forest,
-            origin_seed=self.replica_id * 2654435761)
+            origin_seed=self.replica_id * 2654435761, tracer=self.tracer)
         self.block_repair.clear()
         self.state_machine = self.state_machine_factory()
         self.state_machine.state = state
@@ -1794,6 +1829,7 @@ class Replica:
         for op in [o for o in self.repair_requested if o <= self.commit_min]:
             del self.repair_requested[op]
         self.syncing = None
+        self.tracer.end(Event.state_sync)
 
     # --------------------------------------------------------- reply repair
 
@@ -2011,7 +2047,7 @@ class Replica:
         # ping's otherwise-unused u128 `context`.
         fp = msg.header.context
         if fp != 0 and fp != self._config_fp:
-            self.tracer.count("config_mismatch_peer", 1)
+            self.tracer.count(Event.config_mismatch_peer, 1)
             self._config_mismatch.add(msg.header.replica)
             return
         if fp == self._config_fp:
