@@ -343,6 +343,7 @@ def inner_main() -> None:
     from tigerbeetle_tpu.benchmark import (
         BASELINE_TPS,
         CONFIG_DIAGNOSTICS,
+        CONFIG_ROUTES,
         TARGET_TPS,
         bench_config1,
         bench_config2,
@@ -420,6 +421,13 @@ def inner_main() -> None:
     if recovery:
         emit("recovery_diagnostics", recovery)
 
+    # Dispatch-route record: which kernel route each config's windows
+    # took ("chain" = the scan-form whole-window dispatch, the default
+    # serving route) + the window depths used — a silent route
+    # degradation is as visible as a throughput regression.
+    if CONFIG_ROUTES:
+        emit("dispatch_routes", dict(CONFIG_ROUTES))
+
     # Op-budget summary (light tier subset, pure tracing — no device
     # execution): the per-run record of the kernels' heavy-op footprint
     # on its own ##opbudget line; devhub renders it next to the
@@ -474,6 +482,9 @@ def inner_main() -> None:
         # Per-config routing/fallback counters (per-cause): the measured
         # "zero host fallbacks" record behind every number above.
         "fallback_diagnostics": dict(CONFIG_DIAGNOSTICS),
+        # Dispatch route + window depth per config (chain = the default
+        # whole-window scan route).
+        "dispatch_routes": dict(CONFIG_ROUTES),
         # Chaos/recovery counters next to the fallback record (zeros in
         # a healthy run — and recorded, not assumed).
         "recovery_diagnostics": recovery,
@@ -663,7 +674,8 @@ def main() -> None:
     config_keys = ("config1_2hot_tps", "config2_10k_tps",
                    "config3_chains_tps", "config4_twophase_limits_tps",
                    "config5_oracle_parity", "config6_serving_tps",
-                   "serving_batch_latency", "fallback_diagnostics")
+                   "serving_batch_latency", "fallback_diagnostics",
+                   "dispatch_routes")
     if banked is not None:
         # Self-consistent record: value, per-config numbers AND the
         # platform tag all come from the banked on-chip artifact (a

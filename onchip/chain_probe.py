@@ -1,19 +1,24 @@
-"""Whole-program window-chain probe on the REAL kernel (round 4,
-VERDICT item 1's deliverable).
+"""Whole-program window-chain probe on the REAL kernel — now through
+the REAL serving route (round 7: the chain is the default dispatch
+mode, so the banked numbers must be serving-path numbers, not
+synthetic kernel calls).
 
 Measures config2-shaped commit windows (stack x 8190-event prepares per
-window) three ways on the chip:
+window) on the chip:
 
-  seq      W separate super dispatches (the round-3 regime)
-  chain    ONE compiled program: lax.scan over W windows, donated state
-  unroll   ONE compiled program: W windows unrolled straight-line
+  seq      W separate super dispatches (the round-3 regime, anchor)
+  chain    ONE compiled program: raw lax.scan over W windows (the
+           round-4/5 synthetic arm, kept for series continuity)
+  route    DeviceLedger.submit_window/resolve_windows with depth-2
+           pipelining — the ACTUAL serving dispatch (scan-form chain
+           kernel per window, W prepares per dispatch), so the banked
+           verdict prices the route clients hit.
 
-If chain/unroll amortize (per PERF.md's whole-program model), the
-transfers/s at W windows per dispatch should approach W x the
-sequential rate; if the tunnel op-streams inside a single jit, they
-won't. Writes onchip/chain_probe_result.json either way: the artifact
-that validates or falsifies the 4-16M whole-program claim for this
-environment.
+If the chain amortizes (per PERF.md's whole-program model), transfers/s
+at W prepares per dispatch should approach W x the per-dispatch rate;
+if the tunnel op-streams inside a single jit, it won't. Writes
+onchip/chain_probe_result.json either way: the artifact that validates
+or falsifies the 4-16M whole-program claim for this environment.
 
 Watchdog doctrine (ADVICE r4): the self-deadline arms BEFORE the first
 jax import / backend touch — a wedged PJRT_Client_Create must hit the
@@ -162,6 +167,74 @@ def _run(res, dump, deadline):
                 res[key + "_error"] = repr(e)[:300]
             dump()
 
+    # ---- the REAL serving route: submit_window/resolve_windows with
+    # depth-2 pipelining, W prepares per chain dispatch (the default
+    # dispatch mode since round 7). These are the numbers the serving
+    # path actually delivers — route_wN_tps is the banked verdict's
+    # primary arm now.
+    def mk_prepares(n_windows, w, bi0):
+        rng = np.random.default_rng(3)
+        out = []
+        bi = bi0
+        for _ in range(n_windows):
+            evs, tss = [], []
+            for _ in range(w):
+                base = 2 * 10 ** 8 + bi * N
+                ids = np.arange(base, base + N)
+                dr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+                cr = rng.integers(1, AC + 1, N, dtype=np.uint64)
+                clash = dr == cr
+                cr[clash] = dr[clash] % AC + 1
+                evs.append(_soa(ids, dr, cr,
+                                rng.integers(1, 10 ** 6, N)))
+                tss.append(2 * 10 ** 13 + bi * (N + 10))
+                bi += 1
+            out.append((evs, tss))
+        return out, bi
+
+    def run_route(led, windows):
+        pending = []
+        t0 = time.perf_counter()
+        for evs, tss in windows:
+            tk = led.submit_window(evs, tss)
+            assert tk is not None, "route arm fell off the pipeline"
+            pending.append(tk)
+            if len(pending) > 1:
+                led.resolve_windows(count=1)
+                pending.pop(0)
+        led.resolve_windows()
+        dt = time.perf_counter() - t0
+        stats = led.fallback_stats()
+        assert stats["routes"]["windows"].get("chain", 0) >= 1, stats
+        assert stats["host_fallbacks"] == 0, stats
+        return dt
+
+    bi_r = 0
+    for W in (8, 32):
+        key = f"route_w{W}"
+        if key + "_tps" in res:
+            continue
+        if time.monotonic() > deadline:
+            res.setdefault("deadline_hit", f"before {key}")
+            break
+        try:
+            led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+            warm, bi_r = mk_prepares(2, W, bi_r)
+            t_c0 = time.perf_counter()
+            run_route(led, warm)
+            res[key + "_compile_s"] = round(time.perf_counter() - t_c0, 1)
+            runs = []
+            for _ in range(2):
+                led = _make_ledger(AC, a_cap=1 << 15, t_cap=1 << 22)
+                ws, bi_r = mk_prepares(2, W, bi_r)
+                runs.append(run_route(led, ws))
+            best = min(runs)
+            res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
+            res[key + "_tps"] = round(2 * W * N / best, 1)
+        except Exception as e:  # noqa: BLE001 — record, go on
+            res[key + "_error"] = repr(e)[:300]
+        dump()
+
     if "deadline_hit" not in res and "alarm" not in res:
         # The watcher re-runs this probe in later windows until a
         # COMPLETE artifact lands (partial ones bank data but must
@@ -180,16 +253,21 @@ def main():
     # over and skipped, so a re-run extends the artifact instead of
     # regressing it.
     resume_from(out_path, res,
-                keep=lambda k: k.startswith(("seq_w1_", "chain_w")))
+                keep=lambda k: k.startswith(("seq_w1_", "chain_w",
+                                             "route_w")))
     dump = make_dumper(res, out_path)
 
     def verdict(target=None):
         target = res if target is None else target
-        # Only the measured arms (chain_wN_tps) — NOT best_chain_tps,
-        # which an earlier verdict() call may have written (the watchdog
-        # can re-enter verdict() on a snapshot taken after finally).
+        # Only the measured arms (chain_wN_tps / route_wN_tps) — NOT
+        # best_chain_tps, which an earlier verdict() call may have
+        # written (the watchdog can re-enter verdict() on a snapshot
+        # taken after finally).
         chain_arms = [v for k, v in target.items()
-                      if k.startswith("chain_w") and k.endswith("_tps")
+                      if k.startswith(("chain_w", "route_w"))
+                      and k.endswith("_tps") and v is not None]
+        route_arms = [v for k, v in target.items()
+                      if k.startswith("route_w") and k.endswith("_tps")
                       and v is not None]
         seq = target.get("seq_w1_tps", 0)
         if not chain_arms:
@@ -204,6 +282,9 @@ def main():
             if seq and chain_tps > 1.5 * seq else
             "whole-program chain does NOT beat sequential dispatch here")
         target["best_chain_tps"] = chain_tps
+        # Serving-route record: the default dispatch mode's own number
+        # (submit_window pipeline), the one clients actually see.
+        target["best_route_tps"] = max(route_arms) if route_arms else None
 
     def _on_deadline():
         # Work on a snapshot: mutating res while the main thread is

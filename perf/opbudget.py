@@ -9,17 +9,24 @@ un-regressable:
     scatter / segment_sum / scan — tigerbeetle_tpu.jaxhound.heavy_census)
     plus the operand bytes those ops read, for every create_transfers
     kernel tier INCLUDING the SPMD lowerings (8-device CPU mesh).
-  - budgets: perf/opbudget_r06.json commits a per-tier budget. A kernel
+  - budgets: perf/opbudget_r07.json commits a per-tier budget. A kernel
     change that raises any tier's heavy-op count or operand bytes past
     its budget fails `--check` (wired into scripts/gate.py) — raising a
     budget is an explicit, reviewed edit of the JSON (see
-    ARCHITECTURE.md "Op-budget workflow").
+    ARCHITECTURE.md "Op-budget workflow"). Round 7 adds the CHAIN
+    entries: the scan-form whole-window route's whole-program census
+    (chain_w{2,8,32} — ~constant in window depth, the route's whole
+    point) and its per-iteration BODY census (chain_body_w8, via
+    jaxhound.scan_body_census — pinned <= the per-batch plain tier).
   - lints: `--lint` runs the jaxhound static checks over the serving-
     path jit entries: no closure constant > 4 KiB (the measured
     ~64 ms/call tunnel intercept), no while/fori loop in any serving
-    lowering (the measured 5-8 ms process-wide degradation), and every
-    state-carrying entry donates its ledger buffers (donated-input
-    count == state leaf count in the lowered artifact).
+    lowering (the measured 5-8 ms process-wide degradation) beyond an
+    entry's declared allowance (the chain entries' ONE deliberate scan
+    lowers to one stablehlo.while; everything else allows zero), and
+    every state-carrying entry donates its ledger buffers
+    (donated-input count == state leaf count in the lowered artifact —
+    the chain entries are audited too, incl. the unrolled form).
 
 CLI:
     python perf/opbudget.py             # print the census table
@@ -51,14 +58,32 @@ import numpy as np  # noqa: E402
 from tigerbeetle_tpu import jaxhound  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r06.json")
+BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r07.json")
 
 STACK = 4
 N_SUPER = 1024
+# Chain-route census depths: the whole-program census must be
+# ~constant across these (the scan body lowers once).
+CHAIN_DEPTHS = (2, 8, 32)
+
+
+def _mk_prepares(n_prepares, n=N_SUPER, nid0=10 ** 6, seed=0):
+    from tigerbeetle_tpu.benchmark import _soa
+
+    rng = np.random.default_rng(seed)
+    evs, tss = [], []
+    nid = nid0
+    for b in range(n_prepares):
+        dr = rng.integers(1, 64, n, dtype=np.uint64)
+        cr = (dr % 63) + 1
+        evs.append(_soa(np.arange(nid, nid + n), dr, cr,
+                        rng.integers(1, 100, n)))
+        nid += n
+        tss.append(10 ** 12 + b * (n + 10))
+    return evs, tss
 
 
 def _fixtures():
-    from tigerbeetle_tpu.benchmark import _soa
     from tigerbeetle_tpu.ops.batch import transfers_to_arrays
     from tigerbeetle_tpu.ops.ledger import (
         init_state, pad_transfer_events, stack_superbatch)
@@ -68,18 +93,16 @@ def _fixtures():
     ev = pad_transfer_events(transfers_to_arrays(
         [Transfer(id=1, debit_account_id=1, credit_account_id=2,
                   amount=1, ledger=1, code=1)]))
-    rng = np.random.default_rng(0)
-    evs, tss = [], []
-    nid = 10 ** 6
-    for b in range(STACK):
-        dr = rng.integers(1, 64, N_SUPER, dtype=np.uint64)
-        cr = (dr % 63) + 1
-        evs.append(_soa(np.arange(nid, nid + N_SUPER), dr, cr,
-                        rng.integers(1, 100, N_SUPER)))
-        nid += N_SUPER
-        tss.append(10 ** 12 + b * (N_SUPER + 10))
+    evs, tss = _mk_prepares(STACK)
     ev_s, seg = stack_superbatch(evs, tss)
     return state, ev, ev_s, seg
+
+
+def _chain_fixture(depth):
+    from tigerbeetle_tpu.ops.ledger import stack_chain_window
+
+    evs, tss = _mk_prepares(depth)
+    return stack_chain_window(evs, tss, N_SUPER)
 
 
 def census_tiers(include_sharded: bool = True,
@@ -136,6 +159,25 @@ def census_tiers(include_sharded: bool = True,
         if only is not None and name not in only:
             continue
         out[name] = jaxhound.heavy_census(jax.make_jaxpr(fn)(*args))
+    # Chain route (the default whole-window scan dispatch): the
+    # whole-program census at three depths — ~constant heavy totals
+    # prove the scan body lowers once — plus the per-iteration BODY
+    # census the gate pins against the per-batch plain tier.
+    chain_names = tuple(f"chain_w{w}" for w in CHAIN_DEPTHS) + (
+        "chain_body_w8",)
+    if only is None or any(n in only for n in chain_names):
+        for w in CHAIN_DEPTHS:
+            name = f"chain_w{w}"
+            if only is not None and name not in only and not (
+                    w == 8 and "chain_body_w8" in only):
+                continue
+            ev_c, seg_c = _chain_fixture(w)
+            cj = jax.make_jaxpr(fk._create_transfers_chain)(
+                state, ev_c, seg_c)
+            if only is None or name in only:
+                out[name] = jaxhound.heavy_census(cj)
+            if w == 8 and (only is None or "chain_body_w8" in only):
+                out["chain_body_w8"] = jaxhound.scan_body_census(cj)
     if only is not None:
         include_sharded = False
     if include_sharded and len(jax.devices()) >= 8:
@@ -155,8 +197,10 @@ def census_tiers(include_sharded: bool = True,
 
 
 def serving_entries() -> dict:
-    """name -> (lowered artifact, expected donated-input count) for the
-    state-carrying jit entries on the serving/scan paths."""
+    """name -> (lowered artifact, expected donated-input count, allowed
+    while count) for the state-carrying jit entries on the serving/scan
+    paths. The chain entries allow exactly ONE stablehlo.while (their
+    deliberate lax.scan); everything else allows zero."""
     from tigerbeetle_tpu.ops import fast_kernels as fk
 
     state, ev, ev_s, seg = _fixtures()
@@ -165,8 +209,8 @@ def serving_entries() -> dict:
     n = np.int32(1)
     entries = {}
 
-    def add(name, jitfn, *args):
-        entries[name] = (jitfn.lower(*args), n_leaves)
+    def add(name, jitfn, *args, max_while=0):
+        entries[name] = (jitfn.lower(*args), n_leaves, max_while)
 
     add("create_transfers_fast_jit", fk.create_transfers_fast_jit,
         state, ev, ts, n)
@@ -190,6 +234,19 @@ def serving_entries() -> dict:
         fk.create_transfers_super_deep_ring_jit, state, ev_s, seg)
     add("create_transfers_super_balancing_jit",
         fk.create_transfers_super_balancing_jit, state, ev_s, seg)
+    # Chain entries (the default whole-window route): the scan form's
+    # one deliberate while is allowed; the unrolled fallback form must
+    # stay straight-line — and BOTH must donate the state carry
+    # (create_transfers_chain_unrolled_jit used to escape this audit
+    # because only per-batch tiers were enumerated here).
+    ev_c, seg_c = _chain_fixture(4)
+    add("create_transfers_chain_jit", fk.create_transfers_chain_jit,
+        state, ev_c, seg_c, max_while=1)
+    add("create_transfers_chain_ring_jit",
+        fk.create_transfers_chain_ring_jit, state, ev_c, seg_c,
+        max_while=1)
+    add("create_transfers_chain_unrolled_jit",
+        fk.create_transfers_chain_unrolled_jit, state, ev_c, seg_c)
     # Sharded steps (8-device CPU mesh): same donation contract.
     if len(jax.devices()) >= 8:
         from jax.sharding import Mesh
@@ -202,7 +259,7 @@ def serving_entries() -> dict:
             with mesh:
                 entries[f"sharded_{mode}_step"] = (
                     step.lower(state, ev, np.uint64(1000), np.int32(1)),
-                    n_leaves)
+                    n_leaves, 0)
     return entries
 
 
@@ -210,17 +267,19 @@ def run_lints() -> list[str]:
     """Serving-path static lints (jaxhound): closure constants, while
     loops, donation. Returns human-readable failure strings."""
     fails = []
-    for name, (lowered, n_donate) in serving_entries().items():
+    for name, (lowered, n_donate, max_while) in serving_entries().items():
         # The serving path must stay straight-line: lax.scan/while both
-        # lower to stablehlo.while (the deliberate whole-program chain
-        # entries are NOT in this registry for that reason).
+        # lower to stablehlo.while. The chain entries declare their ONE
+        # deliberate scan (max_while=1); anything beyond an entry's
+        # allowance — e.g. a searchsorted left on the default scan
+        # method — is a red.
         text = lowered.as_text()
         n_while = text.count("stablehlo.while")
-        if n_while:
+        if n_while > max_while:
             fails.append(
                 f"{name}: {n_while} while loop(s) in the lowering "
-                "(one executed while degrades every later dispatch to "
-                "5-8 ms — PERF.md)")
+                f"(> allowed {max_while}; one executed while degrades "
+                "every later dispatch to 5-8 ms — PERF.md)")
         donated = jaxhound.donated_inputs(lowered)
         if donated < n_donate:
             fails.append(
@@ -231,6 +290,7 @@ def run_lints() -> list[str]:
     from tigerbeetle_tpu.ops import fast_kernels as fk
 
     state, ev, ev_s, seg = _fixtures()
+    ev_c, seg_c = _chain_fixture(4)
     for name, fn, args in (
             ("create_transfers_fast", fk.create_transfers_fast,
              (state, ev, np.uint64(1000), np.int32(1))),
@@ -238,6 +298,8 @@ def run_lints() -> list[str]:
              lambda st, e, s: fk.create_transfers_fast(
                  st, e, jnp.uint64(0), jnp.int32(0), seg=s),
              (state, ev_s, seg)),
+            ("create_transfers_chain", fk._create_transfers_chain,
+             (state, ev_c, seg_c)),
     ):
         big = jaxhound.closure_constants(jax.make_jaxpr(fn)(*args))
         for label, size in big:
@@ -282,8 +344,11 @@ def check_budgets(current: dict | None = None) -> list[str]:
 
 # Light subset for bench.py's per-run ##opbudget line (the full table
 # incl. deep/sharded tiers is the gate's job; tracing them every bench
-# run would eat the bench budget).
-BENCH_TIERS = ("per_event_plain", "plain", "fixpoint_8", "super_plain_s4")
+# run would eat the bench budget). chain_body_w8 is the serving route's
+# per-iteration op mass — the number the whole-window dispatch bills W
+# times per window.
+BENCH_TIERS = ("per_event_plain", "plain", "fixpoint_8",
+               "super_plain_s4", "chain_body_w8")
 
 
 def summary_line(current: dict | None = None) -> dict:

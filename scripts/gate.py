@@ -23,17 +23,24 @@ supervisor — zero-silent-corruption asserted per seed; skip with
 --no-chaos), the REBUILD smoke (3-replica in-process cluster, zero one
 data file under load, recover-from-cluster, state-epoch digest match,
 plus one fixed seed each of the message_bus and storage_faults
-fuzzers; skip with --no-rebuild), the TRACE-CATALOG coverage leg
+fuzzers; skip with --no-rebuild), the CHAIN-ROUTE leg (testing/chain_smoke.py: the
+default whole-window scan dispatch through the real
+submit_window/resolve_windows route — chain taken by default,
+per-prepare fallback parity vs the sync path and the oracle, zero
+host fallbacks on plain windows, committed chain budgets present;
+skip with --no-chain), the TRACE-CATALOG coverage leg
 (testing/trace_coverage.py: the smokes re-run under recording tracers;
 red when any event in tigerbeetle_tpu/trace/event.py is never emitted
 or an off-catalog name is emitted; skip with --no-trace-cov), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
-(perf/opbudget_r06.json), bakes a >4 KiB closure constant into a
-serving entry, drops state-buffer donation, or introduces a while loop
-into a serving lowering is a RED. See ARCHITECTURE.md "Op-budget
-workflow" for reading a failure / intentionally raising a budget.
+(perf/opbudget_r07.json — incl. the chain route's whole-program and
+scan-BODY censuses), bakes a >4 KiB closure constant into a serving
+entry, drops state-buffer donation, or introduces a while loop beyond
+an entry's allowance into a serving lowering is a RED. See
+ARCHITECTURE.md "Op-budget workflow" for reading a failure /
+intentionally raising a budget.
 
 Exit status is nonzero on ANY red (test failure, collection error,
 timeout, dryrun assertion, budget excess, lint), so
@@ -154,6 +161,32 @@ def run_rebuild(timeout: int = 600) -> int:
     return rc
 
 
+def run_chain(timeout: int = 600) -> int:
+    """Chain-route leg: quick differential of the default whole-window
+    scan dispatch through the REAL submit_window/resolve_windows route —
+    chain taken by default, per-prepare fallback parity vs the sync
+    path and the oracle, zero host fallbacks on plain windows, and the
+    committed chain budgets present (testing/chain_smoke.py; the
+    r07 budget values themselves are enforced by the opbudget leg).
+    Skip with --no-chain."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import chain_smoke; "
+           "chain_smoke.chain_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] chain: whole-window scan-route differential "
+          "(testing/chain_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: chain timed out after {timeout}s", flush=True)
+        return 124
+    print(f"[gate] chain rc={rc} in {time.time() - t0:.0f}s", flush=True)
+    return rc
+
+
 def run_trace_coverage(timeout: int = 900) -> int:
     """Trace-catalog coverage leg: the vopr/chaos/rebuild-style smokes
     (plus deterministic scenarios for rare events) run under recording
@@ -212,6 +245,9 @@ def main() -> int:
     ap.add_argument("--no-trace-cov", action="store_true",
                     help="skip the trace-catalog coverage leg (dead/"
                          "off-catalog metric detection)")
+    ap.add_argument("--no-chain", action="store_true",
+                    help="skip the chain-route leg (whole-window scan "
+                         "dispatch differential)")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -233,6 +269,10 @@ def main() -> int:
         rc = run_rebuild()
         if rc != 0:
             reds.append(f"rebuild rc={rc}")
+    if not args.no_chain:
+        rc = run_chain()
+        if rc != 0:
+            reds.append(f"chain rc={rc}")
     if not args.no_trace_cov:
         rc = run_trace_coverage()
         if rc != 0:

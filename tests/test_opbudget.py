@@ -20,7 +20,7 @@ import jax.numpy as jnp
 from tigerbeetle_tpu import jaxhound
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r06.json")
+BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r07.json")
 
 
 # ------------------------------------------------------------- census
@@ -58,6 +58,51 @@ def test_heavy_census_recurses_into_scan():
     c = jaxhound.heavy_census(cj)
     assert c["heavy"]["scan"] == 1
     assert c["heavy"]["gather"] >= 1
+
+
+def test_scan_body_census_counts_body_once():
+    """The chain route's gate number: the scan BODY census is the
+    per-iteration op mass — body ops x 1 in the program regardless of
+    the scan length (the whole-window dispatch's point)."""
+    def mk(w):
+        def f(x, idx):
+            def body(c, xi):
+                g = c[idx]                       # 1 gather / iteration
+                s = jnp.sort(c)                  # 1 sort / iteration
+                return c + g.sum() + s.sum() + xi.sum(), ()
+            c, _ = jax.lax.scan(
+                body, x, jnp.zeros((w, 4), jnp.float32))
+            return c
+        return jax.make_jaxpr(f)(jnp.arange(8, dtype=jnp.float32),
+                                 jnp.zeros(8, jnp.int32))
+
+    bodies = [jaxhound.scan_body_census(mk(w)) for w in (2, 8, 32)]
+    assert bodies[0]["heavy_total"] == bodies[1]["heavy_total"] \
+        == bodies[2]["heavy_total"]
+    assert bodies[0]["heavy"]["gather"] >= 1
+    assert bodies[0]["heavy"]["sort"] == 1
+    # Whole-program census = body (once) + the outer scan op.
+    whole = jaxhound.heavy_census(mk(32))
+    assert whole["heavy_total"] == bodies[0]["heavy_total"] + 1
+    # No scan -> zero census, not an error.
+    empty = jaxhound.scan_body_census(
+        jax.make_jaxpr(lambda x: x + 1)(jnp.zeros(4)))
+    assert empty["heavy_total"] == 0
+
+
+def test_chain_body_census_within_plain_budget():
+    """Acceptance pin: the committed chain BODY budget stays at or
+    under the per-batch plain tier's, and the whole-program chain
+    census is depth-independent (body + 1 scan at every committed
+    depth)."""
+    with open(BUDGET_PATH) as f:
+        d = json.load(f)
+    b = d["budget"]
+    assert (b["chain_body_w8"]["heavy_total"]
+            <= b["plain"]["heavy_total"])
+    for w in (2, 8, 32):
+        assert (b[f"chain_w{w}"]["heavy_total"]
+                == b["chain_body_w8"]["heavy_total"] + 1), w
 
 
 # ----------------------------------------------------------- lints
@@ -173,7 +218,8 @@ def test_budget_file_covers_core_tiers():
         d = json.load(f)
     for tier in ("per_event_plain", "plain", "fixpoint_8",
                  "balancing_8", "imported", "super_plain_s4",
-                 "super_deep24_s4", "sharded_plain", "sharded_fixpoint"):
+                 "super_deep24_s4", "sharded_plain", "sharded_fixpoint",
+                 "chain_w2", "chain_w8", "chain_w32", "chain_body_w8"):
         assert tier in d["budget"], tier
         b = d["budget"][tier]
         assert b["heavy_total"] == sum(b["heavy"].values())

@@ -265,7 +265,11 @@ def test_state_machine_commit_window_parity():
 
 def test_commit_window_cross_prepare_dup_seq_fallback():
     """A window with a duplicate id across prepares produces the same
-    replies as sequential commits (via the in-ledger fallback)."""
+    replies as sequential commits. Since the chain route became the
+    default dispatch mode (round 7) this resolves NATIVELY: prepare 2
+    executes against the state prepare 1 evolved inside the one scan
+    dispatch, so the duplicate reads 'exists' with ZERO fallbacks —
+    the flat superbatch used to throw the whole window away (E2)."""
     from tigerbeetle_tpu import multi_batch
     from tigerbeetle_tpu.state_machine import (
         OPERATION_SPECS,
@@ -291,7 +295,8 @@ def test_commit_window_cross_prepare_dup_seq_fallback():
     sm_b = fresh()
     win = sm_b.commit_window(Operation.create_transfers, bodies, tss)
     assert seq == win
-    assert sm_b.led.window_fallbacks == 1
+    assert sm_b.led.window_fallbacks == 0
+    assert sm_b.led.fallback_stats()["routes"]["windows"] == {"chain": 1}
 
 
 def test_replica_catchup_windows_preserve_determinism():

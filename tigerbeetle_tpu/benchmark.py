@@ -53,11 +53,31 @@ def _soa(ids, dr, cr, amount, flags=None, pid=None, timeout=None):
 # assumption. Keyed "configN" -> DeviceLedger.fallback_stats().
 CONFIG_DIAGNOSTICS: dict = {}
 
+# Per-config dispatch-route record: which kernel route each config's
+# windows took ("chain" = the scan-form whole-window dispatch, the
+# default) and the window depths used — emitted into bench.py's ##diag
+# record and the final metric JSON, so a silent route degradation (the
+# old power-of-two stack selection degraded odd batch counts to
+# stack 1) is visible in every run record.
+CONFIG_ROUTES: dict = {}
+
 
 def _record_diag(key, led) -> None:
     try:
         CONFIG_DIAGNOSTICS[key] = led.fallback_stats()
+        routes = led.fallback_stats().get("routes")
+        if routes and routes.get("windows"):
+            CONFIG_ROUTES.setdefault(key, {}).update(
+                windows=routes["windows"])
     except Exception:  # diagnostics must never fail a bench run
+        pass
+
+
+def _record_route(key, route, depths) -> None:
+    try:
+        CONFIG_ROUTES[key] = {"route": route,
+                              "window_depths": sorted(set(depths))}
+    except Exception:
         pass
 
 
@@ -91,66 +111,69 @@ SUPERBATCH_MAX = 32
 
 
 def _superbatch_default(n_batches):
+    """Window depth per dispatch. The chain route (scan-form whole-
+    window dispatch) accepts ARBITRARY depths — the old selection only
+    admitted power-of-two stacks <= 32 dividing the batch count, which
+    silently degraded odd-count windows to stack 1. At most two program
+    shapes compile per run (the full depth + one tail)."""
     import jax
 
     if jax.default_backend() != "tpu":
         return 1
-    s = SUPERBATCH_MAX
-    while s >= 2:
-        if n_batches % s == 0:
-            return s
-        s //= 2
-    return 1
+    return min(SUPERBATCH_MAX, n_batches)
 
 
-def _run_scan(led, evs, ts0, stack=None):
+def _run_scan(led, evs, ts0, stack=None, diag_key=None):
     """Dispatch batches back-to-back with no mid-run host sync; returns
     (accepted, elapsed). Host-side padding is staged before the clock.
 
-    One straight-line (control-flow-free) program per batch; the poison
-    flag threads through dispatches as a DEVICE value, so a mid-run
-    fallback masks every later batch exactly like the old on-device scan
-    did — without a lax.scan op (while-style programs execute
-    pathologically through the remote-TPU tunnel) and without waiting on
-    any per-batch result.
+    stack=1: one straight-line (control-flow-free) program per batch;
+    the poison flag threads through dispatches as a DEVICE value, so a
+    mid-run fallback masks every later batch without waiting on any
+    per-batch result.
 
-    stack=K executes K prepares per dispatch via the superbatch kernel
-    (commit-window aggregation, the group-commit analog of the
-    reference's 8-deep prepare pipeline — src/config.zig:155): per-op
-    dispatch cost is size-independent to ~64k rows, so tunnel-regime
-    throughput scales ~K. Semantics are unchanged — the eligibility
-    proofs extend to the concatenated window and any cross-batch
-    dependency falls back."""
+    stack=K (the serving route): K prepares per dispatch via the
+    SCAN-FORM CHAIN kernel — ONE compiled program whose body executes
+    each prepare against the state evolved by the previous ones
+    (create_transfers_chain_jit, the same route DeviceLedger's
+    submit_window takes). Program op count is ~constant in K, the
+    poison scalar rides the scan carry between prepares AND between
+    dispatches, and K is arbitrary (a tail window of a different depth
+    compiles one extra shape). The chosen route + depths land in
+    CONFIG_ROUTES -> bench.py's ##diag record."""
     import jax
 
     from .ops.fast_kernels import (
         _accum_jit,
+        _accum_sum_jit,
+        create_transfers_chain_jit,
         create_transfers_fast_jit,
-        create_transfers_super_jit,
     )
-    from .ops.ledger import pad_transfer_events, stack_superbatch
+    from .ops.ledger import pad_transfer_events, stack_chain_window
 
     stack = stack or _superbatch_default(len(evs))
     tss = [int(ts0) + i * (N + 10) for i in range(len(evs))]
     poisoned = jax.device_put(np.bool_(False))
     accepted_dev = jax.device_put(np.int64(0))
     if stack > 1:
-        # A short tail group would compile a second program shape, so
-        # drivers send batch counts that are multiples of `stack`.
-        assert len(evs) % stack == 0, "stack must divide the batch count"
         groups = []
+        depths = []
         for lo in range(0, len(evs), stack):
-            ev_s, seg = stack_superbatch(
+            ev_c, seg_c = stack_chain_window(
                 evs[lo:lo + stack], tss[lo:lo + stack])
+            depths.append(len(evs[lo:lo + stack]))
             groups.append((
-                {k: jax.device_put(v) for k, v in ev_s.items()},
-                {k: jax.device_put(v) for k, v in seg.items()}))
+                {k: jax.device_put(v) for k, v in ev_c.items()},
+                {k: jax.device_put(v) for k, v in seg_c.items()}))
+        if diag_key is not None:
+            _record_route(diag_key, "chain", depths)
         t0 = time.perf_counter()
-        for ev_s, seg in groups:
-            led.state, outs = create_transfers_super_jit(
-                led.state, ev_s, seg, force_fallback=poisoned)
-            poisoned = outs["fallback"]
-            accepted_dev = _accum_jit(accepted_dev, outs["created_count"])
+        for ev_c, seg_c in groups:
+            led.state, outs = create_transfers_chain_jit(
+                led.state, ev_c, seg_c, poisoned)
+            poisoned = outs["fallback"][-1]
+            accepted_dev = _accum_sum_jit(accepted_dev,
+                                          outs["created_count"])
         accepted, bad = jax.device_get((accepted_dev, poisoned))
         elapsed = time.perf_counter() - t0
         assert not bool(bad), "unexpected fallback"
@@ -158,6 +181,8 @@ def _run_scan(led, evs, ts0, stack=None):
 
     padded = [{k: jax.device_put(v) for k, v in
                pad_transfer_events(e).items()} for e in evs]
+    if diag_key is not None:
+        _record_route(diag_key, "per_batch", [1])
     n_arr = np.int32(N)
     t0 = time.perf_counter()
     for ev, ts in zip(padded, tss):
@@ -178,8 +203,14 @@ def _warm_and_run(led, mk, batches, diag_key=None):
     warm = stack if stack > 1 else B_CHUNK
     _run_scan(led, [mk(b) for b in range(-warm, 0)],
               np.uint64(10**11), stack=stack)
+    # Warm the tail-window shape too (arbitrary depths compile a second
+    # program), still outside the clock.
+    tail = batches % stack
+    if stack > 1 and tail:
+        _run_scan(led, [mk(b) for b in range(-warm - tail, -warm)],
+                  np.uint64(10**11 + 10**9), stack=tail)
     out = _run_scan(led, [mk(b) for b in range(batches)],
-                    np.uint64(10**12), stack=stack)
+                    np.uint64(10**12), stack=stack, diag_key=diag_key)
     if diag_key is not None:
         _record_diag(diag_key, led)
     return out

@@ -262,9 +262,52 @@ def render(history_path: str, out_path: str,
               "<th>epochs verified</th><th>checksum mismatches</th>"
               "<th>recoveries by cause</th></tr>"
             + "".join(rows_rec) + "</table>")
+    # Dispatch-route panel: which kernel route each config's windows
+    # took ("chain" = the default scan-form whole-window dispatch) and
+    # the per-cause prepares that fell out of chain windows — a shift
+    # away from chain on a plain workload is a routing regression,
+    # rendered next to the fallback diagnostics it would show up in.
+    route_html = ""
+    routes = next((e.get("dispatch_routes") for e in reversed(entries)
+                   if isinstance(e.get("dispatch_routes"), dict)
+                   and e.get("dispatch_routes")), None)
+    if routes is None:
+        fbd = next((e.get("fallback_diagnostics")
+                    for e in reversed(entries)
+                    if isinstance(e.get("fallback_diagnostics"), dict)),
+                   None) or {}
+        routes = {cfg: d.get("routes") for cfg, d in fbd.items()
+                  if isinstance(d, dict)
+                  and isinstance(d.get("routes"), dict)
+                  and (d["routes"].get("windows")
+                       or d["routes"].get("chain_batch_fallbacks"))}
+    if routes:
+        rows_rt = []
+        for cfg in sorted(routes):
+            d = routes[cfg] or {}
+            wins = d.get("windows") or {}
+            if not wins and d.get("route"):
+                depths = ",".join(str(x) for x in
+                                  d.get("window_depths") or []) or "-"
+                wins_txt = f"{d['route']} (depths {depths})"
+            else:
+                wins_txt = ", ".join(
+                    f"{k}={v}" for k, v in sorted(wins.items())) or "-"
+            cbf = d.get("chain_batch_fallbacks") or {}
+            cbf_txt = ", ".join(
+                f"{k}={v}" for k, v in sorted(cbf.items())) or "-"
+            rows_rt.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td></tr>".format(
+                    html.escape(cfg), html.escape(wins_txt),
+                    html.escape(cbf_txt)))
+        route_html = (
+            "<h2>dispatch routes (latest run)</h2>"
+            "<table><tr><th>config</th><th>windows by route</th>"
+            "<th>chain per-prepare fallbacks</th></tr>"
+            + "".join(rows_rt) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
-    # ceilings (perf/opbudget_r06.json) — compile-footprint regressions
+    # ceilings (perf/opbudget_r07.json) — compile-footprint regressions
     # are rendered as loudly as throughput ones.
     ob_html = ""
     ob = next((e.get("opbudget") for e in reversed(entries)
@@ -274,7 +317,7 @@ def render(history_path: str, out_path: str,
         budgets = {}
         try:
             bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "..", "perf", "opbudget_r06.json")
+                                 "..", "perf", "opbudget_r07.json")
             with open(bpath) as f:
                 budgets = json.load(f).get("budget", {})
         except (OSError, ValueError):
@@ -387,6 +430,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 </table>
 {fb_html}
 {rec_html}
+{route_html}
 {ob_html}
 {tr_html}
 {cfo_html}

@@ -93,6 +93,42 @@ def heavy_census(closed_jaxpr) -> dict:
     return out
 
 
+def scan_bodies(closed_jaxpr) -> list:
+    """Every lax.scan body (ClosedJaxpr) anywhere in the program, in
+    visit order. The scan-form chain dispatch's whole point is that the
+    body lowers ONCE regardless of the scan length W — these are the
+    jaxprs the dispatch layer re-executes per iteration."""
+    bodies: list = []
+
+    def visit(eqn):
+        if eqn.primitive.name == "scan":
+            inner = eqn.params.get("jaxpr")
+            if inner is not None:
+                bodies.append(inner)
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return bodies
+
+
+def scan_body_census(closed_jaxpr) -> dict:
+    """heavy_census of the LARGEST lax.scan body in the program (by
+    heavy total) — the chain route's per-ITERATION op mass. The
+    whole-window scan dispatch executes this body once per window
+    iteration (body ops x 1 in the program, x W at runtime), so the
+    op-budget gate pins the BODY census alongside the whole-program one
+    (which counts the body once plus the outer scan op). Returns a
+    zero census when the program holds no scan."""
+    best = None
+    for b in scan_bodies(closed_jaxpr):
+        c = heavy_census(b)
+        if best is None or c["heavy_total"] > best["heavy_total"]:
+            best = c
+    if best is None:
+        best = {"heavy": {c: 0 for c in HEAVY_CLASS_ORDER},
+                "heavy_total": 0, "heavy_operand_bytes": 0}
+    return best
+
+
 # ----------------------------------------------------------- static lints
 
 CLOSURE_CONST_LIMIT = 4096  # bytes; PERF.md: ~64 ms/call at 0.5 MB

@@ -427,6 +427,12 @@ _IDV_U64 = ("id_hi", "id_lo", "dr_hi", "dr_lo", "cr_hi", "cr_lo",
             "amt_hi", "amt_lo", "pid_hi", "pid_lo", "ud128_hi",
             "ud128_lo", "ud64")
 _IDV_32 = ("ud32", "timeout", "ledger", "code", "flags")
+# The 32-bit def-side lanes ride PAIR-PACKED (ev_layout.pack32) in the
+# same u64 stack as the wide lanes: the whole ~21-lane view is ONE
+# stacked matrix gather (round-7 op cut — was two stacked gathers, u64
+# lanes + a separate u32 stack, inside every fixpoint-tier lowering).
+_IDV_P32 = (("ud32", "timeout"), ("ledger", "code"),
+            ("flags", "dr_rowc"), ("cr_rowc",))
 
 
 def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
@@ -438,19 +444,28 @@ def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
     two can never drift. dr_rowc/cr_rowc are the per-event account-row
     probe results the definition's rows are gathered from.
 
-    Op-budget discipline: the ~20 def-side lanes gather as TWO stacked
-    matrix gathers (u64 lanes + 32-bit lanes), not one gather per lane
-    — this view sits inside every fixpoint-tier lowering."""
-    g64 = jnp.stack([ev[k] for k in _IDV_U64] + [ts_event])[:, didx]
-    g32 = jnp.stack(
-        [ev[k] for k in _IDV_32]
-        + [dr_rowc.astype(jnp.uint32), cr_rowc.astype(jnp.uint32)]
-    )[:, didx]
-    out = {k: g64[i] for i, k in enumerate(_IDV_U64)}
-    out.update({k: g32[i] for i, k in enumerate(_IDV_32)})
+    Op-budget discipline: the ~21 def-side lanes gather as ONE stacked
+    matrix gather — the 32-bit lanes pair-pack into u64 words
+    (_IDV_P32) and unpack after the gather — this view sits inside
+    every fixpoint-tier lowering."""
+    src32 = {k: ev[k] for k in _IDV_32}
+    src32["dr_rowc"] = dr_rowc
+    src32["cr_rowc"] = cr_rowc
+    g = jnp.stack(
+        [ev[k] for k in _IDV_U64] + [ts_event]
+        + [pack32(src32[pr[0]], src32[pr[1]] if len(pr) > 1 else None)
+           for pr in _IDV_P32])[:, didx]
+    out = {k: g[i] for i, k in enumerate(_IDV_U64)}
+    base = len(_IDV_U64) + 1
+    for j, pr in enumerate(_IDV_P32):
+        word = g[base + j]
+        for half, name in enumerate(pr):
+            v = ((word >> jnp.uint64(32)) if half
+                 else (word & _M32)).astype(jnp.uint32)
+            out[name] = v
     d_flags = out["flags"]
     d_timeout = out["timeout"]
-    d_ts = g64[len(_IDV_U64)]
+    d_ts = g[len(_IDV_U64)]
     out.update(
         ts=d_ts,
         expires=jnp.where(
@@ -458,8 +473,8 @@ def _inwin_def_view(ev, ts_event, didx, dr_rowc, cr_rowc):
             d_ts + jnp.uint64(d_timeout) * _NSPS, jnp.uint64(0)),
         pstat=jnp.where(_flag(d_flags, _F_PENDING),
                         jnp.int32(_PS_PENDING), jnp.int32(0)),
-        dr_row=g32[len(_IDV_32)].astype(jnp.int32),
-        cr_row=g32[len(_IDV_32) + 1].astype(jnp.int32),
+        dr_row=out.pop("dr_rowc").astype(jnp.int32),
+        cr_row=out.pop("cr_rowc").astype(jnp.int32),
     )
     return out
 
@@ -577,11 +592,21 @@ def imported_batch_ctx(state, ev, ts_event, valid, idxs, seg_start=None):
         num_segments=N)[seg_id]
     # Account-timestamp collision (reference :3808): membership of
     # the user timestamp in the account table's timestamp column.
+    # The column is read PRE-SORTED (round-7 op cut): rows are stored
+    # in applied-timestamp order — the canonical row order the state
+    # digest and from_host/_push_dirty already pin — so the probe is
+    # searchsorted-only; the former per-dispatch jnp.sort of the whole
+    # table is gone. Rows at/after count read as u64::MAX, making the
+    # live ascending prefix + MAX padding a sorted operand (user
+    # timestamps are <= U63, so the padding can never collide).
     # method='sort': the default 'scan' method lowers to a while loop,
     # which degrades every later dispatch in the process to 5-8 ms
     # (PERF.md round-2 finding; jaxhound's serving-path lint enforces
     # while-free lowerings).
-    acct_ts_sorted = jnp.sort(acc["u64"][:, AC_U64_IDX["ts"]])
+    au = acc["u64"]
+    acct_ts_sorted = jnp.where(
+        jnp.arange(au.shape[0], dtype=jnp.int32) < acc["count"],
+        au[:, AC_U64_IDX["ts"]], jnp.uint64(0xFFFFFFFFFFFFFFFF))
     pos = jnp.searchsorted(acct_ts_sorted, ev["ts"], method="sort")
     pos = jnp.minimum(pos, acct_ts_sorted.shape[0] - 1)
     coll = imp_lane & (acct_ts_sorted[pos] == ev["ts"]) \
@@ -2379,27 +2404,52 @@ create_transfers_balancing_deep_jit = jax.jit(
 # syncs (one fetch at the end). Module-level so its compile is absorbed by
 # the driver's warmup pass, not the timed region.
 _accum_jit = jax.jit(lambda acc, c: acc + c, donate_argnums=0)
+# Chain-window variant: the per-iteration counts (W,) sum inside the
+# same fused dispatch.
+_accum_sum_jit = jax.jit(lambda acc, c: acc + c.sum(), donate_argnums=0)
 
 
 # ===================================== whole-program window chain (W>=2)
 
 def _create_transfers_chain(state, ev_stack, seg_stack,
-                            force_fallback=None):
-    """W commit windows chained entirely ON DEVICE in one compiled
-    program: a lax.scan whose carry is the donated ledger state plus the
-    rolling fallback scalar — window k's fallback poisons every later
-    window exactly like the host pipeline's chained force_fallback, so
-    commit order survives with ZERO host round-trips inside the chain.
-    Inputs arrive stacked on a leading W axis; results (r_status/r_ts/
-    created_count/fallback per window) come back stacked and are fetched
-    once after the whole chain.
+                            force_fallback=None, ring_reset=False):
+    """W batches (serving: one commit window's prepares; probes: whole
+    windows) chained entirely ON DEVICE in one compiled program: a
+    lax.scan whose carry is the donated ledger state plus the rolling
+    fallback scalar — iteration k's fallback poisons every later
+    iteration exactly like the host pipeline's chained force_fallback
+    (a poisoned iteration leaves state untouched), so commit order
+    survives with ZERO host round-trips inside the chain. Inputs arrive
+    stacked on a leading W axis; results (r_status/r_ts/created_count/
+    fallback/fb_causes per iteration) come back stacked and are fetched
+    once after the whole chain. The scan body is traced ONCE, so the
+    program's op count is ~constant in W — the property that makes this
+    the default serving dispatch route (DeviceLedger.submit_window /
+    create_transfers_window; op mass gated via jaxhound's
+    scan_body_census + perf/opbudget_r07.json).
+
+    ring_reset (static; the pipelined-serving variant): the event ring
+    is consumed from offset 0 per chain DISPATCH — iterations then
+    accumulate within the window, and the window's delta gather
+    (enqueued before the next window's kernel on the device FIFO
+    stream) reads the rows before a later window can overwrite them. A
+    window pre-poisoned by an earlier in-flight fallback leaves the
+    ring count untouched (the state-untouched contract the redo path
+    relies on).
 
     This is the shape PERF.md's whole-program model prices at ~4-16M tps
     on local silicon (the reference's analog: the prefetch/execute split
     lets commits run back-to-back with no IO between them,
     docs/ARCHITECTURE.md:424-434). Through the tunnel its value is
-    empirical — onchip/wholeprog_probe.py decides (scan-form vs
-    unrolled vs op-streamed)."""
+    empirical — onchip/chain_probe.py measures it, now through the real
+    submit_window route."""
+    poisoned0 = (jnp.bool_(False) if force_fallback is None
+                 else force_fallback)
+    if ring_reset:
+        evr = state["events"]
+        state = dict(state, events=dict(
+            evr, count=jnp.where(poisoned0, evr["count"], jnp.int32(0))))
+
     def step(carry, x):
         st, poisoned = carry
         ev, seg = x
@@ -2408,16 +2458,23 @@ def _create_transfers_chain(state, ev_stack, seg_stack,
             force_fallback=poisoned, seg=seg)
         keep = {k: out[k] for k in
                 ("r_status", "r_ts", "fallback", "created_count")}
+        # Per-iteration cause flags ride out stacked (W,) so the route
+        # counters can name WHY a window left the chain route.
+        keep["fb_causes"] = out["fb_causes"]
         return (new_st, out["fallback"]), keep
 
-    init = (state, jnp.bool_(False) if force_fallback is None
-            else force_fallback)
-    (st, _), outs = jax.lax.scan(step, init, (ev_stack, seg_stack))
+    (st, _), outs = jax.lax.scan(step, (state, poisoned0),
+                                 (ev_stack, seg_stack))
     return st, outs
 
 
 create_transfers_chain_jit = jax.jit(
     _create_transfers_chain, donate_argnums=0)
+# Pipelined-serving variant: the event ring resets once per chain
+# dispatch (see ring_reset above).
+create_transfers_chain_ring_jit = jax.jit(
+    functools.partial(_create_transfers_chain, ring_reset=True),
+    donate_argnums=0)
 
 
 def _create_transfers_chain_unrolled(state, ev_stack, seg_stack,
@@ -2438,9 +2495,15 @@ def _create_transfers_chain_unrolled(state, ev_stack, seg_stack,
             st, ev, jnp.uint64(0), jnp.int32(0),
             force_fallback=poisoned, seg=seg)
         poisoned = out["fallback"]
-        outs.append({key: out[key] for key in
-                     ("r_status", "r_ts", "fallback", "created_count")})
-    stacked = {key: jnp.stack([o[key] for o in outs]) for key in outs[0]}
+        kept = {key: out[key] for key in
+                ("r_status", "r_ts", "fallback", "created_count")}
+        kept["fb_causes"] = out["fb_causes"]
+        outs.append(kept)
+    stacked = {key: (jnp.stack([o[key] for o in outs])
+                     if key != "fb_causes" else
+                     {c: jnp.stack([o[key][c] for o in outs])
+                      for c in outs[0][key]})
+               for key in outs[0]}
     return st, stacked
 
 
@@ -2524,11 +2587,18 @@ def create_accounts_fast(state, ev, timestamp, n, imported_mode=False):
         # Regress vs state (reference :3648-3667): the accounts groove's
         # key_max plus collision with any existing TRANSFER timestamp
         # (sorted-column membership; the in-batch component is the
-        # maxima chain below).
+        # maxima chain below). The transfers ts column is read
+        # PRE-SORTED — rows are stored in applied-timestamp order
+        # (round-7 op cut; see imported_batch_ctx) — so the former
+        # full-table jnp.sort (t_cap rows, the widest sort in any
+        # lowering) is gone.
         # method='sort', not the while-lowering default (see
         # imported_batch_ctx).
-        xfer_ts_sorted = jnp.sort(
-            state["transfers"]["u64"][:, XF_U64_IDX["ts"]])
+        xu = state["transfers"]["u64"]
+        xfer_ts_sorted = jnp.where(
+            jnp.arange(xu.shape[0], dtype=jnp.int32)
+            < state["transfers"]["count"],
+            xu[:, XF_U64_IDX["ts"]], jnp.uint64(0xFFFFFFFFFFFFFFFF))
         pos = jnp.minimum(
             jnp.searchsorted(xfer_ts_sorted, ev["ts"], method="sort"),
             xfer_ts_sorted.shape[0] - 1)

@@ -293,11 +293,18 @@ def _delta_gather_body(state, t_start, e_start, size_t, size_e):
     cr_row = ev_col(e, "cr_row")
     p_rows = jnp.maximum(ev_col(e, "p_row"), 0)
     au = acc["u64"]
-    hi_c, lo_c = AC_U64_IDX["id_hi"], AC_U64_IDX["id_lo"]
+    # Touched-account ids: ONE fused gather of the store's two leading
+    # id columns over the concatenated row set (was four scalar-lane
+    # gathers — round-7 op cut; the column positions are static layout
+    # facts, asserted so a reorder cannot silently gather the wrong
+    # pair).
+    assert (AC_U64_IDX["id_hi"], AC_U64_IDX["id_lo"]) == (0, 1)
+    ids2 = au[:, :2][jnp.concatenate([dr_row, cr_row])]
+    n_e = dr_row.shape[0]
     return dict(
         t=t, e=e,
-        dr_id_hi=au[dr_row, hi_c], dr_id_lo=au[dr_row, lo_c],
-        cr_id_hi=au[cr_row, hi_c], cr_id_lo=au[cr_row, lo_c],
+        dr_id_hi=ids2[:n_e, 0], dr_id_lo=ids2[:n_e, 1],
+        cr_id_hi=ids2[n_e:, 0], cr_id_lo=ids2[n_e:, 1],
         p_ts=xf_col(xfr, "ts")[p_rows],
     )
 
@@ -751,6 +758,35 @@ def stack_superbatch(evs: list[dict], timestamps: list[int],
     return ev_super, seg
 
 
+def stack_chain_window(evs: list[dict], timestamps: list[int],
+                       n_pad: int = N_PAD):
+    """K prepares -> (K, n_pad)-stacked inputs for the scan-form chain
+    kernel (create_transfers_chain_jit): scan element k is prepare k
+    padded to n_pad with single-prepare seg lanes. Unlike
+    stack_superbatch (one flat kernel over the whole window, whose op
+    mass and eligibility are window-wide), the chain executes one
+    kernel BODY per prepare with the donated state threaded through the
+    scan carry — cross-prepare effects (ids created earlier in the
+    window, pendings posted later) resolve through the evolving state
+    instead of window-wide proofs, and K is arbitrary (no power-of-two
+    constraint)."""
+    assert len(evs) == len(timestamps) and evs
+    padded = [pad_transfer_events(e, n_pad) for e in evs]
+    ev_stack = {k: np.stack([p[k] for p in padded]) for k in padded[0]}
+    local = np.arange(n_pad, dtype=np.int64)
+    ts_rows, term_rows = [], []
+    for e, ts in zip(evs, timestamps):
+        n_b = len(e["id_lo"])
+        ts_rows.append(np.uint64(ts) - np.uint64(n_b)
+                       + local.astype(np.uint64) + np.uint64(1))
+        term_rows.append(local == n_b - 1)
+    seg_start = np.zeros((len(evs), n_pad), dtype=bool)
+    seg_start[:, 0] = True
+    seg_stack = dict(ts_event=np.stack(ts_rows), seg_start=seg_start,
+                     chain_term=np.stack(term_rows))
+    return ev_stack, seg_stack
+
+
 class WindowTicket:
     """One pipelined commit window in flight: the kernel + delta gather
     are dispatched, nothing is synced. Resolution (in submission order)
@@ -760,10 +796,12 @@ class WindowTicket:
     poisoned windows left the device state untouched)."""
 
     __slots__ = ("evs", "tss", "ns", "n_pad", "out", "gather_dev",
-                 "size", "deep", "all_or_nothing", "e_only", "results")
+                 "size", "deep", "all_or_nothing", "e_only", "results",
+                 "route", "poison")
 
     def __init__(self, evs, tss, ns, n_pad, out, gather_dev, size, deep,
-                 all_or_nothing, e_only=False):
+                 all_or_nothing, e_only=False, route="super",
+                 poison=None):
         self.evs = evs
         self.tss = tss
         self.ns = ns
@@ -776,28 +814,53 @@ class WindowTicket:
         # Half-width capture: only the event-ring slice was gathered;
         # transfer/der columns synthesize on host from the inputs.
         self.e_only = e_only
+        # Dispatch route ("chain" = the default scan-form whole-window
+        # route, per-prepare outputs; "super*" = one flat superbatch
+        # kernel, window-wide outputs) and the device scalar the NEXT
+        # in-flight window chains as force_fallback (for a chain ticket
+        # that is the LAST iteration's fallback — poisoning composes
+        # transitively, so it equals "any iteration fell back").
+        self.route = route
+        self.poison = poison
         self.results = None  # set at resolve
 
 
-def _window_has_pend_refs(ev_s: dict) -> bool:
-    """Host-side pre-route: does any pid in the stacked window match any
-    id in it? (numpy key-merge; u128 keys as (hi, lo) rows). True routes
-    the window straight to the deep superbatch tier — its dependency
-    fixpoint is the only tier that can keep such a window on device."""
-    pid_hi = np.asarray(ev_s["pid_hi"])
-    pid_lo = np.asarray(ev_s["pid_lo"])
+def _evs_pend_refs(evs: list[dict]) -> bool:
+    """Host-side pre-route: does any pid in the window match any id in
+    it? (numpy key-merge over the UNPADDED prepares; u128 keys as
+    (hi, lo) rows). True routes the window to the deep superbatch tier —
+    its dependency fixpoint resolves in-window pending references the
+    plain chain body cannot."""
+    pid_hi = np.concatenate([np.asarray(e["pid_hi"]) for e in evs])
+    pid_lo = np.concatenate([np.asarray(e["pid_lo"]) for e in evs])
     nz = (pid_hi != 0) | (pid_lo != 0)
     if not nz.any():
         return False
-    valid = np.asarray(ev_s["valid"])
-    ids = np.stack([np.asarray(ev_s["id_hi"])[valid],
-                    np.asarray(ev_s["id_lo"])[valid]], axis=1)
-    pids = np.stack([pid_hi[nz & valid], pid_lo[nz & valid]], axis=1)
-    if not len(pids):
-        return False
+    ids = np.stack(
+        [np.concatenate([np.asarray(e["id_hi"]) for e in evs]),
+         np.concatenate([np.asarray(e["id_lo"]) for e in evs])], axis=1)
+    pids = np.stack([pid_hi[nz], pid_lo[nz]], axis=1)
     cat = np.concatenate([np.unique(ids, axis=0), np.unique(pids, axis=0)])
     _, counts = np.unique(cat, axis=0, return_counts=True)
     return bool((counts > 1).any())
+
+
+_F_CLOSE_HOST_BITS = None
+
+
+def _F_CLOSING_HOST() -> int:
+    global _F_CLOSE_HOST_BITS
+    if _F_CLOSE_HOST_BITS is None:
+        from ..types import TransferFlags
+
+        _F_CLOSE_HOST_BITS = int(TransferFlags.closing_debit
+                                 | TransferFlags.closing_credit)
+    return _F_CLOSE_HOST_BITS
+
+
+def _has_closing(evs) -> bool:
+    bit = np.uint32(_F_CLOSING_HOST())
+    return any((np.asarray(e["flags"]) & bit).any() for e in evs)
 
 
 def default_recovery_stats() -> dict:
@@ -845,6 +908,15 @@ class DeviceLedger:
         # "why did we leave the device" record surfaced through
         # bench.py diagnostics and devhub.py.
         self.fallback_causes: dict = {}
+        # Dispatch-route observability: per-route window counts
+        # ("chain" is the default scan-form whole-window route) and the
+        # per-cause counts of prepares that fell OUT of the chain route
+        # (its per-prepare fallback granularity). Surfaced through
+        # fallback_stats()["routes"]; the serving supervisor mirrors
+        # last_window_route into the trace catalog (dispatch_route).
+        self.window_routes: dict = {}
+        self.chain_batch_fallbacks: dict = {}
+        self.last_window_route: str | None = None
         # Monotone per-batch op sequence: every captured write-through
         # chunk carries the op number it belongs to, so a VERIFY spot
         # divergence can name which batch produced the bad rows.
@@ -971,8 +1043,8 @@ class DeviceLedger:
         return st, ts
 
     def submit_window(self, evs: list[dict], timestamps: list[int]):
-        """Pipelined commit window: dispatch the superbatch kernel AND
-        its delta gather with ZERO host synchronization, chaining the
+        """Pipelined commit window: dispatch the window kernel AND its
+        delta gather with ZERO host synchronization, chaining the
         previous in-flight window's fallback scalar as force_fallback —
         a fallback anywhere poisons every later in-flight window on
         device, so commit order survives without waiting (the scan
@@ -984,13 +1056,25 @@ class DeviceLedger:
         resolve_windows(). Pipelined windows are the SERVING path only:
         all-or-nothing replica windows stay on the synchronous
         create_transfers_window (their per-prepare flush attribution
-        cannot survive a mid-pipeline redo)."""
+        cannot survive a mid-pipeline redo).
+
+        Dispatch routing (see ARCHITECTURE.md "Dispatch modes"): the
+        DEFAULT route is the scan-form whole-window CHAIN kernel — one
+        create_transfers_chain_jit dispatch whose body executes each
+        prepare against the state evolved by the previous ones (op
+        count ~constant in window depth; per-prepare fallback
+        granularity). Windows carrying flags the plain chain body
+        cannot serve natively pre-route to their specialized flat
+        superbatch tier: balancing -> super_balancing, closing /
+        in-window pending refs / the breach-hysteresis regime ->
+        super_deep; imported windows return None (the sync path's
+        super_imported tier takes them)."""
         import jax
 
-        from .fast_kernels import (create_transfers_super_deep_jit,
-                                   create_transfers_super_deep_ring_jit,
-                                   create_transfers_super_jit,
-                                   create_transfers_super_ring_jit)
+        from .fast_kernels import (create_transfers_chain_jit,
+                                   create_transfers_chain_ring_jit,
+                                   create_transfers_super_deep_jit,
+                                   create_transfers_super_deep_ring_jit)
 
         ns = [len(e["id_lo"]) for e in evs]
         if not (len(evs) > 1 and not self._mirror_route()):
@@ -1000,42 +1084,56 @@ class DeviceLedger:
             # pipelined kernels are not imported-aware; the sync window
             # routes to the imported super tier).
             return None
+        t_len = int(self.state["transfers"]["u64"].shape[0])
+        e_len = ev_cap(self.state["events"]) + 1
         if self._wt:
             # Capacity pre-check BEFORE any device mutation: the window's
             # created rows must fit one delta-gather bucket (the sync
             # path splits into groups instead; a pipelined caller just
             # takes that path).
-            t_len = int(self.state["transfers"]["u64"].shape[0])
-            e_len = ev_cap(self.state["events"]) + 1
             if sum(ns) > min(32 * N_PAD, t_len, e_len):
                 return None
         n_pad = _pad_bucket(max(ns))
-        ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
-        deep = self._fixpoint_first or _window_has_pend_refs(ev_s)
-        ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
-        seg = {k: jax.device_put(v) for k, v in seg.items()}
-        prev_fb = self._tickets[-1].out["fallback"] if self._tickets \
-            else None
+        prev_fb = self._tickets[-1].poison if self._tickets else None
         # Serving mode: the ring-reset kernel variants consume the event
         # ring from offset 0 per window, so the pipeline never needs a
         # host recycle barrier.
         ring = self._wt and self.recycle_events
-        if _has_balancing(evs):
+        balancing = _has_balancing(evs)
+        deep = (not balancing
+                and (self._fixpoint_first or _has_closing(evs)
+                     or _evs_pend_refs(evs)))
+        if balancing:
             from .fast_kernels import (
                 create_transfers_super_balancing_jit,
                 create_transfers_super_balancing_ring_jit,
             )
 
+            route = "super_balancing"
             jitfn = (create_transfers_super_balancing_ring_jit if ring
                      else create_transfers_super_balancing_jit)
         elif deep:
+            route = "super_deep"
             jitfn = (create_transfers_super_deep_ring_jit if ring
                      else create_transfers_super_deep_jit)
         else:
-            jitfn = (create_transfers_super_ring_jit if ring
-                     else create_transfers_super_jit)
-        new_state, out = jitfn(self.state, ev_s, seg, prev_fb)
+            route = "chain"
+            jitfn = (create_transfers_chain_ring_jit if ring
+                     else create_transfers_chain_jit)
+        if route == "chain":
+            ev_d, seg_d = stack_chain_window(evs, timestamps, n_pad)
+        else:
+            ev_d, seg_d = stack_superbatch(evs, timestamps, n_pad)
+        ev_d = {k: jax.device_put(v) for k, v in ev_d.items()}
+        seg_d = {k: jax.device_put(v) for k, v in seg_d.items()}
+        new_state, out = jitfn(self.state, ev_d, seg_d, prev_fb)
         self.state = new_state
+        self._count_route(route)
+        # Poison scalar for the NEXT in-flight window: the chain's last
+        # iteration's fallback (transitive poisoning makes it "any
+        # iteration fell back"); the flat tiers' window scalar.
+        poison = (out["fallback"][-1] if route == "chain"
+                  else out["fallback"])
         gather = None
         size_te = (0, 0)
         e_only = False
@@ -1055,14 +1153,21 @@ class DeviceLedger:
             e_only = all(
                 not (np.asarray(ev["flags"]) & excl).any()
                 for ev in evs)
+            # Committed-row count for the device-computed slice start:
+            # the chain's per-iteration counts sum ON DEVICE (poisoned
+            # iterations contribute 0, so a partial window's gather
+            # covers exactly the committed prefix).
+            created = (out["created_count"].sum() if route == "chain"
+                       else out["created_count"])
             if e_only:
                 gather = _ev_delta_gather_window_jit(
-                    self.state, out["created_count"], size_te[1])
+                    self.state, created, size_te[1])
             else:
                 gather = _xfer_delta_gather_window_jit(
-                    self.state, out["created_count"], *size_te)
+                    self.state, created, *size_te)
         ticket = WindowTicket(evs, timestamps, ns, n_pad, out, gather,
-                              size_te, deep, False, e_only=e_only)
+                              size_te, deep, False, e_only=e_only,
+                              route=route, poison=poison)
         self._tickets.append(ticket)
         return ticket
 
@@ -1071,12 +1176,18 @@ class DeviceLedger:
         all of them, or just the oldest `count` (the pipelined driver
         resolves one window per submission to keep the overlap).
         Success recovers exactly the synchronous path's results and
-        write-through chunks; the first fallback switches to redo mode —
-        that window and EVERY later in-flight one (poisoned on device by
-        the chained force_fallback, state untouched) replay through the
-        synchronous window path in order, which escalates tiers or goes
-        per-batch exactly as if the pipeline had never formed. Redo
-        therefore always consumes the whole pipeline, even past `count`."""
+        write-through chunks.
+
+        Fallback handling is route-dependent. A flat super-tier window
+        falls back WHOLE (state untouched): it and EVERY later in-flight
+        window (poisoned on device by the chained force_fallback) replay
+        through the synchronous window path in order, which escalates
+        tiers or goes per-batch exactly as if the pipeline had never
+        formed. A CHAIN-route window falls back PER PREPARE: the clean
+        prefix committed on device and its results/capture stand; only
+        the first ineligible prepare and the poisoned suffix replay —
+        plus every later in-flight window, as above. Redo therefore
+        always consumes the whole pipeline, even past `count`."""
         if not self._tickets:
             return
         import jax
@@ -1091,15 +1202,34 @@ class DeviceLedger:
         while i < len(tickets):
             tk = tickets[i]
             i += 1
-            if not redo and bool(jax.device_get(tk.out["fallback"])):
+            if redo:
+                tk.results = ("redo", self.create_transfers_window(
+                    tk.evs, tk.tss))
+                continue
+            if tk.route == "chain":
+                k, results = self._resolve_chain_prefix(tk)
+                if k == len(tk.evs):
+                    tk.results = ("ok", results)
+                    continue
+                # Per-prepare fallback: prepares [0, k) committed on
+                # device; prepare k and the poisoned suffix (state
+                # untouched) replay through the synchronous window
+                # path. Everything still in flight is poisoned too:
+                # pull it into this redo sequence so order is
+                # preserved (the sync path's own resolve guard must
+                # find nothing).
                 redo = True
-                self._note_fb(tk.out)
-                # Everything still in flight is poisoned: pull it into
-                # this redo sequence so order is preserved (the sync
-                # path's own resolve guard must find nothing).
                 tickets.extend(self._tickets)
                 self._tickets = []
-            if redo:
+                results.extend(self.create_transfers_window(
+                    tk.evs[k:], tk.tss[k:]))
+                tk.results = ("redo", results)
+                continue
+            if bool(jax.device_get(tk.out["fallback"])):
+                redo = True
+                self._note_fb(tk.out)
+                tickets.extend(self._tickets)
+                self._tickets = []
                 tk.results = ("redo", self.create_transfers_window(
                     tk.evs, tk.tss))
                 continue
@@ -1120,6 +1250,41 @@ class DeviceLedger:
             self._probe_succeeded()
             tk.results = ("ok", results)
         self._maybe_recycle_ring()
+
+    def _resolve_chain_prefix(self, tk) -> tuple:
+        """Resolve one chain-route ticket's clean prefix. Returns
+        (k, results): k is the first fallen-back prepare index (== the
+        window depth when the whole window is clean). Prepares [0, k)
+        committed on device inside the one scan dispatch — their
+        results and write-through capture are registered here; cause
+        counters for the per-prepare fallback at k are accumulated.
+        The suffix replay is the CALLER's job (pipeline order: later
+        in-flight tickets must join the redo sequence first)."""
+        import jax
+
+        fb = np.asarray(jax.device_get(tk.out["fallback"]))
+        W = len(tk.evs)
+        k = int(np.argmax(fb)) if fb.any() else W
+        st_all = np.asarray(tk.out["r_status"])
+        ts_all = np.asarray(tk.out["r_ts"])
+        results = []
+        st_slices = []
+        for b in range(k):
+            st = st_all[b, :tk.ns[b]]
+            results.append((st, ts_all[b, :tk.ns[b]]))
+            st_slices.append(st)
+        if self._wt:
+            # Registers the prefix chunks; in ring mode this also
+            # rewinds the host ring cursor to 0 — matching the device's
+            # once-per-chain-dispatch ring reset even when k == 0.
+            self._register_window_capture(tk, st_slices)
+        if k:
+            self.fast_batches += k
+            self._probe_succeeded()
+        if k < W:
+            self.window_fallbacks += 1
+            self._note_chain_fb(tk.out, k)
+        return k, results
 
     def _register_window_capture(self, tk, st_slices) -> None:
         """Resolve-time write-through capture for one pipelined window:
@@ -1189,28 +1354,39 @@ class DeviceLedger:
     def create_transfers_window(self, evs: list[dict],
                                 timestamps: list[int],
                                 all_or_nothing: bool = False):
-        """K prepares in ONE superbatch dispatch (commit-window
-        aggregation; the group-commit analog of the reference's 8-deep
-        prepare pipeline, src/config.zig:155). Returns a list of
+        """K prepares in ONE device dispatch (commit-window aggregation;
+        the group-commit analog of the reference's 8-deep prepare
+        pipeline, src/config.zig:155). Returns a list of
         (status u32[n_b], ts u64[n_b]) pairs, one per prepare.
 
-        Any cross-prepare dependency (duplicate ids, posts of in-window
-        pendings, headroom/overflow proof failures) makes the superbatch
-        kernel fall back with STATE UNTOUCHED. What happens next depends
-        on the caller:
+        The DEFAULT dispatch route is the scan-form whole-window CHAIN
+        kernel (one create_transfers_chain_jit dispatch; op count
+        ~constant in window depth — see ARCHITECTURE.md "Dispatch
+        modes"): each prepare executes against the state evolved by the
+        previous ones, so cross-prepare ids/duplicates resolve through
+        the state and an INELIGIBLE prepare falls back PER PREPARE —
+        the clean prefix stays committed, the ineligible prepare
+        replays per-batch (exact semantics incl. fixpoint escalation
+        and the host-mirror path), and the poisoned remainder
+        re-windows. Windows the plain chain body cannot serve natively
+        pre-route to their flat superbatch tier (imported / balancing /
+        closing / in-window pending refs / breach hysteresis), which
+        falls back WHOLE-window with state untouched:
         - all_or_nothing=False: the window executes per-prepare through
           create_transfers_soa right here (exact sequential semantics,
           including fixpoint redispatch and the host-mirror path);
-        - all_or_nothing=True (the replica commit loop): return None
-          with nothing applied — the caller re-commits op by op through
-          its normal path, so flush cadence and physical determinism
-          are exactly those of a replica that never formed the window.
-          In this mode every sub-batch queues exactly one flush chunk
-          (empty ones included) so the caller can attribute chunks to
-          prepares."""
+        - all_or_nothing=True (the replica commit loop): ALWAYS the
+          flat superbatch route (a chain's partial commit could not be
+          undone), and on fallback return None with nothing applied —
+          the caller re-commits op by op through its normal path, so
+          flush cadence and physical determinism are exactly those of
+          a replica that never formed the window. In this mode every
+          sub-batch queues exactly one flush chunk (empty ones
+          included) so the caller can attribute chunks to prepares."""
         import jax
 
-        from .fast_kernels import (create_transfers_super_deep_jit,
+        from .fast_kernels import (create_transfers_chain_jit,
+                                   create_transfers_super_deep_jit,
                                    create_transfers_super_jit)
 
         self.resolve_windows()  # pipeline ordering
@@ -1219,18 +1395,59 @@ class DeviceLedger:
         eligible = len(evs) > 1 and not self._mirror_route()
         if eligible:
             n_pad = _pad_bucket(max(ns))
-            ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
-            # Route straight to the deep tier when the window carries
-            # in-window pending references or the workload has been
-            # breaching limits (the shallow dispatch is a known waste) —
-            # one numpy key-merge vs an ~800 ms wasted chip dispatch.
-            # The key-merge is skipped when a flag pre-route (imported /
-            # balancing, both cheap host scans) decides the tier anyway.
+            # Flag pre-route (cheap host scans) + one numpy key-merge:
+            # tiers the plain chain body cannot serve natively go to
+            # their specialized flat superbatch kernel; the
+            # breach-hysteresis regime (the shallow/chain dispatch is a
+            # known waste while limit cascades run deep) goes deep too.
             imported = _has_imported(evs)
             balancing = not imported and _has_balancing(evs)
             deep_first = (not imported and not balancing
                           and (self._fixpoint_first
-                               or _window_has_pend_refs(ev_s)))
+                               or _has_closing(evs)
+                               or _evs_pend_refs(evs)))
+            chain_route = (not all_or_nothing and not imported
+                           and not balancing and not deep_first)
+            if chain_route:
+                ev_c, seg_c = stack_chain_window(evs, timestamps, n_pad)
+                ev_c = {k: jax.device_put(v) for k, v in ev_c.items()}
+                seg_c = {k: jax.device_put(v) for k, v in seg_c.items()}
+                new_state, out = create_transfers_chain_jit(
+                    self.state, ev_c, seg_c)
+                self.state = new_state
+                self._count_route("chain")
+                fb = np.asarray(jax.device_get(out["fallback"]))
+                W = len(evs)
+                k = int(np.argmax(fb)) if fb.any() else W
+                st_all = np.asarray(out["r_status"])
+                ts_all = np.asarray(out["r_ts"])
+                results = [(st_all[b, :ns[b]], ts_all[b, :ns[b]])
+                           for b in range(k)]
+                if self._wt and k:
+                    self._capture_window_delta(
+                        evs[:k], [st for st, _ in results],
+                        timestamps=timestamps[:k])
+                if k:
+                    self.fast_batches += k
+                    self._probe_succeeded()
+                if k == W:
+                    return results
+                # Per-prepare fallback: prepare k is ineligible for the
+                # plain chain body. Count the cause, replay it
+                # per-batch (exact path incl. escalation and the
+                # mirror regime), then RE-WINDOW the poisoned
+                # remainder — each recursion consumes at least one
+                # prepare, so the ladder terminates; it never re-chains
+                # the same ineligible prepare at its head twice.
+                self.window_fallbacks += 1
+                self._note_chain_fb(out, k)
+                results.append(
+                    self.create_transfers_soa(evs[k], timestamps[k]))
+                if k + 1 < W:
+                    results.extend(self.create_transfers_window(
+                        evs[k + 1:], timestamps[k + 1:]))
+                return results
+            ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
             ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
             seg = {k: jax.device_put(v) for k, v in seg.items()}
             if imported:
@@ -1238,6 +1455,7 @@ class DeviceLedger:
                     create_transfers_super_imported_jit,
                 )
 
+                self._count_route("super_imported")
                 new_state, out = create_transfers_super_imported_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
@@ -1250,15 +1468,20 @@ class DeviceLedger:
                     create_transfers_super_balancing_jit,
                 )
 
+                self._count_route("super_balancing")
                 new_state, out = create_transfers_super_balancing_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
             elif deep_first:
+                self._count_route("super_deep")
                 new_state, out = create_transfers_super_deep_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
                 self.deep_fixpoint_batches += len(evs)
             else:
+                # all_or_nothing replica windows: the flat plain tier
+                # (whole-window semantics the commit loop requires).
+                self._count_route("super")
                 new_state, out = create_transfers_super_jit(
                     self.state, ev_s, seg)
                 self.state = new_state
@@ -1292,6 +1515,7 @@ class DeviceLedger:
             self._note_fb(out)
         if all_or_nothing:
             return None
+        self._count_route("per_batch")
         return [self.create_transfers_soa(ev, ts)
                 for ev, ts in zip(evs, timestamps)]
 
@@ -1717,13 +1941,20 @@ class DeviceLedger:
             self.drain_mirror()
         self._mirror_chunks = []
         self.state = init_state(self.a_cap, self.t_cap)
-        # Row maps must mirror the PACKING order below: accounts pack in
-        # dict order (eager, creation-ordered), transfers pack in
-        # transfer_by_timestamp (commit) order — under the lazy mirror a
-        # point read moves a key out of dict insertion position, so
-        # enumerate(sm.transfers) could disagree with the packed rows and
-        # scatter later pending flips onto the wrong device rows.
-        self._acct_row = {a: r for r, a in enumerate(sm.accounts)}
+        # Row maps must mirror the PACKING order below: BOTH stores pack
+        # in applied-timestamp order — the canonical row order (the
+        # state-epoch digest row-indexes against it, and the imported
+        # tiers' searchsorted-only collision probes read the ts columns
+        # as pre-sorted operands). For transfers that is
+        # transfer_by_timestamp (commit) order — under the lazy mirror
+        # a point read moves a key out of dict insertion position, so
+        # enumerate(sm.transfers) could disagree with the packed rows
+        # and scatter later pending flips onto the wrong device rows.
+        # For accounts dict order IS creation==timestamp order on every
+        # live path; the explicit sort makes restored states safe too.
+        acct_objs = sorted(sm.accounts.values(),
+                           key=lambda a: a.timestamp)
+        self._acct_row = {a.id: r for r, a in enumerate(acct_objs)}
         self._xfer_row = {t: r for r, t in
                           enumerate(sm.transfer_by_timestamp.values())}
         self._xfer_rows_dev = len(self._xfer_row)
@@ -1741,7 +1972,7 @@ class DeviceLedger:
                 assert bool(ok), "hash rebuild overflow: raise capacities"
             return table
 
-        accounts = list(sm.accounts.values())
+        accounts = acct_objs
         assert len(accounts) <= self.a_cap and len(sm.transfers) <= self.t_cap
         acc = {k: np.asarray(v).copy() if hasattr(v, "shape") else v
                for k, v in st["accounts"].items()}
@@ -2393,6 +2624,24 @@ class DeviceLedger:
             sm.commit_timestamp = acct.timestamp
         self._clear_dirty_dev()
 
+    def _count_route(self, route: str) -> None:
+        """One window dispatched via `route` (see fallback_stats)."""
+        self.window_routes[route] = self.window_routes.get(route, 0) + 1
+        self.last_window_route = route
+
+    def _note_chain_fb(self, out, k: int) -> None:
+        """Accumulate the chain route's per-prepare fallback causes at
+        iteration k (the first fallen-back prepare; later iterations
+        only carry 'forced' — the transitive poison)."""
+        import jax
+
+        for cause, v in jax.device_get(out["fb_causes"]).items():
+            if bool(np.asarray(v)[k]):
+                self.fallback_causes[cause] = (
+                    self.fallback_causes.get(cause, 0) + 1)
+                self.chain_batch_fallbacks[cause] = (
+                    self.chain_batch_fallbacks.get(cause, 0) + 1)
+
     def _note_fb(self, out) -> None:
         """Accumulate one kernel dispatch's per-cause fallback flags
         (out["fb_causes"]) into the host counters. Called at every FINAL
@@ -2418,6 +2667,14 @@ class DeviceLedger:
             "deep_fixpoint_batches": self.deep_fixpoint_batches,
             "escalations": self.escalations,
             "causes": dict(self.fallback_causes),
+            # Dispatch-route record: windows per route (chain = the
+            # default scan-form whole-window dispatch) + the per-cause
+            # prepares that fell out of a chain window (per-prepare
+            # fallback granularity — the prefix stayed committed).
+            "routes": {
+                "windows": dict(self.window_routes),
+                "chain_batch_fallbacks": dict(self.chain_batch_fallbacks),
+            },
             # Chaos/recovery counters (zeros unless a ServingSupervisor
             # owns this ledger): retries, backoff time, replayed
             # windows, verified checksum epochs, recoveries by cause.
@@ -2497,7 +2754,14 @@ class DeviceLedger:
                                 if a in sm.accounts)
         sm.accounts.dirty_dev.clear()
         if dirty_accounts:
-            new_ids = [a for a in dirty_accounts if a not in self._acct_row]
+            # New rows append in APPLIED-TIMESTAMP order — the canonical
+            # row order (from_host / pack_oracle_state pack the same
+            # way), and the invariant the imported tiers' searchsorted-
+            # only collision probe reads the ts column under (the
+            # per-dispatch full-table sort is gone — round-7 op cut).
+            new_ids = sorted(
+                (a for a in dirty_accounts if a not in self._acct_row),
+                key=lambda a: sm.accounts[a].timestamp)
             next_row = int(acc["count"])
             assert next_row + len(new_ids) <= self.a_cap, "a_cap exceeded"
             for aid in new_ids:
@@ -2532,7 +2796,13 @@ class DeviceLedger:
         dirty_transfers = sorted(t for t in sm.transfers.dirty_dev
                                  if t in sm.transfers)
         sm.transfers.dirty_dev.clear()
-        new_tids = [t for t in dirty_transfers if t not in self._xfer_row]
+        # Commit-timestamp order (NOT id order): device rows must stay
+        # in the canonical applied-timestamp order — the order the
+        # state-epoch digest row-indexes against pack_oracle_state and
+        # the imported tiers' searchsorted-only probes rely on.
+        new_tids = sorted(
+            (t for t in dirty_transfers if t not in self._xfer_row),
+            key=lambda t: sm.transfers[t].timestamp)
         if new_tids:
             next_row = int(xfr["count"])
             assert next_row + len(new_tids) <= self.t_cap, "t_cap exceeded"

@@ -175,7 +175,10 @@ def pack_oracle_state(sm, a_cap: int) -> dict:
     from ..types import TransferPendingStatus
     from .ledger import _pack_account_rows, _pack_transfer_rows
 
-    accounts = list(sm.accounts.values())
+    # Applied-timestamp order — the canonical row order (from_host and
+    # _push_dirty pack device rows the same way; dict order equals it
+    # on every live path, the sort pins restored states too).
+    accounts = sorted(sm.accounts.values(), key=lambda a: a.timestamp)
     if accounts:
         a_u64, a_bal = _pack_account_rows(accounts)
     else:

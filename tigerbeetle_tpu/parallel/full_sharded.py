@@ -282,6 +282,12 @@ class ShardedRouter:
             self.shard_loss_reroutes += 1
             self.tracer.count(Event.router_reroute)
         pick = self._single_step if degraded else self._step
+        # Route observability: the same catalog counter the serving
+        # supervisor emits per window, so sharded and single-chip
+        # dispatch routes read off one metric.
+        self.tracer.count(
+            Event.dispatch_route,
+            route=("single_chip_" if degraded else "sharded_") + mode)
         with self.tracer.span(Event.router_step, mode=mode,
                               degraded=int(degraded)):
             new_state, out = pick(mode)(

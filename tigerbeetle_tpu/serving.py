@@ -212,6 +212,13 @@ class ServingSupervisor:
             return self.led.create_transfers_window(evs, timestamps)
 
         out = self._dispatch(thunk, what="window", win=win)
+        # The route the ledger actually took (chain is the default
+        # whole-window scan dispatch) — counted into the trace catalog
+        # so route regressions are visible next to retry/recovery
+        # counters; retry/epoch-verify semantics are route-independent.
+        route = self.led.last_window_route
+        if route:
+            self.tracer.count(Event.dispatch_route, route=route)
         norm = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts.tolist())]
                 for st, ts in out]
         self.log.append(("window", batches, timestamps))

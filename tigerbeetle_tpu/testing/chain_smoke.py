@@ -1,0 +1,154 @@
+"""Chain-route smoke: the gate's quick differential for the default
+whole-window scan dispatch.
+
+Drives the REAL serving route — DeviceLedger.submit_window /
+resolve_windows with a write-through mirror in serving (ring-recycle)
+mode — and asserts the round-7 serving contract:
+
+  1. eligible windows take the CHAIN route by default (route counters);
+  2. results are bit-exact vs the synchronous window path AND vs the
+     pure-Python oracle, including a window with an ineligible prepare
+     (per-prepare fallback: the clean prefix stays committed, the
+     suffix replays);
+  3. plain windows produce ZERO host fallbacks;
+  4. the committed chain-route budgets exist (perf/opbudget_r07.json
+     carries the chain entries) — the census itself is the opbudget
+     leg's job.
+
+Run via ``scripts/gate.py`` (skip with --no-chain) or directly:
+``python -c "from tigerbeetle_tpu.testing import chain_smoke;
+chain_smoke.chain_smoke()"``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+CLUSTER_SEED = 29
+
+
+def _mk_serving(n_accounts: int = 64):
+    from ..oracle import StateMachineOracle
+    from ..ops.ledger import DeviceLedger
+    from ..types import Account
+
+    led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13,
+                       write_through=StateMachineOracle())
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1)
+         for i in range(1, n_accounts + 1)], 120)
+    led.recycle_events = True
+    return led
+
+
+def _windows(rng, n_windows: int, k: int = 3, n: int = 64,
+             base: int = 10 ** 6, poison_window=None):
+    from ..types import Transfer
+
+    out, nid, ts = [], base, 10 ** 12
+    for w in range(n_windows):
+        evs, tss = [], []
+        for b in range(k):
+            batch = []
+            for _ in range(n):
+                dr = int(rng.integers(1, 65))
+                batch.append(Transfer(
+                    id=nid, debit_account_id=dr,
+                    credit_account_id=dr % 64 + 1,
+                    amount=int(rng.integers(1, 100)), ledger=1, code=1))
+                nid += 1
+            if poison_window == w and b == 1:
+                # Duplicate id within one prepare: a hard per-prepare
+                # (E2) fallback the chain route must isolate.
+                batch[-1] = Transfer(
+                    id=batch[0].id, debit_account_id=1,
+                    credit_account_id=2, amount=1, ledger=1, code=1)
+            ts += n + 10
+            evs.append(batch)
+            tss.append(ts)
+        out.append((evs, tss))
+    return out
+
+
+def chain_smoke(n_windows: int = 3) -> None:
+    from ..oracle import StateMachineOracle
+    from ..ops.batch import transfers_to_arrays
+    from ..types import Account
+
+    rng = np.random.default_rng(CLUSTER_SEED)
+    for poison in (None, 1):
+        windows = _windows(rng, n_windows, poison_window=poison,
+                           base=(1 + (poison or 0)) * 10 ** 6)
+        led_p = _mk_serving()
+        led_s = _mk_serving()
+        orc = StateMachineOracle()
+        orc.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+
+        pending, res_p = [], []
+        for evs, tss in windows:
+            arrays = [transfers_to_arrays(b) for b in evs]
+            tk = led_p.submit_window(arrays, tss)
+            if tk is None:
+                led_p.resolve_windows()
+                while pending:
+                    res_p.append(pending.pop(0).results[1])
+                res_p.append(led_p.create_transfers_window(arrays, tss))
+                continue
+            pending.append(tk)
+            if len(pending) > 1:
+                led_p.resolve_windows(count=1)
+                while pending and pending[0].results is not None:
+                    res_p.append(pending.pop(0).results[1])
+        led_p.resolve_windows()
+        for tk in pending:
+            res_p.append(tk.results[1])
+
+        res_s = []
+        for evs, tss in windows:
+            res_s.append(led_s.create_transfers_window(
+                [transfers_to_arrays(b) for b in evs], tss))
+            for b, tb in zip(evs, tss):
+                orc.create_transfers(b, tb)
+
+        assert len(res_p) == len(res_s), (len(res_p), len(res_s))
+        for wp, ws in zip(res_p, res_s):
+            for (stp, tsp), (sts, tss_) in zip(wp, ws):
+                np.testing.assert_array_equal(np.asarray(stp),
+                                              np.asarray(sts))
+                np.testing.assert_array_equal(np.asarray(tsp),
+                                              np.asarray(tss_))
+        hp, hs = led_p.to_host(), led_s.to_host()
+        assert hp.accounts == hs.accounts == orc.accounts
+        assert hp.transfers == hs.transfers == orc.transfers
+        for led in (led_p, led_s):
+            stats = led.fallback_stats()
+            assert stats["routes"]["windows"].get("chain", 0) >= 1, \
+                "eligible windows must default to the chain route"
+            if poison is None:
+                assert stats["host_fallbacks"] == 0, stats
+                assert stats["window_fallbacks"] == 0, stats
+            else:
+                assert stats["routes"]["chain_batch_fallbacks"].get(
+                    "e2_collision", 0) >= 1, stats
+    # The gate's budget leg enforces the chain entries' op mass; here we
+    # only pin that the committed file CARRIES them (a budget file
+    # rollback would silently un-gate the route).
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(os.path.join(repo, "perf", "opbudget_r07.json")) as f:
+        budget = json.load(f)["budget"]
+    for tier in ("chain_w8", "chain_body_w8"):
+        assert tier in budget, f"opbudget_r07.json lacks {tier}"
+    assert (budget["chain_body_w8"]["heavy_total"]
+            <= budget["plain"]["heavy_total"]), \
+        "chain body must stay within the per-batch plain tier's budget"
+    print("[chain-smoke] ok: chain default route, per-prepare fallback, "
+          "oracle parity, budgets present")
+
+
+if __name__ == "__main__":
+    chain_smoke()

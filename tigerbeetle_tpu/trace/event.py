@@ -109,6 +109,9 @@ class Event(enum.Enum):
         "quarantine + bounded oracle replay + device rebuild", "cause")
     serving_retries = _counter("device dispatch retries")
     serving_recoveries = _counter("serving recoveries", "cause")
+    dispatch_route = _counter(
+        "window/batch dispatches by kernel route (chain = the default "
+        "scan-form whole-window route)", "route")
 
     # ------------------------------------------------------ sharded router
     router_step = _span("one sharded (or degraded single-chip) batch step",
