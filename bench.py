@@ -300,6 +300,14 @@ def trace_overhead_probe(quick: bool) -> dict:
     stages = {k: v for k, v in tracers[0].aggregates.snapshot().items()
               if k.startswith("commit_")}
     spans = sum(s["count"] for s in stages.values())
+    # Critical-path attribution over the recording run's merged trace:
+    # which stage owns the slowest-decile windows (devhub "p99 critical
+    # path" panel; trace/merge.py critical_path).
+    from tigerbeetle_tpu.trace import critical_path, merge_traces
+
+    merged = merge_traces([tracers[i].chrome_dict()
+                           for i in sorted(tracers)])
+    cp = critical_path(merged, quantile=0.9)
     return {
         "ops": n_ops + 1,
         "null_s": round(null_s, 4),
@@ -307,6 +315,7 @@ def trace_overhead_probe(quick: bool) -> dict:
         "overhead_ratio": round(recording_s / null_s, 4) if null_s else None,
         "spans_recorded": spans,
         "commit_stages": stages,
+        "critical_path": cp,
     }
 
 

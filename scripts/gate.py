@@ -31,7 +31,16 @@ host fallbacks on plain windows, committed chain budgets present;
 skip with --no-chain), the TRACE-CATALOG coverage leg
 (testing/trace_coverage.py: the smokes re-run under recording tracers;
 red when any event in tigerbeetle_tpu/trace/event.py is never emitted
-or an off-catalog name is emitted; skip with --no-trace-cov), and the
+or an off-catalog name is emitted, or an emitted span/histogram event
+never fed a non-empty histogram; skip with --no-trace-cov), the
+METRICS leg (testing/trace_coverage.py metrics_main: perf/slo.json
+must load with every objective on-catalog — a dead SLO is a RED — and
+a live /metrics endpoint over a seeded serving run must serve
+Prometheus-parseable text with per-route window histograms and SLO
+series; skip with --no-metrics), the BENCH-REGRESSION leg
+(testing/latency_smoke.py: live serving-window p99 vs the committed
+perf/latency_baseline.json and the BENCH_r*.json pinned p99
+trajectory; skip with --no-bench-regression), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
@@ -214,6 +223,62 @@ def run_trace_coverage(timeout: int = 900) -> int:
     return rc
 
 
+def run_metrics(timeout: int = 600) -> int:
+    """Metrics leg: perf/slo.json must load with every referenced event
+    on-catalog (a dead SLO — an objective nothing can feed — is a RED),
+    and a live /metrics HTTP endpoint over a real seeded serving run
+    must serve Prometheus-parseable text carrying the per-route window
+    histograms and the SLO series (testing/trace_coverage.py
+    metrics_main). Skip with --no-metrics."""
+    cmd = [sys.executable, "-c",
+           "import sys; "
+           "from tigerbeetle_tpu.testing import trace_coverage; "
+           "sys.exit(trace_coverage.metrics_main())"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] metrics: SLO catalog check + /metrics exposition "
+          "smoke", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: metrics timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] metrics rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
+def run_bench_regression(timeout: int = 600) -> int:
+    """Bench-regression leg: live serving-window p99 (seeded supervisor
+    workload) vs the committed perf/latency_baseline.json, plus the
+    committed BENCH_r*.json pinned p99 trajectory
+    (testing/latency_smoke.py; regenerate the baseline on a healthy
+    tree with `python -m tigerbeetle_tpu.testing.latency_smoke
+    --write-baseline`). Skip with --no-bench-regression."""
+    cmd = [sys.executable, "-c",
+           "import sys; "
+           "from tigerbeetle_tpu.testing import latency_smoke; "
+           "sys.exit(latency_smoke.regression_main([]))"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] bench-reg: serving-window p99 vs committed baseline",
+          flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: bench-reg timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] bench-reg rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -248,6 +313,12 @@ def main() -> int:
     ap.add_argument("--no-chain", action="store_true",
                     help="skip the chain-route leg (whole-window scan "
                          "dispatch differential)")
+    ap.add_argument("--no-metrics", action="store_true",
+                    help="skip the metrics leg (SLO catalog check + "
+                         "/metrics exposition smoke)")
+    ap.add_argument("--no-bench-regression", action="store_true",
+                    help="skip the bench-regression leg (serving p99 "
+                         "vs committed baseline)")
     ap.add_argument("--mesh-devices", type=int, default=8)
     ap.add_argument("--timeout", type=int, default=840,
                     help="test-tier wall clock budget (s)")
@@ -277,6 +348,14 @@ def main() -> int:
         rc = run_trace_coverage()
         if rc != 0:
             reds.append(f"trace-cov rc={rc}")
+    if not args.no_metrics:
+        rc = run_metrics()
+        if rc != 0:
+            reds.append(f"metrics rc={rc}")
+    if not args.no_bench_regression:
+        rc = run_bench_regression()
+        if rc != 0:
+            reds.append(f"bench-reg rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

@@ -1,0 +1,162 @@
+"""Histogram correctness: bucket round-trip, lossless merge
+associativity, quantile relative-error bound vs exact numpy quantiles,
+and the degenerate (empty / one-sample) cases."""
+
+import json
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.trace.histogram import (
+    Histogram, REL_ERROR, SUB, bucket_index, bucket_mid, bucket_upper)
+
+
+# ------------------------------------------------------------- buckets
+
+def test_bucket_boundary_round_trip():
+    # Every value lands in a bucket whose [lower, upper) straddles it,
+    # and the midpoint is within REL_ERROR of any value in the bucket.
+    rng = np.random.default_rng(7)
+    for v in np.concatenate([
+            10.0 ** rng.uniform(-6, 6, size=500),
+            [1e-9, 1.0, 2.0, 1000.0, 2.0 ** 20]]):
+        i = bucket_index(float(v))
+        lower = bucket_upper(i - 1)
+        upper = bucket_upper(i)
+        assert lower <= v < upper * (1 + 1e-12)
+        mid = bucket_mid(i)
+        assert abs(mid - v) / v <= REL_ERROR * (1 + 1e-9)
+
+
+def test_bucket_exact_powers_of_two():
+    # 2^k is the inclusive lower edge of its octave's first sub-bucket.
+    for k in (-4, 0, 1, 10):
+        assert bucket_index(2.0 ** k) == k * SUB
+
+
+def test_record_round_trip_through_dict():
+    h = Histogram()
+    h.record_many([0.5, 1.5, 3.0, 900.0, 0.0, -2.0])
+    d = json.loads(json.dumps(h.to_dict()))  # survives JSON
+    h2 = Histogram.from_dict(d)
+    assert h2.count == h.count
+    assert h2.zero_count == h.zero_count == 2
+    assert h2.buckets == h.buckets
+    assert h2.min == h.min == -2.0
+    assert h2.max == h.max == 900.0
+    assert h2.quantile(0.5) == h.quantile(0.5)
+
+
+def test_layout_mismatch_rejected():
+    with pytest.raises(AssertionError):
+        Histogram.from_dict({"sub_bits": 3, "buckets": {}})
+
+
+# --------------------------------------------------------------- merge
+
+def test_merge_associative_and_lossless():
+    # Three "replicas" record disjoint slices of one sample set; any
+    # merge order reproduces the histogram of the whole set exactly.
+    rng = np.random.default_rng(11)
+    vals = rng.lognormal(mean=3.0, sigma=1.5, size=3000)
+    whole = Histogram()
+    whole.record_many(vals)
+    parts = []
+    for chunk in np.array_split(vals, 3):
+        h = Histogram()
+        h.record_many(chunk)
+        parts.append(h)
+    a = Histogram.merged([parts[0], parts[1], parts[2]])
+    b = Histogram.merged([Histogram.merged(parts[2:]), parts[0], parts[1]])
+    for m in (a, b):
+        assert m.buckets == whole.buckets
+        assert m.count == whole.count
+        assert m.min == whole.min and m.max == whole.max
+        assert m.sum == pytest.approx(whole.sum)
+        for q in (0.5, 0.95, 0.99):
+            assert m.quantile(q) == whole.quantile(q)
+
+
+def test_merge_returns_self_for_chaining():
+    h = Histogram()
+    other = Histogram()
+    other.record(5.0)
+    assert h.merge(other) is h
+    assert h.count == 1
+
+
+# ------------------------------------------------------------ quantiles
+
+def _rel_err(got, want):
+    return abs(got - want) / want
+
+
+def test_quantile_rel_error_bimodal():
+    # Fast-path/slow-path mixture: the shape the serving router
+    # produces (chain route vs fallback).
+    rng = np.random.default_rng(23)
+    vals = np.concatenate([rng.normal(100.0, 5.0, size=9000),
+                           rng.normal(5000.0, 200.0, size=1000)])
+    vals = np.abs(vals)
+    h = Histogram()
+    h.record_many(vals)
+    # q=0.9 sits exactly on the mode boundary, where numpy interpolates
+    # across the gap between modes — any bucketed sketch "disagrees"
+    # there by construction, so probe either side of the cliff instead.
+    for q in (0.10, 0.50, 0.85, 0.99, 0.999):
+        exact = float(np.quantile(vals, q))
+        got = h.quantile(q)
+        # 2x: REL_ERROR bounds bucket rounding; nearest-rank vs numpy's
+        # interpolated quantile adds at most one sample of separation.
+        assert _rel_err(got, exact) <= 2 * REL_ERROR + 0.01, (q, got, exact)
+
+
+def test_quantile_rel_error_heavy_tail():
+    rng = np.random.default_rng(31)
+    vals = rng.pareto(a=1.5, size=20000) + 1.0
+    h = Histogram()
+    h.record_many(vals)
+    for q in (0.5, 0.9, 0.99):
+        exact = float(np.quantile(vals, q))
+        assert _rel_err(h.quantile(q), exact) <= 2 * REL_ERROR + 0.01
+
+
+def test_empty_histogram():
+    h = Histogram()
+    assert h.count == 0
+    assert h.quantile(0.5) is None
+    assert h.cumulative() == []
+    s = h.summary()
+    assert s["count"] == 0 and s["p99"] is None and s["min"] is None
+
+
+def test_one_sample_exact():
+    h = Histogram()
+    h.record(42.0)
+    # min/max clipping makes every quantile of a singleton exact.
+    for q in (0.0, 0.5, 0.99, 1.0):
+        assert h.quantile(q) == 42.0
+    assert h.summary()["p999"] == 42.0
+
+
+def test_zero_and_negative_samples():
+    h = Histogram()
+    h.record_many([0.0, -1.0, 0.0, 10.0])
+    assert h.zero_count == 3
+    assert h.quantile(0.5) == -1.0  # exact floor for non-positive mass
+    assert h.quantile(1.0) == 10.0
+    cum = h.cumulative()
+    assert cum[0] == (0.0, 3)  # zero bucket first
+    assert cum[-1][1] == 4
+
+
+def test_cumulative_monotone():
+    rng = np.random.default_rng(41)
+    h = Histogram()
+    h.record_many(rng.exponential(50.0, size=500))
+    cum = h.cumulative()
+    uppers = [u for u, _ in cum]
+    counts = [c for _, c in cum]
+    assert uppers == sorted(uppers)
+    assert counts == sorted(counts)
+    assert counts[-1] == h.count

@@ -123,6 +123,21 @@ class TestTracer:
         assert markers[-1]["args"]["dropped_total"] <= t.dropped_events
         assert "trace_dropped_events" in t.emitted
 
+    def test_eviction_never_dents_histograms(self):
+        """Distributions accumulate at span close BEFORE ring
+        bookkeeping: a tiny ring drops span events, but the duration
+        histogram still holds every sample."""
+        t = Tracer(capacity=8)
+        n = 50
+        for k in range(n):
+            with t.span(Event.commit_prefetch, op=k):
+                pass
+        assert t.dropped_events > 0
+        assert len([e for e in t.events if e["ph"] == "X"]) < n
+        assert t.histograms["commit_prefetch"].count == n
+        # The interval aggregates survive eviction identically.
+        assert t.aggregates.snapshot()["commit_prefetch"]["count"] == n
+
     def test_wall_clock_anchored_timestamps(self):
         """ISSUE 5 satellite: ts must be wall-clock comparable across
         processes — two tracers constructed apart agree on 'now'."""
@@ -189,15 +204,17 @@ class TestStatsD:
         s.timing("commit_execute", 1.0)
 
     def test_aggregate_flush_resets(self):
-        """Timing aggregates flush as gauges on the emit interval and
-        RESET after emit (reference statsd.zig semantics)."""
+        """Timing aggregates flush as four gauges plus four
+        histogram-derived percentile timing (|ms) lines per series on
+        the emit interval and RESET after emit (reference statsd.zig
+        semantics + the latency-plane percentile flush)."""
         sock, port = _udp_pair()
         try:
             s = StatsD("127.0.0.1", port)
             t = Tracer(statsd=s, emit_interval_s=0.0)  # flush every record
             with t.span(Event.commit_prefetch, op=1):
                 pass
-            lines = _recv_lines(sock, 4)
+            lines = _recv_lines(sock, 8)
             byname = {ln.split(":")[0]: ln for ln in lines}
             assert "tb_tpu.trace.commit_prefetch.count" in byname
             assert byname["tb_tpu.trace.commit_prefetch.count"] \
@@ -206,13 +223,34 @@ class TestStatsD:
                     "tb_tpu.trace.commit_prefetch.min_us",
                     "tb_tpu.trace.commit_prefetch.max_us"} \
                 <= set(byname)
+            for q in ("p50", "p95", "p99", "p999"):
+                assert byname[f"tb_tpu.trace.commit_prefetch.{q}"] \
+                    .endswith("|ms")
             # Reset after emit: the next flush carries ONLY new spans.
             with t.span(Event.commit_prefetch, op=2):
                 pass
-            lines = _recv_lines(sock, 4)
+            lines = _recv_lines(sock, 8)
             count_line = next(ln for ln in lines if ".count:" in ln)
             assert count_line == "tb_tpu.trace.commit_prefetch.count:1|g"
             assert not t.aggregates.snapshot()  # drained
+            s.close()
+        finally:
+            sock.close()
+
+    def test_flush_percentiles_carry_partition_tags(self):
+        """window_commit's hist_tags (route/tier) ride on every flushed
+        line, one series per tag class — the per-route latency feed."""
+        sock, port = _udp_pair()
+        try:
+            s = StatsD("127.0.0.1", port)
+            t = Tracer(statsd=s, emit_interval_s=0.0)
+            with t.span(Event.window_commit, route="chain", tier="scan"):
+                pass
+            lines = _recv_lines(sock, 8)
+            p99 = next(ln for ln in lines if ".p99:" in ln)
+            assert p99.startswith("tb_tpu.trace.window_commit.p99:")
+            assert p99.endswith("|ms|#route:chain,tier:scan")
+            assert all("|#route:chain,tier:scan" in ln for ln in lines)
             s.close()
         finally:
             sock.close()

@@ -477,9 +477,11 @@ def bench_config6_serving(batches=24, account_count=10_000):
 
     # Serving commits aggregate a window of committed prepares per device
     # dispatch when a backlog exists (commit_window; the reference's
-    # pipeline admits 8 prepares in flight, src/config.zig:155). Window
-    # latency is attributed per prepare as latency/W — each prepare in
-    # the window completes when the window does.
+    # pipeline admits 8 prepares in flight, src/config.zig:155). Latency
+    # is recorded per WINDOW (submit -> resolve wall) into a log2
+    # histogram — the window is the unit that completes; smearing its
+    # latency as latency/W per prepare fabricated W identical samples
+    # and flattened the true distribution (see PERF.md).
     import jax
 
     W = 1
@@ -506,20 +508,20 @@ def bench_config6_serving(batches=24, account_count=10_000):
             assert rec is not None
             next_id += W * nb
         sm.resolve_commit_windows()
+    from .trace.histogram import Histogram
+
     n_before = len(sm.state.transfers)
-    lat_ms = []
+    hist = Histogram()  # per-window latency, milliseconds
     t0 = time.perf_counter()
     if W > 1:
         # Depth-2 pipelined serving: submit window k+1 before resolving
         # window k — upload + dispatch overlap the previous window's
         # execution (the reference pipelines 8 prepares the same way,
-        # src/config.zig:155). Window latency = submit -> resolve wall,
-        # attributed per prepare as latency/W.
+        # src/config.zig:155). One histogram sample per window.
         def note_done(done_recs):
             now = time.perf_counter()
             for done in done_recs:
-                per = (now - done["_tb"]) * 1000 / W
-                lat_ms.extend([per] * W)
+                hist.record((now - done["_tb"]) * 1000)
 
         for lo in range(1, len(bodies), W):
             window = bodies[lo:lo + W]
@@ -533,8 +535,7 @@ def bench_config6_serving(batches=24, account_count=10_000):
             if rec is None:
                 note_done(sm.resolve_commit_windows())
                 sm.commit_window(Operation.create_transfers, window, wts)
-                per = (time.perf_counter() - tb) * 1000 / W
-                lat_ms.extend([per] * W)
+                hist.record((time.perf_counter() - tb) * 1000)
                 continue
             rec["_tb"] = tb
             if len(sm._pending_windows) > 1:
@@ -545,7 +546,7 @@ def bench_config6_serving(batches=24, account_count=10_000):
             ts += nb + 10
             tb = time.perf_counter()
             sm.commit(Operation.create_transfers, body, ts)
-            lat_ms.append((time.perf_counter() - tb) * 1000)
+            hist.record((time.perf_counter() - tb) * 1000)
     elapsed = time.perf_counter() - t0
     # The commit path defers mirror materialization (columnar chunks,
     # drained lazily at read boundaries). Time the drain separately and
@@ -558,24 +559,24 @@ def bench_config6_serving(batches=24, account_count=10_000):
     assert sm.led.fallbacks == 0, "serving bench unexpectedly fell back"
     _record_diag("config6", sm.led)
     accepted = len(sm.state.transfers) - n_before
-    # Per-batch commit latency percentiles (each commit is synchronous on
-    # the serving path, so these are true percentiles — the reference
-    # reports p100, src/tigerbeetle/benchmark_load.zig:587).
-    lat_ms.sort()
+    # True per-window latency percentiles out of the histogram (~1%
+    # relative error; p100 is the exact max the histogram carries).
+    # The serialized histogram rides in the record so the SLO engine
+    # and the gate's bench-regression leg can re-derive any quantile
+    # (the reference reports p100, benchmark_load.zig:587).
     latency = None
-    if lat_ms:
-        import math
-
-        def rank(q):  # nearest-rank percentile
-            return lat_ms[max(0, math.ceil(q * len(lat_ms)) - 1)]
-
+    if hist.count:
         latency = {
-            "p50_ms": round(rank(0.50), 3),
-            "p99_ms": round(rank(0.99), 3),
-            "p100_ms": round(lat_ms[-1], 3),
+            "p50_ms": round(hist.quantile(0.50), 3),
+            "p95_ms": round(hist.quantile(0.95), 3),
+            "p99_ms": round(hist.quantile(0.99), 3),
+            "p999_ms": round(hist.quantile(0.999), 3),
+            "p100_ms": round(hist.max, 3),
+            "windows": hist.count,
             "drain_ms_total": round(drain_ms, 1),
             "sustained_tps": round(
                 accepted / (elapsed + drain_ms / 1000), 1),
+            "histogram": hist.to_dict(),
         }
     return accepted, elapsed, latency
 

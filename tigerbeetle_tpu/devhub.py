@@ -30,6 +30,7 @@ NUMERIC_KEYS = (
 NESTED_KEYS = (
     ("serving_sustained_tps", ("serving_batch_latency", "sustained_tps")),
     ("serving_p99_ms", ("serving_batch_latency", "p99_ms")),
+    ("serving_p999_ms", ("serving_batch_latency", "p999_ms")),
     # Tracing-cost guard (bench ##trace): recording-vs-NullTracer wall
     # clock on the same commit loop; a creeping ratio is a tracing
     # regression like any other.
@@ -89,7 +90,8 @@ def _median(values: list[float]) -> Optional[float]:
 
 # Metrics where a regression is an INCREASE (latency); everything else
 # regresses by dropping (throughput).
-_HIGHER_IS_WORSE = frozenset({"serving_p99_ms", "trace_overhead_ratio"})
+_HIGHER_IS_WORSE = frozenset({"serving_p99_ms", "serving_p999_ms",
+                              "trace_overhead_ratio"})
 
 
 def regressions(entries: list[dict]) -> dict:
@@ -391,6 +393,83 @@ def render(history_path: str, out_path: str,
             + "<table><tr><th>stage</th><th>spans</th><th>total ms</th>"
               "<th>share</th><th></th></tr>"
             + "".join(rows_tr) + "</table>")
+    # SLO panel: every declared objective (perf/slo.json) evaluated
+    # against the recorded runs — latest value vs threshold, burn rate
+    # over the trailing burn window, breach badges. Rendered even when
+    # all green: an invisible SLO is an unenforced one.
+    slo_html = ""
+    try:
+        from .trace.slo import (burn_rates, evaluate_bench_record,
+                                load_objectives)
+
+        slo_cfg = load_objectives()
+    except (OSError, ValueError, ImportError):
+        slo_cfg = None
+    if slo_cfg is not None and entries:
+        per_run = [evaluate_bench_record(e, slo_cfg["objectives"])
+                   for e in entries]
+        burn = burn_rates(per_run, slo_cfg["burn_window_runs"],
+                          slo_cfg["burn_budget"])
+        rows_slo = []
+        any_breach = False
+        for o, latest in zip(slo_cfg["objectives"], per_run[-1]):
+            b = burn.get(o.name, {})
+            badge_cell = ""
+            if latest["ok"] is False:
+                badge_cell = ('<span style="color:#c22;font-weight:600">'
+                              'BREACHED</span>')
+            elif b.get("badge"):
+                badge_cell = ('<span style="color:#c60;font-weight:600">'
+                              'BURNING</span>')
+            any_breach = any_breach or bool(badge_cell)
+            val = latest["value"]
+            rows_slo.append(
+                "<tr><td>{}</td><td>p{:g} {} &le; {:g} {}</td>"
+                "<td>{}</td><td>{:.0%} of {} runs</td><td>{}</td>"
+                "</tr>".format(
+                    html.escape(o.name), o.quantile * 100,
+                    html.escape(o.event), o.threshold,
+                    html.escape(o.unit),
+                    "-" if val is None else f"{val:g} {o.unit}",
+                    b.get("burn_rate", 0.0), b.get("evaluated", 0),
+                    badge_cell))
+        badge_slo = ("" if not any_breach else
+                     '<p style="color:#c22;font-weight:700">SLO BREACH / '
+                     'BURN — an objective is out of budget</p>')
+        slo_html = (
+            "<h2>SLOs (perf/slo.json vs recorded runs)</h2>" + badge_slo
+            + "<table><tr><th>objective</th><th>declared</th>"
+              "<th>latest</th><th>burn rate</th><th></th></tr>"
+            + "".join(rows_slo) + "</table>")
+    # Critical-path panel: stage-share attribution of the slowest-decile
+    # windows from the newest traced run (trace/merge.py critical_path
+    # over the bench probe's merged cluster trace) — the operator-facing
+    # answer to "which stage owns p99".
+    cp_html = ""
+    cp = next((e.get("trace", {}).get("critical_path")
+               for e in reversed(entries)
+               if isinstance(e.get("trace"), dict)
+               and isinstance(e.get("trace").get("critical_path"), dict)),
+              None)
+    if cp:
+        rows_cp = []
+        for stage, share in (cp.get("stage_share") or {}).items():
+            bar = '<div style="background:#26c;height:10px;width:{}px">' \
+                  '</div>'.format(max(1, round(share * 240)))
+            rows_cp.append(
+                "<tr><td>{}</td><td>{:.1%}</td><td>{}</td></tr>".format(
+                    html.escape(stage), share, bar))
+        cp_html = (
+            "<h2>p99 critical path (latest traced run)</h2>"
+            "<p>slowest {} of {} windows ({} units, threshold "
+            "{} ms, p99 {} ms) — p99 owned by <b>{}</b></p>".format(
+                cp.get("windows_analyzed", 0), cp.get("windows_total", 0),
+                html.escape(str(cp.get("window_event", ""))),
+                cp.get("threshold_ms", "-"), cp.get("p99_ms", "-"),
+                html.escape(str(cp.get("p99_owner", "-"))))
+            + "<table><tr><th>stage</th><th>share of slow-window time"
+              "</th><th></th></tr>"
+            + "".join(rows_cp) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -433,6 +512,8 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {route_html}
 {ob_html}
 {tr_html}
+{slo_html}
+{cp_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

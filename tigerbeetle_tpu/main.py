@@ -74,7 +74,7 @@ def cmd_start(args) -> int:
         replica_holder[0].on_message(msg)
 
     tracer = None
-    if args.trace or args.statsd:
+    if args.trace or args.statsd or args.metrics_port is not None:
         from .trace import StatsD, Tracer
 
         statsd = None
@@ -85,7 +85,8 @@ def cmd_start(args) -> int:
                 return 2
             statsd = StatsD(host or "127.0.0.1", int(port))
         # pid = replica id: merged cluster traces get one process track
-        # per replica (trace/merge.py).
+        # per replica (trace/merge.py). --metrics-port implies a
+        # recording tracer: the endpoint exposes its registry.
         tracer = Tracer(statsd=statsd, pid=args.replica,
                         emit_interval_s=args.trace_emit_interval)
     bus = MessageBus(cluster=args.cluster, on_message=on_message,
@@ -118,6 +119,30 @@ def cmd_start(args) -> int:
 
         warm_s = warmup_kernels(a_cap=a_cap, t_cap=t_cap)
         print(f"kernels warm in {warm_s:.1f}s", flush=True)
+    metrics_server = None
+    if args.metrics_port is not None:
+        from .metrics import MetricsServer, render_prometheus
+        from .trace import burn_rates, evaluate, load_objectives
+
+        try:
+            slo_cfg = load_objectives()
+        except (OSError, ValueError) as e:
+            print(f"warning: SLO objectives unavailable: {e}", flush=True)
+            slo_cfg = None
+
+        def _exposition() -> str:
+            rows = burn = None
+            if slo_cfg is not None:
+                rows = evaluate(tracer, slo_cfg["objectives"],
+                                emit_to=tracer)
+                burn = burn_rates([rows], slo_cfg["burn_window_runs"],
+                                  slo_cfg["burn_budget"])
+            return render_prometheus(tracer, slo_rows=rows, burn=burn)
+
+        metrics_server = MetricsServer(_exposition,
+                                       port=args.metrics_port)
+        print(f"metrics on http://127.0.0.1:{metrics_server.port}/metrics",
+              flush=True)
     replica.open()
     print(f"replica {args.replica} listening on "
           f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
@@ -142,6 +167,8 @@ def cmd_start(args) -> int:
     finally:
         _signal.signal(_signal.SIGINT, prev_int)
         _signal.signal(_signal.SIGTERM, prev_term)
+    if metrics_server is not None:
+        metrics_server.close()
     if tracer is not None:
         tracer.flush_statsd()
         if args.trace:
@@ -821,6 +848,10 @@ def main(argv=None) -> int:
     p.add_argument("--trace-emit-interval", type=float, default=10.0,
                    help="seconds between StatsD timing-aggregate flushes "
                         "(gauges reset after each emit)")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   help="serve Prometheus text metrics on this HTTP "
+                        "port (0 = ephemeral); implies a recording "
+                        "tracer")
     p.add_argument("--aof", default=None,
                    help="append committed prepares to this AOF path")
     p.add_argument("--listen-port", type=int, default=None,

@@ -917,6 +917,7 @@ class DeviceLedger:
         self.window_routes: dict = {}
         self.chain_batch_fallbacks: dict = {}
         self.last_window_route: str | None = None
+        self.last_window_tier: str | None = None
         # Monotone per-batch op sequence: every captured write-through
         # chunk carries the op number it belongs to, so a VERIFY spot
         # divergence can name which batch produced the bad rows.
@@ -2625,9 +2626,15 @@ class DeviceLedger:
         self._clear_dirty_dev()
 
     def _count_route(self, route: str) -> None:
-        """One window dispatched via `route` (see fallback_stats)."""
+        """One window dispatched via `route` (see fallback_stats). The
+        tier collapses routes into the three latency classes the SLO
+        objectives partition on: scan (the chain whole-window scan),
+        fallback (per-batch), flat (any unrolled super route)."""
         self.window_routes[route] = self.window_routes.get(route, 0) + 1
         self.last_window_route = route
+        self.last_window_tier = ("scan" if route == "chain" else
+                                 "fallback" if route == "per_batch"
+                                 else "flat")
 
     def _note_chain_fb(self, out, k: int) -> None:
         """Accumulate the chain route's per-prepare fallback causes at

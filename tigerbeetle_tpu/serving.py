@@ -211,14 +211,24 @@ class ServingSupervisor:
             evs = [transfers_to_arrays(b) for b in batches]
             return self.led.create_transfers_window(evs, timestamps)
 
-        out = self._dispatch(thunk, what="window", win=win)
-        # The route the ledger actually took (chain is the default
-        # whole-window scan dispatch) — counted into the trace catalog
-        # so route regressions are visible next to retry/recovery
-        # counters; retry/epoch-verify semantics are route-independent.
-        route = self.led.last_window_route
-        if route:
-            self.tracer.count(Event.dispatch_route, route=route)
+        # window_commit wraps submit→resolve and is tagged late (the
+        # ledger only knows which route it took after dispatch), so
+        # each window lands in its route/tier latency class — the
+        # per-class distributions the SLO objectives read.
+        with self.tracer.span(Event.window_commit) as sp:
+            out = self._dispatch(thunk, what="window", win=win)
+            # The route the ledger actually took (chain is the default
+            # whole-window scan dispatch) — counted into the trace
+            # catalog so route regressions are visible next to
+            # retry/recovery counters; retry/epoch-verify semantics are
+            # route-independent.
+            route = self.led.last_window_route
+            if route:
+                sp.tags["route"] = route
+                tier = self.led.last_window_tier
+                if tier:
+                    sp.tags["tier"] = tier
+                self.tracer.count(Event.dispatch_route, route=route)
         norm = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts.tolist())]
                 for st, ts in out]
         self.log.append(("window", batches, timestamps))
@@ -383,6 +393,9 @@ class ServingSupervisor:
         # than fit between two epoch checks.
         assert n_windows <= self.epoch_interval, \
             (n_windows, self.epoch_interval)
+        # The bounded-replay SLO (perf/slo.json) reads this
+        # distribution: windows replayed per recovery, unit windows.
+        self.tracer.observe(Event.serving_replay_windows, n_windows)
         if replayed is None:
             replayed = self._replay_log_into_base()
         start = len(self.history) - n_entries

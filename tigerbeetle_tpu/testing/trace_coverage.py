@@ -337,6 +337,38 @@ def _scenario_router(col: _Collector) -> None:
     assert fell and router.host_fallbacks == 1, router.stats()
 
 
+def _scenario_slo(col: _Collector) -> None:
+    """The SLO engine against the COMMITTED perf/slo.json: objectives
+    must load (every referenced event on-catalog — a dead SLO is a red
+    right here), evaluate against real samples, and a forced-breach
+    pass (thresholds replaced with -1) must emit the slo_breach
+    counter deterministically."""
+    import dataclasses
+
+    from ..trace import Event as Ev
+    from ..trace import evaluate, load_objectives
+
+    tracer = col.make(0)
+    cfg = load_objectives()
+    # Real samples for every objective's event: a window span per
+    # route class and one replay-length observation.
+    for route, tier in (("chain", "scan"), ("per_batch", "fallback"),
+                        ("super_deep", "flat")):
+        with tracer.span(Ev.window_commit) as sp:
+            sp.tags["route"] = route
+            sp.tags["tier"] = tier
+    with tracer.span(Ev.serving_dispatch, what="window"):
+        pass
+    tracer.observe(Ev.serving_replay_windows, 2)
+    rows = evaluate(tracer, cfg["objectives"], emit_to=tracer)
+    assert all(r["ok"] is not None for r in rows), rows
+    forced = [dataclasses.replace(o, threshold=-1.0)
+              for o in cfg["objectives"]]
+    rows = evaluate(tracer, forced, emit_to=tracer)
+    assert all(r["ok"] is False for r in rows), rows
+    assert tracer.counters.get("slo_breach", 0) >= len(forced)
+
+
 SCENARIOS = (
     _scenario_rebuild,
     _scenario_view_change,
@@ -346,6 +378,7 @@ SCENARIOS = (
     _scenario_chaos,
     _scenario_commit_windows,
     _scenario_router,
+    _scenario_slo,
 )
 
 
@@ -377,6 +410,86 @@ def coverage_main(scenarios=SCENARIOS) -> int:
         failures += 1
         print(f"[trace-cov] RED: off-catalog names emitted: {unknown}",
               flush=True)
+    # Histogram coverage (the metrics plane's own dead-metric check):
+    # every span/histogram event the smokes emitted must have fed a
+    # NON-EMPTY histogram somewhere — an emitted span whose
+    # distribution stayed empty means the tracer's span-close
+    # accumulation regressed.
+    fed: dict = {}
+    for t in col.tracers:
+        for key, h in t.histograms.items():
+            name = t.histogram_series[key][0]
+            fed[name] = fed.get(name, 0) + h.count
+    starved = sorted(
+        e.name for e in Event
+        if e.kind.value in ("span", "histogram") and e.name in emitted
+        and not fed.get(e.name))
+    print(f"[trace-cov] {len(fed)} events fed histograms "
+          f"({sum(fed.values())} samples)", flush=True)
+    if starved:
+        failures += 1
+        print(f"[trace-cov] RED: emitted events with EMPTY histograms "
+              f"(span-close accumulation broken): {starved}", flush=True)
+    return 1 if failures else 0
+
+
+def metrics_main() -> int:
+    """scripts/gate.py's metrics leg: the committed perf/slo.json must
+    load with every referenced event on-catalog (a dead SLO is RED),
+    and a live /metrics endpoint over a real serving run must produce
+    Prometheus-parseable text whose per-route window p99 agrees with
+    the tracer's own histograms."""
+    import urllib.request
+
+    from ..metrics import MetricsServer, parse_prometheus, \
+        render_prometheus
+    from ..trace import burn_rates, evaluate, load_objectives
+    from .chaos import run_chaos_seed
+
+    failures = 0
+    try:
+        cfg = load_objectives()
+        print(f"[metrics] perf/slo.json: {len(cfg['objectives'])} "
+              f"objectives on-catalog, burn window "
+              f"{cfg['burn_window_runs']} runs", flush=True)
+    except (OSError, ValueError) as e:
+        print(f"[metrics] RED: perf/slo.json invalid: {e}", flush=True)
+        return 1
+    # A real (seeded, tiny) serving run feeds the registry, then the
+    # endpoint serves it and the scrape must parse.
+    tracer = Tracer(pid=0)
+    run_chaos_seed(1, windows=4, kinds=("dispatch_fail",),
+                   mesh_scenario=False, tracer=tracer)
+    rows = evaluate(tracer, cfg["objectives"], emit_to=tracer)
+    burn = burn_rates([rows], cfg["burn_window_runs"],
+                      cfg["burn_budget"])
+    srv = MetricsServer(lambda: render_prometheus(
+        tracer, slo_rows=rows, burn=burn), port=0)
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            text = resp.read().decode()
+    finally:
+        srv.close()
+    try:
+        parsed = parse_prometheus(text)
+    except ValueError as e:
+        print(f"[metrics] RED: exposition not parseable: {e}",
+              flush=True)
+        return 1
+    window_counts = parsed.get("tb_tpu_window_commit_us_count", [])
+    routes = {lab.get("route") for lab, _ in window_counts}
+    if not window_counts:
+        failures += 1
+        print("[metrics] RED: no window_commit histogram series on "
+              "the endpoint", flush=True)
+    if not {lab.get("objective") for lab, _ in
+            parsed.get("tb_tpu_slo_threshold", [])}:
+        failures += 1
+        print("[metrics] RED: no SLO series on the endpoint",
+              flush=True)
+    print(f"[metrics] endpoint ok: {len(parsed)} metric families, "
+          f"window routes {sorted(r for r in routes if r)}", flush=True)
     return 1 if failures else 0
 
 

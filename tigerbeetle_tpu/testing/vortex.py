@@ -123,7 +123,7 @@ class VortexSupervisor:
 
     def __init__(self, tmp_dir: str, *, replica_count: int = 3,
                  cluster: int = 0xF0, seed: int = 0,
-                 trace: bool = False):
+                 trace: bool = False, metrics: bool = False):
         self.tmp_dir = tmp_dir
         self.replica_count = replica_count
         self.cluster = cluster
@@ -132,9 +132,18 @@ class VortexSupervisor:
         # r<i>.trace.json on SIGINT shutdown; collect_merged_trace()
         # then yields ONE Perfetto timeline for the whole cluster.
         self.trace = trace
-        ports = free_ports(2 * replica_count)
+        # metrics=True: every replica serves Prometheus text on its own
+        # --metrics-port; scrape_metrics(i) reads it live. The scraped
+        # histogram p99s must agree (within the histogram error bound)
+        # with the offline merged-trace quantiles — the endpoint
+        # acceptance check in tests/test_metrics.py.
+        self.metrics = metrics
+        n_ports = (3 if metrics else 2) * replica_count
+        ports = free_ports(n_ports)
         self.real_ports = ports[:replica_count]
-        self.proxy_ports = ports[replica_count:]
+        self.proxy_ports = ports[replica_count:2 * replica_count]
+        self.metrics_ports = (ports[2 * replica_count:] if metrics
+                              else [])
         self.addresses = ",".join(
             f"127.0.0.1:{p}" for p in self.proxy_ports)
         self.proxies = [
@@ -173,6 +182,8 @@ class VortexSupervisor:
                f"--listen-port={self.real_ports[i]}"]
         if self.trace:
             cmd.append(f"--trace={self.trace_path(i)}")
+        if self.metrics:
+            cmd.append(f"--metrics-port={self.metrics_ports[i]}")
         self.procs[i] = subprocess.Popen(
             cmd + [self._data_path(i)],
             cwd="/root/repo", env=dict(os.environ),
@@ -314,6 +325,27 @@ class VortexSupervisor:
                     proc.kill()
         for proxy in self.proxies:
             proxy.close()
+
+    def scrape_metrics(self, i: int, timeout_s: float = 30.0) -> str:
+        """GET replica i's live /metrics exposition (metrics=True
+        required). Retries connection refusals until the deadline: the
+        cluster commits on a 2-of-3 quorum, so a client can make
+        progress while the third replica is still opening (its endpoint
+        not yet bound)."""
+        import urllib.error
+        import urllib.request
+
+        assert self.metrics, "metrics=True required"
+        url = f"http://127.0.0.1:{self.metrics_ports[i]}/metrics"
+        deadline = time.monotonic() + timeout_s
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=5.0) as resp:
+                    return resp.read().decode()
+            except (urllib.error.URLError, ConnectionError):
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.25)
 
     def collect_merged_trace(self, out_path: Optional[str] = None) -> dict:
         """After shutdown: merge every replica's dumped Chrome trace

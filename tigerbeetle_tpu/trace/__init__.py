@@ -11,8 +11,14 @@ Layout mirrors the reference:
   recording Tracer (bounded ring with self-describing eviction,
   wall-clock-anchored timestamps, per-event timing aggregates).
 - `statsd.py` — DogStatsD UDP emission + interval-flushed aggregates
-  (gauges reset after emit, like the reference).
-- `merge.py`  — cluster-wide trace merge (pid=replica, common timeline).
+  (gauges reset after emit, like the reference) with histogram-derived
+  p50/p95/p99/p999 `|ms` timing lines per series.
+- `histogram.py` — log2-bucketed, losslessly mergeable latency
+  histograms (~1% relative error), fed by every span at close.
+- `merge.py`  — cluster-wide trace merge (pid=replica, common timeline),
+  exact offline span quantiles, and p99 critical-path attribution.
+- `slo.py`    — objectives from perf/slo.json, evaluation against live
+  histograms, and run-granular burn-rate accounting.
 
 The tracer is injected at construction into the replica, journal, grid
 scrubber, message bus, serving supervisor, and sharded router; see
@@ -20,12 +26,19 @@ docs/operating/monitoring.md for the operator-facing catalog.
 """
 
 from .event import CATALOG, TID_BASE, Event, EventKind, EventSpec, lookup
-from .merge import merge_trace_files, merge_traces
+from .histogram import Histogram
+from .merge import (CRITICAL_PATH_STAGES, critical_path, merge_trace_files,
+                    merge_traces, span_quantile)
+from .slo import (Objective, burn_rates, evaluate, evaluate_bench_record,
+                  load_objectives)
 from .statsd import StatsD, TimingAggregates
 from .tracer import NullTracer, Tracer
 
 __all__ = [
     "CATALOG", "TID_BASE", "Event", "EventKind", "EventSpec", "lookup",
-    "merge_trace_files", "merge_traces", "StatsD", "TimingAggregates",
+    "Histogram", "CRITICAL_PATH_STAGES", "critical_path",
+    "merge_trace_files", "merge_traces", "span_quantile",
+    "Objective", "burn_rates", "evaluate", "evaluate_bench_record",
+    "load_objectives", "StatsD", "TimingAggregates",
     "NullTracer", "Tracer",
 ]

@@ -35,6 +35,7 @@ class EventKind(enum.Enum):
     span = "span"
     counter = "counter"
     gauge = "gauge"
+    histogram = "histogram"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -43,10 +44,19 @@ class EventSpec:
     tags: tuple = ()
     slots: int = 1  # concurrency lanes (spans only)
     doc: str = ""
+    # Histogram partition dimensions: the subset of `tags` whose values
+    # split this event's distribution into separate series (bounded
+    # cardinality — route/tier class labels, never ids). Every span
+    # event owns a duration histogram (fed at span close); hist_tags
+    # empty means one series per event.
+    hist_tags: tuple = ()
 
 
-def _span(doc: str, *tags: str, slots: int = 1) -> EventSpec:
-    return EventSpec(EventKind.span, tuple(tags), slots, doc)
+def _span(doc: str, *tags: str, slots: int = 1,
+          hist_tags: tuple = ()) -> EventSpec:
+    assert set(hist_tags) <= set(tags), (hist_tags, tags)
+    return EventSpec(EventKind.span, tuple(tags), slots, doc,
+                     tuple(hist_tags))
 
 
 def _counter(doc: str, *tags: str) -> EventSpec:
@@ -55,6 +65,14 @@ def _counter(doc: str, *tags: str) -> EventSpec:
 
 def _gauge(doc: str, *tags: str) -> EventSpec:
     return EventSpec(EventKind.gauge, tuple(tags), 1, doc)
+
+
+def _histogram(doc: str, *tags: str) -> EventSpec:
+    """A standalone distribution metric (observed via Tracer.observe,
+    unit declared in the doc line) — the third metric kind beside
+    counters and gauges; span events get duration histograms for free."""
+    return EventSpec(EventKind.histogram, tuple(tags), 1, doc,
+                     tuple(tags))
 
 
 class Event(enum.Enum):
@@ -112,6 +130,18 @@ class Event(enum.Enum):
     dispatch_route = _counter(
         "window/batch dispatches by kernel route (chain = the default "
         "scan-form whole-window route)", "route")
+    window_commit = _span(
+        "one serving commit window, submit to resolve, tagged with the "
+        "dispatch route it took and its shape tier (scan = the chain "
+        "whole-window scan, flat = an unrolled super route, fallback = "
+        "per-batch) — the per-class latency distributions the SLO "
+        "engine reads", "route", "tier", hist_tags=("route", "tier"))
+    serving_replay_windows = _histogram(
+        "windows replayed per recovery (unit: windows; the bounded-"
+        "replay objective in perf/slo.json reads this distribution)")
+    slo_breach = _counter(
+        "SLO objectives observed in breach at evaluation "
+        "(trace/slo.py against perf/slo.json)", "objective")
 
     # ------------------------------------------------------ sharded router
     router_step = _span("one sharded (or degraded single-chip) batch step",
@@ -140,6 +170,10 @@ class Event(enum.Enum):
     @property
     def doc(self) -> str:
         return self.value.doc
+
+    @property
+    def hist_tags(self) -> tuple:
+        return self.value.hist_tags
 
 
 CATALOG: dict = {e.name: e for e in Event}

@@ -16,7 +16,10 @@ tracers directly) and testing/vortex.py (real processes dump
 from __future__ import annotations
 
 import json
+import math
 from typing import Optional
+
+from .histogram import Histogram
 
 
 def merge_traces(docs: list, rebase: bool = True) -> dict:
@@ -32,6 +35,7 @@ def merge_traces(docs: list, rebase: bool = True) -> dict:
     seen_pids: set = set()
     anchors: dict = {}
     dropped = 0
+    histograms: dict = {}
     for doc in docs:
         meta = doc.get("metadata", {})
         pid = meta.get("pid", 0)
@@ -40,6 +44,16 @@ def merge_traces(docs: list, rebase: bool = True) -> dict:
         seen_pids.add(pid)
         anchors[pid] = meta.get("clock_anchor_ns")
         dropped += meta.get("dropped_events", 0)
+        # Cluster-wide distributions: per-replica histograms with the
+        # same series key ADD losslessly (integer bucket counts) — the
+        # property the merged p99s in the acceptance check lean on.
+        for key, d in (meta.get("histograms") or {}).items():
+            h = Histogram.from_dict(d)
+            if key in histograms:
+                histograms[key]["_h"].merge(h)
+            else:
+                histograms[key] = {"event": d.get("event"),
+                                   "tags": d.get("tags", {}), "_h": h}
         for e in doc.get("traceEvents", []):
             e = dict(e)
             e["pid"] = pid
@@ -59,8 +73,136 @@ def merge_traces(docs: list, rebase: bool = True) -> dict:
             "replicas": sorted(seen_pids),
             "clock_anchors_ns": anchors,
             "dropped_events": dropped,
+            "histograms": {
+                key: {"event": v["event"], "tags": v["tags"],
+                      **v["_h"].to_dict()}
+                for key, v in histograms.items()},
         },
     }
+
+
+def span_quantile(doc: dict, name: str, q: float,
+                  tag: Optional[str] = None) -> dict:
+    """Exact nearest-rank quantile(s) of a span event's durations in a
+    (merged) trace document, in MILLISECONDS. With `tag` the durations
+    are grouped by that span-arg value ({tag_value: quantile_ms}); the
+    "" key aggregates everything. The offline ground truth the endpoint
+    histograms are checked against (within the histogram error bound)."""
+    groups: dict = {"": []}
+    for e in doc.get("traceEvents", []):
+        if e.get("ph") != "X" or e.get("name") != name:
+            continue
+        dur_ms = e.get("dur", 0.0) / 1000.0
+        groups[""].append(dur_ms)
+        if tag is not None:
+            v = (e.get("args") or {}).get(tag)
+            if v is not None:
+                groups.setdefault(str(v), []).append(dur_ms)
+    out = {}
+    for k, durs in groups.items():
+        if not durs:
+            continue
+        durs.sort()
+        out[k] = durs[max(0, math.ceil(q * len(durs)) - 1)]
+    return out
+
+
+# The stage events a window's wall time is attributed to, in display
+# order; "dispatch_retry" is the serving_dispatch span's backoff +
+# retried attempts, visible as dispatch wall time beyond the window's
+# own execute share.
+CRITICAL_PATH_STAGES = (
+    "commit_prefetch", "commit_execute", "commit_compact",
+    "commit_checkpoint", "journal_write", "serving_dispatch",
+    "serving_epoch_verify", "serving_recovery_replay",
+)
+
+
+def critical_path(doc: dict, quantile: float = 0.9,
+                  window_event: str = "window_commit") -> Optional[dict]:
+    """Stage-share attribution for the slowest windows of a (merged)
+    trace: which stage owns the tail.
+
+    Walks the spans of the slowest-``(1-quantile)`` fraction of windows
+    (default: the slowest decile). A "window" is a `window_event` span
+    when the trace has any (serving traces); otherwise each replica's
+    per-op commit group (commit_* spans sharing an `op` arg — replica
+    traces, where the end-to-end unit is one committed prepare). Each
+    selected window's wall time is attributed to the stage spans
+    overlapping its [ts, ts+dur] interval on the same pid; time no
+    stage claims is "other". Returns None when the trace has neither
+    window spans nor commit groups."""
+    spans = [e for e in doc.get("traceEvents", []) if e.get("ph") == "X"]
+    windows = [e for e in spans if e.get("name") == window_event]
+    synthesized = False
+    if not windows:
+        windows = _commit_groups(spans)
+        synthesized = True
+    if not windows:
+        return None
+    windows.sort(key=lambda e: e["dur"])
+    cut = int(len(windows) * quantile)
+    slow = windows[cut:] or windows[-1:]
+    stage_us: dict = {}
+    other_us = 0.0
+    total_us = 0.0
+    for w in slow:
+        t0, t1 = w["ts"], w["ts"] + w["dur"]
+        total_us += w["dur"]
+        claimed = 0.0
+        # A synthesized window IS its commit_* members: attribute only
+        # the group's own spans, not an interleaved neighbor op's.
+        candidates = w["_members"] if synthesized else spans
+        for s in candidates:
+            if (s is w or s.get("pid") != w.get("pid")
+                    or s.get("name") not in CRITICAL_PATH_STAGES):
+                continue
+            overlap = min(t1, s["ts"] + s["dur"]) - max(t0, s["ts"])
+            if overlap > 0:
+                name = s["name"]
+                stage_us[name] = stage_us.get(name, 0.0) + overlap
+                claimed += overlap
+        other_us += max(0.0, w["dur"] - claimed)
+    if other_us > 1e-9:
+        stage_us["other"] = other_us
+    denom = sum(stage_us.values()) or 1.0
+    shares = {k: round(v / denom, 4)
+              for k, v in sorted(stage_us.items(),
+                                 key=lambda kv: -kv[1])}
+    durs = sorted(e["dur"] for e in windows)
+    p99_us = durs[max(0, math.ceil(0.99 * len(durs)) - 1)]
+    return {
+        "window_event": window_event if not synthesized else "commit_op",
+        "windows_total": len(windows),
+        "windows_analyzed": len(slow),
+        "slow_quantile": quantile,
+        "threshold_ms": round(slow[0]["dur"] / 1000.0, 3),
+        "p99_ms": round(p99_us / 1000.0, 3),
+        "stage_share": shares,
+        "p99_owner": next(iter(shares), None),
+    }
+
+
+def _commit_groups(spans: list) -> list:
+    """Synthesize window intervals from replica commit pipelines: the
+    commit_* spans sharing one (pid, op) form a group whose envelope
+    [first start, last end] is the per-op window."""
+    groups: dict = {}
+    for s in spans:
+        if not str(s.get("name", "")).startswith("commit_"):
+            continue
+        op = (s.get("args") or {}).get("op")
+        if op is None:
+            continue
+        groups.setdefault((s.get("pid"), op), []).append(s)
+    out = []
+    for (pid, op), members in groups.items():
+        t0 = min(s["ts"] for s in members)
+        t1 = max(s["ts"] + s["dur"] for s in members)
+        out.append({"name": "commit_op", "ph": "X", "ts": t0,
+                    "dur": t1 - t0, "pid": pid, "args": {"op": op},
+                    "_members": members})
+    return out
 
 
 def merge_trace_files(paths: list, out_path: Optional[str] = None) -> dict:

@@ -7,11 +7,20 @@ after each emit so a quiet interval reads as zero instead of a stale
 plateau. Counters and gauges emit immediately (the server aggregates
 counts; gauges are last-write-wins anyway). All emission is best-effort:
 a dead collector must never take a replica down with it.
+
+Beyond the reference: each aggregate series carries a per-interval
+log2 Histogram (trace/histogram.py), and the flush emits derived
+p50/p95/p99/p999 as DogStatsD ``|ms`` TIMING lines — tagged with the
+series' partition tags (route/tier on window spans) — next to the
+count/sum/min/max gauges. The flush-and-reset contract is unchanged:
+a quiet interval emits nothing stale.
 """
 
 from __future__ import annotations
 
 import socket
+
+from .histogram import Histogram
 
 
 class StatsD:
@@ -48,16 +57,25 @@ class StatsD:
 
 class TimingAggregates:
     """Per-event span-duration aggregates between StatsD emits:
-    count / sum / min / max in microseconds, reset after each flush
-    (reference statsd.zig behavior: gauges reset after emit)."""
+    count / sum / min / max in microseconds PLUS a per-interval log2
+    histogram, reset after each flush (reference statsd.zig behavior:
+    gauges reset after emit). Series are partitioned by the event's
+    hist_tags values (e.g. window_commit route/tier) so per-class
+    distributions survive the aggregation."""
 
     def __init__(self):
         self._agg: dict[str, list] = {}
+        self._hist: dict[str, Histogram] = {}
+        self._series: dict[str, tuple] = {}  # key -> (name, tags)
 
-    def record(self, name: str, dur_us: float) -> None:
-        a = self._agg.get(name)
+    def record(self, name: str, dur_us: float, tags: dict = None) -> None:
+        key = name if not tags else name + "|" + ",".join(
+            f"{k}:{v}" for k, v in sorted(tags.items()))
+        a = self._agg.get(key)
         if a is None:
-            self._agg[name] = [1, dur_us, dur_us, dur_us]
+            self._agg[key] = [1, dur_us, dur_us, dur_us]
+            self._hist[key] = Histogram()
+            self._series[key] = (name, dict(tags) if tags else {})
         else:
             a[0] += 1
             a[1] += dur_us
@@ -65,18 +83,32 @@ class TimingAggregates:
                 a[2] = dur_us
             if dur_us > a[3]:
                 a[3] = dur_us
+        self._hist[key].record(dur_us)
 
     def snapshot(self) -> dict:
-        """{event: {count, sum_us, min_us, max_us}} without resetting."""
-        return {name: {"count": a[0], "sum_us": round(a[1], 3),
-                       "min_us": round(a[2], 3), "max_us": round(a[3], 3)}
-                for name, a in self._agg.items()}
+        """{series: {count, sum_us, min_us, max_us}} without resetting.
+        Untagged series key on the bare event name (the bench probe and
+        chrome metadata shape); tagged series append |k:v pairs."""
+        return {key: {"count": a[0], "sum_us": round(a[1], 3),
+                      "min_us": round(a[2], 3), "max_us": round(a[3], 3)}
+                for key, a in self._agg.items()}
 
     def flush_to(self, statsd: StatsD) -> None:
-        """Emit every aggregate as four gauges, then reset."""
-        for name, a in self._agg.items():
-            statsd.gauge(f"trace.{name}.count", a[0])
-            statsd.gauge(f"trace.{name}.sum_us", round(a[1], 3))
-            statsd.gauge(f"trace.{name}.min_us", round(a[2], 3))
-            statsd.gauge(f"trace.{name}.max_us", round(a[3], 3))
+        """Emit every series as four gauges plus histogram-derived
+        p50/p95/p99/p999 TIMING (``|ms``) lines carrying the series
+        tags, then reset."""
+        for key, a in self._agg.items():
+            name, tags = self._series[key]
+            statsd.gauge(f"trace.{name}.count", a[0], **tags)
+            statsd.gauge(f"trace.{name}.sum_us", round(a[1], 3), **tags)
+            statsd.gauge(f"trace.{name}.min_us", round(a[2], 3), **tags)
+            statsd.gauge(f"trace.{name}.max_us", round(a[3], 3), **tags)
+            summary = self._hist[key].summary()
+            for q_name in ("p50", "p95", "p99", "p999"):
+                q_us = summary[q_name]
+                if q_us is not None:
+                    statsd.timing(f"trace.{name}.{q_name}",
+                                  round(q_us / 1000.0, 4), **tags)
         self._agg.clear()
+        self._hist.clear()
+        self._series.clear()

@@ -27,6 +27,7 @@ import time as _time
 from typing import Optional
 
 from .event import TID_BASE, Event, EventKind, lookup
+from .histogram import Histogram
 from .statsd import StatsD, TimingAggregates
 
 
@@ -50,6 +51,9 @@ class NullTracer:
     def gauge(self, event, value: float, **tags) -> None:
         pass
 
+    def observe(self, event, value: float, **tags) -> None:
+        pass
+
     def dump_chrome_trace(self, path: str) -> None:
         pass
 
@@ -58,11 +62,17 @@ class NullTracer:
 
 
 class _NullSpan:
+    __slots__ = ()
+
     def __enter__(self):
         return self
 
     def __exit__(self, *exc):
         return False
+
+    @property
+    def tags(self) -> dict:
+        return {}  # a throwaway: late-tagging a null span is a no-op
 
 
 _NULL_SPAN = _NullSpan()
@@ -90,6 +100,15 @@ class Tracer(NullTracer):
         # emitted ts values are comparable ACROSS processes.
         self._epoch_ns = _time.time_ns() - _time.perf_counter_ns()
         self.aggregates = TimingAggregates()
+        # CUMULATIVE distributions for the Prometheus exposition and
+        # the merged-trace metadata: series key -> Histogram, fed at
+        # span close BEFORE any ring bookkeeping (ring eviction drops
+        # span *events*; it must never dent a distribution) and by
+        # observe() for histogram-kind events. Unlike `aggregates`
+        # (flush-and-reset, StatsD interval semantics) these only grow.
+        self.histograms: dict[str, Histogram] = {}
+        # series key -> (event name, partition tags) for exposition.
+        self.histogram_series: dict[str, tuple] = {}
         self._last_flush_ns = _time.perf_counter_ns()
         # Concurrency lanes: event name -> busy slot set (sync spans),
         # and event name -> {slot: (start_ns, tags)} (begin/end spans).
@@ -169,11 +188,46 @@ class Tracer(NullTracer):
             self.statsd.gauge(ev.name, value, **tags)
             self._maybe_flush()
 
+    # ---------------------------------------------------------- histograms
+
+    def observe(self, event, value: float, **tags) -> None:
+        """Record one sample of a histogram-kind event (unit: whatever
+        the event's doc declares). Span durations need no observe() —
+        every span feeds its event's histogram at close."""
+        ev = self._check(event, EventKind.histogram, tags)
+        self.emitted.add(ev.name)
+        self._histogram(ev, tags).record(value)
+        self.aggregates.record(ev.name, float(value),
+                               self._hist_tags(ev, tags))
+        if self.statsd is not None:
+            self._maybe_flush()
+
+    def _hist_tags(self, ev: Event, tags: dict) -> dict:
+        if not ev.hist_tags or not tags:
+            return {}
+        return {k: tags[k] for k in ev.hist_tags if k in tags}
+
+    def _histogram(self, ev: Event, tags: dict) -> Histogram:
+        ht = self._hist_tags(ev, tags)
+        key = ev.name if not ht else ev.name + "|" + ",".join(
+            f"{k}:{v}" for k, v in sorted(ht.items()))
+        h = self.histograms.get(key)
+        if h is None:
+            h = self.histograms[key] = Histogram()
+            self.histogram_series[key] = (ev.name, ht)
+        return h
+
     # ----------------------------------------------------------- recording
 
     def _record(self, ev: Event, start_ns: int, dur_ns: int,
                 tags: dict, tid: int) -> None:
         self.emitted.add(ev.name)
+        # Distributions first, ring second: accumulation at span close
+        # must be complete BEFORE eviction can touch the span events,
+        # so a halved ring never dents a histogram or an aggregate.
+        dur_us = dur_ns / 1000.0
+        self._histogram(ev, tags).record(dur_us)
+        self.aggregates.record(ev.name, dur_us, self._hist_tags(ev, tags))
         if len(self.events) >= self.capacity:
             dropped = self.capacity // 2
             del self.events[:dropped]
@@ -190,10 +244,9 @@ class Tracer(NullTracer):
         self.events.append({
             "name": ev.name, "ph": "X",
             "ts": (start_ns + self._epoch_ns) / 1000.0,
-            "dur": dur_ns / 1000.0,
+            "dur": dur_us,
             "pid": self.pid, "tid": tid, "args": tags,
         })
-        self.aggregates.record(ev.name, dur_ns / 1000.0)
         if self.statsd is not None:
             self._maybe_flush()
 
@@ -232,6 +285,14 @@ class Tracer(NullTracer):
                 "counters": dict(self.counters),
                 "gauges": dict(self.gauges),
                 "aggregates": self.aggregates.snapshot(),
+                # Cumulative per-series distributions: losslessly
+                # mergeable across replica documents (trace/merge.py
+                # adds bucket counts), eviction-proof unlike the ring.
+                "histograms": {
+                    key: {"event": self.histogram_series[key][0],
+                          "tags": dict(self.histogram_series[key][1]),
+                          **h.to_dict()}
+                    for key, h in self.histograms.items()},
             },
         }
 
