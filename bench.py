@@ -222,6 +222,11 @@ def run_bench(platform: str, timeout_s: float) -> dict:
                     partial.update(json.loads(line[len("##trace "):]))
                 except json.JSONDecodeError:
                     pass
+            elif line.startswith("##shard "):
+                try:
+                    partial.update(json.loads(line[len("##shard "):]))
+                except json.JSONDecodeError:
+                    pass
             elif line.startswith("{"):
                 try:
                     final = json.loads(line)
@@ -316,6 +321,61 @@ def trace_overhead_probe(quick: bool) -> dict:
         "spans_recorded": spans,
         "commit_stages": stages,
         "critical_path": cp,
+    }
+
+
+def shard_balance_probe(quick: bool) -> dict:
+    """Partitioned-route balance diagnostics: a mixed uniform window
+    through the PartitionedRouter on whatever mesh exists — events
+    routed per shard, cross-shard fraction, exchange overflow count,
+    per-device resident bytes. The ##shard line of the run record
+    (devhub "shard balance" panel)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+    from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+    from tigerbeetle_tpu.ops.ledger import pad_transfer_events
+    from tigerbeetle_tpu.parallel.partitioned import (
+        PartitionedRouter,
+        partitioned_state_bytes,
+        replicated_state_bytes,
+    )
+    from tigerbeetle_tpu.types import Account, Transfer
+
+    mesh = Mesh(np.array(jax.devices()), ("batch",))
+    router = PartitionedRouter(mesh, a_cap=1 << 9, t_cap=1 << 11)
+    oracle = StateMachineOracle()
+    oracle.create_accounts([Account(id=i, ledger=1, code=1)
+                            for i in range(1, 33)], 10 ** 9)
+    state = router.from_oracle(oracle)
+    rng = np.random.default_rng(11)
+    ts, tid = 2 * 10 ** 9, 1
+    for _ in range(2 if quick else 4):
+        evs = []
+        for _ in range(256):
+            dr, cr = (int(x) for x in
+                      rng.choice(np.arange(1, 33), 2, replace=False))
+            evs.append(Transfer(id=tid, debit_account_id=dr,
+                                credit_account_id=cr, amount=1,
+                                ledger=1, code=1))
+            tid += 1
+        ev = pad_transfer_events(transfers_to_arrays(evs), 1024)
+        state, _, fell = router.step(state, ev, ts, len(evs))
+        assert not fell, router.stats()
+        ts += 10 ** 6
+    s = router.stats()
+    return {
+        "n_shards": router.n_shards,
+        "events_per_shard": s["events_owned"],
+        "cross_shard_transfers": s["cross_shard_transfers"],
+        "cross_shard_fraction": s["cross_shard_fraction"],
+        "exchange_overflows": s["exchange_overflows"],
+        "state_bytes_per_device": partitioned_state_bytes(state),
+        "state_bytes_replicated_equiv": replicated_state_bytes(
+            router.a_cap * router.n_shards,
+            router.t_cap * router.n_shards),
     }
 
 
@@ -453,6 +513,17 @@ def inner_main() -> None:
         trace_probe = {"error": str(e)[:200]}
     print("##trace " + json.dumps({"trace": trace_probe}), flush=True)
 
+    # Shard-balance record (##shard): partitioned-route diagnostics —
+    # events per shard, cross-shard fraction, exchange overflows — so a
+    # skewed ownership hash or an overflow-prone exchange capacity is
+    # visible in the devhub history like any throughput regression.
+    shard = None
+    try:
+        shard = shard_balance_probe(quick)
+    except Exception as e:  # never let the probe kill a bench run
+        shard = {"error": str(e)[:200]}
+    print("##shard " + json.dumps({"shard_balance": shard}), flush=True)
+
     opbudget = None
     try:
         import importlib.util
@@ -502,6 +573,9 @@ def inner_main() -> None:
         "opbudget": opbudget,
         # Tracing-cost guard + commit-stage shares (##trace line).
         "trace": trace_probe,
+        # Partitioned-route shard balance (##shard line): events per
+        # shard, cross-shard fraction, exchange overflow count.
+        "shard_balance": shard,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.

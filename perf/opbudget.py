@@ -9,15 +9,20 @@ un-regressable:
     scatter / segment_sum / scan — tigerbeetle_tpu.jaxhound.heavy_census)
     plus the operand bytes those ops read, for every create_transfers
     kernel tier INCLUDING the SPMD lowerings (8-device CPU mesh).
-  - budgets: perf/opbudget_r07.json commits a per-tier budget. A kernel
+  - budgets: perf/opbudget_r08.json commits a per-tier budget. A kernel
     change that raises any tier's heavy-op count or operand bytes past
     its budget fails `--check` (wired into scripts/gate.py) — raising a
     budget is an explicit, reviewed edit of the JSON (see
-    ARCHITECTURE.md "Op-budget workflow"). Round 7 adds the CHAIN
+    ARCHITECTURE.md "Op-budget workflow"). Round 7 added the CHAIN
     entries: the scan-form whole-window route's whole-program census
     (chain_w{2,8,32} — ~constant in window depth, the route's whole
     point) and its per-iteration BODY census (chain_body_w8, via
     jaxhound.scan_body_census — pinned <= the per-batch plain tier).
+    Round 8 adds the PARTITIONED tiers (sharded state, on-device
+    exchange): cross-device collectives are a counted class
+    ('collective'), so the budget pins the exchange's op count, and
+    the lints additionally reject any collective moving a whole-state
+    operand (jaxhound.state_gathers).
   - lints: `--lint` runs the jaxhound static checks over the serving-
     path jit entries: no closure constant > 4 KiB (the measured
     ~64 ms/call tunnel intercept), no while/fori loop in any serving
@@ -58,7 +63,7 @@ import numpy as np  # noqa: E402
 from tigerbeetle_tpu import jaxhound  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r07.json")
+BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r08.json")
 
 STACK = 4
 N_SUPER = 1024
@@ -103,6 +108,21 @@ def _chain_fixture(depth):
 
     evs, tss = _mk_prepares(depth)
     return stack_chain_window(evs, tss, N_SUPER)
+
+
+def _partitioned_fixture(mesh, axis="batch"):
+    """Stacked empty partitioned state over `mesh` (per-shard caps =
+    the replicated fixture caps / n_shards; the census and lints only
+    need shapes, not contents)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tigerbeetle_tpu.ops.ledger import init_state
+
+    n = mesh.shape[axis]
+    sub = jax.tree.map(np.asarray, init_state(
+        (1 << 10) // n, (1 << 12) // n, orphan_cap=(1 << 16) // n))
+    stacked = jax.tree.map(lambda x: np.stack([x] * n), sub)
+    return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
 
 
 def census_tiers(include_sharded: bool = True,
@@ -193,6 +213,19 @@ def census_tiers(include_sharded: bool = True,
                     lambda st, e: step.__wrapped__(
                         st, e, jnp.uint64(1000), jnp.int32(1)))(state, ev)
             out[f"sharded_{mode}"] = jaxhound.heavy_census(cj)
+        # Partitioned tiers (sharded STATE + on-device exchange): the
+        # 'collective' class pins the exchange's ICI round trips.
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_create_transfers)
+
+        pstate = _partitioned_fixture(mesh)
+        for mode in ("plain", "fixpoint"):
+            pstep = make_partitioned_create_transfers(mesh, mode=mode)
+            with mesh:
+                cj = jax.make_jaxpr(
+                    lambda st, e: pstep.__wrapped__(
+                        st, e, jnp.uint64(1000), jnp.int32(1)))(pstate, ev)
+            out[f"partitioned_{mode}"] = jaxhound.heavy_census(cj)
     return out
 
 
@@ -260,6 +293,18 @@ def serving_entries() -> dict:
                 entries[f"sharded_{mode}_step"] = (
                     step.lower(state, ev, np.uint64(1000), np.int32(1)),
                     n_leaves, 0)
+        # Partitioned steps: same donation contract over the stacked
+        # (device-sharded) state pytree.
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_create_transfers)
+
+        pstate = _partitioned_fixture(mesh)
+        for mode in ("plain", "fixpoint"):
+            pstep = make_partitioned_create_transfers(mesh, mode=mode)
+            with mesh:
+                entries[f"partitioned_{mode}_step"] = (
+                    pstep.lower(pstate, ev, np.uint64(1000), np.int32(1)),
+                    n_leaves, 0)
     return entries
 
 
@@ -307,6 +352,32 @@ def run_lints() -> list[str]:
                 f"{name}: closure constant {label} = {size} B > "
                 f"{jaxhound.CLOSURE_CONST_LIMIT} B (the tunnel re-ships "
                 "baked constants every call: ~64 ms at 0.5 MB — PERF.md)")
+    # Partitioned entries: the exchange must never regress into moving
+    # whole-state operands through a collective — that would rebuild
+    # the replicated route inside the partitioned one.
+    if len(jax.devices()) >= 8:
+        from jax.sharding import Mesh
+
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_create_transfers)
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+        pstate = _partitioned_fixture(mesh)
+        for mode in ("plain", "fixpoint"):
+            pstep = make_partitioned_create_transfers(mesh, mode=mode)
+            with mesh:
+                cj = jax.make_jaxpr(
+                    lambda st, e: pstep.__wrapped__(
+                        st, e, jnp.uint64(1000), jnp.int32(1)))(pstate, ev)
+            for prim, nbytes in jaxhound.state_gathers(cj):
+                fails.append(
+                    f"partitioned_{mode}_step: {prim} moves {nbytes} B "
+                    f"per device (> {jaxhound.STATE_GATHER_LIMIT} B — "
+                    "the exchange regressed into a whole-state gather)")
+            for label, size in jaxhound.closure_constants(cj):
+                fails.append(
+                    f"partitioned_{mode}_step: closure constant {label} "
+                    f"= {size} B > {jaxhound.CLOSURE_CONST_LIMIT} B")
     return fails
 
 
@@ -323,7 +394,7 @@ def check_budgets(current: dict | None = None) -> list[str]:
         cur = current.get(tier)
         if cur is None:
             fails.append(f"{tier}: no current census (tier removed? "
-                         "update perf/opbudget_r06.json)")
+                         "update the committed budget JSON)")
             continue
         if cur["heavy_total"] > budget["heavy_total"]:
             fails.append(

@@ -433,5 +433,12 @@ class TestShardLoss:
         assert s["reroutes"] == 2
         assert s["devices"] >= 1
 
+    def test_partitioned_loss_requires_resync(self):
+        from tigerbeetle_tpu.testing.chaos import shard_resync_scenario
+
+        s = shard_resync_scenario(0)
+        assert s["resyncs"] == 1
+        assert s["devices"] >= 1
+
     def test_corruption_kinds_is_subset(self):
         assert CORRUPTION_KINDS < set(FAULT_KINDS)

@@ -20,7 +20,10 @@ import jax.numpy as jnp
 from tigerbeetle_tpu import jaxhound
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+# r07: historical pin for the round-7 reduction-campaign assertions.
+# r08: the LIVE budget file perf/opbudget.py --check enforces.
 BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r07.json")
+BUDGET_PATH_R08 = os.path.join(REPO, "perf", "opbudget_r08.json")
 
 
 # ------------------------------------------------------------- census
@@ -103,6 +106,36 @@ def test_chain_body_census_within_plain_budget():
     for w in (2, 8, 32):
         assert (b[f"chain_w{w}"]["heavy_total"]
                 == b["chain_body_w8"]["heavy_total"] + 1), w
+
+
+def test_heavy_census_counts_collectives_inside_shard_map():
+    """The partitioned tiers' gate number: the census must descend into
+    a shard_map body (raw Jaxpr param, not ClosedJaxpr) and classify
+    the exchange collectives, and state_gathers must flag any
+    collective whose operand exceeds the whole-state threshold."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from tigerbeetle_tpu.parallel.shard_utils import get_shard_map
+
+    shard_map = get_shard_map()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+
+    def body(a):
+        return jax.lax.psum(a, "x")
+
+    try:
+        f = shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                      out_specs=P(), check_vma=False)
+    except TypeError:
+        f = shard_map(body, mesh=mesh, in_specs=(P("x"),),
+                      out_specs=P(), check_rep=False)
+    cj = jax.make_jaxpr(f)(jnp.zeros((8, 8), jnp.float32))
+    c = jaxhound.heavy_census(cj)
+    assert c["heavy"]["collective"] >= 1
+    hits = jaxhound.state_gathers(cj, limit=8)
+    assert hits and any("psum" in name for name, _ in hits)
+    assert jaxhound.state_gathers(cj, limit=1 << 20) == []
 
 
 # ----------------------------------------------------------- lints
@@ -214,12 +247,13 @@ def test_packed_layout_accounts_flags_isolated_from_code():
 # ------------------------------------------------- committed budgets
 
 def test_budget_file_covers_core_tiers():
-    with open(BUDGET_PATH) as f:
+    with open(BUDGET_PATH_R08) as f:
         d = json.load(f)
     for tier in ("per_event_plain", "plain", "fixpoint_8",
                  "balancing_8", "imported", "super_plain_s4",
                  "super_deep24_s4", "sharded_plain", "sharded_fixpoint",
-                 "chain_w2", "chain_w8", "chain_w32", "chain_body_w8"):
+                 "chain_w2", "chain_w8", "chain_w32", "chain_body_w8",
+                 "partitioned_plain", "partitioned_fixpoint"):
         assert tier in d["budget"], tier
         b = d["budget"][tier]
         assert b["heavy_total"] == sum(b["heavy"].values())
@@ -229,6 +263,11 @@ def test_budget_file_covers_core_tiers():
     for tier, b in d["budget"].items():
         post = d["post"][tier]
         assert post["heavy_total"] <= b["heavy_total"], tier
+    # The partitioned tiers' exchange is budget-pinned: a bounded,
+    # NONZERO collective count (two psum exchange rounds + the merged
+    # bad-flag reduction), never a whole-state gather (run_lints).
+    for tier in ("partitioned_plain", "partitioned_fixpoint"):
+        assert 0 < d["budget"][tier]["heavy"]["collective"] <= 8, tier
 
 
 def test_campaign_hit_the_15pct_reduction():
@@ -249,7 +288,7 @@ def test_check_budgets_flags_excess(monkeypatch):
         "tb_opbudget_test", os.path.join(REPO, "perf", "opbudget.py"))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
-    with open(BUDGET_PATH) as f:
+    with open(mod.BUDGET_PATH) as f:
         budgets = json.load(f)["budget"]
     ok = {t: {"heavy_total": b["heavy_total"],
               "heavy": dict(b["heavy"]),

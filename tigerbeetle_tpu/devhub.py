@@ -309,7 +309,7 @@ def render(history_path: str, out_path: str,
             + "".join(rows_rt) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
-    # ceilings (perf/opbudget_r07.json) — compile-footprint regressions
+    # ceilings (perf/opbudget_r08.json) — compile-footprint regressions
     # are rendered as loudly as throughput ones.
     ob_html = ""
     ob = next((e.get("opbudget") for e in reversed(entries)
@@ -319,7 +319,7 @@ def render(history_path: str, out_path: str,
         budgets = {}
         try:
             bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "..", "perf", "opbudget_r07.json")
+                                 "..", "perf", "opbudget_r08.json")
             with open(bpath) as f:
                 budgets = json.load(f).get("budget", {})
         except (OSError, ValueError):
@@ -356,6 +356,46 @@ def render(history_path: str, out_path: str,
               "<th>budget</th><th>by class</th><th>operand MB</th>"
               "<th></th></tr>"
             + "".join(rows_ob) + "</table>")
+    # Shard-balance panel (bench ##shard): the partitioned route's
+    # events-per-shard spread, cross-shard fraction, and exchange
+    # overflow count — a skewed ownership hash or an overflow-prone
+    # exchange capacity shows up here before it shows up as fallbacks.
+    sh_html = ""
+    sh = next((e.get("shard_balance") for e in reversed(entries)
+               if isinstance(e.get("shard_balance"), dict)
+               and "error" not in e.get("shard_balance", {})), None)
+    if sh:
+        owned = [int(x) for x in (sh.get("events_per_shard") or [])]
+        peak = max(owned) if owned else 1
+        rows_sh = []
+        for i, n in enumerate(owned):
+            bar = '<div style="background:#62a;height:10px;width:{}px">' \
+                  '</div>'.format(max(1, round(n / (peak or 1) * 240)))
+            rows_sh.append(
+                "<tr><td>shard {}</td><td>{}</td><td>{}</td></tr>".format(
+                    i, n, bar))
+        over = int(sh.get("exchange_overflows") or 0)
+        warn = ("" if not over else
+                '<p style="color:#c22;font-weight:700">EXCHANGE '
+                'OVERFLOWS — per-shard capacity too small for this '
+                'workload</p>')
+        bytes_dev = sh.get("state_bytes_per_device")
+        bytes_rep = sh.get("state_bytes_replicated_equiv")
+        ratio = (f" ({bytes_dev / bytes_rep:.3f}x of replicated)"
+                 if bytes_dev and bytes_rep else "")
+        sh_html = (
+            "<h2>shard balance (partitioned route, latest run)</h2>"
+            + warn
+            + "<p>{} shards, cross-shard fraction {:.1%} "
+              "({} transfers), {} exchange overflows, "
+              "{} state bytes/device{}</p>".format(
+                  sh.get("n_shards", "-"),
+                  float(sh.get("cross_shard_fraction") or 0.0),
+                  sh.get("cross_shard_transfers", 0), over,
+                  "-" if bytes_dev is None else bytes_dev,
+                  ratio)
+            + "<table><tr><th>shard</th><th>events owned</th><th></th>"
+              "</tr>" + "".join(rows_sh) + "</table>")
     # Commit-pipeline panel: the newest run's per-stage trace aggregates
     # (bench ##trace, recorded under a recording tracer) as time shares —
     # the operator-facing answer to "where does a commit go", next to the
@@ -511,6 +551,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {rec_html}
 {route_html}
 {ob_html}
+{sh_html}
 {tr_html}
 {slo_html}
 {cp_html}

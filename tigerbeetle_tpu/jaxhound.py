@@ -38,8 +38,20 @@ HEAVY_CLASSES = {
     "reduce_window_sum": "scan",
     "reduce_window_max": "scan",
     "reduce_window_min": "scan",
+    # Cross-device collectives (the partitioned exchange's op class):
+    # each is an ICI round trip billed like a heavy op, and the
+    # partitioned tiers pin their count so the exchange cannot silently
+    # grow (opbudget lint: none of these may move whole-state operands).
+    "psum": "collective",
+    "pmin": "collective",
+    "pmax": "collective",
+    "all_gather": "collective",
+    "all_to_all": "collective",
+    "ppermute": "collective",
+    "reduce_scatter": "collective",
 }
-HEAVY_CLASS_ORDER = ("sort", "gather", "scatter", "segment_sum", "scan")
+HEAVY_CLASS_ORDER = ("sort", "gather", "scatter", "segment_sum", "scan",
+                     "collective")
 
 
 def _aval_bytes(aval) -> int:
@@ -54,12 +66,18 @@ def _aval_bytes(aval) -> int:
 
 
 def _walk_jaxpr(jaxpr, visit) -> None:
-    """Depth-first over a jaxpr and every sub-jaxpr (pjit/cond/scan/...)."""
+    """Depth-first over a jaxpr and every sub-jaxpr (pjit/cond/scan/
+    shard_map/...). Params carry bodies either as ClosedJaxpr (pjit,
+    scan — has .jaxpr) or as a raw Jaxpr (shard_map — has .eqns
+    directly); both forms recurse."""
     for eqn in jaxpr.eqns:
         visit(eqn)
         for sub in eqn.params.values():
             subs = sub if isinstance(sub, (list, tuple)) else (sub,)
             for s in subs:
+                if hasattr(s, "eqns"):  # raw Jaxpr param (shard_map)
+                    _walk_jaxpr(s, visit)
+                    continue
                 inner = getattr(s, "jaxpr", None)
                 if inner is not None:
                     _walk_jaxpr(inner if hasattr(inner, "eqns") else s,
@@ -167,6 +185,31 @@ def while_ops(closed_jaxpr) -> int:
 
     _walk_jaxpr(closed_jaxpr.jaxpr, visit)
     return n[0]
+
+
+# Whole-state gather threshold: the partitioned exchange moves compact
+# per-event bundles (a few MB at N_PAD=8192); any collective whose
+# operand is larger than this is moving ledger STORE rows, which is
+# exactly the regression the partitioned layout exists to prevent.
+STATE_GATHER_LIMIT = 16 << 20  # bytes
+
+
+def state_gathers(closed_jaxpr, limit: int = STATE_GATHER_LIMIT) -> list:
+    """(primitive, operand_bytes) for every cross-device collective whose
+    per-device operand exceeds `limit` — the 'exchange regressed into a
+    whole-state all_gather' lint for partitioned serving entries."""
+    hits: list = []
+
+    def visit(eqn):
+        if HEAVY_CLASSES.get(eqn.primitive.name) != "collective":
+            return
+        nbytes = sum(_aval_bytes(getattr(v, "aval", None))
+                     for v in eqn.invars)
+        if nbytes > limit:
+            hits.append((eqn.primitive.name, nbytes))
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return hits
 
 
 def donated_inputs(lowered) -> int:

@@ -164,6 +164,43 @@ def _pack_account_rows(objs):
     return u64m, bal
 
 
+def _pack_event_rows(records, acct_row: dict, xfer_row: dict,
+                     a_dump: int) -> dict:
+    """Host AccountEventRecords -> the packed ring row matrix (shared by
+    the replicated rebuild/push and the partitioned per-shard rebuild,
+    so the two paths cannot drift). Row maps may be SHARD-LOCAL under
+    the partitioned layout: a remote account resolves to the dump row
+    and a remote pending transfer to -1 (row pointers are non-canonical
+    scope — the digest excludes them and consumers re-derive from ids)."""
+    n = len(records)
+    u64 = np.zeros((n, EV_NCOLS), dtype=np.uint64)
+    w32 = {name: np.zeros(n, dtype=np.int64) for name in EV_P32_POS}
+    U = EV_U64_IDX
+    for i, rec in enumerate(records):
+        u64[i, U["ts"]] = rec.timestamp
+        u64[i, U["amt_hi"]], u64[i, U["amt_lo"]] = _split(rec.amount)
+        u64[i, U["areq_hi"]], u64[i, U["areq_lo"]] = _split(
+            rec.amount_requested)
+        w32["tflags"][i] = (0xFFFFFFFF if rec.transfer_flags is None
+                            else rec.transfer_flags)
+        w32["pstat"][i] = int(rec.transfer_pending_status)
+        w32["p_row"][i] = (
+            xfer_row.get(rec.transfer_pending.id, -1)
+            if rec.transfer_pending is not None else -1)
+        for side, a in (("dr", rec.dr_account), ("cr", rec.cr_account)):
+            w32[f"{side}_row"][i] = acct_row.get(a.id, a_dump)
+            w32[f"{side}_flags"][i] = a.flags
+            for f, val in (("dp", a.debits_pending),
+                           ("dpos", a.debits_posted),
+                           ("cp", a.credits_pending),
+                           ("cpos", a.credits_posted)):
+                (u64[i, U[f"{side}_{f}_hi"]],
+                 u64[i, U[f"{side}_{f}_lo"]]) = _split(val)
+    for name, vals in w32.items():
+        _set32(u64, EV_P32_POS, name, vals)
+    return {"u64": u64}
+
+
 class MirrorDivergence(AssertionError):
     """VERIFY spot-check failure: a device-resident row disagrees with
     the host mirror. Subclasses AssertionError (existing fail-loudly
@@ -2090,33 +2127,8 @@ class DeviceLedger:
     def _event_cols(self, records: list) -> dict:
         """Host AccountEventRecords -> the packed ring row matrix
         (push/from_host)."""
-        n = len(records)
-        u64 = np.zeros((n, EV_NCOLS), dtype=np.uint64)
-        w32 = {name: np.zeros(n, dtype=np.int64) for name in EV_P32_POS}
-        U = EV_U64_IDX
-        for i, rec in enumerate(records):
-            u64[i, U["ts"]] = rec.timestamp
-            u64[i, U["amt_hi"]], u64[i, U["amt_lo"]] = _split(rec.amount)
-            u64[i, U["areq_hi"]], u64[i, U["areq_lo"]] = _split(
-                rec.amount_requested)
-            w32["tflags"][i] = (0xFFFFFFFF if rec.transfer_flags is None
-                                else rec.transfer_flags)
-            w32["pstat"][i] = int(rec.transfer_pending_status)
-            w32["p_row"][i] = (
-                self._xfer_row[rec.transfer_pending.id]
-                if rec.transfer_pending is not None else -1)
-            for side, a in (("dr", rec.dr_account), ("cr", rec.cr_account)):
-                w32[f"{side}_row"][i] = self._acct_row[a.id]
-                w32[f"{side}_flags"][i] = a.flags
-                for f, val in (("dp", a.debits_pending),
-                               ("dpos", a.debits_posted),
-                               ("cp", a.credits_pending),
-                               ("cpos", a.credits_posted)):
-                    (u64[i, U[f"{side}_{f}_hi"]],
-                     u64[i, U[f"{side}_{f}_lo"]]) = _split(val)
-        for name, vals in w32.items():
-            _set32(u64, EV_P32_POS, name, vals)
-        return {"u64": u64}
+        return _pack_event_rows(records, self._acct_row, self._xfer_row,
+                                self.a_cap)
 
 
 

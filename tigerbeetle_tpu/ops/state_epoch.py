@@ -229,3 +229,84 @@ def diverging_components(got: dict, want: dict) -> list[str]:
     attribution for the recovery log)."""
     return sorted(k for k in set(got) | set(want)
                   if got.get(k) != want.get(k))
+
+
+# ------------------------------------------------- partitioned states
+# (parallel/partitioned.py: every store sharded by id hash over the
+# mesh axis). The fold extends by shard-then-sum: each shard digests
+# its LOCAL rows with LOCAL row indices — exactly the indices the
+# per-shard oracle pack assigns under the same shard-then-sort order —
+# and the per-component digests wrap-sum across shards. Addition keeps
+# the combination order-free, so device (vmapped) and host (looped)
+# agree bit-for-bit.
+
+_pdigest_jit = None
+
+
+def _stacked_digest_view(stacked: dict) -> dict:
+    """The digested subset of a stacked partitioned pytree (drops the
+    excluded stores so the vmapped fold never touches them)."""
+    return dict(
+        accounts=stacked["accounts"], transfers=stacked["transfers"],
+        acct_key_max=stacked["acct_key_max"],
+        xfer_key_max=stacked["xfer_key_max"],
+        commit_ts=stacked["commit_ts"])
+
+
+def partitioned_state_digest(stacked: dict) -> dict:
+    """Digest a device-sharded (stacked) partitioned state: per-shard
+    folds wrap-summed per component. Read-only, its own jit entry."""
+    global _pdigest_jit
+    import jax
+
+    if _pdigest_jit is None:
+        import jax.numpy as jnp
+
+        def fold(view):
+            comps = jax.vmap(lambda s: _digest_components(s, jnp))(view)
+            return {k: jnp.sum(v) for k, v in comps.items()}
+
+        _pdigest_jit = jax.jit(fold)
+    out = jax.device_get(_pdigest_jit(_stacked_digest_view(stacked)))
+    return {k: int(v) for k, v in out.items()}
+
+
+def pack_oracle_state_partitioned(sm, a_cap: int, n_shards: int) -> list:
+    """Per-shard canonical packs of an oracle state: objects assigned by
+    the SAME ownership hash the kernels use (shard_utils.shard_of_id),
+    then packed in the canonical order within each shard (accounts by
+    applied timestamp, transfers in commit order) — the shard-then-sort
+    contract partitioned_from_oracle pins on device."""
+    from types import SimpleNamespace
+
+    from ..parallel.shard_utils import shard_of_int
+
+    assert a_cap % n_shards == 0, (a_cap, n_shards)
+    packs = []
+    for s in range(n_shards):
+        view = SimpleNamespace(
+            accounts={aid: a for aid, a in sm.accounts.items()
+                      if shard_of_int(aid, n_shards) == s},
+            transfers=sm.transfers,
+            transfer_by_timestamp={
+                ts: tid for ts, tid in sm.transfer_by_timestamp.items()
+                if shard_of_int(tid, n_shards) == s},
+            pending_status=sm.pending_status,
+            accounts_key_max=sm.accounts_key_max,
+            transfers_key_max=sm.transfers_key_max,
+            commit_timestamp=sm.commit_timestamp,
+        )
+        packs.append(pack_oracle_state(view, a_cap // n_shards))
+    return packs
+
+
+def partitioned_oracle_digest(sm, a_cap: int, n_shards: int) -> dict:
+    """Host-side expected digest of an oracle state under the
+    partitioned layout — bit-comparable with partitioned_state_digest
+    over a stepped device state at the same (a_cap, n_shards)."""
+    total: dict = {}
+    for pack in pack_oracle_state_partitioned(sm, a_cap, n_shards):
+        comps = _digest_components(pack, np)
+        for k, v in comps.items():
+            total[k] = (total.get(k, 0) + int(v)) & _U64_MASK
+    return total

@@ -55,6 +55,7 @@ from ..ops.fast_kernels import (
     per_event_status,
 )
 from ..trace import Event, NullTracer
+from .shard_utils import get_shard_map
 
 __all__ = ["make_sharded_create_transfers", "shard_batch", "ShardedRouter",
            "MODES"]
@@ -83,10 +84,7 @@ def make_sharded_create_transfers(mesh: Mesh, axis: str = "batch",
     contract as the matching single-chip jit entry. `ev` arrays must be
     divisible by the mesh axis size (pad_transfer_events' N_PAD=8192
     divides any power-of-two mesh)."""
-    try:
-        from jax import shard_map
-    except ImportError:  # pre-0.5 jax keeps it under experimental
-        from jax.experimental.shard_map import shard_map
+    shard_map = get_shard_map()
 
     assert mode in MODES, mode
     n_dev = mesh.shape[axis]
@@ -224,7 +222,12 @@ class ShardedRouter:
         """Mark one mesh device lost (simulated ICI/host failure). The
         replicated ledger state means ANY surviving chip — or the
         single-chip path — can serve; we take the single-chip path
-        until restore_devices() (re-meshing is a driver concern)."""
+        until restore_devices() (re-meshing is a driver concern).
+
+        This reroute is a REPLICATED-state privilege: the partitioned
+        sibling (parallel/partitioned.PartitionedRouter.drop_device)
+        cannot take it — a lost shard takes its account range with it —
+        and resyncs from the oracle instead (`shard_resync` cause)."""
         self.lost_devices.add(device)
 
     def restore_devices(self) -> None:

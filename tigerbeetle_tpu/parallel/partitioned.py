@@ -1,0 +1,714 @@
+"""Partitioned ledger state: account-hash sharding with an on-device
+event exchange and owner-masked write-back.
+
+parallel/full_sharded.py scales the per-event FLOPs but replicates the
+WHOLE ledger on every chip — state size is clamped to one device's HBM.
+This module removes that clamp: every store (accounts, transfer rows,
+the two hash tables, the event ring) is sharded over the mesh axis by a
+deterministic id hash (shard_utils.shard_of_id), so per-device resident
+state is ~1/n_shards of the replicated route.
+
+The semantic license is AT2's (PAPERS.md): transfer ordering only
+matters per account, so cross-shard coordination is only needed for the
+compact per-event bundle — never for state. One `shard_map` body runs
+the whole step:
+
+  1. PROBE + EXCHANGE (phase 1, transfers): every shard looks up the
+     batch's transfer ids and pending ids in its LOCAL table and
+     contributes (encoded hit, masked row) lanes to ONE dense `psum`.
+     The partitioned-storage invariant — each key lives on exactly one
+     shard — makes the sum a select: afterwards every shard holds the
+     global lookup result and the owning shard's row for every lane.
+  2. PROBE + EXCHANGE (phase 2, accounts): same exchange for the 4N
+     account keys the batch can touch (ev.dr, ev.cr, and the pending
+     rows' dr/cr from phase 1), carrying the packed account row and the
+     balance limbs.
+  3. ASSEMBLE: the exchanged rows are deduplicated (first-occurrence
+     over the 128-bit keys) into a replicated O(batch) MINI-STATE —
+     init_state-shaped, with its own small hash tables — whose row
+     pointers are rewritten mini-locally. This is the narrow two-phase
+     join: cross-shard transfers resolve against the assembled bundle,
+     not against remote state.
+  4. JUDGE: the UNMODIFIED single-chip kernel stack
+     (per_event_status + create_transfers_fast, any tier) runs on the
+     mini-state, replicated. Bit-exactness vs the single-chip route is
+     inherited, not re-proved: the kernel sees exactly the rows it
+     would have gathered from the full store.
+  5. WRITE-BACK: each shard applies the mini's changes to the rows it
+     owns — appended transfer rows and ring rows land at the local
+     counts, pending-status flips rewrite the (alone-in-its-column)
+     pstat word, touched accounts write back the full packed row +
+     limbs, and the new ids plan/write into the local hash table. All
+     writes are masked by a psum-combined ok (kernel fallback, local
+     capacity, exchange overflow): a failed batch leaves every shard
+     bit-identical, preserving the escalation/replay contract.
+
+Non-canonical columns: transfer `dr_row`/`cr_row` and the ring's row
+pointers are SHARD-LOCAL (or mini-scope, for ring rows) under the
+partitioned layout. They were already excluded from the state-epoch
+digest and re-derived by every consumer (the exchange rewrites them
+from the id columns on assembly), so bit-comparability is unaffected.
+
+Fallback/overflow: the exchange has a static per-shard capacity (the
+mini-state caps and the per-shard table/row headroom). A breach is a
+per-cause host fallback exactly like the replicated router's —
+`shard_capacity` / `exchange_overflow` ride out["fb_causes"].
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.ev_layout import (
+    AC_NCOLS, EV_NCOLS, EV_P32_POS, XF_NCOLS, XF_U64_IDX, XF_P32_POS,
+    pack32,
+)
+from ..ops.fast_kernels import (
+    _CREATED,
+    _TRANSIENT_CODES,
+    _cumsum,
+    create_transfers_fast,
+    imported_batch_ctx,
+    per_event_status,
+)
+from ..ops.hash_table import (
+    ORPHAN_VAL, ht_init, ht_insert, ht_lookup, ht_plan, ht_write,
+)
+from ..ops.ledger import _delta_gather_body
+from ..trace import Event, NullTracer
+from .full_sharded import MODES, _MODE_KWARGS, ShardedRouter
+from .shard_utils import get_shard_map, shard_of_id, shard_of_int
+
+__all__ = ["make_partitioned_create_transfers", "partitioned_from_oracle",
+           "partitioned_state_bytes", "PartitionedRouter", "MODES"]
+
+_U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
+_XF_DRROW_COL = XF_P32_POS["dr_row"][0]   # ("dr_row","cr_row") word
+_XF_PSTAT_COL = XF_P32_POS["pstat"][0]    # pstat lives ALONE (flips)
+_EV_PROW_COL = EV_P32_POS["p_row"][0]     # ("pstat","p_row") word
+_EV_TFLAGS_COL = EV_P32_POS["tflags"][0]  # ("tflags","dr_flags") word
+
+
+def _uniq_rows(k_hi, k_lo, active):
+    """First-occurrence dedupe of 128-bit keys over the exchange lanes.
+
+    Returns (first: bool[N] — the one lane per distinct active key that
+    builds the mini row, row: int32[N] — that key's dense mini row on
+    EVERY lane carrying it (-1 on inactive lanes), n: int32 — number of
+    distinct active keys). Inactive lanes sort to a MAX-key block at
+    the end (valid object ids are never 2^128-1), so active runs get
+    the dense rank prefix."""
+    n = k_hi.shape[0]
+    kh = jnp.where(active, k_hi, _U64_MAX)
+    kl = jnp.where(active, k_lo, _U64_MAX)
+    perm = jnp.lexsort((kl, kh))  # stable: primary kh, secondary kl
+    khs, kls = kh[perm], kl[perm]
+    first_s = jnp.concatenate([
+        jnp.ones((1,), bool),
+        (khs[1:] != khs[:-1]) | (kls[1:] != kls[:-1])])
+    act_s = active[perm]
+    run = _cumsum(first_s.astype(jnp.int32)) - 1
+    n_uniq = jnp.sum((first_s & act_s).astype(jnp.int32))
+    first = jnp.zeros(n, bool).at[perm].set(first_s & act_s)
+    row = jnp.zeros(n, jnp.int32).at[perm].set(run)
+    return first, jnp.where(active, row, jnp.int32(-1)), n_uniq
+
+
+def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
+                                      mode: str = "plain"):
+    """Build the jitted partitioned-state SPMD step over `mesh` for one
+    kernel tier (`mode` in MODES).
+
+    Returns step(stacked_state, ev, timestamp, n) -> (new_state, out).
+    `stacked_state` is the pytree from partitioned_from_oracle: every
+    leaf carries a leading shard axis sharded P(axis); `ev` is the full
+    padded batch, replicated. `out` is the single-chip out dict plus
+    `flush` (the delta gather of the appended rows, replicated),
+    `cross_shard_transfers`, `exchange_overflow`, and
+    `shard_stats.events_owned` (per-shard routed-event counts)."""
+    shard_map = get_shard_map()
+    assert mode in MODES, mode
+    n_dev = mesh.shape[axis]
+
+    def step(state, ev, timestamp, n):
+        N = ev["id_lo"].shape[0]
+
+        def body(stacked, ev):
+            sub = jax.tree.map(lambda x: x[0], stacked)
+            me = jax.lax.axis_index(axis)
+            idxs = jnp.arange(N, dtype=jnp.int32)
+            ts_full = (timestamp - n.astype(jnp.uint64)
+                       + idxs.astype(jnp.uint64) + jnp.uint64(1))
+            acc, xfr, evr = (sub["accounts"], sub["transfers"],
+                             sub["events"])
+            a_dump_l = acc["u64"].shape[0] - 1
+            t_dump_l = xfr["u64"].shape[0] - 1
+            e_cap_l = evr["u64"].shape[0] - 1
+
+            # ---- phase 1: transfer-key probe + exchange (2N lanes:
+            # [ev.id | ev.pid]). Encoding in lane 0 of the exchanged
+            # row: 0 = absent, 1 = orphan (ht_lookup reports stored
+            # ORPHAN_VAL as val=-1), r+2 = live owner-local row r.
+            xk_hi = jnp.concatenate([ev["id_hi"], ev["pid_hi"]])
+            xk_lo = jnp.concatenate([ev["id_lo"], ev["pid_lo"]])
+            xf_l, xv_l = ht_lookup(sub["xfer_ht"], xk_hi, xk_lo)
+            x_live_l = xf_l & (xv_l >= 0)
+            enc_l = jnp.where(
+                xf_l, (xv_l + 2).astype(jnp.uint64), jnp.uint64(0))
+            xrow_l = jnp.where(x_live_l, xv_l, t_dump_l)
+            xdata_l = jnp.where(x_live_l[:, None],
+                                xfr["u64"][xrow_l], jnp.uint64(0))
+            g = jax.lax.psum(
+                jnp.concatenate([enc_l[:, None], xdata_l], axis=1), axis)
+            g_enc, g_rows = g[:, 0], g[:, 1:]
+            x_active = g_enc > 0
+            x_live = g_enc >= 2
+
+            # ---- phase 2: account-key probe + exchange (4N lanes:
+            # [ev.dr | ev.cr | p.dr | p.cr]; the pending rows' account
+            # ids come off the phase-1 exchange). Encoding: 0 = absent,
+            # r+1 = owner-local row r. Zero keys (padded lanes, absent
+            # pendings) hit the hash table's empty sentinel -> absent.
+            p_rows_g = g_rows[N:]
+            ak_hi = jnp.concatenate([
+                ev["dr_hi"], ev["cr_hi"],
+                p_rows_g[:, XF_U64_IDX["dr_hi"]],
+                p_rows_g[:, XF_U64_IDX["cr_hi"]]])
+            ak_lo = jnp.concatenate([
+                ev["dr_lo"], ev["cr_lo"],
+                p_rows_g[:, XF_U64_IDX["dr_lo"]],
+                p_rows_g[:, XF_U64_IDX["cr_lo"]]])
+            af_l, ar_l = ht_lookup(sub["acct_ht"], ak_hi, ak_lo)
+            aenc_l = jnp.where(
+                af_l, (ar_l + 1).astype(jnp.uint64), jnp.uint64(0))
+            arow_g_l = jnp.where(af_l, ar_l, a_dump_l)
+            au_l = jnp.where(af_l[:, None],
+                             acc["u64"][arow_g_l], jnp.uint64(0))
+            ab_l = jnp.where(af_l[:, None],
+                             acc["bal"][arow_g_l], jnp.uint64(0))
+            ga = jax.lax.psum(
+                jnp.concatenate([aenc_l[:, None], au_l, ab_l], axis=1),
+                axis)
+            g_aenc = ga[:, 0]
+            g_au = ga[:, 1:1 + AC_NCOLS]
+            g_ab = ga[:, 1 + AC_NCOLS:]
+            a_active = g_aenc > 0
+
+            # ---- assemble the replicated mini-state (O(batch) caps).
+            MA, MT, ME = 4 * N, 3 * N, N
+            afirst, amrow, n_a = _uniq_rows(ak_hi, ak_lo, a_active)
+            mini_au = jnp.zeros((MA + 1, AC_NCOLS), jnp.uint64).at[
+                jnp.where(afirst, amrow, MA)].set(g_au).at[MA].set(
+                jnp.uint64(0))
+            mini_ab = jnp.zeros((MA + 1, 16), jnp.uint64).at[
+                jnp.where(afirst, amrow, MA)].set(g_ab).at[MA].set(
+                jnp.uint64(0))
+            ht_a, ok_a = ht_insert(
+                ht_init(8 * N), ak_hi, ak_lo, amrow, afirst)
+
+            xfirst, _, _ = _uniq_rows(xk_hi, xk_lo, x_active)
+            lfirst, lrow, n_live = _uniq_rows(xk_hi, xk_lo, x_live)
+            mini_xu = jnp.zeros((MT + 1, XF_NCOLS), jnp.uint64).at[
+                jnp.where(lfirst, lrow, MT)].set(g_rows).at[MT].set(
+                jnp.uint64(0))
+            # Mini-local row pointers: rewrite each exchanged row's
+            # (dr_row, cr_row) word from its OWN id columns through the
+            # mini account table (absent -> mini dump row). Only the
+            # pending rows' pointers are ever dereferenced, and their
+            # dr/cr are in the phase-2 key set by construction.
+            mdr_hi = mini_xu[:, XF_U64_IDX["dr_hi"]]
+            mdr_lo = mini_xu[:, XF_U64_IDX["dr_lo"]]
+            mcr_hi = mini_xu[:, XF_U64_IDX["cr_hi"]]
+            mcr_lo = mini_xu[:, XF_U64_IDX["cr_lo"]]
+            fdr, rdr = ht_lookup(ht_a, mdr_hi, mdr_lo)
+            fcr, rcr = ht_lookup(ht_a, mcr_hi, mcr_lo)
+            has_ids = (mdr_hi | mdr_lo) != 0
+            ptr_word = pack32(jnp.where(fdr, rdr, MA),
+                              jnp.where(fcr, rcr, MA))
+            mini_xu = mini_xu.at[:, _XF_DRROW_COL].set(
+                jnp.where(has_ids, ptr_word,
+                          mini_xu[:, _XF_DRROW_COL]))
+            ht_x, ok_x = ht_insert(
+                ht_init(8 * N), xk_hi, xk_lo,
+                jnp.where(x_live, lrow, jnp.int32(ORPHAN_VAL)), xfirst)
+            xchg_bad = (~ok_a) | (~ok_x) | (n_a > MA) | (n_live > 2 * N)
+
+            # Ring prefill (p_row=-1 / tflags=0xFFFFFFFF) built ON
+            # DEVICE by column sets — never as a host closure constant.
+            mini_ev = jnp.zeros((ME + 1, EV_NCOLS), jnp.uint64)
+            mini_ev = mini_ev.at[:, _EV_PROW_COL].set(
+                jnp.uint64(0xFFFFFFFF) << jnp.uint64(32))
+            mini_ev = mini_ev.at[:, _EV_TFLAGS_COL].set(
+                jnp.uint64(0xFFFFFFFF))
+
+            mini = dict(
+                accounts=dict(u64=mini_au, bal=mini_ab, count=n_a),
+                transfers=dict(u64=mini_xu, count=n_live),
+                events=dict(u64=mini_ev, count=jnp.int32(0)),
+                acct_ht=ht_a,
+                xfer_ht=ht_x,
+                # Scalars are stored per shard but hold GLOBAL values.
+                acct_key_max=sub["acct_key_max"],
+                xfer_key_max=sub["xfer_key_max"],
+                pulse_next=sub["pulse_next"],
+                commit_ts=sub["commit_ts"],
+            )
+
+            # ---- judge: the unmodified single-chip kernel on the
+            # mini-state, replicated. The imported tier's account-ts
+            # collision is the only batch-context piece that needs the
+            # FULL table: each shard probes its sorted local column and
+            # the memberships OR-combine over the mesh.
+            ictx = None
+            if mode == "imported":
+                ctx_l = imported_batch_ctx(sub, ev, ts_full,
+                                           ev["valid"], idxs)
+                ictx = dict(ctx_l)
+                ictx["acct_ts_collision"] = jax.lax.psum(
+                    ctx_l["acct_ts_collision"].astype(jnp.int32),
+                    axis) > 0
+            pe = per_event_status(mini, ev, ts_full, imported_ctx=ictx)
+            mini_t0 = n_live
+            new_mini, out = create_transfers_fast(
+                mini, ev, timestamp, n, per_event=pe,
+                **_MODE_KWARGS[mode])
+
+            # ---- per-shard write-back plan + combined ok.
+            status = out["r_status"]
+            created = ev["valid"] & (status == _CREATED)
+            transient = jnp.zeros_like(created)
+            for code in _TRANSIENT_CODES:
+                transient = transient | (status == code)
+            orphan_new = ev["valid"] & transient
+            ins_mask = created | orphan_new
+            owner_ev = shard_of_id(ev["id_hi"], ev["id_lo"], n_dev)
+            mine = created & (owner_ev == me)
+            ins_mine = ins_mask & (owner_ev == me)
+            n_mine = jnp.sum(mine.astype(jnp.int32))
+            local_rank = _cumsum(mine.astype(jnp.int32)) - mine
+            pos, ok_pl = ht_plan(sub["xfer_ht"], ev["id_hi"],
+                                 ev["id_lo"], ins_mine)
+            bad_l = ((xfr["count"] + n_mine > t_dump_l)
+                     | (evr["count"] + n_mine > e_cap_l)
+                     | ~ok_pl)
+            bad = jax.lax.psum(bad_l.astype(jnp.int32), axis) > 0
+            g_ok = (~out["fallback"]) & (~bad) & (~xchg_bad)
+
+            # ---- write-back (every write masked by g_ok; the dump
+            # rows absorb masked lanes, exactly the kernel's idiom).
+            row_off = _cumsum(created.astype(jnp.int32)) - created
+            mini_trow = jnp.clip(mini_t0 + row_off, 0, MT)
+            dest_t = jnp.where(mine & g_ok,
+                               xfr["count"] + local_rank, t_dump_l)
+            new_rows = new_mini["transfers"]["u64"][mini_trow]
+            # Stored row pointers become SHARD-LOCAL: resolve the new
+            # row's dr/cr against the local table (remote -> dump).
+            fdr2, rdr2 = ht_lookup(sub["acct_ht"],
+                                   ev["dr_hi"], ev["dr_lo"])
+            fcr2, rcr2 = ht_lookup(sub["acct_ht"],
+                                   ev["cr_hi"], ev["cr_lo"])
+            new_rows = new_rows.at[:, _XF_DRROW_COL].set(
+                pack32(jnp.where(fdr2, rdr2, a_dump_l),
+                       jnp.where(fcr2, rcr2, a_dump_l)))
+            xu_new = xfr["u64"].at[dest_t].set(new_rows)
+            # Pending-status flips on existing owned rows: the pstat
+            # word is alone in its column, so the flip cannot clobber a
+            # neighbor. Unchanged rows rewrite their own value.
+            owner_xk = shard_of_id(xk_hi, xk_lo, n_dev)
+            flip = lfirst & (owner_xk == me)
+            dest_p = jnp.where(flip & g_ok,
+                               (g_enc - jnp.uint64(2)).astype(jnp.int32),
+                               t_dump_l)
+            pword = new_mini["transfers"]["u64"][
+                jnp.where(x_live, lrow, MT), _XF_PSTAT_COL]
+            xu_new = xu_new.at[dest_p, _XF_PSTAT_COL].set(pword)
+
+            owner_ak = shard_of_id(ak_hi, ak_lo, n_dev)
+            wb_a = afirst & (owner_ak == me)
+            dest_a = jnp.where(wb_a & g_ok,
+                               (g_aenc - jnp.uint64(1)).astype(jnp.int32),
+                               a_dump_l)
+            amrow_c = jnp.where(afirst, amrow, MA)
+            au_new = acc["u64"].at[dest_a].set(
+                new_mini["accounts"]["u64"][amrow_c])
+            ab_new = acc["bal"].at[dest_a].set(
+                new_mini["accounts"]["bal"][amrow_c])
+
+            dest_e = jnp.where(mine & g_ok,
+                               evr["count"] + local_rank, e_cap_l)
+            ring_rows = new_mini["events"]["u64"][
+                jnp.clip(row_off, 0, ME)]
+            eu_new = evr["u64"].at[dest_e].set(ring_rows)
+
+            vals = jnp.where(created, xfr["count"] + local_rank,
+                             jnp.int32(ORPHAN_VAL))
+            ht_new = ht_write(sub["xfer_ht"], pos, ev["id_hi"],
+                              ev["id_lo"], vals, ins_mine & g_ok)
+
+            n_mine_ok = jnp.where(g_ok, n_mine, 0)
+
+            def adopt(new_v, old_v):
+                return jnp.where(g_ok, new_v, old_v)
+
+            new_sub = dict(
+                accounts=dict(u64=au_new, bal=ab_new,
+                              count=acc["count"]),
+                transfers=dict(u64=xu_new,
+                               count=xfr["count"] + n_mine_ok),
+                events=dict(u64=eu_new,
+                            count=evr["count"] + n_mine_ok),
+                acct_ht=sub["acct_ht"],
+                xfer_ht=ht_new,
+                acct_key_max=adopt(new_mini["acct_key_max"],
+                                   sub["acct_key_max"]),
+                xfer_key_max=adopt(new_mini["xfer_key_max"],
+                                   sub["xfer_key_max"]),
+                pulse_next=adopt(new_mini["pulse_next"],
+                                 sub["pulse_next"]),
+                commit_ts=adopt(new_mini["commit_ts"],
+                                sub["commit_ts"]),
+            )
+
+            # ---- amended out dict: the shard/exchange breaches are
+            # host fallbacks (state untouched), never escalations.
+            xb = bad | xchg_bad
+            rep = dict(out)
+            rep["r_status"] = jnp.where(xb, jnp.zeros_like(status),
+                                        status)
+            rep["r_ts"] = jnp.where(xb, jnp.zeros_like(out["r_ts"]),
+                                    out["r_ts"])
+            rep["fallback"] = out["fallback"] | xb
+            rep["limit_only"] = out["limit_only"] & ~xb
+            rep["created_count"] = jnp.where(xb, 0,
+                                             out["created_count"])
+            fbc = dict(out["fb_causes"])
+            fbc["shard_capacity"] = bad
+            fbc["exchange_overflow"] = xchg_bad
+            rep["fb_causes"] = fbc
+            # Durable flush rides the mini: the appended rows' slice
+            # plus the id/p_ts derivations, all mini-resolved (the
+            # canonical columns are bit-exact vs the single-chip
+            # gather; row-pointer columns are non-canonical scope).
+            rep["flush"] = _delta_gather_body(new_mini, mini_t0, 0,
+                                              N, N)
+            owner_dr = shard_of_id(ev["dr_hi"], ev["dr_lo"], n_dev)
+            owner_cr = shard_of_id(ev["cr_hi"], ev["cr_lo"], n_dev)
+            rep["cross_shard_transfers"] = jnp.sum(
+                (created & (owner_dr != owner_cr)).astype(jnp.int32))
+            rep["exchange_overflow"] = xchg_bad
+            sh = dict(events_owned=jnp.sum(
+                (ev["valid"] & (owner_ev == me)).astype(jnp.int32)
+            )[None])
+
+            new_stacked = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                       new_sub)
+            return new_stacked, {"rep": rep, "sh": sh}
+
+        try:
+            smapped = shard_map(
+                body, mesh=mesh, in_specs=(P(axis), P()),
+                out_specs=(P(axis), {"rep": P(), "sh": P(axis)}),
+                check_vma=False)
+        except TypeError:  # pre-0.5 jax spells the kwarg check_rep
+            smapped = shard_map(
+                body, mesh=mesh, in_specs=(P(axis), P()),
+                out_specs=(P(axis), {"rep": P(), "sh": P(axis)}),
+                check_rep=False)
+        new_state, out2 = smapped(state, ev)
+        out = dict(out2["rep"])
+        out["shard_stats"] = out2["sh"]
+        return new_state, out
+
+    # Donation preserved: the sharded buffers are consumed in place
+    # (jaxhound's donation audit checks the lowered artifact).
+    return jax.jit(step, donate_argnums=0)
+
+
+# --------------------------------------------------------------- host side
+
+def _chunk_insert(table, keys_vals, n_pad):
+    """from_host's batch_insert, shared shape: chunked ht_insert of
+    (id, val) pairs with a hard overflow assert."""
+    table = jax.tree.map(jnp.asarray, table)
+    for lo_i in range(0, len(keys_vals), n_pad):
+        chunk = keys_vals[lo_i:lo_i + n_pad]
+        hi = np.array([k >> 64 for k, _ in chunk], dtype=np.uint64)
+        lo = np.array([k & (1 << 64) - 1 for k, _ in chunk],
+                      dtype=np.uint64)
+        vals = np.array([v for _, v in chunk], dtype=np.int32)
+        table, ok = ht_insert(
+            table, jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(vals),
+            jnp.ones(len(chunk), dtype=bool))
+        assert bool(ok), "hash rebuild overflow: raise capacities"
+    return table
+
+
+def _record_owner_id(sm, rec) -> int:
+    """The id that decides a ring row's shard: the creating transfer's
+    (commit-timestamp keyed), else the pending transfer's, else the
+    debit account's (expiry rows without a commit entry)."""
+    tid = sm.transfer_by_timestamp.get(rec.timestamp)
+    if tid is not None:
+        return tid
+    if rec.transfer_pending is not None:
+        return rec.transfer_pending.id
+    return rec.dr_account.id
+
+
+def partitioned_from_oracle(sm, mesh: Mesh, axis: str = "batch",
+                            a_cap: int = 1 << 12, t_cap: int = 1 << 14,
+                            e_cap: int | None = None):
+    """Build the device-sharded state pytree from a host oracle.
+
+    The partitioned sibling of DeviceLedger.from_host: objects are
+    assigned to shards by shard_of_int over the SAME ownership hash the
+    kernels use, then packed per shard in the canonical order
+    (accounts by applied timestamp, transfers in commit order — the
+    shard-then-sort contract the epoch digest pins). Every leaf gains a
+    leading shard axis and lands with NamedSharding P(axis); per-shard
+    caps are the global caps / n_shards, so per-device resident bytes
+    scale ~1/n_shards."""
+    from ..ops.ledger import (
+        N_PAD, _pack_account_rows, _pack_event_rows, _pack_transfer_rows,
+        init_state,
+    )
+    from ..types import TransferPendingStatus
+
+    n_shards = mesh.shape[axis]
+    assert a_cap % n_shards == 0 and t_cap % n_shards == 0, \
+        (a_cap, t_cap, n_shards)
+    if e_cap is None:
+        e_cap = t_cap
+    a_cap_s = a_cap // n_shards
+    t_cap_s = t_cap // n_shards
+    e_cap_s = max(e_cap // n_shards, 1)
+    # The replicated default keeps a 2^16 orphan floor for load safety;
+    # per shard the floor scales too, keeping the AGGREGATE table the
+    # same size (the 1/n_shards byte assertion depends on it).
+    orphan_cap_s = max((1 << 16) // n_shards, t_cap_s)
+
+    acct_all = sorted(sm.accounts.values(), key=lambda a: a.timestamp)
+    xfer_all = [sm.transfers[tid]
+                for tid in sm.transfer_by_timestamp.values()]
+    orphan_all = sorted(sm.orphaned)
+
+    subs = []
+    for s in range(n_shards):
+        accounts = [a for a in acct_all
+                    if shard_of_int(a.id, n_shards) == s]
+        transfers = [t for t in xfer_all
+                     if shard_of_int(t.id, n_shards) == s]
+        orphans = [o for o in orphan_all
+                   if shard_of_int(o, n_shards) == s]
+        records = [r for r in sm.account_events
+                   if shard_of_int(_record_owner_id(sm, r),
+                                   n_shards) == s]
+        assert len(accounts) <= a_cap_s and len(transfers) <= t_cap_s \
+            and len(records) <= e_cap_s, "shard capacity exceeded"
+        st = jax.tree.map(lambda x: np.array(x), init_state(
+            a_cap_s, t_cap_s, orphan_cap=orphan_cap_s, e_cap=e_cap_s))
+
+        acct_row = {a.id: r for r, a in enumerate(accounts)}
+        xfer_row = {t.id: r for r, t in enumerate(transfers)}
+        a_u64, a_bal = _pack_account_rows(accounts)
+        st["accounts"]["u64"][:len(accounts)] = a_u64
+        st["accounts"]["bal"][:len(accounts)] = a_bal
+        st["accounts"]["count"] = np.int32(len(accounts))
+        st["acct_ht"] = jax.tree.map(np.asarray, _chunk_insert(
+            st["acct_ht"],
+            [(a.id, r) for r, a in enumerate(accounts)], N_PAD))
+
+        u64m = _pack_transfer_rows(
+            transfers,
+            lambda o: int(sm.pending_status.get(
+                o.timestamp, TransferPendingStatus.none)),
+            lambda aid, dump: acct_row.get(aid, dump),
+            a_cap_s)
+        st["transfers"]["u64"][:len(transfers)] = u64m
+        st["transfers"]["count"] = np.int32(len(transfers))
+        st["xfer_ht"] = jax.tree.map(np.asarray, _chunk_insert(
+            st["xfer_ht"],
+            [(t.id, r) for r, t in enumerate(transfers)]
+            + [(o, ORPHAN_VAL) for o in orphans], N_PAD))
+
+        ecols = _pack_event_rows(records, acct_row, xfer_row, a_cap_s)
+        st["events"]["u64"][:len(records)] = ecols["u64"]
+        st["events"]["count"] = np.int32(len(records))
+
+        # Scalars hold GLOBAL values on every shard (the mini-state and
+        # the write-back adopt/replicate them each step).
+        st["acct_key_max"] = np.uint64(sm.accounts_key_max or 0)
+        st["xfer_key_max"] = np.uint64(sm.transfers_key_max or 0)
+        st["pulse_next"] = np.uint64(sm.pulse_next_timestamp)
+        st["commit_ts"] = np.uint64(sm.commit_timestamp)
+        subs.append(st)
+
+    stacked = jax.tree.map(
+        lambda *xs: np.stack([np.asarray(x) for x in xs]), *subs)
+    return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
+
+
+def partitioned_state_bytes(stacked) -> int:
+    """Per-device resident state bytes of a stacked partitioned pytree
+    (every leaf's leading dim is the shard axis)."""
+    leaves = jax.tree.leaves(stacked)
+    n = leaves[0].shape[0]
+    total = sum(int(np.prod(x.shape)) * x.dtype.itemsize
+                for x in leaves)
+    return total // n
+
+
+def replicated_state_bytes(a_cap: int, t_cap: int,
+                           e_cap: int | None = None) -> int:
+    """Per-device resident bytes of the REPLICATED route at the same
+    caps (every device holds the whole pytree) — the comparison base
+    for the ~1/n_shards assertion. Shape-only (eval_shape): nothing is
+    allocated."""
+    from ..ops.ledger import init_state
+
+    shapes = jax.eval_shape(lambda: init_state(a_cap, t_cap,
+                                               e_cap=e_cap))
+    return sum(int(np.prod(x.shape)) * x.dtype.itemsize
+               for x in jax.tree.leaves(shapes))
+
+
+class PartitionedRouter:
+    """Host-side tier router over the partitioned steps — the sharded-
+    state sibling of ShardedRouter. Same flag pre-route, same
+    plain -> fixpoint escalation, same per-cause fallback counters,
+    plus the exchange diagnostics (events routed per shard, cross-shard
+    transfer counts, exchange overflows).
+
+    Shard loss differs STRUCTURALLY from the replicated router: no
+    surviving chip holds the lost range, so a single-chip reroute
+    cannot serve. Loss quarantines the router until `resync(oracle)`
+    rebuilds the sharded state from the last verified oracle — the
+    ServingSupervisor recovery path's bounded-replay contract, counted
+    under the `shard_resync` recovery cause."""
+
+    def __init__(self, mesh: Mesh, axis: str = "batch", tracer=None,
+                 a_cap: int = 1 << 12, t_cap: int = 1 << 14,
+                 e_cap: int | None = None):
+        self.mesh = mesh
+        self.axis = axis
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self.a_cap = a_cap
+        self.t_cap = t_cap
+        self.e_cap = e_cap
+        self.n_shards = mesh.shape[axis]
+        self._steps: dict = {}
+        self.batches = 0
+        self.escalations = 0
+        self.host_fallbacks = 0
+        self.fallback_causes: dict = {}
+        self.lost_devices: set = set()
+        self.shard_resyncs = 0
+        self.cross_shard_transfers = 0
+        self.exchange_overflows = 0
+        self.events_owned = np.zeros(self.n_shards, dtype=np.int64)
+
+    # Same flag-derived tier precedence as the replicated router.
+    route = staticmethod(ShardedRouter.route)
+
+    def from_oracle(self, sm):
+        """Build the router's sharded state from a host oracle."""
+        return partitioned_from_oracle(sm, self.mesh, self.axis,
+                                       self.a_cap, self.t_cap,
+                                       self.e_cap)
+
+    def _step(self, mode: str):
+        fn = self._steps.get(mode)
+        if fn is None:
+            fn = self._steps[mode] = make_partitioned_create_transfers(
+                self.mesh, self.axis, mode=mode)
+        return fn
+
+    def drop_device(self, device, oracle=None):
+        """Mark one mesh device lost. The lost range exists NOWHERE
+        else on the mesh (partitioned state), so — unlike
+        ShardedRouter.drop_device — there is no single-chip reroute:
+        the router refuses to serve until resynced. Passing `oracle`
+        runs the resync immediately and returns the rebuilt state."""
+        self.lost_devices.add(device)
+        if oracle is not None:
+            return self.resync(oracle)
+        return None
+
+    def resync(self, oracle):
+        """Bounded oracle-replay resync of the lost range(s): rebuild
+        the sharded state from the last verified oracle through the
+        supervisor recovery path's event taxonomy (`shard_resync`
+        cause). Returns the fresh stacked state."""
+        with self.tracer.span(Event.serving_recovery_replay,
+                              cause="shard_resync"):
+            state = self.from_oracle(oracle)
+        self.tracer.count(Event.serving_recoveries,
+                          cause="shard_resync")
+        self.shard_resyncs += 1
+        self.lost_devices.clear()
+        return state
+
+    def restore_devices(self) -> None:
+        """The mesh healed WITHOUT state loss (transient link flap):
+        nothing to rebuild."""
+        self.lost_devices.clear()
+
+    def step(self, state, ev: dict, timestamp: int, n: int):
+        """Run one padded batch. Returns (new_state, out, fell_back).
+        On fell_back=True the state is untouched (masked writes on
+        every shard) and the caller owns the exact-path replay."""
+        if self.lost_devices:
+            raise RuntimeError(
+                "partitioned shard lost: resync(oracle) required — the "
+                "single-chip reroute cannot serve a lost range")
+        self.batches += 1
+        mode = self.route(ev)
+        self.tracer.count(Event.dispatch_route,
+                          route="partitioned_" + mode)
+        with self.tracer.span(Event.shard_exchange, mode=mode):
+            new_state, out = self._step(mode)(
+                state, ev, np.uint64(timestamp), np.int32(n))
+            fallback, limit_only = (bool(x) for x in jax.device_get(
+                (out["fallback"], out["limit_only"])))
+            if fallback and limit_only and mode == "plain":
+                self.escalations += 1
+                new_state, out = self._step("fixpoint")(
+                    new_state, ev, np.uint64(timestamp), np.int32(n))
+                fallback = bool(jax.device_get(out["fallback"]))
+        xs, ov, owned = jax.device_get(
+            (out["cross_shard_transfers"], out["exchange_overflow"],
+             out["shard_stats"]["events_owned"]))
+        if int(xs):
+            self.cross_shard_transfers += int(xs)
+            self.tracer.count(Event.cross_shard_transfers,
+                              value=int(xs))
+        self.exchange_overflows += int(bool(ov))
+        self.events_owned += np.asarray(owned, dtype=np.int64)
+        if fallback:
+            self.host_fallbacks += 1
+            for k, v in jax.device_get(out["fb_causes"]).items():
+                if bool(v):
+                    self.fallback_causes[k] = (
+                        self.fallback_causes.get(k, 0) + 1)
+                    self.tracer.count(Event.router_fallback, cause=k)
+        return new_state, out, fallback
+
+    def stats(self) -> dict:
+        total = int(self.events_owned.sum())
+        return {
+            "batches": self.batches,
+            "escalations": self.escalations,
+            "host_fallbacks": self.host_fallbacks,
+            "causes": dict(self.fallback_causes),
+            "lost_devices": len(self.lost_devices),
+            "shard_resyncs": self.shard_resyncs,
+            "cross_shard_transfers": self.cross_shard_transfers,
+            "exchange_overflows": self.exchange_overflows,
+            "events_owned": [int(x) for x in self.events_owned],
+            "cross_shard_fraction": (
+                self.cross_shard_transfers / total if total else 0.0),
+        }

@@ -1,0 +1,65 @@
+"""Shared SPMD plumbing for the sharded modules.
+
+Two things live here because BOTH parallel/full_sharded.py (replicated
+state, sharded per-event stage) and parallel/partitioned.py (sharded
+state, exchange-assembled per-event stage) need them and must agree:
+
+  - `get_shard_map()`: the jax.shard_map / jax.experimental.shard_map
+    import fallback, previously duplicated per module;
+  - `shard_of_id()`: the ownership function — which mesh shard owns a
+    128-bit object id. The device kernels, the host packers
+    (partitioned_from_oracle), and the oracle-side digest pack
+    (state_epoch.pack_oracle_state_partitioned) all route through this
+    ONE definition, so device and host can never disagree about
+    ownership (the partitioned digest comparison depends on it).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# The same splitmix64-style constants the two-choice hash table uses
+# (ops/hash_table.py) — a different finalization order, so shard
+# assignment and bucket choice stay decorrelated.
+_C1 = 0x9E3779B97F4A7C15
+_C2 = 0xBF58476D1CE4E5B9
+_C3 = 0x94D049BB133111EB
+_M64 = (1 << 64) - 1
+
+
+def get_shard_map():
+    """Resolve shard_map across jax versions (>=0.5 exports it from the
+    top-level namespace; older jax keeps it under experimental)."""
+    try:
+        from jax import shard_map
+    except ImportError:  # pre-0.5 jax
+        from jax.experimental.shard_map import shard_map
+    return shard_map
+
+
+def shard_of_id(k_hi, k_lo, n_shards: int):
+    """Owning shard of a 128-bit id (account, transfer, or orphan key).
+
+    Pure function of the id: a splitmix-style 64-bit mix of the two
+    limbs, masked to `n_shards` (power of two — mesh sizes are). Works
+    on jnp arrays (traced, wrapping uint64), numpy arrays, and — via
+    `shard_of_int` — python ints, producing identical assignments.
+    """
+    assert n_shards & (n_shards - 1) == 0, n_shards
+    u64 = np.uint64
+    h = (k_lo ^ (k_hi * u64(_C1))) * u64(_C2)
+    h = (h ^ (h >> u64(31))) * u64(_C3)
+    h = h ^ (h >> u64(29))
+    return (h & u64(n_shards - 1)).astype(np.int32)
+
+
+def shard_of_int(id128: int, n_shards: int) -> int:
+    """Host-side shard_of_id over a python 128-bit int (oracle
+    partitioning / digest packs). Bit-identical to the array form."""
+    assert n_shards & (n_shards - 1) == 0, n_shards
+    k_hi = (id128 >> 64) & _M64
+    k_lo = id128 & _M64
+    h = ((k_lo ^ (k_hi * _C1 & _M64)) * _C2) & _M64
+    h = ((h ^ (h >> 31)) * _C3) & _M64
+    h = h ^ (h >> 29)
+    return h & (n_shards - 1)

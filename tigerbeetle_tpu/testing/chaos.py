@@ -31,6 +31,11 @@ kernel):
                     must hold and the storm must be a counted event.
   shard_loss        (mesh scenario) drop a mesh device; ShardedRouter
                     re-routes to the single-chip step bit-exactly.
+  shard_resync      (mesh scenario) drop a mesh device under the
+                    PARTITIONED router: the lost account range exists
+                    nowhere else, so the router must refuse to serve
+                    until a bounded oracle-replay resync rebuilds the
+                    sharded state (`shard_resync` recovery cause).
 """
 
 from __future__ import annotations
@@ -300,6 +305,7 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
             kinds, epoch_interval, tracer)
         if mesh_scenario:
             summary["shard_loss"] = shard_loss_scenario(seed)
+            summary["shard_resync"] = shard_resync_scenario(seed)
     finally:
         constants.set_verify(was_verify)
         if was_rate is None:
@@ -460,6 +466,94 @@ def shard_loss_scenario(seed: int, mesh=None) -> dict:
     assert reroutes == 2, reroutes  # exactly the degraded steps
     return dict(devices=int(mesh.size), dropped=str(dropped),
                 reroutes=reroutes)
+
+
+# --------------------------------------------- partitioned resync scenario
+
+_PART_ROUTER = None
+
+
+def shard_resync_scenario(seed: int, mesh=None) -> dict:
+    """Drop a mesh device under the PARTITIONED router (sharded state):
+    the single-chip reroute is structurally unavailable — the lost
+    shard's account range exists nowhere else — so the router must (a)
+    refuse to serve while a shard is lost, and (b) recover to bit-exact
+    oracle parity through resync(oracle), counted under the
+    `shard_resync` recovery cause. The router and its compiled steps
+    are cached across seeds."""
+    global _PART_ROUTER
+    import jax
+    from jax.sharding import Mesh
+
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import pad_transfer_events
+    from ..parallel.partitioned import PartitionedRouter
+
+    rng = random.Random(seed ^ 0xCAFE)
+    if mesh is not None:
+        router = PartitionedRouter(mesh, a_cap=1 << 9, t_cap=1 << 11)
+    else:
+        if _PART_ROUTER is None:
+            _PART_ROUTER = PartitionedRouter(
+                Mesh(np.array(jax.devices()), ("batch",)),
+                a_cap=1 << 9, t_cap=1 << 11)
+        router = _PART_ROUTER
+    mesh = router.mesh
+    router.restore_devices()
+    resyncs0 = router.shard_resyncs
+    fallbacks0 = router.host_fallbacks
+
+    n_accounts = 12
+    accounts = [Account(id=i, ledger=1, code=1)
+                for i in range(1, n_accounts + 1)]
+    oracle = StateMachineOracle()
+    oracle.create_accounts(accounts, 1_000)
+    state = router.from_oracle(oracle)
+
+    ts = 10 ** 9
+    next_id = 10_000
+    dropped = None
+    for step_i in range(4):
+        events = []
+        for _ in range(24):
+            dr = rng.randrange(1, n_accounts + 1)
+            cr = dr % n_accounts + 1
+            events.append(Transfer(
+                id=next_id, debit_account_id=dr, credit_account_id=cr,
+                amount=rng.randrange(1, 100), ledger=1, code=1))
+            next_id += 1
+        n = len(events)
+        ts += n + 10
+        evp = pad_transfer_events(transfers_to_arrays(events), 1024)
+        if step_i == 1:
+            dropped = mesh.devices.flat[rng.randrange(mesh.size)]
+            router.drop_device(dropped)
+            # A lost range is NOT servable: the quarantine must be loud.
+            try:
+                router.step(state, evp, ts, n)
+            except RuntimeError:
+                pass
+            else:
+                raise AssertionError(
+                    f"chaos seed {seed}: partitioned router served "
+                    "with a lost shard")
+            state = router.resync(oracle)
+        state, out, fell = router.step(state, evp, ts, n)
+        assert not fell, \
+            f"chaos seed {seed}: unexpected partitioned fallback"
+        got = [(int(t), int(s)) for s, t in zip(
+            np.asarray(out["r_status"][:n]).tolist(),
+            np.asarray(out["r_ts"][:n]).tolist())]
+        want = [(r.timestamp, int(r.status))
+                for r in oracle.create_transfers(events, ts)]
+        assert got == want, \
+            (f"chaos seed {seed}: partitioned step {step_i} diverged "
+             f"after resync (dropped={dropped})")
+    resyncs = router.shard_resyncs - resyncs0
+    assert resyncs == 1, resyncs
+    assert router.host_fallbacks == fallbacks0, "resync run fell back"
+    return dict(devices=int(mesh.size), dropped=str(dropped),
+                resyncs=resyncs)
 
 
 # ------------------------------------------------------------- CI gate

@@ -337,6 +337,63 @@ def _scenario_router(col: _Collector) -> None:
     assert fell and router.host_fallbacks == 1, router.stats()
 
 
+def _scenario_partitioned(col: _Collector) -> None:
+    """PartitionedRouter on whatever mesh exists: a cross-shard step
+    (shard_exchange span + cross_shard_transfers counter + the
+    partitioned_* dispatch route), then a shard loss -> resync through
+    the shard_resync recovery cause."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..oracle import StateMachineOracle
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import pad_transfer_events
+    from ..parallel.partitioned import PartitionedRouter
+    from ..parallel.shard_utils import shard_of_int
+    from ..types import Account, Transfer
+
+    tracer = col.make(0)
+    mesh = Mesh(np.array(jax.devices()), ("batch",))
+    n_dev = int(mesh.size)
+    router = PartitionedRouter(mesh, tracer=tracer,
+                               a_cap=1 << 9, t_cap=1 << 11)
+    oracle = StateMachineOracle()
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 17)]
+    oracle.create_accounts(accts, 1_000)
+    state = router.from_oracle(oracle)
+    # A debit/credit pair on different shards, so the cross-shard
+    # counter is guaranteed to fire (any pair when n_dev == 1).
+    dr, cr = 1, 2
+    for a in range(2, 17):
+        if shard_of_int(a, n_dev) != shard_of_int(1, n_dev):
+            cr = a
+            break
+
+    def batch(evs, ts):
+        n = len(evs)
+        evp = pad_transfer_events(transfers_to_arrays(evs), 1024)
+        return router.step(state, evp, ts, n)
+
+    ts = 10**9
+    state, _, fell = batch([Transfer(
+        id=10, debit_account_id=dr, credit_account_id=cr, amount=1,
+        ledger=1, code=1)], ts)
+    assert not fell
+    oracle.create_transfers([Transfer(
+        id=10, debit_account_id=dr, credit_account_id=cr, amount=1,
+        ledger=1, code=1)], ts)
+    if n_dev > 1:
+        assert router.cross_shard_transfers >= 1, router.stats()
+    router.drop_device(mesh.devices.flat[0])
+    state = router.resync(oracle)
+    assert router.shard_resyncs == 1
+    state, _, fell = batch([Transfer(
+        id=11, debit_account_id=cr, credit_account_id=dr, amount=1,
+        ledger=1, code=1)], ts + 200)
+    assert not fell
+
+
 def _scenario_slo(col: _Collector) -> None:
     """The SLO engine against the COMMITTED perf/slo.json: objectives
     must load (every referenced event on-catalog — a dead SLO is a red
@@ -378,6 +435,7 @@ SCENARIOS = (
     _scenario_chaos,
     _scenario_commit_windows,
     _scenario_router,
+    _scenario_partitioned,
     _scenario_slo,
 )
 

@@ -150,6 +150,12 @@ class Event(enum.Enum):
                                "cause")
     router_reroute = _counter(
         "batches rerouted to the single-chip step under shard loss")
+    shard_exchange = _span(
+        "partitioned-state batch step: on-device event exchange + "
+        "per-shard fixpoint + owner-masked write-back", "mode")
+    cross_shard_transfers = _counter(
+        "created transfers whose debit and credit accounts live on "
+        "different shards (resolved via the exchange join)")
 
     # ------------------------------------------------------ tracer internal
     trace_dropped_events = _counter(
