@@ -325,10 +325,13 @@ def trace_overhead_probe(quick: bool) -> dict:
 
 
 def shard_balance_probe(quick: bool) -> dict:
-    """Partitioned-route balance diagnostics: a mixed uniform window
-    through the PartitionedRouter on whatever mesh exists — events
-    routed per shard, cross-shard fraction, exchange overflow count,
-    per-device resident bytes. The ##shard line of the run record
+    """Partitioned-route balance diagnostics: mixed uniform commit
+    windows through PartitionedRouter.step_window on whatever mesh
+    exists — the FUSED chain dispatch (one shard_map+scan per window,
+    the serving default) — reporting events routed per shard,
+    cross-shard fraction, exchange overflow count, per-device resident
+    bytes, the windows-by-route counters, and the warm per-window
+    dispatch latency percentiles. The ##shard line of the run record
     (devhub "shard balance" panel)."""
     import jax
     import numpy as np
@@ -336,7 +339,6 @@ def shard_balance_probe(quick: bool) -> dict:
 
     from tigerbeetle_tpu.oracle import StateMachineOracle
     from tigerbeetle_tpu.ops.batch import transfers_to_arrays
-    from tigerbeetle_tpu.ops.ledger import pad_transfer_events
     from tigerbeetle_tpu.parallel.partitioned import (
         PartitionedRouter,
         partitioned_state_bytes,
@@ -352,26 +354,60 @@ def shard_balance_probe(quick: bool) -> dict:
     state = router.from_oracle(oracle)
     rng = np.random.default_rng(11)
     ts, tid = 2 * 10 ** 9, 1
-    for _ in range(2 if quick else 4):
-        evs = []
-        for _ in range(256):
-            dr, cr = (int(x) for x in
-                      rng.choice(np.arange(1, 33), 2, replace=False))
-            evs.append(Transfer(id=tid, debit_account_id=dr,
-                                credit_account_id=cr, amount=1,
-                                ledger=1, code=1))
-            tid += 1
-        ev = pad_transfer_events(transfers_to_arrays(evs), 1024)
-        state, _, fell = router.step(state, ev, ts, len(evs))
-        assert not fell, router.stats()
-        ts += 10 ** 6
+    n_windows = 2 if quick else 4
+    lat_ms = []
+    for wi in range(n_windows):
+        window, tss = [], []
+        for _ in range(2):  # W=2 prepares per fused dispatch
+            evs = []
+            for _ in range(256):
+                dr, cr = (int(x) for x in
+                          rng.choice(np.arange(1, 33), 2,
+                                     replace=False))
+                evs.append(Transfer(id=tid, debit_account_id=dr,
+                                    credit_account_id=cr, amount=1,
+                                    ledger=1, code=1))
+                tid += 1
+            window.append(transfers_to_arrays(evs))
+            tss.append(ts)
+            ts += 10 ** 6
+        t0 = time.perf_counter()
+        state, results = router.step_window(state, window, tss, 1024)
+        if wi > 0:  # window 0 pays the one-time compile; not latency
+            lat_ms.append((time.perf_counter() - t0) * 1000.0)
+        assert len(results) == len(window)
+        assert router.host_fallbacks == 0, router.stats()
     s = router.stats()
+    lat_ms.sort()
+
+    def _pct(p):
+        return round(lat_ms[min(len(lat_ms) - 1,
+                                int(p * len(lat_ms)))], 3)
+    try:
+        # Route record for the ##diag/dispatch_routes panel: the probe
+        # is the run's partitioned leg, so its windows-by-route counters
+        # (partitioned_chain = the fused default) ride the same record
+        # as the per-config chain routes.
+        from tigerbeetle_tpu.benchmark import CONFIG_ROUTES
+        CONFIG_ROUTES["shard_probe"] = dict(s["routes"])
+    except Exception:
+        pass
     return {
         "n_shards": router.n_shards,
+        # Per-WINDOW wall latency of the fused dispatch (one
+        # shard_map+scan per W=2 window; warm — window 0 carries the
+        # one-time compile and is excluded).
+        "window_latency": {
+            "p50_ms": _pct(0.50), "p99_ms": _pct(0.99),
+            "p100_ms": round(lat_ms[-1], 3),
+            "windows_timed": len(lat_ms),
+            "events_per_window": 512,
+        },
         "events_per_shard": s["events_owned"],
         "cross_shard_transfers": s["cross_shard_transfers"],
         "cross_shard_fraction": s["cross_shard_fraction"],
         "exchange_overflows": s["exchange_overflows"],
+        "routes": s["routes"],
         "state_bytes_per_device": partitioned_state_bytes(state),
         "state_bytes_replicated_equiv": replicated_state_bytes(
             router.a_cap * router.n_shards,
@@ -490,13 +526,6 @@ def inner_main() -> None:
     if recovery:
         emit("recovery_diagnostics", recovery)
 
-    # Dispatch-route record: which kernel route each config's windows
-    # took ("chain" = the scan-form whole-window dispatch, the default
-    # serving route) + the window depths used — a silent route
-    # degradation is as visible as a throughput regression.
-    if CONFIG_ROUTES:
-        emit("dispatch_routes", dict(CONFIG_ROUTES))
-
     # Op-budget summary (light tier subset, pure tracing — no device
     # execution): the per-run record of the kernels' heavy-op footprint
     # on its own ##opbudget line; devhub renders it next to the
@@ -523,6 +552,16 @@ def inner_main() -> None:
     except Exception as e:  # never let the probe kill a bench run
         shard = {"error": str(e)[:200]}
     print("##shard " + json.dumps({"shard_balance": shard}), flush=True)
+
+    # Dispatch-route record: which kernel route each config's windows
+    # took ("chain" = the scan-form whole-window dispatch, the default
+    # serving route; "partitioned_chain" = the fused sharded-state
+    # window route the shard probe takes) + the window depths used — a
+    # silent route degradation is as visible as a throughput
+    # regression. Emitted after the shard probe so its partitioned
+    # route counters ride the same record.
+    if CONFIG_ROUTES:
+        emit("dispatch_routes", dict(CONFIG_ROUTES))
 
     opbudget = None
     try:
@@ -758,7 +797,7 @@ def main() -> None:
                    "config3_chains_tps", "config4_twophase_limits_tps",
                    "config5_oracle_parity", "config6_serving_tps",
                    "serving_batch_latency", "fallback_diagnostics",
-                   "dispatch_routes")
+                   "dispatch_routes", "shard_balance")
     if banked is not None:
         # Self-consistent record: value, per-config numbers AND the
         # platform tag all come from the banked on-chip artifact (a

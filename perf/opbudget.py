@@ -9,7 +9,7 @@ un-regressable:
     scatter / segment_sum / scan — tigerbeetle_tpu.jaxhound.heavy_census)
     plus the operand bytes those ops read, for every create_transfers
     kernel tier INCLUDING the SPMD lowerings (8-device CPU mesh).
-  - budgets: perf/opbudget_r08.json commits a per-tier budget. A kernel
+  - budgets: perf/opbudget_r09.json commits a per-tier budget. A kernel
     change that raises any tier's heavy-op count or operand bytes past
     its budget fails `--check` (wired into scripts/gate.py) — raising a
     budget is an explicit, reviewed edit of the JSON (see
@@ -22,7 +22,13 @@ un-regressable:
     exchange): cross-device collectives are a counted class
     ('collective'), so the budget pins the exchange's op count, and
     the lints additionally reject any collective moving a whole-state
-    operand (jaxhound.state_gathers).
+    operand (jaxhound.state_gathers). Round 9 fuses the two: the
+    PARTITIONED CHAIN tiers census the whole-window scan dispatch over
+    sharded state (partitioned_chain_w{2,8,32} — whole-program, flat
+    in W) and its per-iteration body (partitioned_chain_body, via
+    scan_body_census — pinned == the per-batch partitioned_plain tier,
+    collectives INSIDE the scan body included, with their ICI byte
+    mass broken out as collective_operand_bytes).
   - lints: `--lint` runs the jaxhound static checks over the serving-
     path jit entries: no closure constant > 4 KiB (the measured
     ~64 ms/call tunnel intercept), no while/fori loop in any serving
@@ -63,7 +69,7 @@ import numpy as np  # noqa: E402
 from tigerbeetle_tpu import jaxhound  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r08.json")
+BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r09.json")
 
 STACK = 4
 N_SUPER = 1024
@@ -108,6 +114,14 @@ def _chain_fixture(depth):
 
     evs, tss = _mk_prepares(depth)
     return stack_chain_window(evs, tss, N_SUPER)
+
+
+def _partitioned_chain_fixture(depth):
+    from tigerbeetle_tpu.parallel.partitioned import (
+        stack_partitioned_window)
+
+    evs, tss = _mk_prepares(depth)
+    return stack_partitioned_window(evs, tss, N_SUPER)
 
 
 def _partitioned_fixture(mesh, axis="batch"):
@@ -226,6 +240,26 @@ def census_tiers(include_sharded: bool = True,
                     lambda st, e: pstep.__wrapped__(
                         st, e, jnp.uint64(1000), jnp.int32(1)))(pstate, ev)
             out[f"partitioned_{mode}"] = jaxhound.heavy_census(cj)
+        # Partitioned CHAIN (the fused default window route): the
+        # whole-program census must be flat across depths (the scan
+        # body — exchange collectives included — lowers ONCE), and the
+        # per-iteration BODY census is pinned == the per-batch
+        # partitioned_plain tier: the window amortizes dispatch, it
+        # must not add op mass per prepare.
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_chain_create_transfers)
+
+        cstep = make_partitioned_chain_create_transfers(mesh, mode="plain")
+        for w in CHAIN_DEPTHS:
+            ev_p, ts_p, n_p = _partitioned_chain_fixture(w)
+            with mesh:
+                cj = jax.make_jaxpr(
+                    lambda st, e, t, nn: cstep.__wrapped__(
+                        st, e, t, nn, None))(pstate, ev_p, ts_p, n_p)
+            out[f"partitioned_chain_w{w}"] = jaxhound.heavy_census(cj)
+            if w == 8:
+                out["partitioned_chain_body"] = \
+                    jaxhound.scan_body_census(cj)
     return out
 
 
@@ -305,6 +339,17 @@ def serving_entries() -> dict:
                 entries[f"partitioned_{mode}_step"] = (
                     pstep.lower(pstate, ev, np.uint64(1000), np.int32(1)),
                     n_leaves, 0)
+        # Partitioned chain step: one deliberate scan (max_while=1),
+        # donated sharded state carry.
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_chain_create_transfers)
+
+        cstep = make_partitioned_chain_create_transfers(mesh, mode="plain")
+        ev_p, ts_p, n_p = _partitioned_chain_fixture(4)
+        with mesh:
+            entries["partitioned_chain_step"] = (
+                cstep.lower(pstate, ev_p, ts_p, n_p, None),
+                n_leaves, 1)
     return entries
 
 
@@ -378,6 +423,28 @@ def run_lints() -> list[str]:
                 fails.append(
                     f"partitioned_{mode}_step: closure constant {label} "
                     f"= {size} B > {jaxhound.CLOSURE_CONST_LIMIT} B")
+        # The fused chain runs the exchange INSIDE its scan body;
+        # state_gathers recurses into scan bodies, so a whole-state
+        # collective can't hide behind the scan either.
+        from tigerbeetle_tpu.parallel.partitioned import (
+            make_partitioned_chain_create_transfers)
+
+        cstep = make_partitioned_chain_create_transfers(mesh, mode="plain")
+        ev_p, ts_p, n_p = _partitioned_chain_fixture(4)
+        with mesh:
+            cj = jax.make_jaxpr(
+                lambda st, e, t, nn: cstep.__wrapped__(
+                    st, e, t, nn, None))(pstate, ev_p, ts_p, n_p)
+        for prim, nbytes in jaxhound.state_gathers(cj):
+            fails.append(
+                f"partitioned_chain_step: {prim} moves {nbytes} B "
+                f"per device (> {jaxhound.STATE_GATHER_LIMIT} B — "
+                "the scanned exchange regressed into a whole-state "
+                "gather)")
+        for label, size in jaxhound.closure_constants(cj):
+            fails.append(
+                f"partitioned_chain_step: closure constant {label} "
+                f"= {size} B > {jaxhound.CLOSURE_CONST_LIMIT} B")
     return fails
 
 

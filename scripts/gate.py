@@ -28,7 +28,13 @@ default whole-window scan dispatch through the real
 submit_window/resolve_windows route — chain taken by default,
 per-prepare fallback parity vs the sync path and the oracle, zero
 host fallbacks on plain windows, committed chain budgets present;
-skip with --no-chain), the TRACE-CATALOG coverage leg
+skip with --no-chain), the PARTITIONED-CHAIN leg
+(testing/partitioned_chain_smoke.py + parallel/multihost.py: the fused
+sharded-state window route — one shard_map+scan dispatch per window —
+differential vs the per-batch ladder and the oracle on an 8-device
+virtual mesh, then the 2-process jax.distributed local leg, skipped
+gracefully where multi-process init is unavailable; skip with
+--no-partitioned-chain), the TRACE-CATALOG coverage leg
 (testing/trace_coverage.py: the smokes re-run under recording tracers;
 red when any event in tigerbeetle_tpu/trace/event.py is never emitted
 or an off-catalog name is emitted, or an emitted span/histogram event
@@ -44,8 +50,9 @@ trajectory; skip with --no-bench-regression), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
-(perf/opbudget_r07.json — incl. the chain route's whole-program and
-scan-BODY censuses), bakes a >4 KiB closure constant into a serving
+(perf/opbudget_r09.json — incl. the chain and partitioned-chain
+routes' whole-program and scan-BODY censuses), bakes a >4 KiB closure
+constant into a serving
 entry, drops state-buffer donation, or introduces a while loop beyond
 an entry's allowance into a serving lowering is a RED. See
 ARCHITECTURE.md "Op-budget workflow" for reading a failure /
@@ -196,6 +203,44 @@ def run_chain(timeout: int = 600) -> int:
     return rc
 
 
+def run_partitioned_chain(timeout: int = 900) -> int:
+    """Partitioned-chain leg: quick differential of the FUSED
+    partitioned window route (ONE shard_map+scan dispatch per window
+    over account-range-sharded state) on an 8-device virtual CPU mesh —
+    chain taken by default, per-prepare limit-cascade fallback with
+    on-device escalation, parity vs the per-batch ladder and the
+    oracle, digest equality, zero host fallbacks, committed r09 fused
+    budgets present (testing/partitioned_chain_smoke.py) — then the
+    2-process ``jax.distributed`` local leg (parallel/multihost.py):
+    the same route over a coordinator-connected 2-process global mesh,
+    skipped gracefully where the multi-process runtime is unavailable.
+    Skip with --no-partitioned-chain."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import partitioned_chain_smoke"
+           " as s; s.partitioned_chain_smoke(); "
+           "from tigerbeetle_tpu.parallel import multihost; "
+           "print('[gate] multihost 2-process: '"
+           " + multihost.two_process_smoke())"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] partitioned-chain: fused sharded window route "
+          "differential + 2-process multihost leg", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: partitioned-chain timed out after "
+              f"{timeout}s", flush=True)
+        return 124
+    print(f"[gate] partitioned-chain rc={rc} in "
+          f"{time.time() - t0:.0f}s", flush=True)
+    return rc
+
+
 def run_trace_coverage(timeout: int = 900) -> int:
     """Trace-catalog coverage leg: the vopr/chaos/rebuild-style smokes
     (plus deterministic scenarios for rare events) run under recording
@@ -313,6 +358,10 @@ def main() -> int:
     ap.add_argument("--no-chain", action="store_true",
                     help="skip the chain-route leg (whole-window scan "
                          "dispatch differential)")
+    ap.add_argument("--no-partitioned-chain", action="store_true",
+                    help="skip the partitioned-chain leg (fused "
+                         "sharded window route differential + "
+                         "2-process multihost leg)")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the metrics leg (SLO catalog check + "
                          "/metrics exposition smoke)")
@@ -344,6 +393,10 @@ def main() -> int:
         rc = run_chain()
         if rc != 0:
             reds.append(f"chain rc={rc}")
+    if not args.no_partitioned_chain:
+        rc = run_partitioned_chain()
+        if rc != 0:
+            reds.append(f"partitioned-chain rc={rc}")
     if not args.no_trace_cov:
         rc = run_trace_coverage()
         if rc != 0:

@@ -21,9 +21,10 @@ from tigerbeetle_tpu import jaxhound
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 # r07: historical pin for the round-7 reduction-campaign assertions.
-# r08: the LIVE budget file perf/opbudget.py --check enforces.
+# r09: the LIVE budget file perf/opbudget.py --check enforces (r08's
+# tiers carried forward + the fused partitioned_chain tiers).
 BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r07.json")
-BUDGET_PATH_R08 = os.path.join(REPO, "perf", "opbudget_r08.json")
+BUDGET_PATH_LIVE = os.path.join(REPO, "perf", "opbudget_r09.json")
 
 
 # ------------------------------------------------------------- census
@@ -138,6 +139,59 @@ def test_heavy_census_counts_collectives_inside_shard_map():
     assert jaxhound.state_gathers(cj, limit=1 << 20) == []
 
 
+def test_scan_body_census_counts_collectives_and_bytes():
+    """The fused partitioned-chain route runs its psum exchange INSIDE
+    the scan body: the body census must count the collective class and
+    carry its operand-byte mass (collective_operand_bytes), and
+    state_gathers must still flag an oversized collective through the
+    scan — collectives in scan bodies must not escape either check."""
+    from jax.sharding import Mesh
+    from jax.sharding import PartitionSpec as P
+
+    from tigerbeetle_tpu.parallel.shard_utils import get_shard_map
+
+    shard_map = get_shard_map()
+    mesh = Mesh(np.array(jax.devices()[:1]), ("x",))
+
+    def body(a, xs):
+        def step(c, x):
+            return c + jax.lax.psum(x, "x"), ()
+        c, _ = jax.lax.scan(step, a, xs)
+        return c
+
+    try:
+        f = shard_map(body, mesh=mesh, in_specs=(P("x"), P()),
+                      out_specs=P("x"), check_vma=False)
+    except TypeError:
+        f = shard_map(body, mesh=mesh, in_specs=(P("x"), P()),
+                      out_specs=P("x"), check_rep=False)
+    cj = jax.make_jaxpr(f)(jnp.zeros((8,), jnp.float32),
+                           jnp.zeros((4, 8), jnp.float32))
+    whole = jaxhound.heavy_census(cj)
+    assert whole["heavy"]["collective"] >= 1
+    assert whole["collective_operand_bytes"] > 0
+    bodyc = jaxhound.scan_body_census(cj)
+    assert bodyc["heavy"]["collective"] >= 1
+    assert bodyc["collective_operand_bytes"] > 0
+    # The collective's bytes are a subset of the body's heavy bytes.
+    assert (bodyc["collective_operand_bytes"]
+            <= bodyc["heavy_operand_bytes"])
+    hits = jaxhound.state_gathers(cj, limit=8)
+    assert hits and any("psum" in name for name, _ in hits)
+    # A collective-free scan censuses zero collective bytes.
+    def plain(x):
+        def step(c, xi):
+            return c + jnp.sort(xi), ()
+        c, _ = jax.lax.scan(step, x, jnp.zeros((4, 8), jnp.float32))
+        return c
+
+    clean = jaxhound.scan_body_census(
+        jax.make_jaxpr(plain)(jnp.zeros((8,), jnp.float32)))
+    assert clean["heavy"]["collective"] == 0
+    assert clean["collective_operand_bytes"] == 0
+    assert clean["heavy"]["sort"] == 1
+
+
 # ----------------------------------------------------------- lints
 
 def test_while_detector_sees_searchsorted_scan_method():
@@ -247,13 +301,15 @@ def test_packed_layout_accounts_flags_isolated_from_code():
 # ------------------------------------------------- committed budgets
 
 def test_budget_file_covers_core_tiers():
-    with open(BUDGET_PATH_R08) as f:
+    with open(BUDGET_PATH_LIVE) as f:
         d = json.load(f)
     for tier in ("per_event_plain", "plain", "fixpoint_8",
                  "balancing_8", "imported", "super_plain_s4",
                  "super_deep24_s4", "sharded_plain", "sharded_fixpoint",
                  "chain_w2", "chain_w8", "chain_w32", "chain_body_w8",
-                 "partitioned_plain", "partitioned_fixpoint"):
+                 "partitioned_plain", "partitioned_fixpoint",
+                 "partitioned_chain_w2", "partitioned_chain_w8",
+                 "partitioned_chain_w32", "partitioned_chain_body"):
         assert tier in d["budget"], tier
         b = d["budget"][tier]
         assert b["heavy_total"] == sum(b["heavy"].values())
@@ -266,8 +322,28 @@ def test_budget_file_covers_core_tiers():
     # The partitioned tiers' exchange is budget-pinned: a bounded,
     # NONZERO collective count (two psum exchange rounds + the merged
     # bad-flag reduction), never a whole-state gather (run_lints).
-    for tier in ("partitioned_plain", "partitioned_fixpoint"):
+    for tier in ("partitioned_plain", "partitioned_fixpoint",
+                 "partitioned_chain_body"):
         assert 0 < d["budget"][tier]["heavy"]["collective"] <= 8, tier
+
+
+def test_partitioned_chain_budget_is_amortized_x1():
+    """Acceptance pin for the fused route: the scan-BODY op count
+    equals the per-batch partitioned tier (the window amortizes
+    dispatch, it adds no per-prepare op mass), the whole-program census
+    is flat in W (body + the one outer scan op at every committed
+    depth), and the exchange's ICI byte mass is pinned nonzero inside
+    the scan body (collective_operand_bytes in the post census)."""
+    with open(BUDGET_PATH_LIVE) as f:
+        d = json.load(f)
+    b = d["budget"]
+    body = b["partitioned_chain_body"]["heavy_total"]
+    assert body == b["partitioned_plain"]["heavy_total"]
+    for w in (2, 8, 32):
+        assert b[f"partitioned_chain_w{w}"]["heavy_total"] == body + 1, w
+    post = d["post"]["partitioned_chain_body"]
+    assert post["heavy"]["collective"] >= 1
+    assert post["collective_operand_bytes"] > 0
 
 
 def test_campaign_hit_the_15pct_reduction():

@@ -26,7 +26,7 @@ from tigerbeetle_tpu.oracle import StateMachineOracle
 from tigerbeetle_tpu.ops.batch import transfers_to_arrays
 from tigerbeetle_tpu.ops.ev_layout import EV_P32_POS, XF_NCOLS, XF_P32_POS
 from tigerbeetle_tpu.ops.ledger import (
-    DeviceLedger, _delta_gather_body, pad_transfer_events)
+    DeviceLedger, _delta_gather_body, _pad_bucket, pad_transfer_events)
 from tigerbeetle_tpu.ops.state_epoch import (
     partitioned_oracle_digest, partitioned_state_digest)
 from tigerbeetle_tpu.parallel.partitioned import (
@@ -276,6 +276,171 @@ class TestPartitioned:
         pb = partitioned_state_bytes(h.state)
         rb = replicated_state_bytes(A_CAP, T_CAP)
         assert pb <= rb // n_dev + rb // 50, (pb, rb, n_dev)
+
+
+_CHAIN_STEPS: dict = {}
+
+
+@pytest.mark.parametrize("n_dev", MESH_SIZES)
+class TestPartitionedChain:
+    """The fused default window route: ONE shard_map+lax.scan dispatch
+    per eligible commit window, differential vs the oracle AND vs the
+    per-batch partitioned ladder — including a poisoned window whose
+    clean prefix must stay committed inside the dispatch while the
+    fallen-back prepare replays per-batch, with host_fallbacks==0."""
+
+    def _fresh(self, n_dev, accounts):
+        mesh = _mesh(n_dev)
+        oracle = StateMachineOracle()
+        oracle.create_accounts(accounts, 50)
+        router = PartitionedRouter(mesh, a_cap=A_CAP, t_cap=T_CAP)
+        router._steps = _STEPS.setdefault(n_dev, {})
+        router._chain_steps = _CHAIN_STEPS.setdefault(n_dev, {})
+        return oracle, router, router.from_oracle(oracle)
+
+    def _window(self, oracle, router, state, evs_list, tss):
+        """step_window + per-prepare oracle parity on every result."""
+        state, results = router.step_window(
+            state, [transfers_to_arrays(e) for e in evs_list], tss)
+        assert len(results) == len(evs_list)
+        for evs, t, (st, rts) in zip(evs_list, tss, results):
+            want = oracle.create_transfers(evs, t)
+            exp = [(r.timestamp, int(r.status)) for r in want]
+            got = [(int(rts[i]), int(st[i])) for i in range(len(evs))]
+            assert got == exp, (got[:5], exp[:5])
+        return state
+
+    def test_two_phase_straddling_prepares_one_dispatch(self, n_dev):
+        """Cross-shard two-phase pairs whose pending lands in an
+        EARLIER prepare than its post/void, all inside one scanned
+        window: the in-dispatch carry must expose prepare b's writes to
+        prepare b+1 on every shard, exactly like W separate
+        dispatches."""
+        rng = np.random.default_rng(23)
+        accts = [Account(id=i, ledger=1, code=1) for i in range(1, 41)]
+        oracle, router, state = self._fresh(n_dev, accts)
+        nid, ts = 10 ** 6, 10 ** 9
+        pendings = []
+        w, tss = [], []
+        for b in range(4):
+            evs = []
+            for dr, cr in _cross_shard_pairs(n_dev, 12, rng):
+                if b < 2 or not pendings:
+                    evs.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=cr,
+                        amount=int(rng.integers(1, 30)), ledger=1,
+                        code=1, flags=PEND))
+                    pendings.append(nid)
+                else:
+                    pid = pendings.pop(0)
+                    f = POST if rng.random() < 0.5 else VOID
+                    evs.append(Transfer(
+                        id=nid, pending_id=pid,
+                        amount=AMOUNT_MAX if f == POST else 0, flags=f))
+                nid += 1
+            ts += 300
+            w.append(evs)
+            tss.append(ts)
+        state = self._window(oracle, router, state, w, tss)
+        # The whole clean window took ONE fused dispatch.
+        assert router.window_routes == {"partitioned_chain": 1}
+        assert router.chain_batch_fallbacks == {}
+        assert router.host_fallbacks == 0
+        if n_dev > 1:
+            assert router.cross_shard_transfers > 0
+        dd = partitioned_state_digest(state)
+        assert dd == partitioned_oracle_digest(oracle, A_CAP, n_dev)
+
+    def test_poisoned_window_parity_vs_per_batch(self, n_dev):
+        """A limit-cascade prepare (e3 headroom proof) poisons the
+        chain mid-window: the prefix stays committed, prepare k replays
+        per-batch (plain -> fixpoint escalation ON DEVICE), the suffix
+        re-windows — and the final state is bit-identical to running
+        the whole workload through the per-batch ladder, and to the
+        oracle, with zero host fallbacks on both routes."""
+        rng = np.random.default_rng(29)
+        accts = [Account(id=i, ledger=1, code=1,
+                         flags=DR_LIMIT if i <= 4 else 0)
+                 for i in range(1, 41)]
+        oracle, router, state = self._fresh(n_dev, accts)
+        oracle_b, router_b, state_b = self._fresh(n_dev, accts)
+        nid, ts = 10 ** 6, 10 ** 9
+        windows = []
+        for wi in range(2):
+            w, tss = [], []
+            for b in range(3):
+                evs = [Transfer(id=nid + i, debit_account_id=dr,
+                                credit_account_id=cr,
+                                amount=int(rng.integers(1, 30)),
+                                ledger=1, code=1)
+                       for i, (dr, cr) in enumerate(
+                           _cross_shard_pairs(n_dev, 8, rng))]
+                nid += 8
+                if wi == 0 and b == 1:
+                    # Debit off a DR_LIMIT account beyond its funded
+                    # credits: the plain tier's headroom proof falls
+                    # back limit_only, poisoning the chain at k=1.
+                    evs.append(Transfer(
+                        id=nid, debit_account_id=1,
+                        credit_account_id=9, amount=10 ** 6,
+                        ledger=1, code=1))
+                    nid += 1
+                ts += 300
+                w.append(evs)
+                tss.append(ts)
+            windows.append((w, tss))
+        for w, tss in windows:
+            state = self._window(oracle, router, state, w, tss)
+            arrays = [transfers_to_arrays(e) for e in w]
+            n_pad = _pad_bucket(max(len(e) for e in w))
+            state_b, res_b = router_b._window_per_batch(
+                state_b, arrays, tss, n_pad)
+            for evs, t, (st, rts) in zip(w, tss, res_b):
+                want = oracle_b.create_transfers(evs, t)
+                got = [(int(rts[i]), int(st[i]))
+                       for i in range(len(evs))]
+                assert got == [(r.timestamp, int(r.status))
+                               for r in want]
+        assert router.host_fallbacks == 0
+        assert router_b.host_fallbacks == 0
+        # The poison was absorbed per-prepare, not per-window: the
+        # chain route still carried the clean windows and the replayed
+        # suffix, and the e3 cause landed in the chain counters.
+        assert router.window_routes.get("partitioned_chain", 0) >= 2
+        assert router.chain_batch_fallbacks.get("e3_limit", 0) >= 1
+        assert router.escalations >= 1
+        dd = partitioned_state_digest(state)
+        assert dd == partitioned_state_digest(state_b)
+        assert dd == partitioned_oracle_digest(oracle, A_CAP, n_dev)
+
+    def test_flagged_window_preroutes_per_batch(self, n_dev):
+        """Windows carrying flags the plain chain body cannot serve
+        (balancing) pre-route to the per-batch ladder — route counters
+        must say so, and parity still holds."""
+        accts = [Account(id=i, ledger=1, code=1,
+                         flags=DR_LIMIT if i <= 2 else 0)
+                 for i in range(1, 41)]
+        oracle, router, state = self._fresh(n_dev, accts)
+        ts = 10 ** 9
+        # Fund account 1, then a balancing debit window.
+        w = [[Transfer(id=100, debit_account_id=10,
+                       credit_account_id=1, amount=50, ledger=1,
+                       code=1)],
+             [Transfer(id=101, debit_account_id=1,
+                       credit_account_id=11, amount=AMOUNT_MAX,
+                       ledger=1, code=1, flags=BAL_DR),
+              Transfer(id=102, debit_account_id=12,
+                       credit_account_id=13, amount=3, ledger=1,
+                       code=1)]]
+        tss = [ts + 300, ts + 600]
+        state = self._window(oracle, router, state, w[:1], tss[:1])
+        state = self._window(oracle, router, state, w[1:], tss[1:])
+        assert router.window_routes.get("partitioned_per_batch") == 2
+        assert "partitioned_chain" not in router.window_routes
+        assert router.host_fallbacks == 0
+        dd = partitioned_state_digest(state)
+        assert dd == partitioned_oracle_digest(oracle, A_CAP, n_dev)
 
 
 class TestShardLoss:

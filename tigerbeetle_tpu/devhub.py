@@ -265,10 +265,12 @@ def render(history_path: str, out_path: str,
               "<th>recoveries by cause</th></tr>"
             + "".join(rows_rec) + "</table>")
     # Dispatch-route panel: which kernel route each config's windows
-    # took ("chain" = the default scan-form whole-window dispatch) and
-    # the per-cause prepares that fell out of chain windows — a shift
-    # away from chain on a plain workload is a routing regression,
-    # rendered next to the fallback diagnostics it would show up in.
+    # took ("chain" = the default scan-form whole-window dispatch;
+    # "partitioned_chain" / "partitioned_per_batch" = the sharded-state
+    # routes, fused scan vs per-prepare) and the per-cause prepares
+    # that fell out of chain windows — a shift away from a chain route
+    # on a plain workload is a routing regression, rendered next to
+    # the fallback diagnostics it would show up in.
     route_html = ""
     routes = next((e.get("dispatch_routes") for e in reversed(entries)
                    if isinstance(e.get("dispatch_routes"), dict)
@@ -309,7 +311,7 @@ def render(history_path: str, out_path: str,
             + "".join(rows_rt) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
-    # ceilings (perf/opbudget_r08.json) — compile-footprint regressions
+    # ceilings (perf/opbudget_r09.json) — compile-footprint regressions
     # are rendered as loudly as throughput ones.
     ob_html = ""
     ob = next((e.get("opbudget") for e in reversed(entries)
@@ -319,7 +321,7 @@ def render(history_path: str, out_path: str,
         budgets = {}
         try:
             bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "..", "perf", "opbudget_r08.json")
+                                 "..", "perf", "opbudget_r09.json")
             with open(bpath) as f:
                 budgets = json.load(f).get("budget", {})
         except (OSError, ValueError):

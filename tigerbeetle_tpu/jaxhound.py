@@ -92,22 +92,36 @@ def heavy_census(closed_jaxpr) -> dict:
     op instance in the unrolled program — a scan body counts once, like
     the dispatch layer sees it) and sums the operand bytes those ops
     read (the bytes-dependent term of the tunnel's per-op cost).
-    Deterministic: no XLA compile, trace-level only."""
+    Deterministic: no XLA compile, trace-level only.
+
+    The collective class is ALSO broken out by operand bytes
+    (`collective_operand_bytes`): collectives bill ICI traffic, not
+    HBM reads, so the partitioned budgets pin their byte mass
+    separately — including inside lax.scan bodies, where the fused
+    partitioned-chain route runs the whole exchange (scan_body_census
+    inherits the key; one iteration's exchange bytes, amortized x1 in
+    the program like every other body op)."""
     counts = collections.Counter({c: 0 for c in HEAVY_CLASS_ORDER})
     nbytes = [0]
+    coll_bytes = [0]
 
     def visit(eqn):
         cls = HEAVY_CLASSES.get(eqn.primitive.name)
         if cls is None:
             return
         counts[cls] += 1
+        b = 0
         for v in eqn.invars:
-            nbytes[0] += _aval_bytes(getattr(v, "aval", None))
+            b += _aval_bytes(getattr(v, "aval", None))
+        nbytes[0] += b
+        if cls == "collective":
+            coll_bytes[0] += b
 
     _walk_jaxpr(closed_jaxpr.jaxpr, visit)
     out = {"heavy": {c: counts[c] for c in HEAVY_CLASS_ORDER}}
     out["heavy_total"] = sum(out["heavy"].values())
     out["heavy_operand_bytes"] = nbytes[0]
+    out["collective_operand_bytes"] = coll_bytes[0]
     return out
 
 
@@ -134,8 +148,14 @@ def scan_body_census(closed_jaxpr) -> dict:
     whole-window scan dispatch executes this body once per window
     iteration (body ops x 1 in the program, x W at runtime), so the
     op-budget gate pins the BODY census alongside the whole-program one
-    (which counts the body once plus the outer scan op). Returns a
-    zero census when the program holds no scan."""
+    (which counts the body once plus the outer scan op). The census
+    covers every heavy class INCLUDING collectives (the fused
+    partitioned chain runs the psum exchange inside its scan body) and
+    carries their operand-byte mass as collective_operand_bytes —
+    state_gathers() recurses into scan bodies with the same classing,
+    so a whole-state collective inside a scan cannot hide from the
+    lint either. Returns a zero census when the program holds no
+    scan."""
     best = None
     for b in scan_bodies(closed_jaxpr):
         c = heavy_census(b)
@@ -143,7 +163,8 @@ def scan_body_census(closed_jaxpr) -> dict:
             best = c
     if best is None:
         best = {"heavy": {c: 0 for c in HEAVY_CLASS_ORDER},
-                "heavy_total": 0, "heavy_operand_bytes": 0}
+                "heavy_total": 0, "heavy_operand_bytes": 0,
+                "collective_operand_bytes": 0}
     return best
 
 

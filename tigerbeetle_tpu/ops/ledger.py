@@ -986,6 +986,12 @@ class DeviceLedger:
         # Pipelined commit windows in flight (submit_window), resolved in
         # order by resolve_windows().
         self._tickets: list = []
+        # Partitioned-mesh attach (attach_partitioned): when set, commit
+        # windows dispatch through the PartitionedRouter's fused
+        # shard_map+scan route against the sharded state instead of the
+        # single-chip pytree.
+        self._part_router = None
+        self._part_state = None
         # Device transfer-row count INCLUDING queued chunks (len(_xfer_row)
         # lags it until the next drain).
         self._xfer_rows_dev = 0
@@ -1114,6 +1120,8 @@ class DeviceLedger:
                                    create_transfers_super_deep_jit,
                                    create_transfers_super_deep_ring_jit)
 
+        if self._part_router is not None:
+            return self._submit_window_partitioned(evs, timestamps)
         ns = [len(e["id_lo"]) for e in evs]
         if not (len(evs) > 1 and not self._mirror_route()):
             return None
@@ -1209,6 +1217,79 @@ class DeviceLedger:
         self._tickets.append(ticket)
         return ticket
 
+    def attach_partitioned(self, router, state) -> None:
+        """Serve commit windows from the partitioned mesh: every window
+        submitted through submit_window (and every synchronous/redo
+        window inside resolve_windows) dispatches through `router`
+        (parallel/partitioned.PartitionedRouter) against the sharded
+        `state` pytree — the fused shard_map+scan chain route by
+        default, the per-batch ladder for flagged windows and replays.
+
+        Attach-mode contract: the partitioned state IS the ledger
+        (read it back via `partitioned_state`); the single-chip pytree
+        stays at its attach-time snapshot and per-batch entry points
+        (create_transfers) keep addressing it. Write-through capture is
+        single-chip scope, so attaching a mirrored ledger is refused."""
+        assert not self._wt, "attach_partitioned: write-through is " \
+            "single-chip scope"
+        assert not self._tickets, "attach_partitioned: windows in flight"
+        self._part_router = router
+        self._part_state = state
+
+    @property
+    def partitioned_state(self):
+        """The sharded state pytree commits land on in attach mode."""
+        return self._part_state
+
+    def _submit_window_partitioned(self, evs, timestamps):
+        """submit_window in attach mode: the fused partitioned chain —
+        ONE shard_map+lax.scan dispatch for the whole window, zero host
+        synchronization, the previous in-flight window's poison scalar
+        chained as force_fallback (identical pipelining contract to the
+        single-chip chain route). Windows the plain chain body cannot
+        serve (depth 1, imported, or any flag-routed prepare) return
+        None; in attach mode the caller's synchronous path lands on
+        _partitioned_window_sync, which runs the per-batch partitioned
+        ladder."""
+        r = self._part_router
+        if (len(evs) < 2 or _has_imported(evs)
+                or any(r.route(e) != "plain" for e in evs)):
+            return None
+        ns = [len(e["id_lo"]) for e in evs]
+        n_pad = _pad_bucket(max(ns))
+        prev_fb = self._tickets[-1].poison if self._tickets else None
+        new_state, out = r.chain_dispatch(
+            evs=evs, timestamps=timestamps, n_pad=n_pad,
+            state=self._part_state, force_fallback=prev_fb)
+        self._part_state = new_state
+        # The router counts the window (stats()["routes"], merged into
+        # fallback_stats); the ledger records the latency class.
+        self.last_window_route = "partitioned_chain"
+        self.last_window_tier = "scan"
+        ticket = WindowTicket(evs, timestamps, ns, n_pad, out, None,
+                              (0, 0), False, False,
+                              route="partitioned_chain",
+                              poison=out["fallback"][-1])
+        self._tickets.append(ticket)
+        return ticket
+
+    def _partitioned_window_sync(self, evs, tss):
+        """The synchronous window path in attach mode (sync commits and
+        resolve-time redo replays): PartitionedRouter.step_window —
+        fused chain when eligible, else the per-batch ladder with
+        on-device tier escalation. Returns the per-prepare
+        (status, ts) results like create_transfers_window."""
+        r = self._part_router
+        self._part_state, results = r.step_window(
+            self._part_state, evs, tss)
+        self.last_window_route = ("partitioned_chain"
+                                  if len(evs) >= 2 and all(
+                                      r.route(e) == "plain" for e in evs)
+                                  else "partitioned_per_batch")
+        self.last_window_tier = ("scan" if self.last_window_route
+                                 == "partitioned_chain" else "fallback")
+        return results
+
     def resolve_windows(self, count: int | None = None) -> None:
         """Resolve in-flight pipelined windows in submission order —
         all of them, or just the oldest `count` (the pipelined driver
@@ -1235,16 +1316,20 @@ class DeviceLedger:
         else:
             tickets = self._tickets[:count]
             del self._tickets[:count]
+        # Attach mode replays through the partitioned ladder (the
+        # single-chip pytree is not the ledger there).
+        win = (self._partitioned_window_sync
+               if self._part_router is not None
+               else self.create_transfers_window)
         redo = False
         i = 0
         while i < len(tickets):
             tk = tickets[i]
             i += 1
             if redo:
-                tk.results = ("redo", self.create_transfers_window(
-                    tk.evs, tk.tss))
+                tk.results = ("redo", win(tk.evs, tk.tss))
                 continue
-            if tk.route == "chain":
+            if tk.route in ("chain", "partitioned_chain"):
                 k, results = self._resolve_chain_prefix(tk)
                 if k == len(tk.evs):
                     tk.results = ("ok", results)
@@ -1259,8 +1344,7 @@ class DeviceLedger:
                 redo = True
                 tickets.extend(self._tickets)
                 self._tickets = []
-                results.extend(self.create_transfers_window(
-                    tk.evs[k:], tk.tss[k:]))
+                results.extend(win(tk.evs[k:], tk.tss[k:]))
                 tk.results = ("redo", results)
                 continue
             if bool(jax.device_get(tk.out["fallback"])):
@@ -1268,8 +1352,7 @@ class DeviceLedger:
                 self._note_fb(tk.out)
                 tickets.extend(self._tickets)
                 self._tickets = []
-                tk.results = ("redo", self.create_transfers_window(
-                    tk.evs, tk.tss))
+                tk.results = ("redo", win(tk.evs, tk.tss))
                 continue
             n_pad = tk.n_pad
             st_all = np.asarray(tk.out["r_status"])
@@ -1303,6 +1386,10 @@ class DeviceLedger:
         fb = np.asarray(jax.device_get(tk.out["fallback"]))
         W = len(tk.evs)
         k = int(np.argmax(fb)) if fb.any() else W
+        if tk.route == "partitioned_chain":
+            # The router owns the partitioned counters (batches,
+            # events_owned, cross-shard traffic, per-cause prepares).
+            self._part_router.absorb_chain_prefix(tk.out, k, W)
         st_all = np.asarray(tk.out["r_status"])
         ts_all = np.asarray(tk.out["r_ts"])
         results = []
@@ -1321,7 +1408,10 @@ class DeviceLedger:
             self._probe_succeeded()
         if k < W:
             self.window_fallbacks += 1
-            self._note_chain_fb(tk.out, k)
+            if tk.route != "partitioned_chain":
+                # Partitioned causes were absorbed at the router above
+                # (merged back through fallback_stats()["routes"]).
+                self._note_chain_fb(tk.out, k)
         return k, results
 
     def _register_window_capture(self, tk, st_slices) -> None:
@@ -2644,9 +2734,10 @@ class DeviceLedger:
         fallback (per-batch), flat (any unrolled super route)."""
         self.window_routes[route] = self.window_routes.get(route, 0) + 1
         self.last_window_route = route
-        self.last_window_tier = ("scan" if route == "chain" else
-                                 "fallback" if route == "per_batch"
-                                 else "flat")
+        self.last_window_tier = (
+            "scan" if route in ("chain", "partitioned_chain") else
+            "fallback" if route in ("per_batch", "partitioned_per_batch")
+            else "flat")
 
     def _note_chain_fb(self, out, k: int) -> None:
         """Accumulate the chain route's per-prepare fallback causes at
@@ -2675,6 +2766,21 @@ class DeviceLedger:
             if bool(v):
                 self.fallback_causes[k] = self.fallback_causes.get(k, 0) + 1
 
+    def _merged_routes(self) -> dict:
+        """The fallback_stats()["routes"] record: the ledger's own route
+        counters plus — in partitioned attach mode — the router's
+        (partitioned_chain / partitioned_per_batch windows and the
+        per-cause prepares that fell out of a fused window)."""
+        windows = dict(self.window_routes)
+        cbf = dict(self.chain_batch_fallbacks)
+        if self._part_router is not None:
+            rr = self._part_router.stats()["routes"]
+            for k, v in rr["windows"].items():
+                windows[k] = windows.get(k, 0) + v
+            for k, v in rr["chain_batch_fallbacks"].items():
+                cbf[k] = cbf.get(k, 0) + v
+        return {"windows": windows, "chain_batch_fallbacks": cbf}
+
     def fallback_stats(self) -> dict:
         """Host-visible routing/fallback counters (bench diagnostics +
         devhub): 'zero host fallbacks' is a measured invariant."""
@@ -2687,13 +2793,13 @@ class DeviceLedger:
             "escalations": self.escalations,
             "causes": dict(self.fallback_causes),
             # Dispatch-route record: windows per route (chain = the
-            # default scan-form whole-window dispatch) + the per-cause
-            # prepares that fell out of a chain window (per-prepare
-            # fallback granularity — the prefix stayed committed).
-            "routes": {
-                "windows": dict(self.window_routes),
-                "chain_batch_fallbacks": dict(self.chain_batch_fallbacks),
-            },
+            # default scan-form whole-window dispatch; partitioned_chain
+            # = its fused sibling on the partitioned mesh) + the
+            # per-cause prepares that fell out of a chain window
+            # (per-prepare fallback granularity — the prefix stayed
+            # committed). In attach mode the PartitionedRouter owns the
+            # partitioned counters; they merge in here.
+            "routes": self._merged_routes(),
             # Chaos/recovery counters (zeros unless a ServingSupervisor
             # owns this ledger): retries, backoff time, replayed
             # windows, verified checksum epochs, recoveries by cause.

@@ -43,6 +43,23 @@ the whole step:
      capacity, exchange overflow): a failed batch leaves every shard
      bit-identical, preserving the escalation/replay contract.
 
+The five steps above are one prepare's worth of work
+(_partitioned_batch_body). Two dispatch forms share it:
+
+  * PER BATCH (make_partitioned_create_transfers): one shard_map
+    dispatch per prepare — the escalation unit, and the replay path
+    for a window's fallen-back suffix.
+  * CHAIN (make_partitioned_chain_create_transfers, the DEFAULT window
+    route): the W prepares of a commit window run as a `lax.scan`
+    carry over the donated sharded state INSIDE one shard_map
+    dispatch, with a rolling poison scalar in the carry — the
+    single-chip chain kernel's transitive-poison contract
+    (ops/fast_kernels.py _create_transfers_chain), composed with the
+    exchange. Collectives run inside the scan body; jaxhound's
+    scan_body_census budgets them (body ops == the per-batch
+    partitioned tier, whole-program ops flat in W —
+    perf/opbudget_r09.json).
+
 Non-canonical columns: transfer `dr_row`/`cr_row` and the ring's row
 pointers are SHARD-LOCAL (or mini-scope, for ring rows) under the
 partitioned layout. They were already excluded from the state-epoch
@@ -78,12 +95,16 @@ from ..ops.fast_kernels import (
 from ..ops.hash_table import (
     ORPHAN_VAL, ht_init, ht_insert, ht_lookup, ht_plan, ht_write,
 )
-from ..ops.ledger import _delta_gather_body
+from ..ops.ledger import (
+    N_PAD, _delta_gather_body, _pad_bucket, pad_transfer_events,
+)
 from ..trace import Event, NullTracer
 from .full_sharded import MODES, _MODE_KWARGS, ShardedRouter
 from .shard_utils import get_shard_map, shard_of_id, shard_of_int
 
-__all__ = ["make_partitioned_create_transfers", "partitioned_from_oracle",
+__all__ = ["make_partitioned_create_transfers",
+           "make_partitioned_chain_create_transfers",
+           "stack_partitioned_window", "partitioned_from_oracle",
            "partitioned_state_bytes", "PartitionedRouter", "MODES"]
 
 _U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
@@ -118,6 +139,293 @@ def _uniq_rows(k_hi, k_lo, active):
     return first, jnp.where(active, row, jnp.int32(-1)), n_uniq
 
 
+def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
+                            mode, force_fallback=None):
+    """One prepare against the per-shard state `sub` (UNSTACKED
+    leaves): the full exchange -> mini-state -> judge -> write-back
+    anatomy of the module docstring, shared VERBATIM by the per-batch
+    shard_map body and the chain route's lax.scan body (one scan
+    iteration == one per-batch dispatch's ops — the budget identity
+    perf/opbudget_r09.json pins).
+
+    `force_fallback` is the chain's rolling poison scalar: threaded
+    into the judge it aborts the batch unconditionally, the masked
+    write-back leaves every shard bit-identical, and the poison rides
+    out through rep["fallback"] — the single-chip chain kernel's
+    transitive-poison contract. Returns (new_sub, rep, events_owned)
+    where rep is the replicated out dict and events_owned the
+    per-shard routed-event count."""
+    N = ev["id_lo"].shape[0]
+    me = jax.lax.axis_index(axis)
+    idxs = jnp.arange(N, dtype=jnp.int32)
+    ts_full = (timestamp - n.astype(jnp.uint64)
+               + idxs.astype(jnp.uint64) + jnp.uint64(1))
+    acc, xfr, evr = (sub["accounts"], sub["transfers"],
+                     sub["events"])
+    a_dump_l = acc["u64"].shape[0] - 1
+    t_dump_l = xfr["u64"].shape[0] - 1
+    e_cap_l = evr["u64"].shape[0] - 1
+
+    # ---- phase 1: transfer-key probe + exchange (2N lanes:
+    # [ev.id | ev.pid]). Encoding in lane 0 of the exchanged
+    # row: 0 = absent, 1 = orphan (ht_lookup reports stored
+    # ORPHAN_VAL as val=-1), r+2 = live owner-local row r.
+    xk_hi = jnp.concatenate([ev["id_hi"], ev["pid_hi"]])
+    xk_lo = jnp.concatenate([ev["id_lo"], ev["pid_lo"]])
+    xf_l, xv_l = ht_lookup(sub["xfer_ht"], xk_hi, xk_lo)
+    x_live_l = xf_l & (xv_l >= 0)
+    enc_l = jnp.where(
+        xf_l, (xv_l + 2).astype(jnp.uint64), jnp.uint64(0))
+    xrow_l = jnp.where(x_live_l, xv_l, t_dump_l)
+    xdata_l = jnp.where(x_live_l[:, None],
+                        xfr["u64"][xrow_l], jnp.uint64(0))
+    g = jax.lax.psum(
+        jnp.concatenate([enc_l[:, None], xdata_l], axis=1), axis)
+    g_enc, g_rows = g[:, 0], g[:, 1:]
+    x_active = g_enc > 0
+    x_live = g_enc >= 2
+
+    # ---- phase 2: account-key probe + exchange (4N lanes:
+    # [ev.dr | ev.cr | p.dr | p.cr]; the pending rows' account
+    # ids come off the phase-1 exchange). Encoding: 0 = absent,
+    # r+1 = owner-local row r. Zero keys (padded lanes, absent
+    # pendings) hit the hash table's empty sentinel -> absent.
+    p_rows_g = g_rows[N:]
+    ak_hi = jnp.concatenate([
+        ev["dr_hi"], ev["cr_hi"],
+        p_rows_g[:, XF_U64_IDX["dr_hi"]],
+        p_rows_g[:, XF_U64_IDX["cr_hi"]]])
+    ak_lo = jnp.concatenate([
+        ev["dr_lo"], ev["cr_lo"],
+        p_rows_g[:, XF_U64_IDX["dr_lo"]],
+        p_rows_g[:, XF_U64_IDX["cr_lo"]]])
+    af_l, ar_l = ht_lookup(sub["acct_ht"], ak_hi, ak_lo)
+    aenc_l = jnp.where(
+        af_l, (ar_l + 1).astype(jnp.uint64), jnp.uint64(0))
+    arow_g_l = jnp.where(af_l, ar_l, a_dump_l)
+    au_l = jnp.where(af_l[:, None],
+                     acc["u64"][arow_g_l], jnp.uint64(0))
+    ab_l = jnp.where(af_l[:, None],
+                     acc["bal"][arow_g_l], jnp.uint64(0))
+    ga = jax.lax.psum(
+        jnp.concatenate([aenc_l[:, None], au_l, ab_l], axis=1),
+        axis)
+    g_aenc = ga[:, 0]
+    g_au = ga[:, 1:1 + AC_NCOLS]
+    g_ab = ga[:, 1 + AC_NCOLS:]
+    a_active = g_aenc > 0
+
+    # ---- assemble the replicated mini-state (O(batch) caps).
+    MA, MT, ME = 4 * N, 3 * N, N
+    afirst, amrow, n_a = _uniq_rows(ak_hi, ak_lo, a_active)
+    mini_au = jnp.zeros((MA + 1, AC_NCOLS), jnp.uint64).at[
+        jnp.where(afirst, amrow, MA)].set(g_au).at[MA].set(
+        jnp.uint64(0))
+    mini_ab = jnp.zeros((MA + 1, 16), jnp.uint64).at[
+        jnp.where(afirst, amrow, MA)].set(g_ab).at[MA].set(
+        jnp.uint64(0))
+    ht_a, ok_a = ht_insert(
+        ht_init(8 * N), ak_hi, ak_lo, amrow, afirst)
+
+    xfirst, _, _ = _uniq_rows(xk_hi, xk_lo, x_active)
+    lfirst, lrow, n_live = _uniq_rows(xk_hi, xk_lo, x_live)
+    mini_xu = jnp.zeros((MT + 1, XF_NCOLS), jnp.uint64).at[
+        jnp.where(lfirst, lrow, MT)].set(g_rows).at[MT].set(
+        jnp.uint64(0))
+    # Mini-local row pointers: rewrite each exchanged row's
+    # (dr_row, cr_row) word from its OWN id columns through the
+    # mini account table (absent -> mini dump row). Only the
+    # pending rows' pointers are ever dereferenced, and their
+    # dr/cr are in the phase-2 key set by construction.
+    mdr_hi = mini_xu[:, XF_U64_IDX["dr_hi"]]
+    mdr_lo = mini_xu[:, XF_U64_IDX["dr_lo"]]
+    mcr_hi = mini_xu[:, XF_U64_IDX["cr_hi"]]
+    mcr_lo = mini_xu[:, XF_U64_IDX["cr_lo"]]
+    fdr, rdr = ht_lookup(ht_a, mdr_hi, mdr_lo)
+    fcr, rcr = ht_lookup(ht_a, mcr_hi, mcr_lo)
+    has_ids = (mdr_hi | mdr_lo) != 0
+    ptr_word = pack32(jnp.where(fdr, rdr, MA),
+                      jnp.where(fcr, rcr, MA))
+    mini_xu = mini_xu.at[:, _XF_DRROW_COL].set(
+        jnp.where(has_ids, ptr_word,
+                  mini_xu[:, _XF_DRROW_COL]))
+    ht_x, ok_x = ht_insert(
+        ht_init(8 * N), xk_hi, xk_lo,
+        jnp.where(x_live, lrow, jnp.int32(ORPHAN_VAL)), xfirst)
+    xchg_bad = (~ok_a) | (~ok_x) | (n_a > MA) | (n_live > 2 * N)
+
+    # Ring prefill (p_row=-1 / tflags=0xFFFFFFFF) built ON
+    # DEVICE by column sets — never as a host closure constant.
+    mini_ev = jnp.zeros((ME + 1, EV_NCOLS), jnp.uint64)
+    mini_ev = mini_ev.at[:, _EV_PROW_COL].set(
+        jnp.uint64(0xFFFFFFFF) << jnp.uint64(32))
+    mini_ev = mini_ev.at[:, _EV_TFLAGS_COL].set(
+        jnp.uint64(0xFFFFFFFF))
+
+    mini = dict(
+        accounts=dict(u64=mini_au, bal=mini_ab, count=n_a),
+        transfers=dict(u64=mini_xu, count=n_live),
+        events=dict(u64=mini_ev, count=jnp.int32(0)),
+        acct_ht=ht_a,
+        xfer_ht=ht_x,
+        # Scalars are stored per shard but hold GLOBAL values.
+        acct_key_max=sub["acct_key_max"],
+        xfer_key_max=sub["xfer_key_max"],
+        pulse_next=sub["pulse_next"],
+        commit_ts=sub["commit_ts"],
+    )
+
+    # ---- judge: the unmodified single-chip kernel on the
+    # mini-state, replicated. The imported tier's account-ts
+    # collision is the only batch-context piece that needs the
+    # FULL table: each shard probes its sorted local column and
+    # the memberships OR-combine over the mesh.
+    ictx = None
+    if mode == "imported":
+        ctx_l = imported_batch_ctx(sub, ev, ts_full,
+                                   ev["valid"], idxs)
+        ictx = dict(ctx_l)
+        ictx["acct_ts_collision"] = jax.lax.psum(
+            ctx_l["acct_ts_collision"].astype(jnp.int32),
+            axis) > 0
+    pe = per_event_status(mini, ev, ts_full, imported_ctx=ictx)
+    mini_t0 = n_live
+    kw = dict(_MODE_KWARGS[mode])
+    if force_fallback is not None:
+        kw["force_fallback"] = force_fallback
+    new_mini, out = create_transfers_fast(
+        mini, ev, timestamp, n, per_event=pe, **kw)
+
+    # ---- per-shard write-back plan + combined ok.
+    status = out["r_status"]
+    created = ev["valid"] & (status == _CREATED)
+    transient = jnp.zeros_like(created)
+    for code in _TRANSIENT_CODES:
+        transient = transient | (status == code)
+    orphan_new = ev["valid"] & transient
+    ins_mask = created | orphan_new
+    owner_ev = shard_of_id(ev["id_hi"], ev["id_lo"], n_dev)
+    mine = created & (owner_ev == me)
+    ins_mine = ins_mask & (owner_ev == me)
+    n_mine = jnp.sum(mine.astype(jnp.int32))
+    local_rank = _cumsum(mine.astype(jnp.int32)) - mine
+    pos, ok_pl = ht_plan(sub["xfer_ht"], ev["id_hi"],
+                         ev["id_lo"], ins_mine)
+    bad_l = ((xfr["count"] + n_mine > t_dump_l)
+             | (evr["count"] + n_mine > e_cap_l)
+             | ~ok_pl)
+    bad = jax.lax.psum(bad_l.astype(jnp.int32), axis) > 0
+    g_ok = (~out["fallback"]) & (~bad) & (~xchg_bad)
+
+    # ---- write-back (every write masked by g_ok; the dump
+    # rows absorb masked lanes, exactly the kernel's idiom).
+    row_off = _cumsum(created.astype(jnp.int32)) - created
+    mini_trow = jnp.clip(mini_t0 + row_off, 0, MT)
+    dest_t = jnp.where(mine & g_ok,
+                       xfr["count"] + local_rank, t_dump_l)
+    new_rows = new_mini["transfers"]["u64"][mini_trow]
+    # Stored row pointers become SHARD-LOCAL: resolve the new
+    # row's dr/cr against the local table (remote -> dump).
+    fdr2, rdr2 = ht_lookup(sub["acct_ht"],
+                           ev["dr_hi"], ev["dr_lo"])
+    fcr2, rcr2 = ht_lookup(sub["acct_ht"],
+                           ev["cr_hi"], ev["cr_lo"])
+    new_rows = new_rows.at[:, _XF_DRROW_COL].set(
+        pack32(jnp.where(fdr2, rdr2, a_dump_l),
+               jnp.where(fcr2, rcr2, a_dump_l)))
+    xu_new = xfr["u64"].at[dest_t].set(new_rows)
+    # Pending-status flips on existing owned rows: the pstat
+    # word is alone in its column, so the flip cannot clobber a
+    # neighbor. Unchanged rows rewrite their own value.
+    owner_xk = shard_of_id(xk_hi, xk_lo, n_dev)
+    flip = lfirst & (owner_xk == me)
+    dest_p = jnp.where(flip & g_ok,
+                       (g_enc - jnp.uint64(2)).astype(jnp.int32),
+                       t_dump_l)
+    pword = new_mini["transfers"]["u64"][
+        jnp.where(x_live, lrow, MT), _XF_PSTAT_COL]
+    xu_new = xu_new.at[dest_p, _XF_PSTAT_COL].set(pword)
+
+    owner_ak = shard_of_id(ak_hi, ak_lo, n_dev)
+    wb_a = afirst & (owner_ak == me)
+    dest_a = jnp.where(wb_a & g_ok,
+                       (g_aenc - jnp.uint64(1)).astype(jnp.int32),
+                       a_dump_l)
+    amrow_c = jnp.where(afirst, amrow, MA)
+    au_new = acc["u64"].at[dest_a].set(
+        new_mini["accounts"]["u64"][amrow_c])
+    ab_new = acc["bal"].at[dest_a].set(
+        new_mini["accounts"]["bal"][amrow_c])
+
+    dest_e = jnp.where(mine & g_ok,
+                       evr["count"] + local_rank, e_cap_l)
+    ring_rows = new_mini["events"]["u64"][
+        jnp.clip(row_off, 0, ME)]
+    eu_new = evr["u64"].at[dest_e].set(ring_rows)
+
+    vals = jnp.where(created, xfr["count"] + local_rank,
+                     jnp.int32(ORPHAN_VAL))
+    ht_new = ht_write(sub["xfer_ht"], pos, ev["id_hi"],
+                      ev["id_lo"], vals, ins_mine & g_ok)
+
+    # int32 pinned: jnp.sum promotes to int64 under x64, and the scan
+    # carry requires the counts' dtype to be a fixpoint.
+    n_mine_ok = jnp.where(g_ok, n_mine, 0).astype(jnp.int32)
+
+    def adopt(new_v, old_v):
+        return jnp.where(g_ok, new_v, old_v)
+
+    new_sub = dict(
+        accounts=dict(u64=au_new, bal=ab_new,
+                      count=acc["count"]),
+        transfers=dict(u64=xu_new,
+                       count=xfr["count"] + n_mine_ok),
+        events=dict(u64=eu_new,
+                    count=evr["count"] + n_mine_ok),
+        acct_ht=sub["acct_ht"],
+        xfer_ht=ht_new,
+        acct_key_max=adopt(new_mini["acct_key_max"],
+                           sub["acct_key_max"]),
+        xfer_key_max=adopt(new_mini["xfer_key_max"],
+                           sub["xfer_key_max"]),
+        pulse_next=adopt(new_mini["pulse_next"],
+                         sub["pulse_next"]),
+        commit_ts=adopt(new_mini["commit_ts"],
+                        sub["commit_ts"]),
+    )
+
+    # ---- amended out dict: the shard/exchange breaches are
+    # host fallbacks (state untouched), never escalations.
+    xb = bad | xchg_bad
+    rep = dict(out)
+    rep["r_status"] = jnp.where(xb, jnp.zeros_like(status),
+                                status)
+    rep["r_ts"] = jnp.where(xb, jnp.zeros_like(out["r_ts"]),
+                            out["r_ts"])
+    rep["fallback"] = out["fallback"] | xb
+    rep["limit_only"] = out["limit_only"] & ~xb
+    rep["created_count"] = jnp.where(xb, 0,
+                                     out["created_count"])
+    fbc = dict(out["fb_causes"])
+    fbc["shard_capacity"] = bad
+    fbc["exchange_overflow"] = xchg_bad
+    rep["fb_causes"] = fbc
+    # Durable flush rides the mini: the appended rows' slice
+    # plus the id/p_ts derivations, all mini-resolved (the
+    # canonical columns are bit-exact vs the single-chip
+    # gather; row-pointer columns are non-canonical scope).
+    rep["flush"] = _delta_gather_body(new_mini, mini_t0, 0,
+                                      N, N)
+    owner_dr = shard_of_id(ev["dr_hi"], ev["dr_lo"], n_dev)
+    owner_cr = shard_of_id(ev["cr_hi"], ev["cr_lo"], n_dev)
+    rep["cross_shard_transfers"] = jnp.sum(
+        (created & (owner_dr != owner_cr)).astype(jnp.int32))
+    rep["exchange_overflow"] = xchg_bad
+    owned = jnp.sum(
+        (ev["valid"] & (owner_ev == me)).astype(jnp.int32))
+    return new_sub, rep, owned
+
+
 def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
                                       mode: str = "plain"):
     """Build the jitted partitioned-state SPMD step over `mesh` for one
@@ -135,275 +443,12 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
     n_dev = mesh.shape[axis]
 
     def step(state, ev, timestamp, n):
-        N = ev["id_lo"].shape[0]
-
         def body(stacked, ev):
             sub = jax.tree.map(lambda x: x[0], stacked)
-            me = jax.lax.axis_index(axis)
-            idxs = jnp.arange(N, dtype=jnp.int32)
-            ts_full = (timestamp - n.astype(jnp.uint64)
-                       + idxs.astype(jnp.uint64) + jnp.uint64(1))
-            acc, xfr, evr = (sub["accounts"], sub["transfers"],
-                             sub["events"])
-            a_dump_l = acc["u64"].shape[0] - 1
-            t_dump_l = xfr["u64"].shape[0] - 1
-            e_cap_l = evr["u64"].shape[0] - 1
-
-            # ---- phase 1: transfer-key probe + exchange (2N lanes:
-            # [ev.id | ev.pid]). Encoding in lane 0 of the exchanged
-            # row: 0 = absent, 1 = orphan (ht_lookup reports stored
-            # ORPHAN_VAL as val=-1), r+2 = live owner-local row r.
-            xk_hi = jnp.concatenate([ev["id_hi"], ev["pid_hi"]])
-            xk_lo = jnp.concatenate([ev["id_lo"], ev["pid_lo"]])
-            xf_l, xv_l = ht_lookup(sub["xfer_ht"], xk_hi, xk_lo)
-            x_live_l = xf_l & (xv_l >= 0)
-            enc_l = jnp.where(
-                xf_l, (xv_l + 2).astype(jnp.uint64), jnp.uint64(0))
-            xrow_l = jnp.where(x_live_l, xv_l, t_dump_l)
-            xdata_l = jnp.where(x_live_l[:, None],
-                                xfr["u64"][xrow_l], jnp.uint64(0))
-            g = jax.lax.psum(
-                jnp.concatenate([enc_l[:, None], xdata_l], axis=1), axis)
-            g_enc, g_rows = g[:, 0], g[:, 1:]
-            x_active = g_enc > 0
-            x_live = g_enc >= 2
-
-            # ---- phase 2: account-key probe + exchange (4N lanes:
-            # [ev.dr | ev.cr | p.dr | p.cr]; the pending rows' account
-            # ids come off the phase-1 exchange). Encoding: 0 = absent,
-            # r+1 = owner-local row r. Zero keys (padded lanes, absent
-            # pendings) hit the hash table's empty sentinel -> absent.
-            p_rows_g = g_rows[N:]
-            ak_hi = jnp.concatenate([
-                ev["dr_hi"], ev["cr_hi"],
-                p_rows_g[:, XF_U64_IDX["dr_hi"]],
-                p_rows_g[:, XF_U64_IDX["cr_hi"]]])
-            ak_lo = jnp.concatenate([
-                ev["dr_lo"], ev["cr_lo"],
-                p_rows_g[:, XF_U64_IDX["dr_lo"]],
-                p_rows_g[:, XF_U64_IDX["cr_lo"]]])
-            af_l, ar_l = ht_lookup(sub["acct_ht"], ak_hi, ak_lo)
-            aenc_l = jnp.where(
-                af_l, (ar_l + 1).astype(jnp.uint64), jnp.uint64(0))
-            arow_g_l = jnp.where(af_l, ar_l, a_dump_l)
-            au_l = jnp.where(af_l[:, None],
-                             acc["u64"][arow_g_l], jnp.uint64(0))
-            ab_l = jnp.where(af_l[:, None],
-                             acc["bal"][arow_g_l], jnp.uint64(0))
-            ga = jax.lax.psum(
-                jnp.concatenate([aenc_l[:, None], au_l, ab_l], axis=1),
-                axis)
-            g_aenc = ga[:, 0]
-            g_au = ga[:, 1:1 + AC_NCOLS]
-            g_ab = ga[:, 1 + AC_NCOLS:]
-            a_active = g_aenc > 0
-
-            # ---- assemble the replicated mini-state (O(batch) caps).
-            MA, MT, ME = 4 * N, 3 * N, N
-            afirst, amrow, n_a = _uniq_rows(ak_hi, ak_lo, a_active)
-            mini_au = jnp.zeros((MA + 1, AC_NCOLS), jnp.uint64).at[
-                jnp.where(afirst, amrow, MA)].set(g_au).at[MA].set(
-                jnp.uint64(0))
-            mini_ab = jnp.zeros((MA + 1, 16), jnp.uint64).at[
-                jnp.where(afirst, amrow, MA)].set(g_ab).at[MA].set(
-                jnp.uint64(0))
-            ht_a, ok_a = ht_insert(
-                ht_init(8 * N), ak_hi, ak_lo, amrow, afirst)
-
-            xfirst, _, _ = _uniq_rows(xk_hi, xk_lo, x_active)
-            lfirst, lrow, n_live = _uniq_rows(xk_hi, xk_lo, x_live)
-            mini_xu = jnp.zeros((MT + 1, XF_NCOLS), jnp.uint64).at[
-                jnp.where(lfirst, lrow, MT)].set(g_rows).at[MT].set(
-                jnp.uint64(0))
-            # Mini-local row pointers: rewrite each exchanged row's
-            # (dr_row, cr_row) word from its OWN id columns through the
-            # mini account table (absent -> mini dump row). Only the
-            # pending rows' pointers are ever dereferenced, and their
-            # dr/cr are in the phase-2 key set by construction.
-            mdr_hi = mini_xu[:, XF_U64_IDX["dr_hi"]]
-            mdr_lo = mini_xu[:, XF_U64_IDX["dr_lo"]]
-            mcr_hi = mini_xu[:, XF_U64_IDX["cr_hi"]]
-            mcr_lo = mini_xu[:, XF_U64_IDX["cr_lo"]]
-            fdr, rdr = ht_lookup(ht_a, mdr_hi, mdr_lo)
-            fcr, rcr = ht_lookup(ht_a, mcr_hi, mcr_lo)
-            has_ids = (mdr_hi | mdr_lo) != 0
-            ptr_word = pack32(jnp.where(fdr, rdr, MA),
-                              jnp.where(fcr, rcr, MA))
-            mini_xu = mini_xu.at[:, _XF_DRROW_COL].set(
-                jnp.where(has_ids, ptr_word,
-                          mini_xu[:, _XF_DRROW_COL]))
-            ht_x, ok_x = ht_insert(
-                ht_init(8 * N), xk_hi, xk_lo,
-                jnp.where(x_live, lrow, jnp.int32(ORPHAN_VAL)), xfirst)
-            xchg_bad = (~ok_a) | (~ok_x) | (n_a > MA) | (n_live > 2 * N)
-
-            # Ring prefill (p_row=-1 / tflags=0xFFFFFFFF) built ON
-            # DEVICE by column sets — never as a host closure constant.
-            mini_ev = jnp.zeros((ME + 1, EV_NCOLS), jnp.uint64)
-            mini_ev = mini_ev.at[:, _EV_PROW_COL].set(
-                jnp.uint64(0xFFFFFFFF) << jnp.uint64(32))
-            mini_ev = mini_ev.at[:, _EV_TFLAGS_COL].set(
-                jnp.uint64(0xFFFFFFFF))
-
-            mini = dict(
-                accounts=dict(u64=mini_au, bal=mini_ab, count=n_a),
-                transfers=dict(u64=mini_xu, count=n_live),
-                events=dict(u64=mini_ev, count=jnp.int32(0)),
-                acct_ht=ht_a,
-                xfer_ht=ht_x,
-                # Scalars are stored per shard but hold GLOBAL values.
-                acct_key_max=sub["acct_key_max"],
-                xfer_key_max=sub["xfer_key_max"],
-                pulse_next=sub["pulse_next"],
-                commit_ts=sub["commit_ts"],
-            )
-
-            # ---- judge: the unmodified single-chip kernel on the
-            # mini-state, replicated. The imported tier's account-ts
-            # collision is the only batch-context piece that needs the
-            # FULL table: each shard probes its sorted local column and
-            # the memberships OR-combine over the mesh.
-            ictx = None
-            if mode == "imported":
-                ctx_l = imported_batch_ctx(sub, ev, ts_full,
-                                           ev["valid"], idxs)
-                ictx = dict(ctx_l)
-                ictx["acct_ts_collision"] = jax.lax.psum(
-                    ctx_l["acct_ts_collision"].astype(jnp.int32),
-                    axis) > 0
-            pe = per_event_status(mini, ev, ts_full, imported_ctx=ictx)
-            mini_t0 = n_live
-            new_mini, out = create_transfers_fast(
-                mini, ev, timestamp, n, per_event=pe,
-                **_MODE_KWARGS[mode])
-
-            # ---- per-shard write-back plan + combined ok.
-            status = out["r_status"]
-            created = ev["valid"] & (status == _CREATED)
-            transient = jnp.zeros_like(created)
-            for code in _TRANSIENT_CODES:
-                transient = transient | (status == code)
-            orphan_new = ev["valid"] & transient
-            ins_mask = created | orphan_new
-            owner_ev = shard_of_id(ev["id_hi"], ev["id_lo"], n_dev)
-            mine = created & (owner_ev == me)
-            ins_mine = ins_mask & (owner_ev == me)
-            n_mine = jnp.sum(mine.astype(jnp.int32))
-            local_rank = _cumsum(mine.astype(jnp.int32)) - mine
-            pos, ok_pl = ht_plan(sub["xfer_ht"], ev["id_hi"],
-                                 ev["id_lo"], ins_mine)
-            bad_l = ((xfr["count"] + n_mine > t_dump_l)
-                     | (evr["count"] + n_mine > e_cap_l)
-                     | ~ok_pl)
-            bad = jax.lax.psum(bad_l.astype(jnp.int32), axis) > 0
-            g_ok = (~out["fallback"]) & (~bad) & (~xchg_bad)
-
-            # ---- write-back (every write masked by g_ok; the dump
-            # rows absorb masked lanes, exactly the kernel's idiom).
-            row_off = _cumsum(created.astype(jnp.int32)) - created
-            mini_trow = jnp.clip(mini_t0 + row_off, 0, MT)
-            dest_t = jnp.where(mine & g_ok,
-                               xfr["count"] + local_rank, t_dump_l)
-            new_rows = new_mini["transfers"]["u64"][mini_trow]
-            # Stored row pointers become SHARD-LOCAL: resolve the new
-            # row's dr/cr against the local table (remote -> dump).
-            fdr2, rdr2 = ht_lookup(sub["acct_ht"],
-                                   ev["dr_hi"], ev["dr_lo"])
-            fcr2, rcr2 = ht_lookup(sub["acct_ht"],
-                                   ev["cr_hi"], ev["cr_lo"])
-            new_rows = new_rows.at[:, _XF_DRROW_COL].set(
-                pack32(jnp.where(fdr2, rdr2, a_dump_l),
-                       jnp.where(fcr2, rcr2, a_dump_l)))
-            xu_new = xfr["u64"].at[dest_t].set(new_rows)
-            # Pending-status flips on existing owned rows: the pstat
-            # word is alone in its column, so the flip cannot clobber a
-            # neighbor. Unchanged rows rewrite their own value.
-            owner_xk = shard_of_id(xk_hi, xk_lo, n_dev)
-            flip = lfirst & (owner_xk == me)
-            dest_p = jnp.where(flip & g_ok,
-                               (g_enc - jnp.uint64(2)).astype(jnp.int32),
-                               t_dump_l)
-            pword = new_mini["transfers"]["u64"][
-                jnp.where(x_live, lrow, MT), _XF_PSTAT_COL]
-            xu_new = xu_new.at[dest_p, _XF_PSTAT_COL].set(pword)
-
-            owner_ak = shard_of_id(ak_hi, ak_lo, n_dev)
-            wb_a = afirst & (owner_ak == me)
-            dest_a = jnp.where(wb_a & g_ok,
-                               (g_aenc - jnp.uint64(1)).astype(jnp.int32),
-                               a_dump_l)
-            amrow_c = jnp.where(afirst, amrow, MA)
-            au_new = acc["u64"].at[dest_a].set(
-                new_mini["accounts"]["u64"][amrow_c])
-            ab_new = acc["bal"].at[dest_a].set(
-                new_mini["accounts"]["bal"][amrow_c])
-
-            dest_e = jnp.where(mine & g_ok,
-                               evr["count"] + local_rank, e_cap_l)
-            ring_rows = new_mini["events"]["u64"][
-                jnp.clip(row_off, 0, ME)]
-            eu_new = evr["u64"].at[dest_e].set(ring_rows)
-
-            vals = jnp.where(created, xfr["count"] + local_rank,
-                             jnp.int32(ORPHAN_VAL))
-            ht_new = ht_write(sub["xfer_ht"], pos, ev["id_hi"],
-                              ev["id_lo"], vals, ins_mine & g_ok)
-
-            n_mine_ok = jnp.where(g_ok, n_mine, 0)
-
-            def adopt(new_v, old_v):
-                return jnp.where(g_ok, new_v, old_v)
-
-            new_sub = dict(
-                accounts=dict(u64=au_new, bal=ab_new,
-                              count=acc["count"]),
-                transfers=dict(u64=xu_new,
-                               count=xfr["count"] + n_mine_ok),
-                events=dict(u64=eu_new,
-                            count=evr["count"] + n_mine_ok),
-                acct_ht=sub["acct_ht"],
-                xfer_ht=ht_new,
-                acct_key_max=adopt(new_mini["acct_key_max"],
-                                   sub["acct_key_max"]),
-                xfer_key_max=adopt(new_mini["xfer_key_max"],
-                                   sub["xfer_key_max"]),
-                pulse_next=adopt(new_mini["pulse_next"],
-                                 sub["pulse_next"]),
-                commit_ts=adopt(new_mini["commit_ts"],
-                                sub["commit_ts"]),
-            )
-
-            # ---- amended out dict: the shard/exchange breaches are
-            # host fallbacks (state untouched), never escalations.
-            xb = bad | xchg_bad
-            rep = dict(out)
-            rep["r_status"] = jnp.where(xb, jnp.zeros_like(status),
-                                        status)
-            rep["r_ts"] = jnp.where(xb, jnp.zeros_like(out["r_ts"]),
-                                    out["r_ts"])
-            rep["fallback"] = out["fallback"] | xb
-            rep["limit_only"] = out["limit_only"] & ~xb
-            rep["created_count"] = jnp.where(xb, 0,
-                                             out["created_count"])
-            fbc = dict(out["fb_causes"])
-            fbc["shard_capacity"] = bad
-            fbc["exchange_overflow"] = xchg_bad
-            rep["fb_causes"] = fbc
-            # Durable flush rides the mini: the appended rows' slice
-            # plus the id/p_ts derivations, all mini-resolved (the
-            # canonical columns are bit-exact vs the single-chip
-            # gather; row-pointer columns are non-canonical scope).
-            rep["flush"] = _delta_gather_body(new_mini, mini_t0, 0,
-                                              N, N)
-            owner_dr = shard_of_id(ev["dr_hi"], ev["dr_lo"], n_dev)
-            owner_cr = shard_of_id(ev["cr_hi"], ev["cr_lo"], n_dev)
-            rep["cross_shard_transfers"] = jnp.sum(
-                (created & (owner_dr != owner_cr)).astype(jnp.int32))
-            rep["exchange_overflow"] = xchg_bad
-            sh = dict(events_owned=jnp.sum(
-                (ev["valid"] & (owner_ev == me)).astype(jnp.int32)
-            )[None])
-
+            new_sub, rep, owned = _partitioned_batch_body(
+                sub, ev, timestamp, n, axis=axis, n_dev=n_dev,
+                mode=mode)
+            sh = dict(events_owned=owned[None])
             new_stacked = jax.tree.map(lambda x: jnp.asarray(x)[None],
                                        new_sub)
             return new_stacked, {"rep": rep, "sh": sh}
@@ -426,6 +471,97 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
     # Donation preserved: the sharded buffers are consumed in place
     # (jaxhound's donation audit checks the lowered artifact).
     return jax.jit(step, donate_argnums=0)
+
+
+def make_partitioned_chain_create_transfers(mesh: Mesh,
+                                            axis: str = "batch",
+                                            mode: str = "plain"):
+    """Build the FUSED window step: the W prepares of a commit window
+    run as a `lax.scan` over the per-batch body INSIDE one shard_map
+    dispatch, with the donated sharded state and a rolling poison
+    scalar in the scan carry.
+
+    Returns step(stacked_state, ev_stack, ts_stack, n_stack,
+    force_fallback) -> (new_state, out). The stacks come from
+    stack_partitioned_window: every ev leaf is [W, n_pad] (replicated),
+    ts_stack/n_stack are the per-prepare commit timestamp and event
+    count. `force_fallback` seeds the poison carry (None = clean), so
+    pipelined drivers chain windows exactly like the single-chip chain
+    route (DeviceLedger.submit_window).
+
+    Per-prepare fallback granularity is PRESERVED: scan iteration k's
+    rep["fallback"] poisons iterations k+1.. (masked writes — their
+    shards stay bit-identical), so the clean prefix commits inside the
+    one dispatch and out["fallback"] ([W], replicated) tells the host
+    which suffix to re-window. Every out leaf gains a leading W axis;
+    `shard_stats.events_owned` is [n_shards, W].
+
+    Why this exists: the per-batch route pays PERF.md's bottleneck #1
+    (per-dispatch fixed cost) once per prepare; here the whole window
+    is ONE dispatch whose whole-program op count is flat in W (the
+    scan body is censused once — partitioned_chain tiers in
+    perf/opbudget_r09.json)."""
+    shard_map = get_shard_map()
+    assert mode in MODES, mode
+    n_dev = mesh.shape[axis]
+
+    def step(state, ev_stack, ts_stack, n_stack, force_fallback):
+        def body(stacked, ev_stack, ts_stack, n_stack):
+            sub = jax.tree.map(lambda x: x[0], stacked)
+            poisoned0 = (jnp.bool_(False) if force_fallback is None
+                         else force_fallback)
+
+            def scan_step(carry, xs):
+                st, poisoned = carry
+                ev_k, ts_k, n_k = xs
+                new_st, rep, owned = _partitioned_batch_body(
+                    st, ev_k, ts_k, n_k, axis=axis, n_dev=n_dev,
+                    mode=mode, force_fallback=poisoned)
+                return (new_st, rep["fallback"]), (rep, owned)
+
+            (new_sub, _), (reps, owned_w) = jax.lax.scan(
+                scan_step, (sub, poisoned0),
+                (ev_stack, ts_stack, n_stack))
+            sh = dict(events_owned=owned_w[None])
+            new_stacked = jax.tree.map(lambda x: jnp.asarray(x)[None],
+                                       new_sub)
+            return new_stacked, {"rep": reps, "sh": sh}
+
+        specs = (P(axis), P(), P(), P())
+        try:
+            smapped = shard_map(
+                body, mesh=mesh, in_specs=specs,
+                out_specs=(P(axis), {"rep": P(), "sh": P(axis)}),
+                check_vma=False)
+        except TypeError:  # pre-0.5 jax spells the kwarg check_rep
+            smapped = shard_map(
+                body, mesh=mesh, in_specs=specs,
+                out_specs=(P(axis), {"rep": P(), "sh": P(axis)}),
+                check_rep=False)
+        new_state, out2 = smapped(state, ev_stack, ts_stack, n_stack)
+        out = dict(out2["rep"])
+        out["shard_stats"] = out2["sh"]
+        return new_state, out
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def stack_partitioned_window(evs: list[dict], timestamps: list[int],
+                             n_pad: int = N_PAD):
+    """W prepares -> the chain step's stacked inputs: each unpadded
+    transfers_to_arrays SoA dict padded to n_pad and stacked on a
+    leading W axis, plus the per-prepare commit-timestamp and
+    valid-count vectors the scan body consumes (the partitioned
+    sibling of ops/ledger.stack_chain_window — per-prepare (ts, n)
+    scalars instead of seg lanes, because the exchange body judges one
+    whole prepare per iteration)."""
+    assert len(evs) == len(timestamps) and evs
+    padded = [pad_transfer_events(e, n_pad) for e in evs]
+    ev_stack = {k: np.stack([p[k] for p in padded]) for k in padded[0]}
+    ts_stack = np.asarray([int(t) for t in timestamps], dtype=np.uint64)
+    n_stack = np.asarray([len(e["id_lo"]) for e in evs],
+                         dtype=np.int32)
+    return ev_stack, ts_stack, n_stack
 
 
 # --------------------------------------------------------------- host side
@@ -552,6 +688,22 @@ def partitioned_from_oracle(sm, mesh: Mesh, axis: str = "batch",
     return jax.device_put(stacked, NamedSharding(mesh, P(axis)))
 
 
+def _host_local(x):
+    """device_get that tolerates a multi-host mesh: a leaf sharded over
+    the global device list cannot be fetched whole from one process, so
+    fall back to the ADDRESSABLE shards — each process accounts the
+    rows it hosts (remote rows read as zero here and accumulate on
+    their own host's router). Replicated leaves fetch whole either
+    way."""
+    try:
+        return np.asarray(jax.device_get(x))
+    except RuntimeError:
+        out = np.zeros(x.shape, dtype=x.dtype)
+        for s in x.addressable_shards:
+            out[s.index] = np.asarray(s.data)
+        return out
+
+
 def partitioned_state_bytes(stacked) -> int:
     """Per-device resident state bytes of a stacked partitioned pytree
     (every leaf's leading dim is the shard axis)."""
@@ -583,6 +735,15 @@ class PartitionedRouter:
     plus the exchange diagnostics (events routed per shard, cross-shard
     transfer counts, exchange overflows).
 
+    Window dispatch (step_window) defaults to the PARTITIONED CHAIN:
+    one fused shard_map+scan dispatch per eligible commit window, with
+    per-prepare fallback — the clean prefix stays committed inside the
+    dispatch, the first ineligible prepare replays through the
+    per-batch step (which escalates plain -> fixpoint on device), and
+    the remainder re-windows. Route counters ride
+    stats()["routes"] in the same shape as
+    DeviceLedger.fallback_stats()["routes"].
+
     Shard loss differs STRUCTURALLY from the replicated router: no
     surviving chip holds the lost range, so a single-chip reroute
     cannot serve. Loss quarantines the router until `resync(oracle)`
@@ -601,6 +762,7 @@ class PartitionedRouter:
         self.e_cap = e_cap
         self.n_shards = mesh.shape[axis]
         self._steps: dict = {}
+        self._chain_steps: dict = {}
         self.batches = 0
         self.escalations = 0
         self.host_fallbacks = 0
@@ -610,6 +772,8 @@ class PartitionedRouter:
         self.cross_shard_transfers = 0
         self.exchange_overflows = 0
         self.events_owned = np.zeros(self.n_shards, dtype=np.int64)
+        self.window_routes: dict = {}
+        self.chain_batch_fallbacks: dict = {}
 
     # Same flag-derived tier precedence as the replicated router.
     route = staticmethod(ShardedRouter.route)
@@ -625,6 +789,14 @@ class PartitionedRouter:
         if fn is None:
             fn = self._steps[mode] = make_partitioned_create_transfers(
                 self.mesh, self.axis, mode=mode)
+        return fn
+
+    def _chain_step(self, mode: str):
+        fn = self._chain_steps.get(mode)
+        if fn is None:
+            fn = self._chain_steps[mode] = \
+                make_partitioned_chain_create_transfers(
+                    self.mesh, self.axis, mode=mode)
         return fn
 
     def drop_device(self, device, oracle=None):
@@ -657,14 +829,17 @@ class PartitionedRouter:
         nothing to rebuild."""
         self.lost_devices.clear()
 
-    def step(self, state, ev: dict, timestamp: int, n: int):
-        """Run one padded batch. Returns (new_state, out, fell_back).
-        On fell_back=True the state is untouched (masked writes on
-        every shard) and the caller owns the exact-path replay."""
+    def _require_serving(self) -> None:
         if self.lost_devices:
             raise RuntimeError(
                 "partitioned shard lost: resync(oracle) required — the "
                 "single-chip reroute cannot serve a lost range")
+
+    def step(self, state, ev: dict, timestamp: int, n: int):
+        """Run one padded batch. Returns (new_state, out, fell_back).
+        On fell_back=True the state is untouched (masked writes on
+        every shard) and the caller owns the exact-path replay."""
+        self._require_serving()
         self.batches += 1
         mode = self.route(ev)
         self.tracer.count(Event.dispatch_route,
@@ -679,9 +854,9 @@ class PartitionedRouter:
                 new_state, out = self._step("fixpoint")(
                     new_state, ev, np.uint64(timestamp), np.int32(n))
                 fallback = bool(jax.device_get(out["fallback"]))
-        xs, ov, owned = jax.device_get(
-            (out["cross_shard_transfers"], out["exchange_overflow"],
-             out["shard_stats"]["events_owned"]))
+        xs, ov = jax.device_get(
+            (out["cross_shard_transfers"], out["exchange_overflow"]))
+        owned = _host_local(out["shard_stats"]["events_owned"])
         if int(xs):
             self.cross_shard_transfers += int(xs)
             self.tracer.count(Event.cross_shard_transfers,
@@ -697,6 +872,127 @@ class PartitionedRouter:
                     self.tracer.count(Event.router_fallback, cause=k)
         return new_state, out, fallback
 
+    # ---- fused window dispatch (the default partitioned route) ----
+
+    def _count_window(self, route: str) -> None:
+        self.window_routes[route] = (
+            self.window_routes.get(route, 0) + 1)
+
+    def chain_dispatch(self, state, evs: list[dict],
+                       timestamps: list[int], n_pad: int | None = None,
+                       force_fallback=None):
+        """ONE fused shard_map+scan dispatch over a whole window,
+        UNRESOLVED (every out leaf stays on device with a leading W
+        axis). Pipelined drivers (DeviceLedger.submit_window) thread
+        out["fallback"][-1] into the next window's force_fallback and
+        resolve later; synchronous callers use step_window. Counts the
+        window under the partitioned_chain route."""
+        self._require_serving()
+        ns = [len(e["id_lo"]) for e in evs]
+        if n_pad is None:
+            n_pad = _pad_bucket(max(ns))
+        ev_stack, ts_stack, n_stack = stack_partitioned_window(
+            evs, timestamps, n_pad)
+        self._count_window("partitioned_chain")
+        self.tracer.count(Event.dispatch_route,
+                          route="partitioned_chain")
+        with self.tracer.span(Event.shard_exchange, mode="chain"):
+            new_state, out = self._chain_step("plain")(
+                state, ev_stack, ts_stack, n_stack, force_fallback)
+        return new_state, out
+
+    def absorb_chain_prefix(self, out, k: int, n_prepares: int) -> None:
+        """Accumulate one fused dispatch's committed-prefix counters
+        ([0, k) prepares) and, when k < n_prepares, the per-prepare
+        fallback causes at iteration k (later iterations only carry
+        the transitive poison). The replayed suffix counts itself
+        through the per-batch step."""
+        self.batches += k
+        if k:
+            xs = int(np.asarray(jax.device_get(
+                out["cross_shard_transfers"]))[:k].sum())
+            if xs:
+                self.cross_shard_transfers += xs
+                self.tracer.count(Event.cross_shard_transfers,
+                                  value=xs)
+            owned = _host_local(out["shard_stats"]["events_owned"])
+            self.events_owned += owned[:, :k].sum(
+                axis=1).astype(np.int64)
+        if k < n_prepares:
+            for cause, v in jax.device_get(out["fb_causes"]).items():
+                if bool(np.asarray(v)[k]):
+                    self.chain_batch_fallbacks[cause] = (
+                        self.chain_batch_fallbacks.get(cause, 0) + 1)
+
+    def _window_per_batch(self, state, evs, timestamps, n_pad,
+                          count_route=True):
+        """The per-batch window ladder: one shard_map dispatch per
+        prepare through step() (plain -> fixpoint escalation on
+        device). The replay path for a chain window's fallen-back
+        prepare, and the pre-route for windows carrying flags the
+        plain chain body cannot serve."""
+        if count_route:
+            self._count_window("partitioned_per_batch")
+        results = []
+        for ev, ts in zip(evs, timestamps):
+            n_b = len(ev["id_lo"])
+            pe = pad_transfer_events(ev, n_pad)
+            state, out, _fb = self.step(state, pe, ts, n_b)
+            st, rts = jax.device_get((out["r_status"], out["r_ts"]))
+            results.append((np.asarray(st)[:n_b],
+                            np.asarray(rts)[:n_b]))
+        return state, results
+
+    def step_window(self, state, evs: list[dict],
+                    timestamps: list[int], n_pad: int | None = None):
+        """Commit one window of W prepares (each an UNPADDED
+        transfers_to_arrays SoA dict). Returns (new_state, results)
+        with one (status u32[n_b], ts u64[n_b]) pair per prepare.
+
+        DEFAULT route: the partitioned CHAIN — ONE fused
+        shard_map+lax.scan dispatch for the whole window when every
+        prepare pre-routes plain (imported/balancing/closing windows
+        take the per-batch ladder, whose steps escalate tiers
+        per-flag). Per-prepare fallback preserves PR 6's window
+        semantics: the clean prefix [0, k) committed inside the
+        dispatch and its results stand; prepare k replays through the
+        per-batch step (plain -> fixpoint escalation on device); the
+        remainder re-windows recursively."""
+        W = len(evs)
+        if W == 0:
+            return state, []
+        self._require_serving()
+        ns = [len(e["id_lo"]) for e in evs]
+        if n_pad is None:
+            n_pad = _pad_bucket(max(ns))
+        if W < 2 or any(self.route(e) != "plain" for e in evs):
+            return self._window_per_batch(state, evs, timestamps,
+                                          n_pad)
+        new_state, out = self.chain_dispatch(state, evs, timestamps,
+                                             n_pad)
+        fb = np.asarray(jax.device_get(out["fallback"]))
+        k = int(np.argmax(fb)) if fb.any() else W
+        self.absorb_chain_prefix(out, k, W)
+        st_all, ts_all = (np.asarray(x) for x in jax.device_get(
+            (out["r_status"], out["r_ts"])))
+        results = [(st_all[b, :ns[b]], ts_all[b, :ns[b]])
+                   for b in range(k)]
+        if k == W:
+            return new_state, results
+        # Prepare k replays per-batch (the device escalation ladder
+        # serves limit cascades without a host fallback); the poisoned
+        # suffix — whose shards are bit-identical to the prefix state —
+        # re-windows through the full ladder.
+        new_state, res_k = self._window_per_batch(
+            new_state, evs[k:k + 1], timestamps[k:k + 1], n_pad,
+            count_route=False)
+        results.extend(res_k)
+        if k + 1 < W:
+            new_state, rest = self.step_window(
+                new_state, evs[k + 1:], timestamps[k + 1:], n_pad)
+            results.extend(rest)
+        return new_state, results
+
     def stats(self) -> dict:
         total = int(self.events_owned.sum())
         return {
@@ -711,4 +1007,13 @@ class PartitionedRouter:
             "events_owned": [int(x) for x in self.events_owned],
             "cross_shard_fraction": (
                 self.cross_shard_transfers / total if total else 0.0),
+            # Dispatch-route record, DeviceLedger.fallback_stats()
+            # shape: windows per route (partitioned_chain = the fused
+            # default) + per-cause prepares that fell out of a chain
+            # window (the prefix stayed committed).
+            "routes": {
+                "windows": dict(self.window_routes),
+                "chain_batch_fallbacks": dict(
+                    self.chain_batch_fallbacks),
+            },
         }

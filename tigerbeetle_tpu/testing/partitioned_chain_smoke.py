@@ -1,0 +1,189 @@
+"""Partitioned-chain smoke: the gate's quick differential for the
+FUSED partitioned window route.
+
+Drives PartitionedRouter.step_window — ONE shard_map+lax.scan dispatch
+per eligible commit window over account-range-sharded state — on
+whatever mesh exists (the gate leg pins an 8-device virtual CPU mesh)
+and asserts the round-9 serving contract:
+
+  1. eligible windows take the PARTITIONED CHAIN route by default
+     (route counters; flagged windows pre-route per-batch);
+  2. results are bit-exact vs the per-batch partitioned ladder AND the
+     pure-Python oracle, including a window poisoned mid-stream by a
+     limit cascade (e3 headroom proof): the clean prefix stays
+     committed inside the dispatch, prepare k replays per-batch with
+     the plain -> fixpoint escalation ON DEVICE, the suffix
+     re-windows;
+  3. zero HOST fallbacks on both routes, and the sharded state digests
+     of both routes equal the oracle's;
+  4. the committed partitioned-chain budgets exist
+     (perf/opbudget_r09.json; the census itself is the opbudget leg's
+     job) with body == the per-batch partitioned tier.
+
+Run via ``scripts/gate.py`` (skip with --no-partitioned-chain) or
+directly: ``python -c "from tigerbeetle_tpu.testing import
+partitioned_chain_smoke as s; s.partitioned_chain_smoke()"``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+import numpy as np
+
+SEED = 37
+A_CAP, T_CAP = 1 << 9, 1 << 11
+
+
+def _routers(n_dev):
+    import jax
+    from jax.sharding import Mesh
+
+    from ..oracle import StateMachineOracle
+    from ..parallel.partitioned import PartitionedRouter
+    from ..types import Account, AccountFlags
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("batch",))
+    accts = [Account(id=i, ledger=1, code=1,
+                     flags=(int(AccountFlags.debits_must_not_exceed_credits)
+                            if i <= 4 else 0))
+             for i in range(1, 41)]
+    oracles, routers, states = [], [], []
+    steps, chain_steps = {}, {}
+    for _ in range(2):
+        orc = StateMachineOracle()
+        orc.create_accounts(accts, 50)
+        r = PartitionedRouter(mesh, a_cap=A_CAP, t_cap=T_CAP)
+        r._steps = steps  # share jit caches between the two routers
+        r._chain_steps = chain_steps
+        oracles.append(orc)
+        routers.append(r)
+        states.append(r.from_oracle(orc))
+    return oracles, routers, states
+
+
+def _windows(rng, n_dev):
+    from ..parallel.shard_utils import shard_of_int
+    from ..types import Transfer, TransferFlags as TF
+
+    def pairs(count):
+        out, ids = [], list(range(1, 41))
+        while len(out) < count:
+            dr, cr = rng.choice(ids, 2, replace=False)
+            if n_dev == 1 or shard_of_int(int(dr), n_dev) != \
+                    shard_of_int(int(cr), n_dev):
+                out.append((int(dr), int(cr)))
+        return out
+
+    nid, ts = [10 ** 6], [10 ** 9]
+    windows = []
+
+    def prepare(n=8, poison=False, flags=0):
+        evs = [Transfer(id=nid[0] + i, debit_account_id=dr,
+                        credit_account_id=cr,
+                        amount=int(rng.integers(1, 30)), ledger=1,
+                        code=1, flags=flags)
+               for i, (dr, cr) in enumerate(pairs(n))]
+        nid[0] += n
+        if poison:
+            # Debit off a DR_LIMIT account beyond its funded credits:
+            # the plain headroom proof falls back limit_only, poisoning
+            # the chain at this prepare.
+            evs.append(Transfer(id=nid[0], debit_account_id=1,
+                                credit_account_id=9, amount=10 ** 6,
+                                ledger=1, code=1))
+            nid[0] += 1
+        ts[0] += 300
+        return evs, ts[0]
+
+    # Window 1: clean 3-prepare two-phase window — pendings in prepare
+    # 0, their posts/voids in prepare 2: the in-dispatch carry must
+    # expose prepare 0's rows to prepare 2 on every shard.
+    p0, t0 = prepare(flags=int(TF.pending))
+    p1, t1 = prepare()
+    closes = [Transfer(id=nid[0] + i, pending_id=p.id,
+                       amount=((1 << 128) - 1) if i % 2 == 0 else 0,
+                       flags=int(TF.post_pending_transfer if i % 2 == 0
+                                 else TF.void_pending_transfer))
+              for i, p in enumerate(p0)]
+    nid[0] += len(closes)
+    ts[0] += 300
+    windows.append(([p0, p1, closes], [t0, t1, ts[0]]))
+    # Window 2: poisoned at prepare 1 (limit cascade).
+    w, tss = [], []
+    for b in range(3):
+        evs, t = prepare(poison=(b == 1))
+        w.append(evs)
+        tss.append(t)
+    windows.append((w, tss))
+    # Window 3: flagged (balancing) — pre-routes per-batch.
+    evs, t = prepare()
+    bal, t2 = prepare(n=2, flags=int(TF.balancing_debit))
+    windows.append(([evs, bal], [t, t2]))
+    return windows
+
+
+def partitioned_chain_smoke() -> None:
+    import jax
+
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import _pad_bucket
+    from ..ops.state_epoch import (
+        partitioned_oracle_digest, partitioned_state_digest)
+
+    n_dev = len(jax.devices())
+    rng = np.random.default_rng(SEED)
+    (orc_c, orc_b), (rt_c, rt_b), (st_c, st_b) = _routers(n_dev)
+    for w, tss in _windows(rng, n_dev):
+        arrays = [transfers_to_arrays(e) for e in w]
+        st_c, res_c = rt_c.step_window(st_c, arrays, tss)
+        st_b, res_b = rt_b._window_per_batch(
+            st_b, arrays, tss, _pad_bucket(max(len(e) for e in w)))
+        assert len(res_c) == len(res_b) == len(w)
+        for evs, t, (stc, rtsc), (stb, rtsb) in zip(w, tss, res_c,
+                                                    res_b):
+            want = orc_c.create_transfers(evs, t)
+            orc_b.create_transfers(evs, t)
+            exp = [(r.timestamp, int(r.status)) for r in want]
+            got_c = [(int(rtsc[i]), int(stc[i]))
+                     for i in range(len(evs))]
+            got_b = [(int(rtsb[i]), int(stb[i]))
+                     for i in range(len(evs))]
+            assert got_c == exp, (got_c[:4], exp[:4])
+            assert got_b == exp, (got_b[:4], exp[:4])
+    # Route counters: the two clean/poisoned windows took the fused
+    # chain, the flagged one pre-routed per-batch, the poison fell out
+    # per-PREPARE (e3_limit) with zero host fallbacks anywhere.
+    wr = rt_c.window_routes
+    assert wr.get("partitioned_chain", 0) >= 2, wr
+    assert wr.get("partitioned_per_batch", 0) >= 1, wr
+    assert rt_c.chain_batch_fallbacks.get("e3_limit", 0) >= 1, \
+        rt_c.chain_batch_fallbacks
+    assert rt_c.escalations >= 1, rt_c.stats()
+    assert rt_c.host_fallbacks == 0, rt_c.stats()
+    assert rt_b.host_fallbacks == 0, rt_b.stats()
+    if n_dev > 1:
+        assert rt_c.cross_shard_transfers > 0
+    dd = partitioned_state_digest(st_c)
+    assert dd == partitioned_state_digest(st_b)
+    assert dd == partitioned_oracle_digest(orc_c, A_CAP, n_dev), dd
+    # The committed budget file must CARRY the fused tiers (a rollback
+    # would silently un-gate the route); values are the opbudget leg's.
+    repo = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    with open(os.path.join(repo, "perf", "opbudget_r09.json")) as f:
+        budget = json.load(f)["budget"]
+    for tier in ("partitioned_chain_w2", "partitioned_chain_w8",
+                 "partitioned_chain_w32", "partitioned_chain_body"):
+        assert tier in budget, f"opbudget_r09.json lacks {tier}"
+    assert (budget["partitioned_chain_body"]["heavy_total"]
+            == budget["partitioned_plain"]["heavy_total"]), \
+        "fused body must cost exactly the per-batch partitioned tier"
+    print(f"[partitioned-chain-smoke] ok: fused default route on "
+          f"{n_dev} device(s), per-prepare fallback, per-batch + "
+          "oracle parity, digests equal, budgets present")
+
+
+if __name__ == "__main__":
+    partitioned_chain_smoke()
