@@ -332,7 +332,15 @@ def shard_balance_probe(quick: bool) -> dict:
     cross-shard fraction, exchange overflow count, per-device resident
     bytes, the windows-by-route counters, and the warm per-window
     dispatch latency percentiles. The ##shard line of the run record
-    (devhub "shard balance" panel)."""
+    (devhub "shard balance" panel).
+
+    Round 10: the shard counters (events per shard, cross-shard
+    transfers/fraction, exchange overflows) decode from the DEVICE
+    telemetry block the fused dispatch harvests with its outputs — the
+    router absorbs the block, no host-side recomputation — and the
+    record additively gains the `telemetry` sub-dict (occupancy
+    histogram and friends) the SLO engine's exchange-headroom burn
+    objective evaluates per run. Schema otherwise unchanged."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -403,11 +411,17 @@ def shard_balance_probe(quick: bool) -> dict:
             "windows_timed": len(lat_ms),
             "events_per_window": 512,
         },
+        # Decoded from the harvested device telemetry block (the
+        # router's absorb path), not recomputed host-side.
         "events_per_shard": s["events_owned"],
         "cross_shard_transfers": s["cross_shard_transfers"],
         "cross_shard_fraction": s["cross_shard_fraction"],
         "exchange_overflows": s["exchange_overflows"],
         "routes": s["routes"],
+        # Device telemetry aggregates incl. the exchange-occupancy
+        # histogram dict trace/slo.py evaluate_bench_record reads for
+        # the exchange_occupancy_p99_pct objective.
+        "telemetry": s["telemetry"],
         "state_bytes_per_device": partitioned_state_bytes(state),
         "state_bytes_replicated_equiv": replicated_state_bytes(
             router.a_cap * router.n_shards,

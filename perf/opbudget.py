@@ -69,7 +69,9 @@ import numpy as np  # noqa: E402
 from tigerbeetle_tpu import jaxhound  # noqa: E402
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-BUDGET_PATH = os.path.join(REPO, "perf", "opbudget_r09.json")
+# The budget trail is append-oriented (a new opbudget_r<N>.json per
+# round that moves a pinned census); always check/write the head.
+BUDGET_PATH = jaxhound.newest_budget_path(os.path.join(REPO, "perf"))
 
 STACK = 4
 N_SUPER = 1024
@@ -448,6 +450,85 @@ def run_lints() -> list[str]:
     return fails
 
 
+def telemetry_report() -> dict:
+    """Census the device-telemetry plane of the fused partitioned
+    chain (round 10): the pack's lane count (jaxhound.telemetry_census
+    — the telemetry block cannot grow a word silently), and the
+    telemetry-on vs telemetry-off DELTA of the scan body's heavy
+    census (the pack is elementwise + a named stack, so the pinned
+    allowance is zero heavy ops — observability must ride the existing
+    op mass, not add to it). Returns {} on < 8 devices (the
+    partitioned tiers need the mesh)."""
+    if len(jax.devices()) < 8:
+        return {}
+    from jax.sharding import Mesh
+    from tigerbeetle_tpu.parallel.partitioned import (
+        make_partitioned_chain_create_transfers)
+
+    mesh = Mesh(np.array(jax.devices()[:8]), ("batch",))
+    pstate = _partitioned_fixture(mesh)
+    ev_p, ts_p, n_p = _partitioned_chain_fixture(8)
+    bodies = {}
+    tel = None
+    for on in (True, False):
+        cstep = make_partitioned_chain_create_transfers(
+            mesh, mode="plain", telemetry=on)
+        with mesh:
+            cj = jax.make_jaxpr(
+                lambda st, e, t, nn: cstep.__wrapped__(
+                    st, e, t, nn, None))(pstate, ev_p, ts_p, n_p)
+        bodies[on] = jaxhound.scan_body_census(cj)["heavy_total"]
+        if on:
+            tel = jaxhound.telemetry_census(cj)
+    return {
+        "lanes": tel["lanes"],
+        "pack_sites": tel["sites"],
+        "pack_ops": tel["ops"],
+        "chain_body_heavy_on": bodies[True],
+        "chain_body_heavy_off": bodies[False],
+        "chain_body_heavy_delta": bodies[True] - bodies[False],
+    }
+
+
+def check_telemetry(report: dict | None = None) -> list[str]:
+    """Gate leg: the telemetry-lane census vs the committed budget's
+    `telemetry` section. Reds when the pack grows lanes/ops past the
+    committed words, when the pack disappeared from the fused route
+    (dead telemetry plane), or when the scan body's heavy-op delta
+    exceeds the pinned allowance."""
+    with open(BUDGET_PATH) as f:
+        committed = json.load(f)
+    budget = committed.get("telemetry")
+    if budget is None:
+        return [f"{os.path.basename(BUDGET_PATH)} has no 'telemetry' "
+                "section (run --write on >= 8 devices)"]
+    if report is None:
+        report = telemetry_report()
+    if not report:
+        return []  # no mesh: the partitioned tiers are not censusable
+    fails = []
+    if report["lanes"] != budget["lanes"]:
+        fails.append(
+            f"telemetry lanes {report['lanes']} != committed "
+            f"{budget['lanes']} (TEL_LAYOUT changed without a budget "
+            "bump — commit a new opbudget round)")
+    if report["pack_sites"] < 1:
+        fails.append("telemetry pack missing from the fused chain "
+                     "route (dead telemetry plane)")
+    if report["pack_ops"] > budget["pack_ops"]:
+        fails.append(
+            f"telemetry pack ops {report['pack_ops']} > committed "
+            f"{budget['pack_ops']} (compute smuggled into the "
+            "observability plane)")
+    delta_max = budget.get("chain_body_heavy_delta_max", 0)
+    if report["chain_body_heavy_delta"] > delta_max:
+        fails.append(
+            f"telemetry heavy-op delta "
+            f"{report['chain_body_heavy_delta']} > allowed {delta_max} "
+            "(the telemetry block added heavy ops to the scan body)")
+    return fails
+
+
 def check_budgets(current: dict | None = None) -> list[str]:
     """Compare the current census against the committed budgets.
     Returns failure strings (empty = within budget)."""
@@ -521,6 +602,11 @@ def main() -> int:
               + f" operand_MB={c['heavy_operand_bytes'] / 1e6:.2f}")
 
     rc = 0
+    tel_report = telemetry_report()
+    if tel_report:
+        print(f"telemetry                lanes={tel_report['lanes']} "
+              f"pack_ops={tel_report['pack_ops']} "
+              f"body_delta={tel_report['chain_body_heavy_delta']}")
     if args.write:
         with open(BUDGET_PATH) as f:
             committed = json.load(f)
@@ -529,11 +615,22 @@ def main() -> int:
             t: {"heavy_total": c["heavy_total"], "heavy": c["heavy"],
                 "heavy_operand_bytes": c["heavy_operand_bytes"]}
             for t, c in current.items()}
+        if tel_report:
+            committed["telemetry"] = {
+                "lanes": tel_report["lanes"],
+                "pack_ops": tel_report["pack_ops"],
+                "chain_body_heavy_delta_max": 0,
+                # Measured wall-clock bound, enforced by the gate's
+                # telemetry leg (testing/telemetry_smoke.py): fused
+                # dispatch ms/window with telemetry on vs off.
+                "overhead_ratio_max": committed.get(
+                    "telemetry", {}).get("overhead_ratio_max", 1.10),
+            }
         with open(BUDGET_PATH, "w") as f:
             json.dump(committed, f, indent=1)
         print(f"[opbudget] wrote {BUDGET_PATH}")
     if args.check:
-        fails = check_budgets(current)
+        fails = check_budgets(current) + check_telemetry(tel_report)
         for f_ in fails:
             print(f"[opbudget] OVER BUDGET: {f_}")
         if fails:

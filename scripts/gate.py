@@ -34,7 +34,13 @@ sharded-state window route — one shard_map+scan dispatch per window —
 differential vs the per-batch ladder and the oracle on an 8-device
 virtual mesh, then the 2-process jax.distributed local leg, skipped
 gracefully where multi-process init is unavailable; skip with
---no-partitioned-chain), the TRACE-CATALOG coverage leg
+--no-partitioned-chain), the TELEMETRY leg
+(testing/telemetry_smoke.py: the device-telemetry plane of the fused
+route — harvested per-prepare block decoded bit-exact vs a host
+recomputation on 1/2/8-device meshes, telemetry-lane census vs the
+committed budget, a negative over-budget-pack red, and the measured
+telemetry-on vs -off dispatch overhead ratio under the budget's
+overhead_ratio_max; skip with --no-telemetry), the TRACE-CATALOG coverage leg
 (testing/trace_coverage.py: the smokes re-run under recording tracers;
 red when any event in tigerbeetle_tpu/trace/event.py is never emitted
 or an off-catalog name is emitted, or an emitted span/histogram event
@@ -241,6 +247,39 @@ def run_partitioned_chain(timeout: int = 900) -> int:
     return rc
 
 
+def run_telemetry(timeout: int = 900) -> int:
+    """Telemetry leg: the round-10 device-telemetry plane on the fused
+    partitioned-chain route (testing/telemetry_smoke.py, 8-device
+    virtual mesh) — the harvested per-prepare block decoded bit-exact
+    vs a host recomputation on 1/2/8-device meshes, the telemetry-lane
+    census vs the committed budget's `telemetry` section, a negative
+    proof that a grown pack reds perf/opbudget.check_telemetry, and
+    the measured telemetry-on vs telemetry-off dispatch overhead ratio
+    under the budget's `overhead_ratio_max`. Skip with
+    --no-telemetry."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import telemetry_smoke as s; "
+           "s.telemetry_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] telemetry: device block oracle + lane census + "
+          "overhead ratio (testing/telemetry_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: telemetry timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] telemetry rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_trace_coverage(timeout: int = 900) -> int:
     """Trace-catalog coverage leg: the vopr/chaos/rebuild-style smokes
     (plus deterministic scenarios for rare events) run under recording
@@ -362,6 +401,9 @@ def main() -> int:
                     help="skip the partitioned-chain leg (fused "
                          "sharded window route differential + "
                          "2-process multihost leg)")
+    ap.add_argument("--no-telemetry", action="store_true",
+                    help="skip the telemetry leg (device block oracle "
+                         "+ lane census + overhead ratio)")
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the metrics leg (SLO catalog check + "
                          "/metrics exposition smoke)")
@@ -397,6 +439,10 @@ def main() -> int:
         rc = run_partitioned_chain()
         if rc != 0:
             reds.append(f"partitioned-chain rc={rc}")
+    if not args.no_telemetry:
+        rc = run_telemetry()
+        if rc != 0:
+            reds.append(f"telemetry rc={rc}")
     if not args.no_trace_cov:
         rc = run_trace_coverage()
         if rc != 0:

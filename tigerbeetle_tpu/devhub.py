@@ -311,8 +311,10 @@ def render(history_path: str, out_path: str,
             + "".join(rows_rt) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
-    # ceilings (perf/opbudget_r09.json) — compile-footprint regressions
-    # are rendered as loudly as throughput ones.
+    # ceilings (the NEWEST perf/opbudget_r*.json — resolved, not
+    # hardcoded, so a new budget round shows up without a devhub edit)
+    # — compile-footprint regressions are rendered as loudly as
+    # throughput ones.
     ob_html = ""
     ob = next((e.get("opbudget") for e in reversed(entries)
                if isinstance(e.get("opbudget"), dict)
@@ -320,9 +322,8 @@ def render(history_path: str, out_path: str,
     if ob:
         budgets = {}
         try:
-            bpath = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                                 "..", "perf", "opbudget_r09.json")
-            with open(bpath) as f:
+            from .jaxhound import newest_budget_path
+            with open(newest_budget_path()) as f:
                 budgets = json.load(f).get("budget", {})
         except (OSError, ValueError):
             pass
@@ -398,6 +399,58 @@ def render(history_path: str, out_path: str,
                   ratio)
             + "<table><tr><th>shard</th><th>events owned</th><th></th>"
               "</tr>" + "".join(rows_sh) + "</table>")
+    # Device-telemetry panel: the fused route's on-device measurements
+    # (bench ##shard record's `telemetry` sub-dict, decoded from the
+    # harvested TEL_LAYOUT block) — exchange-headroom burn first (the
+    # early warning BEFORE overflows become host fallbacks), then the
+    # fixpoint-round distribution, decoded poison causes, and the
+    # flight-recorder activity counters.
+    dt_html = ""
+    dt = (sh or {}).get("telemetry") if isinstance(sh, dict) else None
+    if isinstance(dt, dict):
+        occ_txt = "-"
+        occ_warn = ""
+        try:
+            from .trace import Histogram
+            oh = Histogram.from_dict(dt.get("exchange_occupancy") or {})
+            if oh.count:
+                p99 = oh.quantile(0.99)
+                occ_txt = ("p50 {:.1f}% / p99 {:.1f}% of lane capacity "
+                           "({} samples)").format(
+                               oh.quantile(0.50), p99, oh.count)
+                if p99 is not None and p99 > 85.0:
+                    occ_warn = (
+                        '<p style="color:#c22;font-weight:700">'
+                        'EXCHANGE HEADROOM BURNING — p99 occupancy '
+                        'past the 85% SLO threshold</p>')
+        except (AssertionError, ValueError, TypeError):
+            pass
+        fr = dt.get("fixpoint_rounds") or {}
+        fr_txt = ("-" if not fr.get("count") else
+                  "p50 {} / p99 {} / max {} over {} prepares".format(
+                      fr.get("p50", "-"), fr.get("p99", "-"),
+                      fr.get("max", "-"), fr.get("count", 0)))
+        causes = dt.get("device_poison_causes") or {}
+        cause_txt = ", ".join(f"{k}={v}"
+                              for k, v in sorted(causes.items())) or "none"
+        dt_html = (
+            "<h2>device telemetry (fused partitioned route, latest "
+            "run)</h2>" + occ_warn
+            + "<table>"
+              "<tr><td>exchange occupancy</td><td>{}</td></tr>"
+              "<tr><td>fixpoint rounds</td><td>{}</td></tr>"
+              "<tr><td>poison causes (decoded)</td><td>{}</td></tr>"
+              "<tr><td>write-back rows</td><td>{}</td></tr>"
+              "<tr><td>shard capacity hits</td><td>{}</td></tr>"
+              "<tr><td>flight recorder</td>"
+              "<td>{} windows ringed, {} dumps</td></tr>"
+              "</table>".format(
+                  html.escape(occ_txt), html.escape(fr_txt),
+                  html.escape(cause_txt),
+                  dt.get("writeback_rows", 0),
+                  dt.get("shard_capacity_hits", 0),
+                  dt.get("flight_windows", 0),
+                  dt.get("flight_dumps", 0)))
     # Commit-pipeline panel: the newest run's per-stage trace aggregates
     # (bench ##trace, recorded under a recording tracer) as time shares —
     # the operator-facing answer to "where does a commit go", next to the
@@ -554,6 +607,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {route_html}
 {ob_html}
 {sh_html}
+{dt_html}
 {tr_html}
 {slo_html}
 {cp_html}

@@ -12,6 +12,8 @@ Usage: `python -m tigerbeetle_tpu jaxhound [--kernel NAME]`.
 from __future__ import annotations
 
 import collections
+import glob
+import os
 import re
 from typing import Callable
 
@@ -165,6 +167,67 @@ def scan_body_census(closed_jaxpr) -> dict:
         best = {"heavy": {c: 0 for c in HEAVY_CLASS_ORDER},
                 "heavy_total": 0, "heavy_operand_bytes": 0,
                 "collective_operand_bytes": 0}
+    return best
+
+
+# The telemetry plane's pack marker: parallel/partitioned.py stacks its
+# u32 telemetry words through a named, non-inlined jit wrapper so the
+# pack survives tracing as a `pjit` equation carrying this name — the
+# lanes are then a CENSUSABLE CLASS of their own instead of dissolving
+# into the surrounding elementwise soup.
+TELEMETRY_PACK_NAME = "_telemetry_pack"
+
+
+def telemetry_census(closed_jaxpr) -> dict:
+    """Census of the device-telemetry lanes in a traced program.
+
+    Finds every `pjit` equation named TELEMETRY_PACK_NAME (anywhere —
+    including inside the fused chain route's scan body) and reports:
+    `sites` (pack call sites in the program), `lanes` (telemetry words
+    per pack — the widest site), and `ops` (equation count inside the
+    largest pack body). The op-budget gate pins `lanes` so the
+    telemetry block cannot grow a word without a committed budget bump,
+    and bounds `ops` so 'just one more derived metric' cannot smuggle
+    real compute into the observability plane."""
+    sites = []
+
+    def visit(eqn):
+        if eqn.primitive.name != "pjit":
+            return
+        if eqn.params.get("name") != TELEMETRY_PACK_NAME:
+            return
+        inner = eqn.params.get("jaxpr")
+        n_ops = len(inner.jaxpr.eqns) if inner is not None else 0
+        sites.append((len(eqn.invars), n_ops))
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return {
+        "sites": len(sites),
+        "lanes": max((s[0] for s in sites), default=0),
+        "ops": max((s[1] for s in sites), default=0),
+    }
+
+
+def newest_budget_path(perf_dir: str | None = None) -> str:
+    """Path of the NEWEST committed perf/opbudget_r*.json (highest
+    round number). The budget trail is append-oriented — every round
+    that moves a pinned census commits a new file — so consumers
+    (devhub, smokes, the gate) resolve the head dynamically instead of
+    hardcoding a round that silently goes stale."""
+    if perf_dir is None:
+        perf_dir = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "..", "perf")
+    paths = glob.glob(os.path.join(perf_dir, "opbudget_r*.json"))
+    best = None
+    best_round = -1
+    for p in paths:
+        m = re.search(r"opbudget_r(\d+)\.json$", os.path.basename(p))
+        if m and int(m.group(1)) > best_round:
+            best_round = int(m.group(1))
+            best = p
+    if best is None:
+        raise FileNotFoundError(
+            f"no opbudget_r*.json under {perf_dir!r}")
     return best
 
 

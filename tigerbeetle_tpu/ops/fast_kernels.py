@@ -1277,6 +1277,13 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
     # once the PROOF would pass (dropping back on "no actual breach" would
     # oscillate on workloads that sit near their limits without crossing).
     proof_breach = e3
+    # Rounds the status fixpoint ACTUALLY consumed (telemetry plane):
+    # 0 on the proof-gated plain tier, >=1 on fixpoint tiers. Round 0
+    # always runs; a later round only counts when the previous one had
+    # not converged — so a batch that settles immediately reads 1, a
+    # k-deep limit cascade reads k+1, and an unconverged batch reads
+    # the full round budget. Elementwise adds only: no heavy-op delta.
+    fix_rounds = jnp.int32(0)
 
     a_hi = jnp.where(opt, amt_res_hi, jnp.uint64(0))
     a_lo = jnp.where(opt, amt_res_lo, jnp.uint64(0))
@@ -1507,6 +1514,9 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
             imp_lane = _flag(flags, _F_IMPORTED)
             actual_vec = jnp.where(imp_lane, ev["ts"], ts_event)
         for _round in range(limit_rounds):
+            fix_rounds = fix_rounds + (
+                jnp.int32(1) if _round == 0
+                else (~fix_converged).astype(jnp.int32))
             st_r = jnp.where(ovf_code != 0, ovf_code, status)
             st_r = jnp.where(over_dr, _TS["exceeds_credits"], st_r)
             st_r = jnp.where(over_cr & ~over_dr, _TS["exceeds_debits"],
@@ -2227,6 +2237,7 @@ def create_transfers_fast(state, ev, timestamp, n, force_fallback=None,
         # variant resolves it on device (the caller escalates before
         # touching the host path).
         fix_unconverged=(e3 & ~others & jnp.bool_(limit_rounds > 1)),
+        fix_rounds=fix_rounds,
         # Would the headroom proof have failed this batch? The adaptive
         # router drops back to the cheaper proof-gated kernel only once
         # the proof itself would pass again.

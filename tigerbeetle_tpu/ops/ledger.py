@@ -2800,6 +2800,15 @@ class DeviceLedger:
             # committed). In attach mode the PartitionedRouter owns the
             # partitioned counters; they merge in here.
             "routes": self._merged_routes(),
+            # Device telemetry (None unless a PartitionedRouter is
+            # attached with telemetry on): the decoded-on-host
+            # aggregates of the fixed-layout u32 block the fused route
+            # harvests with its outputs — exchange-occupancy histogram,
+            # fixpoint-round distribution, decoded poison causes,
+            # flight-recorder activity.
+            "device_telemetry": (
+                self._part_router.stats().get("telemetry")
+                if self._part_router is not None else None),
             # Chaos/recovery counters (zeros unless a ServingSupervisor
             # owns this ledger): retries, backoff time, replayed
             # windows, verified checksum epochs, recoveries by cause.

@@ -74,6 +74,8 @@ per-cause host fallback exactly like the replicated router's —
 
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 
 import jax
@@ -98,14 +100,15 @@ from ..ops.hash_table import (
 from ..ops.ledger import (
     N_PAD, _delta_gather_body, _pad_bucket, pad_transfer_events,
 )
-from ..trace import Event, NullTracer
+from ..trace import Event, FlightRecorder, Histogram, NullTracer
 from .full_sharded import MODES, _MODE_KWARGS, ShardedRouter
 from .shard_utils import get_shard_map, shard_of_id, shard_of_int
 
 __all__ = ["make_partitioned_create_transfers",
            "make_partitioned_chain_create_transfers",
            "stack_partitioned_window", "partitioned_from_oracle",
-           "partitioned_state_bytes", "PartitionedRouter", "MODES"]
+           "partitioned_state_bytes", "PartitionedRouter", "MODES",
+           "TEL_WORDS", "TEL_LAYOUT", "TEL_CAUSES", "decode_telemetry"]
 
 _U64_MAX = np.uint64(0xFFFFFFFFFFFFFFFF)
 _XF_DRROW_COL = XF_P32_POS["dr_row"][0]   # ("dr_row","cr_row") word
@@ -139,22 +142,95 @@ def _uniq_rows(k_hi, k_lo, active):
     return first, jnp.where(active, row, jnp.int32(-1)), n_uniq
 
 
+# ------------------------------------------------------- telemetry plane
+#
+# A fixed-layout u32 block per (shard, prepare), built from values the
+# exchange body already computes — elementwise packing only, so the
+# heavy-op identity "chain body == per-batch partitioned tier" survives
+# (gate-pinned in perf/opbudget_r*.json). Words 0-6 and 10 are
+# REPLICATED (equal on every shard: they summarize the replicated mini
+# judgment / exchange); words 7-9 and 11 are PER-SHARD.
+TEL_LAYOUT = (
+    "fix_rounds",            # 0  fixpoint rounds consumed (0 = plain)
+    "poison_cause",          # 1  priority-encoded cause, 0 = clean
+    "xchg1_occupancy",       # 2  live transfer rows in the 2N phase-1 lanes
+    "xchg1_capacity",        # 3  phase-1 lane capacity (2N)
+    "xchg2_occupancy",       # 4  distinct account keys in the 4N phase-2 lanes
+    "xchg2_capacity",        # 5  phase-2 mini capacity (4N)
+    "cross_shard_transfers",  # 6  created transfers whose dr/cr shards differ
+    "ring_occupancy",        # 7  event-ring rows after write-back (per shard)
+    "writeback_transfers",   # 8  owner-masked rows written (per shard)
+    "events_owned",          # 9  valid events routed to this shard
+    "exchange_overflow",     # 10 0/1: a phase capacity breached
+    "shard_capacity_hit",    # 11 0/1: THIS shard's store/ring/plan capacity
+)
+TEL_WORDS = len(TEL_LAYOUT)
+
+# poison_cause codes: index+1 into this tuple; the EARLIEST listed cause
+# that fired wins (a forced/transitive poison only shows when no
+# intrinsic cause explains the prepare). Mirrors out["fb_causes"] plus
+# the exchange breaches.
+TEL_CAUSES = (
+    "e1_hard_flags", "e2_collision", "e3_limit", "e4_overflow",
+    "e5_void_closing", "closing", "capacity", "forced",
+    "shard_capacity", "exchange_overflow",
+)
+
+
+@functools.partial(jax.jit, inline=False)
+def _telemetry_pack(*words):
+    """Pack the telemetry words into one u32 vector. Kept as a NAMED
+    nested jit (inline=False) so the call survives as a pjit equation
+    in the lowered jaxpr: jaxhound.telemetry_census finds it by name
+    and counts its input lanes against the committed lane budget."""
+    return jnp.stack([jnp.asarray(w).astype(jnp.uint32) for w in words])
+
+
+def _telemetry_block(out, fbc, *, n_lanes, n_a, n_live, xchg_bad,
+                     bad_l, cross_shard, ring_count, n_mine_ok, owned):
+    """Assemble the per-(shard, prepare) telemetry vector from the
+    body's existing intermediates. Elementwise ops + the pack only —
+    zero heavy-op delta, no extra collectives (per-shard words ride the
+    sh subtree the shard_map already returns)."""
+    cause = jnp.uint32(0)
+    for i, name in reversed(list(enumerate(TEL_CAUSES, start=1))):
+        cause = jnp.where(fbc[name], jnp.uint32(i), cause)
+    return _telemetry_pack(
+        out["fix_rounds"], cause,
+        n_live, jnp.int32(2 * n_lanes),
+        n_a, jnp.int32(4 * n_lanes),
+        cross_shard, ring_count, n_mine_ok, owned,
+        xchg_bad, bad_l)
+
+
+def decode_telemetry(tel) -> dict:
+    """Host-side decode: [..., TEL_WORDS] u32 -> {name: int array}.
+    The leading axes are whatever the harvest kept (shard, or
+    shard x W for the fused chain)."""
+    arr = np.asarray(tel, dtype=np.uint32)
+    assert arr.shape[-1] == TEL_WORDS, arr.shape
+    return {name: arr[..., i].astype(np.int64)
+            for i, name in enumerate(TEL_LAYOUT)}
+
+
 def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
-                            mode, force_fallback=None):
+                            mode, force_fallback=None, telemetry=True):
     """One prepare against the per-shard state `sub` (UNSTACKED
     leaves): the full exchange -> mini-state -> judge -> write-back
     anatomy of the module docstring, shared VERBATIM by the per-batch
     shard_map body and the chain route's lax.scan body (one scan
     iteration == one per-batch dispatch's ops — the budget identity
-    perf/opbudget_r09.json pins).
+    perf/opbudget_r*.json pins).
 
     `force_fallback` is the chain's rolling poison scalar: threaded
     into the judge it aborts the batch unconditionally, the masked
     write-back leaves every shard bit-identical, and the poison rides
     out through rep["fallback"] — the single-chip chain kernel's
-    transitive-poison contract. Returns (new_sub, rep, events_owned)
-    where rep is the replicated out dict and events_owned the
-    per-shard routed-event count."""
+    transitive-poison contract. Returns (new_sub, rep, events_owned,
+    tel) where rep is the replicated out dict, events_owned the
+    per-shard routed-event count, and tel the TEL_WORDS u32 telemetry
+    vector (None when `telemetry` is off — the overhead-probe
+    baseline)."""
     N = ev["id_lo"].shape[0]
     me = jax.lax.axis_index(axis)
     idxs = jnp.arange(N, dtype=jnp.int32)
@@ -423,11 +499,20 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
     rep["exchange_overflow"] = xchg_bad
     owned = jnp.sum(
         (ev["valid"] & (owner_ev == me)).astype(jnp.int32))
-    return new_sub, rep, owned
+    tel = None
+    if telemetry:
+        tel = _telemetry_block(
+            out, fbc, n_lanes=N, n_a=n_a, n_live=n_live,
+            xchg_bad=xchg_bad, bad_l=bad_l,
+            cross_shard=rep["cross_shard_transfers"],
+            ring_count=evr["count"] + n_mine_ok,
+            n_mine_ok=n_mine_ok, owned=owned)
+    return new_sub, rep, owned, tel
 
 
 def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
-                                      mode: str = "plain"):
+                                      mode: str = "plain",
+                                      telemetry: bool = True):
     """Build the jitted partitioned-state SPMD step over `mesh` for one
     kernel tier (`mode` in MODES).
 
@@ -437,7 +522,10 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
     padded batch, replicated. `out` is the single-chip out dict plus
     `flush` (the delta gather of the appended rows, replicated),
     `cross_shard_transfers`, `exchange_overflow`, and
-    `shard_stats.events_owned` (per-shard routed-event counts)."""
+    `shard_stats.events_owned` (per-shard routed-event counts). With
+    `telemetry` (the default) `shard_stats.tel` carries the
+    [n_shards, TEL_WORDS] device telemetry block; `telemetry=False` is
+    the overhead-probe baseline."""
     shard_map = get_shard_map()
     assert mode in MODES, mode
     n_dev = mesh.shape[axis]
@@ -445,10 +533,12 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
     def step(state, ev, timestamp, n):
         def body(stacked, ev):
             sub = jax.tree.map(lambda x: x[0], stacked)
-            new_sub, rep, owned = _partitioned_batch_body(
+            new_sub, rep, owned, tel = _partitioned_batch_body(
                 sub, ev, timestamp, n, axis=axis, n_dev=n_dev,
-                mode=mode)
+                mode=mode, telemetry=telemetry)
             sh = dict(events_owned=owned[None])
+            if tel is not None:
+                sh["tel"] = tel[None]
             new_stacked = jax.tree.map(lambda x: jnp.asarray(x)[None],
                                        new_sub)
             return new_stacked, {"rep": rep, "sh": sh}
@@ -475,7 +565,8 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
 
 def make_partitioned_chain_create_transfers(mesh: Mesh,
                                             axis: str = "batch",
-                                            mode: str = "plain"):
+                                            mode: str = "plain",
+                                            telemetry: bool = True):
     """Build the FUSED window step: the W prepares of a commit window
     run as a `lax.scan` over the per-batch body INSIDE one shard_map
     dispatch, with the donated sharded state and a rolling poison
@@ -494,7 +585,10 @@ def make_partitioned_chain_create_transfers(mesh: Mesh,
     shards stay bit-identical), so the clean prefix commits inside the
     one dispatch and out["fallback"] ([W], replicated) tells the host
     which suffix to re-window. Every out leaf gains a leading W axis;
-    `shard_stats.events_owned` is [n_shards, W].
+    `shard_stats.events_owned` is [n_shards, W] and (with `telemetry`,
+    the default) `shard_stats.tel` is [n_shards, W, TEL_WORDS] — the
+    whole window's per-prepare device telemetry harvested in the SAME
+    dispatch as the results.
 
     Why this exists: the per-batch route pays PERF.md's bottleneck #1
     (per-dispatch fixed cost) once per prepare; here the whole window
@@ -514,15 +608,24 @@ def make_partitioned_chain_create_transfers(mesh: Mesh,
             def scan_step(carry, xs):
                 st, poisoned = carry
                 ev_k, ts_k, n_k = xs
-                new_st, rep, owned = _partitioned_batch_body(
+                new_st, rep, owned, tel = _partitioned_batch_body(
                     st, ev_k, ts_k, n_k, axis=axis, n_dev=n_dev,
-                    mode=mode, force_fallback=poisoned)
-                return (new_st, rep["fallback"]), (rep, owned)
+                    mode=mode, force_fallback=poisoned,
+                    telemetry=telemetry)
+                ys = ((rep, owned, tel) if telemetry
+                      else (rep, owned))
+                return (new_st, rep["fallback"]), ys
 
-            (new_sub, _), (reps, owned_w) = jax.lax.scan(
+            (new_sub, _), ys_w = jax.lax.scan(
                 scan_step, (sub, poisoned0),
                 (ev_stack, ts_stack, n_stack))
+            if telemetry:
+                reps, owned_w, tel_w = ys_w
+            else:
+                reps, owned_w = ys_w
             sh = dict(events_owned=owned_w[None])
+            if telemetry:
+                sh["tel"] = tel_w[None]
             new_stacked = jax.tree.map(lambda x: jnp.asarray(x)[None],
                                        new_sub)
             return new_stacked, {"rep": reps, "sh": sh}
@@ -753,7 +856,8 @@ class PartitionedRouter:
 
     def __init__(self, mesh: Mesh, axis: str = "batch", tracer=None,
                  a_cap: int = 1 << 12, t_cap: int = 1 << 14,
-                 e_cap: int | None = None):
+                 e_cap: int | None = None, telemetry: bool = True,
+                 flight_recorder=None):
         self.mesh = mesh
         self.axis = axis
         self.tracer = tracer if tracer is not None else NullTracer()
@@ -774,6 +878,20 @@ class PartitionedRouter:
         self.events_owned = np.zeros(self.n_shards, dtype=np.int64)
         self.window_routes: dict = {}
         self.chain_batch_fallbacks: dict = {}
+        # Device telemetry plane: `telemetry` is a MAKE-TIME switch (it
+        # selects which compiled artifact the factories build — the
+        # call signatures never change), the aggregates below are what
+        # the decoded blocks accumulate into between stats() reads.
+        self.telemetry = bool(telemetry)
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FlightRecorder(pid=jax.process_index(),
+                                tracer=self.tracer)
+        self._tel_hist = Histogram()    # exchange occupancy, pct
+        self._tel_rounds = Histogram()  # fixpoint rounds per prepare
+        self.device_poison_causes: dict = {}
+        self.writeback_rows = 0
+        self.shard_capacity_hits = 0
+        self._window_seq = 0
 
     # Same flag-derived tier precedence as the replicated router.
     route = staticmethod(ShardedRouter.route)
@@ -788,7 +906,8 @@ class PartitionedRouter:
         fn = self._steps.get(mode)
         if fn is None:
             fn = self._steps[mode] = make_partitioned_create_transfers(
-                self.mesh, self.axis, mode=mode)
+                self.mesh, self.axis, mode=mode,
+                telemetry=self.telemetry)
         return fn
 
     def _chain_step(self, mode: str):
@@ -796,7 +915,8 @@ class PartitionedRouter:
         if fn is None:
             fn = self._chain_steps[mode] = \
                 make_partitioned_chain_create_transfers(
-                    self.mesh, self.axis, mode=mode)
+                    self.mesh, self.axis, mode=mode,
+                    telemetry=self.telemetry)
         return fn
 
     def drop_device(self, device, oracle=None):
@@ -804,8 +924,15 @@ class PartitionedRouter:
         else on the mesh (partitioned state), so — unlike
         ShardedRouter.drop_device — there is no single-chip reroute:
         the router refuses to serve until resynced. Passing `oracle`
-        runs the resync immediately and returns the rebuilt state."""
+        runs the resync immediately and returns the rebuilt state.
+
+        Quarantine is a flight-recorder dump point: the ring's tail is
+        the last-N windows BEFORE the loss — exactly the post-mortem
+        question — so freeze it now, while the evidence is fresh."""
         self.lost_devices.add(device)
+        self.flight.record(window=self._window_seq, route="quarantined",
+                           lost_devices=len(self.lost_devices))
+        self.flight.dump("shard_loss_quarantine")
         if oracle is not None:
             return self.resync(oracle)
         return None
@@ -815,6 +942,7 @@ class PartitionedRouter:
         the sharded state from the last verified oracle through the
         supervisor recovery path's event taxonomy (`shard_resync`
         cause). Returns the fresh stacked state."""
+        self.flight.dump("shard_resync")
         with self.tracer.span(Event.serving_recovery_replay,
                               cause="shard_resync"):
             state = self.from_oracle(oracle)
@@ -835,6 +963,79 @@ class PartitionedRouter:
                 "partitioned shard lost: resync(oracle) required — the "
                 "single-chip reroute cannot serve a lost range")
 
+    def _absorb_telemetry(self, tel):
+        """Decode one harvested telemetry block ([n_shards, W,
+        TEL_WORDS] or [n_shards, TEL_WORDS], host-local rows) into
+        tracer emissions + the router aggregates, returning the
+        per-window summary dict the flight recorder rings (None when
+        empty). Replicated words were psum'd on device, so every LOCAL
+        shard row carries the same value — max over the shard axis
+        recovers them on multi-host meshes where remote rows read zero
+        (_host_local); per-shard words stay per shard."""
+        tel = np.asarray(tel)
+        if tel.ndim == 2:
+            tel = tel[:, None, :]
+        if tel.shape[1] == 0:
+            return None
+        d = decode_telemetry(tel)
+        rep = {k: d[k].max(axis=0) for k in (
+            "fix_rounds", "poison_cause",
+            "xchg1_occupancy", "xchg1_capacity",
+            "xchg2_occupancy", "xchg2_capacity",
+            "cross_shard_transfers", "exchange_overflow")}
+        W = tel.shape[1]
+        occ_pct = []
+        causes = []
+        for w in range(W):
+            self.tracer.observe(Event.device_fixpoint_rounds,
+                                int(rep["fix_rounds"][w]))
+            self._tel_rounds.record(float(rep["fix_rounds"][w]))
+            for phase, occ, cap in (
+                    ("transfers", rep["xchg1_occupancy"][w],
+                     rep["xchg1_capacity"][w]),
+                    ("accounts", rep["xchg2_occupancy"][w],
+                     rep["xchg2_capacity"][w])):
+                pct = (100.0 * float(occ) / float(cap)) if cap else 0.0
+                pct = round(pct, 3)
+                occ_pct.append(pct)
+                self.tracer.observe(Event.device_exchange_occupancy,
+                                    pct, phase=phase)
+                self._tel_hist.record(pct)
+            code = int(rep["poison_cause"][w])
+            cause = (TEL_CAUSES[code - 1]
+                     if 0 < code <= len(TEL_CAUSES)
+                     else (f"code_{code}" if code else None))
+            causes.append(cause)
+            if cause is not None:
+                self.device_poison_causes[cause] = (
+                    self.device_poison_causes.get(cause, 0) + 1)
+                self.tracer.count(Event.device_poison_cause,
+                                  cause=cause)
+        for s in range(tel.shape[0]):
+            for w in range(W):
+                self.tracer.observe(Event.device_ring_occupancy,
+                                    int(d["ring_occupancy"][s, w]))
+        wb = int(d["writeback_transfers"].sum())
+        if wb:
+            self.writeback_rows += wb
+            self.tracer.count(Event.device_writeback_rows, value=wb)
+        self.shard_capacity_hits += int(d["shard_capacity_hit"].sum())
+        return {
+            "prepares": W,
+            "fix_rounds": [int(x) for x in rep["fix_rounds"]],
+            "poison_causes": causes,
+            "exchange_occupancy_pct": occ_pct,
+            "cross_shard_transfers": int(
+                rep["cross_shard_transfers"].sum()),
+            "exchange_overflows": int(rep["exchange_overflow"].sum()),
+            "shard_capacity_hits": int(d["shard_capacity_hit"].sum()),
+            "writeback_rows": wb,
+            "events_owned": [int(x)
+                             for x in d["events_owned"].sum(axis=1)],
+            "ring_occupancy": [int(x)
+                               for x in d["ring_occupancy"][:, -1]],
+        }
+
     def step(self, state, ev: dict, timestamp: int, n: int):
         """Run one padded batch. Returns (new_state, out, fell_back).
         On fell_back=True the state is untouched (masked writes on
@@ -851,12 +1052,29 @@ class PartitionedRouter:
                 (out["fallback"], out["limit_only"])))
             if fallback and limit_only and mode == "plain":
                 self.escalations += 1
+                mode = "fixpoint"
                 new_state, out = self._step("fixpoint")(
                     new_state, ev, np.uint64(timestamp), np.int32(n))
                 fallback = bool(jax.device_get(out["fallback"]))
-        xs, ov = jax.device_get(
-            (out["cross_shard_transfers"], out["exchange_overflow"]))
-        owned = _host_local(out["shard_stats"]["events_owned"])
+        if self.telemetry:
+            # The harvested block IS the probe (satellite contract: no
+            # host-side recomputation of shard balance) — the shard
+            # diagnostics below decode from the same device words the
+            # tracer events and the flight recorder see.
+            tel = _host_local(out["shard_stats"]["tel"])
+            d = decode_telemetry(tel)
+            xs = int(d["cross_shard_transfers"].max())
+            ov = int(d["exchange_overflow"].max())
+            owned = d["events_owned"]
+            summary = self._absorb_telemetry(tel)
+            self.flight.record(window=self._window_seq,
+                               route="partitioned_" + mode,
+                               telemetry=summary)
+        else:
+            xs, ov = (int(x) for x in jax.device_get(
+                (out["cross_shard_transfers"],
+                 out["exchange_overflow"])))
+            owned = _host_local(out["shard_stats"]["events_owned"])
         if int(xs):
             self.cross_shard_transfers += int(xs)
             self.tracer.count(Event.cross_shard_transfers,
@@ -877,6 +1095,7 @@ class PartitionedRouter:
     def _count_window(self, route: str) -> None:
         self.window_routes[route] = (
             self.window_routes.get(route, 0) + 1)
+        self._window_seq += 1
 
     def chain_dispatch(self, state, evs: list[dict],
                        timestamps: list[int], n_pad: int | None = None,
@@ -906,18 +1125,46 @@ class PartitionedRouter:
         ([0, k) prepares) and, when k < n_prepares, the per-prepare
         fallback causes at iteration k (later iterations only carry
         the transitive poison). The replayed suffix counts itself
-        through the per-batch step."""
+        through the per-batch step.
+
+        With telemetry on, every counter here decodes from the
+        harvested device block — the cross-shard/ownership words, the
+        committed prefix's per-prepare rounds and occupancies (tracer
+        histograms), and iteration k's poison cause — and the window
+        lands one flight-recorder record."""
         self.batches += k
+        tel = None
+        if self.telemetry and "tel" in out.get("shard_stats", {}):
+            tel = _host_local(out["shard_stats"]["tel"])
         if k:
-            xs = int(np.asarray(jax.device_get(
-                out["cross_shard_transfers"]))[:k].sum())
+            if tel is not None:
+                d = decode_telemetry(tel[:, :k])
+                xs = int(d["cross_shard_transfers"].max(axis=0).sum())
+                owned = d["events_owned"].sum(axis=1)
+                self.exchange_overflows += int(
+                    d["exchange_overflow"].max(axis=0).sum())
+            else:
+                xs = int(np.asarray(jax.device_get(
+                    out["cross_shard_transfers"]))[:k].sum())
+                owned = _host_local(
+                    out["shard_stats"]["events_owned"])[:, :k].sum(
+                        axis=1)
             if xs:
                 self.cross_shard_transfers += xs
                 self.tracer.count(Event.cross_shard_transfers,
                                   value=xs)
-            owned = _host_local(out["shard_stats"]["events_owned"])
-            self.events_owned += owned[:, :k].sum(
-                axis=1).astype(np.int64)
+            self.events_owned += np.asarray(owned, dtype=np.int64)
+        if tel is not None:
+            # Emit the committed prefix's per-prepare telemetry; when
+            # the window poisoned at k, fold iteration k in too — its
+            # decoded cause code is the post-mortem headline (later
+            # iterations only carry the transitive `forced` poison).
+            upto = min(k + 1, n_prepares) if k < n_prepares else k
+            summary = self._absorb_telemetry(tel[:, :upto])
+            self.flight.record(
+                window=self._window_seq, route="partitioned_chain",
+                telemetry=summary, prepares=n_prepares,
+                committed_prefix=k)
         if k < n_prepares:
             for cause, v in jax.device_get(out["fb_causes"]).items():
                 if bool(np.asarray(v)[k]):
@@ -1015,5 +1262,20 @@ class PartitionedRouter:
                 "windows": dict(self.window_routes),
                 "chain_batch_fallbacks": dict(
                     self.chain_batch_fallbacks),
+            },
+            # Device telemetry plane: everything below decodes from the
+            # fixed-layout u32 block harvested with the outputs —
+            # measured on device, never host-side guesswork. The
+            # exchange-occupancy histogram dict is what the SLO
+            # engine's exchange-headroom burn objective reads
+            # (trace/slo.py evaluate_bench_record).
+            "telemetry": None if not self.telemetry else {
+                "device_poison_causes": dict(self.device_poison_causes),
+                "writeback_rows": int(self.writeback_rows),
+                "shard_capacity_hits": int(self.shard_capacity_hits),
+                "exchange_occupancy": self._tel_hist.to_dict(),
+                "fixpoint_rounds": self._tel_rounds.summary(),
+                "flight_windows": self.flight.seq,
+                "flight_dumps": self.flight.dumps,
             },
         }

@@ -50,7 +50,7 @@ from dataclasses import dataclass
 
 from .ops.ledger import DeviceLedger, MirrorDivergence, default_recovery_stats
 from .oracle.state_machine import StateMachineOracle
-from .trace import Event, NullTracer
+from .trace import Event, FlightRecorder, NullTracer
 
 
 class TransientDispatchError(RuntimeError):
@@ -151,9 +151,16 @@ class ServingSupervisor:
     def __init__(self, a_cap: int = 1 << 17, t_cap: int = 1 << 21, *,
                  epoch_interval: int = 8, retry: RetryPolicy | None = None,
                  seed: int = 0, mirror_audit: str = "full",
-                 fault_hook=None, sleep=time.sleep, tracer=None):
+                 fault_hook=None, sleep=time.sleep, tracer=None,
+                 flight_recorder=None):
         assert mirror_audit in ("full", "spot", "off")
         self.tracer = tracer if tracer is not None else NullTracer()
+        # Flight recorder: every window's route decision and every
+        # verified epoch digest ring here; any recovery — including
+        # retry exhaustion (dispatch_exhausted / dispatch_deadline) —
+        # freezes the ring into a post-mortem artifact.
+        self.flight = flight_recorder if flight_recorder is not None \
+            else FlightRecorder(tracer=self.tracer)
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.epoch_interval = epoch_interval
@@ -229,6 +236,8 @@ class ServingSupervisor:
                 if tier:
                     sp.tags["tier"] = tier
                 self.tracer.count(Event.dispatch_route, route=route)
+        self.flight.record(window=win, route=route or "unknown",
+                           prepares=len(batches))
         norm = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts.tolist())]
                 for st, ts in out]
         self.log.append(("window", batches, timestamps))
@@ -321,6 +330,9 @@ class ServingSupervisor:
                 detail = ",".join(bad)
         if cause is None:
             self.counters["epochs_verified"] += 1
+            self.flight.record(window=self.windows_total,
+                               route="epoch_verified",
+                               epoch_digest=got)
             self.log.clear()
             self._windows_since_epoch = 0
             return True
@@ -380,7 +392,16 @@ class ServingSupervisor:
         """Quarantine the device state and recover from the last
         verified epoch: oracle-replay the logged suffix (bounded),
         revise the authoritative history, rebuild mirror + device from
-        the recovered oracle, resume serving."""
+        the recovered oracle, resume serving.
+
+        Recovery is THE flight-recorder dump point: freeze the
+        last-N window records (+ epoch digests) as a JSON artifact
+        tagged with the recovery cause before anything is rebuilt —
+        covering retry exhaustion, deadline, divergence, and
+        drain-fault causes alike."""
+        self.flight.record(window=self.windows_total, route="recovery",
+                           cause=cause, detail=detail[:200])
+        self.flight.dump(cause)
         self.tracer.count(Event.serving_recoveries, cause=cause)
         with self.tracer.span(Event.serving_recovery_replay, cause=cause):
             self._recover_replay(cause, detail, replayed)
@@ -424,5 +445,8 @@ class ServingSupervisor:
         out["windows_total"] = self.windows_total
         out["windows_since_epoch"] = self._windows_since_epoch
         out["last_recovery"] = self.last_recovery
+        out["flight"] = {"windows_recorded": self.flight.seq,
+                         "dumps": self.flight.dumps,
+                         "last_dump": self.flight.last_dump_path}
         out["ledger"] = self.led.fallback_stats()
         return out

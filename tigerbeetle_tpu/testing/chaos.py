@@ -35,11 +35,15 @@ kernel):
                     PARTITIONED router: the lost account range exists
                     nowhere else, so the router must refuse to serve
                     until a bounded oracle-replay resync rebuilds the
-                    sharded state (`shard_resync` recovery cause).
+                    sharded state (`shard_resync` recovery cause). The
+                    quarantine must also freeze the flight-recorder
+                    ring into an on-disk artifact whose last record is
+                    the failing window — asserted here.
 """
 
 from __future__ import annotations
 
+import json
 import os
 import random
 
@@ -527,7 +531,25 @@ def shard_resync_scenario(seed: int, mesh=None) -> dict:
         evp = pad_transfer_events(transfers_to_arrays(events), 1024)
         if step_i == 1:
             dropped = mesh.devices.flat[rng.randrange(mesh.size)]
+            window_at_loss = router._window_seq
+            dumps0 = router.flight.dumps
             router.drop_device(dropped)
+            # Quarantine is a flight-recorder dump point: the artifact
+            # must exist on disk and its LAST record must be the
+            # quarantine marker for the failing window — the post-mortem
+            # contract the recorder exists for.
+            assert router.flight.dumps == dumps0 + 1
+            flight_path = router.flight.last_dump_path
+            assert flight_path and os.path.exists(flight_path), \
+                (f"chaos seed {seed}: quarantine produced no flight "
+                 f"artifact (path={flight_path!r})")
+            with open(flight_path) as f:
+                flight_doc = json.load(f)
+            assert flight_doc["reason"] == "shard_loss_quarantine"
+            last = flight_doc["records"][-1]
+            assert last["route"] == "quarantined", last
+            assert last["window"] == window_at_loss, \
+                (last["window"], window_at_loss)
             # A lost range is NOT servable: the quarantine must be loud.
             try:
                 router.step(state, evp, ts, n)
@@ -538,6 +560,7 @@ def shard_resync_scenario(seed: int, mesh=None) -> dict:
                     f"chaos seed {seed}: partitioned router served "
                     "with a lost shard")
             state = router.resync(oracle)
+            assert router.flight.dumps == dumps0 + 2  # resync dumps too
         state, out, fell = router.step(state, evp, ts, n)
         assert not fell, \
             f"chaos seed {seed}: unexpected partitioned fallback"
@@ -553,7 +576,8 @@ def shard_resync_scenario(seed: int, mesh=None) -> dict:
     assert resyncs == 1, resyncs
     assert router.host_fallbacks == fallbacks0, "resync run fell back"
     return dict(devices=int(mesh.size), dropped=str(dropped),
-                resyncs=resyncs)
+                resyncs=resyncs,
+                flight_dump=os.path.basename(flight_path))
 
 
 # ------------------------------------------------------------- CI gate

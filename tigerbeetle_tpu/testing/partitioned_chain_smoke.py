@@ -168,15 +168,18 @@ def partitioned_chain_smoke() -> None:
     dd = partitioned_state_digest(st_c)
     assert dd == partitioned_state_digest(st_b)
     assert dd == partitioned_oracle_digest(orc_c, A_CAP, n_dev), dd
-    # The committed budget file must CARRY the fused tiers (a rollback
-    # would silently un-gate the route); values are the opbudget leg's.
-    repo = os.path.dirname(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))))
-    with open(os.path.join(repo, "perf", "opbudget_r09.json")) as f:
+    # The NEWEST committed budget file must CARRY the fused tiers (a
+    # rollback would silently un-gate the route); values are the
+    # opbudget leg's.
+    from ..jaxhound import newest_budget_path
+
+    bpath = newest_budget_path()
+    with open(bpath) as f:
         budget = json.load(f)["budget"]
     for tier in ("partitioned_chain_w2", "partitioned_chain_w8",
                  "partitioned_chain_w32", "partitioned_chain_body"):
-        assert tier in budget, f"opbudget_r09.json lacks {tier}"
+        assert tier in budget, \
+            f"{os.path.basename(bpath)} lacks {tier}"
     assert (budget["partitioned_chain_body"]["heavy_total"]
             == budget["partitioned_plain"]["heavy_total"]), \
         "fused body must cost exactly the per-batch partitioned tier"

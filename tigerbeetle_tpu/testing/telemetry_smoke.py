@@ -1,0 +1,444 @@
+"""Device-telemetry smoke: the gate's oracle for the round-10
+telemetry plane on the fused partitioned-chain route.
+
+Four legs, one entry point (``telemetry_smoke()``):
+
+  1. BIT-EXACT DECODE — drive ONE fused shard_map+lax.scan dispatch
+     per window on 1/2/8-device meshes and assert every word of the
+     harvested ``shard_stats.tel`` block (decode_telemetry) against a
+     pure-host recomputation from the transfer lists + a live-row
+     mirror: fixpoint rounds (0 on the plain chain), the
+     priority-encoded poison cause (e3_limit at the poisoned prepare,
+     `forced` on the transitive suffix), both exchange phases'
+     occupancy/capacity (distinct live transfer keys over the 2N
+     lanes, distinct active account keys over the 4N lanes),
+     cross-shard transfer counts, per-shard ownership/write-back, and
+     the event-ring's write-back deltas. The per-batch escalation
+     replay is checked too: its block must show fix_rounds >= 1 and a
+     clean cause.
+  2. LANE CENSUS — jaxhound.telemetry_census over the fused route's
+     jaxpr vs the committed budget's `telemetry` section (the pack
+     cannot grow a word or smuggle ops silently).
+  3. NEGATIVE — a deliberately grown (TEL_WORDS+1)-lane pack traced
+     through the same census must RED perf/opbudget.check_telemetry,
+     and the real census must pass it (the gate leg's check is alive
+     in both directions).
+  4. OVERHEAD RATIO — fused dispatch wall-clock with telemetry on vs
+     off (same windows, separate donated states), min-of-reps; the
+     ratio must stay under the budget's `overhead_ratio_max`.
+
+Run via ``scripts/gate.py`` (skip with --no-telemetry) or directly:
+``python -c "from tigerbeetle_tpu.testing import telemetry_smoke as
+s; s.telemetry_smoke()"``.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import time
+
+import numpy as np
+
+SEED = 41
+A_CAP, T_CAP = 1 << 9, 1 << 11
+_CREATED = (1 << 32) - 1  # CreateTransferStatus.created wire code
+
+
+def _new_ledger(n_dev):
+    """Oracle + PartitionedRouter + sharded state on an n_dev mesh
+    (accounts 1-40, ids <= 4 debit-limited: the poison lever)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from ..oracle import StateMachineOracle
+    from ..parallel.partitioned import PartitionedRouter
+    from ..types import Account, AccountFlags
+
+    mesh = Mesh(np.array(jax.devices()[:n_dev]), ("batch",))
+    accts = [Account(id=i, ledger=1, code=1,
+                     flags=(int(AccountFlags.debits_must_not_exceed_credits)
+                            if i <= 4 else 0))
+             for i in range(1, 41)]
+    orc = StateMachineOracle()
+    orc.create_accounts(accts, 50)
+    rt = PartitionedRouter(mesh, a_cap=A_CAP, t_cap=T_CAP)
+    return orc, rt, rt.from_oracle(orc)
+
+
+class _WindowBuilder:
+    """Fresh-id prepares with (on multi-device meshes) every dr/cr
+    pair forced CROSS-SHARD, so the cross_shard_transfers word carries
+    a non-trivial count. Same workload shape as
+    partitioned_chain_smoke."""
+
+    def __init__(self, rng, n_dev):
+        self.rng = rng
+        self.n_dev = n_dev
+        self.nid = 10 ** 6
+        self.ts = 10 ** 9
+
+    def _pairs(self, count):
+        from ..parallel.shard_utils import shard_of_int
+
+        # Clean prepares NEVER debit a limited account (ids <= 4): an
+        # unfunded DR_LIMIT debit is a legitimate e3 fallback, and
+        # these prepares must stay clean so the expected poison causes
+        # are exactly the injected ones.
+        out = []
+        drs, crs = list(range(5, 41)), list(range(1, 41))
+        while len(out) < count:
+            dr = int(self.rng.choice(drs))
+            cr = int(self.rng.choice(crs))
+            if cr != dr and (
+                    self.n_dev == 1
+                    or shard_of_int(dr, self.n_dev) !=
+                    shard_of_int(cr, self.n_dev)):
+                out.append((dr, cr))
+        return out
+
+    def prepare(self, n=8, poison=False, flags=0):
+        from ..types import Transfer
+
+        evs = [Transfer(id=self.nid + i, debit_account_id=dr,
+                        credit_account_id=cr,
+                        amount=int(self.rng.integers(1, 30)), ledger=1,
+                        code=1, flags=flags)
+               for i, (dr, cr) in enumerate(self._pairs(n))]
+        self.nid += n
+        if poison:
+            # Debit off a DR_LIMIT account beyond its funded credits:
+            # the plain headroom proof falls back limit_only (e3),
+            # poisoning the chain at this prepare.
+            evs.append(Transfer(id=self.nid, debit_account_id=1,
+                                credit_account_id=9, amount=10 ** 6,
+                                ledger=1, code=1))
+            self.nid += 1
+        self.ts += 300
+        return evs, self.ts
+
+    def closes(self, pendings):
+        from ..types import Transfer, TransferFlags as TF
+
+        evs = [Transfer(id=self.nid + i, pending_id=p.id,
+                        amount=((1 << 128) - 1) if i % 2 == 0 else 0,
+                        flags=int(TF.post_pending_transfer if i % 2 == 0
+                                  else TF.void_pending_transfer))
+               for i, p in enumerate(pendings)]
+        self.nid += len(evs)
+        self.ts += 300
+        return evs, self.ts
+
+
+def _expected_words(evs, live, n_pad, n_dev, created_ids):
+    """Host recomputation of one prepare's telemetry words from the
+    transfer list, the live-row mirror AT ENTRY (id -> (dr, cr) of
+    stored transfers) and the set of ids the prepare actually created
+    (empty for poisoned/forced prepares: their statuses are zeroed and
+    every write is masked)."""
+    from ..parallel.shard_utils import shard_of_int
+
+    ids = [int(e.id) for e in evs]
+    pids = [int(e.pending_id) for e in evs]
+    # Phase 1: distinct LIVE transfer keys among the [id | pending_id]
+    # lanes (fresh ids are absent; referenced pendings are live rows).
+    n_live = len({k for k in ids + pids if k and k in live})
+    # Phase 2: distinct ACTIVE account keys among [ev.dr | ev.cr |
+    # p.dr | p.cr] — the pending halves come off the phase-1 exchange,
+    # so only live pendings contribute their accounts. Zero keys are
+    # absent (padded lanes, closes' inherited accounts).
+    accts = set()
+    for e in evs:
+        for a in (int(e.debit_account_id), int(e.credit_account_id)):
+            if a:
+                accts.add(a)
+    for p in pids:
+        if p in live:
+            accts.update(a for a in live[p] if a)
+    owned = [0] * n_dev
+    wb = [0] * n_dev
+    cross = 0
+    for e in evs:
+        owned[shard_of_int(int(e.id), n_dev)] += 1
+        if int(e.id) in created_ids:
+            wb[shard_of_int(int(e.id), n_dev)] += 1
+            dr, cr = int(e.debit_account_id), int(e.credit_account_id)
+            if shard_of_int(dr, n_dev) != shard_of_int(cr, n_dev):
+                cross += 1
+    return dict(xchg1_occupancy=n_live, xchg1_capacity=2 * n_pad,
+                xchg2_occupancy=len(accts), xchg2_capacity=4 * n_pad,
+                cross_shard_transfers=cross, events_owned=owned,
+                writeback_transfers=wb)
+
+
+def _oracle_check(n_dev) -> None:
+    """Leg 1: the harvested block of one fused dispatch, word by word,
+    against the host recomputation — clean two-phase window, then a
+    window poisoned mid-stream, then the per-batch fixpoint replay."""
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import _pad_bucket, pad_transfer_events
+    from ..parallel.partitioned import (
+        TEL_CAUSES, _host_local, decode_telemetry)
+    from ..types import TransferFlags as TF
+
+    rng = np.random.default_rng(SEED)
+    orc, rt, st = _new_ledger(n_dev)
+    wb_ = _WindowBuilder(rng, n_dev)
+    live: dict[int, tuple[int, int]] = {}
+
+    def commit_oracle(evs, t):
+        res = orc.create_transfers(evs, t)
+        created = {int(e.id) for e, r in zip(evs, res)
+                   if int(r.status) == _CREATED}
+        for e in evs:
+            if int(e.id) in created:
+                live[int(e.id)] = (int(e.debit_account_id),
+                                   int(e.credit_account_id))
+        return created
+
+    def dispatch(state, w, tss, n_pad):
+        arrays = [transfers_to_arrays(e) for e in w]
+        state, out = rt.chain_dispatch(state, arrays, tss, n_pad)
+        tel = _host_local(out["shard_stats"]["tel"])
+        return state, out, decode_telemetry(tel)
+
+    def check_prepare(d, w, exp, cause_code, clean):
+        def rep(name):  # replicated word: every shard row agrees
+            col = np.asarray(d[name])[:, w]
+            assert (col == col.max()).all(), (name, w, col)
+            return int(col.max())
+
+        assert rep("fix_rounds") == 0, (w, d["fix_rounds"])
+        assert rep("poison_cause") == cause_code, \
+            (w, rep("poison_cause"), cause_code)
+        for k in ("xchg1_occupancy", "xchg1_capacity",
+                  "xchg2_occupancy", "xchg2_capacity"):
+            assert rep(k) == exp[k], (w, k, rep(k), exp[k])
+        assert rep("exchange_overflow") == 0, w
+        assert rep("cross_shard_transfers") == \
+            (exp["cross_shard_transfers"] if clean else 0), w
+        for s in range(n_dev):
+            assert int(d["events_owned"][s, w]) == \
+                exp["events_owned"][s], (s, w)
+            assert int(d["writeback_transfers"][s, w]) == \
+                (exp["writeback_transfers"][s] if clean else 0), (s, w)
+            assert int(d["shard_capacity_hit"][s, w]) == 0, (s, w)
+
+    def check_ring(d, wbs):
+        # The ring word is CUMULATIVE (count after write-back): its
+        # per-prepare deltas must equal the expected write-backs.
+        ring = np.asarray(d["ring_occupancy"])
+        for s in range(n_dev):
+            assert int(ring[s, 0]) >= wbs[0][s], s
+            for w in range(1, len(wbs)):
+                assert int(ring[s, w]) - int(ring[s, w - 1]) == \
+                    wbs[w][s], (s, w, ring[s], wbs)
+
+    # ---- window A: clean two-phase (pendings -> plain -> closes) —
+    # prepare 2's n_live must see prepare 0's pendings through the
+    # in-dispatch scan carry.
+    p0, t0 = wb_.prepare(flags=int(TF.pending))
+    p1, t1 = wb_.prepare()
+    p2, t2 = wb_.closes(p0)
+    wA, tA = [p0, p1, p2], [t0, t1, t2]
+    n_pad = _pad_bucket(max(len(e) for e in wA))
+    exps, wbs = [], []
+    for evs, t in zip(wA, tA):
+        live_before = dict(live)
+        created = commit_oracle(evs, t)
+        exps.append(_expected_words(evs, live_before, n_pad, n_dev,
+                                    created))
+        wbs.append(exps[-1]["writeback_transfers"])
+    st, out, d = dispatch(st, wA, tA, n_pad)
+    assert not np.asarray(out["fallback"]).any(), "clean window fell back"
+    for w, exp in enumerate(exps):
+        check_prepare(d, w, exp, 0, clean=True)
+    check_ring(d, wbs)
+    assert exps[2]["xchg1_occupancy"] == len(p0), \
+        "closes prepare must see every pending as a live phase-1 key"
+
+    # ---- window B: poisoned at prepare 1 (e3 limit cascade); prepare
+    # 2 carries only the transitive `forced` poison.
+    wB, tB = [], []
+    for b in range(3):
+        evs, t = wb_.prepare(poison=(b == 1))
+        wB.append(evs)
+        tB.append(t)
+    n_pad_b = _pad_bucket(max(len(e) for e in wB))
+    exps_b, wbs_b = [], []
+    for b, (evs, t) in enumerate(zip(wB, tB)):
+        live_before = dict(live)
+        created = commit_oracle(evs, t) if b == 0 else set()
+        exps_b.append(_expected_words(evs, live_before, n_pad_b, n_dev,
+                                      created))
+        wbs_b.append(exps_b[-1]["writeback_transfers"])
+    st, out, d = dispatch(st, wB, tB, n_pad_b)
+    fb = [bool(x) for x in np.asarray(out["fallback"])]
+    assert fb == [False, True, True], fb
+    e3_code = TEL_CAUSES.index("e3_limit") + 1
+    forced_code = TEL_CAUSES.index("forced") + 1
+    check_prepare(d, 0, exps_b[0], 0, clean=True)
+    check_prepare(d, 1, exps_b[1], e3_code, clean=False)
+    check_prepare(d, 2, exps_b[2], forced_code, clean=False)
+    check_ring(d, wbs_b)
+
+    # ---- prepare 1 replays per-batch: plain falls back limit_only,
+    # the router escalates to the FIXPOINT tier on device, and the
+    # replay's harvested block must show the rounds it consumed.
+    evs, t = wB[1], tB[1]
+    live_before = dict(live)
+    created = commit_oracle(evs, t)
+    assert len(created) == len(evs) - 1, "poison event must fail"
+    exp = _expected_words(evs, live_before, n_pad_b, n_dev, created)
+    pe = pad_transfer_events(transfers_to_arrays(evs), n_pad_b)
+    ring_before = np.asarray(d["ring_occupancy"])[:, 0]
+    st, out1, fell_back = rt.step(st, pe, t, len(evs))
+    assert not fell_back, rt.stats()
+    assert rt.escalations >= 1, rt.stats()
+    d1 = decode_telemetry(_host_local(out1["shard_stats"]["tel"]))
+    assert int(d1["fix_rounds"].max()) >= 1, \
+        "the escalated replay must report its fixpoint rounds"
+    assert int(d1["poison_cause"].max()) == 0, d1["poison_cause"]
+    assert int(d1["cross_shard_transfers"].max()) == \
+        exp["cross_shard_transfers"]
+    for s in range(n_dev):
+        assert int(d1["writeback_transfers"][s]) == \
+            exp["writeback_transfers"][s], s
+        assert int(d1["ring_occupancy"][s]) == \
+            int(ring_before[s]) + exp["writeback_transfers"][s], s
+    print(f"[telemetry-smoke] mesh {n_dev}: harvested block bit-exact "
+          "vs host recomputation (clean + poisoned + escalated replay)")
+
+
+def _census_check() -> dict:
+    """Leg 2: the fused route's telemetry-lane census vs the committed
+    budget (jaxhound.telemetry_census finds the named pack)."""
+    import jax
+
+    from .. import jaxhound
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import _pad_bucket
+    from ..parallel.partitioned import stack_partitioned_window
+
+    with open(jaxhound.newest_budget_path()) as f:
+        committed = json.load(f)["telemetry"]
+    n_dev = min(8, len(jax.devices()))
+    rng = np.random.default_rng(SEED)
+    _, rt, st = _new_ledger(n_dev)
+    wb_ = _WindowBuilder(rng, n_dev)
+    w, tss = zip(*[wb_.prepare() for _ in range(2)])
+    arrays = [transfers_to_arrays(e) for e in w]
+    ev_p, ts_p, n_p = stack_partitioned_window(
+        arrays, list(tss), _pad_bucket(8))
+    cstep = rt._chain_step("plain")
+    with rt.mesh:
+        cj = jax.make_jaxpr(
+            lambda s, e, t, nn: cstep.__wrapped__(s, e, t, nn, None))(
+                st, ev_p, ts_p, n_p)
+    census = jaxhound.telemetry_census(cj)
+    assert census["sites"] >= 1, \
+        "telemetry pack missing from the fused route (dead plane)"
+    assert census["lanes"] == committed["lanes"], (census, committed)
+    assert census["ops"] <= committed["pack_ops"], (census, committed)
+    return census
+
+
+def _negative_check(real_census: dict) -> None:
+    """Leg 3: a pack grown by one word, traced through the SAME
+    census, must red perf/opbudget.check_telemetry — and the real
+    census must pass it."""
+    import jax
+    import jax.numpy as jnp
+
+    from .. import jaxhound
+    from ..parallel.partitioned import TEL_WORDS, _telemetry_pack
+
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    spec = importlib.util.spec_from_file_location(
+        "_opbudget_for_telemetry_smoke",
+        os.path.join(root, "perf", "opbudget.py"))
+    ob = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(ob)
+
+    grown = jax.make_jaxpr(lambda: _telemetry_pack(
+        *[jnp.uint32(i) for i in range(TEL_WORDS + 1)]))()
+    gc = jaxhound.telemetry_census(grown)
+    assert gc["lanes"] == TEL_WORDS + 1, gc
+    reds = ob.check_telemetry({
+        "lanes": gc["lanes"], "pack_sites": gc["sites"],
+        "pack_ops": gc["ops"], "chain_body_heavy_delta": 0})
+    assert reds and any("lanes" in r for r in reds), \
+        f"an over-budget telemetry lane must red the gate: {reds}"
+    clean = ob.check_telemetry({
+        "lanes": real_census["lanes"],
+        "pack_sites": real_census["sites"],
+        "pack_ops": real_census["ops"], "chain_body_heavy_delta": 0})
+    assert clean == [], clean
+
+
+def _overhead_check(reps: int = 5) -> float:
+    """Leg 4: fused dispatch wall-clock, telemetry on vs off. Same
+    windows against two separately-donated states, min-of-reps per arm
+    (the low-noise estimator); rep 0 is the compile warm-up."""
+    import jax
+
+    from .. import jaxhound
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import _pad_bucket
+    from ..parallel.partitioned import (
+        make_partitioned_chain_create_transfers, stack_partitioned_window)
+
+    with open(jaxhound.newest_budget_path()) as f:
+        ratio_max = json.load(f)["telemetry"]["overhead_ratio_max"]
+    n_dev = min(8, len(jax.devices()))
+    rng = np.random.default_rng(SEED + 1)
+    orc, rt, st = _new_ledger(n_dev)
+    steps = {on: make_partitioned_chain_create_transfers(
+        rt.mesh, telemetry=on) for on in (True, False)}
+    states = {True: st, False: rt.from_oracle(orc)}
+    wb_ = _WindowBuilder(rng, n_dev)
+    W, NB = 4, 8
+    n_pad = _pad_bucket(NB)
+    stacks = []
+    for _ in range(reps + 1):
+        w, tss = zip(*[wb_.prepare(NB) for _ in range(W)])
+        arrays = [transfers_to_arrays(e) for e in w]
+        stacks.append(stack_partitioned_window(arrays, list(tss),
+                                               n_pad))
+    times = {True: [], False: []}
+    for r, (ev_p, ts_p, n_p) in enumerate(stacks):
+        for on in (True, False):
+            t0 = time.perf_counter()
+            new_st, out = steps[on](states[on], ev_p, ts_p, n_p, None)
+            jax.block_until_ready(out["r_status"])
+            dt = time.perf_counter() - t0
+            states[on] = new_st
+            if r:  # rep 0 compiles
+                times[on].append(dt)
+    ratio = min(times[True]) / min(times[False])
+    assert ratio <= ratio_max, (
+        f"telemetry overhead ratio {ratio:.3f} > {ratio_max} "
+        f"(on={min(times[True]) * 1e3:.2f} ms, "
+        f"off={min(times[False]) * 1e3:.2f} ms per window)")
+    return ratio
+
+
+def telemetry_smoke() -> None:
+    import jax
+
+    n_avail = len(jax.devices())
+    sizes = [s for s in (1, 2, 8) if s <= n_avail]
+    for n_dev in sizes:
+        _oracle_check(n_dev)
+    census = _census_check()
+    _negative_check(census)
+    ratio = _overhead_check()
+    print(f"[telemetry-smoke] ok: bit-exact decode on meshes {sizes}, "
+          f"lane census == committed, over-budget pack reds, overhead "
+          f"ratio {ratio:.3f} within budget")
+
+
+if __name__ == "__main__":
+    telemetry_smoke()

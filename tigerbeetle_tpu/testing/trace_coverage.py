@@ -340,8 +340,12 @@ def _scenario_router(col: _Collector) -> None:
 def _scenario_partitioned(col: _Collector) -> None:
     """PartitionedRouter on whatever mesh exists: a cross-shard step
     (shard_exchange span + cross_shard_transfers counter + the
-    partitioned_* dispatch route), then a shard loss -> resync through
-    the shard_resync recovery cause."""
+    partitioned_* dispatch route + the device-telemetry observations:
+    fixpoint rounds, exchange occupancy, ring occupancy, write-back
+    rows), a duplicate-id hard collision (the harvested block's poison
+    cause -> device_poison_cause), then a shard loss -> resync through
+    the shard_resync recovery cause — whose quarantine freezes the
+    flight ring (flight_recorder_dump)."""
     import jax
     import numpy as np
     from jax.sharding import Mesh
@@ -385,6 +389,15 @@ def _scenario_partitioned(col: _Collector) -> None:
         ledger=1, code=1)], ts)
     if n_dev > 1:
         assert router.cross_shard_transfers >= 1, router.stats()
+    # A duplicate-id pair is a hard e2 collision: the harvested block
+    # carries a nonzero poison-cause word, so device_poison_cause is
+    # guaranteed on-catalog-live even in an otherwise healthy sweep.
+    dup = [Transfer(id=20, debit_account_id=dr, credit_account_id=cr,
+                    amount=1, ledger=1, code=1),
+           Transfer(id=20, debit_account_id=dr, credit_account_id=cr,
+                    amount=1, ledger=1, code=1)]
+    state, _, fell = batch(dup, ts + 100)
+    assert fell and router.device_poison_causes, router.stats()
     router.drop_device(mesh.devices.flat[0])
     state = router.resync(oracle)
     assert router.shard_resyncs == 1
@@ -417,6 +430,10 @@ def _scenario_slo(col: _Collector) -> None:
     with tracer.span(Ev.serving_dispatch, what="window"):
         pass
     tracer.observe(Ev.serving_replay_windows, 2)
+    # The exchange-headroom objective reads the device-telemetry plane's
+    # occupancy observations (both psum phases of the fused route).
+    tracer.observe(Ev.device_exchange_occupancy, 37.5, phase="transfers")
+    tracer.observe(Ev.device_exchange_occupancy, 12.5, phase="accounts")
     rows = evaluate(tracer, cfg["objectives"], emit_to=tracer)
     assert all(r["ok"] is not None for r in rows), rows
     forced = [dataclasses.replace(o, threshold=-1.0)

@@ -19,6 +19,10 @@ Layout mirrors the reference:
   exact offline span quantiles, and p99 critical-path attribution.
 - `slo.py`    — objectives from perf/slo.json, evaluation against live
   histograms, and run-granular burn-rate accounting.
+- `flight_recorder.py` — bounded per-replica ring of per-window device
+  telemetry + route decisions + epoch digests, dumped as a JSON
+  artifact on quarantine/recovery/retry-exhaustion, with lossless
+  cross-replica merge via the shared histogram layout.
 
 The tracer is injected at construction into the replica, journal, grid
 scrubber, message bus, serving supervisor, and sharded router; see
@@ -26,6 +30,7 @@ docs/operating/monitoring.md for the operator-facing catalog.
 """
 
 from .event import CATALOG, TID_BASE, Event, EventKind, EventSpec, lookup
+from .flight_recorder import FlightRecorder, merge_flight_records
 from .histogram import Histogram
 from .merge import (CRITICAL_PATH_STAGES, critical_path, merge_trace_files,
                     merge_traces, span_quantile)
@@ -36,6 +41,7 @@ from .tracer import NullTracer, Tracer
 
 __all__ = [
     "CATALOG", "TID_BASE", "Event", "EventKind", "EventSpec", "lookup",
+    "FlightRecorder", "merge_flight_records",
     "Histogram", "CRITICAL_PATH_STAGES", "critical_path",
     "merge_trace_files", "merge_traces", "span_quantile",
     "Objective", "burn_rates", "evaluate", "evaluate_bench_record",

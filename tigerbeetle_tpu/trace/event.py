@@ -157,6 +157,28 @@ class Event(enum.Enum):
         "created transfers whose debit and credit accounts live on "
         "different shards (resolved via the exchange join)")
 
+    # ----------------------------------------------------- device telemetry
+    # Decoded host-side from the fixed-layout u32 telemetry block the
+    # partitioned route harvests with its outputs (parallel/partitioned
+    # TEL_LAYOUT): measured ON DEVICE per prepare, never host-side
+    # guesswork.
+    device_fixpoint_rounds = _histogram(
+        "fixpoint rounds the judge actually consumed per prepare "
+        "(unit: rounds; 0 = the proof-gated plain tier)")
+    device_poison_cause = _counter(
+        "prepares poisoned/escalated on device, by decoded cause code",
+        "cause")
+    device_exchange_occupancy = _histogram(
+        "exchange-lane occupancy per psum phase (unit: pct of the "
+        "static lane capacity; the headroom-burn early-warning "
+        "objective in perf/slo.json reads this distribution)", "phase")
+    device_ring_occupancy = _histogram(
+        "per-shard event-ring rows after write-back (unit: rows)")
+    device_writeback_rows = _counter(
+        "owner-masked transfer rows written back across all shards")
+    flight_recorder_dump = _counter(
+        "flight-recorder artifacts dumped for post-mortem", "reason")
+
     # ------------------------------------------------------ tracer internal
     trace_dropped_events = _counter(
         "span ring evictions (the trace is truncated at its start)")

@@ -146,7 +146,11 @@ def evaluate_bench_record(record: dict, objectives) -> list:
     the devhub panel's per-run data point). Serving-window objectives
     read the record's per-window latency histogram
     (serving_batch_latency.histogram, milliseconds); anything the
-    record does not carry evaluates to ok=None."""
+    record does not carry evaluates to ok=None. Device-telemetry
+    objectives (device_exchange_occupancy — the exchange-headroom burn
+    early warning) read the shard probe's harvested distribution
+    (shard_balance.telemetry.exchange_occupancy, already in the
+    event's declared unit)."""
     lat = record.get("serving_batch_latency") or {}
     hist = None
     if isinstance(lat.get("histogram"), dict):
@@ -154,20 +158,33 @@ def evaluate_bench_record(record: dict, objectives) -> list:
             hist = Histogram.from_dict(lat["histogram"])
         except (AssertionError, ValueError, TypeError):
             hist = None
+    tel = (record.get("shard_balance") or {}).get("telemetry") or {}
+    tel_hist = None
+    if isinstance(tel.get("exchange_occupancy"), dict):
+        try:
+            tel_hist = Histogram.from_dict(tel["exchange_occupancy"])
+        except (AssertionError, ValueError, TypeError):
+            tel_hist = None
     rows = []
     for o in objectives:
         value = None
+        count = 0
         if o.event == "window_commit":
             if hist is not None:
                 value = hist.quantile(o.quantile)  # already ms
+                count = hist.count
             elif o.quantile == 0.99 and lat.get("p99_ms") is not None:
                 value = float(lat["p99_ms"])
+        elif o.event == "device_exchange_occupancy" \
+                and tel_hist is not None:
+            value = tel_hist.quantile(o.quantile)  # already pct
+            count = tel_hist.count
         ok = None if value is None else bool(value <= o.threshold)
         rows.append({
             "name": o.name, "event": o.event, "quantile": o.quantile,
             "value": None if value is None else round(value, 3),
             "threshold": o.threshold, "unit": o.unit,
-            "count": hist.count if hist is not None else 0, "ok": ok,
+            "count": count, "ok": ok,
         })
     return rows
 
