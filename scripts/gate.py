@@ -52,7 +52,14 @@ Prometheus-parseable text with per-route window histograms and SLO
 series; skip with --no-metrics), the BENCH-REGRESSION leg
 (testing/latency_smoke.py: live serving-window p99 vs the committed
 perf/latency_baseline.json and the BENCH_r*.json pinned p99
-trajectory; skip with --no-bench-regression), and the
+trajectory; skip with --no-bench-regression), the STATIC leg
+(testing/static_smoke.py: jaxhound 2.0's four whole-stack passes over
+the full serving-entry registry on an 8-device virtual mesh — device
+determinism, host-determinism AST lint, retrace/recompile audit vs the
+committed perf/tracebudget_r*.json, sharding-spec verification of the
+partitioned lowerings — plus one negative injected-violation proof per
+pass, each of which must RED; writes perf/static_status.json for the
+devhub panel; skip with --no-static), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
@@ -363,6 +370,36 @@ def run_bench_regression(timeout: int = 600) -> int:
     return rc
 
 
+def run_static(timeout: int = 900) -> int:
+    """Static leg: jaxhound 2.0's four whole-stack passes (device
+    determinism, host-determinism AST lint, retrace/recompile audit vs
+    the committed perf/tracebudget_r*.json head, sharding-spec
+    verification) over the FULL serving-entry registry on an 8-device
+    virtual mesh, plus a negative injected-violation proof per pass
+    (testing/static_smoke.py). Skip with --no-static."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import static_smoke as s; "
+           "s.static_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] static: jaxhound passes + negative proofs "
+          "(testing/static_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: static timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] static rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_mesh(n_devices: int) -> int:
     # dryrun_multichip handles its own harness-proofing (re-execs into a
     # pinned virtual-CPU-mesh subprocess when needed).
@@ -407,6 +444,9 @@ def main() -> int:
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the metrics leg (SLO catalog check + "
                          "/metrics exposition smoke)")
+    ap.add_argument("--no-static", action="store_true",
+                    help="skip the static leg (jaxhound determinism/"
+                         "retrace/sharding passes + negative proofs)")
     ap.add_argument("--no-bench-regression", action="store_true",
                     help="skip the bench-regression leg (serving p99 "
                          "vs committed baseline)")
@@ -455,6 +495,10 @@ def main() -> int:
         rc = run_bench_regression()
         if rc != 0:
             reds.append(f"bench-reg rc={rc}")
+    if not args.no_static:
+        rc = run_static()
+        if rc != 0:
+            reds.append(f"static rc={rc}")
     if not args.no_mesh:
         rc = run_mesh(args.mesh_devices)
         if rc != 0:

@@ -342,7 +342,10 @@ def test_vortex_metrics_endpoint(tmp_path):
                 id=100 + i, debit_account_id=1, credit_account_id=2,
                 amount=1 + i, ledger=1, code=1)])
         # Live scrape: parseable, and the commit pipeline fed span
-        # histograms on every replica.
+        # histograms on every replica. A backup that joined late (slow
+        # jax import in its process) exposes commit-free metrics until
+        # it finishes replaying — wait for cluster-wide catch-up first.
+        supervisor.wait_caught_up()
         for i in range(3):
             parsed = parse_prometheus(supervisor.scrape_metrics(i))
             assert parsed["tb_tpu_commit_execute_us_count"][0][1] > 0

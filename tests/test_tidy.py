@@ -93,6 +93,26 @@ def test_monitoring_doc_lists_every_catalog_event():
         f"monitoring.md lacks catalog events: {missing}"
 
 
+def test_jaxhound_pragmas_name_real_rules():
+    """ISSUE 14 satellite: every `# jaxhound: allow(<rule>)` pragma in
+    the tree names a rule hostdet actually enforces — a typo'd pragma
+    suppresses nothing and would silently rot."""
+    from tigerbeetle_tpu.jaxhound import hostdet
+
+    for path in _python_files():
+        rel = path.relative_to(PACKAGE)
+        for i, line in enumerate(path.read_text().splitlines(), 1):
+            m = hostdet._PRAGMA_RE.search(line)
+            if m is None:
+                continue
+            rules = {r.strip() for r in m.group(1).split(",")
+                     if r.strip()}
+            unknown = rules - set(hostdet.RULES)
+            assert not unknown, \
+                f"{rel}:{i}: pragma names unknown jaxhound rule(s) " \
+                f"{sorted(unknown)} (valid: {hostdet.RULES})"
+
+
 def test_no_reference_code_imports():
     """Nothing may read from /root/reference at runtime."""
     for path in _python_files():

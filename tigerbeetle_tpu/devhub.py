@@ -359,6 +359,73 @@ def render(history_path: str, out_path: str,
               "<th>budget</th><th>by class</th><th>operand MB</th>"
               "<th></th></tr>"
             + "".join(rows_ob) + "</table>")
+    # Static-analysis panel: the gate `static` leg's last verdict
+    # (perf/static_status.json, written by testing/static_smoke.py) —
+    # per-pass ok flags with finding samples and the negative-proof
+    # verdicts — next to the committed retrace-budget head (the NEWEST
+    # perf/tracebudget_r*.json, resolved newest_budget_path-style so a
+    # new pinned round shows up without a devhub edit).
+    st_html = ""
+    st = None
+    try:
+        from .jaxhound.core import _DEFAULT_PERF_DIR
+        with open(os.path.join(_DEFAULT_PERF_DIR,
+                               "static_status.json")) as f:
+            st = json.load(f)
+    except (OSError, ValueError, ImportError):
+        pass
+    if isinstance(st, dict):
+        rows_st = []
+        any_red = False
+        for name in sorted(st.get("passes") or {}):
+            d = st["passes"][name] or {}
+            ok = bool(d.get("ok"))
+            any_red = any_red or not ok
+            sample = "; ".join(d.get("findings") or [])[:200] or "-"
+            flag = ("clean" if ok else
+                    '<span style="color:#c22;font-weight:600">RED</span>')
+            rows_st.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "</tr>".format(
+                    html.escape(name), flag, d.get("n_findings", 0),
+                    html.escape(sample)))
+        negs = st.get("negatives") or {}
+        n_ok = sum(1 for v in negs.values() if v)
+        neg_red = n_ok < len(negs)
+        any_red = any_red or neg_red
+        neg_txt = (
+            f"{n_ok}/{len(negs)} injected violations red as required"
+            if negs else "-")
+        if neg_red:
+            neg_txt = ('<span style="color:#c22;font-weight:600">'
+                       + html.escape(neg_txt) + "</span>")
+        else:
+            neg_txt = html.escape(neg_txt)
+        tb_txt = "-"
+        try:
+            from .jaxhound import newest_tracebudget_path
+            with open(newest_tracebudget_path()) as f:
+                tb = json.load(f)
+            tb_txt = "{}: {} entries pinned, depth matrix {}".format(
+                html.escape(str(st.get("tracebudget") or "")),
+                len(tb.get("entries") or {}),
+                html.escape(str((tb.get("matrix") or {}).get(
+                    "depths", "-"))))
+        except (OSError, ValueError, ImportError):
+            pass
+        badge_st = ("" if not any_red else
+                    '<p style="color:#c22;font-weight:700">STATIC '
+                    'ANALYSIS RED — scripts/gate.py static leg would '
+                    'fail</p>')
+        st_html = (
+            "<h2>static analysis (jaxhound passes, last gate leg)</h2>"
+            + badge_st
+            + "<p>{} registry entries; retrace budget {}; negative "
+              "proofs: {}</p>".format(
+                  st.get("n_entries", "-"), tb_txt, neg_txt)
+            + "<table><tr><th>pass</th><th></th><th>findings</th>"
+              "<th>sample</th></tr>"
+            + "".join(rows_st) + "</table>")
     # Shard-balance panel (bench ##shard): the partitioned route's
     # events-per-shard spread, cross-shard fraction, and exchange
     # overflow count — a skewed ownership hash or an overflow-prone
@@ -606,6 +673,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {rec_html}
 {route_html}
 {ob_html}
+{st_html}
 {sh_html}
 {dt_html}
 {tr_html}

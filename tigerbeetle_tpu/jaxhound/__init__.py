@@ -1,0 +1,70 @@
+"""jaxhound: whole-stack static verifier for the TPU ledger.
+
+reference: src/copyhound.zig:1-9 — the reference hunts large memcpys
+and monomorphization bloat in LLVM IR; jaxhound inspects jax/XLA
+compile artifacts and the host control plane. It grew from a census
+module into a package of static passes that turn the system's
+load-bearing runtime invariant — byte-for-byte determinism across
+replicas — into machine-checked artifacts:
+
+  core         heavy-op census, scan-body census, telemetry census,
+               closure/while/gather lints, budget-trail resolvers,
+               lowered-artifact analysis (the original jaxhound).
+  determinism  device determinism pass: RNG without a threaded key,
+               host callbacks in serving lowerings, floating-point
+               cross-device collectives, unsorted-duplicate-index
+               float scatters.
+  hostdet      host determinism pass: Python-AST lint over the
+               deterministic-replay modules (wall-clock reads,
+               unseeded `random`, set-iteration ordering, env reads)
+               with a `# jaxhound: allow(<rule>)` pragma allowlist.
+  retrace      retrace/recompile auditor: the dispatch-route matrix
+               (flat, chain, partitioned, partitioned-chain at
+               W∈{1,2,8,32}) under a jit-cache-miss probe, pinned in
+               perf/tracebudget_r*.json; plus the weak-type carry
+               check.
+  shardspec    sharding-spec verifier: every donated state leaf of a
+               partitioned entry carries the batch sharding on input
+               and output; no state-sized operand silently replicated.
+  registry     the serving-entry registry the passes run over.
+
+CLI: ``python -m tigerbeetle_tpu.jaxhound [--kernel K] [--json]
+[--pass determinism|host|retrace|sharding|all]``; the gate's `static`
+leg (testing/static_smoke.py) runs every pass plus the negative
+injected-violation proofs.
+"""
+
+from __future__ import annotations
+
+from .core import (  # noqa: F401 — the package's public census/lint API
+    CLOSURE_CONST_LIMIT,
+    HEAVY_CLASSES,
+    HEAVY_CLASS_ORDER,
+    STATE_GATHER_LIMIT,
+    TELEMETRY_PACK_NAME,
+    _aval_bytes,
+    _collect_consts,
+    _walk_jaxpr,
+    analyze_lowered,
+    closure_constants,
+    donated_inputs,
+    heavy_census,
+    kernels,
+    newest_budget_path,
+    newest_tracebudget_path,
+    report,
+    scan_bodies,
+    scan_body_census,
+    state_gathers,
+    telemetry_census,
+    while_ops,
+)
+
+from . import (  # noqa: F401 — pass modules (jax-import-free at load)
+    core,
+    determinism,
+    hostdet,
+    registry,
+    retrace,
+    shardspec,
+)

@@ -1,0 +1,115 @@
+"""jaxhound CLI: run the report or any static pass standalone.
+
+    python -m tigerbeetle_tpu.jaxhound                    # HLO report
+    python -m tigerbeetle_tpu.jaxhound --kernel K         # one kernel
+    python -m tigerbeetle_tpu.jaxhound --pass determinism # one pass
+    python -m tigerbeetle_tpu.jaxhound --pass all --json
+
+Passes run over the full serving-entry registry (registry.entries);
+the mesh tiers join automatically on >= 8 devices. Exit status is
+nonzero when any pass REDs — the same verdict the gate's `static` leg
+enforces, runnable in isolation by an operator chasing one finding.
+`--write-tracebudget PATH` derives and commits a new retrace-budget
+round (the explicit act of moving a pin).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json as _json
+import os
+import sys
+
+PASSES = ("determinism", "host", "retrace", "sharding")
+
+
+def run_passes(which: str, write_tracebudget: str | None = None) -> dict:
+    """pass name -> list of RED strings (only the selected passes)."""
+    from . import determinism, hostdet, registry, retrace, shardspec
+
+    selected = PASSES if which == "all" else (which,)
+    out: dict[str, list[str]] = {}
+    entries = None
+    traces = None
+
+    def _entries():
+        nonlocal entries
+        if entries is None:
+            entries = registry.entries()
+        return entries
+
+    def _traces():
+        nonlocal traces
+        if traces is None:
+            traces = {n: e.trace() for n, e in _entries().items()}
+        return traces
+
+    if "determinism" in selected:
+        out["determinism"] = determinism.run(_traces())
+    if "host" in selected:
+        out["host"] = hostdet.run()
+    if "retrace" in selected:
+        fails: list[str] = []
+        if write_tracebudget:
+            retrace.write_budget(_entries(), write_tracebudget)
+            print(f"[jaxhound] wrote {write_tracebudget}")
+        else:
+            table, audit_fails = retrace.audit(_entries())
+            fails.extend(audit_fails)
+            try:
+                fails.extend(retrace.check_budget(
+                    _entries(), table=table))
+            except FileNotFoundError as e:
+                fails.append(f"tracebudget: {e}")
+        for name, cj in _traces().items():
+            fails.extend(retrace.weak_carries(cj, name))
+        out["retrace"] = fails
+    if "sharding" in selected:
+        out["sharding"] = shardspec.run(_entries())
+    return out
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        prog="python -m tigerbeetle_tpu.jaxhound",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("--kernel", default=None,
+                    help="restrict the HLO report to one kernel")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable output")
+    ap.add_argument("--pass", dest="which", default=None,
+                    choices=PASSES + ("all",),
+                    help="run a static pass over the serving-entry "
+                         "registry instead of the HLO report")
+    ap.add_argument("--write-tracebudget", default=None, metavar="PATH",
+                    help="derive and write a new tracebudget round "
+                         "(with --pass retrace)")
+    args = ap.parse_args(argv)
+
+    if args.which is None:
+        from .core import report
+
+        lines = report(args.kernel)
+        if args.json:
+            print(_json.dumps({"report": lines}, indent=1))
+        else:
+            print("\n".join(lines))
+        return 0
+
+    results = run_passes(args.which, args.write_tracebudget)
+    if args.json:
+        print(_json.dumps(
+            {"passes": {k: {"ok": not v, "findings": v}
+                        for k, v in results.items()}}, indent=1))
+    else:
+        for name, fails in results.items():
+            print(f"[jaxhound] pass {name}: "
+                  + ("clean" if not fails else f"{len(fails)} RED"))
+            for f in fails:
+                print(f"  RED {f}")
+    return 1 if any(results.values()) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

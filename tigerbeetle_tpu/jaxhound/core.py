@@ -1,4 +1,4 @@
-"""jaxhound: compile-artifact analysis for the TPU kernels.
+"""jaxhound core: census + lint primitives over jax compile artifacts.
 
 reference: src/copyhound.zig:1-9 — the reference hunts large memcpys and
 monomorphization bloat in LLVM IR; the TPU-native analog inspects XLA
@@ -6,7 +6,12 @@ artifacts: per-kernel HLO instruction counts, fusion counts, and the
 largest temp buffers. Compile bloat here is the same disease copyhound
 hunts there — generated code growing without anyone noticing.
 
-Usage: `python -m tigerbeetle_tpu jaxhound [--kernel NAME]`.
+This module holds the trace-level machinery (heavy-op census, scan-body
+census, telemetry census, budget-trail resolvers, closure/while/gather
+lints, lowered-artifact analysis). The whole-stack static passes —
+device determinism, host determinism, retrace budget, sharding spec —
+live in sibling modules of this package; `python -m
+tigerbeetle_tpu.jaxhound --help` is the operator entry point.
 """
 
 from __future__ import annotations
@@ -208,27 +213,47 @@ def telemetry_census(closed_jaxpr) -> dict:
     }
 
 
+# Repo-relative perf/ dir: this file lives two levels below the repo
+# root (tigerbeetle_tpu/jaxhound/core.py).
+_DEFAULT_PERF_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "perf")
+
+
+def _newest_round_path(perf_dir: str | None, prefix: str) -> str:
+    """Resolve the newest committed `<prefix>_r<N>.json` budget file
+    (highest round number) under perf_dir."""
+    if perf_dir is None:
+        perf_dir = _DEFAULT_PERF_DIR
+    paths = glob.glob(os.path.join(perf_dir, f"{prefix}_r*.json"))
+    best = None
+    best_round = -1
+    for p in paths:
+        m = re.search(rf"{prefix}_r(\d+)\.json$", os.path.basename(p))
+        if m and int(m.group(1)) > best_round:
+            best_round = int(m.group(1))
+            best = p
+    if best is None:
+        raise FileNotFoundError(
+            f"no {prefix}_r*.json under {perf_dir!r}")
+    return best
+
+
 def newest_budget_path(perf_dir: str | None = None) -> str:
     """Path of the NEWEST committed perf/opbudget_r*.json (highest
     round number). The budget trail is append-oriented — every round
     that moves a pinned census commits a new file — so consumers
     (devhub, smokes, the gate) resolve the head dynamically instead of
     hardcoding a round that silently goes stale."""
-    if perf_dir is None:
-        perf_dir = os.path.join(
-            os.path.dirname(os.path.abspath(__file__)), "..", "perf")
-    paths = glob.glob(os.path.join(perf_dir, "opbudget_r*.json"))
-    best = None
-    best_round = -1
-    for p in paths:
-        m = re.search(r"opbudget_r(\d+)\.json$", os.path.basename(p))
-        if m and int(m.group(1)) > best_round:
-            best_round = int(m.group(1))
-            best = p
-    if best is None:
-        raise FileNotFoundError(
-            f"no opbudget_r*.json under {perf_dir!r}")
-    return best
+    return _newest_round_path(perf_dir, "opbudget")
+
+
+def newest_tracebudget_path(perf_dir: str | None = None) -> str:
+    """Path of the NEWEST committed perf/tracebudget_r*.json — the
+    retrace-budget trail (compiled-signature pins for the dispatch
+    route matrix), same append-oriented regime as the op-budget
+    trail."""
+    return _newest_round_path(perf_dir, "tracebudget")
 
 
 # ----------------------------------------------------------- static lints
@@ -236,13 +261,38 @@ def newest_budget_path(perf_dir: str | None = None) -> str:
 CLOSURE_CONST_LIMIT = 4096  # bytes; PERF.md: ~64 ms/call at 0.5 MB
 
 
+def _collect_consts(closed_jaxpr) -> list:
+    """Every closed-over constant anywhere in the program: the
+    top-level ClosedJaxpr's consts PLUS the consts of every sub-
+    ClosedJaxpr (pjit/cond bodies keep their own const list — a
+    constant baked inside a nested jit never surfaces in the outer
+    `.consts`, so a top-level-only scan misses exactly the chain /
+    partitioned-chain bodies). Raw Jaxpr params (shard_map) carry no
+    const list of their own; their constvars are threaded from an
+    enclosing ClosedJaxpr, which this walk does see."""
+    consts = list(closed_jaxpr.consts)
+
+    def visit(eqn):
+        for sub in eqn.params.values():
+            subs = sub if isinstance(sub, (list, tuple)) else (sub,)
+            for s in subs:
+                if hasattr(s, "consts"):  # nested ClosedJaxpr
+                    consts.extend(s.consts)
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return consts
+
+
 def closure_constants(closed_jaxpr) -> list[tuple[str, int]]:
     """(dtype/shape label, bytes) of every closed-over constant above
-    CLOSURE_CONST_LIMIT. The tunnel re-ships baked-in constants every
-    call (~64 ms at 0.5 MB — PERF.md 'closure constants are poison'), so
-    serving-path entries must take every table as an argument."""
+    CLOSURE_CONST_LIMIT — including constants closed inside scan/cond/
+    pjit sub-jaxprs (a lookup table baked into the chain body is just
+    as poisonous as one at top level). The tunnel re-ships baked-in
+    constants every call (~64 ms at 0.5 MB — PERF.md 'closure constants
+    are poison'), so serving-path entries must take every table as an
+    argument."""
     out = []
-    for c in closed_jaxpr.consts:
+    for c in _collect_consts(closed_jaxpr):
         shape = getattr(c, "shape", ())
         dtype = getattr(c, "dtype", None)
         if dtype is None:
@@ -322,6 +372,7 @@ def analyze_lowered(lowered) -> dict:
             ops[match.group(1)] += 1
     compiled = lowered.compile()
     stats = {}
+    unavailable = []
     try:
         analysis = compiled.cost_analysis()
         if isinstance(analysis, list):
@@ -330,20 +381,25 @@ def analyze_lowered(lowered) -> dict:
             stats = {k: analysis[k] for k in
                      ("flops", "bytes accessed", "optimal_seconds")
                      if k in analysis}
-    except Exception:
-        pass
+    except Exception as e:  # backend-dependent; record WHY it failed
+        unavailable.append(f"cost_analysis: {type(e).__name__}: {e}")
     try:
         mem = compiled.memory_analysis()
         stats["temp_bytes"] = getattr(mem, "temp_size_in_bytes", None)
         stats["argument_bytes"] = getattr(mem, "argument_size_in_bytes", None)
         stats["output_bytes"] = getattr(mem, "output_size_in_bytes", None)
-    except Exception:
-        pass
-    return {
+    except Exception as e:
+        unavailable.append(f"memory_analysis: {type(e).__name__}: {e}")
+    out = {
         "instructions": sum(ops.values()),
         "top_ops": ops.most_common(12),
         "stats": stats,
     }
+    if unavailable:
+        # Consumers (report(), devhub) render "n/a: <reason>" instead
+        # of mistaking a swallowed backend failure for zero cost.
+        out["stats_unavailable"] = "; ".join(unavailable)
+    return out
 
 
 def kernels() -> dict[str, Callable[[], "object"]]:
@@ -353,10 +409,10 @@ def kernels() -> dict[str, Callable[[], "object"]]:
         import jax
         import numpy as np
 
-        from .ops.batch import transfers_to_arrays
-        from .ops.fast_kernels import create_transfers_fast
-        from .ops.ledger import init_state, pad_transfer_events
-        from .types import Transfer
+        from ..ops.batch import transfers_to_arrays
+        from ..ops.fast_kernels import create_transfers_fast
+        from ..ops.ledger import init_state, pad_transfer_events
+        from ..types import Transfer
 
         state = init_state(1 << 10, 1 << 12)
         ev = pad_transfer_events(transfers_to_arrays(
@@ -369,10 +425,10 @@ def kernels() -> dict[str, Callable[[], "object"]]:
         import jax
         import numpy as np
 
-        from .ops.fast_kernels import create_accounts_fast
-        from .ops.ledger import init_state, pad_account_events
-        from .ops.batch import accounts_to_arrays
-        from .types import Account
+        from ..ops.fast_kernels import create_accounts_fast
+        from ..ops.ledger import init_state, pad_account_events
+        from ..ops.batch import accounts_to_arrays
+        from ..types import Account
 
         state = init_state(1 << 10, 1 << 12)
         ev = pad_account_events(accounts_to_arrays(
@@ -402,4 +458,6 @@ def report(kernel: str | None = None) -> list[str]:
         for key, value in info["stats"].items():
             if value is not None:
                 lines.append(f"  {key}: {value}")
+        if info.get("stats_unavailable"):
+            lines.append(f"  stats: n/a ({info['stats_unavailable']})")
     return lines

@@ -1,0 +1,249 @@
+"""Retrace/recompile auditor: the closed compiled-signature set, pinned.
+
+Every distinct abstract signature a dispatch entry is called with is
+one more XLA compile (seconds of latency at serving time) and one more
+executable resident in the backend. The dispatch layer's whole design
+pads batches into PAD_BUCKETS and stacks windows so the signature set
+is CLOSED — small, enumerable, flat in window depth W up to the
+unavoidable leading window axis. This auditor makes that property a
+committed artifact:
+
+  - For every registry entry it drives `make_args` across the window
+    matrix W∈{1,2,8,32} (the REAL stacking/padding drivers) and
+    computes the abstract signature (shape/dtype/weak_type per arg
+    leaf) at each depth. The per-entry signatures are UNIFIED across
+    depths into one canonical signature: an axis that varies with W
+    must equal W (the window axis, normalized to "W"); any other
+    variation — a dtype drifting with depth, a weak-typed Python
+    scalar leaking in at one depth only, an axis scaling with
+    something else — is a polymorphic call: a per-depth recompile the
+    route's design forbids. RED.
+  - The canonical set is pinned in perf/tracebudget_r*.json (the
+    opbudget budget-trail pattern: append a new round to move a pin;
+    `newest_tracebudget_path` resolves the head). `check_budget`
+    re-derives and compares count + digest per entry.
+  - A live jit-cache-miss probe (`cache_probe`): call an entry twice
+    at the same depth, then at a second depth — `_cache_size()` must
+    grow exactly [1, 0, 1]: one compile per depth, zero on re-drive
+    (an unstable cache key — e.g. an unhashable static or weak-type
+    flapping — shows up as a miss on the re-drive).
+  - A static weak-type check on scan carry avals (`weak_carries`): a
+    Python scalar smuggled into a chain carry traces as
+    `int32[] weak_type=True`, which re-canonicalizes — and retraces —
+    the first time a strong-typed value meets it (the PR 9 int32
+    chain-carry bug class under x64).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+
+from .core import _walk_jaxpr, newest_tracebudget_path  # noqa: F401
+
+
+def leaf_signature(args) -> list[tuple]:
+    """(shape, dtype, weak_type) per flattened arg leaf — the jit
+    cache key's abstract part."""
+    import jax
+    from jax.api_util import shaped_abstractify
+
+    leaves = jax.tree_util.tree_leaves(args)
+    out = []
+    for x in leaves:
+        a = shaped_abstractify(x)
+        out.append((tuple(int(d) for d in a.shape), str(a.dtype),
+                    bool(a.weak_type)))
+    return out
+
+
+def canonical_signature(entry) -> tuple[list, list[str]]:
+    """Unify an entry's per-depth signatures into one canonical
+    signature (window axes -> "W"); the second element lists
+    polymorphic-call findings (non-empty = RED)."""
+    sigs = {d: leaf_signature(entry.make_args(d)) for d in entry.depths}
+    depths = list(entry.depths)
+    findings: list[str] = []
+    n_leaves = {len(s) for s in sigs.values()}
+    if len(n_leaves) != 1:
+        return [], [
+            f"polymorphic_tree: leaf count varies across depths "
+            f"({ {d: len(s) for d, s in sigs.items()} }) — the arg "
+            "pytree itself depends on W"]
+    canon = []
+    for i in range(n_leaves.pop()):
+        shapes = [sigs[d][i][0] for d in depths]
+        dtypes = {sigs[d][i][1] for d in depths}
+        weaks = {sigs[d][i][2] for d in depths}
+        if len(dtypes) > 1:
+            findings.append(
+                f"polymorphic_dtype: leaf {i} dtype varies with W "
+                f"({sorted(dtypes)}) — one recompile per depth")
+        if len(weaks) > 1:
+            findings.append(
+                f"weak_type_leak: leaf {i} weak_type flaps across W "
+                "(a Python scalar leaks into the call at some depths)")
+        ranks = {len(s) for s in shapes}
+        if len(ranks) > 1:
+            findings.append(
+                f"polymorphic_shape: leaf {i} rank varies with W")
+            canon.append(("<polymorphic>", sorted(dtypes)[0], False))
+            continue
+        cshape = []
+        for ax in range(ranks.pop()):
+            vals = [s[ax] for s in shapes]
+            if len(set(vals)) == 1:
+                cshape.append(vals[0])
+            elif vals == depths:
+                cshape.append("W")
+            else:
+                findings.append(
+                    f"polymorphic_shape: leaf {i} axis {ax} varies "
+                    f"with W but not AS W ({dict(zip(depths, vals))}) "
+                    "— an un-normalized data-dependent dimension")
+                cshape.append("?")
+        canon.append((tuple(cshape), sorted(dtypes)[0],
+                      sorted(weaks)[0]))
+    return canon, findings
+
+
+def signature_digest(canon: list) -> str:
+    """Stable short digest of a canonical signature."""
+    return hashlib.sha256(repr(canon).encode()).hexdigest()[:16]
+
+
+def weak_carries(closed_jaxpr, name: str = "entry") -> list[str]:
+    """Weak-typed scan carry avals anywhere in the program — the
+    Python-scalar-leak recompile class. Empty = clean."""
+    fails: list[str] = []
+
+    def visit(eqn):
+        if eqn.primitive.name != "scan":
+            return
+        nc = eqn.params.get("num_consts", 0)
+        ncar = eqn.params.get("num_carry", 0)
+        for i, v in enumerate(eqn.invars[nc:nc + ncar]):
+            aval = getattr(v, "aval", None)
+            if aval is not None and getattr(aval, "weak_type", False):
+                fails.append(
+                    f"{name}: weak_carry: scan carry {i} is weak-typed "
+                    f"{aval.dtype}[] (a Python scalar in the carry "
+                    "retraces the first time a strong type meets it — "
+                    "pin it with np/jnp dtype construction)")
+
+    _walk_jaxpr(closed_jaxpr.jaxpr, visit)
+    return fails
+
+
+def audit(entries: dict) -> tuple[dict, list[str]]:
+    """Canonical signatures + polymorphism findings for a registry.
+    Returns ({entry: {"signature": ..., "digest": ..., "n_leaves": N,
+    "depths": [...]}} , RED strings)."""
+    table = {}
+    fails: list[str] = []
+    for name, entry in entries.items():
+        canon, findings = canonical_signature(entry)
+        fails.extend(f"{name}: {f}" for f in findings)
+        table[name] = {
+            "route": entry.route,
+            "depths": list(entry.depths),
+            "n_signatures": 1,
+            "n_leaves": len(canon),
+            "digest": signature_digest(canon),
+        }
+    return table, fails
+
+
+def check_budget(entries: dict, budget_path: str | None = None,
+                 table: dict | None = None) -> list[str]:
+    """Current canonical-signature table vs the committed
+    tracebudget_r*.json head. Any drift — a new entry, a vanished
+    entry, a digest change, a signature-count change — is a RED whose
+    fix is an explicit reviewed commit of a new round."""
+    if budget_path is None:
+        budget_path = newest_tracebudget_path()
+    with open(budget_path) as f:
+        committed = json.load(f)
+    pinned = committed.get("entries", {})
+    if table is None:
+        table, fails = audit(entries)
+    else:
+        fails = []
+    base = os.path.basename(budget_path)
+    for name, cur in table.items():
+        pin = pinned.get(name)
+        if pin is None:
+            fails.append(
+                f"{name}: not pinned in {base} — new dispatch entry "
+                "needs a committed tracebudget round")
+            continue
+        if cur["n_signatures"] > pin["n_signatures"]:
+            fails.append(
+                f"{name}: {cur['n_signatures']} compiled signatures > "
+                f"pinned {pin['n_signatures']} in {base}")
+        if cur["digest"] != pin["digest"]:
+            fails.append(
+                f"{name}: canonical signature digest {cur['digest']} "
+                f"!= pinned {pin['digest']} in {base} (the entry's "
+                "abstract call signature changed — if intended, commit "
+                "a new tracebudget round)")
+    for name in pinned:
+        if name not in table:
+            fails.append(
+                f"{name}: pinned in {base} but missing from the "
+                "registry (entry removed? commit a new round)")
+    return fails
+
+
+def cache_probe(jit_fn, args_by_depth: list) -> list[str]:
+    """Live jit-cache-miss probe: execute `jit_fn` over the args
+    sequence (repeat a depth to prove a hit) and compare `_cache_size`
+    deltas against the expectation — +1 the first time a signature is
+    seen, +0 after. Entries without a cache-size probe skip clean."""
+    import jax
+    import numpy as np
+
+    size = getattr(jit_fn, "_cache_size", None)
+    if size is None:
+        return []
+    fails = []
+    seen: set = set()
+    for i, args in enumerate(args_by_depth):
+        sig = repr(leaf_signature(args))
+        before = size()
+        # Serving entries donate their state buffers: re-drive on
+        # fresh host copies so the probe never consumes a fixture
+        # (same avals, same cache key).
+        jit_fn(*jax.tree.map(np.copy, args))
+        delta = size() - before
+        want = 0 if sig in seen else 1
+        # A signature compiled earlier in the process also hits: allow
+        # fewer misses than expected, never more.
+        if delta > want:
+            fails.append(
+                f"cache_probe: call {i} cost {delta} cache misses "
+                f"(expected <= {want}) — unstable jit cache key")
+        seen.add(sig)
+    return fails
+
+
+def write_budget(entries: dict, path: str) -> dict:
+    """Derive the canonical table and write it as a tracebudget round
+    (the explicit, reviewed act of moving a pin)."""
+    table, fails = audit(entries)
+    if fails:
+        raise RuntimeError(
+            "refusing to pin a polymorphic matrix:\n  " +
+            "\n  ".join(fails))
+    doc = {
+        "round": int(os.path.basename(path).split("_r")[1][:2])
+        if "_r" in path else 1,
+        "matrix": {"depths": list(
+            max((e.depths for e in entries.values()), key=len))},
+        "entries": table,
+    }
+    with open(path, "w") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc

@@ -55,6 +55,16 @@ class _WallTime:
 
 
 def cmd_start(args) -> int:
+    # Shutdown rides a signal FLAG from the very top: a SIGINT landing
+    # during storage open / warmup / journal recovery must still reach
+    # the main loop as an orderly stop (and dump the trace), not die as
+    # a KeyboardInterrupt mid-construction. The only remaining unsafe
+    # window is the interpreter's own module imports before this line.
+    import signal as _signal
+
+    stop: list = []
+    prev_int = _signal.signal(_signal.SIGINT, lambda *_: stop.append(1))
+    prev_term = _signal.signal(_signal.SIGTERM, lambda *_: stop.append(1))
     if args.platform:
         import jax
 
@@ -148,20 +158,25 @@ def cmd_start(args) -> int:
           f"{addresses[args.replica][0]}:{addresses[args.replica][1]} "
           f"(cluster={args.cluster}, engine={args.engine})", flush=True)
     # The reference main loop: tick + io.run_for_ns
-    # (src/tigerbeetle/main.zig:522-525). Shutdown rides a signal FLAG,
-    # not KeyboardInterrupt: a SIGINT delivered while the interpreter is
-    # inside a C callback (e.g. JAX's gc hook) raises there and is
-    # swallowed as "exception ignored in callback" — the loop would
-    # never see it and the server would ignore the shutdown.
-    import signal as _signal
-
-    stop = []
-    prev_int = _signal.signal(_signal.SIGINT, lambda *_: stop.append(1))
-    prev_term = _signal.signal(_signal.SIGTERM, lambda *_: stop.append(1))
+    # (src/tigerbeetle/main.zig:522-525). Shutdown rides the signal
+    # FLAG installed at the top of cmd_start, not KeyboardInterrupt: a
+    # SIGINT delivered while the interpreter is inside a C callback
+    # (e.g. JAX's gc hook) raises there and is swallowed as "exception
+    # ignored in callback" — the loop would never see it and the
+    # server would ignore the shutdown.
     try:
+        last_commit = -1
         while not stop:
             bus.poll(0.01)
             replica.tick()
+            if replica.commit_min != last_commit:
+                # Progress marker: the vortex supervisor's shutdown
+                # reads these from the replica log to wait for every
+                # replica to catch up to the cluster commit level
+                # before delivering SIGINT (a lagging backup stopped
+                # mid-catch-up would dump a commit-free trace).
+                last_commit = replica.commit_min
+                print(f"commit={last_commit}", flush=True)
     except KeyboardInterrupt:
         pass  # belt and braces: a late-registered handler race
     finally:

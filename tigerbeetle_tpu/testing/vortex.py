@@ -171,6 +171,9 @@ class VortexSupervisor:
     def trace_path(self, i: int) -> str:
         return os.path.join(self.tmp_dir, f"r{i}.trace.json")
 
+    def _log_path(self, i: int) -> str:
+        return os.path.join(self.tmp_dir, f"r{i}.log")
+
     def start_replica(self, i: int) -> None:
         assert self.procs[i] is None
         # The replica listens on its REAL port but dials peers through
@@ -184,12 +187,17 @@ class VortexSupervisor:
             cmd.append(f"--trace={self.trace_path(i)}")
         if self.metrics:
             cmd.append(f"--metrics-port={self.metrics_ports[i]}")
+        # Never a PIPE nobody drains: a chatty replica would block on a
+        # full pipe buffer and masquerade as a liveness failure. A real
+        # FILE (truncated per start — the marker must come from THIS
+        # process) keeps output flowing AND gives _wait_listening its
+        # readiness marker.
+        log = open(self._log_path(i), "wb")
         self.procs[i] = subprocess.Popen(
             cmd + [self._data_path(i)],
             cwd="/root/repo", env=dict(os.environ),
-            # Never a PIPE nobody drains: a chatty replica would block on a
-            # full pipe buffer and masquerade as a liveness failure.
-            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            stdout=log, stderr=log)
+        log.close()
 
     # -------------------------------------------------------------- faults
 
@@ -312,9 +320,66 @@ class VortexSupervisor:
         for i in range(self.replica_count):
             self.restart_replica(i)
 
+    def _wait_listening(self, i: int, timeout_s: float = 60.0) -> None:
+        """Block until replica i prints its 'listening on' marker (or
+        exits). A replica is only SIGINT-safe once cmd_start's signal
+        FLAG handler is installed; a 2-of-3 quorum lets the whole run
+        finish while the third replica is still importing jax, and an
+        interrupt landing mid-import kills it before it can dump its
+        trace. The marker prints strictly after the handler exists
+        (the bus SOCKET binds much earlier — probing the port is not
+        enough)."""
+        proc = self.procs[i]
+        deadline = time.monotonic() + timeout_s
+        while proc is not None and proc.poll() is None \
+                and time.monotonic() < deadline:
+            try:
+                with open(self._log_path(i), "rb") as f:
+                    if b"listening on" in f.read():
+                        return
+            except OSError:
+                pass
+            time.sleep(0.1)
+
+    def _last_commit(self, i: int) -> int:
+        """Highest `commit=N` progress marker in replica i's log (0 if
+        none yet)."""
+        try:
+            with open(self._log_path(i), "rb") as f:
+                text = f.read()
+        except OSError:
+            return 0
+        n = 0
+        for line in text.splitlines():
+            if line.startswith(b"commit="):
+                try:
+                    n = int(line[len(b"commit="):])
+                except ValueError:
+                    pass
+        return n
+
+    def wait_caught_up(self, timeout_s: float = 30.0) -> None:
+        """Block until every live replica reports the same commit level.
+        Once a workload completes, the cluster commit number is fixed —
+        but a backup that joined late (slow jax import) is still
+        replaying; stopping it mid-catch-up would dump a trace with no
+        commit stages, and scraping it early would show commit-free
+        metrics. Equality is stable once reached (quiesced workload)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            live = [i for i, p in enumerate(self.procs)
+                    if p is not None and p.poll() is None]
+            if len({self._last_commit(i) for i in live}) <= 1:
+                return
+            time.sleep(0.1)
+
     def shutdown(self) -> None:
         self.heal_all()
         for i, proc in enumerate(self.procs):
+            if proc is not None:
+                self._wait_listening(i)
+        self.wait_caught_up()
+        for proc in self.procs:
             if proc is not None:
                 proc.send_signal(signal.SIGINT)
         for i, proc in enumerate(self.procs):

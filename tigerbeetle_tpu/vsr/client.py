@@ -116,7 +116,10 @@ class Client(ClientHelpers):
             operation=int(operation))
         msg = Message(header.finalize(body), body=body)
         self._reply = None
-        start = _time.monotonic()
+        # Liveness plane (timeout/hedge pacing), never committed
+        # state: replies are ordered by the replicas, not by when this
+        # client observed them.
+        start = _time.monotonic()  # jaxhound: allow(wall_clock)
         deadline = start + timeout_s
         hedge_at = start + self.hedge_delay_s()
         resend_at = 0.0
@@ -126,7 +129,7 @@ class Client(ClientHelpers):
             if self._evicted:
                 raise SessionEvicted(
                     f"client {self.client_id} was evicted")
-            now = _time.monotonic()
+            now = _time.monotonic()  # jaxhound: allow(wall_clock)
             if now >= deadline:
                 raise TimeoutError(f"request {self.request_number} timed out")
             if now >= hedge_at and now >= resend_at:
@@ -140,7 +143,8 @@ class Client(ClientHelpers):
             # needed the fan-out measures hedge-wait + loss recovery,
             # not RTT — folding those in would ratchet the hedge delay
             # toward the cap exactly when fast fan-out matters most.
-            self._observe_rtt(_time.monotonic() - start)
+            self._observe_rtt(
+                _time.monotonic() - start)  # jaxhound: allow(wall_clock)
         return self._reply.body
 
     # Typed helpers (create_accounts, lookups, queries) come from
