@@ -59,7 +59,13 @@ determinism, host-determinism AST lint, retrace/recompile audit vs the
 committed perf/tracebudget_r*.json, sharding-spec verification of the
 partitioned lowerings — plus one negative injected-violation proof per
 pass, each of which must RED; writes perf/static_status.json for the
-devhub panel; skip with --no-static), and the
+devhub panel; skip with --no-static), the CAUSALITY leg
+(testing/causality_smoke.py: causal request tracing end to end on a
+REAL 3-replica vortex at sampling 1.0 — one complete orphan-free span
+tree per client request, the commit causally attributed inside it,
+per-pid clock-skew correction from matched bus send/recv pairs, plus
+two negative proofs (dropped trace-context header, dropped root span)
+that must each RED; skip with --no-causality), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
@@ -342,6 +348,36 @@ def run_metrics(timeout: int = 600) -> int:
     return rc
 
 
+def run_causality(timeout: int = 900) -> int:
+    """Causality leg: causal request tracing acceptance over a REAL
+    3-replica vortex cluster at sampling 1.0 — every client request
+    must assemble into exactly one complete orphan-free span tree
+    rooted at client_request with the commit causally attributed
+    inside it, after per-pid clock-skew correction; two negative
+    proofs (dropped trace-context header, dropped root span) must
+    each trip the checker (testing/causality_smoke.py). Skip with
+    --no-causality."""
+    cmd = [sys.executable, "-c",
+           "import sys; "
+           "from tigerbeetle_tpu.testing import causality_smoke; "
+           "sys.exit(causality_smoke.causality_main())"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] causality: causal trace assembly over a real vortex "
+          "(testing/causality_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: causality timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] causality rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_bench_regression(timeout: int = 600) -> int:
     """Bench-regression leg: live serving-window p99 (seeded supervisor
     workload) vs the committed perf/latency_baseline.json, plus the
@@ -444,6 +480,10 @@ def main() -> int:
     ap.add_argument("--no-metrics", action="store_true",
                     help="skip the metrics leg (SLO catalog check + "
                          "/metrics exposition smoke)")
+    ap.add_argument("--no-causality", action="store_true",
+                    help="skip the causality leg (causal request "
+                         "tracing acceptance over a real vortex "
+                         "cluster + negative proofs)")
     ap.add_argument("--no-static", action="store_true",
                     help="skip the static leg (jaxhound determinism/"
                          "retrace/sharding passes + negative proofs)")
@@ -491,6 +531,10 @@ def main() -> int:
         rc = run_metrics()
         if rc != 0:
             reds.append(f"metrics rc={rc}")
+    if not args.no_causality:
+        rc = run_causality()
+        if rc != 0:
+            reds.append(f"causality rc={rc}")
     if not args.no_bench_regression:
         rc = run_bench_regression()
         if rc != 0:

@@ -357,6 +357,104 @@ class TestSupervisorRecovery:
         assert rec["recoveries"] == sup.counters["recoveries"]
 
 
+# ------------------------------------------- chaos x causal tracing
+
+class TestChaosTracing:
+    """ISSUE 15 satellite: chaos and tracing compose. A seeded fault
+    that lands mid-request must leave the affected requests' traces
+    tail-kept (reason = the recovery cause) and cross-referenced from
+    the flight-recorder artifact by trace id."""
+
+    def _traced_supervisor(self, tmp_path):
+        from tigerbeetle_tpu.trace import FlightRecorder, Tracer
+
+        tracer = Tracer(pid=0)
+        flight = FlightRecorder(tracer=tracer, out_dir=str(tmp_path))
+        sup = ServingSupervisor(
+            a_cap=A_CAP, t_cap=1 << 11, epoch_interval=2,
+            retry=RetryPolicy(max_retries=2, base_delay_s=1e-4,
+                              max_delay_s=1e-3),
+            seed=0, sleep=lambda s: None, tracer=tracer,
+            flight_recorder=flight)
+        sup.create_accounts([Account(id=i, ledger=1, code=1)
+                             for i in range(1, 9)], 1_000)
+        return sup, tracer, flight
+
+    def _run_traced(self, sup, windows, corrupt_at):
+        from tigerbeetle_tpu.trace.context import (fmt_trace_id,
+                                                   mint_context)
+
+        trace_ids = []
+        ts = 10 ** 9
+        next_id = 1_000
+        for w in range(windows):
+            if w == corrupt_at:
+                f = {"target": "accounts_bal", "row_pick": 3,
+                     "col_pick": 5, "bit": 11}
+                assert inject_state_bitflip(sup.led, f), f
+            ts += 40
+            batches, tss = _simple_window(next_id, ts)
+            next_id += 24
+            ctx = mint_context(3, w + 1, head_rate=1.0)
+            trace_ids.append(fmt_trace_id(ctx.trace_id))
+            sup.create_transfers_window(batches, tss, trace_ctxs=[ctx])
+        sup.verify_epoch()
+        return trace_ids
+
+    def test_recovery_tail_keeps_affected_traces(self, tmp_path):
+        sup, tracer, _ = self._traced_supervisor(tmp_path)
+        trace_ids = self._run_traced(sup, windows=4, corrupt_at=1)
+        recs = sup.counters["recoveries"]
+        assert sum(recs.values()) >= 1, recs
+        # Every tail-kept trace names the recovery cause as its reason
+        # and is one of the requests in flight since the last epoch.
+        assert tracer.kept_traces, "recovery kept no traces"
+        assert set(tracer.kept_traces.values()) <= set(recs)
+        assert set(tracer.kept_traces) <= set(trace_ids)
+        assert tracer.counters["trace_tail_keep"] \
+            == len(tracer.kept_traces)
+        # The verified-epoch boundary clears the at-risk set: a later
+        # clean run keeps nothing new.
+        before = dict(tracer.kept_traces)
+        self._run_traced(sup, windows=2, corrupt_at=None)
+        assert tracer.kept_traces == before
+
+    def test_flight_artifact_names_affected_trace_ids(self, tmp_path):
+        import json
+
+        sup, tracer, flight = self._traced_supervisor(tmp_path)
+        trace_ids = self._run_traced(sup, windows=4, corrupt_at=1)
+        assert flight.dumps >= 1 and flight.last_dump_path
+        with open(flight.last_dump_path) as f:
+            doc = json.load(f)
+        named = set()
+        for rec in doc["records"]:
+            named.update((rec.get("detail") or {}).get("trace_ids", ()))
+        # The artifact cross-references BOTH planes: the per-window
+        # records carry each window's constituent trace ids (up to the
+        # dump — the ring freezes AT recovery, later windows are not in
+        # it), and the recovery record names the tail-kept set.
+        assert named and named <= set(trace_ids)
+        assert set(tracer.kept_traces) <= named
+        recovery = [rec for rec in doc["records"]
+                    if rec.get("route") == "recovery"]
+        assert recovery, "recovery never reached the flight ring"
+        assert set((recovery[-1].get("detail") or {})["trace_ids"]) \
+            == set(tracer.kept_traces)
+
+    def test_window_spans_link_constituent_traces(self, tmp_path):
+        sup, tracer, _ = self._traced_supervisor(tmp_path)
+        trace_ids = self._run_traced(sup, windows=2, corrupt_at=None)
+        spans = [e for e in tracer.events
+                 if e.get("name") == "window_commit"
+                 and (e.get("args") or {}).get("links")]
+        assert spans, "no window span carried fan-in links"
+        linked = set()
+        for s in spans:
+            linked.update(s["args"]["links"])
+        assert linked == set(trace_ids)
+
+
 class TestSpotCheckDiagnostics:
     def test_divergence_names_op_and_fields(self, monkeypatch):
         import dataclasses

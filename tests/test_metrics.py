@@ -58,6 +58,62 @@ def test_render_parse_round_trip():
     assert "tb_tpu_serving_replay_windows_us_count" not in parsed
 
 
+def test_exemplar_render_parse_round_trip():
+    """ISSUE 15 satellite: a traced span stamps its series' exemplar;
+    the rendered exposition carries an OpenMetrics exemplar suffix on
+    exactly one in-range bucket line per series, and parse_prometheus
+    returns it (labels + value) under __exemplars__."""
+    from tigerbeetle_tpu.trace.context import fmt_trace_id, mint_context
+
+    t = _tracer_with_latency_series()  # untraced spans: no exemplars
+    ctx = mint_context(7, 1)
+    tid = fmt_trace_id(ctx.trace_id)
+    with t.span(Event.window_commit, ctx=ctx, route="chain",
+                tier="scan"):
+        pass
+    assert any(ex["trace_id"] == tid for ex in t.exemplars.values())
+    text = render_prometheus(t)
+    parsed = parse_prometheus(text)
+    exemplars = parsed["__exemplars__"]["tb_tpu_window_commit_us_bucket"]
+    assert len(exemplars) == 1  # one suffixed bucket line per series
+    labels, ex_labels, ex_value = exemplars[0]
+    assert labels["route"] == "chain" and labels["tier"] == "scan"
+    assert ex_labels == {"trace_id": tid}
+    # OpenMetrics: the exemplar lies within its bucket's bounds.
+    assert ex_value > 0
+    if labels["le"] != "+Inf":
+        assert ex_value <= float(labels["le"])
+    # The stripped text (no suffixes) parses to the identical series —
+    # the suffix never perturbs the sample itself.
+    base = parse_prometheus(
+        "\n".join(ln.partition(" # ")[0] for ln in text.splitlines()))
+    assert base["tb_tpu_window_commit_us_bucket"] \
+        == parsed["tb_tpu_window_commit_us_bucket"]
+    assert "__exemplars__" not in base
+
+
+def test_exemplar_merge_keeps_slowest_sample():
+    from tigerbeetle_tpu.trace.context import fmt_trace_id
+
+    from tigerbeetle_tpu.trace.context import TraceContext
+
+    def traced(pid, dur_us, raw_tid):
+        t = Tracer(pid=pid)
+        t.record_span(Event.window_commit, t.now_ns(),
+                      int(dur_us * 1_000), route="chain", tier="scan",
+                      ctx=TraceContext(trace_id=raw_tid))
+        return t
+
+    slow_tid = fmt_trace_id(0xABC)
+    parsed = parse_prometheus(render_prometheus(
+        [traced(0, 50.0, 0x123), traced(1, 9_000.0, 0xABC)]))
+    exemplars = parsed["__exemplars__"]["tb_tpu_window_commit_us_bucket"]
+    assert len(exemplars) == 1
+    _, ex_labels, ex_value = exemplars[0]
+    assert ex_labels["trace_id"] == slow_tid  # the p99 candidate wins
+    assert ex_value == pytest.approx(9_000.0, rel=0.01)
+
+
 def test_render_merges_tracers():
     a = _tracer_with_latency_series()
     b = _tracer_with_latency_series()

@@ -3,7 +3,13 @@
 eviction self-description, wall-clock anchoring, and the cluster-wide
 trace merge — including the ISSUE 5 acceptance: a 3-replica vortex run
 with tracing enabled yields ONE merged Chrome/Perfetto JSON with
-per-commit-stage spans from every replica on a common timeline."""
+per-commit-stage spans from every replica on a common timeline.
+
+ISSUE 15 adds causal request tracing: the wire trace-context block
+(round trip + bit-flip degradation), deterministic identity and head
+sampling, per-pid clock-skew correction (every assembled causal edge
+must satisfy parent_ts <= child_ts after correction), causal assembly
+over an in-process cluster, and tail retention at a 1% head rate."""
 
 import json
 import socket
@@ -329,6 +335,263 @@ def test_cluster_merged_trace_has_commit_stages():
         names = {e["name"] for e in timed if e["pid"] == pid}
         for stage in COMMIT_STAGES:
             assert stage in names, f"replica {pid} lacks {stage}"
+
+
+# ------------------------------------------------- causal trace context
+
+class TestTraceContext:
+    def _ctx(self):
+        from tigerbeetle_tpu.trace.context import TraceContext
+
+        return TraceContext(trace_id=(1 << 127) | 0xDEADBEEF,
+                            parent_span_id=0x1122334455667788)
+
+    def test_pack_unpack_round_trip(self):
+        from tigerbeetle_tpu.trace.context import (CTX_WIRE_SIZE,
+                                                   TraceContext)
+
+        ctx = self._ctx()
+        raw = ctx.pack()
+        assert len(raw) == CTX_WIRE_SIZE == 28
+        assert TraceContext.unpack(raw) == ctx
+        assert ctx.sampled
+        child = ctx.child(0xABCD)
+        assert child.trace_id == ctx.trace_id
+        assert child.parent_span_id == 0xABCD
+        unsampled = TraceContext(trace_id=5, flags=0)
+        assert not unsampled.sampled
+        assert TraceContext.unpack(unsampled.pack()) == unsampled
+
+    def test_every_single_bit_flip_degrades_to_none(self):
+        """The fuzzer's contract, exhaustively: ANY single-bit flip in
+        the 28-byte block makes unpack return None (never raise), so a
+        corrupt context degrades to unsampled without touching the
+        frame."""
+        from tigerbeetle_tpu.trace.context import (CTX_WIRE_SIZE,
+                                                   TraceContext)
+
+        raw = bytearray(self._ctx().pack())
+        for bit in range(CTX_WIRE_SIZE * 8):
+            raw[bit // 8] ^= 1 << (bit % 8)
+            assert TraceContext.unpack(bytes(raw)) is None, f"bit {bit}"
+            raw[bit // 8] ^= 1 << (bit % 8)
+        assert TraceContext.unpack(bytes(raw)) is not None  # restored
+
+    def test_header_carries_ctx_outside_checksum(self):
+        """The context rides the reserved region OUT of the header
+        checksum: a header packs/unpacks with its context intact, and
+        zapping the context bytes leaves the header checksum VALID
+        while the context reads back as None."""
+        from tigerbeetle_tpu.trace.context import CTX_WIRE_SIZE
+        from tigerbeetle_tpu.vsr.header import (TRACE_CTX_OFFSET, Command,
+                                                Header)
+
+        ctx = self._ctx()
+        h = Header(command=Command.request, cluster=1, client=5,
+                   request=3, operation=2, trace_ctx=ctx).finalize(b"xy")
+        raw = h.pack()
+        back = Header.unpack(raw)
+        assert back.trace_ctx == ctx
+        assert back.valid_checksum()
+        zapped = bytearray(raw)
+        zapped[TRACE_CTX_OFFSET] ^= 0xFF
+        degraded = Header.unpack(bytes(zapped))
+        assert degraded.trace_ctx is None
+        assert degraded.valid_checksum()  # the frame survives
+        assert CTX_WIRE_SIZE + TRACE_CTX_OFFSET <= len(raw)
+
+    def test_deterministic_mint_and_head_sampling(self):
+        from tigerbeetle_tpu.trace.context import (head_sampled,
+                                                   mint_context,
+                                                   mint_trace_id)
+
+        assert mint_trace_id(7, 3) == mint_trace_id(7, 3)
+        assert mint_trace_id(7, 3) != mint_trace_id(7, 4)
+        assert mint_trace_id(7, 3, seed=1) != mint_trace_id(7, 3, seed=2)
+        tid = mint_trace_id(7, 3)
+        assert head_sampled(tid, 1.0) and not head_sampled(tid, 0.0)
+        assert head_sampled(tid, 0.3) == head_sampled(tid, 0.3)
+        hits = sum(head_sampled(mint_trace_id(1, n), 0.25)
+                   for n in range(400))
+        assert 40 < hits < 160  # ~100 expected; decisions, not coin flips
+        # The context is ALWAYS minted; only the flag reflects the head
+        # decision (tail retention needs identity on every request).
+        ctx = mint_context(9, 1, head_rate=0.0)
+        assert ctx.trace_id and not ctx.sampled
+
+
+# ---------------------------------------------------- skew correction
+
+def _causal_span(pid, name, ts, dur, tid, sid, parent, **extra):
+    args = {"trace_id": tid, "span_id": sid, "parent_id": parent}
+    args.update(extra)
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "pid": pid, "tid": 0, "args": args}
+
+
+def _bus_span(pid, name, ts, dur, csum):
+    return {"name": name, "ph": "X", "ts": float(ts), "dur": float(dur),
+            "pid": pid, "tid": 0, "args": {"csum": csum}}
+
+
+class TestSkewCorrection:
+    """ISSUE 15 satellite: per-pid clock offsets estimated from matched
+    bus send/recv pairs; after correction EVERY assembled causal edge
+    satisfies parent_ts <= child_ts."""
+
+    OFFSET_US = 80_000.0  # replica clock runs 80ms BEHIND the client
+
+    def _doc(self):
+        from tigerbeetle_tpu.trace import fmt_span_id, fmt_trace_id
+
+        tid = fmt_trace_id(0xFEED)
+        root = fmt_span_id(1)
+        off = self.OFFSET_US
+        events = [
+            # client (pid 10): causal root + one send/recv leg.
+            _causal_span(10, "client_request", 1_000, 9_000, tid, root,
+                         "0" * 16, operation=2),
+            _bus_span(10, "bus_send", 1_200, 10, 111),
+            _bus_span(10, "bus_recv", 2_600, 10, 222),
+            # replica (pid 11): its clock reads 80ms EARLY, so its raw
+            # timestamps land BEFORE the client root span started.
+            _bus_span(11, "bus_recv", 1_250 - off, 10, 111),
+            _causal_span(11, "commit_execute", 2_000 - off, 300, tid,
+                         fmt_span_id(2), root, op=1, operation=2,
+                         window=1),
+            _bus_span(11, "bus_send", 2_500 - off, 10, 222),
+        ]
+        return {"traceEvents": events, "metadata": {}}
+
+    def test_uncorrected_edges_violate_causality(self):
+        from tigerbeetle_tpu.trace.merge import assemble_traces, causal_edges
+
+        asm = assemble_traces(self._doc(), skew_correct=False)
+        edges = causal_edges(asm["traces"][0])
+        assert edges, "no causal edges assembled"
+        assert any(p["ts"] > c["ts"] for p, c in edges), \
+            "synthetic skew did not produce a violation (vacuous test)"
+
+    def test_corrected_edges_are_causal(self):
+        from tigerbeetle_tpu.trace.merge import assemble_traces, causal_edges
+
+        asm = assemble_traces(self._doc(), skew_correct=True)
+        off = asm["clock_offsets_us"].get("11")
+        assert off is not None
+        assert abs(off + self.OFFSET_US) < 500, off  # ~-80ms recovered
+        for t in asm["traces"]:
+            for parent, child in causal_edges(t):
+                assert parent["ts"] <= child["ts"], \
+                    (parent["name"], parent["ts"], child["name"],
+                     child["ts"])
+
+    def test_offsets_estimated_from_matched_pairs(self):
+        from tigerbeetle_tpu.trace.merge import estimate_clock_offsets
+
+        offsets = estimate_clock_offsets(self._doc())
+        assert set(offsets) == {10, 11}
+        assert offsets[10] == 0.0
+        assert abs(offsets[11] + self.OFFSET_US) < 500
+
+
+# ------------------------------------------------------ causal assembly
+
+def test_cluster_causal_assembly_end_to_end():
+    """ISSUE 15 tentpole on the in-process cluster: every traced client
+    request assembles into ONE complete span tree — client_request root
+    on the client's pid, the primary's quorum wait, backup acks, and the
+    commit all causally inside it, zero orphans — with a non-empty
+    per-request critical path whose stages sum to the root's wall
+    time."""
+    from tigerbeetle_tpu import multi_batch
+    from tigerbeetle_tpu.testing.cluster import Cluster
+    from tigerbeetle_tpu.trace.merge import assemble_traces, causal_edges
+    from tigerbeetle_tpu.types import Account, Operation, Transfer
+
+    cluster = Cluster(seed=3, replica_count=3,
+                      tracer_factory=lambda i: Tracer(pid=i))
+    client_tracer = Tracer(pid=90)
+    client = cluster.client(7, tracer=client_tracer)
+
+    def drive(op, body):
+        client.request(op, body)
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+
+    drive(Operation.create_accounts, multi_batch.encode(
+        [b"".join(Account(id=i, ledger=1, code=1).pack()
+                  for i in (1, 2))], 128))
+    for k in range(3):
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=700 + k, debit_account_id=1, credit_account_id=2,
+                      amount=1 + k, ledger=1, code=1).pack()], 128))
+    asm = assemble_traces(cluster.merged_trace())
+    assert asm["total"] == 4
+    assert asm["complete"] == 4
+    assert asm["orphan_spans"] == 0
+    for t in asm["traces"]:
+        root = t["root"]
+        assert root is not None and root["name"] == "client_request"
+        assert root["pid"] == 90
+        names = {s["name"] for s in t["spans"]}
+        assert {"commit_quorum", "replica_ack", "commit_execute"} <= names
+        # Backups ack from their own pids: causality crosses processes.
+        assert len({s["pid"] for s in t["spans"]}) >= 3
+        cp = t["critical_path"]
+        assert cp["total_us"] > 0
+        # Stage sums cover at least the root's wall time (they can
+        # exceed it: commit work runs on every replica in parallel),
+        # and the unattributed remainder is never negative.
+        assert sum(cp["stages"].values()) >= cp["total_us"] - 0.01
+        assert cp["stages"]["network_other_us"] >= 0
+        assert cp["owner"] in cp["stages"]
+        # One shared clock domain: edges are causal without correction.
+        for parent, child in causal_edges(t):
+            assert parent["ts"] <= child["ts"] + 1_000.0
+
+
+# -------------------------------------------------------- tail retention
+
+def test_tail_retention_keeps_flagged_traces_at_one_percent_head():
+    """ISSUE 15 acceptance: at a 1% head rate, 100% of the traces tail
+    retention flags (SLO breach, fallback, recovery cause) stay kept;
+    unflagged traces follow the deterministic head decision."""
+    from tigerbeetle_tpu.trace import fmt_trace_id
+    from tigerbeetle_tpu.trace.context import head_sampled, mint_context
+    from tigerbeetle_tpu.trace.merge import assemble_traces
+
+    t = Tracer(pid=0)
+    n = 300
+    for k in range(1, n + 1):
+        ctx = mint_context(5, k, head_rate=0.01)
+        t.record_span(Event.client_request, t.now_ns(), 1_000, ctx=ctx,
+                      span_id=t.mint_span_id(), operation=1)
+    # Flag three traces the head decision would DROP (the interesting
+    # case: tail retention must override a head miss).
+    dropped = [fmt_trace_id(mint_context(5, k, head_rate=0.01).trace_id)
+               for k in range(1, n + 1)
+               if not head_sampled(mint_context(5, k).trace_id, 0.01)]
+    flagged = {dropped[0]: "slo_breach", dropped[1]: "fallback",
+               dropped[2]: "state_digest"}
+    for tid, reason in flagged.items():
+        t.keep_trace(tid, reason)
+    assert t.counters["trace_tail_keep"] == 3
+    merged = merge_traces([t.chrome_dict()])
+    assert set(merged["metadata"]["kept_traces"]) == set(flagged)
+    asm = assemble_traces(merged, head_rate=0.01)
+    by_id = {tr["trace_id"]: tr for tr in asm["traces"]}
+    assert asm["total"] == n
+    for tid, reason in flagged.items():
+        assert by_id[tid]["kept"], tid
+        assert by_id[tid]["keep_reason"] == f"tail:{reason}"
+    head_kept = [tr for tr in asm["traces"]
+                 if tr["keep_reason"] == "head"]
+    assert asm["kept_total"] == len(head_kept) + len(flagged)
+    assert len(head_kept) < n * 0.1  # ~1% head rate actually thins
+    # keep_trace is idempotent: the first reason wins.
+    t.keep_trace(next(iter(flagged)), "some_other_reason")
+    assert t.kept_traces[next(iter(flagged))] == flagged[
+        next(iter(flagged))]
 
 
 # --------------------------------------------------------------- vortex

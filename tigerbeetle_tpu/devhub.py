@@ -35,6 +35,10 @@ NESTED_KEYS = (
     # clock on the same commit loop; a creeping ratio is a tracing
     # regression like any other.
     ("trace_overhead_ratio", ("trace", "overhead_ratio")),
+    # Causal-propagation cost guard (ISSUE 15): the same loop with
+    # trace-context stamping at sampling 1.0 vs NullTracer; the
+    # acceptance ceiling is 1.15x.
+    ("trace_ctx_overhead_ratio", ("trace", "ctx_overhead_ratio")),
 )
 
 REGRESSION_WINDOW = 8  # trailing runs forming the baseline median
@@ -91,7 +95,8 @@ def _median(values: list[float]) -> Optional[float]:
 # Metrics where a regression is an INCREASE (latency); everything else
 # regresses by dropping (throughput).
 _HIGHER_IS_WORSE = frozenset({"serving_p99_ms", "serving_p999_ms",
-                              "trace_overhead_ratio"})
+                              "trace_overhead_ratio",
+                              "trace_ctx_overhead_ratio"})
 
 
 def regressions(entries: list[dict]) -> dict:
@@ -632,6 +637,61 @@ def render(history_path: str, out_path: str,
             + "<table><tr><th>stage</th><th>share of slow-window time"
               "</th><th></th></tr>"
             + "".join(rows_cp) + "</table>")
+    # Per-request waterfall panel (ISSUE 15): the newest traced run's
+    # assembled request traces (bench ##trace `request_waterfall`, from
+    # trace/merge.py assemble_traces) — one row per kept request, its
+    # wall time broken into quorum wait / commit / device dispatch /
+    # network+other, stacked as a waterfall bar. The causal-propagation
+    # cost guard rides the same record as ctx_overhead_ratio.
+    wf_html = ""
+    wf = next((e.get("trace", {}).get("request_waterfall")
+               for e in reversed(entries)
+               if isinstance(e.get("trace"), dict)
+               and e.get("trace").get("request_waterfall")),
+              None)
+    if wf:
+        colors = {"quorum_wait_us": "#c62", "commit_us": "#2a6",
+                  "device_dispatch_us": "#26c",
+                  "network_other_us": "#aaa"}
+        peak = max((r.get("total_us") or 1.0) for r in wf) or 1.0
+        rows_wf = []
+        for r in wf[:12]:
+            stages = r.get("stages") or {}
+            segs = "".join(
+                '<div style="background:{};height:10px;width:{}px;'
+                'display:inline-block"></div>'.format(
+                    colors.get(k, "#888"),
+                    max(0, round((stages.get(k, 0.0) or 0.0)
+                                 / peak * 320)))
+                for k in colors)
+            rows_wf.append(
+                "<tr><td><code>{}</code></td><td>{:.2f}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td></tr>".format(
+                    html.escape(str(r.get("trace_id", ""))[:16]),
+                    (r.get("total_us") or 0.0) / 1000.0,
+                    html.escape(str(r.get("owner", "-"))),
+                    html.escape(str(r.get("keep_reason", "-"))),
+                    segs))
+        legend = " ".join(
+            '<span style="background:{};padding:0 .5em">&nbsp;</span> {}'
+            .format(c, html.escape(k.replace("_us", "")))
+            for k, c in colors.items())
+        guard_ctx = ""
+        tr_rec = next((e.get("trace") for e in reversed(entries)
+                       if isinstance(e.get("trace"), dict)
+                       and e.get("trace").get("ctx_overhead_ratio")
+                       is not None), None)
+        if tr_rec:
+            guard_ctx = ("<p>causal-propagation cost guard: traced "
+                         "(sampling 1.0) vs NullTracer {}x "
+                         "(ceiling 1.15x)</p>").format(
+                             tr_rec.get("ctx_overhead_ratio"))
+        wf_html = (
+            "<h2>per-request waterfall (latest traced run)</h2>"
+            + guard_ctx + f"<p>{legend}</p>"
+            + "<table><tr><th>trace id</th><th>total ms</th>"
+              "<th>owner</th><th>kept</th><th>waterfall</th></tr>"
+            + "".join(rows_wf) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -679,6 +739,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {tr_html}
 {slo_html}
 {cp_html}
+{wf_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

@@ -16,6 +16,14 @@ tags as labels); histogram-kind catalog events keep their declared unit
 and render as `{prefix}_{event}` histograms. SLO rows render as
 `{prefix}_slo_value` / `{prefix}_slo_threshold` / `{prefix}_slo_ok`
 gauges labelled by objective.
+
+Exemplars (ISSUE 15): when a tracer carries per-series exemplars (the
+latest traced sample of a histogram series, stamped with its causal
+trace id), the series' `_bucket` line containing the exemplar value
+gets an OpenMetrics exemplar suffix — `` # {trace_id="..."} <value>`` —
+so a dashboard can jump from a p99 bucket straight to one concrete
+request trace. `parse_prometheus` understands the suffix and returns
+the exemplars under the reserved `__exemplars__` key.
 """
 
 from __future__ import annotations
@@ -49,6 +57,13 @@ def _fmt(v: float) -> str:
     return str(int(f)) if f == int(f) else repr(f)
 
 
+def _exemplar_suffix(ex: dict) -> str:
+    """OpenMetrics exemplar suffix for one `_bucket` line:
+    `` # {trace_id="<hex>"} <value>``."""
+    return (f' # {{trace_id="{_esc(ex["trace_id"])}"}} '
+            f'{_fmt(ex["value"])}')
+
+
 def render_prometheus(tracers, slo_rows: Optional[list] = None,
                       burn: Optional[dict] = None,
                       prefix: str = "tb_tpu") -> str:
@@ -62,6 +77,7 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
     gauges: dict = {}
     hists: dict = {}
     series: dict = {}
+    exemplars: dict = {}
     for t in tracers:
         for name, v in t.counters.items():
             counters[name] = counters.get(name, 0) + v
@@ -72,6 +88,12 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
             else:
                 hists[key] = Histogram().merge(h)
                 series[key] = t.histogram_series[key]
+        # One exemplar per series survives the merge: the slowest traced
+        # sample wins (the sample an operator chasing a p99 wants).
+        for key, ex in getattr(t, "exemplars", {}).items():
+            cur = exemplars.get(key)
+            if cur is None or ex["value"] >= cur["value"]:
+                exemplars[key] = ex
     lines: list = []
 
     def _doc(name: str) -> str:
@@ -93,7 +115,8 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
     by_event: dict = {}
     for key in sorted(hists):
         name, tags = series[key]
-        by_event.setdefault(name, []).append((tags, hists[key]))
+        by_event.setdefault(name, []).append(
+            (tags, hists[key], exemplars.get(key)))
     for name in sorted(by_event):
         ev = CATALOG.get(name)
         unit_suffix = ("_us" if ev is not None
@@ -101,14 +124,23 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
         metric = f"{prefix}_{name}{unit_suffix}"
         lines.append(f"# HELP {metric} {_doc(name)}")
         lines.append(f"# TYPE {metric} histogram")
-        for tags, h in by_event[name]:
+        for tags, h, ex in by_event[name]:
+            # The exemplar rides the first bucket whose upper bound
+            # covers its value (OpenMetrics: an exemplar must lie
+            # within its bucket), falling back to +Inf.
             for upper, cum_count in h.cumulative():
-                lines.append(
-                    f"{metric}_bucket"
-                    f"{_labels(tags, {'le': _fmt(upper)})} {cum_count}")
-            lines.append(
-                f"{metric}_bucket{_labels(tags, {'le': '+Inf'})} "
-                f"{h.count}")
+                line = (f"{metric}_bucket"
+                        f"{_labels(tags, {'le': _fmt(upper)})} "
+                        f"{cum_count}")
+                if ex is not None and ex["value"] <= upper:
+                    line += _exemplar_suffix(ex)
+                    ex = None
+                lines.append(line)
+            line = (f"{metric}_bucket{_labels(tags, {'le': '+Inf'})} "
+                    f"{h.count}")
+            if ex is not None:
+                line += _exemplar_suffix(ex)
+            lines.append(line)
             lines.append(f"{metric}_sum{_labels(tags)} {_fmt(h.sum)}")
             lines.append(f"{metric}_count{_labels(tags)} {h.count}")
     if slo_rows:
@@ -140,16 +172,40 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
     return "\n".join(lines) + "\n"
 
 
+def _parse_labels(body: str) -> dict:
+    labels: dict = {}
+    for m in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', body):
+        labels[m.group(1)] = (m.group(2).replace('\\"', '"')
+                              .replace("\\n", "\n")
+                              .replace("\\\\", "\\"))
+    return labels
+
+
 def parse_prometheus(text: str) -> dict:
     """Minimal exposition parser for the acceptance tests:
     {metric_name: [(labels_dict, value)]}. Raises ValueError on a line
-    that is neither a comment nor `name{labels} value` — the
-    "Prometheus-parseable" check."""
+    that is neither a comment nor `name{labels} value` (with an
+    optional OpenMetrics `` # {labels} value`` exemplar suffix) — the
+    "Prometheus-parseable" check. Parsed exemplars land under the
+    reserved `__exemplars__` key as
+    {metric_name: [(labels_dict, exemplar_labels_dict, exemplar_value)]}."""
     out: dict = {}
     for line in text.splitlines():
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        exemplar = None
+        if " # " in line:
+            line, _, ex_raw = line.partition(" # ")
+            ex_head, _, ex_val = ex_raw.rpartition(" ")
+            if not (ex_head.startswith("{") and ex_head.endswith("}")):
+                raise ValueError(
+                    f"unparseable exemplar suffix: {ex_raw!r}")
+            try:
+                exemplar = (_parse_labels(ex_head[1:-1]), float(ex_val))
+            except ValueError as e:
+                raise ValueError(
+                    f"unparseable exemplar value: {ex_raw!r}") from e
         head, _, val = line.rpartition(" ")
         if not head:
             raise ValueError(f"unparseable exposition line: {line!r}")
@@ -159,11 +215,7 @@ def parse_prometheus(text: str) -> dict:
             if not head.endswith("}"):
                 raise ValueError(f"unparseable exposition line: {line!r}")
             name, _, body = head.partition("{")
-            body = body[:-1]
-            for m in re.finditer(r'(\w+)="((?:[^"\\]|\\.)*)"', body):
-                labels[m.group(1)] = (m.group(2).replace('\\"', '"')
-                                      .replace("\\n", "\n")
-                                      .replace("\\\\", "\\"))
+            labels = _parse_labels(body[:-1])
         if not name or " " in name:
             raise ValueError(f"unparseable exposition line: {line!r}")
         try:
@@ -172,6 +224,9 @@ def parse_prometheus(text: str) -> dict:
             raise ValueError(
                 f"unparseable exposition value: {line!r}") from e
         out.setdefault(name, []).append((labels, fval))
+        if exemplar is not None:
+            out.setdefault("__exemplars__", {}).setdefault(
+                name, []).append((labels,) + exemplar)
     return out
 
 

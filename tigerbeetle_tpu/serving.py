@@ -50,7 +50,7 @@ from dataclasses import dataclass
 
 from .ops.ledger import DeviceLedger, MirrorDivergence, default_recovery_stats
 from .oracle.state_machine import StateMachineOracle
-from .trace import Event, FlightRecorder, NullTracer
+from .trace import Event, FlightRecorder, NullTracer, fmt_trace_id
 
 
 class TransientDispatchError(RuntimeError):
@@ -182,6 +182,11 @@ class ServingSupervisor:
         self.last_recovery: dict | None = None
         self._windows_since_epoch = 0
         self.windows_total = 0
+        # Trace ids of requests whose windows landed since the last
+        # verified epoch: a recovery affects exactly these requests, so
+        # tail retention force-keeps them (ISSUE 15) and the flight
+        # artifact names them for cross-reference.
+        self._epoch_trace_ids: list[str] = []
         self._attach(DeviceLedger(a_cap, t_cap,
                                   write_through=StateMachineOracle()))
 
@@ -203,16 +208,27 @@ class ServingSupervisor:
         self.history.append(norm)
         return res
 
-    def create_transfers_window(self, batches: list, timestamps: list):
+    def create_transfers_window(self, batches: list, timestamps: list,
+                                trace_ctxs: list | None = None):
         """Submit one commit window: `batches` is a list of Transfer
         object lists, `timestamps` the per-prepare commit timestamps.
         Returns the ledger's per-prepare (status u32[n], ts u64[n])
-        pairs. Runs the epoch check when the interval elapses."""
+        pairs. Runs the epoch check when the interval elapses.
+
+        `trace_ctxs` is the optional per-prepare TraceContext list
+        (entries may be None): the window span joins the first traced
+        request's causal tree and LINKS every constituent trace id —
+        the fan-in edge assemble_traces() reads. A window that lands on
+        the fallback route force-keeps its constituent traces (tail
+        retention), as does any recovery that replays it."""
         from .ops.batch import transfers_to_arrays
 
         batches = [list(b) for b in batches]
         timestamps = list(timestamps)
         win = self.windows_total
+        ctxs = [c for c in (trace_ctxs or ()) if c is not None]
+        trace_ids = [fmt_trace_id(c.trace_id) for c in ctxs]
+        self._epoch_trace_ids.extend(trace_ids)
 
         def thunk():
             evs = [transfers_to_arrays(b) for b in batches]
@@ -222,7 +238,10 @@ class ServingSupervisor:
         # ledger only knows which route it took after dispatch), so
         # each window lands in its route/tier latency class — the
         # per-class distributions the SLO objectives read.
-        with self.tracer.span(Event.window_commit) as sp:
+        with self.tracer.span(Event.window_commit,
+                              ctx=ctxs[0] if ctxs else None) as sp:
+            for tid in trace_ids:
+                sp.link(tid)
             out = self._dispatch(thunk, what="window", win=win)
             # The route the ledger actually took (chain is the default
             # whole-window scan dispatch) — counted into the trace
@@ -236,8 +255,13 @@ class ServingSupervisor:
                 if tier:
                     sp.tags["tier"] = tier
                 self.tracer.count(Event.dispatch_route, route=route)
+        if route and "fallback" in route:
+            for tid in trace_ids:
+                self.tracer.keep_trace(tid, reason="fallback")
         self.flight.record(window=win, route=route or "unknown",
-                           prepares=len(batches))
+                           prepares=len(batches),
+                           **({"trace_ids": trace_ids} if trace_ids
+                              else {}))
         norm = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts.tolist())]
                 for st, ts in out]
         self.log.append(("window", batches, timestamps))
@@ -335,6 +359,7 @@ class ServingSupervisor:
                                epoch_digest=got)
             self.log.clear()
             self._windows_since_epoch = 0
+            self._epoch_trace_ids.clear()
             return True
         self._recover(cause, detail=detail, replayed=replayed)
         return False
@@ -399,8 +424,17 @@ class ServingSupervisor:
         tagged with the recovery cause before anything is rebuilt —
         covering retry exhaustion, deadline, divergence, and
         drain-fault causes alike."""
+        # Tail retention: every request whose window sits in the
+        # replayed suffix is force-kept regardless of head sampling,
+        # and the flight artifact names the same trace ids so the
+        # post-mortem can be cross-referenced with the causal traces.
+        affected = list(dict.fromkeys(self._epoch_trace_ids))
+        for tid in affected:
+            self.tracer.keep_trace(tid, reason=cause)
         self.flight.record(window=self.windows_total, route="recovery",
-                           cause=cause, detail=detail[:200])
+                           cause=cause, detail=detail[:200],
+                           **({"trace_ids": affected} if affected
+                              else {}))
         self.flight.dump(cause)
         self.tracer.count(Event.serving_recoveries, cause=cause)
         with self.tracer.span(Event.serving_recovery_replay, cause=cause):
@@ -436,6 +470,7 @@ class ServingSupervisor:
                                   write_through=new_mirror))
         self.log.clear()
         self._windows_since_epoch = 0
+        self._epoch_trace_ids.clear()
 
     # -------------------------------------------------------------- stats
 

@@ -15,6 +15,7 @@ import random
 from typing import Callable, Optional
 
 from ..state_machine import StateMachine
+from ..trace import Event, NullTracer, mint_context
 from ..types import Operation
 from ..vsr import snapshot as snapshot_codec
 from ..vsr.header import Command, Header, Message
@@ -95,23 +96,40 @@ class SimClient:
     replica (only the primary acts; session request numbers dedupe).
     reference: src/vsr/client.zig (simplified: no hedging, no eviction)."""
 
-    def __init__(self, cluster: "Cluster", client_id: int):
+    def __init__(self, cluster: "Cluster", client_id: int,
+                 tracer=None, trace_head_rate: float = 1.0,
+                 trace_seed: int = 0):
         self.cluster = cluster
         self.client_id = client_id
         self.request_number = 0
         self.inflight: Optional[dict] = None
         self.replies: list[Message] = []
+        # Causal tracing (ISSUE 15): with a recording tracer every
+        # request mints a deterministic context, opens the causal root
+        # span (explicit timing: the reply closes it in on_message),
+        # and ships the context on the wire header.
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self.trace_head_rate = trace_head_rate
+        self.trace_seed = trace_seed
 
     def request(self, operation: Operation, body: bytes,
                 callback: Optional[Callable[[Message], None]] = None) -> None:
         assert self.inflight is None, "one request at a time"
         self.request_number += 1
+        ctx = mint_context(self.client_id, self.request_number,
+                           head_rate=self.trace_head_rate,
+                           seed=self.trace_seed)
+        root_sid = self.tracer.mint_span_id()
         header = Header(
             command=Command.request, cluster=self.cluster.cluster_id,
             client=self.client_id, request=self.request_number,
-            operation=int(operation))
+            operation=int(operation),
+            trace_ctx=ctx.child(root_sid) if root_sid else ctx)
         msg = Message(header.finalize(body), body=body)
-        self.inflight = {"message": msg, "sent_at": 0, "callback": callback}
+        self.inflight = {"message": msg, "sent_at": 0, "callback": callback,
+                         "ctx": ctx, "root_sid": root_sid,
+                         "t0": self.tracer.now_ns(),
+                         "operation": int(operation)}
         self._send()
 
     def _send(self) -> None:
@@ -128,9 +146,15 @@ class SimClient:
             return
         if msg.header.request != self.request_number:
             return
-        cb = self.inflight["callback"]
+        inf = self.inflight
+        cb = inf["callback"]
         self.inflight = None
         self.replies.append(msg)
+        if inf["root_sid"]:
+            self.tracer.record_span(
+                Event.client_request, inf["t0"],
+                self.tracer.now_ns() - inf["t0"], ctx=inf["ctx"],
+                span_id=inf["root_sid"], operation=inf["operation"])
         if cb is not None:
             cb(msg)
 
@@ -219,9 +243,13 @@ class Cluster:
             state_machine_factory=self.state_machine_factory,
             options=self.options, tracer=tracer)
 
-    def client(self, client_id: int) -> SimClient:
+    def client(self, client_id: int, tracer=None,
+               trace_head_rate: float = 1.0,
+               trace_seed: int = 0) -> SimClient:
         if client_id not in self.clients:
-            self.clients[client_id] = SimClient(self, client_id)
+            self.clients[client_id] = SimClient(
+                self, client_id, tracer=tracer,
+                trace_head_rate=trace_head_rate, trace_seed=trace_seed)
         return self.clients[client_id]
 
     # ------------------------------------------------------------- network
@@ -444,8 +472,13 @@ class Cluster:
         from ..trace import merge_traces
 
         assert self.tracers, "Cluster built without tracer_factory"
-        return merge_traces([self.tracers[i].chrome_dict()
-                             for i in sorted(self.tracers)])
+        docs = [self.tracers[i].chrome_dict()
+                for i in sorted(self.tracers)]
+        for cid in sorted(self.clients):
+            t = self.clients[cid].tracer
+            if hasattr(t, "chrome_dict"):
+                docs.append(t.chrome_dict())
+        return merge_traces(docs)
 
     def debug_status(self) -> str:
         return " | ".join(

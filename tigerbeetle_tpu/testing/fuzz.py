@@ -574,12 +574,20 @@ def fuzz_message_bus(prng: random.Random, iterations: int) -> None:
     weak delivery contract (reference: message_buffer.zig framing): for
     ANY byte stream, every delivered message must be a valid frame that
     was actually sent (drop / duplicate / reorder are allowed; delivering
-    corruption never is) and the event loop must survive."""
+    corruption never is) and the event loop must survive.
+
+    Trace-context frames (ISSUE 15): some frames carry a trace-context
+    block in the header's reserved (out-of-checksum) region. Targeted
+    corruption INSIDE that block must degrade the context to dropped/
+    unsampled — the frame still delivers, the payload is untouched, and
+    the bus never crashes; an intact block must survive delivery
+    byte-identically."""
     import selectors as _selectors
     import socket as _socket
 
+    from ..trace.context import CTX_WIRE_SIZE, mint_context
     from ..vsr import message_bus as mb
-    from ..vsr.header import Command, Header, Message
+    from ..vsr.header import TRACE_CTX_OFFSET, Command, Header, Message
 
     for _ in range(iterations):
         got: list = []
@@ -591,14 +599,30 @@ def fuzz_message_bus(prng: random.Random, iterations: int) -> None:
         bus.connections[b] = conn
         bus.selector.register(b, _selectors.EVENT_READ, conn)
         frames = []
+        ctx_want: dict = {}  # header checksum -> expected TraceContext
         for i in range(prng.randrange(1, 12)):
             body = bytes(prng.randrange(256)
                          for _ in range(prng.randrange(0, 200)))
+            ctx = (mint_context(i + 1, i + 1, seed=7)
+                   if prng.random() < 0.5 else None)
             h = Header(command=prng.choice(
                 (Command.ping, Command.commit, Command.prepare_ok)),
-                cluster=7, replica=prng.randrange(3), op=i)
-            frames.append(Message(h.finalize(body), body=body).pack())
-        sent = {Message.unpack(f).header.checksum for f in frames}
+                cluster=7, replica=prng.randrange(3), op=i,
+                trace_ctx=ctx)
+            msg = Message(h.finalize(body), body=body)
+            raw = bytearray(msg.pack())
+            if ctx is not None and prng.random() < 0.5:
+                # Flip one bit inside the trace-context block: the block
+                # is outside the header checksum, so the frame stays
+                # valid and MUST still deliver — with the context
+                # dropped (unpack's magic/mini-checksum rejects any
+                # single-bit damage), never a crash or a payload change.
+                off = TRACE_CTX_OFFSET + prng.randrange(CTX_WIRE_SIZE)
+                raw[off] ^= 1 << prng.randrange(8)
+                ctx = None
+            ctx_want[msg.header.checksum] = ctx
+            frames.append(bytes(raw))
+        sent = set(ctx_want)
         order = list(frames)
         if prng.random() < 0.5:
             prng.shuffle(order)  # reorder: allowed by the contract
@@ -633,6 +657,14 @@ def fuzz_message_bus(prng: random.Random, iterations: int) -> None:
             assert m.valid()
             assert m.header.checksum in sent, \
                 "bus delivered a frame that was never sent"
+            if roll >= 0.4:
+                # Stream undamaged by the generic corruption modes: a
+                # delivered frame's context must match what was sent —
+                # intact contexts byte-identical, ctx-corrupted ones
+                # dropped to None (unsampled) with the payload intact.
+                assert m.header.trace_ctx == \
+                    ctx_want[m.header.checksum], \
+                    "trace context did not degrade cleanly"
         bus.close()
 
 

@@ -443,6 +443,48 @@ def _scenario_slo(col: _Collector) -> None:
     assert tracer.counters.get("slo_breach", 0) >= len(forced)
 
 
+def _scenario_causal_trace(col: _Collector) -> None:
+    """ISSUE 15's causal plane end to end in the simulator: a traced
+    cluster plus a traced client emits the per-request spans
+    (client_request root, the primary's commit_quorum wait, the
+    backups' replica_ack), assemble_traces() rebuilds one complete
+    orphan-free tree per request, and a forced tail-keep at a 0% head
+    rate proves trace_tail_keep + retention."""
+    from .. import multi_batch
+    from ..trace import assemble_traces
+    from ..types import Account, Operation, Transfer
+    from .cluster import Cluster
+
+    cluster = Cluster(seed=3, replica_count=3, tracer_factory=col.make)
+    client_tracer = col.make(90)
+    client = cluster.client(7, tracer=client_tracer)
+
+    def drive(op, body):
+        client.request(op, body)
+        assert cluster.run(4000, until=lambda: client.idle), \
+            cluster.debug_status()
+
+    drive(Operation.create_accounts, multi_batch.encode(
+        [b"".join(Account(id=i, ledger=1, code=1).pack()
+                  for i in (1, 2))], 128))
+    for k in range(3):
+        drive(Operation.create_transfers, multi_batch.encode(
+            [Transfer(id=900 + k, debit_account_id=1,
+                      credit_account_id=2, amount=1 + k,
+                      ledger=1, code=1).pack()], 128))
+    asm = assemble_traces(cluster.merged_trace())
+    assert asm["total"] == 4 and asm["complete"] == 4 \
+        and asm["orphan_spans"] == 0, {
+            k: asm[k] for k in ("total", "complete", "orphan_spans")}
+    # Tail retention: force-keep one trace, then assemble at a 0% head
+    # rate — exactly the kept trace survives sampling.
+    tid = asm["traces"][0]["trace_id"]
+    client_tracer.keep_trace(tid, reason="slo_breach")
+    asm2 = assemble_traces(cluster.merged_trace(), head_rate=0.0)
+    kept = [t["trace_id"] for t in asm2["traces"] if t["kept"]]
+    assert kept == [tid], kept
+
+
 SCENARIOS = (
     _scenario_rebuild,
     _scenario_view_change,
@@ -454,6 +496,7 @@ SCENARIOS = (
     _scenario_router,
     _scenario_partitioned,
     _scenario_slo,
+    _scenario_causal_trace,
 )
 
 

@@ -16,7 +16,12 @@ Layout mirrors the reference:
 - `histogram.py` — log2-bucketed, losslessly mergeable latency
   histograms (~1% relative error), fed by every span at close.
 - `merge.py`  — cluster-wide trace merge (pid=replica, common timeline),
-  exact offline span quantiles, and p99 critical-path attribution.
+  exact offline span quantiles, p99 critical-path attribution, and
+  causal assembly: per-request span trees from propagated trace
+  contexts, with clock-skew correction from matched bus span pairs.
+- `context.py` — the compact trace-context block (trace_id u128,
+  parent_span_id u64, sampled flag) carried in the VSR header's
+  reserved region, plus deterministic minting and head sampling.
 - `slo.py`    — objectives from perf/slo.json, evaluation against live
   histograms, and run-granular burn-rate accounting.
 - `flight_recorder.py` — bounded per-replica ring of per-window device
@@ -29,11 +34,14 @@ scrubber, message bus, serving supervisor, and sharded router; see
 docs/operating/monitoring.md for the operator-facing catalog.
 """
 
+from .context import (TraceContext, fmt_span_id, fmt_trace_id,
+                      head_sampled, mint_context, mint_trace_id)
 from .event import CATALOG, TID_BASE, Event, EventKind, EventSpec, lookup
 from .flight_recorder import FlightRecorder, merge_flight_records
 from .histogram import Histogram
-from .merge import (CRITICAL_PATH_STAGES, critical_path, merge_trace_files,
-                    merge_traces, span_quantile)
+from .merge import (CRITICAL_PATH_STAGES, assemble_traces, causal_edges,
+                    critical_path, estimate_clock_offsets,
+                    merge_trace_files, merge_traces, span_quantile)
 from .slo import (Objective, burn_rates, evaluate, evaluate_bench_record,
                   load_objectives)
 from .statsd import StatsD, TimingAggregates
@@ -41,8 +49,11 @@ from .tracer import NullTracer, Tracer
 
 __all__ = [
     "CATALOG", "TID_BASE", "Event", "EventKind", "EventSpec", "lookup",
+    "TraceContext", "fmt_span_id", "fmt_trace_id", "head_sampled",
+    "mint_context", "mint_trace_id",
     "FlightRecorder", "merge_flight_records",
     "Histogram", "CRITICAL_PATH_STAGES", "critical_path",
+    "assemble_traces", "causal_edges", "estimate_clock_offsets",
     "merge_trace_files", "merge_traces", "span_quantile",
     "Objective", "burn_rates", "evaluate", "evaluate_bench_record",
     "load_objectives", "StatsD", "TimingAggregates",

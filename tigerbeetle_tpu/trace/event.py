@@ -112,8 +112,14 @@ class Event(enum.Enum):
     rebuild = _span("rebuild-from-cluster, open_rebuild to voter re-entry")
 
     # --------------------------------------------------------- message bus
-    bus_send = _span("serialize + enqueue one outbound message", "command")
-    bus_recv = _span("deliver one validated inbound message", "command")
+    # `csum` is the frame's header-checksum low bits: the SAME value is
+    # tagged on the sender's bus_send and the receiver's bus_recv, which
+    # is how trace/merge.py matches send/recv pairs across pids to
+    # estimate per-pid clock offsets before causal assembly.
+    bus_send = _span("serialize + enqueue one outbound message",
+                     "command", "csum")
+    bus_recv = _span("deliver one validated inbound message",
+                     "command", "csum")
     bus_pool_used = _gauge("outbound message-pool slots in use")
     config_mismatch_peer = _counter(
         "pings rejected for a cluster-config fingerprint mismatch")
@@ -179,6 +185,25 @@ class Event(enum.Enum):
     flight_recorder_dump = _counter(
         "flight-recorder artifacts dumped for post-mortem", "reason")
 
+    # -------------------------------------------------- causal tracing
+    # ISSUE 15: per-request spans.  These carry a propagated trace
+    # context (trace_id/span_id/parent_id recorded as span args), so
+    # trace/merge.py's assemble_traces() can rebuild one causal tree
+    # per client request across client + replica dumps.
+    client_request = _span(
+        "one client request, submit to reply (the causal root span "
+        "every downstream span parents to)", "operation")
+    commit_quorum = _span(
+        "primary's prepare_ok quorum wait: prepare fan-out to quorum "
+        "reached (explicit-timing span recorded at quorum)", "op")
+    replica_ack = _span(
+        "backup replication of one traced prepare: receipt to the "
+        "durable-slot prepare_ok", "op")
+    trace_tail_keep = _counter(
+        "traces force-kept by tail retention (SLO breach, fallback/"
+        "poison cause, supervisor recovery) regardless of the head-"
+        "sampling decision", "reason")
+
     # ------------------------------------------------------ tracer internal
     trace_dropped_events = _counter(
         "span ring evictions (the trace is truncated at its start)")
@@ -214,6 +239,18 @@ for _e in Event:
     TID_BASE[_e] = _next
     if _e.kind == EventKind.span:
         _next += _e.slots
+
+# Hot-path constants, stapled onto each member as a PLAIN instance
+# attribute: `ev._hot` is one C-speed attribute read, where `ev.name`
+# costs a DynamicClassAttribute descriptor hop, `ev.tags` a property
+# into the EventSpec, and any dict keyed by the member a Python-level
+# Enum.__hash__ call. The recording tracer's span-close path reads
+# several of these per span; the bench ##trace overhead ratios guard
+# the sum. Layout: (name, kind, frozenset(tags), slots, hist_tags,
+# TID_BASE[member]).
+for _e in Event:
+    _e._hot = (_e.name, _e.kind, frozenset(_e.tags), _e.slots,
+               _e.hist_tags, TID_BASE[_e])
 del _next, _e
 
 

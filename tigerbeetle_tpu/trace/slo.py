@@ -115,13 +115,35 @@ def _series_for(tracer, objective: Objective) -> Histogram:
     return out
 
 
+def _exemplar_trace_ids(tracer, objective: Objective) -> list:
+    """Trace ids exemplifying the objective's series: the tracer keeps
+    one exemplar (latest traced sample) per histogram series; a breach
+    tail-keeps exactly these, tying the breached distribution back to
+    concrete causal request traces."""
+    out = []
+    exemplars = getattr(tracer, "exemplars", None)
+    if not exemplars:
+        return out
+    for key, (name, tags) in tracer.histogram_series.items():
+        if name != objective.event:
+            continue
+        if any(tags.get(k) != v for k, v in objective.tags.items()):
+            continue
+        ex = exemplars.get(key)
+        if ex and ex.get("trace_id"):
+            out.append(ex["trace_id"])
+    return out
+
+
 def evaluate(tracer, objectives, emit_to=None) -> list:
     """Evaluate objectives against a recording tracer's cumulative
     histograms. Returns one row per objective:
     {name, event, quantile, value, threshold, unit, count, ok} with
     ok=None when the series is empty (unknown, not a breach). With
     `emit_to` (a tracer), each breach counts the `slo_breach` catalog
-    event tagged with the objective name."""
+    event tagged with the objective name, and tail-retains the breached
+    series' exemplar traces (keep_trace reason "slo_breach") so a
+    1%-head-sampled deployment still keeps every breach's trace."""
     rows = []
     for o in objectives:
         h = _series_for(tracer, o)
@@ -132,6 +154,8 @@ def evaluate(tracer, objectives, emit_to=None) -> list:
         ok = None if value is None else bool(value <= o.threshold)
         if ok is False and emit_to is not None:
             emit_to.count(Event.slo_breach, objective=o.name)
+            for tid in _exemplar_trace_ids(tracer, o):
+                emit_to.keep_trace(tid, reason="slo_breach")
         rows.append({
             "name": o.name, "event": o.event, "quantile": o.quantile,
             "value": None if value is None else round(value, 3),

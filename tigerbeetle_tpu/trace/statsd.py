@@ -63,18 +63,27 @@ class TimingAggregates:
     hist_tags values (e.g. window_commit route/tier) so per-class
     distributions survive the aggregation."""
 
-    def __init__(self):
+    def __init__(self, with_hist: bool = True):
+        # with_hist=False skips the per-interval histogram entirely —
+        # it only feeds flush_to()'s percentile TIMING lines, so a
+        # tracer with no StatsD attached need not pay a second
+        # Histogram.record per span (the tracer's own cumulative
+        # histograms are unaffected).
         self._agg: dict[str, list] = {}
         self._hist: dict[str, Histogram] = {}
         self._series: dict[str, tuple] = {}  # key -> (name, tags)
+        self._with_hist = with_hist
 
-    def record(self, name: str, dur_us: float, tags: dict = None) -> None:
-        key = name if not tags else name + "|" + ",".join(
-            f"{k}:{v}" for k, v in sorted(tags.items()))
+    def record(self, name: str, dur_us: float, tags: dict = None,
+               key: str = None) -> None:
+        # `key` lets the tracer's span-close path pass its already-built
+        # series key instead of paying the sorted-join twice per span.
+        if key is None:
+            key = name if not tags else name + "|" + ",".join(
+                f"{k}:{v}" for k, v in sorted(tags.items()))
         a = self._agg.get(key)
         if a is None:
             self._agg[key] = [1, dur_us, dur_us, dur_us]
-            self._hist[key] = Histogram()
             self._series[key] = (name, dict(tags) if tags else {})
         else:
             a[0] += 1
@@ -83,7 +92,11 @@ class TimingAggregates:
                 a[2] = dur_us
             if dur_us > a[3]:
                 a[3] = dur_us
-        self._hist[key].record(dur_us)
+        if self._with_hist:
+            h = self._hist.get(key)
+            if h is None:
+                h = self._hist[key] = Histogram()
+            h.record(dur_us)
 
     def snapshot(self) -> dict:
         """{series: {count, sum_us, min_us, max_us}} without resetting.
@@ -103,9 +116,10 @@ class TimingAggregates:
             statsd.gauge(f"trace.{name}.sum_us", round(a[1], 3), **tags)
             statsd.gauge(f"trace.{name}.min_us", round(a[2], 3), **tags)
             statsd.gauge(f"trace.{name}.max_us", round(a[3], 3), **tags)
-            summary = self._hist[key].summary()
+            h = self._hist.get(key)
+            summary = h.summary() if h is not None else {}
             for q_name in ("p50", "p95", "p99", "p999"):
-                q_us = summary[q_name]
+                q_us = summary.get(q_name)
                 if q_us is not None:
                     statsd.timing(f"trace.{name}.{q_name}",
                                   round(q_us / 1000.0, 4), **tags)

@@ -21,6 +21,7 @@ import time as _time
 from typing import Optional
 
 from ..clients.common import ClientHelpers
+from ..trace import Event, NullTracer, mint_context
 from ..types import Operation
 from .header import Command, Header, Message
 from .message_bus import MessageBus
@@ -44,7 +45,9 @@ class SessionEvicted(Exception):
 class Client(ClientHelpers):
     def __init__(self, *, cluster: int, client_id: int,
                  replica_addresses: list[tuple[str, int]],
-                 hedge_delay_s: Optional[float] = None):
+                 hedge_delay_s: Optional[float] = None,
+                 tracer=None, trace_head_rate: float = 1.0,
+                 trace_seed: int = 0):
         self.cluster = cluster
         self.client_id = client_id
         self.request_number = 0
@@ -54,9 +57,15 @@ class Client(ClientHelpers):
         self._reply: Optional[Message] = None
         self._evicted = False
         self._primary_guess = 0
+        # Causal tracing: every request mints a deterministic trace
+        # context (ISSUE 15); the recording span is the request's ROOT,
+        # and the context rides the wire header to the replicas.
+        self.tracer = tracer if tracer is not None else NullTracer()
+        self.trace_head_rate = trace_head_rate
+        self.trace_seed = trace_seed
         self.bus = MessageBus(
             cluster=cluster, on_message=self._on_message,
-            replica_addresses=replica_addresses)
+            replica_addresses=replica_addresses, tracer=self.tracer)
 
     # ------------------------------------------------------- adaptivity
 
@@ -110,42 +119,49 @@ class Client(ClientHelpers):
         if self._evicted:
             raise SessionEvicted(f"client {self.client_id} was evicted")
         self.request_number += 1
-        header = Header(
-            command=Command.request, cluster=self.cluster,
-            client=self.client_id, request=self.request_number,
-            operation=int(operation))
-        msg = Message(header.finalize(body), body=body)
-        self._reply = None
-        # Liveness plane (timeout/hedge pacing), never committed
-        # state: replies are ordered by the replicas, not by when this
-        # client observed them.
-        start = _time.monotonic()  # jaxhound: allow(wall_clock)
-        deadline = start + timeout_s
-        hedge_at = start + self.hedge_delay_s()
-        resend_at = 0.0
-        attempt = 0
-        self.bus.send_to_replica(self._primary_guess, msg)
-        while self._reply is None:
-            if self._evicted:
-                raise SessionEvicted(
-                    f"client {self.client_id} was evicted")
-            now = _time.monotonic()  # jaxhound: allow(wall_clock)
-            if now >= deadline:
-                raise TimeoutError(f"request {self.request_number} timed out")
-            if now >= hedge_at and now >= resend_at:
-                resend_at = now + self._resend_delay_s(attempt)
-                attempt += 1
-                for r in range(len(self.bus.replica_addresses)):
-                    self.bus.send_to_replica(r, msg)
-            self.bus.poll(0.02)
-        if attempt == 0:
-            # Only un-hedged round-trips feed the EWMA: a reply that
-            # needed the fan-out measures hedge-wait + loss recovery,
-            # not RTT — folding those in would ratchet the hedge delay
-            # toward the cap exactly when fast fan-out matters most.
-            self._observe_rtt(
-                _time.monotonic() - start)  # jaxhound: allow(wall_clock)
-        return self._reply.body
+        ctx = mint_context(self.client_id, self.request_number,
+                           head_rate=self.trace_head_rate,
+                           seed=self.trace_seed)
+        with self.tracer.span(Event.client_request, ctx=ctx,
+                              operation=int(operation)) as root:
+            header = Header(
+                command=Command.request, cluster=self.cluster,
+                client=self.client_id, request=self.request_number,
+                operation=int(operation), trace_ctx=root.ctx or ctx)
+            msg = Message(header.finalize(body), body=body)
+            self._reply = None
+            # Liveness plane (timeout/hedge pacing), never committed
+            # state: replies are ordered by the replicas, not by when
+            # this client observed them.
+            start = _time.monotonic()  # jaxhound: allow(wall_clock)
+            deadline = start + timeout_s
+            hedge_at = start + self.hedge_delay_s()
+            resend_at = 0.0
+            attempt = 0
+            self.bus.send_to_replica(self._primary_guess, msg)
+            while self._reply is None:
+                if self._evicted:
+                    raise SessionEvicted(
+                        f"client {self.client_id} was evicted")
+                now = _time.monotonic()  # jaxhound: allow(wall_clock)
+                if now >= deadline:
+                    raise TimeoutError(
+                        f"request {self.request_number} timed out")
+                if now >= hedge_at and now >= resend_at:
+                    resend_at = now + self._resend_delay_s(attempt)
+                    attempt += 1
+                    for r in range(len(self.bus.replica_addresses)):
+                        self.bus.send_to_replica(r, msg)
+                self.bus.poll(0.02)
+            if attempt == 0:
+                # Only un-hedged round-trips feed the EWMA: a reply that
+                # needed the fan-out measures hedge-wait + loss recovery,
+                # not RTT — folding those in would ratchet the hedge
+                # delay toward the cap exactly when fast fan-out matters
+                # most.
+                self._observe_rtt(
+                    _time.monotonic() - start)  # jaxhound: allow(wall_clock)
+            return self._reply.body
 
     # Typed helpers (create_accounts, lookups, queries) come from
     # ClientHelpers — shared with the native C binding.

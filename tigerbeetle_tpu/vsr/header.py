@@ -23,9 +23,19 @@ import dataclasses
 import enum
 import struct
 
+from ..trace.context import CTX_WIRE_SIZE, TraceContext
 from .checksum import checksum
 
 HEADER_SIZE = 256
+
+# The trace-context block (ISSUE 15) rides in the reserved region, at
+# this offset into the packed 256 bytes.  The header checksum is
+# computed over a ZEROED reserved region (`_packed_tail`), so the block
+# is out-of-checksum by construction: corrupting it degrades the frame
+# to "unsampled" (TraceContext.unpack -> None) without invalidating the
+# header or body.
+TRACE_CTX_OFFSET = HEADER_SIZE - 116
+assert TRACE_CTX_OFFSET + CTX_WIRE_SIZE <= HEADER_SIZE
 
 
 class Command(enum.IntEnum):
@@ -98,6 +108,8 @@ class Header:
     operation: int = 0
     command: Command = Command.reserved
     replica: int = 0
+    # Causal identity (not part of either checksum; see TRACE_CTX_OFFSET).
+    trace_ctx: TraceContext | None = None
 
     def _packed_tail(self) -> bytes:
         return _FMT.pack(
@@ -128,7 +140,11 @@ class Header:
         return self
 
     def pack(self) -> bytes:
-        return _u128b(self.checksum) + self._packed_tail()
+        raw = _u128b(self.checksum) + self._packed_tail()
+        if self.trace_ctx is None:
+            return raw
+        return (raw[:TRACE_CTX_OFFSET] + self.trace_ctx.pack()
+                + raw[TRACE_CTX_OFFSET + CTX_WIRE_SIZE:])
 
     @classmethod
     def unpack(cls, data: bytes) -> "Header":
@@ -143,6 +159,8 @@ class Header:
             view=f[8], op=f[9], commit=f[10], timestamp=f[11],
             request=f[12], release=f[13], operation=f[14],
             command=Command(f[15]), replica=f[16],
+            trace_ctx=TraceContext.unpack(
+                data[TRACE_CTX_OFFSET:TRACE_CTX_OFFSET + CTX_WIRE_SIZE]),
         )
 
     def valid_checksum(self) -> bool:

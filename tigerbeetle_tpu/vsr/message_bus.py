@@ -134,8 +134,13 @@ class MessageBus:
             else:
                 self.dropped_replica += 1
             return
+        # `csum` ties this span to the receiver's bus_recv of the SAME
+        # frame: trace/merge.py matches the pairs to estimate per-pid
+        # clock offsets before causal assembly (low 32 bits are plenty
+        # to match within one trace window).
         with self.tracer.span(Event.bus_send,
-                              command=Command(msg.header.command).name):
+                              command=Command(msg.header.command).name,
+                              csum=msg.header.checksum & 0xFFFFFFFF):
             raw = msg.pack()
             conn.tx += raw
         conn.tx_sizes.append(len(raw))
@@ -282,7 +287,8 @@ class MessageBus:
                 continue
             with self.tracer.span(
                     Event.bus_recv,
-                    command=Command(msg.header.command).name):
+                    command=Command(msg.header.command).name,
+                    csum=msg.header.checksum & 0xFFFFFFFF):
                 self._identify(conn, msg.header)
                 self.on_message(msg)
 

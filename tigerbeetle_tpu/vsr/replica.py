@@ -597,10 +597,11 @@ class Replica:
         if not self.state_machine.input_valid(operation, msg.body):
             return  # malformed body: never prepare it (client bug)
         self._primary_prepare(operation, msg.body, client=h.client,
-                              request=h.request)
+                              request=h.request, ctx=h.trace_ctx)
 
     def _primary_prepare(self, operation: Operation, body: bytes, *,
-                         client: int = 0, request: int = 0) -> None:
+                         client: int = 0, request: int = 0,
+                         ctx=None) -> None:
         assert self.is_primary
         op = self.op + 1
         # Consensus drives time, not vice versa (reference clock.zig:1-45;
@@ -625,10 +626,16 @@ class Replica:
             commit=self.commit_max, timestamp=self.prepare_timestamp,
             operation=int(operation), client=client, request=request,
             parent=parent, release=self.release,
+            # The request's trace context rides the prepare to the
+            # backups (their replication spans parent to the client's
+            # root span) and — derived ONLY from prepare fields — into
+            # the reply, keeping replies byte-identical across replicas.
+            trace_ctx=ctx,
         )
         prepare = Message(header=header.finalize(body), body=body)
         self.op = op
-        self.pipeline[op] = {"message": prepare, "oks": set()}
+        self.pipeline[op] = {"message": prepare, "oks": set(),
+                             "ctx": ctx, "t0": self.tracer.now_ns()}
         # The local journal write and the network replication proceed
         # CONCURRENTLY (reference: src/io/linux.zig overlap); the primary
         # counts its own ack only once its WAL slot is durable.
@@ -656,6 +663,9 @@ class Replica:
 
     def on_prepare(self, msg: Message) -> None:
         h = msg.header
+        # Causal tracing: a backup's replication span runs from receipt
+        # to the durable-slot ack (recorded in _send_prepare_ok).
+        t0 = self.tracer.now_ns()
         # A prepare matching a canonical header (installed by the view-change
         # quorum) is authoritative regardless of its original view.
         want_hdr = self.canonical.get(h.op)
@@ -670,7 +680,7 @@ class Replica:
                 pass  # no vote; a pending primary finalizes below instead
             elif not self.is_primary:
                 self.journal.on_slot_durable(
-                    h.op, lambda h=h: self._send_prepare_ok(h))
+                    h.op, lambda h=h, t0=t0: self._send_prepare_ok(h, t0))
             else:
                 self._primary_adopt_canonical(msg)
             self._commit_journal(self.commit_max)
@@ -703,11 +713,12 @@ class Replica:
                 # is durable (an in-flight async append is not yet ours
                 # to vouch for).
                 self.journal.on_slot_durable(
-                    h.op, lambda h=h: self._send_prepare_ok(h))
+                    h.op, lambda h=h, t0=t0: self._send_prepare_ok(h, t0))
         elif h.op == self.op + 1 and h.parent == self._prepare_checksum(self.op):
             self.journal.append(
-                msg, on_durable=(None if self.is_standby or self.rebuilding
-                                 else lambda h=h: self._send_prepare_ok(h)))
+                msg, on_durable=(
+                    None if self.is_standby or self.rebuilding
+                    else lambda h=h, t0=t0: self._send_prepare_ok(h, t0)))
             self.op = h.op
         else:
             # Gap or chain break: repair.
@@ -739,14 +750,25 @@ class Replica:
         op = msg.header.op
         if op <= self.commit_min or op in self.pipeline:
             return
-        self.pipeline[op] = {"message": msg, "oks": set()}
+        # Replay path: the re-replicated prepare keeps its ORIGINAL
+        # trace context, so the new quorum wait re-links to the same
+        # request trace instead of orphaning it.
+        self.pipeline[op] = {"message": msg, "oks": set(),
+                             "ctx": msg.header.trace_ctx,
+                             "t0": self.tracer.now_ns()}
         self.journal.on_slot_durable(op, self._self_ack_fn(msg))
         for r in range(self.peer_count):
             if r != self.replica_id:
                 self.bus.send_to_replica(r, msg)
         self._check_quorum(op)
 
-    def _send_prepare_ok(self, prepare_header: Header) -> None:
+    def _send_prepare_ok(self, prepare_header: Header,
+                         t0: int = 0) -> None:
+        ctx = prepare_header.trace_ctx
+        if ctx is not None and t0:
+            self.tracer.record_span(
+                Event.replica_ack, t0, self.tracer.now_ns() - t0,
+                ctx=ctx, op=prepare_header.op)
         ok = Header(
             command=Command.prepare_ok, cluster=self.cluster,
             replica=self.replica_id, view=self.view, op=prepare_header.op,
@@ -780,6 +802,15 @@ class Replica:
                          len(entry["oks"]) >= self.quorum_replication)
             if not ready:
                 return
+            # The explicit quorum-wait span (ISSUE 15): prepare fan-out
+            # to quorum reached, parented to the request's root — read
+            # from the entry BEFORE it leaves the pipeline.
+            ctx = entry.get("ctx")
+            if ctx is not None:
+                t0 = entry.get("t0", 0)
+                self.tracer.record_span(
+                    Event.commit_quorum, t0, self.tracer.now_ns() - t0,
+                    ctx=ctx, op=self.commit_min + 1)
             self.commit_max = max(self.commit_max, self.commit_min + 1)
             self._commit_op(entry["message"])
             del self.pipeline[self.commit_min]
@@ -878,10 +909,20 @@ class Replica:
             window = (None if window_backoff
                       else self._collect_commit_window(msg, commit_target))
             if window is not None:
+                # Fan-in across batching: the window span joins the
+                # FIRST traced constituent's tree and links every
+                # member's trace id, so each request's trace crosses
+                # the batch boundary and back out to its reply.
+                wctxs = [m.header.trace_ctx for m in window]
                 with self.tracer.span(
                         Event.commit_execute, op=window[0].header.op,
+                        ctx=next((c for c in wctxs if c is not None),
+                                 None),
                         operation=int(window[0].header.operation),
-                        window=len(window)):
+                        window=len(window)) as wsp:
+                    for c in wctxs:
+                        if c is not None:
+                            wsp.link(c.trace_id)
                     out = self.state_machine.commit_window(
                         Operation(window[0].header.operation),
                         [m.body for m in window],
@@ -1032,8 +1073,9 @@ class Replica:
         h = prepare.header
         assert h.op == self.commit_min + 1
         operation = Operation(h.operation)
-        with self.tracer.span(Event.commit_execute, op=h.op,
-                              operation=int(operation), window=1):
+        with self.tracer.span(Event.commit_execute, ctx=h.trace_ctx,
+                              op=h.op, operation=int(operation),
+                              window=1):
             result = self.state_machine.commit(operation, prepare.body,
                                                h.timestamp)
         self._post_commit(prepare, result)
@@ -1090,6 +1132,10 @@ class Replica:
                 client=h.client, request=h.request, commit=h.op,
                 context=h.checksum, operation=h.operation,
                 timestamp=h.timestamp,
+                # Derived ONLY from the prepare (like every reply
+                # field): the context closes the causal loop at the
+                # client without breaking cross-replica byte identity.
+                trace_ctx=h.trace_ctx,
             )
             reply = Message(reply_header.finalize(result), body=result)
             evicted = self.sessions.put_reply(h.client, h.request, reply)
