@@ -608,6 +608,19 @@ def inner_main() -> None:
     if recovery:
         emit("recovery_diagnostics", recovery)
 
+    # Host-staging record (ISSUE 16): per-config double-buffered window
+    # staging accounting — total host staging work (work_ms), the part
+    # the dispatch path actually waited on (stall_ms), windows staged
+    # ahead vs packed inline, and the headline host_stall_fraction
+    # (stall/work; 1.0 = fully synchronous staging, ~0 = the pack is
+    # hidden behind in-flight device execution). The overlap gate leg
+    # asserts a ceiling on the same number from a live seeded run.
+    host_staging = {cfg: d.get("staging")
+                    for cfg, d in CONFIG_DIAGNOSTICS.items()
+                    if isinstance(d, dict) and d.get("staging") is not None}
+    if host_staging:
+        emit("host_staging", host_staging)
+
     # Op-budget summary (light tier subset, pure tracing — no device
     # execution): the per-run record of the kernels' heavy-op footprint
     # on its own ##opbudget line; devhub renders it next to the
@@ -689,6 +702,10 @@ def inner_main() -> None:
         # Chaos/recovery counters next to the fallback record (zeros in
         # a healthy run — and recorded, not assumed).
         "recovery_diagnostics": recovery,
+        # Double-buffered window-staging accounting per config: host
+        # staging work vs the stall the dispatch path paid, and the
+        # host_stall_fraction the overlap gate leg ceilings.
+        "host_staging": host_staging,
         # Heavy-op census of the kernels this run dispatched (see the
         # ##opbudget line / perf/opbudget.py).
         "opbudget": opbudget,
@@ -879,7 +896,7 @@ def main() -> None:
                    "config3_chains_tps", "config4_twophase_limits_tps",
                    "config5_oracle_parity", "config6_serving_tps",
                    "serving_batch_latency", "fallback_diagnostics",
-                   "dispatch_routes", "shard_balance")
+                   "dispatch_routes", "shard_balance", "host_staging")
     if banked is not None:
         # Self-consistent record: value, per-config numbers AND the
         # platform tag all come from the banked on-chip artifact (a

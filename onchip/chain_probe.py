@@ -10,9 +10,16 @@ window) on the chip:
   chain    ONE compiled program: raw lax.scan over W windows (the
            round-4/5 synthetic arm, kept for series continuity)
   route    DeviceLedger.submit_window/resolve_windows with depth-2
-           pipelining — the ACTUAL serving dispatch (scan-form chain
-           kernel per window, W prepares per dispatch), so the banked
-           verdict prices the route clients hit.
+           pipelining AND double-buffered window staging (stage_window
+           packs window k+1 on the background stager while window k's
+           blocking resolve waits on the chip) — the ACTUAL serving
+           dispatch (scan-form chain kernel per window, W prepares per
+           dispatch), so the banked verdict prices the route clients
+           hit, overlap included.
+  proute   the same pipelined+staged submit_window loop in attach mode:
+           the FUSED partitioned-chain route (one shard_map+scan per
+           window over account-range-sharded state) on whatever mesh
+           exists — best_route_tps is the max over route/proute arms.
 
 If the chain amortizes (per PERF.md's whole-program model), transfers/s
 at W prepares per dispatch should approach W x the per-dispatch rate;
@@ -192,21 +199,30 @@ def _run(res, dump, deadline):
             out.append((evs, tss))
         return out, bi
 
-    def run_route(led, windows):
+    def run_route(led, windows, route="chain"):
         pending = []
         t0 = time.perf_counter()
-        for evs, tss in windows:
+        for i, (evs, tss) in enumerate(windows):
             tk = led.submit_window(evs, tss)
             assert tk is not None, "route arm fell off the pipeline"
             pending.append(tk)
+            # Double-buffered staging: window k+1's pack + transfer
+            # runs on the background stager while the resolve below
+            # blocks on window k-1's device execution (ISSUE 16 — the
+            # submit above consumes the previous iteration's stage).
+            if i + 1 < len(windows):
+                led.stage_window(*windows[i + 1])
             if len(pending) > 1:
                 led.resolve_windows(count=1)
                 pending.pop(0)
         led.resolve_windows()
         dt = time.perf_counter() - t0
         stats = led.fallback_stats()
-        assert stats["routes"]["windows"].get("chain", 0) >= 1, stats
+        assert stats["routes"]["windows"].get(route, 0) >= 1, stats
         assert stats["host_fallbacks"] == 0, stats
+        if len(windows) > 1:
+            assert stats["staging"]["staged"] >= 1, stats["staging"]
+        led.shutdown_staging()
         return dt
 
     bi_r = 0
@@ -235,6 +251,58 @@ def _run(res, dump, deadline):
             res[key + "_error"] = repr(e)[:300]
         dump()
 
+    # ---- the FUSED partitioned-chain route through the same pipelined
+    # + staged submit_window loop, in attach mode on whatever mesh
+    # exists (1 chip degenerates gracefully; the chip pod is the real
+    # target): one shard_map+lax.scan dispatch per W-prepare window
+    # over account-range-sharded state. proute_wN_tps extends the
+    # serving-route record with the partitioned tier's own number.
+    from jax.sharding import Mesh
+
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+    from tigerbeetle_tpu.ops.ledger import DeviceLedger
+    from tigerbeetle_tpu.parallel.partitioned import PartitionedRouter
+    from tigerbeetle_tpu.types import Account
+
+    def mk_partitioned():
+        mesh = Mesh(np.array(jax.devices()), ("batch",))
+        router = PartitionedRouter(mesh, a_cap=1 << 15, t_cap=1 << 19)
+        orc = StateMachineOracle()
+        orc.create_accounts([Account(id=i, ledger=1, code=1)
+                             for i in range(1, AC + 1)], AC + 10)
+        led = DeviceLedger(a_cap=1 << 12, t_cap=1 << 14)
+        led.attach_partitioned(router, router.from_oracle(orc))
+        return led
+
+    res["proute_n_shards"] = len(jax.devices())
+    bi_p = 0
+    for W in (2, 8):
+        key = f"proute_w{W}"
+        if key + "_tps" in res:
+            continue
+        if time.monotonic() > deadline:
+            res.setdefault("deadline_hit", f"before {key}")
+            break
+        try:
+            led = mk_partitioned()
+            warm, bi_p = mk_prepares(2, W, bi_p)
+            t_c0 = time.perf_counter()
+            run_route(led, warm, route="partitioned_chain")
+            res[key + "_compile_s"] = round(
+                time.perf_counter() - t_c0, 1)
+            runs = []
+            for _ in range(2):
+                led = mk_partitioned()
+                ws, bi_p = mk_prepares(2, W, bi_p)
+                runs.append(run_route(led, ws,
+                                      route="partitioned_chain"))
+            best = min(runs)
+            res[key + "_ms"] = [round(r * 1e3, 1) for r in runs]
+            res[key + "_tps"] = round(2 * W * N / best, 1)
+        except Exception as e:  # noqa: BLE001 — record, go on
+            res[key + "_error"] = repr(e)[:300]
+        dump()
+
     if "deadline_hit" not in res and "alarm" not in res:
         # The watcher re-runs this probe in later windows until a
         # COMPLETE artifact lands (partial ones bank data but must
@@ -254,7 +322,7 @@ def main():
     # regressing it.
     resume_from(out_path, res,
                 keep=lambda k: k.startswith(("seq_w1_", "chain_w",
-                                             "route_w")))
+                                             "route_w", "proute_w")))
     dump = make_dumper(res, out_path)
 
     def verdict(target=None):
@@ -267,8 +335,8 @@ def main():
                       if k.startswith(("chain_w", "route_w"))
                       and k.endswith("_tps") and v is not None]
         route_arms = [v for k, v in target.items()
-                      if k.startswith("route_w") and k.endswith("_tps")
-                      and v is not None]
+                      if k.startswith(("route_w", "proute_w"))
+                      and k.endswith("_tps") and v is not None]
         seq = target.get("seq_w1_tps", 0)
         if not chain_arms:
             # A deadline-cut run with zero chain arms must not bank a
@@ -282,8 +350,10 @@ def main():
             if seq and chain_tps > 1.5 * seq else
             "whole-program chain does NOT beat sequential dispatch here")
         target["best_chain_tps"] = chain_tps
-        # Serving-route record: the default dispatch mode's own number
-        # (submit_window pipeline), the one clients actually see.
+        # Serving-route record: the best number the overlapped
+        # submit_window pipeline delivered across the single-chip chain
+        # (route_wN) and fused partitioned-chain (proute_wN) arms — the
+        # rate clients actually see.
         target["best_route_tps"] = max(route_arms) if route_arms else None
 
     def _on_deadline():

@@ -34,7 +34,13 @@ sharded-state window route — one shard_map+scan dispatch per window —
 differential vs the per-batch ladder and the oracle on an 8-device
 virtual mesh, then the 2-process jax.distributed local leg, skipped
 gracefully where multi-process init is unavailable; skip with
---no-partitioned-chain), the TELEMETRY leg
+--no-partitioned-chain), the OVERLAP leg
+(testing/overlap_smoke.py: double-buffered window staging proven live —
+a seeded pipelined serving run's host_stall_fraction strictly under the
+committed STALL_CEILING with every eligible window staged ahead, the
+forced-sync negative measuring exactly 1.0 and failing the predicate,
+and bit-exact history parity overlapped vs sync on the chain and fused
+partitioned-chain routes; skip with --no-overlap), the TELEMETRY leg
 (testing/telemetry_smoke.py: the device-telemetry plane of the fused
 route — harvested per-prepare block decoded bit-exact vs a host
 recomputation on 1/2/8-device meshes, telemetry-lane census vs the
@@ -260,6 +266,40 @@ def run_partitioned_chain(timeout: int = 900) -> int:
     return rc
 
 
+def run_overlap(timeout: int = 900) -> int:
+    """Overlap leg: host↔device double-buffered window staging proven
+    LIVE (testing/overlap_smoke.py, 8-device virtual mesh for the
+    partitioned arm) — a seeded pipelined serving run must measure a
+    host_stall_fraction strictly under the committed STALL_CEILING with
+    every eligible window staged ahead, the forced-sync negative
+    (DeviceLedger.overlap_staging=False) must measure exactly 1.0 and
+    FAIL the ceiling predicate, and the overlapped history must be
+    bit-exact vs the sync arm's (poisoned window included) on both the
+    chain and fused partitioned-chain routes. Skip with
+    --no-overlap."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import overlap_smoke as s; "
+           "s.overlap_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] overlap: double-buffered staging stall ceiling + "
+          "forced-sync negative (testing/overlap_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: overlap timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] overlap rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_telemetry(timeout: int = 900) -> int:
     """Telemetry leg: the round-10 device-telemetry plane on the fused
     partitioned-chain route (testing/telemetry_smoke.py, 8-device
@@ -474,6 +514,9 @@ def main() -> int:
                     help="skip the partitioned-chain leg (fused "
                          "sharded window route differential + "
                          "2-process multihost leg)")
+    ap.add_argument("--no-overlap", action="store_true",
+                    help="skip the overlap leg (double-buffered window "
+                         "staging stall ceiling + forced-sync negative)")
     ap.add_argument("--no-telemetry", action="store_true",
                     help="skip the telemetry leg (device block oracle "
                          "+ lane census + overhead ratio)")
@@ -519,6 +562,10 @@ def main() -> int:
         rc = run_partitioned_chain()
         if rc != 0:
             reds.append(f"partitioned-chain rc={rc}")
+    if not args.no_overlap:
+        rc = run_overlap()
+        if rc != 0:
+            reds.append(f"overlap rc={rc}")
     if not args.no_telemetry:
         rc = run_telemetry()
         if rc != 0:

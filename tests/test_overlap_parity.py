@@ -1,0 +1,411 @@
+"""Double-buffered window staging (ISSUE 16): the overlapped pipeline
+(stage_window ahead of submit_window) must be bit-identical to the
+synchronous staging path on every route — statuses, timestamps, flush
+columns, digests — including a window poisoned mid-pipeline and a
+chaos bit-flip recovery that must drain staged-but-undispatched windows
+WITHOUT committing them. Staging is an optimization, never a semantic:
+a staged pack is consumed only on exact identity match (same event
+arrays, timestamps, route, pad bucket), else dropped and re-packed
+inline."""
+
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.ops.batch import transfers_to_arrays
+from tigerbeetle_tpu.ops.ledger import DeviceLedger
+from tigerbeetle_tpu.types import Account, Transfer, TransferFlags
+
+PEND = int(TransferFlags.pending)
+POST = int(TransferFlags.post_pending_transfer)
+U128MAX = (1 << 128) - 1
+
+# The jit-heavy differential tests ride the slow tier like their
+# sibling suite (test_window_pipeline.py); the small staging-identity
+# test stays in the quick tier.
+slow = pytest.mark.slow
+
+
+def _mk_led(t_cap=1 << 13):
+    led = DeviceLedger(a_cap=1 << 10, t_cap=t_cap)
+    led.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 65)], 120)
+    return led
+
+
+def _windows(rng, n_windows, k=3, n=64, base=10**6, with_pend=False,
+             poison_window=None):
+    """n_windows windows of k batches each; optionally a duplicate-id
+    batch (hard fallback) inside window `poison_window`."""
+    out = []
+    nid = base
+    ts = 10**12
+    pend_pool = []
+    for w in range(n_windows):
+        evs, tss = [], []
+        for b in range(k):
+            batch = []
+            for i in range(n):
+                dr = int(rng.integers(1, 65))
+                if with_pend and pend_pool and i % 5 == 0:
+                    batch.append(Transfer(
+                        id=nid, pending_id=pend_pool.pop(0),
+                        amount=U128MAX, ledger=1, code=1, flags=POST))
+                else:
+                    f = PEND if (with_pend and i % 4 == 0) else 0
+                    batch.append(Transfer(
+                        id=nid, debit_account_id=dr,
+                        credit_account_id=dr % 64 + 1,
+                        amount=int(rng.integers(1, 100)), ledger=1,
+                        code=1, flags=f, timeout=10 if f else 0))
+                    if f:
+                        pend_pool.append(nid)
+                nid += 1
+            if poison_window == w and b == k // 2:
+                # duplicate id within the batch: hard fallback (E2)
+                batch[-1] = Transfer(
+                    id=batch[0].id, debit_account_id=1,
+                    credit_account_id=2, amount=1, ledger=1, code=1)
+            ts += n + 10
+            evs.append(batch)
+            tss.append(ts)
+        out.append((evs, tss))
+    return out
+
+
+def _state_eq(a, b):
+    assert a.accounts == b.accounts
+    assert a.transfers == b.transfers
+    assert a.pending_status == b.pending_status
+    assert a.expiry == b.expiry
+    assert set(a.orphaned) == set(b.orphaned)
+    assert a.pulse_next_timestamp == b.pulse_next_timestamp
+    assert a.commit_timestamp == b.commit_timestamp
+
+
+def _run_staged(led, windows, depth=2):
+    """The overlapped serving pattern at ledger level: submit k, stage
+    k+1 (its pack overlaps the blocking resolve), resolve oldest. The
+    SAME prepare-dict objects must be staged and submitted — staging
+    is consumed on identity, exactly like the serving drivers."""
+    arrs = [[transfers_to_arrays(b) for b in evs]
+            for evs, _tss in windows]
+    results = []
+    pending = []
+    for i, (_evs, tss) in enumerate(windows):
+        arrays = arrs[i]
+        tk = led.submit_window(arrays, tss)
+        if tk is None:
+            led.resolve_windows()
+            while pending:
+                results.append(pending.pop(0).results)
+            results.append(
+                ("sync", led.create_transfers_window(arrays, tss)))
+            continue
+        pending.append(tk)
+        if i + 1 < len(windows):
+            led.stage_window(arrs[i + 1], windows[i + 1][1])
+        if len(pending) >= depth:
+            led.resolve_windows(count=1)
+            while pending and pending[0].results is not None:
+                results.append(pending.pop(0).results)
+    led.resolve_windows()
+    for tk in pending:
+        results.append(tk.results)
+    led.shutdown_staging()
+    return results
+
+
+def test_stage_identity_hit_and_miss():
+    """Quick tier: a staged pack is consumed only on exact identity
+    match (prepare-dict identity, not equality); a mismatched stage is
+    a counted miss whose inline re-pack is bit-identical; forced-sync
+    staging measures a stall fraction of exactly 1.0 (the overlap gate
+    leg's negative)."""
+    led = _mk_led()
+    led_sync = _mk_led()
+    led_sync.overlap_staging = False
+    rng = np.random.default_rng(23)
+    (w0, t0), (w1, t1) = _windows(rng, 2, k=2, n=8)
+
+    a0 = [transfers_to_arrays(b) for b in w0]
+    a0_twin = [transfers_to_arrays(b) for b in w0]  # equal, new dicts
+    a1 = [transfers_to_arrays(b) for b in w1]
+    # Stage equal-but-distinct prepare dicts: identity mismatch ->
+    # counted miss, the stage is dropped, the inline pack serves.
+    assert led.stage_window(a0_twin, t0)
+    tk0 = led.submit_window(a0, t0)
+    assert tk0 is not None
+    assert led.staging_stats["misses"] == 1
+    assert led.staging_stats["staged"] == 0
+    # Stage + submit the SAME objects: identity hit.
+    assert led.stage_window(a1, t1)
+    tk1 = led.submit_window(a1, t1)
+    assert tk1 is not None
+    led.resolve_windows()
+    assert led.staging_stats["staged"] == 1
+    assert led.staging_summary()["windows"] == 2
+
+    # Forced-sync arm: stage_window refuses, stall fraction is 1.0.
+    assert not led_sync.stage_window(a0, t0)
+    for w, t in ((w0, t0), (w1, t1)):
+        arrays = [transfers_to_arrays(b) for b in w]
+        assert led_sync.submit_window(arrays, t) is not None
+    led_sync.resolve_windows()
+    sm = led_sync.staging_summary()
+    assert sm["overlap"] is False and sm["staged"] == 0
+    assert sm["host_stall_fraction"] == 1.0
+
+    # Bit-exact regardless of staging path.
+    for tk in (tk0, tk1):
+        assert tk.results is not None
+    _state_eq(led.to_host(), led_sync.to_host())
+    led.shutdown_staging()
+    led_sync.shutdown_staging()
+
+
+@slow
+@pytest.mark.parametrize("with_pend,poison", [
+    (False, None), (True, 2)])
+def test_overlap_matches_sync(with_pend, poison):
+    """Overlapped pipeline vs synchronous windows: statuses, ts, final
+    state — incl. a hard-fallback window mid-pipeline whose redo must
+    not consume a stale staged pack."""
+    rng = np.random.default_rng(3)
+    windows = _windows(rng, 4, with_pend=with_pend,
+                       poison_window=poison)
+    led_p = _mk_led()
+    led_s = _mk_led()
+    led_s.overlap_staging = False
+
+    results_p = _run_staged(led_p, windows)
+    results_s = []
+    for evs, tss in windows:
+        results_s.append(led_s.create_transfers_window(
+            [transfers_to_arrays(b) for b in evs], tss))
+
+    assert len(results_p) == len(results_s)
+    for kind_res, outs_s in zip(results_p, results_s):
+        _, outs_p = kind_res
+        for (st_p, ts_p), (st_s, ts_s) in zip(outs_p, outs_s):
+            np.testing.assert_array_equal(np.asarray(st_p),
+                                          np.asarray(st_s))
+            np.testing.assert_array_equal(np.asarray(ts_p),
+                                          np.asarray(ts_s))
+    _state_eq(led_p.to_host(), led_s.to_host())
+    st = led_p.staging_stats
+    assert st["staged"] >= 1, st
+    # Clean runs consume every stage; a poisoned run may drop stages
+    # (route-hysteresis flip after the redo) but must count them.
+    assert st["staged"] + st["misses"] == st["windows"] - 1 \
+        or poison is not None, st
+
+
+@slow
+def test_overlap_flush_columns_serving_mode():
+    """Serving mode (write-through + ring recycle): the overlapped
+    pipeline's drained flush columns and mirror are bit-identical to
+    the sync path's."""
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+
+    rng = np.random.default_rng(5)
+    windows = _windows(rng, 4, with_pend=True, base=2 * 10**6)
+
+    def mk_serving(overlap):
+        led = DeviceLedger(a_cap=1 << 10, t_cap=1 << 13,
+                           write_through=StateMachineOracle())
+        led.create_accounts(
+            [Account(id=i, ledger=1, code=1) for i in range(1, 65)],
+            120)
+        led.recycle_events = True
+        led.retain_flush_columns = True
+        led.overlap_staging = overlap
+        return led
+
+    led_p = mk_serving(True)
+    led_s = mk_serving(False)
+    _run_staged(led_p, windows)
+    for evs, tss in windows:
+        led_s.create_transfers_window(
+            [transfers_to_arrays(b) for b in evs], tss)
+    led_p.drain_mirror()
+    led_s.drain_mirror()
+    cols_p = led_p.take_flush_columns()
+    cols_s = led_s.take_flush_columns()
+    assert len(cols_p) == len(cols_s)
+    for cp, cs in zip(cols_p, cols_s):
+        assert cp[3] == cs[3]  # n_new per chunk
+        if cp[3]:
+            for key in ("id_hi", "id_lo", "ts", "flags"):
+                np.testing.assert_array_equal(
+                    np.asarray(cp[0][key]), np.asarray(cs[0][key]))
+    _state_eq(led_p.mirror, led_s.mirror)
+    assert led_p.staging_stats["staged"] >= 1
+
+
+@slow
+def test_overlap_partitioned_chain():
+    """The fused partitioned-chain route (attach mode): overlapped
+    staging vs sync staging vs the oracle — results and sharded state
+    digests bit-identical, including a window poisoned by a limit
+    cascade (per-prepare fallback mid-pipeline under staging)."""
+    import jax
+    from jax.sharding import Mesh
+
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+    from tigerbeetle_tpu.ops.state_epoch import (
+        partitioned_oracle_digest, partitioned_state_digest)
+    from tigerbeetle_tpu.parallel.partitioned import PartitionedRouter
+    from tigerbeetle_tpu.types import AccountFlags
+
+    A_CAP, T_CAP = 1 << 9, 1 << 11
+    n_dev = len(jax.devices())
+    dr_limit = int(AccountFlags.debits_must_not_exceed_credits)
+    accts = [Account(id=i, ledger=1, code=1,
+                     flags=(dr_limit if i <= 4 else 0))
+             for i in range(1, 41)]
+    rng = np.random.default_rng(13)
+    nid, ts = 10**6, 10**9
+    windows = []
+    for w in range(4):
+        batches, tss = [], []
+        for b in range(3):
+            n = 8
+            dr = rng.integers(5, 41, n)
+            cr = rng.integers(5, 41, n)
+            clash = dr == cr
+            cr[clash] = dr[clash] % 36 + 5
+            batch = [Transfer(id=nid + i, debit_account_id=int(dr[i]),
+                              credit_account_id=int(cr[i]),
+                              amount=int(rng.integers(1, 30)),
+                              ledger=1, code=1) for i in range(n)]
+            nid += n
+            if w == 1 and b == 1:
+                # DR-limit cascade: poisons the fused chain at this
+                # prepare; the clean prefix stays committed on device.
+                batch.append(Transfer(id=nid, debit_account_id=1,
+                                      credit_account_id=9,
+                                      amount=10**9, ledger=1, code=1))
+                nid += 1
+            ts += 300
+            batches.append(batch)
+            tss.append(ts)
+        windows.append((batches, tss))
+
+    steps, chain_steps = {}, {}
+    digests, results, oracles = [], [], []
+    for overlap in (True, False):
+        mesh = Mesh(np.array(jax.devices()[:n_dev]), ("batch",))
+        orc = StateMachineOracle()
+        orc.create_accounts(accts, 50)
+        router = PartitionedRouter(mesh, a_cap=A_CAP, t_cap=T_CAP)
+        router._steps = steps
+        router._chain_steps = chain_steps
+        led = DeviceLedger(a_cap=A_CAP, t_cap=T_CAP)
+        led.attach_partitioned(router, router.from_oracle(orc))
+        led.overlap_staging = overlap
+        # Same prepare-dict objects staged and submitted (identity).
+        arrs = [[transfers_to_arrays(b) for b in batches]
+                for batches, _tss in windows]
+        tickets = []
+        for i, (_batches, tss) in enumerate(windows):
+            tk = led.submit_window(arrs[i], tss)
+            assert tk is not None
+            tickets.append(tk)
+            if i + 1 < len(windows):
+                led.stage_window(arrs[i + 1], windows[i + 1][1])
+            if len(led._tickets) >= 2:
+                led.resolve_windows(count=1)
+        led.resolve_windows()
+        norm = []
+        for tk in tickets:
+            _kind, pairs = tk.results
+            norm.append([[(int(t), int(s))
+                          for s, t in zip(st.tolist(), ts_.tolist())]
+                         for st, ts_ in pairs])
+        results.append(norm)
+        if overlap:
+            assert led.staging_stats["staged"] >= 1, led.staging_stats
+        else:
+            assert led.staging_stats["staged"] == 0, led.staging_stats
+        digests.append(partitioned_state_digest(led.partitioned_state))
+        oracles.append(orc)
+        led.shutdown_staging()
+
+    assert results[0] == results[1]
+    assert digests[0] == digests[1]
+    # Oracle parity: statuses/ts and final sharded digest.
+    orc = oracles[0]
+    want = []
+    for batches, tss in windows:
+        want.append([[(r.timestamp, int(r.status))
+                      for r in orc.create_transfers(b, t)]
+                     for b, t in zip(batches, tss)])
+    assert results[0] == want
+    assert digests[0] == partitioned_oracle_digest(orc, A_CAP, n_dev)
+
+
+@slow
+def test_bitflip_recovery_drains_staged_without_commit():
+    """Chaos bit-flip mid-pipeline: the epoch verify catches the
+    corruption, recovery replays the LOGGED windows from the oracle
+    (in-flight windows adopt the replay's answers), and a window that
+    was STAGED but never dispatched dies with the quarantined ledger —
+    its transfers never commit, and serving continues cleanly on the
+    rebuilt ledger."""
+    from tigerbeetle_tpu.serving import ServingSupervisor
+    from tigerbeetle_tpu.testing.chaos import inject_state_bitflip
+
+    rng = np.random.default_rng(41)
+    windows = _windows(rng, 4, k=2, n=32, base=4 * 10**6)
+    accts = [Account(id=i, ledger=1, code=1) for i in range(1, 65)]
+
+    def run(faulted):
+        sup = ServingSupervisor(a_cap=1 << 10, t_cap=1 << 13,
+                                epoch_interval=100)
+        sup.create_accounts(accts, 120)
+        for batches, tss in windows[:3]:
+            sup.submit_transfers_window(batches, tss)
+        staged_batches, staged_tss = windows[3]
+        if faulted:
+            # Corrupt a digest-covered live cell, then stage (but never
+            # submit) window 3 on the doomed ledger.
+            f = {"target": "transfers_u64", "row_pick": 0,
+                 "col_pick": 0, "bit": 7}
+            assert inject_state_bitflip(sup.led, f), f
+            assert sup.led.stage_window(
+                [transfers_to_arrays(b) for b in staged_batches],
+                staged_tss)
+            old_led = sup.led
+            # Divergence found -> recovers inside, returns False.
+            assert not sup.verify_epoch()
+            assert sup.last_recovery is not None
+            assert sup.last_recovery["cause"] == "state_digest", \
+                sup.last_recovery
+            assert sup.counters["recoveries"], sup.counters
+            assert sup.counters["checksum_mismatches"] >= 1
+            assert sup.led is not old_led, "ledger not quarantined"
+            # The staged-but-undispatched pack died with the old
+            # ledger's stager: nothing from window 3 committed anywhere.
+            assert old_led._staged is None and old_led._stager is None
+            assert sup.led._staged is None
+            for b in staged_batches:
+                for ev in b:
+                    assert ev.id not in sup.led.mirror.transfers
+                    assert ev.id not in sup.epoch_base.transfers
+        else:
+            assert sup.verify_epoch()
+            assert not sup.counters["recoveries"], sup.counters
+        # Serving continues: window 3 submits cleanly afterwards.
+        sup.submit_transfers_window(staged_batches, staged_tss)
+        sup.drain_pipeline()
+        assert sup.verify_epoch()
+        hist = list(sup.history)
+        sup.led.shutdown_staging()
+        return hist
+
+    hist_f = run(faulted=True)
+    hist_c = run(faulted=False)
+    # Authoritative history bit-exact vs the unfaulted run: recovery
+    # replay changed nothing observable, and window 3's results come
+    # from its REAL post-recovery dispatch, not the dead stage.
+    assert hist_f == hist_c

@@ -523,12 +523,15 @@ def bench_config6_serving(batches=24, account_count=10_000):
             for done in done_recs:
                 hist.record((now - done["_tb"]) * 1000)
 
+        wins = []
         for lo in range(1, len(bodies), W):
             window = bodies[lo:lo + W]
             wts = []
             for _ in window:
                 ts += nb + 10
                 wts.append(ts)
+            wins.append((window, wts))
+        for i, (window, wts) in enumerate(wins):
             tb = time.perf_counter()
             rec = sm.submit_commit_window(
                 Operation.create_transfers, window, wts)
@@ -538,6 +541,14 @@ def bench_config6_serving(batches=24, account_count=10_000):
                 hist.record((time.perf_counter() - tb) * 1000)
                 continue
             rec["_tb"] = tb
+            # Stage window k+1's operand pack NOW, so it runs on the
+            # staging worker while this iteration's blocking resolve
+            # waits on window k's device execution (double-buffered
+            # host↔device overlap; the submit below consumes the pack).
+            if i + 1 < len(wins):
+                sm.stage_commit_window(
+                    Operation.create_transfers, wins[i + 1][0],
+                    wins[i + 1][1])
             if len(sm._pending_windows) > 1:
                 note_done(sm.resolve_commit_windows(count=1))
         note_done(sm.resolve_commit_windows())

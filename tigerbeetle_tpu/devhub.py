@@ -314,6 +314,62 @@ def render(history_path: str, out_path: str,
             "<table><tr><th>config</th><th>windows by route</th>"
             "<th>chain per-prepare fallbacks</th></tr>"
             + "".join(rows_rt) + "</table>")
+    # Host-staging panel (ISSUE 16), next to the dispatch-routes table:
+    # double-buffered window staging per config — how much host pack/
+    # transfer work ran (work_ms), how much of it the dispatch path
+    # actually waited on (stall_ms), windows staged ahead vs packed
+    # inline, and the headline host_stall_fraction (1.0 = staging fully
+    # synchronous; the overlap gate leg ceilings the same number on a
+    # live run). A fraction near 1.0 WITH overlap enabled means the
+    # double buffer stopped hiding the pack — a pipelining regression.
+    stage_html = ""
+    staging = next((e.get("host_staging") for e in reversed(entries)
+                    if isinstance(e.get("host_staging"), dict)
+                    and e.get("host_staging")), None)
+    if staging is None:
+        fbd = next((e.get("fallback_diagnostics")
+                    for e in reversed(entries)
+                    if isinstance(e.get("fallback_diagnostics"), dict)),
+                   None) or {}
+        staging = {cfg: d.get("staging") for cfg, d in fbd.items()
+                   if isinstance(d, dict)
+                   and isinstance(d.get("staging"), dict)
+                   and d["staging"].get("windows")}
+    if staging:
+        rows_st = []
+        any_sync = False
+        for cfg in sorted(staging):
+            d = staging[cfg] or {}
+            frac = d.get("host_stall_fraction")
+            overlap_on = bool(d.get("overlap", True))
+            sync_flag = (overlap_on and frac is not None
+                         and frac >= 0.9 and d.get("staged"))
+            any_sync = any_sync or bool(sync_flag)
+            frac_txt = "-" if frac is None else f"{frac:.4f}"
+            if sync_flag:
+                frac_txt = ('<span style="color:#c22;font-weight:600">'
+                            f"{frac:.4f}</span>")
+            rows_st.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td><td>{}</td><td>{}</td></tr>"
+                .format(html.escape(cfg),
+                        "on" if overlap_on else "off",
+                        d.get("windows", 0) or 0,
+                        d.get("staged", 0) or 0,
+                        d.get("misses", 0) or 0,
+                        d.get("work_ms", 0) or 0,
+                        d.get("stall_ms", 0) or 0, frac_txt))
+        badge_st = ("" if not any_sync else
+                    '<p style="color:#c22;font-weight:700">HOST STALL '
+                    'NEAR 1.0 WITH OVERLAP ON — window staging is no '
+                    'longer hidden behind device execution</p>')
+        stage_html = (
+            "<h2>host staging / overlap (latest run)</h2>" + badge_st
+            + "<table><tr><th>config</th><th>overlap</th>"
+              "<th>windows</th><th>staged ahead</th><th>misses</th>"
+              "<th>staging work ms</th><th>stall ms</th>"
+              "<th>host stall fraction</th></tr>"
+            + "".join(rows_st) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
     # ceilings (the NEWEST perf/opbudget_r*.json — resolved, not
@@ -732,6 +788,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {fb_html}
 {rec_html}
 {route_html}
+{stage_html}
 {ob_html}
 {st_html}
 {sh_html}

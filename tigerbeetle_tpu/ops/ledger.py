@@ -21,9 +21,13 @@ batches, expiries) into the same ring.
 
 from __future__ import annotations
 
+import time as _time
+from concurrent.futures import ThreadPoolExecutor
+
 import numpy as np
 
 from ..constants import BATCH_MAX, NS_PER_S, TIMESTAMP_MIN
+from ..trace import Event, NullTracer
 from ..types import (
     Account,
     AccountFlags,
@@ -834,7 +838,7 @@ class WindowTicket:
 
     __slots__ = ("evs", "tss", "ns", "n_pad", "out", "gather_dev",
                  "size", "deep", "all_or_nothing", "e_only", "results",
-                 "route", "poison")
+                 "route", "poison", "harvested")
 
     def __init__(self, evs, tss, ns, n_pad, out, gather_dev, size, deep,
                  all_or_nothing, e_only=False, route="super",
@@ -860,6 +864,29 @@ class WindowTicket:
         self.route = route
         self.poison = poison
         self.results = None  # set at resolve
+        self.harvested = False
+
+    def start_harvest(self) -> None:
+        """Start non-blocking d2h copies of the kernel's ticket outputs
+        (statuses, timestamps, fallback lanes, cause flags) so
+        resolve_windows()' device_get finds the bytes already on host
+        instead of paying a synchronous round-trip per window.
+        Idempotent; fired when the NEXT window is submitted (this
+        ticket's kernel is ordered before it on device, so the copy
+        drains behind the in-flight dispatch) and again defensively at
+        resolve. The delta-gather buffers are deliberately NOT
+        harvested here: their d2h tonnage would contend with the next
+        kernel's operand transfers for the tunnel (see _DeltaFetchHandle
+        eager_copy=False) — they stay lazy until the mirror drain."""
+        if self.harvested:
+            return
+        self.harvested = True
+        import jax
+
+        for leaf in jax.tree.leaves(self.out):
+            start = getattr(leaf, "copy_to_host_async", None)
+            if start is not None:
+                start()
 
 
 def _evs_pend_refs(evs: list[dict]) -> bool:
@@ -986,6 +1013,32 @@ class DeviceLedger:
         # Pipelined commit windows in flight (submit_window), resolved in
         # order by resolve_windows().
         self._tickets: list = []
+        # Host<->device overlap (double-buffered window staging): a
+        # single-slot stage holds the NEXT window's operands, packed and
+        # pytree-device_put by a one-worker background stager while the
+        # current window's dispatch is in flight. submit_window consumes
+        # a matching staged entry instead of packing inline; a stage
+        # miss (route flipped between stage and submit, a different
+        # window, or no stage call) packs inline — staging is purely an
+        # optimization, the packed bytes are identical either way.
+        # overlap_staging=False forces the synchronous regime (the
+        # overlap gate leg's negative injection).
+        self.overlap_staging = True
+        self._staged = None
+        self._stager = None
+        # Cumulative staging accounting (fallback_stats()["staging"]):
+        # stall_ms is host-staging time the DISPATCH PATH actually
+        # waited on (inline packs + residual waits on a not-yet-done
+        # staged pack); work_ms is the total pack+transfer work
+        # wherever it ran. host_stall_fraction = stall_ms / work_ms:
+        # 1.0 under forced-sync staging, ~0 with the pack fully hidden
+        # behind device execution.
+        self.staging_stats = {"windows": 0, "staged": 0, "misses": 0,
+                              "stall_ms": 0.0, "work_ms": 0.0}
+        # Observability hook: the ServingSupervisor installs its tracer
+        # here (window_stage spans + the host-stall gauge); standalone
+        # ledgers keep the null tracer.
+        self.tracer = NullTracer()
         # Partitioned-mesh attach (attach_partitioned): when set, commit
         # windows dispatch through the PartitionedRouter's fused
         # shard_map+scan route against the sharded state instead of the
@@ -1086,6 +1139,174 @@ class DeviceLedger:
                          count=len(out))
         return st, ts
 
+    def _window_plan(self, evs, timestamps):
+        """Route-select one candidate pipelined window WITHOUT touching
+        device state: the shared eligibility/route logic behind
+        stage_window and submit_window, so a staged pack is provably
+        the same bytes submit_window would have packed inline. Returns
+        (route, n_pad) or None (ineligible — the caller's synchronous
+        path takes the window)."""
+        ns = [len(e["id_lo"]) for e in evs]
+        if self._part_router is not None:
+            r = self._part_router
+            if (len(evs) < 2 or _has_imported(evs)
+                    or any(r.route(e) != "plain" for e in evs)):
+                return None
+            return "partitioned_chain", _pad_bucket(max(ns))
+        if not (len(evs) > 1 and not self._mirror_route()):
+            return None
+        if _has_imported(evs):
+            # Imported windows stay on the synchronous path (the
+            # pipelined kernels are not imported-aware; the sync window
+            # routes to the imported super tier).
+            return None
+        if self._wt:
+            # Capacity pre-check BEFORE any device mutation: the
+            # window's created rows must fit one delta-gather bucket
+            # (the sync path splits into groups instead; a pipelined
+            # caller just takes that path).
+            t_len = int(self.state["transfers"]["u64"].shape[0])
+            e_len = ev_cap(self.state["events"]) + 1
+            if sum(ns) > min(32 * N_PAD, t_len, e_len):
+                return None
+        balancing = _has_balancing(evs)
+        deep = (not balancing
+                and (self._fixpoint_first or _has_closing(evs)
+                     or _evs_pend_refs(evs)))
+        route = ("super_balancing" if balancing
+                 else "super_deep" if deep else "chain")
+        return route, _pad_bucket(max(ns))
+
+    def stage_window(self, evs: list[dict],
+                     timestamps: list[int]) -> bool:
+        """Double-buffered host staging: pack window k+1's stacked
+        operands (stack_chain_window / stack_superbatch /
+        stack_partitioned_window by route) and start their single
+        pytree device transfer on the background stager thread, while
+        window k's dispatch is in flight and window k-1 resolves. The
+        next submit_window of the SAME window (same prepare dicts, same
+        timestamps) consumes the staged operands instead of packing
+        inline; anything else — the route flipped under it (breach
+        hysteresis), a different window, forced-sync mode — discards
+        the stage and packs inline, bit-identically. Never reads or
+        writes ledger/device state past route selection, and the
+        dispatch itself still happens on submit_window's thread in
+        submit order — poison chaining, per-prepare fallback, and the
+        clean-prefix commit contract are untouched. Returns True when
+        a stage was enqueued."""
+        if not self.overlap_staging:
+            return False
+        plan = self._window_plan(evs, timestamps)
+        if plan is None:
+            self._staged = None
+            return False
+        route, n_pad = plan
+        if self._stager is None:
+            self._stager = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="tb-window-stager")
+        fut = self._stager.submit(self._pack_window, route, list(evs),
+                                  list(timestamps), n_pad)
+        # Strong refs to the prepare dicts keep their identity stable:
+        # the stage can only ever be consumed by exactly this window.
+        self._staged = (list(evs), [int(t) for t in timestamps],
+                        route, n_pad, fut)
+        return True
+
+    def _pack_window(self, route, evs, timestamps, n_pad):
+        """Stager-thread body: pure host pack + ONE pytree device
+        transfer. No ledger state is read or written here (thread
+        safety by construction); jax.device_put is thread-safe and the
+        transfer overlaps the in-flight dispatch. Returns
+        (device payload, pack wall ns)."""
+        import jax
+
+        t0 = _time.perf_counter_ns()
+        if route == "partitioned_chain":
+            payload = self._part_router.stage_operands(
+                evs, timestamps, n_pad)
+        elif route == "chain":
+            payload = jax.device_put(
+                stack_chain_window(evs, timestamps, n_pad))
+        else:
+            payload = jax.device_put(
+                stack_superbatch(evs, timestamps, n_pad))
+        return payload, _time.perf_counter_ns() - t0
+
+    def _consume_staged(self, evs, timestamps, route, n_pad):
+        """Take the staged operands when they are EXACTLY this window
+        on this route (prepare-dict identity + timestamps + pad
+        bucket); returns the device payload or None (the caller packs
+        inline). A hit charges only the residual wait on the stager to
+        stall_ms — the pack work itself ran overlapped — and emits the
+        `overlapped` window_stage span with that wait as its cost."""
+        staged, self._staged = self._staged, None
+        if staged is None:
+            return None
+        s_evs, s_tss, s_route, s_n_pad, fut = staged
+        if not (s_route == route and s_n_pad == n_pad
+                and len(s_evs) == len(evs)
+                and all(a is b for a, b in zip(s_evs, evs))
+                and s_tss == [int(t) for t in timestamps]):
+            self.staging_stats["misses"] += 1
+            fut.cancel()
+            return None
+        t0 = _time.perf_counter_ns()
+        payload, pack_ns = fut.result()
+        wait_ns = _time.perf_counter_ns() - t0
+        st = self.staging_stats
+        st["staged"] += 1
+        st["stall_ms"] += wait_ns / 1e6
+        st["work_ms"] += max(pack_ns, wait_ns) / 1e6
+        self.tracer.record_span(Event.window_stage, t0, wait_ns,
+                                mode="overlapped", route=route)
+        return payload
+
+    def _staging_note_inline(self, route, t0_ns) -> None:
+        """Account one inline (synchronous) pack+transfer: the whole
+        cost is a host stall the device pipeline waited on."""
+        dur_ns = _time.perf_counter_ns() - t0_ns
+        st = self.staging_stats
+        st["stall_ms"] += dur_ns / 1e6
+        st["work_ms"] += dur_ns / 1e6
+        self.tracer.record_span(Event.window_stage, t0_ns, dur_ns,
+                                mode="inline", route=route)
+
+    def _staging_gauge(self) -> None:
+        st = self.staging_stats
+        st["windows"] += 1
+        if st["work_ms"]:
+            self.tracer.gauge(Event.host_stall_fraction,
+                              round(st["stall_ms"] / st["work_ms"], 6))
+
+    def staging_summary(self) -> dict:
+        """The fallback_stats()["staging"] record: windows through the
+        pipelined submit path, how many consumed a staged pack, and the
+        measured host-stall split the overlap gate leg and bench ##diag
+        read."""
+        st = self.staging_stats
+        frac = (st["stall_ms"] / st["work_ms"]) if st["work_ms"] else None
+        return {
+            "overlap": bool(self.overlap_staging),
+            "windows": st["windows"],
+            "staged": st["staged"],
+            "misses": st["misses"],
+            "stall_ms": round(st["stall_ms"], 3),
+            "work_ms": round(st["work_ms"], 3),
+            "host_stall_fraction": (round(frac, 4)
+                                    if frac is not None else None),
+        }
+
+    def shutdown_staging(self) -> None:
+        """Drop any staged-but-undispatched window and stop the stager
+        thread. The supervisor's quarantine path calls this before
+        discarding the ledger, so a staged window that never dispatched
+        is provably never committed (its device payload dies with the
+        stage) and no worker outlives the quarantine."""
+        self._staged = None
+        if self._stager is not None:
+            self._stager.shutdown(wait=True, cancel_futures=True)
+            self._stager = None
+
     def submit_window(self, evs: list[dict], timestamps: list[int]):
         """Pipelined commit window: dispatch the window kernel AND its
         delta gather with ZERO host synchronization, chaining the
@@ -1122,56 +1343,48 @@ class DeviceLedger:
 
         if self._part_router is not None:
             return self._submit_window_partitioned(evs, timestamps)
+        plan = self._window_plan(evs, timestamps)
+        if plan is None:
+            self._staged = None
+            return None
+        route, n_pad = plan
         ns = [len(e["id_lo"]) for e in evs]
-        if not (len(evs) > 1 and not self._mirror_route()):
-            return None
-        if _has_imported(evs):
-            # Imported windows stay on the synchronous path (the
-            # pipelined kernels are not imported-aware; the sync window
-            # routes to the imported super tier).
-            return None
-        t_len = int(self.state["transfers"]["u64"].shape[0])
-        e_len = ev_cap(self.state["events"]) + 1
-        if self._wt:
-            # Capacity pre-check BEFORE any device mutation: the window's
-            # created rows must fit one delta-gather bucket (the sync
-            # path splits into groups instead; a pipelined caller just
-            # takes that path).
-            if sum(ns) > min(32 * N_PAD, t_len, e_len):
-                return None
-        n_pad = _pad_bucket(max(ns))
         prev_fb = self._tickets[-1].poison if self._tickets else None
+        if self._tickets:
+            # Async harvest of window k-1: its small ticket outputs
+            # start their non-blocking d2h copy now, draining behind
+            # the dispatch below; resolve_windows() finds them on host.
+            self._tickets[-1].start_harvest()
         # Serving mode: the ring-reset kernel variants consume the event
         # ring from offset 0 per window, so the pipeline never needs a
         # host recycle barrier.
         ring = self._wt and self.recycle_events
-        balancing = _has_balancing(evs)
-        deep = (not balancing
-                and (self._fixpoint_first or _has_closing(evs)
-                     or _evs_pend_refs(evs)))
-        if balancing:
+        deep = route == "super_deep"
+        if route == "super_balancing":
             from .fast_kernels import (
                 create_transfers_super_balancing_jit,
                 create_transfers_super_balancing_ring_jit,
             )
 
-            route = "super_balancing"
             jitfn = (create_transfers_super_balancing_ring_jit if ring
                      else create_transfers_super_balancing_jit)
         elif deep:
-            route = "super_deep"
             jitfn = (create_transfers_super_deep_ring_jit if ring
                      else create_transfers_super_deep_jit)
         else:
-            route = "chain"
             jitfn = (create_transfers_chain_ring_jit if ring
                      else create_transfers_chain_jit)
-        if route == "chain":
-            ev_d, seg_d = stack_chain_window(evs, timestamps, n_pad)
-        else:
-            ev_d, seg_d = stack_superbatch(evs, timestamps, n_pad)
-        ev_d = {k: jax.device_put(v) for k, v in ev_d.items()}
-        seg_d = {k: jax.device_put(v) for k, v in seg_d.items()}
+        payload = self._consume_staged(evs, timestamps, route, n_pad)
+        if payload is None:
+            t0 = _time.perf_counter_ns()
+            if route == "chain":
+                packed = stack_chain_window(evs, timestamps, n_pad)
+            else:
+                packed = stack_superbatch(evs, timestamps, n_pad)
+            payload = jax.device_put(packed)
+            self._staging_note_inline(route, t0)
+        ev_d, seg_d = payload
+        self._staging_gauge()
         new_state, out = jitfn(self.state, ev_d, seg_d, prev_fb)
         self.state = new_state
         self._count_route(route)
@@ -1186,6 +1399,8 @@ class DeviceLedger:
         if self._wt:
             # Delta gather with DEVICE-computed slice starts: ordered
             # after the kernel on device, resolved at drain/flush.
+            t_len = int(self.state["transfers"]["u64"].shape[0])
+            e_len = ev_cap(self.state["events"]) + 1
             total_cap = sum(ns)
             for size in (N_PAD, 8 * N_PAD, 32 * N_PAD):
                 if total_cap <= size:
@@ -1252,15 +1467,25 @@ class DeviceLedger:
         _partitioned_window_sync, which runs the per-batch partitioned
         ladder."""
         r = self._part_router
-        if (len(evs) < 2 or _has_imported(evs)
-                or any(r.route(e) != "plain" for e in evs)):
+        plan = self._window_plan(evs, timestamps)
+        if plan is None:
+            self._staged = None
             return None
+        route, n_pad = plan
         ns = [len(e["id_lo"]) for e in evs]
-        n_pad = _pad_bucket(max(ns))
         prev_fb = self._tickets[-1].poison if self._tickets else None
+        if self._tickets:
+            self._tickets[-1].start_harvest()
+        staged = self._consume_staged(evs, timestamps, route, n_pad)
+        if staged is None:
+            t0 = _time.perf_counter_ns()
+            staged = r.stage_operands(evs, timestamps, n_pad)
+            self._staging_note_inline(route, t0)
+        self._staging_gauge()
         new_state, out = r.chain_dispatch(
             evs=evs, timestamps=timestamps, n_pad=n_pad,
-            state=self._part_state, force_fallback=prev_fb)
+            state=self._part_state, force_fallback=prev_fb,
+            staged=staged)
         self._part_state = new_state
         # The router counts the window (stats()["routes"], merged into
         # fallback_stats); the ledger records the latency class.
@@ -1316,6 +1541,11 @@ class DeviceLedger:
         else:
             tickets = self._tickets[:count]
             del self._tickets[:count]
+        # Defensive harvest: tickets younger than the last submit never
+        # had a successor to fire their async d2h copy — start it now
+        # so the device_gets below overlap across the batch.
+        for tk in tickets:
+            tk.start_harvest()
         # Attach mode replays through the partitioned ladder (the
         # single-chip pytree is not the ledger there).
         win = (self._partitioned_window_sync
@@ -1537,9 +1767,10 @@ class DeviceLedger:
             chain_route = (not all_or_nothing and not imported
                            and not balancing and not deep_first)
             if chain_route:
-                ev_c, seg_c = stack_chain_window(evs, timestamps, n_pad)
-                ev_c = {k: jax.device_put(v) for k, v in ev_c.items()}
-                seg_c = {k: jax.device_put(v) for k, v in seg_c.items()}
+                # One pytree put for the whole stacked window (a single
+                # host round-trip instead of one per leaf).
+                ev_c, seg_c = jax.device_put(
+                    stack_chain_window(evs, timestamps, n_pad))
                 new_state, out = create_transfers_chain_jit(
                     self.state, ev_c, seg_c)
                 self.state = new_state
@@ -1575,9 +1806,8 @@ class DeviceLedger:
                     results.extend(self.create_transfers_window(
                         evs[k + 1:], timestamps[k + 1:]))
                 return results
-            ev_s, seg = stack_superbatch(evs, timestamps, n_pad)
-            ev_s = {k: jax.device_put(v) for k, v in ev_s.items()}
-            seg = {k: jax.device_put(v) for k, v in seg.items()}
+            ev_s, seg = jax.device_put(
+                stack_superbatch(evs, timestamps, n_pad))
             if imported:
                 from .fast_kernels import (
                     create_transfers_super_imported_jit,
@@ -1700,8 +1930,8 @@ class DeviceLedger:
 
         from .fast_kernels import create_transfers_imported_jit
 
-        evp = pad_transfer_events(transfers_to_arrays([]), n_pad)
-        evp = {k: jax.device_put(v) for k, v in evp.items()}
+        evp = jax.device_put(
+            pad_transfer_events(transfers_to_arrays([]), n_pad))
         variants = [create_transfers_fast_jit,
                     create_transfers_fixpoint_jit,
                     create_transfers_fixpoint_deep_jit,
@@ -2800,6 +3030,13 @@ class DeviceLedger:
             # committed). In attach mode the PartitionedRouter owns the
             # partitioned counters; they merge in here.
             "routes": self._merged_routes(),
+            # Host-staging overlap record (pipelined submit_window):
+            # how much of the host's window pack+transfer work the
+            # dispatch path actually waited on. host_stall_fraction is
+            # the overlap gate leg's measured quantity — 1.0 means
+            # fully synchronous staging, ~0 means the pack was hidden
+            # behind in-flight device execution.
+            "staging": self.staging_summary(),
             # Device telemetry (None unless a PartitionedRouter is
             # attached with telemetry on): the decoded-on-host
             # aggregates of the fixed-layout u32 block the fused route

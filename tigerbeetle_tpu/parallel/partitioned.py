@@ -1097,21 +1097,41 @@ class PartitionedRouter:
             self.window_routes.get(route, 0) + 1)
         self._window_seq += 1
 
+    def stage_operands(self, evs: list[dict], timestamps: list[int],
+                       n_pad: int):
+        """Pack one fused window's stacked operands and start their
+        REPLICATED device transfer (the chain step's in_specs are
+        P() for ev_stack/ts_stack/n_stack — state is the only sharded
+        input) as a single pytree put. Pure host work + transfer, no
+        router state touched: DeviceLedger's background stager calls
+        this off the dispatch thread so the pack/transfer overlaps the
+        in-flight window; chain_dispatch(staged=...) consumes the
+        result."""
+        return jax.device_put(
+            stack_partitioned_window(evs, timestamps, n_pad),
+            NamedSharding(self.mesh, P()))
+
     def chain_dispatch(self, state, evs: list[dict],
                        timestamps: list[int], n_pad: int | None = None,
-                       force_fallback=None):
+                       force_fallback=None, staged=None):
         """ONE fused shard_map+scan dispatch over a whole window,
         UNRESOLVED (every out leaf stays on device with a leading W
         axis). Pipelined drivers (DeviceLedger.submit_window) thread
         out["fallback"][-1] into the next window's force_fallback and
         resolve later; synchronous callers use step_window. Counts the
-        window under the partitioned_chain route."""
+        window under the partitioned_chain route. `staged` is an
+        optional pre-staged (ev_stack, ts_stack, n_stack) payload from
+        stage_operands — already packed and resident replicated, so
+        the dispatch skips the inline pack entirely."""
         self._require_serving()
-        ns = [len(e["id_lo"]) for e in evs]
-        if n_pad is None:
-            n_pad = _pad_bucket(max(ns))
-        ev_stack, ts_stack, n_stack = stack_partitioned_window(
-            evs, timestamps, n_pad)
+        if staged is not None:
+            ev_stack, ts_stack, n_stack = staged
+        else:
+            ns = [len(e["id_lo"]) for e in evs]
+            if n_pad is None:
+                n_pad = _pad_bucket(max(ns))
+            ev_stack, ts_stack, n_stack = stack_partitioned_window(
+                evs, timestamps, n_pad)
         self._count_window("partitioned_chain")
         self.tracer.count(Event.dispatch_route,
                           route="partitioned_chain")

@@ -152,7 +152,7 @@ class ServingSupervisor:
                  epoch_interval: int = 8, retry: RetryPolicy | None = None,
                  seed: int = 0, mirror_audit: str = "full",
                  fault_hook=None, sleep=time.sleep, tracer=None,
-                 flight_recorder=None):
+                 flight_recorder=None, pipeline_depth: int = 2):
         assert mirror_audit in ("full", "spot", "off")
         self.tracer = tracer if tracer is not None else NullTracer()
         # Flight recorder: every window's route decision and every
@@ -182,6 +182,15 @@ class ServingSupervisor:
         self.last_recovery: dict | None = None
         self._windows_since_epoch = 0
         self.windows_total = 0
+        # Overlapped serving (submit_transfers_window): in-flight
+        # pipelined window records, oldest first. pipeline_depth bounds
+        # how many stay unresolved — at depth the oldest resolves
+        # before the next submit, and window k+1's host staging (the
+        # ledger's background stager) overlaps exactly that blocking
+        # resolve plus the in-flight dispatch. The synchronous
+        # create_transfers_window path never populates this.
+        self.pipeline_depth = max(1, int(pipeline_depth))
+        self._pending: list = []
         # Trace ids of requests whose windows landed since the last
         # verified epoch: a recovery affects exactly these requests, so
         # tail retention force-keeps them (ISSUE 15) and the flight
@@ -195,6 +204,9 @@ class ServingSupervisor:
         # The ledger surfaces OUR counters through fallback_stats() so
         # bench/devhub records carry them next to the fallback causes.
         led.recovery_stats = self.counters
+        # And OUR tracer flows down so window_stage spans + the
+        # host-stall gauge land in the same catalog as everything else.
+        led.tracer = self.tracer
 
     # ------------------------------------------------------------ serving
 
@@ -272,6 +284,135 @@ class ServingSupervisor:
             self.verify_epoch()
         return out
 
+    # ------------------------------------------------- overlapped serving
+
+    def submit_transfers_window(self, batches: list, timestamps: list,
+                                trace_ctxs: list | None = None) -> int:
+        """The overlapped serving hot loop's submit half: stage window
+        k's stacked operands on the ledger's background stager FIRST,
+        resolve the oldest in-flight window when the pipeline is at
+        depth (the stage's pack+transfer overlaps that blocking resolve
+        and the in-flight dispatch), then dispatch window k with zero
+        host synchronization (DeviceLedger.submit_window — poison
+        chaining unchanged). Returns the window's history index;
+        results materialize at resolve_transfers_windows() /
+        drain_pipeline(), or out of a recovery's oracle replay exactly
+        like the synchronous path (the window is logged at dispatch, so
+        bounded replay covers in-flight windows; a staged-but-
+        undispatched pack dies with the quarantined ledger and is never
+        committed). Windows the pipeline cannot take (flagged/imported/
+        oversized) fall through to the synchronous window path inline.
+        Runs the epoch check when the interval elapses — epoch verify
+        drains the pipeline, as does recovery."""
+        from .ops.batch import transfers_to_arrays
+
+        batches = [list(b) for b in batches]
+        timestamps = list(timestamps)
+        win = self.windows_total
+        ctxs = [c for c in (trace_ctxs or ()) if c is not None]
+        trace_ids = [fmt_trace_id(c.trace_id) for c in ctxs]
+        self._epoch_trace_ids.extend(trace_ids)
+        evs = [transfers_to_arrays(b) for b in batches]
+        self.led.stage_window(evs, timestamps)
+        if len(self._pending) >= self.pipeline_depth:
+            self.resolve_transfers_windows(count=1)
+        t0 = self.tracer.now_ns()
+        ticket = self._dispatch(
+            lambda: self.led.submit_window(evs, timestamps),
+            what="window_submit", win=win)
+        rec = {"hist_idx": len(self.history), "win": win,
+               "ticket": ticket, "t0_ns": t0, "trace_ids": trace_ids,
+               "route": self.led.last_window_route,
+               "tier": self.led.last_window_tier, "results": None}
+        if ticket is None:
+            # Ineligible for the pipeline: the synchronous window path
+            # (which itself resolves everything in flight first, so
+            # submit order is preserved).
+            out = self._dispatch(
+                lambda: self.led.create_transfers_window(evs,
+                                                         timestamps),
+                what="window", win=win)
+            rec["route"] = self.led.last_window_route
+            rec["tier"] = self.led.last_window_tier
+            rec["results"] = [
+                [(int(t), int(s))
+                 for s, t in zip(st.tolist(), ts.tolist())]
+                for st, ts in out]
+        route = rec["route"]
+        if route:
+            self.tracer.count(Event.dispatch_route, route=route)
+        if route and "fallback" in route:
+            for tid in trace_ids:
+                self.tracer.keep_trace(tid, reason="fallback")
+        self.flight.record(window=win, route=route or "unknown",
+                           prepares=len(batches),
+                           **({"trace_ids": trace_ids} if trace_ids
+                              else {}))
+        self.log.append(("window", batches, timestamps))
+        self.history.append(rec["results"])
+        hist_idx = rec["hist_idx"]
+        if rec["results"] is None:
+            self._pending.append(rec)
+        else:
+            self._close_window_span(rec)
+        self.windows_total += 1
+        self._windows_since_epoch += 1
+        if self._windows_since_epoch >= self.epoch_interval:
+            self.verify_epoch()
+        return hist_idx
+
+    def resolve_transfers_windows(self, count: int | None = None) -> list:
+        """Resolve the oldest `count` pending pipelined windows (all of
+        them when None), filling their history entries, and return
+        their normalized per-prepare results ([(ts, status), ...] per
+        prepare, the history/oracle shape). A mid-pipeline fallback or
+        a recovery may resolve more than asked on the ledger side; the
+        extra records simply materialize without blocking when their
+        turn comes."""
+        n = len(self._pending) if count is None \
+            else min(count, len(self._pending))
+        out = []
+        for _ in range(n):
+            rec = self._pending[0]
+            tk = rec["ticket"]
+            if rec["results"] is None and tk is not None \
+                    and tk.results is None:
+                self._dispatch(
+                    lambda: self.led.resolve_windows(count=1),
+                    what="window_resolve", win=rec["win"])
+                tk = rec["ticket"]  # a recovery replaces it with None
+            self._pending.pop(0)
+            if rec["results"] is None:
+                _kind, pairs = tk.results
+                rec["results"] = [
+                    [(int(t), int(s))
+                     for s, t in zip(st.tolist(), ts.tolist())]
+                    for st, ts in pairs]
+                self.history[rec["hist_idx"]] = rec["results"]
+            self._close_window_span(rec)
+            out.append(rec["results"])
+        return out
+
+    def drain_pipeline(self) -> list:
+        """Resolve every pending pipelined window (epoch verify and
+        recovery drain through here): history is fully materialized
+        after this returns."""
+        return self.resolve_transfers_windows()
+
+    def _close_window_span(self, rec) -> None:
+        """Emit the submit->resolve window_commit span for one
+        pipelined window (explicit timing — its open/close sites are
+        separate calls), tagged with the route/tier latency class the
+        SLO engine partitions on."""
+        t0 = rec["t0_ns"]
+        tags = {}
+        if rec["route"]:
+            tags["route"] = rec["route"]
+            if rec["tier"]:
+                tags["tier"] = rec["tier"]
+        self.tracer.record_span(Event.window_commit, t0,
+                                self.tracer.now_ns() - t0, **tags)
+
     def expire_pending_transfers(self, timestamp: int) -> int:
         n = self._dispatch(
             lambda: self.led.expire_pending_transfers(timestamp),
@@ -314,6 +455,12 @@ class ServingSupervisor:
     def _verify_epoch(self) -> bool:
         from .ops import state_epoch
 
+        # Quiesce the overlapped pipeline first: every pending window
+        # resolves (filling its history entry) before the oracle replay
+        # below compares against history. A recovery triggered inside
+        # this drain clears the log and swaps the ledger — the checks
+        # below then run against the freshly rebuilt state, trivially.
+        self.drain_pipeline()
         led = self.led
         try:
             led.resolve_windows()
@@ -455,6 +602,16 @@ class ServingSupervisor:
             replayed = self._replay_log_into_base()
         start = len(self.history) - n_entries
         self.history[start:] = replayed
+        # Pipelined windows still in flight at quarantine: every one of
+        # them was LOGGED at dispatch, so the oracle replay above just
+        # produced their authoritative results — adopt those and detach
+        # the dead tickets. A staged-but-undispatched pack was never
+        # logged: it dies with the quarantined ledger's stager
+        # (shutdown_staging below) and is re-staged fresh if its window
+        # is ever submitted again — drained cleanly, committed never.
+        for rec in self._pending:
+            rec["results"] = self.history[rec["hist_idx"]]
+            rec["ticket"] = None
         self.counters["replayed_windows"] += n_windows
         recs = self.counters["recoveries"]
         recs[cause] = recs.get(cause, 0) + 1
@@ -464,7 +621,10 @@ class ServingSupervisor:
         # Fresh mirror from the recovered oracle (a deep copy: the
         # mirror evolves by write-through deltas, the base only by
         # replay) and a device rebuild through from_host — the same
-        # path a restart/state-sync takes.
+        # path a restart/state-sync takes. The quarantined ledger's
+        # stager drains first: its staged-but-undispatched window (if
+        # any) is dropped, its worker joined.
+        self.led.shutdown_staging()
         new_mirror = copy.deepcopy(self.epoch_base)
         self._attach(DeviceLedger(self.a_cap, self.t_cap,
                                   write_through=new_mirror))
@@ -479,6 +639,8 @@ class ServingSupervisor:
                for k, v in self.counters.items()}
         out["windows_total"] = self.windows_total
         out["windows_since_epoch"] = self._windows_since_epoch
+        out["pipeline"] = {"depth": self.pipeline_depth,
+                           "pending": len(self._pending)}
         out["last_recovery"] = self.last_recovery
         out["flight"] = {"windows_recorded": self.flight.seq,
                          "dumps": self.flight.dumps,

@@ -156,6 +156,10 @@ class StateMachine:
         self.metrics: dict[str, dict] = {}
         # Pipelined commit windows awaiting resolution (submit_commit_window).
         self._pending_windows: list = []
+        # stage_commit_window's decode cache: the staged window's exact
+        # SoA dicts, reused by the matching submit_commit_window so the
+        # ledger's staged pack can be consumed by identity.
+        self._staged_window = None
 
     def fallback_stats(self) -> dict:
         """Device-engine routing/fallback counters (per-cause host
@@ -712,6 +716,34 @@ class StateMachine:
             replies.append(multi_batch.encode(parts, spec.result_size))
         return replies
 
+    def _window_pipelinable(self, op: Operation,
+                            bodies: list[bytes]) -> bool:
+        O = Operation
+        return (self.engine == "device" and len(bodies) > 1
+                and _base_operation(op) == O.create_transfers
+                and op.is_multi_batch()
+                and all(self.input_valid(op, b) for b in bodies))
+
+    def stage_commit_window(self, op: Operation, bodies: list[bytes],
+                            timestamps: list[int]) -> bool:
+        """Host↔device overlap: decode window k+1's bodies and hand its
+        stacked operands to the ledger's background stager while window
+        k's dispatch is in flight (DeviceLedger.stage_window). The
+        decode is cached by body identity so the following
+        submit_commit_window of the same window reuses the exact SoA
+        dicts — which is what lets the ledger match its staged pack.
+        Purely an optimization: an unstaged or mismatched submit packs
+        inline, bit-identically. Returns True when a stage was
+        enqueued."""
+        if not self._window_pipelinable(op, bodies):
+            self._staged_window = None
+            return False
+        evs, tss, shape = self._flatten_window(op, bodies, timestamps)
+        # Keep the bodies alive in the cache: their ids key the reuse.
+        self._staged_window = (op, tuple(map(id, bodies)), bodies,
+                               list(timestamps), evs, tss, shape)
+        return self.led.stage_window(evs, tss)
+
     def submit_commit_window(self, op: Operation, bodies: list[bytes],
                              timestamps: list[int]):
         """Pipelined serving: decode + submit one commit window with no
@@ -720,15 +752,16 @@ class StateMachine:
         Returns an opaque pending record, or None when the window cannot
         pipeline (caller takes the synchronous commit_window path).
         Replies materialize at resolve_commit_windows()."""
-        O = Operation
-        can_window = (
-            self.engine == "device" and len(bodies) > 1
-            and _base_operation(op) == O.create_transfers
-            and op.is_multi_batch()
-            and all(self.input_valid(op, b) for b in bodies))
-        if not can_window:
+        if not self._window_pipelinable(op, bodies):
             return None
-        evs, tss, shape = self._flatten_window(op, bodies, timestamps)
+        staged, self._staged_window = self._staged_window, None
+        if (staged is not None and staged[0] == op
+                and staged[1] == tuple(map(id, bodies))
+                and staged[3] == list(timestamps)):
+            evs, tss, shape = staged[4], staged[5], staged[6]
+        else:
+            evs, tss, shape = self._flatten_window(op, bodies,
+                                                   timestamps)
         ticket = self.led.submit_window(evs, tss)
         if ticket is None:
             return None

@@ -407,6 +407,40 @@ def _scenario_partitioned(col: _Collector) -> None:
     assert not fell
 
 
+def _scenario_overlap(col: _Collector) -> None:
+    """ISSUE 16's staging plane: a small pipelined ledger run emits
+    window_stage in BOTH modes (one window staged ahead on the
+    background stager = overlapped, one packed synchronously on the
+    dispatch path = inline) and the cumulative host_stall_fraction
+    gauge — the events the overlap gate leg's ceiling reads."""
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.ledger import DeviceLedger
+    from ..types import Account, Transfer
+
+    led = DeviceLedger(a_cap=1 << 8, t_cap=1 << 11)
+    led.tracer = col.make(60)
+    led.create_accounts([Account(id=i, ledger=1, code=1)
+                         for i in (1, 2)], 1_000)
+
+    def window(base, ts):
+        evs = [transfers_to_arrays(
+            [Transfer(id=base + b * 4 + i, debit_account_id=1 + i % 2,
+                      credit_account_id=2 - i % 2, amount=1, ledger=1,
+                      code=1) for i in range(4)]) for b in range(2)]
+        return evs, [ts, ts + 100]
+
+    evs, tss = window(7000, 10 ** 9)
+    assert led.stage_window(evs, tss)       # -> mode=overlapped
+    assert led.submit_window(evs, tss) is not None
+    evs2, tss2 = window(7100, 10 ** 9 + 500)
+    assert led.submit_window(evs2, tss2) is not None  # -> mode=inline
+    led.resolve_windows()
+    st = led.staging_stats
+    assert st["staged"] == 1 and st["windows"] == 2, st
+    assert led.staging_summary()["host_stall_fraction"] is not None
+    led.shutdown_staging()
+
+
 def _scenario_slo(col: _Collector) -> None:
     """The SLO engine against the COMMITTED perf/slo.json: objectives
     must load (every referenced event on-catalog — a dead SLO is a red
@@ -495,6 +529,7 @@ SCENARIOS = (
     _scenario_commit_windows,
     _scenario_router,
     _scenario_partitioned,
+    _scenario_overlap,
     _scenario_slo,
     _scenario_causal_trace,
 )

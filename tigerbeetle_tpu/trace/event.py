@@ -142,6 +142,21 @@ class Event(enum.Enum):
         "whole-window scan, flat = an unrolled super route, fallback = "
         "per-batch) — the per-class latency distributions the SLO "
         "engine reads", "route", "tier", hist_tags=("route", "tier"))
+    window_stage = _span(
+        "host-side staging of one commit window's stacked operands "
+        "(numpy pack + pytree device transfer): overlapped = packed on "
+        "the staging worker while the previous window's dispatch was "
+        "in flight (the recorded duration is the WAIT the dispatch "
+        "path actually paid, usually ~0), inline = packed "
+        "synchronously on the dispatch path (the duration is the full "
+        "pack+transfer cost)", "mode", "route", hist_tags=("mode",))
+    host_stall_fraction = _gauge(
+        "fraction of host window-staging work the dispatch path "
+        "actually waited on, cumulative per ledger (stall_ms / total "
+        "staging work): 1.0 = fully synchronous staging (every pack "
+        "blocks the dispatch), ~0 = the pack/transfer fully hidden "
+        "behind in-flight device execution — the overlap gate leg's "
+        "ceiling reads this")
     serving_replay_windows = _histogram(
         "windows replayed per recovery (unit: windows; the bounded-"
         "replay objective in perf/slo.json reads this distribution)")
