@@ -227,6 +227,12 @@ def run_bench(platform: str, timeout_s: float) -> dict:
                     partial.update(json.loads(line[len("##shard "):]))
                 except json.JSONDecodeError:
                     pass
+            elif line.startswith("##admission "):
+                try:
+                    partial.update(
+                        json.loads(line[len("##admission "):]))
+                except json.JSONDecodeError:
+                    pass
             elif line.startswith("{"):
                 try:
                     final = json.loads(line)
@@ -648,6 +654,22 @@ def inner_main() -> None:
         shard = {"error": str(e)[:200]}
     print("##shard " + json.dumps({"shard_balance": shard}), flush=True)
 
+    # Admission record (##admission): the ISSUE 18 ingress plane under
+    # a sessionized Zipfian overload on a virtual clock — sustained
+    # admitted events/s plus per-class admitted-wait p99 while lower
+    # classes shed explicitly (the overload gate leg asserts the same
+    # contract live; this keeps the measured numbers in the run record
+    # so a shed-behavior regression is visible in the devhub history).
+    admission = None
+    try:
+        from tigerbeetle_tpu.benchmark import bench_admission
+
+        admission = bench_admission(rounds=8 if quick else 24)
+    except Exception as e:  # never let the probe kill a bench run
+        admission = {"error": str(e)[:200]}
+    print("##admission " + json.dumps({"admission": admission}),
+          flush=True)
+
     # Dispatch-route record: which kernel route each config's windows
     # took ("chain" = the scan-form whole-window dispatch, the default
     # serving route; "partitioned_chain" = the fused sharded-state
@@ -714,6 +736,9 @@ def inner_main() -> None:
         # Partitioned-route shard balance (##shard line): events per
         # shard, cross-shard fraction, exchange overflow count.
         "shard_balance": shard,
+        # Admission-plane record (##admission line): per-class
+        # admitted/shed counts, shed line, occupancy, sustained tps.
+        "admission": admission,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.
@@ -896,7 +921,8 @@ def main() -> None:
                    "config3_chains_tps", "config4_twophase_limits_tps",
                    "config5_oracle_parity", "config6_serving_tps",
                    "serving_batch_latency", "fallback_diagnostics",
-                   "dispatch_routes", "shard_balance", "host_staging")
+                   "dispatch_routes", "shard_balance", "host_staging",
+                   "admission")
     if banked is not None:
         # Self-consistent record: value, per-config numbers AND the
         # platform tag all come from the banked on-chip artifact (a

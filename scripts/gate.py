@@ -300,6 +300,37 @@ def run_overlap(timeout: int = 900) -> int:
     return rc
 
 
+def run_overload(timeout: int = 900) -> int:
+    """Overload leg: the admission plane's SLO-driven load shedding
+    proven LIVE (testing/overload_smoke.py) — a seeded 100k-session
+    Zipfian overload at ~2x window capacity must keep every class's
+    ADMITTED queue-wait p99 within its committed per-class budget while
+    at least one class sheds, every rejection a typed ShedResult with a
+    tail-kept trace (submitted == admitted + shed, zero silent drops),
+    the admitted history bit-exact vs an oracle replay of only the
+    admitted requests, and the shed-line-disabled negative must
+    collapse past the largest budget and FAIL the gate predicate. Skip
+    with --no-overload."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import overload_smoke as s; "
+           "s.overload_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] overload: 100k-session Zipfian admission shedding + "
+          "no-shed negative (testing/overload_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: overload timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] overload rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_telemetry(timeout: int = 900) -> int:
     """Telemetry leg: the round-10 device-telemetry plane on the fused
     partitioned-chain route (testing/telemetry_smoke.py, 8-device
@@ -517,6 +548,9 @@ def main() -> int:
     ap.add_argument("--no-overlap", action="store_true",
                     help="skip the overlap leg (double-buffered window "
                          "staging stall ceiling + forced-sync negative)")
+    ap.add_argument("--no-overload", action="store_true",
+                    help="skip the overload leg (admission-plane "
+                         "Zipfian shed/SLO proof + no-shed negative)")
     ap.add_argument("--no-telemetry", action="store_true",
                     help="skip the telemetry leg (device block oracle "
                          "+ lane census + overhead ratio)")
@@ -566,6 +600,10 @@ def main() -> int:
         rc = run_overlap()
         if rc != 0:
             reds.append(f"overlap rc={rc}")
+    if not args.no_overload:
+        rc = run_overload()
+        if rc != 0:
+            reds.append(f"overload rc={rc}")
     if not args.no_telemetry:
         rc = run_telemetry()
         if rc != 0:

@@ -1,0 +1,279 @@
+"""Admission plane (ISSUE 18): session credits, the global queue
+bound, the SLO-driven shed line's rise/fall hysteresis, the deadline
+sweep, conservation (zero silent drops), and admitted-history
+bit-exactness vs the admitted-only oracle replay.
+
+Everything runs on a VirtualClock over a real (small) ServingSupervisor
+so queue waits, deadline sweeps, and burn windows are exactly
+reproducible.
+
+Quick tier: the pure-host plane logic (fast rejects, deadline sweep,
+shed-line rise/cool/pin, conservation accounting) — tests that never
+dispatch a window, so the 1-core tier-1 budget pays no jit. Slow tier:
+everything that pumps real windows through the supervisor (admit paths,
+oracle parity, stage-ahead consumption)."""
+
+import pytest
+
+from tigerbeetle_tpu.admission import (
+    SHED_REASONS,
+    AdmissionClass,
+    AdmissionPlane,
+    ShedResult,
+    VirtualClock,
+)
+from tigerbeetle_tpu.serving import ServingSupervisor
+from tigerbeetle_tpu.types import Account, Transfer
+
+CLASSES = (
+    AdmissionClass("critical", 0, slo_ms=50.0, deadline_ms=200.0),
+    AdmissionClass("standard", 1, slo_ms=100.0, deadline_ms=400.0),
+    AdmissionClass("batch", 2, slo_ms=150.0, deadline_ms=600.0),
+)
+
+
+def _mk_plane(**kw):
+    clock = VirtualClock()
+    sup = ServingSupervisor(a_cap=1 << 8, t_cap=1 << 11,
+                            epoch_interval=4, sleep=lambda s: None,
+                            seed=3)
+    args = dict(classes=CLASSES, prepare_max=16, window_prepares=2,
+                session_credits=2, max_queue=32, burn_window_ticks=4,
+                burn_budget=0.25, cool_ticks=2, clock=clock, seed=3)
+    args.update(kw)
+    plane = AdmissionPlane(sup, **args)
+    plane.open_accounts([Account(id=i, ledger=1, code=1)
+                         for i in (1, 2)], 1_000)
+    return plane, sup, clock
+
+
+def _evs(n, start):
+    return [Transfer(id=start + i, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1)
+            for i in range(n)]
+
+
+class TestBackpressure:
+    @pytest.mark.slow
+    def test_no_credit_fast_reject_and_credit_return(self):
+        plane, _, _ = _mk_plane(session_credits=2)
+        r1 = plane.submit(7, _evs(2, 100))
+        r2 = plane.submit(7, _evs(2, 200))
+        r3 = plane.submit(7, _evs(2, 300))
+        assert r1.state == "queued" and r2.state == "queued"
+        assert r3.state == "shed"
+        assert isinstance(r3.shed, ShedResult)
+        assert r3.shed.reason == "no_credit"
+        assert r3.shed.session_id == 7 and r3.shed.cls == "standard"
+        # Credits return on admit: after a pump the session queues again.
+        plane.pump()
+        assert r1.state == "admitted" and r2.state == "admitted"
+        assert plane.submit(7, _evs(2, 400)).state == "queued"
+        plane.drain()
+        assert plane.conservation()["ok"]
+
+    def test_queue_full_fast_reject(self):
+        plane, _, _ = _mk_plane(max_queue=3, session_credits=100)
+        rs = [plane.submit(i + 1, _evs(1, 100 + i * 10))
+              for i in range(5)]
+        assert [r.state for r in rs[:3]] == ["queued"] * 3
+        assert {r.shed.reason for r in rs[3:]} == {"queue_full"}
+        cons = plane.conservation()
+        assert cons["ok"] and cons["queued"] == 3 and cons["shed"] == 2
+
+    def test_shed_is_returned_never_raised(self):
+        plane, _, _ = _mk_plane(session_credits=0)
+        r = plane.submit(1, _evs(1, 100))
+        assert r.state == "shed" and r.shed.reason == "no_credit"
+        assert r.shed.reason in SHED_REASONS
+        assert r.shed.retry_after_ms == pytest.approx(100.0)
+
+
+class TestDeadlineSweep:
+    def test_expired_queued_requests_shed_not_admitted_late(self):
+        plane, _, clock = _mk_plane(stage_ahead=False,
+                                    max_windows_per_pump=0)
+        r = plane.submit(1, _evs(1, 100), cls="critical")
+        clock.advance(0.5)  # past critical's 200ms hard deadline
+        plane.pump()
+        assert r.state == "shed" and r.shed.reason == "deadline"
+        # The swept request released its session credit.
+        assert plane.submit(1, _evs(1, 200)).state == "queued"
+        assert plane.conservation()["ok"]
+
+    @pytest.mark.slow
+    def test_admitted_wait_bounded_by_deadline(self):
+        # Starved pump (1 event of service per tick) under steady load:
+        # whatever IS admitted was admitted within its class deadline.
+        plane, _, clock = _mk_plane(
+            stage_ahead=False, prepare_max=1, window_prepares=1,
+            session_credits=100, max_queue=100)
+        # Pin the shed line open: this test isolates the deadline sweep
+        # (the burn controller would otherwise gate the class first).
+        plane.force_shed_level(0)
+        nid = 10 ** 4
+        for t in range(20):
+            for sid in (1, 2, 3):
+                plane.submit(sid, _evs(1, nid), cls="batch")
+                nid += 1
+            plane.pump()
+            clock.advance(0.1)
+        plane.drain()
+        assert plane.conservation()["ok"]
+        st = plane.stats()["classes"]["batch"]
+        assert st["shed"].get("deadline", 0) > 0
+        mx = st["admit_wait_ms"]["max"]
+        assert mx is not None and mx <= CLASSES[2].deadline_ms + 1e-6
+
+
+class TestShedLine:
+    def test_rises_one_class_per_tick_top_class_never(self):
+        plane, _, clock = _mk_plane(stage_ahead=False,
+                                    max_windows_per_pump=0,
+                                    session_credits=100)
+        nid = 10 ** 4
+        for i in range(4):
+            plane.submit(1, _evs(1, nid), cls="batch")
+            plane.submit(2, _evs(1, nid + 1), cls="standard")
+            nid += 2
+        # Ages (200ms) breach batch's 150ms and standard's 100ms SLOs
+        # but stay inside both hard deadlines.
+        clock.advance(0.2)
+        plane.pump()  # tick 1: burn windows fill, level still 0
+        assert plane.shed_level == 0
+        plane.pump()  # tick 2: burn > budget -> gate batch
+        assert plane.shed_level == 1
+        batch_rs = [r for r in plane.shed_results if r.cls == "batch"]
+        assert batch_rs and all(r.reason == "shed_line"
+                                for r in batch_rs)
+        assert plane.submit(3, _evs(1, nid), cls="batch").shed.reason \
+            == "shed_line"
+        plane.pump()  # tick 3: still burning -> gate standard too
+        assert plane.shed_level == 2
+        assert plane.submit(3, _evs(1, nid + 1),
+                            cls="standard").shed.reason == "shed_line"
+        # The top class is NEVER gated, at any level.
+        assert plane.submit(3, _evs(1, nid + 2),
+                            cls="critical").state == "queued"
+        assert plane.conservation()["ok"]
+
+    def test_cools_down_after_clean_ticks(self):
+        plane, _, clock = _mk_plane(stage_ahead=False,
+                                    max_windows_per_pump=0,
+                                    session_credits=100,
+                                    burn_window_ticks=4, cool_ticks=2)
+        for i in range(3):
+            plane.submit(1, _evs(1, 10 ** 4 + i), cls="batch")
+        clock.advance(0.2)
+        plane.pump()
+        plane.pump()
+        assert plane.shed_level >= 1
+        # Queues are now empty (flushed); the burn window decays to
+        # zero and after cool_ticks clean ticks per step the line walks
+        # back down to 0 — hysteresis, not flapping.
+        levels = []
+        for _ in range(16):
+            plane.pump()
+            levels.append(plane.shed_level)
+        assert plane.shed_level == 0
+        assert sorted(levels, reverse=True) == levels  # monotonic down
+
+    def test_force_shed_level_pins_and_releases(self):
+        # max_windows_per_pump=0: no window ever dispatches, so this
+        # stays a pure-host (quick-tier) test of the pin semantics.
+        plane, _, _ = _mk_plane(stage_ahead=False,
+                                max_windows_per_pump=0)
+        plane.force_shed_level(2)
+        assert plane.submit(1, _evs(1, 100),
+                            cls="batch").shed.reason == "shed_line"
+        assert plane.submit(1, _evs(1, 200),
+                            cls="standard").shed.reason == "shed_line"
+        assert plane.submit(1, _evs(1, 300),
+                            cls="critical").state == "queued"
+        plane.force_shed_level(None)
+        for _ in range(16):
+            plane.pump()
+        assert plane.shed_level == 0
+        # The critical request is still queued (nothing dispatches at
+        # zero windows per pump) and conservation counts it as such.
+        assert plane.conservation()["ok"]
+        assert plane.conservation()["queued"] == 1
+
+    def test_depth_signal_raises_line_without_burn(self):
+        plane, _, _ = _mk_plane(stage_ahead=False,
+                                max_windows_per_pump=0,
+                                session_credits=100, max_queue=8,
+                                depth_shed_fraction=0.5)
+        for i in range(4):  # depth 4 >= 0.5 * 8
+            plane.submit(i + 1, _evs(1, 100 + i), cls="batch")
+        plane.pump()
+        assert plane.shed_level == 1
+
+
+class TestConservationAndOracle:
+    @pytest.mark.slow
+    def test_conservation_and_history_bit_exact_with_sheds(self):
+        plane, sup, clock = _mk_plane(session_credits=1, max_queue=8)
+        nid = 10 ** 5
+        for t in range(6):
+            for sid in range(1, 7):
+                cls = ("critical" if sid == 1
+                       else "standard" if sid < 4 else "batch")
+                # Second submit in the same tick: the session's single
+                # credit is taken -> typed no_credit fast-reject.
+                plane.submit(sid, _evs(2, nid), cls=cls)
+                plane.submit(sid, _evs(2, nid + 2), cls=cls)
+                nid += 4
+            plane.pump()
+            clock.advance(0.05)
+        plane.drain()
+        cons = plane.conservation()
+        assert cons["ok"] and cons["queued"] == 0 and cons["staged"] == 0
+        assert cons["shed"] > 0
+        for r in plane.shed_results:
+            assert isinstance(r, ShedResult)
+            assert r.reason in SHED_REASONS
+        # Bit-exactness under shedding: the supervisor's history equals
+        # an oracle replay of exactly the admitted requests.
+        hist, _oracle = plane.oracle_history()
+        assert hist == sup.history
+        assert sup.verify_epoch()
+        sup.led.shutdown_staging()
+
+    @pytest.mark.slow
+    def test_stats_record_shape(self):
+        plane, _, _ = _mk_plane()
+        plane.submit(1, _evs(2, 100), cls="critical")
+        plane.pump()
+        plane.drain()
+        st = plane.stats()
+        assert set(st["classes"]) == {c.name for c in CLASSES}
+        cs = st["classes"]["critical"]
+        assert cs["submitted"] == 1 and cs["admitted"] == 1
+        assert cs["admit_wait_ms"]["count"] == 1
+        assert st["conservation"]["ok"]
+        assert 0.0 <= st["queue"]["occupancy"] <= 1.0
+
+
+class TestStageAhead:
+    @pytest.mark.slow
+    def test_prestaged_window_is_consumed_not_restaged(self):
+        # prepare_max=4 with 8 offered events/tick -> every window is 2
+        # prepares, the pipelined route's staging-eligibility floor
+        # (DeviceLedger._window_plan requires len(evs) > 1).
+        plane, sup, clock = _mk_plane(stage_ahead=True, prepare_max=4,
+                                      session_credits=100)
+        nid = 10 ** 5
+        for t in range(5):
+            for sid in (1, 2, 3, 4):
+                plane.submit(sid, _evs(2, nid))
+                nid += 2
+            plane.pump()
+            clock.advance(0.02)
+        plane.drain()
+        stats = sup.led.staging_stats
+        assert stats["staged"] > 0, stats
+        assert plane.conservation()["ok"]
+        hist, _ = plane.oracle_history()
+        assert hist == sup.history
+        sup.led.shutdown_staging()

@@ -218,6 +218,42 @@ class TestRetryPolicy:
                               clock=lambda: clock["t"])
         assert ei.value.cause == "dispatch_deadline"
 
+    def test_clamped_deadline_bounds_total_retry_budget(self):
+        # ISSUE 18 satellite: under saturation the whole retry sequence
+        # — attempts AND backoff sleeps — is bounded by the request's
+        # remaining admission deadline (RetryPolicy.clamped), so a
+        # saturated pipeline degrades into a fast typed escalation
+        # instead of every admitted request burning the policy's full
+        # static 30s deadline.
+        t = {"t": 0.0}
+
+        def fn():
+            t["t"] += 0.010  # each attempt costs 10ms of wall
+            raise TransientDispatchError("saturated")
+
+        policy = RetryPolicy(max_retries=99, base_delay_s=0.050,
+                             max_delay_s=10.0, deadline_s=30.0,
+                             jitter=0.0).clamped(0.080)
+        assert policy.deadline_s == pytest.approx(0.080)
+        with pytest.raises(RecoveryNeeded) as ei:
+            call_with_retries(fn, policy, random.Random(0),
+                              self._counters(),
+                              sleep=lambda s: t.__setitem__(
+                                  "t", t["t"] + s),
+                              clock=lambda: t["t"])
+        assert ei.value.cause == "dispatch_deadline"
+        # Total elapsed <= clamped budget + one attempt's own cost (an
+        # in-flight attempt cannot be preempted, only not retried) —
+        # nowhere near the policy's static 30s.
+        assert t["t"] <= 0.080 + 0.010 + 1e-9
+
+    def test_clamped_tightens_never_loosens(self):
+        p = RetryPolicy(deadline_s=0.5)
+        assert p.clamped(30.0) is p
+        assert p.clamped(None) is p
+        assert p.clamped(0.1).deadline_s == pytest.approx(0.1)
+        assert p.clamped(-1.0).deadline_s == 0.0
+
     def test_mirror_divergence_goes_straight_to_recovery(self):
         from tigerbeetle_tpu.ops.ledger import MirrorDivergence
 
@@ -522,6 +558,100 @@ class TestChaosSweep:
         b = run_chaos_seed(11, windows=4, batches_per_window=2,
                            events_per_batch=24, mesh_scenario=False)
         assert a == b
+
+
+# ------------------------------------- adversarial traffic shapes (18)
+
+class TestTrafficShapes:
+    @pytest.mark.slow
+    def test_every_shape_runs_clean_and_reproducibly(self):
+        from tigerbeetle_tpu.testing.chaos import TRAFFIC_SHAPES
+
+        for shape in TRAFFIC_SHAPES:
+            a = run_chaos_seed(9, windows=4, batches_per_window=2,
+                               events_per_batch=24, mesh_scenario=False,
+                               kinds=("dispatch_fail",), traffic=shape)
+            b = run_chaos_seed(9, windows=4, batches_per_window=2,
+                               events_per_batch=24, mesh_scenario=False,
+                               kinds=("dispatch_fail",), traffic=shape)
+            assert a == b, shape
+            assert a["traffic"] == shape
+
+    def test_shapes_generate_distinct_workloads(self):
+        from tigerbeetle_tpu.testing.chaos import TrafficShape
+
+        batches = {}
+        for shape in ("hot_skew", "pending_storm", "open_close_burst"):
+            s = TrafficShape(shape, seed=5, n_accounts=32, n_windows=4)
+            evs, _nid = s.batch(0, random.Random(0), 1_000, 24, [])
+            batches[shape] = [(e.debit_account_id, e.credit_account_id,
+                               int(e.flags)) for e in evs]
+        assert len({tuple(v) for v in batches.values()}) == 3
+
+
+# ------------------------------- admission x saturation (ISSUE 18 #2)
+
+class TestAdmissionSaturation:
+    @pytest.mark.slow
+    def test_saturated_pipeline_sheds_instead_of_timing_out(self):
+        """Offered load ~6x the pump's service capacity: the plane must
+        degrade into TYPED sheds (shed_line/deadline/no_credit) with
+        every ADMITTED request's queue wait inside its class deadline —
+        and the supervisor below must see zero dispatch_deadline
+        recoveries, because shedding (not per-request retry timeouts)
+        is how saturation is absorbed."""
+        from tigerbeetle_tpu.admission import (AdmissionClass,
+                                               AdmissionPlane,
+                                               ShedResult, VirtualClock)
+
+        clock = VirtualClock()
+        sup = ServingSupervisor(
+            a_cap=A_CAP, t_cap=1 << 11, epoch_interval=4,
+            retry=RetryPolicy(max_retries=2, base_delay_s=1e-4,
+                              max_delay_s=1e-3),
+            seed=11, sleep=lambda s: None)
+        classes = (
+            AdmissionClass("critical", 0, slo_ms=60.0, deadline_ms=240.0),
+            AdmissionClass("batch", 1, slo_ms=120.0, deadline_ms=240.0),
+        )
+        plane = AdmissionPlane(
+            sup, classes=classes, prepare_max=8, window_prepares=1,
+            max_windows_per_pump=1, session_credits=3, max_queue=64,
+            burn_window_ticks=4, burn_budget=0.25, cool_ticks=2,
+            clock=clock, seed=11)
+        plane.open_accounts([Account(id=i, ledger=1, code=1)
+                             for i in range(1, 9)], 1_000)
+        nid = 10 ** 5
+        reqs = []
+        for tick in range(15):
+            for sid in range(1, 13):  # 48 events offered vs 8 served
+                cls = "critical" if sid == 1 else "batch"
+                evs = [Transfer(id=nid + i, debit_account_id=1 + i % 7,
+                                credit_account_id=2 + i % 6, amount=1,
+                                ledger=1, code=1) for i in range(4)]
+                nid += 4
+                reqs.append(plane.submit(sid, evs, cls=cls))
+            plane.pump()
+            clock.advance(0.05)
+        plane.drain()
+        cons = plane.conservation()
+        assert cons["ok"] and cons["queued"] == 0
+        assert cons["shed"] > 0, "saturation produced no sheds"
+        for r in reqs:
+            assert r.state in ("admitted", "shed")
+            if r.state == "shed":
+                assert isinstance(r.shed, ShedResult), r.shed
+            else:
+                assert r.admit_wait_ms <= r.cls.deadline_ms + 1e-6
+        # The pipeline below never escalated a retry-deadline recovery:
+        # saturation was absorbed at the admission line, not burned in
+        # per-request retry budgets.
+        assert sup.last_recovery is None
+        assert sup.counters["recoveries"] == {}
+        assert sup.verify_epoch()
+        hist, _ = plane.oracle_history()
+        assert hist == sup.history
+        sup.led.shutdown_staging()
 
 
 @pytest.mark.slow

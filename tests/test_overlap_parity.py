@@ -409,3 +409,127 @@ def test_bitflip_recovery_drains_staged_without_commit():
     # replay changed nothing observable, and window 3's results come
     # from its REAL post-recovery dispatch, not the dead stage.
     assert hist_f == hist_c
+
+
+# --------------------------------------------------------------------------
+# Admission plane × staging (ISSUE 18, satellite 3): shedding decisions
+# landing mid-window must never leak into committed state — the admitted
+# history stays bit-exact vs an oracle replay of ONLY the admitted
+# requests, and a window that was STAGE-AHEAD-packed but shed before
+# submit never commits a single transfer.
+
+
+def _mk_admission_plane(**kw):
+    from tigerbeetle_tpu.admission import (
+        AdmissionClass, AdmissionPlane, VirtualClock)
+    from tigerbeetle_tpu.serving import ServingSupervisor
+
+    clock = VirtualClock()
+    sup = ServingSupervisor(a_cap=1 << 8, t_cap=1 << 11,
+                            epoch_interval=4, sleep=lambda s: None,
+                            seed=11)
+    classes = (
+        AdmissionClass("critical", 0, slo_ms=100.0, deadline_ms=400.0),
+        AdmissionClass("batch", 1, slo_ms=200.0, deadline_ms=800.0),
+    )
+    # prepare_max=4 with 2-event requests -> every window is >=2
+    # prepares, the pipelined route's staging-eligibility floor
+    # (DeviceLedger._window_plan requires len(evs) > 1).
+    args = dict(classes=classes, prepare_max=4, window_prepares=2,
+                session_credits=100, max_queue=256, clock=clock,
+                seed=11)
+    args.update(kw)
+    plane = AdmissionPlane(sup, **args)
+    plane.open_accounts(
+        [Account(id=i, ledger=1, code=1) for i in (1, 2)], 1_000)
+    return plane, sup, clock
+
+
+def _adm_evs(n, start):
+    return [Transfer(id=start + i, debit_account_id=1,
+                     credit_account_id=2, amount=1, ledger=1, code=1)
+            for i in range(n)]
+
+
+@slow
+def test_shed_mid_window_history_bit_exact():
+    """Overloaded plane with the shed line slamming shut mid-run: the
+    supervisor's committed history equals an oracle replay of exactly
+    the admitted requests, and no shed request's transfers ever reach
+    the committed mirror."""
+    plane, sup, clock = _mk_admission_plane(
+        stage_ahead=True, session_credits=1)
+    reqs, nid = [], 10**5
+    for t in range(8):
+        for sid in range(1, 5):
+            cls = "critical" if sid == 1 else "batch"
+            # Second submit in the same tick: typed no_credit shed.
+            reqs.append(plane.submit(sid, _adm_evs(2, nid), cls=cls))
+            reqs.append(
+                plane.submit(sid, _adm_evs(2, nid + 2), cls=cls))
+            nid += 4
+        if t == 4:
+            # The shed line slams shut mid-run: queued AND stage-ahead
+            # batch-class members shed as "shed_line".
+            plane.force_shed_level(1)
+        if t == 6:
+            plane.force_shed_level(None)
+        plane.pump()
+        clock.advance(0.05)
+    plane.drain()
+    cons = plane.conservation()
+    assert cons["ok"] and cons["queued"] == 0 and cons["staged"] == 0
+    shed = [r for r in reqs if r.state == "shed"]
+    admitted = [r for r in reqs if r.state == "admitted"]
+    assert shed and admitted
+    assert {r.shed.reason for r in shed} >= {"no_credit", "shed_line"}
+    # Bit-exactness under shedding: committed history == oracle replay
+    # of the admitted script alone.
+    hist, _oracle = plane.oracle_history()
+    assert hist == sup.history
+    assert sup.verify_epoch()
+    # Zero leakage: no shed transfer committed; every admitted one did.
+    shed_ids = {ev.id for r in shed for ev in r.transfers}
+    adm_ids = {ev.id for r in admitted for ev in r.transfers}
+    assert not shed_ids & set(sup.led.mirror.transfers)
+    assert adm_ids <= set(sup.led.mirror.transfers)
+    sup.led.shutdown_staging()
+
+
+@slow
+def test_staged_but_shed_window_never_commits():
+    """A stage-ahead window whose members are shed between prestage and
+    submit is abandoned: the staged pack is never dispatched, its
+    transfers appear in neither the mirror nor the verified epoch base,
+    and the pack itself dies with shutdown_staging — the same
+    never-committed guarantee the recovery drain gives a quarantined
+    stage."""
+    plane, sup, clock = _mk_admission_plane(stage_ahead=True)
+    nid = 2 * 10**5
+    for sid in range(1, 9):
+        plane.submit(sid, _adm_evs(2, nid), cls="batch")
+        nid += 2
+    # One pump: window 1 (8 events) dispatches, window 2 (8 events) is
+    # packed onto the ledger's background stager.
+    plane.pump()
+    clock.advance(0.02)
+    assert plane._staged_next is not None
+    staged_reqs = list(plane._staged_next[3])
+    staged_ids = {ev.id for r in staged_reqs for ev in r.transfers}
+    assert staged_ids
+    # Gate the batch class before the staged window submits: every
+    # staged member sheds as "shed_line"; the pack is never dispatched.
+    plane.force_shed_level(1)
+    plane.pump()
+    assert all(r.state == "shed" and r.shed.reason == "shed_line"
+               for r in staged_reqs)
+    plane.drain()
+    assert plane.conservation()["ok"]
+    hist, _oracle = plane.oracle_history()
+    assert hist == sup.history
+    assert sup.verify_epoch()
+    assert not staged_ids & set(sup.led.mirror.transfers)
+    assert not staged_ids & set(sup.epoch_base.transfers)
+    # The abandoned pack dies with the stager, never having committed.
+    sup.led.shutdown_staging()
+    assert sup.led._staged is None

@@ -647,3 +647,80 @@ def parity_config5(n_batches=6, batch=256):
             and host.account_events == sm.account_events)
 
 
+
+def bench_admission(rounds=24, sessions=100_000, reqs_per_round=96,
+                    seed=83):
+    """Sessionized-Zipfian admission bench (ISSUE 18): the admission
+    plane in front of a real ServingSupervisor under an offered load
+    ~2x the pump's window capacity, sessions drawn Zipfian-hot from a
+    `sessions`-deep population on a deterministic virtual clock.
+
+    The success metric of the serving path under overload is NOT raw
+    tps — it is SUSTAINED admitted tps plus per-class admitted
+    queue-wait p99 while lower classes shed explicitly. This returns
+    the ##admission record bench.py streams and devhub renders:
+    per-class admitted/shed-by-reason counts, the shed line reached,
+    queue/credit occupancy, conservation, and both virtual-sustained
+    and wall events/s."""
+    from .admission import AdmissionClass, AdmissionPlane, VirtualClock
+    from .serving import ServingSupervisor
+    from .trace import Tracer
+
+    n_accounts = 128
+    txns_per_req = 4
+    tick_s = 0.020
+    classes = (
+        AdmissionClass("critical", 0, slo_ms=100.0, deadline_ms=400.0),
+        AdmissionClass("standard", 1, slo_ms=200.0, deadline_ms=600.0),
+        AdmissionClass("batch", 2, slo_ms=300.0, deadline_ms=300.0),
+    )
+    tracer = Tracer(pid=0)
+    clock = VirtualClock()
+    sup = ServingSupervisor(a_cap=1 << 10, t_cap=1 << 15,
+                            epoch_interval=16, sleep=lambda s: None,
+                            seed=seed, tracer=tracer)
+    plane = AdmissionPlane(
+        sup, classes=classes, prepare_max=64, window_prepares=2,
+        max_windows_per_pump=2, session_credits=4, max_queue=4096,
+        burn_window_ticks=4, burn_budget=0.25, cool_ticks=4,
+        clock=clock, seed=seed, head_rate=0.05)
+    plane.open_accounts([Account(id=i, ledger=1, code=1)
+                         for i in range(1, n_accounts + 1)],
+                        n_accounts + 10)
+
+    from .utils.zipfian import ZipfianGenerator
+
+    zipf = ZipfianGenerator(sessions, theta=1.1, seed=seed)
+    rng = np.random.default_rng(seed)
+    next_id = 10 ** 6
+    t0 = time.perf_counter()
+    for _round in range(rounds):
+        for s in zipf.draw(reqs_per_round).tolist():
+            sid = int(s) + 1
+            m = sid % 10
+            cls = ("critical" if m == 0
+                   else "standard" if m <= 3 else "batch")
+            evs = []
+            for _ in range(txns_per_req):
+                dr = int(rng.integers(1, n_accounts + 1))
+                evs.append(Transfer(
+                    id=next_id, debit_account_id=dr,
+                    credit_account_id=dr % n_accounts + 1,
+                    amount=int(rng.integers(1, 100)), ledger=1, code=1))
+                next_id += 1
+            plane.submit(sid, evs, cls=cls)
+        plane.pump()
+        clock.advance(tick_s)
+    plane.drain()
+    wall_s = time.perf_counter() - t0
+    sup.led.shutdown_staging()
+    st = plane.stats()
+    st["session_population"] = sessions
+    st["rounds"] = rounds
+    st["offered_events_per_round"] = reqs_per_round * txns_per_req
+    st["sustained_admitted_eps_virtual"] = round(
+        st["events_admitted"] / (rounds * tick_s), 1)
+    st["admitted_eps_wall"] = round(
+        st["events_admitted"] / max(wall_s, 1e-9), 1)
+    st["wall_s"] = round(wall_s, 3)
+    return st

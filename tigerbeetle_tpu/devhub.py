@@ -370,6 +370,69 @@ def render(history_path: str, out_path: str,
               "<th>staging work ms</th><th>stall ms</th>"
               "<th>host stall fraction</th></tr>"
             + "".join(rows_st) + "</table>")
+    # Admission panel (ISSUE 18): the latest run's ##admission record —
+    # per-class admitted vs shed-by-reason under the sessionized
+    # Zipfian overload, the shed line reached, queue occupancy, and
+    # sustained ADMITTED events/s (the success metric under overload is
+    # admitted throughput + per-class admitted p99 while lower classes
+    # shed explicitly, not raw tps). RED badge when the top class shed
+    # for shed_line/deadline (the priority ladder regressed) or
+    # conservation broke (a silent drop — the one thing the plane
+    # promises never happens).
+    adm_html = ""
+    adm = next((e.get("admission") for e in reversed(entries)
+                if isinstance(e.get("admission"), dict)
+                and e.get("admission")), None)
+    if adm and isinstance(adm.get("classes"), dict):
+        by_prio = sorted(adm["classes"].items(),
+                         key=lambda kv: kv[1].get("priority", 0))
+        top_name, top_d = by_prio[0]
+        bad_top = sorted(r for r in (top_d.get("shed") or {})
+                         if r in ("shed_line", "deadline"))
+        cons = adm.get("conservation") or {}
+        bad_cons = not cons.get("ok", True)
+        rows_ad = []
+        for name, d in by_prio:
+            shed = d.get("shed") or {}
+            shed_txt = ", ".join(f"{k}={v}"
+                                 for k, v in sorted(shed.items())) or "-"
+            wait = d.get("admit_wait_ms") or {}
+            p99, slo = wait.get("p99"), d.get("slo_ms")
+            p99_txt = "-" if p99 is None else f"{p99:.1f}"
+            if p99 is not None and slo is not None and p99 > slo:
+                p99_txt = ('<span style="color:#c22;font-weight:600">'
+                           f"{p99:.1f}</span>")
+            rows_ad.append(
+                "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                "<td>{}</td><td>{}</td></tr>".format(
+                    html.escape(name), d.get("submitted", 0) or 0,
+                    d.get("admitted", 0) or 0, html.escape(shed_txt),
+                    p99_txt, "-" if slo is None else slo))
+        badge_ad = ""
+        if bad_top or bad_cons:
+            why = []
+            if bad_top:
+                why.append(f"top class '{top_name}' shed for {bad_top}")
+            if bad_cons:
+                why.append("conservation broke (silent drop)")
+            badge_ad = ('<p style="color:#c22;font-weight:700">'
+                        'ADMISSION RED: '
+                        + html.escape("; ".join(why)) + "</p>")
+        q = adm.get("queue") or {}
+        adm_html = (
+            "<h2>admission plane (latest run)</h2>" + badge_ad
+            + "<p>shed level {} &middot; queue occupancy {} &middot; "
+              "sustained {} admitted events/s virtual ({} wall) &middot; "
+              "{} live sessions of a {} population</p>".format(
+                  adm.get("shed_level", "-"), q.get("occupancy", "-"),
+                  adm.get("sustained_admitted_eps_virtual", "-"),
+                  adm.get("admitted_eps_wall", "-"),
+                  adm.get("sessions", "-"),
+                  adm.get("session_population", "-"))
+            + "<table><tr><th>class</th><th>submitted</th>"
+              "<th>admitted</th><th>shed by reason</th>"
+              "<th>admitted p99 ms</th><th>slo ms</th></tr>"
+            + "".join(rows_ad) + "</table>")
     # Op-budget table (next to the fallback diagnostics): the newest
     # run's heavy-op census per kernel tier vs the committed gate
     # ceilings (the NEWEST perf/opbudget_r*.json — resolved, not
@@ -789,6 +852,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {rec_html}
 {route_html}
 {stage_html}
+{adm_html}
 {ob_html}
 {st_html}
 {sh_html}

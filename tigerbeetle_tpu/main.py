@@ -739,7 +739,7 @@ def cmd_cfo(args) -> int:
     import time as _time
 
     from .testing import fuzz
-    from .testing.chaos import run_chaos_seed
+    from .testing.chaos import TRAFFIC_SHAPES, run_chaos_seed
     from .testing.vopr import run_swarm_seed
 
     if args.kind == "chaos" and args.seed is not None and not args.max_runs:
@@ -767,13 +767,25 @@ def cmd_cfo(args) -> int:
                         else "chaos" if roll < (1 / 2) else "fuzz")
             seed = (args.seed if args.seed is not None
                     and args.max_runs == 1 else rng.randrange(1 << 30))
+            # Chaos traffic shape: explicit --traffic pins it; the
+            # random stream interleaves the adversarial shapes with the
+            # uniform workload about half the time (seed-deterministic).
+            traffic = None
+            if kind == "chaos":
+                if getattr(args, "traffic", None):
+                    traffic = args.traffic
+                elif args.seed is None or args.max_runs != 1:
+                    traffic = rng.choice((None, None, None)
+                                         + TRAFFIC_SHAPES)
             name = kind if kind != "fuzz" else rng.choice(names)
-            key = kind if kind != "fuzz" else f"fuzz:{name}"
+            if kind == "chaos" and traffic:
+                name = f"chaos:{traffic}"
+            key = f"fuzz:{name}" if kind == "fuzz" else name
             try:
                 if kind == "vopr":
                     run_swarm_seed(seed)
                 elif kind == "chaos":
-                    run_chaos_seed(seed)
+                    run_chaos_seed(seed, traffic=traffic)
                 else:
                     fuzz.run(name, seed)
                 runs += 1
@@ -786,7 +798,9 @@ def cmd_cfo(args) -> int:
                     f"python -m tigerbeetle_tpu cfo --kind vopr "
                     f"--seed {seed} --max-runs 1" if kind == "vopr"
                     else f"python -m tigerbeetle_tpu cfo --kind chaos "
-                    f"--seed {seed}" if kind == "chaos"
+                    f"--seed {seed}"
+                    + (f" --traffic {traffic}" if traffic else "")
+                    if kind == "chaos"
                     else f"python -m tigerbeetle_tpu fuzz {name} {seed}")
                 failing.append({"kind": kind, "name": name, "seed": seed,
                                 "error": repr(e)[:300],
@@ -993,6 +1007,13 @@ def main(argv=None) -> int:
     p.add_argument("--seed", type=int, default=None,
                    help="deterministic selection; with --max-runs 1 the "
                         "seed IS the run seed (reproduction)")
+    p.add_argument("--traffic", default=None,
+                   choices=["hot_skew", "pending_storm",
+                            "open_close_burst"],
+                   help="pin a named adversarial traffic shape for "
+                        "chaos runs (testing/chaos.py TrafficShape); "
+                        "default: the random stream interleaves shapes "
+                        "with the uniform workload")
     p.set_defaults(fn=cmd_cfo)
 
     p = sub.add_parser("clients")

@@ -1278,6 +1278,23 @@ class DeviceLedger:
             self.tracer.gauge(Event.host_stall_fraction,
                               round(st["stall_ms"] / st["work_ms"], 6))
 
+    def staged_matches(self, evs: list[dict],
+                       timestamps: list[int]) -> bool:
+        """True when the currently staged pack is EXACTLY this window
+        (prepare-dict identity + timestamps, the same test
+        _consume_staged applies). The admission plane's stage-ahead
+        path asks this before the supervisor would re-stage a window
+        the plane already put on the stager — re-staging would replace
+        the in-flight pack and turn the overlap into a synchronous
+        wait."""
+        staged = self._staged
+        if staged is None:
+            return False
+        s_evs, s_tss = staged[0], staged[1]
+        return (len(s_evs) == len(evs)
+                and all(a is b for a, b in zip(s_evs, evs))
+                and s_tss == [int(t) for t in timestamps])
+
     def staging_summary(self) -> dict:
         """The fallback_stats()["staging"] record: windows through the
         pipelined submit path, how many consumed a staged pack, and the

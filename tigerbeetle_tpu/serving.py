@@ -44,6 +44,7 @@ injection harness lives in testing/chaos.py and runs as
 from __future__ import annotations
 
 import copy
+import dataclasses
 import random
 import time
 from dataclasses import dataclass
@@ -92,6 +93,17 @@ class RetryPolicy:
                    self.base_delay_s * (2.0 ** max(0, attempt - 1)))
         return base * (1.0 + self.jitter * rng.random())
 
+    def clamped(self, deadline_s: float | None) -> "RetryPolicy":
+        """This policy with deadline_s tightened to a caller's remaining
+        admission budget — never loosened. The admission plane threads a
+        request's remaining deadline through the window dispatch so the
+        whole retry sequence (attempts + backoff sleeps) is bounded by
+        the budget the request was admitted under, instead of the
+        policy's static per-window deadline."""
+        if deadline_s is None or deadline_s >= self.deadline_s:
+            return self
+        return dataclasses.replace(self, deadline_s=max(0.0, deadline_s))
+
 
 # Structural faults while consuming device-produced bytes (the drain
 # materializes fetched delta chunks into the mirror): an unknown
@@ -128,11 +140,16 @@ def call_with_retries(fn, policy: RetryPolicy, rng: random.Random,
                 raise RecoveryNeeded(
                     "dispatch_exhausted",
                     f"{attempt} attempts: {e!r}") from e
-            if clock() - t0 > policy.deadline_s:
+            remaining = policy.deadline_s - (clock() - t0)
+            if remaining <= 0:
                 raise RecoveryNeeded(
                     "dispatch_deadline",
                     f"deadline {policy.deadline_s}s: {e!r}") from e
-            delay = policy.delay_s(attempt, rng)
+            # The backoff sleep itself is capped by the remaining
+            # deadline budget: under saturation, exponential backoff
+            # must not stack the attempt sequence past the deadline the
+            # caller (per-window or admission) is holding the line on.
+            delay = min(policy.delay_s(attempt, rng), remaining)
             counters["backoff_s"] = round(
                 counters["backoff_s"] + delay, 6)
             sleep(delay)
@@ -221,7 +238,8 @@ class ServingSupervisor:
         return res
 
     def create_transfers_window(self, batches: list, timestamps: list,
-                                trace_ctxs: list | None = None):
+                                trace_ctxs: list | None = None,
+                                deadline_s: float | None = None):
         """Submit one commit window: `batches` is a list of Transfer
         object lists, `timestamps` the per-prepare commit timestamps.
         Returns the ledger's per-prepare (status u32[n], ts u64[n])
@@ -254,7 +272,8 @@ class ServingSupervisor:
                               ctx=ctxs[0] if ctxs else None) as sp:
             for tid in trace_ids:
                 sp.link(tid)
-            out = self._dispatch(thunk, what="window", win=win)
+            out = self._dispatch(thunk, what="window", win=win,
+                                 deadline_s=deadline_s)
             # The route the ledger actually took (chain is the default
             # whole-window scan dispatch) — counted into the trace
             # catalog so route regressions are visible next to
@@ -287,7 +306,9 @@ class ServingSupervisor:
     # ------------------------------------------------- overlapped serving
 
     def submit_transfers_window(self, batches: list, timestamps: list,
-                                trace_ctxs: list | None = None) -> int:
+                                trace_ctxs: list | None = None,
+                                deadline_s: float | None = None,
+                                evs: list | None = None) -> int:
         """The overlapped serving hot loop's submit half: stage window
         k's stacked operands on the ledger's background stager FIRST,
         resolve the oldest in-flight window when the pipeline is at
@@ -312,18 +333,25 @@ class ServingSupervisor:
         ctxs = [c for c in (trace_ctxs or ()) if c is not None]
         trace_ids = [fmt_trace_id(c.trace_id) for c in ctxs]
         self._epoch_trace_ids.extend(trace_ids)
-        evs = [transfers_to_arrays(b) for b in batches]
-        self.led.stage_window(evs, timestamps)
+        # `evs` lets the admission plane pass the SAME array dicts it
+        # already staged ahead (DeviceLedger.stage_window matches on
+        # prepare-dict identity) — re-staging here would replace the
+        # in-flight pack and forfeit the overlap.
+        if evs is None:
+            evs = [transfers_to_arrays(b) for b in batches]
+        if not self.led.staged_matches(evs, timestamps):
+            self.led.stage_window(evs, timestamps)
         if len(self._pending) >= self.pipeline_depth:
             self.resolve_transfers_windows(count=1)
         t0 = self.tracer.now_ns()
         ticket = self._dispatch(
             lambda: self.led.submit_window(evs, timestamps),
-            what="window_submit", win=win)
+            what="window_submit", win=win, deadline_s=deadline_s)
         rec = {"hist_idx": len(self.history), "win": win,
                "ticket": ticket, "t0_ns": t0, "trace_ids": trace_ids,
                "route": self.led.last_window_route,
-               "tier": self.led.last_window_tier, "results": None}
+               "tier": self.led.last_window_tier, "results": None,
+               "deadline_s": deadline_s}
         if ticket is None:
             # Ineligible for the pipeline: the synchronous window path
             # (which itself resolves everything in flight first, so
@@ -331,7 +359,7 @@ class ServingSupervisor:
             out = self._dispatch(
                 lambda: self.led.create_transfers_window(evs,
                                                          timestamps),
-                what="window", win=win)
+                what="window", win=win, deadline_s=deadline_s)
             rec["route"] = self.led.last_window_route
             rec["tier"] = self.led.last_window_tier
             rec["results"] = [
@@ -379,7 +407,8 @@ class ServingSupervisor:
                     and tk.results is None:
                 self._dispatch(
                     lambda: self.led.resolve_windows(count=1),
-                    what="window_resolve", win=rec["win"])
+                    what="window_resolve", win=rec["win"],
+                    deadline_s=rec.get("deadline_s"))
                 tk = rec["ticket"]  # a recovery replaces it with None
             self._pending.pop(0)
             if rec["results"] is None:
@@ -421,9 +450,11 @@ class ServingSupervisor:
         self.history.append(n)
         return n
 
-    def _dispatch(self, thunk, *, what: str = "", win: int | None = None):
+    def _dispatch(self, thunk, *, what: str = "", win: int | None = None,
+                  deadline_s: float | None = None):
         hook = self.fault_hook
         idx = self.windows_total if win is None else win
+        policy = self.retry.clamped(deadline_s)
 
         def run():
             if hook is not None:
@@ -432,7 +463,7 @@ class ServingSupervisor:
 
         try:
             with self.tracer.span(Event.serving_dispatch, what=what):
-                return call_with_retries(run, self.retry, self.rng,
+                return call_with_retries(run, policy, self.rng,
                                          self.counters, sleep=self._sleep,
                                          tracer=self.tracer)
         except RecoveryNeeded as e:

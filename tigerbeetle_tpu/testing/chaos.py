@@ -281,10 +281,101 @@ def _chaos_workload(rng: random.Random, n_accounts: int, next_id: int,
     return events, next_id
 
 
+# ------------------------------------------------- adversarial traffic
+
+TRAFFIC_SHAPES = ("hot_skew", "pending_storm", "open_close_burst")
+
+
+class TrafficShape:
+    """Named adversarial traffic generator (ISSUE 18): a seeded,
+    reproducible workload SHAPE that replaces the uniform chaos
+    workload while the FaultPlan keeps injecting its fault classes
+    around it — shapes and faults interleave, they do not exclude each
+    other. Built on utils/zipfian.py (the reference's
+    stdx.ZipfianGenerator):
+
+    - ``hot_skew``: every debit/credit account drawn from a Zipfian
+      with s=1.2 — a handful of accounts absorb almost all contention
+      (the AT2 hot-account adversary).
+    - ``pending_storm``: two-phase storm — the first half of the run
+      floods two-phase PENDING transfers (growing the pending set),
+      the second half bursts post/void resolutions of that backlog.
+    - ``open_close_burst``: bursty open/close cycles — even windows
+      open pendings in bulk, odd windows immediately post/void what
+      the previous window opened.
+    """
+
+    def __init__(self, name: str, seed: int, n_accounts: int,
+                 n_windows: int):
+        from ..utils.zipfian import ZipfianGenerator
+
+        assert name in TRAFFIC_SHAPES, name
+        self.name = name
+        self.n_accounts = n_accounts
+        self.n_windows = max(1, n_windows)
+        theta = 1.2 if name == "hot_skew" else 0.99
+        self.zipf = ZipfianGenerator(n_accounts, theta=theta,
+                                     seed=(seed * 0x9E3779B1) ^ 0x7A1F)
+
+    def _pair(self):
+        dr, cr = (int(v) + 1 for v in self.zipf.draw(2))
+        if cr == dr:
+            cr = dr % self.n_accounts + 1
+        return dr, cr
+
+    def batch(self, w: int, rng: random.Random, next_id: int,
+              n_events: int, open_pendings: list):
+        """One prepare's events under this shape (same contract as
+        _chaos_workload). `w` is the window index — the storm/burst
+        shapes phase on it."""
+        F = TransferFlags
+        events = []
+        for _ in range(n_events):
+            tid = next_id
+            next_id += 1
+            dr, cr = self._pair()
+            if self.name == "hot_skew":
+                kind = "pend" if rng.random() < 0.10 else "plain"
+            elif self.name == "pending_storm":
+                flood = w < self.n_windows // 2
+                if flood:
+                    kind = "pend" if rng.random() < 0.85 else "plain"
+                else:
+                    kind = "resolve" if (open_pendings
+                                         and rng.random() < 0.85) \
+                        else "plain"
+            else:  # open_close_burst
+                if w % 2 == 0:
+                    kind = "pend"
+                else:
+                    kind = "resolve" if open_pendings else "plain"
+            if kind == "pend":
+                events.append(Transfer(
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=rng.randrange(1, 1000), ledger=1, code=1,
+                    flags=int(F.pending), timeout=3600))
+                open_pendings.append(tid)
+            elif kind == "resolve":
+                pid = open_pendings.pop(0)
+                post = rng.random() < 0.6
+                events.append(Transfer(
+                    id=tid, pending_id=pid,
+                    amount=(1 << 128) - 1 if post else 0, ledger=1,
+                    code=1,
+                    flags=int(F.post_pending_transfer if post
+                              else F.void_pending_transfer)))
+            else:
+                events.append(Transfer(
+                    id=tid, debit_account_id=dr, credit_account_id=cr,
+                    amount=rng.randrange(1, 1000), ledger=1, code=1))
+        return events, next_id
+
+
 def run_chaos_seed(seed: int, *, windows: int = 8,
                    batches_per_window: int = 2, events_per_batch: int = 48,
                    kinds=FAULT_KINDS, epoch_interval: int | None = None,
                    mesh_scenario: bool | None = None,
+                   traffic: str | None = None,
                    tracer=None) -> dict:
     """One seed-deterministic audited chaos run against the serving
     supervisor. Raises on ANY silent corruption (the run must either
@@ -306,7 +397,7 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
     try:
         summary = _run_supervisor_chaos(
             seed, rng, windows, batches_per_window, events_per_batch,
-            kinds, epoch_interval, tracer)
+            kinds, epoch_interval, tracer, traffic=traffic)
         if mesh_scenario:
             summary["shard_loss"] = shard_loss_scenario(seed)
             summary["shard_resync"] = shard_resync_scenario(seed)
@@ -321,8 +412,10 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
 
 def _run_supervisor_chaos(seed, rng, windows, batches_per_window,
                           events_per_batch, kinds, epoch_interval,
-                          tracer=None) -> dict:
+                          tracer=None, traffic: str | None = None) -> dict:
     n_accounts = 16
+    shape = (TrafficShape(traffic, seed, n_accounts, windows)
+             if traffic else None)
     sup = ServingSupervisor(
         a_cap=1 << 9, t_cap=1 << 12, epoch_interval=epoch_interval,
         retry=RetryPolicy(max_retries=2, base_delay_s=1e-3,
@@ -346,8 +439,13 @@ def _run_supervisor_chaos(seed, rng, windows, batches_per_window,
         plan.apply_pre(sup, w)
         batches, tss = [], []
         for _ in range(batches_per_window):
-            events, next_id = _chaos_workload(
-                rng, n_accounts, next_id, events_per_batch, open_pendings)
+            if shape is not None:
+                events, next_id = shape.batch(
+                    w, rng, next_id, events_per_batch, open_pendings)
+            else:
+                events, next_id = _chaos_workload(
+                    rng, n_accounts, next_id, events_per_batch,
+                    open_pendings)
             ts += len(events) + 10
             batches.append(events)
             tss.append(ts)
@@ -384,6 +482,7 @@ def _run_supervisor_chaos(seed, rng, windows, batches_per_window,
          f"injected but zero recoveries — silent corruption")
     return dict(seed=seed, windows=windows,
                 epoch_interval=epoch_interval,
+                traffic=traffic,
                 faults=plan.summary(),
                 recoveries=dict(sup.counters["recoveries"]),
                 retries=sup.counters["retries"],

@@ -441,6 +441,56 @@ def _scenario_overlap(col: _Collector) -> None:
     led.shutdown_staging()
 
 
+def _scenario_admission(col: _Collector) -> None:
+    """ISSUE 18's admission plane: a tiny seeded overload in front of a
+    real supervisor emits the full admission catalog — an
+    admission_decision span for BOTH outcomes (admit, and a typed
+    ShedResult with a tail-kept ``shed:<reason>`` trace), the
+    admission_shed counter, and the per-tick credit-occupancy gauge —
+    covering the fast-reject (no_credit) and forced shed-line paths."""
+    from ..admission import AdmissionClass, AdmissionPlane, ShedResult, \
+        VirtualClock
+    from ..serving import ServingSupervisor
+    from ..types import Account, Transfer
+
+    tracer = col.make(0)
+    clock = VirtualClock()
+    sup = ServingSupervisor(a_cap=1 << 8, t_cap=1 << 11,
+                            epoch_interval=8, sleep=lambda s: None,
+                            seed=7, tracer=tracer)
+    classes = (AdmissionClass("critical", 0, slo_ms=100.0,
+                              deadline_ms=400.0),
+               AdmissionClass("batch", 1, slo_ms=200.0,
+                              deadline_ms=800.0))
+    plane = AdmissionPlane(sup, classes=classes, prepare_max=8,
+                           window_prepares=1, session_credits=2,
+                           max_queue=64, clock=clock, seed=7)
+    plane.open_accounts([Account(id=i, ledger=1, code=1)
+                         for i in (1, 2)], 1_000)
+    plane.force_shed_level(1)  # gate the batch class -> shed_line
+    nid, reqs = 1, []
+    for _round in range(4):
+        for sid, cls in ((1, "critical"), (1, "critical"),
+                         (1, "critical"), (2, "batch")):
+            evs = [Transfer(id=nid + i, debit_account_id=1,
+                            credit_account_id=2, amount=1, ledger=1,
+                            code=1) for i in range(2)]
+            nid += 2
+            reqs.append(plane.submit(sid, evs, cls=cls))
+        plane.pump()
+        clock.advance(0.02)
+    plane.drain()
+    sup.led.shutdown_staging()
+    sheds = [r for r in reqs if r.state == "shed"]
+    admits = [r for r in reqs if r.state == "admitted"]
+    assert admits and sheds, (len(admits), len(sheds))
+    assert all(isinstance(r.shed, ShedResult) for r in sheds)
+    assert {r.shed.reason for r in sheds} >= {"no_credit", "shed_line"}
+    assert all(tracer.kept_traces.get(r.shed.trace_id, "")
+               .startswith("shed:") for r in sheds)
+    assert plane.conservation()["ok"], plane.conservation()
+
+
 def _scenario_slo(col: _Collector) -> None:
     """The SLO engine against the COMMITTED perf/slo.json: objectives
     must load (every referenced event on-catalog — a dead SLO is a red
@@ -463,6 +513,12 @@ def _scenario_slo(col: _Collector) -> None:
             sp.tags["tier"] = tier
     with tracer.span(Ev.serving_dispatch, what="window"):
         pass
+    # Per-class admitted queue-wait samples for the admission
+    # objectives (the shed-aware plane's committed p99 budgets).
+    for cls_name in ("critical", "standard"):
+        with tracer.span(Ev.admission_decision) as sp:
+            sp.tags["decision"] = "admit"
+            sp.tags["cls"] = cls_name
     tracer.observe(Ev.serving_replay_windows, 2)
     # The exchange-headroom objective reads the device-telemetry plane's
     # occupancy observations (both psum phases of the fused route).
@@ -530,6 +586,7 @@ SCENARIOS = (
     _scenario_router,
     _scenario_partitioned,
     _scenario_overlap,
+    _scenario_admission,
     _scenario_slo,
     _scenario_causal_trace,
 )

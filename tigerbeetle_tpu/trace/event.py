@@ -200,6 +200,27 @@ class Event(enum.Enum):
     flight_recorder_dump = _counter(
         "flight-recorder artifacts dumped for post-mortem", "reason")
 
+    # ------------------------------------------------------ admission plane
+    # ISSUE 18: session ingress + SLO-driven load shedding in front of
+    # the serving supervisor (tigerbeetle_tpu/admission.py). `decision`
+    # is admit|shed; `cls` is the priority class (critical/standard/
+    # batch by default); `reason` is the shed cause (no_credit,
+    # queue_full, shed_line, deadline, drain) and is omitted on admits.
+    # The span duration is the request's QUEUE WAIT (enqueue to window
+    # dispatch for admits, enqueue to rejection for sheds) on the
+    # plane's clock — the per-class admitted-latency distributions the
+    # SLO engine's admission objectives read.
+    admission_decision = _span(
+        "one admission decision: request enqueue to window dispatch "
+        "(admit) or to typed ShedResult (shed); duration = queue wait "
+        "on the plane clock", "decision", "cls", "reason",
+        hist_tags=("decision", "cls"))
+    admission_shed = _counter(
+        "requests rejected with a typed ShedResult", "cls", "reason")
+    admission_credit_occupancy = _gauge(
+        "admission queue occupancy, 0..1 of the plane's bounded queue "
+        "capacity (sampled once per pump tick)")
+
     # -------------------------------------------------- causal tracing
     # ISSUE 15: per-request spans.  These carry a propagated trace
     # context (trace_id/span_id/parent_id recorded as span args), so

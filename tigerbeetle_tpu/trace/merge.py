@@ -125,9 +125,10 @@ def span_quantile(doc: dict, name: str, q: float,
 # retried attempts, visible as dispatch wall time beyond the window's
 # own execute share.
 CRITICAL_PATH_STAGES = (
-    "commit_prefetch", "commit_execute", "commit_compact",
-    "commit_checkpoint", "journal_write", "serving_dispatch",
-    "serving_epoch_verify", "serving_recovery_replay",
+    "admission_decision", "commit_prefetch", "commit_execute",
+    "commit_compact", "commit_checkpoint", "journal_write",
+    "serving_dispatch", "serving_epoch_verify",
+    "serving_recovery_replay",
 )
 
 
