@@ -40,7 +40,13 @@ a seeded pipelined serving run's host_stall_fraction strictly under the
 committed STALL_CEILING with every eligible window staged ahead, the
 forced-sync negative measuring exactly 1.0 and failing the predicate,
 and bit-exact history parity overlapped vs sync on the chain and fused
-partitioned-chain routes; skip with --no-overlap), the TELEMETRY leg
+partitioned-chain routes; skip with --no-overlap), the RESHARD leg
+(testing/reshard_smoke.py: crash-safe live resharding — a seeded
+split+migrate+merge_back completes under live traffic on mesh-2 and
+mesh-8 with the src==dst range-digest witness at every flip, zero
+aborts/host fallbacks and bit-exact history vs a never-resharded
+oracle, plus the corrupted-copy negative that must abort PRE-FLIP
+with a flight artifact; skip with --no-reshard), the TELEMETRY leg
 (testing/telemetry_smoke.py: the device-telemetry plane of the fused
 route — harvested per-prepare block decoded bit-exact vs a host
 recomputation on 1/2/8-device meshes, telemetry-lane census vs the
@@ -300,6 +306,40 @@ def run_overlap(timeout: int = 900) -> int:
     return rc
 
 
+def run_reshard(timeout: int = 900) -> int:
+    """Reshard leg: crash-safe live resharding proven LIVE
+    (testing/reshard_smoke.py, 8-device virtual mesh) — a seeded
+    split + migrate + merge_back completes under live traffic on a
+    mesh-2 AND a mesh-8 sub-mesh with the src==dst range-digest
+    witness at every flip, zero aborts, zero host fallbacks, and the
+    history bit-exact vs a never-resharded oracle; the negative arm
+    (an injected copy corruption) must abort PRE-FLIP with a
+    FLIGHT_*_reshard_* artifact — a flip that goes through despite
+    the corruption is a RED. Skip with --no-reshard."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import reshard_smoke as s; "
+           "s.reshard_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] reshard: live split+migrate+merge_back with digest "
+          "witness + corrupted-copy negative "
+          "(testing/reshard_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: reshard timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] reshard rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_overload(timeout: int = 900) -> int:
     """Overload leg: the admission plane's SLO-driven load shedding
     proven LIVE (testing/overload_smoke.py) — a seeded 100k-session
@@ -375,6 +415,11 @@ def run_trace_coverage(timeout: int = 900) -> int:
            "from tigerbeetle_tpu.testing import trace_coverage; "
            "sys.exit(trace_coverage.coverage_main())"]
     env = dict(os.environ, JAX_PLATFORMS="cpu")
+    # The reshard scenario drives a 2-shard migration; the virtual
+    # mesh makes the leg's shard scenarios real multi-device.
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
     env.pop("PALLAS_AXON_POOL_IPS", None)
     print("[gate] trace-cov: catalog coverage "
           "(testing/trace_coverage.py)", flush=True)
@@ -548,6 +593,11 @@ def main() -> int:
     ap.add_argument("--no-overlap", action="store_true",
                     help="skip the overlap leg (double-buffered window "
                          "staging stall ceiling + forced-sync negative)")
+    ap.add_argument("--no-reshard", action="store_true",
+                    help="skip the live-resharding leg (seeded "
+                         "split+migrate+merge_back under traffic + "
+                         "corrupted-copy negative, "
+                         "testing/reshard_smoke.py)")
     ap.add_argument("--no-overload", action="store_true",
                     help="skip the overload leg (admission-plane "
                          "Zipfian shed/SLO proof + no-shed negative)")
@@ -600,6 +650,10 @@ def main() -> int:
         rc = run_overlap()
         if rc != 0:
             reds.append(f"overlap rc={rc}")
+    if not args.no_reshard:
+        rc = run_reshard()
+        if rc != 0:
+            reds.append(f"reshard rc={rc}")
     if not args.no_overload:
         rc = run_overload()
         if rc != 0:

@@ -533,3 +533,64 @@ def test_staged_but_shed_window_never_commits():
     # The abandoned pack dies with the stager, never having committed.
     sup.led.shutdown_staging()
     assert sup.led._staged is None
+
+
+# --------------------------------------------------------------------------
+# Elastic shards (ISSUE 19, satellite fix): quarantine/resync × staging.
+
+
+def test_resync_tears_down_staging_first(tmp_path, monkeypatch):
+    """A pack staged under the pre-quarantine ownership map must die
+    with the resync: `PartitionedRouter.resync` shuts the attached
+    ledger's staging down BEFORE rebuilding, so the stale pack — whose
+    route and pad bucket would still match by identity — can never be
+    consumed against the rebuilt state."""
+    import jax
+    from jax.sharding import Mesh
+
+    from tigerbeetle_tpu.oracle import StateMachineOracle
+    from tigerbeetle_tpu.parallel.partitioned import PartitionedRouter
+
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >= 2 devices")
+    monkeypatch.setenv("TB_TPU_FLIGHT_DIR", str(tmp_path))
+    mesh = Mesh(np.array(jax.devices()[:2]), ("batch",))
+    orc = StateMachineOracle()
+    orc.create_accounts(
+        [Account(id=i, ledger=1, code=1) for i in range(1, 17)], 50)
+    router = PartitionedRouter(mesh, a_cap=1 << 8, t_cap=1 << 10)
+    led = DeviceLedger(a_cap=1 << 8, t_cap=1 << 10)
+    led.attach_partitioned(router, router.from_oracle(orc))
+
+    nid, ts = 10**6, 10**9
+    batches, tss = [], []
+    for _b in range(2):   # W >= 2: the staging-eligibility floor
+        batches.append(
+            [Transfer(id=nid + i, debit_account_id=i % 16 + 1,
+                      credit_account_id=(i + 1) % 16 + 1, amount=1,
+                      ledger=1, code=1) for i in range(8)])
+        nid += 8
+        ts += 100
+        tss.append(ts)
+    evs = [transfers_to_arrays(b) for b in batches]
+    assert led.stage_window(evs, tss)
+    assert led._staged is not None and led._stager is not None
+
+    # Quarantine: the router refuses to serve a lost range...
+    router.drop_device(mesh.devices.flat[0])
+    with pytest.raises(RuntimeError):
+        led.create_transfers_window(evs, tss)
+    # ...and the resync rebuild tears the stale stage down first.
+    state = router.resync(orc)
+    assert led._staged is None and led._stager is None
+    assert router.shard_resyncs == 1 and not router.lost_devices
+    # Serving resumes cleanly on the rebuilt state: the same window
+    # re-packs inline (no stage to hit) and commits with oracle parity.
+    led._part_state = state
+    out = led.create_transfers_window(evs, tss)
+    got = [[(int(t), int(s)) for s, t in zip(st.tolist(), ts_.tolist())]
+           for st, ts_ in out]
+    want = [[(r.timestamp, int(r.status))
+             for r in orc.create_transfers(b, t)]
+            for b, t in zip(batches, tss)]
+    assert got == want

@@ -1,0 +1,341 @@
+"""Elastic shards (ISSUE 19): the pure control-plane pieces of
+crash-safe live resharding — host/device bit-identity of the ownership
+hash and overlay routing at range edges, ownership-table validation,
+plan membership, conflict detection, and the hot-range detector's
+verdicts (including the degenerate single-hot-account case). The
+staged protocol itself is exercised end to end by reshard_smoke, the
+chaos scenario, and the supervisor integration tests."""
+
+from functools import partial
+from types import SimpleNamespace
+
+import jax
+import numpy as np
+import pytest
+
+from tigerbeetle_tpu.jaxhound import core as jh_core
+from tigerbeetle_tpu.jaxhound import determinism
+from tigerbeetle_tpu.parallel import shard_utils as su
+from tigerbeetle_tpu.parallel.resharding import (
+    HotRangeDetector, ReshardController, ReshardPlan)
+
+U64MAX = (1 << 64) - 1
+U128MAX = (1 << 128) - 1
+
+# 128-bit ids at the limb boundaries: 0, the u64 edge (lo saturated,
+# hi empty), the first hi-only id, the top of the id space.
+EDGE_IDS = [0, 1, 2, U64MAX - 1, U64MAX, U64MAX + 1, (1 << 127),
+            (1 << 127) + 1, U128MAX - 1, U128MAX]
+
+
+def _split(ids):
+    hi = np.array([(i >> 64) & U64MAX for i in ids], dtype=np.uint64)
+    lo = np.array([i & U64MAX for i in ids], dtype=np.uint64)
+    return hi, lo
+
+
+def _fuzz_ids(seed, n=256):
+    rng = np.random.default_rng(seed)
+    hi = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+    lo = rng.integers(0, 1 << 64, n, dtype=np.uint64)
+    return [int(h) << 64 | int(l) for h, l in zip(hi, lo)]
+
+
+# ------------------------------------------------- hash bit-identity
+
+
+def test_shard_hash_host_device_identity():
+    """`shard_of_int` (host python-int path: oracle partitioning,
+    digest packs, range membership) and `shard_of_id` (traced device
+    path: routing kernels) must agree bit-for-bit on every id — the
+    whole resharding protocol hangs on the two views never skewing."""
+    ids = EDGE_IDS + _fuzz_ids(7)
+    hi, lo = _split(ids)
+    h_dev = np.asarray(jax.jit(su.mix_id)(hi, lo))
+    for i, want in zip(ids, h_dev.tolist()):
+        assert su.mix_int(i) == want, hex(i)
+    for n_shards in (1, 2, 8):
+        dev = np.asarray(jax.jit(
+            partial(su.shard_of_id, n_shards=n_shards))(hi, lo))
+        for i, got in zip(ids, dev.tolist()):
+            assert su.shard_of_int(i, n_shards) == got, \
+                (hex(i), n_shards)
+
+
+def test_overlay_bit_identity_all_modes():
+    """Jitted `owner_read`/`writes_here` vs host `owner_read_int`/
+    `write_owners_int` under a three-entry overlay covering all three
+    modes, with entry bounds placed EXACTLY on sampled hashes so the
+    inclusive lo/hi edges are exercised, not just interior points."""
+    n_shards = 8
+    ids = EDGE_IDS + _fuzz_ids(11)
+    hs = sorted(su.mix_int(i) for i in ids)
+    # Bounds at actual sampled hashes: ids landing exactly on lo/hi.
+    entries = (
+        (hs[5], hs[60], 0, 1, su.OVERLAY_DOUBLE_WRITE),
+        (hs[20], hs[120], 1, 2, su.OVERLAY_MIGRATED),
+        (hs[80], hs[240], 2, 3, su.OVERLAY_RETURNING),
+    )
+    hi, lo = _split(ids)
+    own_dev = np.asarray(jax.jit(
+        lambda kh, kl: su.owner_read(kh, kl, n_shards, entries))(
+            hi, lo))
+    for i, got in zip(ids, own_dev.tolist()):
+        assert su.owner_read_int(i, n_shards, entries) == got, hex(i)
+    for me in range(n_shards):
+        w_dev = np.asarray(jax.jit(
+            lambda kh, kl, m=me: su.writes_here(
+                kh, kl, n_shards, np.int32(m), entries))(hi, lo))
+        for i, got in zip(ids, w_dev.tolist()):
+            want = me in su.write_owners_int(i, n_shards, entries)
+            assert bool(got) == want, (hex(i), me)
+    # Semantics spot-checks on one in-range id per mode.
+    for (elo, ehi, src, dst, mode) in entries:
+        member = next(i for i in ids
+                      if elo <= su.mix_int(i) <= ehi
+                      and su.shard_of_int(i, n_shards) == src)
+        owner = su.owner_read_int(member, n_shards, entries)
+        writers = su.write_owners_int(member, n_shards, entries)
+        if mode == su.OVERLAY_DOUBLE_WRITE:
+            assert owner == src and set(writers) == {src, dst}
+        elif mode == su.OVERLAY_MIGRATED:
+            assert owner == dst and writers == (dst,)
+        else:  # RETURNING
+            assert owner == dst and set(writers) == {src, dst}
+
+
+def test_empty_overlay_identical_lowering():
+    """With no overlay, `owner_read` IS `shard_of_id` — same jaxpr, so
+    idle windows pay zero routing overhead for reshard-readiness."""
+    hi, lo = _split(EDGE_IDS)
+    jp_base = jax.make_jaxpr(
+        lambda kh, kl: su.shard_of_id(kh, kl, 8))(hi, lo)
+    jp_over = jax.make_jaxpr(
+        lambda kh, kl: su.owner_read(kh, kl, 8, ()))(hi, lo)
+    assert str(jp_base) == str(jp_over)
+
+
+def test_overlay_lowering_jaxhound_clean():
+    """The overlay-routed lowering stays deterministic (no PRNG, no
+    nondeterministic scatter) and gather-free — jaxhound's static
+    lints, the same gate the partitioned step functions pass."""
+    entries = (
+        (0, 1 << 62, 0, 1, su.OVERLAY_DOUBLE_WRITE),
+        (1 << 63, U64MAX, 2, 3, su.OVERLAY_RETURNING),
+    )
+
+    def routed(kh, kl):
+        return (su.owner_read(kh, kl, 8, entries),
+                su.writes_here(kh, kl, 8, np.int32(3), entries))
+
+    hi, lo = _split(EDGE_IDS + _fuzz_ids(3, 64))
+    cj = jax.make_jaxpr(routed)(hi, lo)
+    assert determinism.findings_for(cj, "overlay_route") == []
+    assert jh_core.state_gathers(cj) == []
+
+
+# ------------------------------------------- ownership-table semantics
+
+
+def test_ownership_table_generations_and_validation():
+    t0 = su.OwnershipTable(4)
+    assert not t0.active and t0.generation == 0
+    t1 = t0.with_entry(0, 1 << 32, 1, 2, su.OVERLAY_DOUBLE_WRITE)
+    assert t1.active and t1.generation == 1
+    t2 = t1.transition(t1.entries[0], su.OVERLAY_MIGRATED)
+    assert t2.generation == 2
+    assert t2.entries[0][4] == su.OVERLAY_MIGRATED
+    t3 = t2.without_entry(t2.entries[0])
+    assert t3.generation == 3 and not t3.active
+
+    with pytest.raises(AssertionError):   # src == dst
+        t0.with_entry(0, 10, 1, 1, su.OVERLAY_DOUBLE_WRITE)
+    with pytest.raises(AssertionError):   # bad mode
+        t0.with_entry(0, 10, 1, 2, 9)
+    with pytest.raises(AssertionError):   # lo > hi
+        t0.with_entry(10, 0, 1, 2, su.OVERLAY_DOUBLE_WRITE)
+    with pytest.raises(AssertionError):   # same-src overlap
+        t1.with_entry(1 << 32, 1 << 33, 1, 3, su.OVERLAY_MIGRATED)
+    # Overlap across DIFFERENT sources is fine (disjoint id sets: an
+    # id belongs to an entry only if its base owner == src).
+    t1.with_entry(0, 1 << 32, 2, 3, su.OVERLAY_MIGRATED)
+    with pytest.raises(AssertionError):   # non-power-of-two mesh
+        su.OwnershipTable(3)
+
+
+def test_reshard_plan_validation_and_membership():
+    with pytest.raises(AssertionError):
+        ReshardPlan(lo=10, hi=0, src=0, dst=1, kind="migrate")
+    with pytest.raises(AssertionError):
+        ReshardPlan(lo=0, hi=10, src=1, dst=1, kind="migrate")
+    with pytest.raises(AssertionError):
+        ReshardPlan(lo=0, hi=10, src=0, dst=1, kind="shuffle")
+
+    mid = 1 << 63
+    plan = ReshardPlan(lo=0, hi=mid, src=0, dst=1, kind="migrate")
+    for i in EDGE_IDS + _fuzz_ids(5, 64):
+        h = su.mix_int(i)
+        want = h <= mid and (h & 7) == 0
+        assert plan.in_range(i, 8) == want, hex(i)
+
+
+# ------------------------------------------------ conflict detection
+
+
+def _soa(ids):
+    """A minimal SoA ev dict: transfer ids only, zero pid/dr/cr."""
+    hi, lo = _split(ids)
+    z = np.zeros(len(ids), dtype=np.uint64)
+    return {"id_hi": hi, "id_lo": lo, "pid_hi": z, "pid_lo": z,
+            "dr_hi": z, "dr_lo": z, "cr_hi": z, "cr_lo": z}
+
+
+def test_conflicts_hashes_ids_in_both_batch_forms():
+    """`conflicts` freezes the copy-stage range against BOTH batch
+    representations — SoA ev dicts and Transfer objects — hashing ids
+    bit-identically with the device in each. Regression: the object
+    branch must hash the raw id, never treat it AS the hash."""
+    ctl = ReshardController(SimpleNamespace(n_shards=2))
+    a = next(i for i in _fuzz_ids(17) if su.mix_int(i) < U64MAX)
+    h = su.mix_int(a)
+    src = h & 1
+    ctl.stage = "copy"
+
+    def set_plan(lo, hi):
+        ctl.plan = ReshardPlan(lo=lo, hi=hi, src=src, dst=1 - src,
+                               kind="migrate")
+
+    set_plan(h, h)          # the single-hash range
+    # SoA: id / pid / dr / cr columns all checked; zeros filtered.
+    assert ctl.conflicts([_soa([a])])
+    soa = _soa([0])
+    for k in ("pid", "dr", "cr"):
+        d = dict(soa)
+        ahi, alo = _split([a])
+        d[f"{k}_hi"], d[f"{k}_lo"] = ahi, alo
+        assert ctl.conflicts([d]), k
+    assert not ctl.conflicts([_soa([0])])        # zero ids filtered
+    out = next(i for i in _fuzz_ids(19) if su.mix_int(i) != h)
+    assert not ctl.conflicts([_soa([out])])
+
+    # Transfer objects: same ids, same verdicts. The id column not
+    # under test carries `out` (known out of range), so only `field`
+    # decides the verdict.
+    def obj(i, field="id"):
+        kw = dict(id=out, pending_id=0, debit_account_id=0,
+                  credit_account_id=0)
+        kw[field] = i
+        return SimpleNamespace(**kw)
+
+    assert ctl.conflicts([[obj(a)]])
+    assert ctl.conflicts([[obj(a, "pending_id")]])
+    assert ctl.conflicts([[obj(a, "debit_account_id")]])
+    assert ctl.conflicts([[obj(a, "credit_account_id")]])
+    assert not ctl.conflicts([[obj(out)]])
+    # THE regression: an object whose raw id equals an in-range HASH
+    # value but whose own hash is out of range must not conflict.
+    if su.mix_int(h) != h:
+        assert not ctl.conflicts([[obj(h)]])
+
+    # Inclusive boundaries at both ends of wider ranges.
+    set_plan(0, h)
+    assert ctl.conflicts([_soa([a])])
+    set_plan(h, U64MAX)
+    assert ctl.conflicts([_soa([a])])
+    set_plan(h + 1, U64MAX)
+    assert not ctl.conflicts([_soa([a])])
+
+    # Only the copy stage freezes: double-write serves the range live.
+    set_plan(h, h)
+    for stage in ("idle", "double_write", "flip", "done"):
+        ctl.stage = stage
+        assert not ctl.conflicts([_soa([a])]), stage
+    ctl.stage = "copy"
+    assert not ctl.conflicts([])
+
+
+# ------------------------------------------------- hot-range detector
+
+
+def _acct_window(accounts, n_events):
+    """One SoA window: dr cycles through `accounts`, cr stays zero
+    (zero ids are filtered from the histogram)."""
+    ids = [accounts[i % len(accounts)] for i in range(n_events)]
+    hi, lo = _split(ids)
+    z = np.zeros(n_events, dtype=np.uint64)
+    return {"dr_hi": hi, "dr_lo": lo, "cr_hi": z, "cr_lo": z}
+
+
+def _accounts_on_shard(shard, n_shards, k, start=1):
+    out, i = [], start
+    while len(out) < k:
+        if su.shard_of_int(i, n_shards) == shard:
+            out.append(i)
+        i += 1
+    return out
+
+
+def test_hot_range_detector_verdicts():
+    n_shards = 2
+    # Under-sampled: below min_events, never a verdict.
+    det = HotRangeDetector(n_shards=n_shards)
+    det.observe_window([_acct_window([1], 16)])
+    assert det.propose() is None
+
+    # Balanced: load split across shards, no proposal.
+    det = HotRangeDetector(n_shards=n_shards)
+    a0 = _accounts_on_shard(0, n_shards, 4)
+    a1 = _accounts_on_shard(1, n_shards, 4)
+    det.observe_window([_acct_window(a0 + a1, 128)])
+    assert det.propose() is None
+
+    # Splittable skew: several accounts share one hot shard — a split
+    # plan moves the cold half of the range to the coldest shard.
+    det = HotRangeDetector(n_shards=n_shards)
+    det.observe_window([_acct_window(a0, 128)])
+    v = det.propose()
+    assert v is not None and v["verdict"] == "split", v
+    plan = v["plan"]
+    assert plan.kind == "split" and plan.src == 0 and plan.dst == 1
+    assert plan.lo == 0
+    assert any(plan.in_range(i, n_shards) for i in a0)
+    assert not all(plan.in_range(i, n_shards) for i in a0), \
+        "a split that moves the WHOLE shard isolates nothing"
+
+    # Anti-thrash cooldown: no immediate re-proposal.
+    assert det.propose() is None
+
+
+def test_hot_range_detector_unsplittable_single_account():
+    """Degenerate case: ONE account carries the shard. No hash range
+    smaller than the whole shard isolates it, so the detector must
+    emit the `unsplittable` verdict (naming the account hash and the
+    AT2-lane remedy) instead of proposing a thrashing split."""
+    n_shards = 2
+    hot_acct = 7
+    det = HotRangeDetector(n_shards=n_shards)
+    det.observe_window([_acct_window([hot_acct], 128)])
+    v = det.propose()
+    assert v is not None and v["verdict"] == "unsplittable", v
+    assert v["shard"] == su.shard_of_int(hot_acct, n_shards)
+    assert v["hot_hash"] == su.mix_int(hot_acct)
+    assert v["fraction"] == 1.0
+    assert "AT2" in v["note"]
+    # Anti-thrash: the verdict sets the cooldown too — no churn of
+    # repeated verdicts (or worse, plans) for a load placement can't
+    # fix.
+    assert det.propose() is None
+    det.observe_window([_acct_window([hot_acct], 128)])
+    assert det.propose() is None  # still cooling down
+
+
+def test_hot_range_detector_object_batches():
+    """The detector folds Transfer-object windows too (serving path
+    hands it the same batches the router dispatches)."""
+    det = HotRangeDetector(n_shards=2)
+    batch = [SimpleNamespace(debit_account_id=7, credit_account_id=0)
+             for _ in range(128)]
+    det.observe_window([batch])
+    v = det.propose()
+    assert v is not None and v["verdict"] == "unsplittable"
+    assert v["shard"] == su.shard_of_int(7, 2)

@@ -577,6 +577,34 @@ def render(history_path: str, out_path: str,
         bytes_rep = sh.get("state_bytes_replicated_equiv")
         ratio = (f" ({bytes_dev / bytes_rep:.3f}x of replicated)"
                  if bytes_dev and bytes_rep else "")
+        # Elastic-shards rows (##shard `migration` / `hot_range`): the
+        # probe's live split migration — duration, rows moved, windows
+        # served under double-write — and the degenerate-hot-account
+        # verdict (unsplittable = the remedy is AT2 lane parallelism
+        # within the account's commit lane, not placement).
+        mig = sh.get("migration")
+        mig_html = ""
+        if isinstance(mig, dict):
+            mig_html = (
+                "<p>live migration: {} {}&rarr;{} — {} rows copied in "
+                "{:.3f}s, {} double-write window(s), {} window(s) "
+                "committed while in flight</p>".format(
+                    mig.get("kind", "-"), mig.get("src", "-"),
+                    mig.get("dst", "-"), mig.get("rows_copied", 0),
+                    float(mig.get("duration_s") or 0.0),
+                    mig.get("double_write_windows", 0),
+                    mig.get("windows_live", 0)))
+        hr = sh.get("hot_range")
+        hr_html = ""
+        if isinstance(hr, dict):
+            style = (' style="color:#c60;font-weight:700"'
+                     if hr.get("verdict") == "unsplittable" else "")
+            hr_html = (
+                "<p{}>hot-range detector: {} (shard {}, top-account "
+                "fraction {:.0%}) — {}</p>".format(
+                    style, hr.get("verdict", "-"), hr.get("shard", "-"),
+                    float(hr.get("fraction") or 0.0),
+                    hr.get("note", "")))
         sh_html = (
             "<h2>shard balance (partitioned route, latest run)</h2>"
             + warn
@@ -588,6 +616,7 @@ def render(history_path: str, out_path: str,
                   sh.get("cross_shard_transfers", 0), over,
                   "-" if bytes_dev is None else bytes_dev,
                   ratio)
+            + mig_html + hr_html
             + "<table><tr><th>shard</th><th>events owned</th><th></th>"
               "</tr>" + "".join(rows_sh) + "</table>")
     # Device-telemetry panel: the fused route's on-device measurements

@@ -1467,6 +1467,10 @@ class DeviceLedger:
         assert not self._tickets, "attach_partitioned: windows in flight"
         self._part_router = router
         self._part_state = state
+        # Let router.resync tear down THIS ledger's staging before it
+        # rebuilds sharded state (a pack staged under the old ownership
+        # map must never be consumed by identity after a resync).
+        router._staging_host = self
 
     @property
     def partitioned_state(self):
@@ -1766,6 +1770,15 @@ class DeviceLedger:
 
         self.resolve_windows()  # pipeline ordering
         assert len(evs) == len(timestamps) and evs
+        if self._part_router is not None:
+            # Attach mode: the partitioned state IS the ledger — the
+            # synchronous window path dispatches through the router
+            # (fused chain when eligible, else the per-batch ladder),
+            # exactly like resolve-time redo replays. The single-chip
+            # pytree stays at its attach-time snapshot.
+            assert not all_or_nothing, \
+                "attach mode: the replica commit loop is single-chip scope"
+            return self._partitioned_window_sync(evs, timestamps)
         ns = [len(e["id_lo"]) for e in evs]
         eligible = len(evs) > 1 and not self._mirror_route()
         if eligible:

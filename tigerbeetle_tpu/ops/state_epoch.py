@@ -271,26 +271,36 @@ def partitioned_state_digest(stacked: dict) -> dict:
     return {k: int(v) for k, v in out.items()}
 
 
-def pack_oracle_state_partitioned(sm, a_cap: int, n_shards: int) -> list:
+def pack_oracle_state_partitioned(sm, a_cap: int, n_shards: int,
+                                  overlay: tuple = ()) -> list:
     """Per-shard canonical packs of an oracle state: objects assigned by
     the SAME ownership hash the kernels use (shard_utils.shard_of_id),
     then packed in the canonical order within each shard (accounts by
     applied timestamp, transfers in commit order) — the shard-then-sort
-    contract partitioned_from_oracle pins on device."""
+    contract partitioned_from_oracle pins on device. With an `overlay`
+    (elastic shards mid-/post-migration) assignment follows the READ
+    owner — comparable with a device state whose migrated ranges have
+    flipped AND retired (a stale pre-retire source copy, or a
+    partially-copied target, is exactly the divergence the epoch verify
+    should flag)."""
     from types import SimpleNamespace
 
-    from ..parallel.shard_utils import shard_of_int
+    from ..parallel.shard_utils import owner_read_int
 
     assert a_cap % n_shards == 0, (a_cap, n_shards)
+
+    def shard_of(id128):
+        return owner_read_int(id128, n_shards, overlay)
+
     packs = []
     for s in range(n_shards):
         view = SimpleNamespace(
             accounts={aid: a for aid, a in sm.accounts.items()
-                      if shard_of_int(aid, n_shards) == s},
+                      if shard_of(aid) == s},
             transfers=sm.transfers,
             transfer_by_timestamp={
                 ts: tid for ts, tid in sm.transfer_by_timestamp.items()
-                if shard_of_int(tid, n_shards) == s},
+                if shard_of(tid) == s},
             pending_status=sm.pending_status,
             accounts_key_max=sm.accounts_key_max,
             transfers_key_max=sm.transfers_key_max,
@@ -300,13 +310,155 @@ def pack_oracle_state_partitioned(sm, a_cap: int, n_shards: int) -> list:
     return packs
 
 
-def partitioned_oracle_digest(sm, a_cap: int, n_shards: int) -> dict:
+def partitioned_oracle_digest(sm, a_cap: int, n_shards: int,
+                              overlay: tuple = ()) -> dict:
     """Host-side expected digest of an oracle state under the
     partitioned layout — bit-comparable with partitioned_state_digest
-    over a stepped device state at the same (a_cap, n_shards)."""
+    over a stepped device state at the same (a_cap, n_shards) and
+    (retired) overlay."""
     total: dict = {}
-    for pack in pack_oracle_state_partitioned(sm, a_cap, n_shards):
+    for pack in pack_oracle_state_partitioned(sm, a_cap, n_shards,
+                                              overlay):
         comps = _digest_components(pack, np)
         for k, v in comps.items():
+            total[k] = (total.get(k, 0) + int(v)) & _U64_MASK
+    return total
+
+
+# ------------------------------------------------------- range digests
+# (ISSUE 19, elastic shards). The migration flip needs a witness that
+# ONE hash range is bit-identical on source and target even though the
+# range's rows sit at different LOCAL row indices in the two stores —
+# so the range fold is position-independent: instead of mixing the
+# storage row index, each row mixes its OWN 64-bit ownership hash
+# (shard_utils.mix_id over the row's id limbs). Rows outside [lo, hi]
+# (inclusive — the overlay-entry convention) are zeroed before the
+# wrap-sum, so capacity, row order, and out-of-range neighbours all
+# cancel. Same exclusions as the epoch digest (expires, row caches,
+# tables, ring); additionally the row CONTENT hash is paired with an
+# in-range row COUNT per store, so "same digest, different cardinality"
+# is impossible to miss.
+
+_RSALT = {"accounts_u64": 0x5A1, "accounts_bal": 0x5B2,
+          "transfers_u64": 0x5C3}
+
+
+def _range_matrix_digest(m, count, col_masks, salt: int, h, member,
+                         xp):
+    """Position-independent row fold over rows < count selected by the
+    `member` mask (a (rows,) bool vector — the migration-membership
+    predicate over the precomputed ownership-hash vector `h`). Returns
+    (digest, n_rows) as u64 scalars."""
+    rows = m.shape[0]
+    u = xp.uint64
+    acc = xp.zeros(rows, dtype=xp.uint64)
+    for j in range(m.shape[1]):
+        mask = _U64_MASK if col_masks is None else int(col_masks[j])
+        if mask == 0:
+            continue
+        col = m[:, j]
+        if mask != _U64_MASK:
+            col = col & u(mask)
+        acc = acc * u(_PHI) + col
+    rowd = _mix64(acc ^ (h * u(_PHI)) ^ u(salt & _U64_MASK), xp)
+    iota = xp.arange(rows, dtype=xp.uint64)
+    live = (iota < xp.asarray(count).astype(xp.uint64)) & member
+    dig = xp.sum(xp.where(live, rowd, u(0)))
+    n = xp.sum(live.astype(xp.uint64))
+    return dig, n
+
+
+def _range_digest_components(state: dict, lo, hi, src, n_shards: int,
+                             xp) -> dict:
+    """The range fold over one ledger-state pack (device jnp pytree or
+    a host numpy pack). Membership is the overlay-entry predicate —
+    `h in [lo, hi] AND base_owner(h) == src` — NOT the bare range: the
+    flip compares the source and TARGET shards, and the target's own
+    base rows whose hashes happen to fall inside [lo, hi] must not
+    contaminate its fold. No scalars component — counters and key
+    maxima are whole-shard facts, not range facts."""
+    from ..parallel.shard_utils import mix_id
+
+    u = xp.uint64
+    acc = state["accounts"]
+    xfr = state["transfers"]
+
+    def member(h):
+        return ((h >= xp.asarray(lo).astype(xp.uint64))
+                & (h <= xp.asarray(hi).astype(xp.uint64))
+                & ((h & u(n_shards - 1))
+                   == xp.asarray(src).astype(xp.uint64)))
+
+    a_h = mix_id(acc["u64"][:, 0], acc["u64"][:, 1])
+    x_h = mix_id(xfr["u64"][:, 0], xfr["u64"][:, 1])
+    a_m, x_m = member(a_h), member(x_h)
+    a_dig, a_n = _range_matrix_digest(
+        acc["u64"], acc["count"], AC_COL_MASKS,
+        _RSALT["accounts_u64"], a_h, a_m, xp)
+    b_dig, _ = _range_matrix_digest(
+        acc["bal"], acc["count"], None, _RSALT["accounts_bal"],
+        a_h, a_m, xp)
+    x_dig, x_n = _range_matrix_digest(
+        xfr["u64"], xfr["count"], XF_COL_MASKS,
+        _RSALT["transfers_u64"], x_h, x_m, xp)
+    return {"accounts_u64": a_dig, "accounts_bal": b_dig,
+            "transfers_u64": x_dig, "accounts_rows": a_n,
+            "transfers_rows": x_n}
+
+
+_rdigest_jit = None
+
+
+def partitioned_range_digest(stacked: dict, lo: int, hi: int,
+                             src: int) -> list:
+    """PER-SHARD range digests of a device-sharded (stacked)
+    partitioned state: a list of component dicts, one per shard, NOT
+    summed — the flip compares the source shard's entry against the
+    target shard's (and the host oracle's) at the same epoch. `src` is
+    the migrating range's BASE owner (membership predicate, see
+    `_range_digest_components`). `lo`/`hi`/`src` are traced scalars:
+    one lowering serves every migration on a given mesh size."""
+    global _rdigest_jit
+    import jax
+
+    if _rdigest_jit is None:
+        import jax.numpy as jnp
+
+        def fold(view, lo_, hi_, src_):
+            n = next(iter(
+                view["accounts"].values())).shape[0]
+            return jax.vmap(
+                lambda s: _range_digest_components(s, lo_, hi_, src_,
+                                                   n, jnp)
+            )(view)
+
+        _rdigest_jit = jax.jit(fold)
+    out = jax.device_get(_rdigest_jit(
+        _stacked_digest_view(stacked),
+        np.uint64(lo & _U64_MASK), np.uint64(hi & _U64_MASK),
+        np.uint64(src)))
+    n_shards = len(next(iter(out.values())))
+    return [{k: int(v[s]) for k, v in out.items()}
+            for s in range(n_shards)]
+
+
+def oracle_range_digest(sm, a_cap: int, lo: int, hi: int, src: int,
+                        n_shards: int) -> dict:
+    """Host-side expected range digest over the canonical oracle pack
+    (whole state — the fold is position-independent, so it equals the
+    membership sum across any shard placement of the same rows)."""
+    pack = pack_oracle_state(sm, a_cap)
+    comps = _range_digest_components(
+        pack, np.uint64(lo & _U64_MASK), np.uint64(hi & _U64_MASK),
+        np.uint64(src), n_shards, np)
+    return {k: int(v) for k, v in comps.items()}
+
+
+def sum_range_components(comps: list) -> dict:
+    """Wrap-sum a list of per-shard range-digest dicts (e.g. source +
+    target during double-write equals the oracle's whole-range fold)."""
+    total: dict = {}
+    for c in comps:
+        for k, v in c.items():
             total[k] = (total.get(k, 0) + int(v)) & _U64_MASK
     return total

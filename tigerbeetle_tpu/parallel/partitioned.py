@@ -102,7 +102,10 @@ from ..ops.ledger import (
 )
 from ..trace import Event, FlightRecorder, Histogram, NullTracer
 from .full_sharded import MODES, _MODE_KWARGS, ShardedRouter
-from .shard_utils import get_shard_map, shard_of_id, shard_of_int
+from .shard_utils import (
+    OwnershipTable, get_shard_map, owner_read, owner_read_int,
+    shard_of_id, shard_of_int, writes_here,
+)
 
 __all__ = ["make_partitioned_create_transfers",
            "make_partitioned_chain_create_transfers",
@@ -214,7 +217,8 @@ def decode_telemetry(tel) -> dict:
 
 
 def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
-                            mode, force_fallback=None, telemetry=True):
+                            mode, force_fallback=None, telemetry=True,
+                            overlay=()):
     """One prepare against the per-shard state `sub` (UNSTACKED
     leaves): the full exchange -> mini-state -> judge -> write-back
     anatomy of the module docstring, shared VERBATIM by the per-batch
@@ -230,7 +234,21 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
     tel) where rep is the replicated out dict, events_owned the
     per-shard routed-event count, and tel the TEL_WORDS u32 telemetry
     vector (None when `telemetry` is off — the overhead-probe
-    baseline)."""
+    baseline).
+
+    `overlay` is the elastic-shards ownership override table
+    (shard_utils OwnershipTable.entries), baked in as a static closure
+    constant. The exchange's "each key lives on exactly one shard"
+    invariant — which makes each psum a select — breaks while a range
+    is mid-migration (its rows exist on BOTH owners), so with a
+    non-empty overlay every probe CONTRIBUTION is masked by
+    read-ownership (only the authoritative copy feeds the psum) and
+    every write-back mask generalizes from `shard_of_id == me` to
+    `writes_here` (the copy-catchup owner applies the same rows at its
+    own local positions). An EMPTY overlay takes the original code
+    paths verbatim — byte-identical lowering, so the pinned op budgets
+    and jaxhound signatures never see elastic shards unless one is
+    actually live."""
     N = ev["id_lo"].shape[0]
     me = jax.lax.axis_index(axis)
     idxs = jnp.arange(N, dtype=jnp.int32)
@@ -248,7 +266,15 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
     # ORPHAN_VAL as val=-1), r+2 = live owner-local row r.
     xk_hi = jnp.concatenate([ev["id_hi"], ev["pid_hi"]])
     xk_lo = jnp.concatenate([ev["id_lo"], ev["pid_lo"]])
-    xf_l, xv_l = ht_lookup(sub["xfer_ht"], xk_hi, xk_lo)
+    xf_raw, xv_l = ht_lookup(sub["xfer_ht"], xk_hi, xk_lo)
+    if overlay:
+        # Mid-migration a range's rows exist on BOTH owners: only the
+        # READ owner's copy may feed the psum, or the "sum is a
+        # select" exchange invariant breaks.
+        read_mine_x = owner_read(xk_hi, xk_lo, n_dev, overlay) == me
+        xf_l = xf_raw & read_mine_x
+    else:
+        xf_l = xf_raw
     x_live_l = xf_l & (xv_l >= 0)
     enc_l = jnp.where(
         xf_l, (xv_l + 2).astype(jnp.uint64), jnp.uint64(0))
@@ -275,7 +301,12 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
         ev["dr_lo"], ev["cr_lo"],
         p_rows_g[:, XF_U64_IDX["dr_lo"]],
         p_rows_g[:, XF_U64_IDX["cr_lo"]]])
-    af_l, ar_l = ht_lookup(sub["acct_ht"], ak_hi, ak_lo)
+    af_raw, ar_l = ht_lookup(sub["acct_ht"], ak_hi, ak_lo)
+    if overlay:
+        read_mine_a = owner_read(ak_hi, ak_lo, n_dev, overlay) == me
+        af_l = af_raw & read_mine_a
+    else:
+        af_l = af_raw
     aenc_l = jnp.where(
         af_l, (ar_l + 1).astype(jnp.uint64), jnp.uint64(0))
     arow_g_l = jnp.where(af_l, ar_l, a_dump_l)
@@ -380,9 +411,15 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
         transient = transient | (status == code)
     orphan_new = ev["valid"] & transient
     ins_mask = created | orphan_new
-    owner_ev = shard_of_id(ev["id_hi"], ev["id_lo"], n_dev)
-    mine = created & (owner_ev == me)
-    ins_mine = ins_mask & (owner_ev == me)
+    if overlay:
+        owner_ev = owner_read(ev["id_hi"], ev["id_lo"], n_dev, overlay)
+        wr_ev = writes_here(ev["id_hi"], ev["id_lo"], n_dev, me,
+                            overlay)
+    else:
+        owner_ev = shard_of_id(ev["id_hi"], ev["id_lo"], n_dev)
+        wr_ev = owner_ev == me
+    mine = created & wr_ev
+    ins_mine = ins_mask & wr_ev
     n_mine = jnp.sum(mine.astype(jnp.int32))
     local_rank = _cumsum(mine.astype(jnp.int32)) - mine
     pos, ok_pl = ht_plan(sub["xfer_ht"], ev["id_hi"],
@@ -413,20 +450,42 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
     # Pending-status flips on existing owned rows: the pstat
     # word is alone in its column, so the flip cannot clobber a
     # neighbor. Unchanged rows rewrite their own value.
-    owner_xk = shard_of_id(xk_hi, xk_lo, n_dev)
-    flip = lfirst & (owner_xk == me)
-    dest_p = jnp.where(flip & g_ok,
-                       (g_enc - jnp.uint64(2)).astype(jnp.int32),
-                       t_dump_l)
+    if overlay:
+        # Copy-catchup owners flip their OWN copy's row: the read
+        # owner's row index is the exchanged encoding, the other
+        # write owner's is its local lookup (absent-here rows — a
+        # key outside this shard's tables — mask to the dump row).
+        wr_xk = writes_here(xk_hi, xk_lo, n_dev, me, overlay)
+        flip = lfirst & wr_xk
+        row_here = jnp.where(
+            read_mine_x, (g_enc - jnp.uint64(2)).astype(jnp.int32),
+            xv_l)
+        has_here = read_mine_x | (xf_raw & (xv_l >= 0))
+        dest_p = jnp.where(flip & g_ok & has_here, row_here, t_dump_l)
+    else:
+        owner_xk = shard_of_id(xk_hi, xk_lo, n_dev)
+        flip = lfirst & (owner_xk == me)
+        dest_p = jnp.where(flip & g_ok,
+                           (g_enc - jnp.uint64(2)).astype(jnp.int32),
+                           t_dump_l)
     pword = new_mini["transfers"]["u64"][
         jnp.where(x_live, lrow, MT), _XF_PSTAT_COL]
     xu_new = xu_new.at[dest_p, _XF_PSTAT_COL].set(pword)
 
-    owner_ak = shard_of_id(ak_hi, ak_lo, n_dev)
-    wb_a = afirst & (owner_ak == me)
-    dest_a = jnp.where(wb_a & g_ok,
-                       (g_aenc - jnp.uint64(1)).astype(jnp.int32),
-                       a_dump_l)
+    if overlay:
+        wr_ak = writes_here(ak_hi, ak_lo, n_dev, me, overlay)
+        wb_a = afirst & wr_ak
+        arow_here = jnp.where(
+            read_mine_a, (g_aenc - jnp.uint64(1)).astype(jnp.int32),
+            ar_l)
+        dest_a = jnp.where(wb_a & g_ok & (read_mine_a | af_raw),
+                           arow_here, a_dump_l)
+    else:
+        owner_ak = shard_of_id(ak_hi, ak_lo, n_dev)
+        wb_a = afirst & (owner_ak == me)
+        dest_a = jnp.where(wb_a & g_ok,
+                           (g_aenc - jnp.uint64(1)).astype(jnp.int32),
+                           a_dump_l)
     amrow_c = jnp.where(afirst, amrow, MA)
     au_new = acc["u64"].at[dest_a].set(
         new_mini["accounts"]["u64"][amrow_c])
@@ -492,8 +551,12 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
     # gather; row-pointer columns are non-canonical scope).
     rep["flush"] = _delta_gather_body(new_mini, mini_t0, 0,
                                       N, N)
-    owner_dr = shard_of_id(ev["dr_hi"], ev["dr_lo"], n_dev)
-    owner_cr = shard_of_id(ev["cr_hi"], ev["cr_lo"], n_dev)
+    if overlay:
+        owner_dr = owner_read(ev["dr_hi"], ev["dr_lo"], n_dev, overlay)
+        owner_cr = owner_read(ev["cr_hi"], ev["cr_lo"], n_dev, overlay)
+    else:
+        owner_dr = shard_of_id(ev["dr_hi"], ev["dr_lo"], n_dev)
+        owner_cr = shard_of_id(ev["cr_hi"], ev["cr_lo"], n_dev)
     rep["cross_shard_transfers"] = jnp.sum(
         (created & (owner_dr != owner_cr)).astype(jnp.int32))
     rep["exchange_overflow"] = xchg_bad
@@ -512,7 +575,8 @@ def _partitioned_batch_body(sub, ev, timestamp, n, *, axis, n_dev,
 
 def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
                                       mode: str = "plain",
-                                      telemetry: bool = True):
+                                      telemetry: bool = True,
+                                      overlay: tuple = ()):
     """Build the jitted partitioned-state SPMD step over `mesh` for one
     kernel tier (`mode` in MODES).
 
@@ -525,7 +589,9 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
     `shard_stats.events_owned` (per-shard routed-event counts). With
     `telemetry` (the default) `shard_stats.tel` carries the
     [n_shards, TEL_WORDS] device telemetry block; `telemetry=False` is
-    the overhead-probe baseline."""
+    the overhead-probe baseline. `overlay` (elastic shards) is the
+    static ownership-override tuple baked into the lowering; () — the
+    default — lowers byte-identically to the pre-overlay artifact."""
     shard_map = get_shard_map()
     assert mode in MODES, mode
     n_dev = mesh.shape[axis]
@@ -535,7 +601,7 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
             sub = jax.tree.map(lambda x: x[0], stacked)
             new_sub, rep, owned, tel = _partitioned_batch_body(
                 sub, ev, timestamp, n, axis=axis, n_dev=n_dev,
-                mode=mode, telemetry=telemetry)
+                mode=mode, telemetry=telemetry, overlay=overlay)
             sh = dict(events_owned=owned[None])
             if tel is not None:
                 sh["tel"] = tel[None]
@@ -566,7 +632,8 @@ def make_partitioned_create_transfers(mesh: Mesh, axis: str = "batch",
 def make_partitioned_chain_create_transfers(mesh: Mesh,
                                             axis: str = "batch",
                                             mode: str = "plain",
-                                            telemetry: bool = True):
+                                            telemetry: bool = True,
+                                            overlay: tuple = ()):
     """Build the FUSED window step: the W prepares of a commit window
     run as a `lax.scan` over the per-batch body INSIDE one shard_map
     dispatch, with the donated sharded state and a rolling poison
@@ -611,7 +678,7 @@ def make_partitioned_chain_create_transfers(mesh: Mesh,
                 new_st, rep, owned, tel = _partitioned_batch_body(
                     st, ev_k, ts_k, n_k, axis=axis, n_dev=n_dev,
                     mode=mode, force_fallback=poisoned,
-                    telemetry=telemetry)
+                    telemetry=telemetry, overlay=overlay)
                 ys = ((rep, owned, tel) if telemetry
                       else (rep, owned))
                 return (new_st, rep["fallback"]), ys
@@ -700,7 +767,8 @@ def _record_owner_id(sm, rec) -> int:
 
 def partitioned_from_oracle(sm, mesh: Mesh, axis: str = "batch",
                             a_cap: int = 1 << 12, t_cap: int = 1 << 14,
-                            e_cap: int | None = None):
+                            e_cap: int | None = None,
+                            overlay: tuple = ()):
     """Build the device-sharded state pytree from a host oracle.
 
     The partitioned sibling of DeviceLedger.from_host: objects are
@@ -710,7 +778,14 @@ def partitioned_from_oracle(sm, mesh: Mesh, axis: str = "batch",
     shard-then-sort contract the epoch digest pins). Every leaf gains a
     leading shard axis and lands with NamedSharding P(axis); per-shard
     caps are the global caps / n_shards, so per-device resident bytes
-    scale ~1/n_shards."""
+    scale ~1/n_shards.
+
+    `overlay` (elastic shards): placement follows the READ owner under
+    the override table, so a rebuild mid-overlay (recovery after a
+    flip) lands every range on its authoritative shard. Rebuilding
+    DURING copy-catchup is a controller bug — the ReshardController
+    always reverts (or completes) the in-flight entry before a resync,
+    so a double-write range never reaches this packer."""
     from ..ops.ledger import (
         N_PAD, _pack_account_rows, _pack_event_rows, _pack_transfer_rows,
         init_state,
@@ -735,17 +810,16 @@ def partitioned_from_oracle(sm, mesh: Mesh, axis: str = "batch",
                 for tid in sm.transfer_by_timestamp.values()]
     orphan_all = sorted(sm.orphaned)
 
+    def shard_of(id128):
+        return owner_read_int(id128, n_shards, overlay)
+
     subs = []
     for s in range(n_shards):
-        accounts = [a for a in acct_all
-                    if shard_of_int(a.id, n_shards) == s]
-        transfers = [t for t in xfer_all
-                     if shard_of_int(t.id, n_shards) == s]
-        orphans = [o for o in orphan_all
-                   if shard_of_int(o, n_shards) == s]
+        accounts = [a for a in acct_all if shard_of(a.id) == s]
+        transfers = [t for t in xfer_all if shard_of(t.id) == s]
+        orphans = [o for o in orphan_all if shard_of(o) == s]
         records = [r for r in sm.account_events
-                   if shard_of_int(_record_owner_id(sm, r),
-                                   n_shards) == s]
+                   if shard_of(_record_owner_id(sm, r)) == s]
         assert len(accounts) <= a_cap_s and len(transfers) <= t_cap_s \
             and len(records) <= e_cap_s, "shard capacity exceeded"
         st = jax.tree.map(lambda x: np.array(x), init_state(
@@ -865,6 +939,11 @@ class PartitionedRouter:
         self.t_cap = t_cap
         self.e_cap = e_cap
         self.n_shards = mesh.shape[axis]
+        # Elastic shards: the generation-tagged ownership authority.
+        # Step caches key on (mode, overlay entries) — an overlay swap
+        # SELECTS a different compiled artifact, it never mutates one.
+        self.ownership = OwnershipTable(self.n_shards)
+        self._staging_host = None  # DeviceLedger.attach_partitioned
         self._steps: dict = {}
         self._chain_steps: dict = {}
         self.batches = 0
@@ -897,26 +976,41 @@ class PartitionedRouter:
     route = staticmethod(ShardedRouter.route)
 
     def from_oracle(self, sm):
-        """Build the router's sharded state from a host oracle."""
+        """Build the router's sharded state from a host oracle (under
+        the current ownership table — migrated ranges land on their
+        read owner)."""
         return partitioned_from_oracle(sm, self.mesh, self.axis,
                                        self.a_cap, self.t_cap,
-                                       self.e_cap)
+                                       self.e_cap,
+                                       overlay=self.ownership.entries)
+
+    def set_ownership(self, table: OwnershipTable) -> None:
+        """Swap in a new ownership table (reshard stage transitions).
+        Purely a host-side selection change: the next dispatch picks
+        (or traces) the step keyed by the new overlay entries."""
+        assert table.n_shards == self.n_shards, table
+        assert table.generation >= self.ownership.generation, table
+        self.ownership = table
 
     def _step(self, mode: str):
-        fn = self._steps.get(mode)
+        key = (mode, self.ownership.entries)
+        fn = self._steps.get(key)
         if fn is None:
-            fn = self._steps[mode] = make_partitioned_create_transfers(
+            fn = self._steps[key] = make_partitioned_create_transfers(
                 self.mesh, self.axis, mode=mode,
-                telemetry=self.telemetry)
+                telemetry=self.telemetry,
+                overlay=self.ownership.entries)
         return fn
 
     def _chain_step(self, mode: str):
-        fn = self._chain_steps.get(mode)
+        key = (mode, self.ownership.entries)
+        fn = self._chain_steps.get(key)
         if fn is None:
-            fn = self._chain_steps[mode] = \
+            fn = self._chain_steps[key] = \
                 make_partitioned_chain_create_transfers(
                     self.mesh, self.axis, mode=mode,
-                    telemetry=self.telemetry)
+                    telemetry=self.telemetry,
+                    overlay=self.ownership.entries)
         return fn
 
     def drop_device(self, device, oracle=None):
@@ -941,7 +1035,16 @@ class PartitionedRouter:
         """Bounded oracle-replay resync of the lost range(s): rebuild
         the sharded state from the last verified oracle through the
         supervisor recovery path's event taxonomy (`shard_resync`
-        cause). Returns the fresh stacked state."""
+        cause). Returns the fresh stacked state.
+
+        Staging is torn down FIRST: a pack staged under the
+        pre-quarantine ownership map could otherwise be consumed by
+        identity against the rebuilt state (ISSUE 19 satellite fix —
+        the staged window's route and pad bucket would match while its
+        placement assumptions no longer do)."""
+        host = self._staging_host
+        if host is not None:
+            host.shutdown_staging()
         self.flight.dump("shard_resync")
         with self.tracer.span(Event.serving_recovery_replay,
                               cause="shard_resync"):

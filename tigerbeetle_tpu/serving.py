@@ -213,6 +213,11 @@ class ServingSupervisor:
         # tail retention force-keeps them (ISSUE 15) and the flight
         # artifact names them for cross-reference.
         self._epoch_trace_ids: list[str] = []
+        # Elastic shards (ISSUE 19): set by attach_partitioned — the
+        # sharded-state backend's router and the live-resharding
+        # controller whose migrations interleave with commit windows.
+        self.part_router = None
+        self.resharder = None
         self._attach(DeviceLedger(a_cap, t_cap,
                                   write_through=StateMachineOracle()))
 
@@ -224,6 +229,81 @@ class ServingSupervisor:
         # And OUR tracer flows down so window_stage spans + the
         # host-stall gauge land in the same catalog as everything else.
         led.tracer = self.tracer
+
+    def attach_partitioned(self, router):
+        """Switch serving to the partitioned (sharded-state) backend:
+        a fresh un-mirrored DeviceLedger in attach mode over `router`,
+        seeded from the current verified epoch base, plus a
+        ReshardController for live migrations (driven by `reshard()`
+        and the per-window tick). The write-through mirror does not
+        exist in attach mode, so the epoch check's mirror audit is
+        disabled; result parity and the sharded state digest remain.
+        Create accounts BEFORE attaching (the epoch base seeds the
+        sharded state). Returns the controller."""
+        from .parallel.resharding import ReshardController
+
+        # Fold the open log into the verified base first — the sharded
+        # state is seeded from it, so anything still un-verified would
+        # silently vanish from the new backend.
+        self.verify_epoch()
+        self.led.shutdown_staging()
+        self.mirror_audit = "off"
+        router.tracer = self.tracer
+        router.flight = self.flight
+        self.part_router = router
+        led = DeviceLedger(self.a_cap, self.t_cap)
+        led.attach_partitioned(router,
+                               router.from_oracle(self.epoch_base))
+        self._attach(led)
+        self.resharder = ReshardController(router, tracer=self.tracer)
+        return self.resharder
+
+    def reshard(self, plan) -> None:
+        """Begin a live migration (parallel/resharding.ReshardPlan).
+        The snapshot is taken at a VERIFIED epoch — verify_epoch()
+        quiesces, replays the log, and proves the digests first, so the
+        frozen range is witness-backed and the epoch base can vouch for
+        the copy (oracle digest leg + the range's ring rows). The
+        migration then advances one copy chunk per submitted window
+        (conflicting windows drain it), double-writes, and flips at a
+        later window boundary; MigrationAborted propagates to the
+        caller with ownership already reverted."""
+        from .parallel.resharding import MigrationAborted
+
+        assert self.part_router is not None, \
+            "attach_partitioned() first"
+        assert not self.resharder.active, "migration already in flight"
+        self.verify_epoch()
+        led = self.led
+        try:
+            led._part_state = self.resharder.begin(
+                led.partitioned_state, plan, oracle=self.epoch_base)
+        except MigrationAborted:
+            # begin aborts before staging anything on device: the
+            # artifact is frozen, ownership untouched, serving intact.
+            raise
+
+    def _reshard_tick(self, batches) -> None:
+        """The per-window migration tick (both window paths call this
+        BEFORE dispatching): quiesce the pipeline while a migration is
+        active and advance it one step at this window boundary. An
+        abort here is survivable by construction — ownership reverted,
+        staged copy evicted — so serving continues on the pre-migration
+        owner and the abort surfaces through the controller's records
+        and the flight artifact rather than failing the window."""
+        from .parallel.resharding import MigrationAborted
+
+        ctl = self.resharder
+        if ctl is None or not ctl.active:
+            return
+        self.drain_pipeline()
+        self.led.resolve_windows()
+        led = self.led
+        try:
+            led._part_state = ctl.on_window(led.partitioned_state,
+                                            batches)
+        except MigrationAborted as e:
+            led._part_state = e.state
 
     # ------------------------------------------------------------ serving
 
@@ -259,6 +339,7 @@ class ServingSupervisor:
         ctxs = [c for c in (trace_ctxs or ()) if c is not None]
         trace_ids = [fmt_trace_id(c.trace_id) for c in ctxs]
         self._epoch_trace_ids.extend(trace_ids)
+        self._reshard_tick(batches)
 
         def thunk():
             evs = [transfers_to_arrays(b) for b in batches]
@@ -333,6 +414,7 @@ class ServingSupervisor:
         ctxs = [c for c in (trace_ctxs or ()) if c is not None]
         trace_ids = [fmt_trace_id(c.trace_id) for c in ctxs]
         self._epoch_trace_ids.extend(trace_ids)
+        self._reshard_tick(batches)
         # `evs` lets the admission plane pass the SAME array dicts it
         # already staged ahead (DeviceLedger.stage_window matches on
         # prepare-dict identity) — re-staging here would replace the
@@ -502,6 +584,17 @@ class ServingSupervisor:
         except _STRUCTURAL_FAULTS as e:
             self._recover("drain_fault", detail=repr(e))
             return False
+        # An in-flight migration makes the whole-state digest
+        # incomparable (staged copy rows bump the target's counts):
+        # complete it — or let it abort cleanly — before judging the
+        # epoch. Either way ownership is settled when the folds run.
+        if self.resharder is not None and self.resharder.active:
+            from .parallel.resharding import MigrationAborted
+            try:
+                led._part_state = self.resharder.drain(
+                    led.partitioned_state)
+            except MigrationAborted as e:
+                led._part_state = e.state
         n_entries = len(self.log)
         replayed = self._replay_log_into_base()
         cause = None
@@ -514,10 +607,22 @@ class ServingSupervisor:
                 detail = f"op {start + i}"
                 break
         # (b) state digest: device fold vs the replayed-oracle fold.
+        # Partitioned backend: the sharded digest vs the oracle pack
+        # placed by the CURRENT ownership table (overlay entries are
+        # part of the epoch's identity — a flip moves rows between
+        # shards and the pack must agree on where they landed).
         if cause is None:
-            got = state_epoch.device_state_digest(led.state)
-            want_d = state_epoch.oracle_state_digest(self.epoch_base,
-                                                     self.a_cap)
+            if self.part_router is not None:
+                r = self.part_router
+                got = state_epoch.partitioned_state_digest(
+                    led.partitioned_state)
+                want_d = state_epoch.partitioned_oracle_digest(
+                    self.epoch_base, self.a_cap, r.n_shards,
+                    overlay=r.ownership.entries)
+            else:
+                got = state_epoch.device_state_digest(led.state)
+                want_d = state_epoch.oracle_state_digest(
+                    self.epoch_base, self.a_cap)
             if got != want_d:
                 self.counters["checksum_mismatches"] += 1
                 cause = "state_digest"
@@ -656,9 +761,26 @@ class ServingSupervisor:
         # stager drains first: its staged-but-undispatched window (if
         # any) is dropped, its worker joined.
         self.led.shutdown_staging()
-        new_mirror = copy.deepcopy(self.epoch_base)
-        self._attach(DeviceLedger(self.a_cap, self.t_cap,
-                                  write_through=new_mirror))
+        if self.part_router is not None:
+            # Partitioned backend: an un-flipped migration reverts to
+            # its pre-flip owner FIRST (the controller drops the
+            # overlay entry and records the reshard_abort), then the
+            # whole sharded state rebuilds from the verified base via
+            # the router's resync — the pack places every range by the
+            # reverted table, so staged copy rows simply never
+            # reappear. A flipped migration keeps its MIGRATED entry
+            # and the rebuild honors it.
+            if self.resharder is not None:
+                self.resharder.on_recovery()
+            r = self.part_router
+            state = r.resync(self.epoch_base)
+            led = DeviceLedger(self.a_cap, self.t_cap)
+            led.attach_partitioned(r, state)
+            self._attach(led)
+        else:
+            new_mirror = copy.deepcopy(self.epoch_base)
+            self._attach(DeviceLedger(self.a_cap, self.t_cap,
+                                      write_through=new_mirror))
         self.log.clear()
         self._windows_since_epoch = 0
         self._epoch_trace_ids.clear()
@@ -673,6 +795,11 @@ class ServingSupervisor:
         out["pipeline"] = {"depth": self.pipeline_depth,
                            "pending": len(self._pending)}
         out["last_recovery"] = self.last_recovery
+        if self.resharder is not None:
+            out["resharding"] = {
+                "stage": self.resharder.stage,
+                "migrations": list(self.resharder.migrations),
+                "aborts": list(self.resharder.aborts)}
         out["flight"] = {"windows_recorded": self.flight.seq,
                          "dumps": self.flight.dumps,
                          "last_dump": self.flight.last_dump_path}

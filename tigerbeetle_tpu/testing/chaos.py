@@ -401,6 +401,7 @@ def run_chaos_seed(seed: int, *, windows: int = 8,
         if mesh_scenario:
             summary["shard_loss"] = shard_loss_scenario(seed)
             summary["shard_resync"] = shard_resync_scenario(seed)
+            summary["reshard"] = reshard_chaos_scenario(seed)
     finally:
         constants.set_verify(was_verify)
         if was_rate is None:
@@ -677,6 +678,249 @@ def shard_resync_scenario(seed: int, mesh=None) -> dict:
     return dict(devices=int(mesh.size), dropped=str(dropped),
                 resyncs=resyncs,
                 flight_dump=os.path.basename(flight_path))
+
+
+# --------------------------------------------- elastic-reshard scenario
+
+_RESHARD_ROUTER = None
+
+
+def reshard_chaos_scenario(seed: int, mesh=None) -> dict:
+    """Fault the five-stage elastic-shard handoff at every stage it can
+    die in (ISSUE 19): crash (SIGKILL analog — the supervisor's
+    recovery path: revert overlay, rebuild from the verified oracle)
+    right after the snapshot, mid-copy, and under double-write; shard
+    LOSS of the source and of the target mid-copy (quarantine must be
+    loud, then the same recovery); a bit-corrupted chunk that must
+    abort PRE-FLIP on the digest witness; and a crash after a completed
+    flip (the MIGRATED override must survive the rebuild). Every abort
+    leaves serving bit-exact vs the never-resharded oracle and freezes
+    a FLIGHT_*_reshard_* artifact. The router and its compiled steps
+    are cached across seeds."""
+    global _RESHARD_ROUTER
+    import glob as _glob
+    import tempfile
+
+    import jax
+    from jax.sharding import Mesh
+
+    from ..ops.batch import transfers_to_arrays
+    from ..ops.state_epoch import (partitioned_oracle_digest,
+                                   partitioned_state_digest)
+    from ..parallel.partitioned import PartitionedRouter
+    from ..parallel.resharding import (MigrationAborted,
+                                       ReshardController, ReshardPlan)
+    from ..parallel.shard_utils import OVERLAY_MIGRATED
+
+    if mesh is None and len(jax.devices()) < 2:
+        return {"skipped": "needs >= 2 devices"}
+    rng = random.Random(seed ^ 0xE5A)
+    a_cap = 1 << 9
+    if mesh is not None:
+        router = PartitionedRouter(mesh, a_cap=a_cap, t_cap=1 << 11)
+    else:
+        if _RESHARD_ROUTER is None:
+            _RESHARD_ROUTER = PartitionedRouter(
+                Mesh(np.array(jax.devices()[:2]), ("batch",)),
+                a_cap=a_cap, t_cap=1 << 11)
+        router = _RESHARD_ROUTER
+    router.restore_devices()
+    if router.ownership.entries:
+        # The cached router may carry a MIGRATED override from the
+        # previous seed's completed migration: base ownership again.
+        from ..parallel.shard_utils import OwnershipTable
+        router.set_ownership(OwnershipTable(
+            router.n_shards, router.ownership.generation + 1, ()))
+    mesh = router.mesh
+    fallbacks0 = router.host_fallbacks
+
+    n_accounts = 16
+    oracle = StateMachineOracle()
+    oracle.create_accounts([Account(id=i, ledger=1, code=1)
+                            for i in range(1, n_accounts + 1)], 1_000)
+    state = router.from_oracle(oracle)
+    ctl = ReshardController(router, chunk_rows=4,
+                            min_double_write_windows=2)
+    plan = ReshardPlan(lo=0, hi=(1 << 63) - 1, src=0, dst=1,
+                       kind="split")
+
+    flight_dir = tempfile.mkdtemp(prefix=f"tb_reshard_chaos_{seed}_")
+    was_dir = os.environ.get("TB_TPU_FLIGHT_DIR")
+    os.environ["TB_TPU_FLIGHT_DIR"] = flight_dir
+
+    nid, ts = [50_000], [10 ** 9]
+
+    def drive(k):
+        """k windows of live traffic; every batch bit-exact vs the
+        never-resharded oracle; a digest-mismatch abort is adopted."""
+        nonlocal state
+        aborted = None
+        for _ in range(k):
+            obj_batches, batches, tss = [], [], []
+            for _b in range(2):
+                evs = []
+                for _i in range(8):
+                    dr = rng.randrange(1, n_accounts + 1)
+                    cr = dr % n_accounts + 1
+                    evs.append(Transfer(
+                        id=nid[0], debit_account_id=dr,
+                        credit_account_id=cr,
+                        amount=rng.randrange(1, 50), ledger=1, code=1))
+                    nid[0] += 1
+                ts[0] += 300
+                obj_batches.append(evs)
+                batches.append(transfers_to_arrays(evs))
+                tss.append(ts[0])
+            try:
+                state = ctl.on_window(state, batches)
+            except MigrationAborted as e:
+                state = e.state
+                aborted = e
+            state, results = router.step_window(state, batches, tss)
+            for evs, t, (st_a, ts_a) in zip(obj_batches, tss, results):
+                want = [(r.timestamp, int(r.status))
+                        for r in oracle.create_transfers(evs, t)]
+                got = [(int(ts_a[i]), int(st_a[i]))
+                       for i in range(len(evs))]
+                assert got == want, \
+                    (f"reshard chaos seed {seed}: history diverged "
+                     f"post-fault", got[:4], want[:4])
+        return aborted
+
+    def artifacts():
+        return _glob.glob(os.path.join(flight_dir,
+                                       "FLIGHT_*_reshard_*"))
+
+    def crash():
+        """The supervisor's recovery path for a crash mid-migration."""
+        nonlocal state
+        ctl.on_recovery()
+        router.restore_devices()
+        state = router.resync(oracle)
+
+    faults = []
+    try:
+        drive(1)  # warm traffic
+
+        # 1. crash right after the SNAPSHOT (stage: copy, cursor 0).
+        state = ctl.begin(state, plan)
+        assert ctl.stage == "copy", ctl.stage
+        crash()
+        faults.append("crash_snapshot")
+        drive(1)
+
+        # 2. crash MID-COPY (cursor advanced, nothing flipped).
+        state = ctl.begin(state, plan)
+        state = ctl.on_window(state)  # one quiesced chunk, no traffic
+        assert ctl.stage == "copy", ctl.stage
+        crash()
+        faults.append("crash_mid_copy")
+        drive(1)
+
+        # 3+4. shard LOSS of the source and of the target mid-copy:
+        # quarantine refuses to serve, then the crash recovery runs.
+        for lost_shard, tag in ((plan.src, "loss_source"),
+                                (plan.dst, "loss_target")):
+            state = ctl.begin(state, plan)
+            state = ctl.on_window(state)
+            router.drop_device(mesh.devices.flat[lost_shard])
+            try:
+                router.step_window(state, *_one_window(rng, n_accounts,
+                                                       nid, ts))
+            except RuntimeError:
+                pass
+            else:
+                raise AssertionError(
+                    f"reshard chaos seed {seed}: served with lost "
+                    f"shard {lost_shard} mid-copy")
+            crash()
+            faults.append(tag)
+            drive(1)
+
+        # 5. crash under DOUBLE-WRITE (overlay entry live, pre-flip).
+        state = ctl.begin(state, plan)
+        guard = 0
+        while ctl.stage == "copy":
+            state = ctl.on_window(state)
+            guard += 1
+            assert guard < 64, ctl.stage
+        assert ctl.stage == "double_write", ctl.stage
+        crash()
+        assert router.ownership.entries == (), router.ownership.entries
+        faults.append("crash_double_write")
+        drive(1)
+
+        # 6. bit-corrupted chunk: the digest witness must abort the
+        # flip, revert the overlay, and keep serving bit-exact.
+        state = ctl.begin(state, plan)
+        ctl.corrupt_next_chunk = True
+        aborted, guard = None, 0
+        while aborted is None:
+            aborted = drive(1)
+            guard += 1
+            assert guard < 64, "corrupted copy never aborted"
+            assert ctl.stage != "done", \
+                f"seed {seed}: flip went through on a corrupted copy"
+        assert aborted.reason == "digest_mismatch", aborted.reason
+        faults.append("digest_mismatch")
+        drive(1)
+
+        # 7. clean migration, then crash AFTER the flip: the MIGRATED
+        # override is the collapsed base override and must survive the
+        # oracle rebuild.
+        state = ctl.begin(state, plan)
+        guard = 0
+        while ctl.stage != "done":
+            drive(1)
+            guard += 1
+            assert guard < 64, ctl.stage
+        entries = router.ownership.entries
+        assert len(entries) == 1 and entries[0][4] == OVERLAY_MIGRATED
+        crash()  # no-op on the idle controller; rebuild honors overlay
+        assert router.ownership.entries == entries
+        faults.append("crash_post_flip")
+        drive(2)
+
+        n_arts = len(artifacts())
+        # Every abort froze an artifact: five crashes/losses + the
+        # digest mismatch (the post-flip crash aborts nothing).
+        assert len(ctl.aborts) == 6, ctl.aborts
+        assert n_arts >= 6, (n_arts, os.listdir(flight_dir))
+        assert len(ctl.migrations) == 1, ctl.migrations
+        dd = partitioned_state_digest(state)
+        want = partitioned_oracle_digest(
+            oracle, a_cap, router.n_shards,
+            overlay=router.ownership.entries)
+        assert dd == want, f"seed {seed}: final digest diverged"
+        assert router.host_fallbacks == fallbacks0
+    finally:
+        if was_dir is None:
+            os.environ.pop("TB_TPU_FLIGHT_DIR", None)
+        else:
+            os.environ["TB_TPU_FLIGHT_DIR"] = was_dir
+        # The cached router must come back clean for the next seed.
+        if router.ownership.entries:
+            from ..parallel.shard_utils import OwnershipTable
+            router.set_ownership(OwnershipTable(
+                router.n_shards, router.ownership.generation + 1, ()))
+    return dict(devices=int(mesh.size), faults=faults,
+                aborts=len(ctl.aborts), artifacts=n_arts,
+                migrations=len(ctl.migrations))
+
+
+def _one_window(rng, n_accounts, nid, ts):
+    """One throwaway window (batches, tss) for the quarantine probe."""
+    from ..ops.batch import transfers_to_arrays
+
+    evs = []
+    for _i in range(8):
+        dr = rng.randrange(1, n_accounts + 1)
+        evs.append(Transfer(id=nid[0], debit_account_id=dr,
+                            credit_account_id=dr % n_accounts + 1,
+                            amount=1, ledger=1, code=1))
+        nid[0] += 1
+    ts[0] += 300
+    return [transfers_to_arrays(evs)], [ts[0]]
 
 
 # ------------------------------------------------------------- CI gate

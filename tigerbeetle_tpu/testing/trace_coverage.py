@@ -441,6 +441,57 @@ def _scenario_overlap(col: _Collector) -> None:
     led.shutdown_staging()
 
 
+def _scenario_reshard(col: _Collector) -> None:
+    """ISSUE 19's elastic-shard plane: one live split migration on a
+    2-shard sub-mesh emits the per-stage reshard_stage spans (snapshot,
+    copy, flip, retire), the reshard_rows_copied counter, and the
+    reshard_overlay_active gauge (raised at double-write activation,
+    dropped back at the flip)."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from ..oracle import StateMachineOracle
+    from ..ops.batch import transfers_to_arrays
+    from ..parallel.partitioned import PartitionedRouter
+    from ..parallel.resharding import ReshardController, ReshardPlan
+    from ..types import Account, Transfer
+
+    assert len(jax.devices()) >= 2, "reshard scenario needs >= 2 devices"
+    tracer = col.make(95)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("batch",))
+    router = PartitionedRouter(mesh, a_cap=1 << 9, t_cap=1 << 11)
+    oracle = StateMachineOracle()
+    oracle.create_accounts([Account(id=i, ledger=1, code=1)
+                            for i in range(1, 17)], 1_000)
+    state = router.from_oracle(oracle)
+    ctl = ReshardController(router, tracer=tracer, chunk_rows=256,
+                            min_double_write_windows=1)
+    state = ctl.begin(state, ReshardPlan(lo=0, hi=(1 << 63) - 1,
+                                         src=0, dst=1, kind="split"))
+    rng = np.random.default_rng(19)
+    nid, ts = 5000, 10 ** 9
+    guard = 0
+    while ctl.stage != "done":
+        evs, tss = [], []
+        for _ in range(2):
+            batch = []
+            for _i in range(4):
+                dr, cr = rng.choice(np.arange(1, 17), 2, replace=False)
+                batch.append(Transfer(id=nid, debit_account_id=int(dr),
+                                      credit_account_id=int(cr),
+                                      amount=1, ledger=1, code=1))
+                nid += 1
+            ts += 300
+            evs.append(transfers_to_arrays(batch))
+            tss.append(ts)
+        state = ctl.on_window(state, evs)
+        state, _ = router.step_window(state, evs, tss)
+        guard += 1
+        assert guard < 32, ctl.stage
+    assert len(ctl.migrations) == 1 and not ctl.aborts, ctl.migrations
+
+
 def _scenario_admission(col: _Collector) -> None:
     """ISSUE 18's admission plane: a tiny seeded overload in front of a
     real supervisor emits the full admission catalog — an
@@ -586,6 +637,7 @@ SCENARIOS = (
     _scenario_router,
     _scenario_partitioned,
     _scenario_overlap,
+    _scenario_reshard,
     _scenario_admission,
     _scenario_slo,
     _scenario_causal_trace,

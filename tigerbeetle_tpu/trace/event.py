@@ -177,6 +177,19 @@ class Event(enum.Enum):
     cross_shard_transfers = _counter(
         "created transfers whose debit and credit accounts live on "
         "different shards (resolved via the exchange join)")
+    reshard_stage = _span(
+        "one stage of a live resharding migration (parallel/"
+        "resharding.py five-stage protocol): stage is snapshot|copy|"
+        "double_write|flip|retire, outcome is ok|abort — an abort "
+        "freezes a flight artifact and reverts the overlay",
+        "stage", "outcome")
+    reshard_rows_copied = _counter(
+        "account+transfer rows streamed source->target by the copy "
+        "stage of a resharding migration (chunked; counted per chunk)")
+    reshard_overlay_active = _gauge(
+        "overlay entries currently active in the ownership table "
+        "(0 = base map only; >0 = a migration is between its first "
+        "double-write window and its retire/flip)")
 
     # ----------------------------------------------------- device telemetry
     # Decoded host-side from the fixed-layout u32 telemetry block the
