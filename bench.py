@@ -233,6 +233,11 @@ def run_bench(platform: str, timeout_s: float) -> dict:
                         json.loads(line[len("##admission "):]))
                 except json.JSONDecodeError:
                     pass
+            elif line.startswith("##profile "):
+                try:
+                    partial.update(json.loads(line[len("##profile "):]))
+                except json.JSONDecodeError:
+                    pass
             elif line.startswith("{"):
                 try:
                     final = json.loads(line)
@@ -396,6 +401,68 @@ def trace_overhead_probe(quick: bool) -> dict:
                                "orphan_spans": asm["orphan_spans"]},
         "request_waterfall": waterfall,
     }
+
+
+def profile_probe_bench(quick: bool) -> dict:
+    """Performance-observatory record (ISSUE 20): a small seeded
+    serving workload run with the sampled dispatch profiler at
+    sampling 1/1, so the ##profile line carries a NON-EMPTY
+    dispatch_device_time histogram for every route the run drives
+    (chain + per-batch here; the partitioned tiers ride the shard
+    probe's mesh when >= 8 devices exist), the static FLOPs/HBM-bytes
+    cost model per tier from the lowered HLO, the achieved-vs-roofline
+    fraction per tier, and the memory watermark vs the committed
+    membudget. Everything is assembled by trace.profile_probe over the
+    run's tracer — the probe adds no dispatches of its own beyond the
+    workload."""
+    import numpy as np
+
+    from tigerbeetle_tpu.serving import ServingSupervisor
+    from tigerbeetle_tpu.trace import (AlertEngine, DispatchProfiler,
+                                       MemWatch, Tracer, profile_probe)
+    from tigerbeetle_tpu.types import Account, Transfer
+
+    tracer = Tracer()
+    prof = DispatchProfiler(tracer=tracer, sample_every=1)
+    mw = MemWatch(tracer=tracer)
+    eng = AlertEngine(tracer=tracer, tick_every=1)
+    sup = ServingSupervisor(a_cap=1 << 9, t_cap=1 << 11,
+                            epoch_interval=4, tracer=tracer,
+                            profiler=prof, memwatch=mw,
+                            alert_engine=eng)
+    sup.create_accounts([Account(id=i, ledger=1, code=1)
+                         for i in range(1, 9)], 10 ** 9)
+    rng = np.random.default_rng(20)
+    ts, tid = 2 * 10 ** 9, 1
+    n_windows = 4 if quick else 8
+
+    def mk_batch(n):
+        nonlocal tid
+        out = []
+        for _ in range(n):
+            dr, cr = (int(x) for x in
+                      rng.choice(np.arange(1, 9), 2, replace=False))
+            out.append(Transfer(id=tid, debit_account_id=dr,
+                                credit_account_id=cr, amount=1,
+                                ledger=1, code=1))
+            tid += 1
+        return out
+
+    for _ in range(n_windows):
+        # W=2 prepares -> the chain (whole-window scan) route.
+        sup.create_transfers_window([mk_batch(64), mk_batch(64)],
+                                    [ts, ts + 10 ** 6])
+        ts += 10 ** 7
+    for _ in range(max(2, n_windows // 2)):
+        # Single small prepare -> the per-batch tier.
+        sup.create_transfers_window([mk_batch(8)], [ts])
+        ts += 10 ** 7
+    sup.verify_epoch()  # final memwatch observation at the quiesce
+    rec = profile_probe(tracer=tracer, profiler=prof)
+    rec["memwatch"] = mw.stats()
+    rec["alerts"] = eng.stats()
+    rec["windows"] = sup.windows_total
+    return rec
 
 
 def shard_balance_probe(quick: bool) -> dict:
@@ -766,6 +833,18 @@ def inner_main() -> None:
     print("##admission " + json.dumps({"admission": admission}),
           flush=True)
 
+    # Performance-observatory record (##profile): sampled
+    # dispatch_device_time histograms per route, the static
+    # FLOPs/HBM-bytes cost model per tier, achieved-vs-roofline
+    # fractions, and the memory watermark vs the committed membudget
+    # (trace/profiler.py + trace/memwatch.py; ISSUE 20).
+    profile = None
+    try:
+        profile = profile_probe_bench(quick)
+    except Exception as e:  # never let the probe kill a bench run
+        profile = {"error": str(e)[:200]}
+    print("##profile " + json.dumps({"profile": profile}), flush=True)
+
     # Dispatch-route record: which kernel route each config's windows
     # took ("chain" = the scan-form whole-window dispatch, the default
     # serving route; "partitioned_chain" = the fused sharded-state
@@ -835,6 +914,10 @@ def inner_main() -> None:
         # Admission-plane record (##admission line): per-class
         # admitted/shed counts, shed line, occupancy, sustained tps.
         "admission": admission,
+        # Performance-observatory record (##profile line): per-route
+        # sampled dispatch timing, static cost model, roofline
+        # fractions, memory watermark.
+        "profile": profile,
         "engine": "device_ledger_scan",
     }
     # Bottleneck analysis (VERDICT r1 #3): where the serving gap lives.
@@ -1018,7 +1101,7 @@ def main() -> None:
                    "config5_oracle_parity", "config6_serving_tps",
                    "serving_batch_latency", "fallback_diagnostics",
                    "dispatch_routes", "shard_balance", "host_staging",
-                   "admission")
+                   "admission", "profile")
     if banked is not None:
         # Self-consistent record: value, per-config numbers AND the
         # platform tag all come from the banked on-chip artifact (a

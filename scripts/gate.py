@@ -77,7 +77,16 @@ REAL 3-replica vortex at sampling 1.0 — one complete orphan-free span
 tree per client request, the commit causally attributed inside it,
 per-pid clock-skew correction from matched bus send/recv pairs, plus
 two negative proofs (dropped trace-context header, dropped root span)
-that must each RED; skip with --no-causality), and the
+that must each RED; skip with --no-causality), the PROFILE leg
+(testing/observatory_smoke.py: the performance observatory — per-route
+dispatch_device_time histograms non-empty with finite
+achieved-vs-roofline fractions, the live memory watermark green vs the
+committed perf/membudget_r*.json with the injected-leak negative RED,
+a seeded latency burn firing the page-severity alert (runbook anchor,
+alert:<rule> tail retention, frozen flight artifact) with the
+alert-disabled and dead-rule negatives, and the measured observatory
+overhead ratio under the membudget's profiler ceiling; skip with
+--no-profile), and the
 op-budget check + jaxhound serving-path lints
 (`perf/opbudget.py --check --lint`): a kernel change that raises any
 tier's heavy-op count or operand bytes past its committed budget
@@ -522,6 +531,36 @@ def run_bench_regression(timeout: int = 600) -> int:
     return rc
 
 
+def run_profile(timeout: int = 900) -> int:
+    """Profile leg: the performance observatory proven live WITH its
+    negatives (testing/observatory_smoke.py) — sampled per-dispatch
+    histograms + static-cost-model roofline fractions per tier, the
+    memory watermark audited green vs the committed
+    perf/membudget_r*.json and the injected-leak arm RED, the seeded
+    latency burn firing the page alert (typed, runbook-anchored,
+    trace-tail-keeping, flight-freezing) with the alert-disabled and
+    dead-rule arms, and the observatory overhead ratio under the
+    membudget's profiler ceiling. Skip with --no-profile."""
+    cmd = [sys.executable, "-c",
+           "from tigerbeetle_tpu.testing import observatory_smoke as s; "
+           "s.observatory_smoke()"]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    print("[gate] profile: dispatch roofline + memwatch budget + "
+          "burn-rate alerts (testing/observatory_smoke.py)", flush=True)
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=env, timeout=timeout)
+        rc = p.returncode
+    except subprocess.TimeoutExpired:
+        print(f"[gate] RED: profile timed out after {timeout}s",
+              flush=True)
+        return 124
+    print(f"[gate] profile rc={rc} in {time.time() - t0:.0f}s",
+          flush=True)
+    return rc
+
+
 def run_static(timeout: int = 900) -> int:
     """Static leg: jaxhound 2.0's four whole-stack passes (device
     determinism, host-determinism AST lint, retrace/recompile audit vs
@@ -611,6 +650,9 @@ def main() -> int:
                     help="skip the causality leg (causal request "
                          "tracing acceptance over a real vortex "
                          "cluster + negative proofs)")
+    ap.add_argument("--no-profile", action="store_true",
+                    help="skip the profile leg (dispatch roofline + "
+                         "memwatch budget + burn-rate alert negatives)")
     ap.add_argument("--no-static", action="store_true",
                     help="skip the static leg (jaxhound determinism/"
                          "retrace/sharding passes + negative proofs)")
@@ -678,6 +720,10 @@ def main() -> int:
         rc = run_bench_regression()
         if rc != 0:
             reds.append(f"bench-reg rc={rc}")
+    if not args.no_profile:
+        rc = run_profile()
+        if rc != 0:
+            reds.append(f"profile rc={rc}")
     if not args.no_static:
         rc = run_static()
         if rc != 0:

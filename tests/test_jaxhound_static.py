@@ -373,6 +373,40 @@ def test_committed_tracebudget_schema():
         os.path.basename(TRACEBUDGET_PATH))
 
 
+def test_newest_round_path_family(tmp_path):
+    """One generalized `_newest_round_path` helper behind all three
+    budget-trail resolvers (op / trace / mem): each picks the highest
+    committed round of ITS prefix, ignores the others' files, and the
+    public helpers resolve the repo's committed heads."""
+    for name in ("opbudget_r02.json", "opbudget_r11.json",
+                 "tracebudget_r01.json", "membudget_r01.json",
+                 "membudget_r03.json", "membudget_r02.json"):
+        (tmp_path / name).write_text("{}")
+    d = str(tmp_path)
+    assert core._newest_round_path(d, "opbudget").endswith(
+        "opbudget_r11.json")
+    assert core._newest_round_path(d, "tracebudget").endswith(
+        "tracebudget_r01.json")
+    assert core._newest_round_path(d, "membudget").endswith(
+        "membudget_r03.json")
+    with pytest.raises(FileNotFoundError):
+        core._newest_round_path(d, "nosuchbudget")
+    # The committed heads resolve (and the membudget one is a valid
+    # static-allocation budget the memwatch plane can audit against).
+    for helper, prefix in (
+            (core.newest_budget_path, "opbudget"),
+            (core.newest_tracebudget_path, "tracebudget"),
+            (core.newest_membudget_path, "membudget")):
+        path = helper()
+        assert os.path.basename(path).startswith(prefix + "_r"), path
+        assert os.path.exists(path), path
+    from tigerbeetle_tpu.trace import load_budget
+    budget = load_budget()
+    assert budget["components"] and budget["total_bytes"] == \
+        sum(budget["components"].values())
+    assert budget["profiler"]["overhead_ratio_max"] == 1.05
+
+
 # ---------------------------------------------------- sharding verify
 
 @pytest.fixture(scope="module")

@@ -335,6 +335,34 @@ def test_bench_trajectory_guard(tmp_path, monkeypatch):
     assert latency_smoke.check_trajectory() == 1
 
 
+def test_bench_trajectory_backcompat_pre_observatory_records(tmp_path):
+    """Schema stability across record generations (ISSUE 20): a
+    pre-observatory BENCH record — no `profile` sub-dict anywhere —
+    must audit identically to a new record that carries the full
+    ##profile payload. The trajectory audit keys only on the pinned
+    serving p99, and the ratio check still bites across the
+    generation boundary."""
+    from tigerbeetle_tpu.testing import latency_smoke
+
+    old = {"config": {"quick": True},
+           "parsed": {"serving_batch_latency": {"p99_ms": 80.0}}}
+    assert "profile" not in old and "profile" not in old["parsed"]
+    new = {"config": {"quick": True},
+           "parsed": {"serving_batch_latency": {"p99_ms": 88.0}},
+           "profile": {"cost_model": {"tiers": {}},
+                       "dispatch_device_time": {}, "roofline": {},
+                       "memwatch": {"reds": []}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(old))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(new))
+    bench_glob = str(tmp_path / "BENCH_r*.json")
+    assert latency_smoke.check_trajectory(bench_glob) == 0
+    # The guard still REDs across the boundary: a regressed NEW record
+    # against an old-format best prior.
+    (tmp_path / "BENCH_r03.json").write_text(json.dumps(
+        dict(new, parsed={"serving_batch_latency": {"p99_ms": 170.0}})))
+    assert latency_smoke.check_trajectory(bench_glob) == 1
+
+
 # ------------------------------------------------------- devhub panels
 
 def test_devhub_slo_and_critical_path_panels(tmp_path):

@@ -840,6 +840,139 @@ def render(history_path: str, out_path: str,
             + "<table><tr><th>trace id</th><th>total ms</th>"
               "<th>owner</th><th>kept</th><th>waterfall</th></tr>"
             + "".join(rows_wf) + "</table>")
+    # Performance-observatory panels (ISSUE 20, bench ##profile):
+    # achieved-vs-roofline fraction per dispatch tier, the sampled
+    # dispatch_device_time series, the memory watermark vs the NEWEST
+    # committed perf/membudget_r*.json (resolved, not hardcoded — a new
+    # budget round shows up without a devhub edit), and the burn-rate
+    # alert engine's rule catalog + firing state.
+    obs_html = ""
+    pf = next((e.get("profile") for e in reversed(entries)
+               if isinstance(e.get("profile"), dict)
+               and "error" not in e.get("profile", {})), None)
+    if pf:
+        # Roofline attribution per tier.
+        rows_rf = []
+        for tier in sorted(pf.get("roofline") or {}):
+            d = pf["roofline"][tier] or {}
+            frac = float(d.get("fraction") or 0.0)
+            bar = '<div style="background:#a42;height:10px;width:{}px">' \
+                  '</div>'.format(max(1, round(min(frac, 1.0) * 240)))
+            rows_rf.append(
+                "<tr><td>{}</td><td>{:.3f}</td><td>{:.3f}</td>"
+                "<td>{:.1%}</td><td>{}</td></tr>".format(
+                    html.escape(tier),
+                    float(d.get("roofline_seconds") or 0.0) * 1e3,
+                    float(d.get("measured_p50_s") or 0.0) * 1e3,
+                    frac, bar))
+        rows_dd = []
+        for key in sorted(pf.get("dispatch_device_time") or {}):
+            m = pf["dispatch_device_time"][key] or {}
+            p50, p99 = m.get("p50_us"), m.get("p99_us")
+            rows_dd.append(
+                "<tr><td><code>{}</code></td><td>{}</td><td>{}</td>"
+                "<td>{}</td></tr>".format(
+                    html.escape(key), m.get("count", 0),
+                    "-" if p50 is None else f"{p50 / 1e3:.3f}",
+                    "-" if p99 is None else f"{p99 / 1e3:.3f}"))
+        sampler = pf.get("sampler") or {}
+        plat = (pf.get("cost_model") or {}).get("platform", "-")
+        obs_html += (
+            "<h2>performance observatory: dispatch roofline "
+            "(latest run)</h2>"
+            "<p>platform {} &middot; {} dispatches, {} sampled "
+            "(1-in-{})</p>".format(
+                html.escape(str(plat)), sampler.get("dispatches", "-"),
+                sampler.get("samples", "-"),
+                sampler.get("sample_every", "-"))
+            + "<table><tr><th>tier</th><th>roofline ms</th>"
+              "<th>measured p50 ms</th><th>of roofline</th><th></th>"
+              "</tr>" + "".join(rows_rf) + "</table>"
+            + "<table><tr><th>dispatch series</th><th>samples</th>"
+              "<th>p50 ms</th><th>p99 ms</th></tr>"
+            + "".join(rows_dd) + "</table>")
+        # Memory watermark vs the committed membudget pins.
+        mwr = (pf.get("memwatch") or {}).get("last") or {}
+        reds = (pf.get("memwatch") or {}).get("reds") or []
+        if mwr:
+            pins = {}
+            try:
+                from .jaxhound import newest_membudget_path
+                with open(newest_membudget_path()) as f:
+                    pins = json.load(f).get("components", {})
+            except (OSError, ValueError, ImportError):
+                pass
+            rows_mw = []
+            comps = mwr.get("components") or {}
+            for name in sorted(set(comps) | set(pins)):
+                cur, pin = comps.get(name), pins.get(name)
+                over = (cur is not None and pin is not None
+                        and cur > pin)
+                flag = ('<span style="color:#c22;font-weight:600">OVER '
+                        'PIN</span>' if over else "")
+                rows_mw.append(
+                    "<tr><td>{}</td><td>{}</td><td>{}</td><td>{}</td>"
+                    "</tr>".format(
+                        html.escape(name),
+                        "-" if cur is None else cur,
+                        "-" if pin is None else pin, flag))
+            badge_mw = ""
+            if reds:
+                badge_mw = ('<p style="color:#c22;font-weight:700">'
+                            'MEMORY WATERMARK RED: '
+                            + html.escape("; ".join(reds)[:300]) + "</p>")
+            head = mwr.get("headroom_bytes")
+            obs_html += (
+                "<h2>memory watermark (vs committed membudget)</h2>"
+                + badge_mw
+                + "<p>{} resident bytes &middot; headroom {} &middot; "
+                  "{} observation(s)</p>".format(
+                      mwr.get("total_bytes", "-"),
+                      "-" if head is None else head,
+                      (pf.get("memwatch") or {}).get(
+                          "observations", "-"))
+                + "<table><tr><th>component</th><th>measured</th>"
+                  "<th>budget pin</th><th></th></tr>"
+                + "".join(rows_mw) + "</table>")
+        # Burn-rate alerts: declared rules + the latest run's verdicts.
+        al = pf.get("alerts") or {}
+        rules_cfg = []
+        try:
+            from .trace.alerts import load_alert_rules
+            rules_cfg = load_alert_rules()["rules"]
+        except (OSError, ValueError, ImportError):
+            pass
+        if rules_cfg or al:
+            active = set(al.get("active") or [])
+            rows_al = []
+            for r in rules_cfg:
+                state = ("<span style='color:#c22;font-weight:600'>"
+                         "FIRING</span>" if r.name in active else "ok")
+                rows_al.append(
+                    "<tr><td>{}</td><td>{}</td><td>{}</td>"
+                    "<td>{}/{} ticks @ {:g}/{:g}</td>"
+                    "<td><a href=\"{}\">runbook</a></td><td>{}</td>"
+                    "</tr>".format(
+                        html.escape(r.name), html.escape(r.objective),
+                        html.escape(r.severity), r.fast_window,
+                        r.slow_window, r.fast_burn, r.slow_burn,
+                        html.escape(r.runbook), state))
+            badge_al = ""
+            if active:
+                badge_al = ('<p style="color:#c22;font-weight:700">'
+                            'ALERT FIRING: '
+                            + html.escape(", ".join(sorted(active)))
+                            + "</p>")
+            obs_html += (
+                "<h2>burn-rate alerts (perf/slo.json rules)</h2>"
+                + badge_al
+                + "<p>{} rule(s), {} tick(s) evaluated, {} fired "
+                  "total</p>".format(
+                      len(rules_cfg) or al.get("rules", "-"),
+                      al.get("ticks", "-"), al.get("fired_total", "-"))
+                + "<table><tr><th>rule</th><th>objective</th>"
+                  "<th>severity</th><th>windows</th><th></th><th></th>"
+                  "</tr>" + "".join(rows_al) + "</table>")
     # CFO: the failing-seed feed (reference: cfo.zig pushes failing
     # seeds to devhubdb; a green fleet is part of the dashboard).
     cfo_html = ""
@@ -890,6 +1023,7 @@ sparklines (reference: devhub.tigerbeetle.com).</p>
 {slo_html}
 {cp_html}
 {wf_html}
+{obs_html}
 {cfo_html}
 </body></html>"""
     with open(out_path, "w") as f:

@@ -51,6 +51,7 @@ from .core import (  # noqa: F401 — the package's public census/lint API
     heavy_census,
     kernels,
     newest_budget_path,
+    newest_membudget_path,
     newest_tracebudget_path,
     report,
     scan_bodies,

@@ -256,6 +256,14 @@ def newest_tracebudget_path(perf_dir: str | None = None) -> str:
     return _newest_round_path(perf_dir, "tracebudget")
 
 
+def newest_membudget_path(perf_dir: str | None = None) -> str:
+    """Path of the NEWEST committed perf/membudget_r*.json — the
+    static-allocation memory-budget trail (per-component resident
+    bytes for the serving ledger, trace/memwatch.py), same
+    append-oriented regime as the op-budget trail."""
+    return _newest_round_path(perf_dir, "membudget")
+
+
 # ----------------------------------------------------------- static lints
 
 CLOSURE_CONST_LIMIT = 4096  # bytes; PERF.md: ~64 ms/call at 0.5 MB

@@ -66,11 +66,19 @@ def _exemplar_suffix(ex: dict) -> str:
 
 def render_prometheus(tracers, slo_rows: Optional[list] = None,
                       burn: Optional[dict] = None,
+                      alert_engine=None,
                       prefix: str = "tb_tpu") -> str:
     """Render one or many tracers' registries as Prometheus text.
     Multiple tracers (e.g. an in-process cluster's replicas) merge:
     counters add, gauges keep the last writer, histograms merge
-    losslessly per series key."""
+    losslessly per series key.
+
+    With `alert_engine` (a trace.alerts.AlertEngine), the engine's
+    firing state renders in Prometheus' own ALERTS idiom —
+    `{prefix}_alerts{alertname=...,severity=...} 1` for every ACTIVE
+    alert plus a `{prefix}_alerts_fired_total` counter per rule — so
+    an Alertmanager-style consumer sees the same shape it would from a
+    real Prometheus rule evaluation."""
     if not isinstance(tracers, (list, tuple)):
         tracers = [tracers]
     counters: dict = {}
@@ -169,6 +177,27 @@ def render_prometheus(tracers, slo_rows: Optional[list] = None,
         for name in sorted(burn):
             lab = _labels({"objective": name})
             lines.append(f"{metric}{lab} {_fmt(burn[name]['burn_rate'])}")
+    if alert_engine is not None:
+        metric = f"{prefix}_alerts"
+        lines.append(f"# HELP {metric} active burn-rate alerts "
+                     f"(ALERTS-style: one series per firing rule, "
+                     f"value 1)")
+        lines.append(f"# TYPE {metric} gauge")
+        for name in sorted(alert_engine.active):
+            a = alert_engine.active[name]
+            lines.append(f"{metric}{_labels({'alertname': name, 'severity': a.severity, 'alertstate': 'firing'})} 1")
+        metric = f"{prefix}_alerts_fired_total"
+        lines.append(f"# HELP {metric} burn-rate alert firings per "
+                     f"rule since process start")
+        lines.append(f"# TYPE {metric} counter")
+        fired_by_rule: dict = {}
+        for a in alert_engine.fired:
+            fired_by_rule[a.rule] = fired_by_rule.get(a.rule, 0) + 1
+        for name in sorted(fired_by_rule):
+            sev = next(a.severity for a in alert_engine.fired
+                       if a.rule == name)
+            lines.append(f"{metric}{_labels({'alertname': name, 'severity': sev})} "
+                         f"{fired_by_rule[name]}")
     return "\n".join(lines) + "\n"
 
 

@@ -169,7 +169,8 @@ class ServingSupervisor:
                  epoch_interval: int = 8, retry: RetryPolicy | None = None,
                  seed: int = 0, mirror_audit: str = "full",
                  fault_hook=None, sleep=time.sleep, tracer=None,
-                 flight_recorder=None, pipeline_depth: int = 2):
+                 flight_recorder=None, pipeline_depth: int = 2,
+                 profiler=None, memwatch=None, alert_engine=None):
         assert mirror_audit in ("full", "spot", "off")
         self.tracer = tracer if tracer is not None else NullTracer()
         # Flight recorder: every window's route decision and every
@@ -178,6 +179,18 @@ class ServingSupervisor:
         # freezes the ring into a post-mortem artifact.
         self.flight = flight_recorder if flight_recorder is not None \
             else FlightRecorder(tracer=self.tracer)
+        # Performance observatory (ISSUE 20): all three hooks are
+        # optional and None by default — the unobserved serving path
+        # pays nothing. The profiler samples window dispatches, the
+        # memwatch ticks at every verified epoch (the natural quiesce
+        # point), and the alert engine ticks once per committed window
+        # in the same tracer + flight-recorder universe as everything
+        # else (a page-severity firing dumps OUR flight ring).
+        self.profiler = profiler
+        self.memwatch = memwatch
+        self.alert_engine = alert_engine
+        if alert_engine is not None:
+            alert_engine.bind(self.tracer, self.flight)
         self.a_cap = a_cap
         self.t_cap = t_cap
         self.epoch_interval = epoch_interval
@@ -345,6 +358,7 @@ class ServingSupervisor:
             evs = [transfers_to_arrays(b) for b in batches]
             return self.led.create_transfers_window(evs, timestamps)
 
+        thunk = self._profiled(thunk)
         # window_commit wraps submit→resolve and is tagged late (the
         # ledger only knows which route it took after dispatch), so
         # each window lands in its route/tier latency class — the
@@ -380,6 +394,7 @@ class ServingSupervisor:
         self.history.append(norm)
         self.windows_total += 1
         self._windows_since_epoch += 1
+        self._observatory_tick()
         if self._windows_since_epoch >= self.epoch_interval:
             self.verify_epoch()
         return out
@@ -467,6 +482,7 @@ class ServingSupervisor:
             self._close_window_span(rec)
         self.windows_total += 1
         self._windows_since_epoch += 1
+        self._observatory_tick()
         if self._windows_since_epoch >= self.epoch_interval:
             self.verify_epoch()
         return hist_idx
@@ -531,6 +547,28 @@ class ServingSupervisor:
         self.log.append(("expire", None, timestamp))
         self.history.append(n)
         return n
+
+    def _profiled(self, thunk):
+        """Wrap one WINDOW dispatch thunk in the sampled profiler (when
+        attached). Route/tier are resolved late — the ledger records
+        them only after dispatching — via the profiler's callable-tag
+        hook. Non-window dispatches stay unwrapped: the window routes
+        (chain / partitioned_chain / per-batch) are the dispatch
+        surface the roofline model attributes."""
+        prof = self.profiler
+        if prof is None:
+            return thunk
+        return lambda: prof.time(
+            thunk,
+            route=lambda: self.led.last_window_route or "unknown",
+            tier=lambda: self.led.last_window_tier or "-")
+
+    def _observatory_tick(self) -> None:
+        """Advance the alert engine one committed window (it decimates
+        internally); runs at every window close on both serving
+        paths."""
+        if self.alert_engine is not None:
+            self.alert_engine.tick()
 
     def _dispatch(self, thunk, *, what: str = "", win: int | None = None,
                   deadline_s: float | None = None):
@@ -643,6 +681,11 @@ class ServingSupervisor:
             self.log.clear()
             self._windows_since_epoch = 0
             self._epoch_trace_ids.clear()
+            # Memory watermark at the quiesce point: the pipeline is
+            # drained, so the measured components are the steady-state
+            # residents (plus whatever pack the stager holds).
+            if self.memwatch is not None:
+                self.memwatch.observe(self.led)
             return True
         self._recover(cause, detail=detail, replayed=replayed)
         return False
@@ -803,5 +846,14 @@ class ServingSupervisor:
         out["flight"] = {"windows_recorded": self.flight.seq,
                          "dumps": self.flight.dumps,
                          "last_dump": self.flight.last_dump_path}
+        observatory = {}
+        if self.profiler is not None:
+            observatory["profiler"] = self.profiler.stats()
+        if self.memwatch is not None:
+            observatory["memwatch"] = self.memwatch.stats()
+        if self.alert_engine is not None:
+            observatory["alerts"] = self.alert_engine.stats()
+        if observatory:
+            out["observatory"] = observatory
         out["ledger"] = self.led.fallback_stats()
         return out

@@ -115,13 +115,17 @@ def measure(windows: int = MEASURE_WINDOWS,
     return hist
 
 
-def check_trajectory() -> int:
+def check_trajectory(bench_glob: str | None = None) -> int:
     """Audit the committed BENCH_r*.json pinned p99 series, from the
     TRAJECTORY_RESTART record forward (earlier records measured a
     different workload shape — see the marker's comment). Returns
     failure count; records without the series are reported, never
-    silently skipped."""
-    paths = sorted(glob.glob(BENCH_GLOB))
+    silently skipped. Schema-stable across record generations: the
+    audit keys ONLY on `parsed.serving_batch_latency.p99_ms`, so
+    pre-observatory records (no `profile` sub-dict — every round
+    before ISSUE 20) audit identically to new ones (`bench_glob` lets
+    tests prove that on synthetic old records)."""
+    paths = sorted(glob.glob(bench_glob or BENCH_GLOB))
     names = [os.path.basename(p) for p in paths]
     if TRAJECTORY_RESTART in names:
         paths = paths[names.index(TRAJECTORY_RESTART):]

@@ -584,6 +584,34 @@ def _scenario_slo(col: _Collector) -> None:
     assert tracer.counters.get("slo_breach", 0) >= len(forced)
 
 
+def _scenario_observatory(col: _Collector) -> None:
+    """ISSUE 20's observatory events, each through its real producer:
+    a sampled dispatch feeding the dispatch_device_time histogram, one
+    memory-watermark observation against the committed membudget (both
+    gauges), and a seeded latency burn firing alert_fired through the
+    burn-rate engine — so any of the four going dead REDs this leg."""
+    from ..serving import ServingSupervisor
+    from ..trace import AlertEngine, DispatchProfiler, MemWatch, \
+        mint_context
+
+    tracer = col.make(0)
+    prof = DispatchProfiler(tracer=tracer, sample_every=1)
+    out = prof.time(lambda: 41 + 1, route="chain", tier="scan")
+    assert out == 42 and prof.samples == 1, prof.stats()
+    sup = ServingSupervisor(a_cap=1 << 6, t_cap=1 << 8, tracer=tracer)
+    mw = MemWatch(tracer=tracer)
+    rec = mw.observe(sup.led)
+    assert "headroom_bytes" in rec, \
+        "no committed membudget — headroom gauge would go dead"
+    eng = AlertEngine(tracer=tracer, tick_every=1)
+    for i in range(8):
+        tracer.record_span(Event.window_commit, tracer.now_ns(),
+                           int(600e6), ctx=mint_context(9, i),
+                           route="chain", tier="scan")
+        eng.tick()
+    assert eng.fired, eng.stats()
+
+
 def _scenario_causal_trace(col: _Collector) -> None:
     """ISSUE 15's causal plane end to end in the simulator: a traced
     cluster plus a traced client emits the per-request spans
@@ -640,6 +668,7 @@ SCENARIOS = (
     _scenario_reshard,
     _scenario_admission,
     _scenario_slo,
+    _scenario_observatory,
     _scenario_causal_trace,
 )
 

@@ -28,20 +28,39 @@ Layout mirrors the reference:
   telemetry + route decisions + epoch digests, dumped as a JSON
   artifact on quarantine/recovery/retry-exhaustion, with lossless
   cross-replica merge via the shared histogram layout.
+- `profiler.py` — the performance observatory's dispatch side: sampled
+  block-until-ready dispatch timing (`dispatch_device_time`), optional
+  programmatic jax.profiler capture, and the static FLOPs/HBM-bytes
+  cost model + achieved-vs-roofline fractions per dispatch tier.
+- `memwatch.py` — device-memory watermark plane: the deterministic
+  static-allocation ledger (bytes per component from shapes) audited
+  against the committed perf/membudget_r*.json, plus per-device
+  allocator stats where the backend exposes them.
+- `alerts.py`  — SRE-style multi-window multi-burn-rate alert engine
+  over the SLO objectives, in commit-window-tick time: typed alerts
+  with runbook anchors, `alert:<rule>` tail retention, and page-
+  severity flight-recorder freezes.
 
 The tracer is injected at construction into the replica, journal, grid
 scrubber, message bus, serving supervisor, and sharded router; see
 docs/operating/monitoring.md for the operator-facing catalog.
 """
 
+from .alerts import Alert, AlertEngine, AlertRule, load_alert_rules
 from .context import (TraceContext, fmt_span_id, fmt_trace_id,
                       head_sampled, mint_context, mint_trace_id)
 from .event import CATALOG, TID_BASE, Event, EventKind, EventSpec, lookup
 from .flight_recorder import FlightRecorder, merge_flight_records
 from .histogram import Histogram
+from .memwatch import (MemWatch, check_budget, device_memory_stats,
+                       load_budget, measure_ledger, pytree_bytes,
+                       static_ledger)
 from .merge import (CRITICAL_PATH_STAGES, assemble_traces, causal_edges,
                     critical_path, estimate_clock_offsets,
                     merge_trace_files, merge_traces, span_quantile)
+from .profiler import (DispatchProfiler, measured_dispatch_us,
+                       profile_probe, roofline_fractions,
+                       roofline_seconds, static_cost_model)
 from .slo import (Objective, burn_rates, evaluate, evaluate_bench_record,
                   load_objectives)
 from .statsd import StatsD, TimingAggregates
@@ -58,4 +77,9 @@ __all__ = [
     "Objective", "burn_rates", "evaluate", "evaluate_bench_record",
     "load_objectives", "StatsD", "TimingAggregates",
     "NullTracer", "Tracer",
+    "Alert", "AlertEngine", "AlertRule", "load_alert_rules",
+    "MemWatch", "check_budget", "device_memory_stats", "load_budget",
+    "measure_ledger", "pytree_bytes", "static_ledger",
+    "DispatchProfiler", "measured_dispatch_us", "profile_probe",
+    "roofline_fractions", "roofline_seconds", "static_cost_model",
 ]

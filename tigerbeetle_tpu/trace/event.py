@@ -253,6 +253,34 @@ class Event(enum.Enum):
         "poison cause, supervisor recovery) regardless of the head-"
         "sampling decision", "reason")
 
+    # ------------------------------------------- performance observatory
+    # ISSUE 20: sampled dispatch profiling, device-memory watermarks,
+    # and burn-rate alerting (trace/profiler.py, trace/memwatch.py,
+    # trace/alerts.py). `dispatch_device_time` is the profiler's
+    # measured device time of one SAMPLED dispatch (block-until-ready
+    # timer, or a jax.profiler capture where the backend supports it);
+    # the memory gauges are the host-side static-allocation ledger's
+    # watermark vs the committed perf/membudget_r*.json; `alert_fired`
+    # counts typed alert firings from the multi-window burn-rate engine.
+    dispatch_device_time = _histogram(
+        "device time of one sampled serving dispatch (unit: us; "
+        "sampled 1/N by trace/profiler.py DispatchProfiler, partitioned "
+        "by dispatch route and shape tier — the measured side of the "
+        "achieved-vs-roofline fraction)", "route", "tier")
+    memory_watermark_bytes = _gauge(
+        "static-allocation ledger watermark: bytes the serving ledger "
+        "holds resident (state pytree + staged packs + telemetry block "
+        "+ scratch), summed across components by trace/memwatch.py — "
+        "checked against the committed perf/membudget_r*.json")
+    memory_budget_headroom_bytes = _gauge(
+        "committed memory budget minus the current watermark (negative "
+        "= over budget, the memwatch gate leg REDs)")
+    alert_fired = _counter(
+        "typed alerts fired by the multi-window burn-rate engine "
+        "(trace/alerts.py), by rule and severity; a page-severity "
+        "firing freezes a flight-recorder artifact and tail-keeps the "
+        "breaching traces under reason alert:<rule>", "rule", "severity")
+
     # ------------------------------------------------------ tracer internal
     trace_dropped_events = _counter(
         "span ring evictions (the trace is truncated at its start)")
